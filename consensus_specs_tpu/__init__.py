@@ -1,0 +1,23 @@
+"""consensus_specs_tpu — a TPU-native executable consensus-spec framework.
+
+A from-scratch rebuild of the capabilities of ethereum/consensus-specs
+(reference mounted at /root/reference, v1.4.0-beta.7): SSZ type system with
+incremental merkleization, per-fork beacon-chain state-transition machines,
+LMD-GHOST fork choice, a conformance test harness and cross-client vector
+generators — with the cryptography layer (BLS12-381 signatures, KZG
+commitments, SHA-256 merkleization) implemented as batched JAX kernels that
+jit-compile for TPU, behind the same pluggable ``bls`` module switch the
+reference uses (reference: tests/core/pyspec/eth2spec/utils/bls.py:61-90).
+
+Layout:
+  utils/      hash, SSZ types + merkleization, bls backend switch
+  ops/        numeric kernels (SHA-256, BLS12-381 field/curve/pairing, MSM)
+  parallel/   device-mesh sharding for the crypto kernels (pjit/shard_map)
+  forks/      per-fork spec runtimes (phase0, altair, ...), preset-bound
+  compiler/   markdown-spec compiler (specs -> importable modules)
+  config/     preset/config two-tier constant system
+  presets/    compile-time constant data (minimal, mainnet)
+  configs/    runtime config data
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,31 @@
+"""Preset / config system.
+
+Mirrors the reference's two-tier constant system (reference:
+``setup.py:306-331``, ``tests/core/pyspec/eth2spec/config/config_util.py``):
+
+* **presets** — compile-time constants (SSZ list lengths, committee sizes)
+  loaded from ``consensus_specs_tpu/presets/<preset>/<fork>.yaml``.
+* **configs** — runtime-swappable parameters (fork epochs, genesis params)
+  loaded from ``consensus_specs_tpu/configs/<name>.yaml``.
+
+Unlike the reference (which bakes presets into generated modules and rewrites
+config references via regex), our spec classes bind both at instance-build
+time, so a test can instantiate a spec with config overrides in one call.
+"""
+from .loader import (
+    load_preset,
+    load_config,
+    load_config_file,
+    parse_config_vars,
+    preset_dir,
+    config_path,
+)
+
+__all__ = [
+    "load_preset",
+    "load_config",
+    "load_config_file",
+    "parse_config_vars",
+    "preset_dir",
+    "config_path",
+]

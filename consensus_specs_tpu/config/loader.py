@@ -1,0 +1,84 @@
+"""Load preset and config YAML into typed python values.
+
+Reference behavior: ``setup.py:306-331`` (load_preset/load_config) and
+``config/config_util.py:5-63`` (parse_config_vars). Values that look like
+integers become ``int``; ``0x…`` values stay as hex strings at this layer
+(spec construction converts them to the right SSZ byte types).
+"""
+import os
+from pathlib import Path
+from typing import Any, Dict
+
+PKG_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _read_flat_yaml(path) -> Dict[str, str]:
+    """Parse a flat ``KEY: value`` yaml file preserving ``0x…`` tokens.
+
+    PyYAML eagerly converts unquoted ``0x…`` scalars to int, destroying the
+    byte width of Version/Hash constants — so preset/config files (which are
+    strictly flat) are parsed directly.
+    """
+    out: Dict[str, str] = {}
+    for raw in open(path):
+        line = raw.split("#", 1)[0].strip()
+        if not line or ":" not in line:
+            continue
+        k, v = line.split(":", 1)
+        out[k.strip()] = v.strip().strip("'\"")
+    return out
+
+
+def preset_dir(preset_name: str) -> Path:
+    return PKG_ROOT / "presets" / preset_name
+
+
+def config_path(config_name: str) -> Path:
+    return PKG_ROOT / "configs" / (config_name + ".yaml")
+
+
+def _parse_value(v: Any) -> Any:
+    if isinstance(v, int):
+        return v
+    if isinstance(v, str):
+        s = v.strip()
+        if s.startswith("0x"):
+            return s  # hex constant; typed later
+        if s.isdigit() or (s.startswith("-") and s[1:].isdigit()):
+            return int(s)
+    return v
+
+
+def load_preset(preset_name: str, forks=None) -> Dict[str, Any]:
+    """Merge all per-fork preset files for a preset base into one dict.
+
+    ``forks`` restricts which fork preset files are merged (ordered); by
+    default every stable fork file present on disk is merged in fork order.
+    """
+    order = forks or [
+        "phase0", "altair", "bellatrix", "capella", "deneb",
+        "eip6110", "eip7594", "whisk",
+    ]
+    base = preset_dir(preset_name)
+    if not base.is_dir():
+        raise FileNotFoundError(f"unknown preset: {preset_name!r} ({base})")
+    out: Dict[str, Any] = {}
+    for fork in order:
+        p = base / (fork + ".yaml")
+        if not p.exists():
+            continue
+        for k, v in _read_flat_yaml(p).items():
+            out[k] = _parse_value(v)
+    return out
+
+
+def parse_config_vars(conf: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: _parse_value(v) for k, v in conf.items()}
+
+
+def load_config_file(path: os.PathLike) -> Dict[str, Any]:
+    return parse_config_vars(_read_flat_yaml(path))
+
+
+def load_config(config_name: str) -> Dict[str, Any]:
+    return load_config_file(config_path(config_name))

@@ -1,0 +1,8 @@
+"""Harness globals set by pytest CLI flags (filled out with the decorator DSL).
+
+Reference: tests/core/pyspec/eth2spec/test/context.py + conftest.py.
+"""
+DEFAULT_TEST_PRESET = "minimal"
+DEFAULT_BLS_ACTIVE = True
+DEFAULT_BLS_TYPE = "py"
+ONLY_FORK = None

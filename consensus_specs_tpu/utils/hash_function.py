@@ -1,0 +1,13 @@
+"""SHA-256 ``hash`` primitive.
+
+Reference: ``tests/core/pyspec/eth2spec/utils/hash_function.py`` (the spec's
+``hash(data) -> Bytes32`` is plain SHA-256). Single-shot hashing stays on
+hashlib (C speed); *batched* layer hashing for merkleization goes through
+``consensus_specs_tpu.ops.sha256`` so big trees can use the vectorized
+kernel.
+"""
+from hashlib import sha256 as _sha256
+
+
+def hash(data: bytes) -> bytes:
+    return _sha256(data).digest()

@@ -1,0 +1,106 @@
+"""Merkleization engine.
+
+Implements the SSZ merkleization rules (reference: ``ssz/simple-serialize.md``
+"Merkleization" section): chunkify, pad to the chunk-count limit with
+zero-subtree roots, binary-tree hash, plus ``mix_in_length`` /
+``mix_in_selector``.
+
+Design note (TPU): each tree level is hashed through :func:`hash_layer`,
+which takes one contiguous byte buffer of 64-byte parent inputs. That is the
+natural batch boundary for the vectorized SHA-256 kernel
+(``consensus_specs_tpu.ops.sha256``) — a 1M-leaf tree becomes ~20 kernel
+calls instead of ~2M scalar hashes. A hashlib loop is the small-batch
+fallback.
+"""
+from hashlib import sha256
+from typing import List, Optional, Sequence
+
+ZERO_CHUNK = b"\x00" * 32
+
+# zero_hashes[i] = root of an all-zero subtree of depth i
+zero_hashes: List[bytes] = [ZERO_CHUNK]
+for _ in range(64):
+    h = sha256(zero_hashes[-1] + zero_hashes[-1]).digest()
+    zero_hashes.append(h)
+
+# Threshold (number of 64-byte parent inputs) above which layer hashing is
+# dispatched to the batched kernel instead of a hashlib loop.
+_BATCH_THRESHOLD = 256
+
+_batched_hasher = None
+
+
+def set_batched_hasher(fn) -> None:
+    """Install a batched hasher: fn(data: bytes, n: int) -> bytes (n*32 out).
+
+    ``data`` is ``n`` concatenated 64-byte blocks; result is ``n``
+    concatenated 32-byte digests. Used by the JAX/TPU SHA-256 kernel.
+    """
+    global _batched_hasher
+    _batched_hasher = fn
+
+
+def hash_layer(data: bytes) -> bytes:
+    """Hash a full tree layer: data is n*64 bytes -> n*32 bytes."""
+    n = len(data) // 64
+    if _batched_hasher is not None and n >= _BATCH_THRESHOLD:
+        return _batched_hasher(data, n)
+    out = bytearray(n * 32)
+    for i in range(n):
+        out[i * 32:(i + 1) * 32] = sha256(data[i * 64:(i + 1) * 64]).digest()
+    return bytes(out)
+
+
+def next_power_of_two(v: int) -> int:
+    if v <= 1:
+        return 1
+    return 1 << (v - 1).bit_length()
+
+
+def ceil_log2(v: int) -> int:
+    return (v - 1).bit_length() if v > 1 else 0
+
+
+def merkleize_chunks(chunks: Sequence[bytes], limit: Optional[int] = None) -> bytes:
+    """Merkleize 32-byte chunks, padding (virtually) to ``limit`` chunks.
+
+    ``limit=None`` pads to the next power of two of ``len(chunks)``. A limit
+    smaller than the chunk count is an error. Virtual zero-padding uses
+    ``zero_hashes`` so a 2^40-chunk registry limit costs 40 extra hashes, not
+    2^40.
+    """
+    count = len(chunks)
+    if limit is None:
+        limit = next_power_of_two(count)
+    else:
+        if count > limit:
+            raise ValueError(f"chunk count {count} exceeds limit {limit}")
+        limit = next_power_of_two(limit)
+    depth = ceil_log2(limit)
+
+    if count == 0:
+        return zero_hashes[depth]
+
+    layer = b"".join(chunks)
+    for level in range(depth):
+        n = len(layer) // 32
+        if n % 2 == 1:
+            layer += zero_hashes[level]
+            n += 1
+        layer = hash_layer(layer)
+    return layer
+
+
+def mix_in_length(root: bytes, length: int) -> bytes:
+    return sha256(root + length.to_bytes(32, "little")).digest()
+
+
+def mix_in_selector(root: bytes, selector: int) -> bytes:
+    return sha256(root + selector.to_bytes(32, "little")).digest()
+
+
+def pack_bytes_into_chunks(data: bytes) -> List[bytes]:
+    """Right-pad ``data`` with zeros to a multiple of 32 and split."""
+    if len(data) % 32 != 0:
+        data = data + b"\x00" * (32 - len(data) % 32)
+    return [data[i:i + 32] for i in range(0, len(data), 32)] or []

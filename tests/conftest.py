@@ -1,0 +1,42 @@
+"""Test-session config.
+
+JAX is pinned to an 8-device virtual CPU platform before first import so
+sharding/pjit tests exercise real multi-device code paths without TPU
+hardware (the driver separately dry-runs the multi-chip path via
+``__graft_entry__.dryrun_multichip``).
+
+Test-facing flags mirror the reference harness
+(``tests/core/pyspec/eth2spec/test/conftest.py:30-52``):
+``--preset``, ``--fork``, ``--disable-bls``, ``--bls-type``.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_addoption(parser):
+    parser.addoption("--preset", action="store", default="minimal",
+                     help="preset to run tests with: minimal or mainnet")
+    parser.addoption("--fork", action="store", default=None,
+                     help="restrict tests to one fork")
+    parser.addoption("--disable-bls", action="store_true", default=False,
+                     help="skip BLS verification for speed where tests allow it")
+    parser.addoption("--bls-type", action="store", default="py",
+                     choices=["py", "jax", "fastest"],
+                     help="BLS backend")
+
+
+def pytest_configure(config):
+    from consensus_specs_tpu.test_infra import context as ctx
+    ctx.DEFAULT_TEST_PRESET = config.getoption("--preset")
+    ctx.DEFAULT_BLS_ACTIVE = not config.getoption("--disable-bls")
+    ctx.DEFAULT_BLS_TYPE = config.getoption("--bls-type")
+    only_fork = config.getoption("--fork")
+    if only_fork:
+        ctx.ONLY_FORK = only_fork

@@ -1,0 +1,256 @@
+"""SSZ unit tests with hand-computed vectors.
+
+Oracle values computed directly from the normative rules in the reference's
+``ssz/simple-serialize.md`` (serialization + merkleization sections).
+"""
+from hashlib import sha256
+
+import pytest
+
+from consensus_specs_tpu.utils.ssz import (
+    boolean, uint8, uint16, uint32, uint64, uint256,
+    Bytes32, Bytes48, ByteList, ByteVector,
+    Bitvector, Bitlist, Vector, List, Container, Union,
+    serialize, hash_tree_root, deserialize, uint_to_bytes,
+)
+
+
+def h(a, b):
+    return sha256(a + b).digest()
+
+
+Z = b"\x00" * 32
+
+
+def test_uint_serialize():
+    assert serialize(uint16(0x0506)) == b"\x06\x05"
+    assert serialize(uint8(0)) == b"\x00"
+    assert serialize(uint64(2**64 - 1)) == b"\xff" * 8
+    assert serialize(boolean(True)) == b"\x01"
+    assert serialize(boolean(False)) == b"\x00"
+    assert uint_to_bytes(uint32(1)) == b"\x01\x00\x00\x00"
+
+
+def test_uint_bounds():
+    with pytest.raises(ValueError):
+        uint8(256)
+    with pytest.raises(ValueError):
+        uint64(-1)
+    with pytest.raises(ValueError):
+        uint64(2**64)
+    assert uint64(2**64 - 1) == 2**64 - 1
+
+
+def test_uint_htr():
+    assert hash_tree_root(uint64(5)) == b"\x05" + b"\x00" * 31
+    assert hash_tree_root(uint256(1)) == b"\x01" + b"\x00" * 31
+
+
+def test_bytes_types():
+    b32 = Bytes32(b"\x01" * 32)
+    assert serialize(b32) == b"\x01" * 32
+    assert hash_tree_root(b32) == b"\x01" * 32
+    b48 = Bytes48(b"\x02" * 48)
+    # 48 bytes -> 2 chunks (2nd padded) -> 1 hash
+    assert hash_tree_root(b48) == h(b"\x02" * 32, b"\x02" * 16 + b"\x00" * 16)
+    with pytest.raises(ValueError):
+        Bytes32(b"\x00" * 31)
+
+
+def test_bytelist():
+    BL = ByteList[10]
+    v = BL(b"abc")
+    assert serialize(v) == b"abc"
+    # limit 10 bytes -> 1 chunk; root = mix_in_length(chunk, 3)
+    chunk = b"abc" + b"\x00" * 29
+    assert hash_tree_root(v) == h(chunk, (3).to_bytes(32, "little"))
+    assert deserialize(BL, b"abc") == v
+    with pytest.raises(ValueError):
+        BL(b"0123456789x")
+
+
+def test_bitvector():
+    BV = Bitvector[5]
+    v = BV([1, 0, 1, 0, 1])
+    assert serialize(v) == b"\x15"
+    assert hash_tree_root(v) == b"\x15" + b"\x00" * 31
+    assert deserialize(BV, b"\x15") == v
+    # nonzero padding bit rejected
+    with pytest.raises(ValueError):
+        deserialize(BV, b"\x35")
+
+
+def test_bitlist():
+    BL = Bitlist[8]
+    v = BL([1, 0, 1, 0, 1])
+    assert serialize(v) == b"\x35"  # 0b00110101: bits 10101 + delimiter at 5
+    root = h(b"\x15" + b"\x00" * 31, (5).to_bytes(32, "little"))
+    assert hash_tree_root(v) == root
+    assert deserialize(BL, b"\x35") == v
+    # empty bitlist serializes to just the delimiter
+    assert serialize(BL([])) == b"\x01"
+    assert deserialize(BL, b"\x01") == BL([])
+    with pytest.raises(ValueError):
+        deserialize(BL, b"")
+    with pytest.raises(ValueError):
+        deserialize(BL, b"\x35\x00")
+
+
+def test_vector_basic():
+    V = Vector[uint16, 3]
+    v = V([1, 2, 3])
+    assert serialize(v) == b"\x01\x00\x02\x00\x03\x00"
+    # 6 bytes -> 1 chunk, no hashing
+    assert hash_tree_root(v) == b"\x01\x00\x02\x00\x03\x00" + b"\x00" * 26
+    assert deserialize(V, serialize(v)) == v
+
+
+def test_vector_composite_htr():
+    V = Vector[Bytes32, 2]
+    a, b = Bytes32(b"\xaa" * 32), Bytes32(b"\xbb" * 32)
+    v = V([a, b])
+    assert hash_tree_root(v) == h(bytes(a), bytes(b))
+    V3 = Vector[Bytes32, 3]
+    v3 = V3([a, b, a])
+    assert hash_tree_root(v3) == h(h(bytes(a), bytes(b)), h(bytes(a), Z))
+
+
+def test_list_basic_htr():
+    L = List[uint64, 8]  # limit 8 uint64 = 64 bytes = 2 chunks
+    v = L(1, 2, 3)
+    data = b"".join(int(x).to_bytes(8, "little") for x in (1, 2, 3))
+    assert serialize(v) == data
+    chunk0 = data + b"\x00" * 8
+    root = h(h(chunk0, Z), (3).to_bytes(32, "little"))
+    assert hash_tree_root(v) == root
+    assert deserialize(L, data) == v
+    # empty list
+    assert hash_tree_root(L()) == h(h(Z, Z), (0).to_bytes(32, "little"))
+
+
+def test_list_limit():
+    L = List[uint8, 3]
+    with pytest.raises(ValueError):
+        L(1, 2, 3, 4)
+    v = L(1, 2, 3)
+    with pytest.raises(ValueError):
+        v.append(4)
+
+
+class Checkpoint(Container):
+    epoch: uint64
+    root: Bytes32
+
+
+def test_container_fixed():
+    c = Checkpoint(epoch=3, root=b"\x07" * 32)
+    assert serialize(c) == (3).to_bytes(8, "little") + b"\x07" * 32
+    assert hash_tree_root(c) == h((3).to_bytes(32, "little"), b"\x07" * 32)
+    assert deserialize(Checkpoint, serialize(c)) == c
+    assert c.copy() == c and c.copy() is not c
+
+
+class VarContainer(Container):
+    a: uint16
+    b: List[uint16, 4]
+    c: uint8
+
+
+def test_container_variable():
+    v = VarContainer(a=0x0102, b=List[uint16, 4](5, 6), c=7)
+    # fixed part: a (2) + offset (4) + c (1) = 7; b starts at 7
+    expected = b"\x02\x01" + (7).to_bytes(4, "little") + b"\x07" + b"\x05\x00\x06\x00"
+    assert serialize(v) == expected
+    assert deserialize(VarContainer, expected) == v
+    roots = [
+        hash_tree_root(v.a), hash_tree_root(v.b), hash_tree_root(v.c)]
+    assert hash_tree_root(v) == h(h(roots[0], roots[1]), h(roots[2], Z))
+
+
+def test_container_field_validation():
+    c = Checkpoint()
+    c.epoch = 5
+    assert c.epoch == 5 and isinstance(c.epoch, uint64)
+    with pytest.raises(ValueError):
+        c.epoch = 2**64  # overflow = invalid
+    with pytest.raises(ValueError):
+        c.epoch = -1
+    with pytest.raises(AttributeError):
+        c.bogus = 1
+
+
+def test_container_root_cache_invalidation():
+    c = Checkpoint(epoch=1, root=b"\x00" * 32)
+    r1 = hash_tree_root(c)
+    c.epoch = 2
+    r2 = hash_tree_root(c)
+    assert r1 != r2
+    assert r2 == h((2).to_bytes(32, "little"), Z)
+
+
+def test_union():
+    U = Union[None, uint16, uint32]
+    u0 = U(0)
+    assert serialize(u0) == b"\x00"
+    assert hash_tree_root(u0) == h(Z, (0).to_bytes(32, "little"))
+    u1 = U(1, 0x0304)
+    assert serialize(u1) == b"\x01\x04\x03"
+    assert hash_tree_root(u1) == h(hash_tree_root(uint16(0x0304)), (1).to_bytes(32, "little"))
+    assert deserialize(U, b"\x01\x04\x03") == u1
+
+
+def test_nested_list_of_containers():
+    L = List[Checkpoint, 4]
+    a = Checkpoint(epoch=1, root=b"\x01" * 32)
+    b = Checkpoint(epoch=2, root=b"\x02" * 32)
+    v = L(a, b)
+    # fixed-size elements: concatenation
+    assert serialize(v) == serialize(a) + serialize(b)
+    ra, rb = hash_tree_root(a), hash_tree_root(b)
+    root = h(h(ra, rb), h(Z, Z))
+    assert hash_tree_root(v) == h(root, (2).to_bytes(32, "little"))
+    rt = deserialize(L, serialize(v))
+    assert rt == v
+
+
+def test_list_of_variable_elems():
+    Inner = List[uint8, 3]
+    L = List[Inner, 2]
+    v = L(Inner(1), Inner(2, 3))
+    # offsets: 2 elems -> 8 bytes of offsets; payloads at 8 and 9
+    expected = (8).to_bytes(4, "little") + (9).to_bytes(4, "little") + b"\x01" + b"\x02\x03"
+    assert serialize(v) == expected
+    assert deserialize(L, expected) == v
+
+
+def test_big_list_virtual_padding():
+    # limit 2**40: root must be computable instantly via zero-subtree shortcut
+    L = List[uint64, 2**40]
+    v = L(42)
+    root = hash_tree_root(v)
+    assert isinstance(root, bytes) and len(root) == 32
+
+
+def test_vector_mutation():
+    V = Vector[uint64, 4]
+    v = V()
+    v[2] = 9
+    assert list(v) == [0, 0, 9, 0]
+    with pytest.raises(ValueError):
+        v[0] = 2**64
+
+
+def test_boolean_strictness():
+    with pytest.raises(ValueError):
+        boolean(2)
+    with pytest.raises(ValueError):
+        deserialize(boolean, b"\x02")
+    assert deserialize(boolean, b"\x01") == boolean(True)
+
+
+def test_variable_list_rejects_zero_first_offset():
+    Inner = List[uint8, 3]
+    L = List[Inner, 2]
+    with pytest.raises(ValueError):
+        deserialize(L, b"\x00\x00\x00\x00\xff\xff")
+    assert deserialize(L, b"") == L()
