@@ -57,7 +57,7 @@ def load_preset(preset_name: str, forks=None) -> Dict[str, Any]:
     """
     order = forks or [
         "phase0", "altair", "bellatrix", "capella", "deneb",
-        "eip6110", "eip7594", "whisk",
+        "eip6110", "eip7594", "whisk", "custody_game", "sharding",
     ]
     base = preset_dir(preset_name)
     if not base.is_dir():

@@ -1,0 +1,24 @@
+"""BLS12-381 arithmetic.
+
+Pure-Python reference implementation (the differential-test oracle, standing
+in for the reference's ``py_ecc`` dependency — reference:
+``tests/core/pyspec/eth2spec/utils/bls.py``) plus shared curve parameters for
+the JAX/TPU kernels in ``consensus_specs_tpu.ops.bls_jax``.
+
+Every derived constant (Frobenius coefficients, the SSWU isogeny, cofactor
+formulas) is computed from the base parameters at import and self-verified,
+so there are no opaque magic numbers to mistype.
+"""
+from .fields import P, R_ORDER, X_PARAM, Fq, Fq2, Fq6, Fq12
+from .curve import (
+    G1Point, G2Point, G1_GENERATOR, G2_GENERATOR,
+    g1_from_compressed, g2_from_compressed,
+)
+from .pairing import miller_loop, final_exponentiation, pairing, multi_pairing_check
+
+__all__ = [
+    "P", "R_ORDER", "X_PARAM", "Fq", "Fq2", "Fq6", "Fq12",
+    "G1Point", "G2Point", "G1_GENERATOR", "G2_GENERATOR",
+    "g1_from_compressed", "g2_from_compressed",
+    "miller_loop", "final_exponentiation", "pairing", "multi_pairing_check",
+]

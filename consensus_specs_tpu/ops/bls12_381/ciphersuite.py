@@ -1,0 +1,118 @@
+"""BLS signature scheme (proof-of-possession ciphersuite), python backend.
+
+The 9-function API surface the reference exposes from its backends
+(reference: ``tests/core/pyspec/eth2spec/utils/bls.py:107-202``): SkToPk,
+Sign, Verify, Aggregate, AggregateVerify, FastAggregateVerify, AggregatePKs,
+KeyValidate, plus point helpers. Pubkeys are 48-byte compressed G1,
+signatures 96-byte compressed G2.
+"""
+from typing import Sequence
+
+from .fields import R_ORDER
+from .curve import (
+    G1Point, G2Point, G1_GENERATOR,
+    g1_from_compressed, g2_from_compressed,
+)
+from .pairing import multi_pairing_check
+from .hash_to_curve import hash_to_g2, DST_G2
+
+
+def SkToPk(sk: int) -> bytes:
+    if not 0 < sk < R_ORDER:
+        raise ValueError("secret key out of range")
+    return G1_GENERATOR.mult(sk).to_compressed()
+
+
+def Sign(sk: int, msg: bytes) -> bytes:
+    if not 0 < sk < R_ORDER:
+        raise ValueError("secret key out of range")
+    return hash_to_g2(msg).mult(sk).to_compressed()
+
+
+def _decode_pubkey(pk: bytes):
+    """Decode + KeyValidate in one pass; returns the G1 point or None."""
+    try:
+        p = g1_from_compressed(pk)
+    except Exception:
+        return None
+    if p.infinity or not p.in_subgroup():
+        return None
+    return p
+
+
+def KeyValidate(pk: bytes) -> bool:
+    return _decode_pubkey(pk) is not None
+
+
+def _decode_sig(sig: bytes) -> G2Point:
+    s = g2_from_compressed(sig)
+    if not s.in_subgroup():
+        raise ValueError("signature not in G2 subgroup")
+    return s
+
+
+def Verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
+    try:
+        p = _decode_pubkey(pk)
+        if p is None:
+            return False
+        s = _decode_sig(sig)
+        hm = hash_to_g2(msg)
+        return multi_pairing_check([(p, hm), (-G1_GENERATOR, s)])
+    except Exception:
+        return False
+
+
+def Aggregate(signatures: Sequence[bytes]) -> bytes:
+    if len(signatures) == 0:
+        raise ValueError("cannot aggregate empty signature list")
+    acc = G2Point.inf()
+    for sig in signatures:
+        acc = acc + g2_from_compressed(sig)
+    return acc.to_compressed()
+
+
+def AggregatePKs(pubkeys: Sequence[bytes]) -> bytes:
+    if len(pubkeys) == 0:
+        raise ValueError("cannot aggregate empty pubkey list")
+    acc = G1Point.inf()
+    for pk in pubkeys:
+        p = _decode_pubkey(pk)
+        if p is None:
+            raise ValueError("invalid pubkey in aggregation")
+        acc = acc + p
+    return acc.to_compressed()
+
+
+def AggregateVerify(pubkeys: Sequence[bytes], messages: Sequence[bytes], sig: bytes) -> bool:
+    try:
+        if len(pubkeys) == 0 or len(pubkeys) != len(messages):
+            return False
+        s = _decode_sig(sig)
+        pairs = []
+        for pk, msg in zip(pubkeys, messages):
+            p = _decode_pubkey(pk)
+            if p is None:
+                return False
+            pairs.append((p, hash_to_g2(msg)))
+        pairs.append((-G1_GENERATOR, s))
+        return multi_pairing_check(pairs)
+    except Exception:
+        return False
+
+
+def FastAggregateVerify(pubkeys: Sequence[bytes], msg: bytes, sig: bytes) -> bool:
+    try:
+        if len(pubkeys) == 0:
+            return False
+        acc = G1Point.inf()
+        for pk in pubkeys:
+            p = _decode_pubkey(pk)
+            if p is None:
+                return False
+            acc = acc + p
+        s = _decode_sig(sig)
+        hm = hash_to_g2(msg)
+        return multi_pairing_check([(acc, hm), (-G1_GENERATOR, s)])
+    except Exception:
+        return False

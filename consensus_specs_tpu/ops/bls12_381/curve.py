@@ -1,0 +1,188 @@
+"""BLS12-381 curve groups.
+
+E1: y² = x³ + 4 over Fq            (G1 ⊂ E1(Fq), r-torsion)
+E2: y² = x³ + 4(1+u) over Fq2      (G2 ⊂ E2(Fq2), M-twist)
+
+Points are affine with an explicit infinity flag; group ops use simple
+affine formulas (the python oracle favors clarity; the TPU kernels use
+Jacobian/projective forms). Serialization is the ZCash compressed format the
+reference's backends use (48-byte G1 / 96-byte G2, flag bits in the top three
+bits of the first byte).
+"""
+from .fields import P, R_ORDER, Fq, Fq2
+
+B1 = Fq(4)
+B2 = Fq2(4, 4)
+
+
+class _Point:
+    """Affine point on y² = x³ + b over field F."""
+    __slots__ = ("x", "y", "infinity")
+    b = None
+    field_one = None
+
+    def __init__(self, x=None, y=None, infinity=False):
+        self.x, self.y, self.infinity = x, y, infinity
+
+    @classmethod
+    def inf(cls):
+        return cls(infinity=True)
+
+    def is_on_curve(self):
+        if self.infinity:
+            return True
+        return self.y * self.y == self.x * self.x * self.x + type(self).b
+
+    def __eq__(self, o):
+        if self.infinity or o.infinity:
+            return self.infinity and o.infinity
+        return self.x == o.x and self.y == o.y
+
+    def __neg__(self):
+        if self.infinity:
+            return self
+        return type(self)(self.x, -self.y)
+
+    def double(self):
+        if self.infinity or self.y.is_zero():
+            return type(self).inf()
+        x, y = self.x, self.y
+        three = self.x + self.x + self.x
+        lam = three * x * (y + y).inv()
+        x3 = lam * lam - x - x
+        y3 = lam * (x - x3) - y
+        return type(self)(x3, y3)
+
+    def __add__(self, o):
+        if self.infinity:
+            return o
+        if o.infinity:
+            return self
+        if self.x == o.x:
+            if self.y == o.y:
+                return self.double()
+            return type(self).inf()
+        lam = (o.y - self.y) * (o.x - self.x).inv()
+        x3 = lam * lam - self.x - o.x
+        y3 = lam * (self.x - x3) - self.y
+        return type(self)(x3, y3)
+
+    def __sub__(self, o):
+        return self + (-o)
+
+    def mult(self, k: int):
+        """Scalar multiplication; negative scalars negate the point."""
+        if k < 0:
+            return (-self).mult(-k)
+        result = type(self).inf()
+        addend = self
+        while k:
+            if k & 1:
+                result = result + addend
+            addend = addend.double()
+            k >>= 1
+        return result
+
+    def in_subgroup(self):
+        return self.mult(R_ORDER).infinity
+
+
+class G1Point(_Point):
+    b = B1
+
+    def to_compressed(self) -> bytes:
+        if self.infinity:
+            return bytes([0xC0]) + b"\x00" * 47
+        data = bytearray(self.x.n.to_bytes(48, "big"))
+        data[0] |= 0x80
+        if self.y.n > (P - 1) // 2:
+            data[0] |= 0x20
+        return bytes(data)
+
+
+class G2Point(_Point):
+    b = B2
+
+    def to_compressed(self) -> bytes:
+        if self.infinity:
+            return bytes([0xC0]) + b"\x00" * 95
+        data = bytearray(self.x.b.n.to_bytes(48, "big") + self.x.a.n.to_bytes(48, "big"))
+        data[0] |= 0x80
+        y_im, y_re = self.y.b.n, self.y.a.n
+        if (y_im > (P - 1) // 2) if y_im != 0 else (y_re > (P - 1) // 2):
+            data[0] |= 0x20
+        return bytes(data)
+
+
+def _check_flags(data: bytes):
+    c_flag = (data[0] >> 7) & 1
+    i_flag = (data[0] >> 6) & 1
+    s_flag = (data[0] >> 5) & 1
+    if c_flag != 1:
+        raise ValueError("only compressed encodings supported")
+    return i_flag, s_flag
+
+
+def g1_from_compressed(data: bytes) -> G1Point:
+    if len(data) != 48:
+        raise ValueError("G1 compressed encoding must be 48 bytes")
+    i_flag, s_flag = _check_flags(data)
+    x_int = int.from_bytes(bytes([data[0] & 0x1F]) + data[1:], "big")
+    if i_flag:
+        if x_int != 0 or s_flag:
+            raise ValueError("malformed infinity encoding")
+        return G1Point.inf()
+    if x_int >= P:
+        raise ValueError("x not canonical")
+    x = Fq(x_int)
+    y2 = x * x * x + B1
+    y = y2.sqrt()
+    if y is None:
+        raise ValueError("x not on curve")
+    if (y.n > (P - 1) // 2) != bool(s_flag):
+        y = -y
+    pt = G1Point(x, y)
+    assert pt.is_on_curve()
+    return pt
+
+
+def g2_from_compressed(data: bytes) -> G2Point:
+    if len(data) != 96:
+        raise ValueError("G2 compressed encoding must be 96 bytes")
+    i_flag, s_flag = _check_flags(data)
+    x_im = int.from_bytes(bytes([data[0] & 0x1F]) + data[1:48], "big")
+    x_re = int.from_bytes(data[48:], "big")
+    if i_flag:
+        if x_im != 0 or x_re != 0 or s_flag:
+            raise ValueError("malformed infinity encoding")
+        return G2Point.inf()
+    if x_im >= P or x_re >= P:
+        raise ValueError("x not canonical")
+    x = Fq2(x_re, x_im)
+    y2 = x * x * x + B2
+    y = y2.sqrt()
+    if y is None:
+        raise ValueError("x not on curve")
+    y_im, y_re = y.b.n, y.a.n
+    y_sign = (y_im > (P - 1) // 2) if y_im != 0 else (y_re > (P - 1) // 2)
+    if y_sign != bool(s_flag):
+        y = -y
+    pt = G2Point(x, y)
+    assert pt.is_on_curve()
+    return pt
+
+
+# Standard generators (public parameters of the ciphersuite).
+G1_GENERATOR = G1Point(
+    Fq(0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB),
+    Fq(0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1),
+)
+G2_GENERATOR = G2Point(
+    Fq2(0x024AA2B2F08F0A91260805272DC51051C6E47AD4FA403B02B4510B647AE3D1770BAC0326A805BBEFD48056C8C121BDB8,
+        0x13E02B6052719F607DACD3A088274F65596BD0D09920B61AB5DA61BBDC7F5049334CF11213945D57E5AC7D055D042B7E),
+    Fq2(0x0CE5D527727D6E118CC9CDC6DA2E351AADFD9BAA8CBDD3A76D429A695160D12C923AC9CC3BACA289E193548608B82801,
+        0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE),
+)
+
+assert G1_GENERATOR.is_on_curve(), "G1 generator must lie on E1"
+assert G2_GENERATOR.is_on_curve(), "G2 generator must lie on E2"

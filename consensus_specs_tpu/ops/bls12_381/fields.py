@@ -1,0 +1,325 @@
+"""BLS12-381 field towers: Fq, Fq2 = Fq[u]/(u²+1), Fq6 = Fq2[v]/(v³−ξ),
+Fq12 = Fq6[w]/(w²−v), with ξ = 1+u.
+
+Pure Python (arbitrary-precision ints). This is the correctness oracle for
+the TPU kernels; speed only needs to be "good enough for tests".
+"""
+
+# Base field modulus
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+# Subgroup order r
+R_ORDER = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+# BLS parameter x (negative, low hamming weight); p and r are polynomials in x
+X_PARAM = -0xD201000000010000
+
+assert (X_PARAM - 1) ** 2 * ((X_PARAM ** 4 - X_PARAM ** 2 + 1)) // 3 + X_PARAM == P, \
+    "p(x) consistency"
+assert X_PARAM ** 4 - X_PARAM ** 2 + 1 == R_ORDER, "r(x) consistency"
+
+
+class Fq:
+    __slots__ = ("n",)
+
+    def __init__(self, n):
+        self.n = n % P
+
+    def __add__(self, o):
+        return Fq(self.n + o.n)
+
+    def __sub__(self, o):
+        return Fq(self.n - o.n)
+
+    def __neg__(self):
+        return Fq(-self.n)
+
+    def __mul__(self, o):
+        return Fq(self.n * o.n)
+
+    def __eq__(self, o):
+        return isinstance(o, Fq) and self.n == o.n
+
+    def __hash__(self):
+        return hash(self.n)
+
+    def inv(self):
+        return Fq(pow(self.n, -1, P))
+
+    def __pow__(self, e):
+        return Fq(pow(self.n, e, P))
+
+    def is_zero(self):
+        return self.n == 0
+
+    def sqrt(self):
+        """Square root; p ≡ 3 (mod 4) so x^((p+1)/4) works. None if non-residue."""
+        c = pow(self.n, (P + 1) // 4, P)
+        if c * c % P == self.n:
+            return Fq(c)
+        return None
+
+    @staticmethod
+    def zero():
+        return Fq(0)
+
+    @staticmethod
+    def one():
+        return Fq(1)
+
+    def __repr__(self):
+        return f"Fq(0x{self.n:x})"
+
+
+class Fq2:
+    """a + b·u with u² = −1."""
+    __slots__ = ("a", "b")
+
+    def __init__(self, a, b):
+        self.a = a if isinstance(a, Fq) else Fq(a)
+        self.b = b if isinstance(b, Fq) else Fq(b)
+
+    def __add__(self, o):
+        return Fq2(self.a + o.a, self.b + o.b)
+
+    def __sub__(self, o):
+        return Fq2(self.a - o.a, self.b - o.b)
+
+    def __neg__(self):
+        return Fq2(-self.a, -self.b)
+
+    def __mul__(self, o):
+        # (a+bu)(c+du) = (ac−bd) + (ad+bc)u  (Karatsuba)
+        ac = self.a * o.a
+        bd = self.b * o.b
+        abcd = (self.a + self.b) * (o.a + o.b)
+        return Fq2(ac - bd, abcd - ac - bd)
+
+    def mul_scalar(self, k: int):
+        return Fq2(Fq(self.a.n * k), Fq(self.b.n * k))
+
+    def square(self):
+        # (a+bu)² = (a+b)(a−b) + 2ab·u
+        return Fq2((self.a + self.b) * (self.a - self.b), Fq(2 * self.a.n * self.b.n))
+
+    def conjugate(self):
+        return Fq2(self.a, -self.b)
+
+    def inv(self):
+        # 1/(a+bu) = (a−bu)/(a²+b²)
+        norm = (self.a * self.a + self.b * self.b).inv()
+        return Fq2(self.a * norm, -self.b * norm)
+
+    def __pow__(self, e):
+        if e < 0:
+            return self.inv() ** (-e)
+        result = Fq2.one()
+        base = self
+        while e:
+            if e & 1:
+                result = result * base
+            base = base.square()
+            e >>= 1
+        return result
+
+    def __eq__(self, o):
+        return isinstance(o, Fq2) and self.a == o.a and self.b == o.b
+
+    def __hash__(self):
+        return hash((self.a.n, self.b.n))
+
+    def is_zero(self):
+        return self.a.is_zero() and self.b.is_zero()
+
+    def is_square(self):
+        # Euler criterion via the norm map: a+bu is a square in Fq2 iff
+        # N(a+bu) = a²+b² is a square in Fq (since q ≡ 3 mod 4).
+        n = (self.a * self.a + self.b * self.b).n
+        return pow(n, (P - 1) // 2, P) in (0, 1)
+
+    def sqrt(self):
+        """Square root in Fq2 (complex method, p ≡ 3 mod 4). None if non-residue."""
+        if self.is_zero():
+            return Fq2.zero()
+        if self.b.is_zero():
+            r = self.a.sqrt()
+            if r is not None:
+                return Fq2(r, Fq(0))
+            # sqrt(a) = sqrt(-a) * u since u² = −1
+            r = (-self.a).sqrt()
+            assert r is not None
+            return Fq2(Fq(0), r)
+        # alpha = sqrt(a² + b²) in Fq (norm is a square iff self is a square)
+        alpha = (self.a * self.a + self.b * self.b).sqrt()
+        if alpha is None:
+            return None
+        # x² = (a + alpha)/2, y = b/(2x)
+        inv2 = Fq((P + 1) // 2)
+        delta = (self.a + alpha) * inv2
+        x = delta.sqrt()
+        if x is None:
+            delta = (self.a - alpha) * inv2
+            x = delta.sqrt()
+            if x is None:
+                return None
+        y = self.b * (x + x).inv()
+        c = Fq2(x, y)
+        assert c.square() == self
+        return c
+
+    @staticmethod
+    def zero():
+        return Fq2(0, 0)
+
+    @staticmethod
+    def one():
+        return Fq2(1, 0)
+
+    def frobenius(self):
+        """x -> x^p (= conjugate in Fq2)."""
+        return self.conjugate()
+
+    def __repr__(self):
+        return f"Fq2(0x{self.a.n:x}, 0x{self.b.n:x})"
+
+
+# Non-residue for the sextic extension: ξ = 1 + u
+XI = Fq2(1, 1)
+
+
+class Fq6:
+    """c0 + c1·v + c2·v² with v³ = ξ."""
+    __slots__ = ("c0", "c1", "c2")
+
+    def __init__(self, c0, c1, c2):
+        self.c0, self.c1, self.c2 = c0, c1, c2
+
+    def __add__(self, o):
+        return Fq6(self.c0 + o.c0, self.c1 + o.c1, self.c2 + o.c2)
+
+    def __sub__(self, o):
+        return Fq6(self.c0 - o.c0, self.c1 - o.c1, self.c2 - o.c2)
+
+    def __neg__(self):
+        return Fq6(-self.c0, -self.c1, -self.c2)
+
+    def __mul__(self, o):
+        a0, a1, a2 = self.c0, self.c1, self.c2
+        b0, b1, b2 = o.c0, o.c1, o.c2
+        t0 = a0 * b0
+        t1 = a1 * b1
+        t2 = a2 * b2
+        c0 = t0 + ((a1 + a2) * (b1 + b2) - t1 - t2) * XI
+        c1 = (a0 + a1) * (b0 + b1) - t0 - t1 + t2 * XI
+        c2 = (a0 + a2) * (b0 + b2) - t0 - t2 + t1
+        return Fq6(c0, c1, c2)
+
+    def mul_by_fq2(self, x: Fq2):
+        return Fq6(self.c0 * x, self.c1 * x, self.c2 * x)
+
+    def mul_by_v(self):
+        """multiply by v: (c0,c1,c2) -> (ξ·c2, c0, c1)"""
+        return Fq6(self.c2 * XI, self.c0, self.c1)
+
+    def square(self):
+        return self * self
+
+    def inv(self):
+        a0, a1, a2 = self.c0, self.c1, self.c2
+        t0 = a0.square() - a1 * a2 * XI
+        t1 = a2.square() * XI - a0 * a1
+        t2 = a1.square() - a0 * a2
+        factor = (a0 * t0 + a2 * t1 * XI + a1 * t2 * XI).inv()
+        return Fq6(t0 * factor, t1 * factor, t2 * factor)
+
+    def __eq__(self, o):
+        return isinstance(o, Fq6) and self.c0 == o.c0 and self.c1 == o.c1 and self.c2 == o.c2
+
+    def is_zero(self):
+        return self.c0.is_zero() and self.c1.is_zero() and self.c2.is_zero()
+
+    @staticmethod
+    def zero():
+        return Fq6(Fq2.zero(), Fq2.zero(), Fq2.zero())
+
+    @staticmethod
+    def one():
+        return Fq6(Fq2.one(), Fq2.zero(), Fq2.zero())
+
+
+# Frobenius constants, derived (not memorized): v^p = FROB_V1 · v, v²ᵖ = FROB_V2 · v²
+FROB_V1 = XI ** ((P - 1) // 3)
+FROB_V2 = FROB_V1 * FROB_V1
+# w^p = FROB_W · w with w² = v
+FROB_W = XI ** ((P - 1) // 6)
+
+
+def fq6_frobenius(x: Fq6) -> Fq6:
+    return Fq6(x.c0.frobenius(),
+               x.c1.frobenius() * FROB_V1,
+               x.c2.frobenius() * FROB_V2)
+
+
+class Fq12:
+    """c0 + c1·w with w² = v."""
+    __slots__ = ("c0", "c1")
+
+    def __init__(self, c0, c1):
+        self.c0, self.c1 = c0, c1
+
+    def __add__(self, o):
+        return Fq12(self.c0 + o.c0, self.c1 + o.c1)
+
+    def __sub__(self, o):
+        return Fq12(self.c0 - o.c0, self.c1 - o.c1)
+
+    def __mul__(self, o):
+        a0, a1 = self.c0, self.c1
+        b0, b1 = o.c0, o.c1
+        t0 = a0 * b0
+        t1 = a1 * b1
+        c0 = t0 + t1.mul_by_v()
+        c1 = (a0 + a1) * (b0 + b1) - t0 - t1
+        return Fq12(c0, c1)
+
+    def square(self):
+        return self * self
+
+    def conjugate(self):
+        """x -> x^(p^6): negates the w component."""
+        return Fq12(self.c0, -self.c1)
+
+    def inv(self):
+        t = (self.c0.square() - self.c1.square().mul_by_v()).inv()
+        return Fq12(self.c0 * t, -(self.c1 * t))
+
+    def frobenius(self):
+        c0 = fq6_frobenius(self.c0)
+        c1 = fq6_frobenius(self.c1)
+        # w-component picks up FROB_W on each Fq2 coefficient
+        c1 = Fq6(c1.c0 * FROB_W, c1.c1 * FROB_W, c1.c2 * FROB_W)
+        return Fq12(c0, c1)
+
+    def __pow__(self, e):
+        if e < 0:
+            return self.inv() ** (-e)
+        result = Fq12.one()
+        base = self
+        while e:
+            if e & 1:
+                result = result * base
+            base = base.square()
+            e >>= 1
+        return result
+
+    def __eq__(self, o):
+        return isinstance(o, Fq12) and self.c0 == o.c0 and self.c1 == o.c1
+
+    def is_zero(self):
+        return self.c0.is_zero() and self.c1.is_zero()
+
+    @staticmethod
+    def zero():
+        return Fq12(Fq6.zero(), Fq6.zero())
+
+    @staticmethod
+    def one():
+        return Fq12(Fq6.one(), Fq6.zero())

@@ -1,0 +1,87 @@
+"""Optimal ate pairing on BLS12-381.
+
+e(P, Q) = f_{|x|,Q}(P)^((p¹²−1)/r) with a conjugation correcting for the
+negative BLS parameter x. Line evaluations embed G2 (on the M-twist) into
+Fq12 via the untwist (x/w², y/w³), i.e. a line a + b·w + c·w³ form; here we
+simply lift both points into E(Fq12) coordinates and use generic line
+functions — clarity over speed, this is the oracle.
+"""
+from .fields import P, R_ORDER, X_PARAM, Fq2, Fq6, Fq12
+from .curve import G1Point, G2Point
+
+
+def _fq12_from_fq(a) -> Fq12:
+    return Fq12(Fq6(Fq2(a.n, 0), Fq2.zero(), Fq2.zero()), Fq6.zero())
+
+
+def _fq12_from_fq2(a: Fq2) -> Fq12:
+    return Fq12(Fq6(a, Fq2.zero(), Fq2.zero()), Fq6.zero())
+
+
+# w and its powers as Fq12 elements (w² = v, v³ = ξ)
+W = Fq12(Fq6.zero(), Fq6.one())
+W2 = W * W
+W3 = W2 * W
+
+
+def _untwist(q: G2Point):
+    """Map a twist point (x,y) ∈ E2(Fq2) to E(Fq12): (x/w², y/w³)."""
+    x = _fq12_from_fq2(q.x) * W2.inv()
+    y = _fq12_from_fq2(q.y) * W3.inv()
+    return x, y
+
+
+def miller_loop(p: G1Point, q: G2Point) -> Fq12:
+    """f_{|x|, Q}(P), conjugated for x < 0 (before final exponentiation)."""
+    if p.infinity or q.infinity:
+        return Fq12.one()
+    qx, qy = _untwist(q)
+    px = _fq12_from_fq(p.x)
+    py = _fq12_from_fq(p.y)
+
+    three = _fq12_from_fq2(Fq2(3, 0))
+
+    rx, ry = qx, qy
+    f = Fq12.one()
+    t = -X_PARAM  # positive loop count
+    for bit in bin(t)[3:]:  # skip the leading 1
+        # doubling step: tangent line at R evaluated at P
+        slope = (three * rx * rx) * (ry + ry).inv()
+        line = slope * (px - rx) - (py - ry)
+        f = f * f * line
+        new_rx = slope * slope - rx - rx
+        new_ry = slope * (rx - new_rx) - ry
+        rx, ry = new_rx, new_ry
+        if bit == "1":
+            # addition step: chord through R and Q evaluated at P.
+            # R = [j]Q with 1 < j < |x| < r, so R = ±Q cannot occur mid-loop.
+            slope = (qy - ry) * (qx - rx).inv()
+            line = slope * (px - rx) - (py - ry)
+            f = f * line
+            new_rx = slope * slope - rx - qx
+            new_ry = slope * (rx - new_rx) - ry
+            rx, ry = new_rx, new_ry
+    # x < 0: f_{x} = conjugate(f_{|x|}) up to final exponentiation
+    return f.conjugate()
+
+
+def final_exponentiation(f: Fq12) -> Fq12:
+    """f^((p¹²−1)/r): cheap easy part, then direct hard-part exponentiation."""
+    # easy part: f^(p⁶−1) then ^(p²+1)
+    f = f.conjugate() * f.inv()
+    f = f.frobenius().frobenius() * f
+    # hard part: (p⁴ − p² + 1)/r, done by plain square-and-multiply (oracle)
+    hard = (P ** 4 - P ** 2 + 1) // R_ORDER
+    return f ** hard
+
+
+def pairing(p: G1Point, q: G2Point) -> Fq12:
+    return final_exponentiation(miller_loop(p, q))
+
+
+def multi_pairing_check(pairs) -> bool:
+    """True iff ∏ e(Pᵢ, Qᵢ) == 1 (one shared final exponentiation)."""
+    f = Fq12.one()
+    for p, q in pairs:
+        f = f * miller_loop(p, q)
+    return final_exponentiation(f) == Fq12.one()

@@ -1,0 +1,160 @@
+"""Pluggable BLS backend switch.
+
+Mirrors the reference's backend-switch design
+(``tests/core/pyspec/eth2spec/utils/bls.py:30-104``): one module-level
+``bls`` API whose implementation is swapped at runtime —
+
+  use_py()       pure-Python oracle (role of the reference's py_ecc)
+  use_jax()      batched JAX kernels, jit-compiled for TPU (replaces the
+                 reference's milagro/arkworks Rust backends)
+  use_fastest()  jax if available, else py
+
+plus the test kill-switch ``bls_active`` with STUB constants
+(``bls.py:49-57,93-104``): when inactive, Sign returns a stub and verifies
+trivially pass — used by the harness's @never_bls/@always_bls decorators.
+"""
+from typing import Sequence
+
+from consensus_specs_tpu.ops.bls12_381 import ciphersuite as _py_backend
+from consensus_specs_tpu.ops.bls12_381.fields import R_ORDER as CURVE_ORDER  # noqa: F401
+from consensus_specs_tpu.ops.bls12_381.curve import (  # noqa: F401
+    G1Point, G2Point, G1_GENERATOR, G2_GENERATOR,
+    g1_from_compressed as bytes48_to_G1,
+    g2_from_compressed as bytes96_to_G2,
+)
+from consensus_specs_tpu.ops.bls12_381.pairing import multi_pairing_check as pairing_check
+from consensus_specs_tpu.ops.bls12_381.hash_to_curve import hash_to_g2
+
+bls_active = True
+
+STUB_SIGNATURE = b"\x11" * 96
+STUB_PUBKEY = b"\x22" * 48
+# stub return of signature_to_G2 when bls is inactive: the G2 infinity point
+STUB_COORDINATES = G2Point.inf()
+
+_backend = _py_backend
+_backend_name = "py"
+
+
+def use_py():
+    global _backend, _backend_name
+    _backend = _py_backend
+    _backend_name = "py"
+
+
+def use_jax():
+    global _backend, _backend_name
+    from consensus_specs_tpu.ops import bls_jax
+    _backend = bls_jax
+    _backend_name = "jax"
+
+
+def use_fastest():
+    try:
+        use_jax()
+    except Exception:
+        use_py()
+
+
+def backend_name() -> str:
+    return _backend_name
+
+
+def only_with_bls(alt_return=None):
+    """Decorator: skip the wrapped check when bls is disabled."""
+    def decorator(fn):
+        def wrapper(*args, **kwargs):
+            if not bls_active:
+                return alt_return
+            return fn(*args, **kwargs)
+        return wrapper
+    return decorator
+
+
+@only_with_bls(alt_return=True)
+def Verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
+    return _backend.Verify(bytes(pk), bytes(msg), bytes(sig))
+
+
+@only_with_bls(alt_return=True)
+def AggregateVerify(pks: Sequence[bytes], msgs: Sequence[bytes], sig: bytes) -> bool:
+    return _backend.AggregateVerify([bytes(p) for p in pks], [bytes(m) for m in msgs], bytes(sig))
+
+
+@only_with_bls(alt_return=True)
+def FastAggregateVerify(pks: Sequence[bytes], msg: bytes, sig: bytes) -> bool:
+    return _backend.FastAggregateVerify([bytes(p) for p in pks], bytes(msg), bytes(sig))
+
+
+@only_with_bls(alt_return=STUB_SIGNATURE)
+def Aggregate(signatures: Sequence[bytes]) -> bytes:
+    return _backend.Aggregate([bytes(s) for s in signatures])
+
+
+@only_with_bls(alt_return=STUB_SIGNATURE)
+def Sign(sk: int, msg: bytes) -> bytes:
+    return _backend.Sign(sk, bytes(msg))
+
+
+@only_with_bls(alt_return=STUB_PUBKEY)
+def AggregatePKs(pubkeys: Sequence[bytes]) -> bytes:
+    return _backend.AggregatePKs([bytes(p) for p in pubkeys])
+
+
+@only_with_bls(alt_return=STUB_PUBKEY)
+def SkToPk(sk: int) -> bytes:
+    # NOTE: deliberate divergence — the reference stubs SkToPk with the
+    # 96-byte STUB_SIGNATURE (bls.py:182-183), which is the wrong width for a
+    # pubkey; we return the 48-byte STUB_PUBKEY instead.
+    return _backend.SkToPk(sk)
+
+
+@only_with_bls(alt_return=True)
+def KeyValidate(pk: bytes) -> bool:
+    return _backend.KeyValidate(bytes(pk))
+
+
+@only_with_bls(alt_return=STUB_COORDINATES)
+def signature_to_G2(sig: bytes) -> G2Point:
+    return bytes96_to_G2(bytes(sig))
+
+
+# ---------------------------------------------------------------------------
+# Raw point helpers (reference bls.py:190-326) — used directly by the KZG
+# spec functions (g1_lincomb, pairing checks) and by test vector generators.
+# ---------------------------------------------------------------------------
+
+def add(lhs, rhs):
+    return lhs + rhs
+
+
+def multiply(point, scalar: int):
+    return point.mult(int(scalar))
+
+
+def neg(point):
+    return -point
+
+
+def Z1():
+    return G1Point.inf()
+
+
+def Z2():
+    return G2Point.inf()
+
+
+def G1():
+    return G1_GENERATOR
+
+
+def G2():
+    return G2_GENERATOR
+
+
+def G1_to_bytes48(point) -> bytes:
+    return point.to_compressed()
+
+
+def G2_to_bytes96(point) -> bytes:
+    return point.to_compressed()

@@ -305,15 +305,11 @@ class _BitsBase(SSZValue):
     def _bitfield_bytes(self, with_delimiter: bool) -> bytes:
         n = len(self._bits)
         nbytes = (n + (1 if with_delimiter else 0) + 7) // 8
-        if not with_delimiter:
-            nbytes = (n + 7) // 8
-        buf = bytearray(max(nbytes, 1 if with_delimiter else nbytes))
+        buf = bytearray(nbytes)
         for i, b in enumerate(self._bits):
             if b:
                 buf[i // 8] |= 1 << (i % 8)
         if with_delimiter:
-            if len(buf) * 8 < n + 1:
-                buf.append(0)
             buf[n // 8] |= 1 << (n % 8)
         return bytes(buf)
 
@@ -694,8 +690,13 @@ class _ContainerMeta(type):
         for base in reversed(cls.__mro__):
             anns = base.__dict__.get("__annotations__", {})
             for fname, ftype in anns.items():
-                if isinstance(ftype, type):
-                    fields[fname] = ftype
+                if fname.startswith("_"):
+                    continue  # internal bookkeeping, not an SSZ field
+                if not isinstance(ftype, type):
+                    raise TypeError(
+                        f"{name}.{fname}: SSZ field annotations must be live types "
+                        f"(got {ftype!r}); string/postponed annotations are not supported")
+                fields[fname] = ftype
         cls._fields = fields
         cls._immutable_fields = all(
             issubclass(t, (BasicValue, ByteVectorBase, ByteListBase))
@@ -877,7 +878,13 @@ class UnionBase(SSZValue):
 
     @classmethod
     def coerce(cls, value):
-        return value if type(value) is cls else cls(value)
+        if type(value) is cls:
+            return value
+        if isinstance(value, tuple) and len(value) == 2:
+            return cls(value[0], value[1])
+        raise TypeError(
+            f"cannot coerce {type(value).__name__} to {cls.__name__}; "
+            "pass a Union instance or a (selector, value) tuple")
 
     @classmethod
     def decode_bytes(cls, data: bytes):

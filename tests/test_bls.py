@@ -1,0 +1,136 @@
+"""BLS signature scheme tests (python oracle backend).
+
+Covers the edge cases the reference's ``bls`` vector suite targets
+(reference: ``tests/generators/bls/main.py``): sign/verify round trips,
+aggregation, wrong-key/wrong-message rejection, infinity points, tampered
+and non-canonical encodings, subgroup checks.
+"""
+import pytest
+
+from consensus_specs_tpu.utils import bls
+from consensus_specs_tpu.ops.bls12_381 import (
+    G1_GENERATOR, G2_GENERATOR, R_ORDER, pairing,
+)
+from consensus_specs_tpu.ops.bls12_381.fields import Fq12
+from consensus_specs_tpu.ops.bls12_381.hash_to_curve import hash_to_g2
+from consensus_specs_tpu.ops.bls12_381.curve import G1Point, G2Point
+
+SKS = [1, 2, 3, 12345, R_ORDER - 1]
+MSG_A = b"\xab" * 32
+MSG_B = b"\xcd" * 32
+
+
+def setup_module():
+    bls.use_py()
+    bls.bls_active = True
+
+
+def test_sign_verify_roundtrip():
+    for sk in SKS[:3]:
+        pk = bls.SkToPk(sk)
+        sig = bls.Sign(sk, MSG_A)
+        assert bls.Verify(pk, MSG_A, sig)
+        assert not bls.Verify(pk, MSG_B, sig)
+        assert not bls.Verify(bls.SkToPk(sk + 1), MSG_A, sig)
+
+
+def test_tampered_signature_rejected():
+    pk = bls.SkToPk(7)
+    sig = bytearray(bls.Sign(7, MSG_A))
+    sig[-1] ^= 1
+    assert not bls.Verify(pk, MSG_A, bytes(sig))
+
+
+def test_aggregate_same_message():
+    pks = [bls.SkToPk(sk) for sk in SKS[:3]]
+    sigs = [bls.Sign(sk, MSG_A) for sk in SKS[:3]]
+    agg = bls.Aggregate(sigs)
+    assert bls.FastAggregateVerify(pks, MSG_A, agg)
+    assert not bls.FastAggregateVerify(pks, MSG_B, agg)
+    assert not bls.FastAggregateVerify(pks[:2], MSG_A, agg)
+    # aggregate pubkey equivalence
+    agg_pk = bls.AggregatePKs(pks)
+    assert bls.Verify(agg_pk, MSG_A, agg)
+
+
+def test_aggregate_verify_distinct_messages():
+    msgs = [bytes([i]) * 32 for i in range(3)]
+    pks = [bls.SkToPk(sk) for sk in SKS[:3]]
+    sigs = [bls.Sign(sk, m) for sk, m in zip(SKS[:3], msgs)]
+    agg = bls.Aggregate(sigs)
+    assert bls.AggregateVerify(pks, msgs, agg)
+    assert not bls.AggregateVerify(pks, list(reversed(msgs)), agg)
+    assert not bls.AggregateVerify(list(reversed(pks)), msgs, agg)
+
+
+def test_empty_aggregation_invalid():
+    with pytest.raises(ValueError):
+        bls.Aggregate([])
+    with pytest.raises(ValueError):
+        bls.AggregatePKs([])
+    assert not bls.FastAggregateVerify([], MSG_A, bls.Sign(1, MSG_A))
+    assert not bls.AggregateVerify([], [], bls.Sign(1, MSG_A))
+
+
+def test_infinity_pubkey_rejected():
+    inf_pk = bytes([0xC0]) + b"\x00" * 47
+    assert not bls.KeyValidate(inf_pk)
+    sig = bls.Sign(1, MSG_A)
+    assert not bls.Verify(inf_pk, MSG_A, sig)
+
+
+def test_infinity_signature():
+    inf_sig = bytes([0xC0]) + b"\x00" * 95
+    pk = bls.SkToPk(5)
+    assert not bls.Verify(pk, MSG_A, inf_sig)
+
+
+def test_bad_encodings():
+    assert not bls.KeyValidate(b"\x00" * 48)            # no compression bit
+    assert not bls.KeyValidate(b"\xff" * 48)            # x >= p
+    assert not bls.Verify(bls.SkToPk(1), MSG_A, b"\x00" * 96)
+    assert not bls.KeyValidate(b"\x22" * 48)            # stub pubkey
+
+
+def test_non_subgroup_g1_rejected():
+    # find a curve point NOT in the r-order subgroup (cofactor h1 > 1)
+    from consensus_specs_tpu.ops.bls12_381.fields import Fq
+    from consensus_specs_tpu.ops.bls12_381.curve import B1
+    x = 0
+    pt = None
+    while True:
+        x += 1
+        y = (Fq(x) * Fq(x) * Fq(x) + B1).sqrt()
+        if y is None:
+            continue
+        cand = G1Point(Fq(x), y)
+        if not cand.in_subgroup():
+            pt = cand
+            break
+    assert not bls.KeyValidate(pt.to_compressed())
+
+
+def test_bls_switch_stub():
+    bls.bls_active = False
+    try:
+        assert bls.Sign(1, MSG_A) == bls.STUB_SIGNATURE
+        assert bls.Verify(b"junk", MSG_A, b"junk")
+    finally:
+        bls.bls_active = True
+
+
+def test_signature_matches_pairing_identity():
+    # e(pk, H(m)) == e(g1, sig) directly
+    sk = 42
+    hm = hash_to_g2(MSG_A)
+    sig_pt = hm.mult(sk)
+    lhs = pairing(G1_GENERATOR.mult(sk), hm)
+    rhs = pairing(G1_GENERATOR, sig_pt)
+    assert lhs == rhs
+
+
+def test_hash_to_g2_homomorphic_isogeny():
+    # independence from representative: clear_cofactor lands in G2 always
+    for m in (b"a", b"b", b"c"):
+        p = hash_to_g2(m)
+        assert p.mult(R_ORDER).infinity and not p.infinity
