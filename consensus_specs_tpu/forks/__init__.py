@@ -1,0 +1,56 @@
+"""Per-fork spec runtimes.
+
+Each fork is a class extending the previous fork's class (fork inheritance =
+class inheritance, replacing the reference's markdown dict-merge pipeline,
+``pysetup/helpers.py:222-247``). ``build_spec(fork, preset)`` instantiates a
+fork spec bound to a preset + config; instances are cached like the
+reference's ``spec_targets`` (``test/helpers/specs.py:19-26``).
+"""
+from typing import Dict, Optional, Tuple
+
+_REGISTRY = {}
+
+
+def register_fork(name):
+    def deco(cls):
+        _REGISTRY[name] = cls
+        cls.fork = name
+        return cls
+    return deco
+
+
+def fork_registry() -> Dict[str, type]:
+    if not _REGISTRY:
+        _import_all()
+    return dict(_REGISTRY)
+
+
+def _import_all():
+    from . import phase0  # noqa: F401
+    for mod in ("altair", "bellatrix", "capella", "deneb"):
+        try:
+            __import__(f"{__name__}.{mod}")
+        except ImportError:
+            pass
+
+
+_spec_cache: Dict[Tuple[str, str, Optional[frozenset]], object] = {}
+
+
+def build_spec(fork: str, preset_name: str, config_overrides: Optional[dict] = None):
+    """Build (or fetch cached) spec instance for fork × preset."""
+    key = (fork, preset_name,
+           frozenset(config_overrides.items()) if config_overrides else None)
+    spec = _spec_cache.get(key)
+    if spec is None:
+        from consensus_specs_tpu.config import load_preset, load_config
+        registry = fork_registry()
+        if fork not in registry:
+            raise ValueError(f"unknown fork {fork!r}; have {sorted(registry)}")
+        preset = load_preset(preset_name)
+        config = load_config(preset_name)
+        if config_overrides:
+            config = {**config, **config_overrides}
+        spec = registry[fork](preset, config, preset_name=preset_name)
+        _spec_cache[key] = spec
+    return spec

@@ -1,0 +1,168 @@
+"""Attestation-building helpers.
+
+Reference: ``test/helpers/attestations.py`` (build_attestation_data:~50,
+get_valid_attestation:91, sign_attestation, run_attestation_processing:14).
+"""
+from consensus_specs_tpu.utils import bls
+from consensus_specs_tpu.utils.ssz import Bitlist
+from .keys import privkeys
+from .block import build_empty_block_for_next_slot
+
+
+def build_attestation_data(spec, state, slot, index, beacon_block_root=None):
+    assert state.slot >= slot
+
+    if beacon_block_root is not None:
+        pass
+    elif slot == state.slot:
+        beacon_block_root = build_empty_block_for_next_slot(spec, state).parent_root
+    else:
+        beacon_block_root = spec.get_block_root_at_slot(state, slot)
+
+    current_epoch_start_slot = spec.compute_start_slot_at_epoch(
+        spec.get_current_epoch(state))
+    if slot < current_epoch_start_slot:
+        epoch_boundary_root = spec.get_block_root(state, spec.get_previous_epoch(state))
+    elif slot == current_epoch_start_slot:
+        epoch_boundary_root = beacon_block_root
+    else:
+        epoch_boundary_root = spec.get_block_root(state, spec.get_current_epoch(state))
+
+    if slot < current_epoch_start_slot:
+        source = state.previous_justified_checkpoint
+    else:
+        source = state.current_justified_checkpoint
+
+    return spec.AttestationData(
+        slot=slot,
+        index=index,
+        beacon_block_root=beacon_block_root,
+        source=spec.Checkpoint(epoch=source.epoch, root=source.root),
+        target=spec.Checkpoint(
+            epoch=spec.compute_epoch_at_slot(slot), root=epoch_boundary_root),
+    )
+
+
+def get_valid_attestation(spec, state, slot=None, index=None,
+                          filter_participant_set=None, beacon_block_root=None,
+                          signed=False):
+    if slot is None:
+        slot = state.slot
+    if index is None:
+        index = 0
+
+    attestation_data = build_attestation_data(
+        spec, state, slot=slot, index=index, beacon_block_root=beacon_block_root)
+    beacon_committee = spec.get_beacon_committee(
+        state, attestation_data.slot, attestation_data.index)
+    committee_size = len(beacon_committee)
+    attestation = spec.Attestation(
+        aggregation_bits=Bitlist[spec.MAX_VALIDATORS_PER_COMMITTEE]([0] * committee_size),
+        data=attestation_data,
+    )
+    # fill the attestation with (optionally filtered) participants, and optionally sign it
+    fill_aggregate_attestation(
+        spec, state, attestation, signed=signed,
+        filter_participant_set=filter_participant_set)
+    return attestation
+
+
+def fill_aggregate_attestation(spec, state, attestation, signed=False,
+                               filter_participant_set=None):
+    committee = spec.get_beacon_committee(
+        state, attestation.data.slot, attestation.data.index)
+    participants = set(committee)
+    if filter_participant_set is not None:
+        participants = filter_participant_set(participants)
+    for i in range(len(committee)):
+        attestation.aggregation_bits[i] = committee[i] in participants
+    if signed and len(participants) > 0:
+        sign_attestation(spec, state, attestation)
+
+
+def participants_filter(committee):
+    return committee
+
+
+def sign_attestation(spec, state, attestation):
+    participants = spec.get_attesting_indices(
+        state, attestation.data, attestation.aggregation_bits)
+    attestation.signature = sign_aggregate_attestation(
+        spec, state, attestation.data, participants)
+
+
+def sign_aggregate_attestation(spec, state, attestation_data, participants):
+    signatures = []
+    for validator_index in participants:
+        privkey = privkeys[validator_index]
+        signatures.append(
+            get_attestation_signature(spec, state, attestation_data, privkey))
+    return bls.Aggregate(signatures)
+
+
+def get_attestation_signature(spec, state, attestation_data, privkey):
+    domain = spec.get_domain(
+        state, spec.DOMAIN_BEACON_ATTESTER, attestation_data.target.epoch)
+    signing_root = spec.compute_signing_root(attestation_data, domain)
+    return bls.Sign(privkey, signing_root)
+
+
+def run_attestation_processing(spec, state, attestation, valid=True):
+    """Run ``process_attestation``, yielding (pre, attestation, post) vector
+    parts; if ``valid == False`` the op must raise and post is None.
+    Reference: test/helpers/attestations.py:14-52.
+    """
+    yield "pre", state
+    yield "attestation", attestation
+
+    if not valid:
+        try:
+            spec.process_attestation(state, attestation)
+        except (AssertionError, IndexError, ValueError):
+            yield "post", None
+            return
+        raise AssertionError("attestation processing should have failed")
+
+    current_epoch_count = len(state.current_epoch_attestations)
+    previous_epoch_count = len(state.previous_epoch_attestations)
+
+    spec.process_attestation(state, attestation)
+
+    if attestation.data.target.epoch == spec.get_current_epoch(state):
+        assert len(state.current_epoch_attestations) == current_epoch_count + 1
+    else:
+        assert len(state.previous_epoch_attestations) == previous_epoch_count + 1
+
+    yield "post", state
+
+
+def next_epoch_with_attestations(spec, state, fill_cur_epoch, fill_prev_epoch):
+    from .block import build_empty_block_for_next_slot, state_transition_and_sign_block
+    assert state.slot % spec.SLOTS_PER_EPOCH == 0
+
+    post_state = state.copy()
+    signed_blocks = []
+    for _ in range(spec.SLOTS_PER_EPOCH):
+        block = build_empty_block_for_next_slot(spec, post_state)
+        if fill_cur_epoch and post_state.slot >= spec.MIN_ATTESTATION_INCLUSION_DELAY:
+            slot_to_attest = post_state.slot - spec.MIN_ATTESTATION_INCLUSION_DELAY + 1
+            committees_per_slot = spec.get_committee_count_per_slot(
+                post_state, spec.compute_epoch_at_slot(slot_to_attest))
+            if slot_to_attest >= spec.compute_start_slot_at_epoch(
+                    spec.get_current_epoch(post_state)):
+                for index in range(committees_per_slot):
+                    attestation = get_valid_attestation(
+                        spec, post_state, slot_to_attest, index=index, signed=True)
+                    block.body.attestations.append(attestation)
+        if fill_prev_epoch:
+            slot_to_attest = post_state.slot - spec.SLOTS_PER_EPOCH + 1
+            committees_per_slot = spec.get_committee_count_per_slot(
+                post_state, spec.compute_epoch_at_slot(slot_to_attest))
+            for index in range(committees_per_slot):
+                attestation = get_valid_attestation(
+                    spec, post_state, slot_to_attest, index=index, signed=True)
+                block.body.attestations.append(attestation)
+        signed_block = state_transition_and_sign_block(spec, post_state, block)
+        signed_blocks.append(signed_block)
+
+    return state, signed_blocks, post_state

@@ -1,0 +1,128 @@
+"""Block-building helpers.
+
+Reference: ``test/helpers/block.py`` (build_empty_block:93, sign_block:69,
+transition_unsigned_block:75, state_transition_and_sign_block).
+"""
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+from consensus_specs_tpu.utils import bls
+from .keys import privkeys
+
+
+def get_proposer_index_maybe(spec, state, slot, proposer_index=None):
+    if proposer_index is None:
+        if slot == state.slot:
+            proposer_index = spec.get_beacon_proposer_index(state)
+        else:
+            future_state = state.copy()
+            spec.process_slots(future_state, slot)
+            proposer_index = spec.get_beacon_proposer_index(future_state)
+    return proposer_index
+
+
+def apply_randao_reveal(spec, state, block, proposer_index):
+    assert state.slot <= block.slot
+    privkey = privkeys[proposer_index]
+    epoch = spec.compute_epoch_at_slot(block.slot)
+    domain = spec.get_domain(state, spec.DOMAIN_RANDAO, epoch)
+    signing_root = spec.compute_signing_root(spec.uint64(epoch), domain)
+    block.body.randao_reveal = bls.Sign(privkey, signing_root)
+
+
+def apply_sig(spec, state, signed_block, proposer_index=None):
+    if not bls.bls_active:
+        return
+    block = signed_block.message
+    proposer_index = get_proposer_index_maybe(spec, state, block.slot, proposer_index)
+    privkey = privkeys[proposer_index]
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_PROPOSER,
+                             spec.compute_epoch_at_slot(block.slot))
+    signing_root = spec.compute_signing_root(block, domain)
+    signed_block.signature = bls.Sign(privkey, signing_root)
+
+
+def sign_block(spec, state, block, proposer_index=None):
+    signed_block = spec.SignedBeaconBlock(message=block)
+    apply_sig(spec, state, signed_block, proposer_index)
+    return signed_block
+
+
+def get_state_and_beacon_parent_root_at_slot(spec, state, slot):
+    if slot < state.slot:
+        raise Exception("cannot build blocks for past slots")
+    if slot > state.slot:
+        state = state.copy()
+        spec.process_slots(state, slot)
+    previous_block_header = state.latest_block_header.copy()
+    if previous_block_header.state_root == spec.Root():
+        previous_block_header.state_root = hash_tree_root(state)
+    return state, hash_tree_root(previous_block_header)
+
+
+def build_empty_block(spec, state, slot=None, proposer_index=None):
+    """Build an empty block for ``slot`` upon the latest header seen by state."""
+    if slot is None:
+        slot = state.slot
+    if slot < state.slot:
+        raise Exception("cannot build blocks for past slots")
+    if state.slot < slot:
+        state = state.copy()
+        spec.process_slots(state, slot)
+
+    state, parent_block_root = get_state_and_beacon_parent_root_at_slot(spec, state, slot)
+    if proposer_index is None:
+        proposer_index = spec.get_beacon_proposer_index(state)
+    block = spec.BeaconBlock()
+    block.slot = slot
+    block.proposer_index = proposer_index
+    block.body.eth1_data.deposit_count = state.eth1_deposit_index
+    block.parent_root = parent_block_root
+    apply_randao_reveal(spec, state, block, proposer_index)
+    return block
+
+
+def build_empty_block_for_next_slot(spec, state, proposer_index=None):
+    return build_empty_block(spec, state, state.slot + 1, proposer_index)
+
+
+def transition_unsigned_block(spec, state, block):
+    assert state.slot < block.slot
+    spec.process_slots(state, block.slot)
+    assert state.latest_block_header.slot < block.slot
+    assert state.slot == block.slot
+    spec.process_block(state, block)
+    return block
+
+
+def apply_empty_block(spec, state, slot=None):
+    block = build_empty_block(spec, state, slot)
+    return transition_unsigned_block(spec, state, block)
+
+
+def state_transition_and_sign_block(spec, state, block):
+    """Transition state to block's slot, process block, set the state root,
+    and return the signed block."""
+    transition_unsigned_block(spec, state, block)
+    block.state_root = hash_tree_root(state)
+    return sign_block(spec, state, block, block.proposer_index)
+
+
+def next_slot(spec, state):
+    spec.process_slots(state, state.slot + 1)
+
+
+def next_slots(spec, state, slots):
+    if slots > 0:
+        spec.process_slots(state, state.slot + slots)
+
+
+def next_epoch(spec, state):
+    slot = state.slot + spec.SLOTS_PER_EPOCH - (state.slot % spec.SLOTS_PER_EPOCH)
+    spec.process_slots(state, slot)
+
+
+def next_epoch_via_block(spec, state):
+    """Transition to the start slot of the next epoch via a (signed) full block."""
+    block = build_empty_block(
+        spec, state,
+        state.slot + spec.SLOTS_PER_EPOCH - state.slot % spec.SLOTS_PER_EPOCH)
+    return state_transition_and_sign_block(spec, state, block)

@@ -1,8 +1,242 @@
-"""Harness globals set by pytest CLI flags (filled out with the decorator DSL).
+"""Test decorator DSL.
 
-Reference: tests/core/pyspec/eth2spec/test/context.py + conftest.py.
+Reference: ``test/context.py`` — @spec_state_test, @with_all_phases,
+@with_phases, @with_presets, @always_bls/@never_bls, @with_custom_state,
+@with_config_overrides, expect_assertion_error, plus the genesis-state LRU
+cache (context.py:61-81). Tests are written once as generators yielding
+(name, value) vector parts; under pytest the parts are consumed and
+discarded, under the vector generator they are written to files.
 """
+import functools
+
+import pytest
+
+from consensus_specs_tpu.utils import bls
+from consensus_specs_tpu.utils.ssz import serialize, deserialize
+from consensus_specs_tpu.forks import build_spec, fork_registry
+from .genesis import create_genesis_state
+
+# set by tests/conftest.py from pytest CLI flags
 DEFAULT_TEST_PRESET = "minimal"
 DEFAULT_BLS_ACTIVE = True
 DEFAULT_BLS_TYPE = "py"
 ONLY_FORK = None
+
+ALL_PHASES = ("phase0", "altair", "bellatrix", "capella", "deneb")
+MINIMAL = "minimal"
+MAINNET = "mainnet"
+
+
+def _available_phases():
+    reg = fork_registry()
+    return [p for p in ALL_PHASES if p in reg]
+
+
+# ---------------------------------------------------------------------------
+# balance profiles (reference context.py:100-196)
+# ---------------------------------------------------------------------------
+
+def default_balances(spec):
+    num_validators = spec.SLOTS_PER_EPOCH * 8
+    return [spec.MAX_EFFECTIVE_BALANCE] * num_validators
+
+
+def default_activation_threshold(spec):
+    return spec.MAX_EFFECTIVE_BALANCE
+
+
+def zero_activation_threshold(spec):
+    return 0
+
+
+def low_balances(spec):
+    num_validators = spec.SLOTS_PER_EPOCH * 8
+    low_balance = 18 * 10**9
+    return [low_balance] * num_validators
+
+
+def misc_balances(spec):
+    num_validators = spec.SLOTS_PER_EPOCH * 8
+    balances = [spec.MAX_EFFECTIVE_BALANCE * 2 * i // num_validators
+                for i in range(num_validators)]
+    rng = __import__("random").Random(929)
+    rng.shuffle(balances)
+    return balances
+
+
+def large_validator_set(spec):
+    num_validators = 2 * spec.SLOTS_PER_EPOCH * spec.MAX_COMMITTEES_PER_SLOT \
+        * spec.TARGET_COMMITTEE_SIZE
+    return [spec.MAX_EFFECTIVE_BALANCE] * num_validators
+
+
+# ---------------------------------------------------------------------------
+# genesis-state cache: immutable serialized snapshot, fresh copy per test
+# ---------------------------------------------------------------------------
+
+_state_cache = {}
+
+
+def _get_genesis_state(spec, balances_fn, threshold_fn):
+    # spec instances are cached per (fork, preset, config-overrides) in
+    # build_spec, so the instance id discriminates config-overridden specs
+    key = (spec.fork, spec.preset_name, id(spec),
+           balances_fn.__name__, threshold_fn.__name__)
+    blob = _state_cache.get(key)
+    if blob is None:
+        state = create_genesis_state(spec, balances_fn(spec), threshold_fn(spec))
+        blob = serialize(state)
+        _state_cache[key] = blob
+    return deserialize(spec.BeaconState, blob)
+
+
+# ---------------------------------------------------------------------------
+# core runners
+# ---------------------------------------------------------------------------
+
+def expect_assertion_error(fn):
+    """reference context.py:299-310 — AssertionError/IndexError mean 'invalid'."""
+    bad_success = False
+    try:
+        fn()
+        bad_success = True
+    except (AssertionError, IndexError, ValueError):
+        pass
+    if bad_success:
+        raise AssertionError("expected an assertion error, but got none")
+
+
+def _consume(result):
+    """Run a test generator to completion (pytest mode discards the parts)."""
+    if result is not None and hasattr(result, "__iter__"):
+        return list(result)
+    return result
+
+
+def _set_bls_backend():
+    if DEFAULT_BLS_TYPE == "jax":
+        bls.use_jax()
+    elif DEFAULT_BLS_TYPE == "fastest":
+        bls.use_fastest()
+    else:
+        bls.use_py()
+
+
+def spec_test(fn):
+    """Consume vector yields; apply the session default bls setting."""
+    @functools.wraps(fn)
+    def entry(*args, **kwargs):
+        old_active = bls.bls_active
+        bls.bls_active = DEFAULT_BLS_ACTIVE
+        _set_bls_backend()
+        try:
+            return _consume(fn(*args, **kwargs))
+        finally:
+            bls.bls_active = old_active
+    return entry
+
+
+def always_bls(fn):
+    """Force signature checks on for this test regardless of --disable-bls."""
+    @functools.wraps(fn)
+    def entry(*args, **kwargs):
+        old = bls.bls_active
+        bls.bls_active = True
+        try:
+            return _consume(fn(*args, **kwargs))
+        finally:
+            bls.bls_active = old
+    entry._bls_mode = "always"
+    return entry
+
+
+def never_bls(fn):
+    @functools.wraps(fn)
+    def entry(*args, **kwargs):
+        old = bls.bls_active
+        bls.bls_active = False
+        try:
+            return _consume(fn(*args, **kwargs))
+        finally:
+            bls.bls_active = old
+    entry._bls_mode = "never"
+    return entry
+
+
+def with_custom_state(balances_fn, threshold_fn):
+    def deco(fn):
+        @functools.wraps(fn)
+        def entry(*args, spec, **kwargs):
+            state = _get_genesis_state(spec, balances_fn, threshold_fn)
+            return fn(*args, spec=spec, state=state, **kwargs)
+        return entry
+    return deco
+
+
+def with_state(fn):
+    return with_custom_state(default_balances, default_activation_threshold)(fn)
+
+
+def single_phase(fn):
+    return fn
+
+
+def spec_state_test(fn):
+    """reference context.py:250-251: spec_test + with_state + single_phase"""
+    return spec_test(with_state(single_phase(fn)))
+
+
+def with_config_overrides(config_overrides):
+    """Swap the spec for one built with overridden config vars."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def entry(*args, spec, **kwargs):
+            overridden = build_spec(spec.fork, spec.preset_name, config_overrides)
+            return fn(*args, spec=overridden, **kwargs)
+        return entry
+    return deco
+
+
+def with_phases(phases, other_phases=None):
+    """Run the test once per fork in ``phases`` (intersected with CLI --fork)."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def entry(*args, **kwargs):
+            available = _available_phases()
+            ran = False
+            for fork in phases:
+                if fork not in available:
+                    continue
+                if ONLY_FORK is not None and fork != ONLY_FORK:
+                    continue
+                spec = build_spec(fork, DEFAULT_TEST_PRESET)
+                fn(*args, spec=spec, **kwargs)
+                ran = True
+            if not ran:
+                pytest.skip("no selected fork supports this test")
+        # pytest introspects __wrapped__ for the signature and would treat
+        # spec/state as fixtures; the wrapper takes no pytest arguments.
+        if hasattr(entry, "__wrapped__"):
+            del entry.__wrapped__
+        return entry
+    return deco
+
+
+def with_all_phases(fn):
+    return with_phases(ALL_PHASES)(fn)
+
+
+def with_all_phases_from(earliest):
+    idx = ALL_PHASES.index(earliest)
+    return with_phases(ALL_PHASES[idx:])
+
+
+def with_presets(preset_names, reason=None):
+    def deco(fn):
+        @functools.wraps(fn)
+        def entry(*args, **kwargs):
+            if DEFAULT_TEST_PRESET not in preset_names:
+                pytest.skip(reason or f"test requires presets {preset_names}")
+            return fn(*args, **kwargs)
+        return entry
+    return deco

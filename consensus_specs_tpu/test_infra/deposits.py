@@ -1,0 +1,136 @@
+"""Deposit-building helpers with real Merkle proofs.
+
+Reference: ``test/helpers/deposits.py`` — builds the deposit-contract tree
+(depth 32) and per-deposit branches, so ``process_deposit``'s
+``is_valid_merkle_branch`` check is exercised for real.
+"""
+from consensus_specs_tpu.utils.hash_function import hash
+from consensus_specs_tpu.utils.ssz import hash_tree_root, uint64
+from consensus_specs_tpu.utils.ssz.merkle import zero_hashes
+from consensus_specs_tpu.utils import bls
+from .keys import privkeys, pubkeys
+
+
+def _merkle_tree(leaves, depth):
+    """Layers[0]=leaves padded virtually; returns list of dict layers."""
+    layers = [{i: leaf for i, leaf in enumerate(leaves)}]
+    for d in range(depth):
+        prev = layers[-1]
+        nxt = {}
+        for i in set(k // 2 for k in prev):
+            left = prev.get(2 * i, zero_hashes[d])
+            right = prev.get(2 * i + 1, zero_hashes[d])
+            nxt[i] = hash(left + right)
+        layers.append(nxt)
+    return layers
+
+
+def _merkle_root_and_proof(leaves, depth, index):
+    layers = _merkle_tree(leaves, depth)
+    proof = []
+    for d in range(depth):
+        sibling = (index >> d) ^ 1
+        proof.append(layers[d].get(sibling, zero_hashes[d]))
+    root = layers[depth].get(0, zero_hashes[depth])
+    return root, proof
+
+
+def build_deposit_data(spec, pubkey, privkey, amount, withdrawal_credentials, signed=False):
+    deposit_data = spec.DepositData(
+        pubkey=pubkey,
+        withdrawal_credentials=withdrawal_credentials,
+        amount=amount,
+    )
+    if signed:
+        sign_deposit_data(spec, deposit_data, privkey)
+    return deposit_data
+
+
+def sign_deposit_data(spec, deposit_data, privkey):
+    deposit_message = spec.DepositMessage(
+        pubkey=deposit_data.pubkey,
+        withdrawal_credentials=deposit_data.withdrawal_credentials,
+        amount=deposit_data.amount)
+    domain = spec.compute_domain(spec.DOMAIN_DEPOSIT)
+    signing_root = spec.compute_signing_root(deposit_message, domain)
+    deposit_data.signature = bls.Sign(privkey, signing_root)
+
+
+def build_deposit(spec, deposit_data_list, pubkey, privkey, amount,
+                  withdrawal_credentials, signed):
+    deposit_data = build_deposit_data(
+        spec, pubkey, privkey, amount, withdrawal_credentials, signed)
+    index = len(deposit_data_list)
+    deposit_data_list.append(deposit_data)
+    return deposit_from_context(spec, deposit_data_list, index)
+
+
+def deposit_from_context(spec, deposit_data_list, index):
+    depth = spec.DEPOSIT_CONTRACT_TREE_DEPTH
+    leaves = [hash_tree_root(d) for d in deposit_data_list]
+    root, proof = _merkle_root_and_proof(leaves, depth, index)
+    # mix in the list length (List merkleization) as the last proof element
+    root = hash(root + uint64(len(leaves)).serialize().ljust(32, b"\x00"))
+    proof = proof + [uint64(len(leaves)).serialize().ljust(32, b"\x00")]
+    deposit = spec.Deposit(
+        proof=proof,
+        data=deposit_data_list[index],
+    )
+    return deposit, root, deposit_data_list
+
+
+def prepare_state_and_deposit(spec, state, validator_index, amount,
+                              withdrawal_credentials=None, signed=False):
+    """Prepare the state for the deposit, and create a deposit for the given
+    validator, depositing the given amount."""
+    deposit_data_list = []
+    pubkey = pubkeys[validator_index]
+    privkey = privkeys[validator_index]
+    if withdrawal_credentials is None:
+        # insecurely use pubkey as withdrawal key
+        withdrawal_credentials = spec.BLS_WITHDRAWAL_PREFIX + hash(pubkey)[1:]
+    deposit, root, deposit_data_list = build_deposit(
+        spec, deposit_data_list, pubkey, privkey, amount,
+        withdrawal_credentials, signed)
+    state.eth1_deposit_index = 0
+    state.eth1_data.deposit_root = root
+    state.eth1_data.deposit_count = len(deposit_data_list)
+    return deposit
+
+
+def run_deposit_processing(spec, state, deposit, validator_index, valid=True,
+                           effective=True):
+    """Run ``process_deposit``, yielding (pre, deposit, post)."""
+    pre_validator_count = len(state.validators)
+    pre_balance = 0
+    is_top_up = validator_index < pre_validator_count
+    if is_top_up:
+        pre_balance = state.balances[validator_index]
+
+    yield "pre", state
+    yield "deposit", deposit
+
+    if not valid:
+        try:
+            spec.process_deposit(state, deposit)
+        except (AssertionError, IndexError, ValueError):
+            yield "post", None
+            return
+        raise AssertionError("deposit processing should have failed")
+
+    spec.process_deposit(state, deposit)
+
+    yield "post", state
+
+    if not effective or not bls.KeyValidate(deposit.data.pubkey):
+        assert len(state.validators) == pre_validator_count
+        if is_top_up:
+            assert state.balances[validator_index] == pre_balance
+    else:
+        if is_top_up:
+            assert len(state.validators) == pre_validator_count
+            assert state.balances[validator_index] == pre_balance + deposit.data.amount
+        else:
+            assert len(state.validators) == pre_validator_count + 1
+            assert state.balances[validator_index] == deposit.data.amount
+    assert state.eth1_deposit_index == state.eth1_data.deposit_count

@@ -1,0 +1,44 @@
+"""Epoch sub-transition isolation runner.
+
+Reference: ``test/helpers/epoch_processing.py:43-63`` — run every epoch
+sub-step *before* the one under test, then yield pre/post around it.
+"""
+
+
+def get_process_calls(spec):
+    return [
+        "process_justification_and_finalization",
+        "process_rewards_and_penalties",
+        "process_registry_updates",
+        "process_slashings",
+        "process_eth1_data_reset",
+        "process_effective_balance_updates",
+        "process_slashings_reset",
+        "process_randao_mixes_reset",
+        "process_historical_roots_update",
+        "process_participation_record_updates",
+    ]
+
+
+def run_epoch_processing_to(spec, state, process_name):
+    """Transition to the end of the epoch and run sub-transitions up to
+    (but excluding) ``process_name``."""
+    slot = state.slot + (spec.SLOTS_PER_EPOCH - state.slot % spec.SLOTS_PER_EPOCH)
+    # transition state to slot before epoch state transition
+    if state.slot < slot - 1:
+        spec.process_slots(state, slot - 1)
+    # start transitioning, do one slot update before the epoch itself
+    spec.process_slot(state)
+    # process components of epoch transition before ``process_name``
+    for name in get_process_calls(spec):
+        if name == process_name:
+            break
+        getattr(spec, name)(state)
+
+
+def run_epoch_processing_with(spec, state, process_name):
+    """Run the epoch sub-transition ``process_name``, yielding pre/post."""
+    run_epoch_processing_to(spec, state, process_name)
+    yield "pre", state
+    getattr(spec, process_name)(state)
+    yield "post", state

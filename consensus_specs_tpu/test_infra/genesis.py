@@ -1,0 +1,61 @@
+"""Genesis state builders for tests.
+
+Reference: ``test/helpers/genesis.py`` (build_mock_validator:15,
+create_genesis_state:74): states are built directly with mock validators —
+no deposit proofs — which is what makes the harness fast.
+"""
+from consensus_specs_tpu.utils.hash_function import hash
+from consensus_specs_tpu.utils.ssz import hash_tree_root, uint64
+from .keys import pubkeys
+
+
+def build_mock_validator(spec, i: int, balance: int):
+    pk = pubkeys[i]
+    # insecurely use pubkey as withdrawal key
+    withdrawal_credentials = spec.BLS_WITHDRAWAL_PREFIX + hash(pk)[1:]
+    validator = spec.Validator(
+        pubkey=pk,
+        withdrawal_credentials=withdrawal_credentials,
+        activation_eligibility_epoch=spec.FAR_FUTURE_EPOCH,
+        activation_epoch=spec.FAR_FUTURE_EPOCH,
+        exit_epoch=spec.FAR_FUTURE_EPOCH,
+        withdrawable_epoch=spec.FAR_FUTURE_EPOCH,
+        effective_balance=min(balance - balance % spec.EFFECTIVE_BALANCE_INCREMENT,
+                              spec.MAX_EFFECTIVE_BALANCE),
+    )
+    return validator
+
+
+def create_genesis_state(spec, validator_balances, activation_threshold):
+    deposit_root = b"\x42" * 32
+    eth1_block_hash = b"\xda" * 32
+    previous_version = spec.config.GENESIS_FORK_VERSION
+    current_version = spec.config.GENESIS_FORK_VERSION
+    state = spec.BeaconState(
+        genesis_time=0,
+        eth1_deposit_index=len(validator_balances),
+        eth1_data=spec.Eth1Data(
+            deposit_root=deposit_root,
+            deposit_count=len(validator_balances),
+            block_hash=eth1_block_hash,
+        ),
+        fork=spec.Fork(
+            previous_version=previous_version,
+            current_version=current_version,
+            epoch=spec.GENESIS_EPOCH,
+        ),
+        latest_block_header=spec.BeaconBlockHeader(
+            body_root=hash_tree_root(spec.BeaconBlockBody())),
+        randao_mixes=[eth1_block_hash] * spec.EPOCHS_PER_HISTORICAL_VECTOR,
+    )
+    # "hack" in the initial validators: much faster than processing deposits
+    for i, balance in enumerate(validator_balances):
+        state.validators.append(build_mock_validator(spec, i, balance))
+        state.balances.append(uint64(balance))
+    # process genesis activations through the live views (assignment copies)
+    for validator in state.validators:
+        if validator.effective_balance >= activation_threshold:
+            validator.activation_eligibility_epoch = spec.GENESIS_EPOCH
+            validator.activation_epoch = spec.GENESIS_EPOCH
+    state.genesis_validators_root = hash_tree_root(state.validators)
+    return state

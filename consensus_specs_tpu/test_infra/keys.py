@@ -1,0 +1,41 @@
+"""Deterministic test keypairs.
+
+Reference: ``test/helpers/keys.py`` (privkeys 1..N, pubkeys precomputed).
+Pubkeys are computed lazily through the *real* ciphersuite (never stubbed —
+states need unique, valid pubkeys even when signature checks are disabled).
+"""
+from consensus_specs_tpu.ops.bls12_381 import ciphersuite
+
+_NUM_EAGER = 0
+privkeys = [i + 1 for i in range(8192)]
+
+_pubkey_cache = {}
+
+
+def pubkey(privkey: int) -> bytes:
+    pk = _pubkey_cache.get(privkey)
+    if pk is None:
+        pk = ciphersuite.SkToPk(privkey)
+        _pubkey_cache[privkey] = pk
+    return pk
+
+
+class _PubkeyList:
+    """Lazy list-alike: pubkeys[i] is the pubkey of privkeys[i]."""
+
+    def __getitem__(self, i):
+        return pubkey(privkeys[i])
+
+    def __len__(self):
+        return len(privkeys)
+
+
+pubkeys = _PubkeyList()
+
+
+def pubkey_to_privkey(pk: bytes) -> int:
+    pk = bytes(pk)
+    for sk, known in _pubkey_cache.items():
+        if known == pk:
+            return sk
+    raise KeyError("unknown pubkey (not generated via this module)")

@@ -1,0 +1,102 @@
+"""Proposer/attester slashing builders.
+
+Reference: ``test/helpers/proposer_slashings.py`` + ``attester_slashings.py``.
+"""
+from consensus_specs_tpu.utils import bls
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+from .keys import privkeys
+from .attestations import get_valid_attestation, sign_attestation
+
+
+def sign_block_header(spec, state, header, privkey):
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_PROPOSER,
+                             spec.compute_epoch_at_slot(header.slot))
+    signing_root = spec.compute_signing_root(header, domain)
+    signature = bls.Sign(privkey, signing_root)
+    return spec.SignedBeaconBlockHeader(message=header, signature=signature)
+
+
+def get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True,
+                                proposer_index=None, slot=None):
+    if proposer_index is None:
+        proposer_index = spec.get_beacon_proposer_index(state)
+    if slot is None:
+        slot = state.slot
+    privkey = privkeys[proposer_index]
+
+    header_1 = spec.BeaconBlockHeader(
+        slot=slot,
+        proposer_index=proposer_index,
+        parent_root=b"\x33" * 32,
+        state_root=b"\x44" * 32,
+        body_root=b"\x55" * 32,
+    )
+    header_2 = header_1.copy()
+    header_2.parent_root = b"\x99" * 32
+
+    if signed_1:
+        signed_header_1 = sign_block_header(spec, state, header_1, privkey)
+    else:
+        signed_header_1 = spec.SignedBeaconBlockHeader(message=header_1)
+    if signed_2:
+        signed_header_2 = sign_block_header(spec, state, header_2, privkey)
+    else:
+        signed_header_2 = spec.SignedBeaconBlockHeader(message=header_2)
+
+    return spec.ProposerSlashing(
+        signed_header_1=signed_header_1,
+        signed_header_2=signed_header_2,
+    )
+
+
+def get_valid_attester_slashing(spec, state, slot=None, signed_1=False, signed_2=False):
+    attestation_1 = get_valid_attestation(spec, state, slot=slot, signed=signed_1)
+    attestation_2 = attestation_1.copy()
+    attestation_2.data.target.root = b"\x01" * 32
+    if signed_2:
+        sign_attestation(spec, state, attestation_2)
+    return spec.AttesterSlashing(
+        attestation_1=spec.get_indexed_attestation(state, attestation_1),
+        attestation_2=spec.get_indexed_attestation(state, attestation_2),
+    )
+
+
+def get_indexed_attestation_participants(spec, indexed_att):
+    return list(indexed_att.attesting_indices)
+
+
+def run_proposer_slashing_processing(spec, state, proposer_slashing, valid=True):
+    yield "pre", state
+    yield "proposer_slashing", proposer_slashing
+    if not valid:
+        try:
+            spec.process_proposer_slashing(state, proposer_slashing)
+        except (AssertionError, IndexError, ValueError):
+            yield "post", None
+            return
+        raise AssertionError("proposer slashing should have failed")
+
+    proposer_index = proposer_slashing.signed_header_1.message.proposer_index
+    pre_proposer_balance = state.balances[proposer_index]
+    spec.process_proposer_slashing(state, proposer_slashing)
+    yield "post", state
+    assert state.validators[proposer_index].slashed
+    assert state.balances[proposer_index] < pre_proposer_balance
+
+
+def run_attester_slashing_processing(spec, state, attester_slashing, valid=True):
+    yield "pre", state
+    yield "attester_slashing", attester_slashing
+    if not valid:
+        try:
+            spec.process_attester_slashing(state, attester_slashing)
+        except (AssertionError, IndexError, ValueError):
+            yield "post", None
+            return
+        raise AssertionError("attester slashing should have failed")
+    slashed_indices = set(attester_slashing.attestation_1.attesting_indices) \
+        .intersection(attester_slashing.attestation_2.attesting_indices)
+    spec.process_attester_slashing(state, attester_slashing)
+    for index in slashed_indices:
+        assert state.validators[index].slashed
+    yield "post", state

@@ -1,0 +1,45 @@
+"""Voluntary-exit builders. Reference: ``test/helpers/voluntary_exits.py``."""
+from consensus_specs_tpu.utils import bls
+from .keys import privkeys
+
+
+def prepare_signed_exits(spec, state, indices, fork_version=None):
+    def create_signed_exit(index):
+        voluntary_exit = spec.VoluntaryExit(
+            epoch=spec.get_current_epoch(state),
+            validator_index=index,
+        )
+        return sign_voluntary_exit(spec, state, voluntary_exit,
+                                   privkeys[index], fork_version)
+    return [create_signed_exit(index) for index in indices]
+
+
+def sign_voluntary_exit(spec, state, voluntary_exit, privkey, fork_version=None):
+    if fork_version is None:
+        domain = spec.get_domain(state, spec.DOMAIN_VOLUNTARY_EXIT, voluntary_exit.epoch)
+    else:
+        domain = spec.compute_domain(
+            spec.DOMAIN_VOLUNTARY_EXIT, fork_version, state.genesis_validators_root)
+    signing_root = spec.compute_signing_root(voluntary_exit, domain)
+    return spec.SignedVoluntaryExit(
+        message=voluntary_exit,
+        signature=bls.Sign(privkey, signing_root),
+    )
+
+
+def run_voluntary_exit_processing(spec, state, signed_voluntary_exit, valid=True):
+    validator_index = signed_voluntary_exit.message.validator_index
+    yield "pre", state
+    yield "voluntary_exit", signed_voluntary_exit
+    if not valid:
+        try:
+            spec.process_voluntary_exit(state, signed_voluntary_exit)
+        except (AssertionError, IndexError, ValueError):
+            yield "post", None
+            return
+        raise AssertionError("voluntary exit should have failed")
+    pre_exit_epoch = state.validators[validator_index].exit_epoch
+    spec.process_voluntary_exit(state, signed_voluntary_exit)
+    yield "post", state
+    assert pre_exit_epoch == spec.FAR_FUTURE_EPOCH
+    assert state.validators[validator_index].exit_epoch < spec.FAR_FUTURE_EPOCH

@@ -29,6 +29,17 @@ from .merkle import (
 
 OFFSET_BYTE_LENGTH = 4
 
+# Global mutation clock: bumped on every SSZ mutation anywhere. Composite
+# values memoize hash_tree_root against it — any mutation invalidates all
+# root caches (over-invalidation is cheap; recomputing registry roots per
+# helper call is not). Containers whose fields are all immutable keep their
+# own precise per-object cache instead.
+_mutation_clock = [0]
+
+
+def _bump_clock():
+    _mutation_clock[0] += 1
+
 
 class SSZValue:
     """Marker base for all SSZ value instances."""
@@ -291,6 +302,7 @@ class _BitsBase(SSZValue):
 
     def __setitem__(self, i, v):
         self._bits[i] = bool(v)
+        _bump_clock()
 
     def __eq__(self, other):
         if isinstance(other, _BitsBase):
@@ -337,7 +349,8 @@ class BitvectorBase(_BitsBase):
 
     @classmethod
     def coerce(cls, value):
-        return value if type(value) is cls else cls(value)
+        # value semantics on assignment (remerkleable-compatible): snapshot
+        return cls(value)
 
     @classmethod
     def decode_bytes(cls, data: bytes):
@@ -393,7 +406,8 @@ class BitlistBase(_BitsBase):
 
     @classmethod
     def coerce(cls, value):
-        return value if type(value) is cls else cls(value)
+        # value semantics on assignment (remerkleable-compatible): snapshot
+        return cls(value)
 
     @classmethod
     def decode_bytes(cls, data: bytes):
@@ -411,6 +425,7 @@ class BitlistBase(_BitsBase):
         if len(self._bits) >= type(self).limit:
             raise ValueError("Bitlist: append past limit")
         self._bits.append(bool(v))
+        _bump_clock()
 
     def serialize(self) -> bytes:
         return self._bitfield_bytes(with_delimiter=True)
@@ -449,7 +464,7 @@ def _pack_basic(values, elem_type) -> bytes:
 
 
 class _SequenceBase(SSZValue):
-    __slots__ = ("_items",)
+    __slots__ = ("_items", "_root_memo")
     elem_type: type = None
 
     def _coerce_items(self, values):
@@ -467,6 +482,15 @@ class _SequenceBase(SSZValue):
 
     def __setitem__(self, i, v):
         self._items[i] = type(self).elem_type.coerce(v)
+        _bump_clock()
+
+    def _memoized_root(self, compute):
+        memo = getattr(self, "_root_memo", None)
+        if memo is not None and memo[0] == _mutation_clock[0]:
+            return memo[1]
+        root = compute()
+        self._root_memo = (_mutation_clock[0], root)
+        return root
 
     def __eq__(self, other):
         if isinstance(other, _SequenceBase):
@@ -561,7 +585,8 @@ class VectorBase(_SequenceBase):
 
     @classmethod
     def coerce(cls, value):
-        return value if type(value) is cls else cls(value)
+        # value semantics on assignment (remerkleable-compatible): snapshot
+        return value.copy() if type(value) is cls else cls(value)
 
     @classmethod
     def decode_bytes(cls, data: bytes):
@@ -574,15 +599,19 @@ class VectorBase(_SequenceBase):
         return self._serialize_elems()
 
     def hash_tree_root(self) -> bytes:
-        et = type(self).elem_type
-        if issubclass(et, BasicValue):
-            limit = (type(self).length * et.byte_length + 31) // 32
-        else:
-            limit = type(self).length
-        return self._elem_chunks(max(limit, 1))
+        def compute():
+            et = type(self).elem_type
+            if issubclass(et, BasicValue):
+                limit = (type(self).length * et.byte_length + 31) // 32
+            else:
+                limit = type(self).length
+            return self._elem_chunks(max(limit, 1))
+        return self._memoized_root(compute)
 
     def copy(self):
-        return type(self)([x.copy() for x in self._items])
+        new = object.__new__(type(self))
+        new._items = [x.copy() for x in self._items]
+        return new
 
     def __repr__(self):
         return f"{type(self).__name__}({self._items!r})"
@@ -627,7 +656,8 @@ class ListBase(_SequenceBase):
 
     @classmethod
     def coerce(cls, value):
-        return value if type(value) is cls else cls(value)
+        # value semantics on assignment (remerkleable-compatible): snapshot
+        return value.copy() if type(value) is cls else cls(value)
 
     @classmethod
     def decode_bytes(cls, data: bytes):
@@ -640,24 +670,31 @@ class ListBase(_SequenceBase):
         if len(self._items) >= type(self).limit:
             raise ValueError(f"{type(self).__name__}: append past limit")
         self._items.append(type(self).elem_type.coerce(v))
+        _bump_clock()
 
     def pop(self):
-        return self._items.pop()
+        v = self._items.pop()
+        _bump_clock()
+        return v
 
     def serialize(self) -> bytes:
         return self._serialize_elems()
 
     def hash_tree_root(self) -> bytes:
-        et = type(self).elem_type
-        if issubclass(et, BasicValue):
-            limit = (type(self).limit * et.byte_length + 31) // 32
-        else:
-            limit = type(self).limit
-        root = self._elem_chunks(max(limit, 1))
-        return mix_in_length(root, len(self._items))
+        def compute():
+            et = type(self).elem_type
+            if issubclass(et, BasicValue):
+                limit = (type(self).limit * et.byte_length + 31) // 32
+            else:
+                limit = type(self).limit
+            root = self._elem_chunks(max(limit, 1))
+            return mix_in_length(root, len(self._items))
+        return self._memoized_root(compute)
 
     def copy(self):
-        return type(self)([x.copy() for x in self._items])
+        new = object.__new__(type(self))
+        new._items = [x.copy() for x in self._items]
+        return new
 
     def __repr__(self):
         return f"{type(self).__name__}({self._items!r})"
@@ -731,6 +768,7 @@ class Container(SSZValue, metaclass=_ContainerMeta):
             raise AttributeError(f"{type(self).__name__}: no field {name}")
         object.__setattr__(self, name, ftype.coerce(value))
         object.__setattr__(self, "_root_cache", None)
+        _bump_clock()
 
     @classmethod
     def fields(cls) -> Dict[str, type]:
@@ -752,8 +790,9 @@ class Container(SSZValue, metaclass=_ContainerMeta):
 
     @classmethod
     def coerce(cls, value):
+        # value semantics on assignment (remerkleable-compatible): snapshot
         if type(value) is cls:
-            return value
+            return value.copy()
         if isinstance(value, Container) and type(value)._fields.keys() == cls._fields.keys():
             return cls(**{k: getattr(value, k) for k in cls._fields})
         if isinstance(value, dict):
@@ -822,7 +861,13 @@ class Container(SSZValue, metaclass=_ContainerMeta):
         return root
 
     def copy(self):
-        return type(self)(**{f: getattr(self, f).copy() for f in type(self)._fields})
+        new = object.__new__(type(self))
+        for f in type(self)._fields:
+            object.__setattr__(new, f, getattr(self, f).copy())
+        # field copies have identical roots, so the memoized root carries over
+        object.__setattr__(new, "_root_cache",
+                           object.__getattribute__(self, "_root_cache"))
+        return new
 
     def __eq__(self, other):
         if not isinstance(other, Container):
@@ -879,7 +924,7 @@ class UnionBase(SSZValue):
     @classmethod
     def coerce(cls, value):
         if type(value) is cls:
-            return value
+            return value.copy()
         if isinstance(value, tuple) and len(value) == 2:
             return cls(value[0], value[1])
         raise TypeError(
@@ -909,7 +954,10 @@ class UnionBase(SSZValue):
         return mix_in_selector(root, self._selector)
 
     def copy(self):
-        return type(self)(self._selector, None if self._value is None else self._value.copy())
+        new = object.__new__(type(self))
+        new._selector = self._selector
+        new._value = None if self._value is None else self._value.copy()
+        return new
 
     def __eq__(self, other):
         return (isinstance(other, UnionBase) and self._selector == other._selector

@@ -1,0 +1,133 @@
+"""Attestation operation tests.
+
+Reference: ``test/phase0/block_processing/test_process_attestation.py``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases, always_bls, never_bls,
+)
+from consensus_specs_tpu.test_infra.attestations import (
+    get_valid_attestation, run_attestation_processing, sign_attestation,
+)
+from consensus_specs_tpu.test_infra.block import next_slot, next_slots, next_epoch
+from consensus_specs_tpu.utils.ssz import Bitlist
+
+
+@with_all_phases
+@spec_state_test
+def test_one_basic_attestation(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_attestation_signature(spec, state):
+    attestation = get_valid_attestation(spec, state)  # unsigned
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_empty_participants_seemingly_valid_sig(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    # remove all participants but keep the signature
+    committee_len = len(attestation.aggregation_bits)
+    attestation.aggregation_bits = Bitlist[spec.MAX_VALIDATORS_PER_COMMITTEE](
+        [0] * committee_len)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_before_inclusion_delay(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    # do not increment slot to allow for inclusion delay
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_after_epoch_slots(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    # increment past latest inclusion slot
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH + 1)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_bad_source_root(spec, state):
+    attestation = get_valid_attestation(spec, state)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    attestation.data.source.root = b"\x42" * 32
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_wrong_index_for_slot(spec, state):
+    while spec.get_committee_count_per_slot(
+            state, spec.get_current_epoch(state)) >= spec.MAX_COMMITTEES_PER_SLOT:
+        state.validators.pop()
+        state.balances.pop()
+    index = spec.MAX_COMMITTEES_PER_SLOT - 1
+    attestation = get_valid_attestation(spec, state)
+    attestation.data.index = index
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_old_source_epoch(spec, state):
+    # advance a few epochs so there is a justified checkpoint mismatch to hit
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH * 5)
+    state.finalized_checkpoint.epoch = 2
+    state.previous_justified_checkpoint.epoch = 3
+    state.current_justified_checkpoint.epoch = 4
+    attestation = get_valid_attestation(spec, state, slot=state.slot, signed=False)
+    # test logic sanity check: attestation source matches current justified
+    assert attestation.data.source.epoch == state.current_justified_checkpoint.epoch
+    # make the attestation source point at the older checkpoint
+    attestation.data.source.epoch = state.previous_justified_checkpoint.epoch
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_extra_aggregation_bit(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    bits = list(attestation.aggregation_bits) + [False]
+    attestation.aggregation_bits = Bitlist[spec.MAX_VALIDATORS_PER_COMMITTEE](bits)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_too_few_aggregation_bits(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    bits = list(attestation.aggregation_bits)[:-1]
+    attestation.aggregation_bits = Bitlist[spec.MAX_VALIDATORS_PER_COMMITTEE](bits)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_previous_epoch_attestation(spec, state):
+    next_epoch(spec, state)
+    attestation = get_valid_attestation(
+        spec, state, slot=state.slot - spec.SLOTS_PER_EPOCH + 1, signed=True)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation)
+    assert len(state.previous_epoch_attestations) == 1

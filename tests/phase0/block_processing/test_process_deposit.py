@@ -1,0 +1,85 @@
+"""Deposit operation tests. Reference: ``test/phase0/block_processing/test_process_deposit.py``."""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases, always_bls,
+)
+from consensus_specs_tpu.test_infra.deposits import (
+    prepare_state_and_deposit, run_deposit_processing,
+)
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit_under_max(spec, state):
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE - 1
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit_max(spec, state):
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_top_up__max_effective_balance(spec, state):
+    validator_index = 0
+    amount = spec.MAX_EFFECTIVE_BALANCE // 4
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=True)
+    state.balances[validator_index] = spec.MAX_EFFECTIVE_BALANCE
+    state.validators[validator_index].effective_balance = spec.MAX_EFFECTIVE_BALANCE
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+    assert state.balances[validator_index] == spec.MAX_EFFECTIVE_BALANCE + amount
+    assert state.validators[validator_index].effective_balance == spec.MAX_EFFECTIVE_BALANCE
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_new_deposit_invalid_sig(spec, state):
+    # deposit with bad signature is still "valid" (no-op: validator not added)
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=False)
+    yield from run_deposit_processing(
+        spec, state, deposit, validator_index, effective=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_top_up_invalid_sig(spec, state):
+    # top-ups do not verify the signature: still effective
+    validator_index = 0
+    amount = spec.MAX_EFFECTIVE_BALANCE // 4
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=False)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_bad_merkle_proof(spec, state):
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=True)
+    # break the proof
+    deposit.proof[0] = b"\x27" * 32
+    yield from run_deposit_processing(
+        spec, state, deposit, validator_index, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_wrong_deposit_for_deposit_count(spec, state):
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount, signed=True)
+    # claim a different outstanding deposit index
+    state.eth1_deposit_index = 1
+    yield from run_deposit_processing(
+        spec, state, deposit, validator_index, valid=False)

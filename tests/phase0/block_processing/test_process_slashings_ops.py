@@ -1,0 +1,138 @@
+"""Proposer/attester slashing + voluntary exit operation tests.
+
+Reference: ``test/phase0/block_processing/test_process_proposer_slashing.py``,
+``test_process_attester_slashing.py``, ``test_process_voluntary_exit.py``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases, always_bls,
+)
+from consensus_specs_tpu.test_infra.slashings import (
+    get_valid_proposer_slashing, run_proposer_slashing_processing,
+    get_valid_attester_slashing, run_attester_slashing_processing,
+)
+from consensus_specs_tpu.test_infra.voluntary_exits import (
+    prepare_signed_exits, run_voluntary_exit_processing, sign_voluntary_exit,
+)
+from consensus_specs_tpu.test_infra.block import next_slots, next_epoch
+from consensus_specs_tpu.test_infra.keys import privkeys
+
+
+# --- proposer slashings ---
+
+@with_all_phases
+@spec_state_test
+def test_proposer_slashing_basic(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state)
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_proposer_slashing_sig_1(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, signed_1=False, signed_2=True)
+    yield from run_proposer_slashing_processing(
+        spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_slashing_identical_headers(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state)
+    proposer_slashing.signed_header_2 = proposer_slashing.signed_header_1
+    yield from run_proposer_slashing_processing(
+        spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_slashing_slots_mismatch(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state)
+    header = proposer_slashing.signed_header_2.message
+    header.slot = header.slot + 1
+    yield from run_proposer_slashing_processing(
+        spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_slashing_repeat(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state)
+    slashed_index = proposer_slashing.signed_header_1.message.proposer_index
+    spec.process_proposer_slashing(state, proposer_slashing)
+    assert state.validators[slashed_index].slashed
+    # second identical slashing is invalid (validator no longer slashable)
+    yield from run_proposer_slashing_processing(
+        spec, state, proposer_slashing, valid=False)
+
+
+# --- attester slashings ---
+
+@with_all_phases
+@spec_state_test
+def test_attester_slashing_basic_double(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True, signed_2=True)
+    yield from run_attester_slashing_processing(spec, state, attester_slashing)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_attester_slashing_sig_2(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True, signed_2=False)
+    yield from run_attester_slashing_processing(
+        spec, state, attester_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_attester_slashing_same_data(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True, signed_2=True)
+    attester_slashing.attestation_2 = attester_slashing.attestation_1
+    yield from run_attester_slashing_processing(
+        spec, state, attester_slashing, valid=False)
+
+
+# --- voluntary exits ---
+
+@with_all_phases
+@spec_state_test
+def test_voluntary_exit_basic(spec, state):
+    # move state forward SHARD_COMMITTEE_PERIOD epochs to allow for exit
+    state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+    signed_exit = prepare_signed_exits(spec, state, [0])[0]
+    yield from run_voluntary_exit_processing(spec, state, signed_exit)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_voluntary_exit_sig(spec, state):
+    state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+    voluntary_exit = spec.VoluntaryExit(
+        epoch=spec.get_current_epoch(state), validator_index=0)
+    # sign with the wrong key
+    signed_exit = sign_voluntary_exit(spec, state, voluntary_exit, privkeys[1])
+    yield from run_voluntary_exit_processing(spec, state, signed_exit, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_voluntary_exit_validator_not_long_enough_active(spec, state):
+    signed_exit = prepare_signed_exits(spec, state, [0])[0]
+    assert spec.get_current_epoch(state) \
+        < state.validators[0].activation_epoch + spec.config.SHARD_COMMITTEE_PERIOD
+    yield from run_voluntary_exit_processing(spec, state, signed_exit, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_voluntary_exit_already_exited(spec, state):
+    state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+    state.validators[0].exit_epoch = spec.get_current_epoch(state) + 2
+    signed_exit = prepare_signed_exits(spec, state, [0])[0]
+    yield from run_voluntary_exit_processing(spec, state, signed_exit, valid=False)

@@ -1,0 +1,129 @@
+"""Epoch-processing + finality tests.
+
+Reference: ``test/phase0/epoch_processing/*`` and
+``test/phase0/finality/test_finality.py`` (condensed representative cases).
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases, never_bls,
+)
+from consensus_specs_tpu.test_infra.epoch_processing import (
+    run_epoch_processing_with, run_epoch_processing_to,
+)
+from consensus_specs_tpu.test_infra.attestations import next_epoch_with_attestations
+from consensus_specs_tpu.test_infra.block import next_epoch
+
+
+@with_all_phases
+@spec_state_test
+def test_effective_balance_hysteresis(spec, state):
+    # run up to the sub-transition under test
+    run_epoch_processing_to(spec, state, "process_effective_balance_updates")
+    max_bal = spec.MAX_EFFECTIVE_BALANCE
+    min_bal = spec.EFFECTIVE_BALANCE_INCREMENT
+    down = spec.EFFECTIVE_BALANCE_INCREMENT // spec.HYSTERESIS_QUOTIENT \
+        * spec.HYSTERESIS_DOWNWARD_MULTIPLIER
+    up = spec.EFFECTIVE_BALANCE_INCREMENT // spec.HYSTERESIS_QUOTIENT \
+        * spec.HYSTERESIS_UPWARD_MULTIPLIER
+    cases = [
+        # (pre_eff, balance, post_eff)
+        (max_bal, max_bal, max_bal),
+        (max_bal, max_bal - 1, max_bal),            # no change: within down threshold
+        (max_bal, max_bal - down - 1, max_bal - min_bal),  # below downward threshold
+        (max_bal - min_bal, max_bal - min_bal + up - 1, max_bal - min_bal),
+        (max_bal - min_bal, max_bal - min_bal + up + 1, max_bal),  # above upward threshold
+    ]
+    for i, (pre_eff, balance, _) in enumerate(cases):
+        state.validators[i].effective_balance = pre_eff
+        state.balances[i] = balance
+    yield "pre", state
+    spec.process_effective_balance_updates(state)
+    yield "post", state
+    for i, (_, _, post_eff) in enumerate(cases):
+        assert state.validators[i].effective_balance == post_eff, f"case {i}"
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_data_votes_no_reset(spec, state):
+    assert spec.EPOCHS_PER_ETH1_VOTING_PERIOD > 1
+    # skip ahead to near the end of epoch 0
+    state.slot = spec.SLOTS_PER_EPOCH - 1
+    for i in range(state.slot + 1):
+        state.eth1_data_votes.append(spec.Eth1Data(deposit_count=i))
+    yield from run_epoch_processing_with(spec, state, "process_eth1_data_reset")
+    assert len(state.eth1_data_votes) == spec.SLOTS_PER_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_data_votes_reset(spec, state):
+    state.slot = spec.SLOTS_PER_EPOCH * spec.EPOCHS_PER_ETH1_VOTING_PERIOD - 1
+    for i in range(3):
+        state.eth1_data_votes.append(spec.Eth1Data(deposit_count=i))
+    yield from run_epoch_processing_with(spec, state, "process_eth1_data_reset")
+    assert len(state.eth1_data_votes) == 0
+
+
+@with_all_phases
+@spec_state_test
+def test_registry_activation(spec, state):
+    # add a fresh validator awaiting activation
+    index = len(state.validators)
+    validator = spec.Validator(
+        pubkey=b"\xaa" * 48,
+        withdrawal_credentials=b"\x00" * 32,
+        effective_balance=spec.MAX_EFFECTIVE_BALANCE,
+        activation_eligibility_epoch=spec.FAR_FUTURE_EPOCH,
+        activation_epoch=spec.FAR_FUTURE_EPOCH,
+        exit_epoch=spec.FAR_FUTURE_EPOCH,
+        withdrawable_epoch=spec.FAR_FUTURE_EPOCH,
+    )
+    state.validators.append(validator)
+    state.balances.append(spec.MAX_EFFECTIVE_BALANCE)
+    yield from run_epoch_processing_with(spec, state, "process_registry_updates")
+    # eligibility epoch set (activation itself waits on finality)
+    assert state.validators[index].activation_eligibility_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_ejection(spec, state):
+    index = 0
+    assert spec.is_active_validator(
+        state.validators[index], spec.get_current_epoch(state))
+    state.validators[index].effective_balance = spec.config.EJECTION_BALANCE
+    yield from run_epoch_processing_with(spec, state, "process_registry_updates")
+    assert state.validators[index].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_finality_from_full_attestation_epochs(spec, state):
+    # epoch 0 -> no finality possible yet
+    next_epoch(spec, state)
+
+    blocks = []
+    for epoch in range(4):
+        prev_state, new_blocks, state = next_epoch_with_attestations(
+            spec, state, True, False)
+        blocks += new_blocks
+
+    # with full participation across epochs, justification + finalization advance
+    assert state.current_justified_checkpoint.epoch > 0
+    assert state.finalized_checkpoint.epoch > 0
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_rewards_applied_at_epoch_boundary(spec, state):
+    next_epoch(spec, state)
+    _, _, state = next_epoch_with_attestations(spec, state, True, False)
+    pre_balances = list(state.balances)
+    # process one more epoch with the pending attestations
+    spec.process_slots(
+        state, state.slot + spec.SLOTS_PER_EPOCH - state.slot % spec.SLOTS_PER_EPOCH)
+    # attesters must have earned rewards (balances changed)
+    assert list(state.balances) != pre_balances

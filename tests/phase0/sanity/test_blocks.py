@@ -1,0 +1,186 @@
+"""Sanity whole-block transition tests.
+
+Reference: ``test/phase0/sanity/test_blocks.py``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases, always_bls, expect_assertion_error,
+)
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot, build_empty_block,
+    state_transition_and_sign_block, sign_block, next_slot, next_epoch,
+)
+from consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+from consensus_specs_tpu.test_infra.slashings import get_valid_proposer_slashing
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_block_transition(spec, state):
+    pre_slot = state.slot
+    pre_eth1_votes = len(state.eth1_data_votes)
+    pre_mix = spec.get_randao_mix(state, spec.get_current_epoch(state))
+
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    assert state.slot == pre_slot + 1
+    assert len(state.eth1_data_votes) == pre_eth1_votes + 1
+    assert spec.get_block_root_at_slot(state, pre_slot) == block.parent_root
+    assert spec.get_randao_mix(state, spec.get_current_epoch(state)) != pre_mix
+
+
+@with_all_phases
+@spec_state_test
+def test_skipped_slots(spec, state):
+    yield "pre", state
+    block = build_empty_block(spec, state, state.slot + 4)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    assert state.slot == block.slot
+    assert spec.get_randao_mix(state, spec.get_current_epoch(state)) != spec.Bytes32()
+    for slot in range(int(block.slot) - 4, int(block.slot)):
+        assert spec.get_block_root_at_slot(state, slot) == block.parent_root
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_epoch_transition(spec, state):
+    pre_slot = state.slot
+    yield "pre", state
+    block = build_empty_block(spec, state, state.slot + spec.SLOTS_PER_EPOCH)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    assert state.slot == block.slot
+    for slot in range(int(pre_slot), int(state.slot)):
+        assert spec.get_block_root_at_slot(state, slot) == block.parent_root
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_state_root(spec, state):
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.state_root = b"\xaa" * 32
+    signed_block = sign_block(spec, state, block)
+    expect_assertion_error(
+        lambda: spec.state_transition(state, signed_block))
+    yield "blocks", [signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_block_sig(spec, state):
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    # transition on a copy to compute the correct state root, then break sig
+    tmp_state = state.copy()
+    signed_block = state_transition_and_sign_block(spec, tmp_state, block)
+    invalid_signed_block = spec.SignedBeaconBlock(message=block)  # empty signature
+    expect_assertion_error(
+        lambda: spec.state_transition(state, invalid_signed_block))
+    yield "blocks", [invalid_signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_index_sig_from_expected_proposer(spec, state):
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    expect_proposer_index = block.proposer_index
+    # set invalid proposer index but correct everything else
+    active = spec.get_active_validator_indices(state, spec.get_current_epoch(state))
+    block.proposer_index = (expect_proposer_index + 1) % len(active)
+    invalid_signed_block = sign_block(spec, state, block, expect_proposer_index)
+    expect_assertion_error(
+        lambda: spec.state_transition(state, invalid_signed_block))
+    yield "blocks", [invalid_signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_prev_slot_block_transition(spec, state):
+    spec.process_slots(state, state.slot + 1)
+    block = build_empty_block(spec, state, slot=state.slot)
+    proposer_index = spec.get_beacon_proposer_index(state)
+    spec.process_slots(state, state.slot + 1)
+    yield "pre", state
+    signed_block = sign_block(spec, state, block, proposer_index)
+    expect_assertion_error(
+        lambda: spec.state_transition(state, signed_block))
+    yield "blocks", [signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation(spec, state):
+    next_epoch(spec, state)
+    yield "pre", state
+
+    attestation_block = build_empty_block(
+        spec, state, state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    index = 0
+    attestation = get_valid_attestation(spec, state, index=index, signed=True)
+
+    # attestation is valid already MIN_ATTESTATION_INCLUSION_DELAY slots later
+    attestation_block.body.attestations.append(attestation)
+    signed_attestation_block = state_transition_and_sign_block(
+        spec, state, attestation_block)
+
+    assert len(state.current_epoch_attestations) == 1
+
+    yield "blocks", [signed_attestation_block]
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_slashing_block(spec, state):
+    # copy for later balance comparison
+    pre_state = state.copy()
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, signed_1=True, signed_2=True)
+    slashed_index = proposer_slashing.signed_header_1.message.proposer_index
+
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings.append(proposer_slashing)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    slashed_validator = state.validators[slashed_index]
+    assert slashed_validator.slashed
+    assert slashed_validator.exit_epoch < spec.FAR_FUTURE_EPOCH
+    assert slashed_validator.withdrawable_epoch < spec.FAR_FUTURE_EPOCH
+    assert state.balances[slashed_index] < pre_state.balances[slashed_index]
+
+
+@with_all_phases
+@spec_state_test
+def test_duplicate_attestation_same_block(spec, state):
+    next_epoch(spec, state)
+    yield "pre", state
+    block = build_empty_block(
+        spec, state, state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    attestation = get_valid_attestation(spec, state, index=0, signed=True)
+    for _ in range(2):
+        block.body.attestations.append(attestation)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    # duplicates are valid in phase0 (both become pending attestations)
+    assert len(state.current_epoch_attestations) == 2

@@ -1,0 +1,62 @@
+"""Sanity slot-transition tests. Reference: ``test/phase0/sanity/test_slots.py``."""
+from consensus_specs_tpu.test_infra.context import spec_state_test, with_all_phases
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+
+@with_all_phases
+@spec_state_test
+def test_slots_1(spec, state):
+    pre_slot = state.slot
+    pre_root = hash_tree_root(state)
+    yield "pre", state
+    slots = 1
+    yield "slots", slots
+    spec.process_slots(state, state.slot + slots)
+    yield "post", state
+    assert state.slot == pre_slot + 1
+    assert hash_tree_root(state) != pre_root
+
+
+@with_all_phases
+@spec_state_test
+def test_slots_2(spec, state):
+    yield "pre", state
+    slots = 2
+    yield "slots", slots
+    spec.process_slots(state, state.slot + slots)
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_epoch(spec, state):
+    pre_slot = state.slot
+    yield "pre", state
+    slots = spec.SLOTS_PER_EPOCH
+    yield "slots", slots
+    spec.process_slots(state, state.slot + slots)
+    yield "post", state
+    assert state.slot == pre_slot + spec.SLOTS_PER_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_double_empty_epoch(spec, state):
+    pre_slot = state.slot
+    yield "pre", state
+    slots = spec.SLOTS_PER_EPOCH * 2
+    yield "slots", slots
+    spec.process_slots(state, state.slot + slots)
+    yield "post", state
+    assert state.slot == pre_slot + 2 * spec.SLOTS_PER_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_over_epoch_boundary(spec, state):
+    spec.process_slots(state, state.slot + spec.SLOTS_PER_EPOCH // 2)
+    yield "pre", state
+    slots = spec.SLOTS_PER_EPOCH
+    yield "slots", slots
+    spec.process_slots(state, state.slot + slots)
+    yield "post", state

@@ -254,3 +254,27 @@ def test_variable_list_rejects_zero_first_offset():
     with pytest.raises(ValueError):
         deserialize(L, b"\x00\x00\x00\x00\xff\xff")
     assert deserialize(L, b"") == L()
+
+
+def test_value_semantics_on_assignment():
+    """Assignment snapshots by value; reads return live write-through views
+    (remerkleable-compatible semantics the spec code relies on)."""
+    class Outer(Container):
+        a: Checkpoint
+        b: Checkpoint
+
+    o = Outer()
+    o.a.epoch = 5          # read returns live view; mutation writes through
+    assert o.a.epoch == 5
+    o.b = o.a              # assignment snapshots
+    o.a.epoch = 9
+    assert o.b.epoch == 5 and o.a.epoch == 9
+
+    L = List[Checkpoint, 4]
+    lst = L()
+    c = Checkpoint(epoch=1)
+    lst.append(c)
+    c.epoch = 7            # must not affect the appended snapshot
+    assert lst[0].epoch == 1
+    lst[0].epoch = 3       # live element view writes through
+    assert lst[0].epoch == 3
