@@ -26,12 +26,14 @@ def fork_registry() -> Dict[str, type]:
 
 
 def _import_all():
+    import importlib.util
     from . import phase0  # noqa: F401
     for mod in ("altair", "bellatrix", "capella", "deneb"):
-        try:
+        # Probe existence first so a real import error inside an existing
+        # fork module propagates instead of silently dropping the fork
+        # (and silently skipping its whole test suite).
+        if importlib.util.find_spec(f"{__name__}.{mod}") is not None:
             __import__(f"{__name__}.{mod}")
-        except ImportError:
-            pass
 
 
 _spec_cache: Dict[Tuple[str, str, Optional[frozenset]], object] = {}

@@ -12,6 +12,7 @@ IndexError/ValueError from SSZ bounds) on invalid input — the harness's
 ``expect_assertion_error`` and fork-choice invalid-block handling rely on it
 (reference: ``test/context.py:299-310``).
 """
+from collections import OrderedDict
 from types import SimpleNamespace
 from typing import Dict, Sequence, Set
 
@@ -35,6 +36,26 @@ from .base_types import (
 )
 
 _PRESET_VAR_TYPES = {}  # all plain ints
+
+
+class _LRUDict(OrderedDict):
+    """Minimal bounded LRU mapping (role of the reference's ``lru-dict``)."""
+
+    def __init__(self, maxsize: int):
+        super().__init__()
+        self._maxsize = maxsize
+
+    def get(self, key, default=None):
+        if key in self:
+            self.move_to_end(key)
+            return self[key]
+        return default
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, value)
+        self.move_to_end(key)
+        while len(self) > self._maxsize:
+            self.popitem(last=False)
 
 
 def _bytes_of(hexstr, width):
@@ -90,8 +111,12 @@ class Phase0Spec:
             setattr(self, k, v)
         self.config = self._build_config(config)
         self._build_types()
-        self._caches: Dict[str, dict] = {
-            "committee": {}, "proposer": {}, "active_indices": {},
+        # Bounded like the reference's lru-dict caches
+        # (pysetup/spec_builders/phase0.py:59-105); unbounded dicts would grow
+        # without limit across a long generator run.
+        self._caches: Dict[str, "_LRUDict"] = {
+            "committee": _LRUDict(512), "proposer": _LRUDict(512),
+            "active_indices": _LRUDict(128),
         }
 
     # -- config ------------------------------------------------------------

@@ -1,0 +1,24 @@
+"""JAX/TPU-native BLS12-381 kernel library.
+
+This package is the TPU replacement for the reference's Rust crypto backends
+(milagro_bls_binding / py_arkworks_bls12381, reference
+``tests/core/pyspec/eth2spec/utils/bls.py:30,22``): BLS12-381 field towers,
+curve arithmetic, pairings, hash-to-curve and MSM, all written in
+``jax.numpy`` integer ops so the whole verification pipeline jit-compiles to
+one XLA program and ``vmap``s across attestations / blobs / pubkeys.
+
+Design for TPU hardware:
+
+- **No 64-bit multiplies.** TPUs have no native u64 multiply, so field
+  elements are 24 × 16-bit limbs held in ``uint32`` lanes; limb products are
+  exact in uint32 (< 2^32) and column accumulations stay < 2^22, so carries
+  can be propagated lazily with static unrolled loops the XLA vectorizer
+  fuses into wide VPU ops.
+- **Montgomery form everywhere.** One REDC per multiply; conversions only at
+  byte boundaries.
+- **Branchless.** Point ops use complete projective formulas, square roots
+  and inverses are fixed-exponent powers via ``lax.scan``, selections are
+  ``jnp.where`` — nothing data-dependent blocks vectorization.
+- **Batch-first.** Every function takes arbitrary leading batch dims; the
+  signature/KZG entry points vmap over them.
+"""

@@ -1,0 +1,139 @@
+"""Batched SHA-256 as a JAX kernel.
+
+SHA-256 dominates ``hash_tree_root`` (reference hash fn:
+``tests/core/pyspec/eth2spec/utils/hash_function.py:8``); a 1M-validator
+``BeaconState`` merkleization is millions of 64-byte-message hashes. The
+reference does them one by one through hashlib; here a whole tree layer is
+hashed as ONE vectorized kernel call: the compression function is written in
+``jnp.uint32`` ops and ``vmap``-ed over the message axis, so XLA lays the
+64-round schedule out across the TPU VPU lanes.
+
+Two entry points:
+
+- :func:`hash64_batch` — the merkle hot path: N independent 64-byte messages
+  (two compression rounds each: message block + constant padding block).
+- :func:`sha256_blocks` — generic N-block single-message path used by the
+  hash-to-curve ``expand_message_xmd`` kernel.
+
+Shapes are bucketed to powers of two so XLA compiles O(log N) program
+variants, not one per layer width.
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+# Round constants (FIPS 180-4 §4.2.2): cube-root fractional parts of the
+# first 64 primes.
+_K = np.array([
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+], dtype=np.uint32)
+
+# Initial hash state (square-root fractional parts of the first 8 primes).
+_H0 = np.array([
+    0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
+], dtype=np.uint32)
+
+
+def _rotr(x, n):
+    return (x >> n) | (x << (32 - n))
+
+
+def _compress(state, block_words):
+    """One SHA-256 compression: state (..., 8) u32, block (..., 16) u32."""
+    w = [block_words[..., i] for i in range(16)]
+    for i in range(16, 64):
+        s0 = _rotr(w[i - 15], 7) ^ _rotr(w[i - 15], 18) ^ (w[i - 15] >> 3)
+        s1 = _rotr(w[i - 2], 17) ^ _rotr(w[i - 2], 19) ^ (w[i - 2] >> 10)
+        w.append(w[i - 16] + s0 + w[i - 7] + s1)
+
+    a, b, c, d, e, f, g, h = [state[..., i] for i in range(8)]
+    for i in range(64):
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + jnp.uint32(_K[i]) + w[i]
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
+    return state + jnp.stack([a, b, c, d, e, f, g, h], axis=-1)
+
+
+# Padding block for a 64-byte message: 0x80 marker, zeros, 512-bit length.
+_PAD64 = np.zeros(16, dtype=np.uint32)
+_PAD64[0] = 0x80000000
+_PAD64[15] = 512
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _hash64_words(words):
+    """words: (N, 16) u32 big-endian message words -> (N, 8) u32 digests."""
+    n = words.shape[0]
+    state = jnp.broadcast_to(jnp.asarray(_H0), (n, 8))
+    state = _compress(state, words)
+    pad = jnp.broadcast_to(jnp.asarray(_PAD64), (n, 16))
+    return _compress(state, pad)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def hash64_batch(data: bytes, n: int) -> bytes:
+    """Hash ``n`` concatenated 64-byte messages -> ``n`` 32-byte digests.
+
+    This is the ``set_batched_hasher`` plug for the merkle engine
+    (:mod:`consensus_specs_tpu.utils.ssz.merkle`).
+    """
+    words = np.frombuffer(data, dtype=">u4").reshape(n, 16).astype(np.uint32)
+    n_pad = _next_pow2(n)
+    if n_pad != n:
+        words = np.concatenate([words, np.zeros((n_pad - n, 16), np.uint32)])
+    out = np.asarray(_hash64_words(jnp.asarray(words)))[:n]
+    return out.astype(">u4").tobytes()
+
+
+@functools.partial(jax.jit, static_argnames=("num_blocks",))
+def sha256_blocks(blocks, num_blocks: int):
+    """Sequential compression of pre-padded blocks.
+
+    blocks: (..., num_blocks, 16) u32 -> (..., 8) u32. The caller is
+    responsible for FIPS-180-4 padding; used by the in-graph
+    ``expand_message_xmd`` (hash-to-curve kernel).
+    """
+    state = jnp.broadcast_to(jnp.asarray(_H0), blocks.shape[:-2] + (8,))
+    for i in range(num_blocks):
+        state = _compress(state, blocks[..., i, :])
+    return state
+
+
+def install_merkle_hasher() -> None:
+    """Route SSZ layer hashing through the batched kernel."""
+    from consensus_specs_tpu.utils.ssz import merkle
+    merkle.set_batched_hasher(hash64_batch)
+
+
+def sha256_bytes(msg: bytes) -> bytes:
+    """One-shot SHA-256 of an arbitrary message via the kernel (testing aid)."""
+    length = len(msg)
+    padded = msg + b"\x80"
+    if len(padded) % 64 > 56:
+        padded += b"\x00" * (64 - len(padded) % 64)
+    padded += b"\x00" * (56 - len(padded) % 64 if len(padded) % 64 <= 56 else 0)
+    padded += (length * 8).to_bytes(8, "big")
+    nb = len(padded) // 64
+    words = np.frombuffer(padded, dtype=">u4").reshape(nb, 16).astype(np.uint32)
+    out = np.asarray(sha256_blocks(jnp.asarray(words), nb))
+    return out.astype(">u4").tobytes()

@@ -62,12 +62,6 @@ def build_empty_block(spec, state, slot=None, proposer_index=None):
     """Build an empty block for ``slot`` upon the latest header seen by state."""
     if slot is None:
         slot = state.slot
-    if slot < state.slot:
-        raise Exception("cannot build blocks for past slots")
-    if state.slot < slot:
-        state = state.copy()
-        spec.process_slots(state, slot)
-
     state, parent_block_root = get_state_and_beacon_parent_root_at_slot(spec, state, slot)
     if proposer_index is None:
         proposer_index = spec.get_beacon_proposer_index(state)

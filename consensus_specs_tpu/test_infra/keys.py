@@ -6,7 +6,6 @@ states need unique, valid pubkeys even when signature checks are disabled).
 """
 from consensus_specs_tpu.ops.bls12_381 import ciphersuite
 
-_NUM_EAGER = 0
 privkeys = [i + 1 for i in range(8192)]
 
 _pubkey_cache = {}

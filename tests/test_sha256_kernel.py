@@ -1,0 +1,38 @@
+"""Differential tests: JAX SHA-256 kernel vs hashlib."""
+import hashlib
+import os
+
+import pytest
+
+from consensus_specs_tpu.ops import sha256 as k
+
+
+def test_hash64_batch_matches_hashlib():
+    for n in (1, 2, 3, 7, 256, 300):
+        data = os.urandom(64 * n)
+        out = k.hash64_batch(data, n)
+        assert len(out) == 32 * n
+        for i in range(n):
+            expect = hashlib.sha256(data[i * 64:(i + 1) * 64]).digest()
+            assert out[i * 32:(i + 1) * 32] == expect
+
+
+@pytest.mark.parametrize("length", [0, 1, 55, 56, 63, 64, 65, 119, 120, 128, 1000])
+def test_sha256_bytes_matches_hashlib(length):
+    msg = os.urandom(length)
+    assert k.sha256_bytes(msg) == hashlib.sha256(msg).digest()
+
+
+def test_merkle_layer_uses_kernel():
+    from consensus_specs_tpu.utils.ssz import merkle
+    k.install_merkle_hasher()
+    try:
+        n = 512  # above _BATCH_THRESHOLD
+        data = os.urandom(64 * n)
+        got = merkle.hash_layer(data)
+        expect = b"".join(
+            hashlib.sha256(data[i * 64:(i + 1) * 64]).digest() for i in range(n)
+        )
+        assert got == expect
+    finally:
+        merkle.set_batched_hasher(None)
