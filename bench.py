@@ -1,0 +1,53 @@
+"""Benchmark: batched FastAggregateVerify throughput (BASELINE config #1).
+
+Measures aggregate-signature verifications/second with the JAX backend
+(batch of 16 verifications x 64 pubkeys each, minimal-preset committee
+shape) against the pure-python oracle (the reference's py_ecc role,
+``BASELINE.md`` metric: ">=50x py_ecc").  Prints ONE JSON line.
+"""
+import json
+import os
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+
+
+def main():
+    from consensus_specs_tpu.utils import bls
+    from consensus_specs_tpu.ops import bls_jax
+
+    bls.use_py()
+    n_keys, batch = 64, 16
+    msg = b"bench-attestation-root"
+    sks = list(range(1, 1 + n_keys))
+    pks = [bls.SkToPk(sk) for sk in sks]
+    agg = bls.Aggregate([bls.Sign(sk, msg) for sk in sks])
+
+    # python-oracle baseline (single verification, measured once)
+    t0 = time.time()
+    assert bls.FastAggregateVerify(pks, msg, agg)
+    py_per_verify = time.time() - t0
+
+    items = [(pks, msg, agg)] * batch
+    # warm-up: compile + first dispatch
+    out = bls_jax.verify_aggregates_batch(items)
+    assert all(out), "bench verification must pass"
+    t0 = time.time()
+    reps = 3
+    for _ in range(reps):
+        out = bls_jax.verify_aggregates_batch(items)
+    dt = (time.time() - t0) / reps
+    per_sec = batch / dt
+    vs = per_sec * py_per_verify  # speedup over one-at-a-time py oracle
+
+    print(json.dumps({
+        "metric": "FastAggregateVerify (64 pubkeys, batch 16)",
+        "value": round(per_sec, 3),
+        "unit": "aggverify/s",
+        "vs_baseline": round(vs, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,7 @@ from consensus_specs_tpu.utils.ssz import (
 )
 from consensus_specs_tpu.utils import bls
 from . import register_fork
+from .fork_choice import ForkChoiceMixin
 from .base_types import (
     Slot, Epoch, CommitteeIndex, ValidatorIndex, Gwei, Root, Hash32, Version,
     DomainType, ForkDigest, Domain, BLSPubkey, BLSSignature,
@@ -71,7 +72,7 @@ def _bytes_of(hexstr, width):
 
 
 @register_fork("phase0")
-class Phase0Spec:
+class Phase0Spec(ForkChoiceMixin):
     fork = "phase0"
     previous_fork = None
 

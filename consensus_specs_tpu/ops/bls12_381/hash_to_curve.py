@@ -265,6 +265,9 @@ def _derive_isogeny():
             continue
         s2, s3 = s.square(), s.square() * s
 
+        global ISO_CONSTANTS
+        ISO_CONSTANTS = (x0, u_p, v_p, s2, s3)
+
         def iso(x, y, x0=x0, u_p=u_p, v_p=v_p, s2=s2, s3=s3):
             d = x - x0
             dinv = d.inv()

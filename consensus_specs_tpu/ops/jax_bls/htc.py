@@ -1,0 +1,134 @@
+"""Hash-to-G2 as a batched JAX kernel (RFC 9380 SSWU route).
+
+``expand_message_xmd`` runs host-side (a handful of SHA-256 calls per
+message - negligible next to the curve math); field mapping, SSWU, the
+3-isogeny, and Budroni-Pintore cofactor clearing all run on device,
+branchless, batched over messages.  One block's 128 attestation messages
+hash-to-curve as a single vectorized program (reference: per-call Rust FFI
+inside ``FastAggregateVerify``, ``eth2spec/utils/bls.py:133-143``).
+
+The SSWU/isogeny constants come from the same derivation the python oracle
+performs at import (``ops/bls12_381/hash_to_curve.py``), so the two
+backends hash identically by construction.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from consensus_specs_tpu.ops.bls12_381.fields import P, R_ORDER, X_PARAM, Fq2 as _OFq2
+from consensus_specs_tpu.ops.bls12_381 import hash_to_curve as _oracle
+from . import limbs as L
+from . import tower as T
+from . import points as PT
+
+# SSWU curve E' : y^2 = x^3 + A'x + B', Z = -(2+u)
+_A = T.f2_const(_oracle.A_PRIME)
+_B = T.f2_const(_oracle.B_PRIME)
+_Z = T.f2_const(_oracle.Z_SSWU)
+# -B/A and B/(Z*A), precomputed host-side for the exceptional branch
+_NEG_B_OVER_A = T.f2_const(-(_oracle.B_PRIME) * _oracle.A_PRIME.inv())
+_B_OVER_ZA = T.f2_const(
+    _oracle.B_PRIME * (_oracle.Z_SSWU * _oracle.A_PRIME).inv())
+
+# 3-isogeny rational-map constants (derived by the oracle at import)
+_ISO_X0, _ISO_UP, _ISO_VP, _ISO_S2, _ISO_S3 = \
+    tuple(T.f2_const(c) for c in _oracle.ISO_CONSTANTS)
+
+# psi endomorphism constants
+_PSI_CX = T.f2_const(_oracle._PSI_CX)
+_PSI_CY = T.f2_const(_oracle._PSI_CY)
+
+# cofactor-clearing scalars (x negative): [x^2-x-1]P + [x-1]psi(P) + psi^2([2]P)
+_S1 = X_PARAM * X_PARAM - X_PARAM - 1          # positive
+_S2_ABS = -(X_PARAM - 1)                       # |x-1|; the term is negated
+_S1_BITS = np.array([int(c) for c in bin(_S1)[2:]], dtype=np.uint32)
+_S2_BITS = np.array([int(c) for c in bin(_S2_ABS)[2:]], dtype=np.uint32)
+
+
+_bc = T.f2_broadcast
+
+
+def _sgn0(x):
+    """RFC 9380 sgn0 for Fq2 (lexicographic parity), branchless."""
+    a = L.from_mont(x[0])
+    b = L.from_mont(x[1])
+    a_par = a[..., 0] & 1
+    b_par = b[..., 0] & 1
+    a_zero = L.is_zero(a)
+    return jnp.where(a_zero, b_par, a_par)
+
+
+def sswu_map(u):
+    """Simplified SWU: field element u (Fq2 pair) -> affine point on E'."""
+    A, B, Z = _bc(_A, u), _bc(_B, u), _bc(_Z, u)
+    zu2 = T.f2_mul(Z, T.f2_sqr(u))
+    tv = T.f2_add(T.f2_sqr(zu2), zu2)
+    tv_zero = T.f2_is_zero(tv)
+    x1_main = T.f2_mul(_bc(_NEG_B_OVER_A, u),
+                       T.f2_add(T.f2_one_like(u), T.f2_inv(tv)))
+    x1 = T.f2_select(tv_zero, _bc(_B_OVER_ZA, u), x1_main)
+    gx1 = T.f2_add(T.f2_add(T.f2_mul(T.f2_sqr(x1), x1), T.f2_mul(A, x1)), B)
+    sq1 = T.f2_is_square(gx1)
+    x2 = T.f2_mul(zu2, x1)
+    gx2 = T.f2_add(T.f2_add(T.f2_mul(T.f2_sqr(x2), x2), T.f2_mul(A, x2)), B)
+    x = T.f2_select(sq1, x1, x2)
+    y = T.f2_sqrt(T.f2_select(sq1, gx1, gx2))
+    flip = _sgn0(u) != _sgn0(y)
+    return x, T.f2_select(flip, T.f2_neg(y), y)
+
+
+def iso_map(x, y):
+    """3-isogeny E' -> E2 via the derived Velu rational map (affine)."""
+    d = T.f2_sub(x, _bc(_ISO_X0, x))
+    dinv = T.f2_inv(d)
+    dinv2 = T.f2_sqr(dinv)
+    up = _bc(_ISO_UP, x)
+    vp = _bc(_ISO_VP, x)
+    X = T.f2_add(T.f2_add(x, T.f2_mul(vp, dinv)), T.f2_mul(up, dinv2))
+    two_up = T.f2_add(up, up)
+    Y = T.f2_mul(y, T.f2_sub(T.f2_sub(T.f2_one_like(x), T.f2_mul(vp, dinv2)),
+                             T.f2_mul(two_up, T.f2_mul(dinv2, dinv))))
+    return T.f2_mul(X, _bc(_ISO_S2, x)), T.f2_mul(Y, _bc(_ISO_S3, x))
+
+
+def psi(p):
+    """Untwist-Frobenius-twist endomorphism, projective: acts as [p] on G2."""
+    X, Y, Z = p
+    return (T.f2_mul(T.f2_conj(X), _bc(_PSI_CX, X)),
+            T.f2_mul(T.f2_conj(Y), _bc(_PSI_CY, Y)),
+            T.f2_conj(Z))
+
+
+def clear_cofactor(p):
+    """Budroni-Pintore: [x^2-x-1]P + [x-1]psi(P) + psi^2([2]P), x < 0."""
+    t1 = PT.g2_scalar_mul(p, _S1_BITS)
+    t2 = PT.g2_neg(PT.g2_scalar_mul(psi(p), _S2_BITS))
+    t3 = psi(psi(PT.g2_add(p, p)))
+    return PT.g2_add(PT.g2_add(t1, t2), t3)
+
+
+def map_to_g2(u0, u1):
+    """Two field elements -> one G2 point (projective), batched."""
+    x0, y0 = iso_map(*sswu_map(u0))
+    x1, y1 = iso_map(*sswu_map(u1))
+    one = T.f2_one_like(x0)
+    p = PT.g2_add((x0, y0, one), (x1, y1, one))
+    return clear_cofactor(p)
+
+
+def hash_to_field_host(msgs, dst=_oracle.DST_G2) -> tuple:
+    """Host-side: list of messages -> packed (u0, u1) Fq2 limb batches."""
+    us = [_oracle.hash_to_field_fq2(bytes(m), 2, dst) for m in msgs]
+    def pack(idx):
+        return (L.pack_ints_mont([u[idx].a.n for u in us]),
+                L.pack_ints_mont([u[idx].b.n for u in us]))
+    return pack(0), pack(1)
+
+
+def hash_to_g2_batch(msgs, dst=_oracle.DST_G2):
+    """List of messages -> batched projective G2 limb points (device)."""
+    u0, u1 = hash_to_field_host(msgs, dst)
+    return _map_to_g2_jit(u0, u1)
+
+
+_map_to_g2_jit = jax.jit(map_to_g2)

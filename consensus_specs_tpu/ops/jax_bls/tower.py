@@ -1,0 +1,403 @@
+"""Extension-field towers Fq2/Fq6/Fq12 over the JAX limb kernels.
+
+Same tower as the python oracle (``ops/bls12_381/fields.py``):
+Fq2 = Fq[u]/(u^2+1), Fq6 = Fq2[v]/(v^3 - xi) with xi = 1+u,
+Fq12 = Fq6[w]/(w^2 - v).  Elements are pytrees of Montgomery limb arrays -
+Fq2 = (a, b), Fq6 = (c0, c1, c2), Fq12 = (d0, d1) - so ``vmap``/``scan``
+thread them transparently and all ops batch over leading dims.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from consensus_specs_tpu.ops.bls12_381.fields import (
+    P, Fq2 as _OFq2, XI as _OXI, FROB_V1 as _OFROB_V1, FROB_V2 as _OFROB_V2,
+    FROB_W as _OFROB_W,
+)
+from . import limbs as L
+
+# ---------------------------------------------------------------------------
+# Fq2: x = (a, b) meaning a + b*u
+# ---------------------------------------------------------------------------
+
+
+def f2(a, b):
+    return (a, b)
+
+
+def f2_const(x: _OFq2):
+    """Host-side: oracle Fq2 -> Montgomery limb constant pair."""
+    return (jnp.asarray(L.fq_const(x.a.n)), jnp.asarray(L.fq_const(x.b.n)))
+
+
+def f2_zero_like(x):
+    z = jnp.zeros_like(x[0])
+    return (z, z)
+
+
+def f2_one_like(x):
+    one = jnp.broadcast_to(jnp.asarray(L.ONE_M), x[0].shape)
+    return (one, jnp.zeros_like(x[0]))
+
+
+def f2_add(x, y):
+    return (L.add_mod(x[0], y[0]), L.add_mod(x[1], y[1]))
+
+
+def f2_sub(x, y):
+    return (L.sub_mod(x[0], y[0]), L.sub_mod(x[1], y[1]))
+
+
+def f2_neg(x):
+    return (L.neg_mod(x[0]), L.neg_mod(x[1]))
+
+
+def f2_mul(x, y):
+    # Karatsuba: (a+bu)(c+du) = (ac - bd) + ((a+b)(c+d) - ac - bd) u
+    ac = L.mont_mul(x[0], y[0])
+    bd = L.mont_mul(x[1], y[1])
+    cross = L.mont_mul(L.add_mod(x[0], x[1]), L.add_mod(y[0], y[1]))
+    return (L.sub_mod(ac, bd), L.sub_mod(L.sub_mod(cross, ac), bd))
+
+
+def f2_sqr(x):
+    # (a+bu)^2 = (a+b)(a-b) + 2ab u
+    re = L.mont_mul(L.add_mod(x[0], x[1]), L.sub_mod(x[0], x[1]))
+    im = L.mont_mul(x[0], x[1])
+    return (re, L.add_mod(im, im))
+
+
+def f2_mul_fq(x, s):
+    """Multiply by an Fq element (limb array)."""
+    out = L.mont_mul_many([(x[0], s), (x[1], s)])
+    return (out[0], out[1])
+
+
+def f2_muli(x, k: int):
+    """Multiply by a small integer constant."""
+    c = jnp.broadcast_to(jnp.asarray(L.fq_const(k)), x[0].shape)
+    return f2_mul_fq(x, c)
+
+
+def f2_conj(x):
+    return (x[0], L.neg_mod(x[1]))
+
+
+def f2_mul_xi(x):
+    """Multiply by xi = 1 + u: (a - b) + (a + b) u."""
+    return (L.sub_mod(x[0], x[1]), L.add_mod(x[0], x[1]))
+
+
+def f2_inv(x):
+    # 1/(a+bu) = (a - bu) / (a^2 + b^2)
+    norm = L.add_mod(L.mont_sqr(x[0]), L.mont_sqr(x[1]))
+    ninv = L.inv_mod(norm)
+    return (L.mont_mul(x[0], ninv), L.neg_mod(L.mont_mul(x[1], ninv)))
+
+
+def f2_is_zero(x):
+    return L.is_zero(x[0]) & L.is_zero(x[1])
+
+
+def f2_eq(x, y):
+    return L.eq(x[0], y[0]) & L.eq(x[1], y[1])
+
+
+def f2_select(cond, x, y):
+    return (L.select(cond, x[0], y[0]), L.select(cond, x[1], y[1]))
+
+
+def f2_is_square(x):
+    """Euler criterion via the norm map: a+bu square iff N = a^2+b^2 is a QR."""
+    norm = L.add_mod(L.mont_sqr(x[0]), L.mont_sqr(x[1]))
+    return L.legendre_is_qr(norm)
+
+
+def f2_sqrt(x):
+    """Branchless sqrt in Fq2 (complex method, p = 3 mod 4).
+
+    Caller must know x is a square (use :func:`f2_is_square`); for
+    non-squares the result is unspecified.  Mirrors the oracle
+    (``fields.py:138-166``) with selects instead of branches.
+    """
+    a, b = x
+    # generic path (b != 0): alpha = sqrt(a^2+b^2); delta = (a+alpha)/2
+    norm = L.add_mod(L.mont_sqr(a), L.mont_sqr(b))
+    alpha = L.sqrt_candidate(norm)
+    inv2 = jnp.broadcast_to(jnp.asarray(L.fq_const(pow(2, -1, P))), a.shape)
+    delta1 = L.mont_mul(L.add_mod(a, alpha), inv2)
+    delta2 = L.mont_mul(L.sub_mod(a, alpha), inv2)
+    x1 = L.sqrt_candidate(delta1)
+    use1 = L.eq(L.mont_sqr(x1), delta1)
+    xr = L.select(use1, x1, L.sqrt_candidate(delta2))
+    yr = L.mont_mul(b, L.inv_mod(L.add_mod(xr, xr)))
+    # b == 0 path: sqrt(a) directly, or sqrt(-a)*u if a is a non-residue
+    ra = L.sqrt_candidate(a)
+    a_is_qr = L.eq(L.mont_sqr(ra), a)
+    rb = L.sqrt_candidate(L.neg_mod(a))
+    b0_re = L.select(a_is_qr, ra, jnp.zeros_like(ra))
+    b0_im = L.select(a_is_qr, jnp.zeros_like(rb), rb)
+    b_zero = L.is_zero(b)
+    return (L.select(b_zero, b0_re, xr), L.select(b_zero, b0_im, yr))
+
+
+# ---------------------------------------------------------------------------
+# Batched Fq2 ops: k independent ops -> constant number of kernel calls.
+# These are what the Fq6/Fq12 multiplies and the pairing step "waves" use;
+# without them every tower multiply would emit hundreds of tiny scans
+# (slow to compile on the 1-core box, and narrow on the TPU VPU).
+# ---------------------------------------------------------------------------
+
+def f2_add_many(pairs):
+    flat = L.add_mod_many([(x[0], y[0]) for x, y in pairs]
+                          + [(x[1], y[1]) for x, y in pairs])
+    k = len(pairs)
+    return [(flat[i], flat[k + i]) for i in range(k)]
+
+
+def f2_sub_many(pairs):
+    flat = L.sub_mod_many([(x[0], y[0]) for x, y in pairs]
+                          + [(x[1], y[1]) for x, y in pairs])
+    k = len(pairs)
+    return [(flat[i], flat[k + i]) for i in range(k)]
+
+
+def f2_mul_many(pairs):
+    """Karatsuba over the whole batch: 3k base muls in one kernel call."""
+    k = len(pairs)
+    sums = L.add_mod_many([(x[0], x[1]) for x, _ in pairs]
+                          + [(y[0], y[1]) for _, y in pairs])
+    reqs = []
+    for i, (x, y) in enumerate(pairs):
+        reqs += [(x[0], y[0]), (x[1], y[1]), (sums[i], sums[k + i])]
+    prods = L.mont_mul_many(reqs)
+    # re = ac - bd ; im = cross - ac - bd
+    d = L.sub_mod_many([(prods[3 * i], prods[3 * i + 1]) for i in range(k)]
+                       + [(prods[3 * i + 2], prods[3 * i]) for i in range(k)])
+    im = L.sub_mod_many([(d[k + i], prods[3 * i + 1]) for i in range(k)])
+    return [(d[i], im[i]) for i in range(k)]
+
+
+def f2_sqr_many(xs):
+    """(a+b)(a-b), 2ab batched: 2k base muls in one call."""
+    k = len(xs)
+    sums = L.add_mod_many([(x[0], x[1]) for x in xs])
+    difs = L.sub_mod_many([(x[0], x[1]) for x in xs])
+    prods = L.mont_mul_many([(sums[i], difs[i]) for i in range(k)]
+                            + [(x[0], x[1]) for x in xs])
+    ims = L.add_mod_many([(prods[k + i], prods[k + i]) for i in range(k)])
+    return [(prods[i], ims[i]) for i in range(k)]
+
+
+# ---------------------------------------------------------------------------
+# Fq6: x = (c0, c1, c2) meaning c0 + c1 v + c2 v^2
+# ---------------------------------------------------------------------------
+
+def f6_zero_like(x):
+    z = f2_zero_like(x[0])
+    return (z, z, z)
+
+
+def f6_one_like(x):
+    return (f2_one_like(x[0]), f2_zero_like(x[0]), f2_zero_like(x[0]))
+
+
+def f6_add(x, y):
+    return tuple(f2_add(a, b) for a, b in zip(x, y))
+
+
+def f6_sub(x, y):
+    return tuple(f2_sub(a, b) for a, b in zip(x, y))
+
+
+def f6_neg(x):
+    return tuple(f2_neg(a) for a in x)
+
+
+def f6_mul_many(pairs):
+    """Toom/Karatsuba Fq6 products, all 6k Fq2 muls in one batched call."""
+    k = len(pairs)
+    # pre-sums: (a1+a2, a0+a1, a0+a2) and same for b, per pair
+    pre = []
+    for x, y in pairs:
+        pre += [(x[1], x[2]), (x[0], x[1]), (x[0], x[2]),
+                (y[1], y[2]), (y[0], y[1]), (y[0], y[2])]
+    s = f2_add_many(pre)
+    reqs = []
+    for i, (x, y) in enumerate(pairs):
+        a12, a01, a02, b12, b01, b02 = s[6 * i: 6 * i + 6]
+        reqs += [(x[0], y[0]), (x[1], y[1]), (x[2], y[2]),
+                 (a12, b12), (a01, b01), (a02, b02)]
+    m = f2_mul_many(reqs)
+    # combination, fully batched:
+    #   c0 = t0 + xi(m12 - t1 - t2)
+    #   c1 = (m01 - t0 - t1) + xi(t2)
+    #   c2 = (m02 - t0 - t2) + t1
+    r = f2_sub_many([(m[6 * i + 3], m[6 * i + 1]) for i in range(k)]
+                    + [(m[6 * i + 4], m[6 * i]) for i in range(k)]
+                    + [(m[6 * i + 5], m[6 * i]) for i in range(k)])
+    u = f2_sub_many([(r[i], m[6 * i + 2]) for i in range(k)]
+                    + [(r[k + i], m[6 * i + 1]) for i in range(k)]
+                    + [(r[2 * k + i], m[6 * i + 2]) for i in range(k)])
+    # xi(x) = (x0 - x1, x0 + x1), batched over the u's and t2's
+    xire = L.sub_mod_many([(u[i][0], u[i][1]) for i in range(k)]
+                          + [(m[6 * i + 2][0], m[6 * i + 2][1]) for i in range(k)])
+    xiim = L.add_mod_many([(u[i][0], u[i][1]) for i in range(k)]
+                          + [(m[6 * i + 2][0], m[6 * i + 2][1]) for i in range(k)])
+    fin = f2_add_many(
+        [(m[6 * i], (xire[i], xiim[i])) for i in range(k)]
+        + [(u[k + i], (xire[k + i], xiim[k + i])) for i in range(k)]
+        + [(u[2 * k + i], m[6 * i + 1]) for i in range(k)])
+    return [(fin[i], fin[k + i], fin[2 * k + i]) for i in range(k)]
+
+
+def f6_mul(x, y):
+    return f6_mul_many([(x, y)])[0]
+
+
+def f6_sqr(x):
+    return f6_mul(x, x)
+
+
+def f6_mul_f2(x, s):
+    return tuple(f2_mul(a, s) for a in x)
+
+
+def f6_mul_by_v(x):
+    return (f2_mul_xi(x[2]), x[0], x[1])
+
+
+def f6_inv(x):
+    a0, a1, a2 = x
+    m = f2_mul_many([(a0, a0), (a1, a1), (a2, a2),
+                     (a1, a2), (a0, a1), (a0, a2)])
+    sq0, sq1, sq2, m12, m01, m02 = m
+    t = f2_sub_many([(sq0, f2_mul_xi(m12)),
+                     (f2_mul_xi(sq2), m01),
+                     (sq1, m02)])
+    t0, t1, t2 = t
+    d = f2_mul_many([(a0, t0), (a2, t1), (a1, t2)])
+    det = f2_add(d[0], f2_add(f2_mul_xi(d[1]), f2_mul_xi(d[2])))
+    dinv = f2_inv(det)
+    out = f2_mul_many([(t0, dinv), (t1, dinv), (t2, dinv)])
+    return tuple(out)
+
+
+def f6_select(cond, x, y):
+    return tuple(f2_select(cond, a, b) for a, b in zip(x, y))
+
+
+# Frobenius constants (derived by the oracle at import, converted to limbs).
+def _frob_consts():
+    return (f2_const(_OFROB_V1), f2_const(_OFROB_V2), f2_const(_OFROB_W),
+            f2_const(_OFROB_V1 * _OFROB_W), f2_const(_OFROB_V2 * _OFROB_W))
+
+
+_FROB_V1, _FROB_V2, _FROB_W, _FROB_V1W, _FROB_V2W = _frob_consts()
+
+
+def f6_frobenius(x):
+    return (f2_conj(x[0]),
+            f2_mul(f2_conj(x[1]), _bc2(_FROB_V1, x[1])),
+            f2_mul(f2_conj(x[2]), _bc2(_FROB_V2, x[2])))
+
+
+def f2_broadcast(const_pair, like):
+    """Broadcast a constant Fq2 pair against a batched element."""
+    return (jnp.broadcast_to(const_pair[0], like[0].shape),
+            jnp.broadcast_to(const_pair[1], like[1].shape))
+
+
+_bc2 = f2_broadcast
+
+
+# ---------------------------------------------------------------------------
+# Fq12: x = (d0, d1) meaning d0 + d1 w
+# ---------------------------------------------------------------------------
+
+def f12_zero_like(x):
+    z = f6_zero_like(x[0])
+    return (z, z)
+
+
+def f12_one_like(x):
+    return (f6_one_like(x[0]), f6_zero_like(x[0]))
+
+
+def f12_mul(x, y):
+    a0, a1 = x
+    b0, b1 = y
+    sa = f2_add_many(list(zip(a0, a1)))
+    sb = f2_add_many(list(zip(b0, b1)))
+    t0, t1, tc = f6_mul_many([(a0, b0), (a1, b1), (tuple(sa), tuple(sb))])
+    c0 = f6_add(t0, f6_mul_by_v(t1))
+    c1 = f6_sub(f6_sub(tc, t0), t1)
+    return (c0, c1)
+
+
+def f12_sqr(x):
+    return f12_mul(x, x)
+
+
+def f12_conj(x):
+    return (x[0], f6_neg(x[1]))
+
+
+def f12_inv(x):
+    t = f6_inv(f6_sub(f6_sqr(x[0]), f6_mul_by_v(f6_sqr(x[1]))))
+    return (f6_mul(x[0], t), f6_neg(f6_mul(x[1], t)))
+
+
+def f12_frobenius(x):
+    a, b = x
+    v1 = _bc2(_FROB_V1, a[1])
+    v2 = _bc2(_FROB_V2, a[2])
+    w = _bc2(_FROB_W, b[0])
+    ac = tuple(f2_conj(c) for c in a)
+    bc = tuple(f2_conj(c) for c in b)
+    m = f2_mul_many([(ac[1], v1), (ac[2], v2),
+                     (bc[0], w), (bc[1], _bc2(_FROB_V1W, b[1])),
+                     (bc[2], _bc2(_FROB_V2W, b[2]))])
+    return ((ac[0], m[0], m[1]), (m[2], m[3], m[4]))
+
+
+def f12_eq(x, y):
+    out = None
+    for a, b in zip(_flatten12(x), _flatten12(y)):
+        e = L.eq(a, b)
+        out = e if out is None else (out & e)
+    return out
+
+
+def f12_is_one(x):
+    return f12_eq(x, f12_one_like(x))
+
+
+def f12_select(cond, x, y):
+    return ((f2_select(cond, x[0][0], y[0][0]),
+             f2_select(cond, x[0][1], y[0][1]),
+             f2_select(cond, x[0][2], y[0][2])),
+            (f2_select(cond, x[1][0], y[1][0]),
+             f2_select(cond, x[1][1], y[1][1]),
+             f2_select(cond, x[1][2], y[1][2])))
+
+
+def _flatten12(x):
+    for six in x:
+        for two in six:
+            for limb in two:
+                yield limb
+
+
+# Host-side conversion oracle <-> limbs, for tests and constants.
+def f12_const(x):
+    """Oracle Fq12 -> limb pytree."""
+    return (tuple(f2_const(c) for c in (x.c0.c0, x.c0.c1, x.c0.c2)),
+            tuple(f2_const(c) for c in (x.c1.c0, x.c1.c1, x.c1.c2)))
+
+
+def f12_to_oracle(x):
+    """Limb pytree (unbatched) -> oracle Fq12."""
+    from consensus_specs_tpu.ops.bls12_381.fields import Fq2, Fq6, Fq12
+    vals = [L.unpack_mont(a)[0] for a in _flatten12(x)]
+    f2s = [Fq2(vals[i], vals[i + 1]) for i in range(0, 12, 2)]
+    return Fq12(Fq6(*f2s[0:3]), Fq6(*f2s[3:6]))

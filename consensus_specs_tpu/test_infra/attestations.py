@@ -123,15 +123,27 @@ def run_attestation_processing(spec, state, attestation, valid=True):
             return
         raise AssertionError("attestation processing should have failed")
 
-    current_epoch_count = len(state.current_epoch_attestations)
-    previous_epoch_count = len(state.previous_epoch_attestations)
+    is_phase0 = spec.fork == "phase0"
+    if is_phase0:
+        current_epoch_count = len(state.current_epoch_attestations)
+        previous_epoch_count = len(state.previous_epoch_attestations)
 
     spec.process_attestation(state, attestation)
 
-    if attestation.data.target.epoch == spec.get_current_epoch(state):
-        assert len(state.current_epoch_attestations) == current_epoch_count + 1
+    if is_phase0:
+        # phase0 records pending attestations; altair+ sets flags instead
+        if attestation.data.target.epoch == spec.get_current_epoch(state):
+            assert len(state.current_epoch_attestations) == current_epoch_count + 1
+        else:
+            assert len(state.previous_epoch_attestations) == previous_epoch_count + 1
     else:
-        assert len(state.previous_epoch_attestations) == previous_epoch_count + 1
+        participation = (
+            state.current_epoch_participation
+            if attestation.data.target.epoch == spec.get_current_epoch(state)
+            else state.previous_epoch_participation)
+        attesting = spec.get_attesting_indices(
+            state, attestation.data, attestation.aggregation_bits)
+        assert any(participation[i] != 0 for i in attesting)
 
     yield "post", state
 

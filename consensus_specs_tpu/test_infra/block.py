@@ -70,6 +70,10 @@ def build_empty_block(spec, state, slot=None, proposer_index=None):
     block.proposer_index = proposer_index
     block.body.eth1_data.deposit_count = state.eth1_deposit_index
     block.parent_root = parent_block_root
+    if hasattr(block.body, "sync_aggregate"):
+        # altair+: an empty sync aggregate carries the infinity signature
+        block.body.sync_aggregate.sync_committee_signature = \
+            spec.G2_POINT_AT_INFINITY
     apply_randao_reveal(spec, state, block, proposer_index)
     return block
 

@@ -6,8 +6,24 @@ sub-step *before* the one under test, then yield pre/post around it.
 
 
 def get_process_calls(spec):
-    return [
+    if spec.fork == "phase0":
+        return [
+            "process_justification_and_finalization",
+            "process_rewards_and_penalties",
+            "process_registry_updates",
+            "process_slashings",
+            "process_eth1_data_reset",
+            "process_effective_balance_updates",
+            "process_slashings_reset",
+            "process_randao_mixes_reset",
+            "process_historical_roots_update",
+            "process_participation_record_updates",
+        ]
+    # altair+ ordering (specs/altair/beacon-chain.md process_epoch; capella
+    # renames historical roots to historical summaries)
+    calls = [
         "process_justification_and_finalization",
+        "process_inactivity_updates",
         "process_rewards_and_penalties",
         "process_registry_updates",
         "process_slashings",
@@ -15,9 +31,13 @@ def get_process_calls(spec):
         "process_effective_balance_updates",
         "process_slashings_reset",
         "process_randao_mixes_reset",
-        "process_historical_roots_update",
-        "process_participation_record_updates",
+        ("process_historical_summaries_update"
+         if hasattr(spec, "process_historical_summaries_update")
+         else "process_historical_roots_update"),
+        "process_participation_flag_updates",
+        "process_sync_committee_updates",
     ]
+    return calls
 
 
 def run_epoch_processing_to(spec, state, process_name):

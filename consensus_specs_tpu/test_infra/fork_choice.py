@@ -1,0 +1,119 @@
+"""Fork-choice test machinery: event-sourced store simulation.
+
+Mirrors the reference's ``test/helpers/fork_choice.py`` behavior: drive a
+``Store`` through on_tick / on_block / on_attestation steps, emitting a
+``steps`` event log (the same event-log shape the cross-client
+``fork_choice`` vector format uses, ``tests/formats/fork_choice/README.md``)
+and asserting store checks along the way.
+"""
+from consensus_specs_tpu.utils.ssz import hash_tree_root, serialize
+from consensus_specs_tpu.test_infra.context import expect_assertion_error
+
+
+def get_genesis_forkchoice_store_and_block(spec, genesis_state):
+    assert genesis_state.slot == spec.GENESIS_SLOT
+    genesis_block = spec.BeaconBlock(state_root=hash_tree_root(genesis_state))
+    return spec.get_forkchoice_store(genesis_state, genesis_block), genesis_block
+
+
+def get_genesis_forkchoice_store(spec, genesis_state):
+    return get_genesis_forkchoice_store_and_block(spec, genesis_state)[0]
+
+
+def on_tick_and_append_step(spec, store, time, test_steps):
+    assert time >= store.time
+    spec.on_tick(store, time)
+    test_steps.append({"tick": int(time)})
+    output_store_checks(spec, store, test_steps)
+
+
+def tick_and_add_block(spec, store, signed_block, test_steps, valid=True,
+                       block_not_ticked=False):
+    pre_state = store.block_states[bytes(signed_block.message.parent_root)]
+    if not block_not_ticked:
+        block_time = (pre_state.genesis_time
+                      + signed_block.message.slot * spec.config.SECONDS_PER_SLOT)
+        if store.time < block_time:
+            on_tick_and_append_step(spec, store, block_time, test_steps)
+    return add_block(spec, store, signed_block, test_steps, valid=valid)
+
+
+def add_block(spec, store, signed_block, test_steps, valid=True):
+    """Run on_block and (on success) re-check the stored block."""
+    if not valid:
+        expect_assertion_error(lambda: spec.on_block(store, signed_block))
+        test_steps.append({"block": "invalid", "valid": False})
+        return None
+    spec.on_block(store, signed_block)
+    # an on_block step implies receiving the block's attestations + slashings
+    for attestation in signed_block.message.body.attestations:
+        spec.on_attestation(store, attestation, is_from_block=True)
+    for attester_slashing in signed_block.message.body.attester_slashings:
+        spec.on_attester_slashing(store, attester_slashing)
+    block_root = hash_tree_root(signed_block.message)
+    assert hash_tree_root(store.blocks[block_root]) == block_root
+    test_steps.append({"block": "0x" + block_root.hex()})
+    output_store_checks(spec, store, test_steps)
+    return store.block_states[block_root]
+
+
+def add_attestation(spec, store, attestation, test_steps, is_from_block=False):
+    spec.on_attestation(store, attestation, is_from_block=is_from_block)
+    test_steps.append({"attestation": "0x" + hash_tree_root(attestation).hex()})
+    output_store_checks(spec, store, test_steps)
+
+
+def add_attestations(spec, store, attestations, test_steps, is_from_block=False):
+    for a in attestations:
+        add_attestation(spec, store, a, test_steps, is_from_block=is_from_block)
+
+
+def add_attester_slashing(spec, store, slashing, test_steps, valid=True):
+    if not valid:
+        expect_assertion_error(lambda: spec.on_attester_slashing(store, slashing))
+        test_steps.append({"attester_slashing": "invalid", "valid": False})
+        return
+    spec.on_attester_slashing(store, slashing)
+    test_steps.append(
+        {"attester_slashing": "0x" + hash_tree_root(slashing).hex()})
+
+
+def get_formatted_head_output(spec, store):
+    head = spec.get_head(store)
+    return {"slot": int(store.blocks[bytes(head)].slot),
+            "root": "0x" + bytes(head).hex()}
+
+
+def output_store_checks(spec, store, test_steps):
+    test_steps.append({"checks": {
+        "time": int(store.time),
+        "head": get_formatted_head_output(spec, store),
+        "justified_checkpoint": {
+            "epoch": int(store.justified_checkpoint.epoch),
+            "root": "0x" + bytes(store.justified_checkpoint.root).hex(),
+        },
+        "finalized_checkpoint": {
+            "epoch": int(store.finalized_checkpoint.epoch),
+            "root": "0x" + bytes(store.finalized_checkpoint.root).hex(),
+        },
+        "proposer_boost_root": "0x" + bytes(store.proposer_boost_root).hex(),
+    }})
+
+
+def apply_next_epoch_with_attestations(spec, state, store, fill_cur_epoch,
+                                       fill_prev_epoch, test_steps):
+    """Advance one epoch via attested blocks, feeding each to the store."""
+    from consensus_specs_tpu.test_infra.attestations import (
+        next_epoch_with_attestations)
+    _, new_signed_blocks, post_state = next_epoch_with_attestations(
+        spec, state, fill_cur_epoch, fill_prev_epoch)
+    last_signed_block = None
+    for signed_block in new_signed_blocks:
+        block_root = hash_tree_root(signed_block.message)
+        tick_and_add_block(spec, store, signed_block, test_steps)
+        assert bytes(store.blocks[block_root].parent_root) == \
+            bytes(signed_block.message.parent_root)
+        last_signed_block = signed_block
+    assert hash_tree_root(store.block_states[hash_tree_root(
+        last_signed_block.message)]) == hash_tree_root(post_state)
+    return post_state, store, last_signed_block

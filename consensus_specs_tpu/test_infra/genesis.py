@@ -26,11 +26,26 @@ def build_mock_validator(spec, i: int, balance: int):
     return validator
 
 
+def _genesis_fork_versions(spec):
+    """(previous, current) fork versions for a mock genesis at this fork."""
+    fork = spec.fork
+    versions = {
+        "phase0": spec.config.GENESIS_FORK_VERSION,
+        "altair": getattr(spec.config, "ALTAIR_FORK_VERSION", None),
+        "bellatrix": getattr(spec.config, "BELLATRIX_FORK_VERSION", None),
+        "capella": getattr(spec.config, "CAPELLA_FORK_VERSION", None),
+        "deneb": getattr(spec.config, "DENEB_FORK_VERSION", None),
+    }
+    order = ["phase0", "altair", "bellatrix", "capella", "deneb"]
+    cur = versions[fork]
+    prev = versions[order[max(0, order.index(fork) - 1)]]
+    return prev, cur
+
+
 def create_genesis_state(spec, validator_balances, activation_threshold):
     deposit_root = b"\x42" * 32
     eth1_block_hash = b"\xda" * 32
-    previous_version = spec.config.GENESIS_FORK_VERSION
-    current_version = spec.config.GENESIS_FORK_VERSION
+    previous_version, current_version = _genesis_fork_versions(spec)
     state = spec.BeaconState(
         genesis_time=0,
         eth1_deposit_index=len(validator_balances),
@@ -58,4 +73,8 @@ def create_genesis_state(spec, validator_balances, activation_threshold):
             validator.activation_eligibility_epoch = spec.GENESIS_EPOCH
             validator.activation_epoch = spec.GENESIS_EPOCH
     state.genesis_validators_root = hash_tree_root(state.validators)
+    # fork-specific genesis fields (participation, sync committees, ...)
+    post_hook = getattr(spec, "post_mock_genesis", None)
+    if post_hook is not None:
+        post_hook(state)
     return state

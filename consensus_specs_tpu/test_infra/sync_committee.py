@@ -1,0 +1,65 @@
+"""Sync-committee signing helpers.
+
+Reference: ``test/helpers/sync_committee.py`` (compute_aggregate_sync_
+committee_signature and the sync-aggregate test runner).
+"""
+from consensus_specs_tpu.utils import bls
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+from .keys import privkeys, pubkeys
+
+
+def compute_sync_committee_signature(spec, state, slot, privkey,
+                                     block_root=None):
+    domain = spec.get_domain(state, spec.DOMAIN_SYNC_COMMITTEE,
+                             spec.compute_epoch_at_slot(slot))
+    if block_root is None:
+        if slot == state.slot:
+            block_root = build_latest_block_root(spec, state)
+        else:
+            block_root = spec.get_block_root_at_slot(state, slot)
+    signing_root = spec.compute_signing_root(block_root, domain)
+    return bls.Sign(privkey, signing_root)
+
+
+def build_latest_block_root(spec, state):
+    header = state.latest_block_header.copy()
+    if bytes(header.state_root) == b"\x00" * 32:
+        header.state_root = hash_tree_root(state)
+    return hash_tree_root(header)
+
+
+def compute_aggregate_sync_committee_signature(spec, state, slot,
+                                               participants,
+                                               block_root=None):
+    """Aggregate signature of the given participant validator indices."""
+    if len(participants) == 0:
+        return spec.G2_POINT_AT_INFINITY
+    signatures = [
+        compute_sync_committee_signature(spec, state, slot,
+                                         privkeys[validator_index],
+                                         block_root)
+        for validator_index in participants]
+    return bls.Aggregate(signatures)
+
+
+def compute_committee_indices(state, committee=None):
+    """Validator indices of the current sync committee members."""
+    if committee is None:
+        committee = state.current_sync_committee
+    all_pubkeys = [bytes(v.pubkey) for v in state.validators]
+    return [all_pubkeys.index(bytes(pubkey)) for pubkey in committee.pubkeys]
+
+
+def run_sync_committee_processing(spec, state, block, expect_exception=False):
+    """Process a block's sync aggregate, yielding vector parts."""
+    from .context import expect_assertion_error
+    yield "pre", state
+    yield "sync_aggregate", block.body.sync_aggregate
+    if expect_exception:
+        expect_assertion_error(
+            lambda: spec.process_sync_aggregate(state,
+                                                block.body.sync_aggregate))
+        yield "post", None
+    else:
+        spec.process_sync_aggregate(state, block.body.sync_aggregate)
+        yield "post", state

@@ -657,7 +657,13 @@ class ListBase(_SequenceBase):
     @classmethod
     def coerce(cls, value):
         # value semantics on assignment (remerkleable-compatible): snapshot
-        return value.copy() if type(value) is cls else cls(value)
+        if type(value) is cls:
+            return value.copy()
+        if isinstance(value, _SequenceBase):
+            # cross-class sequence (e.g. same-shape List from another fork's
+            # spec instance): rebuild elementwise, never as a single element
+            return cls(list(value))
+        return cls(value)
 
     @classmethod
     def decode_bytes(cls, data: bytes):

@@ -1,0 +1,102 @@
+"""Sync-aggregate processing tests.
+
+Reference model: ``test/altair/block_processing/sync_aggregate/``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases_from, always_bls, never_bls,
+)
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot, next_slots,
+)
+from consensus_specs_tpu.test_infra.sync_committee import (
+    compute_aggregate_sync_committee_signature, compute_committee_indices,
+    run_sync_committee_processing,
+)
+
+with_altair_and_later = with_all_phases_from("altair")
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committee_rewards_all_participating(spec, state):
+    committee_indices = compute_committee_indices(state)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * len(committee_indices),
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, committee_indices),
+    )
+    spec.process_slots(state, block.slot)
+    pre_balances = [int(state.balances[i]) for i in committee_indices]
+    yield from run_sync_committee_processing(spec, state, block)
+    post_balances = [int(state.balances[i]) for i in committee_indices]
+    assert all(post >= pre for pre, post in zip(pre_balances, post_balances))
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committee_nonparticipating_penalized(spec, state):
+    committee_indices = compute_committee_indices(state)
+    block = build_empty_block_for_next_slot(spec, state)
+    half = len(committee_indices) // 2
+    bits = [i < half for i in range(len(committee_indices))]
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, committee_indices[:half]),
+    )
+    spec.process_slots(state, block.slot)
+    nonparticipant = committee_indices[-1]
+    pre = int(state.balances[nonparticipant])
+    yield from run_sync_committee_processing(spec, state, block)
+    assert int(state.balances[nonparticipant]) < pre
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_invalid_signature_bad_domain(spec, state):
+    committee_indices = compute_committee_indices(state)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * len(committee_indices),
+        # signed over the wrong block root
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, committee_indices,
+            block_root=spec.Root(b"\x42" * 32)),
+    )
+    spec.process_slots(state, block.slot)
+    yield from run_sync_committee_processing(spec, state, block,
+                                             expect_exception=True)
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_invalid_signature_missing_participant(spec, state):
+    committee_indices = compute_committee_indices(state)
+    block = build_empty_block_for_next_slot(spec, state)
+    # all bits set, but one participant missing from the signature
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * len(committee_indices),
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, committee_indices[:-1]),
+    )
+    spec.process_slots(state, block.slot)
+    yield from run_sync_committee_processing(spec, state, block,
+                                             expect_exception=True)
+
+
+@with_altair_and_later
+@spec_state_test
+@never_bls
+def test_empty_sync_aggregate_infinity_sig(spec, state):
+    """All-zero bits with the infinity signature is valid (bls.md:61)."""
+    committee_indices = compute_committee_indices(state)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[False] * len(committee_indices),
+        sync_committee_signature=spec.G2_POINT_AT_INFINITY,
+    )
+    spec.process_slots(state, block.slot)
+    yield from run_sync_committee_processing(spec, state, block)

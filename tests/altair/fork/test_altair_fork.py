@@ -1,0 +1,60 @@
+"""upgrade_to_altair fork tests.
+
+Reference model: ``test/altair/fork/test_altair_fork_basic.py`` -
+build a phase0 state, upgrade, check invariants.
+"""
+from consensus_specs_tpu.forks import build_spec
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, never_bls,
+)
+from consensus_specs_tpu.test_infra.block import next_epoch
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+
+def run_fork_test(post_spec, pre_state):
+    yield "pre", pre_state
+    post_state = post_spec.upgrade_to_altair(pre_state)
+
+    # stable fields stay identical
+    for field in ("genesis_time", "genesis_validators_root", "slot",
+                  "eth1_deposit_index", "justification_bits"):
+        assert getattr(pre_state, field) == getattr(post_state, field)
+    for field in ("block_roots", "state_roots", "historical_roots",
+                  "validators", "balances", "randao_mixes", "slashings"):
+        assert hash_tree_root(getattr(pre_state, field)) == \
+            hash_tree_root(getattr(post_state, field))
+
+    # fork versions
+    assert post_state.fork.previous_version == pre_state.fork.current_version
+    assert bytes(post_state.fork.current_version) == \
+        bytes(post_spec.config.ALTAIR_FORK_VERSION)
+
+    # new fields sized to the registry
+    assert len(post_state.previous_epoch_participation) == \
+        len(post_state.validators)
+    assert len(post_state.current_epoch_participation) == \
+        len(post_state.validators)
+    assert len(post_state.inactivity_scores) == len(post_state.validators)
+    assert all(int(s) == 0 for s in post_state.inactivity_scores)
+
+    # sync committees populated
+    assert len(post_state.current_sync_committee.pubkeys) == \
+        post_spec.SYNC_COMMITTEE_SIZE
+    yield "post", post_state
+
+
+@with_phases(["phase0"])
+@spec_state_test
+@never_bls
+def test_altair_fork_basic(spec, state):
+    post_spec = build_spec("altair", spec.preset_name)
+    yield from run_fork_test(post_spec, state)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+@never_bls
+def test_altair_fork_next_epoch(spec, state):
+    next_epoch(spec, state)
+    post_spec = build_spec("altair", spec.preset_name)
+    yield from run_fork_test(post_spec, state)

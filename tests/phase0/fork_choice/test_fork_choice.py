@@ -1,0 +1,231 @@
+"""Fork-choice store tests.
+
+Reference models: ``test/phase0/fork_choice/test_get_head.py`` and
+``test_on_block.py`` (event-sourced store simulation with head checks).
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases, never_bls,
+)
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block,
+    next_epoch, next_slots,
+)
+from consensus_specs_tpu.test_infra.attestations import (
+    get_valid_attestation,
+)
+from consensus_specs_tpu.test_infra.fork_choice import (
+    get_genesis_forkchoice_store_and_block, on_tick_and_append_step,
+    tick_and_add_block, add_attestation, get_genesis_forkchoice_store,
+    apply_next_epoch_with_attestations,
+)
+from consensus_specs_tpu.test_infra.context import expect_assertion_error
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_genesis_head(spec, state):
+    store, genesis_block = get_genesis_forkchoice_store_and_block(spec, state)
+    assert bytes(spec.get_head(store)) == hash_tree_root(genesis_block)
+    yield
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_chain_no_attestations(spec, state):
+    test_steps = []
+    store, genesis_block = get_genesis_forkchoice_store_and_block(spec, state)
+    anchor_root = hash_tree_root(genesis_block)
+    assert bytes(spec.get_head(store)) == anchor_root
+
+    block1 = build_empty_block_for_next_slot(spec, state)
+    signed1 = state_transition_and_sign_block(spec, state, block1)
+    tick_and_add_block(spec, store, signed1, test_steps)
+    block2 = build_empty_block_for_next_slot(spec, state)
+    signed2 = state_transition_and_sign_block(spec, state, block2)
+    tick_and_add_block(spec, store, signed2, test_steps)
+
+    assert bytes(spec.get_head(store)) == hash_tree_root(block2)
+    yield
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_split_tie_breaker_no_attestations(spec, state):
+    """Two competing heads at the same height: lexicographically
+    greater root wins (fork-choice.md get_head tie-break)."""
+    test_steps = []
+    store, genesis_block = get_genesis_forkchoice_store_and_block(spec, state)
+    base_state = state.copy()
+
+    state1 = base_state.copy()
+    block1 = build_empty_block_for_next_slot(spec, state1)
+    signed1 = state_transition_and_sign_block(spec, state1, block1)
+
+    state2 = base_state.copy()
+    block2 = build_empty_block_for_next_slot(spec, state2)
+    block2.body.graffiti = b"\x42" * 32
+    signed2 = state_transition_and_sign_block(spec, state2, block2)
+
+    # tick past slot 1 so the proposer boost does not break the tie
+    time = store.genesis_time + (int(block2.slot) + 1) * spec.config.SECONDS_PER_SLOT
+    on_tick_and_append_step(spec, store, time, test_steps)
+    tick_and_add_block(spec, store, signed1, test_steps)
+    tick_and_add_block(spec, store, signed2, test_steps)
+
+    expected = max(hash_tree_root(block1), hash_tree_root(block2))
+    assert bytes(spec.get_head(store)) == expected
+    yield
+
+
+@with_all_phases
+@spec_state_test
+def test_shorter_chain_but_heavier_weight(spec, state):
+    """An attested one-block chain beats an unattested longer chain."""
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    base_state = state.copy()
+
+    # longer chain with no attestations
+    long_state = base_state.copy()
+    for _ in range(3):
+        b = build_empty_block_for_next_slot(spec, long_state)
+        sb = state_transition_and_sign_block(spec, long_state, b)
+        tick_and_add_block(spec, store, sb, test_steps)
+    long_head = spec.get_head(store)
+
+    # short chain with an attestation
+    short_state = base_state.copy()
+    short_block = build_empty_block_for_next_slot(spec, short_state)
+    short_block.body.graffiti = b"\x99" * 32
+    signed_short = state_transition_and_sign_block(spec, short_state, short_block)
+    tick_and_add_block(spec, store, signed_short, test_steps)
+
+    att = get_valid_attestation(spec, short_state, slot=short_block.slot,
+                                signed=True)
+    next_slots(spec, short_state, 2)  # make attestation slot reachable
+    time = store.genesis_time + int(short_state.slot) * spec.config.SECONDS_PER_SLOT
+    on_tick_and_append_step(spec, store, time, test_steps)
+    add_attestation(spec, store, att, test_steps)
+
+    head = spec.get_head(store)
+    assert bytes(head) == hash_tree_root(short_block)
+    assert bytes(head) != bytes(long_head)
+    yield
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_on_block_future_block(spec, state):
+    """Blocks from the future are not added to the store."""
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    # do not tick: store time stays at genesis
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    tick_and_add_block(spec, store, signed, test_steps, valid=False,
+                       block_not_ticked=True)
+    yield
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_on_block_bad_parent_root(spec, state):
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    signed.message.parent_root = b"\x55" * 32
+    time = store.genesis_time + int(block.slot) * spec.config.SECONDS_PER_SLOT
+    on_tick_and_append_step(spec, store, time, test_steps)
+    from consensus_specs_tpu.test_infra.fork_choice import add_block
+    add_block(spec, store, signed, test_steps, valid=False)
+    yield
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_proposer_boost(spec, state):
+    """A timely block gets the proposer score boost; the boost wears off
+    at the next slot (fork-choice.md on_block boost + on_tick reset)."""
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+
+    # arrive exactly at the block's slot start: timely
+    time = (store.genesis_time
+            + int(block.slot) * spec.config.SECONDS_PER_SLOT)
+    on_tick_and_append_step(spec, store, time, test_steps)
+    tick_and_add_block(spec, store, signed, test_steps)
+    root = hash_tree_root(block)
+    assert bytes(store.proposer_boost_root) == root
+    assert spec.get_weight(store, root) > 0
+
+    # next slot: boost resets
+    on_tick_and_append_step(
+        spec, store, time + spec.config.SECONDS_PER_SLOT, test_steps)
+    assert bytes(store.proposer_boost_root) == b"\x00" * 32
+    assert spec.get_weight(store, root) == 0
+    yield
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_on_attestation_future_epoch(spec, state):
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    tick_and_add_block(spec, store, signed, test_steps)
+    # attestation targets a future epoch relative to store time
+    att = get_valid_attestation(spec, state, slot=block.slot, signed=False)
+    att.data.target.epoch = spec.get_current_store_epoch(store) + 1
+    expect_assertion_error(
+        lambda: spec.on_attestation(store, att, is_from_block=False))
+    yield
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_updates_latest_messages(spec, state):
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    tick_and_add_block(spec, store, signed, test_steps)
+
+    att = get_valid_attestation(spec, state, slot=block.slot, signed=True)
+    # move store time forward so the attestation slot is in the past
+    time = (store.genesis_time
+            + (int(att.data.slot) + 2) * spec.config.SECONDS_PER_SLOT)
+    on_tick_and_append_step(spec, store, time, test_steps)
+    assert len(store.latest_messages) == 0
+    add_attestation(spec, store, att, test_steps)
+    assert len(store.latest_messages) > 0
+    for msg in store.latest_messages.values():
+        assert msg.root == bytes(att.data.beacon_block_root)
+        assert msg.epoch == att.data.target.epoch
+    yield
+
+
+@with_all_phases
+@spec_state_test
+def test_justification_update_from_epoch_transition(spec, state):
+    """Run >2 epochs of fully-attested blocks through the store and check
+    the store's justified checkpoint advances."""
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    assert store.justified_checkpoint.epoch == 0
+    for _ in range(3):
+        state, store, _ = apply_next_epoch_with_attestations(
+            spec, state, store, True, False, test_steps)
+    assert store.justified_checkpoint.epoch > 0
+    yield
