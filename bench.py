@@ -7,10 +7,12 @@ shape) against the pure-python oracle (the reference's py_ecc role,
 """
 import json
 import os
+import sys
 import time
 
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from consensus_specs_tpu.utils.jax_env import setup_compile_cache  # noqa: E402
+setup_compile_cache()
 
 
 def main():

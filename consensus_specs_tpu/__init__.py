@@ -21,3 +21,10 @@ Layout:
 """
 
 __version__ = "0.1.0"
+
+# Point JAX at the shared persistent compile cache before any kernel module
+# compiles — consumers importing the package directly get the same cache as
+# pytest / bench.py / the driver entry points.
+from consensus_specs_tpu.utils.jax_env import setup_compile_cache as _scc
+_scc()
+del _scc

@@ -1,0 +1,53 @@
+"""Shared JAX environment setup: persistent compile cache.
+
+Every entry point (pytest, bench.py, __graft_entry__, plain consumer
+imports) uses the same cache directory so big XLA programs (pairing,
+hash-to-curve, MSM) compile once per machine.  The directory is keyed by
+jaxlib + libtpu build versions: replaying an AOT executable compiled by a
+different libtpu than the runtime fails with FAILED_PRECONDITION (the
+round-2 multichip failure mode), so a build change must land in a fresh
+directory.
+"""
+import os
+
+_CACHE_ROOT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    ".jax_cache")
+
+
+def keyed_cache_dir() -> str:
+    parts = []
+    try:
+        import jaxlib.version
+        parts.append(jaxlib.version.__version__)
+    except Exception:
+        parts.append("jaxlib-unknown")
+    try:
+        import importlib.metadata as _md
+        parts.append("libtpu-" + _md.version("libtpu"))
+    except Exception:
+        parts.append("libtpu-none")
+    return os.path.join(_CACHE_ROOT, "-".join(parts))
+
+
+def setup_compile_cache() -> str:
+    """Point JAX at the keyed persistent cache; idempotent.
+
+    Works both before and after ``import jax`` (config reads the env var
+    lazily until a backend is initialized; after that we push it through
+    jax.config as well, which is safe pre-first-compile).
+    """
+    cache_dir = keyed_cache_dir()
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+    import sys
+    if "jax" in sys.modules:
+        try:
+            import jax
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs",
+                int(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
+        except Exception:
+            pass
+    return cache_dir

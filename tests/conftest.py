@@ -13,17 +13,16 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: the container env sets axon (TPU)
-# Persistent XLA compile cache: the BLS pairing programs are big; cache them
-# across pytest runs (1-core box, see memory note).
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      os.path.join(os.path.dirname(os.path.dirname(
-                          os.path.abspath(__file__))), ".jax_cache"))
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Shared persistent XLA compile cache (keyed by jaxlib/libtpu build); the
+# BLS pairing programs are big — cache them across pytest runs.
+from consensus_specs_tpu.utils.jax_env import setup_compile_cache  # noqa: E402
+setup_compile_cache()
 
 
 def pytest_addoption(parser):
