@@ -4,17 +4,15 @@ Implements the RFC 9380 construction used by the eth2 ciphersuite
 ``BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_`` (reference:
 ``specs/phase0/beacon-chain.md:660``): expand_message_xmd(SHA-256) →
 hash_to_field(Fq2, m=2, L=64) → simplified-SWU on the 3-isogenous curve E'
-(A' = 240u, B' = 1012(1+u), Z = −(2+u)) → 3-isogeny to E2 → cofactor
-clearing via the ψ (untwist-Frobenius-twist) endomorphism.
+(A' = 240u, B' = 1012(1+u), Z = −(2+u)) → the RFC 9380 Appendix E.3
+3-isogeny rational map to E2 → cofactor clearing via the ψ
+(untwist-Frobenius-twist) endomorphism (Budroni–Pintore).
 
-Zero-egress caveat: the 3-isogeny rational map is DERIVED here at import via
-Vélu's formulas from a kernel root of E'’s 3-division polynomial, then
-self-verified (image on E2, homomorphism property, subgroup landing). The
-derivation pins down the isogeny only up to post-composition with an
-automorphism of E2, so hashed points may differ from the IETF ciphersuite by
-that automorphism until checked against official vectors; the scheme is
-internally consistent (sign↔verify) either way. TODO(round-2+): pin exact
-RFC 9380 E.3 constants against external vectors.
+The isogeny uses the standard E.3 constant table (not a derived map).  It
+is self-verified at import: every mapped point must land on E2, the map
+must be a group homomorphism E'→E2, and hashed points must land in the
+r-torsion subgroup — a single wrong constant fails those checks with
+overwhelming probability.
 """
 import hashlib
 from typing import List, Tuple
@@ -103,193 +101,66 @@ def map_to_curve_sswu(u: Fq2) -> Tuple[Fq2, Fq2]:
 
 
 # ---------------------------------------------------------------------------
-# 3-isogeny E' -> E2, derived via Vélu's formulas
+# 3-isogeny E' -> E2: RFC 9380 Appendix E.3 rational map
 # ---------------------------------------------------------------------------
+#
+# X = x_num(x')/x_den(x');  Y = y' * y_num(x')/y_den(x')
+# Coefficients k_(i,j) as Fq2 = re + im*u, low degree first.
 
-def _cube_root(c: Fq2):
-    """Cube root in Fq2; None if c is not a cube.
-
-    q² − 1 = 3^s·t with s = 2 for this field, so after computing
-    x0 = c^(3⁻¹ mod t) (correct up to a 3-Sylow component of order ≤ 9) the
-    right cube root is found by scanning x0·e^j over the 9-element Sylow
-    subgroup.
-    """
-    if c.is_zero():
-        return Fq2.zero()
-    q1 = P * P - 1
-    s, t = 0, q1
-    while t % 3 == 0:
-        s, t = s + 1, t // 3
-    # find a generator of the 3-Sylow subgroup: e = g^t for a cubic non-residue g
-    e = None
-    for trial_a in range(2, 40):
-        g = Fq2(trial_a, 1)
-        if (g ** (q1 // 3)) != Fq2.one():
-            e = g ** t
-            break
-    assert e is not None, "no cubic non-residue found"
-    x0 = c ** pow(3, -1, t)
-    cand = x0
-    for _ in range(3 ** s):
-        if cand * cand * cand == c:
-            return cand
-        cand = cand * e
-    return None
-
-
-def _sixth_root(c: Fq2):
-    r = c.sqrt()
-    if r is not None:
-        cr = _cube_root(r)
-        if cr is not None:
-            return cr
-        cr = _cube_root(-r)
-        if cr is not None:
-            return cr
-    return None
+ISO_XNUM = (
+    Fq2(0x5c759507e8e333ebb5b7a9a47d7ed8532c52d39fd3a042a88b58423c50ae15d5c2638e343d9c71c6238aaaaaaaa97d6,
+        0x5c759507e8e333ebb5b7a9a47d7ed8532c52d39fd3a042a88b58423c50ae15d5c2638e343d9c71c6238aaaaaaaa97d6),
+    Fq2(0,
+        0x11560bf17baa99bc32126fced787c88f984f87adf7ae0c7f9a208c6b4f20a4181472aaa9cb8d555526a9ffffffffc71a),
+    Fq2(0x11560bf17baa99bc32126fced787c88f984f87adf7ae0c7f9a208c6b4f20a4181472aaa9cb8d555526a9ffffffffc71e,
+        0x8ab05f8bdd54cde190937e76bc3e447cc27c3d6fbd7063fcd104635a790520c0a395554e5c6aaaa9354ffffffffe38d),
+    Fq2(0x171d6541fa38ccfaed6dea691f5fb614cb14b4e7f4e810aa22d6108f142b85757098e38d0f671c7188e2aaaaaaaa5ed1,
+        0),
+)
+ISO_XDEN = (
+    Fq2(0,
+        0x1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffaa63),
+    Fq2(0xc,
+        0x1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffaa9f),
+    Fq2(1, 0),  # monic x'^2
+)
+ISO_YNUM = (
+    Fq2(0x1530477c7ab4113b59a4c18b076d11930f7da5d4a07f649bf54439d87d27e500fc8c25ebf8c92f6812cfc71c71c6d706,
+        0x1530477c7ab4113b59a4c18b076d11930f7da5d4a07f649bf54439d87d27e500fc8c25ebf8c92f6812cfc71c71c6d706),
+    Fq2(0,
+        0x5c759507e8e333ebb5b7a9a47d7ed8532c52d39fd3a042a88b58423c50ae15d5c2638e343d9c71c6238aaaaaaaa97be),
+    Fq2(0x11560bf17baa99bc32126fced787c88f984f87adf7ae0c7f9a208c6b4f20a4181472aaa9cb8d555526a9ffffffffc71c,
+        0x8ab05f8bdd54cde190937e76bc3e447cc27c3d6fbd7063fcd104635a790520c0a395554e5c6aaaa9354ffffffffe38f),
+    Fq2(0x124c9ad43b6cf79bfbf7043de3811ad0761b0f37a1e26286b0e977c69aa274524e79097a56dc4bd9e1b371c71c718b10,
+        0),
+)
+ISO_YDEN = (
+    Fq2(0x1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffa8fb,
+        0x1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffa8fb),
+    Fq2(0,
+        0x1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffa9d3),
+    Fq2(0x12,
+        0x1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffaa99),
+    Fq2(1, 0),  # monic x'^3
+)
 
 
-def _derive_isogeny():
-    """Find the 3-isogeny E' -> E2 (Vélu) and return its rational map.
-
-    Returns (iso,) where iso(x, y) -> (X, Y) on E2.
-    """
-    A, B = A_PRIME, B_PRIME
-    # 3-division polynomial of E': ψ₃(x) = 3x⁴ + 6Ax² + 12Bx − A²
-    # Find its roots in Fq2 by exhaustive gcd with x^(q²) − x over the quartic
-    # — implemented as: for each candidate root found by factoring via
-    # repeated root-extraction (the quartic has at most 4 roots; find them by
-    # solving with resolvent-free numeric search: try roots of form derived
-    # from polynomial gcd). Simpler: use that ψ₃ factors and find roots by
-    # computing gcd(x^q² − x, ψ₃) via modular exponentiation of x.
-    q2 = P * P
-
-    def poly_mulmod(f, g, mod):
-        out = [Fq2.zero()] * (len(f) + len(g) - 1)
-        for i, fi in enumerate(f):
-            if fi.is_zero():
-                continue
-            for j, gj in enumerate(g):
-                out[i + j] = out[i + j] + fi * gj
-        return poly_mod(out, mod)
-
-    def poly_mod(f, mod):
-        # mod: monic, degree 4
-        f = list(f)
-        dm = len(mod) - 1
-        while len(f) > dm:
-            lead = f[-1]
-            if not lead.is_zero():
-                shift = len(f) - 1 - dm
-                for i in range(dm):
-                    f[shift + i] = f[shift + i] - lead * mod[i]
-            f.pop()
-        return f
-
-    inv3 = Fq2(pow(3, -1, P), 0)
-    # monic ψ₃: x⁴ + 2A x² + 4B x − A²/3
-    psi3 = [(-(A * A)) * inv3, B.mul_scalar(4), A.mul_scalar(2), Fq2.zero(), Fq2.one()]
-
-    # x^(q²) mod ψ₃ by square-and-multiply on the polynomial x
-    xpoly = [Fq2.zero(), Fq2.one()]
-    result = [Fq2.one()]
-    base = xpoly
-    e = q2
-    while e:
-        if e & 1:
-            result = poly_mulmod(result, base, psi3)
-        base = poly_mulmod(base, base, psi3)
-        e >>= 1
-    # gcd(x^(q²) − x, ψ₃)
-    f1 = [a for a in result]
-    while len(f1) < 2:
-        f1.append(Fq2.zero())
-    f1[1] = f1[1] - Fq2.one()  # subtract x
-
-    def poly_gcd(a, b):
-        a, b = list(a), list(b)
-
-        def norm(f):
-            while f and f[-1].is_zero():
-                f.pop()
-            return f
-        a, b = norm(a), norm(b)
-        while b:
-            # a mod b
-            binv = b[-1].inv()
-            while len(a) >= len(b):
-                lead = a[-1] * binv
-                shift = len(a) - len(b)
-                for i in range(len(b)):
-                    a[shift + i] = a[shift + i] - lead * b[i]
-                a = norm(a)
-                if len(a) < len(b):
-                    break
-            a, b = b, a
-        return norm(a)
-
-    g = poly_gcd([a for a in psi3], f1)
-    # g has the Fq2-rational kernel x-coordinates as roots (degree 1 or 2)
-    roots = []
-    if len(g) == 2:  # linear: x + c0  (monic after normalization)
-        roots.append(-(g[0] * g[1].inv()))
-    elif len(g) == 3:  # quadratic
-        c = g[0] * g[2].inv()
-        bq = g[1] * g[2].inv()
-        disc = bq * bq - c.mul_scalar(4)
-        sd = disc.sqrt()
-        if sd is not None:
-            half = Fq2(pow(2, -1, P), 0)
-            roots.append((-bq + sd) * half)
-            roots.append((-bq - sd) * half)
-    else:
-        # fall back: try all roots via quartic being fully split — factor by
-        # repeatedly extracting linear factors with random shifts
-        raise RuntimeError(f"unexpected kernel gcd degree {len(g) - 1}")
-
-    for x0 in roots:
-        y0sq = x0 * x0 * x0 + A * x0 + B
-        # Vélu needs the kernel point coordinates; y0 may live in Fq4 but the
-        # formulas below only use y0² — they stay in Fq2 regardless.
-        gx = x0.square().mul_scalar(3) + A
-        u_p = y0sq.mul_scalar(4)
-        v_p = gx.mul_scalar(2)
-        v_sum, w_sum = v_p, u_p + x0 * v_p
-        a_cod = A - v_sum.mul_scalar(5)
-        b_cod = B - w_sum.mul_scalar(7)
-        if not a_cod.is_zero():
-            continue  # wrong kernel: codomain must have j = 0
-        # scale codomain y² = x³ + b_cod onto E2: need s⁶ = B2 / b_cod
-        s = _sixth_root(B2 * b_cod.inv())
-        if s is None:
-            continue
-        s2, s3 = s.square(), s.square() * s
-
-        global ISO_CONSTANTS
-        ISO_CONSTANTS = (x0, u_p, v_p, s2, s3)
-
-        def iso(x, y, x0=x0, u_p=u_p, v_p=v_p, s2=s2, s3=s3):
-            d = x - x0
-            dinv = d.inv()
-            X = x + v_p * dinv + u_p * dinv.square()
-            Y = y * (Fq2.one() - v_p * dinv.square() - u_p.mul_scalar(2) * dinv.square() * dinv)
-            return X * s2, Y * s3
-
-        # verify on a sample of E' points produced by SSWU
-        ok = True
-        for test_msg in (b"velu-test-1", b"velu-test-2", b"velu-test-3"):
-            ux = hash_to_field_fq2(test_msg, 1)[0]
-            px, py = map_to_curve_sswu(ux)
-            X, Y = iso(px, py)
-            if Y.square() != X.square() * X + B2:
-                ok = False
-                break
-        if ok:
-            return iso
-    raise RuntimeError("3-isogeny derivation failed")
+def _poly_eval(coeffs, x: Fq2) -> Fq2:
+    acc = Fq2.zero()
+    for c in reversed(coeffs):
+        acc = acc * x + c
+    return acc
 
 
-_ISO = _derive_isogeny()
+def iso_map_g2(x: Fq2, y: Fq2) -> Tuple[Fq2, Fq2]:
+    """Evaluate the E.3 rational map at an affine E' point."""
+    x_num = _poly_eval(ISO_XNUM, x)
+    x_den = _poly_eval(ISO_XDEN, x)
+    y_num = _poly_eval(ISO_YNUM, x)
+    y_den = _poly_eval(ISO_YDEN, x)
+    return x_num * x_den.inv(), y * y_num * y_den.inv()
+
+
 
 
 # ---------------------------------------------------------------------------
@@ -326,14 +197,47 @@ def clear_cofactor(pt: G2Point) -> G2Point:
 
 def hash_to_g2(msg: bytes, dst: bytes = DST_G2) -> G2Point:
     u0, u1 = hash_to_field_fq2(msg, 2, dst)
-    q0 = _ISO(*map_to_curve_sswu(u0))
-    q1 = _ISO(*map_to_curve_sswu(u1))
+    q0 = iso_map_g2(*map_to_curve_sswu(u0))
+    q1 = iso_map_g2(*map_to_curve_sswu(u1))
     p0 = G2Point(q0[0], q0[1])
     p1 = G2Point(q1[0], q1[1])
     return clear_cofactor(p0 + p1)
 
 
-# one-time self-check: hashed points land in the r-torsion subgroup
+# ---------------------------------------------------------------------------
+# one-time import self-checks
+# ---------------------------------------------------------------------------
+
+def _eprime_add(p1, p2):
+    """Generic affine short-Weierstrass addition on E' (for verification)."""
+    (x1, y1), (x2, y2) = p1, p2
+    if x1 == x2 and y1 == y2:
+        lam = (x1.square().mul_scalar(3) + A_PRIME) * (y1 + y1).inv()
+    else:
+        lam = (y2 - y1) * (x2 - x1).inv()
+    x3 = lam.square() - x1 - x2
+    return x3, lam * (x1 - x3) - y1
+
+
+def _verify_iso():
+    # 1. mapped SSWU points are on E2 (y² = x³ + B2)
+    pts = []
+    for tag in (b"iso-check-0", b"iso-check-1", b"iso-check-2"):
+        u = hash_to_field_fq2(tag, 1)[0]
+        xp, yp = map_to_curve_sswu(u)
+        X, Y = iso_map_g2(xp, yp)
+        assert Y.square() == X.square() * X + B2, "E.3 map image must lie on E2"
+        pts.append(((xp, yp), G2Point(X, Y)))
+    # 2. homomorphism: iso(P ⊕' Q) == iso(P) + iso(Q) on E2
+    (p_aff, p_img), (q_aff, q_img) = pts[0], pts[1]
+    s_aff = _eprime_add(p_aff, q_aff)
+    Xs, Ys = iso_map_g2(*s_aff)
+    assert G2Point(Xs, Ys) == p_img + q_img, "E.3 map must be a homomorphism"
+
+
+_verify_iso()
+
+# hashed points land in the r-torsion subgroup
 _probe = hash_to_g2(b"subgroup-probe")
 assert _probe.mult(R_ORDER).infinity, "hash_to_g2 must land in G2"
 assert not _probe.infinity

@@ -30,9 +30,12 @@ _NEG_B_OVER_A = T.f2_const(-(_oracle.B_PRIME) * _oracle.A_PRIME.inv())
 _B_OVER_ZA = T.f2_const(
     _oracle.B_PRIME * (_oracle.Z_SSWU * _oracle.A_PRIME).inv())
 
-# 3-isogeny rational-map constants (derived by the oracle at import)
-_ISO_X0, _ISO_UP, _ISO_VP, _ISO_S2, _ISO_S3 = \
-    tuple(T.f2_const(c) for c in _oracle.ISO_CONSTANTS)
+# 3-isogeny rational-map coefficient tables (RFC 9380 Appendix E.3,
+# shared with the oracle), low degree first
+_XNUM = tuple(T.f2_const(c) for c in _oracle.ISO_XNUM)
+_XDEN = tuple(T.f2_const(c) for c in _oracle.ISO_XDEN)
+_YNUM = tuple(T.f2_const(c) for c in _oracle.ISO_YNUM)
+_YDEN = tuple(T.f2_const(c) for c in _oracle.ISO_YDEN)
 
 # psi endomorphism constants
 _PSI_CX = T.f2_const(_oracle._PSI_CX)
@@ -78,17 +81,23 @@ def sswu_map(u):
 
 
 def iso_map(x, y):
-    """3-isogeny E' -> E2 via the derived Velu rational map (affine)."""
-    d = T.f2_sub(x, _bc(_ISO_X0, x))
-    dinv = T.f2_inv(d)
-    dinv2 = T.f2_sqr(dinv)
-    up = _bc(_ISO_UP, x)
-    vp = _bc(_ISO_VP, x)
-    X = T.f2_add(T.f2_add(x, T.f2_mul(vp, dinv)), T.f2_mul(up, dinv2))
-    two_up = T.f2_add(up, up)
-    Y = T.f2_mul(y, T.f2_sub(T.f2_sub(T.f2_one_like(x), T.f2_mul(vp, dinv2)),
-                             T.f2_mul(two_up, T.f2_mul(dinv2, dinv))))
-    return T.f2_mul(X, _bc(_ISO_S2, x)), T.f2_mul(Y, _bc(_ISO_S3, x))
+    """3-isogeny E' -> E2: the RFC 9380 E.3 rational map (affine, Horner).
+
+    One shared field inversion: inv(x_den*y_den) recovers both 1/x_den and
+    1/y_den via multiplication by the other denominator.
+    """
+    def horner(coeffs):
+        acc = _bc(coeffs[-1], x)
+        for c in reversed(coeffs[:-1]):
+            acc = T.f2_add(T.f2_mul(acc, x), _bc(c, x))
+        return acc
+
+    x_num, x_den = horner(_XNUM), horner(_XDEN)
+    y_num, y_den = horner(_YNUM), horner(_YDEN)
+    inv_both = T.f2_inv(T.f2_mul(x_den, y_den))
+    X = T.f2_mul(x_num, T.f2_mul(inv_both, y_den))
+    Y = T.f2_mul(y, T.f2_mul(y_num, T.f2_mul(inv_both, x_den)))
+    return X, Y
 
 
 def psi(p):

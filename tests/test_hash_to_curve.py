@@ -1,0 +1,88 @@
+"""RFC 9380 conformance for the hash-to-G2 ciphersuite.
+
+Vectors: RFC 9380 Appendix K.1 (expand_message_xmd, SHA-256) and
+Appendix G.10.2 (suite BLS12381G2_XMD:SHA-256_SSWU_RO_).  The reference
+relies on its Rust backends for this (``eth2spec/utils/bls.py:2``,
+py_ecc's RFC implementation); here both the python oracle and the JAX
+kernel must reproduce the IETF vectors exactly — this is what makes
+emitted signatures interoperable with real Ethereum clients.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu.ops.bls12_381 import hash_to_curve as H
+
+XMD_DST = b"QUUX-V01-CS02-with-expander-SHA256-128"
+
+# RFC 9380 K.1: (msg, len_in_bytes, uniform_bytes)
+XMD_VECTORS = [
+    (b"", 0x20,
+     "68a985b87eb6b46952128911f2a4412bbc302a9d759667f87f7a21d803f07235"),
+    (b"abc", 0x20,
+     "d8ccab23b5985ccea865c6c97b6e5b8350e794e603b4b97902f53a8a0d605615"),
+    (b"abcdef0123456789", 0x20,
+     "eff31487c770a893cfb36f912fbfcbff40d5661771ca4b2cb4eafe524333f5c1"),
+    (b"q128_" + b"q" * 128, 0x20,
+     "b23a1d2b4d97b2ef7785562a7e8bac7eed54ed6e97e29aa51bfe3f12ddad1ff9"),
+    (b"a512_" + b"a" * 512, 0x20,
+     "4623227bcc01293b8c130bf771da8c298dede7383243dc0993d2d94823958c4c"),
+]
+
+G2_DST = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+
+# RFC 9380 G.10.2: msg -> P = hash_to_curve(msg) as (x_re, x_im, y_re, y_im)
+G2_VECTORS = {
+    b"": (
+        0x0141ebfbdca40eb85b87142e130ab689c673cf60f1a3e98d69335266f30d9b8d4ac44c1038e9dcdd5393faf5c41fb78a,
+        0x05cb8437535e20ecffaef7752baddf98034139c38452458baeefab379ba13dff5bf5dd71b72418717047f5b0f37da03d,
+        0x0503921d7f6a12805e72940b963c0cf3471c7b2a524950ca195d11062ee75ec076daf2d4bc358c4b190c0c98064fdd92,
+        0x12424ac32561493f3fe3c260708a12b7c620e7be00099a974e259ddc7d1f6395c3c811cdd19f1e8dbf3e9ecfdcbab8d6,
+    ),
+    b"abc": (
+        0x02c2d18e033b960562aae3cab37a27ce00d80ccd5ba4b7fe0e7a210245129dbec7780ccc7954725f4168aff2787776e6,
+        0x139cddbccdc5e91b9623efd38c49f81a6f83f175e80b06fc374de9eb4b41dfe4ca3a230ed250fbe3a2acf73a41177fd8,
+        0x1787327b68159716a37440985269cf584bcb1e621d3a7202be6ea05c4cfe244aeb197642555a0645fb87bf7466b2ba48,
+        0x00aa65dae3c8d732d10ecd2c50f8a1baf3001578f71c694e03866e9f3d49ac1e1ce70dd94a733534f106d4cec0eddd16,
+    ),
+}
+
+
+def test_expand_message_xmd_rfc_vectors():
+    for msg, n, expect in XMD_VECTORS:
+        assert H.expand_message_xmd(msg, XMD_DST, n).hex() == expect, msg
+
+
+def test_hash_to_g2_rfc_vectors_oracle():
+    for msg, (xr, xi, yr, yi) in G2_VECTORS.items():
+        pt = H.hash_to_g2(msg, G2_DST)
+        assert (pt.x.a.n, pt.x.b.n, pt.y.a.n, pt.y.b.n) == (xr, xi, yr, yi), msg
+
+
+def test_hash_to_g2_rfc_vectors_jax_kernel():
+    """The batched device kernel must agree with the IETF vectors too."""
+    from consensus_specs_tpu.ops.jax_bls import htc as HTC
+    from consensus_specs_tpu.ops.jax_bls import points as PT
+    import jax
+
+    msgs = list(G2_VECTORS)
+    out = HTC.hash_to_g2_batch(msgs, dst=G2_DST)
+    for i, msg in enumerate(msgs):
+        one = jax.tree_util.tree_map(lambda a: a[i:i + 1], out)
+        pt = PT.g2_unpack(one)
+        xr, xi, yr, yi = G2_VECTORS[msg]
+        assert (pt.x.a.n, pt.x.b.n, pt.y.a.n, pt.y.b.n) == (xr, xi, yr, yi), msg
+
+
+def test_iso_map_is_homomorphism():
+    """Belt and braces beyond the import-time check: fresh sample points."""
+    for tag in (b"homo-a", b"homo-b"):
+        u0, u1 = H.hash_to_field_fq2(tag, 2, G2_DST)
+        p = H.map_to_curve_sswu(u0)
+        q = H.map_to_curve_sswu(u1)
+        from consensus_specs_tpu.ops.bls12_381.curve import G2Point
+        s = H._eprime_add(p, q)
+        lhs = G2Point(*H.iso_map_g2(*s))
+        rhs = G2Point(*H.iso_map_g2(*p)) + G2Point(*H.iso_map_g2(*q))
+        assert lhs == rhs
