@@ -296,11 +296,15 @@ class AltairSpec(Phase0Spec):
     # -- block processing ----------------------------------------------------
 
     def process_block(self, state, block):
-        self.process_block_header(state, block)
-        self.process_randao(state, block.body)
-        self.process_eth1_data(state, block.body)
-        self.process_operations(state, block.body)
-        self.process_sync_aggregate(state, block.body.sync_aggregate)
+        # Same batched-signature discipline as phase0.process_block; the
+        # sync-aggregate verify (<=512 pubkeys) joins the block batch too.
+        with bls.batched_verification() as batch:
+            self.process_block_header(state, block)
+            self.process_randao(state, block.body)
+            self.process_eth1_data(state, block.body)
+            self.process_operations(state, block.body)
+            self.process_sync_aggregate(state, block.body.sync_aggregate)
+        batch.assert_valid()
 
     def process_attestation(self, state, attestation):
         """Altair rewrite: flags + immediate proposer reward."""

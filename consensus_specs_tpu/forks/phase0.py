@@ -670,11 +670,15 @@ class Phase0Spec(ForkChoiceMixin):
         block = signed_block.message
         # Process slots (including those with no blocks) since block
         self.process_slots(state, block.slot)
-        # Verify signature
-        if validate_result:
-            assert self.verify_block_signature(state, signed_block)
-        # Process block
-        self.process_block(state, block)
+        # One batched signature dispatch covers the proposer signature and
+        # the whole block body (see utils/bls.py batched_verification).
+        with bls.batched_verification() as batch:
+            # Verify signature
+            if validate_result:
+                assert self.verify_block_signature(state, signed_block)
+            # Process block
+            self.process_block(state, block)
+        batch.assert_valid()
         # Verify state root
         if validate_result:
             assert block.state_root == hash_tree_root(state)
@@ -998,10 +1002,16 @@ class Phase0Spec(ForkChoiceMixin):
     # ======================================================================
 
     def process_block(self, state, block) -> None:
-        self.process_block_header(state, block)
-        self.process_randao(state, block.body)
-        self.process_eth1_data(state, block.body)
-        self.process_operations(state, block.body)
+        # Batch the block's assert-style signature checks (randao +
+        # slashings + up to MAX_ATTESTATIONS aggregates + exits) into one
+        # device dispatch — the TPU-native replacement for the reference's
+        # serial per-operation FFI loop (beacon-chain.md:1757-1774).
+        with bls.batched_verification() as batch:
+            self.process_block_header(state, block)
+            self.process_randao(state, block.body)
+            self.process_eth1_data(state, block.body)
+            self.process_operations(state, block.body)
+        batch.assert_valid()
 
     def process_block_header(self, state, block) -> None:
         # Verify that the slots match
@@ -1161,7 +1171,10 @@ class Phase0Spec(ForkChoiceMixin):
             # Fork-agnostic domain since deposits are valid across forks
             domain = self.compute_domain(DOMAIN_DEPOSIT)
             signing_root = self.compute_signing_root(deposit_message, domain)
-            if bls.Verify(pubkey, signing_root, signature):
+            # Eager: this boolean steers state (invalid PoP skips the
+            # validator, it does NOT invalidate the block) so it cannot
+            # join the deferred block batch.
+            if bls.VerifyEager(pubkey, signing_root, signature):
                 self.add_validator_to_registry(
                     state, pubkey, withdrawal_credentials, amount)
         else:

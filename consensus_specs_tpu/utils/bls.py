@@ -13,6 +13,7 @@ plus the test kill-switch ``bls_active`` with STUB constants
 (``bls.py:49-57,93-104``): when inactive, Sign returns a stub and verifies
 trivially pass — used by the harness's @never_bls/@always_bls decorators.
 """
+from contextlib import contextmanager
 from typing import Sequence
 
 from consensus_specs_tpu.ops.bls12_381 import ciphersuite as _py_backend
@@ -60,6 +61,72 @@ def backend_name() -> str:
     return _backend_name
 
 
+# ---------------------------------------------------------------------------
+# Deferred batch verification — the TPU-native block path.
+#
+# The reference verifies a block's signatures one FFI call at a time inside
+# the serial ``for_ops`` loop (``specs/phase0/beacon-chain.md:1757-1774``).
+# Here ``process_block`` opens a batch context; every assert-style
+# ``Verify``/``FastAggregateVerify`` inside it enqueues its (pubkeys, msg,
+# sig) triple and optimistically returns True, and the block flushes the
+# whole batch as ONE device dispatch.  Any invalid signature then raises
+# AssertionError, which keeps exception-as-invalidity semantics: a block
+# is atomically valid or invalid, and partially-mutated state is discarded
+# by every caller on failure (reference ``test/context.py:299-310``,
+# ``fork-choice.md`` on_block state copy).
+#
+# Only *assert-style* verifications may be deferred.  Conditional ones
+# (deposit proofs of possession, where the boolean steers state) must use
+# the eager paths below.
+# ---------------------------------------------------------------------------
+
+class DeferredBatch:
+    """Signature-verification triples collected under one block."""
+
+    def __init__(self):
+        self.items = []
+
+    def add(self, pubkeys, message, signature):
+        self.items.append(([bytes(pk) for pk in pubkeys],
+                           bytes(message), bytes(signature)))
+
+    def flush(self) -> bool:
+        items, self.items = self.items, []
+        if not items:
+            return True
+        if _backend_name == "jax":
+            from consensus_specs_tpu.ops import bls_jax
+            results = bls_jax.verify_aggregates_batch(items)
+        else:
+            results = [_backend.FastAggregateVerify(pks, msg, sig)
+                       for pks, msg, sig in items]
+        return all(results)
+
+    def assert_valid(self):
+        assert self.flush(), "batched signature verification failed"
+
+
+_batch_stack = []
+
+
+@contextmanager
+def batched_verification():
+    """Defer assert-style signature checks to one batched dispatch.
+
+    Re-entrant: a nested context joins the enclosing batch so a whole
+    ``state_transition`` (block signature + block body) flushes once.
+    """
+    if _batch_stack:
+        yield _batch_stack[-1]
+        return
+    batch = DeferredBatch()
+    _batch_stack.append(batch)
+    try:
+        yield batch
+    finally:
+        _batch_stack.pop()
+
+
 def only_with_bls(alt_return=None):
     """Decorator: skip the wrapped check when bls is disabled."""
     def decorator(fn):
@@ -73,6 +140,17 @@ def only_with_bls(alt_return=None):
 
 @only_with_bls(alt_return=True)
 def Verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
+    if _batch_stack:
+        _batch_stack[-1].add([pk], msg, sig)
+        return True
+    return _backend.Verify(bytes(pk), bytes(msg), bytes(sig))
+
+
+@only_with_bls(alt_return=True)
+def VerifyEager(pk: bytes, msg: bytes, sig: bytes) -> bool:
+    """Immediate verification even inside a batch context — for call sites
+    where the boolean result steers state (deposit proof of possession,
+    ``specs/phase0/beacon-chain.md:1877``) rather than block validity."""
     return _backend.Verify(bytes(pk), bytes(msg), bytes(sig))
 
 
@@ -83,6 +161,9 @@ def AggregateVerify(pks: Sequence[bytes], msgs: Sequence[bytes], sig: bytes) -> 
 
 @only_with_bls(alt_return=True)
 def FastAggregateVerify(pks: Sequence[bytes], msg: bytes, sig: bytes) -> bool:
+    if _batch_stack:
+        _batch_stack[-1].add(pks, msg, sig)
+        return True
     return _backend.FastAggregateVerify([bytes(p) for p in pks], bytes(msg), bytes(sig))
 
 

@@ -3,7 +3,7 @@
 Reference: ``test/phase0/block_processing/test_process_attestation.py``.
 """
 from consensus_specs_tpu.test_infra.context import (
-    spec_state_test, with_all_phases, always_bls, never_bls,
+    spec_state_test, with_all_phases, with_phases, always_bls, never_bls,
 )
 from consensus_specs_tpu.test_infra.attestations import (
     get_valid_attestation, run_attestation_processing, sign_attestation,
@@ -50,9 +50,11 @@ def test_invalid_before_inclusion_delay(spec, state):
     yield from run_attestation_processing(spec, state, attestation, valid=False)
 
 
-@with_all_phases
+@with_phases(["phase0", "altair", "bellatrix", "capella"])
 @spec_state_test
 def test_invalid_after_epoch_slots(spec, state):
+    # deneb (EIP-7045) removes the upper inclusion bound — see
+    # tests/deneb/block_processing test_attestation_included_after_one_epoch
     attestation = get_valid_attestation(spec, state, signed=True)
     # increment past latest inclusion slot
     next_slots(spec, state, spec.SLOTS_PER_EPOCH + 1)
