@@ -311,6 +311,12 @@ class ForkChoiceMixin:
 
     # -- handlers -----------------------------------------------------------
 
+    def _on_block_merge_check(self, pre_state, block) -> None:
+        """Pre-merge forks: nothing to validate (overridden in bellatrix)."""
+
+    def _on_block_data_availability_check(self, block) -> None:
+        """Pre-blob forks: nothing to check (overridden in deneb)."""
+
     def on_tick_per_slot(self, store, time) -> None:
         previous_slot = self.get_current_slot(store)
         store.time = int(time)
@@ -344,9 +350,17 @@ class ForkChoiceMixin:
             store, block.parent_root, store.finalized_checkpoint.epoch)
         assert bytes(store.finalized_checkpoint.root) == bytes(finalized_block)
 
+        # deneb+: blob data-availability check (deneb/fork-choice.md:70);
+        # no-op pre-deneb
+        self._on_block_data_availability_check(block)
+
         state = pre_state
         block_root = hash_tree_root(block)
         self.state_transition(state, signed_block, True)
+        # bellatrix+: merge-transition validation hook
+        # (specs/bellatrix/fork-choice.md:266); no-op pre-merge
+        self._on_block_merge_check(store.block_states[bytes(block.parent_root)],
+                                   block)
         store.blocks[block_root] = block.copy()
         store.block_states[block_root] = state
 

@@ -74,6 +74,11 @@ def build_empty_block(spec, state, slot=None, proposer_index=None):
         # altair+: an empty sync aggregate carries the infinity signature
         block.body.sync_aggregate.sync_committee_signature = \
             spec.G2_POINT_AT_INFINITY
+    if hasattr(block.body, "execution_payload"):
+        # bellatrix+: a valid (empty) payload for the block's slot
+        from .execution_payload import build_empty_execution_payload
+        block.body.execution_payload = \
+            build_empty_execution_payload(spec, state)
     apply_randao_reveal(spec, state, block, proposer_index)
     return block
 

@@ -1,0 +1,65 @@
+"""Execution-payload builders for tests.
+
+Reference: ``test/helpers/execution_payload.py`` (build_empty_execution_payload,
+compute_el_block_hash).  Divergence: the reference fabricates a realistic
+RLP + Merkle-Patricia ``block_hash`` so vectors look like mainnet blocks;
+consensus validity never depends on it (the Noop engine accepts any hash,
+``pysetup/spec_builders/bellatrix.py:40-65``), so here the hash is a
+deterministic SSZ-derived digest instead of an RLP encoding.
+"""
+from consensus_specs_tpu.utils.hash_function import hash
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+
+def compute_el_block_hash(spec, payload):
+    """Deterministic stand-in for the execution block hash: digest of the
+    payload with its own block_hash field zeroed."""
+    snapshot = payload.copy()
+    snapshot.block_hash = spec.Hash32()
+    return spec.Hash32(hash(hash_tree_root(snapshot) + b"el-block-hash"))
+
+
+def build_empty_execution_payload(spec, state, randao_mix=None):
+    """A payload that passes process_execution_payload against ``state``
+    (already advanced to the block's slot)."""
+    latest = state.latest_execution_payload_header
+    timestamp = spec.compute_timestamp_at_slot(state, state.slot)
+    if randao_mix is None:
+        randao_mix = spec.get_randao_mix(state, spec.get_current_epoch(state))
+    payload = spec.ExecutionPayload(
+        parent_hash=latest.block_hash,
+        fee_recipient=spec.ExecutionAddress(),
+        state_root=latest.state_root,  # no EL state change for empty payload
+        receipts_root=spec.Bytes32(bytes.fromhex(
+            "56e81f171bcc55a6ff8345e692c0f86e5b48e01b996cadc001622fb5e363b421")),
+        logs_bloom=b"\x00" * spec.BYTES_PER_LOGS_BLOOM,
+        prev_randao=randao_mix,
+        block_number=latest.block_number + 1,
+        gas_limit=latest.gas_limit,
+        gas_used=0,
+        timestamp=timestamp,
+        extra_data=b"",
+        base_fee_per_gas=latest.base_fee_per_gas,
+    )
+    if hasattr(payload, "withdrawals"):
+        payload.withdrawals = spec.get_expected_withdrawals(state)
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    return payload
+
+
+def build_state_with_incomplete_transition(spec, state):
+    """State whose payload header is empty (pre-merge)."""
+    return build_state_with_execution_payload_header(
+        spec, state, spec.ExecutionPayloadHeader())
+
+
+def build_state_with_complete_transition(spec, state):
+    """State with a non-empty payload header (merge complete)."""
+    return build_state_with_execution_payload_header(
+        spec, state, spec.default_payload_header())
+
+
+def build_state_with_execution_payload_header(spec, state, header):
+    pre_state = state.copy()
+    pre_state.latest_execution_payload_header = header
+    return pre_state

@@ -1,0 +1,125 @@
+"""process_execution_payload operation tests.
+
+Reference model: ``test/bellatrix/block_processing/test_process_execution_payload.py``
+against ``specs/bellatrix/beacon-chain.md:384``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, expect_assertion_error,
+)
+from consensus_specs_tpu.test_infra.execution_payload import (
+    build_empty_execution_payload, compute_el_block_hash,
+    build_state_with_incomplete_transition,
+    build_state_with_complete_transition,
+)
+
+EXECUTION_FORKS = ["bellatrix", "capella", "deneb"]
+
+
+def run_execution_payload_processing(spec, state, body_payload, valid=True,
+                                     execution_valid=True):
+    """Emit pre/body/post around process_execution_payload; absent post on
+    invalid (reference operations vector format)."""
+    body = spec.BeaconBlockBody(execution_payload=body_payload)
+
+    yield "pre", state
+    yield "execution", {"execution_valid": execution_valid}
+    yield "body", body
+
+    class TestEngine(spec.NoopExecutionEngine):
+        def verify_and_notify_new_payload(self, new_payload_request) -> bool:
+            return execution_valid
+
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_execution_payload(state, body, TestEngine()))
+        yield "post", None
+        return
+
+    prev_header = state.latest_execution_payload_header.copy()
+    spec.process_execution_payload(state, body, TestEngine())
+    yield "post", state
+
+    assert state.latest_execution_payload_header.block_hash == \
+        body_payload.block_hash
+    assert state.latest_execution_payload_header != prev_header
+
+
+@with_phases(EXECUTION_FORKS)
+@spec_state_test
+def test_success_regular_payload(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_success_first_payload(spec, state):
+    """Merge-transition block: empty pre header, any parent hash allowed."""
+    state = build_state_with_incomplete_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.parent_hash = b"\x55" * 32
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@with_phases(EXECUTION_FORKS)
+@spec_state_test
+def test_invalid_bad_parent_hash(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.parent_hash = b"\x55" * 32
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload,
+                                                valid=False)
+
+
+@with_phases(EXECUTION_FORKS)
+@spec_state_test
+def test_invalid_bad_prev_randao(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    payload.prev_randao = b"\x42" * 32
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload,
+                                                valid=False)
+
+
+@with_phases(EXECUTION_FORKS)
+@spec_state_test
+def test_invalid_future_timestamp(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    payload.timestamp = payload.timestamp + 1
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload,
+                                                valid=False)
+
+
+@with_phases(EXECUTION_FORKS)
+@spec_state_test
+def test_invalid_execution_engine_rejects(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_execution_payload_processing(spec, state, payload,
+                                                valid=False,
+                                                execution_valid=False)
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_pre_merge_empty_payload_skipped(spec, state):
+    """Before the merge an all-default payload leaves execution disabled."""
+    state = build_state_with_incomplete_transition(spec, state)
+    body = spec.BeaconBlockBody()
+    assert not spec.is_execution_enabled(state, body)
+    assert not spec.is_merge_transition_complete(state)
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_merge_transition_predicates(spec, state):
+    pre = build_state_with_incomplete_transition(spec, state)
+    post = build_state_with_complete_transition(spec, state)
+    payload = build_empty_execution_payload(spec, post)
+    body = spec.BeaconBlockBody(execution_payload=payload)
+    assert spec.is_merge_transition_block(pre, body)
+    assert spec.is_execution_enabled(pre, body)
+    assert spec.is_merge_transition_complete(post)
+    assert not spec.is_merge_transition_block(post, body)

@@ -1,0 +1,50 @@
+"""upgrade_to_bellatrix fork tests (``specs/bellatrix/fork.md:69``)."""
+from consensus_specs_tpu.forks import build_spec
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, never_bls,
+)
+from consensus_specs_tpu.test_infra.block import next_epoch
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+
+def run_fork_test(post_spec, pre_state):
+    yield "pre", pre_state
+    post_state = post_spec.upgrade_to_bellatrix(pre_state)
+
+    for field in ("genesis_time", "genesis_validators_root", "slot",
+                  "eth1_deposit_index", "justification_bits"):
+        assert getattr(pre_state, field) == getattr(post_state, field)
+    for field in ("block_roots", "state_roots", "historical_roots",
+                  "validators", "balances", "randao_mixes", "slashings",
+                  "previous_epoch_participation",
+                  "current_epoch_participation", "inactivity_scores",
+                  "current_sync_committee", "next_sync_committee"):
+        assert hash_tree_root(getattr(pre_state, field)) == \
+            hash_tree_root(getattr(post_state, field))
+
+    assert post_state.fork.previous_version == pre_state.fork.current_version
+    assert bytes(post_state.fork.current_version) == \
+        bytes(post_spec.config.BELLATRIX_FORK_VERSION)
+
+    # pre-merge header: all defaults
+    assert post_state.latest_execution_payload_header == \
+        post_spec.ExecutionPayloadHeader()
+    assert not post_spec.is_merge_transition_complete(post_state)
+    yield "post", post_state
+
+
+@with_phases(["altair"])
+@spec_state_test
+@never_bls
+def test_bellatrix_fork_basic(spec, state):
+    post_spec = build_spec("bellatrix", spec.preset_name)
+    yield from run_fork_test(post_spec, state)
+
+
+@with_phases(["altair"])
+@spec_state_test
+@never_bls
+def test_bellatrix_fork_next_epoch(spec, state):
+    next_epoch(spec, state)
+    post_spec = build_spec("bellatrix", spec.preset_name)
+    yield from run_fork_test(post_spec, state)

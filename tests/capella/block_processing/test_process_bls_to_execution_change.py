@@ -1,0 +1,114 @@
+"""process_bls_to_execution_change operation tests.
+
+Reference model:
+``test/capella/block_processing/test_process_bls_to_execution_change.py``
+against ``specs/capella/beacon-chain.md:466``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, always_bls, expect_assertion_error,
+)
+from consensus_specs_tpu.test_infra.keys import pubkeys, privkeys, pubkey_to_privkey
+from consensus_specs_tpu.utils import bls
+from consensus_specs_tpu.utils.hash_function import hash
+
+CHANGE_FORKS = ["capella", "deneb"]
+
+
+def get_signed_address_change(spec, state, validator_index=0,
+                              withdrawal_pubkey=None, to_execution_address=None,
+                              bad_signature=False):
+    if withdrawal_pubkey is None:
+        # mock genesis uses pubkey as withdrawal key (test_infra/genesis.py)
+        withdrawal_pubkey = pubkeys[validator_index]
+    if to_execution_address is None:
+        to_execution_address = b"\x42" * 20
+    privkey = pubkey_to_privkey(bytes(withdrawal_pubkey))
+    change = spec.BLSToExecutionChange(
+        validator_index=validator_index,
+        from_bls_pubkey=withdrawal_pubkey,
+        to_execution_address=to_execution_address,
+    )
+    domain = spec.compute_domain(
+        spec.DOMAIN_BLS_TO_EXECUTION_CHANGE,
+        genesis_validators_root=state.genesis_validators_root)
+    signing_root = spec.compute_signing_root(change, domain)
+    signature = bls.Sign(privkey, signing_root)
+    if bad_signature:
+        signature = bls.Sign(privkey, spec.Root(b"\x99" * 32))
+    return spec.SignedBLSToExecutionChange(message=change, signature=signature)
+
+
+def run_bls_to_execution_change_processing(spec, state, signed_change,
+                                           valid=True):
+    yield "pre", state
+    yield "address_change", signed_change
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_bls_to_execution_change(state, signed_change))
+        yield "post", None
+        return
+    spec.process_bls_to_execution_change(state, signed_change)
+    yield "post", state
+
+    validator = state.validators[signed_change.message.validator_index]
+    assert bytes(validator.withdrawal_credentials[:1]) == \
+        spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX
+    assert bytes(validator.withdrawal_credentials[12:]) == \
+        bytes(signed_change.message.to_execution_address)
+
+
+@with_phases(CHANGE_FORKS)
+@spec_state_test
+def test_success(spec, state):
+    signed_change = get_signed_address_change(spec, state)
+    yield from run_bls_to_execution_change_processing(spec, state, signed_change)
+
+
+@with_phases(CHANGE_FORKS)
+@spec_state_test
+def test_success_many_validators(spec, state):
+    for index in (1, 3, 5):
+        signed_change = get_signed_address_change(spec, state,
+                                                  validator_index=index)
+        spec.process_bls_to_execution_change(state, signed_change)
+    signed_change = get_signed_address_change(spec, state, validator_index=7)
+    yield from run_bls_to_execution_change_processing(spec, state, signed_change)
+
+
+@with_phases(CHANGE_FORKS)
+@spec_state_test
+def test_invalid_out_of_range_validator_index(spec, state):
+    signed_change = get_signed_address_change(spec, state)
+    signed_change.message.validator_index = len(state.validators)
+    yield from run_bls_to_execution_change_processing(spec, state,
+                                                      signed_change, valid=False)
+
+
+@with_phases(CHANGE_FORKS)
+@spec_state_test
+def test_invalid_already_eth1_credentials(spec, state):
+    signed_change = get_signed_address_change(spec, state)
+    # flip the validator to eth1 credentials first
+    spec.process_bls_to_execution_change(state, signed_change)
+    second = get_signed_address_change(spec, state)
+    yield from run_bls_to_execution_change_processing(spec, state, second,
+                                                      valid=False)
+
+
+@with_phases(CHANGE_FORKS)
+@spec_state_test
+def test_invalid_withdrawal_pubkey_mismatch(spec, state):
+    # signed by (and claiming) a different BLS withdrawal key
+    signed_change = get_signed_address_change(
+        spec, state, validator_index=0, withdrawal_pubkey=pubkeys[1])
+    yield from run_bls_to_execution_change_processing(spec, state,
+                                                      signed_change, valid=False)
+
+
+@with_phases(CHANGE_FORKS)
+@spec_state_test
+@always_bls
+def test_invalid_signature(spec, state):
+    signed_change = get_signed_address_change(spec, state, bad_signature=True)
+    yield from run_bls_to_execution_change_processing(spec, state,
+                                                      signed_change, valid=False)

@@ -1,0 +1,138 @@
+"""process_withdrawals operation tests.
+
+Reference model: ``test/capella/block_processing/test_process_withdrawals.py``
+against ``specs/capella/beacon-chain.md:346-403``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, expect_assertion_error,
+)
+from consensus_specs_tpu.test_infra.execution_payload import (
+    build_empty_execution_payload, compute_el_block_hash,
+)
+
+WITHDRAWAL_FORKS = ["capella", "deneb"]
+
+
+def set_eth1_credentials(spec, state, index):
+    validator = state.validators[index]
+    validator.withdrawal_credentials = (
+        spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11
+        + bytes([0x10 + index % 0xe0]) * 20)
+
+
+def prepare_expected_withdrawals(spec, state, num_full=0, num_partial=0):
+    """Mark validators withdrawable; returns (full_indices, partial_indices)."""
+    assert num_full + num_partial <= len(state.validators)
+    full = list(range(num_full))
+    partial = list(range(num_full, num_full + num_partial))
+    for index in full:
+        set_eth1_credentials(spec, state, index)
+        state.validators[index].withdrawable_epoch = \
+            spec.get_current_epoch(state)
+    for index in partial:
+        set_eth1_credentials(spec, state, index)
+        state.balances[index] = spec.MAX_EFFECTIVE_BALANCE + 10**9
+    return full, partial
+
+
+def run_withdrawals_processing(spec, state, payload, valid=True):
+    pre_next_withdrawal_index = state.next_withdrawal_index
+    expected = spec.get_expected_withdrawals(state)
+
+    yield "pre", state
+    yield "execution_payload", payload
+
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_withdrawals(state, payload))
+        yield "post", None
+        return
+
+    spec.process_withdrawals(state, payload)
+    yield "post", state
+
+    if expected:
+        assert state.next_withdrawal_index == \
+            pre_next_withdrawal_index + len(expected)
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_success_no_withdrawals(spec, state):
+    assert spec.get_expected_withdrawals(state) == []
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_success_one_full_withdrawal(spec, state):
+    full, _ = prepare_expected_withdrawals(spec, state, num_full=1)
+    pre_balance = state.balances[full[0]]
+    assert pre_balance > 0
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_withdrawals_processing(spec, state, payload)
+    assert state.balances[full[0]] == 0
+    assert len(payload.withdrawals) == 1
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_success_one_partial_withdrawal(spec, state):
+    _, partial = prepare_expected_withdrawals(spec, state, num_partial=1)
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_withdrawals_processing(spec, state, payload)
+    assert state.balances[partial[0]] == spec.MAX_EFFECTIVE_BALANCE
+    assert len(payload.withdrawals) == 1
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_success_max_per_payload(spec, state):
+    prepare_expected_withdrawals(
+        spec, state, num_full=spec.MAX_WITHDRAWALS_PER_PAYLOAD + 2)
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == spec.MAX_WITHDRAWALS_PER_PAYLOAD
+    yield from run_withdrawals_processing(spec, state, payload)
+    # sweep cursor advanced past the last processed withdrawal
+    assert state.next_withdrawal_validator_index == \
+        payload.withdrawals[-1].validator_index + 1
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_invalid_withdrawal_count(spec, state):
+    prepare_expected_withdrawals(spec, state, num_full=1)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals = payload.withdrawals[:-1]  # drop the withdrawal
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_invalid_withdrawal_amount(spec, state):
+    prepare_expected_withdrawals(spec, state, num_full=1)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals[0].amount += 1
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_invalid_withdrawal_index(spec, state):
+    prepare_expected_withdrawals(spec, state, num_full=1)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals[0].index += 1
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_sweep_cursor_advances_without_withdrawals(spec, state):
+    payload = build_empty_execution_payload(spec, state)
+    pre_cursor = state.next_withdrawal_validator_index
+    yield from run_withdrawals_processing(spec, state, payload)
+    expected_cursor = (pre_cursor + min(
+        len(state.validators), spec.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)
+    ) % len(state.validators)
+    assert state.next_withdrawal_validator_index == expected_cursor
