@@ -1,0 +1,108 @@
+"""Deneb block-processing deltas: blob commitment caps, EIP-7044 exits,
+EIP-7045 attestation windows.
+
+Reference models: ``test/deneb/block_processing/test_process_execution_payload.py``,
+``test/deneb/block_processing/test_process_voluntary_exit.py``,
+``test/deneb/sanity/test_blocks.py``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, always_bls, expect_assertion_error,
+)
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block, next_epoch, next_slots,
+    state_transition_and_sign_block,
+)
+from consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+from consensus_specs_tpu.test_infra.execution_payload import (
+    build_empty_execution_payload,
+)
+from consensus_specs_tpu.test_infra.keys import privkeys
+from consensus_specs_tpu.utils import bls
+
+
+@with_phases(["deneb"])
+@spec_state_test
+def test_invalid_exceed_max_blobs_per_block(spec, state):
+    body = spec.BeaconBlockBody(
+        execution_payload=build_empty_execution_payload(spec, state))
+    body.blob_kzg_commitments = [
+        spec.G1_POINT_AT_INFINITY] * (spec.MAX_BLOBS_PER_BLOCK + 1)
+    yield "pre", state
+    expect_assertion_error(
+        lambda: spec.process_execution_payload(
+            state, body, spec.EXECUTION_ENGINE))
+    yield "post", None
+
+
+@with_phases(["deneb"])
+@spec_state_test
+def test_max_blobs_per_block_ok(spec, state):
+    body = spec.BeaconBlockBody(
+        execution_payload=build_empty_execution_payload(spec, state))
+    body.blob_kzg_commitments = [
+        spec.G1_POINT_AT_INFINITY] * spec.MAX_BLOBS_PER_BLOCK
+    yield "pre", state
+    spec.process_execution_payload(state, body, spec.EXECUTION_ENGINE)
+    yield "post", state
+
+
+@with_phases(["deneb"])
+@spec_state_test
+def test_versioned_hash_prefix(spec, state):
+    vh = spec.kzg_commitment_to_versioned_hash(spec.G1_POINT_AT_INFINITY)
+    assert bytes(vh[:1]) == spec.VERSIONED_HASH_VERSION_KZG
+    assert len(vh) == 32
+
+
+@with_phases(["deneb"])
+@spec_state_test
+@always_bls
+def test_voluntary_exit_uses_capella_domain(spec, state):
+    """EIP-7044: exits are signed over CAPELLA_FORK_VERSION regardless of
+    the current fork (beacon-chain.md:411)."""
+    current_epoch = spec.get_current_epoch(state)
+    validator_index = spec.get_active_validator_indices(
+        state, current_epoch)[0]
+    # make the validator old enough
+    state.validators[validator_index].activation_epoch = 0
+    state.slot = spec.SLOTS_PER_EPOCH * (spec.config.SHARD_COMMITTEE_PERIOD + 1)
+
+    exit_msg = spec.VoluntaryExit(epoch=0, validator_index=validator_index)
+    domain = spec.compute_domain(spec.DOMAIN_VOLUNTARY_EXIT,
+                                 spec.config.CAPELLA_FORK_VERSION,
+                                 state.genesis_validators_root)
+    signing_root = spec.compute_signing_root(exit_msg, domain)
+    signed = spec.SignedVoluntaryExit(
+        message=exit_msg,
+        signature=bls.Sign(privkeys[validator_index], signing_root))
+    spec.process_voluntary_exit(state, signed)
+    assert state.validators[validator_index].exit_epoch < spec.FAR_FUTURE_EPOCH
+
+    # the *current* fork domain must NOT validate
+    state2 = state.copy()
+    state2.validators[validator_index].exit_epoch = spec.FAR_FUTURE_EPOCH
+    bad_domain = spec.get_domain(state2, spec.DOMAIN_VOLUNTARY_EXIT, 0)
+    bad_root = spec.compute_signing_root(exit_msg, bad_domain)
+    bad_signed = spec.SignedVoluntaryExit(
+        message=exit_msg,
+        signature=bls.Sign(privkeys[validator_index], bad_root))
+    expect_assertion_error(
+        lambda: spec.process_voluntary_exit(state2, bad_signed))
+
+
+@with_phases(["deneb"])
+@spec_state_test
+def test_attestation_included_after_one_epoch_eip7045(spec, state):
+    """Pre-deneb this inclusion (delay > SLOTS_PER_EPOCH) is invalid;
+    deneb accepts it and still grants the target flag."""
+    next_epoch(spec, state)
+    attestation = get_valid_attestation(spec, state, index=0, signed=True)
+    # advance well past the old upper bound
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH + 3)
+    yield "pre", state
+    block = build_empty_block(spec, state, state.slot + 1)
+    block.body.attestations.append(attestation)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert any(f != 0 for f in state.previous_epoch_participation)

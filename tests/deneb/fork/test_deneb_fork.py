@@ -1,0 +1,53 @@
+"""upgrade_to_deneb fork tests (``specs/deneb/fork.md:77``)."""
+from consensus_specs_tpu.forks import build_spec
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, never_bls,
+)
+from consensus_specs_tpu.test_infra.block import next_epoch
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+
+def run_fork_test(post_spec, pre_state):
+    yield "pre", pre_state
+    post_state = post_spec.upgrade_to_deneb(pre_state)
+
+    for field in ("genesis_time", "genesis_validators_root", "slot",
+                  "eth1_deposit_index", "justification_bits",
+                  "next_withdrawal_index", "next_withdrawal_validator_index"):
+        assert getattr(pre_state, field) == getattr(post_state, field)
+    for field in ("block_roots", "state_roots", "historical_roots",
+                  "validators", "balances", "randao_mixes", "slashings",
+                  "previous_epoch_participation",
+                  "current_epoch_participation", "inactivity_scores",
+                  "current_sync_committee", "next_sync_committee",
+                  "historical_summaries"):
+        assert hash_tree_root(getattr(pre_state, field)) == \
+            hash_tree_root(getattr(post_state, field))
+
+    assert post_state.fork.previous_version == pre_state.fork.current_version
+    assert bytes(post_state.fork.current_version) == \
+        bytes(post_spec.config.DENEB_FORK_VERSION)
+
+    post_header = post_state.latest_execution_payload_header
+    assert post_header.block_hash == \
+        pre_state.latest_execution_payload_header.block_hash
+    assert post_header.blob_gas_used == 0
+    assert post_header.excess_blob_gas == 0
+    yield "post", post_state
+
+
+@with_phases(["capella"])
+@spec_state_test
+@never_bls
+def test_deneb_fork_basic(spec, state):
+    post_spec = build_spec("deneb", spec.preset_name)
+    yield from run_fork_test(post_spec, state)
+
+
+@with_phases(["capella"])
+@spec_state_test
+@never_bls
+def test_deneb_fork_next_epoch(spec, state):
+    next_epoch(spec, state)
+    post_spec = build_spec("deneb", spec.preset_name)
+    yield from run_fork_test(post_spec, state)

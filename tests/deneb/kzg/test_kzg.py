@@ -1,0 +1,164 @@
+"""KZG polynomial-commitment library tests.
+
+Reference model: ``tests/generators/kzg_4844/main.py`` cases against
+``specs/deneb/polynomial-commitments.md``.  The mathematical identity
+tests (constant/linear blobs) pin the trusted-setup loading, bit-reversal
+permutation and MSM independently of the proof machinery.
+"""
+import pytest
+
+from consensus_specs_tpu.ops import kzg as K
+from consensus_specs_tpu.ops.bls12_381.curve import (
+    G1_GENERATOR, g1_from_compressed)
+
+SETUP = K.trusted_setup("minimal")
+WIDTH = SETUP.FIELD_ELEMENTS_PER_BLOB
+BLS_MODULUS = K.BLS_MODULUS
+
+
+def _fe(x):
+    return (x % BLS_MODULUS).to_bytes(32, "big")
+
+
+def _blob_from_values(values):
+    assert len(values) == WIDTH
+    return b"".join(_fe(v) for v in values)
+
+
+def _random_blob(seed):
+    rng = __import__("random").Random(seed)
+    return _blob_from_values([rng.randrange(BLS_MODULUS)
+                              for _ in range(WIDTH)])
+
+
+# ---------------------------------------------------------------------------
+# structural helpers
+# ---------------------------------------------------------------------------
+
+def test_reverse_bits():
+    assert K.reverse_bits(0, 8) == 0
+    assert K.reverse_bits(1, 8) == 4
+    assert K.reverse_bits(3, 8) == 6
+    assert K.bit_reversal_permutation([0, 1, 2, 3]) == [0, 2, 1, 3]
+
+
+def test_roots_of_unity():
+    roots = K.compute_roots_of_unity(WIDTH)
+    assert len(roots) == WIDTH
+    assert roots[0] == 1
+    w = roots[1]
+    assert pow(w, WIDTH, BLS_MODULUS) == 1
+    assert pow(w, WIDTH // 2, BLS_MODULUS) == BLS_MODULUS - 1
+
+
+def test_bytes_to_bls_field_rejects_modulus():
+    with pytest.raises(AssertionError):
+        K.bytes_to_bls_field(BLS_MODULUS.to_bytes(32, "big"))
+    assert K.bytes_to_bls_field(_fe(BLS_MODULUS - 1)) == BLS_MODULUS - 1
+
+
+def test_validate_kzg_g1():
+    K.validate_kzg_g1(K.G1_POINT_AT_INFINITY)       # infinity allowed
+    K.validate_kzg_g1(G1_GENERATOR.to_compressed())  # generator fine
+    with pytest.raises(Exception):
+        K.validate_kzg_g1(b"\x12" * 48)              # garbage rejected
+
+
+def test_g1_lincomb_small():
+    """MSM vs naive scalar arithmetic on tiny inputs."""
+    pts = [G1_GENERATOR.mult(3).to_compressed(),
+           G1_GENERATOR.mult(5).to_compressed()]
+    out = K.g1_lincomb(pts, [7, 11])
+    assert out == G1_GENERATOR.mult(3 * 7 + 5 * 11).to_compressed()
+    # empty MSM = point at infinity
+    assert K.g1_lincomb([], []) == K.G1_POINT_AT_INFINITY
+
+
+# ---------------------------------------------------------------------------
+# commitment identities (validate setup + brp + MSM end to end)
+# ---------------------------------------------------------------------------
+
+def test_constant_blob_commitment_is_c_times_g():
+    """sum_i L_i(tau) = 1 so commit(c,...,c) == [c]G."""
+    c = 0x1234
+    blob = _blob_from_values([c] * WIDTH)
+    commitment = K.blob_to_kzg_commitment(blob, SETUP)
+    assert commitment == G1_GENERATOR.mult(c).to_compressed()
+
+
+def test_linear_blob_commitment_matches_monomial_setup():
+    """p(X) = a*X + b evaluated on the brp domain must commit to
+    a*[tau]G + b*G (checks Lagrange<->monomial consistency of the setup)."""
+    a, b = 3, 10
+    roots_brp = K.bit_reversal_permutation(
+        list(K.compute_roots_of_unity(WIDTH)))
+    blob = _blob_from_values([(a * w + b) % BLS_MODULUS for w in roots_brp])
+    commitment = K.blob_to_kzg_commitment(blob, SETUP)
+    tau_g = g1_from_compressed(SETUP.KZG_SETUP_G1_MONOMIAL[1])
+    expect = (tau_g.mult(a) + G1_GENERATOR.mult(b)).to_compressed()
+    assert commitment == expect
+
+
+def test_evaluate_polynomial_in_evaluation_form():
+    """Barycentric evaluation of a linear polynomial is exact everywhere."""
+    a, b = 5, 9
+    roots_brp = K.bit_reversal_permutation(
+        list(K.compute_roots_of_unity(WIDTH)))
+    poly = [(a * w + b) % BLS_MODULUS for w in roots_brp]
+    # in-domain: indexing shortcut
+    assert K.evaluate_polynomial_in_evaluation_form(
+        poly, roots_brp[5], WIDTH) == poly[5]
+    # out-of-domain: barycentric formula
+    z = 98765
+    assert K.evaluate_polynomial_in_evaluation_form(
+        poly, z, WIDTH) == (a * z + b) % BLS_MODULUS
+
+
+# ---------------------------------------------------------------------------
+# proof round trips
+# ---------------------------------------------------------------------------
+
+def test_compute_and_verify_kzg_proof():
+    blob = _random_blob(42)
+    commitment = K.blob_to_kzg_commitment(blob, SETUP)
+    z = _fe(123456789)
+    proof, y = K.compute_kzg_proof(blob, z, SETUP)
+    assert K.verify_kzg_proof(commitment, z, y, proof, SETUP)
+    # wrong claimed y fails
+    bad_y = _fe(K.bytes_to_bls_field(y) + 1)
+    assert not K.verify_kzg_proof(commitment, z, bad_y, proof, SETUP)
+
+
+def test_compute_kzg_proof_in_domain_point():
+    """z on a root of unity exercises the special-case quotient."""
+    blob = _random_blob(7)
+    commitment = K.blob_to_kzg_commitment(blob, SETUP)
+    roots_brp = K.bit_reversal_permutation(
+        list(K.compute_roots_of_unity(WIDTH)))
+    z = _fe(roots_brp[3])
+    proof, y = K.compute_kzg_proof(blob, z, SETUP)
+    # in-domain evaluation is just the blob element
+    assert K.bytes_to_bls_field(y) == K.blob_to_polynomial(blob, WIDTH)[3]
+    assert K.verify_kzg_proof(commitment, z, y, proof, SETUP)
+
+
+def test_verify_blob_kzg_proof_roundtrip():
+    blob = _random_blob(1)
+    commitment = K.blob_to_kzg_commitment(blob, SETUP)
+    proof = K.compute_blob_kzg_proof(blob, commitment, SETUP)
+    assert K.verify_blob_kzg_proof(blob, commitment, proof, SETUP)
+    assert not K.verify_blob_kzg_proof(blob, commitment,
+                                       K.G1_POINT_AT_INFINITY, SETUP)
+
+
+def test_verify_blob_kzg_proof_batch():
+    blobs = [_random_blob(i) for i in range(2)]
+    commitments = [K.blob_to_kzg_commitment(b, SETUP) for b in blobs]
+    proofs = [K.compute_blob_kzg_proof(b, c, SETUP)
+              for b, c in zip(blobs, commitments)]
+    assert K.verify_blob_kzg_proof_batch(blobs, commitments, proofs, SETUP)
+    # swapped proofs must fail
+    assert not K.verify_blob_kzg_proof_batch(
+        blobs, commitments, proofs[::-1], SETUP)
+    # empty batch verifies
+    assert K.verify_blob_kzg_proof_batch([], [], [], SETUP)
