@@ -1,0 +1,488 @@
+"""Altair light-client sync protocol.
+
+Behavioral sources: ``specs/altair/light-client/sync-protocol.md``
+(containers :85-170, ``is_better_update`` :196,
+``initialize_light_client_store`` :287, ``validate_light_client_update``
+:322, ``apply_light_client_update`` :406, force update :426,
+``process_light_client_update`` :444, finality/optimistic wrappers
+:495-535) and ``specs/altair/light-client/full-node.md`` (the
+``create_light_client_*`` derivation helpers).  Mixed into
+:class:`AltairSpec`; proofs come from the generic SSZ gindex machinery
+(``utils/ssz/proofs.py``) instead of a hand-maintained backing tree.
+"""
+from dataclasses import dataclass
+from typing import Optional
+
+from consensus_specs_tpu.utils.ssz import (
+    hash_tree_root, uint64, Bytes32, Vector, Container,
+    get_generalized_index, compute_merkle_proof,
+)
+from consensus_specs_tpu.utils import bls
+from .base_types import Slot, Root, DOMAIN_SYNC_COMMITTEE
+
+
+def floorlog2(x: int) -> int:
+    return int(x).bit_length() - 1
+
+
+class LightClientMixin:
+    """Light-client protocol methods for altair+ spec classes."""
+
+    MIN_SYNC_COMMITTEE_PARTICIPANTS = 1
+    floorlog2 = staticmethod(floorlog2)
+
+    # -- type construction (sync-protocol.md:60-170) -------------------------
+
+    def _build_light_client_types(self):
+        S = self
+        self.FINALIZED_ROOT_GINDEX = get_generalized_index(
+            self.BeaconState, "finalized_checkpoint", "root")
+        self.CURRENT_SYNC_COMMITTEE_GINDEX = get_generalized_index(
+            self.BeaconState, "current_sync_committee")
+        self.NEXT_SYNC_COMMITTEE_GINDEX = get_generalized_index(
+            self.BeaconState, "next_sync_committee")
+        self.UPDATE_TIMEOUT = \
+            self.SLOTS_PER_EPOCH * self.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+
+        FinalityBranch = Vector[Bytes32, floorlog2(self.FINALIZED_ROOT_GINDEX)]
+        CurrentSyncCommitteeBranch = Vector[
+            Bytes32, floorlog2(self.CURRENT_SYNC_COMMITTEE_GINDEX)]
+        NextSyncCommitteeBranch = Vector[
+            Bytes32, floorlog2(self.NEXT_SYNC_COMMITTEE_GINDEX)]
+        self.FinalityBranch = FinalityBranch
+        self.CurrentSyncCommitteeBranch = CurrentSyncCommitteeBranch
+        self.NextSyncCommitteeBranch = NextSyncCommitteeBranch
+
+        class LightClientHeader(Container):
+            beacon: S.BeaconBlockHeader
+
+        class LightClientBootstrap(Container):
+            header: LightClientHeader
+            current_sync_committee: S.SyncCommittee
+            current_sync_committee_branch: CurrentSyncCommitteeBranch
+
+        class LightClientUpdate(Container):
+            attested_header: LightClientHeader
+            next_sync_committee: S.SyncCommittee
+            next_sync_committee_branch: NextSyncCommitteeBranch
+            finalized_header: LightClientHeader
+            finality_branch: FinalityBranch
+            sync_aggregate: S.SyncAggregate
+            signature_slot: Slot
+
+        class LightClientFinalityUpdate(Container):
+            attested_header: LightClientHeader
+            finalized_header: LightClientHeader
+            finality_branch: FinalityBranch
+            sync_aggregate: S.SyncAggregate
+            signature_slot: Slot
+
+        class LightClientOptimisticUpdate(Container):
+            attested_header: LightClientHeader
+            sync_aggregate: S.SyncAggregate
+            signature_slot: Slot
+
+        @dataclass
+        class LightClientStore:
+            finalized_header: object
+            current_sync_committee: object
+            next_sync_committee: object
+            best_valid_update: Optional[object]
+            optimistic_header: object
+            previous_max_active_participants: int
+            current_max_active_participants: int
+
+        self.LightClientHeader = LightClientHeader
+        self.LightClientBootstrap = LightClientBootstrap
+        self.LightClientUpdate = LightClientUpdate
+        self.LightClientFinalityUpdate = LightClientFinalityUpdate
+        self.LightClientOptimisticUpdate = LightClientOptimisticUpdate
+        self.LightClientStore = LightClientStore
+
+    # -- helpers (sync-protocol.md:172-281) ----------------------------------
+
+    def is_valid_light_client_header(self, header) -> bool:
+        return True  # altair; capella+ add execution-payload validation
+
+    def is_sync_committee_update(self, update) -> bool:
+        return update.next_sync_committee_branch != \
+            self.NextSyncCommitteeBranch()
+
+    def is_finality_update(self, update) -> bool:
+        return update.finality_branch != self.FinalityBranch()
+
+    def is_better_update(self, new_update, old_update) -> bool:
+        """Update-ranking rules (sync-protocol.md:196)."""
+        max_active_participants = len(
+            new_update.sync_aggregate.sync_committee_bits)
+        new_num = sum(new_update.sync_aggregate.sync_committee_bits)
+        old_num = sum(old_update.sync_aggregate.sync_committee_bits)
+        new_super = new_num * 3 >= max_active_participants * 2
+        old_super = old_num * 3 >= max_active_participants * 2
+        if new_super != old_super:
+            return new_super > old_super
+        if not new_super and new_num != old_num:
+            return new_num > old_num
+
+        new_relevant = self.is_sync_committee_update(new_update) and (
+            self.compute_sync_committee_period_at_slot(
+                new_update.attested_header.beacon.slot)
+            == self.compute_sync_committee_period_at_slot(
+                new_update.signature_slot))
+        old_relevant = self.is_sync_committee_update(old_update) and (
+            self.compute_sync_committee_period_at_slot(
+                old_update.attested_header.beacon.slot)
+            == self.compute_sync_committee_period_at_slot(
+                old_update.signature_slot))
+        if new_relevant != old_relevant:
+            return new_relevant
+
+        new_final = self.is_finality_update(new_update)
+        old_final = self.is_finality_update(old_update)
+        if new_final != old_final:
+            return new_final
+
+        if new_final:
+            new_cf = (self.compute_sync_committee_period_at_slot(
+                new_update.finalized_header.beacon.slot)
+                == self.compute_sync_committee_period_at_slot(
+                    new_update.attested_header.beacon.slot))
+            old_cf = (self.compute_sync_committee_period_at_slot(
+                old_update.finalized_header.beacon.slot)
+                == self.compute_sync_committee_period_at_slot(
+                    old_update.attested_header.beacon.slot))
+            if new_cf != old_cf:
+                return new_cf
+
+        if new_num != old_num:
+            return new_num > old_num
+        if new_update.attested_header.beacon.slot != \
+                old_update.attested_header.beacon.slot:
+            return new_update.attested_header.beacon.slot < \
+                old_update.attested_header.beacon.slot
+        return new_update.signature_slot < old_update.signature_slot
+
+    def is_next_sync_committee_known(self, store) -> bool:
+        return store.next_sync_committee != self.SyncCommittee()
+
+    def get_safety_threshold(self, store) -> int:
+        return max(store.previous_max_active_participants,
+                   store.current_max_active_participants) // 2
+
+    def get_subtree_index(self, generalized_index: int) -> uint64:
+        return uint64(generalized_index % 2**(floorlog2(generalized_index)))
+
+    def compute_sync_committee_period(self, epoch):
+        return uint64(epoch // self.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
+
+    def compute_sync_committee_period_at_slot(self, slot):
+        return self.compute_sync_committee_period(
+            self.compute_epoch_at_slot(slot))
+
+    def compute_fork_version(self, epoch):
+        """Fork version schedule (``specs/altair/fork.md`` pattern),
+        walking the configured fork ladder newest-first."""
+        ladder = (("DENEB_FORK_EPOCH", "DENEB_FORK_VERSION"),
+                  ("CAPELLA_FORK_EPOCH", "CAPELLA_FORK_VERSION"),
+                  ("BELLATRIX_FORK_EPOCH", "BELLATRIX_FORK_VERSION"),
+                  ("ALTAIR_FORK_EPOCH", "ALTAIR_FORK_VERSION"))
+        for epoch_name, version_name in ladder:
+            fork_epoch = getattr(self.config, epoch_name, None)
+            if fork_epoch is not None and epoch >= fork_epoch:
+                return getattr(self.config, version_name)
+        return self.config.GENESIS_FORK_VERSION
+
+    # -- initialization (sync-protocol.md:287) -------------------------------
+
+    def initialize_light_client_store(self, trusted_block_root, bootstrap):
+        assert self.is_valid_light_client_header(bootstrap.header)
+        assert hash_tree_root(bootstrap.header.beacon) == trusted_block_root
+
+        assert self.is_valid_merkle_branch(
+            leaf=hash_tree_root(bootstrap.current_sync_committee),
+            branch=bootstrap.current_sync_committee_branch,
+            depth=floorlog2(self.CURRENT_SYNC_COMMITTEE_GINDEX),
+            index=self.get_subtree_index(self.CURRENT_SYNC_COMMITTEE_GINDEX),
+            root=bootstrap.header.beacon.state_root,
+        )
+        return self.LightClientStore(
+            finalized_header=bootstrap.header,
+            current_sync_committee=bootstrap.current_sync_committee,
+            next_sync_committee=self.SyncCommittee(),
+            best_valid_update=None,
+            optimistic_header=bootstrap.header,
+            previous_max_active_participants=0,
+            current_max_active_participants=0,
+        )
+
+    # -- update validation (sync-protocol.md:322) ----------------------------
+
+    def validate_light_client_update(self, store, update, current_slot,
+                                     genesis_validators_root) -> None:
+        sync_aggregate = update.sync_aggregate
+        assert sum(sync_aggregate.sync_committee_bits) >= \
+            self.MIN_SYNC_COMMITTEE_PARTICIPANTS
+
+        assert self.is_valid_light_client_header(update.attested_header)
+        update_attested_slot = update.attested_header.beacon.slot
+        update_finalized_slot = update.finalized_header.beacon.slot
+        assert current_slot >= update.signature_slot > update_attested_slot \
+            >= update_finalized_slot
+        store_period = self.compute_sync_committee_period_at_slot(
+            store.finalized_header.beacon.slot)
+        update_signature_period = self.compute_sync_committee_period_at_slot(
+            update.signature_slot)
+        if self.is_next_sync_committee_known(store):
+            assert update_signature_period in (store_period, store_period + 1)
+        else:
+            assert update_signature_period == store_period
+
+        update_attested_period = self.compute_sync_committee_period_at_slot(
+            update_attested_slot)
+        update_has_next_sync_committee = \
+            not self.is_next_sync_committee_known(store) and (
+                self.is_sync_committee_update(update)
+                and update_attested_period == store_period)
+        assert (update_attested_slot > store.finalized_header.beacon.slot
+                or update_has_next_sync_committee)
+
+        # finality branch confirms finalized_header against attested state
+        if not self.is_finality_update(update):
+            assert update.finalized_header == self.LightClientHeader()
+        else:
+            if update_finalized_slot == self.GENESIS_SLOT:
+                assert update.finalized_header == self.LightClientHeader()
+                finalized_root = Bytes32()
+            else:
+                assert self.is_valid_light_client_header(
+                    update.finalized_header)
+                finalized_root = hash_tree_root(update.finalized_header.beacon)
+            assert self.is_valid_merkle_branch(
+                leaf=finalized_root,
+                branch=update.finality_branch,
+                depth=floorlog2(self.FINALIZED_ROOT_GINDEX),
+                index=self.get_subtree_index(self.FINALIZED_ROOT_GINDEX),
+                root=update.attested_header.beacon.state_root,
+            )
+
+        # next sync committee branch
+        if not self.is_sync_committee_update(update):
+            assert update.next_sync_committee == self.SyncCommittee()
+        else:
+            if update_attested_period == store_period and \
+                    self.is_next_sync_committee_known(store):
+                assert update.next_sync_committee == store.next_sync_committee
+            assert self.is_valid_merkle_branch(
+                leaf=hash_tree_root(update.next_sync_committee),
+                branch=update.next_sync_committee_branch,
+                depth=floorlog2(self.NEXT_SYNC_COMMITTEE_GINDEX),
+                index=self.get_subtree_index(self.NEXT_SYNC_COMMITTEE_GINDEX),
+                root=update.attested_header.beacon.state_root,
+            )
+
+        # aggregate signature
+        if update_signature_period == store_period:
+            sync_committee = store.current_sync_committee
+        else:
+            sync_committee = store.next_sync_committee
+        participant_pubkeys = [
+            pubkey for (bit, pubkey) in zip(
+                sync_aggregate.sync_committee_bits, sync_committee.pubkeys)
+            if bit]
+        fork_version_slot = max(update.signature_slot, Slot(1)) - Slot(1)
+        fork_version = self.compute_fork_version(
+            self.compute_epoch_at_slot(fork_version_slot))
+        domain = self.compute_domain(DOMAIN_SYNC_COMMITTEE, fork_version,
+                                     genesis_validators_root)
+        signing_root = self.compute_signing_root(
+            update.attested_header.beacon, domain)
+        assert bls.FastAggregateVerify(
+            participant_pubkeys, signing_root,
+            sync_aggregate.sync_committee_signature)
+
+    # -- apply / force / process (sync-protocol.md:406-535) ------------------
+
+    def apply_light_client_update(self, store, update) -> None:
+        store_period = self.compute_sync_committee_period_at_slot(
+            store.finalized_header.beacon.slot)
+        update_finalized_period = self.compute_sync_committee_period_at_slot(
+            update.finalized_header.beacon.slot)
+        if not self.is_next_sync_committee_known(store):
+            assert update_finalized_period == store_period
+            store.next_sync_committee = update.next_sync_committee
+        elif update_finalized_period == store_period + 1:
+            store.current_sync_committee = store.next_sync_committee
+            store.next_sync_committee = update.next_sync_committee
+            store.previous_max_active_participants = \
+                store.current_max_active_participants
+            store.current_max_active_participants = 0
+        if update.finalized_header.beacon.slot > \
+                store.finalized_header.beacon.slot:
+            store.finalized_header = update.finalized_header
+            if store.finalized_header.beacon.slot > \
+                    store.optimistic_header.beacon.slot:
+                store.optimistic_header = store.finalized_header
+
+    def process_light_client_store_force_update(self, store,
+                                                current_slot) -> None:
+        if (current_slot > store.finalized_header.beacon.slot
+                + self.UPDATE_TIMEOUT
+                and store.best_valid_update is not None):
+            if store.best_valid_update.finalized_header.beacon.slot <= \
+                    store.finalized_header.beacon.slot:
+                store.best_valid_update.finalized_header = \
+                    store.best_valid_update.attested_header
+            self.apply_light_client_update(store, store.best_valid_update)
+            store.best_valid_update = None
+
+    def process_light_client_update(self, store, update, current_slot,
+                                    genesis_validators_root) -> None:
+        self.validate_light_client_update(store, update, current_slot,
+                                          genesis_validators_root)
+        sync_committee_bits = update.sync_aggregate.sync_committee_bits
+
+        if (store.best_valid_update is None
+                or self.is_better_update(update, store.best_valid_update)):
+            store.best_valid_update = update
+
+        store.current_max_active_participants = max(
+            store.current_max_active_participants, sum(sync_committee_bits))
+
+        if (sum(sync_committee_bits) > self.get_safety_threshold(store)
+                and update.attested_header.beacon.slot
+                > store.optimistic_header.beacon.slot):
+            store.optimistic_header = update.attested_header
+
+        update_has_finalized_next_sync_committee = (
+            not self.is_next_sync_committee_known(store)
+            and self.is_sync_committee_update(update)
+            and self.is_finality_update(update)
+            and (self.compute_sync_committee_period_at_slot(
+                update.finalized_header.beacon.slot)
+                == self.compute_sync_committee_period_at_slot(
+                    update.attested_header.beacon.slot)))
+        if (sum(sync_committee_bits) * 3 >= len(sync_committee_bits) * 2
+                and (update.finalized_header.beacon.slot
+                     > store.finalized_header.beacon.slot
+                     or update_has_finalized_next_sync_committee)):
+            self.apply_light_client_update(store, update)
+            store.best_valid_update = None
+
+    def process_light_client_finality_update(self, store, finality_update,
+                                             current_slot,
+                                             genesis_validators_root) -> None:
+        update = self.LightClientUpdate(
+            attested_header=finality_update.attested_header,
+            next_sync_committee=self.SyncCommittee(),
+            next_sync_committee_branch=self.NextSyncCommitteeBranch(),
+            finalized_header=finality_update.finalized_header,
+            finality_branch=finality_update.finality_branch,
+            sync_aggregate=finality_update.sync_aggregate,
+            signature_slot=finality_update.signature_slot,
+        )
+        self.process_light_client_update(store, update, current_slot,
+                                         genesis_validators_root)
+
+    def process_light_client_optimistic_update(self, store, optimistic_update,
+                                               current_slot,
+                                               genesis_validators_root) -> None:
+        update = self.LightClientUpdate(
+            attested_header=optimistic_update.attested_header,
+            next_sync_committee=self.SyncCommittee(),
+            next_sync_committee_branch=self.NextSyncCommitteeBranch(),
+            finalized_header=self.LightClientHeader(),
+            finality_branch=self.FinalityBranch(),
+            sync_aggregate=optimistic_update.sync_aggregate,
+            signature_slot=optimistic_update.signature_slot,
+        )
+        self.process_light_client_update(store, update, current_slot,
+                                         genesis_validators_root)
+
+    # -- full-node derivation (full-node.md) ---------------------------------
+
+    def block_to_light_client_header(self, block):
+        return self.LightClientHeader(
+            beacon=self.BeaconBlockHeader(
+                slot=block.message.slot,
+                proposer_index=block.message.proposer_index,
+                parent_root=block.message.parent_root,
+                state_root=block.message.state_root,
+                body_root=hash_tree_root(block.message.body),
+            ))
+
+    def create_light_client_bootstrap(self, state, block):
+        """full-node.md create_light_client_bootstrap."""
+        assert self.compute_epoch_at_slot(state.slot) >= \
+            self.config.ALTAIR_FORK_EPOCH
+        assert state.slot == state.latest_block_header.slot
+        header = state.latest_block_header.copy()
+        header.state_root = hash_tree_root(state)
+        assert hash_tree_root(header) == hash_tree_root(block.message)
+        return self.LightClientBootstrap(
+            header=self.block_to_light_client_header(block),
+            current_sync_committee=state.current_sync_committee,
+            current_sync_committee_branch=compute_merkle_proof(
+                state, self.CURRENT_SYNC_COMMITTEE_GINDEX),
+        )
+
+    def create_light_client_update(self, state, block, attested_state,
+                                   attested_block, finalized_block):
+        """full-node.md create_light_client_update."""
+        assert self.compute_epoch_at_slot(attested_state.slot) >= \
+            self.config.ALTAIR_FORK_EPOCH
+        assert sum(block.message.body.sync_aggregate.sync_committee_bits) >= \
+            self.MIN_SYNC_COMMITTEE_PARTICIPANTS
+
+        # signature block must correspond to the given state
+        assert state.slot == state.latest_block_header.slot
+        header = state.latest_block_header.copy()
+        header.state_root = hash_tree_root(state)
+        assert hash_tree_root(header) == hash_tree_root(block.message)
+        assert attested_state.slot == attested_state.latest_block_header.slot
+
+        attested_header = attested_state.latest_block_header.copy()
+        attested_header.state_root = hash_tree_root(attested_state)
+        assert hash_tree_root(attested_header) == \
+            hash_tree_root(attested_block.message) == \
+            block.message.parent_root
+
+        update = self.LightClientUpdate()
+        update.attested_header = \
+            self.block_to_light_client_header(attested_block)
+        update_attested_period = self.compute_sync_committee_period_at_slot(
+            attested_block.message.slot)
+        update_signature_period = self.compute_sync_committee_period_at_slot(
+            block.message.slot)
+        if update_attested_period == update_signature_period:
+            update.next_sync_committee = attested_state.next_sync_committee
+            update.next_sync_committee_branch = compute_merkle_proof(
+                attested_state, self.NEXT_SYNC_COMMITTEE_GINDEX)
+        if finalized_block is not None:
+            if finalized_block.message.slot != self.GENESIS_SLOT:
+                update.finalized_header = \
+                    self.block_to_light_client_header(finalized_block)
+                assert hash_tree_root(update.finalized_header.beacon) == \
+                    attested_state.finalized_checkpoint.root
+            else:
+                assert attested_state.finalized_checkpoint.root == Bytes32()
+            update.finality_branch = compute_merkle_proof(
+                attested_state, self.FINALIZED_ROOT_GINDEX)
+        update.sync_aggregate = block.message.body.sync_aggregate
+        update.signature_slot = block.message.slot
+        return update
+
+    def create_light_client_finality_update(self, update):
+        return self.LightClientFinalityUpdate(
+            attested_header=update.attested_header,
+            finalized_header=update.finalized_header,
+            finality_branch=update.finality_branch,
+            sync_aggregate=update.sync_aggregate,
+            signature_slot=update.signature_slot,
+        )
+
+    def create_light_client_optimistic_update(self, update):
+        return self.LightClientOptimisticUpdate(
+            attested_header=update.attested_header,
+            sync_aggregate=update.sync_aggregate,
+            signature_slot=update.signature_slot,
+        )

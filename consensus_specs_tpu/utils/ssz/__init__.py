@@ -13,6 +13,15 @@ from .types import (
 )
 from .impl import serialize, hash_tree_root, uint_to_bytes, copy, deserialize
 from .merkle import merkleize_chunks, mix_in_length, mix_in_selector, zero_hashes
+from .proofs import (
+    GeneralizedIndex, get_generalized_index, concat_generalized_indices,
+    get_generalized_index_length, get_generalized_index_bit,
+    generalized_index_sibling, generalized_index_child,
+    generalized_index_parent, calculate_merkle_root, verify_merkle_proof,
+    get_branch_indices, get_path_indices, get_helper_indices,
+    calculate_multi_merkle_root, verify_merkle_multiproof,
+    compute_merkle_proof, get_subtree_node_root,
+)
 
 __all__ = [
     "SSZValue", "BasicValue", "boolean", "byte",
@@ -22,4 +31,11 @@ __all__ = [
     "Bitvector", "Bitlist", "Vector", "List", "Container", "Union",
     "serialize", "hash_tree_root", "uint_to_bytes", "copy", "deserialize",
     "merkleize_chunks", "mix_in_length", "mix_in_selector", "zero_hashes",
+    "GeneralizedIndex", "get_generalized_index", "concat_generalized_indices",
+    "get_generalized_index_length", "get_generalized_index_bit",
+    "generalized_index_sibling", "generalized_index_child",
+    "generalized_index_parent", "calculate_merkle_root", "verify_merkle_proof",
+    "get_branch_indices", "get_path_indices", "get_helper_indices",
+    "calculate_multi_merkle_root", "verify_merkle_multiproof",
+    "compute_merkle_proof", "get_subtree_node_root",
 ]

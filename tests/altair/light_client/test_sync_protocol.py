@@ -1,0 +1,208 @@
+"""Altair light-client sync-protocol tests.
+
+Reference model: ``test/altair/light_client/test_sync.py`` +
+``test_update_ranking.py`` against
+``specs/altair/light-client/sync-protocol.md``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, with_config_overrides, always_bls,
+    never_bls,
+)
+
+# light-client derivation requires the altair fork to be active at genesis
+# (full-node.md asserts epoch >= ALTAIR_FORK_EPOCH; default configs pin
+# fork epochs to FAR_FUTURE like the reference's)
+altair_active = with_config_overrides({"ALTAIR_FORK_EPOCH": 0})
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block,
+)
+from consensus_specs_tpu.test_infra.sync_committee import (
+    compute_aggregate_sync_committee_signature, compute_committee_indices,
+)
+from consensus_specs_tpu.utils.ssz import hash_tree_root, compute_merkle_proof
+from consensus_specs_tpu.utils import bls
+
+
+def _advance_chain(spec, state, n_blocks):
+    """Apply n empty blocks; returns [(signed_block, post_state_copy)]."""
+    out = []
+    for _ in range(n_blocks):
+        block = build_empty_block_for_next_slot(spec, state)
+        signed = state_transition_and_sign_block(spec, state, block)
+        out.append((signed, state.copy()))
+    return out
+
+
+def _signed_sync_aggregate(spec, signing_state, attested_root, signature_slot,
+                           participation=1.0):
+    committee_indices = compute_committee_indices(signing_state)
+    n = int(len(committee_indices) * participation)
+    participants = committee_indices[:n]
+    bits = [i < n for i in range(len(committee_indices))]
+    signature = compute_aggregate_sync_committee_signature(
+        spec, signing_state, signature_slot - 1, participants,
+        block_root=attested_root)
+    return spec.SyncAggregate(sync_committee_bits=bits,
+                              sync_committee_signature=signature)
+
+
+def _bootstrap_store(spec, chain):
+    signed_block, post_state = chain[0]
+    bootstrap = spec.create_light_client_bootstrap(post_state, signed_block)
+    trusted_root = hash_tree_root(signed_block.message)
+    return spec.initialize_light_client_store(trusted_root, bootstrap)
+
+
+@with_phases(["altair"])
+@altair_active
+@spec_state_test
+@never_bls
+def test_bootstrap_proof_and_store_init(spec, state):
+    chain = _advance_chain(spec, state, 1)
+    store = _bootstrap_store(spec, chain)
+    signed_block, post_state = chain[0]
+    assert store.finalized_header.beacon.slot == signed_block.message.slot
+    assert store.current_sync_committee == post_state.current_sync_committee
+    assert not spec.is_next_sync_committee_known(store)
+    # tampered branch must be rejected
+    bad = spec.create_light_client_bootstrap(post_state, signed_block)
+    bad.current_sync_committee_branch[0] = b"\x13" * 32
+    try:
+        spec.initialize_light_client_store(
+            hash_tree_root(signed_block.message), bad)
+        raise SystemExit("tampered bootstrap must fail")
+    except AssertionError:
+        pass
+
+
+@with_phases(["altair"])
+@altair_active
+@spec_state_test
+@always_bls
+def test_process_light_client_update_optimistic(spec, state):
+    chain = _advance_chain(spec, state, 2)
+    store = _bootstrap_store(spec, chain)
+    attested_block, attested_state = chain[1]
+
+    attested_header = spec.block_to_light_client_header(attested_block)
+    signature_slot = attested_block.message.slot + 1
+    sync_aggregate = _signed_sync_aggregate(
+        spec, attested_state, hash_tree_root(attested_block.message),
+        signature_slot)
+    update = spec.LightClientUpdate(
+        attested_header=attested_header,
+        sync_aggregate=sync_aggregate,
+        signature_slot=signature_slot,
+    )
+    current_slot = signature_slot
+    spec.process_light_client_update(
+        store, update, current_slot, attested_state.genesis_validators_root)
+
+    # optimistic header advanced; no finality -> finalized unchanged
+    assert store.optimistic_header.beacon.slot == attested_block.message.slot
+    assert store.finalized_header.beacon.slot == chain[0][0].message.slot
+    assert store.best_valid_update == update
+    assert store.current_max_active_participants == \
+        spec.SYNC_COMMITTEE_SIZE
+
+
+@with_phases(["altair"])
+@altair_active
+@spec_state_test
+@always_bls
+def test_invalid_signature_rejected(spec, state):
+    chain = _advance_chain(spec, state, 2)
+    store = _bootstrap_store(spec, chain)
+    attested_block, attested_state = chain[1]
+    signature_slot = attested_block.message.slot + 1
+    # sign the WRONG root
+    sync_aggregate = _signed_sync_aggregate(
+        spec, attested_state, spec.Root(b"\x66" * 32), signature_slot)
+    update = spec.LightClientUpdate(
+        attested_header=spec.block_to_light_client_header(attested_block),
+        sync_aggregate=sync_aggregate,
+        signature_slot=signature_slot,
+    )
+    try:
+        spec.process_light_client_update(
+            store, update, signature_slot,
+            attested_state.genesis_validators_root)
+        raise SystemExit("invalid signature must fail")
+    except AssertionError:
+        pass
+
+
+@with_phases(["altair"])
+@altair_active
+@spec_state_test
+@always_bls
+def test_finality_branch_genesis_case(spec, state):
+    """Finality update whose finalized checkpoint is still the genesis
+    zero-root (sync-protocol.md:361 special case)."""
+    chain = _advance_chain(spec, state, 2)
+    store = _bootstrap_store(spec, chain)
+    attested_block, attested_state = chain[1]
+    assert bytes(attested_state.finalized_checkpoint.root) == b"\x00" * 32
+
+    signature_slot = attested_block.message.slot + 1
+    update = spec.LightClientUpdate(
+        attested_header=spec.block_to_light_client_header(attested_block),
+        finality_branch=compute_merkle_proof(
+            attested_state, spec.FINALIZED_ROOT_GINDEX),
+        sync_aggregate=_signed_sync_aggregate(
+            spec, attested_state, hash_tree_root(attested_block.message),
+            signature_slot),
+        signature_slot=signature_slot,
+    )
+    assert spec.is_finality_update(update)
+    spec.validate_light_client_update(
+        store, update, signature_slot,
+        attested_state.genesis_validators_root)
+
+
+@with_phases(["altair"])
+@altair_active
+@spec_state_test
+@never_bls
+def test_is_better_update_ranking(spec, state):
+    def mk(participation_n, attested_slot, signature_slot):
+        bits = [i < participation_n for i in range(spec.SYNC_COMMITTEE_SIZE)]
+        return spec.LightClientUpdate(
+            attested_header=spec.LightClientHeader(
+                beacon=spec.BeaconBlockHeader(slot=attested_slot)),
+            sync_aggregate=spec.SyncAggregate(sync_committee_bits=bits),
+            signature_slot=signature_slot,
+        )
+
+    n = spec.SYNC_COMMITTEE_SIZE
+    # supermajority beats non-supermajority
+    assert spec.is_better_update(mk(n, 10, 11), mk(n // 2, 10, 11))
+    # higher participation wins below supermajority
+    assert spec.is_better_update(mk(n // 2, 10, 11), mk(n // 3, 10, 11))
+    # both supermajority: higher participation wins
+    assert spec.is_better_update(mk(n, 10, 11), mk((2 * n + 2) // 3, 10, 11))
+    # tie on participation: older attested data wins
+    assert spec.is_better_update(mk(n, 9, 11), mk(n, 10, 11))
+    assert not spec.is_better_update(mk(n, 10, 11), mk(n, 9, 11))
+
+
+@with_phases(["altair"])
+@altair_active
+@spec_state_test
+@never_bls
+def test_force_update_after_timeout(spec, state):
+    chain = _advance_chain(spec, state, 2)
+    store = _bootstrap_store(spec, chain)
+    attested_block, _ = chain[1]
+    bits = [True] * spec.SYNC_COMMITTEE_SIZE
+    store.best_valid_update = spec.LightClientUpdate(
+        attested_header=spec.block_to_light_client_header(attested_block),
+        sync_aggregate=spec.SyncAggregate(sync_committee_bits=bits),
+        signature_slot=attested_block.message.slot + 1,
+    )
+    timeout_slot = store.finalized_header.beacon.slot + \
+        spec.UPDATE_TIMEOUT + 1
+    spec.process_light_client_store_force_update(store, timeout_slot)
+    # forced update promotes attested header to finalized
+    assert store.finalized_header.beacon.slot == attested_block.message.slot
+    assert store.best_valid_update is None

@@ -1,0 +1,148 @@
+"""Generalized-index and Merkle-proof tests.
+
+Reference model: ``ssz/merkle-proofs.md`` rules plus the hardcoded gindex
+assertions the reference emits into the altair module
+(``pysetup/spec_builders/altair.py:43-48``: FINALIZED_ROOT_GINDEX=105,
+CURRENT_SYNC_COMMITTEE_GINDEX=54, NEXT_SYNC_COMMITTEE_GINDEX=55).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu.utils.ssz import (
+    Container, List, Vector, Bitlist, uint8, uint64, Bytes32,
+    hash_tree_root,
+    get_generalized_index, concat_generalized_indices,
+    get_generalized_index_length, generalized_index_sibling,
+    generalized_index_child, generalized_index_parent,
+    verify_merkle_proof, compute_merkle_proof, get_subtree_node_root,
+    get_helper_indices, verify_merkle_multiproof,
+)
+
+
+class Inner(Container):
+    a: uint64
+    b: Bytes32
+
+
+class Outer(Container):
+    x: uint64
+    inner: Inner
+    items: List[uint64, 1024]
+    vecs: Vector[Inner, 4]
+    bits: Bitlist[100]
+
+
+def test_gindex_arithmetic():
+    assert get_generalized_index_length(1) == 0
+    assert get_generalized_index_length(9) == 3
+    assert generalized_index_sibling(8) == 9
+    assert generalized_index_parent(9) == 4
+    assert generalized_index_child(4, False) == 8
+    assert generalized_index_child(4, True) == 9
+    assert concat_generalized_indices(4, 3) == 9
+    assert concat_generalized_indices(2, 2, 2) == 8
+
+
+def test_gindex_container_paths():
+    # Outer has 5 fields -> padded to 8 -> depth 3
+    assert get_generalized_index(Outer, "x") == 8
+    assert get_generalized_index(Outer, "inner") == 9
+    # Inner has 2 fields -> depth 1
+    assert get_generalized_index(Outer, "inner", "a") == 18
+    assert get_generalized_index(Outer, "inner", "b") == 19
+    # list length mixin
+    assert get_generalized_index(Outer, "items", "__len__") == \
+        get_generalized_index(Outer, "items") * 2 + 1
+
+
+def test_altair_state_gindices_match_reference_constants():
+    """The hardcoded reference constants pin our whole gindex pipeline."""
+    from consensus_specs_tpu.forks import build_spec
+    spec = build_spec("altair", "minimal")
+    assert get_generalized_index(
+        spec.BeaconState, "finalized_checkpoint", "root") == 105
+    assert get_generalized_index(
+        spec.BeaconState, "current_sync_committee") == 54
+    assert get_generalized_index(
+        spec.BeaconState, "next_sync_committee") == 55
+
+
+def _example():
+    return Outer(
+        x=7,
+        inner=Inner(a=3, b=b"\x22" * 32),
+        items=[1, 2, 3, 4, 5],
+        vecs=[Inner(a=i, b=bytes([i]) * 32) for i in range(4)],
+        bits=[True, False, True],
+    )
+
+
+def test_single_proofs_verify_against_root():
+    value = _example()
+    root = hash_tree_root(value)
+    for path in (("x",), ("inner",), ("inner", "b"), ("items",),
+                 ("items", "__len__"), ("vecs",), ("vecs", 2),
+                 ("vecs", 2, "a"), ("bits",)):
+        gindex = get_generalized_index(Outer, *path)
+        leaf = get_subtree_node_root(value, gindex)
+        proof = compute_merkle_proof(value, gindex)
+        assert len(proof) == get_generalized_index_length(gindex)
+        assert verify_merkle_proof(leaf, proof, gindex, root), path
+        # a corrupted leaf must fail
+        assert not verify_merkle_proof(b"\x00" * 32, proof, gindex, root) \
+            or leaf == b"\x00" * 32
+
+
+def test_leaf_roots_match_field_roots():
+    value = _example()
+    gindex = get_generalized_index(Outer, "inner")
+    assert get_subtree_node_root(value, gindex) == \
+        hash_tree_root(value.inner)
+    gindex = get_generalized_index(Outer, "vecs", 1)
+    assert get_subtree_node_root(value, gindex) == \
+        hash_tree_root(value.vecs[1])
+
+
+def test_proof_changes_when_value_mutates():
+    value = _example()
+    gindex = get_generalized_index(Outer, "inner", "a")
+    root = hash_tree_root(value)
+    leaf = get_subtree_node_root(value, gindex)
+    proof = compute_merkle_proof(value, gindex)
+    assert verify_merkle_proof(leaf, proof, gindex, root)
+    value.inner.a = 999
+    new_root = hash_tree_root(value)
+    assert new_root != root
+    # old leaf no longer verifies against the new root
+    assert not verify_merkle_proof(leaf, proof, gindex, new_root)
+    # fresh leaf + proof do
+    assert verify_merkle_proof(
+        get_subtree_node_root(value, gindex),
+        compute_merkle_proof(value, gindex), gindex, new_root)
+
+
+def test_multiproof():
+    value = _example()
+    root = hash_tree_root(value)
+    indices = [get_generalized_index(Outer, "x"),
+               get_generalized_index(Outer, "inner", "a")]
+    leaves = [get_subtree_node_root(value, g) for g in indices]
+    helper_indices = get_helper_indices(indices)
+    proof = [get_subtree_node_root(value, g) for g in helper_indices]
+    assert verify_merkle_multiproof(leaves, proof, indices, root)
+    assert not verify_merkle_multiproof(leaves[::-1], proof, indices, root)
+
+
+def test_beacon_state_finalized_root_proof():
+    """The altair light-client bootstrap proof shape end to end."""
+    from consensus_specs_tpu.forks import build_spec
+    from consensus_specs_tpu.test_infra.genesis import create_genesis_state
+    spec = build_spec("altair", "minimal")
+    state = create_genesis_state(
+        spec, [spec.MAX_EFFECTIVE_BALANCE] * 64, spec.MAX_EFFECTIVE_BALANCE)
+    gindex = 105  # finalized_checkpoint.root
+    proof = compute_merkle_proof(state, gindex)
+    leaf = bytes(state.finalized_checkpoint.root)
+    assert verify_merkle_proof(leaf, proof, gindex, hash_tree_root(state))
