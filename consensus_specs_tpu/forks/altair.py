@@ -20,6 +20,7 @@ from consensus_specs_tpu.utils import bls
 from . import register_fork
 from .phase0 import Phase0Spec
 from .light_client import LightClientMixin
+from .validator_guide import SyncDutiesMixin
 from .base_types import (
     Slot, Epoch, ValidatorIndex, Gwei, Root, Version, BLSPubkey, BLSSignature,
     ParticipationFlags, GENESIS_EPOCH,
@@ -44,7 +45,7 @@ G2_POINT_AT_INFINITY = BLSSignature(b"\xc0" + b"\x00" * 95)
 
 
 @register_fork("altair")
-class AltairSpec(LightClientMixin, Phase0Spec):
+class AltairSpec(SyncDutiesMixin, LightClientMixin, Phase0Spec):
     fork = "altair"
     previous_fork = "phase0"
 
@@ -84,6 +85,7 @@ class AltairSpec(LightClientMixin, Phase0Spec):
         super()._build_types()
         # light-client containers need BeaconState/BeaconBlockHeader built
         self._build_light_client_types()
+        self._build_sync_duty_types()
 
     def _block_body_fields(self, t) -> dict:
         fields = super()._block_body_fields(t)

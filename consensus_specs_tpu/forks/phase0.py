@@ -25,6 +25,7 @@ from consensus_specs_tpu.utils.ssz import (
 from consensus_specs_tpu.utils import bls
 from . import register_fork
 from .fork_choice import ForkChoiceMixin
+from .validator_guide import ValidatorGuideMixin
 from .base_types import (
     Slot, Epoch, CommitteeIndex, ValidatorIndex, Gwei, Root, Hash32, Version,
     DomainType, ForkDigest, Domain, BLSPubkey, BLSSignature,
@@ -72,7 +73,7 @@ def _bytes_of(hexstr, width):
 
 
 @register_fork("phase0")
-class Phase0Spec(ForkChoiceMixin):
+class Phase0Spec(ValidatorGuideMixin, ForkChoiceMixin):
     fork = "phase0"
     previous_fork = None
 

@@ -1,0 +1,385 @@
+"""Honest-validator duties, p2p subnet computation, weak subjectivity.
+
+Behavioral sources:
+- ``specs/phase0/validator.md`` (``get_committee_assignment`` :211,
+  ``is_proposer`` :239, randao/eth1-vote/signing helpers :325-448,
+  ``compute_subnet_for_attestation`` :519, selection proofs +
+  ``is_aggregator`` :541-552, aggregate-and-proof :589-610)
+- ``specs/phase0/p2p-interface.md`` (constants :184-206,
+  ``compute_subscribed_subnet(s)`` :1021-1037)
+- ``specs/phase0/weak-subjectivity.md``
+  (``compute_weak_subjectivity_period`` :87,
+  ``is_within_weak_subjectivity_period`` :171)
+- ``specs/altair/validator.md`` (sync-committee duty containers :79-130,
+  message/selection/aggregation helpers :271-400,
+  ``process_sync_committee_contributions`` :222)
+"""
+from typing import Optional, Sequence, Set, Tuple
+
+from consensus_specs_tpu.utils.hash_function import hash
+from consensus_specs_tpu.utils.ssz import uint64, Container, Bitvector
+from consensus_specs_tpu.utils import bls
+from .base_types import (
+    Slot, Epoch, CommitteeIndex, ValidatorIndex, Root, BLSSignature,
+    DOMAIN_RANDAO, DOMAIN_BEACON_PROPOSER, DOMAIN_BEACON_ATTESTER,
+    DOMAIN_SELECTION_PROOF, DOMAIN_AGGREGATE_AND_PROOF,
+    DOMAIN_SYNC_COMMITTEE, DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+    DOMAIN_CONTRIBUTION_AND_PROOF,
+)
+
+SubnetID = uint64
+NodeID = int
+
+# p2p-interface.md:184-206
+TARGET_AGGREGATORS_PER_COMMITTEE = 2**4
+NODE_ID_BITS = 256
+EPOCHS_PER_SUBNET_SUBSCRIPTION = 2**8
+SUBNETS_PER_NODE = 2
+ATTESTATION_SUBNET_COUNT = 2**6
+ATTESTATION_SUBNET_EXTRA_BITS = 0
+ATTESTATION_SUBNET_PREFIX_BITS = (
+    (ATTESTATION_SUBNET_COUNT - 1).bit_length() + ATTESTATION_SUBNET_EXTRA_BITS)
+
+# weak-subjectivity.md:60-80
+ETH_TO_GWEI = uint64(10**9)
+SAFETY_DECAY = uint64(10)
+
+
+class ValidatorGuideMixin:
+    """phase0 honest-validator duties, mixed into the spec classes."""
+
+    TARGET_AGGREGATORS_PER_COMMITTEE = TARGET_AGGREGATORS_PER_COMMITTEE
+    NODE_ID_BITS = NODE_ID_BITS
+    EPOCHS_PER_SUBNET_SUBSCRIPTION = EPOCHS_PER_SUBNET_SUBSCRIPTION
+    SUBNETS_PER_NODE = SUBNETS_PER_NODE
+    ATTESTATION_SUBNET_COUNT = ATTESTATION_SUBNET_COUNT
+    ATTESTATION_SUBNET_PREFIX_BITS = ATTESTATION_SUBNET_PREFIX_BITS
+    ETH_TO_GWEI = ETH_TO_GWEI
+    SAFETY_DECAY = SAFETY_DECAY
+    SubnetID = SubnetID
+
+    # -- assignments (validator.md:211-241) ----------------------------------
+
+    def get_committee_assignment(
+            self, state, epoch, validator_index
+    ) -> Optional[Tuple[Sequence[int], int, int]]:
+        """(committee, committee index, slot) or None (validator.md:211)."""
+        next_epoch = Epoch(self.get_current_epoch(state) + 1)
+        assert epoch <= next_epoch
+
+        start_slot = self.compute_start_slot_at_epoch(epoch)
+        committee_count_per_slot = self.get_committee_count_per_slot(
+            state, epoch)
+        for slot in range(start_slot, start_slot + self.SLOTS_PER_EPOCH):
+            for index in range(committee_count_per_slot):
+                committee = self.get_beacon_committee(
+                    state, Slot(slot), CommitteeIndex(index))
+                if validator_index in committee:
+                    return committee, CommitteeIndex(index), Slot(slot)
+        return None
+
+    def is_proposer(self, state, validator_index) -> bool:
+        return self.get_beacon_proposer_index(state) == validator_index
+
+    # -- signing helpers (validator.md:325-448,504) --------------------------
+
+    def get_epoch_signature(self, state, block, privkey) -> bytes:
+        domain = self.get_domain(state, DOMAIN_RANDAO,
+                                 self.compute_epoch_at_slot(block.slot))
+        signing_root = self.compute_signing_root(
+            uint64(self.compute_epoch_at_slot(block.slot)), domain)
+        return bls.Sign(privkey, signing_root)
+
+    def get_block_signature(self, state, block, privkey) -> bytes:
+        domain = self.get_domain(state, DOMAIN_BEACON_PROPOSER,
+                                 self.compute_epoch_at_slot(block.slot))
+        signing_root = self.compute_signing_root(block, domain)
+        return bls.Sign(privkey, signing_root)
+
+    def get_attestation_signature(self, state, attestation_data,
+                                  privkey) -> bytes:
+        domain = self.get_domain(state, DOMAIN_BEACON_ATTESTER,
+                                 attestation_data.target.epoch)
+        signing_root = self.compute_signing_root(attestation_data, domain)
+        return bls.Sign(privkey, signing_root)
+
+    # -- eth1 voting (validator.md:350-393) ----------------------------------
+
+    def compute_time_at_slot(self, state, slot) -> uint64:
+        return uint64(state.genesis_time
+                      + slot * self.config.SECONDS_PER_SLOT)
+
+    def voting_period_start_time(self, state) -> uint64:
+        eth1_voting_period_start_slot = Slot(
+            state.slot - state.slot % (self.EPOCHS_PER_ETH1_VOTING_PERIOD
+                                       * self.SLOTS_PER_EPOCH))
+        return self.compute_time_at_slot(state, eth1_voting_period_start_slot)
+
+    def is_candidate_block(self, block, period_start) -> bool:
+        follow = (self.config.SECONDS_PER_ETH1_BLOCK
+                  * self.config.ETH1_FOLLOW_DISTANCE)
+        return (block.timestamp + follow <= period_start
+                and block.timestamp + follow * 2 >= period_start)
+
+    def get_eth1_data(self, block):
+        """Test stub mapping an Eth1Block to its vote data (the reference
+        injects an equivalent stub, ``pysetup/spec_builders/phase0.py:37``)."""
+        return self.Eth1Data(
+            deposit_root=block.deposit_root,
+            deposit_count=block.deposit_count,
+            block_hash=self.hash_tree_root(block),
+        )
+
+    def get_eth1_vote(self, state, eth1_chain):
+        """validator.md:369"""
+        period_start = self.voting_period_start_time(state)
+        votes_to_consider = [
+            self.get_eth1_data(block) for block in eth1_chain
+            if (self.is_candidate_block(block, period_start)
+                and self.get_eth1_data(block).deposit_count
+                >= state.eth1_data.deposit_count)
+        ]
+        valid_votes = [vote for vote in state.eth1_data_votes
+                       if vote in votes_to_consider]
+        default_vote = (votes_to_consider[len(votes_to_consider) - 1]
+                        if any(votes_to_consider) else state.eth1_data)
+        return max(
+            valid_votes,
+            key=lambda v: (valid_votes.count(v), -valid_votes.index(v)),
+            default=default_vote,
+        )
+
+    # -- attestation aggregation (validator.md:519-610) ----------------------
+
+    def compute_subnet_for_attestation(self, committees_per_slot, slot,
+                                       committee_index) -> uint64:
+        """validator.md:519"""
+        slots_since_epoch_start = uint64(slot % self.SLOTS_PER_EPOCH)
+        committees_since_epoch_start = (committees_per_slot
+                                        * slots_since_epoch_start)
+        return SubnetID((committees_since_epoch_start + committee_index)
+                        % ATTESTATION_SUBNET_COUNT)
+
+    def get_slot_signature(self, state, slot, privkey) -> bytes:
+        domain = self.get_domain(state, DOMAIN_SELECTION_PROOF,
+                                 self.compute_epoch_at_slot(slot))
+        signing_root = self.compute_signing_root(uint64(slot), domain)
+        return bls.Sign(privkey, signing_root)
+
+    def is_aggregator(self, state, slot, index, slot_signature) -> bool:
+        """validator.md:548"""
+        committee = self.get_beacon_committee(state, slot, index)
+        modulo = max(1, len(committee) // TARGET_AGGREGATORS_PER_COMMITTEE)
+        return self.bytes_to_uint64(hash(slot_signature)[0:8]) % modulo == 0
+
+    def get_aggregate_signature(self, attestations) -> bytes:
+        return bls.Aggregate([a.signature for a in attestations])
+
+    def get_aggregate_and_proof(self, state, aggregator_index, aggregate,
+                                privkey):
+        return self.AggregateAndProof(
+            aggregator_index=aggregator_index,
+            aggregate=aggregate,
+            selection_proof=self.get_slot_signature(
+                state, aggregate.data.slot, privkey),
+        )
+
+    def get_aggregate_and_proof_signature(self, state, aggregate_and_proof,
+                                          privkey) -> bytes:
+        aggregate = aggregate_and_proof.aggregate
+        domain = self.get_domain(
+            state, DOMAIN_AGGREGATE_AND_PROOF,
+            self.compute_epoch_at_slot(aggregate.data.slot))
+        signing_root = self.compute_signing_root(aggregate_and_proof, domain)
+        return bls.Sign(privkey, signing_root)
+
+    # -- p2p subnet backbone (p2p-interface.md:1021-1037) --------------------
+
+    def compute_subscribed_subnet(self, node_id: int, epoch, index) -> uint64:
+        node_id_prefix = node_id >> (NODE_ID_BITS
+                                     - ATTESTATION_SUBNET_PREFIX_BITS)
+        node_offset = node_id % EPOCHS_PER_SUBNET_SUBSCRIPTION
+        permutation_seed = hash(self.uint_to_bytes(uint64(
+            (epoch + node_offset) // EPOCHS_PER_SUBNET_SUBSCRIPTION)))
+        permutated_prefix = self.compute_shuffled_index(
+            node_id_prefix, 1 << ATTESTATION_SUBNET_PREFIX_BITS,
+            permutation_seed)
+        return SubnetID((permutated_prefix + index)
+                        % ATTESTATION_SUBNET_COUNT)
+
+    def compute_subscribed_subnets(self, node_id: int, epoch):
+        return [self.compute_subscribed_subnet(node_id, epoch, index)
+                for index in range(SUBNETS_PER_NODE)]
+
+    # -- weak subjectivity (weak-subjectivity.md:87,171) ---------------------
+
+    def compute_weak_subjectivity_period(self, state) -> uint64:
+        ws_period = self.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+        N = len(self.get_active_validator_indices(
+            state, self.get_current_epoch(state)))
+        t = self.get_total_active_balance(state) // N // ETH_TO_GWEI
+        T = self.MAX_EFFECTIVE_BALANCE // ETH_TO_GWEI
+        delta = self.get_validator_churn_limit(state)
+        Delta = self.MAX_DEPOSITS * self.SLOTS_PER_EPOCH
+        D = SAFETY_DECAY
+
+        if T * (200 + 3 * D) < t * (200 + 12 * D):
+            epochs_for_validator_set_churn = (
+                N * (t * (200 + 12 * D) - T * (200 + 3 * D))
+                // (600 * delta * (2 * t + T)))
+            epochs_for_balance_top_ups = N * (200 + 3 * D) // (600 * Delta)
+            ws_period += max(epochs_for_validator_set_churn,
+                             epochs_for_balance_top_ups)
+        else:
+            ws_period += 3 * N * D * t // (200 * Delta * (T - t))
+        return uint64(ws_period)
+
+    def is_within_weak_subjectivity_period(self, store, ws_state,
+                                           ws_checkpoint) -> bool:
+        assert ws_state.latest_block_header.state_root == ws_checkpoint.root
+        assert self.compute_epoch_at_slot(ws_state.slot) == ws_checkpoint.epoch
+
+        ws_period = self.compute_weak_subjectivity_period(ws_state)
+        ws_state_epoch = self.compute_epoch_at_slot(ws_state.slot)
+        current_epoch = self.compute_epoch_at_slot(
+            self.get_current_slot(store))
+        return current_epoch <= ws_state_epoch + ws_period
+
+
+# altair/validator.md:71-72
+TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE = 2**4
+SYNC_COMMITTEE_SUBNET_COUNT = 4
+
+
+class SyncDutiesMixin:
+    """altair+ sync-committee duties (altair/validator.md)."""
+
+    TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE = \
+        TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE
+    SYNC_COMMITTEE_SUBNET_COUNT = SYNC_COMMITTEE_SUBNET_COUNT
+
+    def _build_sync_duty_types(self):
+        S = self
+
+        class SyncCommitteeMessage(Container):
+            slot: Slot
+            beacon_block_root: Root
+            validator_index: ValidatorIndex
+            signature: BLSSignature
+
+        class SyncCommitteeContribution(Container):
+            slot: Slot
+            beacon_block_root: Root
+            subcommittee_index: uint64
+            aggregation_bits: Bitvector[
+                S.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT]
+            signature: BLSSignature
+
+        class ContributionAndProof(Container):
+            aggregator_index: ValidatorIndex
+            contribution: SyncCommitteeContribution
+            selection_proof: BLSSignature
+
+        class SignedContributionAndProof(Container):
+            message: ContributionAndProof
+            signature: BLSSignature
+
+        class SyncAggregatorSelectionData(Container):
+            slot: Slot
+            subcommittee_index: uint64
+
+        self.SyncCommitteeMessage = SyncCommitteeMessage
+        self.SyncCommitteeContribution = SyncCommitteeContribution
+        self.ContributionAndProof = ContributionAndProof
+        self.SignedContributionAndProof = SignedContributionAndProof
+        self.SyncAggregatorSelectionData = SyncAggregatorSelectionData
+
+    def get_sync_committee_message(self, state, block_root, validator_index,
+                                   privkey):
+        """altair/validator.md:271"""
+        epoch = self.get_current_epoch(state)
+        domain = self.get_domain(state, DOMAIN_SYNC_COMMITTEE, epoch)
+        signing_root = self.compute_signing_root(block_root, domain)
+        return self.SyncCommitteeMessage(
+            slot=state.slot,
+            beacon_block_root=block_root,
+            validator_index=validator_index,
+            signature=bls.Sign(privkey, signing_root),
+        )
+
+    def compute_subnets_for_sync_committee(self, state,
+                                           validator_index) -> Set[int]:
+        """altair/validator.md:292"""
+        next_slot_epoch = self.compute_epoch_at_slot(Slot(state.slot + 1))
+        if self.compute_sync_committee_period(
+                self.get_current_epoch(state)) == \
+                self.compute_sync_committee_period(next_slot_epoch):
+            sync_committee = state.current_sync_committee
+        else:
+            sync_committee = state.next_sync_committee
+        target_pubkey = state.validators[validator_index].pubkey
+        sync_committee_indices = [
+            index for index, pubkey in enumerate(sync_committee.pubkeys)
+            if pubkey == target_pubkey]
+        return set(
+            uint64(index // (self.SYNC_COMMITTEE_SIZE
+                             // SYNC_COMMITTEE_SUBNET_COUNT))
+            for index in sync_committee_indices)
+
+    def get_sync_committee_selection_proof(self, state, slot,
+                                           subcommittee_index, privkey):
+        domain = self.get_domain(state,
+                                 DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+                                 self.compute_epoch_at_slot(slot))
+        signing_data = self.SyncAggregatorSelectionData(
+            slot=slot, subcommittee_index=subcommittee_index)
+        signing_root = self.compute_signing_root(signing_data, domain)
+        return bls.Sign(privkey, signing_root)
+
+    def is_sync_committee_aggregator(self, signature) -> bool:
+        modulo = max(1, self.SYNC_COMMITTEE_SIZE
+                     // SYNC_COMMITTEE_SUBNET_COUNT
+                     // TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE)
+        return self.bytes_to_uint64(hash(signature)[0:8]) % modulo == 0
+
+    def get_contribution_and_proof(self, state, aggregator_index,
+                                   contribution, privkey):
+        selection_proof = self.get_sync_committee_selection_proof(
+            state, contribution.slot, contribution.subcommittee_index,
+            privkey)
+        return self.ContributionAndProof(
+            aggregator_index=aggregator_index,
+            contribution=contribution,
+            selection_proof=selection_proof,
+        )
+
+    def get_contribution_and_proof_signature(self, state,
+                                             contribution_and_proof,
+                                             privkey):
+        contribution = contribution_and_proof.contribution
+        domain = self.get_domain(state, DOMAIN_CONTRIBUTION_AND_PROOF,
+                                 self.compute_epoch_at_slot(
+                                     contribution.slot))
+        signing_root = self.compute_signing_root(contribution_and_proof,
+                                                 domain)
+        return bls.Sign(privkey, signing_root)
+
+    def process_sync_committee_contributions(self, block,
+                                             contributions) -> None:
+        """altair/validator.md:222"""
+        sync_aggregate = self.SyncAggregate()
+        signatures = []
+        sync_subcommittee_size = (self.SYNC_COMMITTEE_SIZE
+                                  // SYNC_COMMITTEE_SUBNET_COUNT)
+        for contribution in contributions:
+            subcommittee_index = contribution.subcommittee_index
+            for index, participated in enumerate(
+                    contribution.aggregation_bits):
+                if participated:
+                    participant_index = (sync_subcommittee_size
+                                         * subcommittee_index + index)
+                    sync_aggregate.sync_committee_bits[participant_index] = \
+                        True
+            signatures.append(contribution.signature)
+        sync_aggregate.sync_committee_signature = bls.Aggregate(signatures)
+        block.body.sync_aggregate = sync_aggregate

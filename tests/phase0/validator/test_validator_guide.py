@@ -1,0 +1,217 @@
+"""Honest-validator duties, p2p subnets, weak subjectivity.
+
+Reference model: ``test/phase0/unittests/validator/test_validator_unittest.py``
+and the executable blocks of ``specs/phase0/validator.md``,
+``specs/phase0/p2p-interface.md:1021``, ``specs/phase0/weak-subjectivity.md``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases, with_phases, always_bls,
+)
+from consensus_specs_tpu.test_infra.keys import privkeys, pubkeys
+from consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+from consensus_specs_tpu.test_infra.block import next_slots
+from consensus_specs_tpu.utils import bls
+
+
+@with_all_phases
+@spec_state_test
+def test_committee_assignment_covers_all_validators(spec, state):
+    epoch = spec.get_current_epoch(state)
+    seen = set()
+    for index in spec.get_active_validator_indices(state, epoch):
+        assignment = spec.get_committee_assignment(state, epoch, index)
+        assert assignment is not None
+        committee, committee_index, slot = assignment
+        assert index in committee
+        assert spec.compute_epoch_at_slot(slot) == epoch
+        assert committee_index < spec.get_committee_count_per_slot(
+            state, epoch)
+        seen.add(int(index))
+    assert seen == set(
+        int(i) for i in spec.get_active_validator_indices(state, epoch))
+    # next-epoch lookahead allowed; beyond raises
+    assert spec.get_committee_assignment(state, epoch + 1, 0) is not None
+    try:
+        spec.get_committee_assignment(state, epoch + 2, 0)
+        raise SystemExit("two-epoch lookahead must fail")
+    except AssertionError:
+        pass
+
+
+@with_all_phases
+@spec_state_test
+def test_is_proposer_matches_proposer_index(spec, state):
+    proposer = spec.get_beacon_proposer_index(state)
+    assert spec.is_proposer(state, proposer)
+    assert not spec.is_proposer(state, (proposer + 1) % len(state.validators))
+
+
+@with_all_phases
+@spec_state_test
+def test_compute_subnet_for_attestation_range(spec, state):
+    epoch = spec.get_current_epoch(state)
+    committees_per_slot = spec.get_committee_count_per_slot(state, epoch)
+    seen = set()
+    for slot in range(spec.SLOTS_PER_EPOCH):
+        for index in range(committees_per_slot):
+            subnet = spec.compute_subnet_for_attestation(
+                committees_per_slot, slot, index)
+            assert 0 <= subnet < spec.ATTESTATION_SUBNET_COUNT
+            seen.add(int(subnet))
+    assert len(seen) == min(
+        committees_per_slot * spec.SLOTS_PER_EPOCH,
+        spec.ATTESTATION_SUBNET_COUNT)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_is_aggregator_selection_deterministic(spec, state):
+    slot = state.slot
+    committee_index = 0
+    committee = spec.get_beacon_committee(state, slot, committee_index)
+    # with a minimal committee, modulo is 1 -> everyone aggregates
+    modulo = max(1, len(committee) // spec.TARGET_AGGREGATORS_PER_COMMITTEE)
+    results = []
+    for validator_index in committee[:4]:
+        sig = spec.get_slot_signature(state, slot,
+                                      privkeys[validator_index])
+        results.append(spec.is_aggregator(state, slot, committee_index, sig))
+    if modulo == 1:
+        assert all(results)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_aggregate_and_proof_roundtrip(spec, state):
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    attestation = get_valid_attestation(spec, state, slot=state.slot - 1,
+                                        signed=True)
+    aggregator = spec.get_beacon_committee(
+        state, attestation.data.slot, attestation.data.index)[0]
+    aap = spec.get_aggregate_and_proof(
+        state, aggregator, attestation, privkeys[aggregator])
+    assert aap.aggregator_index == aggregator
+    signature = spec.get_aggregate_and_proof_signature(
+        state, aap, privkeys[aggregator])
+    # verify against the published pubkey
+    domain = spec.get_domain(
+        state, spec.DOMAIN_AGGREGATE_AND_PROOF,
+        spec.compute_epoch_at_slot(attestation.data.slot))
+    signing_root = spec.compute_signing_root(aap, domain)
+    assert bls.Verify(pubkeys[aggregator], signing_root, signature)
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_vote_default_and_majority(spec, state):
+    # mock genesis uses genesis_time=0, putting the voting period start
+    # before any candidate block could exist; give it a real clock
+    state.genesis_time = 10**9
+    period_start = spec.voting_period_start_time(state)
+    follow = (spec.config.SECONDS_PER_ETH1_BLOCK
+              * spec.config.ETH1_FOLLOW_DISTANCE)
+    blocks = [spec.Eth1Block(timestamp=max(0, period_start - follow - i),
+                             deposit_root=spec.Root(bytes([i]) * 32),
+                             deposit_count=state.eth1_data.deposit_count + i)
+              for i in range(1, 4)]
+    vote = spec.get_eth1_vote(state, blocks)
+    # no prior votes: default = latest candidate block's data
+    assert vote == spec.get_eth1_data(blocks[-1]) or vote == state.eth1_data
+
+    # now cast a majority of votes for one candidate
+    target = spec.get_eth1_data(blocks[0])
+    for _ in range(2):
+        state.eth1_data_votes.append(target)
+    vote = spec.get_eth1_vote(state, blocks)
+    assert vote == target
+
+
+@with_all_phases
+@spec_state_test
+def test_compute_subscribed_subnets(spec, state):
+    for node_id in (0, 1, 2**255 + 12345):
+        subnets = spec.compute_subscribed_subnets(node_id, epoch=5)
+        assert len(subnets) == spec.SUBNETS_PER_NODE
+        for s in subnets:
+            assert 0 <= s < spec.ATTESTATION_SUBNET_COUNT
+        # stable within the subscription period
+        assert subnets == spec.compute_subscribed_subnets(node_id, epoch=5)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_weak_subjectivity_period(spec, state):
+    ws_period = spec.compute_weak_subjectivity_period(state)
+    # at least the withdrawability delay
+    assert ws_period >= spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+
+    # store within the period accepts; far-future store rejects
+    header = state.latest_block_header.copy()
+    if header.state_root == spec.Root():
+        header.state_root = spec.hash_tree_root(state)
+    ws_checkpoint = spec.Checkpoint(
+        epoch=spec.compute_epoch_at_slot(state.slot), root=header.state_root)
+
+    class _Store:
+        time = int(state.genesis_time
+                   + spec.config.SECONDS_PER_SLOT * state.slot)
+        genesis_time = int(state.genesis_time)
+    ws_state = state.copy()
+    ws_state.latest_block_header.state_root = header.state_root
+    assert spec.is_within_weak_subjectivity_period(
+        _Store(), ws_state, ws_checkpoint)
+
+    far_future_time = int(state.genesis_time + spec.config.SECONDS_PER_SLOT
+                          * (state.slot + (int(ws_period) + 2)
+                             * spec.SLOTS_PER_EPOCH))
+
+    class _LateStore:
+        time = far_future_time
+        genesis_time = int(state.genesis_time)
+    assert not spec.is_within_weak_subjectivity_period(
+        _LateStore(), ws_state, ws_checkpoint)
+
+
+@with_phases(["altair", "bellatrix", "capella", "deneb"])
+@spec_state_test
+@always_bls
+def test_sync_committee_duties(spec, state):
+    committee_pubkeys = list(state.current_sync_committee.pubkeys)
+    all_pubkeys = [bytes(v.pubkey) for v in state.validators]
+    validator_index = all_pubkeys.index(bytes(committee_pubkeys[0]))
+
+    # message construction + signature verifies
+    block_root = spec.Root(b"\x25" * 32)
+    msg = spec.get_sync_committee_message(
+        state, block_root, validator_index, privkeys[validator_index])
+    domain = spec.get_domain(state, spec.DOMAIN_SYNC_COMMITTEE,
+                             spec.get_current_epoch(state))
+    signing_root = spec.compute_signing_root(block_root, domain)
+    assert bls.Verify(pubkeys[validator_index], signing_root, msg.signature)
+
+    # subnets for a committee member are in range and non-empty
+    subnets = spec.compute_subnets_for_sync_committee(state, validator_index)
+    assert subnets and all(
+        0 <= s < spec.SYNC_COMMITTEE_SUBNET_COUNT for s in subnets)
+
+    # selection proof + aggregator determinism
+    proof = spec.get_sync_committee_selection_proof(
+        state, state.slot, list(subnets)[0], privkeys[validator_index])
+    assert isinstance(spec.is_sync_committee_aggregator(proof), bool)
+
+    # contribution-and-proof signature verifies
+    contribution = spec.SyncCommitteeContribution(
+        slot=state.slot, beacon_block_root=block_root,
+        subcommittee_index=list(subnets)[0])
+    contribution.aggregation_bits[0] = True
+    contribution.signature = msg.signature
+    cap = spec.get_contribution_and_proof(
+        state, validator_index, contribution, privkeys[validator_index])
+    sig = spec.get_contribution_and_proof_signature(
+        state, cap, privkeys[validator_index])
+    domain = spec.get_domain(state, spec.DOMAIN_CONTRIBUTION_AND_PROOF,
+                             spec.compute_epoch_at_slot(contribution.slot))
+    signing_root = spec.compute_signing_root(cap, domain)
+    assert bls.Verify(pubkeys[validator_index], signing_root, sig)
