@@ -106,10 +106,29 @@ def expect_assertion_error(fn):
         raise AssertionError("expected an assertion error, but got none")
 
 
+# Set by the vector generator (gen/gen_runner.py): a callable receiving
+# every yielded (name, value) part.  Under pytest it stays None and parts
+# are consumed and discarded — the reference's two-consumption-mode design
+# (context.py vector_test + gen_runner is_pytest flag).
+VECTOR_COLLECTOR = None
+
+
 def _consume(result):
-    """Run a test generator to completion (pytest mode discards the parts)."""
-    if result is not None and hasattr(result, "__iter__"):
-        return list(result)
+    """Run a test generator to completion (pytest mode discards the parts;
+    generator mode forwards them to VECTOR_COLLECTOR).
+
+    Only live generators forward: nested decorators (@always_bls inside
+    @spec_test) call _consume twice, and re-forwarding the returned list
+    would hand the collector already-mutated state objects."""
+    import inspect
+    if inspect.isgenerator(result):
+        if VECTOR_COLLECTOR is None:
+            return list(result)
+        out = []
+        for part in result:
+            VECTOR_COLLECTOR(part)
+            out.append(part)
+        return out
     return result
 
 

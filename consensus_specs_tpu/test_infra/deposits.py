@@ -79,6 +79,34 @@ def deposit_from_context(spec, deposit_data_list, index):
     return deposit, root, deposit_data_list
 
 
+def prepare_full_genesis_deposits(spec, amount, deposit_count, signed=False,
+                                  duplicate_last=False):
+    """Build ``deposit_count`` genesis deposits whose proofs verify against
+    the incrementally-growing deposit tree, the way
+    ``initialize_beacon_state_from_eth1`` consumes them
+    (reference helpers/deposits.py prepare_full_genesis_deposits)."""
+    deposit_data_list = []
+    genesis_deposits = []
+    for index in range(deposit_count):
+        key_index = index if not (duplicate_last
+                                  and index == deposit_count - 1) else index - 1
+        pubkey = pubkeys[key_index]
+        privkey = privkeys[key_index]
+        withdrawal_credentials = spec.BLS_WITHDRAWAL_PREFIX + hash(pubkey)[1:]
+        deposit_data = build_deposit_data(
+            spec, pubkey, privkey, amount, withdrawal_credentials,
+            signed=signed)
+        deposit_data_list.append(deposit_data)
+        # genesis proof: against the tree of deposits seen SO FAR
+        # (the list holds exactly index+1 items here).  NOTE: keyed off the
+        # 8192-entry test key pool and O(n^2) tree rebuilds — minimal-preset
+        # genesis counts only (callers guard with @with_presets).
+        deposit, root, _ = deposit_from_context(
+            spec, deposit_data_list, index)
+        genesis_deposits.append(deposit)
+    return genesis_deposits, root, deposit_data_list
+
+
 def prepare_state_and_deposit(spec, state, validator_index, amount,
                               withdrawal_credentials=None, signed=False):
     """Prepare the state for the deposit, and create a deposit for the given

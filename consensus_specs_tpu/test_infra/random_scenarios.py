@@ -1,0 +1,111 @@
+"""Randomized multi-epoch scenario machine.
+
+Reference: ``test/utils/randomized_block_tests.py`` (randomize_state :60,
+random block/epoch transition compositions :239-430) — seeded scenarios
+that mutate registry/participation state and then keep producing valid
+blocks, catching cross-component interactions single-purpose tests miss.
+"""
+from random import Random
+
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+from .attestations import get_valid_attestation
+from .block import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block,
+    next_slots, next_epoch,
+)
+from .slashings import get_valid_proposer_slashing, get_valid_attester_slashing
+from .voluntary_exits import prepare_signed_exits
+
+
+def randomize_state(spec, state, rng: Random, exit_fraction=0.1,
+                    slash_fraction=0.1):
+    """Scatter balances, exits and slashings across the registry
+    (reference randomized_block_tests.py:60)."""
+    for index in range(len(state.validators)):
+        balance = int(state.balances[index])
+        offset = rng.randint(-1, 1) * spec.EFFECTIVE_BALANCE_INCREMENT // 4
+        state.balances[index] = max(0, balance + offset)
+        roll = rng.random()
+        if roll < exit_fraction:
+            spec.initiate_validator_exit(state, index)
+        elif roll < exit_fraction + slash_fraction:
+            spec.slash_validator(state, index)
+    randomize_participation(spec, state, rng)
+    return state
+
+
+def randomize_participation(spec, state, rng: Random):
+    if spec.fork == "phase0":
+        return  # pending attestations accumulate naturally
+    for index in range(len(state.validators)):
+        state.previous_epoch_participation[index] = \
+            spec.ParticipationFlags(rng.randint(0, 7))
+        state.current_epoch_participation[index] = \
+            spec.ParticipationFlags(rng.randint(0, 7))
+    if hasattr(state, "inactivity_scores"):
+        for index in range(len(state.validators)):
+            state.inactivity_scores[index] = rng.randint(0, 10)
+
+
+def random_block(spec, state, rng: Random):
+    """A valid block with a random mix of attestations and occasional
+    slashings/exits, built against the current state."""
+    block = build_empty_block_for_next_slot(spec, state)
+
+    # attestations for a recent slot (if deep enough into the chain)
+    if state.slot >= spec.MIN_ATTESTATION_INCLUSION_DELAY:
+        slot_to_attest = state.slot - spec.MIN_ATTESTATION_INCLUSION_DELAY + 1
+        if slot_to_attest >= spec.compute_start_slot_at_epoch(
+                spec.compute_epoch_at_slot(state.slot)) \
+                and slot_to_attest <= state.slot:
+            committees = spec.get_committee_count_per_slot(
+                state, spec.compute_epoch_at_slot(slot_to_attest))
+            for index in range(committees):
+                if rng.random() < 0.8:
+                    att = get_valid_attestation(
+                        spec, state, slot_to_attest, index=index,
+                        filter_participant_set=lambda c: set(
+                            i for i in c if rng.random() < 0.9),
+                        signed=True)
+                    if any(att.aggregation_bits):
+                        block.body.attestations.append(att)
+
+    # occasional voluntary exit of a never-touched validator
+    if rng.random() < 0.15:
+        current_epoch = spec.get_current_epoch(state)
+        candidates = [
+            i for i in spec.get_active_validator_indices(state, current_epoch)
+            if state.validators[i].exit_epoch == spec.FAR_FUTURE_EPOCH
+            and current_epoch >= state.validators[i].activation_epoch
+            + spec.config.SHARD_COMMITTEE_PERIOD]
+        if candidates:
+            index = rng.choice(candidates)
+            block.body.voluntary_exits = prepare_signed_exits(
+                spec, state, [index])
+    return block
+
+
+def run_random_scenario(spec, state, seed: int, epochs=2,
+                        blocks_per_epoch=4):
+    """Seeded scenario: randomize, then alternate empty slots and random
+    blocks for several epochs; every block must transition cleanly."""
+    rng = Random(seed)
+    # warm the chain past genesis so attestations/exits are possible
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    randomize_state(spec, state, rng, exit_fraction=0.05, slash_fraction=0.05)
+
+    signed_blocks = []
+    for _ in range(epochs):
+        for _ in range(blocks_per_epoch):
+            if rng.random() < 0.3:
+                next_slots(spec, state, rng.randint(1, 2))
+            block = random_block(spec, state, rng)
+            signed = state_transition_and_sign_block(spec, state, block)
+            signed_blocks.append(signed)
+        # let epoch processing churn through the randomized registry
+        next_epoch(spec, state)
+    # final sanity: the state merkleizes and keeps processing slots
+    assert hash_tree_root(state) is not None
+    next_slots(spec, state, 1)
+    return signed_blocks

@@ -1,0 +1,155 @@
+"""Rewards-deltas test machinery.
+
+Reference: ``test/helpers/rewards.py`` (the 520-LoC ``run_deltas`` family):
+run each reward component in isolation, emit its per-validator deltas as
+vector parts, and sanity-check them against spec invariants.
+"""
+from random import Random
+
+from consensus_specs_tpu.utils.ssz import List, uint64
+
+
+def _deltas_list(spec, values):
+    return List[uint64, spec.VALIDATOR_REGISTRY_LIMIT](
+        [uint64(int(v)) for v in values])
+
+
+def has_enough_for_reward(spec, state, index) -> bool:
+    """A validator with a tiny balance may earn a zero reward; exclude
+    those from 'must be rewarded' assertions (reference rewards.py)."""
+    return (state.validators[index].effective_balance
+            * spec.BASE_REWARD_FACTOR
+            > spec.integer_squareroot(spec.get_total_active_balance(state))
+            // spec.BASE_REWARDS_PER_EPOCH)
+
+
+def run_deltas(spec, state):
+    """Yield deltas for every reward component (phase0: source/target/head/
+    inclusion-delay/inactivity; altair+: per-flag + inactivity)."""
+    if spec.fork == "phase0":
+        yield from run_attestation_component_deltas(
+            spec, state, spec.get_source_deltas, "source_deltas",
+            spec.get_matching_source_attestations)
+        yield from run_attestation_component_deltas(
+            spec, state, spec.get_target_deltas, "target_deltas",
+            spec.get_matching_target_attestations)
+        yield from run_attestation_component_deltas(
+            spec, state, spec.get_head_deltas, "head_deltas",
+            spec.get_matching_head_attestations)
+        yield from run_get_inclusion_delay_deltas(spec, state)
+    else:
+        for flag_index in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+            rewards, penalties = spec.get_flag_index_deltas(state, flag_index)
+            yield f"flag_index_{flag_index}_deltas", {
+                "rewards": _deltas_list(spec, rewards),
+                "penalties": _deltas_list(spec, penalties)}
+    yield from run_get_inactivity_penalty_deltas(spec, state)
+
+
+def run_attestation_component_deltas(spec, state, component_delta_fn,
+                                     deltas_name, matching_att_fn):
+    """One of source/target/head: attesters rewarded, non-attesters
+    penalized (reference rewards.py run_attestation_component_deltas)."""
+    rewards, penalties = component_delta_fn(state)
+    yield deltas_name, {"rewards": _deltas_list(spec, rewards),
+                        "penalties": _deltas_list(spec, penalties)}
+
+    matching_attestations = matching_att_fn(
+        state, spec.get_previous_epoch(state))
+    matching_indices = spec.get_unslashed_attesting_indices(
+        state, matching_attestations)
+    eligible_indices = set(spec.get_eligible_validator_indices(state))
+    for index in range(len(state.validators)):
+        if index not in eligible_indices:
+            assert rewards[index] == 0 and penalties[index] == 0
+            continue
+        if index in matching_indices:
+            if has_enough_for_reward(spec, state, index) \
+                    and not spec.is_in_inactivity_leak(state):
+                assert rewards[index] > 0
+            assert penalties[index] == 0
+        else:
+            assert rewards[index] == 0
+            if has_enough_for_reward(spec, state, index):
+                assert penalties[index] > 0
+
+
+def run_get_inclusion_delay_deltas(spec, state):
+    rewards, penalties = spec.get_inclusion_delay_deltas(state)
+    yield "inclusion_delay_deltas", {
+        "rewards": _deltas_list(spec, rewards),
+        "penalties": _deltas_list(spec, penalties)}
+    # inclusion delay never penalizes (beacon-chain.md:1512)
+    assert all(p == 0 for p in penalties)
+
+
+def run_get_inactivity_penalty_deltas(spec, state):
+    rewards, penalties = spec.get_inactivity_penalty_deltas(state)
+    yield "inactivity_penalty_deltas", {
+        "rewards": _deltas_list(spec, rewards),
+        "penalties": _deltas_list(spec, penalties)}
+    # inactivity never rewards
+    assert all(r == 0 for r in rewards)
+    if not spec.is_in_inactivity_leak(state):
+        if spec.fork == "phase0":
+            # outside a leak, phase0 still charges the base-reward offset
+            return
+        assert all(p == 0 for p in penalties)
+
+
+# ---------------------------------------------------------------------------
+# state preparation
+# ---------------------------------------------------------------------------
+
+def prepare_state_with_attestations(spec, state, participation_fn=None):
+    """Attest every slot of one full epoch, including each attestation
+    after MIN_ATTESTATION_INCLUSION_DELAY (reference rewards.py
+    prepare_state_with_attestations)."""
+    from .attestations import get_valid_attestation
+    from .block import next_slot
+
+    start_epoch = spec.get_current_epoch(state)
+    attestations = []
+    pending = []  # (creation slot, [attestations])
+    for iteration in range(spec.SLOTS_PER_EPOCH
+                           + spec.MIN_ATTESTATION_INCLUSION_DELAY):
+        if iteration < spec.SLOTS_PER_EPOCH:
+            committees = spec.get_committee_count_per_slot(
+                state, spec.get_current_epoch(state))
+            slot_atts = []
+            for index in range(committees):
+                def participants(comm):
+                    if participation_fn is None:
+                        return comm
+                    return participation_fn(comm)
+                attestation = get_valid_attestation(
+                    spec, state, state.slot, index=index,
+                    filter_participant_set=participants, signed=False)
+                if any(attestation.aggregation_bits):
+                    slot_atts.append(attestation)
+            pending.append((state.slot, slot_atts))
+        next_slot(spec, state)
+        while pending and pending[0][0] \
+                + spec.MIN_ATTESTATION_INCLUSION_DELAY <= state.slot:
+            _, atts = pending.pop(0)
+            for attestation in atts:
+                spec.process_attestation(state, attestation)
+                attestations.append(attestation)
+    assert spec.get_current_epoch(state) == start_epoch + 1
+    if spec.fork == "phase0" and participation_fn is None:
+        assert len(state.previous_epoch_attestations) == len(attestations)
+    return attestations
+
+
+def randomize_participation(rng: Random, fraction=0.7):
+    def participation_fn(committee):
+        return set(i for i in committee if rng.random() < fraction)
+    return participation_fn
+
+
+def set_state_in_leak(spec, state):
+    """Advance far enough past finality to trigger the inactivity leak."""
+    from .block import next_epoch
+    for _ in range(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY + 2):
+        next_epoch(spec, state)
+    assert spec.is_in_inactivity_leak(state)

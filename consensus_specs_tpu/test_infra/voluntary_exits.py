@@ -14,9 +14,23 @@ def prepare_signed_exits(spec, state, indices, fork_version=None):
     return [create_signed_exit(index) for index in indices]
 
 
+def _is_post_deneb(spec) -> bool:
+    from .context import ALL_PHASES
+    return spec.fork in ALL_PHASES \
+        and ALL_PHASES.index(spec.fork) >= ALL_PHASES.index("deneb")
+
+
 def sign_voluntary_exit(spec, state, voluntary_exit, privkey, fork_version=None):
     if fork_version is None:
-        domain = spec.get_domain(state, spec.DOMAIN_VOLUNTARY_EXIT, voluntary_exit.epoch)
+        if _is_post_deneb(spec):
+            # EIP-7044: deneb onward pins exits to the capella fork domain
+            # (specs/deneb/beacon-chain.md:411)
+            domain = spec.compute_domain(
+                spec.DOMAIN_VOLUNTARY_EXIT, spec.config.CAPELLA_FORK_VERSION,
+                state.genesis_validators_root)
+        else:
+            domain = spec.get_domain(state, spec.DOMAIN_VOLUNTARY_EXIT,
+                                     voluntary_exit.epoch)
     else:
         domain = spec.compute_domain(
             spec.DOMAIN_VOLUNTARY_EXIT, fork_version, state.genesis_validators_root)

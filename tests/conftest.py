@@ -12,7 +12,6 @@ Test-facing flags mirror the reference harness
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: the container env sets axon (TPU)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
@@ -20,9 +19,14 @@ if "xla_force_host_platform_device_count" not in _flags:
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # Shared persistent XLA compile cache (keyed by jaxlib/libtpu build); the
-# BLS pairing programs are big — cache them across pytest runs.
-from consensus_specs_tpu.utils.jax_env import setup_compile_cache  # noqa: E402
+# BLS pairing programs are big — cache them across pytest runs.  Then pin
+# the whole test session to the host-CPU platform: the container's
+# accelerator plugin force-selects the tunnel-backed backend via
+# jax.config, and tests must never hang on that tunnel.
+from consensus_specs_tpu.utils.jax_env import (  # noqa: E402
+    setup_compile_cache, force_cpu_platform)
 setup_compile_cache()
+force_cpu_platform()
 
 
 def pytest_addoption(parser):
@@ -30,8 +34,14 @@ def pytest_addoption(parser):
                      help="preset to run tests with: minimal or mainnet")
     parser.addoption("--fork", action="store", default=None,
                      help="restrict tests to one fork")
+    # BLS is disabled by default for suite speed, exactly like the
+    # reference's `make test` (Makefile:118-120); @always_bls tests force
+    # signature checks regardless, and --enable-bls turns them on
+    # everywhere (the reference's citest mode).
+    parser.addoption("--enable-bls", action="store_true", default=False,
+                     help="verify BLS signatures in every test")
     parser.addoption("--disable-bls", action="store_true", default=False,
-                     help="skip BLS verification for speed where tests allow it")
+                     help="(default) skip BLS checks where tests allow it")
     parser.addoption("--bls-type", action="store", default="py",
                      choices=["py", "jax", "fastest"],
                      help="BLS backend")
@@ -40,7 +50,8 @@ def pytest_addoption(parser):
 def pytest_configure(config):
     from consensus_specs_tpu.test_infra import context as ctx
     ctx.DEFAULT_TEST_PRESET = config.getoption("--preset")
-    ctx.DEFAULT_BLS_ACTIVE = not config.getoption("--disable-bls")
+    ctx.DEFAULT_BLS_ACTIVE = (config.getoption("--enable-bls")
+                              and not config.getoption("--disable-bls"))
     ctx.DEFAULT_BLS_TYPE = config.getoption("--bls-type")
     only_fork = config.getoption("--fork")
     if only_fork:

@@ -1,0 +1,164 @@
+"""Justification/finalization over multi-epoch attestation patterns.
+
+Reference model: ``test/phase0/finality/test_finality.py`` — the
+23/123/12-rule scenarios of ``weigh_justification_and_finalization``
+(``specs/phase0/beacon-chain.md:1359``).
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases,
+)
+from consensus_specs_tpu.test_infra.block import next_epoch
+from consensus_specs_tpu.test_infra.attestations import (
+    next_epoch_with_attestations,
+)
+
+
+def check_finality(spec, state, prev_state, current_justified_changed,
+                   previous_justified_changed, finalized_changed):
+    if current_justified_changed:
+        assert state.current_justified_checkpoint.epoch > \
+            prev_state.current_justified_checkpoint.epoch
+        assert state.current_justified_checkpoint.root != \
+            prev_state.current_justified_checkpoint.root
+    else:
+        assert state.current_justified_checkpoint == \
+            prev_state.current_justified_checkpoint
+    if previous_justified_changed:
+        assert state.previous_justified_checkpoint.epoch > \
+            prev_state.previous_justified_checkpoint.epoch
+    else:
+        assert state.previous_justified_checkpoint == \
+            prev_state.previous_justified_checkpoint
+    if finalized_changed:
+        assert state.finalized_checkpoint.epoch > \
+            prev_state.finalized_checkpoint.epoch
+        assert state.finalized_checkpoint.root != \
+            prev_state.finalized_checkpoint.root
+    else:
+        assert state.finalized_checkpoint == prev_state.finalized_checkpoint
+
+
+@with_all_phases
+@spec_state_test
+def test_finality_no_updates_at_genesis(spec, state):
+    assert spec.get_current_epoch(state) == spec.GENESIS_EPOCH
+    yield "pre", state
+    blocks = []
+    # genesis and genesis+1 epochs skip FFG updates entirely
+    for _ in range(2):
+        prev_state, new_blocks, state = next_epoch_with_attestations(
+            spec, state, True, False)
+        blocks += new_blocks
+        check_finality(spec, state, prev_state, False, False, False)
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_finality_rule_4(spec, state):
+    """Two consecutive justified epochs finalize the first (rule 4: bits
+    0-1 justified, current source)."""
+    yield "pre", state
+    blocks = []
+    for epoch in range(4):
+        prev_state, new_blocks, state = next_epoch_with_attestations(
+            spec, state, True, False)
+        blocks += new_blocks
+        if epoch == 2:
+            check_finality(spec, state, prev_state, True, False, False)
+        elif epoch == 3:
+            # justified from epoch 2, finalized via rule 4
+            check_finality(spec, state, prev_state, True, True, True)
+            assert state.finalized_checkpoint == \
+                prev_state.current_justified_checkpoint
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_finality_rule_1(spec, state):
+    """Finalize with attestations to the previous epoch only (rule 1:
+    bits 1-2 justified, previous source)."""
+    # pump up to epoch 2 with real blocks (FFG active, distinct roots)
+    prev_state, blocks_a, state = next_epoch_with_attestations(
+        spec, state, False, False)
+    prev_state, blocks_b, state = next_epoch_with_attestations(
+        spec, state, False, False)
+    yield "pre", state
+    blocks = []
+    for epoch in range(3):
+        prev_state, new_blocks, state = next_epoch_with_attestations(
+            spec, state, False, True)
+        blocks += new_blocks
+        if epoch == 0:
+            check_finality(spec, state, prev_state, True, False, False)
+        elif epoch == 1:
+            check_finality(spec, state, prev_state, True, True, False)
+        elif epoch == 2:
+            check_finality(spec, state, prev_state, True, True, True)
+            assert state.finalized_checkpoint == \
+                prev_state.previous_justified_checkpoint
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_finality_rule_2(spec, state):
+    """Skip an epoch of attestations, then finalize via previous-epoch
+    attestations (rule 2: bits 1-2, two-epoch gap to current)."""
+    prev_state, _, state = next_epoch_with_attestations(
+        spec, state, False, False)
+    prev_state, _, state = next_epoch_with_attestations(
+        spec, state, False, False)
+    yield "pre", state
+    blocks = []
+    for epoch in range(3):
+        if epoch == 0:
+            prev_state, new_blocks, state = next_epoch_with_attestations(
+                spec, state, True, False)
+            check_finality(spec, state, prev_state, True, False, False)
+        elif epoch == 1:
+            prev_state, new_blocks, state = next_epoch_with_attestations(
+                spec, state, False, False)
+            check_finality(spec, state, prev_state, False, True, False)
+        elif epoch == 2:
+            prev_state, new_blocks, state = next_epoch_with_attestations(
+                spec, state, False, True)
+            # finalized old current -> rule 2
+            check_finality(spec, state, prev_state, True, False, True)
+        blocks += new_blocks
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_finality_rule_3(spec, state):
+    """Reference scenario: justify current, miss one, re-justify both —
+    finality via rule 3 (bits 0-2 justified, current source two back)."""
+    yield "pre", state
+    blocks = []
+    # epochs 0..3: full current-epoch attesting until finality flows
+    for _ in range(4):
+        prev_state, new_blocks, state = next_epoch_with_attestations(
+            spec, state, True, False)
+        blocks += new_blocks
+    check_finality(spec, state, prev_state, True, True, True)
+
+    # skip an epoch of attesting
+    prev_state, new_blocks, state = next_epoch_with_attestations(
+        spec, state, False, False)
+    blocks += new_blocks
+    check_finality(spec, state, prev_state, False, True, False)
+
+    # attest previous + current: catches up via rule 3; the previous
+    # justified checkpoint re-anchors to the same epoch-3 checkpoint
+    prev_state, new_blocks, state = next_epoch_with_attestations(
+        spec, state, True, True)
+    blocks += new_blocks
+    check_finality(spec, state, prev_state, True, False, True)
+    yield "blocks", blocks
+    yield "post", state

@@ -1,0 +1,112 @@
+"""Genesis initialization and validity tests.
+
+Reference model: ``test/phase0/genesis/test_initialization.py`` /
+``test_validity.py`` against ``initialize_beacon_state_from_eth1``
+(``specs/phase0/beacon-chain.md:1195``) and ``is_valid_genesis_state``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_test, with_phases, with_presets, single_phase,
+)
+from consensus_specs_tpu.test_infra.deposits import (
+    prepare_full_genesis_deposits,
+)
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+
+def _eth1_params(spec):
+    return spec.Hash32(b"\x12" * 32), spec.uint64(1578009600)
+
+
+@with_phases(["phase0"])
+@with_presets(["minimal"], reason="mainnet genesis counts exceed the test key pool")
+@spec_test
+@single_phase
+def test_initialize_beacon_state_from_eth1(spec):
+    deposit_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+    deposits, deposit_root, _ = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE, deposit_count, signed=True)
+    eth1_block_hash, eth1_timestamp = _eth1_params(spec)
+
+    yield "eth1_block_hash", eth1_block_hash
+    yield "eth1_timestamp", eth1_timestamp
+    yield "deposits", deposits
+
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, eth1_timestamp, deposits)
+
+    assert state.genesis_time == \
+        eth1_timestamp + spec.config.GENESIS_DELAY
+    assert len(state.validators) == deposit_count
+    assert state.eth1_data.deposit_root == deposit_root
+    assert state.eth1_data.deposit_count == deposit_count
+    assert state.eth1_data.block_hash == eth1_block_hash
+    assert spec.get_total_active_balance(state) == \
+        deposit_count * spec.MAX_EFFECTIVE_BALANCE
+    # every genesis validator activated immediately
+    for v in state.validators:
+        assert v.activation_epoch == spec.GENESIS_EPOCH
+    assert state.genesis_validators_root == hash_tree_root(state.validators)
+    yield "state", state
+
+
+@with_phases(["phase0"])
+@with_presets(["minimal"], reason="mainnet genesis counts exceed the test key pool")
+@spec_test
+@single_phase
+def test_initialize_duplicate_pubkey_deposit_tops_up(spec):
+    """A second deposit for an existing pubkey adds balance, not a
+    validator (beacon-chain.md:1877 apply_deposit else-branch)."""
+    deposit_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT + 1
+    deposits, _, deposit_data_list = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE, deposit_count, signed=True,
+        duplicate_last=True)
+    eth1_block_hash, eth1_timestamp = _eth1_params(spec)
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, eth1_timestamp, deposits)
+    # one fewer validator than deposits; the duplicate topped up instead
+    assert len(state.validators) == deposit_count - 1
+    assert state.balances[deposit_count - 2] == \
+        2 * spec.MAX_EFFECTIVE_BALANCE
+
+
+@with_phases(["phase0"])
+@with_presets(["minimal"], reason="mainnet genesis counts exceed the test key pool")
+@spec_test
+@single_phase
+def test_is_valid_genesis_state_true(spec):
+    deposit_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+    deposits, _, _ = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE, deposit_count, signed=True)
+    eth1_block_hash, eth1_timestamp = _eth1_params(spec)
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, eth1_timestamp, deposits)
+    assert spec.is_valid_genesis_state(state)
+
+
+@with_phases(["phase0"])
+@with_presets(["minimal"], reason="mainnet genesis counts exceed the test key pool")
+@spec_test
+@single_phase
+def test_is_valid_genesis_state_false_invalid_timestamp(spec):
+    deposit_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+    deposits, _, _ = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE, deposit_count, signed=True)
+    eth1_block_hash, _ = _eth1_params(spec)
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, spec.uint64(0), deposits)
+    if spec.config.MIN_GENESIS_TIME > spec.config.GENESIS_DELAY:
+        assert not spec.is_valid_genesis_state(state)
+
+
+@with_phases(["phase0"])
+@with_presets(["minimal"], reason="mainnet genesis counts exceed the test key pool")
+@spec_test
+@single_phase
+def test_is_valid_genesis_state_false_not_enough_validators(spec):
+    deposit_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT - 1
+    deposits, _, _ = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE, deposit_count, signed=True)
+    eth1_block_hash, eth1_timestamp = _eth1_params(spec)
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, eth1_timestamp, deposits)
+    assert not spec.is_valid_genesis_state(state)

@@ -1,0 +1,39 @@
+"""Seeded randomized scenarios across all forks.
+
+Reference model: ``tests/generators/random/main.py`` scenarios compiled
+from ``test/utils/randomized_block_tests.py``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases,
+)
+from consensus_specs_tpu.test_infra.random_scenarios import (
+    run_random_scenario,
+)
+
+
+@with_all_phases
+@spec_state_test
+def test_random_scenario_0(spec, state):
+    yield "pre", state
+    blocks = run_random_scenario(spec, state, seed=440)
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_random_scenario_1(spec, state):
+    yield "pre", state
+    blocks = run_random_scenario(spec, state, seed=7021)
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_random_scenario_2_longer(spec, state):
+    yield "pre", state
+    blocks = run_random_scenario(spec, state, seed=90210, epochs=3,
+                                 blocks_per_epoch=3)
+    yield "blocks", blocks
+    yield "post", state

@@ -1,0 +1,98 @@
+"""Rewards-deltas suite.
+
+Reference model: ``test/phase0/rewards/test_basic.py`` /
+``test_random.py`` / ``test_leak.py`` through the
+``helpers/rewards.py`` machinery.
+"""
+from random import Random
+
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases,
+)
+from consensus_specs_tpu.test_infra import rewards as rw
+from consensus_specs_tpu.test_infra.block import next_epoch
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_full_participation(spec, state):
+    rw.prepare_state_with_attestations(spec, state)
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_empty_participation(spec, state):
+    rw.prepare_state_with_attestations(spec, state,
+                                       participation_fn=lambda c: set())
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_half_participation_random(spec, state):
+    rng = Random(5566)
+    rw.prepare_state_with_attestations(
+        spec, state, participation_fn=rw.randomize_participation(rng, 0.5))
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_leak_full_participation(spec, state):
+    rw.set_state_in_leak(spec, state)
+    rw.prepare_state_with_attestations(spec, state)
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_leak_empty_participation(spec, state):
+    rw.set_state_in_leak(spec, state)
+    rw.prepare_state_with_attestations(spec, state,
+                                       participation_fn=lambda c: set())
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_with_slashed_validators(spec, state):
+    rng = Random(7788)
+    rw.prepare_state_with_attestations(spec, state)
+    # slash a handful of validators after the fact
+    for index in rng.sample(range(len(state.validators)), 4):
+        state.validators[index].slashed = True
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_balance_conservation_applies(spec, state):
+    """process_rewards_and_penalties applies exactly the computed deltas
+    (component by component, with the zero floor of decrease_balance)."""
+    rw.prepare_state_with_attestations(spec, state)
+    post = state.copy()
+    spec.process_rewards_and_penalties(post)
+
+    balances = [int(b) for b in state.balances]
+
+    def apply(rewards, penalties):
+        for i in range(len(balances)):
+            balances[i] += int(rewards[i])
+            balances[i] = 0 if int(penalties[i]) > balances[i] \
+                else balances[i] - int(penalties[i])
+
+    if spec.fork == "phase0":
+        apply(*spec.get_attestation_deltas(state))
+    else:
+        for flag_index in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+            apply(*spec.get_flag_index_deltas(state, flag_index))
+        apply(*spec.get_inactivity_penalty_deltas(state))
+
+    assert [int(b) for b in post.balances] == balances
