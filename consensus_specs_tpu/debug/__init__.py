@@ -1,0 +1,2 @@
+"""Debug encoding/decoding and randomized SSZ value generation
+(reference: ``eth2spec/debug/{encode,decode,random_value}.py``)."""

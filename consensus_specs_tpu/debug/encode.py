@@ -1,0 +1,52 @@
+"""SSZ value <-> YAML-able python structures.
+
+Reference: ``eth2spec/debug/encode.py`` / ``decode.py`` — uints encode as
+strings when they exceed YAML-safe integer range, byte types as 0x-hex,
+containers as dicts keyed by field name.
+"""
+from consensus_specs_tpu.utils.ssz.types import (
+    BasicValue, boolean, ByteVectorBase, ByteListBase, BitvectorBase,
+    BitlistBase, VectorBase, ListBase, Container, UnionBase,
+)
+
+
+def encode(value):
+    """Typed SSZ value -> dict/list/int/str for YAML output."""
+    if isinstance(value, boolean):
+        return bool(value)
+    if isinstance(value, BasicValue):
+        n = int(value)
+        return n if n < 2**53 else str(n)
+    if isinstance(value, (ByteVectorBase, ByteListBase)):
+        return "0x" + bytes(value).hex()
+    if isinstance(value, (BitvectorBase, BitlistBase)):
+        return "0x" + value.serialize().hex()
+    if isinstance(value, (VectorBase, ListBase)):
+        return [encode(v) for v in value]
+    if isinstance(value, Container):
+        return {name: encode(getattr(value, name))
+                for name in type(value).fields()}
+    if isinstance(value, UnionBase):
+        return {"selector": int(value.selector),
+                "value": None if value.value is None else encode(value.value)}
+    raise TypeError(f"cannot encode {type(value)}")
+
+
+def decode(data, typ):
+    """Inverse of :func:`encode` for a known SSZ type."""
+    from consensus_specs_tpu.utils.ssz.types import _ParamMeta  # noqa: F401
+    if issubclass(typ, boolean):
+        return typ(bool(data))
+    if issubclass(typ, BasicValue):
+        return typ(int(data))
+    if issubclass(typ, (ByteVectorBase, ByteListBase)):
+        return typ(bytes.fromhex(data[2:]) if isinstance(data, str) else data)
+    if issubclass(typ, (BitvectorBase, BitlistBase)):
+        raw = bytes.fromhex(data[2:]) if isinstance(data, str) else data
+        return typ.decode_bytes(raw)
+    if issubclass(typ, (VectorBase, ListBase)):
+        return typ([decode(v, typ.elem_type) for v in data])
+    if issubclass(typ, Container):
+        return typ(**{name: decode(data[name], ftype)
+                      for name, ftype in typ.fields().items()})
+    raise TypeError(f"cannot decode into {typ}")

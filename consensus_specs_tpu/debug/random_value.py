@@ -1,0 +1,107 @@
+"""Randomized SSZ value generation for ssz_static vectors.
+
+Reference: ``eth2spec/debug/random_value.py`` — ``RandomizationMode``
+controls the shape (pure random, zeroed, max-values, nil/one/max-length
+collections) so serializers get exercised across the edge cases.
+"""
+from enum import Enum
+from random import Random
+
+from consensus_specs_tpu.utils.ssz.types import (
+    BasicValue, boolean, ByteVectorBase, ByteListBase, BitvectorBase,
+    BitlistBase, VectorBase, ListBase, Container, UnionBase,
+)
+
+
+class RandomizationMode(Enum):
+    mode_random = 0
+    mode_zero = 1
+    mode_max = 2
+    mode_nil_count = 3
+    mode_one_count = 4
+    mode_max_count = 5
+
+    def is_changing(self) -> bool:
+        return self.value in (0, 4, 5)
+
+
+def get_random_ssz_object(rng: Random, typ, max_bytes_length: int,
+                          max_list_length: int, mode: RandomizationMode,
+                          chaos: bool = False):
+    """Build a value of ``typ`` under the randomization mode (reference
+    random_value.py:46)."""
+    if chaos:
+        mode = rng.choice(list(RandomizationMode))
+    if issubclass(typ, boolean):
+        return typ({RandomizationMode.mode_zero: 0,
+                    RandomizationMode.mode_max: 1}.get(mode, rng.randint(0, 1)))
+    if issubclass(typ, BasicValue):
+        if mode == RandomizationMode.mode_zero:
+            return typ(0)
+        if mode == RandomizationMode.mode_max:
+            return typ(2 ** (typ.byte_length * 8) - 1)
+        return typ(rng.randrange(2 ** (typ.byte_length * 8)))
+    if issubclass(typ, ByteVectorBase):
+        if mode == RandomizationMode.mode_zero:
+            return typ(b"\x00" * typ.length)
+        if mode == RandomizationMode.mode_max:
+            return typ(b"\xff" * typ.length)
+        return typ(bytes(rng.randrange(256) for _ in range(typ.length)))
+    if issubclass(typ, ByteListBase):
+        length = {
+            RandomizationMode.mode_nil_count: 0,
+            RandomizationMode.mode_one_count: min(1, typ.limit),
+            RandomizationMode.mode_max_count: min(max_bytes_length,
+                                                  typ.limit),
+            RandomizationMode.mode_zero: 0,
+        }.get(mode, rng.randint(0, min(max_bytes_length, typ.limit)))
+        fill = (b"\x00" if mode == RandomizationMode.mode_zero else
+                b"\xff" if mode == RandomizationMode.mode_max else None)
+        if fill is not None:
+            return typ(fill * length)
+        return typ(bytes(rng.randrange(256) for _ in range(length)))
+    if issubclass(typ, BitvectorBase):
+        if mode == RandomizationMode.mode_zero:
+            return typ([False] * typ.length)
+        if mode == RandomizationMode.mode_max:
+            return typ([True] * typ.length)
+        return typ([rng.randint(0, 1) == 1 for _ in range(typ.length)])
+    if issubclass(typ, BitlistBase):
+        length = {
+            RandomizationMode.mode_nil_count: 0,
+            RandomizationMode.mode_one_count: min(1, typ.limit),
+            RandomizationMode.mode_max_count: min(max_list_length, typ.limit),
+            RandomizationMode.mode_zero: 0,
+        }.get(mode, rng.randint(0, min(max_list_length, typ.limit)))
+        if mode == RandomizationMode.mode_zero:
+            return typ([False] * length)
+        return typ([rng.randint(0, 1) == 1 for _ in range(length)])
+    if issubclass(typ, VectorBase):
+        return typ([get_random_ssz_object(rng, typ.elem_type,
+                                          max_bytes_length, max_list_length,
+                                          mode, chaos)
+                    for _ in range(typ.length)])
+    if issubclass(typ, ListBase):
+        length = {
+            RandomizationMode.mode_nil_count: 0,
+            RandomizationMode.mode_one_count: min(1, typ.limit),
+            RandomizationMode.mode_max_count: min(max_list_length, typ.limit),
+        }.get(mode, rng.randint(0, min(max_list_length, typ.limit)))
+        return typ([get_random_ssz_object(rng, typ.elem_type,
+                                          max_bytes_length, max_list_length,
+                                          mode, chaos)
+                    for _ in range(length)])
+    if issubclass(typ, Container):
+        return typ(**{
+            name: get_random_ssz_object(rng, ftype, max_bytes_length,
+                                        max_list_length, mode, chaos)
+            for name, ftype in typ.fields().items()})
+    if issubclass(typ, UnionBase):
+        selector = rng.randrange(len(typ.options)) \
+            if mode == RandomizationMode.mode_random else 0
+        opt = typ.options[selector]
+        if opt is None:
+            return typ(0)
+        return typ(selector, get_random_ssz_object(
+            rng, opt, max_bytes_length, max_list_length, mode, chaos))
+    raise TypeError(f"cannot randomize {typ}")

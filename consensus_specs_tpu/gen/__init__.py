@@ -1,0 +1,11 @@
+"""Cross-client test-vector generation pipeline.
+
+Reference: ``eth2spec/gen_helpers/`` (gen_base/gen_runner.py +
+gen_from_tests/gen.py) and the 18 entrypoints under ``tests/generators/``.
+"""
+from .gen_typing import TestCase, TestProvider
+from .gen_runner import run_generator
+from .gen_from_tests import generate_from_tests, run_state_test_generators
+
+__all__ = ["TestCase", "TestProvider", "run_generator",
+           "generate_from_tests", "run_state_test_generators"]

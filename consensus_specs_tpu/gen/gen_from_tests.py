@@ -1,0 +1,73 @@
+"""Bridge: pytest-style test modules -> vector TestCases.
+
+Reference: ``gen_helpers/gen_from_tests/gen.py`` — reflect ``test_*``
+functions out of the suite modules and wrap each as a TestCase per
+fork x preset.  The same test code serves pytest and generation; the
+harness's VECTOR_COLLECTOR hook surfaces the yielded parts.
+"""
+import importlib
+import pkgutil
+
+from .gen_typing import TestCase, TestProvider
+
+
+def generate_from_tests(runner_name: str, handler_name: str, src,
+                        fork_name: str, preset_name: str,
+                        suite_name: str = "pyspec_tests",
+                        exec_fork: str = None):
+    """All test_* functions of module ``src`` as TestCases
+    (reference gen.py:17-60)."""
+    for name in dir(src):
+        if not name.startswith("test_"):
+            continue
+        case_fn = getattr(src, name)
+        if not callable(case_fn):
+            continue
+        yield TestCase(
+            fork_name=fork_name,
+            preset_name=preset_name,
+            runner_name=runner_name,
+            handler_name=handler_name,
+            suite_name=suite_name,
+            case_name=name[len("test_"):],
+            case_fn=case_fn,
+            exec_fork=exec_fork,
+        )
+
+
+def _prepare_bls():
+    """Generators force real signature crypto (reference gen.py:82-84
+    pins milagro; here: the fastest available backend)."""
+    from consensus_specs_tpu.test_infra import context as ctx
+    ctx.DEFAULT_BLS_ACTIVE = True
+    ctx.DEFAULT_BLS_TYPE = "fastest"
+
+
+def run_state_test_generators(runner_name: str, all_mods,
+                              presets=("minimal", "mainnet"), args=None,
+                              exec_forks=None):
+    """all_mods: {fork: {handler: module path}}; ``exec_forks`` optionally
+    maps a fork to the fork whose spec executes its tests (fork-upgrade
+    suites run under the pre-fork) (reference gen.py:103-136)."""
+    from .gen_runner import run_generator
+
+    def make_cases():
+        for preset_name in presets:
+            for fork_name, handlers in all_mods.items():
+                for handler_name, mod_path in handlers.items():
+                    mod = importlib.import_module(mod_path)
+                    yield from generate_from_tests(
+                        runner_name, handler_name, mod, fork_name,
+                        preset_name,
+                        exec_fork=(exec_forks or {}).get(fork_name))
+
+    provider = TestProvider(prepare=_prepare_bls, make_cases=make_cases)
+    return run_generator(runner_name, [provider], args)
+
+
+def combine_mods(dict_1, dict_2):
+    """Fork inheritance of handler modules: later forks re-run the earlier
+    fork's handlers plus their own (reference gen.py:119-136)."""
+    out = dict(dict_2)
+    out.update(dict_1)  # dict_1 (newer) wins on collision
+    return out

@@ -1,0 +1,207 @@
+"""Vector-generation runner.
+
+Reference: ``gen_base/gen_runner.py`` — CLI, skip-if-complete resume,
+INCOMPLETE tags, error log, diagnostics JSON, YAML + ssz-snappy part
+writers.  Output tree:
+``tests/<preset>/<fork>/<runner>/<handler>/<suite>/<case>/<part>``.
+"""
+import argparse
+import json
+import os
+import shutil
+import sys
+import time
+import traceback
+
+import yaml
+
+from consensus_specs_tpu.utils import snappy
+from consensus_specs_tpu.utils.ssz.types import SSZValue
+from consensus_specs_tpu.debug.encode import encode
+
+TIME_THRESHOLD_TO_PRINT = 1.0  # seconds (reference gen_base/settings.py)
+
+
+def _write_yaml(path: str, data) -> None:
+    with open(path, "w") as f:
+        yaml.safe_dump(data, f, default_flow_style=None, sort_keys=False)
+
+
+def _encode_meta(value):
+    if isinstance(value, SSZValue):
+        return encode(value)
+    if isinstance(value, bytes):
+        return "0x" + value.hex()
+    if isinstance(value, dict):
+        return {k: _encode_meta(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_encode_meta(v) for v in value]
+    return value
+
+
+def write_part(case_dir: str, name: str, value, meta: dict) -> None:
+    """One yielded (name, value) part -> file(s) (reference
+    gen_runner.py:399-426 output kinds)."""
+    if value is None:
+        return  # absent part (e.g. no post state for invalid cases)
+    if isinstance(value, SSZValue):
+        with open(os.path.join(case_dir, f"{name}.ssz_snappy"), "wb") as f:
+            f.write(snappy.compress(value.serialize()))
+    elif isinstance(value, (list, tuple)) and value \
+            and all(isinstance(v, SSZValue) for v in value):
+        for i, v in enumerate(value):
+            with open(os.path.join(case_dir, f"{name}_{i}.ssz_snappy"),
+                      "wb") as f:
+                f.write(snappy.compress(v.serialize()))
+        meta[f"{name}_count"] = len(value)
+    elif isinstance(value, (dict, list, tuple)):
+        _write_yaml(os.path.join(case_dir, f"{name}.yaml"),
+                    _encode_meta(value))
+    else:
+        meta[name] = _encode_meta(value)
+
+
+def generate_test_vector(test_case, output_dir: str, log) -> str:
+    """Run one case and materialize its part files (reference
+    gen_runner.py:304-361).  Returns 'generated'/'skipped'/'error'."""
+    from consensus_specs_tpu.test_infra import context as ctx
+
+    case_dir = os.path.join(output_dir, test_case.dir_path())
+    incomplete_tag = os.path.join(case_dir, "INCOMPLETE")
+
+    if os.path.exists(case_dir) and not os.path.exists(incomplete_tag):
+        return "skipped"
+    if os.path.exists(case_dir):
+        shutil.rmtree(case_dir)
+    os.makedirs(case_dir, exist_ok=True)
+    with open(incomplete_tag, "w") as f:
+        f.write("INCOMPLETE")
+
+    meta = {}
+    parts = []
+
+    def collector(part):
+        # snapshot NOW: the test keeps mutating the state object it just
+        # yielded (the 'pre' part must not turn into the post state)
+        name, value = part
+        if isinstance(value, SSZValue):
+            value = value.copy()
+        elif isinstance(value, (list, tuple)):
+            value = [v.copy() if isinstance(v, SSZValue) else v
+                     for v in value]
+        parts.append((name, value))
+
+    start = time.time()
+    old_collector = ctx.VECTOR_COLLECTOR
+    old_fork, old_preset = ctx.ONLY_FORK, ctx.DEFAULT_TEST_PRESET
+    ctx.VECTOR_COLLECTOR = collector
+    ctx.ONLY_FORK = test_case.exec_fork
+    ctx.DEFAULT_TEST_PRESET = test_case.preset_name
+    try:
+        try:
+            test_case.case_fn()
+        except BaseException as exc:  # noqa: B036 — pytest.skip raises
+            # a test skipping itself (preset/fork gating) is not an error
+            if type(exc).__name__ in ("Skipped", "OutcomeException"):
+                shutil.rmtree(case_dir)
+                return "skipped"
+            raise
+        bls_mode = getattr(test_case.case_fn, "_bls_mode", None)
+        if bls_mode == "always":
+            meta["bls_setting"] = 1
+        elif bls_mode == "never":
+            meta["bls_setting"] = 2
+        for name, value in parts:
+            write_part(case_dir, name, value, meta)
+        if meta:
+            _write_yaml(os.path.join(case_dir, "meta.yaml"),
+                        _encode_meta(meta))
+        os.remove(incomplete_tag)
+        elapsed = time.time() - start
+        if elapsed > TIME_THRESHOLD_TO_PRINT:
+            print(f"  {test_case.dir_path()}: {elapsed:.1f}s")
+        return "generated"
+    except Exception:
+        log.append({"case": test_case.dir_path(),
+                    "error": traceback.format_exc()})
+        return "error"
+    finally:
+        ctx.VECTOR_COLLECTOR = old_collector
+        ctx.ONLY_FORK, ctx.DEFAULT_TEST_PRESET = old_fork, old_preset
+
+
+def run_generator(generator_name: str, providers, args=None) -> dict:
+    """CLI + provider loop (reference gen_runner.py:142-301)."""
+    parser = argparse.ArgumentParser(
+        prog=f"gen-{generator_name}",
+        description=f"Generate {generator_name} test vectors")
+    parser.add_argument("-o", "--output-dir", required=True,
+                        help="output directory (tree root)")
+    parser.add_argument("-f", "--force", action="store_true",
+                        help="regenerate existing complete cases")
+    parser.add_argument("--preset-list", nargs="*", default=None)
+    parser.add_argument("--fork-list", nargs="*", default=None)
+    parser.add_argument("-c", "--collect-only", action="store_true")
+    ns = parser.parse_args(args)
+
+    # Host-side tool: never block on the accelerator tunnel.
+    from consensus_specs_tpu.utils.jax_env import force_cpu_platform
+    force_cpu_platform()
+
+    from consensus_specs_tpu.test_infra import context as ctx
+    ctx.DEFAULT_BLS_ACTIVE = True  # generators force real signatures
+
+    diagnostics = {"collected": 0, "generated": 0, "skipped": 0, "errors": 0,
+                   "test_identifiers": []}
+    error_log = []
+    for provider in providers:
+        provider.prepare()
+        for test_case in provider.make_cases():
+            if ns.preset_list is not None \
+                    and test_case.preset_name not in ns.preset_list:
+                continue
+            if ns.fork_list is not None \
+                    and test_case.fork_name not in ns.fork_list:
+                continue
+            diagnostics["collected"] += 1
+            if ns.collect_only:
+                print(test_case.dir_path())
+                continue
+            if ns.force:
+                case_dir = os.path.join(ns.output_dir, test_case.dir_path())
+                if os.path.exists(case_dir):
+                    shutil.rmtree(case_dir)
+            result = generate_test_vector(test_case, ns.output_dir, error_log)
+            diagnostics[result if result != "error" else "errors"] = \
+                diagnostics.get(
+                    result if result != "error" else "errors", 0) + 1
+            if result == "generated":
+                diagnostics["test_identifiers"].append(test_case.dir_path())
+
+    if ns.collect_only:
+        print(f"collected {diagnostics['collected']} cases")
+        return diagnostics
+
+    os.makedirs(ns.output_dir, exist_ok=True)
+    if error_log:
+        with open(os.path.join(ns.output_dir,
+                               f"testgen_error_log_{generator_name}.txt"),
+                  "a") as f:
+            for entry in error_log:
+                f.write(f"{entry['case']}\n{entry['error']}\n")
+    diag_path = os.path.join(ns.output_dir, "diagnostics_obj.json")
+    existing = {}
+    if os.path.exists(diag_path):
+        with open(diag_path) as f:
+            existing = json.load(f)
+    existing[generator_name] = {k: v for k, v in diagnostics.items()
+                                if k != "test_identifiers"}
+    with open(diag_path, "w") as f:
+        json.dump(existing, f, indent=2)
+
+    print(f"{generator_name}: collected={diagnostics['collected']} "
+          f"generated={diagnostics['generated']} "
+          f"skipped={diagnostics['skipped']} errors={diagnostics['errors']}")
+    if diagnostics["errors"]:
+        sys.exit(1)
+    return diagnostics

@@ -1,0 +1,36 @@
+"""Generator case/provider types (reference gen_base/gen_typing.py)."""
+from dataclasses import dataclass, field
+from typing import Callable, Iterable
+
+
+@dataclass
+class TestCase:
+    fork_name: str
+    preset_name: str
+    runner_name: str
+    handler_name: str
+    suite_name: str
+    case_name: str
+    case_fn: Callable[[], Iterable]
+    # fork whose spec executes the test; fork-upgrade tests run under the
+    # PRE-fork spec but are filed under the post-fork directory
+    exec_fork: str = None
+
+    def __post_init__(self):
+        if self.exec_fork is None:
+            self.exec_fork = self.fork_name
+
+    def dir_path(self) -> str:
+        """tests/<preset>/<fork>/<runner>/<handler>/<suite>/<case>
+        (reference gen_runner.py:101-106)."""
+        return "/".join([
+            "tests", self.preset_name, self.fork_name, self.runner_name,
+            self.handler_name, self.suite_name, self.case_name])
+
+
+@dataclass
+class TestProvider:
+    """prepare() runs once (e.g. select the BLS backend); make_cases yields
+    TestCases (reference gen_typing.py:20-40)."""
+    prepare: Callable[[], None]
+    make_cases: Callable[[], Iterable[TestCase]]

@@ -1,0 +1,216 @@
+"""Raw-snappy codec (pure python).
+
+The cross-client vector corpus stores SSZ bodies as ``.ssz_snappy`` in the
+*raw* snappy block format (reference: ``gen_runner.py:421-426`` via
+python-snappy/libsnappy, which this image does not ship).  This module
+implements the format from scratch:
+
+- ``compress``: greedy LZ77 with a 4-byte-hash match table — the same
+  family of scheme libsnappy uses.  Output is valid raw snappy (any
+  conforming decoder, including libsnappy, decodes it); byte-for-byte
+  output parity with libsnappy is NOT guaranteed (the format permits many
+  encodings of the same payload), which is fine because consumers always
+  decompress before comparing.
+- ``decompress``: full decoder for all tag types (literal, copy-1/2/4).
+
+SSZ states are zero-heavy, so even this simple matcher reaches libsnappy-
+class ratios on vector payloads.
+
+A native C implementation (``csrc/snappy.c``, built by ``make native`` into
+``csrc/libcsnappy.so``) is preferred when present — the role libsnappy's C
+core plays for the reference; these python functions are the fallback and
+the differential oracle (``tests/test_snappy.py``).
+"""
+import ctypes
+import os
+
+_MAX_OFFSET = 1 << 15  # keep copies in copy-2 range (offset < 65536)
+
+
+def _load_native():
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "csrc", "libcsnappy.so")
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.csnappy_compress.restype = ctypes.c_size_t
+        lib.csnappy_compress.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p]
+        lib.csnappy_max_compressed_length.restype = ctypes.c_size_t
+        lib.csnappy_max_compressed_length.argtypes = [ctypes.c_size_t]
+        lib.csnappy_uncompressed_length.restype = ctypes.c_size_t
+        lib.csnappy_uncompressed_length.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t]
+        lib.csnappy_decompress.restype = ctypes.c_size_t
+        lib.csnappy_decompress.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+            ctypes.c_size_t]
+        return lib
+    except OSError:
+        return None
+
+
+_native = _load_native()
+
+
+def compress(data: bytes) -> bytes:
+    data = bytes(data)
+    if _native is not None:
+        buf = ctypes.create_string_buffer(
+            _native.csnappy_max_compressed_length(len(data)))
+        n = _native.csnappy_compress(data, len(data), buf)
+        if n:
+            return buf.raw[:n]
+        if len(data) == 0:
+            return _py_compress(data)
+    return _py_compress(data)
+
+
+def decompress(data: bytes) -> bytes:
+    data = bytes(data)
+    if _native is not None:
+        length = _native.csnappy_uncompressed_length(data, len(data))
+        if length != ctypes.c_size_t(-1).value:
+            buf = ctypes.create_string_buffer(max(length, 1))
+            n = _native.csnappy_decompress(data, len(data), buf, length)
+            if n == length:
+                return buf.raw[:length]
+        raise ValueError("snappy: malformed input")
+    return _py_decompress(data)
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while n >= 0x80:
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+    out.append(n)
+    return bytes(out)
+
+
+def _emit_literal(out: bytearray, data, start: int, end: int) -> None:
+    length = end - start
+    if length == 0:
+        return
+    n = length - 1
+    if n < 60:
+        out.append(n << 2)
+    elif n < (1 << 8):
+        out.append(60 << 2)
+        out.append(n & 0xFF)
+    elif n < (1 << 16):
+        out.append(61 << 2)
+        out += (n).to_bytes(2, "little")
+    elif n < (1 << 24):
+        out.append(62 << 2)
+        out += (n).to_bytes(3, "little")
+    else:
+        out.append(63 << 2)
+        out += (n).to_bytes(4, "little")
+    out += data[start:end]
+
+
+def _emit_copy(out: bytearray, offset: int, length: int) -> None:
+    # prefer copy-2 (3-byte tag, len 1..64, offset < 65536)
+    while length > 0:
+        chunk = min(length, 64)
+        if chunk < 4 and length != chunk:
+            # avoid leaving a tail shorter than the minimum match
+            chunk = length
+        out.append(((chunk - 1) << 2) | 0b10)
+        out += offset.to_bytes(2, "little")
+        length -= chunk
+
+
+def _py_compress(data: bytes) -> bytes:
+    data = bytes(data)
+    out = bytearray(_varint(len(data)))
+    n = len(data)
+    if n == 0:
+        return bytes(out)
+    if n < 16:
+        _emit_literal(out, data, 0, n)
+        return bytes(out)
+
+    table = {}
+    i = 0
+    literal_start = 0
+    while i + 4 <= n:
+        key = data[i:i + 4]
+        cand = table.get(key)
+        table[key] = i
+        if cand is not None and i - cand < _MAX_OFFSET:
+            # extend the match forward
+            match_len = 4
+            while (i + match_len < n and match_len < 1 << 16
+                   and data[cand + match_len] == data[i + match_len]):
+                match_len += 1
+            _emit_literal(out, data, literal_start, i)
+            _emit_copy(out, i - cand, match_len)
+            # index a couple of positions inside the match (cheap and
+            # keeps the table fresh on runs of zeros)
+            for j in range(i + 1, min(i + match_len, n - 4), 7):
+                table[data[j:j + 4]] = j
+            i += match_len
+            literal_start = i
+        else:
+            i += 1
+    _emit_literal(out, data, literal_start, n)
+    return bytes(out)
+
+
+def _py_decompress(data: bytes) -> bytes:
+    data = bytes(data)
+    # uncompressed length varint
+    shift = 0
+    length = 0
+    pos = 0
+    while True:
+        b = data[pos]
+        length |= (b & 0x7F) << shift
+        pos += 1
+        if not b & 0x80:
+            break
+        shift += 7
+
+    out = bytearray()
+    n = len(data)
+    while pos < n:
+        tag = data[pos]
+        pos += 1
+        tag_type = tag & 0b11
+        if tag_type == 0b00:  # literal
+            ln = tag >> 2
+            if ln < 60:
+                ln += 1
+            else:
+                extra = ln - 59
+                ln = int.from_bytes(data[pos:pos + extra], "little") + 1
+                pos += extra
+            out += data[pos:pos + ln]
+            pos += ln
+        else:
+            if tag_type == 0b01:  # copy-1: len 4..11, offset 11 bits
+                ln = ((tag >> 2) & 0b111) + 4
+                offset = ((tag >> 5) << 8) | data[pos]
+                pos += 1
+            elif tag_type == 0b10:  # copy-2
+                ln = (tag >> 2) + 1
+                offset = int.from_bytes(data[pos:pos + 2], "little")
+                pos += 2
+            else:  # copy-4
+                ln = (tag >> 2) + 1
+                offset = int.from_bytes(data[pos:pos + 4], "little")
+                pos += 4
+            if offset == 0 or offset > len(out):
+                raise ValueError("snappy: invalid copy offset")
+            # overlapping copies are byte-serial by definition
+            start = len(out) - offset
+            for k in range(ln):
+                out.append(out[start + k])
+    if len(out) != length:
+        raise ValueError(
+            f"snappy: length mismatch (expected {length}, got {len(out)})")
+    return bytes(out)

@@ -1,0 +1,179 @@
+/* Raw-snappy codec (C) — native backend for consensus_specs_tpu.utils.snappy.
+ *
+ * Role of the reference's libsnappy/python-snappy dependency
+ * (gen_runner.py:421-426): .ssz_snappy vector IO.  Implements the raw
+ * block format: varint uncompressed length, then literal and copy tags.
+ * The compressor is a greedy 4-byte-hash matcher (same family as
+ * libsnappy); any conforming decoder handles its output.
+ *
+ * Build: make native   (gcc -O2 -shared -fPIC -o libcsnappy.so snappy.c)
+ * Loaded via ctypes by utils/snappy.py; the pure-python codec is the
+ * fallback when the library has not been built.
+ */
+#include <stddef.h>
+#include <stdint.h>
+#include <string.h>
+
+#define MAX_OFFSET (1u << 15)
+#define HASH_BITS 14
+#define HASH_SIZE (1u << HASH_BITS)
+
+static inline uint32_t hash4(const uint8_t *p) {
+    uint32_t v;
+    memcpy(&v, p, 4);
+    return (v * 0x9E3779B1u) >> (32 - HASH_BITS);
+}
+
+static inline size_t emit_varint(uint8_t *dst, size_t n) {
+    size_t i = 0;
+    while (n >= 0x80) { dst[i++] = (uint8_t)((n & 0x7F) | 0x80); n >>= 7; }
+    dst[i++] = (uint8_t)n;
+    return i;
+}
+
+static size_t emit_literal(uint8_t *dst, const uint8_t *src, size_t start,
+                           size_t end) {
+    size_t len = end - start, o = 0;
+    if (len == 0) return 0;
+    size_t n = len - 1;
+    if (n < 60) {
+        dst[o++] = (uint8_t)(n << 2);
+    } else if (n < (1u << 8)) {
+        dst[o++] = 60u << 2; dst[o++] = (uint8_t)n;
+    } else if (n < (1u << 16)) {
+        dst[o++] = 61u << 2; dst[o++] = (uint8_t)n; dst[o++] = (uint8_t)(n >> 8);
+    } else if (n < (1u << 24)) {
+        dst[o++] = 62u << 2; dst[o++] = (uint8_t)n; dst[o++] = (uint8_t)(n >> 8);
+        dst[o++] = (uint8_t)(n >> 16);
+    } else {
+        dst[o++] = 63u << 2; dst[o++] = (uint8_t)n; dst[o++] = (uint8_t)(n >> 8);
+        dst[o++] = (uint8_t)(n >> 16); dst[o++] = (uint8_t)(n >> 24);
+    }
+    memcpy(dst + o, src + start, len);
+    return o + len;
+}
+
+static size_t emit_copy(uint8_t *dst, size_t offset, size_t len) {
+    size_t o = 0;
+    while (len > 0) {
+        size_t chunk = len > 64 ? 64 : len;
+        if (chunk < 4 && len != chunk) chunk = len;
+        dst[o++] = (uint8_t)(((chunk - 1) << 2) | 0x2);
+        dst[o++] = (uint8_t)offset;
+        dst[o++] = (uint8_t)(offset >> 8);
+        len -= chunk;
+    }
+    return o;
+}
+
+/* Worst-case output bound for the literal-only path. */
+size_t csnappy_max_compressed_length(size_t n) {
+    return 16 + n + n / 59 * 5 + 8;
+}
+
+/* Returns compressed size, or 0 on error. */
+size_t csnappy_compress(const uint8_t *src, size_t n, uint8_t *dst) {
+    size_t o = emit_varint(dst, n);
+    if (n == 0) return o;
+    if (n < 16) return o + emit_literal(dst + o, src, 0, n);
+
+    static _Thread_local int32_t table[HASH_SIZE];
+    for (size_t i = 0; i < HASH_SIZE; i++) table[i] = -1;
+
+    size_t i = 0, literal_start = 0;
+    while (i + 4 <= n) {
+        uint32_t h = hash4(src + i);
+        int32_t cand = table[h];
+        table[h] = (int32_t)i;
+        if (cand >= 0 && i - (size_t)cand < MAX_OFFSET
+            && memcmp(src + cand, src + i, 4) == 0) {
+            size_t match_len = 4;
+            while (i + match_len < n && match_len < (1u << 16)
+                   && src[cand + match_len] == src[i + match_len])
+                match_len++;
+            o += emit_literal(dst + o, src, literal_start, i);
+            o += emit_copy(dst + o, i - (size_t)cand, match_len);
+            size_t stop = i + match_len;
+            for (size_t j = i + 1; j + 4 <= n && j < stop; j += 7)
+                table[hash4(src + j)] = (int32_t)j;
+            i = stop;
+            literal_start = i;
+        } else {
+            i++;
+        }
+    }
+    o += emit_literal(dst + o, src, literal_start, n);
+    return o;
+}
+
+/* Returns decompressed size, or (size_t)-1 on malformed input.
+ * dst must hold the length announced by the stream header
+ * (csnappy_uncompressed_length). */
+size_t csnappy_uncompressed_length(const uint8_t *src, size_t n) {
+    size_t len = 0, shift = 0, pos = 0;
+    while (pos < n) {
+        uint8_t b = src[pos++];
+        len |= (size_t)(b & 0x7F) << shift;
+        if (!(b & 0x80)) return len;
+        shift += 7;
+        if (shift > 56) break;
+    }
+    return (size_t)-1;
+}
+
+size_t csnappy_decompress(const uint8_t *src, size_t n, uint8_t *dst,
+                          size_t dst_cap) {
+    size_t pos = 0;
+    /* skip the varint header */
+    while (pos < n && (src[pos] & 0x80)) pos++;
+    if (pos >= n) return (size_t)-1;
+    pos++;
+
+    size_t o = 0;
+    while (pos < n) {
+        uint8_t tag = src[pos++];
+        uint32_t type = tag & 0x3;
+        if (type == 0) { /* literal */
+            size_t len = tag >> 2;
+            if (len < 60) {
+                len += 1;
+            } else {
+                size_t extra = len - 59;
+                if (pos + extra > n) return (size_t)-1;
+                len = 0;
+                for (size_t k = 0; k < extra; k++)
+                    len |= (size_t)src[pos + k] << (8 * k);
+                len += 1;
+                pos += extra;
+            }
+            if (pos + len > n || o + len > dst_cap) return (size_t)-1;
+            memcpy(dst + o, src + pos, len);
+            pos += len; o += len;
+        } else {
+            size_t len, offset;
+            if (type == 1) {
+                len = ((tag >> 2) & 0x7) + 4;
+                if (pos + 1 > n) return (size_t)-1;
+                offset = ((size_t)(tag >> 5) << 8) | src[pos];
+                pos += 1;
+            } else if (type == 2) {
+                len = (tag >> 2) + 1;
+                if (pos + 2 > n) return (size_t)-1;
+                offset = (size_t)src[pos] | ((size_t)src[pos + 1] << 8);
+                pos += 2;
+            } else {
+                len = (tag >> 2) + 1;
+                if (pos + 4 > n) return (size_t)-1;
+                offset = (size_t)src[pos] | ((size_t)src[pos + 1] << 8)
+                       | ((size_t)src[pos + 2] << 16)
+                       | ((size_t)src[pos + 3] << 24);
+                pos += 4;
+            }
+            if (offset == 0 || offset > o || o + len > dst_cap)
+                return (size_t)-1;
+            /* overlapping copies are byte-serial by definition */
+            for (size_t k = 0; k < len; k++) { dst[o] = dst[o - offset]; o++; }
+        }
+    }
+    return o;
+}

@@ -1,0 +1,119 @@
+"""BLS raw-operation vector generator.
+
+Reference: ``tests/generators/bls/main.py`` — sign/verify/aggregate/
+fast_aggregate_verify/aggregate_verify vectors including the IETF edge
+cases (infinity point, empty sets, tampered messages).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from consensus_specs_tpu.gen import TestCase, TestProvider, run_generator
+from consensus_specs_tpu.utils import bls
+
+PRIVKEYS = [1, 5, 124, 6565321]
+MESSAGES = [b"\x00" * 32, b"\x56" * 32, b"\xab" * 32]
+Z1_PUBKEY = b"\xc0" + b"\x00" * 47
+Z2_SIGNATURE = b"\xc0" + b"\x00" * 95
+
+
+def _case(handler, name, fn):
+    def case_fn():
+        from consensus_specs_tpu.test_infra import context as ctx
+        parts = fn()
+        if ctx.VECTOR_COLLECTOR is not None:
+            for part in parts:
+                ctx.VECTOR_COLLECTOR(part)
+        return parts
+    return TestCase(fork_name="general", preset_name="general",
+                    runner_name="bls", handler_name=handler,
+                    suite_name="bls", case_name=name, case_fn=case_fn)
+
+
+def _hex(b):
+    return "0x" + bytes(b).hex()
+
+
+def make_cases():
+    bls.use_py()
+    # sign
+    for i, sk in enumerate(PRIVKEYS):
+        for j, msg in enumerate(MESSAGES):
+            def fn(sk=sk, msg=msg):
+                sig = bls.Sign(sk, msg)
+                return [("data", {
+                    "input": {"privkey": hex(sk), "message": _hex(msg)},
+                    "output": _hex(sig)})]
+            yield _case("sign", f"sign_case_{i}_{j}", fn)
+    # verify: valid, wrong message, wrong pubkey, infinity pubkey
+    sk, msg = PRIVKEYS[0], MESSAGES[0]
+    pk = bls.SkToPk(sk)
+    sig = bls.Sign(sk, msg)
+
+    def _verify_case(pubkey, message, signature, expect):
+        def fn():
+            ok = bls.Verify(pubkey, message, signature)
+            assert ok == expect
+            return [("data", {
+                "input": {"pubkey": _hex(pubkey), "message": _hex(message),
+                          "signature": _hex(signature)},
+                "output": ok})]
+        return fn
+    yield _case("verify", "verify_valid", _verify_case(pk, msg, sig, True))
+    yield _case("verify", "verify_wrong_message",
+                _verify_case(pk, MESSAGES[1], sig, False))
+    yield _case("verify", "verify_infinity_pubkey",
+                _verify_case(Z1_PUBKEY, msg, sig, False))
+    yield _case("verify", "verify_tampered_signature",
+                _verify_case(pk, msg, sig[:-4] + b"\x00" * 4, False))
+    # aggregate
+    sigs = [bls.Sign(sk, MESSAGES[0]) for sk in PRIVKEYS]
+
+    def agg_fn():
+        agg = bls.Aggregate(sigs)
+        return [("data", {"input": [_hex(s) for s in sigs],
+                          "output": _hex(agg)})]
+    yield _case("aggregate", "aggregate_basic", agg_fn)
+    # fast aggregate verify (+ edge cases)
+    pks = [bls.SkToPk(sk) for sk in PRIVKEYS]
+    agg = bls.Aggregate(sigs)
+
+    def fav(pubkeys, message, signature, expect):
+        def fn():
+            ok = bls.FastAggregateVerify(pubkeys, message, signature)
+            assert ok == expect
+            return [("data", {
+                "input": {"pubkeys": [_hex(p) for p in pubkeys],
+                          "message": _hex(message),
+                          "signature": _hex(signature)},
+                "output": ok})]
+        return fn
+    yield _case("fast_aggregate_verify", "fav_valid",
+                fav(pks, MESSAGES[0], agg, True))
+    yield _case("fast_aggregate_verify", "fav_extra_pubkey",
+                fav(pks + [bls.SkToPk(99)], MESSAGES[0], agg, False))
+    yield _case("fast_aggregate_verify", "fav_na_pubkeys_and_infinity_sig",
+                fav([], MESSAGES[0], Z2_SIGNATURE, False))
+    # aggregate verify (distinct messages)
+    msgs = MESSAGES[:len(PRIVKEYS)] + MESSAGES[:1]
+    pairs = list(zip(PRIVKEYS, msgs))
+    av_sigs = [bls.Sign(sk, m) for sk, m in pairs]
+    av_pks = [bls.SkToPk(sk) for sk, _ in pairs]
+    av_agg = bls.Aggregate(av_sigs)
+
+    def av_fn():
+        ok = bls.AggregateVerify(av_pks, [m for _, m in pairs], av_agg)
+        assert ok
+        return [("data", {
+            "input": {"pubkeys": [_hex(p) for p in av_pks],
+                      "messages": [_hex(m) for _, m in pairs],
+                      "signature": _hex(av_agg)},
+            "output": ok})]
+    yield _case("aggregate_verify", "av_valid", av_fn)
+
+
+if __name__ == "__main__":
+    run_generator("bls", [
+        TestProvider(prepare=bls.use_py, make_cases=make_cases)])

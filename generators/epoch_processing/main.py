@@ -1,0 +1,17 @@
+"""Epoch-processing vector generator (reference
+tests/generators/epoch_processing/main.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from consensus_specs_tpu.gen import run_state_test_generators
+
+mods = {"epoch_processing": "tests.phase0.epoch_processing.test_epoch_processing"}
+ALL_MODS = {fork: mods
+            for fork in ("phase0", "altair", "bellatrix", "capella", "deneb")}
+
+if __name__ == "__main__":
+    run_state_test_generators("epoch_processing", ALL_MODS,
+                              presets=("minimal",))

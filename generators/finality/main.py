@@ -1,0 +1,15 @@
+"""Finality vector generator (reference tests/generators/finality/main.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from consensus_specs_tpu.gen import run_state_test_generators
+
+mods = {"finality": "tests.phase0.finality.test_finality"}
+ALL_MODS = {fork: mods
+            for fork in ("phase0", "altair", "bellatrix", "capella", "deneb")}
+
+if __name__ == "__main__":
+    run_state_test_generators("finality", ALL_MODS, presets=("minimal",))

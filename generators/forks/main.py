@@ -1,0 +1,23 @@
+"""Fork-upgrade vector generator (reference tests/generators/forks/main.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from consensus_specs_tpu.gen import run_state_test_generators
+
+ALL_MODS = {
+    "altair": {"fork": "tests.altair.fork.test_altair_fork"},
+    "bellatrix": {"fork": "tests.bellatrix.fork.test_bellatrix_fork"},
+    "capella": {"fork": "tests.capella.fork.test_capella_fork"},
+    "deneb": {"fork": "tests.deneb.fork.test_deneb_fork"},
+}
+
+# upgrade tests execute under the PRE-fork spec
+EXEC_FORKS = {"altair": "phase0", "bellatrix": "altair",
+              "capella": "bellatrix", "deneb": "capella"}
+
+if __name__ == "__main__":
+    run_state_test_generators("forks", ALL_MODS, presets=("minimal",),
+                              exec_forks=EXEC_FORKS)

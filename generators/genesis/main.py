@@ -1,0 +1,13 @@
+"""Genesis vector generator (reference tests/generators/genesis/main.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from consensus_specs_tpu.gen import run_state_test_generators
+
+ALL_MODS = {"phase0": {"initialization": "tests.phase0.genesis.test_genesis"}}
+
+if __name__ == "__main__":
+    run_state_test_generators("genesis", ALL_MODS, presets=("minimal",))

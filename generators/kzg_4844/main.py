@@ -1,0 +1,118 @@
+"""KZG-4844 vector generator (reference tests/generators/kzg_4844/main.py).
+
+Emits blob_to_kzg_commitment / compute+verify blob proof cases (valid and
+invalid encodings) against the minimal trusted setup.
+"""
+import os
+import sys
+from random import Random
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from consensus_specs_tpu.ops import kzg as K
+from consensus_specs_tpu.gen import TestCase, TestProvider, run_generator
+
+SETUP = K.trusted_setup("minimal")
+WIDTH = SETUP.FIELD_ELEMENTS_PER_BLOB
+
+
+def _blob(seed):
+    rng = Random(seed)
+    return b"".join(
+        rng.randrange(K.BLS_MODULUS).to_bytes(32, "big")
+        for _ in range(WIDTH))
+
+
+INVALID_BLOB = (K.BLS_MODULUS).to_bytes(32, "big") * WIDTH  # fe >= modulus
+
+
+def _case(handler, name, fn):
+    def case_fn():
+        from consensus_specs_tpu.test_infra import context as ctx
+        parts = fn()
+        if ctx.VECTOR_COLLECTOR is not None:
+            for part in parts:
+                ctx.VECTOR_COLLECTOR(part)
+        return parts
+    return TestCase(fork_name="deneb", preset_name="general",
+                    runner_name="kzg", handler_name=handler,
+                    suite_name="kzg-mainnet", case_name=name, case_fn=case_fn)
+
+
+def make_cases():
+    def commit_case(seed):
+        def fn():
+            blob = _blob(seed)
+            commitment = K.blob_to_kzg_commitment(blob, SETUP)
+            return [("data", {"input": {"blob": "0x" + blob.hex()},
+                              "output": "0x" + commitment.hex()})]
+        return fn
+    yield _case("blob_to_kzg_commitment", "commit_random_0", commit_case(0))
+    yield _case("blob_to_kzg_commitment", "commit_random_1", commit_case(1))
+
+    def invalid_commit_case():
+        def fn():
+            try:
+                K.blob_to_kzg_commitment(INVALID_BLOB, SETUP)
+                raise SystemExit("invalid blob must be rejected")
+            except AssertionError:
+                pass
+            return [("data", {
+                "input": {"blob": "0x" + INVALID_BLOB[:64].hex() + "..."},
+                "output": None})]
+        return fn
+    yield _case("blob_to_kzg_commitment", "commit_invalid_field_element",
+                invalid_commit_case())
+
+    def roundtrip_case(seed):
+        def fn():
+            blob = _blob(seed)
+            commitment = K.blob_to_kzg_commitment(blob, SETUP)
+            proof = K.compute_blob_kzg_proof(blob, commitment, SETUP)
+            ok = K.verify_blob_kzg_proof(blob, commitment, proof, SETUP)
+            assert ok
+            return [("data", {
+                "input": {"blob": "0x" + blob.hex(),
+                          "commitment": "0x" + commitment.hex(),
+                          "proof": "0x" + proof.hex()},
+                "output": True})]
+        return fn
+    yield _case("verify_blob_kzg_proof", "verify_roundtrip_0",
+                roundtrip_case(10))
+
+    def invalid_proof_case():
+        def fn():
+            blob = _blob(20)
+            commitment = K.blob_to_kzg_commitment(blob, SETUP)
+            ok = K.verify_blob_kzg_proof(
+                blob, commitment, K.G1_POINT_AT_INFINITY, SETUP)
+            assert not ok
+            return [("data", {
+                "input": {"blob": "0x" + blob.hex(),
+                          "commitment": "0x" + commitment.hex(),
+                          "proof": "0x" + K.G1_POINT_AT_INFINITY.hex()},
+                "output": False})]
+        return fn
+    yield _case("verify_blob_kzg_proof", "verify_infinity_proof_invalid",
+                invalid_proof_case())
+
+    def point_eval_case():
+        def fn():
+            blob = _blob(30)
+            commitment = K.blob_to_kzg_commitment(blob, SETUP)
+            z = (12345).to_bytes(32, "big")
+            proof, y = K.compute_kzg_proof(blob, z, SETUP)
+            ok = K.verify_kzg_proof(commitment, z, y, proof, SETUP)
+            assert ok
+            return [("data", {
+                "input": {"blob": "0x" + blob.hex(), "z": "0x" + z.hex()},
+                "output": ["0x" + proof.hex(), "0x" + y.hex()]})]
+        return fn
+    yield _case("compute_kzg_proof", "compute_kzg_proof_0",
+                point_eval_case())
+
+
+if __name__ == "__main__":
+    run_generator("kzg", [
+        TestProvider(prepare=lambda: None, make_cases=make_cases)])

@@ -1,0 +1,16 @@
+"""Light-client vector generator
+(reference tests/generators/light_client/main.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from consensus_specs_tpu.gen import run_state_test_generators
+
+ALL_MODS = {
+    "altair": {"sync": "tests.altair.light_client.test_sync_protocol"},
+}
+
+if __name__ == "__main__":
+    run_state_test_generators("light_client", ALL_MODS, presets=("minimal",))

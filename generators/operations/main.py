@@ -1,0 +1,46 @@
+"""Operations vector generator (reference tests/generators/operations/main.py).
+
+Usage: python generators/operations/main.py -o ../consensus-spec-tests
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from consensus_specs_tpu.gen import run_state_test_generators
+from consensus_specs_tpu.gen.gen_from_tests import combine_mods
+
+phase0_mods = {
+    "attestation": "tests.phase0.block_processing.test_process_attestation",
+    "deposit": "tests.phase0.block_processing.test_process_deposit",
+    "slashing": "tests.phase0.block_processing.test_process_slashings_ops",
+}
+altair_mods = combine_mods({
+    "sync_aggregate":
+        "tests.altair.block_processing.test_process_sync_aggregate",
+}, phase0_mods)
+bellatrix_mods = combine_mods({
+    "execution_payload":
+        "tests.bellatrix.block_processing.test_process_execution_payload",
+}, altair_mods)
+capella_mods = combine_mods({
+    "withdrawals": "tests.capella.block_processing.test_process_withdrawals",
+    "bls_to_execution_change":
+        "tests.capella.block_processing.test_process_bls_to_execution_change",
+}, bellatrix_mods)
+deneb_mods = combine_mods({
+    "blob_commitments":
+        "tests.deneb.block_processing.test_deneb_block_processing",
+}, capella_mods)
+
+ALL_MODS = {
+    "phase0": phase0_mods,
+    "altair": altair_mods,
+    "bellatrix": bellatrix_mods,
+    "capella": capella_mods,
+    "deneb": deneb_mods,
+}
+
+if __name__ == "__main__":
+    run_state_test_generators("operations", ALL_MODS, presets=("minimal",))

@@ -1,0 +1,15 @@
+"""Random-scenario vector generator (reference tests/generators/random)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from consensus_specs_tpu.gen import run_state_test_generators
+
+mods = {"random": "tests.phase0.random.test_random"}
+ALL_MODS = {fork: mods
+            for fork in ("phase0", "altair", "bellatrix", "capella", "deneb")}
+
+if __name__ == "__main__":
+    run_state_test_generators("random", ALL_MODS, presets=("minimal",))

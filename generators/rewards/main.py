@@ -1,0 +1,15 @@
+"""Rewards vector generator (reference tests/generators/rewards/main.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from consensus_specs_tpu.gen import run_state_test_generators
+
+mods = {"basic": "tests.phase0.rewards.test_rewards"}
+ALL_MODS = {fork: mods
+            for fork in ("phase0", "altair", "bellatrix", "capella", "deneb")}
+
+if __name__ == "__main__":
+    run_state_test_generators("rewards", ALL_MODS, presets=("minimal",))

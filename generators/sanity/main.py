@@ -1,0 +1,18 @@
+"""Sanity vector generator (reference tests/generators/sanity/main.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from consensus_specs_tpu.gen import run_state_test_generators
+
+mods = {
+    "blocks": "tests.phase0.sanity.test_blocks",
+    "slots": "tests.phase0.sanity.test_slots",
+}
+ALL_MODS = {fork: mods
+            for fork in ("phase0", "altair", "bellatrix", "capella", "deneb")}
+
+if __name__ == "__main__":
+    run_state_test_generators("sanity", ALL_MODS, presets=("minimal",))

@@ -1,0 +1,41 @@
+"""Swap-or-not shuffle vector generator
+(reference tests/generators/shuffling/main.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from consensus_specs_tpu.forks import build_spec
+from consensus_specs_tpu.gen import TestCase, TestProvider, run_generator
+
+
+def shuffling_case(spec, seed, count):
+    def case_fn():
+        from consensus_specs_tpu.test_infra import context as ctx
+        mapping = [int(spec.compute_shuffled_index(i, count, seed))
+                   for i in range(count)]
+        parts = [("mapping", {"seed": "0x" + seed.hex(), "count": count,
+                              "mapping": mapping})]
+        if ctx.VECTOR_COLLECTOR is not None:
+            for part in parts:
+                ctx.VECTOR_COLLECTOR(part)
+        return parts
+    return TestCase(fork_name="phase0", preset_name="minimal",
+                    runner_name="shuffling", handler_name="core",
+                    suite_name="shuffle",
+                    case_name=f"shuffle_0x{seed[:4].hex()}_{count}",
+                    case_fn=case_fn)
+
+
+def make_cases():
+    spec = build_spec("phase0", "minimal")
+    for seed_byte in (0, 0x55, 0xAA):
+        seed = bytes([seed_byte]) * 32
+        for count in (0, 1, 2, 3, 5, 33, 100):
+            yield shuffling_case(spec, seed, count)
+
+
+if __name__ == "__main__":
+    run_generator("shuffling", [
+        TestProvider(prepare=lambda: None, make_cases=make_cases)])

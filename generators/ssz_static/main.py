@@ -1,0 +1,77 @@
+"""ssz_static vector generator.
+
+Reference: ``tests/generators/ssz_static/main.py`` — reflect every
+Container class of each fork's spec and emit (value, serialized, root)
+triples across randomization modes.
+"""
+import os
+import sys
+from random import Random
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from consensus_specs_tpu.forks import build_spec
+from consensus_specs_tpu.gen import TestCase, TestProvider, run_generator
+from consensus_specs_tpu.utils.ssz import hash_tree_root, serialize
+from consensus_specs_tpu.utils.ssz.types import Container
+from consensus_specs_tpu.debug.encode import encode
+from consensus_specs_tpu.debug.random_value import (
+    RandomizationMode, get_random_ssz_object,
+)
+
+FORKS = ("phase0", "altair", "bellatrix", "capella", "deneb")
+MAX_BYTES_LENGTH = 1000
+MAX_LIST_LENGTH = 10
+
+
+def _spec_container_types(spec):
+    seen = {}
+    for name in dir(spec):
+        typ = getattr(spec, name, None)
+        if isinstance(typ, type) and issubclass(typ, Container) \
+                and typ is not Container and typ.fields():
+            seen[name] = typ
+    return seen
+
+
+def ssz_static_case(fork, preset, type_name, typ, mode, seed, count):
+    def case_fn():
+        rng = Random(seed)
+        value = get_random_ssz_object(
+            rng, typ, MAX_BYTES_LENGTH, MAX_LIST_LENGTH, mode)
+        from consensus_specs_tpu.test_infra import context as ctx
+        collector = ctx.VECTOR_COLLECTOR
+        parts = [
+            ("value", {"description": encode(value)}),
+            ("serialized", value),
+            ("roots", {"root": "0x" + hash_tree_root(value).hex()}),
+        ]
+        if collector is not None:
+            for part in parts:
+                collector(part)
+        return parts
+    return TestCase(
+        fork_name=fork, preset_name=preset, runner_name="ssz_static",
+        handler_name=type_name, suite_name=f"ssz_{mode.name[5:]}",
+        case_name=f"case_{count}", case_fn=case_fn)
+
+
+def make_cases():
+    for fork in FORKS:
+        spec = build_spec(fork, "minimal")
+        for type_name, typ in sorted(_spec_container_types(spec).items()):
+            for mode in (RandomizationMode.mode_random,
+                         RandomizationMode.mode_zero,
+                         RandomizationMode.mode_max):
+                count = 3 if mode.is_changing() else 1
+                for i in range(count):
+                    yield ssz_static_case(
+                        fork, "minimal", type_name, typ, mode,
+                        seed=hash((fork, type_name, mode.value, i)) & 0xFFFF,
+                        count=i)
+
+
+if __name__ == "__main__":
+    run_generator("ssz_static", [
+        TestProvider(prepare=lambda: None, make_cases=make_cases)])

@@ -1,0 +1,70 @@
+"""Raw-snappy codec tests: python/native differential + format edges.
+
+Reference role: the ``python-snappy``/libsnappy dependency
+(``gen_runner.py:421-426``).
+"""
+import os
+import random
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu.utils import snappy
+
+
+CASES = [
+    b"",
+    b"a",
+    b"hello world, hello world, hello world",
+    b"\x00" * 100000,
+    bytes(random.Random(7).randrange(256) for _ in range(5000)),
+    (b"abcd" * 1000) + bytes(random.Random(8).randrange(256)
+                             for _ in range(500)),
+    bytes(random.Random(9).randrange(4) for _ in range(70000)),
+]
+
+
+@pytest.mark.parametrize("data", CASES, ids=range(len(CASES)))
+def test_roundtrip(data):
+    assert snappy.decompress(snappy.compress(data)) == data
+
+
+@pytest.mark.parametrize("data", CASES, ids=range(len(CASES)))
+def test_python_and_native_interoperate(data):
+    """Either codec must decode the other's output (the format allows
+    different encodings; the payload must match)."""
+    z_py = snappy._py_compress(data)
+    assert snappy._py_decompress(z_py) == data
+    assert snappy.decompress(z_py) == data
+    if snappy._native is not None:
+        z = snappy.compress(data)
+        assert snappy._py_decompress(z) == data
+
+
+def test_zero_heavy_payload_compresses():
+    data = b"\x00" * 50000
+    assert len(snappy.compress(data)) < len(data) // 10
+
+
+def test_malformed_input_rejected():
+    with pytest.raises(Exception):
+        snappy.decompress(b"\x05\x02\x01\x00")  # copy beyond output start
+    with pytest.raises(Exception):
+        # announced length 5 but no body
+        snappy._py_decompress(b"\x05")
+
+
+def test_ssz_state_payload_roundtrip():
+    """End-to-end on a real SSZ state body."""
+    from consensus_specs_tpu.forks import build_spec
+    from consensus_specs_tpu.test_infra.genesis import create_genesis_state
+    from consensus_specs_tpu.utils.ssz import serialize
+    spec = build_spec("phase0", "minimal")
+    state = create_genesis_state(spec, [spec.MAX_EFFECTIVE_BALANCE] * 32,
+                                 spec.MAX_EFFECTIVE_BALANCE)
+    body = serialize(state)
+    z = snappy.compress(body)
+    assert snappy.decompress(z) == body
+    assert len(z) < len(body) // 2  # states are highly compressible
