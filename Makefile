@@ -1,0 +1,51 @@
+# Build/test/generate entrypoints (role of the reference's Makefile:108-209).
+
+PYTHON ?= python
+OUTPUT_DIR ?= ../consensus-spec-tests
+GENERATORS = operations sanity finality rewards random forks epoch_processing \
+             genesis ssz_static bls shuffling light_client kzg_4844
+
+.PHONY: test citest test-crypto bench bench-all dryrun native \
+        generate_tests $(addprefix gen_,$(GENERATORS)) clean-vectors pyspec
+
+# fast local suite: signature checks off except @always_bls
+# (reference `make test`, Makefile:118-120)
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+# CI tier: every signature verified, minimal preset
+# (reference `make citest`, Makefile:129-137)
+citest:
+	$(PYTHON) -m pytest tests/ -q --enable-bls
+
+# crypto kernels incl. the heavy differential tier
+test-crypto:
+	CS_TPU_HEAVY=1 $(PYTHON) -m pytest tests/test_bls.py tests/test_jax_bls.py \
+		tests/test_hash_to_curve.py tests/test_sha256_kernel.py \
+		tests/test_multichip.py tests/deneb/kzg -q
+
+bench:
+	$(PYTHON) bench.py
+
+bench-all:
+	$(PYTHON) benchmarks/bench_all.py
+
+dryrun:
+	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+# compile the markdown specs into importable modules (reference `make pyspec`)
+pyspec:
+	$(PYTHON) -m consensus_specs_tpu.compiler
+
+# vector generation (reference `make generate_tests` / `make gen_<name>`)
+generate_tests: $(addprefix gen_,$(GENERATORS))
+
+$(addprefix gen_,$(GENERATORS)): gen_%:
+	$(PYTHON) generators/$*/main.py -o $(OUTPUT_DIR)
+
+# native C components (raw-snappy codec for vector IO)
+native:
+	gcc -O2 -shared -fPIC -o csrc/libcsnappy.so csrc/snappy.c
+
+clean-vectors:
+	rm -rf $(OUTPUT_DIR)/tests

@@ -1,0 +1,23 @@
+"""Markdown-spec compiler.
+
+The reference's defining architecture is "specs as executable markdown":
+``setup.py:178-354`` parses the spec documents, merges forks, and emits
+importable python modules.  This package provides the same capability for
+this framework:
+
+- ``mdgen``: emits the canonical markdown documents from a spec class
+  (used once to bootstrap ``specs/``; afterwards markdown is the editable
+  source of truth).
+- ``extract``: parses a spec markdown document — fenced python blocks,
+  constant tables — into a SpecDocument.
+- ``emit``: renders a SpecDocument (plus its fork's mixin scaffolding)
+  into an importable module under ``consensus_specs_tpu/forks/compiled/``.
+- ``python -m consensus_specs_tpu.compiler``: the ``make pyspec``
+  equivalent; golden parity with the hand-written runtime is enforced by
+  ``tests/test_spec_compiler.py``.
+"""
+from .extract import SpecDocument, parse_markdown_spec
+from .emit import emit_spec_module, compile_spec
+
+__all__ = ["SpecDocument", "parse_markdown_spec", "emit_spec_module",
+           "compile_spec"]

@@ -1,0 +1,3 @@
+from .emit import main
+
+main()

@@ -1,0 +1,121 @@
+"""Spec-module emitter (role of ``pysetup/helpers.py:37-158``
+objects_to_spec + the per-fork builders).
+
+The emitted module defines ``<Fork>Spec`` composed from the markdown's
+function blocks over the same infrastructure mixins the hand-written
+runtime uses (fork choice, validator guide, light client).  Markdown is
+the single source of truth for spec logic; presets/configs stay
+runtime-bound exactly like the hand-written classes.
+"""
+import os
+import textwrap
+
+from .extract import parse_markdown_spec
+
+_SCAFFOLD = {
+    "phase0": {
+        "bases": "ValidatorGuideMixin, ForkChoiceMixin",
+        "imports": """\
+from collections import OrderedDict
+from types import SimpleNamespace
+from typing import Dict, Optional, Sequence, Set, Tuple
+
+from consensus_specs_tpu.utils.hash_function import hash
+from consensus_specs_tpu.utils.ssz import (
+    hash_tree_root, uint_to_bytes, copy as ssz_copy,
+    boolean, uint8, uint32, uint64, Bytes4, Bytes32, Bytes48, Bytes96,
+    Bitlist, Bitvector, Vector, List, Container,
+)
+from consensus_specs_tpu.utils import bls
+from consensus_specs_tpu.forks.fork_choice import ForkChoiceMixin
+from consensus_specs_tpu.forks.validator_guide import ValidatorGuideMixin
+from consensus_specs_tpu.forks.phase0 import _LRUDict, _bytes_of
+from consensus_specs_tpu.forks.base_types import *  # noqa: F401,F403
+""",
+    },
+}
+
+
+def emit_spec_module(doc, class_name=None) -> str:
+    """SpecDocument -> python module source."""
+    scaffold = _SCAFFOLD[doc.fork]
+    class_name = class_name or f"Compiled{doc.fork.capitalize()}Spec"
+    out = [f'"""AUTO-COMPILED from specs/{doc.fork}/ — do not edit.\n'
+           f'Source of truth: the markdown spec; regenerate with\n'
+           f'`python -m consensus_specs_tpu.compiler`."""',
+           scaffold["imports"]]
+
+    out.append(f"class {class_name}({scaffold['bases']}):")
+    out.append(f'    fork = "{doc.fork}"')
+    prev = f'"{doc.previous_fork}"' if doc.previous_fork else "None"
+    out.append(f"    previous_fork = {prev}")
+    out.append("")
+    # surface re-exports matching the hand-written class
+    out.append(textwrap.indent(textwrap.dedent("""\
+        hash = staticmethod(hash)
+        hash_tree_root = staticmethod(hash_tree_root)
+        uint_to_bytes = staticmethod(uint_to_bytes)
+        copy = staticmethod(ssz_copy)
+        bls = bls
+        Slot, Epoch, CommitteeIndex = Slot, Epoch, CommitteeIndex
+        ValidatorIndex, Gwei, Root = ValidatorIndex, Gwei, Root
+        Hash32, Version, DomainType = Hash32, Version, DomainType
+        ForkDigest, Domain = ForkDigest, Domain
+        BLSPubkey, BLSSignature = BLSPubkey, BLSSignature
+        uint8, uint64, Bytes32 = uint8, uint64, Bytes32
+        GENESIS_SLOT, GENESIS_EPOCH = GENESIS_SLOT, GENESIS_EPOCH
+        FAR_FUTURE_EPOCH = FAR_FUTURE_EPOCH
+        BASE_REWARDS_PER_EPOCH = BASE_REWARDS_PER_EPOCH
+        DEPOSIT_CONTRACT_TREE_DEPTH = DEPOSIT_CONTRACT_TREE_DEPTH
+        JUSTIFICATION_BITS_LENGTH = JUSTIFICATION_BITS_LENGTH
+        BLS_WITHDRAWAL_PREFIX = BLS_WITHDRAWAL_PREFIX
+        ETH1_ADDRESS_WITHDRAWAL_PREFIX = ETH1_ADDRESS_WITHDRAWAL_PREFIX
+        DOMAIN_BEACON_PROPOSER = DOMAIN_BEACON_PROPOSER
+        DOMAIN_BEACON_ATTESTER = DOMAIN_BEACON_ATTESTER
+        DOMAIN_RANDAO = DOMAIN_RANDAO
+        DOMAIN_DEPOSIT = DOMAIN_DEPOSIT
+        DOMAIN_VOLUNTARY_EXIT = DOMAIN_VOLUNTARY_EXIT
+        DOMAIN_SELECTION_PROOF = DOMAIN_SELECTION_PROOF
+        DOMAIN_AGGREGATE_AND_PROOF = DOMAIN_AGGREGATE_AND_PROOF
+        """), "    "))
+    for name, value in doc.constants.items():
+        out.append(f"    {name} = {value}")
+    out.append("")
+    for block in doc.code_blocks:
+        out.append(textwrap.indent(block, "    "))
+        out.append("")
+    return "\n".join(out) + "\n"
+
+
+def compile_spec(md_path: str, out_path: str = None) -> str:
+    """Compile one markdown spec; returns (and optionally writes) the
+    module source."""
+    with open(md_path) as f:
+        doc = parse_markdown_spec(f.read())
+    src = emit_spec_module(doc)
+    compile(src, out_path or "<compiled-spec>", "exec")  # syntax gate
+    if out_path:
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        with open(out_path, "w") as f:
+            f.write(src)
+    return src
+
+
+def main():
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    targets = [("phase0", os.path.join(repo, "specs/phase0/beacon-chain.md"))]
+    for fork, md_path in targets:
+        out_path = os.path.join(
+            repo, "consensus_specs_tpu/forks/compiled", f"{fork}.py")
+        compile_spec(md_path, out_path)
+        print(f"compiled {md_path} -> {out_path}")
+    init = os.path.join(repo, "consensus_specs_tpu/forks/compiled",
+                        "__init__.py")
+    if not os.path.exists(init):
+        with open(init, "w") as f:
+            f.write('"""Markdown-compiled spec modules (make pyspec)."""\n')
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""Markdown spec parser (role of ``setup.py:178-303`` get_spec).
+
+Grammar understood:
+- ``### <Section>`` headers give structure (kept for diagnostics only);
+- fenced ```python blocks contain spec members: methods of the spec
+  class (``def name(self, ...)``), SSZ container classes, or plain
+  assignments (custom types / module constants);
+- two-column constant tables ``| NAME | value |`` classify as constants
+  (value parses) — preset/config vars are runtime-bound by the class
+  machinery and appear as documentation-only tables (3+ columns).
+"""
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+
+@dataclass
+class SpecDocument:
+    fork: str = ""
+    previous_fork: str = ""
+    title: str = ""
+    constants: Dict[str, str] = field(default_factory=dict)
+    code_blocks: List[str] = field(default_factory=list)
+
+    def functions(self) -> Dict[str, str]:
+        """name -> source for every top-level def in the code blocks."""
+        out = {}
+        for block in self.code_blocks:
+            for name, src in _split_defs(block):
+                out[name] = src
+        return out
+
+
+_FENCE_RE = re.compile(r"^```python\s*$")
+_FENCE_END_RE = re.compile(r"^```\s*$")
+_META_RE = re.compile(r"^<!--\s*(\w+):\s*([\w-]+)\s*-->$")
+_CONST_ROW_RE = re.compile(r"^\|\s*`?([A-Z][A-Z0-9_]*)`?\s*\|\s*`?([^|`]+)`?\s*\|\s*$")
+
+
+def parse_markdown_spec(text: str) -> SpecDocument:
+    doc = SpecDocument()
+    lines = text.splitlines()
+    i = 0
+    in_block = False
+    block_lines: List[str] = []
+    while i < len(lines):
+        line = lines[i]
+        if in_block:
+            if _FENCE_END_RE.match(line):
+                doc.code_blocks.append("\n".join(block_lines))
+                block_lines = []
+                in_block = False
+            else:
+                block_lines.append(line)
+        elif _FENCE_RE.match(line):
+            in_block = True
+        else:
+            meta = _META_RE.match(line.strip())
+            if meta:
+                key, value = meta.groups()
+                if key == "fork":
+                    doc.fork = value
+                elif key == "previous_fork":
+                    doc.previous_fork = value
+            elif line.startswith("# ") and not doc.title:
+                doc.title = line[2:].strip()
+            else:
+                row = _CONST_ROW_RE.match(line.strip())
+                if row and row.group(2).strip() not in ("Value", "---",
+                                                        ":---:"):
+                    name, value = row.groups()
+                    value = value.strip()
+                    if _parses_as_value(value):
+                        doc.constants[name] = value
+        i += 1
+    if in_block:
+        raise ValueError("unterminated python fence")
+    return doc
+
+
+def _parses_as_value(value: str) -> bool:
+    try:
+        compile(value, "<spec-table>", "eval")
+        return True
+    except SyntaxError:
+        return False
+
+
+def _split_defs(block: str):
+    """Yield (name, source) for each top-level def/class in a block."""
+    lines = block.splitlines()
+    starts = []
+    for idx, line in enumerate(lines):
+        m = re.match(r"^(def|class)\s+(\w+)", line)
+        if m:
+            starts.append((idx, m.group(2)))
+        elif re.match(r"^\w+\s*=", line) and "(" not in line.split("=")[0]:
+            starts.append((idx, line.split("=")[0].strip()))
+    starts.append((len(lines), None))
+    for (begin, name), (end, _) in zip(starts, starts[1:]):
+        src = "\n".join(lines[begin:end]).rstrip()
+        if src:
+            yield name, src

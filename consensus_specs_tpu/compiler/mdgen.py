@@ -1,0 +1,166 @@
+"""Bootstrap tool: emit the canonical markdown spec from a spec class.
+
+Run once per fork to materialize ``specs/<fork>/beacon-chain.md`` with the
+runtime's method sources as the embedded python blocks; from then on the
+markdown is the editable source of truth and ``compiler.emit`` closes the
+loop back to an importable module (golden-tested for parity).
+"""
+import inspect
+import os
+import textwrap
+
+_SECTIONS = [
+    ("Configuration and types", """
+The spec class binds a **preset** (compile-time constants: list limits,
+committee sizes) and a **config** (runtime parameters: fork epochs,
+genesis settings) at construction, then builds every SSZ container with
+the preset's dimensions baked in.  This is the same two-tier constant
+split the wire format depends on.""",
+     ["__init__", "_build_config"]),
+    ("Containers", """
+All beacon-chain containers.  Field order is consensus-critical: it fixes
+both the serialized layout and every generalized index.""",
+     ["_build_types", "_block_body_fields", "_state_fields"]),
+    ("Math helpers", """
+Integer math used across the transition.  `integer_squareroot` must floor
+and must accept the full uint64 range.""",
+     ["integer_squareroot", "xor", "bytes_to_uint64"]),
+    ("Predicates", """
+Validator/attestation predicates.  Exceptions raised anywhere below mean
+the containing object is invalid.""",
+     ["is_active_validator", "is_eligible_for_activation_queue",
+      "is_eligible_for_activation", "is_slashable_validator",
+      "is_slashable_attestation_data", "is_valid_indexed_attestation",
+      "is_valid_merkle_branch"]),
+    ("Shuffling and committees", """
+The swap-or-not shuffle and everything derived from it.  Committee
+membership for an epoch is fully determined by the seed, so it can be
+computed one epoch ahead.""",
+     ["compute_shuffled_index", "compute_proposer_index",
+      "compute_committee"]),
+    ("Time and domains", """
+Slot/epoch arithmetic and the domain-separation scheme that keeps
+signatures from one context unusable in another.""",
+     ["compute_epoch_at_slot", "compute_start_slot_at_epoch",
+      "compute_activation_exit_epoch", "compute_fork_data_root",
+      "compute_fork_digest", "compute_domain", "compute_signing_root"]),
+    ("State accessors", """
+Read-only views over the state.  The committee/proposer accessors memoize
+on the registry root — identical lookups dominate block processing.""",
+     ["get_current_epoch", "get_previous_epoch", "get_block_root",
+      "get_block_root_at_slot", "get_randao_mix",
+      "get_active_validator_indices", "get_validator_churn_limit",
+      "get_seed", "get_committee_count_per_slot", "get_beacon_committee",
+      "get_beacon_proposer_index", "get_total_balance",
+      "get_total_active_balance", "get_domain", "get_indexed_attestation",
+      "get_attesting_indices"]),
+    ("State mutators", """
+Balance arithmetic saturates at zero; exits are queued against the churn
+limit; slashing burns a proportional penalty and rewards the reporter.""",
+     ["increase_balance", "decrease_balance", "initiate_validator_exit",
+      "slash_validator"]),
+    ("Genesis", """
+Bootstrapping from eth1 deposits.  The state becomes valid once enough
+full-balance validators are active at the configured genesis time.""",
+     ["initialize_beacon_state_from_eth1", "is_valid_genesis_state"]),
+    ("State transition", """
+The top-level transition: empty slots are processed one at a time (epoch
+processing fires on boundaries), the proposer signature is checked, the
+block is applied, and the resulting state root must match the block.
+Signature checks inside one block batch into a single verification
+dispatch — the framework's device-native hot path.""",
+     ["state_transition", "verify_block_signature", "process_slots",
+      "process_slot"]),
+    ("Epoch processing", """
+The ten end-of-epoch stages, in mandatory order.  Justification counts
+attesting balance for the two FFG checkpoints; finalization applies the
+2-of-3 voting rules over the last four epochs.""",
+     ["process_epoch", "get_matching_source_attestations",
+      "get_matching_target_attestations", "get_matching_head_attestations",
+      "get_unslashed_attesting_indices", "get_attesting_balance",
+      "process_justification_and_finalization",
+      "weigh_justification_and_finalization"]),
+    ("Rewards and penalties", """
+Per-component deltas: source/target/head participation, proposer
+inclusion rewards, and the inactivity leak that drains non-participants
+whenever finality stalls.""",
+     ["get_base_reward", "get_proposer_reward", "get_finality_delay",
+      "is_in_inactivity_leak", "get_eligible_validator_indices",
+      "get_attestation_component_deltas", "get_source_deltas",
+      "get_target_deltas", "get_head_deltas", "get_inclusion_delay_deltas",
+      "get_inactivity_penalty_deltas", "get_attestation_deltas",
+      "process_rewards_and_penalties"]),
+    ("Registry updates and slashings", """
+Activation queueing under the churn limit, ejections, and the
+proportional slashing penalty sweep.""",
+     ["process_registry_updates", "process_slashings",
+      "process_eth1_data_reset", "process_effective_balance_updates",
+      "process_slashings_reset", "process_randao_mixes_reset",
+      "process_historical_roots_update",
+      "process_participation_record_updates"]),
+    ("Block processing", """
+Header checks, randao mixing, eth1 voting, then the five operation
+lists.  Every assertion failure invalidates the whole block.""",
+     ["process_block", "process_block_header", "process_randao",
+      "process_eth1_data", "process_operations",
+      "process_proposer_slashing", "process_attester_slashing",
+      "process_attestation", "get_validator_from_deposit",
+      "add_validator_to_registry", "apply_deposit", "process_deposit",
+      "process_voluntary_exit"]),
+]
+
+
+def generate_markdown(spec_cls, fork: str, previous_fork=None) -> str:
+    out = [f"# The {fork} beacon chain",
+           "",
+           f"<!-- fork: {fork} -->"]
+    if previous_fork:
+        out.append(f"<!-- previous_fork: {previous_fork} -->")
+    out.append("""
+This document is the canonical specification of the %s consensus runtime
+of this framework.  The fenced python blocks ARE the implementation: the
+spec compiler (`python -m consensus_specs_tpu.compiler`) assembles them
+into the importable runtime, and the conformance suite runs against the
+result.  Behavioral parity target: ethereum/consensus-specs v1.4.0-beta.7
+(`specs/%s/beacon-chain.md` of the reference tree).
+""" % (fork, fork))
+
+    emitted = set()
+    for title, prose, names in _SECTIONS:
+        out.append(f"## {title}")
+        out.append(textwrap.dedent(prose).strip())
+        out.append("")
+        for name in names:
+            fn = spec_cls.__dict__.get(name)
+            if fn is None:
+                continue
+            src = textwrap.dedent(inspect.getsource(fn))
+            out.append(f"### `{name}`\n")
+            out.append("```python")
+            out.append(src.rstrip())
+            out.append("```")
+            out.append("")
+            emitted.add(name)
+
+    import types
+    missing = [n for n, v in spec_cls.__dict__.items()
+               if isinstance(v, types.FunctionType)
+               and not n.startswith("__") and n not in emitted]
+    if missing:
+        raise RuntimeError(f"sections missing methods: {missing}")
+    return "\n".join(out) + "\n"
+
+
+def main():
+    from consensus_specs_tpu.forks.phase0 import Phase0Spec
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    path = os.path.join(repo, "specs", "phase0", "beacon-chain.md")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(generate_markdown(Phase0Spec, "phase0"))
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

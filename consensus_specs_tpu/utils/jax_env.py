@@ -30,6 +30,45 @@ def keyed_cache_dir() -> str:
     return os.path.join(_CACHE_ROOT, "-".join(parts))
 
 
+def force_cpu_platform() -> None:
+    """Make this process's JAX run on host CPU only, reliably.
+
+    Setting ``JAX_PLATFORMS=cpu`` in the environment is NOT enough here:
+    the container's accelerator plugin calls
+    ``jax.config.update("jax_platforms", "axon,cpu")`` during interpreter
+    startup (sitecustomize), which overrides the env var and makes every
+    ``backends()`` call initialize the tunnel-backed accelerator first —
+    hanging all JAX work whenever the tunnel is unavailable.  Tests and
+    the multichip dryrun must never depend on that tunnel, so this pushes
+    ``cpu`` back through jax.config (and clears any already-initialized
+    backend set so the change takes effect).
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    try:
+        from jax._src import xla_bridge as xb
+        initialized = xb.backends_are_initialized()
+    except Exception:
+        initialized = True  # unknown — clear defensively below
+    if initialized:
+        try:
+            from jax.extend.backend import clear_backends
+            clear_backends()
+        except Exception:
+            try:
+                jax.clear_backends()
+            except Exception:
+                import warnings
+                warnings.warn(
+                    "force_cpu_platform: could not clear initialized JAX "
+                    "backends; a previously-selected accelerator backend "
+                    "may still be active")
+
+
 def setup_compile_cache() -> str:
     """Point JAX at the keyed persistent cache; idempotent.
 
