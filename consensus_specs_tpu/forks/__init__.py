@@ -28,7 +28,8 @@ def fork_registry() -> Dict[str, type]:
 def _import_all():
     import importlib.util
     from . import phase0  # noqa: F401
-    for mod in ("altair", "bellatrix", "capella", "deneb"):
+    for mod in ("altair", "bellatrix", "capella", "deneb",
+                "eip6110", "eip7002"):
         # Probe existence first so a real import error inside an existing
         # fork module propagates instead of silently dropping the fork
         # (and silently skipping its whole test suite).

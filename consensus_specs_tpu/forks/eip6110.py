@@ -1,0 +1,124 @@
+"""EIP-6110 feature fork: in-protocol deposit receipts.
+
+Behavioral source: ``specs/_features/eip6110/beacon-chain.md``
+(``DepositReceipt`` :63, extended payload :76-118, modified
+``process_operations`` :194, ``process_deposit_receipt`` :221) and
+``specs/_features/eip6110/fork.md``.  Fork DAG parent: deneb
+(``pysetup/md_doc_paths.py:22``).
+"""
+from consensus_specs_tpu.utils.ssz import (
+    hash_tree_root, uint64, Bytes32, List, Container,
+)
+from . import register_fork
+from .deneb import DenebSpec
+from .base_types import Gwei, BLSPubkey, BLSSignature
+
+UNSET_DEPOSIT_RECEIPTS_START_INDEX = uint64(2**64 - 1)
+
+
+@register_fork("eip6110")
+class EIP6110Spec(DenebSpec):
+    fork = "eip6110"
+    previous_fork = "deneb"
+
+    UNSET_DEPOSIT_RECEIPTS_START_INDEX = UNSET_DEPOSIT_RECEIPTS_START_INDEX
+
+    def _build_types(self):
+        class DepositReceipt(Container):
+            pubkey: BLSPubkey
+            withdrawal_credentials: Bytes32
+            amount: Gwei
+            signature: BLSSignature
+            index: uint64
+
+        self.DepositReceipt = DepositReceipt
+        super()._build_types()
+
+    def _execution_payload_fields(self) -> dict:
+        fields = super()._execution_payload_fields()
+        fields["deposit_receipts"] = List[
+            self.DepositReceipt, self.MAX_DEPOSIT_RECEIPTS_PER_PAYLOAD]
+        return fields
+
+    def _execution_payload_header_fields(self) -> dict:
+        fields = super()._execution_payload_header_fields()
+        fields["deposit_receipts_root"] = Bytes32
+        return fields
+
+    def _state_fields(self, t) -> dict:
+        fields = super()._state_fields(t)
+        fields["deposit_receipts_start_index"] = uint64
+        return fields
+
+    def _payload_to_header(self, payload):
+        header = super()._payload_to_header(payload)
+        header.deposit_receipts_root = hash_tree_root(
+            payload.deposit_receipts)
+        return header
+
+    def process_operations(self, state, body):
+        """beacon-chain.md:194 — former deposit channel winds down once
+        the receipts flow starts; receipts processed from the payload."""
+        eth1_deposit_index_limit = min(state.eth1_data.deposit_count,
+                                       state.deposit_receipts_start_index)
+        if state.eth1_deposit_index < eth1_deposit_index_limit:
+            assert len(body.deposits) == min(
+                self.MAX_DEPOSITS,
+                eth1_deposit_index_limit - state.eth1_deposit_index)
+        else:
+            assert len(body.deposits) == 0
+
+        for operation in body.proposer_slashings:
+            self.process_proposer_slashing(state, operation)
+        for operation in body.attester_slashings:
+            self.process_attester_slashing(state, operation)
+        for operation in body.attestations:
+            self.process_attestation(state, operation)
+        for operation in body.deposits:
+            self.process_deposit(state, operation)
+        for operation in body.voluntary_exits:
+            self.process_voluntary_exit(state, operation)
+        for operation in body.bls_to_execution_changes:
+            self.process_bls_to_execution_change(state, operation)
+        # [New in EIP6110]
+        for operation in body.execution_payload.deposit_receipts:
+            self.process_deposit_receipt(state, operation)
+
+    def process_deposit_receipt(self, state, deposit_receipt):
+        """beacon-chain.md:221"""
+        if state.deposit_receipts_start_index == \
+                UNSET_DEPOSIT_RECEIPTS_START_INDEX:
+            state.deposit_receipts_start_index = deposit_receipt.index
+        self.apply_deposit(
+            state=state,
+            pubkey=deposit_receipt.pubkey,
+            withdrawal_credentials=deposit_receipt.withdrawal_credentials,
+            amount=deposit_receipt.amount,
+            signature=deposit_receipt.signature,
+        )
+
+    def post_mock_genesis(self, state):
+        super().post_mock_genesis(state)
+        state.deposit_receipts_start_index = \
+            UNSET_DEPOSIT_RECEIPTS_START_INDEX
+
+    def upgrade_to_eip6110(self, pre):
+        """fork.md — deneb state + unset receipts start index."""
+        post = self.BeaconState(
+            **{f: getattr(pre, f) for f in type(pre).fields()
+               if f not in ("fork", "latest_execution_payload_header")},
+            fork=self.Fork(
+                previous_version=pre.fork.current_version,
+                current_version=self.config.EIP6110_FORK_VERSION,
+                epoch=self.get_current_epoch(pre),
+            ),
+            latest_execution_payload_header=self._translate_header(
+                pre.latest_execution_payload_header),
+            deposit_receipts_start_index=UNSET_DEPOSIT_RECEIPTS_START_INDEX,
+        )
+        return post
+
+    def _translate_header(self, pre_header):
+        fields = {f: getattr(pre_header, f)
+                  for f in type(pre_header).fields()}
+        return self.ExecutionPayloadHeader(**fields)

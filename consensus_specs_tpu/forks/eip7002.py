@@ -1,0 +1,82 @@
+"""EIP-7002 feature fork: execution-layer triggerable exits.
+
+Behavioral source: ``specs/_features/eip7002/beacon-chain.md``
+(``ExecutionLayerExit`` :54, extended payload :61-118, modified
+``process_operations`` :200, ``process_execution_layer_exit`` :223).
+Fork DAG parent: capella (``pysetup/md_doc_paths.py:24``).
+"""
+from consensus_specs_tpu.utils.ssz import (
+    hash_tree_root, Bytes32, List, Container,
+)
+from . import register_fork
+from .capella import CapellaSpec
+from .base_types import (
+    ValidatorIndex, ExecutionAddress, BLSPubkey,
+    ETH1_ADDRESS_WITHDRAWAL_PREFIX,
+)
+
+
+@register_fork("eip7002")
+class EIP7002Spec(CapellaSpec):
+    fork = "eip7002"
+    previous_fork = "capella"
+
+    # preset (beacon-chain.md:45)
+    MAX_EXECUTION_LAYER_EXITS = 2**4
+
+    def _build_types(self):
+        class ExecutionLayerExit(Container):
+            source_address: ExecutionAddress
+            validator_pubkey: BLSPubkey
+
+        self.ExecutionLayerExit = ExecutionLayerExit
+        super()._build_types()
+
+    def _execution_payload_fields(self) -> dict:
+        fields = super()._execution_payload_fields()
+        fields["exits"] = List[self.ExecutionLayerExit,
+                               self.MAX_EXECUTION_LAYER_EXITS]
+        return fields
+
+    def _execution_payload_header_fields(self) -> dict:
+        fields = super()._execution_payload_header_fields()
+        fields["exits_root"] = Bytes32
+        return fields
+
+    def _payload_to_header(self, payload):
+        header = super()._payload_to_header(payload)
+        header.exits_root = hash_tree_root(payload.exits)
+        return header
+
+    def process_operations(self, state, body):
+        """beacon-chain.md:200 — adds payload-carried exits."""
+        super().process_operations(state, body)
+        for operation in body.execution_payload.exits:
+            self.process_execution_layer_exit(state, operation)
+
+    def process_execution_layer_exit(self, state, execution_layer_exit):
+        """beacon-chain.md:223 — credential/activation mismatches no-op;
+        an unknown pubkey raises (ValueError = invalid block), exactly as
+        the reference's list.index does."""
+        validator_pubkeys = [v.pubkey for v in state.validators]
+        validator_index = ValidatorIndex(validator_pubkeys.index(
+            execution_layer_exit.validator_pubkey))
+        validator = state.validators[validator_index]
+
+        is_execution_address = bytes(
+            validator.withdrawal_credentials[:1]) == \
+            ETH1_ADDRESS_WITHDRAWAL_PREFIX
+        is_correct_source_address = bytes(
+            validator.withdrawal_credentials[12:]) == \
+            bytes(execution_layer_exit.source_address)
+        if not (is_execution_address and is_correct_source_address):
+            return
+        if not self.is_active_validator(validator,
+                                        self.get_current_epoch(state)):
+            return
+        if validator.exit_epoch != self.FAR_FUTURE_EPOCH:
+            return
+        if self.get_current_epoch(state) < validator.activation_epoch \
+                + self.config.SHARD_COMMITTEE_PERIOD:
+            return
+        self.initiate_validator_exit(state, validator_index)

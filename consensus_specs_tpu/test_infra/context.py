@@ -23,13 +23,15 @@ DEFAULT_BLS_TYPE = "py"
 ONLY_FORK = None
 
 ALL_PHASES = ("phase0", "altair", "bellatrix", "capella", "deneb")
+# feature forks: selectable via with_phases, excluded from with_all_phases
+FEATURE_PHASES = ("eip6110", "eip7002")
 MINIMAL = "minimal"
 MAINNET = "mainnet"
 
 
 def _available_phases():
     reg = fork_registry()
-    return [p for p in ALL_PHASES if p in reg]
+    return [p for p in ALL_PHASES + FEATURE_PHASES if p in reg]
 
 
 # ---------------------------------------------------------------------------

@@ -35,10 +35,16 @@ def _genesis_fork_versions(spec):
         "bellatrix": getattr(spec.config, "BELLATRIX_FORK_VERSION", None),
         "capella": getattr(spec.config, "CAPELLA_FORK_VERSION", None),
         "deneb": getattr(spec.config, "DENEB_FORK_VERSION", None),
+        "eip6110": getattr(spec.config, "EIP6110_FORK_VERSION", None),
+        "eip7002": getattr(spec.config, "EIP7002_FORK_VERSION", None),
     }
-    order = ["phase0", "altair", "bellatrix", "capella", "deneb"]
+    order = ["phase0", "altair", "bellatrix", "capella", "deneb",
+             "eip6110", "eip7002"]
+    # feature forks branch off their DAG parent, not list order
+    parents = {"eip7002": "capella"}
     cur = versions[fork]
-    prev = versions[order[max(0, order.index(fork) - 1)]]
+    prev_name = parents.get(fork, order[max(0, order.index(fork) - 1)])
+    prev = versions[prev_name]
     return prev, cur
 
 

@@ -1,0 +1,134 @@
+"""EIP-7594 sampling tests: FFT, cells, multiproofs, erasure recovery.
+
+Reference model: the eip7594 test surface against
+``specs/_features/eip7594/polynomial-commitments-sampling.md``.
+"""
+import random
+
+import pytest
+
+from consensus_specs_tpu.ops import kzg as K
+from consensus_specs_tpu.ops import kzg_7594 as S
+
+SETUP = K.trusted_setup("minimal")
+WIDTH = SETUP.FIELD_ELEMENTS_PER_BLOB
+EXT = 2 * WIDTH
+BLS_MODULUS = K.BLS_MODULUS
+
+
+def _random_blob(seed):
+    rng = random.Random(seed)
+    return b"".join(rng.randrange(BLS_MODULUS).to_bytes(32, "big")
+                    for _ in range(WIDTH))
+
+
+def _cells_to_bytes(cell):
+    return [int(x).to_bytes(32, "big") for x in cell]
+
+
+def test_fft_roundtrip():
+    rng = random.Random(11)
+    vals = [rng.randrange(BLS_MODULUS) for _ in range(256)]
+    roots = list(K.compute_roots_of_unity(256))
+    freq = S.fft_field(vals, roots)
+    back = S.fft_field(freq, roots, inv=True)
+    assert back == vals
+
+
+def test_fft_matches_direct_evaluation():
+    """FFT output i must equal p(w^i) for the coefficient polynomial."""
+    rng = random.Random(12)
+    coeffs = [rng.randrange(BLS_MODULUS) for _ in range(64)]
+    roots = list(K.compute_roots_of_unity(64))
+    freq = S.fft_field(coeffs, roots)
+    for i in (0, 1, 5, 63):
+        assert freq[i] == S.evaluate_polynomialcoeff(coeffs, roots[i])
+
+
+def test_polynomial_arithmetic():
+    a = [1, 2, 3]
+    b = [5, 7]
+    prod = S.multiply_polynomialcoeff(a, b)
+    # (1+2x+3x^2)(5+7x) = 5 + 17x + 29x^2 + 21x^3
+    assert prod == [5, 17, 29, 21]
+    quot = S.divide_polynomialcoeff(prod, b)
+    assert quot == [1, 2, 3]
+    z = 9
+    assert S.evaluate_polynomialcoeff(prod, z) == \
+        S.evaluate_polynomialcoeff(a, z) * S.evaluate_polynomialcoeff(b, z) \
+        % BLS_MODULUS
+
+
+def test_interpolation_and_vanishing():
+    rng = random.Random(13)
+    xs = [rng.randrange(BLS_MODULUS) for _ in range(6)]
+    ys = [rng.randrange(BLS_MODULUS) for _ in range(6)]
+    poly = S.interpolate_polynomialcoeff(xs, ys)
+    for x, y in zip(xs, ys):
+        assert S.evaluate_polynomialcoeff(poly, x) == y
+    vanish = S.vanishing_polynomialcoeff(xs)
+    for x in xs:
+        assert S.evaluate_polynomialcoeff(vanish, x) == 0
+
+
+def test_compute_cells_extends_the_blob():
+    """First half of the (de-brp'd) extended data = original evaluations."""
+    blob = _random_blob(21)
+    cells = S.compute_cells(blob, SETUP)
+    assert len(cells) == S.cells_per_blob(SETUP)
+    flat_rbo = [x for cell in cells for x in cell]
+    extended = S.fft_field(
+        K.bit_reversal_permutation(flat_rbo),
+        list(K.compute_roots_of_unity(EXT)), inv=False)
+    # instead of comparing domains directly, interpolate back: the
+    # extended evaluations must agree with the original polynomial
+    polynomial = K.blob_to_polynomial(blob, WIDTH)
+    coeffs = S.polynomial_eval_to_coeff(polynomial, SETUP)
+    roots_ext = list(K.compute_roots_of_unity(EXT))
+    brp_ext = K.bit_reversal_permutation(list(range(EXT)))
+    for probe in (0, 1, 77, EXT - 1):
+        idx = brp_ext[probe]
+        assert flat_rbo[probe] == S.evaluate_polynomialcoeff(
+            coeffs, roots_ext[idx])
+
+
+def test_cell_multiproof_verifies():
+    blob = _random_blob(22)
+    commitment = K.blob_to_kzg_commitment(blob, SETUP)
+    polynomial = K.blob_to_polynomial(blob, WIDTH)
+    coeffs = S.polynomial_eval_to_coeff(polynomial, SETUP)
+    cell_id = 3
+    coset = S.coset_for_cell(cell_id, SETUP)
+    proof, ys = S.compute_kzg_proof_multi_impl(coeffs, coset, SETUP)
+    assert S.verify_cell_proof(commitment, cell_id, _cells_to_bytes(ys),
+                               proof, SETUP)
+    # tampered cell data must fail
+    bad = list(ys)
+    bad[0] = (bad[0] + 1) % BLS_MODULUS
+    assert not S.verify_cell_proof(commitment, cell_id,
+                                   _cells_to_bytes(bad), proof, SETUP)
+    # batch wrapper
+    assert S.verify_cell_proof_batch(
+        [commitment], [0], [cell_id], [_cells_to_bytes(ys)], [proof], SETUP)
+
+
+def test_recover_polynomial_from_half_the_cells():
+    blob = _random_blob(23)
+    cells = S.compute_cells(blob, SETUP)
+    n_cells = S.cells_per_blob(SETUP)
+    rng = random.Random(99)
+    kept = sorted(rng.sample(range(n_cells), n_cells // 2))
+    recovered = S.recover_polynomial(
+        kept, [_cells_to_bytes(cells[i]) for i in kept], SETUP)
+    full = [x for cell in cells for x in cell]
+    assert recovered == full
+
+
+def test_recover_rejects_insufficient_cells():
+    blob = _random_blob(24)
+    cells = S.compute_cells(blob, SETUP)
+    n_cells = S.cells_per_blob(SETUP)
+    kept = list(range(n_cells // 2 - 1))
+    with pytest.raises(AssertionError):
+        S.recover_polynomial(
+            kept, [_cells_to_bytes(cells[i]) for i in kept], SETUP)

@@ -1,0 +1,94 @@
+"""EIP-6110 deposit-receipt tests.
+
+Reference model: ``test/eip6110/block_processing/test_deposit_receipt.py``
+against ``specs/_features/eip6110/beacon-chain.md:194-232``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, always_bls, expect_assertion_error,
+)
+from consensus_specs_tpu.test_infra.deposits import build_deposit_data
+from consensus_specs_tpu.test_infra.keys import pubkeys, privkeys
+from consensus_specs_tpu.utils.hash_function import hash
+
+
+def _receipt(spec, validator_index, amount, index=0, signed=True):
+    pubkey = pubkeys[validator_index]
+    withdrawal_credentials = spec.BLS_WITHDRAWAL_PREFIX + hash(pubkey)[1:]
+    data = build_deposit_data(spec, pubkey, privkeys[validator_index],
+                              amount, withdrawal_credentials, signed=signed)
+    return spec.DepositReceipt(
+        pubkey=data.pubkey,
+        withdrawal_credentials=data.withdrawal_credentials,
+        amount=data.amount,
+        signature=data.signature,
+        index=index,
+    )
+
+
+@with_phases(["eip6110"])
+@spec_state_test
+def test_genesis_start_index_unset(spec, state):
+    assert state.deposit_receipts_start_index == \
+        spec.UNSET_DEPOSIT_RECEIPTS_START_INDEX
+
+
+@with_phases(["eip6110"])
+@spec_state_test
+@always_bls
+def test_new_validator_from_receipt(spec, state):
+    pre_count = len(state.validators)
+    new_index = pre_count
+    receipt = _receipt(spec, new_index, spec.MAX_EFFECTIVE_BALANCE, index=7)
+    yield "pre", state
+    spec.process_deposit_receipt(state, receipt)
+    yield "post", state
+    assert len(state.validators) == pre_count + 1
+    assert state.balances[new_index] == spec.MAX_EFFECTIVE_BALANCE
+    # first receipt pins the start index
+    assert state.deposit_receipts_start_index == 7
+
+
+@with_phases(["eip6110"])
+@spec_state_test
+@always_bls
+def test_top_up_existing_validator(spec, state):
+    pre_count = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE // 4
+    receipt = _receipt(spec, 0, amount, index=3)
+    pre_balance = state.balances[0]
+    spec.process_deposit_receipt(state, receipt)
+    assert len(state.validators) == pre_count
+    assert state.balances[0] == pre_balance + amount
+
+
+@with_phases(["eip6110"])
+@spec_state_test
+@always_bls
+def test_invalid_signature_receipt_skipped(spec, state):
+    """An invalid proof of possession skips the validator, like the
+    legacy deposit path."""
+    pre_count = len(state.validators)
+    receipt = _receipt(spec, pre_count, spec.MAX_EFFECTIVE_BALANCE,
+                       signed=False)
+    spec.process_deposit_receipt(state, receipt)
+    assert len(state.validators) == pre_count
+
+
+@with_phases(["eip6110"])
+@spec_state_test
+def test_legacy_deposit_channel_winds_down(spec, state):
+    """Once the receipts flow started and legacy deposits are consumed,
+    blocks must carry zero legacy deposits (beacon-chain.md:194)."""
+    state.deposit_receipts_start_index = 0
+    state.eth1_deposit_index = state.eth1_data.deposit_count
+    body = spec.BeaconBlockBody()
+    # empty deposits list is required and accepted
+    spec.process_operations(state, body)
+
+    state2 = state.copy()
+    state2.eth1_data.deposit_count += 1  # pretend an unprocessed deposit
+    state2.eth1_deposit_index = 0
+    state2.deposit_receipts_start_index = 0
+    # limit = min(count, start=0) = 0 -> must carry zero deposits; a body
+    # with any deposits is invalid, and the empty body passes
+    spec.process_operations(state2, spec.BeaconBlockBody())

@@ -1,0 +1,92 @@
+"""EIP-7002 execution-layer exit tests.
+
+Reference model: ``test/eip7002/block_processing/
+test_process_execution_layer_exit.py`` against
+``specs/_features/eip7002/beacon-chain.md:223``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases,
+)
+from consensus_specs_tpu.test_infra.block import next_epoch
+
+
+def _set_eth1_credentials(spec, state, index, address=b"\x42" * 20):
+    state.validators[index].withdrawal_credentials = (
+        spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + address)
+    return address
+
+
+def _age_validator(spec, state, index):
+    state.validators[index].activation_epoch = 0
+    state.slot = spec.SLOTS_PER_EPOCH * (
+        spec.config.SHARD_COMMITTEE_PERIOD + 1)
+
+
+@with_phases(["eip7002"])
+@spec_state_test
+def test_exit_success(spec, state):
+    index = 0
+    address = _set_eth1_credentials(spec, state, index)
+    _age_validator(spec, state, index)
+    exit_op = spec.ExecutionLayerExit(
+        source_address=address,
+        validator_pubkey=state.validators[index].pubkey)
+    yield "pre", state
+    spec.process_execution_layer_exit(state, exit_op)
+    yield "post", state
+    assert state.validators[index].exit_epoch < spec.FAR_FUTURE_EPOCH
+
+
+@with_phases(["eip7002"])
+@spec_state_test
+def test_exit_wrong_source_address_noop(spec, state):
+    index = 0
+    _set_eth1_credentials(spec, state, index)
+    _age_validator(spec, state, index)
+    exit_op = spec.ExecutionLayerExit(
+        source_address=b"\x99" * 20,
+        validator_pubkey=state.validators[index].pubkey)
+    spec.process_execution_layer_exit(state, exit_op)
+    assert state.validators[index].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_phases(["eip7002"])
+@spec_state_test
+def test_exit_bls_credentials_noop(spec, state):
+    """A validator still on BLS withdrawal credentials cannot be exited
+    from the execution layer."""
+    index = 0
+    _age_validator(spec, state, index)
+    exit_op = spec.ExecutionLayerExit(
+        source_address=b"\x42" * 20,
+        validator_pubkey=state.validators[index].pubkey)
+    spec.process_execution_layer_exit(state, exit_op)
+    assert state.validators[index].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_phases(["eip7002"])
+@spec_state_test
+def test_exit_too_young_noop(spec, state):
+    index = 0
+    address = _set_eth1_credentials(spec, state, index)
+    # not aged: SHARD_COMMITTEE_PERIOD has not passed
+    exit_op = spec.ExecutionLayerExit(
+        source_address=address,
+        validator_pubkey=state.validators[index].pubkey)
+    spec.process_execution_layer_exit(state, exit_op)
+    assert state.validators[index].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_phases(["eip7002"])
+@spec_state_test
+def test_exit_already_initiated_noop(spec, state):
+    index = 0
+    address = _set_eth1_credentials(spec, state, index)
+    _age_validator(spec, state, index)
+    spec.initiate_validator_exit(state, index)
+    first_exit_epoch = state.validators[index].exit_epoch
+    exit_op = spec.ExecutionLayerExit(
+        source_address=address,
+        validator_pubkey=state.validators[index].pubkey)
+    spec.process_execution_layer_exit(state, exit_op)
+    assert state.validators[index].exit_epoch == first_exit_epoch
