@@ -20,13 +20,14 @@ from consensus_specs_tpu.utils.ssz import (
 from consensus_specs_tpu.utils import bls
 from . import register_fork
 from .altair import AltairSpec
+from .optimistic_sync import OptimisticSyncMixin
 from .base_types import (
     Epoch, Gwei, ValidatorIndex, Hash32, ExecutionAddress,
 )
 
 
 @register_fork("bellatrix")
-class BellatrixSpec(AltairSpec):
+class BellatrixSpec(OptimisticSyncMixin, AltairSpec):
     fork = "bellatrix"
     previous_fork = "altair"
 

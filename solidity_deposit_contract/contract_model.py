@@ -1,0 +1,72 @@
+"""Python model of the deposit contract's incremental Merkle accumulator.
+
+Mirrors ``deposit_contract.sol`` statement for statement so the contract
+logic is testable without an EVM (the reference tests its vendored
+contract through a web3 tester the same way — ``Makefile:164-181``).
+The model's root must equal the SSZ ``hash_tree_root`` of the
+``List[DepositData, 2**32]`` the beacon chain verifies against
+(``tests/test_deposit_contract.py``).
+"""
+from hashlib import sha256
+
+DEPOSIT_CONTRACT_TREE_DEPTH = 32
+
+
+def _sha(data: bytes) -> bytes:
+    return sha256(data).digest()
+
+
+class DepositContractModel:
+    def __init__(self):
+        self.branch = [b"\x00" * 32] * DEPOSIT_CONTRACT_TREE_DEPTH
+        self.deposit_count = 0
+        self.zero_hashes = [b"\x00" * 32] * DEPOSIT_CONTRACT_TREE_DEPTH
+        for height in range(DEPOSIT_CONTRACT_TREE_DEPTH - 1):
+            self.zero_hashes[height + 1] = _sha(
+                self.zero_hashes[height] + self.zero_hashes[height])
+
+    @staticmethod
+    def deposit_data_root(pubkey: bytes, withdrawal_credentials: bytes,
+                          amount_gwei: int, signature: bytes) -> bytes:
+        """On-chain SSZ hash_tree_root(DepositData) reconstruction."""
+        pubkey_root = _sha(pubkey + b"\x00" * 16)
+        signature_root = _sha(
+            _sha(signature[:64]) + _sha(signature[64:] + b"\x00" * 32))
+        return _sha(
+            _sha(pubkey_root + withdrawal_credentials)
+            + _sha(amount_gwei.to_bytes(8, "little") + b"\x00" * 24
+                   + signature_root))
+
+    def deposit(self, pubkey: bytes, withdrawal_credentials: bytes,
+                amount_gwei: int, signature: bytes) -> None:
+        assert len(pubkey) == 48
+        assert len(withdrawal_credentials) == 32
+        assert len(signature) == 96
+        assert amount_gwei >= 10**9  # 1 ether minimum
+        node = self.deposit_data_root(pubkey, withdrawal_credentials,
+                                      amount_gwei, signature)
+        assert self.deposit_count < 2 ** DEPOSIT_CONTRACT_TREE_DEPTH - 1
+        self.deposit_count += 1
+        size = self.deposit_count
+        for height in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+            if size & 1:
+                self.branch[height] = node
+                return
+            node = _sha(self.branch[height] + node)
+            size //= 2
+        raise AssertionError("unreachable")
+
+    def get_deposit_root(self) -> bytes:
+        node = b"\x00" * 32
+        size = self.deposit_count
+        for height in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+            if size & 1:
+                node = _sha(self.branch[height] + node)
+            else:
+                node = _sha(node + self.zero_hashes[height])
+            size //= 2
+        return _sha(node + self.deposit_count.to_bytes(8, "little")
+                    + b"\x00" * 24)
+
+    def get_deposit_count(self) -> bytes:
+        return self.deposit_count.to_bytes(8, "little")

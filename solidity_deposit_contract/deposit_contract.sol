@@ -1,0 +1,150 @@
+// SPDX-License-Identifier: CC0-1.0
+pragma solidity ^0.8.19;
+
+// Beacon-chain deposit contract (capability parity with the artifact the
+// reference vendors; specified by specs/phase0/deposit-contract.md).
+// Maintains an incremental Merkle accumulator over SSZ DepositData roots
+// so get_deposit_root() always equals the SSZ hash_tree_root of the
+// deposit list (with length mix-in) that the beacon chain verifies in
+// process_deposit.
+
+interface ERC165 {
+    function supportsInterface(bytes4 interfaceId)
+        external pure returns (bool);
+}
+
+interface IDepositContract {
+    event DepositEvent(
+        bytes pubkey,
+        bytes withdrawal_credentials,
+        bytes amount,
+        bytes signature,
+        bytes index
+    );
+
+    function deposit(
+        bytes calldata pubkey,
+        bytes calldata withdrawal_credentials,
+        bytes calldata signature,
+        bytes32 deposit_data_root
+    ) external payable;
+
+    function get_deposit_root() external view returns (bytes32);
+
+    function get_deposit_count() external view returns (bytes memory);
+}
+
+contract DepositContract is IDepositContract, ERC165 {
+    uint256 private constant DEPOSIT_CONTRACT_TREE_DEPTH = 32;
+    // Accumulator cannot overflow before the sun burns out, but cap like
+    // the consensus spec's list limit anyway.
+    uint256 private constant MAX_DEPOSIT_COUNT =
+        2 ** DEPOSIT_CONTRACT_TREE_DEPTH - 1;
+
+    bytes32[DEPOSIT_CONTRACT_TREE_DEPTH] private branch;
+    uint256 private deposit_count;
+    bytes32[DEPOSIT_CONTRACT_TREE_DEPTH] private zero_hashes;
+
+    constructor() {
+        for (uint256 height = 0;
+             height < DEPOSIT_CONTRACT_TREE_DEPTH - 1;
+             height++)
+            zero_hashes[height + 1] = sha256(
+                abi.encodePacked(zero_hashes[height], zero_hashes[height]));
+    }
+
+    function get_deposit_root() external view override returns (bytes32) {
+        bytes32 node;
+        uint256 size = deposit_count;
+        for (uint256 height = 0;
+             height < DEPOSIT_CONTRACT_TREE_DEPTH;
+             height++) {
+            if ((size & 1) == 1)
+                node = sha256(abi.encodePacked(branch[height], node));
+            else
+                node = sha256(abi.encodePacked(node, zero_hashes[height]));
+            size /= 2;
+        }
+        return sha256(abi.encodePacked(
+            node, to_little_endian_64(uint64(deposit_count)),
+            bytes24(0)));
+    }
+
+    function get_deposit_count() external view override
+            returns (bytes memory) {
+        return to_little_endian_64(uint64(deposit_count));
+    }
+
+    function deposit(
+        bytes calldata pubkey,
+        bytes calldata withdrawal_credentials,
+        bytes calldata signature,
+        bytes32 deposit_data_root
+    ) external payable override {
+        require(pubkey.length == 48, "DepositContract: bad pubkey length");
+        require(withdrawal_credentials.length == 32,
+                "DepositContract: bad credentials length");
+        require(signature.length == 96,
+                "DepositContract: bad signature length");
+
+        require(msg.value >= 1 ether,
+                "DepositContract: deposit value too low");
+        require(msg.value % 1 gwei == 0,
+                "DepositContract: deposit not gwei multiple");
+        uint256 deposit_amount = msg.value / 1 gwei;
+        require(deposit_amount <= type(uint64).max,
+                "DepositContract: deposit value too high");
+
+        emit DepositEvent(
+            pubkey,
+            withdrawal_credentials,
+            to_little_endian_64(uint64(deposit_amount)),
+            signature,
+            to_little_endian_64(uint64(deposit_count)));
+
+        // SSZ hash_tree_root(DepositData) recomputed on-chain so the
+        // supplied root cannot lie about the deposit's content.
+        bytes32 pubkey_root = sha256(abi.encodePacked(pubkey, bytes16(0)));
+        bytes32 signature_root = sha256(abi.encodePacked(
+            sha256(abi.encodePacked(signature[:64])),
+            sha256(abi.encodePacked(signature[64:], bytes32(0)))));
+        bytes32 node = sha256(abi.encodePacked(
+            sha256(abi.encodePacked(pubkey_root, withdrawal_credentials)),
+            sha256(abi.encodePacked(
+                to_little_endian_64(uint64(deposit_amount)), bytes24(0),
+                signature_root))));
+        require(node == deposit_data_root,
+                "DepositContract: reconstructed root mismatch");
+
+        require(deposit_count < MAX_DEPOSIT_COUNT,
+                "DepositContract: merkle tree full");
+        deposit_count += 1;
+        uint256 size = deposit_count;
+        for (uint256 height = 0;
+             height < DEPOSIT_CONTRACT_TREE_DEPTH;
+             height++) {
+            if ((size & 1) == 1) {
+                branch[height] = node;
+                return;
+            }
+            node = sha256(abi.encodePacked(branch[height], node));
+            size /= 2;
+        }
+        assert(false);
+    }
+
+    function supportsInterface(bytes4 interfaceId)
+            external pure override returns (bool) {
+        return interfaceId == type(ERC165).interfaceId
+            || interfaceId == type(IDepositContract).interfaceId;
+    }
+
+    function to_little_endian_64(uint64 value) internal pure
+            returns (bytes memory ret) {
+        ret = new bytes(8);
+        bytes8 b = bytes8(value);
+        for (uint256 i = 0; i < 8; i++) {
+            ret[i] = b[7 - i];
+        }
+    }
+}

@@ -1,0 +1,76 @@
+"""Optimistic-sync rule tests (``sync/optimistic.md``).
+
+Reference model: ``test/bellatrix/sync/test_optimistic.py``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, never_bls,
+)
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block,
+)
+from consensus_specs_tpu.test_infra.execution_payload import (
+    build_state_with_incomplete_transition,
+)
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+EXECUTION_FORKS = ["bellatrix", "capella", "deneb"]
+
+
+def _chain(spec, state, n):
+    blocks = []
+    for _ in range(n):
+        block = build_empty_block_for_next_slot(spec, state)
+        state_transition_and_sign_block(spec, state, block)
+        blocks.append(block)
+    return blocks
+
+
+@with_phases(EXECUTION_FORKS)
+@spec_state_test
+@never_bls
+def test_optimistic_store_and_ancestor_walk(spec, state):
+    anchor_state = state.copy()
+    anchor_block = spec.BeaconBlock(state_root=hash_tree_root(anchor_state))
+    opt_store = spec.get_optimistic_store(anchor_state, anchor_block)
+
+    blocks = _chain(spec, state, 3)
+    for b in blocks:
+        opt_store.blocks[bytes(hash_tree_root(b))] = b
+    # mark the last two optimistic
+    for b in blocks[1:]:
+        opt_store.optimistic_roots.add(bytes(hash_tree_root(b)))
+
+    assert not spec.is_optimistic(opt_store, blocks[0])
+    assert spec.is_optimistic(opt_store, blocks[2])
+    # ancestor walk stops at the first verified block
+    assert spec.latest_verified_ancestor(opt_store, blocks[2]) == blocks[0]
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+@never_bls
+def test_optimistic_candidate_rules(spec, state):
+    pre_merge = build_state_with_incomplete_transition(spec, state)
+    anchor_block = spec.BeaconBlock(state_root=hash_tree_root(pre_merge))
+    opt_store = spec.get_optimistic_store(pre_merge, anchor_block)
+
+    # parent without execution payload: only old blocks qualify
+    parent = spec.BeaconBlock(slot=1)
+    child = spec.BeaconBlock(slot=2, parent_root=hash_tree_root(parent))
+    opt_store.blocks[bytes(hash_tree_root(parent))] = parent
+    assert not spec.is_execution_block(parent)
+    assert not spec.is_optimistic_candidate_block(
+        opt_store, current_slot=child.slot + 1, block=child)
+    assert spec.is_optimistic_candidate_block(
+        opt_store,
+        current_slot=child.slot + spec.SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY,
+        block=child)
+
+    # parent with an execution payload: always a candidate
+    exec_parent = spec.BeaconBlock(slot=1)
+    exec_parent.body.execution_payload.block_hash = b"\x01" * 32
+    child2 = spec.BeaconBlock(slot=2, parent_root=hash_tree_root(exec_parent))
+    opt_store.blocks[bytes(hash_tree_root(exec_parent))] = exec_parent
+    assert spec.is_execution_block(exec_parent)
+    assert spec.is_optimistic_candidate_block(
+        opt_store, current_slot=child2.slot + 1, block=child2)

@@ -1,0 +1,98 @@
+"""Deposit-contract accumulator vs SSZ merkleization.
+
+Reference model: the dapp/web3 tests around
+``solidity_deposit_contract/deposit_contract.sol`` — the contract's
+incremental root must equal the SSZ ``List[DepositData, 2**32]``
+hash_tree_root the beacon chain checks in ``process_deposit``
+(``specs/phase0/deposit-contract.md``).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from solidity_deposit_contract.contract_model import DepositContractModel
+from consensus_specs_tpu.forks import build_spec
+from consensus_specs_tpu.utils.ssz import hash_tree_root, List
+from consensus_specs_tpu.test_infra.keys import pubkeys, privkeys
+from consensus_specs_tpu.test_infra.deposits import build_deposit_data
+from consensus_specs_tpu.utils.hash_function import hash
+
+
+def _spec():
+    return build_spec("phase0", "minimal")
+
+
+def test_incremental_root_matches_ssz_list_root():
+    spec = _spec()
+    contract = DepositContractModel()
+    DepositDataList = List[spec.DepositData,
+                           2 ** spec.DEPOSIT_CONTRACT_TREE_DEPTH]
+    deposit_data_list = []
+    for i in range(8):
+        wc = spec.BLS_WITHDRAWAL_PREFIX + hash(pubkeys[i])[1:]
+        amount = spec.MAX_EFFECTIVE_BALANCE
+        data = build_deposit_data(spec, pubkeys[i], privkeys[i], amount, wc,
+                                  signed=True)
+        deposit_data_list.append(data)
+        contract.deposit(bytes(data.pubkey),
+                         bytes(data.withdrawal_credentials),
+                         int(data.amount), bytes(data.signature))
+        # after every deposit, the contract root equals the SSZ list root
+        assert contract.get_deposit_root() == \
+            hash_tree_root(DepositDataList(deposit_data_list)), i
+        assert contract.get_deposit_count() == \
+            len(deposit_data_list).to_bytes(8, "little")
+
+
+def test_deposit_data_root_reconstruction():
+    """The contract's in-EVM SSZ reconstruction must equal the real
+    hash_tree_root(DepositData)."""
+    spec = _spec()
+    wc = spec.BLS_WITHDRAWAL_PREFIX + hash(pubkeys[0])[1:]
+    data = build_deposit_data(spec, pubkeys[0], privkeys[0],
+                              spec.MAX_EFFECTIVE_BALANCE, wc, signed=True)
+    assert DepositContractModel.deposit_data_root(
+        bytes(data.pubkey), bytes(data.withdrawal_credentials),
+        int(data.amount), bytes(data.signature)) == hash_tree_root(data)
+
+
+def test_empty_contract_root_matches_empty_list():
+    spec = _spec()
+    contract = DepositContractModel()
+    DepositDataList = List[spec.DepositData,
+                           2 ** spec.DEPOSIT_CONTRACT_TREE_DEPTH]
+    assert contract.get_deposit_root() == hash_tree_root(DepositDataList())
+
+
+def test_contract_proofs_feed_process_deposit():
+    """End to end: a deposit proven against the contract root passes
+    process_deposit."""
+    from consensus_specs_tpu.test_infra.genesis import create_genesis_state
+    from consensus_specs_tpu.test_infra.deposits import deposit_from_context
+    from consensus_specs_tpu.utils import bls
+    spec = _spec()
+    bls.bls_active = False
+    try:
+        state = create_genesis_state(
+            spec, [spec.MAX_EFFECTIVE_BALANCE] * 32,
+            spec.MAX_EFFECTIVE_BALANCE)
+        contract = DepositContractModel()
+        new_index = len(state.validators)
+        wc = spec.BLS_WITHDRAWAL_PREFIX + hash(pubkeys[new_index])[1:]
+        data = build_deposit_data(spec, pubkeys[new_index],
+                                  privkeys[new_index],
+                                  spec.MAX_EFFECTIVE_BALANCE, wc, signed=True)
+        contract.deposit(bytes(data.pubkey),
+                         bytes(data.withdrawal_credentials),
+                         int(data.amount), bytes(data.signature))
+        deposit, root, _ = deposit_from_context(spec, [data], 0)
+        assert root == contract.get_deposit_root()
+        state.eth1_data.deposit_root = contract.get_deposit_root()
+        state.eth1_data.deposit_count = 1
+        state.eth1_deposit_index = 0
+        pre_count = len(state.validators)
+        spec.process_deposit(state, deposit)
+        assert len(state.validators) == pre_count + 1
+    finally:
+        bls.bls_active = True
