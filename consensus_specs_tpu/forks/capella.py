@@ -10,7 +10,8 @@ predicates :260-291, ``get_expected_withdrawals`` :346,
 (``upgrade_to_capella`` :77).
 """
 from consensus_specs_tpu.utils.ssz import (
-    hash_tree_root, uint64, Bytes32, List, Container,
+    hash_tree_root, uint64, Bytes32, Vector, List, Container,
+    get_generalized_index, compute_merkle_proof,
 )
 from consensus_specs_tpu.utils import bls
 from consensus_specs_tpu.utils.hash_function import hash
@@ -276,6 +277,105 @@ class CapellaSpec(BellatrixSpec):
 
     def _on_block_merge_check(self, pre_state, block) -> None:
         """capella: the merge is complete; nothing to validate."""
+
+    # -- light client (specs/capella/light-client/sync-protocol.md) ----------
+
+    def _build_light_client_types(self):
+        """Capella LightClientHeader adds the execution payload header +
+        its inclusion branch (sync-protocol.md:48)."""
+        from .light_client import floorlog2
+        S = self
+        self.EXECUTION_PAYLOAD_GINDEX = get_generalized_index(
+            self.BeaconBlockBody, "execution_payload")
+        ExecutionBranch = Vector[
+            Bytes32, floorlog2(self.EXECUTION_PAYLOAD_GINDEX)]
+        self.ExecutionBranch = ExecutionBranch
+
+        class LightClientHeader(Container):
+            beacon: S.BeaconBlockHeader
+            execution: S.ExecutionPayloadHeader
+            execution_branch: ExecutionBranch
+
+        super()._build_light_client_types()
+        self.LightClientHeader = LightClientHeader
+        # rebuild the dependent containers against the new header
+        self._rebuild_light_client_containers(LightClientHeader)
+
+    def _rebuild_light_client_containers(self, LightClientHeader):
+        S = self
+
+        class LightClientBootstrap(Container):
+            header: LightClientHeader
+            current_sync_committee: S.SyncCommittee
+            current_sync_committee_branch: S.CurrentSyncCommitteeBranch
+
+        class LightClientUpdate(Container):
+            attested_header: LightClientHeader
+            next_sync_committee: S.SyncCommittee
+            next_sync_committee_branch: S.NextSyncCommitteeBranch
+            finalized_header: LightClientHeader
+            finality_branch: S.FinalityBranch
+            sync_aggregate: S.SyncAggregate
+            signature_slot: S.Slot
+
+        class LightClientFinalityUpdate(Container):
+            attested_header: LightClientHeader
+            finalized_header: LightClientHeader
+            finality_branch: S.FinalityBranch
+            sync_aggregate: S.SyncAggregate
+            signature_slot: S.Slot
+
+        class LightClientOptimisticUpdate(Container):
+            attested_header: LightClientHeader
+            sync_aggregate: S.SyncAggregate
+            signature_slot: S.Slot
+
+        self.LightClientBootstrap = LightClientBootstrap
+        self.LightClientUpdate = LightClientUpdate
+        self.LightClientFinalityUpdate = LightClientFinalityUpdate
+        self.LightClientOptimisticUpdate = LightClientOptimisticUpdate
+
+    def get_lc_execution_root(self, header):
+        """light-client/sync-protocol.md:61"""
+        epoch = self.compute_epoch_at_slot(header.beacon.slot)
+        if epoch >= self.config.CAPELLA_FORK_EPOCH:
+            return hash_tree_root(header.execution)
+        return Root()
+
+    def is_valid_light_client_header(self, header) -> bool:
+        """light-client/sync-protocol.md:73"""
+        from .light_client import floorlog2
+        epoch = self.compute_epoch_at_slot(header.beacon.slot)
+        if epoch < self.config.CAPELLA_FORK_EPOCH:
+            return (header.execution == self.ExecutionPayloadHeader()
+                    and header.execution_branch == self.ExecutionBranch())
+        return self.is_valid_merkle_branch(
+            leaf=self.get_lc_execution_root(header),
+            branch=header.execution_branch,
+            depth=floorlog2(self.EXECUTION_PAYLOAD_GINDEX),
+            index=self.get_subtree_index(self.EXECUTION_PAYLOAD_GINDEX),
+            root=header.beacon.body_root,
+        )
+
+    def block_to_light_client_header(self, block):
+        """light-client/full-node.md:27"""
+        epoch = self.compute_epoch_at_slot(block.message.slot)
+        beacon = self.BeaconBlockHeader(
+            slot=block.message.slot,
+            proposer_index=block.message.proposer_index,
+            parent_root=block.message.parent_root,
+            state_root=block.message.state_root,
+            body_root=hash_tree_root(block.message.body),
+        )
+        if epoch >= self.config.CAPELLA_FORK_EPOCH:
+            payload = block.message.body.execution_payload
+            execution_header = self._payload_to_header(payload)
+            execution_branch = compute_merkle_proof(
+                block.message.body, self.EXECUTION_PAYLOAD_GINDEX)
+            return self.LightClientHeader(
+                beacon=beacon, execution=execution_header,
+                execution_branch=execution_branch)
+        return self.LightClientHeader(beacon=beacon)
 
     # -- fork upgrade (fork.md:77) -------------------------------------------
 

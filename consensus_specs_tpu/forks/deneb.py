@@ -308,6 +308,17 @@ class DenebSpec(CapellaSpec):
             validator.activation_epoch = self.compute_activation_exit_epoch(
                 self.get_current_epoch(state))
 
+    # -- light client (specs/deneb/light-client/sync-protocol.md) -------------
+
+    def is_valid_light_client_header(self, header) -> bool:
+        """Deneb variant: blob-gas fields must be zero before the fork."""
+        epoch = self.compute_epoch_at_slot(header.beacon.slot)
+        if epoch < self.config.DENEB_FORK_EPOCH:
+            if header.execution.blob_gas_used != 0 \
+                    or header.execution.excess_blob_gas != 0:
+                return False
+        return super().is_valid_light_client_header(header)
+
     # -- data availability (fork-choice.md:53) ---------------------------------
 
     def retrieve_blobs_and_proofs(self, beacon_block_root):

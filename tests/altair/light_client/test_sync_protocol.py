@@ -206,3 +206,23 @@ def test_force_update_after_timeout(spec, state):
     # forced update promotes attested header to finalized
     assert store.finalized_header.beacon.slot == attested_block.message.slot
     assert store.best_valid_update is None
+
+
+@with_phases(["capella", "deneb"])
+@with_config_overrides({"ALTAIR_FORK_EPOCH": 0, "BELLATRIX_FORK_EPOCH": 0,
+                        "CAPELLA_FORK_EPOCH": 0, "DENEB_FORK_EPOCH": 0})
+@spec_state_test
+@never_bls
+def test_capella_header_execution_branch_roundtrip(spec, state):
+    """Capella+ LightClientHeader carries the execution header proven
+    into the block body (capella/light-client/sync-protocol.md:48-88)."""
+    chain = _advance_chain(spec, state, 1)
+    signed_block, _ = chain[0]
+    header = spec.block_to_light_client_header(signed_block)
+    assert header.execution.block_hash == \
+        signed_block.message.body.execution_payload.block_hash
+    assert spec.is_valid_light_client_header(header)
+    # tampering with the execution header breaks the branch
+    bad = header.copy()
+    bad.execution.gas_used = 999
+    assert not spec.is_valid_light_client_header(bad)
