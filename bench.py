@@ -11,8 +11,12 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from consensus_specs_tpu.utils.jax_env import setup_compile_cache  # noqa: E402
+from consensus_specs_tpu.utils.jax_env import (  # noqa: E402
+    setup_compile_cache, ensure_working_backend)
 setup_compile_cache()
+# The bench must always print its line: if the accelerator tunnel is down
+# (backend init hangs), measure on host CPU instead of hanging forever.
+ensure_working_backend()
 
 
 def main():
@@ -26,10 +30,15 @@ def main():
     pks = [bls.SkToPk(sk) for sk in sks]
     agg = bls.Aggregate([bls.Sign(sk, msg) for sk in sks])
 
-    # python-oracle baseline (single verification, measured once)
-    t0 = time.time()
+    # python-oracle baseline: warmed (decompression caches populated),
+    # then the median-ish of repeated runs
     assert bls.FastAggregateVerify(pks, msg, agg)
-    py_per_verify = time.time() - t0
+    py_times = []
+    for _ in range(3):
+        t0 = time.time()
+        bls.FastAggregateVerify(pks, msg, agg)
+        py_times.append(time.time() - t0)
+    py_per_verify = sorted(py_times)[1]
 
     items = [(pks, msg, agg)] * batch
     # warm-up: compile + first dispatch

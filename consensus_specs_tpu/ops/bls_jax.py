@@ -105,11 +105,34 @@ _NEG_G1 = PT.g1_pack([-G1_GENERATOR])
 
 # All batches are chunked to this fixed size so the expensive programs
 # (hash-to-curve, pairing) compile exactly once per process regardless of
-# caller batch size.  Raise for TPU throughput runs via env.
-BUCKET_B = int(os.environ.get("CS_TPU_BLS_BATCH", "8"))
+# caller batch size.  Default: small on host CPU (compile time dominates),
+# wide on an accelerator (fill the vector units — a mainnet block carries
+# up to 128 aggregates).  Override via env for throughput runs.
+def bucket_b() -> int:
+    """Resolved lazily at first dispatch: jax.default_backend() initializes
+    the backend, which must never happen at import time (a tunnel-backed
+    accelerator plugin can hang there)."""
+    global _BUCKET_B
+    if _BUCKET_B is None:
+        if "CS_TPU_BLS_BATCH" in os.environ:
+            _BUCKET_B = int(os.environ["CS_TPU_BLS_BATCH"])
+        else:
+            try:
+                _BUCKET_B = 32 if jax.default_backend() != "cpu" else 8
+            except Exception:
+                _BUCKET_B = 8
+    return _BUCKET_B
+
+
+_BUCKET_B = None
 # Pubkey-aggregation axis buckets (the aggregate program is cheap to
 # compile, so power-of-two buckets with a floor are fine).
 _N_MIN = 8
+# Fuse aggregate+hash-to-curve+pairing into ONE compiled program (single
+# dispatch, cross-stage XLA fusion).  Opt-in via CS_TPU_BLS_FUSE=1; the
+# staged pipeline stays the default (smaller compiles, maximal
+# cross-shape reuse).
+FUSE_VERIFY = os.environ.get("CS_TPU_BLS_FUSE") == "1"
 
 
 # ---------------------------------------------------------------------------
@@ -144,15 +167,10 @@ def _program_multi_pair_verify(px, py, qx0, qx1, qy0, qy1, degen):
     return jax.vmap(one)(px, py, qx0, qx1, qy0, qy1, degen)
 
 
-def _program_agg_verify(pk_pts, u0, u1, sig_q, agg_degen, sig_degen):
-    """Batched FastAggregateVerify: three staged device programs.
-
-    Staging keeps each compiled program small and maximizes cross-shape
-    reuse (the pairing program only depends on the batch size, not on how
-    many pubkeys each aggregate had).
-    """
-    agg, agg_inf = _program_aggregate(pk_pts)
-    hpt = _program_htc(u0, u1)
+def _agg_verify_body(pk_pts, u0, u1, sig_q, agg_degen, sig_degen,
+                     *, aggregate, htc, pair):
+    agg, agg_inf = aggregate(pk_pts)
+    hpt = htc(u0, u1)
     px = jnp.stack([agg[0], jnp.broadcast_to(_NEG_G1[0][0], agg[0].shape)], axis=1)
     py = jnp.stack([agg[1], jnp.broadcast_to(_NEG_G1[1][0], agg[1].shape)], axis=1)
     qx0 = jnp.stack([hpt[0][0], sig_q[0][0]], axis=1)
@@ -160,7 +178,40 @@ def _program_agg_verify(pk_pts, u0, u1, sig_q, agg_degen, sig_degen):
     qy0 = jnp.stack([hpt[1][0], sig_q[1][0]], axis=1)
     qy1 = jnp.stack([hpt[1][1], sig_q[1][1]], axis=1)
     degen = jnp.stack([agg_degen | agg_inf, sig_degen], axis=1)
-    return _program_multi_pair_verify(px, py, qx0, qx1, qy0, qy1, degen)
+    return pair(px, py, qx0, qx1, qy0, qy1, degen)
+
+
+@jax.jit
+def _program_agg_verify_fused(pk_pts, u0, u1, sig_q, agg_degen, sig_degen):
+    """Whole FastAggregateVerify batch as ONE compiled program: one
+    dispatch, no intermediate host round trips, cross-stage XLA fusion.
+    Reuses the staged programs — jit-of-jit inlines during tracing, so the
+    math cannot diverge between modes."""
+    return _agg_verify_body(
+        pk_pts, u0, u1, sig_q, agg_degen, sig_degen,
+        aggregate=_program_aggregate,
+        htc=_program_htc,
+        pair=_program_multi_pair_verify)
+
+
+def _program_agg_verify(pk_pts, u0, u1, sig_q, agg_degen, sig_degen):
+    """Batched FastAggregateVerify.
+
+    Staged mode runs three smaller device programs (fast compiles,
+    maximal cross-shape reuse — the pairing program only depends on the
+    batch size, not the per-aggregate pubkey count); fused mode compiles
+    the whole thing once and dispatches once.
+    """
+    if FUSE_VERIFY:
+        return _program_agg_verify_fused(pk_pts, u0, u1, sig_q, agg_degen,
+                                         sig_degen)
+    agg, agg_inf = _program_aggregate(pk_pts)
+    hpt = _program_htc(u0, u1)
+    return _agg_verify_body(
+        pk_pts, u0, u1, sig_q, agg_degen, sig_degen,
+        aggregate=lambda _: (agg, agg_inf),
+        htc=lambda *_: hpt,
+        pair=_program_multi_pair_verify)
 
 
 # ---------------------------------------------------------------------------
@@ -187,28 +238,29 @@ def verify_aggregates_batch(items) -> list:
     if not rows:
         return [bool(r) for r in results_host]
 
-    for start in range(0, len(rows), BUCKET_B):
-        chunk = rows[start:start + BUCKET_B]
+    B = bucket_b()
+    for start in range(0, len(rows), B):
+        chunk = rows[start:start + B]
         n_pad = max(_N_MIN, _pow2(max(len(r[1]) for r in chunk)))
         pk_rows, sig_pts, msgs = [], [], []
         for _, pts, msg, spt in chunk:
             pk_rows.append(pts + [G1Point.inf()] * (n_pad - len(pts)))
             sig_pts.append(spt)
             msgs.append(msg)
-        for _ in range(BUCKET_B - len(chunk)):   # degenerate padding rows
+        for _ in range(B - len(chunk)):   # degenerate padding rows
             pk_rows.append([G1Point.inf()] * n_pad)
             sig_pts.append(G2Point.inf())
             msgs.append(b"")
 
         packed = PT.g1_pack([p for row in pk_rows for p in row])
         pk_pts = jax.tree_util.tree_map(
-            lambda a: a.reshape((BUCKET_B, n_pad) + a.shape[1:]), packed)
+            lambda a: a.reshape((B, n_pad) + a.shape[1:]), packed)
         u0, u1 = HTC.hash_to_field_host(msgs)
         sig_packed = PT.g2_pack(sig_pts)
         sig_q = (sig_packed[0], sig_packed[1])
         sig_degen = jnp.array([p.infinity for p in sig_pts])
         agg_degen = jnp.array(
-            [False] * len(chunk) + [True] * (BUCKET_B - len(chunk)))
+            [False] * len(chunk) + [True] * (B - len(chunk)))
 
         out = np.asarray(_program_agg_verify(
             pk_pts, u0, u1, sig_q, agg_degen, sig_degen))
@@ -238,8 +290,9 @@ def aggregate_verify_batch(items) -> list:
     if not rows:
         return [bool(r) for r in results_host]
 
-    for start in range(0, len(rows), BUCKET_B):
-        chunk = rows[start:start + BUCKET_B]
+    B = bucket_b()
+    for start in range(0, len(rows), B):
+        chunk = rows[start:start + B]
         npair_pad = max(_N_MIN, _pow2(max(len(r[1]) for r in chunk) + 1))
         all_msgs, g1_rows, g2_sigs, degen_rows = [], [], [], []
         for _, pts, messages, spt in chunk:
@@ -249,7 +302,7 @@ def aggregate_verify_batch(items) -> list:
             g2_sigs.append(spt)
             degen_rows.append([False] * len(pts) + [True] * pad
                               + [spt.infinity])
-        for _ in range(BUCKET_B - len(chunk)):
+        for _ in range(B - len(chunk)):
             g1_rows.append([G1Point.inf()] * npair_pad)
             all_msgs.extend([b""] * (npair_pad - 1))
             g2_sigs.append(G2Point.inf())
@@ -258,10 +311,10 @@ def aggregate_verify_batch(items) -> list:
         # hash all messages in one device call, scatter into (B, n-1) slots
         u0, u1 = HTC.hash_to_field_host(all_msgs)
         hpts = PT.g2_normalize(HTC._map_to_g2_jit(u0, u1))
-        hx = ((hpts[0][0]).reshape(BUCKET_B, npair_pad - 1, 24),
-              (hpts[0][1]).reshape(BUCKET_B, npair_pad - 1, 24))
-        hy = ((hpts[1][0]).reshape(BUCKET_B, npair_pad - 1, 24),
-              (hpts[1][1]).reshape(BUCKET_B, npair_pad - 1, 24))
+        hx = ((hpts[0][0]).reshape(B, npair_pad - 1, 24),
+              (hpts[0][1]).reshape(B, npair_pad - 1, 24))
+        hy = ((hpts[1][0]).reshape(B, npair_pad - 1, 24),
+              (hpts[1][1]).reshape(B, npair_pad - 1, 24))
         sig_packed = PT.g2_pack(g2_sigs)
         qx0 = jnp.concatenate([hx[0], sig_packed[0][0][:, None]], axis=1)
         qx1 = jnp.concatenate([hx[1], sig_packed[0][1][:, None]], axis=1)
@@ -269,8 +322,8 @@ def aggregate_verify_batch(items) -> list:
         qy1 = jnp.concatenate([hy[1], sig_packed[1][1][:, None]], axis=1)
 
         packed = PT.g1_pack([p for row in g1_rows for p in row])
-        px = packed[0].reshape(BUCKET_B, npair_pad, 24)
-        py = packed[1].reshape(BUCKET_B, npair_pad, 24)
+        px = packed[0].reshape(B, npair_pad, 24)
+        py = packed[1].reshape(B, npair_pad, 24)
         degen = jnp.array(degen_rows)
         # a G1 infinity in a live pair must also degenerate its pair
         inf_mask = np.array([[p.infinity for p in row] for row in g1_rows])

@@ -69,6 +69,44 @@ def force_cpu_platform() -> None:
                     "may still be active")
 
 
+def ensure_working_backend(timeout: int = 90) -> str:
+    """Probe JAX backend initialization in a subprocess; fall back to CPU
+    when the default (tunnel-backed) accelerator hangs or fails.
+
+    The container's accelerator plugin initializes a remote tunnel during
+    ``jax.devices()``; when that tunnel is down the call blocks forever,
+    which must never take down the bench/compile-check entry points.
+    Returns the platform that will be used ("default" or "cpu").
+    """
+    global _PROBE_RESULT
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        return "cpu"  # already pinned; nothing to probe
+    if _PROBE_RESULT is not None:
+        return _PROBE_RESULT
+    import subprocess
+    import sys as _sys
+    try:
+        proc = subprocess.run(
+            [_sys.executable, "-c",
+             "import jax; jax.devices()"],
+            timeout=timeout, capture_output=True)
+        if proc.returncode == 0:
+            _PROBE_RESULT = "default"
+            return "default"
+    except subprocess.TimeoutExpired:
+        pass
+    except Exception:
+        pass
+    print("jax_env: accelerator backend unavailable (init hung or failed); "
+          "falling back to host CPU", flush=True)
+    force_cpu_platform()
+    _PROBE_RESULT = "cpu"
+    return "cpu"
+
+
+_PROBE_RESULT = None
+
+
 def setup_compile_cache() -> str:
     """Point JAX at the keyed persistent cache; idempotent.
 

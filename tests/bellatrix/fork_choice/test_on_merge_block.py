@@ -1,0 +1,105 @@
+"""Merge-transition fork-choice tests.
+
+Reference model: ``test/bellatrix/fork_choice/test_on_merge_block.py``
+against ``specs/bellatrix/fork-choice.md:204`` (validate_merge_block).
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, never_bls,
+)
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block,
+)
+from consensus_specs_tpu.test_infra.execution_payload import (
+    build_state_with_incomplete_transition, build_empty_execution_payload,
+    compute_el_block_hash,
+)
+from consensus_specs_tpu.test_infra.fork_choice import (
+    get_genesis_forkchoice_store_and_block, tick_and_add_block,
+)
+
+
+def _merge_block_setup(spec, state):
+    """Pre-merge anchor + a signed merge-transition block."""
+    state = build_state_with_incomplete_transition(spec, state)
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+
+    # build_empty_block fills a slot-consistent payload; repoint its
+    # parent at a PoW block to make this the merge-transition block
+    block = build_empty_block_for_next_slot(spec, state)
+    payload = block.body.execution_payload
+    payload.parent_hash = b"\xaa" * 32
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    block.body.execution_payload = payload
+    signed_block = state_transition_and_sign_block(spec, state.copy(), block)
+    return state, store, signed_block, payload
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+@never_bls
+def test_merge_block_valid_terminal_pow(spec, state):
+    state, store, signed_block, payload = _merge_block_setup(spec, state)
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+
+    def get_pow_block(block_hash):
+        if bytes(block_hash) == bytes(payload.parent_hash):
+            return spec.PowBlock(block_hash=block_hash,
+                                 parent_hash=b"\xbb" * 32,
+                                 total_difficulty=ttd)
+        return spec.PowBlock(block_hash=block_hash,
+                             parent_hash=b"\x00" * 32,
+                             total_difficulty=max(0, ttd - 1))
+
+    spec.get_pow_block = get_pow_block
+    try:
+        test_steps = []
+        tick_and_add_block(spec, store, signed_block, test_steps)
+        from consensus_specs_tpu.utils.ssz import hash_tree_root
+        assert hash_tree_root(signed_block.message) in store.blocks
+    finally:
+        del spec.get_pow_block  # restore the class-level stub
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+@never_bls
+def test_invalid_merge_block_pow_below_ttd(spec, state):
+    state, store, signed_block, payload = _merge_block_setup(spec, state)
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+
+    def get_pow_block(block_hash):
+        # terminal difficulty NOT reached
+        return spec.PowBlock(block_hash=block_hash,
+                             parent_hash=b"\xbb" * 32,
+                             total_difficulty=max(0, ttd - 1))
+
+    spec.get_pow_block = get_pow_block
+    try:
+        test_steps = []
+        tick_and_add_block(spec, store, signed_block, test_steps,
+                           valid=False)
+    finally:
+        del spec.get_pow_block
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+@never_bls
+def test_invalid_merge_block_missing_pow_parent(spec, state):
+    state, store, signed_block, payload = _merge_block_setup(spec, state)
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+
+    def get_pow_block(block_hash):
+        if bytes(block_hash) == bytes(payload.parent_hash):
+            return spec.PowBlock(block_hash=block_hash,
+                                 parent_hash=b"\xbb" * 32,
+                                 total_difficulty=ttd)
+        return None  # parent unavailable
+
+    spec.get_pow_block = get_pow_block
+    try:
+        test_steps = []
+        tick_and_add_block(spec, store, signed_block, test_steps,
+                           valid=False)
+    finally:
+        del spec.get_pow_block

@@ -1,0 +1,73 @@
+"""Deneb fork-choice blob data-availability tests.
+
+Reference model: ``test/deneb/fork_choice/test_on_block.py`` with the
+``retrieve_blobs_and_proofs`` stub swapped per scenario
+(``specs/deneb/fork-choice.md:53-60``).
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, never_bls,
+)
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block,
+)
+from consensus_specs_tpu.test_infra.fork_choice import (
+    get_genesis_forkchoice_store_and_block, tick_and_add_block,
+)
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+
+@with_phases(["deneb"])
+@spec_state_test
+@never_bls
+def test_on_block_no_commitments_is_available(spec, state):
+    """No blob commitments: the empty batch verifies (md:571 'True if
+    there are zero blobs')."""
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state.copy(), block)
+    test_steps = []
+    tick_and_add_block(spec, store, signed_block, test_steps)
+    assert hash_tree_root(signed_block.message) in store.blocks
+
+
+@with_phases(["deneb"])
+@spec_state_test
+@never_bls
+def test_invalid_on_block_data_unavailable(spec, state):
+    """Commitments present but blobs unretrievable: on_block must reject
+    (is_data_available raises/fails)."""
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.blob_kzg_commitments = [spec.G1_POINT_AT_INFINITY]
+    signed_block = state_transition_and_sign_block(spec, state.copy(), block)
+
+    def retrieve_blobs_and_proofs(beacon_block_root):
+        raise AssertionError("blobs not available")
+
+    spec.retrieve_blobs_and_proofs = retrieve_blobs_and_proofs
+    try:
+        test_steps = []
+        tick_and_add_block(spec, store, signed_block, test_steps,
+                           valid=False)
+        assert hash_tree_root(signed_block.message) not in store.blocks
+    finally:
+        del spec.retrieve_blobs_and_proofs
+
+
+@with_phases(["deneb"])
+@spec_state_test
+@never_bls
+def test_invalid_on_block_mismatched_blob_count(spec, state):
+    """Commitment count != retrieved blob count fails batch verification."""
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.blob_kzg_commitments = [spec.G1_POINT_AT_INFINITY]
+    signed_block = state_transition_and_sign_block(spec, state.copy(), block)
+
+    spec.retrieve_blobs_and_proofs = lambda root: ([], [])
+    try:
+        test_steps = []
+        tick_and_add_block(spec, store, signed_block, test_steps,
+                           valid=False)
+    finally:
+        del spec.retrieve_blobs_and_proofs

@@ -1,0 +1,155 @@
+"""Per-sub-transition epoch-processing tests via the isolation runner.
+
+Reference model: the ``test/phase0/epoch_processing/`` family run through
+``run_epoch_processing_to`` (``helpers/epoch_processing.py:43``).
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases, with_phases,
+)
+from consensus_specs_tpu.test_infra.epoch_processing import (
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.test_infra.block import next_epoch
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+
+@with_all_phases
+@spec_state_test
+def test_process_slashings_penalty_applied(spec, state):
+    # slash a third of the balance-weight to make the penalty non-zero
+    n_slashed = len(state.validators) // 3
+    epoch = spec.get_current_epoch(state)
+    for index in range(n_slashed):
+        validator = state.validators[index]
+        validator.slashed = True
+        validator.withdrawable_epoch = \
+            epoch + spec.EPOCHS_PER_SLASHINGS_VECTOR // 2
+        state.slashings[epoch % spec.EPOCHS_PER_SLASHINGS_VECTOR] += \
+            validator.effective_balance
+    pre_balances = [int(b) for b in state.balances]
+    yield from run_epoch_processing_with(spec, state, "process_slashings")
+    for index in range(n_slashed):
+        assert int(state.balances[index]) < pre_balances[index], index
+    assert int(state.balances[n_slashed + 1]) == pre_balances[n_slashed + 1]
+
+
+@with_all_phases
+@spec_state_test
+def test_process_slashings_reset(spec, state):
+    epoch = spec.get_current_epoch(state)
+    next_index = (epoch + 1) % spec.EPOCHS_PER_SLASHINGS_VECTOR
+    state.slashings[next_index] = spec.Gwei(10**9)
+    yield from run_epoch_processing_with(spec, state,
+                                         "process_slashings_reset")
+    assert state.slashings[next_index] == 0
+
+
+@with_all_phases
+@spec_state_test
+def test_process_randao_mixes_reset(spec, state):
+    current_epoch = spec.get_current_epoch(state)
+    next_index = (current_epoch + 1) % spec.EPOCHS_PER_HISTORICAL_VECTOR
+    state.randao_mixes[next_index] = b"\x77" * 32
+    yield from run_epoch_processing_with(spec, state,
+                                         "process_randao_mixes_reset")
+    assert bytes(state.randao_mixes[next_index]) == \
+        bytes(spec.get_randao_mix(state, current_epoch))
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_process_historical_roots_update(spec, state):
+    # jump to the last epoch of a SLOTS_PER_HISTORICAL_ROOT period
+    period_epochs = spec.SLOTS_PER_HISTORICAL_ROOT // spec.SLOTS_PER_EPOCH
+    while (spec.get_current_epoch(state) + 1) % period_epochs != 0:
+        next_epoch(spec, state)
+    pre_len = len(state.historical_roots)
+    yield from run_epoch_processing_with(spec, state,
+                                         "process_historical_roots_update")
+    assert len(state.historical_roots) == pre_len + 1
+    expected = hash_tree_root(spec.HistoricalBatch(
+        block_roots=state.block_roots, state_roots=state.state_roots))
+    assert bytes(state.historical_roots[-1]) == expected
+
+
+@with_phases(["capella", "deneb"])
+@spec_state_test
+def test_process_historical_summaries_update(spec, state):
+    period_epochs = spec.SLOTS_PER_HISTORICAL_ROOT // spec.SLOTS_PER_EPOCH
+    while (spec.get_current_epoch(state) + 1) % period_epochs != 0:
+        next_epoch(spec, state)
+    pre_len = len(state.historical_summaries)
+    yield from run_epoch_processing_with(
+        spec, state, "process_historical_summaries_update")
+    assert len(state.historical_summaries) == pre_len + 1
+    assert bytes(state.historical_summaries[-1].block_summary_root) == \
+        hash_tree_root(state.block_roots)
+
+
+@with_phases(["altair", "bellatrix", "capella", "deneb"])
+@spec_state_test
+def test_process_participation_flag_updates(spec, state):
+    for index in range(len(state.validators)):
+        state.current_epoch_participation[index] = \
+            spec.ParticipationFlags(0b111)
+    yield from run_epoch_processing_with(
+        spec, state, "process_participation_flag_updates")
+    assert all(int(f) == 0b111 for f in state.previous_epoch_participation)
+    assert all(int(f) == 0 for f in state.current_epoch_participation)
+
+
+@with_phases(["altair", "bellatrix", "capella", "deneb"])
+@spec_state_test
+def test_process_sync_committee_updates_rotation(spec, state):
+    """At a sync-committee period boundary, next becomes current."""
+    # advance to the last epoch of the period
+    while (spec.get_current_epoch(state) + 1) % \
+            spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD != 0:
+        next_epoch(spec, state)
+    pre_next = state.next_sync_committee.copy()
+    yield from run_epoch_processing_with(
+        spec, state, "process_sync_committee_updates")
+    assert state.current_sync_committee == pre_next
+
+
+@with_phases(["altair", "bellatrix", "capella", "deneb"])
+@spec_state_test
+def test_process_inactivity_updates_scores(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    # non-participants gain score, participants decay to zero
+    for index in range(len(state.validators)):
+        state.inactivity_scores[index] = 4
+        # half participate on target
+        if index % 2 == 0:
+            state.previous_epoch_participation[index] = \
+                spec.ParticipationFlags(1 << spec.TIMELY_TARGET_FLAG_INDEX)
+        else:
+            state.previous_epoch_participation[index] = \
+                spec.ParticipationFlags(0)
+    yield from run_epoch_processing_with(spec, state,
+                                         "process_inactivity_updates")
+    # not in leak: everyone recovers by INACTIVITY_SCORE_RECOVERY_RATE,
+    # participants additionally decrement first
+    for index in range(len(state.validators)):
+        if index % 2 == 0:
+            assert int(state.inactivity_scores[index]) < 4
+        else:
+            expected = 4 + int(spec.config.INACTIVITY_SCORE_BIAS)
+            if not spec.is_in_inactivity_leak(state):
+                expected = max(0, expected - int(
+                    spec.config.INACTIVITY_SCORE_RECOVERY_RATE))
+            assert int(state.inactivity_scores[index]) == expected
+
+
+@with_all_phases
+@spec_state_test
+def test_process_eth1_data_reset_at_period_boundary(spec, state):
+    # fill a vote, advance to the voting-period boundary epoch
+    state.eth1_data_votes.append(spec.Eth1Data(deposit_count=1))
+    while (spec.get_current_epoch(state) + 1) % \
+            spec.EPOCHS_PER_ETH1_VOTING_PERIOD != 0:
+        next_epoch(spec, state)
+    yield from run_epoch_processing_with(spec, state,
+                                         "process_eth1_data_reset")
+    assert len(state.eth1_data_votes) == 0

@@ -1,0 +1,68 @@
+"""Mainnet-preset smoke tests.
+
+The conformance suites default to the minimal preset (like the reference
+CI matrix); this module pins the mainnet-preset constants and exercises
+one real transition so preset plumbing regressions cannot hide.
+Run everything mainnet with `pytest --preset mainnet`.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu.forks import build_spec
+from consensus_specs_tpu.utils import bls
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+
+def test_mainnet_preset_constants():
+    spec = build_spec("phase0", "mainnet")
+    assert spec.SLOTS_PER_EPOCH == 32
+    assert spec.MAX_ATTESTATIONS == 128
+    assert spec.MAX_VALIDATORS_PER_COMMITTEE == 2048
+    assert spec.SHUFFLE_ROUND_COUNT == 90
+    altair = build_spec("altair", "mainnet")
+    assert altair.SYNC_COMMITTEE_SIZE == 512
+    deneb = build_spec("deneb", "mainnet")
+    assert deneb.MAX_BLOBS_PER_BLOCK == 6
+    assert deneb.FIELD_ELEMENTS_PER_BLOB == 4096
+    # mainnet gindices match the protocol constants too (depth identical)
+    assert altair.FINALIZED_ROOT_GINDEX == 105
+    assert altair.CURRENT_SYNC_COMMITTEE_GINDEX == 54
+
+
+def test_mainnet_empty_block_transition():
+    from consensus_specs_tpu.test_infra.genesis import create_genesis_state
+    from consensus_specs_tpu.test_infra.block import (
+        build_empty_block_for_next_slot, state_transition_and_sign_block)
+    spec = build_spec("phase0", "mainnet")
+    old = bls.bls_active
+    bls.bls_active = False
+    try:
+        # small registry: committee math must still hold on mainnet shapes
+        state = create_genesis_state(
+            spec, [spec.MAX_EFFECTIVE_BALANCE] * 256,
+            spec.MAX_EFFECTIVE_BALANCE)
+        pre_root = hash_tree_root(state)
+        block = build_empty_block_for_next_slot(spec, state)
+        state_transition_and_sign_block(spec, state, block)
+        assert state.slot == 1
+        assert hash_tree_root(state) != pre_root
+    finally:
+        bls.bls_active = old
+
+
+def test_mainnet_capella_state_shape():
+    from consensus_specs_tpu.test_infra.genesis import create_genesis_state
+    spec = build_spec("capella", "mainnet")
+    old = bls.bls_active
+    bls.bls_active = False
+    try:
+        state = create_genesis_state(
+            spec, [spec.MAX_EFFECTIVE_BALANCE] * 600,
+            spec.MAX_EFFECTIVE_BALANCE)
+        assert len(state.current_sync_committee.pubkeys) == 512
+        assert spec.MAX_WITHDRAWALS_PER_PAYLOAD == 16
+        assert state.next_withdrawal_index == 0
+    finally:
+        bls.bls_active = old
