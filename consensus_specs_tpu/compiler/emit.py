@@ -8,9 +8,18 @@ the single source of truth for spec logic; presets/configs stay
 runtime-bound exactly like the hand-written classes.
 """
 import os
+import re
 import textwrap
 
 from .extract import parse_markdown_spec
+
+
+def _absolutize_imports(block: str) -> str:
+    """Method bodies written inside ``consensus_specs_tpu.forks`` use
+    relative imports (``from .light_client import ...``); the compiled
+    modules live under ``forks.compiled``, so rewrite them absolute."""
+    return re.sub(r"from \.(\w+) import",
+                  r"from consensus_specs_tpu.forks.\1 import", block)
 
 _SCAFFOLD = {
     "phase0": {
@@ -33,6 +42,40 @@ from consensus_specs_tpu.forks.phase0 import _LRUDict, _bytes_of
 from consensus_specs_tpu.forks.base_types import *  # noqa: F401,F403
 """,
     },
+    # Delta forks: the fork module's namespace provides the method bodies'
+    # globals (constants, mixins, ssz types); the compiled class extends
+    # the previous COMPILED spec so the whole ladder is markdown-built.
+    "altair": {
+        "bases": "SyncDutiesMixin, LightClientMixin, CompiledPhase0Spec",
+        "imports": """\
+from consensus_specs_tpu.forks.altair import *  # noqa: F401,F403
+from consensus_specs_tpu.forks.compiled.phase0 import CompiledPhase0Spec
+""",
+    },
+    "bellatrix": {
+        "bases": "OptimisticSyncMixin, CompiledAltairSpec",
+        "imports": """\
+from consensus_specs_tpu.forks.bellatrix import *  # noqa: F401,F403
+from consensus_specs_tpu.forks.compiled.altair import CompiledAltairSpec
+""",
+    },
+    "capella": {
+        "bases": "CompiledBellatrixSpec",
+        "imports": """\
+from consensus_specs_tpu.forks.capella import *  # noqa: F401,F403
+from consensus_specs_tpu.forks.capella import hash
+from consensus_specs_tpu.forks.compiled.bellatrix import \\
+    CompiledBellatrixSpec
+""",
+    },
+    "deneb": {
+        "bases": "CompiledCapellaSpec",
+        "imports": """\
+from consensus_specs_tpu.forks.deneb import *  # noqa: F401,F403
+from consensus_specs_tpu.forks.deneb import hash, _kzg
+from consensus_specs_tpu.forks.compiled.capella import CompiledCapellaSpec
+""",
+    },
 }
 
 
@@ -50,6 +93,14 @@ def emit_spec_module(doc, class_name=None) -> str:
     prev = f'"{doc.previous_fork}"' if doc.previous_fork else "None"
     out.append(f"    previous_fork = {prev}")
     out.append("")
+    if doc.fork != "phase0":
+        for name, value in doc.constants.items():
+            out.append(f"    {name} = {value}")
+        out.append("")
+        for block in doc.code_blocks:
+            out.append(textwrap.indent(_absolutize_imports(block), "    "))
+            out.append("")
+        return "\n".join(out) + "\n"
     # surface re-exports matching the hand-written class
     out.append(textwrap.indent(textwrap.dedent("""\
         hash = staticmethod(hash)
@@ -82,7 +133,7 @@ def emit_spec_module(doc, class_name=None) -> str:
         out.append(f"    {name} = {value}")
     out.append("")
     for block in doc.code_blocks:
-        out.append(textwrap.indent(block, "    "))
+        out.append(textwrap.indent(_absolutize_imports(block), "    "))
         out.append("")
     return "\n".join(out) + "\n"
 
@@ -104,7 +155,9 @@ def compile_spec(md_path: str, out_path: str = None) -> str:
 def main():
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    targets = [("phase0", os.path.join(repo, "specs/phase0/beacon-chain.md"))]
+    targets = [
+        (fork, os.path.join(repo, f"specs/{fork}/beacon-chain.md"))
+        for fork in ("phase0", "altair", "bellatrix", "capella", "deneb")]
     for fork, md_path in targets:
         out_path = os.path.join(
             repo, "consensus_specs_tpu/forks/compiled", f"{fork}.py")

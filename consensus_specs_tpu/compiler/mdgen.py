@@ -151,8 +151,85 @@ result.  Behavioral parity target: ethereum/consensus-specs v1.4.0-beta.7
     return "\n".join(out) + "\n"
 
 
+_FORK_INTROS = {
+    "altair": """Altair introduces sync committees (512-member rotating
+committees whose aggregate signatures light clients follow),
+participation-flag epoch accounting replacing pending attestations, and
+inactivity-leak scores.""",
+    "bellatrix": """Bellatrix (the Merge) embeds execution payloads into
+beacon blocks: the ExecutionEngine protocol, merge-transition predicates
+and terminal-PoW validation, plus updated slashing/inactivity quotients.""",
+    "capella": """Capella activates withdrawals: a bounded sweep over the
+registry pays out fully/partially withdrawable validators through the
+execution payload, BLS-to-execution credential changes, and historical
+summaries replacing the historical-roots accumulator.""",
+    "deneb": """Deneb carries blob KZG commitments (EIP-4844) with
+versioned hashes and data-availability checks, pins voluntary-exit
+domains (EIP-7044), extends attestation inclusion windows (EIP-7045) and
+caps the activation churn (EIP-7514).""",
+}
+
+
+def generate_delta_markdown(spec_cls, fork: str, previous_fork: str) -> str:
+    """Delta document for a non-phase0 fork: every method the fork class
+    itself defines (its diff over the previous fork), one section per
+    member, in definition order."""
+    import types
+    out = [f"# The {fork} beacon chain",
+           "",
+           f"<!-- fork: {fork} -->",
+           f"<!-- previous_fork: {previous_fork} -->",
+           "",
+           _FORK_INTROS.get(fork, "").strip(),
+           "",
+           f"""This document specifies {fork} as a delta over
+{previous_fork}: the fenced python blocks below override or extend the
+{previous_fork} runtime (fork inheritance; the reference gets the same
+effect from markdown dict-merge).  Compiled by
+`python -m consensus_specs_tpu.compiler`.""",
+           "", "## Constants and re-exports", "",
+           "Values inherited from the fork module's constant tables:", ""]
+    import sys as _sys
+    mod = _sys.modules[spec_cls.__module__]
+    const_lines = []
+    for name, member in spec_cls.__dict__.items():
+        if isinstance(member, (types.FunctionType, property)) \
+                or name.startswith("__") \
+                or name in ("fork", "previous_fork"):
+            continue
+        if hasattr(mod, name):
+            const_lines.append(f"{name} = {name}")
+        elif isinstance(member, (bool, int, bytes, str)) \
+                and not isinstance(member, type):
+            const_lines.append(f"{name} = {member!r}")
+        else:
+            const_lines.append(f"{name} = {name}")
+    if const_lines:
+        out.append("```python")
+        out.extend(const_lines)
+        out.append("```")
+    out.extend(["", "## Fork deltas", ""])
+    for name, member in spec_cls.__dict__.items():
+        if isinstance(member, property):
+            member = member.fget  # getsource includes the @property line
+        elif not isinstance(member, types.FunctionType) or \
+                name.startswith("__"):
+            continue
+        src = textwrap.dedent(inspect.getsource(member))
+        out.append(f"### `{name}`\n")
+        out.append("```python")
+        out.append(src.rstrip())
+        out.append("```")
+        out.append("")
+    return "\n".join(out) + "\n"
+
+
 def main():
     from consensus_specs_tpu.forks.phase0 import Phase0Spec
+    from consensus_specs_tpu.forks.altair import AltairSpec
+    from consensus_specs_tpu.forks.bellatrix import BellatrixSpec
+    from consensus_specs_tpu.forks.capella import CapellaSpec
+    from consensus_specs_tpu.forks.deneb import DenebSpec
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     path = os.path.join(repo, "specs", "phase0", "beacon-chain.md")
@@ -160,6 +237,15 @@ def main():
     with open(path, "w") as f:
         f.write(generate_markdown(Phase0Spec, "phase0"))
     print(f"wrote {path}")
+    for cls, fork, prev in ((AltairSpec, "altair", "phase0"),
+                            (BellatrixSpec, "bellatrix", "altair"),
+                            (CapellaSpec, "capella", "bellatrix"),
+                            (DenebSpec, "deneb", "capella")):
+        path = os.path.join(repo, "specs", fork, "beacon-chain.md")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(generate_delta_markdown(cls, fork, prev))
+        print(f"wrote {path}")
 
 
 if __name__ == "__main__":

@@ -55,7 +55,7 @@ def test_sharded_g1_aggregate_matches_host():
 
     step = jax.jit(shard_map(
         local, mesh=mesh,
-        in_specs=jax.tree_util.tree_map(lambda _: P("agg"), packed),
+        in_specs=(jax.tree_util.tree_map(lambda _: P("agg"), packed),),
         out_specs=P(), check_rep=False))
     out = step(packed)
     got = PT.g1_unpack(jax.tree_util.tree_map(lambda a: a[None], out))

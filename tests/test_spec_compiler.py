@@ -70,6 +70,38 @@ def test_compiled_module_matches_handwritten_runtime():
         bls.bls_active = True
 
 
+def test_compiled_fork_ladder_matches_handwritten():
+    """The full markdown-compiled ladder (phase0->deneb) must reproduce
+    the hand-written runtime's states across a signed-block transition."""
+    import subprocess
+    subprocess.run([sys.executable, "-m", "consensus_specs_tpu.compiler"],
+                   check=True, cwd=REPO, capture_output=True)
+    from consensus_specs_tpu.forks import build_spec
+    from consensus_specs_tpu.forks.compiled.deneb import CompiledDenebSpec
+    from consensus_specs_tpu.test_infra.genesis import create_genesis_state
+    from consensus_specs_tpu.test_infra.block import (
+        build_empty_block_for_next_slot, state_transition_and_sign_block)
+
+    hand = build_spec("deneb", "minimal")
+    comp = CompiledDenebSpec(load_preset("minimal"), load_config("minimal"),
+                             preset_name="minimal")
+    bls.bls_active = False
+    try:
+        balances = [hand.MAX_EFFECTIVE_BALANCE] * 32
+        state_h = create_genesis_state(hand, balances,
+                                       hand.MAX_EFFECTIVE_BALANCE)
+        state_c = create_genesis_state(comp, balances,
+                                       comp.MAX_EFFECTIVE_BALANCE)
+        assert hash_tree_root(state_h) == hash_tree_root(state_c)
+        block_h = build_empty_block_for_next_slot(hand, state_h)
+        state_transition_and_sign_block(hand, state_h, block_h)
+        block_c = build_empty_block_for_next_slot(comp, state_c)
+        state_transition_and_sign_block(comp, state_c, block_c)
+        assert hash_tree_root(state_h) == hash_tree_root(state_c)
+    finally:
+        bls.bls_active = True
+
+
 def test_compiled_shuffle_matches():
     from consensus_specs_tpu.forks import build_spec
     hand = build_spec("phase0", "minimal")
