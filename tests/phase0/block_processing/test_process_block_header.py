@@ -1,0 +1,87 @@
+"""process_block_header operation tests.
+
+Reference model: ``test/phase0/block_processing/test_process_block_header.py``
+against ``specs/phase0/beacon-chain.md:1711``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases, expect_assertion_error,
+)
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+
+def _prepare(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    spec.process_slots(state, block.slot)
+    return block
+
+
+def run_block_header_processing(spec, state, block, valid=True):
+    yield "pre", state
+    yield "block", block
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_block_header(state, block))
+        yield "post", None
+        return
+    spec.process_block_header(state, block)
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_success_block_header(spec, state):
+    block = _prepare(spec, state)
+    yield from run_block_header_processing(spec, state, block)
+    # latest header caches the block with an empty state root
+    assert state.latest_block_header.slot == block.slot
+    assert state.latest_block_header.state_root == spec.Root()
+    assert state.latest_block_header.body_root == \
+        hash_tree_root(block.body)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_slot_block_header(spec, state):
+    block = _prepare(spec, state)
+    block.slot = state.slot + 1  # header slot != state slot
+    yield from run_block_header_processing(spec, state, block, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_index(spec, state):
+    block = _prepare(spec, state)
+    active = spec.get_active_validator_indices(
+        state, spec.get_current_epoch(state))
+    block.proposer_index = (block.proposer_index + 1) % len(active)
+    yield from run_block_header_processing(spec, state, block, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_parent_root(spec, state):
+    block = _prepare(spec, state)
+    block.parent_root = b"\x99" * 32
+    yield from run_block_header_processing(spec, state, block, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_multiple_blocks_single_slot(spec, state):
+    block = _prepare(spec, state)
+    spec.process_block_header(state, block)
+    # a second block for the same slot must fail the freshness check
+    child = block.copy()
+    child.parent_root = hash_tree_root(state.latest_block_header)
+    yield from run_block_header_processing(spec, state, child, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_slashed(spec, state):
+    block = _prepare(spec, state)
+    state.validators[block.proposer_index].slashed = True
+    yield from run_block_header_processing(spec, state, block, valid=False)

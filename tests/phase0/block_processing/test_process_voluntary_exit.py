@@ -1,0 +1,99 @@
+"""process_voluntary_exit operation tests.
+
+Reference model: ``test/phase0/block_processing/test_process_voluntary_exit.py``
+against ``specs/phase0/beacon-chain.md`` (process_voluntary_exit).
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases, always_bls,
+)
+from consensus_specs_tpu.test_infra.voluntary_exits import (
+    prepare_signed_exits, sign_voluntary_exit, run_voluntary_exit_processing,
+)
+from consensus_specs_tpu.test_infra.keys import privkeys
+from consensus_specs_tpu.test_infra.block import next_epoch
+
+
+def _age_state(spec, state):
+    state.slot += spec.SLOTS_PER_EPOCH * spec.config.SHARD_COMMITTEE_PERIOD
+
+
+@with_all_phases
+@spec_state_test
+def test_success_exit(spec, state):
+    _age_state(spec, state)
+    signed_exit = prepare_signed_exits(spec, state, [0])[0]
+    yield from run_voluntary_exit_processing(spec, state, signed_exit)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_success_exit_queue_churn(spec, state):
+    """More exits than the churn limit spread across two epochs."""
+    _age_state(spec, state)
+    churn_limit = int(spec.get_validator_churn_limit(state))
+    indices = list(range(churn_limit + 1))
+    signed_exits = prepare_signed_exits(spec, state, indices)
+    for signed_exit in signed_exits[:-1]:
+        spec.process_voluntary_exit(state, signed_exit)
+    yield from run_voluntary_exit_processing(spec, state, signed_exits[-1])
+    # the overflow exit lands one epoch later
+    first_epoch = state.validators[0].exit_epoch
+    assert state.validators[churn_limit].exit_epoch == first_epoch + 1
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_not_active(spec, state):
+    _age_state(spec, state)
+    index = 0
+    state.validators[index].activation_epoch = spec.FAR_FUTURE_EPOCH
+    signed_exit = prepare_signed_exits(spec, state, [index])[0]
+    yield from run_voluntary_exit_processing(spec, state, signed_exit,
+                                             valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_exit_already_initiated(spec, state):
+    _age_state(spec, state)
+    index = 0
+    spec.initiate_validator_exit(state, index)
+    signed_exit = prepare_signed_exits(spec, state, [index])[0]
+    yield from run_voluntary_exit_processing(spec, state, signed_exit,
+                                             valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_future_exit_epoch(spec, state):
+    _age_state(spec, state)
+    index = 0
+    exit_msg = spec.VoluntaryExit(
+        epoch=spec.get_current_epoch(state) + 5, validator_index=index)
+    signed_exit = sign_voluntary_exit(spec, state, exit_msg, privkeys[index])
+    yield from run_voluntary_exit_processing(spec, state, signed_exit,
+                                             valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_not_active_long_enough(spec, state):
+    # fresh genesis: SHARD_COMMITTEE_PERIOD has not elapsed
+    signed_exit = prepare_signed_exits(spec, state, [0])[0]
+    yield from run_voluntary_exit_processing(spec, state, signed_exit,
+                                             valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_signature_wrong_key(spec, state):
+    _age_state(spec, state)
+    index = 0
+    exit_msg = spec.VoluntaryExit(
+        epoch=spec.get_current_epoch(state), validator_index=index)
+    signed_exit = sign_voluntary_exit(spec, state, exit_msg,
+                                      privkeys[index + 1])
+    yield from run_voluntary_exit_processing(spec, state, signed_exit,
+                                             valid=False)

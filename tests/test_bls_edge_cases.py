@@ -1,0 +1,97 @@
+"""BLS ciphersuite edge cases (the bls vector family's adversarial set).
+
+Reference model: ``tests/generators/bls/main.py`` edge cases — infinity
+points, empty aggregations, tampered/non-canonical encodings — against
+the IETF BLS spec semantics the reference inherits from py_ecc/milagro.
+"""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu.utils import bls
+
+Z1_PUBKEY = b"\xc0" + b"\x00" * 47
+Z2_SIGNATURE = b"\xc0" + b"\x00" * 95
+MSG = b"\xab" * 32
+
+
+def setup_module():
+    bls.use_py()
+    bls.bls_active = True
+
+
+def test_keyvalidate_rejects_infinity_pubkey():
+    assert not bls.KeyValidate(Z1_PUBKEY)
+
+
+def test_keyvalidate_rejects_garbage():
+    assert not bls.KeyValidate(b"\x12" * 48)
+    # valid compressed flag but off-curve x
+    assert not bls.KeyValidate(b"\xa0" + b"\x00" * 47)
+
+
+def test_keyvalidate_accepts_real_pubkey():
+    assert bls.KeyValidate(bls.SkToPk(42))
+
+
+def test_verify_rejects_infinity_pubkey():
+    sig = bls.Sign(1, MSG)
+    assert not bls.Verify(Z1_PUBKEY, MSG, sig)
+
+
+def test_verify_rejects_infinity_signature():
+    pk = bls.SkToPk(1)
+    assert not bls.Verify(pk, MSG, Z2_SIGNATURE)
+
+
+def test_fast_aggregate_verify_empty_pubkeys_false():
+    """IETF: FastAggregateVerify over zero pubkeys is invalid — even with
+    the infinity signature (the altair eth_ variant special-cases it)."""
+    assert not bls.FastAggregateVerify([], MSG, Z2_SIGNATURE)
+
+
+def test_aggregate_empty_signature_list_raises():
+    with pytest.raises(Exception):
+        bls.Aggregate([])
+
+
+def test_aggregate_verify_mismatched_lengths_false():
+    pks = [bls.SkToPk(1), bls.SkToPk(2)]
+    sig = bls.Aggregate([bls.Sign(1, MSG)])
+    assert not bls.AggregateVerify(pks, [MSG], sig)
+
+
+def test_sign_verify_distinct_messages_aggregate():
+    pairs = [(1, b"\x01" * 32), (2, b"\x02" * 32), (3, b"\x03" * 32)]
+    sig = bls.Aggregate([bls.Sign(sk, m) for sk, m in pairs])
+    pks = [bls.SkToPk(sk) for sk, _ in pairs]
+    msgs = [m for _, m in pairs]
+    assert bls.AggregateVerify(pks, msgs, sig)
+    # reordering messages breaks it
+    assert not bls.AggregateVerify(pks, msgs[::-1], sig)
+
+
+def test_signature_malleability_rejected():
+    """Flipping the compression sign bit must not verify."""
+    sig = bytearray(bls.Sign(7, MSG))
+    sig[0] ^= 0x20  # flip the sort flag
+    assert not bls.Verify(bls.SkToPk(7), MSG, bytes(sig))
+
+
+def test_noncanonical_signature_rejected():
+    """x >= p in the encoding is non-canonical."""
+    assert not bls.Verify(bls.SkToPk(7), MSG, b"\xbf" + b"\xff" * 95)
+
+
+def test_stub_mode_behaviour():
+    old = bls.bls_active
+    bls.bls_active = False
+    try:
+        assert bls.Sign(1, MSG) == bls.STUB_SIGNATURE
+        assert bls.SkToPk(1) == bls.STUB_PUBKEY
+        assert bls.Verify(b"\x00" * 48, MSG, b"\x00" * 96)
+    finally:
+        bls.bls_active = old

@@ -1,0 +1,154 @@
+"""SSZ wire-format edge cases (the ssz_generic vector family).
+
+Reference model: ``tests/generators/ssz_generic/`` hand-built edge cases
+against ``ssz/simple-serialize.md``: uint boundaries, bitlist delimiters,
+offset validation, union selectors, nested variable-size layouts.
+"""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu.utils.ssz import (
+    serialize, deserialize, hash_tree_root,
+    boolean, uint8, uint16, uint32, uint64, uint128, uint256,
+    Bitlist, Bitvector, ByteList, ByteVector, Vector, List, Container, Union,
+    Bytes32,
+)
+
+
+@pytest.mark.parametrize("typ,bits", [
+    (uint8, 8), (uint16, 16), (uint32, 32), (uint64, 64),
+    (uint128, 128), (uint256, 256)])
+def test_uint_boundaries(typ, bits):
+    top = 2**bits - 1
+    assert serialize(typ(top)) == b"\xff" * (bits // 8)
+    assert deserialize(typ, b"\xff" * (bits // 8)) == top
+    with pytest.raises(ValueError):
+        typ(top + 1)
+    with pytest.raises(ValueError):
+        typ(-1)
+    # round trip at a non-trivial value
+    v = typ(top // 3)
+    assert deserialize(typ, serialize(v)) == v
+
+
+def test_uint_serialization_is_little_endian():
+    assert serialize(uint32(0x01020304)) == b"\x04\x03\x02\x01"
+    assert serialize(uint16(0x0102)) == b"\x02\x01"
+
+
+@pytest.mark.parametrize("n_bits", [0, 1, 7, 8, 9, 255, 256, 300])
+def test_bitlist_delimiter_roundtrip(n_bits):
+    T = Bitlist[512]
+    value = T([i % 2 == 0 for i in range(n_bits)])
+    data = serialize(value)
+    # delimiter bit: serialization is never empty and last byte non-zero
+    assert len(data) >= 1 and data[-1] != 0
+    assert deserialize(T, data) == value
+
+
+def test_bitlist_rejects_missing_delimiter():
+    with pytest.raises(ValueError):
+        deserialize(Bitlist[16], b"")
+    with pytest.raises(ValueError):
+        deserialize(Bitlist[16], b"\x01\x00")  # trailing zero byte
+
+
+def test_bitlist_rejects_overflow_bits():
+    # 9 content bits into a limit-8 bitlist
+    data = serialize(Bitlist[16]([True] * 9))
+    with pytest.raises(ValueError):
+        deserialize(Bitlist[8], data)
+
+
+def test_bitvector_rejects_nonzero_padding():
+    data = serialize(Bitvector[4]([True, True, True, True]))
+    assert data == b"\x0f"
+    with pytest.raises(ValueError):
+        Bitvector[4].decode_bytes(b"\x1f")  # bit 4 set beyond length
+
+
+class _VarElem(Container):
+    data: ByteList[64]
+
+
+class _VarOuter(Container):
+    fixed: uint64
+    var_a: List[uint16, 16]
+    var_b: _VarElem
+
+
+def test_container_offset_layout():
+    value = _VarOuter(fixed=7, var_a=[1, 2, 3], var_b=_VarElem(data=b"zz"))
+    data = serialize(value)
+    # fixed part: uint64 + two 4-byte offsets
+    assert int.from_bytes(data[8:12], "little") == 16  # first offset
+    rt = deserialize(_VarOuter, data)
+    assert rt == value
+    assert hash_tree_root(rt) == hash_tree_root(value)
+
+
+def test_container_rejects_bad_first_offset():
+    value = _VarOuter(fixed=7, var_a=[1], var_b=_VarElem(data=b"q"))
+    data = bytearray(serialize(value))
+    data[8:12] = (17).to_bytes(4, "little")  # first offset != fixed size
+    with pytest.raises(ValueError):
+        deserialize(_VarOuter, bytes(data))
+
+
+def test_container_rejects_decreasing_offsets():
+    value = _VarOuter(fixed=7, var_a=[1, 2], var_b=_VarElem(data=b"q"))
+    data = bytearray(serialize(value))
+    # second offset less than the first
+    data[12:16] = (10).to_bytes(4, "little")
+    with pytest.raises(ValueError):
+        deserialize(_VarOuter, bytes(data))
+
+
+def test_union_selector_edges():
+    U = Union[None, uint64, Bytes32]
+    assert serialize(U(0)) == b"\x00"
+    two = U(2, b"\x11" * 32)
+    assert serialize(two)[0] == 2
+    assert deserialize(U, serialize(two)) == two
+    with pytest.raises(ValueError):
+        deserialize(U, b"\x03\x00")  # selector out of range
+    with pytest.raises(ValueError):
+        deserialize(U, b"\x00\x00")  # None option with payload
+
+
+def test_empty_collections_roots_are_distinct_by_type():
+    assert hash_tree_root(List[uint64, 16]()) != \
+        hash_tree_root(List[uint64, 32]())
+    # limits under one 256-bit chunk share a tree depth; crossing the
+    # chunk boundary must change the (empty) root
+    assert hash_tree_root(Bitlist[16]()) == hash_tree_root(Bitlist[256]())
+    assert hash_tree_root(Bitlist[16]()) != hash_tree_root(Bitlist[512]())
+
+
+def test_vector_of_containers_roundtrip():
+    class Pair(Container):
+        a: uint8
+        b: uint8
+    T = Vector[Pair, 3]
+    v = T([Pair(a=i, b=i + 1) for i in range(3)])
+    assert deserialize(T, serialize(v)) == v
+    with pytest.raises(ValueError):
+        deserialize(T, serialize(v)[:-1])  # truncated
+
+
+def test_bytelist_limit_enforced():
+    with pytest.raises(ValueError):
+        ByteList[4](b"12345")
+    assert deserialize(ByteList[4], b"1234") == ByteList[4](b"1234")
+    with pytest.raises(ValueError):
+        deserialize(ByteList[4], b"12345")
+
+
+def test_bytevector_exact_length():
+    assert len(ByteVector[5](b"abcde")) == 5
+    with pytest.raises(ValueError):
+        ByteVector[5](b"abcd")
