@@ -1,0 +1,316 @@
+"""Whisk feature fork: single secret leader election via shuffled trackers.
+
+Behavioral source: ``specs/_features/whisk/beacon-chain.md``
+(``WhiskTracker`` :134, tracker selections :196-230, modified
+``process_block_header`` :247 (opening proof replaces the proposer-index
+equality), ``process_shuffled_trackers`` :327,
+``process_whisk_registration`` :352, whisk deposits :383, header-based
+``get_beacon_proposer_index`` :429) and ``specs/_features/whisk/fork.md``
+(``upgrade_to_whisk`` :55-125).  Fork DAG parent: capella
+(``pysetup/md_doc_paths.py:23``).
+
+Proof systems: :mod:`consensus_specs_tpu.ops.whisk_proofs` (DLEQ opening
+proofs implemented for real; shuffle proofs via the documented
+permutation-rerandomization stand-in — the reference defers both to the
+external curdleproofs library).
+"""
+from consensus_specs_tpu.utils.hash_function import hash
+from consensus_specs_tpu.utils.ssz import (
+    hash_tree_root, uint64, Bytes32, Bytes48, ByteList, Vector, List,
+    Container,
+)
+from consensus_specs_tpu.ops import whisk_proofs
+from consensus_specs_tpu.ops.bls12_381.fields import R_ORDER
+from . import register_fork
+from .capella import CapellaSpec
+from .base_types import (
+    Epoch, ValidatorIndex, DomainType,
+)
+
+DOMAIN_WHISK_CANDIDATE_SELECTION = DomainType("0x07000000")
+DOMAIN_WHISK_SHUFFLE = DomainType("0x07100000")
+DOMAIN_WHISK_PROPOSER_SELECTION = DomainType("0x07200000")
+
+BLSG1Point = Bytes48
+BLS_G1_GENERATOR = whisk_proofs.BLS_G1_GENERATOR
+WHISK_BLS_MODULUS = R_ORDER
+
+
+def saturating_sub(a, b):
+    return a - b if a > b else type(a)(0)
+
+
+@register_fork("whisk")
+class WhiskSpec(CapellaSpec):
+    fork = "whisk"
+    previous_fork = "capella"
+
+    DOMAIN_WHISK_CANDIDATE_SELECTION = DOMAIN_WHISK_CANDIDATE_SELECTION
+    DOMAIN_WHISK_SHUFFLE = DOMAIN_WHISK_SHUFFLE
+    DOMAIN_WHISK_PROPOSER_SELECTION = DOMAIN_WHISK_PROPOSER_SELECTION
+    BLSG1Point = BLSG1Point
+    BLS_G1_GENERATOR = BLS_G1_GENERATOR
+    BLS_MODULUS = WHISK_BLS_MODULUS
+    saturating_sub = staticmethod(saturating_sub)
+
+    # proof-system interface (beacon-chain.md:101-130)
+    IsValidWhiskOpeningProof = staticmethod(
+        whisk_proofs.IsValidWhiskOpeningProof)
+    IsValidWhiskShuffleProof = staticmethod(
+        whisk_proofs.IsValidWhiskShuffleProof)
+
+    # -- type construction ---------------------------------------------------
+
+    def _build_types(self):
+        S = self
+
+        class WhiskTracker(Container):
+            r_G: BLSG1Point
+            k_r_G: BLSG1Point
+
+        self.WhiskTracker = WhiskTracker
+        self.WhiskShuffleProof = ByteList[S.WHISK_MAX_SHUFFLE_PROOF_SIZE]
+        self.WhiskTrackerProof = ByteList[S.WHISK_MAX_OPENING_PROOF_SIZE]
+        super()._build_types()
+
+    def _block_body_fields(self, t) -> dict:
+        fields = super()._block_body_fields(t)
+        fields["whisk_opening_proof"] = self.WhiskTrackerProof
+        fields["whisk_post_shuffle_trackers"] = Vector[
+            self.WhiskTracker, self.WHISK_VALIDATORS_PER_SHUFFLE]
+        fields["whisk_shuffle_proof"] = self.WhiskShuffleProof
+        fields["whisk_registration_proof"] = self.WhiskTrackerProof
+        fields["whisk_tracker"] = self.WhiskTracker
+        fields["whisk_k_commitment"] = BLSG1Point
+        return fields
+
+    def _state_fields(self, t) -> dict:
+        fields = super()._state_fields(t)
+        fields["whisk_candidate_trackers"] = Vector[
+            self.WhiskTracker, self.WHISK_CANDIDATE_TRACKERS_COUNT]
+        fields["whisk_proposer_trackers"] = Vector[
+            self.WhiskTracker, self.WHISK_PROPOSER_TRACKERS_COUNT]
+        fields["whisk_trackers"] = List[
+            self.WhiskTracker, self.VALIDATOR_REGISTRY_LIMIT]
+        fields["whisk_k_commitments"] = List[
+            BLSG1Point, self.VALIDATOR_REGISTRY_LIMIT]
+        return fields
+
+    # -- whisk crypto helpers (beacon-chain.md:69-100,383-428) ---------------
+
+    def BLSG1ScalarMultiply(self, scalar, point) -> bytes:
+        return whisk_proofs._to_point(point).mult(
+            int(scalar) % R_ORDER).to_compressed()
+
+    def whisk_bytes_to_bls_field(self, b: bytes) -> int:
+        return int.from_bytes(bytes(b), "little") % R_ORDER
+
+    def get_initial_whisk_k(self, validator_index, counter) -> int:
+        return self.whisk_bytes_to_bls_field(hash(
+            self.uint_to_bytes(uint64(validator_index))
+            + self.uint_to_bytes(uint64(counter))))
+
+    def is_k_commitment_unique(self, state, k_commitment) -> bool:
+        return all(bytes(c) != bytes(k_commitment)
+                   for c in state.whisk_k_commitments)
+
+    def get_unique_whisk_k(self, state, validator_index) -> int:
+        counter = 0
+        while True:
+            k = self.get_initial_whisk_k(validator_index, counter)
+            if self.is_k_commitment_unique(
+                    state, self.BLSG1ScalarMultiply(k, BLS_G1_GENERATOR)):
+                return k
+            counter += 1
+
+    def get_k_commitment(self, k) -> bytes:
+        return self.BLSG1ScalarMultiply(k, BLS_G1_GENERATOR)
+
+    def get_initial_tracker(self, k):
+        return self.WhiskTracker(
+            r_G=BLS_G1_GENERATOR,
+            k_r_G=self.BLSG1ScalarMultiply(k, BLS_G1_GENERATOR))
+
+    # -- tracker selection (beacon-chain.md:196-230) -------------------------
+
+    def select_whisk_proposer_trackers(self, state, epoch) -> None:
+        proposer_seed = self.get_seed(
+            state, saturating_sub(epoch, self.config.WHISK_PROPOSER_SELECTION_GAP),
+            DOMAIN_WHISK_PROPOSER_SELECTION)
+        for i in range(self.WHISK_PROPOSER_TRACKERS_COUNT):
+            index = self.compute_shuffled_index(
+                uint64(i), uint64(len(state.whisk_candidate_trackers)),
+                proposer_seed)
+            state.whisk_proposer_trackers[i] = \
+                state.whisk_candidate_trackers[index]
+
+    def select_whisk_candidate_trackers(self, state, epoch) -> None:
+        active_validator_indices = self.get_active_validator_indices(
+            state, epoch)
+        for i in range(self.WHISK_CANDIDATE_TRACKERS_COUNT):
+            seed = hash(self.get_seed(state, epoch,
+                                      DOMAIN_WHISK_CANDIDATE_SELECTION)
+                        + self.uint_to_bytes(uint64(i)))
+            candidate_index = self.compute_proposer_index(
+                state, active_validator_indices, seed)
+            state.whisk_candidate_trackers[i] = \
+                state.whisk_trackers[candidate_index]
+
+    def process_whisk_updates(self, state) -> None:
+        next_epoch = Epoch(self.get_current_epoch(state) + 1)
+        if next_epoch % self.config.WHISK_EPOCHS_PER_SHUFFLING_PHASE == 0:
+            self.select_whisk_proposer_trackers(state, next_epoch)
+            self.select_whisk_candidate_trackers(state, next_epoch)
+
+    def process_epoch(self, state) -> None:
+        super().process_epoch(state)
+        self.process_whisk_updates(state)  # [New in Whisk]
+
+    # -- block header (beacon-chain.md:247-280) ------------------------------
+
+    def process_whisk_opening_proof(self, state, block) -> None:
+        tracker = state.whisk_proposer_trackers[
+            state.slot % self.WHISK_PROPOSER_TRACKERS_COUNT]
+        k_commitment = state.whisk_k_commitments[block.proposer_index]
+        assert self.IsValidWhiskOpeningProof(
+            tracker, k_commitment, block.body.whisk_opening_proof)
+
+    def process_block_header(self, state, block) -> None:
+        # Verify slots and lineage; the proposer-index equality is
+        # REPLACED by the whisk opening proof
+        assert block.slot == state.slot
+        assert block.slot > state.latest_block_header.slot
+        assert block.parent_root == hash_tree_root(state.latest_block_header)
+        state.latest_block_header = self.BeaconBlockHeader(
+            slot=block.slot,
+            proposer_index=block.proposer_index,
+            parent_root=block.parent_root,
+            state_root=Bytes32(),
+            body_root=hash_tree_root(block.body),
+        )
+        proposer = state.validators[block.proposer_index]
+        assert not proposer.slashed
+        self.process_whisk_opening_proof(state, block)  # [New in Whisk]
+
+    def get_beacon_proposer_index(self, state) -> ValidatorIndex:
+        """beacon-chain.md:429 — the proposer is whoever opened the
+        tracker; read it back from the processed header."""
+        assert state.latest_block_header.slot == state.slot
+        return state.latest_block_header.proposer_index
+
+    # -- shuffling and registration (beacon-chain.md:311-381) ----------------
+
+    def get_shuffle_indices(self, randao_reveal):
+        indices = []
+        for i in range(self.WHISK_VALIDATORS_PER_SHUFFLE):
+            pre_image = bytes(randao_reveal) + self.uint_to_bytes(uint64(i))
+            indices.append(self.bytes_to_uint64(hash(pre_image)[0:8])
+                           % self.WHISK_CANDIDATE_TRACKERS_COUNT)
+        return indices
+
+    def process_shuffled_trackers(self, state, body) -> None:
+        shuffle_epoch = self.get_current_epoch(state) \
+            % self.config.WHISK_EPOCHS_PER_SHUFFLING_PHASE
+        if shuffle_epoch + self.config.WHISK_PROPOSER_SELECTION_GAP + 1 \
+                >= self.config.WHISK_EPOCHS_PER_SHUFFLING_PHASE:
+            # cooldown: trackers must be zeroed
+            assert body.whisk_post_shuffle_trackers == Vector[
+                self.WhiskTracker, self.WHISK_VALIDATORS_PER_SHUFFLE]()
+            assert body.whisk_shuffle_proof == self.WhiskShuffleProof()
+        else:
+            shuffle_indices = self.get_shuffle_indices(body.randao_reveal)
+            pre_shuffle_trackers = [state.whisk_candidate_trackers[i]
+                                    for i in shuffle_indices]
+            assert self.IsValidWhiskShuffleProof(
+                pre_shuffle_trackers, body.whisk_post_shuffle_trackers,
+                body.whisk_shuffle_proof)
+            for i, shuffle_index in enumerate(shuffle_indices):
+                state.whisk_candidate_trackers[shuffle_index] = \
+                    body.whisk_post_shuffle_trackers[i]
+
+    def process_whisk_registration(self, state, body) -> None:
+        proposer_index = self.get_beacon_proposer_index(state)
+        if bytes(state.whisk_trackers[proposer_index].r_G) == \
+                BLS_G1_GENERATOR:  # first whisk proposal
+            assert bytes(body.whisk_tracker.r_G) != BLS_G1_GENERATOR
+            assert self.is_k_commitment_unique(state,
+                                               body.whisk_k_commitment)
+            assert self.IsValidWhiskOpeningProof(
+                body.whisk_tracker, body.whisk_k_commitment,
+                body.whisk_registration_proof)
+            state.whisk_trackers[proposer_index] = body.whisk_tracker
+            state.whisk_k_commitments[proposer_index] = \
+                body.whisk_k_commitment
+        else:  # subsequent proposals
+            assert body.whisk_registration_proof == self.WhiskTrackerProof()
+            assert body.whisk_tracker == self.WhiskTracker()
+            assert bytes(body.whisk_k_commitment) == bytes(BLSG1Point())
+
+    def process_block(self, state, block) -> None:
+        from consensus_specs_tpu.utils import bls as _bls
+        with _bls.batched_verification() as batch:
+            self.process_block_header(state, block)
+            self.process_withdrawals(state, block.body.execution_payload)
+            self.process_execution_payload(state, block.body,
+                                           self.EXECUTION_ENGINE)
+            self.process_randao(state, block.body)
+            self.process_eth1_data(state, block.body)
+            self.process_operations(state, block.body)
+            self.process_sync_aggregate(state, block.body.sync_aggregate)
+            self.process_shuffled_trackers(state, block.body)
+            self.process_whisk_registration(state, block.body)
+        batch.assert_valid()
+
+    # -- deposits (beacon-chain.md:383-428) ----------------------------------
+
+    def add_validator_to_registry(self, state, pubkey,
+                                  withdrawal_credentials, amount) -> None:
+        super().add_validator_to_registry(state, pubkey,
+                                          withdrawal_credentials, amount)
+        k = self.get_unique_whisk_k(
+            state, ValidatorIndex(len(state.validators) - 1))
+        state.whisk_trackers.append(self.get_initial_tracker(k))
+        state.whisk_k_commitments.append(self.get_k_commitment(k))
+
+    # -- genesis / upgrade (fork.md:55-125) ----------------------------------
+
+    def post_mock_genesis(self, state):
+        super().post_mock_genesis(state)
+        for index in range(len(state.validators)):
+            k = self.get_initial_whisk_k(ValidatorIndex(index), 0)
+            state.whisk_trackers.append(self.get_initial_tracker(k))
+            state.whisk_k_commitments.append(self.get_k_commitment(k))
+        epoch = self.get_current_epoch(state)
+        self.select_whisk_candidate_trackers(state, Epoch(saturating_sub(
+            epoch, self.config.WHISK_PROPOSER_SELECTION_GAP + 1)))
+        self.select_whisk_proposer_trackers(state, epoch)
+        self.select_whisk_candidate_trackers(state, epoch)
+
+    def upgrade_to_whisk(self, pre):
+        """fork.md:55 — capella state + whisk trackers for every
+        validator, then the bootstrap selections."""
+        epoch = self.get_current_epoch(pre)
+        ks = [self.get_initial_whisk_k(ValidatorIndex(i), 0)
+              for i in range(len(pre.validators))]
+        post = self.BeaconState(
+            **{f: getattr(pre, f) for f in type(pre).fields()
+               if f != "fork"},
+            fork=self.Fork(
+                previous_version=pre.fork.current_version,
+                current_version=self.config.WHISK_FORK_VERSION,
+                epoch=epoch,
+            ),
+            whisk_proposer_trackers=[
+                self.WhiskTracker()
+                for _ in range(self.WHISK_PROPOSER_TRACKERS_COUNT)],
+            whisk_candidate_trackers=[
+                self.WhiskTracker()
+                for _ in range(self.WHISK_CANDIDATE_TRACKERS_COUNT)],
+            whisk_trackers=[self.get_initial_tracker(k) for k in ks],
+            whisk_k_commitments=[self.get_k_commitment(k) for k in ks],
+        )
+        self.select_whisk_candidate_trackers(post, Epoch(saturating_sub(
+            epoch, self.config.WHISK_PROPOSER_SELECTION_GAP + 1)))
+        self.select_whisk_proposer_trackers(post, epoch)
+        self.select_whisk_candidate_trackers(post, epoch)
+        return post

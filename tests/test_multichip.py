@@ -62,6 +62,26 @@ def test_sharded_g1_aggregate_matches_host():
     assert got.to_compressed() == expected
 
 
+@pytest.mark.skipif(not HEAVY, reason="full pairing execution (CS_TPU_HEAVY=1)")
+def test_sharded_verify_module_end_to_end():
+    """consensus_specs_tpu.parallel: the library sharded-verify step
+    accepts valid aggregates and rejects a wrong message."""
+    _require_devices(8)
+    import __graft_entry__ as ge
+    from consensus_specs_tpu.parallel import build_mesh, \
+        make_sharded_agg_verify
+
+    mesh = build_mesh(jax.devices()[:8], 2, 4)
+    pk_pts, u0, u1, sig_q, agg_degen, sig_degen = ge._example_inputs(
+        batch=4, n_keys=8)
+    step = make_sharded_agg_verify(mesh)
+    out = np.asarray(step(pk_pts, u0, u1, sig_q, agg_degen, sig_degen))
+    assert out.shape == (4,) and bool(out.all())
+    # wrong message: swap u0/u1 -> hash point mismatches the signatures
+    out_bad = np.asarray(step(pk_pts, u1, u0, sig_q, agg_degen, sig_degen))
+    assert not bool(out_bad.any())
+
+
 def test_sharded_sum_collective_layout():
     """Sanity: the mesh really has 8 addressable devices and psum runs."""
     _require_devices(8)

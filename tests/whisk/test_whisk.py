@@ -1,0 +1,222 @@
+"""Whisk (SSLE) feature-fork tests.
+
+Reference model: ``test/whisk/`` against
+``specs/_features/whisk/beacon-chain.md`` — opening-proof-gated block
+headers, candidate/proposer tracker selection, shuffling, registration.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, expect_assertion_error,
+)
+from consensus_specs_tpu.test_infra.block import (
+    get_state_and_beacon_parent_root_at_slot, apply_randao_reveal,
+)
+from consensus_specs_tpu.ops import whisk_proofs
+
+
+def _slot_proposer(spec, state, slot):
+    """(validator index, k) matching the slot's proposer tracker.
+
+    Genesis trackers are initial (r_G = G, k_r_G = k*G == commitment),
+    so the owner is found by commitment equality."""
+    tracker = state.whisk_proposer_trackers[
+        slot % spec.WHISK_PROPOSER_TRACKERS_COUNT]
+    for index in range(len(state.validators)):
+        if bytes(state.whisk_k_commitments[index]) == bytes(tracker.k_r_G):
+            return index, spec.get_initial_whisk_k(index, 0)
+    raise AssertionError("no tracker owner found (non-initial tracker?)")
+
+
+def _fill_shuffle(spec, state, block):
+    """Satisfy process_shuffled_trackers for the block's randao reveal."""
+    shuffle_epoch = spec.compute_epoch_at_slot(block.slot) \
+        % spec.config.WHISK_EPOCHS_PER_SHUFFLING_PHASE
+    if shuffle_epoch + spec.config.WHISK_PROPOSER_SELECTION_GAP + 1 \
+            >= spec.config.WHISK_EPOCHS_PER_SHUFFLING_PHASE:
+        return  # cooldown: leave zeroed
+    indices = spec.get_shuffle_indices(block.body.randao_reveal)
+    pre = [state.whisk_candidate_trackers[i] for i in indices]
+    n = len(pre)
+    post, proof = whisk_proofs.GenerateWhiskShuffleProof(
+        pre, list(range(n)), [7 + i for i in range(n)])
+    block.body.whisk_post_shuffle_trackers = [
+        spec.WhiskTracker(r_G=r, k_r_G=krg) for r, krg in post]
+    block.body.whisk_shuffle_proof = proof
+
+
+def build_whisk_block(spec, state, register=True):
+    """A valid whisk block for the next slot (proposer chosen by
+    tracker, opening proof attached).  ``register=True`` is the only
+    valid mode against a genesis state: every tracker is still initial,
+    so the first-proposal registration branch always applies."""
+    slot = state.slot + 1
+    adv_state, parent_root = get_state_and_beacon_parent_root_at_slot(
+        spec, state, slot)
+    proposer_index, k = _slot_proposer(spec, adv_state, slot)
+
+    block = spec.BeaconBlock()
+    block.slot = slot
+    block.proposer_index = proposer_index
+    block.parent_root = parent_root
+    block.body.eth1_data.deposit_count = adv_state.eth1_deposit_index
+    block.body.sync_aggregate.sync_committee_signature = \
+        spec.G2_POINT_AT_INFINITY
+    from consensus_specs_tpu.test_infra.execution_payload import (
+        build_empty_execution_payload)
+    block.body.execution_payload = build_empty_execution_payload(
+        spec, adv_state)
+    apply_randao_reveal(spec, adv_state, block, proposer_index)
+
+    # opening proof over the slot's proposer tracker
+    tracker = adv_state.whisk_proposer_trackers[
+        slot % spec.WHISK_PROPOSER_TRACKERS_COUNT]
+    block.body.whisk_opening_proof = whisk_proofs.GenerateWhiskTrackerProof(
+        tracker, k)
+    _fill_shuffle(spec, adv_state, block)
+    if register:
+        r = 12345
+        k_new = 67890
+        new_tracker = spec.WhiskTracker(
+            r_G=spec.BLSG1ScalarMultiply(r, spec.BLS_G1_GENERATOR),
+            k_r_G=spec.BLSG1ScalarMultiply(
+                (k_new * r) % spec.BLS_MODULUS, spec.BLS_G1_GENERATOR))
+        block.body.whisk_tracker = new_tracker
+        block.body.whisk_k_commitment = spec.get_k_commitment(k_new)
+        block.body.whisk_registration_proof = \
+            whisk_proofs.GenerateWhiskTrackerProof(new_tracker, k_new)
+    return block
+
+
+def _transition(spec, state, block):
+    spec.process_slots(state, block.slot)
+    spec.process_block(state, block)
+
+
+@with_phases(["whisk"])
+@spec_state_test
+def test_whisk_genesis_shape(spec, state):
+    assert len(state.whisk_trackers) == len(state.validators)
+    assert len(state.whisk_k_commitments) == len(state.validators)
+    # genesis trackers are initial: r_G == G
+    assert all(bytes(t.r_G) == spec.BLS_G1_GENERATOR
+               for t in state.whisk_trackers)
+    # selections populated (non-zero trackers)
+    assert any(bytes(t.k_r_G) != bytes(spec.BLSG1Point())
+               for t in state.whisk_proposer_trackers)
+
+
+@with_phases(["whisk"])
+@spec_state_test
+def test_whisk_block_with_registration(spec, state):
+    block = build_whisk_block(spec, state, register=True)
+    proposer = block.proposer_index
+    yield "pre", state
+    _transition(spec, state, block)
+    yield "post", state
+    # tracker re-registered away from the initial form
+    assert bytes(state.whisk_trackers[proposer].r_G) != \
+        spec.BLS_G1_GENERATOR
+    assert bytes(state.whisk_k_commitments[proposer]) == \
+        bytes(block.body.whisk_k_commitment)
+
+
+@with_phases(["whisk"])
+@spec_state_test
+def test_whisk_invalid_opening_proof(spec, state):
+    block = build_whisk_block(spec, state, register=True)
+    bad = bytearray(bytes(block.body.whisk_opening_proof))
+    bad[-1] ^= 1
+    block.body.whisk_opening_proof = bytes(bad)
+    expect_assertion_error(lambda: _transition(spec, state.copy(), block))
+
+
+@with_phases(["whisk"])
+@spec_state_test
+def test_whisk_invalid_wrong_proposer(spec, state):
+    """A proposer whose tracker doesn't match the slot fails the proof."""
+    block = build_whisk_block(spec, state, register=True)
+    block.proposer_index = (block.proposer_index + 1) \
+        % len(state.validators)
+    expect_assertion_error(lambda: _transition(spec, state.copy(), block))
+
+
+@with_phases(["whisk"])
+@spec_state_test
+def test_whisk_invalid_shuffle_proof(spec, state):
+    block = build_whisk_block(spec, state, register=True)
+    if len(bytes(block.body.whisk_shuffle_proof)) == 0:
+        return  # cooldown phase: no shuffle to corrupt
+    bad = bytearray(bytes(block.body.whisk_shuffle_proof))
+    bad[9] ^= 1  # corrupt a rerandomization scalar
+    block.body.whisk_shuffle_proof = bytes(bad)
+    expect_assertion_error(lambda: _transition(spec, state.copy(), block))
+
+
+@with_phases(["whisk"])
+@spec_state_test
+def test_whisk_invalid_duplicate_registration_commitment(spec, state):
+    """Registering an already-used k commitment must fail."""
+    block = build_whisk_block(spec, state, register=True)
+    existing = bytes(state.whisk_k_commitments[0])
+    k0 = spec.get_initial_whisk_k(0, 0)
+    r = 999
+    dup_tracker = spec.WhiskTracker(
+        r_G=spec.BLSG1ScalarMultiply(r, spec.BLS_G1_GENERATOR),
+        k_r_G=spec.BLSG1ScalarMultiply((k0 * r) % spec.BLS_MODULUS,
+                                       spec.BLS_G1_GENERATOR))
+    block.body.whisk_tracker = dup_tracker
+    block.body.whisk_k_commitment = existing
+    block.body.whisk_registration_proof = \
+        whisk_proofs.GenerateWhiskTrackerProof(dup_tracker, k0)
+    expect_assertion_error(lambda: _transition(spec, state.copy(), block))
+
+
+@with_phases(["whisk"])
+@spec_state_test
+def test_whisk_shuffle_updates_candidates(spec, state):
+    block = build_whisk_block(spec, state, register=True)
+    if len(bytes(block.body.whisk_shuffle_proof)) == 0:
+        return
+    indices = spec.get_shuffle_indices(block.body.randao_reveal)
+    _transition(spec, state, block)
+    for i, shuffle_index in enumerate(indices):
+        assert state.whisk_candidate_trackers[shuffle_index] == \
+            block.body.whisk_post_shuffle_trackers[i]
+
+
+def test_opening_proof_roundtrip():
+    """Unit: DLEQ proof verifies and rejects mismatched commitments."""
+    from consensus_specs_tpu.ops.bls12_381.curve import G1_GENERATOR
+
+    class T:
+        pass
+    k, r = 777, 555
+    t = T()
+    t.r_G = G1_GENERATOR.mult(r).to_compressed()
+    t.k_r_G = G1_GENERATOR.mult(k * r).to_compressed()
+    commitment = G1_GENERATOR.mult(k).to_compressed()
+    proof = whisk_proofs.GenerateWhiskTrackerProof(t, k)
+    assert whisk_proofs.IsValidWhiskOpeningProof(t, commitment, proof)
+    wrong = G1_GENERATOR.mult(k + 1).to_compressed()
+    assert not whisk_proofs.IsValidWhiskOpeningProof(t, wrong, proof)
+    assert not whisk_proofs.IsValidWhiskOpeningProof(
+        t, commitment, proof[:-1] + b"\x00")
+
+
+def test_shuffle_proof_rejects_non_permutation():
+    from consensus_specs_tpu.ops.bls12_381.curve import G1_GENERATOR
+
+    class T:
+        def __init__(self, r_G, k_r_G):
+            self.r_G, self.k_r_G = r_G, k_r_G
+    pre = [T(G1_GENERATOR.mult(i + 2).to_compressed(),
+             G1_GENERATOR.mult(3 * i + 5).to_compressed())
+           for i in range(4)]
+    post, proof = whisk_proofs.GenerateWhiskShuffleProof(
+        pre, [2, 0, 3, 1], [11, 12, 13, 14])
+    post_t = [T(r, k) for r, k in post]
+    assert whisk_proofs.IsValidWhiskShuffleProof(pre, post_t, proof)
+    # repeated permutation index must fail
+    bad = bytearray(proof)
+    bad[0:8] = (0).to_bytes(8, "little")
+    bad[40:48] = (0).to_bytes(8, "little")
+    assert not whisk_proofs.IsValidWhiskShuffleProof(pre, post_t, bytes(bad))
