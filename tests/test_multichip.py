@@ -77,8 +77,9 @@ def test_sharded_verify_module_end_to_end():
     step = make_sharded_agg_verify(mesh)
     out = np.asarray(step(pk_pts, u0, u1, sig_q, agg_degen, sig_degen))
     assert out.shape == (4,) and bool(out.all())
-    # wrong message: swap u0/u1 -> hash point mismatches the signatures
-    out_bad = np.asarray(step(pk_pts, u1, u0, sig_q, agg_degen, sig_degen))
+    # wrong message: duplicate u0 — H = map(u0) + map(u0) != map(u0)+map(u1)
+    # (swapping u0/u1 would be a no-op: the two mapped points are summed)
+    out_bad = np.asarray(step(pk_pts, u0, u0, sig_q, agg_degen, sig_degen))
     assert not bool(out_bad.any())
 
 
