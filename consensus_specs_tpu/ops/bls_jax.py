@@ -35,6 +35,7 @@ from consensus_specs_tpu.ops.jax_bls import points as PT
 from consensus_specs_tpu.ops.jax_bls import pairing as PR
 from consensus_specs_tpu.ops.jax_bls import htc as HTC
 from consensus_specs_tpu.ops.jax_bls import tower as T
+from consensus_specs_tpu.ops.jax_bls import limbs as L
 
 # Cold-path delegation (oracle)
 Sign = _oracle.Sign
@@ -65,23 +66,31 @@ class _LRU(OrderedDict):
 
 
 # Pubkeys are bounded by the validator registry; signatures are unique per
-# message so their cache mainly serves immediate re-verification.
+# message so their cache mainly serves immediate re-verification.  One
+# cache entry per pubkey holds BOTH views — the oracle G1Point and the
+# lazily-packed Montgomery limb rows the device path stacks — so the
+# registry is never resident twice.
 _g1_cache = _LRU(1 << 21)
 _g2_cache = _LRU(1 << 14)
 
 
-def _decompress_g1(data: bytes):
-    """bytes48 -> G1Point or None if invalid per KeyValidate (non-canonical,
-    off-curve, out of subgroup, or the identity - IETF BLS KeyValidate)."""
+def _g1_entry(data: bytes):
+    """bytes48 -> [G1Point|None, packed|None] (KeyValidate semantics:
+    non-canonical, off-curve, out-of-subgroup and identity are None)."""
     key = bytes(data)
     if key not in _g1_cache:
         try:
             pt = g1_from_compressed(key)
             ok = (not pt.infinity) and pt.in_subgroup()
-            _g1_cache.put(key, pt if ok else None)
+            _g1_cache.put(key, [pt if ok else None, None])
         except Exception:
-            _g1_cache.put(key, None)
+            _g1_cache.put(key, [None, None])
     return _g1_cache[key]
+
+
+def _decompress_g1(data: bytes):
+    """bytes48 -> G1Point or None if invalid per KeyValidate."""
+    return _g1_entry(data)[0]
 
 
 def _decompress_g2(data: bytes):
@@ -95,6 +104,19 @@ def _decompress_g2(data: bytes):
         except Exception:
             _g2_cache.put(key, None)
     return _g2_cache[key]
+
+
+def _packed_g1(data: bytes):
+    """bytes48 -> (x_limbs, y_limbs) numpy rows (affine, Montgomery) or
+    None if the key fails KeyValidate.  The python int->limb conversion
+    costs ~50us/point and registry pubkeys repeat across every block, so
+    the rows are packed once and cached alongside the point."""
+    entry = _g1_entry(data)
+    if entry[0] is None:
+        return None
+    if entry[1] is None:
+        entry[1] = PT.g1_pack_affine_rows(entry[0])
+    return entry[1]
 
 
 def _pow2(n: int) -> int:
@@ -229,7 +251,7 @@ def verify_aggregates_batch(items) -> list:
     results_host = [None] * len(items)
     rows = []
     for idx, (pubkeys, msg, sig) in enumerate(items):
-        pts = [_decompress_g1(pk) for pk in pubkeys]
+        pts = [_packed_g1(pk) for pk in pubkeys]
         spt = _decompress_g2(sig)
         if len(pubkeys) == 0 or any(p is None for p in pts) or spt is None:
             results_host[idx] = False
@@ -242,17 +264,17 @@ def verify_aggregates_batch(items) -> list:
     for start in range(0, len(rows), B):
         chunk = rows[start:start + B]
         n_pad = max(_N_MIN, _pow2(max(len(r[1]) for r in chunk)))
-        pk_rows, sig_pts, msgs = [], [], []
+        sig_pts, msgs, pk_rows = [], [], []
         for _, pts, msg, spt in chunk:
-            pk_rows.append(pts + [G1Point.inf()] * (n_pad - len(pts)))
+            pk_rows.append(pts)
             sig_pts.append(spt)
             msgs.append(msg)
         for _ in range(B - len(chunk)):   # degenerate padding rows
-            pk_rows.append([G1Point.inf()] * n_pad)
+            pk_rows.append([])
             sig_pts.append(G2Point.inf())
             msgs.append(b"")
 
-        packed = PT.g1_pack([p for row in pk_rows for p in row])
+        packed = PT.g1_stack_packed(pk_rows, n_pad)
         pk_pts = jax.tree_util.tree_map(
             lambda a: a.reshape((B, n_pad) + a.shape[1:]), packed)
         u0, u1 = HTC.hash_to_field_host(msgs)
