@@ -39,12 +39,29 @@ def _encode_meta(value):
     return value
 
 
+class RawSSZBytes(bytes):
+    """Part wrapper: pre-serialized (possibly deliberately malformed) SSZ
+    bytes to be written as <name>.ssz_snappy — the ``ssz_generic``
+    invalid-encoding cases need byte streams no typed value can produce."""
+
+
+class YamlPart(dict):
+    """Part wrapper: force a <name>.yaml file even for scalar payloads."""
+
+
 def write_part(case_dir: str, name: str, value, meta: dict) -> None:
     """One yielded (name, value) part -> file(s) (reference
     gen_runner.py:399-426 output kinds)."""
     if value is None:
         return  # absent part (e.g. no post state for invalid cases)
-    if isinstance(value, SSZValue):
+    if isinstance(value, RawSSZBytes):
+        with open(os.path.join(case_dir, f"{name}.ssz_snappy"), "wb") as f:
+            f.write(snappy.compress(bytes(value)))
+    elif isinstance(value, YamlPart):
+        payload = value["value"] if set(value) == {"value"} else dict(value)
+        _write_yaml(os.path.join(case_dir, f"{name}.yaml"),
+                    _encode_meta(payload))
+    elif isinstance(value, SSZValue):
         with open(os.path.join(case_dir, f"{name}.ssz_snappy"), "wb") as f:
             f.write(snappy.compress(value.serialize()))
     elif isinstance(value, (list, tuple)) and value \

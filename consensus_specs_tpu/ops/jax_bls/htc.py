@@ -41,11 +41,12 @@ _YDEN = tuple(T.f2_const(c) for c in _oracle.ISO_YDEN)
 _PSI_CX = T.f2_const(_oracle._PSI_CX)
 _PSI_CY = T.f2_const(_oracle._PSI_CY)
 
-# cofactor-clearing scalars (x negative): [x^2-x-1]P + [x-1]psi(P) + psi^2([2]P)
-_S1 = X_PARAM * X_PARAM - X_PARAM - 1          # positive
-_S2_ABS = -(X_PARAM - 1)                       # |x-1|; the term is negated
-_S1_BITS = np.array([int(c) for c in bin(_S1)[2:]], dtype=np.uint32)
-_S2_BITS = np.array([int(c) for c in bin(_S2_ABS)[2:]], dtype=np.uint32)
+# cofactor clearing (Budroni-Pintore): h_eff P = [x^2-x-1]P + [x-1]psi(P)
+# + psi^2([2]P).  Both scalar terms factor through the 64-bit BLS
+# parameter: with R = [x]P - P,  [x^2-x-1]P = [x]R - P  and
+# [x-1]psi(P) = psi(R) - so two |x|-multiplications (Hamming weight 6)
+# replace the naive 127-bit + 64-bit generic ladders.
+_ABS_X_BITS = np.array([int(c) for c in bin(-X_PARAM)[2:]], dtype=np.uint32)
 
 
 _bc = T.f2_broadcast
@@ -108,12 +109,19 @@ def psi(p):
             T.f2_conj(Z))
 
 
+def _mul_x(p):
+    """[x]P for the (negative) BLS parameter x: MSB-first ladder over the
+    static bits of |x| with the 5 adds under ``lax.cond``, then negate."""
+    return PT.g2_neg(PT.g2_scalar_mul(p, _ABS_X_BITS))
+
+
 def clear_cofactor(p):
-    """Budroni-Pintore: [x^2-x-1]P + [x-1]psi(P) + psi^2([2]P), x < 0."""
-    t1 = PT.g2_scalar_mul(p, _S1_BITS)
-    t2 = PT.g2_neg(PT.g2_scalar_mul(psi(p), _S2_BITS))
-    t3 = psi(psi(PT.g2_add(p, p)))
-    return PT.g2_add(PT.g2_add(t1, t2), t3)
+    """Budroni-Pintore via the x-chain: [x]R - P + psi(R) + psi^2([2]P)
+    with R = [x]P - P."""
+    r = PT.g2_add(_mul_x(p), PT.g2_neg(p))
+    out = PT.g2_add(_mul_x(r), PT.g2_neg(p))
+    out = PT.g2_add(out, psi(r))
+    return PT.g2_add(out, psi(psi(PT.g2_add(p, p))))
 
 
 def map_to_g2(u0, u1):

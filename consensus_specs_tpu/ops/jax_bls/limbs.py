@@ -55,48 +55,73 @@ ONE_M = int_to_limbs(R_MONT)                     # mont(1)
 R2_LIMBS = int_to_limbs(R2_MONT)
 
 
+def _shift_limbs(x, d):
+    """Shift limb values up by ``d`` positions (toward higher indices),
+    filling with zeros - i.e. out[..., i] = x[..., i-d]."""
+    pad = jnp.zeros(x.shape[:-1] + (d,), x.dtype)
+    return jnp.concatenate([pad, x[..., :-d]], axis=-1)
+
+
 def _carry_chain(cols, n_out):
     """Propagate 16-bit carries over ``cols`` (..., n) -> (..., n_out) limbs.
 
-    Column values must be < 2^32 - carry headroom (they are < 2^22 here).
-    Runs as a ``lax.scan`` so the HLO stays one small While loop regardless
-    of width; the final carry is dropped (callers guarantee no overflow).
+    Carry-lookahead, fully parallel: two split-and-add passes shrink every
+    carry to {0, 1}, then a Kogge-Stone generate/propagate prefix network
+    (log2 depth, unrolled - no sequential loop at all) resolves the ripple.
+    Column values must be < 2^32 with carry headroom (they are < 2^22
+    here); carries INTO the kept range come only from kept columns, so
+    truncating first is exact, and the final carry out of limb ``n_out-1``
+    is dropped (callers guarantee no overflow / want mod 2^(16*n_out)).
     """
-    xs = jnp.moveaxis(cols[..., :n_out], -1, 0)
-    carry0 = jnp.zeros(cols.shape[:-1], jnp.uint32)
+    c = cols[..., :n_out]
+    # pass 1: columns < 2^22 -> limbs < 2^16 + 2^6
+    c = (c & MASK) + _shift_limbs(c >> LIMB_BITS, 1)
+    # pass 2: -> values <= 2^16 (carry in {0, 1})
+    c = (c & MASK) + _shift_limbs(c >> LIMB_BITS, 1)
+    lo = c & MASK
+    g = c >> LIMB_BITS                    # generates a carry (0/1)
+    p = (lo == MASK).astype(jnp.uint32)   # propagates an incoming carry
+    carry_in = _shift_limbs(_kogge_stone(g, p, n_out), 1)
+    return (lo + carry_in) & MASK
 
-    def step(carry, x):
-        t = x + carry
-        return t >> LIMB_BITS, t & MASK
 
-    _, out = jax.lax.scan(step, carry0, xs)
-    return jnp.moveaxis(out, 0, -1)
+def _kogge_stone(g, p, n):
+    """Resolve a generate/propagate prefix over ``n`` limb positions:
+    out[i] = g[i] | (p[i] & g[i-1]) | (p[i] & p[i-1] & g[i-2]) | ... -
+    the carry (or borrow) out of position i.  Unrolled log2 depth."""
+    d = 1
+    while d < n:
+        g = g | (p & _shift_limbs(g, d))
+        p = p & _shift_limbs(p, d)
+        d *= 2
+    return g
 
 
-# Static gather indices for antidiagonal (polynomial-product column) sums:
-# col[k] = sum_i lo[i, k-i] + sum_i hi[i, k-1-i].  Out-of-range entries are
-# routed to a zero pad column.
 _NCOL = 2 * NLIMB
-_I = np.arange(NLIMB)[:, None]
-_K = np.arange(_NCOL)[None, :]
-_LO_IDX = np.where((_K - _I >= 0) & (_K - _I < NLIMB), _K - _I, NLIMB)
-_HI_IDX = np.where((_K - 1 - _I >= 0) & (_K - 1 - _I < NLIMB), _K - 1 - _I, NLIMB)
+
+# Antidiagonal scatter matrix: row (s, i, j) of the flattened
+# (2, 24, 24) lo/hi product tensor contributes to column i + j + s.
+# col[k] = sum_i lo[i, k-i] + sum_i hi[i, k-1-i] then becomes ONE
+# integer dot against this constant 0/1 matrix - a single HLO op that
+# every backend compiles instantly and lowers to a small GEMM, where
+# both the take_along_axis (gather) and pad/stack formulations sent
+# XLA:CPU's LLVM pipeline into minutes-long compiles.
+_SCATTER = np.zeros((2 * NLIMB * NLIMB, _NCOL), dtype=np.uint32)
+for _s in range(2):
+    for _i in range(NLIMB):
+        for _j in range(NLIMB):
+            _SCATTER[_s * NLIMB * NLIMB + _i * NLIMB + _j, _i + _j + _s] = 1
+del _s, _i, _j
 
 
 def _product_columns(a, b):
     """(...,24) x (...,24) -> (...,48) antidiagonal column sums (< 2^22)."""
     prods = a[..., :, None] * b[..., None, :]            # exact in uint32
-    lo = prods & MASK
-    hi = prods >> LIMB_BITS
-    # one zero pad column at index NLIMB for out-of-range gathers
-    pad = jnp.zeros(prods.shape[:-1] + (1,), jnp.uint32)
-    lo = jnp.concatenate([lo, pad], axis=-1)
-    hi = jnp.concatenate([hi, pad], axis=-1)
-    lo_idx = jnp.broadcast_to(jnp.asarray(_LO_IDX), lo.shape[:-2] + _LO_IDX.shape)
-    hi_idx = jnp.broadcast_to(jnp.asarray(_HI_IDX), hi.shape[:-2] + _HI_IDX.shape)
-    cols = (jnp.take_along_axis(lo, lo_idx, axis=-1)
-            + jnp.take_along_axis(hi, hi_idx, axis=-1))
-    return cols.sum(axis=-2)
+    parts = jnp.stack([prods & MASK, prods >> LIMB_BITS], axis=-3)
+    flat = parts.reshape(parts.shape[:-3] + (2 * NLIMB * NLIMB,))
+    return jax.lax.dot_general(
+        flat, jnp.asarray(_SCATTER),
+        dimension_numbers=(((flat.ndim - 1,), (0,)), ((), ())))
 
 
 def _full_mul(a, b):
@@ -115,18 +140,23 @@ def _add_raw(a, b, n):
 
 
 def _sub_limbs(a, b):
-    """a - b over 24 limbs: returns (diff mod 2^384, borrow flag)."""
-    xs_a = jnp.moveaxis(a, -1, 0)
-    xs_b = jnp.moveaxis(b, -1, 0)
-    borrow0 = jnp.zeros(a.shape[:-1], jnp.uint32)
+    """a - b over 24 limbs: returns (diff mod 2^384, borrow flag).
 
-    def step(borrow, ab):
-        ai, bi = ab
-        t = ai + (MASK + jnp.uint32(1)) - bi - borrow    # in [1, 2^17)
-        return jnp.uint32(1) - (t >> LIMB_BITS), t & MASK
-
-    borrow, out = jax.lax.scan(step, borrow0, (xs_a, xs_b))
-    return jnp.moveaxis(out, 0, -1), borrow
+    Borrow-lookahead mirror of :func:`_carry_chain`: per-limb provisional
+    t = a + 2^16 - b in [1, 2^17); a limb *generates* a borrow when
+    t < 2^16 and *propagates* an incoming borrow when t == 2^16 (its
+    output digit is then 0 minus the borrow).  Kogge-Stone resolves the
+    ripple in log2 depth with no sequential loop.
+    """
+    t = a + (MASK + jnp.uint32(1)) - b
+    g = (jnp.uint32(1) - (t >> LIMB_BITS))          # borrows on its own
+    p = (t == MASK + jnp.uint32(1)).astype(jnp.uint32)
+    borrow_in = _shift_limbs(_kogge_stone(g, p, a.shape[-1]), 1)
+    out = (t - borrow_in) & MASK
+    # borrow out of the top limb
+    top = (t[..., -1] - borrow_in[..., -1]) >> LIMB_BITS
+    borrow = jnp.uint32(1) - top
+    return out, borrow
 
 
 def _cond_sub_p(x):
@@ -191,17 +221,32 @@ def _exp_bits(e: int, width: int = None) -> np.ndarray:
 def pow_fixed(a, bits: np.ndarray):
     """a^e for a fixed public exponent given as MSB-first bits (Montgomery).
 
-    381-bit exponents (inverse, sqrt) run as a 381-step scan: one square
-    plus one conditional multiply per step.
+    4-bit fixed-window ladder: a 16-entry table (15 setup multiplies) then
+    one scan step per window - 4 squarings + 1 table multiply - so a
+    381-bit exponent (inverse, sqrt) runs in ~96 sequential steps instead
+    of 381, with ~40% fewer multiplies overall.
     """
+    e = 0
+    for b in np.asarray(bits).astype(int):
+        e = (e << 1) | int(b)
+    width = len(bits)
+    nwin = (width + 3) // 4
+    windows = np.array([(e >> (4 * (nwin - 1 - i))) & 0xF
+                        for i in range(nwin)], dtype=np.uint32)
+
     one = jnp.broadcast_to(jnp.asarray(ONE_M), a.shape)
+    entries = [one, a]
+    for _ in range(14):
+        entries.append(mont_mul(entries[-1], a))
+    table = jnp.stack(entries)                  # (16, ..., 24)
 
-    def step(acc, bit):
-        acc = mont_sqr(acc)
-        acc = jnp.where(bit != 0, mont_mul(acc, a), acc)
-        return acc, None
+    def step(acc, w):
+        acc = mont_sqr(mont_sqr(mont_sqr(mont_sqr(acc))))
+        return mont_mul(acc, jnp.take(table, w, axis=0)), None
 
-    out, _ = jax.lax.scan(step, one, jnp.asarray(bits))
+    # first window seeds the accumulator directly (acc = table[w0])
+    acc = jnp.take(table, jnp.asarray(windows[0]), axis=0)
+    out, _ = jax.lax.scan(step, acc, jnp.asarray(windows[1:]))
     return out
 
 

@@ -115,6 +115,19 @@ def expect_assertion_error(fn):
 VECTOR_COLLECTOR = None
 
 
+def emit_part(name, value):
+    """Push one vector part straight to the active collector (no-op under
+    pytest, where VECTOR_COLLECTOR is None).
+
+    The reference's fork-choice helpers are generators that ``yield`` their
+    block/attestation parts up through the test (helpers/fork_choice.py:166).
+    Ours are plain functions called imperatively, so they emit parts in
+    event order through this hook instead; the test itself still yields its
+    trailing parts (e.g. the ``steps`` event log)."""
+    if VECTOR_COLLECTOR is not None:
+        VECTOR_COLLECTOR((name, value))
+
+
 def _consume(result):
     """Run a test generator to completion (pytest mode discards the parts;
     generator mode forwards them to VECTOR_COLLECTOR).
@@ -128,7 +141,9 @@ def _consume(result):
             return list(result)
         out = []
         for part in result:
-            VECTOR_COLLECTOR(part)
+            # a bare `yield` (None) marks a part-less test, not a part
+            if part is not None:
+                VECTOR_COLLECTOR(part)
             out.append(part)
         return out
     return result
@@ -245,6 +260,64 @@ def with_phases(phases, other_phases=None):
 
 def with_all_phases(fn):
     return with_phases(ALL_PHASES)(fn)
+
+
+class ForkMeta:
+    """One fork-boundary scenario: pre fork, post fork, activation epoch
+    (reference context.py:627-664 @with_fork_metas)."""
+
+    def __init__(self, pre_fork_name, post_fork_name, fork_epoch):
+        self.pre_fork_name = pre_fork_name
+        self.post_fork_name = post_fork_name
+        self.fork_epoch = fork_epoch
+
+
+# adjacent stable-fork pairs, for transition suites
+AFTER_FORK_PAIRS = tuple(zip(ALL_PHASES[:-1], ALL_PHASES[1:]))
+
+
+def with_fork_metas(fork_metas):
+    """Run a transition test once per ForkMeta with BOTH specs bound.
+
+    The test receives (state, fork_epoch, spec, post_spec); under the
+    generator, cases are filed under the POST fork's directory while
+    executing from the PRE fork's genesis (reference runs these with
+    pre_tag/post_tag block wrappers; our blocks carry their spec's types
+    directly).
+    """
+    def deco(fn):
+        @functools.wraps(fn)
+        def entry(*args, **kwargs):
+            available = _available_phases()
+            ran = False
+            for meta in fork_metas:
+                if meta.pre_fork_name not in available \
+                        or meta.post_fork_name not in available:
+                    continue
+                if ONLY_FORK is not None \
+                        and meta.post_fork_name != ONLY_FORK:
+                    continue
+                spec = build_spec(meta.pre_fork_name, DEFAULT_TEST_PRESET)
+                post_spec = build_spec(meta.post_fork_name,
+                                       DEFAULT_TEST_PRESET)
+                state = _get_genesis_state(
+                    spec, default_balances, default_activation_threshold)
+                old_active = bls.bls_active
+                bls.bls_active = DEFAULT_BLS_ACTIVE
+                _set_bls_backend()
+                try:
+                    _consume(fn(*args, state=state,
+                                fork_epoch=meta.fork_epoch, spec=spec,
+                                post_spec=post_spec, **kwargs))
+                finally:
+                    bls.bls_active = old_active
+                ran = True
+            if not ran:
+                pytest.skip("no selected fork pair supports this test")
+        if hasattr(entry, "__wrapped__"):
+            del entry.__wrapped__
+        return entry
+    return deco
 
 
 def with_all_phases_from(earliest):

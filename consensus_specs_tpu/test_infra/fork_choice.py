@@ -7,12 +7,15 @@ Mirrors the reference's ``test/helpers/fork_choice.py`` behavior: drive a
 and asserting store checks along the way.
 """
 from consensus_specs_tpu.utils.ssz import hash_tree_root, serialize
-from consensus_specs_tpu.test_infra.context import expect_assertion_error
+from consensus_specs_tpu.test_infra.context import (
+    expect_assertion_error, emit_part)
 
 
 def get_genesis_forkchoice_store_and_block(spec, genesis_state):
     assert genesis_state.slot == spec.GENESIS_SLOT
     genesis_block = spec.BeaconBlock(state_root=hash_tree_root(genesis_state))
+    emit_part("anchor_state", genesis_state)
+    emit_part("anchor_block", genesis_block)
     return spec.get_forkchoice_store(genesis_state, genesis_block), genesis_block
 
 
@@ -40,9 +43,11 @@ def tick_and_add_block(spec, store, signed_block, test_steps, valid=True,
 
 def add_block(spec, store, signed_block, test_steps, valid=True):
     """Run on_block and (on success) re-check the stored block."""
+    block_name = "block_0x" + hash_tree_root(signed_block.message).hex()
+    emit_part(block_name, signed_block)
     if not valid:
         expect_assertion_error(lambda: spec.on_block(store, signed_block))
-        test_steps.append({"block": "invalid", "valid": False})
+        test_steps.append({"block": block_name, "valid": False})
         return None
     spec.on_block(store, signed_block)
     # an on_block step implies receiving the block's attestations + slashings
@@ -52,14 +57,16 @@ def add_block(spec, store, signed_block, test_steps, valid=True):
         spec.on_attester_slashing(store, attester_slashing)
     block_root = hash_tree_root(signed_block.message)
     assert hash_tree_root(store.blocks[block_root]) == block_root
-    test_steps.append({"block": "0x" + block_root.hex()})
+    test_steps.append({"block": block_name})
     output_store_checks(spec, store, test_steps)
     return store.block_states[block_root]
 
 
 def add_attestation(spec, store, attestation, test_steps, is_from_block=False):
+    att_name = "attestation_0x" + hash_tree_root(attestation).hex()
+    emit_part(att_name, attestation)
     spec.on_attestation(store, attestation, is_from_block=is_from_block)
-    test_steps.append({"attestation": "0x" + hash_tree_root(attestation).hex()})
+    test_steps.append({"attestation": att_name})
     output_store_checks(spec, store, test_steps)
 
 
@@ -69,13 +76,15 @@ def add_attestations(spec, store, attestations, test_steps, is_from_block=False)
 
 
 def add_attester_slashing(spec, store, slashing, test_steps, valid=True):
+    slashing_name = "attester_slashing_0x" + hash_tree_root(slashing).hex()
+    emit_part(slashing_name, slashing)
     if not valid:
         expect_assertion_error(lambda: spec.on_attester_slashing(store, slashing))
-        test_steps.append({"attester_slashing": "invalid", "valid": False})
+        test_steps.append({"attester_slashing": slashing_name,
+                           "valid": False})
         return
     spec.on_attester_slashing(store, slashing)
-    test_steps.append(
-        {"attester_slashing": "0x" + hash_tree_root(slashing).hex()})
+    test_steps.append({"attester_slashing": slashing_name})
 
 
 def get_formatted_head_output(spec, store):

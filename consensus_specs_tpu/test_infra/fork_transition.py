@@ -1,0 +1,72 @@
+"""Fork-boundary transition machinery.
+
+Reference model: ``test/helpers/fork_transition.py`` (do_fork,
+transition_until_fork, state_transition_across_slots) - drive a pre-fork
+state up to the boundary under the pre spec, upgrade it, and continue
+under the post spec, collecting the signed blocks that cross the seam.
+"""
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block, build_empty_block_for_next_slot,
+    state_transition_and_sign_block, next_slots, sign_block,
+)
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+_UPGRADE_FN = {
+    "altair": "upgrade_to_altair",
+    "bellatrix": "upgrade_to_bellatrix",
+    "capella": "upgrade_to_capella",
+    "deneb": "upgrade_to_deneb",
+    "eip6110": "upgrade_to_eip6110",
+    "eip7002": "upgrade_to_eip7002",
+    "whisk": "upgrade_to_whisk",
+}
+
+
+def transition_until_fork(spec, state, fork_epoch):
+    """Advance (empty slots) to the last slot before the fork epoch."""
+    to_slot = fork_epoch * spec.SLOTS_PER_EPOCH - 1
+    assert state.slot < to_slot, "state already at/after the fork boundary"
+    next_slots(spec, state, int(to_slot) - int(state.slot))
+
+
+def state_transition_across_slots(spec, state, to_slot):
+    """Produce one signed empty block per slot up to ``to_slot``
+    (inclusive), returning the signed blocks."""
+    blocks = []
+    while int(state.slot) < int(to_slot):
+        block = build_empty_block_for_next_slot(spec, state)
+        blocks.append(state_transition_and_sign_block(spec, state, block))
+    return blocks
+
+
+def do_fork(state, spec, post_spec, fork_epoch, with_block=True):
+    """Cross the boundary: pre-spec epoch processing into the fork slot,
+    state upgrade, and (optionally) the first post-fork block.
+
+    Returns (post_state, signed_fork_block_or_None).
+    """
+    fork_slot = fork_epoch * spec.SLOTS_PER_EPOCH
+    assert int(state.slot) == int(fork_slot) - 1
+    spec.process_slots(state, fork_slot)
+
+    post_state = getattr(post_spec, _UPGRADE_FN[post_spec.fork])(state)
+    assert bytes(post_state.fork.current_version) == bytes(getattr(
+        post_spec.config, f"{post_spec.fork.upper()}_FORK_VERSION"))
+
+    if not with_block:
+        return post_state, None
+    # the first post-fork block sits AT the fork slot: the state is already
+    # there, so apply process_block directly (no process_slots)
+    block = build_empty_block(post_spec, post_state, slot=fork_slot)
+    post_spec.process_block(post_state, block)
+    block.state_root = hash_tree_root(post_state)
+    signed = sign_block(post_spec, post_state, block, block.proposer_index)
+    return post_state, signed
+
+
+def transition_to_next_epoch_and_append_blocks(spec, state, blocks,
+                                               epochs=1):
+    """Continue block production for ``epochs`` epochs under ``spec``."""
+    target = int(state.slot) + epochs * int(spec.SLOTS_PER_EPOCH)
+    blocks.extend(state_transition_across_slots(spec, state, target))
+    return blocks

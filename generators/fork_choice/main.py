@@ -1,0 +1,38 @@
+"""Fork-choice vector generator (reference tests/generators/fork_choice/main.py).
+
+Cases are event-sourced store simulations: anchor_state/anchor_block +
+block/attestation parts emitted in event order + a ``steps`` yaml of
+on_tick / on_block / on_attestation events with store checks
+(reference format: tests/formats/fork_choice/README.md:33-50).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from consensus_specs_tpu.gen import run_state_test_generators
+from consensus_specs_tpu.gen.gen_from_tests import combine_mods
+
+phase0_mods = {
+    "get_head": "tests.phase0.fork_choice.test_fork_choice",
+}
+altair_mods = phase0_mods
+bellatrix_mods = combine_mods({
+    "on_merge_block": "tests.bellatrix.fork_choice.test_on_merge_block",
+}, altair_mods)
+capella_mods = bellatrix_mods
+deneb_mods = combine_mods({
+    "on_block": "tests.deneb.fork_choice.test_on_block_blob_data",
+}, capella_mods)
+
+ALL_MODS = {
+    "phase0": phase0_mods,
+    "altair": altair_mods,
+    "bellatrix": bellatrix_mods,
+    "capella": capella_mods,
+    "deneb": deneb_mods,
+}
+
+if __name__ == "__main__":
+    run_state_test_generators("fork_choice", ALL_MODS, presets=("minimal",))

@@ -1,0 +1,18 @@
+"""Merkle-proof vector generator (reference tests/generators/merkle_proof)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from consensus_specs_tpu.gen import run_state_test_generators
+
+ALL_MODS = {
+    "deneb": {
+        "single_merkle_proof":
+            "tests.deneb.merkle_proof.test_single_merkle_proof",
+    },
+}
+
+if __name__ == "__main__":
+    run_state_test_generators("merkle_proof", ALL_MODS, presets=("minimal",))

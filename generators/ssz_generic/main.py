@@ -1,0 +1,202 @@
+"""ssz_generic vector generator: handcrafted valid + invalid wire-format
+cases (reference tests/generators/ssz_generic/ — uints, boolean,
+bitvector, bitlist, basic_vector, containers; format
+tests/formats/ssz_generic/README.md: valid cases carry serialized bytes +
+value.yaml + root meta, invalid cases carry only the malformed bytes).
+"""
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from consensus_specs_tpu.gen import TestCase, TestProvider, run_generator
+from consensus_specs_tpu.gen.gen_runner import RawSSZBytes, YamlPart
+from consensus_specs_tpu.debug.encode import encode
+from consensus_specs_tpu.utils.ssz import (
+    uint8, uint16, uint32, uint64, uint128, uint256, boolean,
+    Bitvector, Bitlist, Vector, List, Container, Bytes32,
+    serialize, hash_tree_root,
+)
+
+random.seed(0x5352)  # deterministic corpus
+
+
+class SingleFieldContainer(Container):
+    a: uint8
+
+
+class SmallContainer(Container):
+    a: uint16
+    b: uint16
+
+
+class FixedContainer(Container):
+    a: uint8
+    b: uint64
+    c: uint32
+
+
+class VarContainer(Container):
+    a: uint16
+    b: List[uint16, 1024]
+
+
+class ComplexContainer(Container):
+    a: uint16
+    b: List[uint16, 128]
+    c: uint8
+    d: Bytes32
+    e: VarContainer
+    f: Vector[FixedContainer, 4]
+
+
+def valid_case(value):
+    def case():
+        yield "value", YamlPart(value=encode(value))
+        yield "serialized", RawSSZBytes(serialize(value))
+        yield "root", hash_tree_root(value)
+    return case
+
+
+def invalid_case(data: bytes):
+    def case():
+        yield "serialized", RawSSZBytes(data)
+    return case
+
+
+def make_cases():
+    cases = {}  # (handler, suite, name) -> fn
+
+    # --- uints ------------------------------------------------------------
+    for typ, bits in ((uint8, 8), (uint16, 16), (uint32, 32), (uint64, 64),
+                      (uint128, 128), (uint256, 256)):
+        h = f"uint_{bits}"
+        cases[("uints", "valid", f"{h}_zero")] = valid_case(typ(0))
+        cases[("uints", "valid", f"{h}_max")] = \
+            valid_case(typ((1 << bits) - 1))
+        cases[("uints", "valid", f"{h}_random")] = \
+            valid_case(typ(random.getrandbits(bits)))
+        nbytes = bits // 8
+        cases[("uints", "invalid", f"{h}_one_byte_short")] = \
+            invalid_case(b"\x01" * (nbytes - 1))
+        cases[("uints", "invalid", f"{h}_one_byte_long")] = \
+            invalid_case(b"\x01" * (nbytes + 1))
+
+    # --- boolean ----------------------------------------------------------
+    cases[("boolean", "valid", "true")] = valid_case(boolean(True))
+    cases[("boolean", "valid", "false")] = valid_case(boolean(False))
+    cases[("boolean", "invalid", "byte_2")] = invalid_case(b"\x02")
+    cases[("boolean", "invalid", "byte_ff")] = invalid_case(b"\xff")
+    cases[("boolean", "invalid", "empty")] = invalid_case(b"")
+
+    # --- bitvector --------------------------------------------------------
+    for size in (1, 2, 8, 9, 16, 31, 512, 513):
+        typ = Bitvector[size]
+        bits = [bool(random.getrandbits(1)) for _ in range(size)]
+        cases[("bitvector", "valid", f"bitvec_{size}_random")] = \
+            valid_case(typ(bits))
+        cases[("bitvector", "valid", f"bitvec_{size}_zero")] = \
+            valid_case(typ([False] * size))
+        nbytes = (size + 7) // 8
+        cases[("bitvector", "invalid", f"bitvec_{size}_short")] = \
+            invalid_case(b"\x00" * (nbytes - 1))
+        cases[("bitvector", "invalid", f"bitvec_{size}_long")] = \
+            invalid_case(b"\x00" * (nbytes + 1))
+        if size % 8:
+            # a set bit above the length in the final byte
+            bad = bytearray(nbytes)
+            bad[-1] = 1 << (size % 8)
+            cases[("bitvector", "invalid", f"bitvec_{size}_high_bit")] = \
+                invalid_case(bytes(bad))
+
+    # --- bitlist ----------------------------------------------------------
+    for limit in (1, 2, 8, 9, 512):
+        typ = Bitlist[limit]
+        for n in {0, 1, limit // 2, limit}:
+            bits = [bool(random.getrandbits(1)) for _ in range(n)]
+            cases[("bitlist", "valid", f"bitlist_{limit}_len_{n}")] = \
+                valid_case(typ(bits))
+        # no delimiter bit at all
+        cases[("bitlist", "invalid", f"bitlist_{limit}_no_delimiter")] = \
+            invalid_case(b"\x00")
+        # delimiter places length beyond the limit
+        over = bytearray((limit + 8) // 8 + 1)
+        over[-1] = 2  # delimiter at bit position limit+1
+        cases[("bitlist", "invalid", f"bitlist_{limit}_over_limit")] = \
+            invalid_case(bytes(over))
+        cases[("bitlist", "invalid", f"bitlist_{limit}_empty_stream")] = \
+            invalid_case(b"")
+
+    # --- basic_vector -----------------------------------------------------
+    for elem, bits in ((uint8, 8), (uint16, 16), (uint64, 64)):
+        for length in (1, 2, 5, 128):
+            typ = Vector[elem, length]
+            vals = [elem(random.getrandbits(bits)) for _ in range(length)]
+            cases[("basic_vector", "valid",
+                   f"vec_uint{bits}_{length}_random")] = \
+                valid_case(typ(vals))
+            nbytes = (bits // 8) * length
+            cases[("basic_vector", "invalid",
+                   f"vec_uint{bits}_{length}_short")] = \
+                invalid_case(b"\x00" * (nbytes - 1))
+            cases[("basic_vector", "invalid",
+                   f"vec_uint{bits}_{length}_long")] = \
+                invalid_case(b"\x00" * (nbytes + 1))
+
+    # --- containers -------------------------------------------------------
+    def rand_var(n):
+        return VarContainer(
+            a=uint16(random.getrandbits(16)),
+            b=List[uint16, 1024](
+                *[uint16(random.getrandbits(16)) for _ in range(n)]))
+
+    cases[("containers", "valid", "single_field")] = \
+        valid_case(SingleFieldContainer(a=uint8(0xab)))
+    cases[("containers", "valid", "small")] = \
+        valid_case(SmallContainer(a=uint16(1), b=uint16(2)))
+    cases[("containers", "valid", "fixed")] = \
+        valid_case(FixedContainer(a=uint8(1), b=uint64(2), c=uint32(3)))
+    cases[("containers", "valid", "var_empty_list")] = \
+        valid_case(rand_var(0))
+    cases[("containers", "valid", "var_some")] = valid_case(rand_var(7))
+    cases[("containers", "valid", "complex")] = valid_case(
+        ComplexContainer(
+            a=uint16(0x1122),
+            b=List[uint16, 128](uint16(1), uint16(2), uint16(3)),
+            c=uint8(0xff),
+            d=Bytes32(bytes(range(32))),
+            e=rand_var(3),
+            f=Vector[FixedContainer, 4]([
+                FixedContainer(a=uint8(i), b=uint64(i * 2), c=uint32(i * 3))
+                for i in range(4)])))
+
+    cases[("containers", "invalid", "single_field_empty")] = invalid_case(b"")
+    cases[("containers", "invalid", "fixed_short")] = \
+        invalid_case(b"\x01" * 12)
+    cases[("containers", "invalid", "fixed_long")] = \
+        invalid_case(b"\x01" * 14)
+    # variable container offset pathologies: first offset must equal the
+    # fixed-part size (6); test below-fixed, past-end and truncated stream
+    good = serialize(rand_var(3))
+    bad_low = bytearray(good); bad_low[2:6] = (2).to_bytes(4, "little")
+    bad_high = bytearray(good)
+    bad_high[2:6] = (len(good) + 1).to_bytes(4, "little")
+    cases[("containers", "invalid", "var_offset_below_fixed_part")] = \
+        invalid_case(bytes(bad_low))
+    cases[("containers", "invalid", "var_offset_past_end")] = \
+        invalid_case(bytes(bad_high))
+    cases[("containers", "invalid", "var_truncated")] = \
+        invalid_case(good[:-1])
+
+    for (handler, suite, name), fn in cases.items():
+        yield TestCase(
+            fork_name="phase0", preset_name="general",
+            runner_name="ssz_generic", handler_name=handler,
+            suite_name=suite, case_name=name, case_fn=fn)
+
+
+if __name__ == "__main__":
+    run_generator("ssz_generic", [
+        TestProvider(prepare=lambda: None, make_cases=make_cases)])

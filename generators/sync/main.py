@@ -1,0 +1,14 @@
+"""Optimistic-sync vector generator (reference tests/generators/sync/main.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from consensus_specs_tpu.gen import run_state_test_generators
+
+mods = {"optimistic": "tests.bellatrix.sync.test_optimistic"}
+ALL_MODS = {fork: mods for fork in ("bellatrix", "capella", "deneb")}
+
+if __name__ == "__main__":
+    run_state_test_generators("sync", ALL_MODS, presets=("minimal",))

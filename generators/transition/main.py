@@ -1,0 +1,19 @@
+"""Fork-transition vector generator (reference tests/generators/transition).
+
+Cases run from the PRE fork's genesis and are filed under the POST fork's
+directory (the @with_fork_metas DSL binds both specs).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from consensus_specs_tpu.gen import run_state_test_generators
+
+mods = {"core": "tests.transition.test_transition"}
+ALL_MODS = {fork: mods
+            for fork in ("altair", "bellatrix", "capella", "deneb")}
+
+if __name__ == "__main__":
+    run_state_test_generators("transition", ALL_MODS, presets=("minimal",))

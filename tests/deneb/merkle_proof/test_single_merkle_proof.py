@@ -1,0 +1,82 @@
+"""Single-merkle-proof vectors: blob-commitment inclusion in the block body.
+
+Reference model: ``test/deneb/merkle_proof/test_single_merkle_proof.py``
+(blob sidecar inclusion proofs) and the ``merkle_proof`` vector format
+(``tests/formats/merkle_proof/README.md``: object.ssz_snappy + proof.yaml
+with leaf / leaf_index / branch).
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, never_bls,
+)
+from consensus_specs_tpu.utils.ssz import (
+    hash_tree_root, get_generalized_index, get_generalized_index_length,
+    get_subtree_node_root, compute_merkle_proof, verify_merkle_proof,
+)
+
+
+def _body_with_commitments(spec, n):
+    body = spec.BeaconBlockBody()
+    commitments = [bytes([0x01, i]) + bytes(46) for i in range(n)]
+    body.blob_kzg_commitments = body.blob_kzg_commitments.__class__(
+        *commitments)
+    return body
+
+
+def _run_blob_commitment_proof(spec, body, blob_index):
+    gindex = get_generalized_index(
+        type(body), "blob_kzg_commitments", blob_index)
+    leaf = get_subtree_node_root(body, gindex)
+    branch = compute_merkle_proof(body, gindex)
+    yield "object", body
+    yield "proof", {
+        "leaf": "0x" + leaf.hex(),
+        "leaf_index": int(gindex),
+        "branch": ["0x" + b.hex() for b in branch],
+    }
+    assert len(branch) == get_generalized_index_length(gindex)
+    assert len(branch) == int(spec.KZG_COMMITMENT_INCLUSION_PROOF_DEPTH)
+    assert verify_merkle_proof(leaf, branch, gindex, hash_tree_root(body))
+
+
+@with_phases(["deneb"])
+@spec_state_test
+@never_bls
+def test_blob_kzg_commitment_merkle_proof_first(spec, state):
+    body = _body_with_commitments(spec, 1)
+    yield from _run_blob_commitment_proof(spec, body, 0)
+
+
+@with_phases(["deneb"])
+@spec_state_test
+@never_bls
+def test_blob_kzg_commitment_merkle_proof_max_blobs(spec, state):
+    n = int(spec.MAX_BLOBS_PER_BLOCK)
+    body = _body_with_commitments(spec, n)
+    yield from _run_blob_commitment_proof(spec, body, n - 1)
+
+
+@with_phases(["deneb"])
+@spec_state_test
+@never_bls
+def test_blob_kzg_commitment_proof_rejects_wrong_root(spec, state):
+    body = _body_with_commitments(spec, 2)
+    gindex = get_generalized_index(type(body), "blob_kzg_commitments", 1)
+    leaf = get_subtree_node_root(body, gindex)
+    branch = compute_merkle_proof(body, gindex)
+    other = _body_with_commitments(spec, 3)
+    assert not verify_merkle_proof(
+        leaf, branch, gindex, hash_tree_root(other))
+    yield
+
+
+@with_phases(["deneb"])
+@spec_state_test
+@never_bls
+def test_blob_kzg_commitment_proof_rejects_wrong_index(spec, state):
+    body = _body_with_commitments(spec, 2)
+    g0 = get_generalized_index(type(body), "blob_kzg_commitments", 0)
+    g1 = get_generalized_index(type(body), "blob_kzg_commitments", 1)
+    leaf = get_subtree_node_root(body, g0)
+    branch = compute_merkle_proof(body, g0)
+    assert not verify_merkle_proof(leaf, branch, g1, hash_tree_root(body))
+    yield

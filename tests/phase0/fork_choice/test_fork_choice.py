@@ -48,7 +48,7 @@ def test_chain_no_attestations(spec, state):
     tick_and_add_block(spec, store, signed2, test_steps)
 
     assert bytes(spec.get_head(store)) == hash_tree_root(block2)
-    yield
+    yield "steps", test_steps
 
 
 @with_all_phases
@@ -78,7 +78,7 @@ def test_split_tie_breaker_no_attestations(spec, state):
 
     expected = max(hash_tree_root(block1), hash_tree_root(block2))
     assert bytes(spec.get_head(store)) == expected
-    yield
+    yield "steps", test_steps
 
 
 @with_all_phases
@@ -114,7 +114,7 @@ def test_shorter_chain_but_heavier_weight(spec, state):
     head = spec.get_head(store)
     assert bytes(head) == hash_tree_root(short_block)
     assert bytes(head) != bytes(long_head)
-    yield
+    yield "steps", test_steps
 
 
 @with_all_phases
@@ -129,7 +129,7 @@ def test_on_block_future_block(spec, state):
     signed = state_transition_and_sign_block(spec, state, block)
     tick_and_add_block(spec, store, signed, test_steps, valid=False,
                        block_not_ticked=True)
-    yield
+    yield "steps", test_steps
 
 
 @with_all_phases
@@ -145,7 +145,7 @@ def test_on_block_bad_parent_root(spec, state):
     on_tick_and_append_step(spec, store, time, test_steps)
     from consensus_specs_tpu.test_infra.fork_choice import add_block
     add_block(spec, store, signed, test_steps, valid=False)
-    yield
+    yield "steps", test_steps
 
 
 @with_all_phases
@@ -173,7 +173,7 @@ def test_proposer_boost(spec, state):
         spec, store, time + spec.config.SECONDS_PER_SLOT, test_steps)
     assert bytes(store.proposer_boost_root) == b"\x00" * 32
     assert spec.get_weight(store, root) == 0
-    yield
+    yield "steps", test_steps
 
 
 @with_all_phases
@@ -190,7 +190,7 @@ def test_on_attestation_future_epoch(spec, state):
     att.data.target.epoch = spec.get_current_store_epoch(store) + 1
     expect_assertion_error(
         lambda: spec.on_attestation(store, att, is_from_block=False))
-    yield
+    yield "steps", test_steps
 
 
 @with_all_phases
@@ -213,7 +213,7 @@ def test_on_attestation_updates_latest_messages(spec, state):
     for msg in store.latest_messages.values():
         assert msg.root == bytes(att.data.beacon_block_root)
         assert msg.epoch == att.data.target.epoch
-    yield
+    yield "steps", test_steps
 
 
 @with_all_phases
@@ -228,4 +228,4 @@ def test_justification_update_from_epoch_transition(spec, state):
         state, store, _ = apply_next_epoch_with_attestations(
             spec, state, store, True, False, test_steps)
     assert store.justified_checkpoint.epoch > 0
-    yield
+    yield "steps", test_steps

@@ -1,0 +1,108 @@
+"""Fork-transition tests: blocks spanning the fork boundary.
+
+Reference model: ``test/<fork>/transition/test_transition.py`` driven by
+``@with_fork_metas`` (context.py:627-664) - one scenario per adjacent
+fork pair, emitted under the ``transition`` runner with the format
+``tests/formats/transition/README.md`` (meta: post_fork / fork_epoch /
+fork_block index / blocks_count; parts: pre, blocks_<i>, post).
+"""
+from consensus_specs_tpu.test_infra.context import (
+    ForkMeta, with_fork_metas, AFTER_FORK_PAIRS,
+)
+from consensus_specs_tpu.test_infra.fork_transition import (
+    transition_until_fork, state_transition_across_slots, do_fork,
+    transition_to_next_epoch_and_append_blocks,
+)
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+_METAS = [ForkMeta(pre, post, fork_epoch=2)
+          for pre, post in AFTER_FORK_PAIRS]
+
+
+def _finish(post_spec, fork_epoch, blocks, post_state):
+    yield "post_fork", post_spec.fork
+    yield "fork_epoch", int(fork_epoch)
+    yield "blocks_count", len(blocks)
+    yield "blocks", blocks
+    yield "post", post_state
+
+
+@with_fork_metas(_METAS)
+def test_simple_transition(state, fork_epoch, spec, post_spec):
+    """Empty blocks every slot from genesis through one post-fork epoch."""
+    yield "pre", state
+    blocks = state_transition_across_slots(
+        spec, state, fork_epoch * spec.SLOTS_PER_EPOCH - 1)
+    state, fork_block = do_fork(state, spec, post_spec, fork_epoch)
+    blocks.append(fork_block)
+    yield "fork_block", len(blocks) - 1
+    transition_to_next_epoch_and_append_blocks(post_spec, state, blocks)
+
+    assert int(state.slot) == (fork_epoch + 1) * spec.SLOTS_PER_EPOCH
+    assert bytes(state.fork.current_version) == bytes(getattr(
+        post_spec.config, f"{post_spec.fork.upper()}_FORK_VERSION"))
+    yield from _finish(post_spec, fork_epoch, blocks, state)
+
+
+@with_fork_metas(_METAS)
+def test_transition_no_blocks_around_fork(state, fork_epoch, spec,
+                                          post_spec):
+    """Empty slots straddle the boundary: the first post-fork block comes
+    half an epoch late and must build on the upgraded state."""
+    yield "pre", state
+    transition_until_fork(spec, state, fork_epoch)
+    state, _ = do_fork(state, spec, post_spec, fork_epoch, with_block=False)
+    blocks = []
+    # half an epoch of empty slots, then blocks
+    from consensus_specs_tpu.test_infra.block import next_slots
+    next_slots(post_spec, state, int(spec.SLOTS_PER_EPOCH) // 2)
+    transition_to_next_epoch_and_append_blocks(post_spec, state, blocks)
+    assert len(blocks) == int(spec.SLOTS_PER_EPOCH)
+    yield from _finish(post_spec, fork_epoch, blocks, state)
+
+
+@with_fork_metas(_METAS)
+def test_transition_preserves_registry(state, fork_epoch, spec, post_spec):
+    """The upgrade must not touch validators/balances, and the post spec
+    must keep producing valid epochs on the migrated state."""
+    yield "pre", state
+    transition_until_fork(spec, state, fork_epoch)
+    # pre-spec replica of the boundary crossing: the epoch transition may
+    # legitimately touch the registry; the UPGRADE itself must not
+    replica = state.copy()
+    spec.process_slots(replica, fork_epoch * spec.SLOTS_PER_EPOCH)
+    state, _ = do_fork(state, spec, post_spec, fork_epoch, with_block=False)
+    assert hash_tree_root(state.validators) == \
+        hash_tree_root(replica.validators)
+    assert hash_tree_root(state.balances) == hash_tree_root(replica.balances)
+    blocks = []
+    transition_to_next_epoch_and_append_blocks(post_spec, state, blocks)
+    yield from _finish(post_spec, fork_epoch, blocks, state)
+
+
+@with_fork_metas(_METAS)
+def test_transition_pre_spec_rejects_post_block(state, fork_epoch, spec,
+                                                post_spec):
+    """A first-post-fork-epoch block is invalid under the PRE spec: its
+    proposer signed over the post fork version."""
+    from consensus_specs_tpu.test_infra.context import expect_assertion_error
+    transition_until_fork(spec, state, fork_epoch)
+    pre_state_for_replay = state.copy()
+    state, fork_block = do_fork(state, spec, post_spec, fork_epoch)
+    if fork_block is None:
+        return
+    # replaying the post-fork block under the pre-fork spec must fail:
+    # either the SSZ body shape or the state-root/signature check breaks
+    def replay():
+        replay_state = pre_state_for_replay.copy()
+        spec.process_slots(replay_state, fork_block.message.slot)
+        pre_block = spec.SignedBeaconBlock(
+            message=spec.BeaconBlock(
+                slot=fork_block.message.slot,
+                proposer_index=fork_block.message.proposer_index,
+                parent_root=fork_block.message.parent_root,
+                state_root=fork_block.message.state_root),
+            signature=fork_block.signature)
+        spec.state_transition(replay_state, pre_block)
+    expect_assertion_error(replay)
+    yield
