@@ -3,7 +3,8 @@
 PYTHON ?= python
 OUTPUT_DIR ?= ../consensus-spec-tests
 GENERATORS = operations sanity finality rewards random forks epoch_processing \
-             genesis ssz_static bls shuffling light_client kzg_4844
+             genesis ssz_static bls shuffling light_client kzg_4844 \
+             fork_choice merkle_proof ssz_generic sync transition
 
 .PHONY: test citest test-crypto bench bench-all dryrun native \
         generate_tests $(addprefix gen_,$(GENERATORS)) clean-vectors pyspec
@@ -43,9 +44,11 @@ generate_tests: $(addprefix gen_,$(GENERATORS))
 $(addprefix gen_,$(GENERATORS)): gen_%:
 	$(PYTHON) generators/$*/main.py -o $(OUTPUT_DIR)
 
-# native C components (raw-snappy codec for vector IO)
+# native C components (raw-snappy codec for vector IO, SHA-256 merkle
+# layer hasher for host-side merkleization)
 native:
 	gcc -O2 -shared -fPIC -o csrc/libcsnappy.so csrc/snappy.c
+	gcc -O3 -shared -fPIC -o csrc/libcsha256.so csrc/sha256_merkle.c
 
 clean-vectors:
 	rm -rf $(OUTPUT_DIR)/tests

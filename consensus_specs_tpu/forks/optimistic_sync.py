@@ -66,3 +66,56 @@ class OptimisticSyncMixin:
                 <= current_slot:
             return True
         return False
+
+    def import_optimistic_block(self, opt_store, block) -> None:
+        """Import a block whose execution payload has NOT been validated
+        (optimistic.md "when importing an optimistic block").  The parent
+        must already be in the store and not INVALIDATED."""
+        root = bytes(hash_tree_root(block))
+        assert bytes(block.parent_root) in opt_store.blocks
+        opt_store.blocks[root] = block.copy()
+        opt_store.optimistic_roots.add(root)
+
+    def on_payload_status(self, opt_store, block_root: bytes,
+                          valid: bool) -> None:
+        """Execution-engine verdict for an optimistically-imported block
+        (optimistic.md "how to apply" transitions):
+
+        - VALID: the block and every optimistic ancestor become verified
+          (a payload is only valid if its ancestors are).
+        - INVALIDATED: the block and all its descendants are removed from
+          the store entirely — they can never become canonical.
+        """
+        block_root = bytes(block_root)
+        assert block_root in opt_store.blocks
+        if valid:
+            block = opt_store.blocks[block_root]
+            while True:
+                opt_store.optimistic_roots.discard(
+                    bytes(hash_tree_root(block)))
+                parent = bytes(block.parent_root)
+                if parent not in opt_store.blocks:
+                    break
+                parent_block = opt_store.blocks[parent]
+                if not self.is_optimistic(opt_store, parent_block):
+                    break
+                block = parent_block
+            return
+        # INVALIDATED: only not-yet-validated blocks can transition
+        # (a verified block's payload verdict is final)
+        assert block_root in opt_store.optimistic_roots
+        # drop the subtree rooted at block_root
+        doomed = {block_root}
+        changed = True
+        while changed:
+            changed = False
+            for root, blk in list(opt_store.blocks.items()):
+                if root in doomed:
+                    continue
+                if bytes(blk.parent_root) in doomed:
+                    doomed.add(root)
+                    changed = True
+        for root in doomed:
+            opt_store.blocks.pop(root, None)
+            opt_store.block_states.pop(root, None)
+            opt_store.optimistic_roots.discard(root)

@@ -23,6 +23,8 @@ def generate_from_tests(runner_name: str, handler_name: str, src,
         case_fn = getattr(src, name)
         if not callable(case_fn):
             continue
+        if getattr(case_fn, "_pytest_only", False):
+            continue
         yield TestCase(
             fork_name=fork_name,
             preset_name=preset_name,

@@ -116,7 +116,15 @@ def generate_test_vector(test_case, output_dir: str, log) -> str:
     ctx.DEFAULT_TEST_PRESET = test_case.preset_name
     try:
         try:
-            test_case.case_fn()
+            result = test_case.case_fn()
+            # decorated spec tests consume their own yields (forwarding
+            # through ctx.VECTOR_COLLECTOR); a direct-provider case fn is
+            # a bare generator whose parts must be drained here
+            import inspect
+            if inspect.isgenerator(result):
+                for part in result:
+                    if part is not None:
+                        collector(part)
         except BaseException as exc:  # noqa: B036 — pytest.skip raises
             # a test skipping itself (preset/fork gating) is not an error
             if type(exc).__name__ in ("Skipped", "OutcomeException"):

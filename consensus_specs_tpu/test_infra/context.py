@@ -115,6 +115,16 @@ def expect_assertion_error(fn):
 VECTOR_COLLECTOR = None
 
 
+def pytest_only(fn):
+    """Mark a test as pytest-only: the vector generators skip it.
+
+    For negatives that assert API behavior without yielding the parts a
+    vector format requires - emitting them would produce empty,
+    format-violating case directories."""
+    fn._pytest_only = True
+    return fn
+
+
 def emit_part(name, value):
     """Push one vector part straight to the active collector (no-op under
     pytest, where VECTOR_COLLECTOR is None).

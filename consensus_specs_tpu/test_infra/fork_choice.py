@@ -62,9 +62,16 @@ def add_block(spec, store, signed_block, test_steps, valid=True):
     return store.block_states[block_root]
 
 
-def add_attestation(spec, store, attestation, test_steps, is_from_block=False):
+def add_attestation(spec, store, attestation, test_steps, is_from_block=False,
+                    valid=True):
     att_name = "attestation_0x" + hash_tree_root(attestation).hex()
     emit_part(att_name, attestation)
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.on_attestation(store, attestation,
+                                        is_from_block=is_from_block))
+        test_steps.append({"attestation": att_name, "valid": False})
+        return
     spec.on_attestation(store, attestation, is_from_block=is_from_block)
     test_steps.append({"attestation": att_name})
     output_store_checks(spec, store, test_steps)

@@ -12,10 +12,32 @@ natural batch boundary for the vectorized SHA-256 kernel
 calls instead of ~2M scalar hashes. A hashlib loop is the small-batch
 fallback.
 """
+import ctypes
+import os
 from hashlib import sha256
 from typing import List, Optional, Sequence
 
 ZERO_CHUNK = b"\x00" * 32
+
+
+def _load_native_hasher():
+    """csrc/libcsha256.so (make native): C merkle-layer SHA-256, the
+    pycryptodome-role native hash path (reference setup.py:546).  Absent
+    lib -> hashlib loop."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))), "csrc", "libcsha256.so")
+    try:
+        lib = ctypes.CDLL(path)
+        lib.sha256_merkle_layer.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t]
+        lib.sha256_merkle_layer.restype = None
+        return lib
+    except OSError:
+        return None
+
+
+_native = _load_native_hasher()
 
 # zero_hashes[i] = root of an all-zero subtree of depth i
 zero_hashes: List[bytes] = [ZERO_CHUNK]
@@ -45,6 +67,10 @@ def hash_layer(data: bytes) -> bytes:
     n = len(data) // 64
     if _batched_hasher is not None and n >= _BATCH_THRESHOLD:
         return _batched_hasher(data, n)
+    if _native is not None and n > 1:
+        out = ctypes.create_string_buffer(n * 32)
+        _native.sha256_merkle_layer(data, out, n)
+        return out.raw
     out = bytearray(n * 32)
     for i in range(n):
         out[i * 32:(i + 1) * 32] = sha256(data[i * 64:(i + 1) * 64]).digest()

@@ -1,0 +1,247 @@
+/* Batched SHA-256 Merkle-layer hasher.
+ *
+ * The role pycryptodome's C SHA-256 plays in the reference stack
+ * (reference setup.py:546; hash_tree_root is SHA-256-bound,
+ * specs/phase0/beacon-chain.md state roots): hash n independent 64-byte
+ * parent nodes into n 32-byte digests in one C call, removing the
+ * per-hash Python/hashlib dispatch overhead from host-side
+ * merkleization.  Each 64-byte message is exactly one data block plus
+ * one constant padding block, so the whole layer is 2n compression
+ * function calls in a tight loop.
+ *
+ * Build: make native  ->  csrc/libcsha256.so (loaded via ctypes by
+ * consensus_specs_tpu/utils/ssz/merkle.py).
+ */
+#include <stddef.h>
+#include <stdint.h>
+#include <string.h>
+
+static const uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5,
+    0x3956c25b, 0x59f111f1, 0x923f82a4, 0xab1c5ed5,
+    0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174,
+    0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+    0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7,
+    0xc6e00bf3, 0xd5a79147, 0x06ca6351, 0x14292967,
+    0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+    0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3,
+    0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5,
+    0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f, 0x682e6ff3,
+    0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+};
+
+#define ROTR(x, n) (((x) >> (n)) | ((x) << (32 - (n))))
+
+static void compress(uint32_t state[8], const uint8_t block[64]) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++) {
+        w[i] = ((uint32_t)block[4 * i] << 24) |
+               ((uint32_t)block[4 * i + 1] << 16) |
+               ((uint32_t)block[4 * i + 2] << 8) |
+               (uint32_t)block[4 * i + 3];
+    }
+    for (int i = 16; i < 64; i++) {
+        uint32_t s0 = ROTR(w[i - 15], 7) ^ ROTR(w[i - 15], 18) ^ (w[i - 15] >> 3);
+        uint32_t s1 = ROTR(w[i - 2], 17) ^ ROTR(w[i - 2], 19) ^ (w[i - 2] >> 10);
+        w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = state[0], b = state[1], c = state[2], d = state[3];
+    uint32_t e = state[4], f = state[5], g = state[6], h = state[7];
+    for (int i = 0; i < 64; i++) {
+        uint32_t s1 = ROTR(e, 6) ^ ROTR(e, 11) ^ ROTR(e, 25);
+        uint32_t ch = (e & f) ^ (~e & g);
+        uint32_t t1 = h + s1 + ch + K[i] + w[i];
+        uint32_t s0 = ROTR(a, 2) ^ ROTR(a, 13) ^ ROTR(a, 22);
+        uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+        uint32_t t2 = s0 + maj;
+        h = g; g = f; f = e; e = d + t1;
+        d = c; c = b; b = a; a = t1 + t2;
+    }
+    state[0] += a; state[1] += b; state[2] += c; state[3] += d;
+    state[4] += e; state[5] += f; state[6] += g; state[7] += h;
+}
+
+#if defined(__x86_64__) || defined(__i386__)
+#include <immintrin.h>
+
+/* SHA-NI compression: processes one 64-byte block into state.
+ * Standard x86 SHA extension schedule (two rounds per sha256rnds2). */
+__attribute__((target("sha,sse4.1")))
+static void compress_shani(uint32_t state[8], const uint8_t *block) {
+    const __m128i SHUF = _mm_set_epi64x(
+        0x0c0d0e0f08090a0bULL, 0x0405060700010203ULL);
+    __m128i TMP = _mm_loadu_si128((const __m128i *)&state[0]); /* DCBA */
+    __m128i STATE1 = _mm_loadu_si128((const __m128i *)&state[4]); /* HGFE */
+    TMP = _mm_shuffle_epi32(TMP, 0xB1);           /* CDAB */
+    STATE1 = _mm_shuffle_epi32(STATE1, 0x1B);     /* EFGH */
+    __m128i STATE0 = _mm_alignr_epi8(TMP, STATE1, 8);    /* ABEF */
+    STATE1 = _mm_blend_epi16(STATE1, TMP, 0xF0);         /* CDGH */
+
+    __m128i ABEF_SAVE = STATE0, CDGH_SAVE = STATE1;
+    __m128i MSG, MSG0, MSG1, MSG2, MSG3;
+
+    /* rounds 0-3 */
+    MSG0 = _mm_shuffle_epi8(
+        _mm_loadu_si128((const __m128i *)(block + 0)), SHUF);
+    MSG = _mm_add_epi32(MSG0, _mm_set_epi64x(
+        0xE9B5DBA5B5C0FBCFULL, 0x71374491428A2F98ULL));
+    STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);
+    MSG = _mm_shuffle_epi32(MSG, 0x0E);
+    STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);
+
+    /* rounds 4-7 */
+    MSG1 = _mm_shuffle_epi8(
+        _mm_loadu_si128((const __m128i *)(block + 16)), SHUF);
+    MSG = _mm_add_epi32(MSG1, _mm_set_epi64x(
+        0xAB1C5ED5923F82A4ULL, 0x59F111F13956C25BULL));
+    STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);
+    MSG = _mm_shuffle_epi32(MSG, 0x0E);
+    STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);
+    MSG0 = _mm_sha256msg1_epu32(MSG0, MSG1);
+
+    /* rounds 8-11 */
+    MSG2 = _mm_shuffle_epi8(
+        _mm_loadu_si128((const __m128i *)(block + 32)), SHUF);
+    MSG = _mm_add_epi32(MSG2, _mm_set_epi64x(
+        0x550C7DC3243185BEULL, 0x12835B01D807AA98ULL));
+    STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);
+    MSG = _mm_shuffle_epi32(MSG, 0x0E);
+    STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);
+    MSG1 = _mm_sha256msg1_epu32(MSG1, MSG2);
+
+    /* rounds 12-15 */
+    MSG3 = _mm_shuffle_epi8(
+        _mm_loadu_si128((const __m128i *)(block + 48)), SHUF);
+    MSG = _mm_add_epi32(MSG3, _mm_set_epi64x(
+        0xC19BF1749BDC06A7ULL, 0x80DEB1FE72BE5D74ULL));
+    STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);
+    MSG0 = _mm_add_epi32(MSG0,
+        _mm_alignr_epi8(MSG3, MSG2, 4));
+    MSG0 = _mm_sha256msg2_epu32(MSG0, MSG3);
+    MSG = _mm_shuffle_epi32(MSG, 0x0E);
+    STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);
+    MSG2 = _mm_sha256msg1_epu32(MSG2, MSG3);
+
+    static const uint64_t KK[12][2] = {
+        {0x240CA1CC0FC19DC6ULL, 0xEFBE4786E49B69C1ULL},
+        {0x76F988DA5CB0A9DCULL, 0x4A7484AA2DE92C6FULL},
+        {0xBF597FC7B00327C8ULL, 0xA831C66D983E5152ULL},
+        {0x1429296706CA6351ULL, 0xD5A79147C6E00BF3ULL},
+        {0x53380D134D2C6DFCULL, 0x2E1B213827B70A85ULL},
+        {0x92722C8581C2C92EULL, 0x766A0ABB650A7354ULL},
+        {0xC76C51A3C24B8B70ULL, 0xA81A664BA2BFE8A1ULL},
+        {0x106AA070F40E3585ULL, 0xD6990624D192E819ULL},
+        {0x34B0BCB52748774CULL, 0x1E376C0819A4C116ULL},
+        {0x682E6FF35B9CCA4FULL, 0x4ED8AA4A391C0CB3ULL},
+        {0x8CC7020884C87814ULL, 0x78A5636F748F82EEULL},
+        {0xC67178F2BEF9A3F7ULL, 0xA4506CEB90BEFFFAULL},
+    };
+    /* rounds 16-63: steady-state schedule */
+    __m128i *msgs[4] = {&MSG0, &MSG1, &MSG2, &MSG3};
+    for (int r = 0; r < 12; r++) {
+        __m128i *cur = msgs[r % 4];
+        __m128i *nx1 = msgs[(r + 1) % 4];
+        __m128i *nx3 = msgs[(r + 3) % 4];
+        MSG = _mm_add_epi32(*cur, _mm_set_epi64x(
+            (long long)KK[r][0], (long long)KK[r][1]));
+        STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);
+        *nx1 = _mm_add_epi32(*nx1, _mm_alignr_epi8(*cur, *nx3, 4));
+        *nx1 = _mm_sha256msg2_epu32(*nx1, *cur);
+        MSG = _mm_shuffle_epi32(MSG, 0x0E);
+        STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);
+        if (r < 10)
+            *nx3 = _mm_sha256msg1_epu32(*nx3, *cur);
+    }
+
+    STATE0 = _mm_add_epi32(STATE0, ABEF_SAVE);
+    STATE1 = _mm_add_epi32(STATE1, CDGH_SAVE);
+
+    TMP = _mm_shuffle_epi32(STATE0, 0x1B);        /* FEBA */
+    STATE1 = _mm_shuffle_epi32(STATE1, 0xB1);     /* DCHG */
+    STATE0 = _mm_blend_epi16(TMP, STATE1, 0xF0);  /* DCBA */
+    STATE1 = _mm_alignr_epi8(STATE1, TMP, 8);     /* HGFE */
+    _mm_storeu_si128((__m128i *)&state[0], STATE0);
+    _mm_storeu_si128((__m128i *)&state[4], STATE1);
+}
+
+static int has_shani(void) {
+    static int cached = -1;
+    if (cached < 0)
+        cached = __builtin_cpu_supports("sha") ? 1 : 0;
+    return cached;
+}
+#else
+static int has_shani(void) { return 0; }
+static void compress_shani(uint32_t state[8], const uint8_t *block) {
+    (void)state; (void)block;
+}
+#endif
+
+/* The padding block for a 64-byte message is constant: 0x80, zeros, and
+ * the 512-bit length in the trailing 8 bytes. */
+static const uint8_t PAD_BLOCK[64] = {
+    0x80, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0x02, 0x00,
+};
+
+/* in: n*64 bytes of parent nodes; out: n*32 bytes of digests. */
+void sha256_merkle_layer(const uint8_t *in, uint8_t *out, size_t n) {
+    int ni = has_shani();
+    for (size_t i = 0; i < n; i++) {
+        uint32_t st[8] = {
+            0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+            0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
+        };
+        if (ni) {
+            compress_shani(st, in + 64 * i);
+            compress_shani(st, PAD_BLOCK);
+        } else {
+            compress(st, in + 64 * i);
+            compress(st, PAD_BLOCK);
+        }
+        uint8_t *o = out + 32 * i;
+        for (int j = 0; j < 8; j++) {
+            o[4 * j] = (uint8_t)(st[j] >> 24);
+            o[4 * j + 1] = (uint8_t)(st[j] >> 16);
+            o[4 * j + 2] = (uint8_t)(st[j] >> 8);
+            o[4 * j + 3] = (uint8_t)st[j];
+        }
+    }
+}
+
+/* General one-shot SHA-256 (for mix_in_length-style 64-byte inputs the
+ * layer entrypoint is faster; this exists for completeness/testing). */
+void sha256_oneshot(const uint8_t *in, size_t len, uint8_t *out) {
+    uint32_t st[8] = {
+        0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+        0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
+    };
+    size_t full = len / 64;
+    for (size_t i = 0; i < full; i++)
+        compress(st, in + 64 * i);
+    uint8_t tail[128];
+    size_t rem = len - 64 * full;
+    memset(tail, 0, sizeof(tail));
+    memcpy(tail, in + 64 * full, rem);
+    tail[rem] = 0x80;
+    size_t tail_blocks = (rem + 1 + 8 <= 64) ? 1 : 2;
+    uint64_t bitlen = (uint64_t)len * 8;
+    uint8_t *lenp = tail + 64 * tail_blocks - 8;
+    for (int j = 0; j < 8; j++)
+        lenp[j] = (uint8_t)(bitlen >> (56 - 8 * j));
+    for (size_t i = 0; i < tail_blocks; i++)
+        compress(st, tail + 64 * i);
+    for (int j = 0; j < 8; j++) {
+        out[4 * j] = (uint8_t)(st[j] >> 24);
+        out[4 * j + 1] = (uint8_t)(st[j] >> 16);
+        out[4 * j + 2] = (uint8_t)(st[j] >> 8);
+        out[4 * j + 3] = (uint8_t)st[j];
+    }
+}

@@ -5,7 +5,7 @@ tests/formats/ssz_generic/README.md: valid cases carry serialized bytes +
 value.yaml + root meta, invalid cases carry only the malformed bytes).
 """
 import os
-import random
+import random as _random
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
@@ -19,9 +19,6 @@ from consensus_specs_tpu.utils.ssz import (
     Bitvector, Bitlist, Vector, List, Container, Bytes32,
     serialize, hash_tree_root,
 )
-
-random.seed(0x5352)  # deterministic corpus
-
 
 class SingleFieldContainer(Container):
     a: uint8
@@ -56,7 +53,8 @@ def valid_case(value):
     def case():
         yield "value", YamlPart(value=encode(value))
         yield "serialized", RawSSZBytes(serialize(value))
-        yield "root", hash_tree_root(value)
+        # meta entry (root is format metadata, not an ssz part)
+        yield "root", "0x" + bytes(hash_tree_root(value)).hex()
     return case
 
 
@@ -67,6 +65,7 @@ def invalid_case(data: bytes):
 
 
 def make_cases():
+    random = _random.Random(0x5352)   # deterministic, call-local corpus
     cases = {}  # (handler, suite, name) -> fn
 
     # --- uints ------------------------------------------------------------

@@ -2,20 +2,22 @@
 
 Reference model: ``test/bellatrix/fork_choice/test_on_merge_block.py``
 against ``specs/bellatrix/fork-choice.md:204`` (validate_merge_block).
+Vector format: the fork_choice event log plus ``pow_block_<hash>`` parts
+describing the PoW chain the merge block anchors to.
 """
 from consensus_specs_tpu.test_infra.context import (
-    spec_state_test, with_phases, never_bls,
+    spec_state_test, with_phases, never_bls, emit_part,
 )
 from consensus_specs_tpu.test_infra.block import (
     build_empty_block_for_next_slot, state_transition_and_sign_block,
 )
 from consensus_specs_tpu.test_infra.execution_payload import (
-    build_state_with_incomplete_transition, build_empty_execution_payload,
-    compute_el_block_hash,
+    build_state_with_incomplete_transition, compute_el_block_hash,
 )
 from consensus_specs_tpu.test_infra.fork_choice import (
     get_genesis_forkchoice_store_and_block, tick_and_add_block,
 )
+from consensus_specs_tpu.utils.ssz import hash_tree_root
 
 
 def _merge_block_setup(spec, state):
@@ -34,72 +36,81 @@ def _merge_block_setup(spec, state):
     return state, store, signed_block, payload
 
 
+def _register_pow_chain(pow_blocks, test_steps):
+    """Emit the PoW blocks as vector parts + steps, and return the lookup
+    the spec's get_pow_block stub will serve."""
+    table = {}
+    for pb in pow_blocks:
+        name = "pow_block_0x" + bytes(pb.block_hash).hex()
+        emit_part(name, pb)
+        test_steps.append({"pow_block": name})
+        table[bytes(pb.block_hash)] = pb
+    return table
+
+
+def _run_merge_block_case(spec, state, pow_blocks, valid):
+    state, store, signed_block, payload = _merge_block_setup(spec, state)
+    test_steps = []
+    table = _register_pow_chain(pow_blocks(spec, payload), test_steps)
+    spec.get_pow_block = lambda h: table.get(bytes(h))
+    try:
+        tick_and_add_block(spec, store, signed_block, test_steps,
+                           valid=valid)
+        if valid:
+            assert hash_tree_root(signed_block.message) in store.blocks
+    finally:
+        del spec.get_pow_block  # restore the class-level stub
+    yield "steps", test_steps
+
+
 @with_phases(["bellatrix"])
 @spec_state_test
 @never_bls
 def test_merge_block_valid_terminal_pow(spec, state):
-    state, store, signed_block, payload = _merge_block_setup(spec, state)
     ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
 
-    def get_pow_block(block_hash):
-        if bytes(block_hash) == bytes(payload.parent_hash):
-            return spec.PowBlock(block_hash=block_hash,
-                                 parent_hash=b"\xbb" * 32,
-                                 total_difficulty=ttd)
-        return spec.PowBlock(block_hash=block_hash,
-                             parent_hash=b"\x00" * 32,
-                             total_difficulty=max(0, ttd - 1))
-
-    spec.get_pow_block = get_pow_block
-    try:
-        test_steps = []
-        tick_and_add_block(spec, store, signed_block, test_steps)
-        from consensus_specs_tpu.utils.ssz import hash_tree_root
-        assert hash_tree_root(signed_block.message) in store.blocks
-    finally:
-        del spec.get_pow_block  # restore the class-level stub
+    def pow_blocks(spec, payload):
+        return [
+            spec.PowBlock(block_hash=payload.parent_hash,
+                           parent_hash=b"\xbb" * 32,
+                           total_difficulty=ttd),
+            spec.PowBlock(block_hash=b"\xbb" * 32,
+                           parent_hash=b"\x00" * 32,
+                           total_difficulty=max(0, ttd - 1)),
+        ]
+    yield from _run_merge_block_case(spec, state, pow_blocks, True)
 
 
 @with_phases(["bellatrix"])
 @spec_state_test
 @never_bls
 def test_invalid_merge_block_pow_below_ttd(spec, state):
-    state, store, signed_block, payload = _merge_block_setup(spec, state)
+    """Terminal difficulty NOT reached by the payload's PoW parent."""
     ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
 
-    def get_pow_block(block_hash):
-        # terminal difficulty NOT reached
-        return spec.PowBlock(block_hash=block_hash,
-                             parent_hash=b"\xbb" * 32,
-                             total_difficulty=max(0, ttd - 1))
-
-    spec.get_pow_block = get_pow_block
-    try:
-        test_steps = []
-        tick_and_add_block(spec, store, signed_block, test_steps,
-                           valid=False)
-    finally:
-        del spec.get_pow_block
+    def pow_blocks(spec, payload):
+        return [
+            spec.PowBlock(block_hash=payload.parent_hash,
+                           parent_hash=b"\xbb" * 32,
+                           total_difficulty=max(0, ttd - 1)),
+            spec.PowBlock(block_hash=b"\xbb" * 32,
+                           parent_hash=b"\x00" * 32,
+                           total_difficulty=max(0, ttd - 2)),
+        ]
+    yield from _run_merge_block_case(spec, state, pow_blocks, False)
 
 
 @with_phases(["bellatrix"])
 @spec_state_test
 @never_bls
 def test_invalid_merge_block_missing_pow_parent(spec, state):
-    state, store, signed_block, payload = _merge_block_setup(spec, state)
+    """The PoW parent of the terminal block is unavailable."""
     ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
 
-    def get_pow_block(block_hash):
-        if bytes(block_hash) == bytes(payload.parent_hash):
-            return spec.PowBlock(block_hash=block_hash,
-                                 parent_hash=b"\xbb" * 32,
-                                 total_difficulty=ttd)
-        return None  # parent unavailable
-
-    spec.get_pow_block = get_pow_block
-    try:
-        test_steps = []
-        tick_and_add_block(spec, store, signed_block, test_steps,
-                           valid=False)
-    finally:
-        del spec.get_pow_block
+    def pow_blocks(spec, payload):
+        return [
+            spec.PowBlock(block_hash=payload.parent_hash,
+                           parent_hash=b"\xbb" * 32,
+                           total_difficulty=ttd),
+        ]
+    yield from _run_merge_block_case(spec, state, pow_blocks, False)

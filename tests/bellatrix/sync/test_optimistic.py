@@ -3,7 +3,7 @@
 Reference model: ``test/bellatrix/sync/test_optimistic.py``.
 """
 from consensus_specs_tpu.test_infra.context import (
-    spec_state_test, with_phases, never_bls,
+    spec_state_test, with_phases, never_bls, pytest_only, emit_part,
 )
 from consensus_specs_tpu.test_infra.block import (
     build_empty_block_for_next_slot, state_transition_and_sign_block,
@@ -25,6 +25,7 @@ def _chain(spec, state, n):
     return blocks
 
 
+@pytest_only
 @with_phases(EXECUTION_FORKS)
 @spec_state_test
 @never_bls
@@ -46,6 +47,7 @@ def test_optimistic_store_and_ancestor_walk(spec, state):
     assert spec.latest_verified_ancestor(opt_store, blocks[2]) == blocks[0]
 
 
+@pytest_only
 @with_phases(["bellatrix"])
 @spec_state_test
 @never_bls
@@ -74,3 +76,52 @@ def test_optimistic_candidate_rules(spec, state):
     assert spec.is_execution_block(exec_parent)
     assert spec.is_optimistic_candidate_block(
         opt_store, current_slot=child2.slot + 1, block=child2)
+
+
+@with_phases(EXECUTION_FORKS)
+@spec_state_test
+@never_bls
+def test_optimistic_import_then_payload_verdicts(spec, state):
+    """Event-log scenario for the ``sync`` vector format: a chain imported
+    optimistically, then engine verdicts — VALID on the middle block
+    verifies it and its ancestors; INVALIDATED on its child prunes the
+    whole descendant subtree."""
+    anchor_state = state.copy()
+    anchor_block = spec.BeaconBlock(state_root=hash_tree_root(anchor_state))
+    emit_part("anchor_state", anchor_state)
+    emit_part("anchor_block", anchor_block)
+    opt_store = spec.get_optimistic_store(anchor_state, anchor_block)
+
+    steps = []
+    blocks = _chain(spec, state, 4)
+    roots = [bytes(hash_tree_root(b)) for b in blocks]
+    for b, r in zip(blocks, roots):
+        name = "block_0x" + r.hex()
+        emit_part(name, b)
+        spec.import_optimistic_block(opt_store, b)
+        steps.append({"block": name, "payload_status": "SYNCING"})
+        assert spec.is_optimistic(opt_store, b)
+
+    # the engine validates block[1]: it and block[0] become verified
+    spec.on_payload_status(opt_store, roots[1], valid=True)
+    steps.append({"payload_status_update": "0x" + roots[1].hex(),
+                  "status": "VALID"})
+    assert not spec.is_optimistic(opt_store, blocks[0])
+    assert not spec.is_optimistic(opt_store, blocks[1])
+    assert spec.is_optimistic(opt_store, blocks[2])
+    assert spec.latest_verified_ancestor(opt_store, blocks[3]) == blocks[1]
+    steps.append({"checks": {
+        "optimistic_roots": ["0x" + r.hex() for r in roots[2:]],
+        "latest_verified_ancestor": "0x" + roots[1].hex()}})
+
+    # the engine invalidates block[2]: it and block[3] are pruned
+    spec.on_payload_status(opt_store, roots[2], valid=False)
+    steps.append({"payload_status_update": "0x" + roots[2].hex(),
+                  "status": "INVALIDATED"})
+    assert roots[2] not in opt_store.blocks
+    assert roots[3] not in opt_store.blocks
+    assert not opt_store.optimistic_roots
+    steps.append({"checks": {"optimistic_roots": [],
+                             "pruned": ["0x" + roots[2].hex(),
+                                        "0x" + roots[3].hex()]}})
+    yield "steps", steps

@@ -28,6 +28,7 @@ def test_on_block_no_commitments_is_available(spec, state):
     test_steps = []
     tick_and_add_block(spec, store, signed_block, test_steps)
     assert hash_tree_root(signed_block.message) in store.blocks
+    yield "steps", test_steps
 
 
 @with_phases(["deneb"])
@@ -52,6 +53,7 @@ def test_invalid_on_block_data_unavailable(spec, state):
         assert hash_tree_root(signed_block.message) not in store.blocks
     finally:
         del spec.retrieve_blobs_and_proofs
+    yield "steps", test_steps
 
 
 @with_phases(["deneb"])
@@ -71,3 +73,4 @@ def test_invalid_on_block_mismatched_blob_count(spec, state):
                            valid=False)
     finally:
         del spec.retrieve_blobs_and_proofs
+    yield "steps", test_steps

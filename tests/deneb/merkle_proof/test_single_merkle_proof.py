@@ -6,7 +6,7 @@ Reference model: ``test/deneb/merkle_proof/test_single_merkle_proof.py``
 with leaf / leaf_index / branch).
 """
 from consensus_specs_tpu.test_infra.context import (
-    spec_state_test, with_phases, never_bls,
+    spec_state_test, with_phases, never_bls, pytest_only,
 )
 from consensus_specs_tpu.utils.ssz import (
     hash_tree_root, get_generalized_index, get_generalized_index_length,
@@ -55,6 +55,7 @@ def test_blob_kzg_commitment_merkle_proof_max_blobs(spec, state):
     yield from _run_blob_commitment_proof(spec, body, n - 1)
 
 
+@pytest_only
 @with_phases(["deneb"])
 @spec_state_test
 @never_bls
@@ -69,6 +70,7 @@ def test_blob_kzg_commitment_proof_rejects_wrong_root(spec, state):
     yield
 
 
+@pytest_only
 @with_phases(["deneb"])
 @spec_state_test
 @never_bls

@@ -188,8 +188,7 @@ def test_on_attestation_future_epoch(spec, state):
     # attestation targets a future epoch relative to store time
     att = get_valid_attestation(spec, state, slot=block.slot, signed=False)
     att.data.target.epoch = spec.get_current_store_epoch(store) + 1
-    expect_assertion_error(
-        lambda: spec.on_attestation(store, att, is_from_block=False))
+    add_attestation(spec, store, att, test_steps, valid=False)
     yield "steps", test_steps
 
 

@@ -7,7 +7,7 @@ fork pair, emitted under the ``transition`` runner with the format
 fork_block index / blocks_count; parts: pre, blocks_<i>, post).
 """
 from consensus_specs_tpu.test_infra.context import (
-    ForkMeta, with_fork_metas, AFTER_FORK_PAIRS,
+    ForkMeta, with_fork_metas, AFTER_FORK_PAIRS, pytest_only,
 )
 from consensus_specs_tpu.test_infra.fork_transition import (
     transition_until_fork, state_transition_across_slots, do_fork,
@@ -80,6 +80,7 @@ def test_transition_preserves_registry(state, fork_epoch, spec, post_spec):
     yield from _finish(post_spec, fork_epoch, blocks, state)
 
 
+@pytest_only
 @with_fork_metas(_METAS)
 def test_transition_pre_spec_rejects_post_block(state, fork_epoch, spec,
                                                 post_spec):
