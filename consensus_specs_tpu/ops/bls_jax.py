@@ -162,19 +162,37 @@ FUSE_VERIFY = os.environ.get("CS_TPU_BLS_FUSE") == "1"
 # ---------------------------------------------------------------------------
 
 @jax.jit
-def _program_aggregate(pk_pts):
-    """(B, N) projective G1 pytree -> normalized (B,) aggregate + inf flag.
-
-    Compiles per (B, N) bucket; contains only point adds (cheap compile).
-    """
-    agg = PT.g1_normalize(jax.vmap(PT.g1_tree_sum)(pk_pts))
-    return agg, PT.g1_is_identity(agg)
+def _j_tree_sum(pk_pts):
+    """(B, N) projective G1 pytree -> (B,) unnormalized sum; one bounded
+    fori_loop program per (B, N) bucket."""
+    return PT.g1_tree_sum_batched(pk_pts)
 
 
 @jax.jit
+def _j_g1_normalize_flag(p):
+    agg = PT.g1_normalize(p)
+    return agg, PT.g1_is_identity(agg)
+
+
+def _program_aggregate(pk_pts):
+    """(B, N) projective G1 pytree -> normalized (B,) aggregate + inf
+    flag, as two bounded programs (sum, then normalize with its
+    inversion chain)."""
+    return _j_g1_normalize_flag(_j_tree_sum(pk_pts))
+
+
+@jax.jit
+def _program_g2_normalize(p):
+    return PT.g2_normalize(p)
+
+
 def _program_htc(u0, u1):
-    """hash_to_field outputs -> affine G2 points (B,)."""
-    return PT.g2_normalize(HTC.map_to_g2(u0, u1))
+    """hash_to_field outputs -> affine G2 points (B,).
+
+    Staged dispatch (sswu+iso twice, add+cofactor, normalize): the
+    monolithic module compiles pathologically slowly on XLA:CPU; the
+    stages are each bounded and individually cached."""
+    return _program_g2_normalize(HTC.map_to_g2_staged(u0, u1))
 
 
 @jax.jit
@@ -219,21 +237,27 @@ def _program_agg_verify_fused(pk_pts, u0, u1, sig_q, agg_degen, sig_degen):
 def _program_agg_verify(pk_pts, u0, u1, sig_q, agg_degen, sig_degen):
     """Batched FastAggregateVerify.
 
-    Staged mode runs three smaller device programs (fast compiles,
-    maximal cross-shape reuse — the pairing program only depends on the
-    batch size, not the per-aggregate pubkey count); fused mode compiles
-    the whole thing once and dispatches once.
+    Staged mode runs a pipeline of bounded device programs (fast
+    compiles on the 1-core host, maximal cross-shape reuse — only the
+    aggregation program depends on the per-aggregate pubkey count);
+    fused mode compiles the whole thing once and dispatches once (the
+    TPU toolchain handles the monolith; XLA:CPU's fusion pass does not).
     """
     if FUSE_VERIFY:
         return _program_agg_verify_fused(pk_pts, u0, u1, sig_q, agg_degen,
                                          sig_degen)
     agg, agg_inf = _program_aggregate(pk_pts)
     hpt = _program_htc(u0, u1)
-    return _agg_verify_body(
-        pk_pts, u0, u1, sig_q, agg_degen, sig_degen,
-        aggregate=lambda _: (agg, agg_inf),
-        htc=lambda *_: hpt,
-        pair=_program_multi_pair_verify)
+    # assemble (pairs=2, B, ...) inputs for the staged pairing pipeline
+    px = jnp.stack([agg[0], jnp.broadcast_to(_NEG_G1[0][0], agg[0].shape)])
+    py = jnp.stack([agg[1], jnp.broadcast_to(_NEG_G1[1][0], agg[1].shape)])
+    qx0 = jnp.stack([hpt[0][0], sig_q[0][0]])
+    qx1 = jnp.stack([hpt[0][1], sig_q[0][1]])
+    qy0 = jnp.stack([hpt[1][0], sig_q[1][0]])
+    qy1 = jnp.stack([hpt[1][1], sig_q[1][1]])
+    degen = jnp.stack([agg_degen | agg_inf, sig_degen])
+    return np.asarray(PR.staged_pairing_check(
+        px, py, ((qx0, qx1), (qy0, qy1)), degen))
 
 
 # ---------------------------------------------------------------------------
@@ -332,7 +356,7 @@ def aggregate_verify_batch(items) -> list:
 
         # hash all messages in one device call, scatter into (B, n-1) slots
         u0, u1 = HTC.hash_to_field_host(all_msgs)
-        hpts = PT.g2_normalize(HTC._map_to_g2_jit(u0, u1))
+        hpts = _program_g2_normalize(HTC._map_to_g2_jit(u0, u1))
         hx = ((hpts[0][0]).reshape(B, npair_pad - 1, 24),
               (hpts[0][1]).reshape(B, npair_pad - 1, 24))
         hy = ((hpts[1][0]).reshape(B, npair_pad - 1, 24),
@@ -351,8 +375,14 @@ def aggregate_verify_batch(items) -> list:
         inf_mask = np.array([[p.infinity for p in row] for row in g1_rows])
         degen = degen | jnp.asarray(inf_mask)
 
-        out = np.asarray(_program_multi_pair_verify(
-            px, py, qx0, qx1, qy0, qy1, degen))
+        if FUSE_VERIFY:
+            out = np.asarray(_program_multi_pair_verify(
+                px, py, qx0, qx1, qy0, qy1, degen))
+        else:
+            mv = lambda a: jnp.moveaxis(a, 0, 1)   # (B, n_pairs) -> (n_pairs, B)
+            out = np.asarray(PR.staged_pairing_check(
+                mv(px), mv(py),
+                ((mv(qx0), mv(qx1)), (mv(qy0), mv(qy1))), mv(degen)))
         for j, (idx, _, _, _) in enumerate(chunk):
             results_host[idx] = bool(out[j])
     return [bool(r) for r in results_host]
@@ -361,6 +391,20 @@ def aggregate_verify_batch(items) -> list:
 # ---------------------------------------------------------------------------
 # Scalar (reference-shaped) API
 # ---------------------------------------------------------------------------
+
+# Public staged-program surface (the sharded verify path in
+# consensus_specs_tpu.parallel builds on these):
+def normalize_flag_program(p):
+    return _j_g1_normalize_flag(p)
+
+
+def htc_program(u0, u1):
+    return _program_htc(u0, u1)
+
+
+def neg_g1_packed():
+    return _NEG_G1
+
 
 def FastAggregateVerify(pubkeys, message: bytes, signature: bytes) -> bool:
     return verify_aggregates_batch([(pubkeys, message, signature)])[0]

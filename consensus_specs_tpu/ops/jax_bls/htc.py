@@ -133,6 +133,76 @@ def map_to_g2(u0, u1):
     return clear_cofactor(p)
 
 
+# Staged pipeline: XLA:CPU's fusion pass goes superlinear with module
+# size (the monolithic map_to_g2 module does not compile in 30+ minutes
+# on a 1-core host while its pieces take ~1 minute each), so the batch
+# entry point dispatches a chain of bounded programs.  The double-run
+# program takes a TRACED trip count, so every segment of the cofactor
+# ladder reuses ONE compiled program.
+_sswu_jit = jax.jit(sswu_map)
+_iso_jit = jax.jit(iso_map)
+
+
+@jax.jit
+def _j_affine_add(x0, y0, x1, y1):
+    one = T.f2_one_like(x0)
+    return PT.g2_add((x0, y0, one), (x1, y1, one))
+
+
+@jax.jit
+def _j_g2_dbl_run(acc, n):
+    return jax.lax.fori_loop(
+        0, n, lambda _, a: PT.g2_dbl(a), acc)
+
+
+@jax.jit
+def _j_g2_add_point(a, b):
+    return PT.g2_add(a, b)
+
+
+@jax.jit
+def _j_neg_add(a, b):
+    """-(a + b)."""
+    return PT.g2_neg(PT.g2_add(a, b))
+
+
+@jax.jit
+def _j_cofactor_combine(mulx_r, r, p):
+    """[x]R - P + psi(R) + psi^2([2]P), given [|x|]R (x < 0 so
+    [x]R = -[|x|]R)."""
+    s = PT.g2_neg(mulx_r)
+    t3 = psi(psi(PT.g2_add(p, p)))
+    return PT.g2_add(PT.g2_add(s, PT.g2_neg(p)),
+                     PT.g2_add(psi(r), t3))
+
+
+# schedule over |x|'s bits after the leading one: (n_doublings, add_after)
+from consensus_specs_tpu.ops.jax_bls.pairing import bit_schedule
+_X_SCHEDULE = bit_schedule(_ABS_X_BITS[1:])
+
+
+def _staged_mul_abs_x(p):
+    """[|x|]P via the run/add programs (acc seeds at P for the lead bit)."""
+    acc = p
+    for n, with_add in _X_SCHEDULE:
+        acc = _j_g2_dbl_run(acc, n)
+        if with_add:
+            acc = _j_g2_add_point(acc, p)
+    return acc
+
+
+def _staged_clear_cofactor(p):
+    r = _j_neg_add(_staged_mul_abs_x(p), p)          # [x]P - P
+    return _j_cofactor_combine(_staged_mul_abs_x(r), r, p)
+
+
+def map_to_g2_staged(u0, u1):
+    """Same math as :func:`map_to_g2`, as a pipeline of bounded programs."""
+    x0, y0 = _iso_jit(*_sswu_jit(u0))
+    x1, y1 = _iso_jit(*_sswu_jit(u1))
+    return _staged_clear_cofactor(_j_affine_add(x0, y0, x1, y1))
+
+
 def hash_to_field_host(msgs, dst=_oracle.DST_G2) -> tuple:
     """Host-side: list of messages -> packed (u0, u1) Fq2 limb batches."""
     us = [_oracle.hash_to_field_fq2(bytes(m), 2, dst) for m in msgs]
@@ -148,4 +218,4 @@ def hash_to_g2_batch(msgs, dst=_oracle.DST_G2):
     return _map_to_g2_jit(u0, u1)
 
 
-_map_to_g2_jit = jax.jit(map_to_g2)
+_map_to_g2_jit = map_to_g2_staged

@@ -31,6 +31,8 @@ class _FqOps:
     mul = staticmethod(L.mont_mul)
     sqr = staticmethod(L.mont_sqr)
     mul_many = staticmethod(L.mont_mul_many)
+    add_many = staticmethod(L.add_mod_many)
+    sub_many = staticmethod(L.sub_mod_many)
     select = staticmethod(L.select)
     is_zero = staticmethod(L.is_zero)
     eq = staticmethod(L.eq)
@@ -51,6 +53,8 @@ class _Fq2Ops:
     mul = staticmethod(T.f2_mul)
     sqr = staticmethod(T.f2_sqr)
     mul_many = staticmethod(T.f2_mul_many)
+    add_many = staticmethod(T.f2_add_many)
+    sub_many = staticmethod(T.f2_sub_many)
     select = staticmethod(T.f2_select)
     is_zero = staticmethod(T.f2_is_zero)
     eq = staticmethod(T.f2_eq)
@@ -72,42 +76,49 @@ def _b3(f, like):
 def _complete_add(f, p, q):
     """RCB 2015 Algorithm 7 (complete addition, a = 0, projective).
 
-    Multiplications grouped into three batched waves (6 + 2 + 6).
+    Multiplications in three batched waves (6 + 2 + 6), and every group
+    of independent adds/subs in one batched wave too — XLA:CPU compile
+    cost is ~linear in the number of carry networks, so singles are the
+    enemy.
     """
     x1, y1, z1 = p
     x2, y2, z2 = q
     b3 = _b3(f, x1)
+    s = f.add_many([(x1, y1), (y1, z1), (x1, z1),
+                    (x2, y2), (y2, z2), (x2, z2)])
     t0, t1, t2, m1, m2, m3 = f.mul_many([
         (x1, x2), (y1, y2), (z1, z2),
-        (f.add(x1, y1), f.add(x2, y2)),
-        (f.add(y1, z1), f.add(y2, z2)),
-        (f.add(x1, z1), f.add(x2, z2))])
-    t3 = f.sub(m1, f.add(t0, t1))                      # x1y2 + x2y1
-    t4 = f.sub(m2, f.add(t1, t2))                      # y1z2 + y2z1
-    yp = f.sub(m3, f.add(t0, t2))                      # x1z2 + x2z1
-    x3 = f.add(f.add(t0, t0), t0)                      # 3 x1x2
+        (s[0], s[3]), (s[1], s[4]), (s[2], s[5])])
+    w = f.add_many([(t0, t1), (t1, t2), (t0, t2), (t0, t0)])
+    t3, t4, yp = f.sub_many([(m1, w[0]), (m2, w[1]), (m3, w[2])])
+    x3 = f.add_many([(w[3], t0)])[0]                   # 3 x1x2
     t2b, y3 = f.mul_many([(b3, t2), (b3, yp)])
-    z3 = f.add(t1, t2b)                                # y1y2 + 3b z1z2
-    t1b = f.sub(t1, t2b)                               # y1y2 - 3b z1z2
+    z3 = f.add_many([(t1, t2b)])[0]                    # y1y2 + 3b z1z2
+    t1b = f.sub_many([(t1, t2b)])[0]                   # y1y2 - 3b z1z2
     p1, p2, p3, p4, p5, p6 = f.mul_many([
         (t3, t1b), (t4, y3), (t1b, z3), (y3, x3), (z3, t4), (x3, t3)])
-    return (f.sub(p1, p2), f.add(p3, p4), f.add(p5, p6))
+    fin_a = f.add_many([(p3, p4), (p5, p6)])
+    return (f.sub_many([(p1, p2)])[0], fin_a[0], fin_a[1])
 
 
 def _complete_dbl(f, p):
     """RCB 2015 Algorithm 9 (exception-free doubling, a = 0, projective):
-    9 muls in three batched waves vs 12 for the general complete add.
+    9 muls in three batched waves vs 12 for the general complete add;
+    adds wave-batched like :func:`_complete_add`.
     The identity (and any y = 0 input) correctly lands on (0 : c : 0)."""
     X, Y, Z = p
     b3 = _b3(f, X)
     t0, t1, xy, zz = f.mul_many([(Y, Y), (Y, Z), (X, Y), (Z, Z)])
-    z3 = f.add(f.add(t0, t0), f.add(t0, t0))
-    z3 = f.add(z3, z3)                                 # 8Y^2
+    w1 = f.add_many([(t0, t0)])[0]
+    w2 = f.add_many([(w1, w1)])[0]
     t2 = f.mul_many([(b3, zz)])[0]                     # 3b Z^2
-    y3 = f.add(t0, t2)
-    t0 = f.sub(t0, f.add(f.add(t2, t2), t2))           # Y^2 - 9b Z^2
+    w3 = f.add_many([(w2, w2), (t0, t2), (t2, t2)])
+    z3, y3 = w3[0], w3[1]                              # z3 = 8Y^2
+    t2_3 = f.add_many([(w3[2], t2)])[0]                # 3 t2
+    t0 = f.sub_many([(t0, t2_3)])[0]                   # Y^2 - 9b Z^2
     m1, m2, m3, m4 = f.mul_many([(t2, z3), (t1, z3), (t0, y3), (t0, xy)])
-    return (f.add(m4, m4), f.add(m1, m3), m2)
+    fin = f.add_many([(m4, m4), (m1, m3)])
+    return (fin[0], fin[1], m2)
 
 
 def _identity_like(f, p):
@@ -202,6 +213,14 @@ def g1_add(p, q):
     return _complete_add(_FqOps, p, q)
 
 
+def g1_dbl(p):
+    return _complete_dbl(_FqOps, p)
+
+
+def g2_dbl(p):
+    return _complete_dbl(_Fq2Ops, p)
+
+
 def g2_add(p, q):
     return _complete_add(_Fq2Ops, p, q)
 
@@ -232,6 +251,36 @@ def g2_scalar_mul(p, bits):
 
 def g1_tree_sum(pts):
     return _tree_sum(_FqOps, pts)
+
+
+def g1_tree_sum_batched(pts):
+    """Sum over axis 1 of a (B, N, ...) packed batch, N a power of two.
+
+    Fixed-shape halving: every level is one full-width complete add of
+    the array against itself rolled by the (traced) stride, keeping only
+    the live prefix — so the whole reduction is ONE fori_loop program
+    with a ~13-mul body, not log2(N) differently-shaped adds.  (XLA:CPU
+    compile cost scales superlinearly with module size; this keeps the
+    aggregation program bounded for any N.)
+    """
+    f = _FqOps
+    n = jax.tree_util.tree_leaves(pts)[0].shape[1]
+    if n == 1:
+        return jax.tree_util.tree_map(lambda a: a[:, 0], pts)
+    assert n & (n - 1) == 0, "pad the aggregation axis to a power of two"
+    levels = n.bit_length() - 1
+    lane = jnp.arange(n, dtype=jnp.uint32)
+
+    def body(k, arr):
+        stride = jnp.uint32(n) >> (k + 1)
+        rolled = jax.tree_util.tree_map(
+            lambda a: jnp.roll(a, -stride.astype(jnp.int32), axis=1), arr)
+        summed = _complete_add(f, arr, rolled)
+        keep = (lane < stride)[None, :]
+        return _select(f, keep, summed, arr)
+
+    out = jax.lax.fori_loop(0, levels, body, pts)
+    return jax.tree_util.tree_map(lambda a: a[:, 0], out)
 
 
 def g2_tree_sum(pts):

@@ -340,14 +340,19 @@ def f12_sqr(x):
     """Complex squaring over Fq6: (a + bw)^2 with w^2 = v.
 
     c0 = (a + b)(a + vb) - ab - v*ab, c1 = 2ab — two Fq6 products instead
-    of f12_mul's three.
+    of f12_mul's three.  Pre/post adds are wave-batched (XLA:CPU compile
+    time is ~linear in the number of carry networks, so every group of
+    independent adds must ride one batched call).
     """
     a, b = x
     vb = f6_mul_by_v(b)
-    m0, m1 = f6_mul_many([(f6_add(a, b), f6_add(a, vb)), (a, b)])
-    c0 = f6_sub(f6_sub(m0, m1), f6_mul_by_v(m1))
-    c1 = f6_add(m1, m1)
-    return (c0, c1)
+    pre = f2_add_many(list(zip(a, b)) + list(zip(a, vb)))
+    m0, m1 = f6_mul_many([(tuple(pre[:3]), tuple(pre[3:])), (a, b)])
+    vm1 = f6_mul_by_v(m1)
+    d = f2_sub_many([(m0[i], m1[i]) for i in range(3)])
+    c0 = f2_sub_many([(d[i], vm1[i]) for i in range(3)])
+    c1 = f2_add_many([(m1[i], m1[i]) for i in range(3)])
+    return (tuple(c0), tuple(c1))
 
 
 def f12_cyclotomic_sqr(x):
@@ -357,27 +362,44 @@ def f12_cyclotomic_sqr(x):
 
     Coordinates (x0..x5) = (c0.c0, c0.c1, c0.c2, c1.c0, c1.c1, c1.c2);
     the three Fq4 sub-squarings pair them as (x0, x4), (x3, x2), (x1, x5)
-    with v the Fq4 non-residue and xi the Fq2 one.
+    with v the Fq4 non-residue and xi the Fq2 one.  All combination
+    adds/subs run as four batched waves.
     """
     (x0, x1, x2), (x3, x4, x5) = x
-    sq = f2_sqr_many([x0, x4, x3, x2, x1, x5,
-                      f2_add(x0, x4), f2_add(x3, x2), f2_add(x1, x5)])
+    pre = f2_add_many([(x0, x4), (x3, x2), (x1, x5)])
+    sq = f2_sqr_many([x0, x4, x3, x2, x1, x5] + pre)
     s0, s4, s3, s2, s1, s5, s04, s32, s15 = sq
-    # Fq4 squaring (a + b*t, t^2 = nr): A = a^2 + nr*b^2,
-    #   B = (a+b)^2 - a^2 - b^2 = 2ab
-    t0 = f2_add(s0, f2_mul_xi(s4))            # re of (x0 + x4 t)^2
-    t1 = f2_sub(s04, f2_add(s0, s4))          # 2 x0 x4
-    t2 = f2_add(s3, f2_mul_xi(s2))            # re of (x3 + x2 t)^2
-    t3 = f2_sub(s32, f2_add(s3, s2))          # 2 x3 x2
-    t4 = f2_add(s1, f2_mul_xi(s5))            # re of (x1 + x5 t)^2
-    t5 = f2_sub(s15, f2_add(s1, s5))          # 2 x1 x5
-    z0 = f2_add(f2_add(f2_sub(t0, x0), f2_sub(t0, x0)), t0)   # 3t0 - 2x0
-    z1 = f2_add(f2_add(f2_sub(t2, x1), f2_sub(t2, x1)), t2)   # 3t2 - 2x1
-    z2 = f2_add(f2_add(f2_sub(t4, x2), f2_sub(t4, x2)), t4)   # 3t4 - 2x2
-    xt5 = f2_mul_xi(t5)
-    z3 = f2_add(f2_add(f2_add(xt5, x3), f2_add(xt5, x3)), xt5)  # 3 xi t5 + 2x3
-    z4 = f2_add(f2_add(f2_add(t1, x4), f2_add(t1, x4)), t1)     # 3t1 + 2x4
-    z5 = f2_add(f2_add(f2_add(t3, x5), f2_add(t3, x5)), t3)     # 3t3 + 2x5
+    # wave A: xi multiples ride raw limb batches; pair sums for the 2ab
+    # terms.  xi(a+bu) = (a-b) + (a+b)u.
+    wa_add = L.add_mod_many([
+        (s4[0], s4[1]), (s2[0], s2[1]), (s5[0], s5[1]),   # xi(s4,s2,s5).im
+        (s0[0], s4[0]), (s3[0], s2[0]), (s1[0], s5[0]),   # (s+s').re
+        (s0[1], s4[1]), (s3[1], s2[1]), (s1[1], s5[1]),   # (s+s').im
+    ])
+    wa_sub = L.sub_mod_many([
+        (s4[0], s4[1]), (s2[0], s2[1]), (s5[0], s5[1]),   # xi(s4,s2,s5).re
+    ])
+    xi4 = (wa_sub[0], wa_add[0])
+    xi2 = (wa_sub[1], wa_add[1])
+    xi5 = (wa_sub[2], wa_add[2])
+    # wave B: t0/t2/t4 = s + xi(s'); t1/t3/t5 = s'' - (s + s')
+    tb_add = f2_add_many([(s0, xi4), (s3, xi2), (s1, xi5)])
+    t0, t2, t4 = tb_add
+    tb_sub = f2_sub_many([
+        (s04, (wa_add[3], wa_add[6])),
+        (s32, (wa_add[4], wa_add[7])),
+        (s15, (wa_add[5], wa_add[8]))])
+    t1, t3, t5 = tb_sub
+    # xi(t5) for z3
+    xt5 = (L.sub_mod_many([(t5[0], t5[1])])[0],
+           L.add_mod_many([(t5[0], t5[1])])[0])
+    # wave C: d = t -/+ x (z = 2d + t)
+    wc = f2_sub_many([(t0, x0), (t2, x1), (t4, x2)]) \
+        + f2_add_many([(xt5, x3), (t1, x4), (t3, x5)])
+    # wave D: z = (d + d) + t
+    dd = f2_add_many([(w, w) for w in wc])
+    fin = f2_add_many(list(zip(dd, [t0, t2, t4, xt5, t1, t3])))
+    z0, z1, z2, z3, z4, z5 = fin
     return ((z0, z1, z2), (z3, z4, z5))
 
 

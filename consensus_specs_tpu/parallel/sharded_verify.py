@@ -5,10 +5,17 @@ attestations) x ``agg`` (the pubkey-aggregation tree of each
 verification).  Each shard tree-sums its local pubkey slice; partials
 ``all_gather`` across the ``agg`` axis and combine on-device (complete
 point addition is not a ``psum``-able monoid over raw limb vectors, so
-the collective carries partial sums); the pairing check runs
-data-parallel.  Scales to multi-host the way the reference's Rust FFI
-loop cannot: the same program spans ICI within a slice and DCN across
-slices purely through the mesh.
+the collective carries partial sums); the hash-to-curve and pairing
+stages then run data-parallel.  Scales to multi-host the way the
+reference's Rust FFI loop cannot: the same program spans ICI within a
+slice and DCN across slices purely through the mesh.
+
+Structure: ONLY the collective aggregation is a ``shard_map`` program;
+everything downstream reuses the bounded staged programs from
+``ops.bls_jax`` / ``ops.jax_bls.pairing`` — GSPMD propagates the data
+sharding through them.  (A monolithic sharded module is exactly the
+shape XLA:CPU's fusion pass cannot compile on the 1-core dryrun host —
+the round-1/round-2 dryrun timeouts.)
 
 ``__graft_entry__.dryrun_multichip`` and ``tests/test_multichip.py``
 exercise this on the 8-device virtual CPU mesh.
@@ -36,26 +43,35 @@ def make_sharded_agg_verify(mesh):
     """
     from jax.sharding import PartitionSpec as P
     from jax.experimental.shard_map import shard_map
-    from consensus_specs_tpu.ops.jax_bls import points as PT, htc as HTC
+    from consensus_specs_tpu.ops.jax_bls import points as PT
     from consensus_specs_tpu.ops.jax_bls import pairing as PR
-    from consensus_specs_tpu.ops.bls12_381.curve import G1_GENERATOR
+    from consensus_specs_tpu.ops import bls_jax
 
     agg_size = mesh.shape["agg"]
 
-    def local_step(pk_pts, u0, u1, sig_q, agg_degen, sig_degen):
+    def local_agg(pk_pts):
         # per-shard partial aggregation over the local pubkey slice
-        part = jax.vmap(PT.g1_tree_sum)(pk_pts)
+        part = PT.g1_tree_sum_batched(pk_pts)
         # gather partials across 'agg' and combine on every device
         gathered = jax.tree_util.tree_map(
             lambda a: jax.lax.all_gather(a, "agg"), part)
         total = jax.tree_util.tree_map(lambda a: a[0], gathered)
         for i in range(1, agg_size):
             total = PT.g1_add(
-                total, jax.tree_util.tree_map(lambda a: a[i], gathered))
-        aggp = PT.g1_normalize(total)
-        agg_inf = PT.g1_is_identity(aggp)
-        hpt = PT.g2_normalize(HTC.map_to_g2(u0, u1))
-        neg_g = PT.g1_pack([-G1_GENERATOR])
+                total,
+                jax.tree_util.tree_map(lambda a, i=i: a[i], gathered))
+        return total
+
+    pk_spec = P("data", "agg")
+    sharded_agg = jax.jit(shard_map(
+        local_agg, mesh=mesh, in_specs=((pk_spec,) * 3,),
+        out_specs=P("data"), check_rep=False))
+
+    def step(pk_pts, u0, u1, sig_q, agg_degen, sig_degen):
+        total = sharded_agg(pk_pts)
+        aggp, agg_inf = bls_jax.normalize_flag_program(total)
+        hpt = bls_jax.htc_program(u0, u1)
+        neg_g = bls_jax.neg_g1_packed()
         b = aggp[0].shape[:-1]
         px = jnp.stack([aggp[0], jnp.broadcast_to(neg_g[0][0], b + (24,))])
         py = jnp.stack([aggp[1], jnp.broadcast_to(neg_g[1][0], b + (24,))])
@@ -64,21 +80,6 @@ def make_sharded_agg_verify(mesh):
         qy = (jnp.stack([hpt[1][0], sig_q[1][0]]),
               jnp.stack([hpt[1][1], sig_q[1][1]]))
         degen = jnp.stack([agg_degen | agg_inf, sig_degen])
+        return PR.staged_pairing_check(px, py, (qx, qy), degen)
 
-        def one(px, py, qx0, qx1, qy0, qy1, dg):
-            return PR.pairing_check(px, py, ((qx0, qx1), (qy0, qy1)), dg)
-
-        return jax.vmap(one, in_axes=(1, 1, 1, 1, 1, 1, 1))(
-            px, py, qx[0], qx[1], qy[0], qy[1], degen)
-
-    pk_spec = P("data", "agg")
-    in_specs = (
-        (pk_spec,) * 3,           # projective pytree: (x, y, z) leaves
-        (P("data"),) * 2,         # u0 (two Fq2 limb arrays)
-        (P("data"),) * 2,         # u1
-        (((P("data"),) * 2,) * 2),  # sig_q: ((xa, xb), (ya, yb))
-        P("data"), P("data"),
-    )
-    return jax.jit(shard_map(
-        local_step, mesh=mesh, in_specs=in_specs, out_specs=P("data"),
-        check_rep=False))
+    return step

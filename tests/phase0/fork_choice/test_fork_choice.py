@@ -18,7 +18,6 @@ from consensus_specs_tpu.test_infra.fork_choice import (
     tick_and_add_block, add_attestation, get_genesis_forkchoice_store,
     apply_next_epoch_with_attestations,
 )
-from consensus_specs_tpu.test_infra.context import expect_assertion_error
 from consensus_specs_tpu.utils.ssz import hash_tree_root
 
 
