@@ -1,7 +1,7 @@
 """Benchmark: batched FastAggregateVerify throughput (BASELINE config #1).
 
 Measures aggregate-signature verifications/second with the JAX backend
-(batch of 16 verifications x 64 pubkeys each, minimal-preset committee
+(batch of 32 verifications x 64 pubkeys each, minimal-preset committee
 shape) against the pure-python oracle (the reference's py_ecc role,
 ``BASELINE.md`` metric: ">=50x py_ecc").  Prints ONE JSON line.
 """
@@ -24,7 +24,7 @@ def main():
     from consensus_specs_tpu.ops import bls_jax
 
     bls.use_py()
-    n_keys, batch = 64, 16
+    n_keys, batch = 64, 32
     msg = b"bench-attestation-root"
     sks = list(range(1, 1 + n_keys))
     pks = [bls.SkToPk(sk) for sk in sks]
@@ -53,7 +53,7 @@ def main():
     vs = per_sec * py_per_verify  # speedup over one-at-a-time py oracle
 
     print(json.dumps({
-        "metric": "FastAggregateVerify (64 pubkeys, batch 16)",
+        "metric": "FastAggregateVerify (64 pubkeys, batch 32)",
         "value": round(per_sec, 3),
         "unit": "aggverify/s",
         "vs_baseline": round(vs, 2),

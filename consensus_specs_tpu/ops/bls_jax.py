@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from consensus_specs_tpu.ops.bls12_381 import ciphersuite as _oracle
+from consensus_specs_tpu.utils.profiling import span
 from consensus_specs_tpu.ops.bls12_381.curve import (
     G1Point, G2Point, G1_GENERATOR, g1_from_compressed, g2_from_compressed)
 from consensus_specs_tpu.ops.jax_bls import points as PT
@@ -151,10 +152,26 @@ _BUCKET_B = None
 # compile, so power-of-two buckets with a floor are fine).
 _N_MIN = 8
 # Fuse aggregate+hash-to-curve+pairing into ONE compiled program (single
-# dispatch, cross-stage XLA fusion).  Opt-in via CS_TPU_BLS_FUSE=1; the
-# staged pipeline stays the default (smaller compiles, maximal
-# cross-shape reuse).
-FUSE_VERIFY = os.environ.get("CS_TPU_BLS_FUSE") == "1"
+# dispatch, cross-stage XLA fusion) vs the staged pipeline of bounded
+# programs.  Default is backend-dependent: an accelerator (tunnel-backed
+# TPU) wants one dispatch — per-stage round trips are latency-bound and
+# its compiler handles the monolith; XLA:CPU cannot compile the monolith
+# on this 1-core host, so tests/dryrun run staged.  Override with
+# CS_TPU_BLS_FUSE=1/0.
+def fuse_verify() -> bool:
+    global _FUSE_VERIFY
+    if _FUSE_VERIFY is None:
+        if "CS_TPU_BLS_FUSE" in os.environ:
+            _FUSE_VERIFY = os.environ["CS_TPU_BLS_FUSE"] == "1"
+        else:
+            try:
+                _FUSE_VERIFY = jax.default_backend() != "cpu"
+            except Exception:
+                _FUSE_VERIFY = False
+    return _FUSE_VERIFY
+
+
+_FUSE_VERIFY = None
 
 
 # ---------------------------------------------------------------------------
@@ -243,7 +260,7 @@ def _program_agg_verify(pk_pts, u0, u1, sig_q, agg_degen, sig_degen):
     fused mode compiles the whole thing once and dispatches once (the
     TPU toolchain handles the monolith; XLA:CPU's fusion pass does not).
     """
-    if FUSE_VERIFY:
+    if fuse_verify():
         return _program_agg_verify_fused(pk_pts, u0, u1, sig_q, agg_degen,
                                          sig_degen)
     agg, agg_inf = _program_aggregate(pk_pts)
@@ -272,6 +289,11 @@ def verify_aggregates_batch(items) -> list:
     """
     if not items:
         return []
+    with span("bls.verify_aggregates_batch"):
+        return _verify_aggregates_batch(items)
+
+
+def _verify_aggregates_batch(items) -> list:
     results_host = [None] * len(items)
     rows = []
     for idx, (pubkeys, msg, sig) in enumerate(items):
@@ -375,7 +397,7 @@ def aggregate_verify_batch(items) -> list:
         inf_mask = np.array([[p.infinity for p in row] for row in g1_rows])
         degen = degen | jnp.asarray(inf_mask)
 
-        if FUSE_VERIFY:
+        if fuse_verify():
             out = np.asarray(_program_multi_pair_verify(
                 px, py, qx0, qx1, qy0, qy1, degen))
         else:
