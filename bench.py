@@ -1,64 +1,131 @@
 """Benchmark: batched FastAggregateVerify throughput (BASELINE config #1).
 
-Measures aggregate-signature verifications/second with the JAX backend
-(batch of 32 verifications x 64 pubkeys each, minimal-preset committee
-shape) against the pure-python oracle (the reference's py_ecc role,
-``BASELINE.md`` metric: ">=50x py_ecc").  Prints ONE JSON line.
+Measures aggregate-signature verifications/second with the fastest
+available backend (JAX/TPU when the accelerator answers, JAX on host CPU
+otherwise) against the pure-python oracle (the reference's py_ecc role,
+``BASELINE.md``: ">=50x py_ecc" north star; backend ladder being replaced:
+reference ``eth2spec/utils/bls.py:35-53``).
+
+Prints exactly ONE JSON line on stdout, ALWAYS, inside a wall-clock
+budget (``CS_TPU_BENCH_BUDGET`` seconds, default 480): a watchdog thread
+emits whatever has been measured so far (``"partial": true``) and exits
+the process if the full pipeline doesn't fit - a cold XLA compile on a
+slow host must never turn the benchmark artifact into an rc=124 null
+(the round-1..3 failure mode).
 """
 import json
 import os
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from consensus_specs_tpu.utils.jax_env import (  # noqa: E402
-    setup_compile_cache, ensure_working_backend)
-setup_compile_cache()
-# The bench must always print its line: if the accelerator tunnel is down
-# (backend init hangs), measure on host CPU instead of hanging forever.
-ensure_working_backend()
+
+BUDGET = float(os.environ.get("CS_TPU_BENCH_BUDGET", "480"))
+_T0 = time.time()
+
+
+def _remaining() -> float:
+    return BUDGET - (time.time() - _T0)
+
+
+# Shared mutable result; the watchdog prints it if time runs out.
+_RESULT = {
+    "metric": "FastAggregateVerify (64 pubkeys, batch)",
+    "value": 0.0,
+    "unit": "aggverify/s",
+    "vs_baseline": 0.0,
+    "partial": True,
+    "stage": "init",
+    "platform": "unknown",
+}
+_EMITTED = threading.Lock()
+
+
+def _emit_and_exit(code=0):
+    if _EMITTED.acquire(blocking=False):
+        out = dict(_RESULT)
+        out["elapsed_s"] = round(time.time() - _T0, 1)
+        print(json.dumps(out), flush=True)
+        os._exit(code)
+
+
+def _watchdog():
+    # wake early enough to flush; os._exit skips atexit/XLA teardown, which
+    # is exactly right when a compile is wedged in C++ with the GIL held.
+    delay = max(1.0, _remaining() - 2.0)
+    time.sleep(delay)
+    _RESULT["stage"] += " (budget expired)"
+    _emit_and_exit(0)
 
 
 def main():
+    threading.Thread(target=_watchdog, daemon=True).start()
+
+    from consensus_specs_tpu.utils.jax_env import (
+        setup_compile_cache, ensure_working_backend)
+    setup_compile_cache()
+    # If the accelerator tunnel is down, backend init hangs forever; probe
+    # it in a subprocess and fall back to host CPU.
+    probe_budget = int(min(90, max(10, _remaining() / 4)))
+    ensure_working_backend(timeout=probe_budget)
+    import jax
+    _RESULT["platform"] = jax.default_backend()
+    _RESULT["stage"] = "backend-ready"
+
     from consensus_specs_tpu.utils import bls
     from consensus_specs_tpu.ops import bls_jax
 
     bls.use_py()
-    n_keys, batch = 64, 32
+    n_keys = 64
     msg = b"bench-attestation-root"
     sks = list(range(1, 1 + n_keys))
     pks = [bls.SkToPk(sk) for sk in sks]
     agg = bls.Aggregate([bls.Sign(sk, msg) for sk in sks])
 
-    # python-oracle baseline: warmed (decompression caches populated),
-    # then the median-ish of repeated runs
+    # --- python-oracle baseline: warmed (decompression caches populated),
+    # then median of repeated runs ---------------------------------------
     assert bls.FastAggregateVerify(pks, msg, agg)
     py_times = []
     for _ in range(3):
         t0 = time.time()
         bls.FastAggregateVerify(pks, msg, agg)
         py_times.append(time.time() - t0)
-    py_per_verify = sorted(py_times)[1]
+        if _remaining() < BUDGET * 0.5:
+            break
+    py_per_verify = sorted(py_times)[len(py_times) // 2]
+    _RESULT["py_oracle_s_per_verify"] = round(py_per_verify, 3)
+    _RESULT["stage"] = "oracle-measured"
 
+    # --- JAX backend: warm (compile) then measure steady-state ----------
+    batch = bls_jax.bucket_b()
+    _RESULT["metric"] = f"FastAggregateVerify (64 pubkeys, batch {batch})"
     items = [(pks, msg, agg)] * batch
-    # warm-up: compile + first dispatch
-    out = bls_jax.verify_aggregates_batch(items)
-    assert all(out), "bench verification must pass"
     t0 = time.time()
-    reps = 3
-    for _ in range(reps):
-        out = bls_jax.verify_aggregates_batch(items)
-    dt = (time.time() - t0) / reps
-    per_sec = batch / dt
-    vs = per_sec * py_per_verify  # speedup over one-at-a-time py oracle
-
-    print(json.dumps({
-        "metric": "FastAggregateVerify (64 pubkeys, batch 32)",
-        "value": round(per_sec, 3),
-        "unit": "aggverify/s",
-        "vs_baseline": round(vs, 2),
-    }))
+    out = bls_jax.verify_aggregates_batch(items)   # compile + first dispatch
+    warm_s = time.time() - t0
+    assert all(out), "bench verification must pass"
+    _RESULT["stage"] = "jax-warm"
+    _RESULT["jax_warm_s"] = round(warm_s, 1)
+    # First measurement immediately (so even one rep beats an empty line),
+    # then refine with more reps while budget remains.
+    reps_done, t_acc = 0, 0.0
+    while reps_done < 5 and (reps_done == 0 or _remaining() > t_acc / reps_done + 5):
+        t0 = time.time()
+        bls_jax.verify_aggregates_batch(items)
+        t_acc += time.time() - t0
+        reps_done += 1
+        per_sec = batch / (t_acc / reps_done)
+        _RESULT["value"] = round(per_sec, 3)
+        _RESULT["vs_baseline"] = round(per_sec * py_per_verify, 2)
+        _RESULT["stage"] = f"jax-measured-{reps_done}"
+    _RESULT["partial"] = False
+    _emit_and_exit(0)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # emit whatever we had, plus the error
+        _RESULT["error"] = f"{type(e).__name__}: {e}"[:300]
+        _emit_and_exit(0)

@@ -1,0 +1,79 @@
+"""BASELINE config #5: multi-slot replay wall-clock at large registries.
+
+Measures ``process_slots(state, state.slot + 32)`` (a full epoch of slot
+processing: per-slot state-root snapshots, i.e. the merkleization-bound
+path) at 10k / 100k / 1M validators, exercising the dirty-subtree root
+caching in ``utils/ssz`` (remerkleable's role; reference
+``setup.py:549``).  Pubkeys are synthetic — signature checks are off in
+this config; the workload is hashing, not crypto.
+
+Prints one JSON line per registry size.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu.forks import build_spec
+from consensus_specs_tpu.utils import bls
+
+
+def build_state(spec, n):
+    state = spec.BeaconState(
+        genesis_time=0,
+        fork=spec.Fork(
+            previous_version=spec.config.GENESIS_FORK_VERSION,
+            current_version=spec.config.GENESIS_FORK_VERSION,
+            epoch=0),
+        latest_block_header=spec.BeaconBlockHeader(
+            body_root=spec.hash_tree_root(spec.BeaconBlockBody())),
+    )
+    v = spec.Validator(
+        effective_balance=spec.MAX_EFFECTIVE_BALANCE,
+        activation_eligibility_epoch=0, activation_epoch=0,
+        exit_epoch=spec.FAR_FUTURE_EPOCH,
+        withdrawable_epoch=spec.FAR_FUTURE_EPOCH,
+        withdrawal_credentials=b"\x00" * 32)
+    for i in range(n):
+        v.pubkey = i.to_bytes(8, "little") * 6       # unique synthetic key
+        state.validators.append(v)
+        state.balances.append(spec.MAX_EFFECTIVE_BALANCE)
+    return state
+
+
+def main():
+    bls.bls_active = False
+    # mainnet preset: SLOTS_PER_EPOCH=32, so slots 1..31 isolate the
+    # merkleization-bound per-slot path and the boundary crossing at 32
+    # isolates the (python-loop-bound) epoch transition.
+    spec = build_spec("phase0", "mainnet")
+    sizes = [int(s) for s in (sys.argv[1:] or ["10000", "100000", "1000000"])]
+    for n in sizes:
+        t0 = time.time()
+        state = build_state(spec, n)
+        build_s = time.time() - t0
+        t0 = time.time()
+        state.hash_tree_root()
+        first_root_s = time.time() - t0
+        state.slot = 1
+        n_slots = 30
+        t0 = time.time()
+        spec.process_slots(state, state.slot + n_slots)   # stays in-epoch
+        slots_s = time.time() - t0
+        t0 = time.time()
+        spec.process_slots(state, state.slot + 1)         # crosses boundary
+        epoch_s = time.time() - t0
+        print(json.dumps({
+            "metric": f"32-slot replay, {n} validators",
+            "value": round(slots_s + epoch_s, 3), "unit": "s",
+            "build_s": round(build_s, 1),
+            "first_full_root_s": round(first_root_s, 2),
+            "per_slot_ms": round(slots_s / n_slots * 1000, 1),
+            "epoch_transition_s": round(epoch_s, 2),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -29,7 +29,7 @@ def _import_all():
     import importlib.util
     from . import phase0  # noqa: F401
     for mod in ("altair", "bellatrix", "capella", "deneb",
-                "eip6110", "eip7002", "whisk"):
+                "eip6110", "eip7002", "eip7594", "whisk"):
         # Probe existence first so a real import error inside an existing
         # fork module propagates instead of silently dropping the fork
         # (and silently skipping its whole test suite).
@@ -57,3 +57,30 @@ def build_spec(fork: str, preset_name: str, config_overrides: Optional[dict] = N
         spec = registry[fork](preset, config, preset_name=preset_name)
         _spec_cache[key] = spec
     return spec
+
+
+def use_compiled_registry():
+    """Swap the phase0..deneb registry entries for the markdown-COMPILED
+    ladder (``make pyspec`` output, ``compiler/emit.py``), so the same
+    conformance suite that exercises the hand-written classes runs
+    against the classes built from ``specs/*/beacon-chain.md`` — pytest
+    session flag ``--compiled`` (reference analog: the reference suite
+    only ever runs the markdown-built pyspec).
+
+    Always recompiles from the markdown first (a couple of seconds of
+    pure python) so a green ``--compiled`` run certifies the CURRENT
+    spec text, never a stale or half-written generated tree.  Feature
+    forks (eip6110/eip7002/eip7594/whisk) keep their hand-written
+    classes — they extend the hand-written ladder, and their markdown
+    (``specs/_features/``) is documentation-first.
+    """
+    import importlib
+    fork_registry()  # populate before overriding
+    from consensus_specs_tpu.compiler.emit import main as _compile_all
+    _compile_all()
+    importlib.invalidate_caches()  # compiled/ may have just been created
+    for fork in ("phase0", "altair", "bellatrix", "capella", "deneb"):
+        mod = importlib.import_module(f"{__name__}.compiled.{fork}")
+        importlib.reload(mod)
+        _REGISTRY[fork] = getattr(mod, f"Compiled{fork.capitalize()}Spec")
+    _spec_cache.clear()

@@ -1,0 +1,100 @@
+"""EIP-7594 (PeerDAS) feature fork: data-availability sampling.
+
+Behavioral source: ``specs/_features/eip7594/fork.md`` (fork version
+ladder :40-56, ``upgrade_to_eip7594`` :70-125) and
+``specs/_features/eip7594/polynomial-commitments-sampling.md`` — the
+sampling math itself (cells, multiproofs, erasure recovery) lives in
+``consensus_specs_tpu/ops/kzg_7594.py`` and is differential-tested by
+``tests/test_kzg_7594*``.  Fork DAG parent: deneb.
+
+The state layout is UNCHANGED from deneb (7594 is a data-availability
+fork, not a state fork): the upgrade only rotates ``state.fork``.  What
+changes is how availability is established — ``is_data_available``
+samples extended-blob cells instead of downloading full blobs, so a
+node custodies/examines only a fraction of each blob column.
+"""
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+from . import register_fork
+from .deneb import DenebSpec
+from consensus_specs_tpu.ops import kzg_7594 as K7
+
+
+@register_fork("eip7594")
+class EIP7594Spec(DenebSpec):
+    fork = "eip7594"
+    previous_fork = "deneb"
+
+    # polynomial-commitments-sampling.md: cells per extended blob
+    FIELD_ELEMENTS_PER_CELL = K7.FIELD_ELEMENTS_PER_CELL
+
+    # -- sampling surface (polynomial-commitments-sampling.md) -------------
+
+    def compute_cells(self, blob):
+        return K7.compute_cells(bytes(blob), self.kzg_setup)
+
+    def compute_cells_and_proofs(self, blob):
+        return K7.compute_cells_and_proofs(bytes(blob), self.kzg_setup)
+
+    def verify_cell_proof(self, commitment, cell_id, cell, proof):
+        return K7.verify_cell_proof(bytes(commitment), int(cell_id),
+                                    bytes(cell), bytes(proof),
+                                    self.kzg_setup)
+
+    def verify_cell_proof_batch(self, row_commitments, row_ids, column_ids,
+                                cells, proofs):
+        return K7.verify_cell_proof_batch(
+            [bytes(c) for c in row_commitments],
+            [int(r) for r in row_ids], [int(c) for c in column_ids],
+            [bytes(c) for c in cells], [bytes(p) for p in proofs],
+            self.kzg_setup)
+
+    def recover_polynomial(self, cell_ids, cells_bytes):
+        return K7.recover_polynomial([int(c) for c in cell_ids],
+                                     [bytes(c) for c in cells_bytes],
+                                     self.kzg_setup)
+
+    # -- availability via sampling (replaces deneb full-blob checking) -----
+
+    def is_data_available(self, beacon_block_root, blob_kzg_commitments):
+        """Sampling-based availability: verify the retrieved cells of
+        each committed blob against their multiproofs.
+
+        ``retrieve_blobs_and_proofs`` remains the retrieval stub the
+        harness monkeypatches (deneb fork-choice.md:70 pattern); a cell
+        retrieval stub (``retrieve_cells_and_proofs``) takes precedence
+        when the harness provides one.
+        """
+        retrieve = getattr(self, "retrieve_cells_and_proofs", None)
+        if retrieve is None:
+            # fall back to deneb full-blob verification
+            return super().is_data_available(beacon_block_root,
+                                             blob_kzg_commitments)
+        sampled = retrieve(beacon_block_root)
+        for commitment, (cell_ids, cells, proofs) in zip(
+                blob_kzg_commitments, sampled):
+            if not self.verify_cell_proof_batch(
+                    [commitment], [0] * len(cell_ids), cell_ids,
+                    cells, proofs):
+                return False
+        return True
+
+    # -- fork ladder / upgrade (fork.md) -----------------------------------
+
+    def compute_fork_version(self, epoch):
+        cfg = self.config
+        e7594 = getattr(cfg, "EIP7594_FORK_EPOCH", None)
+        if e7594 is not None and epoch >= e7594:
+            return cfg.EIP7594_FORK_VERSION
+        return super().compute_fork_version(epoch)
+
+    def upgrade_to_eip7594(self, pre):
+        """State upgrade at EIP7594_FORK_EPOCH: identical layout, new
+        fork version (fork.md:70 - 7594 'does not need a hard fork'
+        beyond the version rotation)."""
+        post = self.BeaconState.decode_bytes(pre.serialize())
+        post.fork = self.Fork(
+            previous_version=pre.fork.current_version,
+            current_version=self.config.EIP7594_FORK_VERSION,
+            epoch=self.get_current_epoch(pre),
+        )
+        return post

@@ -3,14 +3,16 @@
 TPUs have no native 64-bit integer multiply, so a 381-bit field element is
 held as 24 x 16-bit limbs in ``uint32`` lanes (little-endian limb order,
 shape ``(..., 24)``).  A limb product is exact in uint32
-(``(2^16-1)^2 < 2^32``); products are split into lo/hi halves so column
-accumulations stay below ``48 * 2^16 < 2^22`` and never overflow.
+(``(2^16-1)^2 < 2^32``); products split into 16-bit lo/hi halves, each
+exact in f32, and the 48-term antidiagonal column sums stay below
+``48 * (2^16-1) < 2^22`` - exact in f32 accumulation (< 2^24) and far
+from uint32 overflow in the carry chain.
 
-Multiplication = one batched outer product, antidiagonal column sums via a
-single static gather, and a 48-step ``lax.scan`` carry chain - about 25 HLO
-ops per Montgomery multiply, so the big consumers (Miller loop, final
-exponentiation, SSWU) compile to compact XLA programs.  Everything carries
-arbitrary leading batch dims; the batch axis is the TPU vector axis.
+Multiplication = one batched uint32 outer product, column sums as ONE f32
+matmul against a constant 0/1 scatter matrix (``_product_columns`` - the
+MXU on TPU, a library sgemm on CPU), then a Kogge-Stone carry-lookahead
+network over the carried limbs.  Everything carries arbitrary leading
+batch dims; the batch axis is the TPU vector axis.
 
 All elements are kept in Montgomery form (R = 2^384) between byte
 boundaries.  This module replaces the role of the reference's Rust field
@@ -100,30 +102,49 @@ def _kogge_stone(g, p, n):
 _NCOL = 2 * NLIMB
 
 
+def _make_scatter_matrix() -> np.ndarray:
+    """(2*24*24, 48) f32 0/1 matrix routing outer-product halves to their
+    columns: lo[i, j] -> col i+j, hi[i, j] -> col i+j+1 (max index
+    23+23+1 = 47, so every term lands inside the 48 columns)."""
+    S = np.zeros((2, NLIMB, NLIMB, _NCOL), np.float32)
+    for i in range(NLIMB):
+        for j in range(NLIMB):
+            S[0, i, j, i + j] = 1.0
+            S[1, i, j, i + j + 1] = 1.0
+    return S.reshape(2 * NLIMB * NLIMB, _NCOL)
+
+
+_SCATTER = _make_scatter_matrix()
+
+
 def _product_columns(a, b):
     """(...,24) x (...,24) -> (...,48) antidiagonal column sums (< 2^22).
 
-    col[k] = sum_i lo[i, k-i] + sum_i hi[i, k-1-i], realized as one
-    statically-padded stack + reduction: row i of the lo (hi)
-    half-product lands at column offset i (i+1).  Formulation note (the
-    three candidates were measured on XLA:CPU): take_along_axis gathers
-    explode compile time on wide stacked muls; an integer dot_general
-    against a constant scatter matrix has no CPU library kernel and
-    unrolls to ~55k LLVM instructions per multiply (minutes per module);
-    the pad/stack form compiles fastest everywhere and vectorizes
-    cleanly on the TPU VPU.
+    col[k] = sum_i lo[i, k-i] + sum_i hi[i, k-1-i], realized as ONE f32
+    matmul against a constant 0/1 scatter matrix: the uint32 outer
+    product splits into exact 16-bit halves, each half casts exactly to
+    f32, and the 48-term column sums stay < 2^22 so the f32 accumulation
+    is exact too (forced to HIGHEST precision so the TPU MXU path does
+    full-f32 passes, keeping integer exactness).
+
+    Formulation note, measured on XLA:CPU (the 1-core dryrun host):
+    take_along_axis gathers explode compile time; an INTEGER dot_general
+    has no CPU library kernel and unrolls to ~55k LLVM instructions; a
+    statically-padded stack + reduction compiles fine alone but fuses
+    superlinearly into each consumer carry chain (~12 s compile PER
+    MONT_MUL, the round-1..3 bench/dryrun timeout root cause).  The f32
+    matmul hits Eigen's sgemm on CPU / the MXU on TPU - an opaque
+    library call XLA cannot fuse into - so a mont_mul compiles in ~1 s
+    and runs 8-17x faster than the stacked-pad form on wide batches.
     """
     prods = a[..., :, None] * b[..., None, :]            # exact in uint32
-    lo = prods & MASK
-    hi = prods >> LIMB_BITS
-    nb = prods.ndim - 2                                  # batch dims
-    terms = []
-    for i in range(NLIMB):
-        terms.append(jnp.pad(lo[..., i, :],
-                             [(0, 0)] * nb + [(i, NLIMB - i)]))
-        terms.append(jnp.pad(hi[..., i, :],
-                             [(0, 0)] * nb + [(i + 1, NLIMB - i - 1)]))
-    return jnp.sum(jnp.stack(terms), axis=0)
+    lo = (prods & MASK).astype(jnp.float32)
+    hi = (prods >> LIMB_BITS).astype(jnp.float32)
+    stacked = jnp.concatenate([lo, hi], axis=-2)         # (..., 48, 24)
+    flat = stacked.reshape(stacked.shape[:-2] + (2 * NLIMB * NLIMB,))
+    cols = jnp.dot(flat, jnp.asarray(_SCATTER),
+                   precision=jax.lax.Precision.HIGHEST)
+    return cols.astype(jnp.uint32)
 
 
 def _full_mul(a, b):

@@ -24,7 +24,7 @@ ONLY_FORK = None
 
 ALL_PHASES = ("phase0", "altair", "bellatrix", "capella", "deneb")
 # feature forks: selectable via with_phases, excluded from with_all_phases
-FEATURE_PHASES = ("eip6110", "eip7002", "whisk")
+FEATURE_PHASES = ("eip6110", "eip7002", "eip7594", "whisk")
 MINIMAL = "minimal"
 MAINNET = "mainnet"
 

@@ -1,22 +1,82 @@
 """Execution-payload builders for tests.
 
 Reference: ``test/helpers/execution_payload.py`` (build_empty_execution_payload,
-compute_el_block_hash).  Divergence: the reference fabricates a realistic
-RLP + Merkle-Patricia ``block_hash`` so vectors look like mainnet blocks;
-consensus validity never depends on it (the Noop engine accepts any hash,
-``pysetup/spec_builders/bellatrix.py:40-65``), so here the hash is a
-deterministic SSZ-derived digest instead of an RLP encoding.
+compute_el_block_hash).  The ``block_hash`` is the REAL execution block
+hash — ``keccak256(rlp(header))`` with EIP-2718/4895 indexed tries for
+transactions / withdrawals / deposit-receipts / exits — via the in-repo
+keccak/RLP/MPT implementations (``utils/keccak.py``, ``utils/el_trie.py``;
+the reference uses the external eth_hash/rlp/trie packages), so
+bellatrix+ vectors carry reference-corpus-compatible hashes.  Consensus
+validity never depends on the value (the Noop engine accepts any hash,
+``pysetup/spec_builders/bellatrix.py:40-65``).
 """
 from consensus_specs_tpu.utils.hash_function import hash
+from consensus_specs_tpu.utils.keccak import keccak256
+from consensus_specs_tpu.utils.el_trie import indexed_trie_root, rlp_encode
 from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+# keccak256 of the RLP of an empty ommers list — constant in every
+# post-merge header (EIP-3675 fixes ommers to []).
+_EMPTY_OMMERS_HASH = bytes.fromhex(
+    "1dcc4de8dec75d7aab85b567b6ccd41ad312451b948a7413f0a142fd40d49347")
+
+
+def _withdrawal_rlp(w) -> bytes:
+    # EIP-4895 network encoding
+    return rlp_encode([int(w.index), int(w.validator_index),
+                       bytes(w.address), int(w.amount)])
+
+
+def _deposit_receipt_rlp(r) -> bytes:
+    return rlp_encode([bytes(r.pubkey), bytes(r.withdrawal_credentials),
+                       int(r.amount), bytes(r.signature), int(r.index)])
+
+
+def _exit_rlp(e) -> bytes:
+    return rlp_encode([bytes(e.source_address), bytes(e.validator_pubkey)])
 
 
 def compute_el_block_hash(spec, payload):
-    """Deterministic stand-in for the execution block hash: digest of the
-    payload with its own block_hash field zeroed."""
-    snapshot = payload.copy()
-    snapshot.block_hash = spec.Hash32()
-    return spec.Hash32(hash(hash_tree_root(snapshot) + b"el-block-hash"))
+    """keccak256 of the RLP execution header described by ``payload``
+    (reference ``compute_el_header_block_hash``; field order per
+    EIP-3675/4399/1559/4895/4844)."""
+    header = [
+        bytes(payload.parent_hash),
+        _EMPTY_OMMERS_HASH,
+        bytes(payload.fee_recipient),
+        bytes(payload.state_root),
+        indexed_trie_root(bytes(tx) for tx in payload.transactions),
+        bytes(payload.receipts_root),
+        bytes(payload.logs_bloom),
+        0,                                   # difficulty (EIP-3675)
+        int(payload.block_number),
+        int(payload.gas_limit),
+        int(payload.gas_used),
+        int(payload.timestamp),
+        bytes(payload.extra_data),
+        bytes(payload.prev_randao),          # mixHash (EIP-4399)
+        b"\x00" * 8,                         # nonce (EIP-3675)
+        int(payload.base_fee_per_gas),       # EIP-1559
+    ]
+    if hasattr(payload, "withdrawals"):
+        header.append(indexed_trie_root(
+            _withdrawal_rlp(w) for w in payload.withdrawals))
+    if hasattr(payload, "blob_gas_used"):
+        # NOTE: the reference generator appends only the two gas fields -
+        # no EIP-4788 parent_beacon_block_root - so real Cancun headers
+        # differ, but corpus compatibility is defined by the reference's
+        # own fabrication (helpers/execution_payload.py:103-107), which
+        # this matches field-for-field (including its blob_gas_used-first
+        # ordering).
+        header.append(int(payload.blob_gas_used))
+        header.append(int(payload.excess_blob_gas))
+    if hasattr(payload, "deposit_receipts"):
+        header.append(indexed_trie_root(
+            _deposit_receipt_rlp(r) for r in payload.deposit_receipts))
+    if hasattr(payload, "exits"):
+        header.append(indexed_trie_root(
+            _exit_rlp(e) for e in payload.exits))
+    return spec.Hash32(keccak256(rlp_encode(header)))
 
 
 def build_empty_execution_payload(spec, state, randao_mix=None):

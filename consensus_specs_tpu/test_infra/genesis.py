@@ -37,12 +37,13 @@ def _genesis_fork_versions(spec):
         "deneb": getattr(spec.config, "DENEB_FORK_VERSION", None),
         "eip6110": getattr(spec.config, "EIP6110_FORK_VERSION", None),
         "eip7002": getattr(spec.config, "EIP7002_FORK_VERSION", None),
+        "eip7594": getattr(spec.config, "EIP7594_FORK_VERSION", None),
         "whisk": getattr(spec.config, "WHISK_FORK_VERSION", None),
     }
     order = ["phase0", "altair", "bellatrix", "capella", "deneb",
-             "eip6110", "eip7002", "whisk"]
+             "eip6110", "eip7002", "eip7594", "whisk"]
     # feature forks branch off their DAG parent, not list order
-    parents = {"eip7002": "capella", "whisk": "capella"}
+    parents = {"eip7002": "capella", "eip7594": "deneb", "whisk": "capella"}
     cur = versions[fork]
     prev_name = parents.get(fork, order[max(0, order.index(fork) - 1)])
     prev = versions[prev_name]

@@ -1,0 +1,1 @@
+"""Operational tools: cache prewarming, diagnostics."""

@@ -1,0 +1,121 @@
+"""RLP encoding + hexary Merkle-Patricia trie roots (execution layer).
+
+Replaces the reference's ``rlp`` + ``trie`` dependencies
+(``test/helpers/execution_payload.py:1-4``) for fabricating
+reference-corpus-compatible execution block hashes: the EL block hash is
+``keccak256(rlp(header))`` with transaction / withdrawal / receipt tries
+rooted per EIP-2718/4895 (``patriciaTrie(rlp(index) => data)``).
+
+Only insertion-then-root is needed (no proofs, no deletes), so the trie
+is built in one recursive pass over the sorted nibble keys instead of a
+node database.
+"""
+from .keccak import keccak256
+
+
+# ---------------------------------------------------------------------------
+# RLP
+# ---------------------------------------------------------------------------
+
+def rlp_encode(item) -> bytes:
+    """RLP-encode bytes, ints (big-endian minimal), or nested lists."""
+    if isinstance(item, int):
+        if item < 0:
+            raise ValueError("RLP cannot encode negative integers")
+        payload = b"" if item == 0 else item.to_bytes(
+            (item.bit_length() + 7) // 8, "big")
+        return _rlp_bytes(payload)
+    if isinstance(item, (bytes, bytearray, memoryview)):
+        return _rlp_bytes(bytes(item))
+    if isinstance(item, (list, tuple)):
+        payload = b"".join(rlp_encode(x) for x in item)
+        return _rlp_length(len(payload), 0xC0) + payload
+    raise TypeError(f"cannot RLP-encode {type(item).__name__}")
+
+
+def _rlp_bytes(b: bytes) -> bytes:
+    if len(b) == 1 and b[0] < 0x80:
+        return b
+    return _rlp_length(len(b), 0x80) + b
+
+
+def _rlp_length(n: int, offset: int) -> bytes:
+    if n < 56:
+        return bytes([offset + n])
+    ll = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([offset + 55 + len(ll)]) + ll
+
+
+# ---------------------------------------------------------------------------
+# Hexary Merkle-Patricia trie root
+# ---------------------------------------------------------------------------
+
+EMPTY_TRIE_ROOT = keccak256(rlp_encode(b""))   # 56e81f17...
+
+
+def _hex_prefix(nibbles, is_leaf: bool) -> bytes:
+    """Yellow-paper hex-prefix encoding of a nibble path."""
+    flag = 2 if is_leaf else 0
+    if len(nibbles) % 2:
+        first = bytes([(flag + 1) << 4 | nibbles[0]])
+        rest = nibbles[1:]
+    else:
+        first = bytes([flag << 4])
+        rest = nibbles
+    return first + bytes(
+        rest[i] << 4 | rest[i + 1] for i in range(0, len(rest), 2))
+
+
+def _node_ref(node):
+    """Node -> its reference inside a parent: the rlp itself when short,
+    else its keccak."""
+    encoded = rlp_encode(node)
+    return encoded if len(encoded) < 32 else keccak256(encoded)
+
+
+def _build_node(items):
+    """items: list of (nibble_tuple, value) with distinct keys -> node
+    structure (an rlp-able list), or b"" for no entries."""
+    if not items:
+        return b""
+    if len(items) == 1:
+        nibbles, value = items[0]
+        return [_hex_prefix(list(nibbles), True), value]
+    # strip the longest common prefix into an extension node
+    first = items[0][0]
+    prefix_len = 0
+    while (prefix_len < len(first)
+           and all(len(k) > prefix_len and k[prefix_len] == first[prefix_len]
+                   for k, _ in items)):
+        prefix_len += 1
+    if prefix_len:
+        child = _build_node([(k[prefix_len:], v) for k, v in items])
+        return [_hex_prefix(list(first[:prefix_len]), False),
+                _node_ref(child)]
+    # branch node: bucket by first nibble; empty-key entry is the value slot
+    branch = [b""] * 17
+    buckets = {}
+    for k, v in items:
+        if len(k) == 0:
+            branch[16] = v
+        else:
+            buckets.setdefault(k[0], []).append((k[1:], v))
+    for nib, sub in buckets.items():
+        branch[nib] = _node_ref(_build_node(sub))
+    return branch
+
+
+def trie_root(pairs) -> bytes:
+    """Root hash of the MPT holding ``{key_bytes: value_bytes}``."""
+    items = sorted(
+        (tuple(n for byte in key for n in (byte >> 4, byte & 0xF)), value)
+        for key, value in pairs)
+    node = _build_node(items)
+    if node == b"":
+        return EMPTY_TRIE_ROOT
+    return keccak256(rlp_encode(node))
+
+
+def indexed_trie_root(values) -> bytes:
+    """EIP-2718-style ``patriciaTrie(rlp(index) => value)`` root."""
+    return trie_root((rlp_encode(i), bytes(v)) for i, v in enumerate(values))

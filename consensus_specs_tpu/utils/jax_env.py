@@ -15,6 +15,33 @@ _CACHE_ROOT = os.path.join(
     ".jax_cache")
 
 
+def _cpu_fingerprint() -> str:
+    """Short hash of this host's CPU feature set.
+
+    XLA:CPU AOT artifacts embed the feature set of the machine that
+    compiled them; loading them on a host with a different set at best
+    spams feature-mismatch errors and at worst SIGILLs (the round-3
+    ``BENCH_r03.json`` failure tail).  Keying the cache directory by the
+    host's own flags guarantees artifacts are only ever replayed on a
+    machine whose features match the compiling one.
+    """
+    import hashlib
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                # x86 spells it "flags", aarch64 "Features"
+                if line.startswith(("flags", "Features")):
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except Exception:
+        pass
+    if not flags:
+        import platform
+        flags = platform.processor() or platform.machine() or "unknown-cpu"
+    return hashlib.sha256(flags.encode()).hexdigest()[:12]
+
+
 def keyed_cache_dir() -> str:
     parts = []
     try:
@@ -27,6 +54,7 @@ def keyed_cache_dir() -> str:
         parts.append("libtpu-" + _md.version("libtpu"))
     except Exception:
         parts.append("libtpu-none")
+    parts.append("cpu-" + _cpu_fingerprint())
     return os.path.join(_CACHE_ROOT, "-".join(parts))
 
 
@@ -97,8 +125,9 @@ def ensure_working_backend(timeout: int = 90) -> str:
         pass
     except Exception:
         pass
+    import sys as _s
     print("jax_env: accelerator backend unavailable (init hung or failed); "
-          "falling back to host CPU", flush=True)
+          "falling back to host CPU", file=_s.stderr, flush=True)
     force_cpu_platform()
     _PROBE_RESULT = "cpu"
     return "cpu"

@@ -1,0 +1,88 @@
+"""Pure-python Keccak-256 (the pre-NIST padding Ethereum uses).
+
+The reference pulls ``eth_hash``/pycryptodome for this
+(``test/helpers/execution_payload.py:1``); neither ships in this image
+and ``hashlib.sha3_256`` is NIST SHA-3 (domain byte ``0x06``) — Ethereum
+keccak pads with ``0x01``, so the permutation is implemented here.
+Throughput is irrelevant: the only consumer is execution-block-hash
+fabrication for test vectors (a few hundred bytes per payload).
+
+Verified against the two universally-known anchors:
+``keccak256(b"") = c5d24601...`` and the empty-trie root
+``keccak256(rlp(b"")) = 56e81f17...`` (asserted at import).
+"""
+
+_ROUND_CONSTANTS = [
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A,
+    0x8000000080008000, 0x000000000000808B, 0x0000000080000001,
+    0x8000000080008081, 0x8000000000008009, 0x000000000000008A,
+    0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089,
+    0x8000000000008003, 0x8000000000008002, 0x8000000000000080,
+    0x000000000000800A, 0x800000008000000A, 0x8000000080008081,
+    0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+]
+
+_ROTATIONS = [[0, 36, 3, 41, 18],
+              [1, 44, 10, 45, 2],
+              [62, 6, 43, 15, 61],
+              [28, 55, 25, 21, 56],
+              [27, 20, 39, 8, 14]]
+
+_MASK = (1 << 64) - 1
+
+
+def _rol(x, n):
+    n %= 64
+    return ((x << n) | (x >> (64 - n))) & _MASK
+
+
+def _keccak_f(A):
+    for rc in _ROUND_CONSTANTS:
+        # theta
+        C = [A[x][0] ^ A[x][1] ^ A[x][2] ^ A[x][3] ^ A[x][4] for x in range(5)]
+        D = [C[(x - 1) % 5] ^ _rol(C[(x + 1) % 5], 1) for x in range(5)]
+        for x in range(5):
+            for y in range(5):
+                A[x][y] ^= D[x]
+        # rho + pi
+        B = [[0] * 5 for _ in range(5)]
+        for x in range(5):
+            for y in range(5):
+                B[y][(2 * x + 3 * y) % 5] = _rol(A[x][y], _ROTATIONS[x][y])
+        # chi
+        for x in range(5):
+            for y in range(5):
+                A[x][y] = B[x][y] ^ ((~B[(x + 1) % 5][y]) & B[(x + 2) % 5][y])
+        # iota
+        A[0][0] ^= rc
+    return A
+
+
+def keccak256(data: bytes) -> bytes:
+    rate = 136                       # 1600/8 - 2*32
+    # pad10*1 with the 0x01 domain byte (NIST SHA-3 would use 0x06)
+    padded = bytearray(data)
+    pad_len = rate - (len(padded) % rate)
+    padded += b"\x00" * pad_len
+    padded[len(data)] ^= 0x01
+    padded[-1] ^= 0x80
+
+    A = [[0] * 5 for _ in range(5)]
+    for off in range(0, len(padded), rate):
+        block = padded[off:off + rate]
+        for i in range(rate // 8):
+            lane = int.from_bytes(block[8 * i:8 * i + 8], "little")
+            A[i % 5][i // 5] ^= lane
+        A = _keccak_f(A)
+
+    out = bytearray()
+    for i in range(4):               # 32 bytes = 4 lanes
+        out += A[i % 5][i // 5].to_bytes(8, "little")
+    return bytes(out)
+
+
+assert keccak256(b"").hex() == \
+    "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+assert keccak256(b"\x80").hex() == \
+    "56e81f171bcc55a6ff8345e692c0f86e5b48e01b996cadc001622fb5e363b421"

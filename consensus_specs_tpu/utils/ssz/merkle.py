@@ -117,6 +117,111 @@ def merkleize_chunks(chunks: Sequence[bytes], limit: Optional[int] = None) -> by
     return layer
 
 
+class IncrementalTree:
+    """Cached Merkle tree over a growable chunk list, virtually padded to
+    ``limit`` chunks with zero subtrees.
+
+    The dirty-subtree engine behind composite root caching (remerkleable's
+    role in the reference, ``setup.py:549``): a mutation at chunk ``i``
+    re-hashes only the ``depth`` nodes on its root path instead of the
+    whole tree.  Levels store only the occupied prefix; everything to the
+    right is a precomputed ``zero_hashes`` entry.  Bulk construction goes
+    through :func:`hash_layer` (native/batched SHA-256); incremental
+    updates use hashlib (a handful of pairs).
+    """
+
+    __slots__ = ("depth", "levels")
+
+    def __init__(self, chunks: Sequence[bytes], limit: int):
+        self.depth = ceil_log2(next_power_of_two(limit))
+        self._build(chunks)
+
+    def _build(self, chunks: Sequence[bytes]) -> None:
+        levels = [bytearray(b"".join(chunks))]
+        for level in range(self.depth):
+            layer = levels[-1]
+            n = len(layer) // 32
+            if n % 2 == 1:
+                layer = layer + zero_hashes[level]
+            levels.append(bytearray(hash_layer(bytes(layer))))
+        self.levels = levels
+
+    @property
+    def count(self) -> int:
+        return len(self.levels[0]) // 32
+
+    def root(self) -> bytes:
+        if self.count == 0:
+            return zero_hashes[self.depth]
+        return bytes(self.levels[self.depth][:32])
+
+    def update(self, updates: dict) -> None:
+        """Apply ``{chunk_index: chunk_bytes}``; indices may extend the
+        occupied prefix by any amount (gaps zero-fill)."""
+        if not updates:
+            return
+        from hashlib import sha256 as _sha
+        level0 = self.levels[0]
+        hi = max(updates)
+        if hi >= (1 << self.depth):
+            raise ValueError("chunk index beyond tree limit")
+        if (hi + 1) * 32 > len(level0):
+            level0.extend(ZERO_CHUNK * (hi + 1 - len(level0) // 32))
+        dirty = set()
+        for i, chunk in updates.items():
+            level0[i * 32:(i + 1) * 32] = chunk
+            dirty.add(i >> 1)
+        for level in range(self.depth):
+            cur, parent = self.levels[level], self.levels[level + 1]
+            next_dirty = set()
+            occ = len(cur) // 32
+            for p in sorted(dirty):
+                li, ri = 2 * p, 2 * p + 1
+                if li * 32 >= len(cur):
+                    break  # parent of fully-virtual children stays zero-hash
+                left = bytes(cur[li * 32:(li + 1) * 32])
+                right = bytes(cur[ri * 32:(ri + 1) * 32]) \
+                    if ri < occ else zero_hashes[level]
+                node = _sha(left + right).digest()
+                if (p + 1) * 32 > len(parent):
+                    parent.extend(zero_hashes[level + 1]
+                                  * (p + 1 - len(parent) // 32))
+                parent[p * 32:(p + 1) * 32] = node
+                next_dirty.add(p >> 1)
+            dirty = next_dirty
+
+    def truncate(self, count: int) -> None:
+        """Shrink the occupied prefix to ``count`` chunks (pop support):
+        drops trailing chunks and re-hashes the affected right edge."""
+        old = self.count
+        if count >= old:
+            return
+        self.levels[0] = self.levels[0][:count * 32]
+        # re-hash the path of the last surviving chunk and every dropped
+        # parent edge: rebuilding the right edge level by level
+        for level in range(self.depth):
+            cur, parent = self.levels[level], self.levels[level + 1]
+            n_parent = (len(cur) // 32 + 1) // 2
+            self.levels[level + 1] = parent[:n_parent * 32]
+            parent = self.levels[level + 1]
+            if n_parent == 0:
+                continue
+            p = n_parent - 1
+            li, ri = 2 * p, 2 * p + 1
+            occ = len(cur) // 32
+            left = bytes(cur[li * 32:(li + 1) * 32])
+            right = bytes(cur[ri * 32:(ri + 1) * 32]) \
+                if ri < occ else zero_hashes[level]
+            from hashlib import sha256 as _sha
+            parent[p * 32:(p + 1) * 32] = _sha(left + right).digest()
+
+    def copy(self) -> "IncrementalTree":
+        new = object.__new__(IncrementalTree)
+        new.depth = self.depth
+        new.levels = [bytearray(l) for l in self.levels]
+        return new
+
+
 def mix_in_length(root: bytes, length: int) -> bytes:
     return sha256(root + length.to_bytes(32, "little")).digest()
 

@@ -14,13 +14,15 @@ out-of-range value into a uint64 field raises, which is how the spec's
 "uint64 overflow ⇒ invalid state transition" rule (reference:
 ``specs/phase0/beacon-chain.md:1253``) is enforced.
 
-Containers whose fields are all immutable (basic/bytes types) memoize their
-hash_tree_root — e.g. ``Validator`` — so registry-scale merkleization feeds
-cached leaf roots into the batched SHA-256 layer kernel.
+Every composite memoizes its hash_tree_root, invalidated precisely by
+parent-pointer dirty propagation (see the note below) — so registry-scale
+merkleization re-hashes only mutated subtree paths.
 """
+import weakref
 from typing import Any, Dict, Optional, Sequence, Tuple, Type
 
 from .merkle import (
+    IncrementalTree,
     merkleize_chunks,
     mix_in_length,
     mix_in_selector,
@@ -29,21 +31,35 @@ from .merkle import (
 
 OFFSET_BYTE_LENGTH = 4
 
-# Global mutation clock: bumped on every SSZ mutation anywhere. Composite
-# values memoize hash_tree_root against it — any mutation invalidates all
-# root caches (over-invalidation is cheap; recomputing registry roots per
-# helper call is not). Containers whose fields are all immutable keep their
-# own precise per-object cache instead.
-_mutation_clock = [0]
-
-
-def _bump_clock():
-    _mutation_clock[0] += 1
-
-
+# Root caching uses parent-pointer dirty propagation: every mutable
+# composite knows the single location that owns it (value semantics:
+# storing always snapshots, so ownership is unique), and a mutation walks
+# the ownership chain invalidating only the ancestors' caches, while
+# sequences additionally record WHICH child index changed so
+# re-merkleization re-hashes only the dirty root paths
+# (``merkle.IncrementalTree``).  This is the remerkleable role in the
+# reference (``setup.py:549``): per-slot state roots cost O(mutations *
+# log n) hashes, not O(registry).
 class SSZValue:
     """Marker base for all SSZ value instances."""
     __slots__ = ()
+
+
+def _set_owner(value, parent, key) -> None:
+    """Record that ``value`` is stored at ``parent[key]`` (field index or
+    element index).  Only mutable composites track ownership; leaves
+    (ints/bytes) are immutable and need none."""
+    if isinstance(value, (Container, _SequenceBase, _BitsBase, UnionBase)):
+        object.__setattr__(value, "_owner", (weakref.ref(parent), key))
+
+
+def _notify_owner(value) -> None:
+    """Propagate a dirty mark from ``value`` up the ownership chain."""
+    owner = getattr(value, "_owner", None)
+    if owner is not None:
+        parent = owner[0]()
+        if parent is not None:
+            parent._mark_child_dirty(owner[1])
 
 
 # ---------------------------------------------------------------------------
@@ -278,7 +294,7 @@ Bytes96 = ByteVector[96]
 # ---------------------------------------------------------------------------
 
 class _BitsBase(SSZValue):
-    __slots__ = ("_bits",)
+    __slots__ = ("_bits", "_owner")
 
     def _init_bits(self, value, fixed_len: Optional[int]):
         if value is None:
@@ -302,7 +318,7 @@ class _BitsBase(SSZValue):
 
     def __setitem__(self, i, v):
         self._bits[i] = bool(v)
-        _bump_clock()
+        _notify_owner(self)
 
     def __eq__(self, other):
         if isinstance(other, _BitsBase):
@@ -425,7 +441,7 @@ class BitlistBase(_BitsBase):
         if len(self._bits) >= type(self).limit:
             raise ValueError("Bitlist: append past limit")
         self._bits.append(bool(v))
-        _bump_clock()
+        _notify_owner(self)
 
     def serialize(self) -> bytes:
         return self._bitfield_bytes(with_delimiter=True)
@@ -464,12 +480,84 @@ def _pack_basic(values, elem_type) -> bytes:
 
 
 class _SequenceBase(SSZValue):
-    __slots__ = ("_items", "_root_memo")
+    __slots__ = ("_items", "_root_memo", "_tree", "_dirty", "_owner")
     elem_type: type = None
 
     def _coerce_items(self, values):
         et = type(self).elem_type
-        return [et.coerce(v) for v in values]
+        items = [et.coerce(v) for v in values]
+        for i, v in enumerate(items):
+            _set_owner(v, self, i)
+        return items
+
+    def _mark_child_dirty(self, key) -> None:
+        tree = getattr(self, "_tree", None)
+        if tree is not None:
+            self._dirty.add(key)
+        self._root_memo = None
+        _notify_owner(self)
+
+    def _drop_tree(self) -> None:
+        """Structural change the incremental path doesn't model (shrink):
+        fall back to a full rebuild on next root."""
+        object.__setattr__(self, "_tree", None)
+        self._root_memo = None
+        _notify_owner(self)
+
+    def _chunks_for_items(self, indices=None):
+        """Leaf chunks for ``indices`` (None = all) as {chunk_idx: bytes}."""
+        et = type(self).elem_type
+        if issubclass(et, BasicValue):
+            size = et.byte_length
+            per = 32 // size
+            if indices is None:
+                return dict(enumerate(
+                    pack_bytes_into_chunks(_pack_basic(self._items, et))))
+            out = {}
+            for ci in {i // per for i in indices}:
+                seg = self._items[ci * per:(ci + 1) * per]
+                out[ci] = _pack_basic(seg, et).ljust(32, b"\x00")
+            return out
+        if indices is None:
+            return dict(enumerate(x.hash_tree_root() for x in self._items))
+        return {i: self._items[i].hash_tree_root() for i in indices}
+
+    def _limit_chunks(self) -> int:
+        et = type(self).elem_type
+        bound = getattr(type(self), "limit", 0) or getattr(
+            type(self), "length", 0)
+        if issubclass(et, BasicValue):
+            return max((bound * et.byte_length + 31) // 32, 1)
+        return max(bound, 1)
+
+    def _copy_tree_into(self, new) -> None:
+        """Carry the cached chunk tree (and pending dirt) into a copy."""
+        tree = getattr(self, "_tree", None)
+        object.__setattr__(new, "_tree",
+                           tree.copy() if tree is not None else None)
+        object.__setattr__(new, "_dirty", set(getattr(self, "_dirty", ())))
+        new._root_memo = getattr(self, "_root_memo", None)
+
+    def _tree_root(self) -> bytes:
+        """Chunk-tree root (before any length mix-in), incrementally
+        maintained: only dirty chunk paths re-hash."""
+        tree = getattr(self, "_tree", None)
+        if tree is None:
+            tree = IncrementalTree(
+                list(self._chunks_for_items(None).values()),
+                self._limit_chunks())
+            object.__setattr__(self, "_tree", tree)
+            object.__setattr__(self, "_dirty", set())
+        elif self._dirty:
+            et = type(self).elem_type
+            per = 32 // et.byte_length if issubclass(et, BasicValue) else 1
+            n_chunks = (len(self._items) + per - 1) // per
+            if tree.count > n_chunks:
+                tree.truncate(n_chunks)
+            live = {i for i in self._dirty if i < len(self._items)}
+            self._dirty.clear()
+            tree.update(self._chunks_for_items(live))
+        return tree.root()
 
     def __len__(self):
         return len(self._items)
@@ -481,15 +569,22 @@ class _SequenceBase(SSZValue):
         return self._items[i]
 
     def __setitem__(self, i, v):
-        self._items[i] = type(self).elem_type.coerce(v)
-        _bump_clock()
+        if i < 0:
+            i += len(self._items)
+        value = type(self).elem_type.coerce(v)
+        self._items[i] = value
+        _set_owner(value, self, i)
+        self._mark_child_dirty(i)
 
-    def _memoized_root(self, compute):
+    def _cached_root(self, finish):
+        """Memoized root: the memo is cleared EXPLICITLY by every mutation
+        (own mutators + child dirty notifications), so validity is exact -
+        no global clock involved."""
         memo = getattr(self, "_root_memo", None)
-        if memo is not None and memo[0] == _mutation_clock[0]:
-            return memo[1]
-        root = compute()
-        self._root_memo = (_mutation_clock[0], root)
+        if memo is not None:
+            return memo
+        root = finish(self._tree_root())
+        self._root_memo = root
         return root
 
     def __eq__(self, other):
@@ -546,16 +641,6 @@ class _SequenceBase(SSZValue):
             items.append(et.decode_bytes(data[offsets[i]:offsets[i + 1]]))
         return items
 
-    def _elem_chunks(self, limit_chunks: Optional[int]) -> bytes:
-        """Return merkleized root of element data (before any length mix-in)."""
-        et = type(self).elem_type
-        if issubclass(et, BasicValue):
-            chunks = pack_bytes_into_chunks(_pack_basic(self._items, et))
-        else:
-            chunks = [x.hash_tree_root() for x in self._items]
-        return merkleize_chunks(chunks, limit=limit_chunks)
-
-
 class VectorBase(_SequenceBase):
     length = 0
 
@@ -563,6 +648,8 @@ class VectorBase(_SequenceBase):
         if value is None:
             et = type(self).elem_type
             self._items = [et.default() for _ in range(type(self).length)]
+            for i, x in enumerate(self._items):
+                _set_owner(x, self, i)
         else:
             self._items = self._coerce_items(value)
             if len(self._items) != type(self).length:
@@ -599,18 +686,14 @@ class VectorBase(_SequenceBase):
         return self._serialize_elems()
 
     def hash_tree_root(self) -> bytes:
-        def compute():
-            et = type(self).elem_type
-            if issubclass(et, BasicValue):
-                limit = (type(self).length * et.byte_length + 31) // 32
-            else:
-                limit = type(self).length
-            return self._elem_chunks(max(limit, 1))
-        return self._memoized_root(compute)
+        return self._cached_root(lambda root: root)
 
     def copy(self):
         new = object.__new__(type(self))
         new._items = [x.copy() for x in self._items]
+        for i, x in enumerate(new._items):
+            _set_owner(x, new, i)
+        self._copy_tree_into(new)
         return new
 
     def __repr__(self):
@@ -675,31 +758,31 @@ class ListBase(_SequenceBase):
     def append(self, v):
         if len(self._items) >= type(self).limit:
             raise ValueError(f"{type(self).__name__}: append past limit")
-        self._items.append(type(self).elem_type.coerce(v))
-        _bump_clock()
+        value = type(self).elem_type.coerce(v)
+        self._items.append(value)
+        _set_owner(value, self, len(self._items) - 1)
+        self._mark_child_dirty(len(self._items) - 1)
 
     def pop(self):
         v = self._items.pop()
-        _bump_clock()
+        # shrink isn't modeled incrementally (the vacated chunk and its
+        # path must revert); rebuild on next root
+        self._drop_tree()
         return v
 
     def serialize(self) -> bytes:
         return self._serialize_elems()
 
     def hash_tree_root(self) -> bytes:
-        def compute():
-            et = type(self).elem_type
-            if issubclass(et, BasicValue):
-                limit = (type(self).limit * et.byte_length + 31) // 32
-            else:
-                limit = type(self).limit
-            root = self._elem_chunks(max(limit, 1))
-            return mix_in_length(root, len(self._items))
-        return self._memoized_root(compute)
+        return self._cached_root(
+            lambda root: mix_in_length(root, len(self._items)))
 
     def copy(self):
         new = object.__new__(type(self))
         new._items = [x.copy() for x in self._items]
+        for i, x in enumerate(new._items):
+            _set_owner(x, new, i)
+        self._copy_tree_into(new)
         return new
 
     def __repr__(self):
@@ -741,9 +824,6 @@ class _ContainerMeta(type):
                         f"(got {ftype!r}); string/postponed annotations are not supported")
                 fields[fname] = ftype
         cls._fields = fields
-        cls._immutable_fields = all(
-            issubclass(t, (BasicValue, ByteVectorBase, ByteListBase))
-            for t in fields.values()) and len(fields) > 0
         return cls
 
 
@@ -763,18 +843,26 @@ class Container(SSZValue, metaclass=_ContainerMeta):
                 raise TypeError(f"{type(self).__name__}: unknown field {k}")
         for fname, ftype in fields.items():
             if fname in kwargs:
-                object.__setattr__(self, fname, ftype.coerce(kwargs[fname]))
+                value = ftype.coerce(kwargs[fname])
             else:
-                object.__setattr__(self, fname, ftype.default())
+                value = ftype.default()
+            object.__setattr__(self, fname, value)
+            _set_owner(value, self, fname)
         object.__setattr__(self, "_root_cache", None)
 
     def __setattr__(self, name, value):
         ftype = type(self)._fields.get(name)
         if ftype is None:
             raise AttributeError(f"{type(self).__name__}: no field {name}")
-        object.__setattr__(self, name, ftype.coerce(value))
+        value = ftype.coerce(value)
+        object.__setattr__(self, name, value)
+        _set_owner(value, self, name)
         object.__setattr__(self, "_root_cache", None)
-        _bump_clock()
+        _notify_owner(self)
+
+    def _mark_child_dirty(self, key) -> None:
+        object.__setattr__(self, "_root_cache", None)
+        _notify_owner(self)
 
     @classmethod
     def fields(cls) -> Dict[str, type]:
@@ -856,20 +944,23 @@ class Container(SSZValue, metaclass=_ContainerMeta):
         return bytes(head + tail)
 
     def hash_tree_root(self) -> bytes:
-        if type(self)._immutable_fields:
-            cached = object.__getattribute__(self, "_root_cache")
-            if cached is not None:
-                return cached
+        # Safe to cache on EVERY container: any mutation below this node
+        # (field assignment, nested setitem/append/bit flip) walks the
+        # ownership chain and clears this cache precisely.
+        cached = object.__getattribute__(self, "_root_cache")
+        if cached is not None:
+            return cached
         chunks = [getattr(self, f).hash_tree_root() for f in type(self)._fields]
         root = merkleize_chunks(chunks)
-        if type(self)._immutable_fields:
-            object.__setattr__(self, "_root_cache", root)
+        object.__setattr__(self, "_root_cache", root)
         return root
 
     def copy(self):
         new = object.__new__(type(self))
         for f in type(self)._fields:
-            object.__setattr__(new, f, getattr(self, f).copy())
+            fv = getattr(self, f).copy()
+            object.__setattr__(new, f, fv)
+            _set_owner(fv, new, f)
         # field copies have identical roots, so the memoized root carries over
         object.__setattr__(new, "_root_cache",
                            object.__getattribute__(self, "_root_cache"))
@@ -895,7 +986,7 @@ class Container(SSZValue, metaclass=_ContainerMeta):
 # ---------------------------------------------------------------------------
 
 class UnionBase(SSZValue):
-    __slots__ = ("_selector", "_value")
+    __slots__ = ("_selector", "_value", "_owner")
     options: Tuple[Optional[type], ...] = ()
 
     def __init__(self, selector: int = 0, value=None):
@@ -909,7 +1000,11 @@ class UnionBase(SSZValue):
             self._value = None
         else:
             self._value = opt.coerce(value) if value is not None else opt.default()
+            _set_owner(self._value, self, 0)
         self._selector = selector
+
+    def _mark_child_dirty(self, key) -> None:
+        _notify_owner(self)
 
     @property
     def selector(self):
@@ -963,6 +1058,8 @@ class UnionBase(SSZValue):
         new = object.__new__(type(self))
         new._selector = self._selector
         new._value = None if self._value is None else self._value.copy()
+        if new._value is not None:
+            _set_owner(new._value, new, 0)
         return new
 
     def __eq__(self, other):

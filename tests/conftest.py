@@ -45,6 +45,10 @@ def pytest_addoption(parser):
     parser.addoption("--bls-type", action="store", default="py",
                      choices=["py", "jax", "fastest"],
                      help="BLS backend")
+    parser.addoption("--compiled", action="store_true", default=False,
+                     help="run the conformance suite against the markdown-"
+                          "compiled spec ladder (make pyspec output) instead "
+                          "of the hand-written classes")
 
 
 def pytest_configure(config):
@@ -56,3 +60,6 @@ def pytest_configure(config):
     only_fork = config.getoption("--fork")
     if only_fork:
         ctx.ONLY_FORK = only_fork
+    if config.getoption("--compiled"):
+        from consensus_specs_tpu.forks import use_compiled_registry
+        use_compiled_registry()

@@ -1,0 +1,89 @@
+"""EIP-7594 (PeerDAS) fork + sampling-surface tests.
+
+Reference model: ``specs/_features/eip7594/fork.md`` (upgrade, version
+ladder) and ``test/eip7594/unittests`` (sampling surface, exercised here
+through the spec object rather than the bare library - the library
+itself is differential-tested in ``tests/deneb/kzg/test_kzg_7594.py``).
+"""
+import os
+
+import pytest
+
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases,
+)
+
+# The preset trusted setup is 4096 field elements -> 128 cells per blob;
+# multiproof computation over it is a host-Pippenger MSM per cell, which
+# belongs in the gated crypto tier (the small-setup library versions of
+# these paths run in tests/deneb/kzg/test_kzg_7594.py).
+HEAVY = os.environ.get("CS_TPU_HEAVY") == "1"
+
+
+@with_phases(["eip7594"])
+@spec_state_test
+def test_upgrade_rotates_fork_version_only(spec, state):
+    pre = spec.BeaconState.decode_bytes(state.serialize())
+    post = spec.upgrade_to_eip7594(pre)
+    assert post.fork.current_version == spec.config.EIP7594_FORK_VERSION
+    assert post.fork.previous_version == pre.fork.current_version
+    # data-availability fork: every other field byte-identical
+    post.fork = pre.fork
+    assert post.serialize() == pre.serialize()
+
+
+@with_phases(["eip7594"])
+@spec_state_test
+def test_fork_version_ladder(spec, state):
+    cfg = spec.config
+    assert spec.compute_fork_version(cfg.EIP7594_FORK_EPOCH) == \
+        cfg.EIP7594_FORK_VERSION
+    if cfg.DENEB_FORK_EPOCH < cfg.EIP7594_FORK_EPOCH:
+        assert spec.compute_fork_version(cfg.DENEB_FORK_EPOCH) == \
+            cfg.DENEB_FORK_VERSION
+    yield  # part-less
+
+
+@pytest.mark.skipif(not HEAVY, reason="set CS_TPU_HEAVY=1 (full-size setup)")
+@with_phases(["eip7594"])
+@spec_state_test
+def test_cells_roundtrip_through_spec_surface(spec, state):
+    """compute_cells -> drop half -> recover_polynomial round-trips."""
+    import random
+    rng = random.Random(7594)
+    n = spec.FIELD_ELEMENTS_PER_BLOB
+    blob = b"".join(
+        rng.randrange(spec.BLS_MODULUS).to_bytes(32, "big")
+        for _ in range(int(n)))
+    cells = spec.compute_cells(blob)
+    k = len(cells)
+    # any half of the extended cells recovers the full extended data
+    keep = sorted(rng.sample(range(k), k // 2))
+    cells_bytes = [
+        b"".join(int(x).to_bytes(32, "big") for x in c) for c in cells]
+    rec = spec.recover_polynomial(keep, [cells_bytes[i] for i in keep])
+    assert rec == [x for c in cells for x in c]
+    yield  # part-less
+
+
+@pytest.mark.skipif(not HEAVY, reason="set CS_TPU_HEAVY=1 (full-size setup)")
+@with_phases(["eip7594"])
+@spec_state_test
+def test_cell_proofs_verify_through_spec_surface(spec, state):
+    import random
+    rng = random.Random(75941)
+    n = spec.FIELD_ELEMENTS_PER_BLOB
+    blob = b"".join(
+        rng.randrange(spec.BLS_MODULUS).to_bytes(32, "big")
+        for _ in range(int(n)))
+    commitment = spec.blob_to_kzg_commitment(blob)
+    cells, proofs = spec.compute_cells_and_proofs(blob)
+    cell_bytes = [
+        b"".join(int(x).to_bytes(32, "big") for x in c) for c in cells]
+    cid = rng.randrange(len(cells))
+    assert spec.verify_cell_proof(commitment, cid, cell_bytes[cid],
+                                  proofs[cid])
+    wrong = (cid + 1) % len(cells)
+    assert not spec.verify_cell_proof(commitment, wrong, cell_bytes[cid],
+                                      proofs[cid])
+    yield  # part-less

@@ -10,6 +10,7 @@ from consensus_specs_tpu.test_infra.context import (
 from consensus_specs_tpu.test_infra.deposits import (
     prepare_full_genesis_deposits,
 )
+from consensus_specs_tpu.gen.gen_runner import YamlPart
 from consensus_specs_tpu.utils.ssz import hash_tree_root
 
 
@@ -80,7 +81,9 @@ def test_is_valid_genesis_state_true(spec):
     eth1_block_hash, eth1_timestamp = _eth1_params(spec)
     state = spec.initialize_beacon_state_from_eth1(
         eth1_block_hash, eth1_timestamp, deposits)
+    yield "genesis", state
     assert spec.is_valid_genesis_state(state)
+    yield "is_valid", YamlPart(value=True)
 
 
 @with_phases(["phase0"])
@@ -95,7 +98,9 @@ def test_is_valid_genesis_state_false_invalid_timestamp(spec):
     state = spec.initialize_beacon_state_from_eth1(
         eth1_block_hash, spec.uint64(0), deposits)
     if spec.config.MIN_GENESIS_TIME > spec.config.GENESIS_DELAY:
+        yield "genesis", state
         assert not spec.is_valid_genesis_state(state)
+        yield "is_valid", YamlPart(value=False)
 
 
 @with_phases(["phase0"])
@@ -109,4 +114,6 @@ def test_is_valid_genesis_state_false_not_enough_validators(spec):
     eth1_block_hash, eth1_timestamp = _eth1_params(spec)
     state = spec.initialize_beacon_state_from_eth1(
         eth1_block_hash, eth1_timestamp, deposits)
+    yield "genesis", state
     assert not spec.is_valid_genesis_state(state)
+    yield "is_valid", YamlPart(value=False)

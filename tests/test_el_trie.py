@@ -1,0 +1,86 @@
+"""keccak / RLP / Merkle-Patricia trie + EL block hash tests.
+
+Reference role: the external eth_hash/rlp/trie packages the reference
+imports in ``test/helpers/execution_payload.py:1-4``; anchors are the
+universally-known keccak256("") and empty-trie-root constants.
+"""
+import pytest
+
+from consensus_specs_tpu.utils.keccak import keccak256
+from consensus_specs_tpu.utils.el_trie import (
+    EMPTY_TRIE_ROOT, indexed_trie_root, rlp_encode, trie_root)
+
+
+def test_keccak_anchors():
+    assert keccak256(b"").hex() == (
+        "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470")
+    # 200-byte input crosses the 136-byte rate boundary (two permutations)
+    two_block = keccak256(b"\xab" * 200)
+    assert two_block != keccak256(b"\xab" * 136)
+    assert len(two_block) == 32
+
+
+def test_rlp_encoding_rules():
+    assert rlp_encode(b"") == b"\x80"
+    assert rlp_encode(0) == b"\x80"                 # ints: minimal big-endian
+    assert rlp_encode(b"\x00") == b"\x00" * 1       # single byte < 0x80: as-is
+    assert rlp_encode(b"\x7f") == b"\x7f"
+    assert rlp_encode(b"\x80") == b"\x81\x80"       # >= 0x80 gets a length tag
+    assert rlp_encode(15) == b"\x0f"
+    assert rlp_encode(1024) == b"\x82\x04\x00"
+    assert rlp_encode([]) == b"\xc0"
+    assert rlp_encode([b"cat", b"dog"]) == b"\xc8\x83cat\x83dog"
+    long = b"a" * 56
+    assert rlp_encode(long) == b"\xb8\x38" + long   # long-form length
+    with pytest.raises(ValueError):
+        rlp_encode(-1)
+
+
+def test_empty_trie_root_constant():
+    assert EMPTY_TRIE_ROOT.hex() == (
+        "56e81f171bcc55a6ff8345e692c0f86e5b48e01b996cadc001622fb5e363b421")
+    assert indexed_trie_root([]) == EMPTY_TRIE_ROOT
+
+
+def test_trie_root_structure_sensitivity():
+    # deterministic + insertion-order independent
+    pairs = [(rlp_encode(i), bytes([i])) for i in range(20)]
+    assert trie_root(pairs) == trie_root(reversed(pairs))
+    # value changes move the root
+    r1 = indexed_trie_root([b"a", b"b"])
+    r2 = indexed_trie_root([b"a", b"c"])
+    assert r1 != r2
+    # index matters (leaf position), content-equal lists differ by order
+    assert indexed_trie_root([b"a", b"b"]) != indexed_trie_root([b"b", b"a"])
+    # single-entry trie differs from empty and from two-entry
+    assert indexed_trie_root([b"a"]) not in (EMPTY_TRIE_ROOT, r1)
+
+
+def test_trie_exercises_extension_nodes():
+    # keys sharing a long prefix force extension + branch + leaf nodes
+    root = trie_root([(b"\x12\x34\x56", b"x"), (b"\x12\x34\x99", b"y")])
+    assert root != trie_root([(b"\x12\x34\x56", b"x")])
+    # a 17th empty-path entry lands in the branch value slot
+    root2 = trie_root([(b"\x12", b"v"), (b"\x12\x34", b"w")])
+    assert len(root2) == 32
+
+
+def test_el_block_hash_is_rlp_keccak():
+    from consensus_specs_tpu.forks import build_spec
+    from consensus_specs_tpu.test_infra.execution_payload import (
+        compute_el_block_hash)
+    spec = build_spec("bellatrix", "minimal")
+    payload = spec.ExecutionPayload()
+    h1 = compute_el_block_hash(spec, payload)
+    assert len(bytes(h1)) == 32
+    # header fields feed the hash
+    payload.block_number = 7
+    assert compute_el_block_hash(spec, payload) != h1
+    # capella appends the withdrawals trie root to the header list
+    spec_c = build_spec("capella", "minimal")
+    pc = spec_c.ExecutionPayload()
+    hc = compute_el_block_hash(spec_c, pc)
+    w = spec_c.Withdrawal(index=1, validator_index=2,
+                          address=b"\x03" * 20, amount=4)
+    pc.withdrawals = [w]
+    assert compute_el_block_hash(spec_c, pc) != hc

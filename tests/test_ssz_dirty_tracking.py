@@ -1,0 +1,129 @@
+"""Dirty-subtree root caching: differential + incremental-tree tests.
+
+The ownership/dirty protocol (``utils/ssz/types.py``) must keep every
+cached root EXACTLY equal to a from-scratch recompute after arbitrary
+API mutations — a stale cache is a consensus bug.  The oracle here is
+``decode_bytes(serialize())``: a fresh value with no caches at all.
+Reference role: remerkleable's backing-tree correctness
+(``setup.py:549``).
+"""
+import random
+
+from consensus_specs_tpu.utils.ssz.merkle import (
+    IncrementalTree, merkleize_chunks, zero_hashes)
+from consensus_specs_tpu.utils.ssz import (
+    Bitlist, Bytes32, Container, List, Vector, uint64, hash_tree_root)
+
+
+class Inner(Container):
+    a: uint64
+    b: Bytes32
+
+
+class Outer(Container):
+    nums: List[uint64, 4096]
+    inners: List[Inner, 1024]
+    fixed: Vector[Bytes32, 16]
+    bits: Bitlist[64]
+    tag: uint64
+
+
+def _fresh_root(v):
+    return type(v).decode_bytes(v.serialize()).hash_tree_root()
+
+
+def test_incremental_tree_matches_merkleize():
+    rng = random.Random(1)
+    for count in (0, 1, 2, 3, 7, 8, 64, 65):
+        chunks = [rng.randbytes(32) for _ in range(count)]
+        t = IncrementalTree(chunks, 4096)
+        assert t.root() == merkleize_chunks(chunks, limit=4096)
+        # single-chunk updates track full recomputes
+        for _ in range(5):
+            if not chunks:
+                break
+            i = rng.randrange(len(chunks))
+            chunks[i] = rng.randbytes(32)
+            t.update({i: chunks[i]})
+            assert t.root() == merkleize_chunks(chunks, limit=4096)
+        # growth via update beyond the occupied prefix
+        chunks.append(rng.randbytes(32))
+        t.update({len(chunks) - 1: chunks[-1]})
+        assert t.root() == merkleize_chunks(chunks, limit=4096)
+        # truncation
+        if len(chunks) > 1:
+            chunks = chunks[: len(chunks) // 2]
+            t.truncate(len(chunks))
+            assert t.root() == merkleize_chunks(chunks, limit=4096)
+
+
+def test_empty_tree_root_is_zero_subtree():
+    t = IncrementalTree([], 4096)
+    assert t.root() == zero_hashes[12]
+
+
+def test_randomized_mutations_never_stale():
+    rng = random.Random(42)
+    v = Outer(
+        nums=list(range(100)),
+        inners=[Inner(a=i, b=bytes([i % 256]) * 32) for i in range(50)],
+        bits=[True, False] * 10,
+    )
+    assert v.hash_tree_root() == _fresh_root(v)
+
+    def mutate():
+        op = rng.randrange(9)
+        if op == 0:
+            v.nums[rng.randrange(len(v.nums))] = rng.randrange(2**64)
+        elif op == 1:
+            v.nums.append(rng.randrange(2**64))
+        elif op == 2 and len(v.nums) > 1:
+            v.nums.pop()
+        elif op == 3:
+            v.inners[rng.randrange(len(v.inners))].a = rng.randrange(2**64)
+        elif op == 4:
+            v.inners[rng.randrange(len(v.inners))] = Inner(
+                a=rng.randrange(2**64), b=rng.randbytes(32))
+        elif op == 5:
+            v.inners.append(Inner(a=rng.randrange(2**64)))
+        elif op == 6:
+            v.fixed[rng.randrange(16)] = rng.randbytes(32)
+        elif op == 7:
+            v.bits[rng.randrange(len(v.bits))] = rng.randrange(2)
+        else:
+            v.tag = rng.randrange(2**64)
+
+    for step in range(300):
+        mutate()
+        if step % 3 == 0:   # roots queried at varying cadence: caches must
+            # survive BOTH repeated queries and query-free mutation bursts
+            assert v.hash_tree_root() == _fresh_root(v), f"stale at {step}"
+    assert v.hash_tree_root() == _fresh_root(v)
+
+
+def test_copies_are_independent():
+    v = Outer(nums=[1, 2, 3], inners=[Inner(a=1)])
+    r0 = v.hash_tree_root()
+    c = v.copy()
+    assert c.hash_tree_root() == r0
+    # mutating the copy (incl. in-place element writes) leaves the
+    # original untouched, and vice versa
+    c.inners[0].a = 99
+    c.nums[0] = 77
+    assert v.hash_tree_root() == r0
+    assert c.hash_tree_root() == _fresh_root(c) != r0
+    v.inners[0].a = 5
+    assert c.inners[0].a == 99
+    assert v.hash_tree_root() == _fresh_root(v)
+
+
+def test_aliased_element_mutation_after_copy():
+    v = Outer(inners=[Inner(a=1), Inner(a=2)])
+    held = v.inners[0]          # live reference into v
+    c = v.copy()
+    r_c = c.hash_tree_root()
+    held.a = 123                # must dirty v, not c
+    assert v.inners[0].a == 123
+    assert v.hash_tree_root() == _fresh_root(v)
+    assert c.hash_tree_root() == r_c
+    assert c.inners[0].a == 1
