@@ -6,7 +6,7 @@ GENERATORS = operations sanity finality rewards random forks epoch_processing \
              genesis ssz_static bls shuffling light_client kzg_4844 \
              fork_choice merkle_proof ssz_generic sync transition
 
-.PHONY: test citest test-crypto bench bench-all dryrun warm native \
+.PHONY: test citest test-crypto bench bench-all dryrun warm native lint \
         generate_tests $(addprefix gen_,$(GENERATORS)) clean-vectors pyspec
 
 # fast local suite: signature checks off except @always_bls
@@ -18,6 +18,13 @@ test:
 # (reference `make citest`, Makefile:129-137)
 citest:
 	$(PYTHON) -m pytest tests/ -q --enable-bls
+
+# static checks: syntax gate + stdlib AST lint (unused imports, bare
+# except, mutable defaults) — role of the reference `make lint`
+# (Makefile:153-158, flake8+mypy; neither ships in this image)
+lint:
+	$(PYTHON) -m compileall -q consensus_specs_tpu tests generators benchmarks
+	$(PYTHON) -m consensus_specs_tpu.tools.lint .
 
 # crypto kernels incl. the heavy differential tier
 test-crypto:

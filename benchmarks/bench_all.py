@@ -53,8 +53,7 @@ def bench_fast_aggregate_verify(batch=16, n_keys=64):
 def _build_block_with_attestations(spec, state, max_atts):
     from consensus_specs_tpu.test_infra.attestations import (
         get_valid_attestation)
-    from consensus_specs_tpu.test_infra.block import (
-        build_empty_block, get_state_and_beacon_parent_root_at_slot)
+    from consensus_specs_tpu.test_infra.block import build_empty_block
     from consensus_specs_tpu.test_infra import block as blk
 
     target_slot = state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY

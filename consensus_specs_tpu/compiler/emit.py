@@ -21,6 +21,19 @@ def _absolutize_imports(block: str) -> str:
     return re.sub(r"from \.(\w+) import",
                   r"from consensus_specs_tpu.forks.\1 import", block)
 
+# Per-fork document lists (role of the reference's
+# ``pysetup/md_doc_paths.py:65-80`` — every markdown document of a fork
+# is compiled, not just beacon-chain.md).  Paths relative to specs/.
+_FORK_DOCS = {
+    "phase0": ["phase0/beacon-chain.md", "phase0/fork-choice.md",
+               "phase0/validator.md"],
+    "altair": ["altair/beacon-chain.md", "altair/validator.md",
+               "altair/light-client/sync-protocol.md"],
+    "bellatrix": ["bellatrix/beacon-chain.md", "sync/optimistic.md"],
+    "capella": ["capella/beacon-chain.md"],
+    "deneb": ["deneb/beacon-chain.md"],
+}
+
 _SCAFFOLD = {
     "phase0": {
         "bases": "ValidatorGuideMixin, ForkChoiceMixin",
@@ -70,36 +83,59 @@ from consensus_specs_tpu.forks.compiled.bellatrix import \\
     },
     "deneb": {
         "bases": "CompiledCapellaSpec",
+        # _kzg binds to the markdown-compiled KZG library (built from
+        # specs/deneb/polynomial-commitments.md) rather than ops.kzg, so
+        # the compiled ladder's blob verification is markdown-sourced
+        # end to end.
         "imports": """\
 from consensus_specs_tpu.forks.deneb import *  # noqa: F401,F403
-from consensus_specs_tpu.forks.deneb import hash, _kzg
+from consensus_specs_tpu.forks.deneb import hash
+from consensus_specs_tpu.forks.compiled import polynomial_commitments \\
+    as _kzg
 from consensus_specs_tpu.forks.compiled.capella import CompiledCapellaSpec
 """,
     },
 }
 
 
-def emit_spec_module(doc, class_name=None) -> str:
-    """SpecDocument -> python module source."""
+def emit_spec_module(doc, class_name=None, extra_docs=()) -> str:
+    """SpecDocument(s) -> python module source.
+
+    ``doc`` is the fork's beacon-chain document (it names the fork and
+    its predecessor); ``extra_docs`` are the fork's auxiliary documents
+    (fork choice, validator duties, light client, optimistic sync) whose
+    class-scope blocks are appended after the beacon-chain members and
+    whose ``<!-- scope: module -->`` blocks are spliced at module level.
+    """
     scaffold = _SCAFFOLD[doc.fork]
     class_name = class_name or f"Compiled{doc.fork.capitalize()}Spec"
     out = [f'"""AUTO-COMPILED from specs/{doc.fork}/ — do not edit.\n'
            f'Source of truth: the markdown spec; regenerate with\n'
            f'`python -m consensus_specs_tpu.compiler`."""',
            scaffold["imports"]]
+    for d in (doc,) + tuple(extra_docs):
+        for block in d.module_blocks:
+            out.append(_absolutize_imports(block))
+            out.append("")
 
     out.append(f"class {class_name}({scaffold['bases']}):")
     out.append(f'    fork = "{doc.fork}"')
     prev = f'"{doc.previous_fork}"' if doc.previous_fork else "None"
     out.append(f"    previous_fork = {prev}")
     out.append("")
+    all_docs = (doc,) + tuple(extra_docs)
+    constants = {}
+    for d in all_docs:
+        constants.update(d.constants)
     if doc.fork != "phase0":
-        for name, value in doc.constants.items():
+        for name, value in constants.items():
             out.append(f"    {name} = {value}")
         out.append("")
-        for block in doc.code_blocks:
-            out.append(textwrap.indent(_absolutize_imports(block), "    "))
-            out.append("")
+        for d in all_docs:
+            for block in d.code_blocks:
+                out.append(
+                    textwrap.indent(_absolutize_imports(block), "    "))
+                out.append("")
         return "\n".join(out) + "\n"
     # surface re-exports matching the hand-written class
     out.append(textwrap.indent(textwrap.dedent("""\
@@ -129,21 +165,40 @@ def emit_spec_module(doc, class_name=None) -> str:
         DOMAIN_SELECTION_PROOF = DOMAIN_SELECTION_PROOF
         DOMAIN_AGGREGATE_AND_PROOF = DOMAIN_AGGREGATE_AND_PROOF
         """), "    "))
-    for name, value in doc.constants.items():
+    for name, value in constants.items():
         out.append(f"    {name} = {value}")
     out.append("")
-    for block in doc.code_blocks:
-        out.append(textwrap.indent(_absolutize_imports(block), "    "))
+    for d in all_docs:
+        for block in d.code_blocks:
+            out.append(textwrap.indent(_absolutize_imports(block), "    "))
+            out.append("")
+    return "\n".join(out) + "\n"
+
+
+def emit_library_module(doc, source_rel: str) -> str:
+    """SpecDocument -> plain module: every block at module scope (the
+    polynomial-commitments library has no beacon-state receiver)."""
+    out = [f'"""AUTO-COMPILED from {source_rel} — do not edit.\n'
+           f'Source of truth: the markdown spec; regenerate with\n'
+           f'`python -m consensus_specs_tpu.compiler`."""']
+    for block in doc.module_blocks + doc.code_blocks:
+        out.append(_absolutize_imports(block))
         out.append("")
     return "\n".join(out) + "\n"
 
 
-def compile_spec(md_path: str, out_path: str = None) -> str:
-    """Compile one markdown spec; returns (and optionally writes) the
-    module source."""
+def _parse(md_path: str):
     with open(md_path) as f:
-        doc = parse_markdown_spec(f.read())
-    src = emit_spec_module(doc)
+        return parse_markdown_spec(f.read())
+
+
+def compile_spec(md_path, out_path: str = None) -> str:
+    """Compile one fork's markdown documents (a path or list of paths,
+    beacon-chain first); returns (and optionally writes) the module
+    source."""
+    paths = [md_path] if isinstance(md_path, str) else list(md_path)
+    docs = [_parse(p) for p in paths]
+    src = emit_spec_module(docs[0], extra_docs=docs[1:])
     compile(src, out_path or "<compiled-spec>", "exec")  # syntax gate
     if out_path:
         os.makedirs(os.path.dirname(out_path), exist_ok=True)
@@ -152,22 +207,35 @@ def compile_spec(md_path: str, out_path: str = None) -> str:
     return src
 
 
+def compile_library(md_path: str, source_rel: str, out_path: str) -> str:
+    doc = _parse(md_path)
+    src = emit_library_module(doc, source_rel)
+    compile(src, out_path, "exec")  # syntax gate
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        f.write(src)
+    return src
+
+
 def main():
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    targets = [
-        (fork, os.path.join(repo, f"specs/{fork}/beacon-chain.md"))
-        for fork in ("phase0", "altair", "bellatrix", "capella", "deneb")]
-    for fork, md_path in targets:
-        out_path = os.path.join(
-            repo, "consensus_specs_tpu/forks/compiled", f"{fork}.py")
-        compile_spec(md_path, out_path)
-        print(f"compiled {md_path} -> {out_path}")
-    init = os.path.join(repo, "consensus_specs_tpu/forks/compiled",
-                        "__init__.py")
+    compiled_dir = os.path.join(repo, "consensus_specs_tpu/forks/compiled")
+    init = os.path.join(compiled_dir, "__init__.py")
+    os.makedirs(compiled_dir, exist_ok=True)
     if not os.path.exists(init):
         with open(init, "w") as f:
             f.write('"""Markdown-compiled spec modules (make pyspec)."""\n')
+    lib_md = os.path.join(repo, "specs/deneb/polynomial-commitments.md")
+    compile_library(lib_md, "specs/deneb/polynomial-commitments.md",
+                    os.path.join(compiled_dir, "polynomial_commitments.py"))
+    print(f"compiled {lib_md}")
+    for fork in ("phase0", "altair", "bellatrix", "capella", "deneb"):
+        md_paths = [os.path.join(repo, "specs", rel)
+                    for rel in _FORK_DOCS[fork]]
+        out_path = os.path.join(compiled_dir, f"{fork}.py")
+        compile_spec(md_paths, out_path)
+        print(f"compiled {' + '.join(_FORK_DOCS[fork])} -> {out_path}")
 
 
 if __name__ == "__main__":

@@ -21,6 +21,10 @@ class SpecDocument:
     title: str = ""
     constants: Dict[str, str] = field(default_factory=dict)
     code_blocks: List[str] = field(default_factory=list)
+    # blocks preceded by ``<!-- scope: module -->``: emitted at module
+    # level (Store/LatestMessage dataclasses, module helper functions)
+    # instead of inside the spec class body
+    module_blocks: List[str] = field(default_factory=list)
 
     def functions(self) -> Dict[str, str]:
         """name -> source for every top-level def in the code blocks."""
@@ -42,14 +46,17 @@ def parse_markdown_spec(text: str) -> SpecDocument:
     lines = text.splitlines()
     i = 0
     in_block = False
+    module_scope = False
     block_lines: List[str] = []
     while i < len(lines):
         line = lines[i]
         if in_block:
             if _FENCE_END_RE.match(line):
-                doc.code_blocks.append("\n".join(block_lines))
+                dest = doc.module_blocks if module_scope else doc.code_blocks
+                dest.append("\n".join(block_lines))
                 block_lines = []
                 in_block = False
+                module_scope = False
             else:
                 block_lines.append(line)
         elif _FENCE_RE.match(line):
@@ -62,6 +69,8 @@ def parse_markdown_spec(text: str) -> SpecDocument:
                     doc.fork = value
                 elif key == "previous_fork":
                     doc.previous_fork = value
+                elif key == "scope" and value == "module":
+                    module_scope = True
             elif line.startswith("# ") and not doc.title:
                 doc.title = line[2:].strip()
             else:

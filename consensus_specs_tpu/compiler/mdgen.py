@@ -7,6 +7,7 @@ loop back to an importable module (golden-tested for parity).
 """
 import inspect
 import os
+import re
 import textwrap
 
 _SECTIONS = [
@@ -224,6 +225,159 @@ effect from markdown dict-merge).  Compiled by
     return "\n".join(out) + "\n"
 
 
+def _module_import_header(mod) -> str:
+    """The module's import statements (everything the embedded python
+    blocks need at module scope), taken verbatim from its source."""
+    out = []
+    cont = False
+    for line in inspect.getsource(mod).splitlines():
+        if cont:
+            out.append(line)
+            cont = line.rstrip().endswith(("(", ",", "\\")) \
+                and ")" not in line
+        elif line.startswith(("import ", "from ")):
+            out.append(line)
+            cont = line.rstrip().endswith(("(", "\\"))
+        elif re.match(r"^(def|class|@)", line):
+            break
+    return "\n".join(out).rstrip()
+
+
+def generate_component_doc(fork: str, document: str, title: str,
+                           intro: str, mixin_cls, module_members=(),
+                           section_notes=None) -> str:
+    """Markdown for an auxiliary spec document (fork choice, validator
+    duties, light client, optimistic sync) whose python blocks are the
+    REAL runtime sources: module-scope definitions (``Store`` etc.) carry
+    a ``<!-- scope: module -->`` marker the compiler honors, and every
+    mixin method becomes a class-body block of the compiled spec class
+    (reference compiles the same documents per fork,
+    ``pysetup/md_doc_paths.py:65-80``)."""
+    import sys
+    import types
+    mod = sys.modules[mixin_cls.__module__]
+    out = [f"# {title}", "",
+           f"<!-- fork: {fork} -->",
+           f"<!-- document: {document} -->", "",
+           textwrap.dedent(intro).strip(), ""]
+
+    out += ["## Module-scope definitions", """
+These definitions live at module scope of the compiled spec (imports,
+event-machine state holders, plain helpers); the compiler splices them
+above the spec class.""", ""]
+    header = _module_import_header(mod)
+    blocks = [header] if header else []
+    emitted = set()
+    # every module-level CONSTANT, automatically: mixin methods reference
+    # them as globals of the compiled module
+    import ast
+    mod_src = inspect.getsource(mod)
+    mod_lines = mod_src.splitlines()
+    for node in ast.parse(mod_src).body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and re.fullmatch(r"[A-Z_][A-Z0-9_]*", node.targets[0].id):
+            blocks.append("\n".join(
+                mod_lines[node.lineno - 1:node.end_lineno]).rstrip())
+            emitted.add(node.targets[0].id)
+    for name in module_members:
+        if name in emitted:
+            continue
+        member = getattr(mod, name)
+        if isinstance(member, (types.FunctionType, type)):
+            src = textwrap.dedent(inspect.getsource(member))
+        else:
+            src = f"{name} = {member!r}"
+        blocks.append(src.rstrip())
+    out.append("<!-- scope: module -->")
+    out.append("```python")
+    out.append("\n\n\n".join(blocks))
+    out.append("```")
+    out.append("")
+
+    out.append("## Spec methods")
+    out.append("")
+    section_notes = section_notes or {}
+    emitted_methods = set()
+    for name, member in mixin_cls.__dict__.items():
+        if isinstance(member, property):
+            member = member.fget
+        elif isinstance(member, (staticmethod, classmethod)):
+            member = member.__func__  # getsource keeps the decorator line
+        elif not isinstance(member, types.FunctionType) \
+                or name.startswith("__"):
+            continue
+        if name.startswith("__"):
+            continue
+        out.append(f"### `{name}`\n")
+        if name in section_notes:
+            out.append(textwrap.dedent(section_notes[name]).strip() + "\n")
+        out.append("```python")
+        out.append(textwrap.dedent(inspect.getsource(member)).rstrip())
+        out.append("```")
+        out.append("")
+        emitted_methods.add(name)
+    # completeness gate: a silently-dropped member kind would let the
+    # compiled spec diverge from the runtime class
+    missing = [n_ for n_, m in mixin_cls.__dict__.items()
+               if callable(m) or isinstance(m, (staticmethod, classmethod,
+                                                property))
+               if not n_.startswith("__") and n_ not in emitted_methods
+               and not isinstance(m, type)]
+    if missing:
+        raise RuntimeError(
+            f"{mixin_cls.__name__}: members not emitted to markdown: "
+            f"{missing}")
+    return "\n".join(out) + "\n"
+
+
+def generate_module_doc(mod, fork: str, document: str, title: str,
+                        intro: str) -> str:
+    """Markdown for a spec LIBRARY (polynomial commitments): every
+    module member in definition order, all module-scope, compiled into a
+    standalone module (the reference's polynomial-commitments.md is
+    likewise a function library, not beacon-state methods)."""
+    import types
+    import ast
+    src = inspect.getsource(mod)
+    src_lines = src.splitlines()
+    out = [f"# {title}", "",
+           f"<!-- fork: {fork} -->",
+           f"<!-- document: {document} -->", "",
+           textwrap.dedent(intro).strip(), "",
+           "## Module-scope definitions", "",
+           "<!-- scope: module -->", "```python",
+           _module_import_header(mod), "```", ""]
+
+    for node in ast.parse(src).body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            continue  # the header block carries these
+        if isinstance(node, ast.Expr) and isinstance(node.value,
+                                                     ast.Constant):
+            continue  # module docstring
+        start = node.lineno
+        for deco in getattr(node, "decorator_list", []):
+            start = min(start, deco.lineno)  # the '@' line
+        segment = "\n".join(src_lines[start - 1:node.end_lineno]).rstrip()
+        if isinstance(node, (ast.FunctionDef, ast.ClassDef)):
+            name = node.name
+        elif isinstance(node, ast.Assign) and node.targets \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name):
+            name = node.target.id
+        else:
+            name = src_lines[node.lineno - 1].strip()[:40]
+        out.append(f"### `{name}`\n")
+        out.append("<!-- scope: module -->")
+        out.append("```python")
+        out.append(segment)
+        out.append("```")
+        out.append("")
+    return "\n".join(out) + "\n"
+
+
 def main():
     from consensus_specs_tpu.forks.phase0 import Phase0Spec
     from consensus_specs_tpu.forks.altair import AltairSpec
@@ -245,6 +399,99 @@ def main():
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with open(path, "w") as f:
             f.write(generate_delta_markdown(cls, fork, prev))
+        print(f"wrote {path}")
+    write_component_docs(repo)
+
+
+def write_component_docs(repo: str) -> None:
+    """The auxiliary spec documents, generated with real runtime sources
+    so the compiler can build them into the compiled ladder (reference
+    equivalents: specs/phase0/{fork-choice,validator}.md,
+    specs/altair/{validator.md,light-client/sync-protocol.md},
+    specs/sync/optimistic.md, specs/deneb/polynomial-commitments.md)."""
+    from consensus_specs_tpu.forks.fork_choice import ForkChoiceMixin
+    from consensus_specs_tpu.forks.validator_guide import (
+        ValidatorGuideMixin, SyncDutiesMixin)
+    from consensus_specs_tpu.forks.light_client import LightClientMixin
+    from consensus_specs_tpu.forks.optimistic_sync import OptimisticSyncMixin
+    from consensus_specs_tpu.ops import kzg as kzg_mod
+
+    docs = [
+        ("phase0/fork-choice.md", generate_component_doc(
+            "phase0", "fork-choice", "Phase0 fork choice", """
+This document specifies the LMD-GHOST fork-choice rule (reference
+parity target: `specs/phase0/fork-choice.md`).  A node maintains a
+`Store` — its view of blocks, states, checkpoints and the latest votes —
+and feeds it three kinds of events: clock ticks (`on_tick`), blocks
+(`on_block`), and attestations (`on_attestation` /
+`on_attester_slashing`).  `get_head` folds the accumulated votes over
+the viable block tree to pick the canonical head; `get_proposer_head`
+layers the proposer re-org policy on top.  Design differences from the
+reference (same observable behavior): `get_ancestor` is iterative,
+`filter_block_tree` walks an explicit stack over a per-call
+parent->children index, and `checkpoint_states` is keyed by
+`(epoch, root)` tuples because this framework's SSZ values are mutable.
+""", ForkChoiceMixin,
+            ("INTERVALS_PER_SLOT", "LatestMessage", "Store", "_ckpt_key"))),
+        ("phase0/validator.md", generate_component_doc(
+            "phase0", "validator", "Phase0 honest validator guide", """
+Expected behavior of an honest validator (reference parity target:
+`specs/phase0/validator.md`): committee assignment lookahead, proposal
+and attestation signing, the eth1-data voting window, attestation
+subnet selection and rotation (`compute_subscribed_subnets`),
+aggregation duties (`is_aggregator`, aggregate-and-proof), and the
+weak-subjectivity checkpoint rules every syncing node must enforce.
+""", ValidatorGuideMixin)),
+        ("altair/validator.md", generate_component_doc(
+            "altair", "validator", "Altair honest validator duties", """
+Sync-committee duties added by altair (reference parity target:
+`specs/altair/validator.md`): per-slot sync committee messages, the
+subnet partition (`compute_subnets_for_sync_committee`),
+selection-proof based aggregation (`is_sync_committee_aggregator`),
+contribution-and-proof construction, and folding collected
+contributions into the block's `sync_aggregate`.
+""", SyncDutiesMixin)),
+        ("altair/light-client/sync-protocol.md", generate_component_doc(
+            "altair", "sync-protocol", "Altair light-client sync protocol",
+            """
+Minimal light-client sync (reference parity target:
+`specs/altair/light-client/sync-protocol.md`): a `LightClientStore`
+tracks a finalized and an optimistic header plus the current/next sync
+committees; updates are validated against the committee of the
+attested period (`validate_light_client_update`), applied under the
+2/3-supermajority and finality rules, and force-updated after a
+timeout.  The full-node side derives bootstraps and updates from
+finalized blocks (`create_light_client_bootstrap/update/...`); capella
+and deneb extend the header with execution fields via upgrade helpers.
+""", LightClientMixin, ("floorlog2",))),
+        ("sync/optimistic.md", generate_component_doc(
+            "bellatrix", "optimistic", "Optimistic sync", """
+Optimistic sync (reference parity target: `specs/sync/optimistic.md`):
+a beacon node may import bellatrix+ blocks whose execution payloads are
+not yet validated, tracking them in an `OptimisticStore`.  A block is
+optimistically importable once its justified ancestor is deep enough
+(`is_optimistic_candidate_block`); INVALIDATED verdicts prune the
+subtree, VALIDATED verdicts shrink the optimistic set.
+""", OptimisticSyncMixin,
+            ("SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY", "OptimisticStore"))),
+        ("deneb/polynomial-commitments.md", generate_module_doc(
+            kzg_mod, "deneb", "polynomial-commitments",
+            "Deneb KZG polynomial commitments", """
+The KZG commitment library behind deneb blob transactions (reference
+parity target: `specs/deneb/polynomial-commitments.md`).  Scalars live
+in the BLS12-381 scalar field; blobs are 4096 field elements evaluated
+over a bit-reversed root-of-unity domain.  The hot paths (`g1_lincomb`
+MSM, pairing checks) dispatch to the device kernels when JAX answers
+and fall back to the host Pippenger/oracle implementations otherwise.
+Compiled into `forks/compiled/polynomial_commitments.py`, which the
+compiled deneb spec binds as its `_kzg` backend.
+""")),
+    ]
+    for rel, text in docs:
+        path = os.path.join(repo, "specs", rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(text)
         print(f"wrote {path}")
 
 

@@ -15,7 +15,7 @@ dict-merge, ``pysetup/helpers.py:222-247``).
 from consensus_specs_tpu.utils.ssz import (
     hash_tree_root, uint8, uint64, Bytes32,
     Bitvector, Bitlist, Vector, List, Container,
-)
+)  # noqa: F401 (compiled-spec namespace)
 from consensus_specs_tpu.utils import bls
 from . import register_fork
 from .phase0 import Phase0Spec
@@ -26,7 +26,7 @@ from .base_types import (
     ParticipationFlags, GENESIS_EPOCH,
     DOMAIN_SYNC_COMMITTEE, DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
     DOMAIN_CONTRIBUTION_AND_PROOF,
-)
+)  # noqa: F401 (compiled-spec namespace)
 
 # incentivization weights (specs/altair/beacon-chain.md "Incentivization")
 TIMELY_SOURCE_FLAG_INDEX = 0

@@ -5,7 +5,7 @@ Reference: "Custom types" + "Constants" tables of
 """
 from consensus_specs_tpu.utils.ssz import (
     uint8, uint64, Bytes4, Bytes20, Bytes32, Bytes48, Bytes96, ByteVector,
-)
+)  # noqa: F401 (compiled-spec namespace)
 
 # custom types (aliases of basic/byte types)
 Slot = uint64

@@ -10,13 +10,13 @@ modified ``slash_validator`` :279 / ``process_slashings`` :421 /
 ``validate_merge_block`` :204, modified ``on_block`` :235).  The Noop
 execution engine mirrors ``pysetup/spec_builders/bellatrix.py:40-65``.
 """
-from dataclasses import dataclass, field as _dc_field
+from dataclasses import dataclass, field as _dc_field  # noqa: F401 (compiled-spec namespace)
 from typing import Optional
 
 from consensus_specs_tpu.utils.ssz import (
     hash_tree_root, uint64, uint256, Bytes32,
     ByteList, ByteVector, Vector, List, Container,
-)
+)  # noqa: F401 (compiled-spec namespace)
 from consensus_specs_tpu.utils import bls
 from . import register_fork
 from .altair import AltairSpec

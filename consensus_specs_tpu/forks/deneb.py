@@ -15,7 +15,7 @@ from consensus_specs_tpu.utils.hash_function import hash
 from consensus_specs_tpu.utils.ssz import (
     hash_tree_root, uint64, Bytes32, Bytes48, ByteVector, Vector, List,
     Container,
-)
+)  # noqa: F401 (compiled-spec namespace)
 from consensus_specs_tpu.utils import bls
 from consensus_specs_tpu.ops import kzg as _kzg
 from . import register_fork

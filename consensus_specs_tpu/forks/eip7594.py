@@ -13,7 +13,7 @@ changes is how availability is established — ``is_data_available``
 samples extended-blob cells instead of downloading full blobs, so a
 node custodies/examines only a fraction of each blob column.
 """
-from consensus_specs_tpu.utils.ssz import hash_tree_root
+from consensus_specs_tpu.utils.ssz import hash_tree_root  # noqa: F401 (compiled-spec namespace)
 from . import register_fork
 from .deneb import DenebSpec
 from consensus_specs_tpu.ops import kzg_7594 as K7

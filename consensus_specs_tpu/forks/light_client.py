@@ -18,7 +18,7 @@ from consensus_specs_tpu.utils.ssz import (
     get_generalized_index, compute_merkle_proof,
 )
 from consensus_specs_tpu.utils import bls
-from .base_types import Slot, Root, DOMAIN_SYNC_COMMITTEE
+from .base_types import Slot, Root, DOMAIN_SYNC_COMMITTEE  # noqa: F401 (compiled-spec namespace)
 
 
 def floorlog2(x: int) -> int:

@@ -21,7 +21,7 @@ from consensus_specs_tpu.utils.ssz import (
     hash_tree_root, uint_to_bytes, copy as ssz_copy,
     boolean, uint8, uint32, uint64, Bytes4, Bytes32, Bytes48, Bytes96,
     Bitlist, Bitvector, Vector, List, Container,
-)
+)  # noqa: F401 (compiled-spec namespace)
 from consensus_specs_tpu.utils import bls
 from . import register_fork
 from .fork_choice import ForkChoiceMixin

@@ -6,7 +6,6 @@ fork x preset.  The same test code serves pytest and generation; the
 harness's VECTOR_COLLECTOR hook surfaces the yielded parts.
 """
 import importlib
-import pkgutil
 
 from .gen_typing import TestCase, TestProvider
 

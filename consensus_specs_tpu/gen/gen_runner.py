@@ -155,6 +155,21 @@ def generate_test_vector(test_case, output_dir: str, log) -> str:
         ctx.ONLY_FORK, ctx.DEFAULT_TEST_PRESET = old_fork, old_preset
 
 
+# Module-global case table for the fork-based worker pool: closures are
+# not picklable, but with the 'fork' start method child processes inherit
+# the parent image, so workers receive INDICES into this list instead of
+# the cases themselves (the role of the reference's pathos/dill pool,
+# gen_base/gen_runner.py:259-264, without the dill dependency).
+_POOL_CASES = []
+_POOL_OUTPUT_DIR = None
+
+
+def _pool_worker(idx: int):
+    log = []
+    result = generate_test_vector(_POOL_CASES[idx], _POOL_OUTPUT_DIR, log)
+    return idx, result, log
+
+
 def run_generator(generator_name: str, providers, args=None) -> dict:
     """CLI + provider loop (reference gen_runner.py:142-301)."""
     parser = argparse.ArgumentParser(
@@ -167,7 +182,12 @@ def run_generator(generator_name: str, providers, args=None) -> dict:
     parser.add_argument("--preset-list", nargs="*", default=None)
     parser.add_argument("--fork-list", nargs="*", default=None)
     parser.add_argument("-c", "--collect-only", action="store_true")
+    parser.add_argument("-j", "--workers", type=int, default=None,
+                        help="worker processes (default: cpu count, "
+                             "capped at 8; 1 = serial)")
     ns = parser.parse_args(args)
+    if ns.workers is None:
+        ns.workers = min(8, os.cpu_count() or 1)
 
     # Host-side tool: never block on the accelerator tunnel.
     from consensus_specs_tpu.utils.jax_env import force_cpu_platform
@@ -179,6 +199,7 @@ def run_generator(generator_name: str, providers, args=None) -> dict:
     diagnostics = {"collected": 0, "generated": 0, "skipped": 0, "errors": 0,
                    "test_identifiers": []}
     error_log = []
+    cases = []
     for provider in providers:
         provider.prepare()
         for test_case in provider.make_cases():
@@ -196,12 +217,45 @@ def run_generator(generator_name: str, providers, args=None) -> dict:
                 case_dir = os.path.join(ns.output_dir, test_case.dir_path())
                 if os.path.exists(case_dir):
                     shutil.rmtree(case_dir)
-            result = generate_test_vector(test_case, ns.output_dir, error_log)
-            diagnostics[result if result != "error" else "errors"] = \
-                diagnostics.get(
-                    result if result != "error" else "errors", 0) + 1
-            if result == "generated":
-                diagnostics["test_identifiers"].append(test_case.dir_path())
+            cases.append(test_case)
+
+    def _record(test_case, result):
+        diagnostics[result if result != "error" else "errors"] = \
+            diagnostics.get(
+                result if result != "error" else "errors", 0) + 1
+        if result == "generated":
+            diagnostics["test_identifiers"].append(test_case.dir_path())
+
+    import multiprocessing
+
+    def _fork_safe() -> bool:
+        """Forking after XLA backends initialize is deadlock-prone (the
+        child inherits live client threads/mutexes).  Generators run the
+        pure-python BLS backend and never dispatch to a device, so the
+        backends are normally untouched — but if anything DID initialize
+        them, degrade to serial instead of risking a silent hang."""
+        try:
+            from jax._src import xla_bridge as xb
+            return not xb.backends_are_initialized()
+        except Exception:
+            return True
+
+    if ns.workers > 1 and len(cases) > 1 \
+            and "fork" in multiprocessing.get_all_start_methods() \
+            and _fork_safe():
+        global _POOL_CASES, _POOL_OUTPUT_DIR
+        _POOL_CASES, _POOL_OUTPUT_DIR = cases, ns.output_dir
+        mp = multiprocessing.get_context("fork")
+        with mp.Pool(min(ns.workers, len(cases))) as pool:
+            for idx, result, log in pool.imap_unordered(
+                    _pool_worker, range(len(cases))):
+                _record(cases[idx], result)
+                error_log.extend(log)
+        _POOL_CASES, _POOL_OUTPUT_DIR = [], None
+    else:
+        for test_case in cases:
+            _record(test_case,
+                    generate_test_vector(test_case, ns.output_dir, error_log))
 
     if ns.collect_only:
         print(f"collected {diagnostics['collected']} cases")

@@ -1,5 +1,5 @@
 """Generator case/provider types (reference gen_base/gen_typing.py)."""
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Iterable
 
 

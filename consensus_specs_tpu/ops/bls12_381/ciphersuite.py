@@ -14,7 +14,7 @@ from .curve import (
     g1_from_compressed, g2_from_compressed,
 )
 from .pairing import multi_pairing_check
-from .hash_to_curve import hash_to_g2, DST_G2
+from .hash_to_curve import hash_to_g2
 
 
 def SkToPk(sk: int) -> bytes:

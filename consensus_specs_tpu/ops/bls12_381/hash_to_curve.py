@@ -17,7 +17,7 @@ overwhelming probability.
 import hashlib
 from typing import List, Tuple
 
-from .fields import P, R_ORDER, X_PARAM, Fq, Fq2
+from .fields import P, R_ORDER, X_PARAM, Fq2
 from .curve import G2Point, G2_GENERATOR, B2
 
 # SSWU curve E': y² = x³ + A'x + B'

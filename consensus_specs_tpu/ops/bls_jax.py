@@ -20,7 +20,6 @@ Shape discipline: batch and aggregate axes are padded to powers of two so
 the number of compiled program variants stays O(log n); padding lanes are
 degenerate pairs that contribute the identity to the pairing product.
 """
-import functools
 import os
 from collections import OrderedDict
 
@@ -35,8 +34,6 @@ from consensus_specs_tpu.ops.bls12_381.curve import (
 from consensus_specs_tpu.ops.jax_bls import points as PT
 from consensus_specs_tpu.ops.jax_bls import pairing as PR
 from consensus_specs_tpu.ops.jax_bls import htc as HTC
-from consensus_specs_tpu.ops.jax_bls import tower as T
-from consensus_specs_tpu.ops.jax_bls import limbs as L
 
 # Cold-path delegation (oracle)
 Sign = _oracle.Sign

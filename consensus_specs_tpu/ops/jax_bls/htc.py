@@ -15,7 +15,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from consensus_specs_tpu.ops.bls12_381.fields import P, R_ORDER, X_PARAM, Fq2 as _OFq2
+from consensus_specs_tpu.ops.bls12_381.fields import X_PARAM
 from consensus_specs_tpu.ops.bls12_381 import hash_to_curve as _oracle
 from . import limbs as L
 from . import tower as T

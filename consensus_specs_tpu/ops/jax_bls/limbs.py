@@ -19,7 +19,6 @@ boundaries.  This module replaces the role of the reference's Rust field
 arithmetic inside milagro/arkworks (reference
 ``tests/core/pyspec/eth2spec/utils/bls.py:22-30``).
 """
-import functools
 
 import numpy as np
 import jax

@@ -6,11 +6,10 @@ Fq12 = Fq6[w]/(w^2 - v).  Elements are pytrees of Montgomery limb arrays -
 Fq2 = (a, b), Fq6 = (c0, c1, c2), Fq12 = (d0, d1) - so ``vmap``/``scan``
 thread them transparently and all ops batch over leading dims.
 """
-import numpy as np
 import jax.numpy as jnp
 
 from consensus_specs_tpu.ops.bls12_381.fields import (
-    P, Fq2 as _OFq2, XI as _OXI, FROB_V1 as _OFROB_V1, FROB_V2 as _OFROB_V2,
+    P, Fq2 as _OFq2, FROB_V1 as _OFROB_V1, FROB_V2 as _OFROB_V2,
     FROB_W as _OFROB_W,
 )
 from . import limbs as L

@@ -230,8 +230,11 @@ def _pippenger_msm(pts, scalars, window: int = 8) -> G1Point:
 
 class TrustedSetup:
     def __init__(self, preset_name: str):
-        path = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "presets", preset_name,
+        # anchored on the package root, not this module's __file__: the
+        # markdown-compiled copy of this class lives under forks/compiled/
+        import consensus_specs_tpu as _pkg
+        path = os.path.join(os.path.dirname(
+            os.path.abspath(_pkg.__file__)), "presets", preset_name,
             "trusted_setup_4096.json")
         with open(path) as f:
             data = json.load(f)

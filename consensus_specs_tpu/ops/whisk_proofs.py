@@ -5,26 +5,27 @@ The reference delegates BOTH whisk proof systems to the external
 "verifier code ... is specified in curdleproofs.pie"); no proof logic
 lives in the reference tree.  Here:
 
-- **Opening proofs are implemented for real**: a Chaum-Pedersen DLEQ
-  sigma protocol proving knowledge of ``k`` with ``k_r_G == k * r_G``
-  and ``k_commitment == k * G`` (exactly the relation the spec states),
+- **Opening proofs**: a Chaum-Pedersen DLEQ sigma protocol proving
+  knowledge of ``k`` with ``k_r_G == k * r_G`` and
+  ``k_commitment == k * G`` (exactly the relation the spec states),
   made non-interactive by Fiat-Shamir over all public inputs.
-- **Shuffle proofs use a permutation-rerandomization verifier**: the
-  proof reveals the permutation and per-tracker rerandomization scalars
-  and the verifier checks ``post[i] == (s_i * pre[pi(i)].r_G,
-  s_i * pre[pi(i)].k_r_G)``.  This is *sound* for the shuffle relation
-  (post IS a rerandomized permutation of pre) but NOT zero-knowledge —
-  a stand-in with the same interface until a curdleproofs IPA port
-  lands; the divergence is intentional and documented.
+- **Shuffle proofs**: the zero-knowledge curdleproofs-style argument in
+  ``ops/curdleproofs.py`` — the prover shows the post-shuffle trackers
+  are a permutation of the pre-shuffle trackers rerandomized by one
+  common scalar ``k`` (``post[i] = k * pre[sigma[i]]`` componentwise)
+  without revealing ``sigma`` or ``k``.  Log-size (Pedersen
+  commitments, grand-product IPA, same-multiscalar folding, DLEQ).
 
 Wire formats (ours; the spec leaves the formats to the proof library):
   opening proof  = A1(48) || A2(48) || s(32)                 = 128 bytes
-  shuffle proof  = n * [ pi_i(8, little) || s_i(32, big) ]   = 40n bytes
+  shuffle proof  = fixed-size curdleproofs encoding (log2 N rounds; see
+                   ``ops/curdleproofs._serialize``)
 """
 from consensus_specs_tpu.utils.hash_function import hash
 from consensus_specs_tpu.ops.bls12_381.fields import R_ORDER
 from consensus_specs_tpu.ops.bls12_381.curve import (
     G1Point, G1_GENERATOR, g1_from_compressed)
+from consensus_specs_tpu.ops import curdleproofs
 
 BLS_G1_GENERATOR = G1_GENERATOR.to_compressed()
 _DLEQ_DOMAIN = b"whisk-tracker-opening-v1"
@@ -84,47 +85,40 @@ def IsValidWhiskOpeningProof(tracker, k_commitment: bytes,
 
 
 def GenerateWhiskShuffleProof(pre_shuffle_trackers, permutation,
-                              scalars) -> tuple:
-    """Build (post_shuffle_trackers, proof) for the stand-in scheme."""
-    assert len(permutation) == len(pre_shuffle_trackers) == len(scalars)
-    post = []
-    proof = bytearray()
-    for i, (pi, s) in enumerate(zip(permutation, scalars)):
-        s = int(s) % R_ORDER
-        assert s != 0
-        src = pre_shuffle_trackers[pi]
-        post.append((
-            _to_point(src.r_G).mult(s).to_compressed(),
-            _to_point(src.k_r_G).mult(s).to_compressed()))
-        proof += int(pi).to_bytes(8, "little") + s.to_bytes(32, "big")
-    return post, bytes(proof)
+                              shuffle_scalar) -> tuple:
+    """Build (post_shuffle_trackers, proof): post[i] is
+    pre[permutation[i]] with both components multiplied by the one
+    common ``shuffle_scalar`` (the curdleproofs shuffle relation — a
+    common scalar keeps each tracker's ``k`` intact while refreshing
+    ``r``), plus the zero-knowledge shuffle proof."""
+    n = len(pre_shuffle_trackers)
+    assert len(permutation) == n
+    k = int(shuffle_scalar) % R_ORDER
+    assert k != 0
+    R_pts = [_to_point(tr.r_G) for tr in pre_shuffle_trackers]
+    S_pts = [_to_point(tr.k_r_G) for tr in pre_shuffle_trackers]
+    T_pts = [R_pts[permutation[i]].mult(k) for i in range(n)]
+    U_pts = [S_pts[permutation[i]].mult(k) for i in range(n)]
+    proof = curdleproofs.prove_shuffle(
+        R_pts, S_pts, T_pts, U_pts, list(permutation), k)
+    post = [(t.to_compressed(), u.to_compressed())
+            for t, u in zip(T_pts, U_pts)]
+    return post, proof
 
 
 def IsValidWhiskShuffleProof(pre_shuffle_trackers, post_shuffle_trackers,
                              shuffle_proof: bytes) -> bool:
     """beacon-chain.md:106 interface — verify post is a rerandomized
-    permutation of pre (stand-in scheme; see module docstring)."""
+    permutation of pre under one common scalar, in zero knowledge."""
     try:
-        proof = bytes(shuffle_proof)
         n = len(pre_shuffle_trackers)
-        if len(post_shuffle_trackers) != n or len(proof) != 40 * n:
+        if len(post_shuffle_trackers) != n:
             return False
-        seen = set()
-        for i in range(n):
-            off = 40 * i
-            pi = int.from_bytes(proof[off:off + 8], "little")
-            s = int.from_bytes(proof[off + 8:off + 40], "big")
-            if pi >= n or pi in seen or s == 0 or s >= R_ORDER:
-                return False
-            seen.add(pi)
-            src = pre_shuffle_trackers[pi]
-            post = post_shuffle_trackers[i]
-            if _to_point(src.r_G).mult(s).to_compressed() \
-                    != bytes(post.r_G):
-                return False
-            if _to_point(src.k_r_G).mult(s).to_compressed() \
-                    != bytes(post.k_r_G):
-                return False
-        return True
+        R_pts = [_to_point(tr.r_G) for tr in pre_shuffle_trackers]
+        S_pts = [_to_point(tr.k_r_G) for tr in pre_shuffle_trackers]
+        T_pts = [_to_point(tr.r_G) for tr in post_shuffle_trackers]
+        U_pts = [_to_point(tr.k_r_G) for tr in post_shuffle_trackers]
+        return curdleproofs.verify_shuffle(
+            R_pts, S_pts, T_pts, U_pts, bytes(shuffle_proof))
     except Exception:
         return False

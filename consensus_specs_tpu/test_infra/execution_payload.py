@@ -10,10 +10,8 @@ bellatrix+ vectors carry reference-corpus-compatible hashes.  Consensus
 validity never depends on the value (the Noop engine accepts any hash,
 ``pysetup/spec_builders/bellatrix.py:40-65``).
 """
-from consensus_specs_tpu.utils.hash_function import hash
 from consensus_specs_tpu.utils.keccak import keccak256
 from consensus_specs_tpu.utils.el_trie import indexed_trie_root, rlp_encode
-from consensus_specs_tpu.utils.ssz import hash_tree_root
 
 # keccak256 of the RLP of an empty ommers list — constant in every
 # post-merge header (EIP-3675 fixes ommers to []).

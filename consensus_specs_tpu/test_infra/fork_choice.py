@@ -6,7 +6,7 @@ Mirrors the reference's ``test/helpers/fork_choice.py`` behavior: drive a
 ``fork_choice`` vector format uses, ``tests/formats/fork_choice/README.md``)
 and asserting store checks along the way.
 """
-from consensus_specs_tpu.utils.ssz import hash_tree_root, serialize
+from consensus_specs_tpu.utils.ssz import hash_tree_root
 from consensus_specs_tpu.test_infra.context import (
     expect_assertion_error, emit_part)
 

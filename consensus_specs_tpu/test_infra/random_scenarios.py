@@ -13,7 +13,6 @@ from .block import (
     build_empty_block_for_next_slot, state_transition_and_sign_block,
     next_slots, next_epoch,
 )
-from .slashings import get_valid_proposer_slashing, get_valid_attester_slashing
 from .voluntary_exits import prepare_signed_exits
 
 

@@ -3,7 +3,6 @@
 Reference: ``test/helpers/proposer_slashings.py`` + ``attester_slashings.py``.
 """
 from consensus_specs_tpu.utils import bls
-from consensus_specs_tpu.utils.ssz import hash_tree_root
 from .keys import privkeys
 from .attestations import get_valid_attestation, sign_attestation
 

@@ -5,7 +5,7 @@ committee_signature and the sync-aggregate test runner).
 """
 from consensus_specs_tpu.utils import bls
 from consensus_specs_tpu.utils.ssz import hash_tree_root
-from .keys import privkeys, pubkeys
+from .keys import privkeys
 
 
 def compute_sync_committee_signature(spec, state, slot, privkey,

@@ -57,17 +57,33 @@ def warm_dryrun(n_devices=8):
 
 
 def main():
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--platform", choices=("auto", "cpu"),
+                        default="auto",
+                        help="cpu: pin XLA:CPU (the dryrun cache and the "
+                             "bench fallback path); auto: probe the "
+                             "accelerator and use it if it answers")
+    parser.add_argument("--stage", choices=("all", "bench", "dryrun"),
+                        default="all")
+    ns = parser.parse_args()
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))))
     from consensus_specs_tpu.utils.jax_env import (
-        setup_compile_cache, ensure_working_backend)
+        setup_compile_cache, ensure_working_backend, force_cpu_platform)
     cache = setup_compile_cache()
     _log(f"cache dir: {cache}")
-    ensure_working_backend()
-    warm_bench()
+    if ns.platform == "cpu":
+        force_cpu_platform()
+        _log("platform pinned: cpu")
+    else:
+        _log(f"platform: {ensure_working_backend()}")
+    if ns.stage in ("all", "bench"):
+        warm_bench()
     # the dryrun re-execs via subprocess paths of __graft_entry__; warm it
     # last (it shares most staged programs with the bench pipeline).
-    warm_dryrun()
+    if ns.stage in ("all", "dryrun"):
+        warm_dryrun()
     _log("done")
 
 

@@ -23,8 +23,7 @@ from consensus_specs_tpu.ops.bls12_381.curve import (  # noqa: F401
     g1_from_compressed as bytes48_to_G1,
     g2_from_compressed as bytes96_to_G2,
 )
-from consensus_specs_tpu.ops.bls12_381.pairing import multi_pairing_check as pairing_check
-from consensus_specs_tpu.ops.bls12_381.hash_to_curve import hash_to_g2
+from consensus_specs_tpu.ops.bls12_381.pairing import multi_pairing_check as pairing_check  # noqa: F401 (spec API)
 
 bls_active = True
 

@@ -19,7 +19,7 @@ parent-pointer dirty propagation (see the note below) — so registry-scale
 merkleization re-hashes only mutated subtree paths.
 """
 import weakref
-from typing import Any, Dict, Optional, Sequence, Tuple, Type
+from typing import Dict, Optional, Sequence, Tuple
 
 from .merkle import (
     IncrementalTree,

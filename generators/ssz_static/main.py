@@ -13,7 +13,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
 
 from consensus_specs_tpu.forks import build_spec
 from consensus_specs_tpu.gen import TestCase, TestProvider, run_generator
-from consensus_specs_tpu.utils.ssz import hash_tree_root, serialize
+from consensus_specs_tpu.utils.ssz import hash_tree_root
 from consensus_specs_tpu.utils.ssz.types import Container
 from consensus_specs_tpu.debug.encode import encode
 from consensus_specs_tpu.debug.random_value import (

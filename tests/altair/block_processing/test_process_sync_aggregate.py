@@ -6,8 +6,7 @@ from consensus_specs_tpu.test_infra.context import (
     spec_state_test, with_all_phases_from, always_bls, never_bls,
 )
 from consensus_specs_tpu.test_infra.block import (
-    build_empty_block_for_next_slot, next_slots,
-)
+    build_empty_block_for_next_slot)
 from consensus_specs_tpu.test_infra.sync_committee import (
     compute_aggregate_sync_committee_signature, compute_committee_indices,
     run_sync_committee_processing,

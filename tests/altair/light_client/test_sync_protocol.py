@@ -20,7 +20,6 @@ from consensus_specs_tpu.test_infra.sync_committee import (
     compute_aggregate_sync_committee_signature, compute_committee_indices,
 )
 from consensus_specs_tpu.utils.ssz import hash_tree_root, compute_merkle_proof
-from consensus_specs_tpu.utils import bls
 
 
 def _advance_chain(spec, state, n_blocks):

@@ -7,9 +7,8 @@ against ``specs/capella/beacon-chain.md:466``.
 from consensus_specs_tpu.test_infra.context import (
     spec_state_test, with_phases, always_bls, expect_assertion_error,
 )
-from consensus_specs_tpu.test_infra.keys import pubkeys, privkeys, pubkey_to_privkey
+from consensus_specs_tpu.test_infra.keys import pubkeys, pubkey_to_privkey
 from consensus_specs_tpu.utils import bls
-from consensus_specs_tpu.utils.hash_function import hash
 
 CHANGE_FORKS = ["capella", "deneb"]
 

@@ -7,8 +7,7 @@ from consensus_specs_tpu.test_infra.context import (
     spec_state_test, with_phases, expect_assertion_error,
 )
 from consensus_specs_tpu.test_infra.execution_payload import (
-    build_empty_execution_payload, compute_el_block_hash,
-)
+    build_empty_execution_payload)
 
 WITHDRAWAL_FORKS = ["capella", "deneb"]
 

@@ -4,8 +4,7 @@ Reference model: ``test/eip6110/block_processing/test_deposit_receipt.py``
 against ``specs/_features/eip6110/beacon-chain.md:194-232``.
 """
 from consensus_specs_tpu.test_infra.context import (
-    spec_state_test, with_phases, always_bls, expect_assertion_error,
-)
+    spec_state_test, with_phases, always_bls)
 from consensus_specs_tpu.test_infra.deposits import build_deposit_data
 from consensus_specs_tpu.test_infra.keys import pubkeys, privkeys
 from consensus_specs_tpu.utils.hash_function import hash

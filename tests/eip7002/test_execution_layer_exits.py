@@ -7,7 +7,6 @@ test_process_execution_layer_exit.py`` against
 from consensus_specs_tpu.test_infra.context import (
     spec_state_test, with_phases,
 )
-from consensus_specs_tpu.test_infra.block import next_epoch
 
 
 def _set_eth1_credentials(spec, state, index, address=b"\x42" * 20):

@@ -3,12 +3,11 @@
 Reference: ``test/phase0/block_processing/test_process_attestation.py``.
 """
 from consensus_specs_tpu.test_infra.context import (
-    spec_state_test, with_all_phases, with_phases, always_bls, never_bls,
-)
+    spec_state_test, with_all_phases, with_phases, always_bls)
 from consensus_specs_tpu.test_infra.attestations import (
     get_valid_attestation, run_attestation_processing, sign_attestation,
 )
-from consensus_specs_tpu.test_infra.block import next_slot, next_slots, next_epoch
+from consensus_specs_tpu.test_infra.block import next_slots, next_epoch
 from consensus_specs_tpu.utils.ssz import Bitlist
 
 

@@ -13,7 +13,6 @@ from consensus_specs_tpu.test_infra.slashings import (
 from consensus_specs_tpu.test_infra.voluntary_exits import (
     prepare_signed_exits, run_voluntary_exit_processing, sign_voluntary_exit,
 )
-from consensus_specs_tpu.test_infra.block import next_slots, next_epoch
 from consensus_specs_tpu.test_infra.keys import privkeys
 
 

@@ -10,7 +10,6 @@ from consensus_specs_tpu.test_infra.voluntary_exits import (
     prepare_signed_exits, sign_voluntary_exit, run_voluntary_exit_processing,
 )
 from consensus_specs_tpu.test_infra.keys import privkeys
-from consensus_specs_tpu.test_infra.block import next_epoch
 
 
 def _age_state(spec, state):

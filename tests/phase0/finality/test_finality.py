@@ -7,7 +7,6 @@ Reference model: ``test/phase0/finality/test_finality.py`` — the
 from consensus_specs_tpu.test_infra.context import (
     spec_state_test, with_all_phases,
 )
-from consensus_specs_tpu.test_infra.block import next_epoch
 from consensus_specs_tpu.test_infra.attestations import (
     next_epoch_with_attestations,
 )

@@ -7,17 +7,12 @@ from consensus_specs_tpu.test_infra.context import (
     spec_state_test, with_all_phases, never_bls,
 )
 from consensus_specs_tpu.test_infra.block import (
-    build_empty_block_for_next_slot, state_transition_and_sign_block,
-    next_epoch, next_slots,
-)
+    build_empty_block_for_next_slot, state_transition_and_sign_block, next_slots)
 from consensus_specs_tpu.test_infra.attestations import (
     get_valid_attestation,
 )
 from consensus_specs_tpu.test_infra.fork_choice import (
-    get_genesis_forkchoice_store_and_block, on_tick_and_append_step,
-    tick_and_add_block, add_attestation, get_genesis_forkchoice_store,
-    apply_next_epoch_with_attestations,
-)
+    get_genesis_forkchoice_store_and_block, on_tick_and_append_step, tick_and_add_block, add_attestation, apply_next_epoch_with_attestations)
 from consensus_specs_tpu.utils.ssz import hash_tree_root
 
 

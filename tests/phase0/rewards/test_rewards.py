@@ -10,7 +10,6 @@ from consensus_specs_tpu.test_infra.context import (
     spec_state_test, with_all_phases,
 )
 from consensus_specs_tpu.test_infra import rewards as rw
-from consensus_specs_tpu.test_infra.block import next_epoch
 
 
 @with_all_phases

@@ -6,12 +6,9 @@ from consensus_specs_tpu.test_infra.context import (
     spec_state_test, with_all_phases, always_bls, expect_assertion_error,
 )
 from consensus_specs_tpu.test_infra.block import (
-    build_empty_block_for_next_slot, build_empty_block,
-    state_transition_and_sign_block, sign_block, next_slot, next_epoch,
-)
+    build_empty_block_for_next_slot, build_empty_block, state_transition_and_sign_block, sign_block, next_epoch)
 from consensus_specs_tpu.test_infra.attestations import get_valid_attestation
 from consensus_specs_tpu.test_infra.slashings import get_valid_proposer_slashing
-from consensus_specs_tpu.utils.ssz import hash_tree_root
 
 
 @with_all_phases

@@ -8,12 +8,9 @@ and non-canonical encodings, subgroup checks.
 import pytest
 
 from consensus_specs_tpu.utils import bls
-from consensus_specs_tpu.ops.bls12_381 import (
-    G1_GENERATOR, G2_GENERATOR, R_ORDER, pairing,
-)
-from consensus_specs_tpu.ops.bls12_381.fields import Fq12
+from consensus_specs_tpu.ops.bls12_381 import G1_GENERATOR, R_ORDER, pairing
 from consensus_specs_tpu.ops.bls12_381.hash_to_curve import hash_to_g2
-from consensus_specs_tpu.ops.bls12_381.curve import G1Point, G2Point
+from consensus_specs_tpu.ops.bls12_381.curve import G1Point
 
 SKS = [1, 2, 3, 12345, R_ORDER - 1]
 MSG_A = b"\xab" * 32

@@ -16,7 +16,7 @@ import jax
 
 from consensus_specs_tpu.ops.bls12_381.fields import P, R_ORDER, Fq2, Fq6, Fq12
 from consensus_specs_tpu.ops.bls12_381.curve import (
-    G1_GENERATOR, G2_GENERATOR, G1Point, G2Point)
+    G1_GENERATOR, G2_GENERATOR, G1Point)
 from consensus_specs_tpu.ops.jax_bls import limbs as L
 from consensus_specs_tpu.ops.jax_bls import tower as T
 from consensus_specs_tpu.ops.jax_bls import points as PT

@@ -11,14 +11,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from consensus_specs_tpu.utils.ssz import (
-    Container, List, Vector, Bitlist, uint8, uint64, Bytes32,
-    hash_tree_root,
-    get_generalized_index, concat_generalized_indices,
-    get_generalized_index_length, generalized_index_sibling,
-    generalized_index_child, generalized_index_parent,
-    verify_merkle_proof, compute_merkle_proof, get_subtree_node_root,
-    get_helper_indices, verify_merkle_multiproof,
-)
+    Container, List, Vector, Bitlist, uint64, Bytes32, hash_tree_root, get_generalized_index, concat_generalized_indices, get_generalized_index_length, generalized_index_sibling, generalized_index_child, generalized_index_parent, verify_merkle_proof, compute_merkle_proof, get_subtree_node_root, get_helper_indices, verify_merkle_multiproof)
 
 
 class Inner(Container):

@@ -8,11 +8,7 @@ from hashlib import sha256
 import pytest
 
 from consensus_specs_tpu.utils.ssz import (
-    boolean, uint8, uint16, uint32, uint64, uint256,
-    Bytes32, Bytes48, ByteList, ByteVector,
-    Bitvector, Bitlist, Vector, List, Container, Union,
-    serialize, hash_tree_root, deserialize, uint_to_bytes,
-)
+    boolean, uint8, uint16, uint32, uint64, uint256, Bytes32, Bytes48, ByteList, Bitvector, Bitlist, Vector, List, Container, Union, serialize, hash_tree_root, deserialize, uint_to_bytes)
 
 
 def h(a, b):

@@ -12,7 +12,7 @@ import random
 from consensus_specs_tpu.utils.ssz.merkle import (
     IncrementalTree, merkleize_chunks, zero_hashes)
 from consensus_specs_tpu.utils.ssz import (
-    Bitlist, Bytes32, Container, List, Vector, uint64, hash_tree_root)
+    Bitlist, Bytes32, Container, List, Vector, uint64)
 
 
 class Inner(Container):

@@ -12,11 +12,7 @@ import pytest
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from consensus_specs_tpu.utils.ssz import (
-    serialize, deserialize, hash_tree_root,
-    boolean, uint8, uint16, uint32, uint64, uint128, uint256,
-    Bitlist, Bitvector, ByteList, ByteVector, Vector, List, Container, Union,
-    Bytes32,
-)
+    serialize, deserialize, hash_tree_root, uint8, uint16, uint32, uint64, uint128, uint256, Bitlist, Bitvector, ByteList, ByteVector, Vector, List, Container, Union, Bytes32)
 
 
 @pytest.mark.parametrize("typ,bits", [

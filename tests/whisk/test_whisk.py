@@ -37,7 +37,7 @@ def _fill_shuffle(spec, state, block):
     pre = [state.whisk_candidate_trackers[i] for i in indices]
     n = len(pre)
     post, proof = whisk_proofs.GenerateWhiskShuffleProof(
-        pre, list(range(n)), [7 + i for i in range(n)])
+        pre, list(range(n)), 7)
     block.body.whisk_post_shuffle_trackers = [
         spec.WhiskTracker(r_G=r, k_r_G=krg) for r, krg in post]
     block.body.whisk_shuffle_proof = proof
@@ -212,11 +212,14 @@ def test_shuffle_proof_rejects_non_permutation():
              G1_GENERATOR.mult(3 * i + 5).to_compressed())
            for i in range(4)]
     post, proof = whisk_proofs.GenerateWhiskShuffleProof(
-        pre, [2, 0, 3, 1], [11, 12, 13, 14])
+        pre, [2, 0, 3, 1], 11)
     post_t = [T(r, k) for r, k in post]
     assert whisk_proofs.IsValidWhiskShuffleProof(pre, post_t, proof)
-    # repeated permutation index must fail
+    # a proof is bound to its instance: swapping two post trackers
+    # breaks the permutation relation and must fail
+    swapped = [post_t[1], post_t[0]] + post_t[2:]
+    assert not whisk_proofs.IsValidWhiskShuffleProof(pre, swapped, proof)
+    # tampered proof bytes must fail
     bad = bytearray(proof)
-    bad[0:8] = (0).to_bytes(8, "little")
-    bad[40:48] = (0).to_bytes(8, "little")
+    bad[60] ^= 0x01
     assert not whisk_proofs.IsValidWhiskShuffleProof(pre, post_t, bytes(bad))
