@@ -1,20 +1,27 @@
 """Benchmark: batched FastAggregateVerify throughput (BASELINE config #1).
 
 Measures aggregate-signature verifications/second with the fastest
-available backend (JAX/TPU when the accelerator answers, JAX on host CPU
-otherwise) against the pure-python oracle (the reference's py_ecc role,
-``BASELINE.md``: ">=50x py_ecc" north star; backend ladder being replaced:
-reference ``eth2spec/utils/bls.py:35-53``).
+available backend against the pure-python oracle (the reference's
+py_ecc role, ``BASELINE.md``: ">=50x py_ecc" north star; backend ladder
+being replaced: reference ``eth2spec/utils/bls.py:35-53``).
 
 Prints exactly ONE JSON line on stdout, ALWAYS, inside a wall-clock
-budget (``CS_TPU_BENCH_BUDGET`` seconds, default 480): a watchdog thread
-emits whatever has been measured so far (``"partial": true``) and exits
-the process if the full pipeline doesn't fit - a cold XLA compile on a
-slow host must never turn the benchmark artifact into an rc=124 null
-(the round-1..3 failure mode).
+budget (``CS_TPU_BENCH_BUDGET`` seconds, default 480):
+
+* a watchdog thread emits whatever has been measured so far
+  (``"partial": true``) and exits if the pipeline doesn't fit — a cold
+  XLA compile or a wedged accelerator tunnel must never turn the
+  benchmark artifact into an rc=124 null (the round-1..3 failure mode);
+* the device measurement runs in a KILLABLE SUBPROCESS per platform:
+  the accelerator gets the first slice of the budget, and on timeout or
+  failure the warm host-CPU cache gets the rest — so a flaky tunnel
+  degrades the number, not the artifact;
+* the deterministic key/signature inputs are precomputed
+  (``tools/bench_fixtures.json``), saving minutes of pure-python setup.
 """
 import json
 import os
+import subprocess
 import sys
 import threading
 import time
@@ -51,81 +58,126 @@ def _emit_and_exit(code=0):
 
 
 def _watchdog():
-    # wake early enough to flush; os._exit skips atexit/XLA teardown, which
-    # is exactly right when a compile is wedged in C++ with the GIL held.
+    # wake early enough to flush; os._exit skips atexit/XLA teardown,
+    # which is exactly right when a compile is wedged in C++.
     delay = max(1.0, _remaining() - 2.0)
     time.sleep(delay)
     _RESULT["stage"] += " (budget expired)"
     _emit_and_exit(0)
 
 
-def main():
-    threading.Thread(target=_watchdog, daemon=True).start()
-
+def _measure_inner():
+    """Subprocess body: measure the batched verify on THIS process's
+    JAX platform; print one JSON line."""
     from consensus_specs_tpu.utils.jax_env import (
         setup_compile_cache, ensure_working_backend)
     setup_compile_cache()
-    # If the accelerator tunnel is down, backend init hangs forever; probe
-    # it in a subprocess and fall back to host CPU.
-    probe_budget = int(min(90, max(10, _remaining() / 4)))
-    ensure_working_backend(timeout=probe_budget)
+    ensure_working_backend(timeout=60)
     import jax
-    _RESULT["platform"] = jax.default_backend()
-    _RESULT["stage"] = "backend-ready"
-
-    from consensus_specs_tpu.utils import bls
+    from consensus_specs_tpu.tools import bench_fixtures
     from consensus_specs_tpu.ops import bls_jax
 
-    bls.use_py()
-    n_keys = 64
-    msg = b"bench-attestation-root"
-    sks = list(range(1, 1 + n_keys))
-    pks = [bls.SkToPk(sk) for sk in sks]
-    agg = bls.Aggregate([bls.Sign(sk, msg) for sk in sks])
+    pks, msg, agg = bench_fixtures.load()
+    batch = bls_jax.bucket_b()
+    items = [(pks, msg, agg)] * batch
+    t0 = time.time()
+    out = bls_jax.verify_aggregates_batch(items)   # compile + dispatch
+    warm_s = time.time() - t0
+    assert all(out), "bench verification must pass"
+    reps, t_acc = 0, 0.0
+    deadline = float(os.environ.get("CS_TPU_BENCH_INNER_DEADLINE", "inf"))
+    while reps < 5 and (reps == 0 or
+                        time.time() + t_acc / reps < deadline - 2):
+        t0 = time.time()
+        bls_jax.verify_aggregates_batch(items)
+        t_acc += time.time() - t0
+        reps += 1
+    print(json.dumps({
+        "platform": jax.default_backend(),
+        "batch": batch,
+        "warm_s": round(warm_s, 1),
+        "reps": reps,
+        "per_sec": batch / (t_acc / reps),
+    }), flush=True)
 
-    # --- python-oracle baseline: warmed (decompression caches populated),
-    # then median of repeated runs ---------------------------------------
+
+def _try_platform(env_overrides, timeout):
+    env = dict(os.environ, CS_TPU_BENCH_INNER="1", **env_overrides)
+    env["CS_TPU_BENCH_INNER_DEADLINE"] = str(time.time() + timeout)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, timeout=timeout, capture_output=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return None, "timeout"
+    if proc.returncode != 0:
+        return None, proc.stderr.decode()[-300:]
+    for line in reversed(proc.stdout.decode().splitlines()):
+        try:
+            return json.loads(line), None
+        except json.JSONDecodeError:
+            continue
+    return None, "no-json"
+
+
+def main():
+    threading.Thread(target=_watchdog, daemon=True).start()
+
+    from consensus_specs_tpu.utils import bls
+    from consensus_specs_tpu.tools import bench_fixtures
+    bls.use_py()
+    pks, msg, agg = bench_fixtures.load()
+    _RESULT["stage"] = "fixtures-loaded"
+
+    # --- python-oracle baseline: warmed, then median of up to 3 runs --
     assert bls.FastAggregateVerify(pks, msg, agg)
     py_times = []
     for _ in range(3):
         t0 = time.time()
         bls.FastAggregateVerify(pks, msg, agg)
         py_times.append(time.time() - t0)
-        if _remaining() < BUDGET * 0.5:
+        if _remaining() < BUDGET * 0.55:
             break
     py_per_verify = sorted(py_times)[len(py_times) // 2]
     _RESULT["py_oracle_s_per_verify"] = round(py_per_verify, 3)
     _RESULT["stage"] = "oracle-measured"
 
-    # --- JAX backend: warm (compile) then measure steady-state ----------
-    batch = bls_jax.bucket_b()
-    _RESULT["metric"] = f"FastAggregateVerify (64 pubkeys, batch {batch})"
-    items = [(pks, msg, agg)] * batch
-    t0 = time.time()
-    out = bls_jax.verify_aggregates_batch(items)   # compile + first dispatch
-    warm_s = time.time() - t0
-    assert all(out), "bench verification must pass"
-    _RESULT["stage"] = "jax-warm"
-    _RESULT["jax_warm_s"] = round(warm_s, 1)
-    # First measurement immediately (so even one rep beats an empty line),
-    # then refine with more reps while budget remains.
-    reps_done, t_acc = 0, 0.0
-    while reps_done < 5 and (reps_done == 0 or _remaining() > t_acc / reps_done + 5):
-        t0 = time.time()
-        bls_jax.verify_aggregates_batch(items)
-        t_acc += time.time() - t0
-        reps_done += 1
-        per_sec = batch / (t_acc / reps_done)
+    # --- device measurement: accelerator first, warm CPU as fallback --
+    attempts = [("cpu", {"JAX_PLATFORMS": "cpu"})]
+    if os.environ.get("JAX_PLATFORMS") != "cpu":
+        # accelerator (tunnel) attempt gets the first ~55% of what's left
+        attempts.insert(0, ("default", {}))
+    for i, (name, overrides) in enumerate(attempts):
+        remaining_attempts = len(attempts) - i
+        slice_s = max(45.0, _remaining() * (0.55 if remaining_attempts > 1
+                                            else 0.9))
+        slice_s = min(slice_s, max(30.0, _remaining() - 15))
+        _RESULT["stage"] = f"measuring-{name}"
+        data, err = _try_platform(overrides, slice_s)
+        if data is None:
+            _RESULT[f"attempt_{name}"] = (err or "")[:200]
+            continue
+        per_sec = data["per_sec"]
+        _RESULT["metric"] = (
+            f"FastAggregateVerify (64 pubkeys, batch {data['batch']})")
         _RESULT["value"] = round(per_sec, 3)
         _RESULT["vs_baseline"] = round(per_sec * py_per_verify, 2)
-        _RESULT["stage"] = f"jax-measured-{reps_done}"
-    _RESULT["partial"] = False
+        _RESULT["platform"] = data["platform"]
+        _RESULT["jax_warm_s"] = data["warm_s"]
+        _RESULT["reps"] = data["reps"]
+        _RESULT["partial"] = False
+        _RESULT["stage"] = f"measured-{data['platform']}"
+        break
     _emit_and_exit(0)
 
 
 if __name__ == "__main__":
-    try:
-        main()
-    except Exception as e:  # emit whatever we had, plus the error
-        _RESULT["error"] = f"{type(e).__name__}: {e}"[:300]
-        _emit_and_exit(0)
+    if os.environ.get("CS_TPU_BENCH_INNER") == "1":
+        _measure_inner()
+    else:
+        try:
+            main()
+        except Exception as e:  # emit whatever we had, plus the error
+            _RESULT["error"] = f"{type(e).__name__}: {e}"[:300]
+            _emit_and_exit(0)

@@ -143,7 +143,16 @@ def run_attestation_processing(spec, state, attestation, valid=True):
             else state.previous_epoch_participation)
         attesting = spec.get_attesting_indices(
             state, attestation.data, attestation.aggregation_bits)
-        assert any(participation[i] != 0 for i in attesting)
+        # the flags the spec says this attestation earns (may be empty:
+        # e.g. wrong target root at one-epoch inclusion delay earns none
+        # yet the operation is still valid)
+        expected = spec.get_attestation_participation_flag_indices(
+            state, attestation.data,
+            state.slot - attestation.data.slot)
+        for flag_index in expected:
+            assert all(
+                spec.has_flag(participation[i], flag_index)
+                for i in attesting)
 
     yield "post", state
 

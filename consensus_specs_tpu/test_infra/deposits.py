@@ -80,16 +80,25 @@ def deposit_from_context(spec, deposit_data_list, index):
 
 
 def prepare_full_genesis_deposits(spec, amount, deposit_count, signed=False,
-                                  duplicate_last=False):
+                                  duplicate_last=False,
+                                  deposit_data_list=None,
+                                  min_pubkey_index=0):
     """Build ``deposit_count`` genesis deposits whose proofs verify against
     the incrementally-growing deposit tree, the way
     ``initialize_beacon_state_from_eth1`` consumes them
-    (reference helpers/deposits.py prepare_full_genesis_deposits)."""
-    deposit_data_list = []
+    (reference helpers/deposits.py prepare_full_genesis_deposits).
+
+    ``deposit_data_list`` continues an existing deposit tree (for mixed
+    batches: full-balance then small-balance/top-up deposits);
+    ``min_pubkey_index`` offsets into the test key pool so batches can
+    target fresh or repeated keys."""
+    deposit_data_list = deposit_data_list if deposit_data_list is not None \
+        else []
     genesis_deposits = []
     for index in range(deposit_count):
-        key_index = index if not (duplicate_last
-                                  and index == deposit_count - 1) else index - 1
+        key_index = min_pubkey_index + (
+            index if not (duplicate_last and index == deposit_count - 1)
+            else index - 1)
         pubkey = pubkeys[key_index]
         privkey = privkeys[key_index]
         withdrawal_credentials = spec.BLS_WITHDRAWAL_PREFIX + hash(pubkey)[1:]
@@ -98,11 +107,11 @@ def prepare_full_genesis_deposits(spec, amount, deposit_count, signed=False,
             signed=signed)
         deposit_data_list.append(deposit_data)
         # genesis proof: against the tree of deposits seen SO FAR
-        # (the list holds exactly index+1 items here).  NOTE: keyed off the
+        # (the list holds exactly len so far).  NOTE: keyed off the
         # 8192-entry test key pool and O(n^2) tree rebuilds — minimal-preset
         # genesis counts only (callers guard with @with_presets).
         deposit, root, _ = deposit_from_context(
-            spec, deposit_data_list, index)
+            spec, deposit_data_list, len(deposit_data_list) - 1)
         genesis_deposits.append(deposit)
     return genesis_deposits, root, deposit_data_list
 

@@ -1,0 +1,51 @@
+"""Precomputed bench inputs (deterministic: sks 1..64, fixed message).
+
+The pure-python key/signature setup for bench.py costs minutes on a slow
+host (64 G1 multiplications + 64 G2 signatures); the inputs are fully
+deterministic, so they are generated once into ``bench_fixtures.json``
+next to this module and loaded thereafter.  ``python -m
+consensus_specs_tpu.tools.bench_fixtures`` regenerates the file (run it
+whenever N_KEYS/MSG change).
+"""
+import json
+import os
+
+N_KEYS = 64
+MSG = b"bench-attestation-root"
+_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "bench_fixtures.json")
+
+
+def load():
+    """(pubkeys, msg, aggregate_signature) — from the fixture file when
+    present and matching, computed live otherwise."""
+    if os.path.exists(_PATH):
+        with open(_PATH) as f:
+            data = json.load(f)
+        if data.get("n_keys") == N_KEYS \
+                and bytes.fromhex(data["msg"]) == MSG:
+            return ([bytes.fromhex(p) for p in data["pubkeys"]],
+                    MSG, bytes.fromhex(data["aggregate"]))
+    return _compute()
+
+
+def _compute():
+    from consensus_specs_tpu.utils import bls
+    bls.use_py()
+    sks = list(range(1, 1 + N_KEYS))
+    pks = [bls.SkToPk(sk) for sk in sks]
+    agg = bls.Aggregate([bls.Sign(sk, MSG) for sk in sks])
+    return pks, MSG, agg
+
+
+def main():
+    pks, msg, agg = _compute()
+    with open(_PATH, "w") as f:
+        json.dump({"n_keys": N_KEYS, "msg": msg.hex(),
+                   "pubkeys": [bytes(p).hex() for p in pks],
+                   "aggregate": bytes(agg).hex()}, f, indent=1)
+    print(f"wrote {_PATH}")
+
+
+if __name__ == "__main__":
+    main()

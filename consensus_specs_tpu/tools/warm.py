@@ -25,15 +25,10 @@ def _log(msg):
 
 def warm_bench(batch=None):
     """Compile the batched FastAggregateVerify pipeline bench.py measures."""
-    from consensus_specs_tpu.utils import bls
     from consensus_specs_tpu.ops import bls_jax
+    from consensus_specs_tpu.tools import bench_fixtures
 
-    bls.use_py()
-    n_keys = 64
-    msg = b"bench-attestation-root"
-    sks = list(range(1, 1 + n_keys))
-    pks = [bls.SkToPk(sk) for sk in sks]
-    agg = bls.Aggregate([bls.Sign(sk, msg) for sk in sks])
+    pks, msg, agg = bench_fixtures.load()
     b = batch or bls_jax.bucket_b()
     t0 = time.time()
     out = bls_jax.verify_aggregates_batch([(pks, msg, agg)] * b)
@@ -44,14 +39,30 @@ def warm_bench(batch=None):
 def warm_dryrun(n_devices=8):
     """Compile the sharded dryrun step on the virtual CPU mesh.
 
-    Calls the INNER compiled path directly, with no budget: paying the
-    cold compile in full is this tool's entire job - the budgeted
-    wrapper would time out and "succeed" through the eager fallback
-    without caching anything on exactly the hosts that need warming.
+    Runs the INNER compiled path with no budget: paying the cold
+    compile in full is this tool's entire job - the budgeted wrapper
+    would time out and "succeed" through the eager fallback without
+    caching anything on exactly the hosts that need warming.  Runs in a
+    SUBPROCESS because the virtual-device-count flag must be set before
+    the CPU backend initializes, and warm_bench has already initialized
+    it in this process.
     """
-    import __graft_entry__ as g
+    import subprocess
     t0 = time.time()
-    g._dryrun_inner(n_devices)
+    env = dict(os.environ, CS_TPU_DRYRUN_INNER="1")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         f"import __graft_entry__ as g; g.dryrun_multichip({n_devices})"],
+        cwd=here, env=env)
+    if proc.returncode != 0:
+        raise RuntimeError(f"dryrun warm failed rc={proc.returncode}")
     _log(f"dryrun_multichip({n_devices}) compiled path: "
          f"{time.time() - t0:.1f}s")
 

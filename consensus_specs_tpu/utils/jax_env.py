@@ -108,7 +108,11 @@ def ensure_working_backend(timeout: int = 90) -> str:
     """
     global _PROBE_RESULT
     if os.environ.get("JAX_PLATFORMS") == "cpu":
-        return "cpu"  # already pinned; nothing to probe
+        # the env var alone is NOT binding in this container (the
+        # accelerator plugin's sitecustomize overrides it through
+        # jax.config) — push cpu through the config as well
+        force_cpu_platform()
+        return "cpu"
     if _PROBE_RESULT is not None:
         return _PROBE_RESULT
     import subprocess

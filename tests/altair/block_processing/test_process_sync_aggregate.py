@@ -99,3 +99,153 @@ def test_empty_sync_aggregate_infinity_sig(spec, state):
     )
     spec.process_slots(state, block.slot)
     yield from run_sync_committee_processing(spec, state, block)
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_invalid_signature_extra_participant(spec, state):
+    committee_indices = compute_committee_indices(state)
+    block = build_empty_block_for_next_slot(spec, state)
+    # one bit cleared, but the "absent" member still signed
+    bits = [True] * len(committee_indices)
+    bits[0] = False
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, committee_indices),
+    )
+    spec.process_slots(state, block.slot)
+    yield from run_sync_committee_processing(spec, state, block,
+                                             expect_exception=True)
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_invalid_signature_infinite_signature_with_all_participants(
+        spec, state):
+    committee_indices = compute_committee_indices(state)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * len(committee_indices),
+        sync_committee_signature=spec.BLSSignature(
+            b"\xc0" + b"\x00" * 95),  # point at infinity
+    )
+    spec.process_slots(state, block.slot)
+    yield from run_sync_committee_processing(spec, state, block,
+                                             expect_exception=True)
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_invalid_signature_infinite_signature_with_single_participant(
+        spec, state):
+    committee_indices = compute_committee_indices(state)
+    block = build_empty_block_for_next_slot(spec, state)
+    bits = [False] * len(committee_indices)
+    bits[0] = True
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=spec.BLSSignature(
+            b"\xc0" + b"\x00" * 95),
+    )
+    spec.process_slots(state, block.slot)
+    yield from run_sync_committee_processing(spec, state, block,
+                                             expect_exception=True)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committee_rewards_nonduplicate_committee(spec, state):
+    # proposer reward accounting: proposer earns a cut per participant
+    committee_indices = compute_committee_indices(state)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * len(committee_indices),
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, committee_indices),
+    )
+    spec.process_slots(state, block.slot)
+    proposer = spec.get_beacon_proposer_index(state)
+    pre_proposer = int(state.balances[proposer])
+    yield from run_sync_committee_processing(spec, state, block)
+    assert int(state.balances[proposer]) > pre_proposer
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committee_rewards_empty_participants(spec, state):
+    # no participants: every committee member is penalized, none rewarded
+    committee_indices = compute_committee_indices(state)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[False] * len(committee_indices),
+        sync_committee_signature=spec.G2_POINT_AT_INFINITY
+        if hasattr(spec, "G2_POINT_AT_INFINITY")
+        else spec.BLSSignature(b"\xc0" + b"\x00" * 95),
+    )
+    spec.process_slots(state, block.slot)
+    pre = [int(state.balances[i]) for i in committee_indices]
+    yield from run_sync_committee_processing(spec, state, block)
+    post = [int(state.balances[i]) for i in committee_indices]
+    assert all(b <= a for a, b in zip(pre, post))
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committee_rewards_duplicate_committee_members(spec, state):
+    # minimal registries repeat members in the sync committee: rewards
+    # accrue once PER SLOT in the committee, not once per validator
+    committee = state.current_sync_committee.pubkeys
+    committee_indices = compute_committee_indices(state)
+    duplicated = len(committee) != len(set(bytes(p) for p in committee))
+    if not duplicated:
+        # registry large enough that no duplicates occur — nothing to test
+        return
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * len(committee_indices),
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, committee_indices),
+    )
+    spec.process_slots(state, block.slot)
+    from collections import Counter
+    multiplicity = Counter(committee_indices)
+    index, count = multiplicity.most_common(1)[0]
+    assert count >= 2
+    single_index = min(i for i in committee_indices
+                       if multiplicity[i] == 1) \
+        if any(multiplicity[i] == 1 for i in committee_indices) else None
+    pre = int(state.balances[index])
+    pre_single = int(state.balances[single_index]) \
+        if single_index is not None else None
+    proposer = spec.get_beacon_proposer_index(state)
+    yield from run_sync_committee_processing(spec, state, block)
+    gain = int(state.balances[index]) - pre
+    if single_index is not None and single_index != proposer \
+            and index != proposer:
+        single_gain = int(state.balances[single_index]) - pre_single
+        assert gain == count * single_gain
+
+
+@with_altair_and_later
+@spec_state_test
+def test_proposer_in_committee_with_participation(spec, state):
+    committee_indices = compute_committee_indices(state)
+    block = build_empty_block_for_next_slot(spec, state)
+    spec.process_slots(state, block.slot)
+    proposer = spec.get_beacon_proposer_index(state)
+    if proposer not in committee_indices:
+        return  # committee draw excluded the proposer this slot
+    state_copy = state.copy()
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * len(committee_indices),
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state_copy, block.slot - 1, committee_indices),
+    )
+    pre = int(state.balances[proposer])
+    yield from run_sync_committee_processing(spec, state, block)
+    # proposer earns both the participant reward and the proposer cut
+    assert int(state.balances[proposer]) > pre

@@ -133,3 +133,275 @@ def test_previous_epoch_attestation(spec, state):
     yield from run_attestation_processing(spec, state, attestation)
     if spec.fork == "phase0":
         assert len(state.previous_epoch_attestations) == 1
+
+
+@with_all_phases
+@spec_state_test
+def test_multi_proposer_index_iterations(spec, state):
+    # start deeper into the epoch structure so proposer-index search
+    # iterates (reference scenario of the same name)
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH * 2)
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_empty_participants_zeroes_sig(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    committee_len = len(attestation.aggregation_bits)
+    attestation.aggregation_bits = Bitlist[spec.MAX_VALIDATORS_PER_COMMITTEE](
+        [0] * committee_len)
+    attestation.signature = spec.BLSSignature(b"\x00" * 96)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_phases(["phase0", "altair", "bellatrix", "capella"])
+@spec_state_test
+def test_at_max_inclusion_slot(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    # exactly data.slot + SLOTS_PER_EPOCH is still includable pre-deneb
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_wrong_index_for_committee_signature(spec, state):
+    # signature is over index 0; flipping the index afterwards must fail
+    # the (real) signature check
+    attestation = get_valid_attestation(spec, state, signed=True)
+    attestation.data.index += 1
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_index(spec, state):
+    attestation = get_valid_attestation(spec, state)
+    # committee index out of range for the slot
+    attestation.data.index = spec.get_committee_count_per_slot(
+        state, spec.get_current_epoch(state))
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_mismatched_target_and_slot(spec, state):
+    next_epoch(spec, state)
+    attestation = get_valid_attestation(
+        spec, state, slot=state.slot - spec.SLOTS_PER_EPOCH + 1)
+    # slot is in the previous epoch but target says current epoch
+    attestation.data.target.epoch += 1
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_old_target_epoch(spec, state):
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH * 2)
+    attestation = get_valid_attestation(spec, state)
+    attestation.data.target.epoch = spec.get_previous_epoch(state) - 1
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_future_target_epoch(spec, state):
+    attestation = get_valid_attestation(spec, state)
+    attestation.data.target.epoch = spec.get_current_epoch(state) + 1
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_new_source_epoch(spec, state):
+    attestation = get_valid_attestation(spec, state)
+    attestation.data.source.epoch += 1
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_source_root_is_target_root(spec, state):
+    attestation = get_valid_attestation(spec, state)
+    attestation.data.source.root = attestation.data.target.root
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_current_source_root(spec, state):
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH * 5)
+    state.finalized_checkpoint.epoch = 2
+    state.previous_justified_checkpoint = spec.Checkpoint(
+        epoch=3, root=b"\x01" * 32)
+    state.current_justified_checkpoint = spec.Checkpoint(
+        epoch=4, root=b"\x32" * 32)
+    attestation = get_valid_attestation(spec, state, slot=state.slot)
+    # correct epoch but wrong root for the current justified checkpoint
+    attestation.data.source.root = b"\x99" * 32
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_previous_source_root(spec, state):
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH * 5)
+    state.finalized_checkpoint.epoch = 2
+    state.previous_justified_checkpoint = spec.Checkpoint(
+        epoch=3, root=b"\x01" * 32)
+    state.current_justified_checkpoint = spec.Checkpoint(
+        epoch=4, root=b"\x32" * 32)
+    # attestation for the previous epoch must match the PREVIOUS
+    # justified checkpoint; give it the current one's root instead
+    attestation = get_valid_attestation(
+        spec, state, slot=state.slot - spec.SLOTS_PER_EPOCH)
+    assert attestation.data.source.epoch == 3
+    attestation.data.source.root = state.current_justified_checkpoint.root
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+def _sqrt_epoch_delay(spec):
+    return spec.integer_squareroot(spec.SLOTS_PER_EPOCH)
+
+
+def _run_delay_matrix_case(spec, state, delay, wrong_head=False,
+                           wrong_target=False, valid=True):
+    """Correct/incorrect head/target attestations at a given inclusion
+    delay.  Wrong head/target roots are NOT operation-invalid (they only
+    affect rewards/participation flags), so these cases are valid unless
+    the inclusion window is exceeded."""
+    attestation = get_valid_attestation(spec, state)
+    if wrong_head:
+        attestation.data.beacon_block_root = b"\x42" * 32
+    if wrong_target:
+        attestation.data.target.root = b"\x73" * 32
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, delay)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=valid)
+
+
+@with_all_phases
+@spec_state_test
+def test_correct_attestation_included_at_sqrt_epoch_delay(spec, state):
+    yield from _run_delay_matrix_case(spec, state, _sqrt_epoch_delay(spec))
+
+
+@with_all_phases
+@spec_state_test
+def test_correct_attestation_included_at_one_epoch_delay(spec, state):
+    yield from _run_delay_matrix_case(spec, state, spec.SLOTS_PER_EPOCH)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_head_included_at_min_inclusion_delay(spec, state):
+    yield from _run_delay_matrix_case(
+        spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY, wrong_head=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_head_included_at_sqrt_epoch_delay(spec, state):
+    yield from _run_delay_matrix_case(
+        spec, state, _sqrt_epoch_delay(spec), wrong_head=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_head_included_at_max_inclusion_slot(spec, state):
+    yield from _run_delay_matrix_case(
+        spec, state, spec.SLOTS_PER_EPOCH, wrong_head=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_target_included_at_min_inclusion_delay(spec, state):
+    yield from _run_delay_matrix_case(
+        spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY, wrong_target=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_target_included_at_sqrt_epoch_delay(spec, state):
+    yield from _run_delay_matrix_case(
+        spec, state, _sqrt_epoch_delay(spec), wrong_target=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_target_included_at_epoch_delay(spec, state):
+    yield from _run_delay_matrix_case(
+        spec, state, spec.SLOTS_PER_EPOCH, wrong_target=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_head_and_target_min_inclusion_delay(spec, state):
+    yield from _run_delay_matrix_case(
+        spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY,
+        wrong_head=True, wrong_target=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_head_and_target_included_at_sqrt_epoch_delay(spec, state):
+    yield from _run_delay_matrix_case(
+        spec, state, _sqrt_epoch_delay(spec),
+        wrong_head=True, wrong_target=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_head_and_target_included_at_epoch_delay(spec, state):
+    yield from _run_delay_matrix_case(
+        spec, state, spec.SLOTS_PER_EPOCH,
+        wrong_head=True, wrong_target=True)
+
+
+@with_phases(["phase0", "altair", "bellatrix", "capella"])
+@spec_state_test
+def test_invalid_incorrect_head_included_after_max_inclusion_slot(spec, state):
+    yield from _run_delay_matrix_case(
+        spec, state, spec.SLOTS_PER_EPOCH + 1, wrong_head=True, valid=False)
+
+
+@with_phases(["phase0", "altair", "bellatrix", "capella"])
+@spec_state_test
+def test_invalid_incorrect_target_included_after_max_inclusion_slot(
+        spec, state):
+    yield from _run_delay_matrix_case(
+        spec, state, spec.SLOTS_PER_EPOCH + 1, wrong_target=True,
+        valid=False)
+
+
+@with_phases(["phase0", "altair", "bellatrix", "capella"])
+@spec_state_test
+def test_invalid_incorrect_head_and_target_after_max_inclusion_slot(
+        spec, state):
+    yield from _run_delay_matrix_case(
+        spec, state, spec.SLOTS_PER_EPOCH + 1, wrong_head=True,
+        wrong_target=True, valid=False)

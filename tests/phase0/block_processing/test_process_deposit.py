@@ -83,3 +83,153 @@ def test_invalid_wrong_deposit_for_deposit_count(spec, state):
     state.eth1_deposit_index = 1
     yield from run_deposit_processing(
         spec, state, deposit, validator_index, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit_over_max(spec, state):
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE + 1
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+    # balance carries the excess; effective balance is capped
+    assert state.balances[validator_index] == amount
+    assert state.validators[validator_index].effective_balance \
+        == spec.MAX_EFFECTIVE_BALANCE
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit_eth1_withdrawal_credentials(spec, state):
+    validator_index = len(state.validators)
+    withdrawal_credentials = (
+        spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + b"\x59" * 20)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, amount,
+        withdrawal_credentials=withdrawal_credentials, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+    assert state.validators[validator_index].withdrawal_credentials \
+        == withdrawal_credentials
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit_non_versioned_withdrawal_credentials(spec, state):
+    # any credentials bytes are accepted at deposit time (versioning is
+    # enforced at withdrawal, not here)
+    validator_index = len(state.validators)
+    withdrawal_credentials = b"\xff" * 32
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, amount,
+        withdrawal_credentials=withdrawal_credentials, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_top_up__less_effective_balance(spec, state):
+    validator_index = 0
+    amount = spec.MAX_EFFECTIVE_BALANCE // 4
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, amount, signed=True)
+    initial = spec.MAX_EFFECTIVE_BALANCE - 1000
+    state.balances[validator_index] = initial
+    state.validators[validator_index].effective_balance = \
+        initial - initial % spec.EFFECTIVE_BALANCE_INCREMENT
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+    assert state.balances[validator_index] == initial + amount
+    # effective balance only updates at the epoch boundary
+    assert state.validators[validator_index].effective_balance \
+        == initial - initial % spec.EFFECTIVE_BALANCE_INCREMENT
+
+
+@with_all_phases
+@spec_state_test
+def test_top_up__zero_balance(spec, state):
+    validator_index = 0
+    amount = spec.MAX_EFFECTIVE_BALANCE // 4
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, amount, signed=True)
+    state.balances[validator_index] = 0
+    state.validators[validator_index].effective_balance = 0
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+    assert state.balances[validator_index] == amount
+    assert state.validators[validator_index].effective_balance == 0
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_incorrect_sig_top_up(spec, state):
+    # a top-up to an existing validator skips signature verification:
+    # the deposit is still EFFECTIVE despite the bad signature
+    validator_index = 0
+    amount = spec.MAX_EFFECTIVE_BALANCE // 4
+    deposit = prepare_state_and_deposit(spec, state, validator_index, amount)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_incorrect_withdrawal_credentials_top_up(spec, state):
+    # top-ups do not check withdrawal credentials; balance still credited
+    validator_index = 0
+    amount = spec.MAX_EFFECTIVE_BALANCE // 4
+    withdrawal_credentials = spec.BLS_WITHDRAWAL_PREFIX + b"\x77" * 31
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, amount,
+        withdrawal_credentials=withdrawal_credentials, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_key_validate_invalid_subgroup(spec, state):
+    # identity-pubkey deposit: KeyValidate must reject it, deposit is
+    # ineffective (no new validator) but the operation itself succeeds
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    pubkey = b"\xc0" + b"\x00" * 47  # compressed point at infinity
+    deposit_data_list = []
+    from consensus_specs_tpu.test_infra.deposits import deposit_from_context
+    deposit_data = spec.DepositData(
+        pubkey=pubkey,
+        withdrawal_credentials=spec.BLS_WITHDRAWAL_PREFIX + b"\x11" * 31,
+        amount=amount,
+        signature=b"\x00" * 96,
+    )
+    deposit_data_list.append(deposit_data)
+    deposit, root, _ = deposit_from_context(spec, deposit_data_list, 0)
+    state.eth1_deposit_index = 0
+    state.eth1_data.deposit_root = root
+    state.eth1_data.deposit_count = 1
+    yield from run_deposit_processing(
+        spec, state, deposit, validator_index, effective=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_key_validate_invalid_decompression(spec, state):
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    # 0xff... has the compression flag set but an x >= field modulus:
+    # decompression must fail KeyValidate
+    from consensus_specs_tpu.test_infra.deposits import deposit_from_context
+    deposit_data = spec.DepositData(
+        pubkey=b"\xff" * 48,
+        withdrawal_credentials=spec.BLS_WITHDRAWAL_PREFIX + b"\x11" * 31,
+        amount=amount,
+        signature=b"\x00" * 96,
+    )
+    deposit_data_list = [deposit_data]
+    deposit, root, _ = deposit_from_context(spec, deposit_data_list, 0)
+    state.eth1_deposit_index = 0
+    state.eth1_data.deposit_root = root
+    state.eth1_data.deposit_count = 1
+    yield from run_deposit_processing(
+        spec, state, deposit, validator_index, effective=False)

@@ -14,6 +14,7 @@ from consensus_specs_tpu.test_infra.voluntary_exits import (
     prepare_signed_exits, run_voluntary_exit_processing, sign_voluntary_exit,
 )
 from consensus_specs_tpu.test_infra.keys import privkeys
+from consensus_specs_tpu.test_infra.block import next_slots
 
 
 # --- proposer slashings ---
@@ -96,6 +97,282 @@ def test_invalid_attester_slashing_same_data(spec, state):
         spec, state, attester_slashing, valid=False)
 
 
+def _surround_slashing(spec, state):
+    """attestation_1 surrounds attestation_2 (source earlier AND target
+    later); both independently signed over their final data."""
+    from consensus_specs_tpu.test_infra.attestations import (
+        get_valid_attestation, sign_attestation)
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH * 3)
+    attestation_2 = get_valid_attestation(spec, state)
+    attestation_2.data.source.epoch = 1
+    attestation_1 = attestation_2.copy()
+    attestation_1.data.source.epoch = 0
+    attestation_1.data.target.epoch = attestation_2.data.target.epoch + 0
+    attestation_2.data.target.epoch -= 1
+    assert spec.is_slashable_attestation_data(
+        attestation_1.data, attestation_2.data)
+    sign_attestation(spec, state, attestation_1)
+    sign_attestation(spec, state, attestation_2)
+    return spec.AttesterSlashing(
+        attestation_1=spec.get_indexed_attestation(state, attestation_1),
+        attestation_2=spec.get_indexed_attestation(state, attestation_2),
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_slashing_basic_surround(spec, state):
+    attester_slashing = _surround_slashing(spec, state)
+    yield from run_attester_slashing_processing(spec, state, attester_slashing)
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_slashing_already_exited_recent(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True, signed_2=True)
+    slashed_indices = set(
+        attester_slashing.attestation_1.attesting_indices).intersection(
+        attester_slashing.attestation_2.attesting_indices)
+    # an exited-but-not-withdrawn validator is still slashable
+    spec.initiate_validator_exit(state, sorted(slashed_indices)[0])
+    yield from run_attester_slashing_processing(spec, state, attester_slashing)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_attester_slashing_sig_1(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=False, signed_2=True)
+    yield from run_attester_slashing_processing(
+        spec, state, attester_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_attester_slashing_sig_1_and_2(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=False, signed_2=False)
+    yield from run_attester_slashing_processing(
+        spec, state, attester_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_attester_slashing_no_double_or_surround(spec, state):
+    from consensus_specs_tpu.test_infra.attestations import (
+        get_valid_attestation, sign_attestation)
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH)
+    attestation_1 = get_valid_attestation(spec, state, signed=True)
+    attestation_2 = attestation_1.copy()
+    # different target epochs, no surround -> not slashable
+    attestation_2.data.target.epoch -= 1
+    sign_attestation(spec, state, attestation_2)
+    slashing = spec.AttesterSlashing(
+        attestation_1=spec.get_indexed_attestation(state, attestation_1),
+        attestation_2=spec.get_indexed_attestation(state, attestation_2),
+    )
+    yield from run_attester_slashing_processing(
+        spec, state, slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_attester_slashing_participants_already_slashed(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True, signed_2=True)
+    slashed_indices = set(
+        attester_slashing.attestation_1.attesting_indices).intersection(
+        attester_slashing.attestation_2.attesting_indices)
+    for index in slashed_indices:
+        state.validators[index].slashed = True
+    yield from run_attester_slashing_processing(
+        spec, state, attester_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_attester_slashing_att1_high_index(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True, signed_2=True)
+    indices = list(attester_slashing.attestation_1.attesting_indices)
+    indices.append(len(state.validators))  # out of range
+    attester_slashing.attestation_1.attesting_indices = type(
+        attester_slashing.attestation_1.attesting_indices)(*sorted(indices))
+    yield from run_attester_slashing_processing(
+        spec, state, attester_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_attester_slashing_att2_high_index(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True, signed_2=True)
+    indices = list(attester_slashing.attestation_2.attesting_indices)
+    indices.append(len(state.validators))
+    attester_slashing.attestation_2.attesting_indices = type(
+        attester_slashing.attestation_2.attesting_indices)(*sorted(indices))
+    yield from run_attester_slashing_processing(
+        spec, state, attester_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_attester_slashing_att1_empty_indices(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True, signed_2=True)
+    attester_slashing.attestation_1.attesting_indices = type(
+        attester_slashing.attestation_1.attesting_indices)()
+    yield from run_attester_slashing_processing(
+        spec, state, attester_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_attester_slashing_all_empty_indices(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True, signed_2=True)
+    attester_slashing.attestation_1.attesting_indices = type(
+        attester_slashing.attestation_1.attesting_indices)()
+    attester_slashing.attestation_2.attesting_indices = type(
+        attester_slashing.attestation_2.attesting_indices)()
+    yield from run_attester_slashing_processing(
+        spec, state, attester_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_attester_slashing_att1_bad_extra_index(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True, signed_2=True)
+    indices = list(attester_slashing.attestation_1.attesting_indices)
+    # valid registry index that did not sign: aggregate pubkey mismatch
+    options = [i for i in range(len(state.validators)) if i not in indices]
+    indices.append(options[0])
+    attester_slashing.attestation_1.attesting_indices = type(
+        attester_slashing.attestation_1.attesting_indices)(*sorted(indices))
+    yield from run_attester_slashing_processing(
+        spec, state, attester_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_attester_slashing_att1_duplicate_index(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True, signed_2=True)
+    indices = list(attester_slashing.attestation_1.attesting_indices)
+    indices.append(indices[0])  # duplicate breaks sorted-unique rule
+    attester_slashing.attestation_1.attesting_indices = type(
+        attester_slashing.attestation_1.attesting_indices)(*sorted(indices))
+    yield from run_attester_slashing_processing(
+        spec, state, attester_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_attester_slashing_unsorted_att_1(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True, signed_2=True)
+    indices = list(attester_slashing.attestation_1.attesting_indices)
+    if len(indices) < 2:
+        indices = indices + [len(state.validators) - 1]
+    indices[0], indices[1] = indices[1], indices[0]  # unsorted
+    attester_slashing.attestation_1.attesting_indices = type(
+        attester_slashing.attestation_1.attesting_indices)(*indices)
+    yield from run_attester_slashing_processing(
+        spec, state, attester_slashing, valid=False)
+
+
+# --- proposer slashings (additional scenarios) ---
+
+@with_all_phases
+@spec_state_test
+def test_proposer_slashing_block_header_from_future(spec, state):
+    # a header pair for a FUTURE slot is still slashable evidence
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, slot=state.slot + 5)
+    yield from run_proposer_slashing_processing(spec, state, proposer_slashing)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_proposer_slashing_sig_2(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, signed_1=True, signed_2=False)
+    yield from run_proposer_slashing_processing(
+        spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_proposer_slashing_sig_1_and_2_swap(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state)
+    sig_1 = proposer_slashing.signed_header_1.signature
+    proposer_slashing.signed_header_1.signature = \
+        proposer_slashing.signed_header_2.signature
+    proposer_slashing.signed_header_2.signature = sig_1
+    yield from run_proposer_slashing_processing(
+        spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_slashing_incorrect_proposer_index(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state)
+    # out-of-registry index
+    bad = len(state.validators)
+    proposer_slashing.signed_header_1.message.proposer_index = bad
+    proposer_slashing.signed_header_2.message.proposer_index = bad
+    yield from run_proposer_slashing_processing(
+        spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_slashing_different_proposer_indices(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state)
+    proposer_slashing.signed_header_2.message.proposer_index = \
+        proposer_slashing.signed_header_1.message.proposer_index + 1
+    yield from run_proposer_slashing_processing(
+        spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_slashing_slots_of_different_epochs(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state)
+    proposer_slashing.signed_header_2.message.slot += spec.SLOTS_PER_EPOCH
+    yield from run_proposer_slashing_processing(
+        spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_slashing_not_activated(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(spec, state)
+    index = proposer_slashing.signed_header_1.message.proposer_index
+    state.validators[index].activation_epoch = spec.FAR_FUTURE_EPOCH
+    yield from run_proposer_slashing_processing(
+        spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_slashing_proposer_is_withdrawn(spec, state):
+    next_slots(spec, state, 2 * spec.SLOTS_PER_EPOCH)
+    proposer_slashing = get_valid_proposer_slashing(spec, state)
+    index = proposer_slashing.signed_header_1.message.proposer_index
+    current_epoch = spec.get_current_epoch(state)
+    state.validators[index].withdrawable_epoch = current_epoch - 1
+    yield from run_proposer_slashing_processing(
+        spec, state, proposer_slashing, valid=False)
+
+
 # --- voluntary exits ---
 
 @with_all_phases
@@ -135,3 +412,36 @@ def test_invalid_voluntary_exit_already_exited(spec, state):
     state.validators[0].exit_epoch = spec.get_current_epoch(state) + 2
     signed_exit = prepare_signed_exits(spec, state, [0])[0]
     yield from run_voluntary_exit_processing(spec, state, signed_exit, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_voluntary_exit_in_future(spec, state):
+    state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+    voluntary_exit = spec.VoluntaryExit(
+        epoch=spec.get_current_epoch(state) + 1, validator_index=0)
+    signed_exit = sign_voluntary_exit(spec, state, voluntary_exit, privkeys[0])
+    yield from run_voluntary_exit_processing(spec, state, signed_exit, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_voluntary_exit_incorrect_validator_index(spec, state):
+    state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+    voluntary_exit = spec.VoluntaryExit(
+        epoch=spec.get_current_epoch(state),
+        validator_index=len(state.validators))
+    signed_exit = sign_voluntary_exit(spec, state, voluntary_exit, privkeys[0])
+    yield from run_voluntary_exit_processing(spec, state, signed_exit, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_voluntary_exit_events_consistency(spec, state):
+    # two different validators exiting in sequence join the same exit
+    # queue epoch until the churn limit binds
+    state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+    exits = prepare_signed_exits(spec, state, [0, 1])
+    spec.process_voluntary_exit(state, exits[0])
+    yield from run_voluntary_exit_processing(spec, state, exits[1])
+    assert state.validators[0].exit_epoch == state.validators[1].exit_epoch

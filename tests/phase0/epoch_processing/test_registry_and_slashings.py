@@ -1,0 +1,185 @@
+"""Registry-update and slashings epoch-processing depth.
+
+Reference: ``test/phase0/epoch_processing/test_process_registry_updates.py``
+(activation queue ordering/efficiency/churn interaction) and
+``test_process_slashings.py`` (penalty magnitudes).
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases,
+)
+from consensus_specs_tpu.test_infra.epoch_processing import (
+    run_epoch_processing_with, run_epoch_processing_to,
+)
+
+
+def _queue_validator(spec, state, index, epoch):
+    v = state.validators[index]
+    v.activation_eligibility_epoch = epoch
+    v.activation_epoch = spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_add_to_activation_queue(spec, state):
+    index = 0
+    state.validators[index].activation_eligibility_epoch = \
+        spec.FAR_FUTURE_EPOCH
+    state.validators[index].activation_epoch = spec.FAR_FUTURE_EPOCH
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    # eligibility is stamped with the NEXT epoch
+    assert state.validators[index].activation_eligibility_epoch \
+        == spec.get_current_epoch(state) + 1
+    assert state.validators[index].activation_epoch \
+        == spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_sorting(spec, state):
+    # queue five validators with eligibility epochs out of index order:
+    # activations must dequeue by (eligibility_epoch, index)
+    churn = int(spec.get_validator_churn_limit(state))
+    # eligibility must be <= finalized epoch to dequeue
+    state.finalized_checkpoint.epoch = 2
+    for index in range(5):
+        _queue_validator(spec, state, index, epoch=2)
+    # index 2 gets the EARLIEST eligibility: it must beat lower indices
+    state.validators[2].activation_eligibility_epoch = 1
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    activated = [i for i in range(5)
+                 if state.validators[i].activation_epoch
+                 != spec.FAR_FUTURE_EPOCH]
+    assert len(activated) == min(5, churn)
+    # ordering: (eligibility_epoch, index) — index 2 first, then 0, 1...
+    expected = [2] + [i for i in (0, 1, 3, 4)][:max(0, churn - 1)]
+    assert sorted(activated) == sorted(expected)
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_no_activation_no_finality(spec, state):
+    # finality far behind eligibility: nobody activates
+    index = 0
+    _queue_validator(spec, state, index,
+                     epoch=state.finalized_checkpoint.epoch + 1)
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    assert state.validators[index].activation_epoch \
+        == spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_efficiency_min(spec, state):
+    # more eligible validators than the churn limit: exactly churn-many
+    # activate per epoch
+    churn = spec.get_validator_churn_limit(state)
+    n = int(churn) + 2
+    for index in range(n):
+        _queue_validator(spec, state, index, epoch=0)
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    activated = [i for i in range(n)
+                 if state.validators[i].activation_epoch
+                 != spec.FAR_FUTURE_EPOCH]
+    assert len(activated) == churn
+
+
+@with_all_phases
+@spec_state_test
+def test_ejection_past_churn_limit_min(spec, state):
+    # every ejected validator is queued for exit even past the churn
+    # limit: exit epochs spread out across the queue
+    churn = spec.get_validator_churn_limit(state)
+    n = int(churn) + 2
+    for index in range(n):
+        state.validators[index].effective_balance = \
+            spec.config.EJECTION_BALANCE
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    exit_epochs = [state.validators[i].exit_epoch for i in range(n)]
+    assert all(e != spec.FAR_FUTURE_EPOCH for e in exit_epochs)
+    # the queue spills into at least one later epoch
+    assert len(set(exit_epochs)) >= 2
+
+
+@with_all_phases
+@spec_state_test
+def test_slashings_proportional_penalties(spec, state):
+    # slash a third of the registry and check the exact proportional
+    # penalty formula per fork (multiplier 1 in phase0, 2 in altair,
+    # 3 from bellatrix — full wipe-out only when the cap saturates)
+    slashed_count = (len(state.validators) + 2) // 3
+    out_epoch = spec.get_current_epoch(state) \
+        + spec.EPOCHS_PER_SLASHINGS_VECTOR // 2
+    total_balance = spec.get_total_active_balance(state)
+    for i in range(slashed_count):
+        v = state.validators[i]
+        v.slashed = True
+        v.withdrawable_epoch = out_epoch
+        state.slashings[
+            out_epoch % spec.EPOCHS_PER_SLASHINGS_VECTOR] += \
+            v.effective_balance
+    pre_balances = [int(state.balances[i]) for i in range(slashed_count)]
+    total_penalties = sum(state.slashings)
+    run_epoch_processing_to(spec, state, "process_slashings")
+    yield "pre", state
+    spec.process_slashings(state)
+    yield "post", state
+    increment = spec.EFFECTIVE_BALANCE_INCREMENT
+    # the multiplier is renamed per fork (1x / 2x / 3x) and the preset
+    # injects all three names onto every spec: select by fork ladder
+    if spec.fork == "phase0":
+        multiplier = spec.PROPORTIONAL_SLASHING_MULTIPLIER
+    elif spec.fork == "altair":
+        multiplier = spec.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR
+    else:
+        multiplier = spec.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX
+    adjusted = min(total_penalties * multiplier, total_balance)
+    for i in range(slashed_count):
+        eff = state.validators[i].effective_balance
+        expected = eff // increment * adjusted // total_balance * increment
+        assert state.balances[i] == pre_balances[i] - expected
+        assert expected > 0
+
+
+@with_all_phases
+@spec_state_test
+def test_slashings_low_penalty(spec, state):
+    # one small slashing: penalty proportional to total slashed, floored
+    # at increments
+    out_epoch = spec.get_current_epoch(state) \
+        + spec.EPOCHS_PER_SLASHINGS_VECTOR // 2
+    v = state.validators[0]
+    v.slashed = True
+    v.withdrawable_epoch = out_epoch
+    state.slashings[out_epoch % spec.EPOCHS_PER_SLASHINGS_VECTOR] = \
+        v.effective_balance
+    pre_balance = state.balances[0]
+    run_epoch_processing_to(spec, state, "process_slashings")
+    yield "pre", state
+    spec.process_slashings(state)
+    yield "post", state
+    assert state.balances[0] <= pre_balance
+    # single slashing against a large registry: penalty far below the
+    # full effective balance
+    assert state.balances[0] > pre_balance - v.effective_balance
+
+
+@with_all_phases
+@spec_state_test
+def test_slashings_no_penalty_outside_window(spec, state):
+    # slashed but withdrawable epoch NOT at the halfway point: no
+    # penalty applied this epoch
+    v = state.validators[0]
+    v.slashed = True
+    v.withdrawable_epoch = spec.get_current_epoch(state) \
+        + spec.EPOCHS_PER_SLASHINGS_VECTOR // 2 + 5
+    pre_balance = state.balances[0]
+    run_epoch_processing_to(spec, state, "process_slashings")
+    yield "pre", state
+    spec.process_slashings(state)
+    yield "post", state
+    assert state.balances[0] == pre_balance

@@ -117,3 +117,92 @@ def test_is_valid_genesis_state_false_not_enough_validators(spec):
     yield "genesis", state
     assert not spec.is_valid_genesis_state(state)
     yield "is_valid", YamlPart(value=False)
+
+
+@with_phases(["phase0"])
+@with_presets(["minimal"], reason="mainnet genesis counts exceed the test key pool")
+@spec_test
+@single_phase
+def test_initialize_beacon_state_some_small_balances(spec):
+    # half the deposits carry max balance, half only half: small-balance
+    # depositors are registered but NOT active at genesis
+    count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+    full, root_full, dlist = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE, count, signed=True)
+    small, deposit_root, _ = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE // 2, count // 2, signed=True,
+        deposit_data_list=dlist, min_pubkey_index=count)
+    deposits = full + small
+    eth1_block_hash, eth1_timestamp = _eth1_params(spec)
+    yield "eth1_block_hash", eth1_block_hash
+    yield "eth1_timestamp", eth1_timestamp
+    yield "deposits", deposits
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, eth1_timestamp, deposits)
+    assert len(state.validators) == count + count // 2
+    active = spec.get_active_validator_indices(state, spec.GENESIS_EPOCH)
+    assert len(active) == count
+    assert spec.is_valid_genesis_state(state)
+    yield "state", state
+
+
+@with_phases(["phase0"])
+@with_presets(["minimal"], reason="mainnet genesis counts exceed the test key pool")
+@spec_test
+@single_phase
+def test_initialize_beacon_state_one_topup_activation(spec):
+    # a deposit at half balance plus a top-up for the same key reaches
+    # the activation threshold at genesis
+    count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+    full, _, dlist = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE, count - 1, signed=True)
+    half1, _, dlist = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE // 2, 1, signed=True,
+        deposit_data_list=dlist, min_pubkey_index=count - 1)
+    half2, _, _ = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE // 2, 1, signed=True,
+        deposit_data_list=dlist, min_pubkey_index=count - 1)
+    deposits = full + half1 + half2
+    eth1_block_hash, eth1_timestamp = _eth1_params(spec)
+    yield "eth1_block_hash", eth1_block_hash
+    yield "eth1_timestamp", eth1_timestamp
+    yield "deposits", deposits
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, eth1_timestamp, deposits)
+    assert len(state.validators) == count
+    active = spec.get_active_validator_indices(state, spec.GENESIS_EPOCH)
+    assert len(active) == count
+    assert spec.is_valid_genesis_state(state)
+    yield "state", state
+
+
+@with_phases(["phase0"])
+@with_presets(["minimal"], reason="mainnet genesis counts exceed the test key pool")
+@spec_test
+@single_phase
+def test_is_valid_genesis_state_true_one_more_validator(spec):
+    count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT + 1
+    deposits, _, _ = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE, count, signed=True)
+    eth1_block_hash, eth1_timestamp = _eth1_params(spec)
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, eth1_timestamp, deposits)
+    assert spec.is_valid_genesis_state(state)
+    yield "state", state
+
+
+@with_phases(["phase0"])
+@with_presets(["minimal"], reason="mainnet genesis counts exceed the test key pool")
+@spec_test
+@single_phase
+def test_is_valid_genesis_state_true_extra_balance(spec):
+    # over-max deposits still count once toward the active threshold
+    count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+    deposits, _, _ = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE + spec.EFFECTIVE_BALANCE_INCREMENT,
+        count, signed=True)
+    eth1_block_hash, eth1_timestamp = _eth1_params(spec)
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, eth1_timestamp, deposits)
+    assert spec.is_valid_genesis_state(state)
+    yield "state", state

@@ -95,3 +95,115 @@ def test_rewards_balance_conservation_applies(spec, state):
         apply(*spec.get_inactivity_penalty_deltas(state))
 
     assert [int(b) for b in post.balances] == balances
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_quarter_participation(spec, state):
+    rng = Random(11)
+    rw.prepare_state_with_attestations(
+        spec, state, participation_fn=rw.randomize_participation(rng, 0.25))
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_two_thirds_participation(spec, state):
+    rng = Random(22)
+    rw.prepare_state_with_attestations(
+        spec, state, participation_fn=rw.randomize_participation(rng, 0.67))
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_almost_full_participation(spec, state):
+    # every committee minus its first member
+    rw.prepare_state_with_attestations(
+        spec, state,
+        participation_fn=lambda comm: set(sorted(comm)[1:]))
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_one_attestation_one_participant(spec, state):
+    rw.prepare_state_with_attestations(
+        spec, state,
+        participation_fn=lambda comm: {sorted(comm)[0]})
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_random_seed_2(spec, state):
+    rng = Random(7788)
+    rw.prepare_state_with_attestations(
+        spec, state, participation_fn=rw.randomize_participation(rng, 0.7))
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_leak_half_participation(spec, state):
+    rng = Random(33)
+    rw.set_state_in_leak(spec, state)
+    rw.prepare_state_with_attestations(
+        spec, state, participation_fn=rw.randomize_participation(rng, 0.5))
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_misc_balances(spec, state):
+    # mixed effective balances incl. sub-increment and ejection-level
+    rng = Random(44)
+    for index, validator in enumerate(state.validators):
+        if rng.random() < 0.5:
+            eff = rng.randrange(
+                int(spec.config.EJECTION_BALANCE),
+                int(spec.MAX_EFFECTIVE_BALANCE) + 1,
+                int(spec.EFFECTIVE_BALANCE_INCREMENT))
+            validator.effective_balance = eff
+            state.balances[index] = eff
+    rw.prepare_state_with_attestations(spec, state)
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_some_exited_validators(spec, state):
+    # a few validators exited (but not slashed) during the epoch
+    for index in (1, 3):
+        spec.initiate_validator_exit(state, index)
+    rw.prepare_state_with_attestations(spec, state)
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_some_very_low_balances(spec, state):
+    for index in (0, 2):
+        state.balances[index] = 1  # below reward eligibility floor
+    rw.prepare_state_with_attestations(spec, state)
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_leak_with_slashed(spec, state):
+    rw.set_state_in_leak(spec, state)
+    for index in (1, 4):
+        state.validators[index].slashed = True
+    rw.prepare_state_with_attestations(spec, state)
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)

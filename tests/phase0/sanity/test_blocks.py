@@ -188,3 +188,362 @@ def test_duplicate_attestation_same_block(spec, state):
     else:
         # altair+: the second copy grants no new flags (idempotent)
         assert any(f != 0 for f in state.current_epoch_participation)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_same_slot_block_transition(spec, state):
+    # a block for the CURRENT slot (already processed) is invalid
+    spec.process_slots(state, state.slot + 1)
+    block = build_empty_block(spec, state, slot=state.slot)
+    yield "pre", state
+    signed_block = sign_block(spec, state, block)
+    expect_assertion_error(
+        lambda: spec.state_transition(state, signed_block))
+    yield "blocks", [signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposal_for_genesis_slot(spec, state):
+    assert state.slot == spec.GENESIS_SLOT
+    block = build_empty_block(spec, state, slot=spec.GENESIS_SLOT)
+    block.parent_root = state.latest_block_header.parent_root
+    yield "pre", state
+    signed_block = sign_block(spec, state, block)
+    expect_assertion_error(
+        lambda: spec.state_transition(state, signed_block))
+    yield "blocks", [signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_parent_from_same_slot(spec, state):
+    yield "pre", state
+    parent_block = build_empty_block_for_next_slot(spec, state)
+    signed_parent = state_transition_and_sign_block(spec, state, parent_block)
+    child_block = parent_block.copy()
+    child_block.parent_root = state.latest_block_header.parent_root
+    # same-slot child of the parent's parent: header check must fail
+    signed_child = sign_block(spec, state, child_block)
+    expect_assertion_error(
+        lambda: spec.state_transition(state, signed_child))
+    yield "blocks", [signed_parent, signed_child]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_all_zeroed_sig(spec, state):
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    tmp_state = state.copy()
+    state_transition_and_sign_block(spec, tmp_state, block)
+    invalid_signed_block = spec.SignedBeaconBlock(
+        message=block, signature=b"\x00" * 96)
+    expect_assertion_error(
+        lambda: spec.state_transition(state, invalid_signed_block))
+    yield "blocks", [invalid_signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_index_sig_from_proposer_index(spec, state):
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    expect_proposer_index = int(block.proposer_index)
+    active = spec.get_active_validator_indices(
+        state, spec.get_current_epoch(state))
+    wrong_index = (expect_proposer_index + 1) % len(active)
+    block.proposer_index = wrong_index
+    # signed by the CLAIMED (wrong) proposer: index check must fail
+    invalid_signed_block = sign_block(spec, state, block, wrong_index)
+    expect_assertion_error(
+        lambda: spec.state_transition(state, invalid_signed_block))
+    yield "blocks", [invalid_signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_self_slashing(spec, state):
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, proposer_index=int(block.proposer_index),
+        signed_1=True, signed_2=True)
+    block.body.proposer_slashings.append(proposer_slashing)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert state.validators[block.proposer_index].slashed
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_duplicate_proposer_slashings_same_block(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, signed_1=True, signed_2=True)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings.append(proposer_slashing)
+    block.body.proposer_slashings.append(proposer_slashing)
+    signed_block = sign_block_after_failed_transition(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_multiple_different_proposer_slashings_same_block(spec, state):
+    from consensus_specs_tpu.test_infra.slashings import (
+        get_valid_proposer_slashing as _gvps)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    proposer = int(block.proposer_index)
+    indices = [i for i in range(len(state.validators)) if i != proposer][:2]
+    for index in indices:
+        block.body.proposer_slashings.append(_gvps(
+            spec, state, proposer_index=index,
+            signed_1=True, signed_2=True))
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    for index in indices:
+        assert state.validators[index].slashed
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_slashing(spec, state):
+    from consensus_specs_tpu.test_infra.slashings import (
+        get_valid_attester_slashing)
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True, signed_2=True)
+    slashed = set(attester_slashing.attestation_1.attesting_indices) \
+        .intersection(attester_slashing.attestation_2.attesting_indices)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attester_slashings.append(attester_slashing)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    for index in slashed:
+        assert state.validators[index].slashed
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_duplicate_attester_slashing_same_block(spec, state):
+    from consensus_specs_tpu.test_infra.slashings import (
+        get_valid_attester_slashing)
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True, signed_2=True)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attester_slashings.append(attester_slashing)
+    block.body.attester_slashings.append(attester_slashing)
+    signed_block = sign_block_after_failed_transition(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_deposit_in_block(spec, state):
+    from consensus_specs_tpu.test_infra.deposits import (
+        prepare_state_and_deposit)
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, amount, signed=True)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.deposits.append(deposit)
+    block.body.eth1_data.deposit_count = state.eth1_data.deposit_count
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert len(state.validators) == validator_index + 1
+    assert state.balances[validator_index] == amount
+
+
+@with_all_phases
+@spec_state_test
+def test_deposit_top_up(spec, state):
+    from consensus_specs_tpu.test_infra.deposits import (
+        prepare_state_and_deposit)
+    validator_index = 0
+    amount = spec.MAX_EFFECTIVE_BALANCE // 4
+    # baseline: the same empty block without the deposit (isolates the
+    # top-up from per-block sync-committee rewards/penalties in altair+)
+    baseline = state.copy()
+    state_transition_and_sign_block(
+        spec, baseline, build_empty_block_for_next_slot(spec, baseline))
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index, amount, signed=True)
+    pre_count = len(state.validators)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.deposits.append(deposit)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert len(state.validators) == pre_count
+    assert state.balances[validator_index] \
+        == baseline.balances[validator_index] + amount
+
+
+@with_all_phases
+@spec_state_test
+def test_voluntary_exit(spec, state):
+    from consensus_specs_tpu.test_infra.voluntary_exits import (
+        prepare_signed_exits)
+    state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+    signed_exit = prepare_signed_exits(spec, state, [0])[0]
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.voluntary_exits.append(signed_exit)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert state.validators[0].exit_epoch < spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_duplicate_validator_exit_same_block(spec, state):
+    from consensus_specs_tpu.test_infra.voluntary_exits import (
+        prepare_signed_exits)
+    state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+    signed_exit = prepare_signed_exits(spec, state, [0])[0]
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.voluntary_exits.append(signed_exit)
+    block.body.voluntary_exits.append(signed_exit)
+    signed_block = sign_block_after_failed_transition(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_multiple_different_validator_exits_same_block(spec, state):
+    from consensus_specs_tpu.test_infra.voluntary_exits import (
+        prepare_signed_exits)
+    state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+    exits = prepare_signed_exits(spec, state, [0, 1, 2])
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    for signed_exit in exits:
+        block.body.voluntary_exits.append(signed_exit)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    for index in (0, 1, 2):
+        assert state.validators[index].exit_epoch < spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_slash_and_exit_same_index(spec, state):
+    # slashing and a voluntary exit for the SAME validator in one block:
+    # the exit must fail (validator no longer active at exit processing)
+    from consensus_specs_tpu.test_infra.slashings import (
+        get_valid_proposer_slashing as _gvps)
+    from consensus_specs_tpu.test_infra.voluntary_exits import (
+        prepare_signed_exits)
+    state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+    proposer = int(build_empty_block_for_next_slot(spec, state).proposer_index)
+    index = (proposer + 1) % len(state.validators)
+    slashing = _gvps(spec, state, proposer_index=index,
+                     signed_1=True, signed_2=True)
+    signed_exit = prepare_signed_exits(spec, state, [index])[0]
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings.append(slashing)
+    block.body.voluntary_exits.append(signed_exit)
+    signed_block = sign_block_after_failed_transition(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_slash_and_exit_diff_index(spec, state):
+    from consensus_specs_tpu.test_infra.slashings import (
+        get_valid_proposer_slashing as _gvps)
+    from consensus_specs_tpu.test_infra.voluntary_exits import (
+        prepare_signed_exits)
+    state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+    proposer = int(build_empty_block_for_next_slot(spec, state).proposer_index)
+    slash_index = (proposer + 1) % len(state.validators)
+    exit_index = (proposer + 2) % len(state.validators)
+    slashing = _gvps(spec, state, proposer_index=slash_index,
+                     signed_1=True, signed_2=True)
+    signed_exit = prepare_signed_exits(spec, state, [exit_index])[0]
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings.append(slashing)
+    block.body.voluntary_exits.append(signed_exit)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert state.validators[slash_index].slashed
+    assert state.validators[exit_index].exit_epoch < spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_high_proposer_index(spec, state):
+    # build a block at a slot whose proposer sits in the upper half of
+    # the registry (probing a couple of epochs of proposer draws; falls
+    # back to the next slot if the draw never lands there)
+    next_epoch(spec, state)
+    active = spec.get_active_validator_indices(
+        state, spec.get_current_epoch(state))
+    slot = None
+    probe = state.copy()
+    for _ in range(2 * int(spec.SLOTS_PER_EPOCH)):
+        spec.process_slots(probe, probe.slot + 1)
+        if spec.get_beacon_proposer_index(probe) >= len(active) // 2:
+            slot = int(probe.slot)
+            break
+    if slot is None:
+        slot = int(state.slot) + 1  # fall back: any proposer
+    yield "pre", state
+    block = build_empty_block(spec, state, slot=slot)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_historical_batch(spec, state):
+    # cross a SLOTS_PER_HISTORICAL_ROOT boundary: the accumulator grows
+    state.slot = spec.SLOTS_PER_HISTORICAL_ROOT - 1
+    pre_historical = len(getattr(state, "historical_roots", [])) \
+        + len(getattr(state, "historical_summaries", []))
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    post_historical = len(getattr(state, "historical_roots", [])) \
+        + len(getattr(state, "historical_summaries", []))
+    assert post_historical == pre_historical + 1
+
+
+def sign_block_after_failed_transition(spec, state, block):
+    """Sign a block that must FAIL state_transition: compute the
+    signature over the block as-is against a throwaway copy, then assert
+    the real transition rejects it."""
+    signed_block = sign_block(spec, state.copy(), block)
+    expect_assertion_error(
+        lambda: spec.state_transition(state, signed_block))
+    return signed_block
