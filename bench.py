@@ -66,6 +66,49 @@ def _watchdog():
     _emit_and_exit(0)
 
 
+# Last-known-good measurements per platform, recorded by every successful
+# inner run. When the live attempts cannot fit the driver budget (cold
+# cache, wedged accelerator tunnel), the artifact still carries the most
+# recent REAL measurement from this machine, flagged with its age.
+# Entries are keyed by this host's CPU fingerprint (the compile-cache
+# key), so a store committed from one machine is never misread as a
+# measurement of another.
+_STORE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "consensus_specs_tpu", "tools",
+                      "bench_measurements.json")
+
+
+def _machine_key() -> str:
+    from consensus_specs_tpu.utils.jax_env import _cpu_fingerprint
+    return _cpu_fingerprint()
+
+
+def _store_load() -> dict:
+    """This machine's {platform: entry} map (empty for foreign stores)."""
+    try:
+        with open(_STORE) as f:
+            return json.load(f).get(_machine_key(), {})
+    except Exception:
+        return {}
+
+
+def _store_record(entry: dict) -> None:
+    try:
+        with open(_STORE) as f:
+            data = json.load(f)
+    except Exception:
+        data = {}
+    data.setdefault(_machine_key(), {})[entry["platform"]] = entry
+    # atomic replace: a budget-kill mid-dump must not wipe the store
+    try:
+        tmp = _STORE + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+        os.replace(tmp, _STORE)
+    except Exception:
+        pass
+
+
 def _measure_inner():
     """Subprocess body: measure the batched verify on THIS process's
     JAX platform; print one JSON line."""
@@ -92,13 +135,15 @@ def _measure_inner():
         bls_jax.verify_aggregates_batch(items)
         t_acc += time.time() - t0
         reps += 1
-    print(json.dumps({
+    result = {
         "platform": jax.default_backend(),
         "batch": batch,
         "warm_s": round(warm_s, 1),
         "reps": reps,
         "per_sec": batch / (t_acc / reps),
-    }), flush=True)
+    }
+    _store_record(dict(result, measured_at=time.time()))
+    print(json.dumps(result), flush=True)
 
 
 def _try_platform(env_overrides, timeout):
@@ -169,6 +214,23 @@ def main():
         _RESULT["partial"] = False
         _RESULT["stage"] = f"measured-{data['platform']}"
         break
+    else:
+        # Every live attempt failed (cold cache / dead tunnel): fall back
+        # to the freshest stored measurement from this machine.
+        store = _store_load()
+        best = max(store.values(), key=lambda e: e.get("measured_at", 0),
+                   default=None) if store else None
+        if best is not None:
+            per_sec = best["per_sec"]
+            _RESULT["metric"] = (
+                f"FastAggregateVerify (64 pubkeys, batch {best['batch']})")
+            _RESULT["value"] = round(per_sec, 3)
+            _RESULT["vs_baseline"] = round(per_sec * py_per_verify, 2)
+            _RESULT["platform"] = best["platform"]
+            _RESULT["stale"] = True
+            _RESULT["stale_age_s"] = round(
+                time.time() - best.get("measured_at", 0))
+            _RESULT["stage"] = f"stored-{best['platform']}"
     _emit_and_exit(0)
 
 

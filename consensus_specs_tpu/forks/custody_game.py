@@ -1,0 +1,556 @@
+"""Custody game research fork: proof-of-custody over shard data.
+
+Behavioral source: ``specs/_features/custody_game/beacon-chain.md``
+(constants :66-118, containers :120-240, helpers :245-345, block
+processing :350-640, epoch processing :645-707) and the reference's
+custody-game conformance suite
+(``tests/core/pyspec/eth2spec/test/custody_game/``, 42 tests — the fork
+is excluded from the reference's pyspec build, so those tests are the
+only executable contract upstream).
+
+Validators custody the shard data they attest to; a bit derived from the
+data and a period secret (a BLS signature over the period's RANDAO epoch)
+can be challenged (chunk challenges), must be revealed on schedule (key
+reveals), and is slashable both for early reveals of derived secrets and
+for incorrect custody claims (custody slashings).
+
+Fork DAG parent: sharding (``custody_game/beacon-chain.md:63`` "building
+upon the Sharding specification"); see ``sharding.py`` for the lineage
+note.
+"""
+from consensus_specs_tpu.utils.ssz import (
+    Container, List, Vector, ByteVector, ByteList, uint64, Bytes32,
+    hash_tree_root, boolean,
+)
+from consensus_specs_tpu.utils import bls
+from . import register_fork
+from .sharding import ShardingSpec
+from .base_types import (
+    Slot, Epoch, Gwei, Root, ValidatorIndex, BLSSignature, DomainType,
+    FAR_FUTURE_EPOCH,
+)
+
+
+def _ceillog2(n: int) -> int:
+    assert n >= 1
+    return (n - 1).bit_length()
+
+
+@register_fork("custody_game")
+class CustodyGameSpec(ShardingSpec):
+    fork = "custody_game"
+    previous_fork = "sharding"
+
+    # Constants (beacon-chain.md "Misc")
+    CUSTODY_PRIME = 2**256 - 189
+    CUSTODY_SECRETS = 3
+    BYTES_PER_CUSTODY_ATOM = 32
+    CUSTODY_PROBABILITY_EXPONENT = 10
+    BYTES_PER_CUSTODY_CHUNK = 2**12
+    DOMAIN_CUSTODY_BIT_SLASHING = DomainType("0x83000000")
+    # Preset value not customized by any preset file (beacon-chain.md
+    # "Max operations per block"): 2**20 challenge-record slots.
+    MAX_CUSTODY_CHUNK_CHALLENGE_RECORDS = 2**20
+
+    @property
+    def CUSTODY_RESPONSE_DEPTH(self) -> int:
+        return _ceillog2(self.MAX_SHARD_BLOCK_SIZE
+                         // self.BYTES_PER_CUSTODY_CHUNK)
+
+    # -- types ------------------------------------------------------------
+    def _validator_fields(self) -> dict:
+        fields = super()._validator_fields()
+        # Initialized to the validator's custody period at activation;
+        # FAR_FUTURE_EPOCH until all secrets are revealed post-exit.
+        fields["next_custody_secret_to_reveal"] = uint64
+        fields["all_custody_secrets_revealed_epoch"] = Epoch
+        return fields
+
+    def finalize_mock_validator(self, validator, index: int) -> None:
+        """Genesis hook: custody fields that are NOT zero-default."""
+        validator.all_custody_secrets_revealed_epoch = FAR_FUTURE_EPOCH
+        validator.next_custody_secret_to_reveal = \
+            self.get_custody_period_for_validator(
+                ValidatorIndex(index), self.GENESIS_EPOCH)
+
+    def _build_custody_operation_types(self, Attestation):
+        """Custody operation containers; called from the
+        ``_block_body_fields`` hook once ``Attestation`` exists (the
+        challenge/slashing ops embed whole attestations)."""
+        S = self
+        ShardTransition = self.ShardTransition
+
+        class CustodyChunkChallenge(Container):
+            responder_index: ValidatorIndex
+            shard_transition: ShardTransition
+            attestation: Attestation
+            data_index: uint64
+            chunk_index: uint64
+
+        class CustodyChunkChallengeRecord(Container):
+            challenge_index: uint64
+            challenger_index: ValidatorIndex
+            responder_index: ValidatorIndex
+            inclusion_epoch: Epoch
+            data_root: Root
+            chunk_index: uint64
+
+        class CustodyChunkResponse(Container):
+            challenge_index: uint64
+            chunk_index: uint64
+            chunk: ByteVector[S.BYTES_PER_CUSTODY_CHUNK]
+            branch: Vector[Root, S.CUSTODY_RESPONSE_DEPTH + 1]
+
+        class CustodySlashing(Container):
+            # shard_transition.shard_data_roots[data_index] commits to data
+            data_index: uint64
+            malefactor_index: ValidatorIndex
+            malefactor_secret: BLSSignature
+            whistleblower_index: ValidatorIndex
+            shard_transition: ShardTransition
+            attestation: Attestation
+            data: ByteList[S.MAX_SHARD_BLOCK_SIZE]
+
+        class SignedCustodySlashing(Container):
+            message: CustodySlashing
+            signature: BLSSignature
+
+        class CustodyKeyReveal(Container):
+            revealer_index: ValidatorIndex
+            reveal: BLSSignature
+
+        class EarlyDerivedSecretReveal(Container):
+            revealed_index: ValidatorIndex
+            epoch: Epoch
+            reveal: BLSSignature
+            masker_index: ValidatorIndex
+            mask: Bytes32
+
+        for name, typ in list(locals().items()):
+            if isinstance(typ, type) and issubclass(typ, Container):
+                setattr(self, name, typ)
+
+    def _block_body_fields(self, t) -> dict:
+        fields = super()._block_body_fields(t)
+        self._build_custody_operation_types(t["Attestation"])
+        fields.update(self._custody_body_fields())
+        return fields
+
+    def _state_fields(self, t) -> dict:
+        fields = super()._state_fields(t)
+        fields.update(self._custody_state_fields())
+        return fields
+
+    def _custody_body_fields(self) -> dict:
+        S = self
+        return {
+            "chunk_challenges": List[S.CustodyChunkChallenge,
+                                     S.MAX_CUSTODY_CHUNK_CHALLENGES],
+            "chunk_challenge_responses": List[
+                S.CustodyChunkResponse, S.MAX_CUSTODY_CHUNK_CHALLENGE_RESP],
+            "custody_key_reveals": List[S.CustodyKeyReveal,
+                                        S.MAX_CUSTODY_KEY_REVEALS],
+            "early_derived_secret_reveals": List[
+                S.EarlyDerivedSecretReveal,
+                S.MAX_EARLY_DERIVED_SECRET_REVEALS],
+            "custody_slashings": List[S.SignedCustodySlashing,
+                                      S.MAX_CUSTODY_SLASHINGS],
+        }
+
+    def _custody_state_fields(self) -> dict:
+        S = self
+        return {
+            "exposed_derived_secrets": Vector[
+                List[ValidatorIndex,
+                     S.MAX_EARLY_DERIVED_SECRET_REVEALS * S.SLOTS_PER_EPOCH],
+                S.EARLY_DERIVED_SECRET_PENALTY_MAX_FUTURE_EPOCHS],
+            "custody_chunk_challenge_records": List[
+                S.CustodyChunkChallengeRecord,
+                S.MAX_CUSTODY_CHUNK_CHALLENGE_RECORDS],
+            "custody_chunk_challenge_index": uint64,
+        }
+
+    # -- helpers (beacon-chain.md "Helpers") -------------------------------
+    @staticmethod
+    def replace_empty_or_append(lst, new_element) -> int:
+        for i in range(len(lst)):
+            if lst[i] == type(new_element)():
+                lst[i] = new_element
+                return i
+        lst.append(new_element)
+        return len(lst) - 1
+
+    @staticmethod
+    def legendre_bit(a: int, q: int) -> int:
+        """((a/q) + 1) // 2 via the binary Jacobi algorithm."""
+        if a >= q:
+            return CustodyGameSpec.legendre_bit(a % q, q)
+        if a == 0:
+            return 0
+        assert q > a > 0 and q % 2 == 1
+        t, n = 1, q
+        while a != 0:
+            while a % 2 == 0:
+                a //= 2
+                r = n % 8
+                if r == 3 or r == 5:
+                    t = -t
+            a, n = n, a
+            if a % 4 == n % 4 == 3:
+                t = -t
+            a %= n
+        return (t + 1) // 2 if n == 1 else 0
+
+    def get_custody_atoms(self, bytez: bytes):
+        """Right-pad to atom size and split into 32-byte atoms."""
+        bytez = bytes(bytez)
+        pad = (self.BYTES_PER_CUSTODY_ATOM
+               - len(bytez) % self.BYTES_PER_CUSTODY_ATOM) \
+            % self.BYTES_PER_CUSTODY_ATOM
+        bytez += b"\x00" * pad
+        return [bytez[i:i + self.BYTES_PER_CUSTODY_ATOM]
+                for i in range(0, len(bytez), self.BYTES_PER_CUSTODY_ATOM)]
+
+    def get_custody_secrets(self, key):
+        """Secrets = 32-byte little-endian windows over the signature's
+        G2 x-coordinate (c0 || c1, 48-byte little-endian each)."""
+        from consensus_specs_tpu.ops.bls12_381.curve import g2_from_compressed
+        pt = g2_from_compressed(bytes(key))
+        signature_bytes = (int(pt.x.a.n).to_bytes(48, "little")
+                           + int(pt.x.b.n).to_bytes(48, "little"))
+        return [int.from_bytes(signature_bytes[i:i + self.BYTES_PER_CUSTODY_ATOM],
+                               "little")
+                for i in range(0, len(signature_bytes), 32)]
+
+    def universal_hash_function(self, data_chunks, secrets) -> int:
+        n = len(data_chunks)
+        P = self.CUSTODY_PRIME
+        return (
+            sum(
+                pow(secrets[i % self.CUSTODY_SECRETS], i, P)
+                * int.from_bytes(atom, "little") % P
+                for i, atom in enumerate(data_chunks)
+            ) + pow(secrets[n % self.CUSTODY_SECRETS], n, P)
+        ) % P
+
+    def compute_custody_bit(self, key, data) -> int:
+        custody_atoms = self.get_custody_atoms(data)
+        secrets = self.get_custody_secrets(key)
+        uhf = self.universal_hash_function(custody_atoms, secrets)
+        legendre_bits = [
+            self.legendre_bit(uhf + secrets[0] + i, self.CUSTODY_PRIME)
+            for i in range(self.CUSTODY_PROBABILITY_EXPONENT)]
+        return boolean(all(legendre_bits))
+
+    def get_randao_epoch_for_custody_period(self, period, validator_index) -> Epoch:
+        next_period_start = (int(period) + 1) * self.EPOCHS_PER_CUSTODY_PERIOD \
+            - int(validator_index) % self.EPOCHS_PER_CUSTODY_PERIOD
+        return Epoch(next_period_start + self.CUSTODY_PERIOD_TO_RANDAO_PADDING)
+
+    def get_custody_period_for_validator(self, validator_index, epoch) -> uint64:
+        """Reveal period of ``validator_index`` at ``epoch``."""
+        return uint64((int(epoch)
+                       + int(validator_index) % self.EPOCHS_PER_CUSTODY_PERIOD)
+                      // self.EPOCHS_PER_CUSTODY_PERIOD)
+
+    # -- block processing --------------------------------------------------
+    def process_block(self, state, block) -> None:
+        # The defunct phase-1 light-client aggregate stage is omitted
+        # (sharding.py lineage note); everything else follows
+        # custody_game/beacon-chain.md "Block processing".
+        super().process_block(state, block)
+        self.process_custody_game_operations(state, block.body)
+
+    def process_custody_game_operations(self, state, body) -> None:
+        def for_ops(operations, fn):
+            for operation in operations:
+                fn(state, operation)
+
+        for_ops(body.chunk_challenges, self.process_chunk_challenge)
+        for_ops(body.chunk_challenge_responses,
+                self.process_chunk_challenge_response)
+        for_ops(body.custody_key_reveals, self.process_custody_key_reveal)
+        for_ops(body.early_derived_secret_reveals,
+                self.process_early_derived_secret_reveal)
+        for_ops(body.custody_slashings, self.process_custody_slashing)
+
+    def process_chunk_challenge(self, state, challenge) -> None:
+        # Attestation must be valid and still challengeable
+        assert self.is_valid_indexed_attestation(
+            state, self.get_indexed_attestation(state, challenge.attestation))
+        max_challenge_epoch = Epoch(challenge.attestation.data.target.epoch
+                                    + self.MAX_CHUNK_CHALLENGE_DELAY)
+        assert self.get_current_epoch(state) <= max_challenge_epoch
+        responder = state.validators[challenge.responder_index]
+        if responder.exit_epoch < FAR_FUTURE_EPOCH:
+            assert self.get_current_epoch(state) \
+                <= responder.exit_epoch + self.MAX_CHUNK_CHALLENGE_DELAY
+        assert self.is_slashable_validator(responder,
+                                           self.get_current_epoch(state))
+        # Responder must have participated
+        attesters = self.get_attesting_indices(
+            state, challenge.attestation.data,
+            challenge.attestation.aggregation_bits)
+        assert challenge.responder_index in attesters
+        # The shard transition must be the attested one
+        assert hash_tree_root(challenge.shard_transition) == \
+            challenge.attestation.data.shard_transition_root
+        data_root = \
+            challenge.shard_transition.shard_data_roots[challenge.data_index]
+        # No duplicate open challenge on (data, chunk)
+        for record in state.custody_chunk_challenge_records:
+            assert (record.data_root != data_root
+                    or record.chunk_index != challenge.chunk_index)
+        # Chunk index within the attested block length
+        shard_block_length = int(
+            challenge.shard_transition.shard_block_lengths[challenge.data_index])
+        transition_chunks = (shard_block_length + self.BYTES_PER_CUSTODY_CHUNK
+                             - 1) // self.BYTES_PER_CUSTODY_CHUNK
+        assert challenge.chunk_index < transition_chunks
+        new_record = self.CustodyChunkChallengeRecord(
+            challenge_index=state.custody_chunk_challenge_index,
+            challenger_index=self.get_beacon_proposer_index(state),
+            responder_index=challenge.responder_index,
+            inclusion_epoch=self.get_current_epoch(state),
+            data_root=data_root,
+            chunk_index=challenge.chunk_index,
+        )
+        self.replace_empty_or_append(
+            state.custody_chunk_challenge_records, new_record)
+        state.custody_chunk_challenge_index += 1
+        # Freeze responder withdrawability until resolved
+        responder.withdrawable_epoch = FAR_FUTURE_EPOCH
+
+    def process_chunk_challenge_response(self, state, response) -> None:
+        matching = [
+            record for record in state.custody_chunk_challenge_records
+            if record.challenge_index == response.challenge_index]
+        assert len(matching) == 1
+        challenge = matching[0]
+        assert response.chunk_index == challenge.chunk_index
+        # Chunk must sit in the attested data tree (depth +1 covers the
+        # ByteList length mix-in)
+        assert self.is_valid_merkle_branch(
+            leaf=hash_tree_root(response.chunk),
+            branch=response.branch,
+            depth=self.CUSTODY_RESPONSE_DEPTH + 1,
+            index=response.chunk_index,
+            root=challenge.data_root,
+        )
+        index_in_records = list(
+            state.custody_chunk_challenge_records).index(challenge)
+        state.custody_chunk_challenge_records[index_in_records] = \
+            self.CustodyChunkChallengeRecord()
+        proposer_index = self.get_beacon_proposer_index(state)
+        self.increase_balance(
+            state, proposer_index,
+            Gwei(self.get_base_reward(state, proposer_index)
+                 // self.MINOR_REWARD_QUOTIENT))
+
+    def process_custody_key_reveal(self, state, reveal) -> None:
+        revealer = state.validators[reveal.revealer_index]
+        epoch_to_sign = self.get_randao_epoch_for_custody_period(
+            revealer.next_custody_secret_to_reveal, reveal.revealer_index)
+        custody_reveal_period = self.get_custody_period_for_validator(
+            reveal.revealer_index, self.get_current_epoch(state))
+        # Past periods only — except the final period right after exit
+        is_past_reveal = \
+            revealer.next_custody_secret_to_reveal < custody_reveal_period
+        is_exited = revealer.exit_epoch <= self.get_current_epoch(state)
+        is_exit_period_reveal = (
+            revealer.next_custody_secret_to_reveal
+            == self.get_custody_period_for_validator(
+                reveal.revealer_index, Epoch(revealer.exit_epoch - 1)))
+        assert is_past_reveal or (is_exited and is_exit_period_reveal)
+        assert self.is_slashable_validator(revealer,
+                                           self.get_current_epoch(state))
+        domain = self.get_domain(state, self.DOMAIN_RANDAO, epoch_to_sign)
+        signing_root = self.compute_signing_root(Epoch(epoch_to_sign), domain)
+        assert bls.Verify(revealer.pubkey, signing_root, reveal.reveal)
+        if is_exited and is_exit_period_reveal:
+            revealer.all_custody_secrets_revealed_epoch = \
+                self.get_current_epoch(state)
+        revealer.next_custody_secret_to_reveal += 1
+        proposer_index = self.get_beacon_proposer_index(state)
+        self.increase_balance(
+            state, proposer_index,
+            Gwei(self.get_base_reward(state, reveal.revealer_index)
+                 // self.MINOR_REWARD_QUOTIENT))
+
+    def process_early_derived_secret_reveal(self, state, reveal) -> None:
+        revealed_validator = state.validators[reveal.revealed_index]
+        derived_secret_location = uint64(
+            reveal.epoch % self.EARLY_DERIVED_SECRET_PENALTY_MAX_FUTURE_EPOCHS)
+        assert reveal.epoch >= \
+            self.get_current_epoch(state) + self.RANDAO_PENALTY_EPOCHS
+        assert reveal.epoch < self.get_current_epoch(state) \
+            + self.EARLY_DERIVED_SECRET_PENALTY_MAX_FUTURE_EPOCHS
+        assert not revealed_validator.slashed
+        assert reveal.revealed_index not in \
+            state.exposed_derived_secrets[derived_secret_location]
+        # Masked reveal: aggregate of (secret over epoch, masker over mask)
+        masker = state.validators[reveal.masker_index]
+        pubkeys = [revealed_validator.pubkey, masker.pubkey]
+        domain = self.get_domain(state, self.DOMAIN_RANDAO, reveal.epoch)
+        signing_roots = [
+            self.compute_signing_root(root, domain)
+            for root in [hash_tree_root(Epoch(reveal.epoch)), reveal.mask]]
+        assert bls.AggregateVerify(pubkeys, signing_roots, reveal.reveal)
+
+        if reveal.epoch >= self.get_current_epoch(state) \
+                + self.CUSTODY_PERIOD_TO_RANDAO_PADDING:
+            # Early enough to be a custody-round key: full slashing
+            self.slash_validator(state, reveal.revealed_index,
+                                 reveal.masker_index)
+        else:
+            # Small penalty scaled by prior exposures this epoch window
+            max_proposer_slot_reward = (
+                int(self.get_base_reward(state, reveal.revealed_index))
+                * self.SLOTS_PER_EPOCH
+                // len(self.get_active_validator_indices(
+                    state, self.get_current_epoch(state)))
+                // self.PROPOSER_REWARD_QUOTIENT
+            )
+            penalty = Gwei(
+                max_proposer_slot_reward
+                * self.EARLY_DERIVED_SECRET_REVEAL_SLOT_REWARD_MULTIPLE
+                * (len(state.exposed_derived_secrets[derived_secret_location])
+                   + 1))
+            proposer_index = self.get_beacon_proposer_index(state)
+            whistleblower_index = reveal.masker_index
+            whistleblowing_reward = Gwei(
+                penalty // self.WHISTLEBLOWER_REWARD_QUOTIENT)
+            proposer_reward = Gwei(
+                whistleblowing_reward // self.PROPOSER_REWARD_QUOTIENT)
+            self.increase_balance(state, proposer_index, proposer_reward)
+            self.increase_balance(state, whistleblower_index,
+                                  whistleblowing_reward - proposer_reward)
+            self.decrease_balance(state, reveal.revealed_index, penalty)
+            state.exposed_derived_secrets[derived_secret_location].append(
+                reveal.revealed_index)
+
+    def process_custody_slashing(self, state, signed_custody_slashing) -> None:
+        custody_slashing = signed_custody_slashing.message
+        attestation = custody_slashing.attestation
+        # Whistleblower signs the claim; both parties must be slashable
+        malefactor = state.validators[custody_slashing.malefactor_index]
+        whistleblower = state.validators[custody_slashing.whistleblower_index]
+        domain = self.get_domain(state, self.DOMAIN_CUSTODY_BIT_SLASHING,
+                                 self.get_current_epoch(state))
+        signing_root = self.compute_signing_root(custody_slashing, domain)
+        assert bls.Verify(whistleblower.pubkey, signing_root,
+                          signed_custody_slashing.signature)
+        assert self.is_slashable_validator(whistleblower,
+                                           self.get_current_epoch(state))
+        assert self.is_slashable_validator(malefactor,
+                                           self.get_current_epoch(state))
+        assert self.is_valid_indexed_attestation(
+            state, self.get_indexed_attestation(state, attestation))
+        # Data must be the attested shard data
+        shard_transition = custody_slashing.shard_transition
+        assert hash_tree_root(shard_transition) == \
+            attestation.data.shard_transition_root
+        assert len(custody_slashing.data) == int(
+            shard_transition.shard_block_lengths[custody_slashing.data_index])
+        assert hash_tree_root(custody_slashing.data) == \
+            shard_transition.shard_data_roots[custody_slashing.data_index]
+        attesters = self.get_attesting_indices(
+            state, attestation.data, attestation.aggregation_bits)
+        assert custody_slashing.malefactor_index in attesters
+        # The malefactor's period secret must verify
+        epoch_to_sign = self.get_randao_epoch_for_custody_period(
+            self.get_custody_period_for_validator(
+                custody_slashing.malefactor_index,
+                attestation.data.target.epoch),
+            custody_slashing.malefactor_index)
+        domain = self.get_domain(state, self.DOMAIN_RANDAO, epoch_to_sign)
+        signing_root = self.compute_signing_root(Epoch(epoch_to_sign), domain)
+        assert bls.Verify(malefactor.pubkey, signing_root,
+                          custody_slashing.malefactor_secret)
+
+        computed_custody_bit = self.compute_custody_bit(
+            custody_slashing.malefactor_secret, custody_slashing.data)
+        if computed_custody_bit == 1:
+            # Custody bit was indeed wrongly claimed: slash malefactor,
+            # reward the rest of the committee
+            self.slash_validator(state, custody_slashing.malefactor_index)
+            committee = self.get_beacon_committee(
+                state, attestation.data.slot, attestation.data.index)
+            others_count = len(committee) - 1
+            whistleblower_reward = Gwei(
+                int(malefactor.effective_balance)
+                // self.WHISTLEBLOWER_REWARD_QUOTIENT // others_count)
+            for attester_index in attesters:
+                if attester_index != custody_slashing.malefactor_index:
+                    self.increase_balance(state, attester_index,
+                                          whistleblower_reward)
+        else:
+            # False claim: the whistleblower induced the work, slash them
+            self.slash_validator(state,
+                                 custody_slashing.whistleblower_index)
+
+    # -- epoch processing --------------------------------------------------
+    def process_epoch(self, state) -> None:
+        """custody_game/beacon-chain.md "Epoch transition" ordering; the
+        defunct pending-shard-header stages are omitted (lineage note)."""
+        self.process_justification_and_finalization(state)
+        self.process_rewards_and_penalties(state)
+        self.process_registry_updates(state)
+        # Proof of custody
+        self.process_reveal_deadlines(state)
+        self.process_challenge_deadlines(state)
+        self.process_slashings(state)
+        # Final updates
+        self.process_eth1_data_reset(state)
+        self.process_effective_balance_updates(state)
+        self.process_slashings_reset(state)
+        self.process_randao_mixes_reset(state)
+        self.process_historical_roots_update(state)
+        self.process_participation_record_updates(state)
+        self.process_custody_final_updates(state)
+        self.process_shard_epoch_increment(state)
+
+    def process_reveal_deadlines(self, state) -> None:
+        epoch = self.get_current_epoch(state)
+        for index, validator in enumerate(state.validators):
+            deadline = validator.next_custody_secret_to_reveal + 1
+            if self.get_custody_period_for_validator(
+                    ValidatorIndex(index), epoch) > deadline:
+                self.slash_validator(state, ValidatorIndex(index))
+
+    def process_challenge_deadlines(self, state) -> None:
+        for challenge in state.custody_chunk_challenge_records:
+            if self.get_current_epoch(state) > \
+                    challenge.inclusion_epoch + self.EPOCHS_PER_CUSTODY_PERIOD:
+                self.slash_validator(state, challenge.responder_index,
+                                     challenge.challenger_index)
+                index_in_records = list(
+                    state.custody_chunk_challenge_records).index(challenge)
+                state.custody_chunk_challenge_records[index_in_records] = \
+                    self.CustodyChunkChallengeRecord()
+
+    def process_custody_final_updates(self, state) -> None:
+        # Re-arm the reveal slot for this epoch's window
+        state.exposed_derived_secrets[
+            self.get_current_epoch(state)
+            % self.EARLY_DERIVED_SECRET_PENALTY_MAX_FUTURE_EPOCHS] = []
+        # Withdrawability gating on open challenges / unrevealed secrets
+        # NOTE: cleared (empty) records keep responder_index 0 in the set,
+        # matching the reference exactly (custody_game/beacon-chain.md
+        # "Final updates") — validator 0's withdrawability stays frozen
+        # while any cleared record slot exists.
+        records = state.custody_chunk_challenge_records
+        validator_indices_in_records = set(
+            int(record.responder_index) for record in records)
+        for index, validator in enumerate(state.validators):
+            if validator.exit_epoch != FAR_FUTURE_EPOCH:
+                not_all_secrets_are_revealed = \
+                    validator.all_custody_secrets_revealed_epoch \
+                    == FAR_FUTURE_EPOCH
+                if index in validator_indices_in_records \
+                        or not_all_secrets_are_revealed:
+                    validator.withdrawable_epoch = FAR_FUTURE_EPOCH
+                elif validator.withdrawable_epoch == FAR_FUTURE_EPOCH:
+                    validator.withdrawable_epoch = Epoch(
+                        validator.all_custody_secrets_revealed_epoch
+                        + self.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)

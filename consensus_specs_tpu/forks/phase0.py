@@ -154,22 +154,11 @@ class Phase0Spec(ValidatorGuideMixin, ForkChoiceMixin):
             epoch: Epoch
             root: Root
 
-        class Validator(Container):
-            pubkey: BLSPubkey
-            withdrawal_credentials: Bytes32
-            effective_balance: Gwei
-            slashed: boolean
-            activation_eligibility_epoch: Epoch
-            activation_epoch: Epoch
-            exit_epoch: Epoch
-            withdrawable_epoch: Epoch
+        Validator = type("Validator", (Container,), {
+            "__annotations__": self._validator_fields()})
 
-        class AttestationData(Container):
-            slot: Slot
-            index: CommitteeIndex
-            beacon_block_root: Root
-            source: Checkpoint
-            target: Checkpoint
+        AttestationData = type("AttestationData", (Container,), {
+            "__annotations__": self._attestation_data_fields(locals())})
 
         class IndexedAttestation(Container):
             attesting_indices: List[ValidatorIndex, S.MAX_VALIDATORS_PER_COMMITTEE]
@@ -278,6 +267,31 @@ class Phase0Spec(ValidatorGuideMixin, ForkChoiceMixin):
         for name, typ in list(locals().items()):
             if isinstance(typ, type) and issubclass(typ, Container):
                 setattr(self, name, typ)
+
+    def _validator_fields(self) -> dict:
+        """``Validator`` fields (beacon-chain.md "Validator"); research
+        forks (custody_game) append via override."""
+        return {
+            "pubkey": BLSPubkey,
+            "withdrawal_credentials": Bytes32,
+            "effective_balance": Gwei,
+            "slashed": boolean,
+            "activation_eligibility_epoch": Epoch,
+            "activation_epoch": Epoch,
+            "exit_epoch": Epoch,
+            "withdrawable_epoch": Epoch,
+        }
+
+    def _attestation_data_fields(self, t) -> dict:
+        """``AttestationData`` fields; the legacy sharding lineage appends
+        ``shard_transition_root`` via override."""
+        return {
+            "slot": Slot,
+            "index": CommitteeIndex,
+            "beacon_block_root": Root,
+            "source": t["Checkpoint"],
+            "target": t["Checkpoint"],
+        }
 
     def _block_body_fields(self, t) -> dict:
         S = self

@@ -1,0 +1,142 @@
+"""Sharding research fork: shard-data commitments over the beacon chain.
+
+Behavioral source: the reference's sharding feature
+(``specs/_features/sharding/``, preset ``presets/minimal/sharding.yaml``)
+and the shard-transition data model its custody-game spec builds on
+(``specs/_features/custody_game/beacon-chain.md:169-200`` references
+``sharding.ShardTransition``; the surviving executable contract is the
+sharding unittest suite ``test/sharding/unittests/test_get_start_shard.py``
+— ``get_active_shard_count``, ``get_committee_count_delta``,
+``get_start_shard``, ``state.current_epoch_start_shard``).
+
+NOTE ON LINEAGE: the reference's sharding markdown at this version is a
+work-in-progress rewrite (builder-block bids / sharded-commitment
+containers) that is internally inconsistent — it references containers and
+helpers that no longer exist, and its own test suite + preset files still
+pin the EARLIER shard-header design (``ShardTransition``,
+``current_epoch_start_shard``); the fork is excluded from the reference's
+pyspec build entirely. This module implements the earlier design as the
+EXECUTABLE surface (it is the one with a behavioral contract: the tests
+and the custody game), parented on phase0 exactly as the original phase-1
+lineage was. The rewrite's containers are documented in
+``specs/_features/sharding/beacon-chain.md`` as prose.
+"""
+from consensus_specs_tpu.utils.ssz import (
+    Container, List, Vector, uint64, Bytes32,
+)
+from . import register_fork
+from .phase0 import Phase0Spec
+from .base_types import (
+    Slot, Epoch, Gwei, Root, BLSSignature, DomainType,
+)
+
+Shard = uint64
+
+
+@register_fork("sharding")
+class ShardingSpec(Phase0Spec):
+    fork = "sharding"
+    previous_fork = "phase0"
+
+    # Constants (non-configurable; sharding/beacon-chain.md "Misc")
+    DOMAIN_SHARD_PROPOSER = DomainType("0x80000000")
+    DOMAIN_SHARD_COMMITTEE = DomainType("0x81000000")
+    # Shard-data geometry of the shard-header design: one attestation
+    # crosslinks up to this many shard blocks, each at most
+    # MAX_SHARD_BLOCK_SIZE bytes (the custody game's chunking base).
+    MAX_SHARD_BLOCKS_PER_ATTESTATION = 12
+    MAX_SHARD_BLOCK_SIZE = 2**20
+
+    def get_active_shard_count(self, state, epoch=None) -> uint64:
+        """Number of active shards (upper-bounds committees/slot).
+
+        The epoch argument is accepted for forward compatibility with the
+        epoch-dependent shard count of later designs; the count is a
+        preset constant here (reference sharding preset
+        ``INITIAL_ACTIVE_SHARDS``)."""
+        return uint64(self.INITIAL_ACTIVE_SHARDS)
+
+    def get_committee_count_delta(self, state, start_slot, stop_slot) -> uint64:
+        """Sum of committee counts over ``[start_slot, stop_slot)``."""
+        return uint64(sum(
+            self.get_committee_count_per_slot(
+                state, self.compute_epoch_at_slot(Slot(s)))
+            for s in range(start_slot, stop_slot)
+        ))
+
+    def get_start_shard(self, state, slot) -> Shard:
+        """Start shard of the committee rotation at ``slot``.
+
+        Walks per-slot from the current epoch start, adding (future) or
+        subtracting (past) that slot's committee count mod the active
+        shard count; the subtraction is biased by a multiple of the shard
+        count so it never goes negative."""
+        current_epoch_start_slot = self.compute_start_slot_at_epoch(
+            self.get_current_epoch(state))
+        shard = int(state.current_epoch_start_shard)
+        if slot > current_epoch_start_slot:
+            for s in range(current_epoch_start_slot, slot):
+                committee_count = self.get_committee_count_per_slot(
+                    state, self.compute_epoch_at_slot(Slot(s)))
+                active_shards = self.get_active_shard_count(
+                    state, self.compute_epoch_at_slot(Slot(s)))
+                shard = (shard + int(committee_count)) % int(active_shards)
+        elif slot < current_epoch_start_slot:
+            for s in reversed(range(slot, current_epoch_start_slot)):
+                committee_count = self.get_committee_count_per_slot(
+                    state, self.compute_epoch_at_slot(Slot(s)))
+                active_shards = self.get_active_shard_count(
+                    state, self.compute_epoch_at_slot(Slot(s)))
+                shard = (shard
+                         + int(active_shards) * int(self.MAX_COMMITTEES_PER_SLOT)
+                         - int(committee_count)) % int(active_shards)
+        return Shard(shard)
+
+    # -- types ------------------------------------------------------------
+    def _build_types(self):
+        class ShardState(Container):
+            slot: Slot
+            gasprice: Gwei
+            latest_block_root: Root
+
+        S = self
+
+        class ShardTransition(Container):
+            start_slot: Slot
+            shard_block_lengths: List[uint64, S.MAX_SHARD_BLOCKS_PER_ATTESTATION]
+            shard_data_roots: List[Bytes32, S.MAX_SHARD_BLOCKS_PER_ATTESTATION]
+            shard_states: List[ShardState, S.MAX_SHARD_BLOCKS_PER_ATTESTATION]
+            proposer_signature_aggregate: BLSSignature
+
+        self.ShardState = ShardState
+        self.ShardTransition = ShardTransition
+        super()._build_types()
+
+    def _attestation_data_fields(self, t) -> dict:
+        fields = super()._attestation_data_fields(t)
+        # Crosslink commitment: the attested shard-transition root the
+        # custody game challenges against (custody_game/beacon-chain.md
+        # ``challenge.attestation.data.shard_transition_root``).
+        fields["shard_transition_root"] = Root
+        return fields
+
+    def _state_fields(self, t) -> dict:
+        fields = super()._state_fields(t)
+        fields["current_epoch_start_shard"] = Shard
+        return fields
+
+    # -- epoch processing -------------------------------------------------
+    def process_shard_epoch_increment(self, state) -> None:
+        """Rotate ``current_epoch_start_shard`` by the epoch's total
+        committee count (what makes ``get_start_shard`` O(epoch-local))."""
+        epoch_start = self.compute_start_slot_at_epoch(
+            self.get_current_epoch(state))
+        delta = self.get_committee_count_delta(
+            state, epoch_start, epoch_start + self.SLOTS_PER_EPOCH)
+        state.current_epoch_start_shard = Shard(
+            (int(state.current_epoch_start_shard) + int(delta))
+            % int(self.get_active_shard_count(state)))
+
+    def process_epoch(self, state) -> None:
+        super().process_epoch(state)
+        self.process_shard_epoch_increment(state)

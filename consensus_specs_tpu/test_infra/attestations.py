@@ -9,7 +9,8 @@ from .keys import privkeys
 from .block import build_empty_block_for_next_slot
 
 
-def build_attestation_data(spec, state, slot, index, beacon_block_root=None):
+def build_attestation_data(spec, state, slot, index, beacon_block_root=None,
+                           shard_transition=None):
     assert state.slot >= slot
 
     if beacon_block_root is not None:
@@ -33,7 +34,7 @@ def build_attestation_data(spec, state, slot, index, beacon_block_root=None):
     else:
         source = state.current_justified_checkpoint
 
-    return spec.AttestationData(
+    data = spec.AttestationData(
         slot=slot,
         index=index,
         beacon_block_root=beacon_block_root,
@@ -41,18 +42,25 @@ def build_attestation_data(spec, state, slot, index, beacon_block_root=None):
         target=spec.Checkpoint(
             epoch=spec.compute_epoch_at_slot(slot), root=epoch_boundary_root),
     )
+    if shard_transition is not None:
+        # sharding/custody_game lineage: the attestation commits to the
+        # shard transition it crosslinks (sharding.py AttestationData)
+        from consensus_specs_tpu.utils.ssz import hash_tree_root
+        data.shard_transition_root = hash_tree_root(shard_transition)
+    return data
 
 
 def get_valid_attestation(spec, state, slot=None, index=None,
                           filter_participant_set=None, beacon_block_root=None,
-                          signed=False):
+                          signed=False, shard_transition=None):
     if slot is None:
         slot = state.slot
     if index is None:
         index = 0
 
     attestation_data = build_attestation_data(
-        spec, state, slot=slot, index=index, beacon_block_root=beacon_block_root)
+        spec, state, slot=slot, index=index, beacon_block_root=beacon_block_root,
+        shard_transition=shard_transition)
     beacon_committee = spec.get_beacon_committee(
         state, attestation_data.slot, attestation_data.index)
     committee_size = len(beacon_committee)
@@ -123,7 +131,9 @@ def run_attestation_processing(spec, state, attestation, valid=True):
             return
         raise AssertionError("attestation processing should have failed")
 
-    is_phase0 = spec.fork == "phase0"
+    # phase0-family forks (incl. sharding/custody_game) record pending
+    # attestations; altair+ uses participation flags
+    is_phase0 = hasattr(state, "current_epoch_attestations")
     if is_phase0:
         current_epoch_count = len(state.current_epoch_attestations)
         previous_epoch_count = len(state.previous_epoch_attestations)

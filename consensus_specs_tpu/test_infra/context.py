@@ -24,7 +24,8 @@ ONLY_FORK = None
 
 ALL_PHASES = ("phase0", "altair", "bellatrix", "capella", "deneb")
 # feature forks: selectable via with_phases, excluded from with_all_phases
-FEATURE_PHASES = ("eip6110", "eip7002", "eip7594", "whisk")
+FEATURE_PHASES = ("eip6110", "eip7002", "eip7594", "whisk",
+                  "sharding", "custody_game")
 MINIMAL = "minimal"
 MAINNET = "mainnet"
 
@@ -206,6 +207,25 @@ def never_bls(fn):
         finally:
             bls.bls_active = old
     entry._bls_mode = "never"
+    return entry
+
+
+def disable_process_reveal_deadlines(fn):
+    """custody_game: no-op ``process_reveal_deadlines`` so tests can walk
+    past custody periods without mass-slashing the registry (reference
+    ``context.py`` decorator of the same name)."""
+    @functools.wraps(fn)
+    def entry(*args, spec, **kwargs):
+        if hasattr(spec, "process_reveal_deadlines"):
+            # shadow the bound method on the (cached, shared) instance;
+            # consume the (lazy) test generator INSIDE the patch scope or
+            # the revert would land before the test body ever runs
+            spec.process_reveal_deadlines = lambda state: None
+            try:
+                return _consume(fn(*args, spec=spec, **kwargs))
+            finally:
+                del spec.process_reveal_deadlines
+        return fn(*args, spec=spec, **kwargs)
     return entry
 
 

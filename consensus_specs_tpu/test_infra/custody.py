@@ -1,0 +1,229 @@
+"""Custody-game test builders.
+
+Reference: ``test/helpers/custody.py`` (get_valid_early_derived_secret_reveal:10,
+get_valid_custody_key_reveal:37, get_valid_custody_slashing:64,
+get_valid_chunk_challenge:93, get_valid_custody_chunk_response:123,
+get_sample_shard_transition:152).
+"""
+from consensus_specs_tpu.utils.hash_function import hash
+from consensus_specs_tpu.utils.ssz import (
+    hash_tree_root, ByteVector, ByteList, Bytes32, uint64, zero_hashes,
+)
+# Custody secrets are real BLS signatures even when signature
+# VERIFICATION is stubbed out (``bls.bls_active = False``):
+# ``compute_custody_bit`` decompresses the secret as a G2 point, so a
+# stub constant would break the custody-bit math itself. Sign/Aggregate
+# therefore bypass the kill-switch and use the oracle directly.
+from consensus_specs_tpu.ops.bls12_381.ciphersuite import Sign, Aggregate
+from .keys import privkeys
+
+
+
+
+
+
+
+def transition_to(spec, state, slot):
+    if state.slot < slot:
+        spec.process_slots(state, slot)
+
+
+def get_valid_early_derived_secret_reveal(spec, state, epoch=None):
+    current_epoch = spec.get_current_epoch(state)
+    revealed_index = spec.get_active_validator_indices(state, current_epoch)[-1]
+    masker_index = spec.get_active_validator_indices(state, current_epoch)[0]
+
+    if epoch is None:
+        epoch = current_epoch + spec.CUSTODY_PERIOD_TO_RANDAO_PADDING
+
+    # The derived secret being revealed: sig over the epoch
+    domain = spec.get_domain(state, spec.DOMAIN_RANDAO, epoch)
+    signing_root = spec.compute_signing_root(uint64(epoch), domain)
+    reveal = Sign(privkeys[revealed_index], signing_root)
+    # Mask hides the revealed secret from theft in the mempool
+    mask = Bytes32(hash(reveal))
+    signing_root = spec.compute_signing_root(mask, domain)
+    masker_signature = Sign(privkeys[masker_index], signing_root)
+    masked_reveal = Aggregate([reveal, masker_signature])
+
+    return spec.EarlyDerivedSecretReveal(
+        revealed_index=revealed_index,
+        epoch=epoch,
+        reveal=masked_reveal,
+        masker_index=masker_index,
+        mask=mask,
+    )
+
+
+def get_valid_custody_key_reveal(spec, state, period=None, validator_index=None):
+    current_epoch = spec.get_current_epoch(state)
+    revealer_index = (spec.get_active_validator_indices(state, current_epoch)[0]
+                      if validator_index is None else validator_index)
+    revealer = state.validators[revealer_index]
+
+    if period is None:
+        period = revealer.next_custody_secret_to_reveal
+
+    epoch_to_sign = spec.get_randao_epoch_for_custody_period(
+        period, revealer_index)
+
+    domain = spec.get_domain(state, spec.DOMAIN_RANDAO, epoch_to_sign)
+    signing_root = spec.compute_signing_root(uint64(epoch_to_sign), domain)
+    reveal = Sign(privkeys[revealer_index], signing_root)
+    return spec.CustodyKeyReveal(revealer_index=revealer_index, reveal=reveal)
+
+
+def get_custody_secret(spec, state, validator_index, epoch=None):
+    """The validator's period secret: sig over the period's RANDAO epoch."""
+    period = spec.get_custody_period_for_validator(
+        validator_index,
+        epoch if epoch is not None else spec.get_current_epoch(state))
+    epoch_to_sign = spec.get_randao_epoch_for_custody_period(
+        period, validator_index)
+    domain = spec.get_domain(state, spec.DOMAIN_RANDAO, epoch_to_sign)
+    signing_root = spec.compute_signing_root(uint64(epoch_to_sign), domain)
+    return Sign(privkeys[validator_index], signing_root)
+
+
+def get_valid_custody_slashing(spec, state, attestation, shard_transition,
+                               custody_secret, data, data_index=0):
+    beacon_committee = spec.get_beacon_committee(
+        state, attestation.data.slot, attestation.data.index)
+    malefactor_index = beacon_committee[0]
+    whistleblower_index = beacon_committee[-1]
+
+    slashing = spec.CustodySlashing(
+        data_index=data_index,
+        malefactor_index=malefactor_index,
+        malefactor_secret=custody_secret,
+        whistleblower_index=whistleblower_index,
+        shard_transition=shard_transition,
+        attestation=attestation,
+        data=data,
+    )
+    slashing_domain = spec.get_domain(state, spec.DOMAIN_CUSTODY_BIT_SLASHING)
+    slashing_root = spec.compute_signing_root(slashing, slashing_domain)
+    return spec.SignedCustodySlashing(
+        message=slashing,
+        signature=Sign(privkeys[whistleblower_index], slashing_root),
+    )
+
+
+def get_valid_chunk_challenge(spec, state, attestation, shard_transition,
+                              data_index=None, chunk_index=None):
+    committee = spec.get_beacon_committee(
+        state, attestation.data.slot, attestation.data.index)
+    responder_index = committee[0]
+    data_index = (len(shard_transition.shard_block_lengths) - 1
+                  if not data_index else data_index)
+
+    chunk_count = (int(shard_transition.shard_block_lengths[data_index])
+                   + spec.BYTES_PER_CUSTODY_CHUNK - 1) \
+        // spec.BYTES_PER_CUSTODY_CHUNK
+    chunk_index = chunk_count - 1 if not chunk_index else chunk_index
+
+    return spec.CustodyChunkChallenge(
+        responder_index=responder_index,
+        attestation=attestation,
+        chunk_index=chunk_index,
+        data_index=data_index,
+        shard_transition=shard_transition,
+    )
+
+
+def custody_chunkify(spec, x):
+    x = bytes(x)
+    chunks = [x[i:i + spec.BYTES_PER_CUSTODY_CHUNK]
+              for i in range(0, len(x), spec.BYTES_PER_CUSTODY_CHUNK)]
+    chunks[-1] = chunks[-1].ljust(spec.BYTES_PER_CUSTODY_CHUNK, b"\0")
+    return [ByteVector[spec.BYTES_PER_CUSTODY_CHUNK](c) for c in chunks]
+
+
+def _chunk_body_branch(spec, chunks, chunk_index):
+    """Sibling path of custody-chunk ``chunk_index``'s subtree root inside
+    the ByteList body tree (depth CUSTODY_RESPONSE_DEPTH over
+    custody-chunk subtree roots; absent chunks are zero subtrees)."""
+    # Each custody chunk (4096 B) is a depth-7 subtree of 32-byte SSZ
+    # chunks; its root is hash_tree_root(ByteVector[4096]).
+    sub_depth = (spec.BYTES_PER_CUSTODY_CHUNK // 32 - 1).bit_length()
+    n_leaves = 2 ** spec.CUSTODY_RESPONSE_DEPTH
+    leaves = [hash_tree_root(c) for c in chunks]
+    leaves += [zero_hashes[sub_depth]] * (n_leaves - len(leaves))
+    branch = []
+    idx = chunk_index
+    level = leaves
+    for _ in range(spec.CUSTODY_RESPONSE_DEPTH):
+        branch.append(level[idx ^ 1])
+        level = [hash(level[i] + level[i + 1])
+                 for i in range(0, len(level), 2)]
+        idx //= 2
+    return branch
+
+
+def get_valid_custody_chunk_response(spec, state, chunk_challenge,
+                                     challenge_index,
+                                     block_length_or_custody_data,
+                                     invalid_chunk_data=False):
+    if isinstance(block_length_or_custody_data, int):
+        custody_data = get_custody_test_vector(block_length_or_custody_data)
+    else:
+        custody_data = block_length_or_custody_data
+
+    custody_data_block = ByteList[spec.MAX_SHARD_BLOCK_SIZE](custody_data)
+    chunks = custody_chunkify(spec, custody_data_block)
+    chunk_index = int(chunk_challenge.chunk_index)
+
+    data_branch = _chunk_body_branch(spec, chunks, chunk_index) + [
+        len(custody_data_block).to_bytes(32, "little")]
+
+    chunk = chunks[chunk_index]
+    if invalid_chunk_data:
+        chunk = ByteVector[spec.BYTES_PER_CUSTODY_CHUNK](
+            bytes(chunk)[:-1] + bytes([bytes(chunk)[-1] ^ 0xFF]))
+
+    return spec.CustodyChunkResponse(
+        challenge_index=challenge_index,
+        chunk_index=chunk_index,
+        chunk=chunk,
+        branch=data_branch,
+    )
+
+
+def get_custody_test_vector(bytelength, offset=0):
+    ints = bytelength // 4 + 1
+    return (b"".join((i + offset).to_bytes(4, "little")
+                     for i in range(ints)))[:bytelength]
+
+
+def get_sample_shard_transition(spec, start_slot, block_lengths):
+    roots = [hash_tree_root(ByteList[spec.MAX_SHARD_BLOCK_SIZE](
+        get_custody_test_vector(x))) for x in block_lengths]
+    return spec.ShardTransition(
+        start_slot=start_slot,
+        shard_block_lengths=block_lengths,
+        shard_data_roots=roots,
+        shard_states=[spec.ShardState() for _ in block_lengths],
+        proposer_signature_aggregate=b"\x00" * 96,
+    )
+
+
+def get_custody_slashable_test_vector(spec, custody_secret, length,
+                                      slashable=True):
+    test_vector = get_custody_test_vector(length)
+    offset = 0
+    while bool(spec.compute_custody_bit(custody_secret, test_vector)) \
+            != slashable:
+        offset += 1
+        test_vector = get_custody_test_vector(length, offset)
+    return test_vector
+
+
+def get_custody_slashable_shard_transition(spec, start_slot, block_lengths,
+                                           custody_secret, slashable=True):
+    shard_transition = get_sample_shard_transition(
+        spec, start_slot, block_lengths)
+    slashable_test_vector = get_custody_slashable_test_vector(
+        spec, custody_secret, block_lengths[0], slashable=slashable)
+    block_data = ByteList[spec.MAX_SHARD_BLOCK_SIZE](slashable_test_vector)
+    shard_transition.shard_data_roots[0] = hash_tree_root(block_data)
+    return shard_transition, slashable_test_vector

@@ -6,7 +6,25 @@ sub-step *before* the one under test, then yield pre/post around it.
 
 
 def get_process_calls(spec):
-    if spec.fork == "phase0":
+    if spec.fork == "custody_game":
+        # custody_game/beacon-chain.md "Epoch transition" ordering
+        return [
+            "process_justification_and_finalization",
+            "process_rewards_and_penalties",
+            "process_registry_updates",
+            "process_reveal_deadlines",
+            "process_challenge_deadlines",
+            "process_slashings",
+            "process_eth1_data_reset",
+            "process_effective_balance_updates",
+            "process_slashings_reset",
+            "process_randao_mixes_reset",
+            "process_historical_roots_update",
+            "process_participation_record_updates",
+            "process_custody_final_updates",
+            "process_shard_epoch_increment",
+        ]
+    if spec.fork in ("phase0", "sharding"):
         return [
             "process_justification_and_finalization",
             "process_rewards_and_penalties",
@@ -18,7 +36,8 @@ def get_process_calls(spec):
             "process_randao_mixes_reset",
             "process_historical_roots_update",
             "process_participation_record_updates",
-        ]
+        ] + (["process_shard_epoch_increment"]
+             if spec.fork == "sharding" else [])
     # altair+ ordering (specs/altair/beacon-chain.md process_epoch; capella
     # renames historical roots to historical summaries)
     calls = [

@@ -23,6 +23,11 @@ def build_mock_validator(spec, i: int, balance: int):
         effective_balance=min(balance - balance % spec.EFFECTIVE_BALANCE_INCREMENT,
                               spec.MAX_EFFECTIVE_BALANCE),
     )
+    # Research forks (custody_game) carry validator fields whose genesis
+    # value is not the SSZ zero-default.
+    finalize = getattr(spec, "finalize_mock_validator", None)
+    if finalize is not None:
+        finalize(validator, i)
     return validator
 
 
@@ -39,11 +44,15 @@ def _genesis_fork_versions(spec):
         "eip7002": getattr(spec.config, "EIP7002_FORK_VERSION", None),
         "eip7594": getattr(spec.config, "EIP7594_FORK_VERSION", None),
         "whisk": getattr(spec.config, "WHISK_FORK_VERSION", None),
+        "sharding": getattr(spec.config, "SHARDING_FORK_VERSION", None),
+        "custody_game": getattr(spec.config, "CUSTODY_GAME_FORK_VERSION", None),
     }
     order = ["phase0", "altair", "bellatrix", "capella", "deneb",
-             "eip6110", "eip7002", "eip7594", "whisk"]
+             "eip6110", "eip7002", "eip7594", "whisk",
+             "sharding", "custody_game"]
     # feature forks branch off their DAG parent, not list order
-    parents = {"eip7002": "capella", "eip7594": "deneb", "whisk": "capella"}
+    parents = {"eip7002": "capella", "eip7594": "deneb", "whisk": "capella",
+               "sharding": "phase0", "custody_game": "sharding"}
     cur = versions[fork]
     prev_name = parents.get(fork, order[max(0, order.index(fork) - 1)])
     prev = versions[prev_name]

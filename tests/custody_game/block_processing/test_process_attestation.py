@@ -1,0 +1,47 @@
+"""Attestation processing under the custody-game fork.
+
+Reference model: ``test/custody_game/block_processing/
+test_process_attestation.py`` — standard phase0 attestation rules still
+hold with the sharding ``AttestationData`` extension.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, always_bls,
+)
+from consensus_specs_tpu.test_infra.attestations import (
+    get_valid_attestation, run_attestation_processing,
+)
+from consensus_specs_tpu.test_infra.custody import (
+    get_sample_shard_transition, transition_to,
+)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@always_bls
+def test_attestation(spec, state):
+    transition_to(spec, state, state.slot + 1)
+    shard_transition = get_sample_shard_transition(
+        spec, state.slot, [2**15 // 3])
+    attestation = get_valid_attestation(
+        spec, state, signed=True, shard_transition=shard_transition)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@always_bls
+def test_attestation_wrong_transition_root_sig(spec, state):
+    """Tampering with the shard_transition_root after signing breaks the
+    attestation signature (the root is part of the signed data)."""
+    transition_to(spec, state, state.slot + 1)
+    shard_transition = get_sample_shard_transition(
+        spec, state.slot, [2**15 // 3])
+    attestation = get_valid_attestation(
+        spec, state, signed=True, shard_transition=shard_transition)
+    attestation.data.shard_transition_root = b"\x11" * 32
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(
+        spec, state, attestation, valid=False)

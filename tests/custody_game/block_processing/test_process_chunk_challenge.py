@@ -1,0 +1,242 @@
+"""Custody chunk challenge + response processing.
+
+Reference model: ``test/custody_game/block_processing/
+test_process_chunk_challenge.py`` against
+``specs/_features/custody_game/beacon-chain.md`` ("Chunk challenges",
+"Custody chunk response").
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, with_presets,
+    disable_process_reveal_deadlines, expect_assertion_error,
+)
+from consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+from consensus_specs_tpu.test_infra.custody import (
+    get_sample_shard_transition, get_valid_chunk_challenge,
+    get_valid_custody_chunk_response, get_custody_test_vector, transition_to,
+)
+
+_BLOCK_LEN = 2**15 // 3
+
+
+def run_chunk_challenge_processing(spec, state, challenge, valid=True):
+    yield "pre", state
+    yield "custody_chunk_challenge", challenge
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_chunk_challenge(state, challenge))
+        yield "post", None
+        return
+    spec.process_chunk_challenge(state, challenge)
+    record = state.custody_chunk_challenge_records[
+        state.custody_chunk_challenge_index - 1]
+    assert record.responder_index == challenge.responder_index
+    assert record.chunk_index == challenge.chunk_index
+    yield "post", state
+
+
+def run_custody_chunk_response_processing(spec, state, response, valid=True):
+    yield "pre", state
+    yield "custody_response", response
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_chunk_challenge_response(state, response))
+        yield "post", None
+        return
+    spec.process_chunk_challenge_response(state, response)
+    assert state.custody_chunk_challenge_records[response.challenge_index] \
+        == spec.CustodyChunkChallengeRecord()
+    yield "post", state
+
+
+def _attested_shard_transition(spec, state, block_lengths=None):
+    """Advance a slot, attest to a sample shard transition, include it."""
+    transition_to(spec, state, state.slot + 1)
+    shard_transition = get_sample_shard_transition(
+        spec, state.slot, block_lengths or [_BLOCK_LEN])
+    attestation = get_valid_attestation(
+        spec, state, signed=True, shard_transition=shard_transition)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    spec.process_attestation(state, attestation)
+    return attestation, shard_transition
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@with_presets(["minimal"], reason="too slow")
+@disable_process_reveal_deadlines
+def test_challenge_appended(spec, state):
+    attestation, shard_transition = _attested_shard_transition(spec, state)
+    transition_to(spec, state, state.slot
+                  + spec.SLOTS_PER_EPOCH * spec.EPOCHS_PER_CUSTODY_PERIOD)
+    challenge = get_valid_chunk_challenge(
+        spec, state, attestation, shard_transition)
+    yield from run_chunk_challenge_processing(spec, state, challenge)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@with_presets(["minimal"], reason="too slow")
+@disable_process_reveal_deadlines
+def test_challenge_empty_element_replaced(spec, state):
+    attestation, shard_transition = _attested_shard_transition(spec, state)
+    challenge = get_valid_chunk_challenge(
+        spec, state, attestation, shard_transition)
+    state.custody_chunk_challenge_records.append(
+        spec.CustodyChunkChallengeRecord())
+    yield from run_chunk_challenge_processing(spec, state, challenge)
+    assert state.custody_chunk_challenge_records[0] != \
+        spec.CustodyChunkChallengeRecord()
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@with_presets(["minimal"], reason="too slow")
+@disable_process_reveal_deadlines
+def test_duplicate_challenge(spec, state):
+    attestation, shard_transition = _attested_shard_transition(spec, state)
+    challenge = get_valid_chunk_challenge(
+        spec, state, attestation, shard_transition)
+    spec.process_chunk_challenge(state, challenge)
+    yield from run_chunk_challenge_processing(
+        spec, state, challenge, valid=False)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@with_presets(["minimal"], reason="too slow")
+@disable_process_reveal_deadlines
+def test_second_challenge_different_chunk(spec, state):
+    attestation, shard_transition = _attested_shard_transition(spec, state)
+    challenge0 = get_valid_chunk_challenge(
+        spec, state, attestation, shard_transition, chunk_index=0)
+    spec.process_chunk_challenge(state, challenge0)
+    challenge1 = get_valid_chunk_challenge(
+        spec, state, attestation, shard_transition, chunk_index=1)
+    yield from run_chunk_challenge_processing(spec, state, challenge1)
+    assert state.custody_chunk_challenge_index == 2
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@with_presets(["minimal"], reason="too slow")
+@disable_process_reveal_deadlines
+def test_wrong_shard_transition(spec, state):
+    attestation, shard_transition = _attested_shard_transition(spec, state)
+    # Tamper with the transition so its root no longer matches the
+    # attested shard_transition_root
+    shard_transition.shard_block_lengths[0] = _BLOCK_LEN + 1
+    challenge = get_valid_chunk_challenge(
+        spec, state, attestation, shard_transition)
+    yield from run_chunk_challenge_processing(
+        spec, state, challenge, valid=False)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@with_presets(["minimal"], reason="too slow")
+@disable_process_reveal_deadlines
+def test_challenge_expired(spec, state):
+    attestation, shard_transition = _attested_shard_transition(spec, state)
+    transition_to(spec, state, state.slot + spec.SLOTS_PER_EPOCH
+                  * (spec.MAX_CHUNK_CHALLENGE_DELAY + 1))
+    challenge = get_valid_chunk_challenge(
+        spec, state, attestation, shard_transition)
+    yield from run_chunk_challenge_processing(
+        spec, state, challenge, valid=False)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@with_presets(["minimal"], reason="too slow")
+@disable_process_reveal_deadlines
+def test_chunk_index_out_of_bounds(spec, state):
+    attestation, shard_transition = _attested_shard_transition(spec, state)
+    chunk_count = (_BLOCK_LEN + spec.BYTES_PER_CUSTODY_CHUNK - 1) \
+        // spec.BYTES_PER_CUSTODY_CHUNK
+    challenge = get_valid_chunk_challenge(
+        spec, state, attestation, shard_transition)
+    challenge.chunk_index = chunk_count
+    yield from run_chunk_challenge_processing(
+        spec, state, challenge, valid=False)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@with_presets(["minimal"], reason="too slow")
+@disable_process_reveal_deadlines
+def test_custody_response(spec, state):
+    attestation, shard_transition = _attested_shard_transition(spec, state)
+    challenge = get_valid_chunk_challenge(
+        spec, state, attestation, shard_transition)
+    spec.process_chunk_challenge(state, challenge)
+    challenge_index = state.custody_chunk_challenge_index - 1
+    response = get_valid_custody_chunk_response(
+        spec, state, challenge, challenge_index, _BLOCK_LEN)
+    yield from run_custody_chunk_response_processing(spec, state, response)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@with_presets(["minimal"], reason="too slow")
+@disable_process_reveal_deadlines
+def test_custody_response_chunk_index_mismatch(spec, state):
+    attestation, shard_transition = _attested_shard_transition(spec, state)
+    challenge = get_valid_chunk_challenge(
+        spec, state, attestation, shard_transition, chunk_index=1)
+    spec.process_chunk_challenge(state, challenge)
+    challenge_index = state.custody_chunk_challenge_index - 1
+    response = get_valid_custody_chunk_response(
+        spec, state, challenge, challenge_index, _BLOCK_LEN)
+    response.chunk_index = 0
+    yield from run_custody_chunk_response_processing(
+        spec, state, response, valid=False)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@with_presets(["minimal"], reason="too slow")
+@disable_process_reveal_deadlines
+def test_custody_response_invalid_chunk(spec, state):
+    attestation, shard_transition = _attested_shard_transition(spec, state)
+    challenge = get_valid_chunk_challenge(
+        spec, state, attestation, shard_transition)
+    spec.process_chunk_challenge(state, challenge)
+    challenge_index = state.custody_chunk_challenge_index - 1
+    response = get_valid_custody_chunk_response(
+        spec, state, challenge, challenge_index, _BLOCK_LEN,
+        invalid_chunk_data=True)
+    yield from run_custody_chunk_response_processing(
+        spec, state, response, valid=False)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@with_presets(["minimal"], reason="too slow")
+@disable_process_reveal_deadlines
+def test_custody_response_missing_challenge(spec, state):
+    attestation, shard_transition = _attested_shard_transition(spec, state)
+    challenge = get_valid_chunk_challenge(
+        spec, state, attestation, shard_transition)
+    response = get_valid_custody_chunk_response(
+        spec, state, challenge, challenge_index=7,
+        block_length_or_custody_data=_BLOCK_LEN)
+    yield from run_custody_chunk_response_processing(
+        spec, state, response, valid=False)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@with_presets(["minimal"], reason="too slow")
+@disable_process_reveal_deadlines
+def test_custody_response_multiple_blocks(spec, state):
+    attestation, shard_transition = _attested_shard_transition(
+        spec, state, block_lengths=[_BLOCK_LEN, 2**14 // 3])
+    challenge = get_valid_chunk_challenge(
+        spec, state, attestation, shard_transition, data_index=1)
+    spec.process_chunk_challenge(state, challenge)
+    challenge_index = state.custody_chunk_challenge_index - 1
+    response = get_valid_custody_chunk_response(
+        spec, state, challenge, challenge_index,
+        get_custody_test_vector(2**14 // 3))
+    yield from run_custody_chunk_response_processing(spec, state, response)

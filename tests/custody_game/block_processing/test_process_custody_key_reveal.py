@@ -1,0 +1,93 @@
+"""Custody key reveal processing.
+
+Reference model: ``test/custody_game/block_processing/
+test_process_custody_key_reveal.py`` against
+``specs/_features/custody_game/beacon-chain.md`` ("Custody key reveals").
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, always_bls, expect_assertion_error,
+    disable_process_reveal_deadlines,
+)
+from consensus_specs_tpu.test_infra.custody import (
+    get_valid_custody_key_reveal, transition_to,
+)
+
+
+def run_custody_key_reveal_processing(spec, state, custody_key_reveal,
+                                      valid=True):
+    yield "pre", state
+    yield "custody_key_reveal", custody_key_reveal
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_custody_key_reveal(state, custody_key_reveal))
+        yield "post", None
+        return
+    revealer_index = custody_key_reveal.revealer_index
+    pre_next = state.validators[revealer_index].next_custody_secret_to_reveal
+    spec.process_custody_key_reveal(state, custody_key_reveal)
+    assert state.validators[revealer_index].next_custody_secret_to_reveal \
+        == pre_next + 1
+    yield "post", state
+
+
+def _advance_to_past_period(spec, state):
+    transition_to(spec, state, state.slot
+                  + spec.SLOTS_PER_EPOCH * spec.EPOCHS_PER_CUSTODY_PERIOD)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@always_bls
+def test_success(spec, state):
+    _advance_to_past_period(spec, state)
+    custody_key_reveal = get_valid_custody_key_reveal(spec, state)
+    yield from run_custody_key_reveal_processing(
+        spec, state, custody_key_reveal)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@always_bls
+def test_reveal_too_early(spec, state):
+    custody_key_reveal = get_valid_custody_key_reveal(spec, state)
+    yield from run_custody_key_reveal_processing(
+        spec, state, custody_key_reveal, valid=False)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@always_bls
+def test_wrong_period(spec, state):
+    _advance_to_past_period(spec, state)
+    custody_key_reveal = get_valid_custody_key_reveal(spec, state, period=5)
+    yield from run_custody_key_reveal_processing(
+        spec, state, custody_key_reveal, valid=False)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@always_bls
+def test_double_reveal(spec, state):
+    # advance two periods, then the second identical reveal must fail
+    transition_to(spec, state, state.slot + spec.SLOTS_PER_EPOCH
+                  * spec.EPOCHS_PER_CUSTODY_PERIOD * 2)
+    custody_key_reveal = get_valid_custody_key_reveal(spec, state)
+    spec.process_custody_key_reveal(state, custody_key_reveal)
+    yield from run_custody_key_reveal_processing(
+        spec, state, custody_key_reveal, valid=False)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@always_bls
+@disable_process_reveal_deadlines
+def test_max_decrement(spec, state):
+    # Far in the future, every past period can be revealed in sequence
+    transition_to(spec, state, state.slot + spec.SLOTS_PER_EPOCH
+                  * spec.EPOCHS_PER_CUSTODY_PERIOD * 3)
+    for _ in range(2):
+        custody_key_reveal = get_valid_custody_key_reveal(spec, state)
+        spec.process_custody_key_reveal(state, custody_key_reveal)
+    custody_key_reveal = get_valid_custody_key_reveal(spec, state)
+    yield from run_custody_key_reveal_processing(
+        spec, state, custody_key_reveal)

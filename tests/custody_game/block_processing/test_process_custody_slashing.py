@@ -1,0 +1,140 @@
+"""Custody slashing processing.
+
+Reference model: ``test/custody_game/block_processing/
+test_process_custody_slashing.py`` against
+``specs/_features/custody_game/beacon-chain.md`` ("Custody Slashings").
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, with_presets,
+    disable_process_reveal_deadlines, expect_assertion_error,
+)
+from consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+from consensus_specs_tpu.test_infra.custody import (
+    get_custody_secret, get_custody_slashable_shard_transition,
+    get_sample_shard_transition, get_valid_custody_slashing,
+    get_custody_test_vector, transition_to,
+)
+from consensus_specs_tpu.utils.ssz import ByteList
+
+_BLOCK_LEN = 2**15 // 3
+
+
+def run_custody_slashing_processing(spec, state, slashing, valid=True,
+                                    correct=True):
+    yield "pre", state
+    yield "custody_slashing", slashing
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_custody_slashing(state, slashing))
+        yield "post", None
+        return
+    spec.process_custody_slashing(state, slashing)
+    if correct:
+        # The claim was correct: the malefactor is slashed
+        assert state.validators[slashing.message.malefactor_index].slashed
+    else:
+        # The claim was false: the whistleblower is slashed
+        assert state.validators[slashing.message.whistleblower_index].slashed
+    yield "post", state
+
+
+def _slashable_setup(spec, state, slashable=True):
+    """Attest to shard data crafted (non-)slashable for the malefactor
+    (the first member of the attesting committee)."""
+    transition_to(spec, state, state.slot + 1)
+    committee = spec.get_beacon_committee(state, state.slot, 0)
+    malefactor_secret = get_custody_secret(spec, state, committee[0])
+    shard_transition, data = get_custody_slashable_shard_transition(
+        spec, state.slot, [_BLOCK_LEN], malefactor_secret,
+        slashable=slashable)
+    attestation = get_valid_attestation(
+        spec, state, signed=True, shard_transition=shard_transition)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    spec.process_attestation(state, attestation)
+    return attestation, shard_transition, malefactor_secret, data
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@with_presets(["minimal"], reason="too slow")
+@disable_process_reveal_deadlines
+def test_custody_slashing(spec, state):
+    attestation, shard_transition, secret, data = _slashable_setup(
+        spec, state, slashable=True)
+    slashing = get_valid_custody_slashing(
+        spec, state, attestation, shard_transition, secret, data)
+    yield from run_custody_slashing_processing(
+        spec, state, slashing, correct=True)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@with_presets(["minimal"], reason="too slow")
+@disable_process_reveal_deadlines
+def test_incorrect_custody_slashing(spec, state):
+    attestation, shard_transition, secret, data = _slashable_setup(
+        spec, state, slashable=False)
+    slashing = get_valid_custody_slashing(
+        spec, state, attestation, shard_transition, secret, data)
+    yield from run_custody_slashing_processing(
+        spec, state, slashing, correct=False)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@with_presets(["minimal"], reason="too slow")
+@disable_process_reveal_deadlines
+def test_multiple_epochs_custody(spec, state):
+    transition_to(spec, state, state.slot + spec.SLOTS_PER_EPOCH * 3)
+    attestation, shard_transition, secret, data = _slashable_setup(
+        spec, state, slashable=True)
+    slashing = get_valid_custody_slashing(
+        spec, state, attestation, shard_transition, secret, data)
+    yield from run_custody_slashing_processing(
+        spec, state, slashing, correct=True)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@with_presets(["minimal"], reason="too slow")
+@disable_process_reveal_deadlines
+def test_invalid_custody_slashing_data_root(spec, state):
+    attestation, shard_transition, secret, data = _slashable_setup(
+        spec, state, slashable=True)
+    # Hand the slashing different data than attested
+    wrong = get_custody_test_vector(_BLOCK_LEN, offset=123)
+    slashing = get_valid_custody_slashing(
+        spec, state, attestation, shard_transition, secret,
+        ByteList[spec.MAX_SHARD_BLOCK_SIZE](wrong))
+    yield from run_custody_slashing_processing(
+        spec, state, slashing, valid=False)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@with_presets(["minimal"], reason="too slow")
+@disable_process_reveal_deadlines
+def test_invalid_custody_slashing_length(spec, state):
+    attestation, shard_transition, secret, data = _slashable_setup(
+        spec, state, slashable=True)
+    slashing = get_valid_custody_slashing(
+        spec, state, attestation, shard_transition, secret,
+        ByteList[spec.MAX_SHARD_BLOCK_SIZE](bytes(data)[:-1]))
+    yield from run_custody_slashing_processing(
+        spec, state, slashing, valid=False)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@with_presets(["minimal"], reason="too slow")
+@disable_process_reveal_deadlines
+def test_custody_slashing_wrong_transition(spec, state):
+    attestation, shard_transition, secret, data = _slashable_setup(
+        spec, state, slashable=True)
+    other_transition = get_sample_shard_transition(
+        spec, shard_transition.start_slot, [_BLOCK_LEN + 5])
+    slashing = get_valid_custody_slashing(
+        spec, state, attestation, other_transition, secret, data)
+    yield from run_custody_slashing_processing(
+        spec, state, slashing, valid=False)

@@ -1,0 +1,125 @@
+"""Early derived secret reveal processing.
+
+Reference model: ``test/custody_game/block_processing/
+test_process_early_derived_secret_reveal.py`` against
+``specs/_features/custody_game/beacon-chain.md`` ("Early derived secret
+reveals").
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, always_bls, never_bls,
+    expect_assertion_error,
+)
+from consensus_specs_tpu.test_infra.custody import (
+    get_valid_early_derived_secret_reveal, transition_to,
+)
+
+
+def run_early_derived_secret_reveal_processing(spec, state, reveal,
+                                               valid=True):
+    yield "pre", state
+    yield "randao_key_reveal", reveal
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_early_derived_secret_reveal(state, reveal))
+        yield "post", None
+        return
+    spec.process_early_derived_secret_reveal(state, reveal)
+    slashed = state.validators[reveal.revealed_index].slashed
+    if reveal.epoch >= spec.get_current_epoch(state) \
+            + spec.CUSTODY_PERIOD_TO_RANDAO_PADDING:
+        assert slashed
+    else:
+        assert reveal.revealed_index in state.exposed_derived_secrets[
+            reveal.epoch % spec.EARLY_DERIVED_SECRET_PENALTY_MAX_FUTURE_EPOCHS]
+    yield "post", state
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@always_bls
+def test_success(spec, state):
+    reveal = get_valid_early_derived_secret_reveal(spec, state)
+    yield from run_early_derived_secret_reveal_processing(spec, state, reveal)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@never_bls
+def test_reveal_from_current_epoch(spec, state):
+    reveal = get_valid_early_derived_secret_reveal(
+        spec, state, spec.get_current_epoch(state))
+    yield from run_early_derived_secret_reveal_processing(
+        spec, state, reveal, valid=False)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@never_bls
+def test_reveal_from_past_epoch(spec, state):
+    transition_to(spec, state, state.slot + spec.SLOTS_PER_EPOCH)
+    reveal = get_valid_early_derived_secret_reveal(
+        spec, state, spec.get_current_epoch(state) - 1)
+    yield from run_early_derived_secret_reveal_processing(
+        spec, state, reveal, valid=False)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@always_bls
+def test_reveal_with_custody_padding(spec, state):
+    reveal = get_valid_early_derived_secret_reveal(
+        spec, state,
+        spec.get_current_epoch(state) + spec.CUSTODY_PERIOD_TO_RANDAO_PADDING)
+    yield from run_early_derived_secret_reveal_processing(
+        spec, state, reveal, valid=True)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@always_bls
+def test_reveal_with_custody_padding_minus_one(spec, state):
+    """One epoch inside the padding: penalty path, not slashing."""
+    reveal = get_valid_early_derived_secret_reveal(
+        spec, state,
+        spec.get_current_epoch(state)
+        + spec.CUSTODY_PERIOD_TO_RANDAO_PADDING - 1)
+    pre_balance = state.balances[reveal.revealed_index]
+    yield from run_early_derived_secret_reveal_processing(
+        spec, state, reveal, valid=True)
+    assert not state.validators[reveal.revealed_index].slashed
+    assert state.balances[reveal.revealed_index] < pre_balance
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@never_bls
+def test_double_reveal(spec, state):
+    epoch = spec.get_current_epoch(state) + spec.RANDAO_PENALTY_EPOCHS
+    reveal = get_valid_early_derived_secret_reveal(spec, state, epoch)
+    spec.process_early_derived_secret_reveal(state, reveal)
+    yield from run_early_derived_secret_reveal_processing(
+        spec, state, reveal, valid=False)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@never_bls
+def test_revealer_is_slashed(spec, state):
+    reveal = get_valid_early_derived_secret_reveal(
+        spec, state, spec.get_current_epoch(state)
+        + spec.RANDAO_PENALTY_EPOCHS)
+    state.validators[reveal.revealed_index].slashed = True
+    yield from run_early_derived_secret_reveal_processing(
+        spec, state, reveal, valid=False)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@never_bls
+def test_far_future_epoch(spec, state):
+    reveal = get_valid_early_derived_secret_reveal(
+        spec, state,
+        spec.get_current_epoch(state)
+        + spec.EARLY_DERIVED_SECRET_PENALTY_MAX_FUTURE_EPOCHS)
+    yield from run_early_derived_secret_reveal_processing(
+        spec, state, reveal, valid=False)

@@ -1,0 +1,57 @@
+"""Challenge-deadline epoch processing.
+
+Reference model: ``test/custody_game/epoch_processing/
+test_process_challenge_deadlines.py``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, with_presets,
+    disable_process_reveal_deadlines,
+)
+from consensus_specs_tpu.test_infra.epoch_processing import (
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+from consensus_specs_tpu.test_infra.custody import (
+    get_sample_shard_transition, get_valid_chunk_challenge, transition_to,
+)
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@with_presets(["minimal"], reason="too slow")
+@disable_process_reveal_deadlines
+def test_validator_slashed_after_chunk_challenge(spec, state):
+    transition_to(spec, state, state.slot + 1)
+    shard_transition = get_sample_shard_transition(
+        spec, state.slot, [2**15 // 3])
+    attestation = get_valid_attestation(
+        spec, state, signed=True, shard_transition=shard_transition)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    spec.process_attestation(state, attestation)
+
+    validator_index = spec.get_beacon_committee(
+        state, attestation.data.slot, attestation.data.index)[0]
+    challenge = get_valid_chunk_challenge(
+        spec, state, attestation, shard_transition)
+    spec.process_chunk_challenge(state, challenge)
+    assert state.validators[validator_index].slashed == 0
+
+    # Response never arrives. Walk so the deadline (current_epoch >
+    # inclusion + EPOCHS_PER_CUSTODY_PERIOD) is first crossed INSIDE the
+    # stage under test, not at an earlier boundary of the walk itself.
+    # (The reference test walks past the deadline first, which would
+    # clear the record before the stage runs — latent bug in a suite its
+    # repo never executes; see sharding.py lineage note.)
+    inclusion = spec.get_current_epoch(state)
+    transition_to(
+        spec, state,
+        (inclusion + spec.EPOCHS_PER_CUSTODY_PERIOD + 1)
+        * spec.SLOTS_PER_EPOCH + 1)
+    assert state.custody_chunk_challenge_records[0] != \
+        spec.CustodyChunkChallengeRecord()
+    yield from run_epoch_processing_with(
+        spec, state, "process_challenge_deadlines")
+    assert state.validators[validator_index].slashed == 1
+    assert state.custody_chunk_challenge_records[0] == \
+        spec.CustodyChunkChallengeRecord()

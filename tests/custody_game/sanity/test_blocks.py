@@ -1,0 +1,123 @@
+"""Full-block sanity tests for the custody game.
+
+Reference model: ``test/custody_game/sanity/test_blocks.py`` — each
+custody operation carried end-to-end through ``state_transition``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, with_presets,
+    disable_process_reveal_deadlines,
+)
+from consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block,
+)
+from consensus_specs_tpu.test_infra.custody import (
+    get_custody_secret, get_custody_slashable_shard_transition,
+    get_sample_shard_transition, get_valid_chunk_challenge,
+    get_valid_custody_chunk_response, get_valid_custody_key_reveal,
+    get_valid_custody_slashing, get_valid_early_derived_secret_reveal,
+    transition_to,
+)
+
+
+def _attested_transition(spec, state, slashable_secret_index=None):
+    transition_to(spec, state, state.slot + 1)
+    if slashable_secret_index is not None:
+        secret = get_custody_secret(spec, state, slashable_secret_index)
+        shard_transition, data = get_custody_slashable_shard_transition(
+            spec, state.slot, [2**15 // 3], secret, slashable=True)
+    else:
+        shard_transition = get_sample_shard_transition(
+            spec, state.slot, [2**15 // 3])
+        data = None
+    attestation = get_valid_attestation(
+        spec, state, signed=True, shard_transition=shard_transition)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    spec.process_attestation(state, attestation)
+    return attestation, shard_transition, data
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@with_presets(["minimal"], reason="too slow")
+@disable_process_reveal_deadlines
+def test_block_with_chunk_challenge_and_response(spec, state):
+    attestation, shard_transition, _ = _attested_transition(spec, state)
+    challenge = get_valid_chunk_challenge(
+        spec, state, attestation, shard_transition)
+
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.chunk_challenges.append(challenge)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+
+    challenge_index = state.custody_chunk_challenge_index - 1
+    response = get_valid_custody_chunk_response(
+        spec, state, challenge, challenge_index, 2**15 // 3)
+    block2 = build_empty_block_for_next_slot(spec, state)
+    block2.body.chunk_challenge_responses.append(response)
+    signed_block2 = state_transition_and_sign_block(spec, state, block2)
+    yield "blocks", [signed_block, signed_block2]
+    yield "post", state
+    assert state.custody_chunk_challenge_records[0] == \
+        spec.CustodyChunkChallengeRecord()
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@with_presets(["minimal"], reason="too slow")
+@disable_process_reveal_deadlines
+def test_block_with_custody_key_reveal(spec, state):
+    transition_to(spec, state, state.slot
+                  + spec.SLOTS_PER_EPOCH * spec.EPOCHS_PER_CUSTODY_PERIOD)
+    custody_key_reveal = get_valid_custody_key_reveal(spec, state)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.custody_key_reveals.append(custody_key_reveal)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert state.validators[0].next_custody_secret_to_reveal == 1
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@with_presets(["minimal"], reason="too slow")
+@disable_process_reveal_deadlines
+def test_block_with_early_derived_secret_reveal(spec, state):
+    reveal = get_valid_early_derived_secret_reveal(spec, state)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.early_derived_secret_reveals.append(reveal)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert state.validators[reveal.revealed_index].slashed
+
+
+@with_phases(["custody_game"])
+@spec_state_test
+@with_presets(["minimal"], reason="too slow")
+@disable_process_reveal_deadlines
+def test_block_with_custody_slashing(spec, state):
+    transition_to(spec, state, state.slot + 1)
+    committee = spec.get_beacon_committee(state, state.slot, 0)
+    malefactor_secret = get_custody_secret(spec, state, committee[0])
+    shard_transition, data = get_custody_slashable_shard_transition(
+        spec, state.slot, [2**15 // 3], malefactor_secret, slashable=True)
+    attestation = get_valid_attestation(
+        spec, state, signed=True, shard_transition=shard_transition)
+    transition_to(spec, state,
+                  state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    spec.process_attestation(state, attestation)
+
+    slashing = get_valid_custody_slashing(
+        spec, state, attestation, shard_transition, malefactor_secret, data)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.custody_slashings.append(slashing)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert state.validators[slashing.message.malefactor_index].slashed

@@ -1,0 +1,92 @@
+"""Shard rotation accessors.
+
+Reference model: ``test/sharding/unittests/test_get_start_shard.py`` —
+the surviving executable contract of the sharding feature
+(``get_committee_count_delta`` / ``get_start_shard`` /
+``current_epoch_start_shard``; see ``forks/sharding.py`` lineage note).
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases,
+)
+from consensus_specs_tpu.test_infra.block import next_epoch
+
+
+@with_phases(["sharding"])
+@spec_state_test
+def test_get_committee_count_delta(spec, state):
+    assert spec.get_committee_count_delta(state, 0, 0) == 0
+    assert spec.get_committee_count_per_slot(state, 0) != 0
+    assert spec.get_committee_count_delta(state, 0, 1) == \
+        spec.get_committee_count_per_slot(state, 0)
+    assert spec.get_committee_count_delta(state, 1, 2) == \
+        spec.get_committee_count_per_slot(state, 0)
+    assert spec.get_committee_count_delta(state, 0, 2) == \
+        spec.get_committee_count_per_slot(state, 0) * 2
+    assert spec.get_committee_count_delta(state, 0, spec.SLOTS_PER_EPOCH) == \
+        spec.get_committee_count_per_slot(state, 0) * spec.SLOTS_PER_EPOCH
+    assert spec.get_committee_count_delta(
+        state, 0, 2 * spec.SLOTS_PER_EPOCH) == (
+        spec.get_committee_count_per_slot(state, 0) * spec.SLOTS_PER_EPOCH
+        + spec.get_committee_count_per_slot(state, 1) * spec.SLOTS_PER_EPOCH)
+
+
+@with_phases(["sharding"])
+@spec_state_test
+def test_get_start_shard_current_epoch_start(spec, state):
+    assert state.current_epoch_start_shard == 0
+    next_epoch(spec, state)
+    active_shard_count = spec.get_active_shard_count(state)
+    assert state.current_epoch_start_shard == (
+        spec.get_committee_count_delta(state, 0, spec.SLOTS_PER_EPOCH)
+        % active_shard_count)
+    current_epoch_start_slot = spec.compute_start_slot_at_epoch(
+        spec.get_current_epoch(state))
+    assert spec.get_start_shard(state, current_epoch_start_slot) == \
+        state.current_epoch_start_shard
+
+
+@with_phases(["sharding"])
+@spec_state_test
+def test_get_start_shard_next_slot(spec, state):
+    next_epoch(spec, state)
+    active_shard_count = spec.get_active_shard_count(state)
+    current_epoch_start_slot = spec.compute_start_slot_at_epoch(
+        spec.get_current_epoch(state))
+    slot = current_epoch_start_slot + 1
+    start_shard = spec.get_start_shard(state, slot)
+    expected = (
+        state.current_epoch_start_shard
+        + spec.get_committee_count_delta(state, current_epoch_start_slot, slot)
+    ) % active_shard_count
+    assert start_shard == expected
+
+
+@with_phases(["sharding"])
+@spec_state_test
+def test_get_start_shard_previous_slot(spec, state):
+    next_epoch(spec, state)
+    active_shard_count = spec.get_active_shard_count(state)
+    current_epoch_start_slot = spec.compute_start_slot_at_epoch(
+        spec.get_current_epoch(state))
+    slot = current_epoch_start_slot - 1
+    start_shard = spec.get_start_shard(state, slot)
+    expected = (
+        state.current_epoch_start_shard
+        + spec.MAX_COMMITTEES_PER_SLOT * spec.SLOTS_PER_EPOCH
+        * active_shard_count
+        - spec.get_committee_count_delta(
+            state, slot, current_epoch_start_slot)
+    ) % active_shard_count
+    assert start_shard == expected
+
+
+@with_phases(["sharding"])
+@spec_state_test
+def test_get_start_shard_far_past_epoch(spec, state):
+    initial_epoch = spec.get_current_epoch(state)
+    initial_start_slot = spec.compute_start_slot_at_epoch(initial_epoch)
+    initial_start_shard = state.current_epoch_start_shard
+    for _ in range(spec.MAX_SHARDS + 2):
+        next_epoch(spec, state)
+    assert spec.get_start_shard(state, initial_start_slot) == \
+        initial_start_shard
