@@ -224,6 +224,22 @@ class ForkChoiceMixin:
             head = max(children,
                        key=lambda r: (int(self.get_weight(store, r)), r))
 
+    def get_safe_beacon_block_root(self, store):
+        """specs/fork_choice/safe-block.md — the engine-API ``safe`` tag:
+        the most recent justified block (reorging it needs a slashable
+        supermajority equivocation)."""
+        return self.Root(store.justified_checkpoint.root)
+
+    def get_safe_execution_payload_hash(self, store):
+        """safe-block.md — the safe block's payload hash, or the zero
+        hash for pre-merge blocks."""
+        safe_block_root = self.get_safe_beacon_block_root(store)
+        safe_block = store.blocks[safe_block_root]
+        body = safe_block.body
+        if hasattr(body, "execution_payload"):
+            return self.Hash32(body.execution_payload.block_hash)
+        return self.Hash32()
+
     # -- checkpoint bookkeeping --------------------------------------------
 
     def update_checkpoints(self, store, justified_checkpoint, finalized_checkpoint):

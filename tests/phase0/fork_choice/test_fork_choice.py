@@ -4,7 +4,7 @@ Reference models: ``test/phase0/fork_choice/test_get_head.py`` and
 ``test_on_block.py`` (event-sourced store simulation with head checks).
 """
 from consensus_specs_tpu.test_infra.context import (
-    spec_state_test, with_all_phases, never_bls,
+    spec_state_test, with_all_phases, never_bls, pytest_only,
 )
 from consensus_specs_tpu.test_infra.block import (
     build_empty_block_for_next_slot, state_transition_and_sign_block, next_slots)
@@ -222,3 +222,19 @@ def test_justification_update_from_epoch_transition(spec, state):
             spec, state, store, True, False, test_steps)
     assert store.justified_checkpoint.epoch > 0
     yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+@pytest_only
+def test_safe_block_root_is_justified(spec, state):
+    """specs/fork_choice/safe-block.md: at the genesis anchor the safe
+    block IS the anchor, and its payload hash is the zero hash on every
+    fork (pre-merge structurally; post-merge because the anchor block's
+    empty payload carries a zero block_hash)."""
+    store, anchor = get_genesis_forkchoice_store_and_block(spec, state)
+    assert spec.get_safe_beacon_block_root(store) == \
+        hash_tree_root(anchor)
+    safe_hash = spec.get_safe_execution_payload_hash(store)
+    assert bytes(safe_hash) == b"\x00" * 32
+    assert hash_tree_root(safe_hash) == safe_hash  # SSZ-typed return
