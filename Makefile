@@ -30,7 +30,8 @@ lint:
 test-crypto:
 	CS_TPU_HEAVY=1 $(PYTHON) -m pytest tests/test_bls.py tests/test_jax_bls.py \
 		tests/test_hash_to_curve.py tests/test_sha256_kernel.py \
-		tests/test_multichip.py tests/deneb/kzg -q
+		tests/test_multichip.py tests/test_curdleproofs.py \
+		tests/deneb/kzg -q
 
 bench:
 	$(PYTHON) bench.py

@@ -132,7 +132,12 @@ def test_proof_size_is_permutation_independent():
     assert not cp.verify_shuffle(R, S, T, U, p2)
 
 
-@pytest.mark.parametrize("n", [2, 5])
+import os
+
+HEAVY = os.environ.get("CS_TPU_HEAVY") == "1"
+
+
+@pytest.mark.parametrize("n", [2] + ([5] if HEAVY else []))
 def test_various_sizes(n):
     R, S, T, U, sigma, k = _instance(
         n, sigma=list(range(1, n)) + [0], k=1234567)

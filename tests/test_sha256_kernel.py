@@ -1,8 +1,20 @@
-"""Differential tests: JAX SHA-256 kernel vs hashlib."""
+"""Differential tests: JAX SHA-256 kernel vs hashlib.
+
+Heavy tier only (``CS_TPU_HEAVY=1`` / ``make test-crypto``): every test
+here jit-compiles the batched SHA-256 program — minutes of cold XLA:CPU
+compile per message-length shape on a 1-core host. The default suite
+covers the merkle plug through the C hasher (``tests/test_ssz.py`` and
+the suite-wide merkleization) and the kernel itself through this gated
+tier.
+"""
 import hashlib
 import os
 
 import pytest
+
+HEAVY = os.environ.get("CS_TPU_HEAVY") == "1"
+pytestmark = pytest.mark.skipif(
+    not HEAVY, reason="jit of the SHA-256 kernel: set CS_TPU_HEAVY=1")
 
 from consensus_specs_tpu.ops import sha256 as k
 
