@@ -14,8 +14,15 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-from consensus_specs_tpu.utils.jax_env import setup_compile_cache  # noqa: E402
+from consensus_specs_tpu.utils.jax_env import (  # noqa: E402
+    setup_compile_cache, ensure_working_backend)
 setup_compile_cache()
+# Never hang the matrix on a dead accelerator tunnel: probe the backend
+# in a killable subprocess and fall back to host CPU (same guard as
+# bench.py / __graft_entry__; the container's sitecustomize overrides a
+# plain JAX_PLATFORMS=cpu, so the forced-CPU path is the only reliable
+# opt-out).
+ensure_working_backend()
 
 
 def _timeit(fn, reps=3, warmup=1):

@@ -50,7 +50,8 @@ from consensus_specs_tpu.utils.ssz import (
 )
 from consensus_specs_tpu.utils import bls
 from consensus_specs_tpu.forks.fork_choice import ForkChoiceMixin
-from consensus_specs_tpu.forks.validator_guide import ValidatorGuideMixin
+from consensus_specs_tpu.forks.validator_guide import ValidatorGuideMixin, \\
+    SubnetID
 from consensus_specs_tpu.forks.phase0 import _LRUDict, _bytes_of
 from consensus_specs_tpu.forks.base_types import *  # noqa: F401,F403
 """,

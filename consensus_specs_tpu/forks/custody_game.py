@@ -26,7 +26,7 @@ from consensus_specs_tpu.utils import bls
 from . import register_fork
 from .sharding import ShardingSpec
 from .base_types import (
-    Slot, Epoch, Gwei, Root, ValidatorIndex, BLSSignature, DomainType,
+    Epoch, Gwei, Root, ValidatorIndex, BLSSignature, DomainType,
     FAR_FUTURE_EPOCH,
 )
 

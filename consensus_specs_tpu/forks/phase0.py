@@ -12,7 +12,6 @@ IndexError/ValueError from SSZ bounds) on invalid input — the harness's
 ``expect_assertion_error`` and fork-choice invalid-block handling rely on it
 (reference: ``test/context.py:299-310``).
 """
-from collections import OrderedDict
 from types import SimpleNamespace
 from typing import Dict, Sequence, Set
 
@@ -40,24 +39,9 @@ from .base_types import (
 _PRESET_VAR_TYPES = {}  # all plain ints
 
 
-class _LRUDict(OrderedDict):
-    """Minimal bounded LRU mapping (role of the reference's ``lru-dict``)."""
-
-    def __init__(self, maxsize: int):
-        super().__init__()
-        self._maxsize = maxsize
-
-    def get(self, key, default=None):
-        if key in self:
-            self.move_to_end(key)
-            return self[key]
-        return default
-
-    def __setitem__(self, key, value):
-        super().__setitem__(key, value)
-        self.move_to_end(key)
-        while len(self) > self._maxsize:
-            self.popitem(last=False)
+# Re-exported under the historical name: the compiled-spec scaffold and
+# this module's caches both use it (shared impl: utils/lru.py).
+from consensus_specs_tpu.utils.lru import LRUDict as _LRUDict  # noqa: E402
 
 
 def _bytes_of(hexstr, width):

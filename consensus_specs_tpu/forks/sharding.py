@@ -22,12 +22,12 @@ lineage was. The rewrite's containers are documented in
 ``specs/_features/sharding/beacon-chain.md`` as prose.
 """
 from consensus_specs_tpu.utils.ssz import (
-    Container, List, Vector, uint64, Bytes32,
+    Container, List, uint64, Bytes32,
 )
 from . import register_fork
 from .phase0 import Phase0Spec
 from .base_types import (
-    Slot, Epoch, Gwei, Root, BLSSignature, DomainType,
+    Slot, Gwei, Root, BLSSignature, DomainType,
 )
 
 Shard = uint64

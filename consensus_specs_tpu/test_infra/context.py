@@ -8,6 +8,7 @@ cache (context.py:61-81). Tests are written once as generators yielding
 discarded, under the vector generator they are written to files.
 """
 import functools
+import os
 
 import pytest
 
@@ -28,6 +29,8 @@ FEATURE_PHASES = ("eip6110", "eip7002", "eip7594", "whisk",
                   "sharding", "custody_game")
 MINIMAL = "minimal"
 MAINNET = "mainnet"
+# Heavy crypto tier gate (jit-compile-bound tests; `make test-crypto`)
+HEAVY = os.environ.get("CS_TPU_HEAVY") == "1"
 
 
 def _available_phases():

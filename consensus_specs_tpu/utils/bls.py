@@ -13,10 +13,10 @@ plus the test kill-switch ``bls_active`` with STUB constants
 (``bls.py:49-57,93-104``): when inactive, Sign returns a stub and verifies
 trivially pass — used by the harness's @never_bls/@always_bls decorators.
 """
-from collections import OrderedDict
 from contextlib import contextmanager
 from typing import Sequence
 
+from consensus_specs_tpu.utils.lru import LRUDict
 from consensus_specs_tpu.ops.bls12_381 import ciphersuite as _py_backend
 from consensus_specs_tpu.ops.bls12_381.fields import R_ORDER as CURVE_ORDER  # noqa: F401
 from consensus_specs_tpu.ops.bls12_381.curve import (  # noqa: F401
@@ -39,17 +39,23 @@ _backend_name = "py"
 
 def use_py():
     global _backend, _backend_name
+    if _backend_name != "py":
+        # a differential run must exercise the newly selected backend,
+        # so memoized results from the other one are dropped; repeated
+        # use_py() calls (the harness resets the backend per test) keep
+        # the memo — cross-test reuse is its whole payoff
+        clear_verify_memo()
     _backend = _py_backend
     _backend_name = "py"
-    clear_verify_memo()
 
 
 def use_jax():
     global _backend, _backend_name
     from consensus_specs_tpu.ops import bls_jax
+    if _backend_name != "jax":
+        clear_verify_memo()
     _backend = bls_jax
     _backend_name = "jax"
-    clear_verify_memo()
 
 
 def use_fastest():
@@ -150,7 +156,7 @@ def only_with_bls(alt_return=None):
 # the same inputs) always exercises the newly selected backend, and
 # benchmarks can call ``clear_verify_memo`` between reps so they time
 # pairings, not dict hits.
-_verify_memo = OrderedDict()
+_verify_memo = LRUDict(1 << 16)
 
 
 def clear_verify_memo() -> None:
@@ -158,16 +164,11 @@ def clear_verify_memo() -> None:
 
 
 def _memo_get(key):
-    hit = _verify_memo.get(key)
-    if hit is not None:
-        _verify_memo.move_to_end(key)
-    return hit
+    return _verify_memo.get(key)
 
 
 def _memo_put(key, value: bool) -> bool:
     _verify_memo[key] = value
-    if len(_verify_memo) > (1 << 16):
-        _verify_memo.popitem(last=False)
     return value
 
 

@@ -1,0 +1,24 @@
+"""Minimal bounded LRU mapping (role of the reference's ``lru-dict`` C
+extension, ``setup.py:550``). Shared by the spec runtimes' committee/
+proposer caches (``forks/phase0.py``) and the BLS verification memo
+(``utils/bls.py``)."""
+from collections import OrderedDict
+
+
+class LRUDict(OrderedDict):
+
+    def __init__(self, maxsize: int):
+        super().__init__()
+        self._maxsize = maxsize
+
+    def get(self, key, default=None):
+        if key in self:
+            self.move_to_end(key)
+            return self[key]
+        return default
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, value)
+        self.move_to_end(key)
+        while len(self) > self._maxsize:
+            self.popitem(last=False)

@@ -12,7 +12,7 @@ import sys
 
 import pytest
 
-HEAVY = os.environ.get("CS_TPU_HEAVY") == "1"
+from consensus_specs_tpu.test_infra.context import HEAVY
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

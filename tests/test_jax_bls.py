@@ -7,7 +7,6 @@ Heavy tier (set ``CS_TPU_HEAVY=1``): full pairing bilinearity and the
 end-to-end ``bls.use_jax()`` backend - the pairing program takes minutes to
 compile cold on the 1-core CI box (cached in ``.jax_cache`` afterwards).
 """
-import os
 import random
 
 import numpy as np
@@ -21,7 +20,7 @@ from consensus_specs_tpu.ops.jax_bls import limbs as L
 from consensus_specs_tpu.ops.jax_bls import tower as T
 from consensus_specs_tpu.ops.jax_bls import points as PT
 
-HEAVY = os.environ.get("CS_TPU_HEAVY") == "1"
+from consensus_specs_tpu.test_infra.context import HEAVY  # noqa: E402
 rng = random.Random(1234)
 
 

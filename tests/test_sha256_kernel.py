@@ -12,7 +12,8 @@ import os
 
 import pytest
 
-HEAVY = os.environ.get("CS_TPU_HEAVY") == "1"
+from consensus_specs_tpu.test_infra.context import HEAVY
+
 pytestmark = pytest.mark.skipif(
     not HEAVY, reason="jit of the SHA-256 kernel: set CS_TPU_HEAVY=1")
 
