@@ -8,11 +8,11 @@ cache (context.py:61-81). Tests are written once as generators yielding
 discarded, under the vector generator they are written to files.
 """
 import functools
-import os
 
 import pytest
 
 from consensus_specs_tpu.utils import bls
+from consensus_specs_tpu.utils.env_flags import HEAVY
 from consensus_specs_tpu.utils.ssz import serialize, deserialize
 from consensus_specs_tpu.forks import build_spec, fork_registry
 from .genesis import create_genesis_state
@@ -29,8 +29,7 @@ FEATURE_PHASES = ("eip6110", "eip7002", "eip7594", "whisk",
                   "sharding", "custody_game")
 MINIMAL = "minimal"
 MAINNET = "mainnet"
-# Heavy crypto tier gate (jit-compile-bound tests; `make test-crypto`)
-HEAVY = os.environ.get("CS_TPU_HEAVY") == "1"
+# HEAVY (the crypto-tier gate) is imported above for harness users
 
 
 def _available_phases():

@@ -36,6 +36,19 @@ def warm_bench(batch=None):
     _log(f"bench pipeline (batch {b}, 64 keys): {time.time() - t0:.1f}s")
 
 
+def warm_entry():
+    """Compile the single-chip graft-entry program (the flagship pairing
+    check the driver compile-checks)."""
+    import importlib
+    import jax
+    g = importlib.import_module("__graft_entry__")
+    t0 = time.time()
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    assert bool(jax.numpy.asarray(out).all())
+    _log(f"graft entry pairing check: {time.time() - t0:.1f}s")
+
+
 def warm_dryrun(n_devices=8):
     """Compile the sharded dryrun step on the virtual CPU mesh.
 
@@ -75,7 +88,7 @@ def main():
                         help="cpu: pin XLA:CPU (the dryrun cache and the "
                              "bench fallback path); auto: probe the "
                              "accelerator and use it if it answers")
-    parser.add_argument("--stage", choices=("all", "bench", "dryrun"),
+    parser.add_argument("--stage", choices=("all", "bench", "dryrun", "entry"),
                         default="all")
     ns = parser.parse_args()
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
@@ -91,6 +104,8 @@ def main():
         _log(f"platform: {ensure_working_backend()}")
     if ns.stage in ("all", "bench"):
         warm_bench()
+    if ns.stage in ("all", "entry"):
+        warm_entry()
     # the dryrun re-execs via subprocess paths of __graft_entry__; warm it
     # last (it shares most staged programs with the bench pipeline).
     if ns.stage in ("all", "dryrun"):

@@ -1,0 +1,6 @@
+"""Environment tier flags, importable without pulling in jax or the fork
+registry (test modules read these at collection time)."""
+import os
+
+# Heavy crypto tier gate (jit-compile-bound tests; ``make test-crypto``)
+HEAVY = os.environ.get("CS_TPU_HEAVY") == "1"

@@ -132,7 +132,7 @@ def test_proof_size_is_permutation_independent():
     assert not cp.verify_shuffle(R, S, T, U, p2)
 
 
-from consensus_specs_tpu.test_infra.context import HEAVY
+from consensus_specs_tpu.utils.env_flags import HEAVY
 
 
 @pytest.mark.parametrize("n", [2] + ([5] if HEAVY else []))

@@ -12,7 +12,7 @@ import sys
 
 import pytest
 
-from consensus_specs_tpu.test_infra.context import HEAVY
+from consensus_specs_tpu.utils.env_flags import HEAVY
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -20,7 +20,7 @@ from consensus_specs_tpu.ops.jax_bls import limbs as L
 from consensus_specs_tpu.ops.jax_bls import tower as T
 from consensus_specs_tpu.ops.jax_bls import points as PT
 
-from consensus_specs_tpu.test_infra.context import HEAVY  # noqa: E402
+from consensus_specs_tpu.utils.env_flags import HEAVY  # noqa: E402
 rng = random.Random(1234)
 
 

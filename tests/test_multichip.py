@@ -16,7 +16,7 @@ import jax.numpy as jnp
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from consensus_specs_tpu.test_infra.context import HEAVY  # noqa: E402
+from consensus_specs_tpu.utils.env_flags import HEAVY  # noqa: E402
 
 
 def _require_devices(n):

@@ -12,7 +12,7 @@ import os
 
 import pytest
 
-from consensus_specs_tpu.test_infra.context import HEAVY
+from consensus_specs_tpu.utils.env_flags import HEAVY
 
 pytestmark = pytest.mark.skipif(
     not HEAVY, reason="jit of the SHA-256 kernel: set CS_TPU_HEAVY=1")
