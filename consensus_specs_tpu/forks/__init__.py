@@ -30,7 +30,7 @@ def _import_all():
     from . import phase0  # noqa: F401
     for mod in ("altair", "bellatrix", "capella", "deneb",
                 "eip6110", "eip7002", "eip7594", "whisk",
-                "sharding", "custody_game"):
+                "sharding", "custody_game", "eip6914"):
         # Probe existence first so a real import error inside an existing
         # fork module propagates instead of silently dropping the fork
         # (and silently skipping its whole test suite).

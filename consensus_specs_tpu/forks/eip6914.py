@@ -1,0 +1,70 @@
+"""EIP-6914 feature fork: reuse of fully-withdrawn validator indices.
+
+Behavioral source: ``specs/_features/eip6914/beacon-chain.md``
+(``SAFE_EPOCHS_TO_REUSE_INDEX`` :33, ``is_reusable_validator`` :43,
+modified ``get_index_for_new_validator`` :60) and ``fork-choice.md``
+(``on_reused_index`` :33). Fork DAG parent: capella. The reference
+excludes this fork from its build and carries no tests for it; here it
+is runnable (``tests/eip6914/``).
+
+The registry is append-only in phase0..deneb, so it grows without bound
+as validators exit and withdraw. After an index has been fully
+withdrawn for ``SAFE_EPOCHS_TO_REUSE_INDEX`` epochs (~0.8 years — past
+every slashing/attestation horizon), a new deposit may take over the
+slot instead of appending.
+"""
+from . import register_fork
+from .capella import CapellaSpec
+from .base_types import Gwei, ValidatorIndex
+
+
+@register_fork("eip6914")
+class EIP6914Spec(CapellaSpec):
+    fork = "eip6914"
+    previous_fork = "capella"
+
+    # preset (beacon-chain.md "Time parameters"); ~0.8 years of epochs
+    SAFE_EPOCHS_TO_REUSE_INDEX = 2**16
+
+    def is_reusable_validator(self, validator, balance, epoch) -> bool:
+        """beacon-chain.md:43 — fully withdrawn and long past every
+        slashing horizon."""
+        return (
+            int(epoch) > int(validator.withdrawable_epoch)
+            + self.SAFE_EPOCHS_TO_REUSE_INDEX
+            and int(balance) == 0
+        )
+
+    def get_index_for_new_validator(self, state) -> ValidatorIndex:
+        """beacon-chain.md:60 — first reusable slot, else append."""
+        for index, validator in enumerate(state.validators):
+            if self.is_reusable_validator(validator, state.balances[index],
+                                          self.get_current_epoch(state)):
+                return ValidatorIndex(index)
+        return ValidatorIndex(len(state.validators))
+
+    def add_validator_to_registry(self, state, pubkey,
+                                  withdrawal_credentials, amount) -> None:
+        index = self.get_index_for_new_validator(state)
+        if index == len(state.validators):
+            # append path: the inherited chain appends EVERY per-validator
+            # list (validators/balances + altair's participation flags and
+            # inactivity scores)
+            super().add_validator_to_registry(
+                state, pubkey, withdrawal_credentials, amount)
+            return
+        # reuse path: overwrite the slot in every per-validator list — the
+        # previous owner's participation/inactivity must not leak onto the
+        # new validator
+        state.validators[index] = self.get_validator_from_deposit(
+            pubkey, withdrawal_credentials, amount)
+        state.balances[index] = Gwei(amount)
+        state.previous_epoch_participation[index] = 0
+        state.current_epoch_participation[index] = 0
+        state.inactivity_scores[index] = 0
+
+    # -- fork choice (fork-choice.md) --------------------------------------
+    def on_reused_index(self, store, index) -> None:
+        """fork-choice.md:33 — a reused slot's equivocation record belongs
+        to the previous owner; drop it."""
+        store.equivocating_indices.discard(int(index))

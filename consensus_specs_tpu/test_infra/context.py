@@ -12,7 +12,7 @@ import functools
 import pytest
 
 from consensus_specs_tpu.utils import bls
-from consensus_specs_tpu.utils.env_flags import HEAVY
+from consensus_specs_tpu.utils.env_flags import HEAVY  # noqa: F401 (re-export)
 from consensus_specs_tpu.utils.ssz import serialize, deserialize
 from consensus_specs_tpu.forks import build_spec, fork_registry
 from .genesis import create_genesis_state
@@ -26,7 +26,7 @@ ONLY_FORK = None
 ALL_PHASES = ("phase0", "altair", "bellatrix", "capella", "deneb")
 # feature forks: selectable via with_phases, excluded from with_all_phases
 FEATURE_PHASES = ("eip6110", "eip7002", "eip7594", "whisk",
-                  "sharding", "custody_game")
+                  "sharding", "custody_game", "eip6914")
 MINIMAL = "minimal"
 MAINNET = "mainnet"
 # HEAVY (the crypto-tier gate) is imported above for harness users

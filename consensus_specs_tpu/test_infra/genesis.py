@@ -46,13 +46,15 @@ def _genesis_fork_versions(spec):
         "whisk": getattr(spec.config, "WHISK_FORK_VERSION", None),
         "sharding": getattr(spec.config, "SHARDING_FORK_VERSION", None),
         "custody_game": getattr(spec.config, "CUSTODY_GAME_FORK_VERSION", None),
+        "eip6914": getattr(spec.config, "EIP6914_FORK_VERSION", None),
     }
     order = ["phase0", "altair", "bellatrix", "capella", "deneb",
              "eip6110", "eip7002", "eip7594", "whisk",
-             "sharding", "custody_game"]
+             "sharding", "custody_game", "eip6914"]
     # feature forks branch off their DAG parent, not list order
     parents = {"eip7002": "capella", "eip7594": "deneb", "whisk": "capella",
-               "sharding": "phase0", "custody_game": "sharding"}
+               "sharding": "phase0", "custody_game": "sharding",
+               "eip6914": "capella"}
     cur = versions[fork]
     prev_name = parents.get(fork, order[max(0, order.index(fork) - 1)])
     prev = versions[prev_name]

@@ -1,0 +1,106 @@
+"""EIP-6914 validator-index reuse.
+
+Reference model: ``specs/_features/eip6914/beacon-chain.md`` — the
+reference carries no tests for this fork; these pin the predicate, the
+deposit-path override, and the fork-choice handler.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases,
+)
+from consensus_specs_tpu.test_infra.deposits import (
+    prepare_state_and_deposit,
+)
+from consensus_specs_tpu.test_infra.fork_choice import (
+    get_genesis_forkchoice_store_and_block,
+)
+
+
+def _retire_validator(spec, state, index):
+    """Make index fully withdrawn long enough ago to be reusable."""
+    v = state.validators[index]
+    v.exit_epoch = 0
+    v.withdrawable_epoch = 0
+    v.effective_balance = 0
+    state.balances[index] = 0
+    state.slot = spec.SLOTS_PER_EPOCH * (spec.SAFE_EPOCHS_TO_REUSE_INDEX + 2)
+
+
+@with_phases(["eip6914"])
+@spec_state_test
+def test_is_reusable_validator_windows(spec, state):
+    v = state.validators[0]
+    epoch = spec.get_current_epoch(state)
+    # active validator: not reusable
+    assert not spec.is_reusable_validator(v, state.balances[0], epoch)
+    _retire_validator(spec, state, 0)
+    epoch = spec.get_current_epoch(state)
+    assert spec.is_reusable_validator(
+        state.validators[0], state.balances[0], epoch)
+    # nonzero balance blocks reuse
+    state.balances[0] = 1
+    assert not spec.is_reusable_validator(
+        state.validators[0], state.balances[0], epoch)
+    # too-recent withdrawability blocks reuse
+    state.balances[0] = 0
+    state.validators[0].withdrawable_epoch = epoch - 1
+    assert not spec.is_reusable_validator(
+        state.validators[0], state.balances[0], epoch)
+    yield
+
+
+@with_phases(["eip6914"])
+@spec_state_test
+def test_deposit_reuses_retired_index(spec, state):
+    # plant stale records a leaked reuse would inherit
+    state.current_epoch_participation[0] = 7
+    state.inactivity_scores[0] = 99
+    _retire_validator(spec, state, 0)
+    pre_count = len(state.validators)
+    assert spec.get_index_for_new_validator(state) == 0
+    # a fresh-pubkey deposit takes over slot 0 instead of appending
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index=pre_count,
+        amount=spec.MAX_EFFECTIVE_BALANCE, signed=True)
+    yield "pre", state
+    spec.process_deposit(state, deposit)
+    yield "post", state
+    assert len(state.validators) == pre_count
+    assert state.validators[0].pubkey == deposit.data.pubkey
+    assert state.balances[0] == spec.MAX_EFFECTIVE_BALANCE
+    # the previous owner's per-validator records must not leak
+    assert state.previous_epoch_participation[0] == 0
+    assert state.current_epoch_participation[0] == 0
+    assert state.inactivity_scores[0] == 0
+
+
+@with_phases(["eip6914"])
+@spec_state_test
+def test_deposit_appends_when_no_reusable_index(spec, state):
+    pre_count = len(state.validators)
+    assert spec.get_index_for_new_validator(state) == pre_count
+    deposit = prepare_state_and_deposit(
+        spec, state, validator_index=pre_count,
+        amount=spec.MAX_EFFECTIVE_BALANCE, signed=True)
+    yield "pre", state
+    spec.process_deposit(state, deposit)
+    yield "post", state
+    assert len(state.validators) == pre_count + 1
+    # every per-validator list must have grown in lockstep, or the next
+    # epoch transition would IndexError
+    assert len(state.previous_epoch_participation) == pre_count + 1
+    assert len(state.inactivity_scores) == pre_count + 1
+    spec.process_slots(
+        state, state.slot + spec.SLOTS_PER_EPOCH
+        - state.slot % spec.SLOTS_PER_EPOCH)
+
+
+@with_phases(["eip6914"])
+@spec_state_test
+def test_on_reused_index_clears_equivocation(spec, state):
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    store.equivocating_indices.add(0)
+    spec.on_reused_index(store, 0)
+    assert 0 not in store.equivocating_indices
+    # discarding an absent index is a no-op
+    spec.on_reused_index(store, 5)
+    yield
