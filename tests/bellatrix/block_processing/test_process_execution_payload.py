@@ -123,3 +123,43 @@ def test_merge_transition_predicates(spec, state):
     assert spec.is_execution_enabled(pre, body)
     assert spec.is_merge_transition_complete(post)
     assert not spec.is_merge_transition_block(post, body)
+
+
+
+@with_phases(EXECUTION_FORKS)
+@spec_state_test
+def test_invalid_prev_randao_first_payload(spec, state):
+    """prev_randao IS checked even on the transition payload."""
+    state = build_state_with_incomplete_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.prev_randao = b"\x42" * 32
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(
+        spec, state, payload, valid=False)
+
+
+@with_phases(EXECUTION_FORKS)
+@spec_state_test
+def test_invalid_past_timestamp(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    # at genesis slot the expected timestamp IS 0 — shift genesis so a
+    # zero timestamp actually mismatches compute_timestamp_at_slot
+    state.genesis_time = 100
+    payload = build_empty_execution_payload(spec, state)
+    payload.timestamp = 0
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(
+        spec, state, payload, valid=False)
+
+
+@with_phases(EXECUTION_FORKS)
+@spec_state_test
+def test_full_extra_data_round_trips(spec, state):
+    """A maximum-size extra_data field is valid and lands in the header."""
+    state = build_state_with_complete_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.extra_data = b"\x2a" * spec.MAX_EXTRA_DATA_BYTES
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload)
+    assert len(state.latest_execution_payload_header.extra_data) == \
+        spec.MAX_EXTRA_DATA_BYTES

@@ -111,3 +111,23 @@ def test_invalid_signature(spec, state):
     signed_change = get_signed_address_change(spec, state, bad_signature=True)
     yield from run_bls_to_execution_change_processing(spec, state,
                                                       signed_change, valid=False)
+
+
+@with_phases(CHANGE_FORKS)
+@spec_state_test
+@always_bls
+def test_invalid_current_fork_domain_signature(spec, state):
+    """Address changes sign under the GENESIS fork version (they stay
+    valid across forks); a signature under the current fork's domain
+    must be rejected (capella/beacon-chain.md
+    process_bls_to_execution_change)."""
+    signed = get_signed_address_change(spec, state, validator_index=0)
+    # re-sign under the (wrong) current-fork domain
+    wrong_domain = spec.get_domain(
+        state, spec.DOMAIN_BLS_TO_EXECUTION_CHANGE,
+        spec.get_current_epoch(state))
+    signing_root = spec.compute_signing_root(signed.message, wrong_domain)
+    privkey = pubkey_to_privkey(bytes(signed.message.from_bls_pubkey))
+    signed.signature = bls.Sign(privkey, signing_root)
+    yield from run_bls_to_execution_change_processing(
+        spec, state, signed, valid=False)

@@ -135,3 +135,67 @@ def test_sweep_cursor_advances_without_withdrawals(spec, state):
         len(state.validators), spec.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)
     ) % len(state.validators)
     assert state.next_withdrawal_validator_index == expected_cursor
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_success_mixed_full_and_partial(spec, state):
+    prepare_expected_withdrawals(spec, state, num_full=2, num_partial=2)
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_withdrawals_processing(spec, state, payload)
+    # full withdrawals zero the balance; partials skim to the cap
+    assert state.balances[0] == 0 and state.balances[1] == 0
+    assert state.balances[2] == spec.MAX_EFFECTIVE_BALANCE
+    assert state.balances[3] == spec.MAX_EFFECTIVE_BALANCE
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_sweep_wraps_around_registry_end(spec, state):
+    """The sweep cursor wraps modulo the registry length."""
+    last = len(state.validators) - 1
+    set_eth1_credentials(spec, state, last)
+    state.validators[last].withdrawable_epoch = spec.get_current_epoch(state)
+    state.next_withdrawal_validator_index = last
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_withdrawals_processing(spec, state, payload)
+    assert state.balances[last] == 0
+    # non-full payload: the cursor jumps a whole sweep bound and wraps
+    # modulo the registry (capella/beacon-chain.md process_withdrawals)
+    expected_cursor = (last + spec.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP) \
+        % len(state.validators)
+    assert int(state.next_withdrawal_validator_index) == expected_cursor
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_bls_credentialed_validator_not_swept(spec, state):
+    """A withdrawable validator still on 0x00 (BLS) credentials is
+    skipped by the sweep — withdrawals need an execution address."""
+    state.validators[0].withdrawable_epoch = spec.get_current_epoch(state)
+    assert bytes(state.validators[0].withdrawal_credentials[:1]) == \
+        spec.BLS_WITHDRAWAL_PREFIX
+    assert spec.get_expected_withdrawals(state) == []
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_withdrawals_processing(spec, state, payload)
+    assert state.balances[0] > 0
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_exact_max_balance_no_partial(spec, state):
+    """balance == MAX_EFFECTIVE_BALANCE is NOT an excess — no skim."""
+    set_eth1_credentials(spec, state, 0)
+    state.balances[0] = spec.MAX_EFFECTIVE_BALANCE
+    assert spec.get_expected_withdrawals(state) == []
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_invalid_missing_expected_withdrawal(spec, state):
+    prepare_expected_withdrawals(spec, state, num_full=2)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals = payload.withdrawals[:1]
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
