@@ -5,7 +5,7 @@ Reference model: ``test/deneb/fork_choice/test_on_block.py`` with the
 (``specs/deneb/fork-choice.md:53-60``).
 """
 from consensus_specs_tpu.test_infra.context import (
-    spec_state_test, with_phases, never_bls,
+    spec_state_test, with_phases, never_bls, pytest_only,
 )
 from consensus_specs_tpu.test_infra.block import (
     build_empty_block_for_next_slot, state_transition_and_sign_block,
@@ -73,4 +73,33 @@ def test_invalid_on_block_mismatched_blob_count(spec, state):
                            valid=False)
     finally:
         del spec.retrieve_blobs_and_proofs
+    yield "steps", test_steps
+
+
+@with_phases(["deneb"])
+@spec_state_test
+@pytest_only
+def test_on_block_accepted_when_blobs_available(spec, state):
+    """With a real blob + commitment + proof wired into retrieval, the
+    availability gate passes and the block enters the store."""
+    from consensus_specs_tpu.ops import kzg as K
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    setup = K.trusted_setup(spec.preset_name)
+    blob = b"".join(
+        (i % 255).to_bytes(32, "big")
+        for i in range(setup.FIELD_ELEMENTS_PER_BLOB))
+    commitment = K.blob_to_kzg_commitment(blob, setup)
+    proof = K.compute_blob_kzg_proof(blob, commitment, setup)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.blob_kzg_commitments.append(commitment)
+    signed = state_transition_and_sign_block(spec, state, block)
+    spec.retrieve_blobs_and_proofs = lambda root: ([blob], [proof])
+    try:
+        assert spec.is_data_available(
+            hash_tree_root(block), block.body.blob_kzg_commitments)
+        tick_and_add_block(spec, store, signed, test_steps)
+    finally:
+        del spec.__dict__["retrieve_blobs_and_proofs"]
+    assert hash_tree_root(block) in store.blocks
     yield "steps", test_steps
