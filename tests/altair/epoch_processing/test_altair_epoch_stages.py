@@ -1,0 +1,118 @@
+"""Altair-specific epoch sub-transitions.
+
+Reference model: ``test/altair/epoch_processing/`` —
+``process_inactivity_updates``, ``process_participation_flag_updates``,
+``process_sync_committee_updates`` against
+``specs/altair/beacon-chain.md``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases,
+)
+from consensus_specs_tpu.test_infra.epoch_processing import (
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.test_infra.block import next_epoch
+
+ALTAIR_PLUS = ["altair", "bellatrix", "capella", "deneb"]
+
+
+def _set_full_previous_target_participation(spec, state, participate=True):
+    flag = spec.ParticipationFlags(0)
+    if participate:
+        flag = spec.add_flag(flag, spec.TIMELY_TARGET_FLAG_INDEX)
+    for i in range(len(state.validators)):
+        state.previous_epoch_participation[i] = flag
+
+
+@with_phases(ALTAIR_PLUS)
+@spec_state_test
+def test_inactivity_scores_decrease_when_participating(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    for i in range(len(state.validators)):
+        state.inactivity_scores[i] = 10
+    _set_full_previous_target_participation(spec, state, True)
+    yield from run_epoch_processing_with(
+        spec, state, "process_inactivity_updates")
+    # -1 for participating, then recovery-rate decrement (not leaking)
+    expected = 10 - 1 - min(10 - 1, spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
+    assert all(int(s) == expected for s in state.inactivity_scores)
+
+
+@with_phases(ALTAIR_PLUS)
+@spec_state_test
+def test_inactivity_scores_increase_when_absent(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    _set_full_previous_target_participation(spec, state, False)
+    pre = [int(s) for s in state.inactivity_scores]
+    yield from run_epoch_processing_with(
+        spec, state, "process_inactivity_updates")
+    # +BIAS for absence, then recovery decrement while not leaking
+    bias = spec.config.INACTIVITY_SCORE_BIAS
+    rec = spec.config.INACTIVITY_SCORE_RECOVERY_RATE
+    for before, after in zip(pre, state.inactivity_scores):
+        assert int(after) == before + bias - min(before + bias, rec)
+
+
+@with_phases(ALTAIR_PLUS)
+@spec_state_test
+def test_inactivity_scores_no_recovery_during_leak(spec, state):
+    # force a leak: finalized checkpoint far behind
+    next_epoch(spec, state)
+    for _ in range(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY + 2):
+        next_epoch(spec, state)
+    assert spec.is_in_inactivity_leak(state)
+    _set_full_previous_target_participation(spec, state, False)
+    pre = [int(s) for s in state.inactivity_scores]
+    yield from run_epoch_processing_with(
+        spec, state, "process_inactivity_updates")
+    bias = spec.config.INACTIVITY_SCORE_BIAS
+    for before, after in zip(pre, state.inactivity_scores):
+        assert int(after) == before + bias
+
+
+@with_phases(ALTAIR_PLUS)
+@spec_state_test
+def test_participation_flag_rotation(spec, state):
+    next_epoch(spec, state)
+    flag = spec.add_flag(spec.ParticipationFlags(0),
+                         spec.TIMELY_TARGET_FLAG_INDEX)
+    for i in range(len(state.validators)):
+        state.current_epoch_participation[i] = flag
+        state.previous_epoch_participation[i] = spec.ParticipationFlags(0)
+    yield from run_epoch_processing_with(
+        spec, state, "process_participation_flag_updates")
+    # current rotates into previous; current resets to zero
+    assert all(int(p) == int(flag)
+               for p in state.previous_epoch_participation)
+    assert all(int(p) == 0 for p in state.current_epoch_participation)
+
+
+@with_phases(ALTAIR_PLUS)
+@spec_state_test
+def test_sync_committee_rotation_at_period_boundary(spec, state):
+    """At an EPOCHS_PER_SYNC_COMMITTEE_PERIOD boundary the next committee
+    becomes current and a fresh next is derived."""
+    pre_next = state.next_sync_committee.copy()
+    # advance to one slot before the period boundary
+    target_epoch = spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+    while spec.get_current_epoch(state) < target_epoch - 1:
+        next_epoch(spec, state)
+    yield from run_epoch_processing_with(
+        spec, state, "process_sync_committee_updates")
+    assert state.current_sync_committee == pre_next
+
+
+@with_phases(ALTAIR_PLUS)
+@spec_state_test
+def test_sync_committee_stable_mid_period(spec, state):
+    pre_current = state.current_sync_committee.copy()
+    pre_next = state.next_sync_committee.copy()
+    next_epoch(spec, state)
+    assert spec.get_current_epoch(state) % \
+        spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD != 0
+    yield from run_epoch_processing_with(
+        spec, state, "process_sync_committee_updates")
+    assert state.current_sync_committee == pre_current
+    assert state.next_sync_committee == pre_next
