@@ -72,36 +72,6 @@ def test_inactivity_scores_no_recovery_during_leak(spec, state):
         assert int(after) == before + bias
 
 
-@with_phases(ALTAIR_PLUS)
-@spec_state_test
-def test_participation_flag_rotation(spec, state):
-    next_epoch(spec, state)
-    flag = spec.add_flag(spec.ParticipationFlags(0),
-                         spec.TIMELY_TARGET_FLAG_INDEX)
-    for i in range(len(state.validators)):
-        state.current_epoch_participation[i] = flag
-        state.previous_epoch_participation[i] = spec.ParticipationFlags(0)
-    yield from run_epoch_processing_with(
-        spec, state, "process_participation_flag_updates")
-    # current rotates into previous; current resets to zero
-    assert all(int(p) == int(flag)
-               for p in state.previous_epoch_participation)
-    assert all(int(p) == 0 for p in state.current_epoch_participation)
-
-
-@with_phases(ALTAIR_PLUS)
-@spec_state_test
-def test_sync_committee_rotation_at_period_boundary(spec, state):
-    """At an EPOCHS_PER_SYNC_COMMITTEE_PERIOD boundary the next committee
-    becomes current and a fresh next is derived."""
-    pre_next = state.next_sync_committee.copy()
-    # advance to one slot before the period boundary
-    target_epoch = spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
-    while spec.get_current_epoch(state) < target_epoch - 1:
-        next_epoch(spec, state)
-    yield from run_epoch_processing_with(
-        spec, state, "process_sync_committee_updates")
-    assert state.current_sync_committee == pre_next
 
 
 @with_phases(ALTAIR_PLUS)
@@ -110,7 +80,8 @@ def test_sync_committee_stable_mid_period(spec, state):
     pre_current = state.current_sync_committee.copy()
     pre_next = state.next_sync_committee.copy()
     next_epoch(spec, state)
-    assert spec.get_current_epoch(state) % \
+    # rotation triggers when (current + 1) % period == 0 — rule THAT out
+    assert (spec.get_current_epoch(state) + 1) % \
         spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD != 0
     yield from run_epoch_processing_with(
         spec, state, "process_sync_committee_updates")

@@ -110,6 +110,8 @@ def test_process_sync_committee_updates_rotation(spec, state):
     yield from run_epoch_processing_with(
         spec, state, "process_sync_committee_updates")
     assert state.current_sync_committee == pre_next
+    # the next committee must be RE-DERIVED, not left stale
+    assert state.next_sync_committee == spec.get_next_sync_committee(state)
 
 
 @with_phases(["altair", "bellatrix", "capella", "deneb"])
