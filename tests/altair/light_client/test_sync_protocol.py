@@ -6,7 +6,7 @@ Reference model: ``test/altair/light_client/test_sync.py`` +
 """
 from consensus_specs_tpu.test_infra.context import (
     spec_state_test, with_phases, with_config_overrides, always_bls,
-    never_bls,
+    never_bls, pytest_only, expect_assertion_error,
 )
 
 # light-client derivation requires the altair fork to be active at genesis
@@ -225,3 +225,85 @@ def test_capella_header_execution_branch_roundtrip(spec, state):
     bad = header.copy()
     bad.execution.gas_used = 999
     assert not spec.is_valid_light_client_header(bad)
+
+
+@with_phases(["altair"])
+@altair_active
+@spec_state_test
+@never_bls
+@pytest_only
+def test_bootstrap_wrong_trusted_root_rejected(spec, state):
+    chain = _advance_chain(spec, state, 1)
+    signed_block, post_state = chain[0]
+    bootstrap = spec.create_light_client_bootstrap(post_state, signed_block)
+    expect_assertion_error(
+        lambda: spec.initialize_light_client_store(b"\x13" * 32, bootstrap))
+    yield
+
+
+@with_phases(["altair"])
+@altair_active
+@spec_state_test
+@never_bls
+@pytest_only
+def test_insufficient_participation_rejected(spec, state):
+    """An update whose aggregate carries fewer than
+    MIN_SYNC_COMMITTEE_PARTICIPANTS bits is invalid
+    (sync-protocol.md validate_light_client_update)."""
+    chain = _advance_chain(spec, state, 2)
+    store = _bootstrap_store(spec, chain)
+    attested_block, attested_state = chain[1]
+    attested_header = spec.block_to_light_client_header(attested_block)
+    signature_slot = attested_block.message.slot + 1
+    floor = spec.MIN_SYNC_COMMITTEE_PARTICIPANTS
+    sync_aggregate = _signed_sync_aggregate(
+        spec, attested_state, hash_tree_root(attested_block.message),
+        signature_slot,
+        participation=(floor - 1) / spec.SYNC_COMMITTEE_SIZE)
+    update = spec.LightClientUpdate(
+        attested_header=attested_header,
+        sync_aggregate=sync_aggregate,
+        signature_slot=signature_slot,
+    )
+    current_slot = int(signature_slot)
+    expect_assertion_error(
+        lambda: spec.process_light_client_update(
+            store, update, current_slot,
+            attested_state.genesis_validators_root))
+    yield
+
+
+@with_phases(["altair"])
+@altair_active
+@spec_state_test
+@never_bls
+@pytest_only
+def test_sub_supermajority_update_does_not_finalize(spec, state):
+    """At 50% participation an update is collected (best_valid_update)
+    and the optimistic header advances past the safety threshold — but
+    without a 2/3 supermajority (and no finality proof) the FINALIZED
+    header must not move (sync-protocol.md
+    process_light_client_update apply conditions)."""
+    chain = _advance_chain(spec, state, 2)
+    store = _bootstrap_store(spec, chain)
+    attested_block, attested_state = chain[1]
+    attested_header = spec.block_to_light_client_header(attested_block)
+    signature_slot = attested_block.message.slot + 1
+    sync_aggregate = _signed_sync_aggregate(
+        spec, attested_state, hash_tree_root(attested_block.message),
+        signature_slot, participation=0.5)  # >= floor, < 2/3
+    update = spec.LightClientUpdate(
+        attested_header=attested_header,
+        sync_aggregate=sync_aggregate,
+        signature_slot=signature_slot,
+    )
+    pre_finalized_slot = int(store.finalized_header.beacon.slot)
+    current_slot = int(signature_slot)
+    spec.process_light_client_update(
+        store, update, current_slot, attested_state.genesis_validators_root)
+    assert store.best_valid_update is not None
+    # optimistic header advances (participation > safety threshold) ...
+    assert int(store.optimistic_header.beacon.slot) == \
+        int(attested_block.message.slot)
+    # ... but the finalized header does not (no supermajority, no proof)
+    assert int(store.finalized_header.beacon.slot) == pre_finalized_slot
