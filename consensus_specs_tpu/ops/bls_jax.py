@@ -24,8 +24,9 @@ import os
 from collections import OrderedDict
 
 import numpy as np
-import jax
-import jax.numpy as jnp
+import jax  # tree_util; array ops ride the kernel backend switch
+from consensus_specs_tpu.ops.jax_bls.backend import (
+    xp as jnp, kjit, NUMPY_KERNELS)
 
 from consensus_specs_tpu.ops.bls12_381 import ciphersuite as _oracle
 from consensus_specs_tpu.utils.profiling import span
@@ -136,6 +137,8 @@ def bucket_b() -> int:
     if _BUCKET_B is None:
         if "CS_TPU_BLS_BATCH" in os.environ:
             _BUCKET_B = int(os.environ["CS_TPU_BLS_BATCH"])
+        elif NUMPY_KERNELS:
+            _BUCKET_B = 8
         else:
             try:
                 _BUCKET_B = 32 if jax.default_backend() != "cpu" else 8
@@ -158,7 +161,11 @@ _N_MIN = 8
 def fuse_verify() -> bool:
     global _FUSE_VERIFY
     if _FUSE_VERIFY is None:
-        if "CS_TPU_BLS_FUSE" in os.environ:
+        if NUMPY_KERNELS:
+            # numpy mode has no fused path: _program_multi_pair_verify's
+            # jax.vmap cannot trace numpy-bound kernels
+            _FUSE_VERIFY = False
+        elif "CS_TPU_BLS_FUSE" in os.environ:
             _FUSE_VERIFY = os.environ["CS_TPU_BLS_FUSE"] == "1"
         else:
             try:
@@ -175,29 +182,27 @@ _FUSE_VERIFY = None
 # Device programs (jitted once per shape bucket)
 # ---------------------------------------------------------------------------
 
-@jax.jit
+@kjit
 def _j_tree_sum(pk_pts):
     """(B, N) projective G1 pytree -> (B,) unnormalized sum; one bounded
     fori_loop program per (B, N) bucket."""
     return PT.g1_tree_sum_batched(pk_pts)
 
 
-@jax.jit
 def _j_g1_normalize_flag(p):
-    agg = PT.g1_normalize(p)
-    return agg, PT.g1_is_identity(agg)
+    """Normalize + identity flag; the inversion rides the shared ladder
+    program (round-4 compile-cost restructuring)."""
+    return PT.g1_normalize_flag_staged(p)
 
 
 def _program_aggregate(pk_pts):
     """(B, N) projective G1 pytree -> normalized (B,) aggregate + inf
-    flag, as two bounded programs (sum, then normalize with its
-    inversion chain)."""
+    flag, as bounded programs (sum, then staged normalize)."""
     return _j_g1_normalize_flag(_j_tree_sum(pk_pts))
 
 
-@jax.jit
 def _program_g2_normalize(p):
-    return PT.g2_normalize(p)
+    return PT.g2_normalize_staged(p)
 
 
 def _program_htc(u0, u1):
@@ -209,7 +214,7 @@ def _program_htc(u0, u1):
     return _program_g2_normalize(HTC.map_to_g2_staged(u0, u1))
 
 
-@jax.jit
+@kjit
 def _program_multi_pair_verify(px, py, qx0, qx1, qy0, qy1, degen):
     """Batched n-pair product pairing check: (B, n_pairs, ...) inputs.
 
@@ -235,7 +240,7 @@ def _agg_verify_body(pk_pts, u0, u1, sig_q, agg_degen, sig_degen,
     return pair(px, py, qx0, qx1, qy0, qy1, degen)
 
 
-@jax.jit
+@kjit
 def _program_agg_verify_fused(pk_pts, u0, u1, sig_q, agg_degen, sig_degen):
     """Whole FastAggregateVerify batch as ONE compiled program: one
     dispatch, no intermediate host round trips, cross-stage XLA fusion.
@@ -415,6 +420,27 @@ def aggregate_verify_batch(items) -> list:
 # consensus_specs_tpu.parallel builds on these):
 def normalize_flag_program(p):
     return _j_g1_normalize_flag(p)
+
+
+def verify_from_aggregate(total, u0, u1, sig_q, agg_degen, sig_degen):
+    """Finish a batched FastAggregateVerify from an UNNORMALIZED projective
+    aggregate: normalize, hash-to-curve, 2-pair product pairing check.
+
+    This is the downstream half of the sharded step
+    (``parallel.sharded_verify.make_sharded_agg_verify``) and of the
+    multichip dryrun's numpy cross-check - one implementation, whichever
+    process computed the aggregate."""
+    aggp, agg_inf = _j_g1_normalize_flag(total)
+    hpt = _program_htc(u0, u1)
+    b = aggp[0].shape[:-1]
+    px = jnp.stack([aggp[0], jnp.broadcast_to(_NEG_G1[0][0], b + (24,))])
+    py = jnp.stack([aggp[1], jnp.broadcast_to(_NEG_G1[1][0], b + (24,))])
+    qx = (jnp.stack([hpt[0][0], sig_q[0][0]]),
+          jnp.stack([hpt[0][1], sig_q[0][1]]))
+    qy = (jnp.stack([hpt[1][0], sig_q[1][0]]),
+          jnp.stack([hpt[1][1], sig_q[1][1]]))
+    degen = jnp.stack([agg_degen | agg_inf, sig_degen])
+    return PR.staged_pairing_check(px, py, (qx, qy), degen)
 
 
 def htc_program(u0, u1):

@@ -1,0 +1,93 @@
+"""Array-backend switch for the BLS12-381 limb kernels: JAX or numpy.
+
+Default is JAX (jnp ops, ``jax.jit``, ``jax.lax`` control flow) - the
+TPU path.  Setting ``CS_TPU_NUMPY_KERNELS=1`` BEFORE import selects a
+pure-numpy mirror: the same kernel source executes eagerly on numpy
+arrays with python-loop shims for scan/fori/cond and identity ``kjit``.
+
+Why this exists: on a weak 1-core host neither XLA:CPU compilation of
+the staged pipeline (> 9 min) nor per-op JAX eager dispatch (> 9 min)
+fits the driver's multichip-dryrun budget, while the identical limb
+arithmetic in vectorized numpy completes in seconds.  The numpy mode
+powers the dryrun's documented fallback (real mesh collectives run
+compiled/eager in a jax process; the full pairing math is then
+cross-checked in a numpy process) and doubles as a fast differential
+oracle for kernel tests.
+
+The switch is process-level (import-time): kernels bind their array
+namespace once.  Nothing else in the framework flips it at runtime.
+"""
+import os
+
+import numpy as _np
+
+NUMPY_KERNELS = os.environ.get("CS_TPU_NUMPY_KERNELS") == "1"
+
+
+if NUMPY_KERNELS:
+    xp = _np
+
+    def kjit(fn=None, **kwargs):
+        """Identity stand-in for jax.jit (numpy executes eagerly)."""
+        if fn is None:
+            return lambda f: f
+        return fn
+
+    class lax:  # noqa: N801 - mirrors jax.lax's lowercase module name
+        @staticmethod
+        def scan(f, init, xs, length=None):
+            carry = init
+            if xs is None:
+                n = length
+                get = lambda i: None
+            else:
+                n = len(xs) if isinstance(xs, (list, tuple)) else \
+                    _np.asarray(xs).shape[0]
+                get = lambda i: xs[i]
+            ys = []
+            for i in range(n):
+                carry, y = f(carry, get(i))
+                ys.append(y)
+            if not ys or all(y is None for y in ys):
+                return carry, None
+            import jax.tree_util as tu   # pure-python pytree walk
+            stacked = tu.tree_map(lambda *leaves: _np.stack(leaves), *ys)
+            return carry, stacked
+
+        @staticmethod
+        def fori_loop(lo, hi, body, init):
+            val = init
+            for i in range(int(lo), int(hi)):
+                val = body(i, val)
+            return val
+
+        @staticmethod
+        def cond(pred, true_fn, false_fn, operand):
+            return true_fn(operand) if bool(pred) else false_fn(operand)
+
+    def dot_f32(a, b):
+        """f32 matmul (exactness argument in limbs._product_columns)."""
+        return _np.dot(a, b)
+
+    def at_set(arr, idx, value):
+        out = _np.array(arr)
+        out[idx] = value
+        return out
+
+    def block_until_ready(x):
+        return x
+else:
+    import jax as _jax
+    import jax.numpy as xp  # noqa: F401
+    from jax import lax  # noqa: F401
+
+    kjit = _jax.jit
+
+    def dot_f32(a, b):
+        return xp.dot(a, b, precision=_jax.lax.Precision.HIGHEST)
+
+    def at_set(arr, idx, value):
+        return arr.at[idx].set(value)
+
+    def block_until_ready(x):
+        return _jax.block_until_ready(x)

@@ -12,8 +12,8 @@ performs at import (``ops/bls12_381/hash_to_curve.py``), so the two
 backends hash identically by construction.
 """
 import numpy as np
-import jax
-import jax.numpy as jnp
+from .backend import xp as jnp, kjit, lax
+
 
 from consensus_specs_tpu.ops.bls12_381.fields import X_PARAM
 from consensus_specs_tpu.ops.bls12_381 import hash_to_curve as _oracle
@@ -138,35 +138,113 @@ def map_to_g2(u0, u1):
 # on a 1-core host while its pieces take ~1 minute each), so the batch
 # entry point dispatches a chain of bounded programs.  The double-run
 # program takes a TRACED trip count, so every segment of the cofactor
-# ladder reuses ONE compiled program.
-_sswu_jit = jax.jit(sswu_map)
-_iso_jit = jax.jit(iso_map)
+# ladder reuses ONE compiled program.  The five fixed-exponent powers
+# inside SSWU (inversion, Legendre, three sqrt ladders) dispatch through
+# the SHARED ladder program (``limbs._j_pow_windows``) - in-trace they
+# each duplicated a 96-step scan body, making the SSWU program the
+# single biggest compile of the whole pipeline (47 s of the 185 s
+# hash-to-curve total on the 1-core host; measured round 4).
 
 
-@jax.jit
+@kjit
+def _j_sswu_tv(u):
+    """u -> (zu2, tv): tv is the inversion operand of the x1 numerator."""
+    zu2 = T.f2_mul(_bc(_Z, u), T.f2_sqr(u))
+    tv = T.f2_add(T.f2_sqr(zu2), zu2)
+    return zu2, tv
+
+
+@kjit
+def _j_sswu_x(u, zu2, tv, tvinv):
+    """Candidate x's and their curve polynomials + the Legendre operand."""
+    A, B = _bc(_A, u), _bc(_B, u)
+    tv_zero = T.f2_is_zero(tv)
+    x1_main = T.f2_mul(_bc(_NEG_B_OVER_A, u),
+                       T.f2_add(T.f2_one_like(u), tvinv))
+    x1 = T.f2_select(tv_zero, _bc(_B_OVER_ZA, u), x1_main)
+    gx1 = T.f2_add(T.f2_add(T.f2_mul(T.f2_sqr(x1), x1), T.f2_mul(A, x1)), B)
+    x2 = T.f2_mul(zu2, x1)
+    gx2 = T.f2_add(T.f2_add(T.f2_mul(T.f2_sqr(x2), x2), T.f2_mul(A, x2)), B)
+    norm_gx1 = L.add_mod(L.mont_sqr(gx1[0]), L.mont_sqr(gx1[1]))
+    return x1, x2, gx1, gx2, norm_gx1
+
+
+@kjit
+def _j_sswu_pick(x1, x2, gx1, gx2, norm_gx1, lq):
+    """Select (x, gx) by the Legendre result lq = norm_gx1^((p-1)/2)."""
+    one = jnp.broadcast_to(jnp.asarray(L.ONE_M), lq.shape)
+    sq1 = L.eq(lq, one) | L.is_zero(norm_gx1)
+    return T.f2_select(sq1, x1, x2), T.f2_select(sq1, gx1, gx2)
+
+
+@kjit
+def _j_sswu_sign(u, x, y):
+    flip = _sgn0(u) != _sgn0(y)
+    return x, T.f2_select(flip, T.f2_neg(y), y)
+
+
+def _horner_all(x):
+    def horner(coeffs):
+        acc = _bc(coeffs[-1], x)
+        for c in reversed(coeffs[:-1]):
+            acc = T.f2_add(T.f2_mul(acc, x), _bc(c, x))
+        return acc
+    return horner(_XNUM), horner(_XDEN), horner(_YNUM), horner(_YDEN)
+
+
+@kjit
+def _j_iso_horner(x):
+    x_num, x_den, y_num, y_den = _horner_all(x)
+    return x_num, x_den, y_num, y_den, T.f2_mul(x_den, y_den)
+
+
+@kjit
+def _j_iso_post(y, x_num, x_den, y_num, y_den, dinv):
+    X = T.f2_mul(x_num, T.f2_mul(dinv, y_den))
+    Y = T.f2_mul(y, T.f2_mul(y_num, T.f2_mul(dinv, x_den)))
+    return X, Y
+
+
+def _staged_sswu_iso(u):
+    """SSWU + 3-isogeny as a pipeline of bounded programs; u batches over
+    arbitrary leading dims (map_to_g2_staged stacks u0/u1 on axis 0 so
+    both halves ride every program once)."""
+    zu2, tv = _j_sswu_tv(u)
+    tvinv = T.staged_f2_inv(tv)
+    x1, x2, gx1, gx2, n1 = _j_sswu_x(u, zu2, tv, tvinv)
+    lq = L.pow_windows_staged(n1, L.LEGENDRE_WINDOWS)
+    x, gx = _j_sswu_pick(x1, x2, gx1, gx2, n1, lq)
+    y = T.staged_f2_sqrt(gx)
+    x, y = _j_sswu_sign(u, x, y)
+    x_num, x_den, y_num, y_den, den = _j_iso_horner(x)
+    dinv = T.staged_f2_inv(den)
+    return _j_iso_post(y, x_num, x_den, y_num, y_den, dinv)
+
+
+@kjit
 def _j_affine_add(x0, y0, x1, y1):
     one = T.f2_one_like(x0)
     return PT.g2_add((x0, y0, one), (x1, y1, one))
 
 
-@jax.jit
+@kjit
 def _j_g2_dbl_run(acc, n):
-    return jax.lax.fori_loop(
+    return lax.fori_loop(
         0, n, lambda _, a: PT.g2_dbl(a), acc)
 
 
-@jax.jit
+@kjit
 def _j_g2_add_point(a, b):
     return PT.g2_add(a, b)
 
 
-@jax.jit
+@kjit
 def _j_neg_add(a, b):
     """-(a + b)."""
     return PT.g2_neg(PT.g2_add(a, b))
 
 
-@jax.jit
+@kjit
 def _j_cofactor_combine(mulx_r, r, p):
     """[x]R - P + psi(R) + psi^2([2]P), given [|x|]R (x < 0 so
     [x]R = -[|x|]R)."""
@@ -197,9 +275,14 @@ def _staged_clear_cofactor(p):
 
 
 def map_to_g2_staged(u0, u1):
-    """Same math as :func:`map_to_g2`, as a pipeline of bounded programs."""
-    x0, y0 = _iso_jit(*_sswu_jit(u0))
-    x1, y1 = _iso_jit(*_sswu_jit(u1))
+    """Same math as :func:`map_to_g2`, as a pipeline of bounded programs.
+
+    u0/u1 are stacked on a fresh leading axis so SSWU + isogeny run once
+    over both halves (every program dispatch covers 2x the lanes)."""
+    u = (jnp.stack([u0[0], u1[0]]), jnp.stack([u0[1], u1[1]]))
+    X, Y = _staged_sswu_iso(u)
+    x0, y0 = (X[0][0], X[1][0]), (Y[0][0], Y[1][0])
+    x1, y1 = (X[0][1], X[1][1]), (Y[0][1], Y[1][1])
     return _staged_clear_cofactor(_j_affine_add(x0, y0, x1, y1))
 
 

@@ -21,8 +21,7 @@ arithmetic inside milagro/arkworks (reference
 """
 
 import numpy as np
-import jax
-import jax.numpy as jnp
+from .backend import xp as jnp, lax, kjit, dot_f32, at_set
 
 from consensus_specs_tpu.ops.bls12_381.fields import P
 
@@ -141,8 +140,7 @@ def _product_columns(a, b):
     hi = (prods >> LIMB_BITS).astype(jnp.float32)
     stacked = jnp.concatenate([lo, hi], axis=-2)         # (..., 48, 24)
     flat = stacked.reshape(stacked.shape[:-2] + (2 * NLIMB * NLIMB,))
-    cols = jnp.dot(flat, jnp.asarray(_SCATTER),
-                   precision=jax.lax.Precision.HIGHEST)
+    cols = dot_f32(flat, jnp.asarray(_SCATTER))
     return cols.astype(jnp.uint32)
 
 
@@ -228,7 +226,7 @@ def to_mont(a):
 
 
 def from_mont(a):
-    one = jnp.zeros(NLIMB, jnp.uint32).at[0].set(1)
+    one = at_set(jnp.zeros(NLIMB, jnp.uint32), 0, 1)
     return mont_mul(a, jnp.broadcast_to(one, a.shape))
 
 
@@ -268,13 +266,85 @@ def pow_fixed(a, bits: np.ndarray):
 
     # first window seeds the accumulator directly (acc = table[w0])
     acc = jnp.take(table, jnp.asarray(windows[0]), axis=0)
-    out, _ = jax.lax.scan(step, acc, jnp.asarray(windows[1:]))
+    out, _ = lax.scan(step, acc, jnp.asarray(windows[1:]))
     return out
 
 
 _INV_BITS = _exp_bits(P - 2)
 _SQRT_BITS = _exp_bits((P + 1) // 4)
 _LEGENDRE_BITS = _exp_bits((P - 1) // 2)
+
+
+# ---------------------------------------------------------------------------
+# Shared exponentiation ladder: ONE compiled program for every fixed-
+# exponent power (inversion, sqrt, Legendre) across every staged pipeline.
+#
+# Why: each in-trace ``pow_fixed`` instance duplicates its 15-multiply
+# table setup and 96-step scan body in the XLA module; the SSWU map alone
+# holds five instances, making hash-to-curve the compile-time whale
+# (185 s of the ~450 s cold staged pipeline on a 1-core XLA:CPU host -
+# measured round 4).  Staging the pows out of their callers and passing
+# the exponent as a TRACED window array leaves exactly one compiled
+# ladder per row-bucket, shared by all of them.
+# ---------------------------------------------------------------------------
+
+N_WINDOWS = 96  # ceil(384/4): every exponent here is < 2^384
+
+
+def exp_windows(e: int) -> np.ndarray:
+    """Host-side: exponent -> (96,) MSB-first 4-bit windows, left-padded
+    with zeros (exact for the ladder: acc stays 1 through leading zero
+    windows since 1^16 * table[0] == 1)."""
+    return np.array([(e >> (4 * (N_WINDOWS - 1 - i))) & 0xF
+                     for i in range(N_WINDOWS)], dtype=np.uint32)
+
+
+INV_WINDOWS = exp_windows(P - 2)
+SQRT_WINDOWS = exp_windows((P + 1) // 4)
+LEGENDRE_WINDOWS = exp_windows((P - 1) // 2)
+
+
+@kjit
+def _j_pow_windows(a, windows):
+    """a^e for (R, 24) Montgomery rows; e given as traced 4-bit windows.
+
+    Same math as :func:`pow_fixed` without the first-window seeding
+    optimization (left-zero-padding needs the neutral start).  a == 0
+    rows yield 0 (table powers >= 1 are zero), preserving the
+    inv(0) == 0 convention."""
+    one = jnp.broadcast_to(jnp.asarray(ONE_M), a.shape)
+    entries = [one, a]
+    for _ in range(14):
+        entries.append(mont_mul(entries[-1], a))
+    table = jnp.stack(entries)
+
+    def step(acc, w):
+        acc = mont_sqr(mont_sqr(mont_sqr(mont_sqr(acc))))
+        return mont_mul(acc, jnp.take(table, w, axis=0)), None
+
+    out, _ = lax.scan(step, one, windows)
+    return out
+
+
+def pow_windows_staged(a, windows: np.ndarray):
+    """Dispatch the shared ladder for any leading batch shape.
+
+    Rows are flattened and zero-padded to a power-of-two bucket (floor
+    64) so only a handful of shapes ever compile regardless of call
+    site."""
+    from .backend import NUMPY_KERNELS
+    lead = a.shape[:-1]
+    flat = a.reshape((-1, NLIMB))
+    rows = flat.shape[0]
+    if NUMPY_KERNELS:
+        bucket = rows   # eager numpy: no compile to amortize, no padding
+    else:
+        bucket = max(64, 1 << max(0, rows - 1).bit_length()) if rows else 64
+    if bucket != rows:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((bucket - rows, NLIMB), jnp.uint32)], axis=0)
+    out = _j_pow_windows(flat, jnp.asarray(windows))
+    return out[:rows].reshape(lead + (NLIMB,))
 
 
 def inv_mod(a):

@@ -18,8 +18,8 @@ tensors versus thousands of dependent bucket operations — the shape XLA
 and the TPU vector units want.
 """
 import numpy as np
-import jax
-import jax.numpy as jnp
+import jax  # tree_util only; array ops ride the backend switch
+from .backend import xp as jnp, lax, kjit
 
 from consensus_specs_tpu.ops.bls12_381.curve import G1Point
 from . import points as PT
@@ -34,17 +34,17 @@ def _double_k_times(p, k):
     return p
 
 
-@jax.jit
+@kjit
 def _expand_windows(pts):
     """(N,) packed G1 -> (N_WINDOWS, N) stacked window multiples."""
     def step(carry, _):
         nxt = _double_k_times(carry, WINDOW_BITS)
         return nxt, carry
-    _, stacked = jax.lax.scan(step, pts, None, length=N_WINDOWS)
+    _, stacked = lax.scan(step, pts, None, length=N_WINDOWS)
     return stacked
 
 
-@jax.jit
+@kjit
 def _msm_core(window_pts, digit_bits):
     """window_pts: (M,) packed points; digit_bits: (M, 8) uint32 bits
     (MSB first) -> single packed point."""

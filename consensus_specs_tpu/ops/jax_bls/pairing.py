@@ -16,8 +16,8 @@ program (reference equivalent: per-call Rust FFI, one at a time -
 ``eth2spec/utils/bls.py:107-143``).
 """
 import numpy as np
-import jax
-import jax.numpy as jnp
+import jax  # vmap/tree_util for the monolithic path; arrays ride the backend
+from .backend import xp as jnp, lax, kjit
 
 from consensus_specs_tpu.ops.bls12_381.fields import P, R_ORDER, X_PARAM
 from . import limbs as L
@@ -183,10 +183,10 @@ def miller_loop(px, py, q, degenerate):
             r, line = _add_step(r, q, px, py)
             return (r, _mul_by_line(f, line))
 
-        carry = jax.lax.cond(bit != 0, with_add, lambda rf: rf, (r, f))
+        carry = lax.cond(bit != 0, with_add, lambda rf: rf, (r, f))
         return carry, None
 
-    (_, f), _ = jax.lax.scan(step, (r0, one), jnp.asarray(_MILLER_BITS))
+    (_, f), _ = lax.scan(step, (r0, one), jnp.asarray(_MILLER_BITS))
     f = T.f12_conj(f)                       # x < 0
     return T.f12_select(degenerate, one, f)
 
@@ -196,11 +196,11 @@ def _pow_x(f):
     the 5 multiplies at set bits execute under ``lax.cond``."""
     def step(acc, bit):
         acc = T.f12_cyclotomic_sqr(acc)
-        acc = jax.lax.cond(bit != 0, lambda a: T.f12_mul(a, f),
+        acc = lax.cond(bit != 0, lambda a: T.f12_mul(a, f),
                            lambda a: a, acc)
         return acc, None
 
-    out, _ = jax.lax.scan(step, f, jnp.asarray(_X_BITS[1:]))
+    out, _ = lax.scan(step, f, jnp.asarray(_X_BITS[1:]))
     return out
 
 
@@ -272,13 +272,13 @@ _MILLER_SCHEDULE = bit_schedule(_MILLER_BITS)
 _X_SCHEDULE = bit_schedule(_X_BITS[1:])
 
 
-@jax.jit
+@kjit
 def _j_miller_init(q):
     one = T.f12_one_like(((q[0], q[0], q[0]), (q[0], q[0], q[0])))
     return (q[0], q[1], T.f2_one_like(q[0])), one
 
 
-@jax.jit
+@kjit
 def _j_miller_dbl_run(carry, px, py, n):
     """``n`` (traced) square+double+line steps - one compiled program."""
     def body(_, carry):
@@ -286,52 +286,83 @@ def _j_miller_dbl_run(carry, px, py, n):
         f = T.f12_sqr(f)
         r, line = _dbl_step(r, px, py)
         return (r, _mul_by_line(f, line))
-    return jax.lax.fori_loop(0, n, body, carry)
+    return lax.fori_loop(0, n, body, carry)
 
 
-@jax.jit
+@kjit
 def _j_miller_add(carry, q, px, py):
     r, f = carry
     r, line = _add_step(r, q, px, py)
     return (r, _mul_by_line(f, line))
 
 
-@jax.jit
+@kjit
 def _j_miller_finish(carry, degenerate):
     _, f = carry
     one = T.f12_one_like(f)
     return T.f12_select(degenerate, one, T.f12_conj(f))
 
 
-@jax.jit
+@kjit
 def _j_f12_mul(a, b):
     return T.f12_mul(a, b)
 
 
-@jax.jit
-def _j_easy_part(f):
-    g = T.f12_mul(T.f12_conj(f), T.f12_inv(f))
+# The easy part split around its single Fq inversion so the 96-step
+# ladder dispatches through the SHARED pow program instead of inlining
+# (the in-trace version cost 73 s of cold XLA:CPU compile; round 4).
+
+@kjit
+def _j_easy_det(f):
+    """f12_inv front half: f6_inv partials of d6 = a0^2 - v*a1^2 down to
+    the Fq2 determinant (mirrors tower.f6_inv)."""
+    a0, a1 = f
+    d6 = T.f6_sub(T.f6_sqr(a0), T.f6_mul_by_v(T.f6_sqr(a1)))
+    b0, b1, b2 = d6
+    m = T.f2_mul_many([(b0, b0), (b1, b1), (b2, b2),
+                       (b1, b2), (b0, b1), (b0, b2)])
+    sq0, sq1, sq2, m12, m01, m02 = m
+    t = T.f2_sub_many([(sq0, T.f2_mul_xi(m12)),
+                       (T.f2_mul_xi(sq2), m01),
+                       (sq1, m02)])
+    d = T.f2_mul_many([(b0, t[0]), (b2, t[1]), (b1, t[2])])
+    det = T.f2_add(d[0], T.f2_add(T.f2_mul_xi(d[1]), T.f2_mul_xi(d[2])))
+    return t[0], t[1], t[2], det
+
+
+@kjit
+def _j_easy_finish(f, t0, t1, t2, dinv):
+    inv6 = tuple(T.f2_mul_many([(t0, dinv), (t1, dinv), (t2, dinv)]))
+    a0, a1 = f
+    inv12 = (T.f6_mul(a0, inv6), T.f6_neg(T.f6_mul(a1, inv6)))
+    g = T.f12_mul(T.f12_conj(f), inv12)
     return T.f12_mul(T.f12_frobenius(T.f12_frobenius(g)), g)
 
 
-@jax.jit
+def _staged_easy_part(f):
+    t0, t1, t2, det = _j_easy_det(f)
+    dinv = T.staged_f2_inv(det)
+    return _j_easy_finish(f, t0, t1, t2, dinv)
+
+
+@kjit
 def _j_cyc_sqr_run(acc, n):
-    return jax.lax.fori_loop(
+    return lax.fori_loop(
         0, n, lambda _, a: T.f12_cyclotomic_sqr(a), acc)
 
 
-@jax.jit
+@kjit
 def _j_conj(f):
     return T.f12_conj(f)
 
 
-@jax.jit
+@kjit
 def _j_hard_combine_t3(t2, t2x):
     """t2^(x+p) given t2 and t2^|x|: conj(t2^|x|) * frobenius(t2)."""
     return T.f12_mul(T.f12_conj(t2x), T.f12_frobenius(t2))
 
 
-@jax.jit
+@kjit
 def _j_hard_combine_t4(t3, xx):
     """xx = t3^(x^2); t4 = xx * t3^(p^2) * t3^{-1} (conj = inverse)."""
     return T.f12_mul(
@@ -339,7 +370,7 @@ def _j_hard_combine_t4(t3, xx):
         T.f12_conj(t3))
 
 
-@jax.jit
+@kjit
 def _j_final_combine(t4, g):
     out = T.f12_mul(t4, T.f12_mul(T.f12_cyclotomic_sqr(g), g))
     return T.f12_is_one(out)
@@ -387,7 +418,7 @@ def staged_miller(px, py, q, degenerate):
 
 def staged_final_exp_is_one(f):
     """Staged equivalent of :func:`final_exp_is_one`."""
-    g = _j_easy_part(f)
+    g = _staged_easy_part(f)
     t1 = _j_conj(_j_f12_mul(_staged_pow_x(g), g))          # g^(x-1), x<0
     t2 = _j_conj(_j_f12_mul(_staged_pow_x(t1), t1))        # t1^(x-1)
     t3 = _j_hard_combine_t3(t2, _staged_pow_x(t2))

@@ -14,8 +14,8 @@ pubkeys is a depth-11 vectorized reduction rather than a serial loop
 (reference hot path ``eth2spec/utils/bls.py:133-143``).
 """
 import numpy as np
-import jax
-import jax.numpy as jnp
+import jax  # tree_util only; array ops ride the backend switch
+from .backend import xp as jnp, lax, kjit
 
 from consensus_specs_tpu.ops.bls12_381.fields import Fq2 as _OFq2
 from consensus_specs_tpu.ops.bls12_381.curve import G1Point, G2Point
@@ -160,12 +160,12 @@ def _scalar_mul(f, p, bits):
             nxt = _complete_add(f, acc, p)
             acc = _select(f, bit != 0, nxt, acc)
         else:
-            acc = jax.lax.cond(bit != 0,
+            acc = lax.cond(bit != 0,
                                lambda a: _complete_add(f, a, p),
                                lambda a: a, acc)
         return acc, None
 
-    acc, _ = jax.lax.scan(step, acc, xs)
+    acc, _ = lax.scan(step, acc, xs)
     return acc
 
 
@@ -279,7 +279,7 @@ def g1_tree_sum_batched(pts):
         keep = (lane < stride)[None, :]
         return _select(f, keep, summed, arr)
 
-    out = jax.lax.fori_loop(0, levels, body, pts)
+    out = lax.fori_loop(0, levels, body, pts)
     return jax.tree_util.tree_map(lambda a: a[:, 0], out)
 
 
@@ -325,6 +325,44 @@ def g2_normalize(p):
     return (T.f2_select(inf, zero, x),
             T.f2_select(inf, one, y),
             T.f2_select(inf, zero, one))
+
+
+# Staged normalizations: the field inversion dispatches through the
+# shared ladder program (limbs._j_pow_windows) so only the cheap
+# combine compiles per call site.  Same math as g1/g2_normalize.
+
+@kjit
+def _j_g1_norm_post(p, zinv):
+    inf = L.is_zero(p[2])
+    x = L.mont_mul(p[0], zinv)
+    y = L.mont_mul(p[1], zinv)
+    one = _FqOps.one_like(p[2])
+    pt = (L.select(inf, jnp.zeros_like(x), x),
+          L.select(inf, one, y),
+          L.select(inf, jnp.zeros_like(p[2]), one))
+    return pt, inf
+
+
+def g1_normalize_flag_staged(p):
+    """Projective -> affine-with-Z=1 + identity flag, staged."""
+    zinv = L.pow_windows_staged(p[2], L.INV_WINDOWS)
+    return _j_g1_norm_post(p, zinv)
+
+
+@kjit
+def _j_g2_norm_post(p, zinv):
+    inf = T.f2_is_zero(p[2])
+    x = T.f2_mul(p[0], zinv)
+    y = T.f2_mul(p[1], zinv)
+    one = T.f2_one_like(p[2])
+    zero = T.f2_zero_like(p[2])
+    return (T.f2_select(inf, zero, x),
+            T.f2_select(inf, one, y),
+            T.f2_select(inf, zero, one))
+
+
+def g2_normalize_staged(p):
+    return _j_g2_norm_post(p, T.staged_f2_inv(p[2]))
 
 
 # ---------------------------------------------------------------------------

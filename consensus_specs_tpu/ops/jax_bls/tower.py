@@ -6,7 +6,7 @@ Fq12 = Fq6[w]/(w^2 - v).  Elements are pytrees of Montgomery limb arrays -
 Fq2 = (a, b), Fq6 = (c0, c1, c2), Fq12 = (d0, d1) - so ``vmap``/``scan``
 thread them transparently and all ops batch over leading dims.
 """
-import jax.numpy as jnp
+from .backend import xp as jnp, kjit, lax
 
 from consensus_specs_tpu.ops.bls12_381.fields import (
     P, Fq2 as _OFq2, FROB_V1 as _OFROB_V1, FROB_V2 as _OFROB_V2,
@@ -139,6 +139,79 @@ def f2_sqrt(x):
     b0_im = L.select(a_is_qr, jnp.zeros_like(rb), rb)
     b_zero = L.is_zero(b)
     return (L.select(b_zero, b0_re, xr), L.select(b_zero, b0_im, yr))
+
+
+# ---------------------------------------------------------------------------
+# Staged Fq2 inversion / sqrt: the expensive fixed-exponent powers inside
+# f2_inv/f2_sqrt/f2_is_square dispatch through the SHARED ladder program
+# (``limbs._j_pow_windows``) instead of inlining their own scan bodies;
+# only the cheap glue compiles per call site.  Use these from host-
+# orchestrated staged pipelines; the in-trace f2_inv/f2_sqrt above remain
+# for code that is compiled as one program anyway.
+# ---------------------------------------------------------------------------
+
+@kjit
+def _j_f2_norm(x):
+    """a^2 + b^2 - the Fq norm every Fq2 inv/sqrt/Legendre reduces to."""
+    return L.add_mod(L.mont_sqr(x[0]), L.mont_sqr(x[1]))
+
+
+@kjit
+def _j_f2_inv_post(x, ninv):
+    """(a, b), 1/(a^2+b^2) -> (a*ninv, -b*ninv)."""
+    m = L.mont_mul_many([(x[0], ninv), (x[1], ninv)])
+    return (m[0], L.neg_mod(m[1]))
+
+
+def staged_f2_inv(x):
+    """f2_inv as [tiny norm] -> [shared ladder] -> [tiny combine]."""
+    ninv = L.pow_windows_staged(_j_f2_norm(x), L.INV_WINDOWS)
+    return _j_f2_inv_post(x, ninv)
+
+
+@kjit
+def _j_sqrt_stack(x, alpha):
+    """Candidates whose shared-exponent roots cover every sqrt branch:
+    stacks (delta1, delta2, a, -a) on a new leading axis."""
+    a, b = x
+    inv2 = jnp.broadcast_to(jnp.asarray(L.fq_const(pow(2, -1, P))), a.shape)
+    d = L.mont_mul_many([(L.add_mod(a, alpha), inv2),
+                         (L.sub_mod(a, alpha), inv2)])
+    return jnp.stack([d[0], d[1], a, L.neg_mod(a)])
+
+
+@kjit
+def _j_sqrt_sel(x, stacked, roots):
+    """Pick xr from the two delta roots; return (xr, 2*xr, delta1)."""
+    x1, x2c = roots[0], roots[1]
+    use1 = L.eq(L.mont_sqr(x1), stacked[0])
+    xr = L.select(use1, x1, x2c)
+    return xr, L.add_mod(xr, xr)
+
+
+@kjit
+def _j_sqrt_final(x, roots, xr, den_inv):
+    """Assemble the Fq2 root, covering the b == 0 branch."""
+    a, b = x
+    ra, rb = roots[2], roots[3]
+    yr = L.mont_mul(b, den_inv)
+    a_is_qr = L.eq(L.mont_sqr(ra), a)
+    b0_re = L.select(a_is_qr, ra, jnp.zeros_like(ra))
+    b0_im = L.select(a_is_qr, jnp.zeros_like(rb), rb)
+    b_zero = L.is_zero(b)
+    return (L.select(b_zero, b0_re, xr), L.select(b_zero, b0_im, yr))
+
+
+def staged_f2_sqrt(x):
+    """f2_sqrt as a pipeline over the shared ladder (same math/branches
+    as :func:`f2_sqrt`; caller must know x is a square)."""
+    norm = _j_f2_norm(x)
+    alpha = L.pow_windows_staged(norm, L.SQRT_WINDOWS)
+    stacked = _j_sqrt_stack(x, alpha)
+    roots = L.pow_windows_staged(stacked, L.SQRT_WINDOWS)
+    xr, den = _j_sqrt_sel(x, stacked, roots)
+    den_inv = L.pow_windows_staged(den, L.INV_WINDOWS)
+    return _j_sqrt_final(x, roots, xr, den_inv)
 
 
 # ---------------------------------------------------------------------------

@@ -4,6 +4,7 @@ The TPU-native replacement for the reference's distributed axis (NCCL/MPI
 have no role there — see SURVEY.md §2.4): aggregate-signature work shards
 over a ``jax.sharding.Mesh`` with XLA collectives riding ICI.
 """
-from .sharded_verify import build_mesh, make_sharded_agg_verify
+from .sharded_verify import build_mesh, make_sharded_agg, \
+    make_sharded_agg_verify
 
-__all__ = ["build_mesh", "make_sharded_agg_verify"]
+__all__ = ["build_mesh", "make_sharded_agg", "make_sharded_agg_verify"]

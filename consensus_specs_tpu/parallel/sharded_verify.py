@@ -32,20 +32,18 @@ def build_mesh(devices, data: int, agg: int):
     return Mesh(dev, ("data", "agg"))
 
 
-def make_sharded_agg_verify(mesh):
-    """Compile a sharded verification step for ``mesh``.
+def make_sharded_agg(mesh):
+    """Compile the COLLECTIVE half for ``mesh``: per-shard partial G1
+    tree sums over the local pubkey slice, ``all_gather`` across 'agg',
+    ordered combine on every device.  Returns ``agg(pk_pts) ->
+    total[data_batch]`` (unnormalized projective aggregate).
 
-    Returns ``step(pk_pts, u0, u1, sig_q, agg_degen, sig_degen) ->
-    bool[data_batch]`` where ``pk_pts`` is a packed projective G1 pytree
-    of shape ``(batch, n_keys)`` sharded ``P('data', 'agg')`` and the
-    rest are data-sharded (see ``bls_jax.verify_aggregates_batch`` for
-    the packing).
+    Exposed separately so ``__graft_entry__``'s hybrid dryrun fallback
+    runs the EXACT collective program the full step uses.
     """
     from jax.sharding import PartitionSpec as P
     from jax.experimental.shard_map import shard_map
     from consensus_specs_tpu.ops.jax_bls import points as PT
-    from consensus_specs_tpu.ops.jax_bls import pairing as PR
-    from consensus_specs_tpu.ops import bls_jax
 
     agg_size = mesh.shape["agg"]
 
@@ -63,23 +61,27 @@ def make_sharded_agg_verify(mesh):
         return total
 
     pk_spec = P("data", "agg")
-    sharded_agg = jax.jit(shard_map(
+    return jax.jit(shard_map(
         local_agg, mesh=mesh, in_specs=((pk_spec,) * 3,),
         out_specs=P("data"), check_rep=False))
 
+
+def make_sharded_agg_verify(mesh):
+    """Compile a sharded verification step for ``mesh``.
+
+    Returns ``step(pk_pts, u0, u1, sig_q, agg_degen, sig_degen) ->
+    bool[data_batch]`` where ``pk_pts`` is a packed projective G1 pytree
+    of shape ``(batch, n_keys)`` sharded ``P('data', 'agg')`` and the
+    rest are data-sharded (see ``bls_jax.verify_aggregates_batch`` for
+    the packing).  Downstream of the collective this IS
+    ``bls_jax.verify_from_aggregate`` - one shared implementation.
+    """
+    from consensus_specs_tpu.ops import bls_jax
+
+    sharded_agg = make_sharded_agg(mesh)
+
     def step(pk_pts, u0, u1, sig_q, agg_degen, sig_degen):
-        total = sharded_agg(pk_pts)
-        aggp, agg_inf = bls_jax.normalize_flag_program(total)
-        hpt = bls_jax.htc_program(u0, u1)
-        neg_g = bls_jax.neg_g1_packed()
-        b = aggp[0].shape[:-1]
-        px = jnp.stack([aggp[0], jnp.broadcast_to(neg_g[0][0], b + (24,))])
-        py = jnp.stack([aggp[1], jnp.broadcast_to(neg_g[1][0], b + (24,))])
-        qx = (jnp.stack([hpt[0][0], sig_q[0][0]]),
-              jnp.stack([hpt[0][1], sig_q[0][1]]))
-        qy = (jnp.stack([hpt[1][0], sig_q[1][0]]),
-              jnp.stack([hpt[1][1], sig_q[1][1]]))
-        degen = jnp.stack([agg_degen | agg_inf, sig_degen])
-        return PR.staged_pairing_check(px, py, (qx, qy), degen)
+        return bls_jax.verify_from_aggregate(
+            sharded_agg(pk_pts), u0, u1, sig_q, agg_degen, sig_degen)
 
     return step

@@ -24,6 +24,12 @@ def _cpu_fingerprint() -> str:
     ``BENCH_r03.json`` failure tail).  Keying the cache directory by the
     host's own flags guarantees artifacts are only ever replayed on a
     machine whose features match the compiling one.
+
+    NOTE: ``bench.py``'s ``_machine_key`` inlines this exact derivation
+    (its parent process must never import the package) and keys the
+    last-known-good measurement store with it - change both together or
+    every machine's own store entries silently degrade to
+    ``foreign_machine`` fallbacks.
     """
     import hashlib
     flags = ""
@@ -95,6 +101,23 @@ def force_cpu_platform() -> None:
                     "force_cpu_platform: could not clear initialized JAX "
                     "backends; a previously-selected accelerator backend "
                     "may still be active")
+
+
+def cpu_subprocess_env(base=None) -> dict:
+    """Environment for a CPU-only child process that must NEVER touch the
+    accelerator tunnel.
+
+    Removing ``PALLAS_AXON_POOL_IPS`` makes the container's sitecustomize
+    skip accelerator-plugin registration entirely - measured round 4:
+    with the tunnel flaky, ``register()`` stalls EVERY interpreter start
+    for minutes (it runs from a .pth hook before the script body), which
+    is unsurvivable for budget-bound children.  ``JAX_PLATFORMS=cpu``
+    then binds cleanly because no plugin is registered to override it.
+    """
+    env = dict(os.environ if base is None else base)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
 
 
 def ensure_working_backend(timeout: int = 90) -> str:

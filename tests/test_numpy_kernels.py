@@ -1,0 +1,72 @@
+"""The numpy mirror of the limb kernels (CS_TPU_NUMPY_KERNELS=1).
+
+The same kernel source (``ops/jax_bls``) executes on numpy arrays with
+python-shim control flow (``ops/jax_bls/backend.py``).  This mode backs
+the multichip dryrun's hybrid fallback on hosts where XLA:CPU cannot
+compile the staged pipeline inside the driver budget, so its
+correctness IS a driver-facing guarantee.  The switch is import-time,
+hence the subprocess.
+"""
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHECK = r"""
+import sys
+sys.path.insert(0, %(repo)r)
+import numpy as np
+from consensus_specs_tpu.ops.jax_bls.backend import NUMPY_KERNELS
+assert NUMPY_KERNELS
+import jax  # tree_util only
+
+from consensus_specs_tpu.utils import bls
+from consensus_specs_tpu.ops import bls_jax
+from consensus_specs_tpu.ops.jax_bls import htc as HTC, points as PT
+from consensus_specs_tpu.ops.bls12_381 import hash_to_curve as ORC
+
+bls.use_py()
+wide = %(wide)r
+# hash-to-curve equals the pure-python oracle
+msgs = [b"np-kernel-0", b"np-kernel-1"] if wide else [b"np-kernel-0"]
+pts = HTC.hash_to_g2_batch(msgs)
+for i, m in enumerate(msgs):
+    got = PT.g2_unpack(jax.tree_util.tree_map(lambda a: a[i], pts))
+    assert got == ORC.hash_to_g2(m), "htc mismatch"
+
+# a real aggregate verifies; a wrong message does not
+sks = [1, 2, 3, 4] if wide else [1, 2]
+msg = b"np-kernel-agg"
+pks = [bls.SkToPk(sk) for sk in sks]
+agg = bls.Aggregate([bls.Sign(sk, msg) for sk in sks])
+items = [(pks, msg, agg), (pks, msg + b"!", agg)]
+out = bls_jax.verify_aggregates_batch(items)
+assert out == [True, False], out
+print("NUMPY-KERNELS-OK")
+"""
+
+
+def _run_check(wide: bool):
+    env = dict(os.environ, CS_TPU_NUMPY_KERNELS="1",
+               CS_TPU_BLS_BATCH="2")
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # no accelerator registration
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHECK % {"repo": _REPO, "wide": wide}],
+        env=env, capture_output=True, timeout=300, cwd=_REPO)
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    assert b"NUMPY-KERNELS-OK" in proc.stdout
+
+
+def test_numpy_kernel_mirror_matches_oracle():
+    _run_check(wide=False)
+
+
+import pytest  # noqa: E402
+from consensus_specs_tpu.utils.env_flags import HEAVY  # noqa: E402
+
+
+@pytest.mark.skipif(not HEAVY, reason="wider numpy-mirror differential "
+                    "(CS_TPU_HEAVY=1)")
+def test_numpy_kernel_mirror_wide():
+    _run_check(wide=True)
