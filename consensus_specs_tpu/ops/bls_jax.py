@@ -416,12 +416,9 @@ def aggregate_verify_batch(items) -> list:
 # Scalar (reference-shaped) API
 # ---------------------------------------------------------------------------
 
-# Public staged-program surface (the sharded verify path in
-# consensus_specs_tpu.parallel builds on these):
-def normalize_flag_program(p):
-    return _j_g1_normalize_flag(p)
-
-
+# Public staged-program surface (the sharded step in
+# consensus_specs_tpu.parallel and the dryrun's numpy cross-check both
+# finish through this):
 def verify_from_aggregate(total, u0, u1, sig_q, agg_degen, sig_degen):
     """Finish a batched FastAggregateVerify from an UNNORMALIZED projective
     aggregate: normalize, hash-to-curve, 2-pair product pairing check.
@@ -441,14 +438,6 @@ def verify_from_aggregate(total, u0, u1, sig_q, agg_degen, sig_degen):
           jnp.stack([hpt[1][1], sig_q[1][1]]))
     degen = jnp.stack([agg_degen | agg_inf, sig_degen])
     return PR.staged_pairing_check(px, py, (qx, qy), degen)
-
-
-def htc_program(u0, u1):
-    return _program_htc(u0, u1)
-
-
-def neg_g1_packed():
-    return _NEG_G1
 
 
 def FastAggregateVerify(pubkeys, message: bytes, signature: bytes) -> bool:

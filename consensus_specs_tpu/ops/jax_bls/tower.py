@@ -6,7 +6,7 @@ Fq12 = Fq6[w]/(w^2 - v).  Elements are pytrees of Montgomery limb arrays -
 Fq2 = (a, b), Fq6 = (c0, c1, c2), Fq12 = (d0, d1) - so ``vmap``/``scan``
 thread them transparently and all ops batch over leading dims.
 """
-from .backend import xp as jnp, kjit, lax
+from .backend import xp as jnp, kjit
 
 from consensus_specs_tpu.ops.bls12_381.fields import (
     P, Fq2 as _OFq2, FROB_V1 as _OFROB_V1, FROB_V2 as _OFROB_V2,
@@ -181,8 +181,8 @@ def _j_sqrt_stack(x, alpha):
 
 
 @kjit
-def _j_sqrt_sel(x, stacked, roots):
-    """Pick xr from the two delta roots; return (xr, 2*xr, delta1)."""
+def _j_sqrt_sel(stacked, roots):
+    """Pick xr from the two delta roots; return (xr, 2*xr)."""
     x1, x2c = roots[0], roots[1]
     use1 = L.eq(L.mont_sqr(x1), stacked[0])
     xr = L.select(use1, x1, x2c)
@@ -209,7 +209,7 @@ def staged_f2_sqrt(x):
     alpha = L.pow_windows_staged(norm, L.SQRT_WINDOWS)
     stacked = _j_sqrt_stack(x, alpha)
     roots = L.pow_windows_staged(stacked, L.SQRT_WINDOWS)
-    xr, den = _j_sqrt_sel(x, stacked, roots)
+    xr, den = _j_sqrt_sel(stacked, roots)
     den_inv = L.pow_windows_staged(den, L.INV_WINDOWS)
     return _j_sqrt_final(x, roots, xr, den_inv)
 

@@ -22,7 +22,6 @@ exercise this on the 8-device virtual CPU mesh.
 """
 import numpy as np
 import jax
-import jax.numpy as jnp
 
 
 def build_mesh(devices, data: int, agg: int):

@@ -31,6 +31,27 @@ def _random_blob(seed):
                               for _ in range(WIDTH)])
 
 
+# Commitments/proofs over the fixed random blobs, shared across tests:
+# each blob_to_kzg_commitment / compute_blob_kzg_proof is a 4096-point
+# host MSM (~5-10 s on a 1-core box), so recomputing them per test
+# dominated the suite's KZG slice.
+_COMMIT_MEMO = {}
+
+
+def _commitment(seed):
+    if ("c", seed) not in _COMMIT_MEMO:
+        _COMMIT_MEMO[("c", seed)] = K.blob_to_kzg_commitment(
+            _random_blob(seed), SETUP)
+    return _COMMIT_MEMO[("c", seed)]
+
+
+def _blob_proof(seed):
+    if ("p", seed) not in _COMMIT_MEMO:
+        _COMMIT_MEMO[("p", seed)] = K.compute_blob_kzg_proof(
+            _random_blob(seed), _commitment(seed), SETUP)
+    return _COMMIT_MEMO[("p", seed)]
+
+
 # ---------------------------------------------------------------------------
 # structural helpers
 # ---------------------------------------------------------------------------
@@ -120,7 +141,7 @@ def test_evaluate_polynomial_in_evaluation_form():
 
 def test_compute_and_verify_kzg_proof():
     blob = _random_blob(42)
-    commitment = K.blob_to_kzg_commitment(blob, SETUP)
+    commitment = _commitment(42)
     z = _fe(123456789)
     proof, y = K.compute_kzg_proof(blob, z, SETUP)
     assert K.verify_kzg_proof(commitment, z, y, proof, SETUP)
@@ -132,7 +153,7 @@ def test_compute_and_verify_kzg_proof():
 def test_compute_kzg_proof_in_domain_point():
     """z on a root of unity exercises the special-case quotient."""
     blob = _random_blob(7)
-    commitment = K.blob_to_kzg_commitment(blob, SETUP)
+    commitment = _commitment(7)
     roots_brp = K.bit_reversal_permutation(
         list(K.compute_roots_of_unity(WIDTH)))
     z = _fe(roots_brp[3])
@@ -144,8 +165,8 @@ def test_compute_kzg_proof_in_domain_point():
 
 def test_verify_blob_kzg_proof_roundtrip():
     blob = _random_blob(1)
-    commitment = K.blob_to_kzg_commitment(blob, SETUP)
-    proof = K.compute_blob_kzg_proof(blob, commitment, SETUP)
+    commitment = _commitment(1)
+    proof = _blob_proof(1)
     assert K.verify_blob_kzg_proof(blob, commitment, proof, SETUP)
     assert not K.verify_blob_kzg_proof(blob, commitment,
                                        K.G1_POINT_AT_INFINITY, SETUP)
@@ -153,9 +174,8 @@ def test_verify_blob_kzg_proof_roundtrip():
 
 def test_verify_blob_kzg_proof_batch():
     blobs = [_random_blob(i) for i in range(2)]
-    commitments = [K.blob_to_kzg_commitment(b, SETUP) for b in blobs]
-    proofs = [K.compute_blob_kzg_proof(b, c, SETUP)
-              for b, c in zip(blobs, commitments)]
+    commitments = [_commitment(i) for i in range(2)]
+    proofs = [_blob_proof(i) for i in range(2)]
     assert K.verify_blob_kzg_proof_batch(blobs, commitments, proofs, SETUP)
     # swapped proofs must fail
     assert not K.verify_blob_kzg_proof_batch(
