@@ -195,23 +195,59 @@ def _j_g1_normalize_flag(p):
     return PT.g1_normalize_flag_staged(p)
 
 
+def _aggregate_nopad(pk_pts):
+    return _j_g1_normalize_flag(_j_tree_sum(pk_pts))
+
+
 def _program_aggregate(pk_pts):
     """(B, N) projective G1 pytree -> normalized (B,) aggregate + inf
-    flag, as bounded programs (sum, then staged normalize)."""
-    return _j_g1_normalize_flag(_j_tree_sum(pk_pts))
+    flag, as bounded programs (sum, then staged normalize).
+
+    The batch axis pads to the shared lane bucket (identity rows) so the
+    bench / graft-entry / dryrun consumers hit the same tree-sum and
+    normalize compiles.  (The fused monolith uses the nopad variant -
+    it compiles per-shape anyway, so padding would only waste lanes.)"""
+    b = pk_pts[0].shape[0]
+    bucket = PR.lane_bucket(b)
+    if bucket != b:
+        from consensus_specs_tpu.ops.jax_bls.limbs import ZERO, ONE_M
+        n = bucket - b
+        pk_pts = (PR.pad_axis(pk_pts[0], 0, n, ZERO),
+                  PR.pad_axis(pk_pts[1], 0, n, ONE_M),
+                  PR.pad_axis(pk_pts[2], 0, n, ZERO))
+    agg, inf = _aggregate_nopad(pk_pts)
+    if bucket != b:
+        agg = jax.tree_util.tree_map(lambda a: a[:b], agg)
+        inf = inf[:b]
+    return agg, inf
 
 
 def _program_g2_normalize(p):
     return PT.g2_normalize_staged(p)
 
 
+def _htc_nopad(u0, u1):
+    return _program_g2_normalize(HTC.map_to_g2_staged(u0, u1))
+
+
 def _program_htc(u0, u1):
     """hash_to_field outputs -> affine G2 points (B,).
 
-    Staged dispatch (sswu+iso twice, add+cofactor, normalize): the
+    Staged dispatch (sswu+iso stacked, add+cofactor, normalize): the
     monolithic module compiles pathologically slowly on XLA:CPU; the
-    stages are each bounded and individually cached."""
-    return _program_g2_normalize(HTC.map_to_g2_staged(u0, u1))
+    stages are each bounded and individually cached.  The batch axis is
+    padded to the shared lane bucket so every consumer hits one set of
+    compiled SSWU/ladder programs (see pairing.staged_pairing_check)."""
+    b = u0[0].shape[0]
+    bucket = PR.lane_bucket(b)
+    if bucket != b:
+        pad = lambda a: PR.pad_axis(a, 0, bucket - b)
+        u0 = (pad(u0[0]), pad(u0[1]))
+        u1 = (pad(u1[0]), pad(u1[1]))
+    out = _htc_nopad(u0, u1)
+    if bucket != b:
+        out = jax.tree_util.tree_map(lambda a: a[:b], out)
+    return out
 
 
 @kjit
@@ -248,8 +284,8 @@ def _program_agg_verify_fused(pk_pts, u0, u1, sig_q, agg_degen, sig_degen):
     math cannot diverge between modes."""
     return _agg_verify_body(
         pk_pts, u0, u1, sig_q, agg_degen, sig_degen,
-        aggregate=_program_aggregate,
-        htc=_program_htc,
+        aggregate=_aggregate_nopad,      # monolith compiles per shape -
+        htc=_htc_nopad,                  # lane padding would waste work
         pair=_program_multi_pair_verify)
 
 

@@ -427,10 +427,48 @@ def staged_final_exp_is_one(f):
     return _j_final_combine(t4, g)
 
 
+LANE_BUCKET = 8
+
+
+def lane_bucket(batch: int) -> int:
+    """Power-of-two lane bucket (floor LANE_BUCKET) every staged
+    consumer pads its batch axis to - ONE set of compiled programs per
+    topology regardless of caller batch.  Identity in numpy-kernel mode
+    (eager: no compile to amortize)."""
+    from .backend import NUMPY_KERNELS
+    if NUMPY_KERNELS:
+        return batch
+    return max(LANE_BUCKET, 1 << max(0, batch - 1).bit_length())
+
+
+def pad_axis(a, axis: int, n: int, fill=0):
+    """Append ``n`` entries of ``fill`` (scalar or broadcastable row)
+    along ``axis``."""
+    shape = a.shape[:axis] + (n,) + a.shape[axis + 1:]
+    pad = jnp.broadcast_to(jnp.asarray(fill), shape).astype(a.dtype)
+    return jnp.concatenate([a, pad], axis=axis)
+
+
 def staged_pairing_check(px, py, q, degenerate):
     """pairing_check as a pipeline of bounded compiled programs.
 
     Unlike :func:`pairing_check` the inputs carry (pairs, batch) leading
     axes directly (no outer vmap) - each stage is already batch-shaped.
+
+    The batch axis is padded to a power-of-two lane bucket (floor
+    ``LANE_BUCKET``) with degenerate pairs, so every consumer of the
+    staged pipeline (the bench batch, the graft-entry compile check, the
+    multichip dryrun) hits ONE set of compiled programs - on a slow
+    XLA:CPU host the per-shape recompile of the Miller/final-exp stages
+    is minutes each (measured round 4).  Skipped in numpy-kernel mode
+    (eager: no compile to amortize).
     """
-    return staged_final_exp_is_one(staged_miller(px, py, q, degenerate))
+    tm = jax.tree_util.tree_map
+    batch = jax.tree_util.tree_leaves(px)[0].shape[1]
+    bucket = lane_bucket(batch)
+    if bucket != batch:
+        pad = lambda a: pad_axis(a, 1, bucket - batch)
+        px, py, q = tm(pad, px), tm(pad, py), tm(pad, q)
+        degenerate = pad_axis(degenerate, 1, bucket - batch, fill=True)
+    out = staged_final_exp_is_one(staged_miller(px, py, q, degenerate))
+    return out[:batch]
