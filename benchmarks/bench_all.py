@@ -5,7 +5,7 @@
 line per config.  Python baselines are warmed and repeated (VERDICT r2
 methodology fix).
 
-Usage: python benchmarks/bench_all.py [--configs 1,2,3,5] [--validators N]
+Usage: python benchmarks/bench_all.py [--configs 1,2,3,4,5] [--validators N]
 """
 import argparse
 import json
@@ -179,17 +179,52 @@ def bench_epoch_replay(n_validators=4096, slots=8):
             "value": round(dt, 3), "unit": "s/epoch", "vs_baseline": 1.0}
 
 
+def bench_blob_batch(n_blobs=6):
+    """Config #4: deneb ``verify_blob_kzg_proof_batch`` over 6 blobs
+    (mainnet setup) vs serial per-blob verification.  The batch path is
+    the spec's random-linear-combination optimization - two MSMs and ONE
+    pairing check for the whole batch vs one pairing per blob
+    (``specs/deneb/polynomial-commitments.md`` verify_blob_kzg_proof_batch)."""
+    import random as _random
+    from consensus_specs_tpu.ops import kzg as K
+
+    setup = K.trusted_setup("mainnet")
+    width = setup.FIELD_ELEMENTS_PER_BLOB
+    rng = _random.Random(4)
+    blobs = [b"".join((rng.randrange(K.BLS_MODULUS)).to_bytes(32, "big")
+                      for _ in range(width)) for _ in range(n_blobs)]
+    commitments = [K.blob_to_kzg_commitment(b, setup) for b in blobs]
+    proofs = [K.compute_blob_kzg_proof(b, c, setup)
+              for b, c in zip(blobs, commitments)]
+
+    def serial():
+        assert all(K.verify_blob_kzg_proof(b, c, p, setup)
+                   for b, c, p in zip(blobs, commitments, proofs))
+
+    def batched():
+        assert K.verify_blob_kzg_proof_batch(
+            blobs, commitments, proofs, setup)
+
+    serial_dt = _timeit(serial, reps=2, warmup=1)
+    batch_dt = _timeit(batched, reps=2, warmup=1)
+    return {"metric": f"verify_blob_kzg_proof_batch ({n_blobs} blobs, "
+                      "mainnet)",
+            "value": round(batch_dt, 3), "unit": "s/batch",
+            "vs_baseline": round(serial_dt / batch_dt, 2)}
+
+
 CONFIGS = {
     "1": bench_fast_aggregate_verify,
     "2": bench_process_block,
     "3": bench_sync_aggregate,
+    "4": bench_blob_batch,
     "5": bench_epoch_replay,
 }
 
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("--configs", default="1,2,3,5")
+    parser.add_argument("--configs", default="1,2,3,4,5")
     ns = parser.parse_args()
     for key in ns.configs.split(","):
         result = CONFIGS[key.strip()]()
