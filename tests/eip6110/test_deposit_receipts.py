@@ -91,3 +91,51 @@ def test_legacy_deposit_channel_winds_down(spec, state):
     # limit = min(count, start=0) = 0 -> must carry zero deposits; a body
     # with any deposits is invalid, and the empty body passes
     spec.process_operations(state2, spec.BeaconBlockBody())
+
+
+@with_phases(["eip6110"])
+@spec_state_test
+def test_receipts_processed_in_payload_order(spec, state):
+    """process_operations consumes every payload receipt in order: a new
+    validator followed by an immediate top-up of the same key."""
+    pre_count = len(state.validators)
+    new_index = pre_count
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    top_up = spec.EFFECTIVE_BALANCE_INCREMENT
+    body = spec.BeaconBlockBody()
+    body.execution_payload.deposit_receipts = type(
+        body.execution_payload.deposit_receipts)(
+        _receipt(spec, new_index, amount, index=11),
+        _receipt(spec, new_index, top_up, index=12),
+    )
+    spec.process_operations(state, body)
+    assert len(state.validators) == pre_count + 1
+    assert state.balances[new_index] == amount + top_up
+    # the FIRST receipt pinned the start index; the second left it alone
+    assert state.deposit_receipts_start_index == 11
+
+
+@with_phases(["eip6110"])
+@spec_state_test
+def test_receipt_effective_balance_capped(spec, state):
+    """A deposit above MAX_EFFECTIVE_BALANCE credits the full amount but
+    caps the validator's effective balance (apply_deposit ->
+    add_validator_to_registry semantics)."""
+    new_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE * 2
+    spec.process_deposit_receipt(
+        state, _receipt(spec, new_index, amount, index=0))
+    assert state.balances[new_index] == amount
+    assert state.validators[new_index].effective_balance == \
+        spec.MAX_EFFECTIVE_BALANCE
+
+
+@with_phases(["eip6110"])
+@spec_state_test
+def test_top_up_leaves_effective_balance_until_epoch(spec, state):
+    """A top-up raises the balance immediately; the effective balance
+    only moves at the epoch-processing hysteresis update."""
+    pre_effective = state.validators[0].effective_balance
+    spec.process_deposit_receipt(
+        state, _receipt(spec, 0, spec.EFFECTIVE_BALANCE_INCREMENT, index=2))
+    assert state.validators[0].effective_balance == pre_effective

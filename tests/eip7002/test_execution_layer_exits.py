@@ -89,3 +89,54 @@ def test_exit_already_initiated_noop(spec, state):
         validator_pubkey=state.validators[index].pubkey)
     spec.process_execution_layer_exit(state, exit_op)
     assert state.validators[index].exit_epoch == first_exit_epoch
+
+
+@with_phases(["eip7002"])
+@spec_state_test
+def test_exit_unknown_pubkey_invalid(spec, state):
+    """A request naming a pubkey outside the registry invalidates the
+    block (the registry lookup raises), unlike the credential no-ops."""
+    from consensus_specs_tpu.test_infra.keys import pubkeys
+    exit_op = spec.ExecutionLayerExit(
+        source_address=b"\x42" * 20,
+        validator_pubkey=pubkeys[len(state.validators) + 5])
+    try:
+        spec.process_execution_layer_exit(state, exit_op)
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("unknown pubkey must invalidate the block")
+
+
+@with_phases(["eip7002"])
+@spec_state_test
+def test_exit_second_request_noop(spec, state):
+    """A second request for an already-exiting validator changes nothing
+    (exit_epoch pinned by the first)."""
+    index = 0
+    address = _set_eth1_credentials(spec, state, index)
+    _age_validator(spec, state, index)
+    exit_op = spec.ExecutionLayerExit(
+        source_address=address,
+        validator_pubkey=state.validators[index].pubkey)
+    spec.process_execution_layer_exit(state, exit_op)
+    first_exit_epoch = state.validators[index].exit_epoch
+    assert first_exit_epoch < spec.FAR_FUTURE_EPOCH
+    spec.process_execution_layer_exit(state, exit_op)
+    assert state.validators[index].exit_epoch == first_exit_epoch
+
+
+@with_phases(["eip7002"])
+@spec_state_test
+def test_exit_sets_withdrawable_epoch(spec, state):
+    """initiate_validator_exit pins withdrawable_epoch = exit_epoch +
+    MIN_VALIDATOR_WITHDRAWABILITY_DELAY (phase0 semantics carried over)."""
+    index = 0
+    address = _set_eth1_credentials(spec, state, index)
+    _age_validator(spec, state, index)
+    spec.process_execution_layer_exit(state, spec.ExecutionLayerExit(
+        source_address=address,
+        validator_pubkey=state.validators[index].pubkey))
+    v = state.validators[index]
+    assert v.withdrawable_epoch == \
+        v.exit_epoch + spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
