@@ -107,3 +107,32 @@ def test_transition_pre_spec_rejects_post_block(state, fork_epoch, spec,
         spec.state_transition(replay_state, pre_block)
     expect_assertion_error(replay)
     yield
+
+
+@with_fork_metas(_METAS)
+def test_transition_attestation_from_pre_fork_included_after(
+        state, fork_epoch, spec, post_spec):
+    """An attestation produced under the PRE-fork spec rides a POST-fork
+    block: the wire container is fork-stable and the post spec credits
+    it (participation flags post-altair, pending attestations in
+    phase0-shaped forks) - the reference's transition suites include
+    pre-fork operations the same way."""
+    from consensus_specs_tpu.test_infra.attestations import (
+        get_valid_attestation)
+    from consensus_specs_tpu.test_infra.block import (
+        build_empty_block_for_next_slot, state_transition_and_sign_block)
+
+    yield "pre", state
+    blocks = state_transition_across_slots(
+        spec, state, fork_epoch * spec.SLOTS_PER_EPOCH - 1)
+    att = get_valid_attestation(spec, state, slot=state.slot, signed=True)
+    state, fork_block = do_fork(state, spec, post_spec, fork_epoch)
+    blocks.append(fork_block)
+    yield "fork_block", len(blocks) - 1
+
+    block = build_empty_block_for_next_slot(post_spec, state)
+    block.body.attestations = type(block.body.attestations)(att)
+    blocks.append(state_transition_and_sign_block(post_spec, state, block))
+
+    assert int(state.slot) == fork_epoch * spec.SLOTS_PER_EPOCH + 1
+    yield from _finish(post_spec, fork_epoch, blocks, state)
