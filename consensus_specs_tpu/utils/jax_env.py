@@ -103,6 +103,32 @@ def force_cpu_platform() -> None:
                     "may still be active")
 
 
+def process_age_s() -> float:
+    """Seconds since THIS process exec'd - including time burned in
+    sitecustomize/.pth hooks BEFORE any script code ran.
+
+    The container's accelerator plugin registers itself at interpreter
+    start; with a flaky tunnel that registration has been observed to
+    stall for minutes.  A driver wraps entry points in its own external
+    timeout that started at exec, so budget-bound code must subtract
+    this overhead or it overshoots the driver's window exactly when the
+    tunnel is sick (the round-3 rc=124 shape).
+    """
+    try:
+        with open("/proc/self/stat") as f:
+            stat = f.read()
+        # comm may contain spaces; fields resume after the last ')'
+        fields = stat[stat.rindex(")") + 2:].split()
+        start_ticks = int(fields[19])            # field 22 overall
+        hz = os.sysconf("SC_CLK_TCK")
+        with open("/proc/uptime") as f:
+            uptime = float(f.read().split()[0])
+        age = uptime - start_ticks / hz
+        return max(0.0, age)
+    except Exception:
+        return 0.0
+
+
 def cpu_subprocess_env(base=None) -> dict:
     """Environment for a CPU-only child process that must NEVER touch the
     accelerator tunnel.
