@@ -87,3 +87,27 @@ def test_cell_proofs_verify_through_spec_surface(spec, state):
     assert not spec.verify_cell_proof(commitment, wrong, cell_bytes[cid],
                                       proofs[cid])
     yield  # part-less
+
+
+@with_phases(["eip7594"])
+@spec_state_test
+def test_is_data_available_fallback_and_stub_precedence(spec, state):
+    """Without a cell-retrieval stub the deneb full-blob path answers;
+    a harness-provided ``retrieve_cells_and_proofs`` takes precedence
+    (fork-choice stubbing pattern, deneb fork-choice.md:70)."""
+    root = b"\x07" * 32
+    # no commitments: both paths are trivially available
+    assert spec.is_data_available(root, [])
+
+    calls = []
+
+    def fake_retrieve(block_root):
+        calls.append(block_root)
+        return []
+
+    spec.retrieve_cells_and_proofs = fake_retrieve
+    try:
+        assert spec.is_data_available(root, [])
+        assert calls == [root], "cell stub must take precedence"
+    finally:
+        del spec.retrieve_cells_and_proofs
