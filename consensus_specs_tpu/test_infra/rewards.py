@@ -83,6 +83,15 @@ def run_get_inclusion_delay_deltas(spec, state):
     assert all(p == 0 for p in penalties)
 
 
+def _altair_inactivity_quotient(spec):
+    """Fork-graduated quotient (altair beacon-chain.md Modified
+    get_inactivity_penalty_deltas; bellatrix raises the quotient)."""
+    if hasattr(spec, "INACTIVITY_PENALTY_QUOTIENT_BELLATRIX") \
+            and spec.fork not in ("phase0", "altair"):
+        return spec.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX
+    return spec.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+
+
 def run_get_inactivity_penalty_deltas(spec, state):
     rewards, penalties = spec.get_inactivity_penalty_deltas(state)
     yield "inactivity_penalty_deltas", {
@@ -90,11 +99,24 @@ def run_get_inactivity_penalty_deltas(spec, state):
         "penalties": _deltas_list(spec, penalties)}
     # inactivity never rewards
     assert all(r == 0 for r in rewards)
-    if not spec.is_in_inactivity_leak(state):
-        if spec.fork == "phase0":
-            # outside a leak, phase0 still charges the base-reward offset
-            return
-        assert all(p == 0 for p in penalties)
+    if spec.fork == "phase0":
+        # outside a leak, phase0 still charges the base-reward offset;
+        # its exact deltas are covered by the phase0 rewards suite
+        return
+    # altair+: the penalty tracks the inactivity SCORE whether or not a
+    # leak is on; target participants and ineligible indices pay nothing
+    matching = spec.get_unslashed_participating_indices(
+        state, spec.TIMELY_TARGET_FLAG_INDEX, spec.get_previous_epoch(state))
+    eligible = set(spec.get_eligible_validator_indices(state))
+    denominator = (spec.config.INACTIVITY_SCORE_BIAS
+                   * _altair_inactivity_quotient(spec))
+    for index in range(len(state.validators)):
+        if index not in eligible or index in matching:
+            assert penalties[index] == 0
+        else:
+            expected = (state.validators[index].effective_balance
+                        * state.inactivity_scores[index]) // denominator
+            assert penalties[index] == expected
 
 
 # ---------------------------------------------------------------------------

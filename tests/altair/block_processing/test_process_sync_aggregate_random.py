@@ -1,0 +1,231 @@
+"""Randomized sync-aggregate participation coverage.
+
+Reference model:
+``test/altair/block_processing/sync_aggregate/test_process_sync_aggregate_random.py``
+(12 cases: participation fractions x {duplicate,nonduplicate} committee
+membership, misc balances, exited members) against
+``specs/altair/beacon-chain.md`` ``process_sync_aggregate``.
+
+The "_with_duplicates" variants pin the registry to HALF the sync
+committee size, so ``get_next_sync_committee_indices`` must wrap its
+candidate walk and every member holds multiple committee positions —
+exercising the repeated reward/penalty application path. The
+"_without_duplicates" variants run on the default 64-validator registry,
+whose 32 accepted draws are distinct.
+"""
+from random import Random
+
+from consensus_specs_tpu.test_infra.context import (
+    spec_test, spec_state_test, with_phases, with_all_phases_from,
+    with_custom_state, single_phase, misc_balances,
+    default_activation_threshold,
+)
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot, next_epoch,
+)
+from consensus_specs_tpu.test_infra.sync_committee import (
+    compute_aggregate_sync_committee_signature, compute_committee_indices,
+    run_sync_committee_processing,
+)
+
+with_altair_and_later = with_all_phases_from("altair")
+ALTAIR_ONLY = with_phases(["altair"])
+
+
+def half_committee_balances(spec):
+    """Registry of SYNC_COMMITTEE_SIZE // 2 validators: the committee
+    draw must wrap, so every member appears at least twice."""
+    return [spec.MAX_EFFECTIVE_BALANCE] * (int(spec.SYNC_COMMITTEE_SIZE) // 2)
+
+
+def _run_random_participation(spec, state, fraction, rng,
+                              exit_some=False, expect_duplicates=False):
+    committee_indices = compute_committee_indices(state)
+    size = len(committee_indices)
+    if expect_duplicates:
+        assert len(set(committee_indices)) < size, \
+            "fixture must produce duplicate committee membership"
+    if exit_some:
+        # initiate exits for a few members; they still serve the current
+        # period and their signatures still count
+        for index in set(committee_indices[:max(1, size // 8)]):
+            spec.initiate_validator_exit(state, spec.ValidatorIndex(index))
+    selected = set(rng.sample(range(size), int(size * fraction)))
+    bits = [i in selected for i in range(size)]
+    participants = [committee_indices[i] for i in range(size) if bits[i]]
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, participants),
+    )
+    spec.process_slots(state, block.slot)
+
+    # per-validator position counts: rewards/penalties apply once PER
+    # POSITION, so a duplicated member's net delta follows the sign of
+    # (participating positions - absent positions)
+    from collections import Counter
+    pos_participating = Counter(committee_indices[i]
+                                for i in range(size) if bits[i])
+    pos_absent = Counter(committee_indices[i]
+                         for i in range(size) if not bits[i])
+    balances_pre = {i: int(state.balances[i]) for i in committee_indices}
+    proposer = spec.get_beacon_proposer_index(state)
+    yield from run_sync_committee_processing(spec, state, block)
+    for index in set(committee_indices):
+        if index == proposer:
+            continue  # proposer gains its cut on top of its slot deltas
+        delta = int(state.balances[index]) - balances_pre[index]
+        net_positions = pos_participating[index] - pos_absent[index]
+        if net_positions > 0:
+            assert delta >= 0
+        elif net_positions < 0:
+            assert delta <= 0
+        else:
+            assert delta == 0  # equal rewards and penalties cancel
+
+
+def _distinct_only_bits(spec, state, rng, fraction):
+    """Participation over the DISTINCT committee members only."""
+    committee_indices = compute_committee_indices(state)
+    distinct = sorted(set(committee_indices))
+    chosen = set(rng.sample(distinct, int(len(distinct) * fraction)))
+    bits = [committee_indices[i] in chosen
+            for i in range(len(committee_indices))]
+    participants = [committee_indices[i]
+                    for i in range(len(committee_indices)) if bits[i]]
+    return bits, participants
+
+
+def _run_distinct_participation(spec, state, fraction, rng):
+    bits, participants = _distinct_only_bits(spec, state, rng, fraction)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, participants),
+    )
+    spec.process_slots(state, block.slot)
+    yield from run_sync_committee_processing(spec, state, block)
+
+
+# -- with duplicates (registry smaller than the committee) ------------------
+
+@with_altair_and_later
+@with_custom_state(half_committee_balances, default_activation_threshold)
+@single_phase
+@spec_test
+def test_random_only_one_participant_with_duplicates(spec, state):
+    committee_indices = compute_committee_indices(state)
+    yield from _run_random_participation(
+        spec, state, 1 / len(committee_indices), Random(101),
+        expect_duplicates=True)
+
+
+@with_altair_and_later
+@with_custom_state(half_committee_balances, default_activation_threshold)
+@single_phase
+@spec_test
+def test_random_low_participation_with_duplicates(spec, state):
+    yield from _run_random_participation(spec, state, 0.25, Random(201),
+                                         expect_duplicates=True)
+
+
+@with_altair_and_later
+@with_custom_state(half_committee_balances, default_activation_threshold)
+@single_phase
+@spec_test
+def test_random_high_participation_with_duplicates(spec, state):
+    yield from _run_random_participation(spec, state, 0.75, Random(301),
+                                         expect_duplicates=True)
+
+
+@with_altair_and_later
+@with_custom_state(half_committee_balances, default_activation_threshold)
+@single_phase
+@spec_test
+def test_random_all_but_one_participating_with_duplicates(spec, state):
+    committee_indices = compute_committee_indices(state)
+    size = len(committee_indices)
+    yield from _run_random_participation(
+        spec, state, (size - 1) / size, Random(401),
+        expect_duplicates=True)
+
+
+@ALTAIR_ONLY
+@with_custom_state(half_committee_balances, default_activation_threshold)
+@single_phase
+@spec_test
+def test_random_misc_balances_and_half_participation_with_duplicates(
+        spec, state):
+    # vary effective balances across the small registry too
+    rng = Random(511)
+    for i in range(len(state.validators)):
+        bal = spec.MAX_EFFECTIVE_BALANCE - rng.randrange(2) \
+            * spec.EFFECTIVE_BALANCE_INCREMENT
+        state.validators[i].effective_balance = bal
+    yield from _run_random_participation(spec, state, 0.5, Random(501),
+                                         expect_duplicates=True)
+
+
+@ALTAIR_ONLY
+@with_custom_state(half_committee_balances, default_activation_threshold)
+@single_phase
+@spec_test
+def test_random_with_exits_with_duplicates(spec, state):
+    next_epoch(spec, state)
+    yield from _run_random_participation(spec, state, 0.5, Random(601),
+                                         exit_some=True,
+                                         expect_duplicates=True)
+
+
+# -- without duplicates (distinct-member subset) ----------------------------
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_only_one_participant_without_duplicates(spec, state):
+    committee_indices = compute_committee_indices(state)
+    distinct = len(set(committee_indices))
+    yield from _run_distinct_participation(
+        spec, state, 1 / distinct, Random(701))
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_low_participation_without_duplicates(spec, state):
+    yield from _run_distinct_participation(spec, state, 0.25, Random(801))
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_high_participation_without_duplicates(spec, state):
+    yield from _run_distinct_participation(spec, state, 0.75, Random(901))
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_all_but_one_participating_without_duplicates(spec, state):
+    committee_indices = compute_committee_indices(state)
+    distinct = len(set(committee_indices))
+    yield from _run_distinct_participation(
+        spec, state, (distinct - 1) / distinct, Random(1001))
+
+
+@ALTAIR_ONLY
+@with_custom_state(misc_balances, default_activation_threshold)
+@single_phase
+@spec_test
+def test_random_misc_balances_and_half_participation_without_duplicates(
+        spec, state):
+    yield from _run_distinct_participation(spec, state, 0.5, Random(1101))
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_with_exits_without_duplicates(spec, state):
+    next_epoch(spec, state)
+    committee_indices = compute_committee_indices(state)
+    for index in sorted(set(committee_indices))[:2]:
+        spec.initiate_validator_exit(state, spec.ValidatorIndex(index))
+    yield from _run_distinct_participation(spec, state, 0.5, Random(1201))

@@ -1,0 +1,335 @@
+"""``process_inactivity_updates`` boundary coverage.
+
+Reference model:
+``test/altair/epoch_processing/test_process_inactivity_updates.py``
+(21 cases: genesis short-circuit; {zero,random} pre-scores x
+{empty,random,full} previous-target participation x {leaking,not};
+slashed-validator variants) against
+``specs/altair/beacon-chain.md`` New ``process_inactivity_updates``.
+"""
+from random import Random
+
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, with_all_phases_from,
+)
+from consensus_specs_tpu.test_infra.epoch_processing import (
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.test_infra.block import next_epoch
+from consensus_specs_tpu.test_infra.rewards import set_state_in_leak
+
+with_altair_and_later = with_all_phases_from("altair")
+ALTAIR_ONLY = with_phases(["altair"])
+
+
+def _set_previous_target_participation(spec, state, selector):
+    """selector(index) -> bool decides previous-epoch target participation."""
+    for i in range(len(state.validators)):
+        flag = spec.ParticipationFlags(0)
+        if selector(i):
+            flag = spec.add_flag(flag, spec.TIMELY_TARGET_FLAG_INDEX)
+        state.previous_epoch_participation[i] = flag
+
+
+def _expected_scores(spec, state):
+    """Independent re-derivation of the spec update rule."""
+    participating = spec.get_unslashed_participating_indices(
+        state, spec.TIMELY_TARGET_FLAG_INDEX, spec.get_previous_epoch(state))
+    eligible = set(spec.get_eligible_validator_indices(state))
+    leaking = spec.is_in_inactivity_leak(state)
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    recovery = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
+    out = []
+    for i, score in enumerate(state.inactivity_scores):
+        score = int(score)
+        if i in eligible:
+            if i in participating:
+                score -= min(1, score)
+            else:
+                score += bias
+            if not leaking:
+                score -= min(recovery, score)
+        out.append(score)
+    return out
+
+
+def _run_inactivity_scores_test(spec, state, selector,
+                                scores_fn=None):
+    # two epochs in so previous-epoch accounting is live
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    if scores_fn is not None:
+        for i in range(len(state.validators)):
+            state.inactivity_scores[i] = scores_fn(i)
+    _set_previous_target_participation(spec, state, selector)
+    expected = _expected_scores(spec, state)
+    yield from run_epoch_processing_with(
+        spec, state, "process_inactivity_updates")
+    assert [int(s) for s in state.inactivity_scores] == expected
+
+
+def _run_leaking_inactivity_scores_test(spec, state, selector,
+                                        scores_fn=None):
+    set_state_in_leak(spec, state)
+    if scores_fn is not None:
+        for i in range(len(state.validators)):
+            state.inactivity_scores[i] = scores_fn(i)
+    _set_previous_target_participation(spec, state, selector)
+    expected = _expected_scores(spec, state)
+    yield from run_epoch_processing_with(
+        spec, state, "process_inactivity_updates")
+    assert [int(s) for s in state.inactivity_scores] == expected
+
+
+def _random_scores(rng, ceiling=100):
+    return lambda i, r=rng: r.randrange(ceiling)
+
+
+def _random_selector(rng, fraction=0.5):
+    return lambda i, r=rng: r.random() < fraction
+
+
+# -- genesis short-circuit ---------------------------------------------------
+
+@with_altair_and_later
+@spec_state_test
+def test_genesis(spec, state):
+    """At GENESIS_EPOCH the stage is a no-op regardless of participation."""
+    assert spec.get_current_epoch(state) == spec.GENESIS_EPOCH
+    _set_previous_target_participation(spec, state, lambda i: False)
+    yield from run_epoch_processing_with(
+        spec, state, "process_inactivity_updates")
+    assert all(int(s) == 0 for s in state.inactivity_scores)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_genesis_random_scores(spec, state):
+    assert spec.get_current_epoch(state) == spec.GENESIS_EPOCH
+    rng = Random(10102)
+    pre = [rng.randrange(100) for _ in range(len(state.validators))]
+    for i, s in enumerate(pre):
+        state.inactivity_scores[i] = s
+    yield from run_epoch_processing_with(
+        spec, state, "process_inactivity_updates")
+    # untouched: the genesis short-circuit fires before any mutation
+    assert [int(s) for s in state.inactivity_scores] == pre
+
+
+# -- all-zero pre-scores -----------------------------------------------------
+
+@with_altair_and_later
+@spec_state_test
+def test_all_zero_inactivity_scores_empty_participation(spec, state):
+    yield from _run_inactivity_scores_test(
+        spec, state, lambda i: False, scores_fn=lambda i: 0)
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_all_zero_inactivity_scores_empty_participation_leaking(spec, state):
+    yield from _run_leaking_inactivity_scores_test(
+        spec, state, lambda i: False, scores_fn=lambda i: 0)
+    # absent while leaking: every eligible score grew by exactly BIAS
+    eligible = set(spec.get_eligible_validator_indices(state))
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    assert all(int(state.inactivity_scores[i]) == bias for i in eligible)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_all_zero_inactivity_scores_random_participation(spec, state):
+    yield from _run_inactivity_scores_test(
+        spec, state, _random_selector(Random(5555)), scores_fn=lambda i: 0)
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_all_zero_inactivity_scores_random_participation_leaking(spec, state):
+    yield from _run_leaking_inactivity_scores_test(
+        spec, state, _random_selector(Random(5565)), scores_fn=lambda i: 0)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_all_zero_inactivity_scores_full_participation(spec, state):
+    yield from _run_inactivity_scores_test(
+        spec, state, lambda i: True, scores_fn=lambda i: 0)
+    assert all(int(s) == 0 for s in state.inactivity_scores)
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_all_zero_inactivity_scores_full_participation_leaking(spec, state):
+    yield from _run_leaking_inactivity_scores_test(
+        spec, state, lambda i: True, scores_fn=lambda i: 0)
+    # participating with zero score: stays zero even while leaking
+    assert all(int(s) == 0 for s in state.inactivity_scores)
+
+
+# -- random pre-scores -------------------------------------------------------
+
+@with_altair_and_later
+@spec_state_test
+def test_random_inactivity_scores_empty_participation(spec, state):
+    yield from _run_inactivity_scores_test(
+        spec, state, lambda i: False, _random_scores(Random(9999)))
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_inactivity_scores_empty_participation_leaking(spec, state):
+    yield from _run_leaking_inactivity_scores_test(
+        spec, state, lambda i: False, _random_scores(Random(9989)))
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_inactivity_scores_random_participation(spec, state):
+    yield from _run_inactivity_scores_test(
+        spec, state, _random_selector(Random(22222)),
+        _random_scores(Random(22)))
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_inactivity_scores_random_participation_leaking(spec, state):
+    yield from _run_leaking_inactivity_scores_test(
+        spec, state, _random_selector(Random(22322)),
+        _random_scores(Random(23)))
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_inactivity_scores_full_participation(spec, state):
+    yield from _run_inactivity_scores_test(
+        spec, state, lambda i: True, _random_scores(Random(33333)))
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_inactivity_scores_full_participation_leaking(spec, state):
+    yield from _run_leaking_inactivity_scores_test(
+        spec, state, lambda i: True, _random_scores(Random(33433)))
+    # leaking but participating: each score only ever decremented by 1
+    # (no recovery subtraction fires during a leak)
+
+
+# -- slashed-validator variants ---------------------------------------------
+
+def _slash_some(spec, state, rng=None):
+    """Slash a handful of validators; they are excluded from
+    'unslashed participating' regardless of their flags."""
+    rng = rng or Random(40404)
+    count = max(1, len(state.validators) // 8)
+    slashed = rng.sample(range(len(state.validators)), count)
+    for index in slashed:
+        spec.slash_validator(state, spec.ValidatorIndex(index))
+    return slashed
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_some_slashed_zero_scores_full_participation(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    slashed = _slash_some(spec, state)
+    for i in range(len(state.validators)):
+        state.inactivity_scores[i] = 0
+    _set_previous_target_participation(spec, state, lambda i: True)
+    expected = _expected_scores(spec, state)
+    yield from run_epoch_processing_with(
+        spec, state, "process_inactivity_updates")
+    assert [int(s) for s in state.inactivity_scores] == expected
+    # slashed validators count as absent: their score grew
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    recovery = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
+    grown = max(0, bias - recovery)
+    for i in slashed:
+        assert int(state.inactivity_scores[i]) == grown
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_some_slashed_zero_scores_full_participation_leaking(spec, state):
+    set_state_in_leak(spec, state)
+    slashed = _slash_some(spec, state, Random(40414))
+    for i in range(len(state.validators)):
+        state.inactivity_scores[i] = 0
+    _set_previous_target_participation(spec, state, lambda i: True)
+    expected = _expected_scores(spec, state)
+    yield from run_epoch_processing_with(
+        spec, state, "process_inactivity_updates")
+    assert [int(s) for s in state.inactivity_scores] == expected
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    for i in slashed:
+        # slashed + leaking: full BIAS growth, no recovery
+        assert int(state.inactivity_scores[i]) == bias
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_some_slashed_random_scores_random_participation(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    _slash_some(spec, state, Random(40424))
+    rng = Random(40434)
+    for i in range(len(state.validators)):
+        state.inactivity_scores[i] = rng.randrange(100)
+    _set_previous_target_participation(spec, state,
+                                       _random_selector(Random(40444)))
+    expected = _expected_scores(spec, state)
+    yield from run_epoch_processing_with(
+        spec, state, "process_inactivity_updates")
+    assert [int(s) for s in state.inactivity_scores] == expected
+
+
+# -- boundary values ---------------------------------------------------------
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_score_at_exactly_recovery_rate(spec, state):
+    """score == RECOVERY_RATE drains to zero in one participating epoch."""
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    rate = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
+    for i in range(len(state.validators)):
+        # +1 first cancels the participation decrement
+        state.inactivity_scores[i] = rate + 1
+    _set_previous_target_participation(spec, state, lambda i: True)
+    yield from run_epoch_processing_with(
+        spec, state, "process_inactivity_updates")
+    eligible = set(spec.get_eligible_validator_indices(state))
+    assert all(int(state.inactivity_scores[i]) == 0 for i in eligible)
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_score_one_above_full_recovery(spec, state):
+    """score = RECOVERY + 2 participating: floor at 1 above the drain."""
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    rate = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
+    for i in range(len(state.validators)):
+        state.inactivity_scores[i] = rate + 2
+    _set_previous_target_participation(spec, state, lambda i: True)
+    yield from run_epoch_processing_with(
+        spec, state, "process_inactivity_updates")
+    eligible = set(spec.get_eligible_validator_indices(state))
+    assert all(int(state.inactivity_scores[i]) == 1 for i in eligible)
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_score_never_negative(spec, state):
+    """min() clamps stop the unsigned scores underflowing at 0/1."""
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    for i in range(len(state.validators)):
+        state.inactivity_scores[i] = i % 2  # zeros and ones
+    _set_previous_target_participation(spec, state, lambda i: True)
+    yield from run_epoch_processing_with(
+        spec, state, "process_inactivity_updates")
+    assert all(int(s) >= 0 for s in state.inactivity_scores)
+    eligible = set(spec.get_eligible_validator_indices(state))
+    assert all(int(state.inactivity_scores[i]) == 0 for i in eligible)

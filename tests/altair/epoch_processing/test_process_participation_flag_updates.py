@@ -1,0 +1,147 @@
+"""``process_participation_flag_updates`` rotation coverage.
+
+Reference model:
+``test/altair/epoch_processing/test_process_participation_flag_updates.py``
+(12 cases: zeroed/filled/one-side-filled/random patterns) against
+``specs/altair/beacon-chain.md`` New ``process_participation_flag_updates``:
+current flags rotate into previous, current resets to zero.
+"""
+from random import Random
+
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, with_all_phases_from,
+)
+from consensus_specs_tpu.test_infra.epoch_processing import (
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.test_infra.block import next_epoch
+
+with_altair_and_later = with_all_phases_from("altair")
+ALTAIR_ONLY = with_phases(["altair"])
+
+_FULL_FLAGS = 0b111  # all three timely flags set
+
+
+def _set_flags(spec, state, current_fn, previous_fn):
+    for i in range(len(state.validators)):
+        state.current_epoch_participation[i] = \
+            spec.ParticipationFlags(current_fn(i))
+        state.previous_epoch_participation[i] = \
+            spec.ParticipationFlags(previous_fn(i))
+
+
+def _run_rotation(spec, state):
+    pre_current = [int(f) for f in state.current_epoch_participation]
+    yield from run_epoch_processing_with(
+        spec, state, "process_participation_flag_updates")
+    # previous := old current; current := all-zero, same length
+    assert [int(f) for f in state.previous_epoch_participation] == pre_current
+    assert all(int(f) == 0 for f in state.current_epoch_participation)
+    assert len(state.current_epoch_participation) == len(state.validators)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_all_zeroed(spec, state):
+    next_epoch(spec, state)
+    _set_flags(spec, state, lambda i: 0, lambda i: 0)
+    yield from _run_rotation(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_filled(spec, state):
+    next_epoch(spec, state)
+    _set_flags(spec, state, lambda i: _FULL_FLAGS, lambda i: _FULL_FLAGS)
+    yield from _run_rotation(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_previous_filled(spec, state):
+    next_epoch(spec, state)
+    _set_flags(spec, state, lambda i: 0, lambda i: _FULL_FLAGS)
+    yield from _run_rotation(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_current_filled(spec, state):
+    next_epoch(spec, state)
+    _set_flags(spec, state, lambda i: _FULL_FLAGS, lambda i: 0)
+    yield from _run_rotation(spec, state)
+
+
+def _random_flags(rng):
+    return lambda i, r=rng: r.randrange(_FULL_FLAGS + 1)
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_0(spec, state):
+    next_epoch(spec, state)
+    _set_flags(spec, state, _random_flags(Random(100)), _random_flags(Random(101)))
+    yield from _run_rotation(spec, state)
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_1(spec, state):
+    next_epoch(spec, state)
+    _set_flags(spec, state, _random_flags(Random(200)), _random_flags(Random(201)))
+    yield from _run_rotation(spec, state)
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_2(spec, state):
+    next_epoch(spec, state)
+    _set_flags(spec, state, _random_flags(Random(300)), _random_flags(Random(301)))
+    yield from _run_rotation(spec, state)
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_genesis(spec, state):
+    # rotation happens at genesis epoch too (no short-circuit here)
+    _set_flags(spec, state, _random_flags(Random(400)), _random_flags(Random(401)))
+    yield from _run_rotation(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_current_epoch_zeroed(spec, state):
+    next_epoch(spec, state)
+    _set_flags(spec, state, lambda i: 0, _random_flags(Random(500)))
+    yield from _run_rotation(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_previous_epoch_zeroed(spec, state):
+    next_epoch(spec, state)
+    _set_flags(spec, state, _random_flags(Random(600)), lambda i: 0)
+    yield from _run_rotation(spec, state)
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_single_flag_patterns(spec, state):
+    """Each validator carries exactly one distinct flag bit."""
+    next_epoch(spec, state)
+    _set_flags(spec, state,
+               lambda i: 1 << (i % 3),
+               lambda i: 1 << ((i + 1) % 3))
+    yield from _run_rotation(spec, state)
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_rotation_is_value_copy_not_alias(spec, state):
+    """Mutating current after rotation must not leak into previous."""
+    next_epoch(spec, state)
+    _set_flags(spec, state, lambda i: _FULL_FLAGS, lambda i: 0)
+    yield from run_epoch_processing_with(
+        spec, state, "process_participation_flag_updates")
+    state.current_epoch_participation[0] = spec.ParticipationFlags(0b010)
+    assert int(state.previous_epoch_participation[0]) == _FULL_FLAGS

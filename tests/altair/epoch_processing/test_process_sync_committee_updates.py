@@ -1,0 +1,92 @@
+"""``process_sync_committee_updates`` period-boundary coverage.
+
+Reference model:
+``test/altair/epoch_processing/test_process_sync_committee_updates.py``
+(5 cases: progress at genesis/non-genesis period boundaries, misc
+balances, no progress off-boundary) against
+``specs/altair/beacon-chain.md`` New ``process_sync_committee_updates``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_test, with_phases, with_all_phases_from, with_custom_state,
+    single_phase, spec_state_test, misc_balances, default_balances,
+    default_activation_threshold,
+)
+from consensus_specs_tpu.test_infra.epoch_processing import (
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.test_infra.block import next_epoch
+
+with_altair_and_later = with_all_phases_from("altair")
+ALTAIR_ONLY = with_phases(["altair"])
+
+
+def _transition_to_period_end(spec, state):
+    """Advance so the NEXT epoch starts a new sync-committee period."""
+    period = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
+    while (spec.get_current_epoch(state) + 1) % period != 0:
+        next_epoch(spec, state)
+
+
+def _run_committees_progress_test(spec, state):
+    pre_next = state.next_sync_committee.copy()
+    yield from run_epoch_processing_with(
+        spec, state, "process_sync_committee_updates")
+    # rotation: next becomes current; a fresh committee is drawn for next
+    assert state.current_sync_committee == pre_next
+    # the new next committee is a valid draw for the upcoming period
+    assert len(state.next_sync_committee.pubkeys) == \
+        spec.SYNC_COMMITTEE_SIZE
+    registry_pubkeys = set(bytes(v.pubkey) for v in state.validators)
+    assert all(bytes(p) in registry_pubkeys
+               for p in state.next_sync_committee.pubkeys)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committees_progress_genesis(spec, state):
+    # genesis sits one epoch before the first period boundary on minimal
+    _transition_to_period_end(spec, state)
+    yield from _run_committees_progress_test(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committees_progress_not_genesis(spec, state):
+    next_epoch(spec, state)
+    _transition_to_period_end(spec, state)
+    yield from _run_committees_progress_test(spec, state)
+
+
+@ALTAIR_ONLY
+@with_custom_state(misc_balances, default_activation_threshold)
+@single_phase
+@spec_test
+def test_sync_committees_progress_misc_balances_genesis(spec, state):
+    _transition_to_period_end(spec, state)
+    yield from _run_committees_progress_test(spec, state)
+
+
+@ALTAIR_ONLY
+@with_custom_state(misc_balances, default_activation_threshold)
+@single_phase
+@spec_test
+def test_sync_committees_progress_misc_balances_not_genesis(spec, state):
+    next_epoch(spec, state)
+    _transition_to_period_end(spec, state)
+    yield from _run_committees_progress_test(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committees_no_progress_not_at_period_boundary(spec, state):
+    period = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
+    assert period > 1, "minimal preset period must exceed one epoch"
+    next_epoch(spec, state)
+    assert (spec.get_current_epoch(state) + 1) % period != 0
+    pre_current = state.current_sync_committee.copy()
+    pre_next = state.next_sync_committee.copy()
+    yield from run_epoch_processing_with(
+        spec, state, "process_sync_committee_updates")
+    # off-boundary: both committees unchanged
+    assert state.current_sync_committee == pre_current
+    assert state.next_sync_committee == pre_next

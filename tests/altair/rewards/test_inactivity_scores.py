@@ -1,0 +1,142 @@
+"""Altair reward deltas under randomized inactivity scores.
+
+Reference model: ``test/altair/rewards/test_inactivity_scores.py``
+(12 cases: random/high/half-zero score distributions x {leaking,not} x
+balance profiles) against ``specs/altair/beacon-chain.md``
+``get_inactivity_penalty_deltas`` / ``get_flag_index_deltas``.
+"""
+from random import Random
+
+from consensus_specs_tpu.test_infra.context import (
+    spec_test, spec_state_test, with_phases, with_all_phases_from,
+    with_custom_state, single_phase, low_balances, misc_balances,
+    default_activation_threshold, zero_activation_threshold,
+)
+from consensus_specs_tpu.test_infra.rewards import (
+    run_deltas, prepare_state_with_attestations, randomize_participation,
+    set_state_in_leak,
+)
+
+ALTAIR_ONLY = with_phases(["altair"])
+with_altair_and_later = with_all_phases_from("altair")
+
+
+def _randomize_scores(spec, state, rng, ceiling=100):
+    for i in range(len(state.validators)):
+        state.inactivity_scores[i] = rng.randrange(ceiling)
+
+
+def _run_with_scores(spec, state, rng, scores_fn, leak=False,
+                     participation_rng=None):
+    if leak:
+        set_state_in_leak(spec, state)
+    scores_fn(spec, state, rng)
+    participation = randomize_participation(
+        participation_rng or Random(rng.randrange(1 << 30)))
+    prepare_state_with_attestations(spec, state,
+                                    participation_fn=participation)
+    yield from run_deltas(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_inactivity_scores_0(spec, state):
+    yield from _run_with_scores(spec, state, Random(9999), _randomize_scores)
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_inactivity_scores_1(spec, state):
+    yield from _run_with_scores(spec, state, Random(10000), _randomize_scores)
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_half_zero_half_random_inactivity_scores(spec, state):
+    def half_zero(spec_, state_, rng):
+        for i in range(len(state_.validators)):
+            state_.inactivity_scores[i] = \
+                rng.randrange(100) if i % 2 else 0
+    yield from _run_with_scores(spec, state, Random(10101), half_zero)
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_high_inactivity_scores(spec, state):
+    def high(spec_, state_, rng):
+        _randomize_scores(spec_, state_, rng, ceiling=100000)
+    yield from _run_with_scores(spec, state, Random(10201), high)
+
+
+@ALTAIR_ONLY
+@with_custom_state(low_balances, zero_activation_threshold)
+@single_phase
+@spec_test
+def test_random_inactivity_scores_low_balances_0(spec, state):
+    yield from _run_with_scores(spec, state, Random(10301), _randomize_scores)
+
+
+@ALTAIR_ONLY
+@with_custom_state(low_balances, zero_activation_threshold)
+@single_phase
+@spec_test
+def test_random_inactivity_scores_low_balances_1(spec, state):
+    yield from _run_with_scores(spec, state, Random(10401), _randomize_scores)
+
+
+@ALTAIR_ONLY
+@with_custom_state(misc_balances, default_activation_threshold)
+@single_phase
+@spec_test
+def test_full_random_misc_balances(spec, state):
+    yield from _run_with_scores(spec, state, Random(10501), _randomize_scores)
+
+
+# -- leaking variants --------------------------------------------------------
+
+@with_altair_and_later
+@spec_state_test
+def test_random_inactivity_scores_leaking_0(spec, state):
+    yield from _run_with_scores(spec, state, Random(11111),
+                                _randomize_scores, leak=True)
+    assert spec.is_in_inactivity_leak(state)
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_inactivity_scores_leaking_1(spec, state):
+    yield from _run_with_scores(spec, state, Random(11211),
+                                _randomize_scores, leak=True)
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_half_zero_half_random_inactivity_scores_leaking(spec, state):
+    def half_zero(spec_, state_, rng):
+        for i in range(len(state_.validators)):
+            state_.inactivity_scores[i] = \
+                rng.randrange(100) if i % 2 else 0
+    yield from _run_with_scores(spec, state, Random(11311), half_zero,
+                                leak=True)
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_high_inactivity_scores_leaking(spec, state):
+    def high(spec_, state_, rng):
+        _randomize_scores(spec_, state_, rng, ceiling=100000)
+    yield from _run_with_scores(spec, state, Random(11411), high, leak=True)
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_high_inactivity_scores_leaking_8_epochs(spec, state):
+    from consensus_specs_tpu.test_infra.block import next_epoch
+
+    def high(spec_, state_, rng):
+        _randomize_scores(spec_, state_, rng, ceiling=100000)
+    set_state_in_leak(spec, state)
+    for _ in range(4):  # deepen the leak well past its onset
+        next_epoch(spec, state)
+    yield from _run_with_scores(spec, state, Random(11511), high)
+    assert spec.is_in_inactivity_leak(state)

@@ -1,0 +1,123 @@
+"""Altair whole-block sanity transitions.
+
+Reference model: ``test/altair/sanity/test_blocks.py`` (8 cases:
+sync-committee participation fractions at genesis/after an epoch,
+inactivity-score evolution under leak with/without participation)
+against ``specs/altair/beacon-chain.md`` ``process_block`` +
+``process_sync_aggregate``.
+"""
+from random import Random
+
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, with_all_phases_from,
+)
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot, next_epoch,
+    state_transition_and_sign_block,
+)
+from consensus_specs_tpu.test_infra.sync_committee import (
+    compute_aggregate_sync_committee_signature, compute_committee_indices,
+)
+from consensus_specs_tpu.test_infra.rewards import set_state_in_leak
+
+with_altair_and_later = with_all_phases_from("altair")
+ALTAIR_ONLY = with_phases(["altair"])
+
+
+def _run_sync_committee_sanity_test(spec, state, fraction_full=1.0,
+                                    rng=Random(454545)):
+    committee_indices = compute_committee_indices(state)
+    size = len(committee_indices)
+    selected = set(rng.sample(range(size), int(size * fraction_full)))
+    bits = [i in selected for i in range(size)]
+    participants = [committee_indices[i] for i in range(size) if bits[i]]
+
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, participants),
+    )
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committee_committee__full(spec, state):
+    next_epoch(spec, state)
+    yield from _run_sync_committee_sanity_test(spec, state, 1.0)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committee_committee__half(spec, state):
+    next_epoch(spec, state)
+    yield from _run_sync_committee_sanity_test(spec, state, 0.5, Random(1212))
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committee_committee__empty(spec, state):
+    next_epoch(spec, state)
+    yield from _run_sync_committee_sanity_test(spec, state, 0.0)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committee_committee_genesis__full(spec, state):
+    yield from _run_sync_committee_sanity_test(spec, state, 1.0)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committee_committee_genesis__half(spec, state):
+    yield from _run_sync_committee_sanity_test(spec, state, 0.5, Random(2323))
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committee_committee_genesis__empty(spec, state):
+    yield from _run_sync_committee_sanity_test(spec, state, 0.0)
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_inactivity_scores_leaking(spec, state):
+    """Empty blocks through a leak: absent validators' scores climb."""
+    set_state_in_leak(spec, state)
+    yield "pre", state
+    blocks = []
+    for _ in range(spec.SLOTS_PER_EPOCH):
+        block = build_empty_block_for_next_slot(spec, state)
+        blocks.append(state_transition_and_sign_block(spec, state, block))
+    yield "blocks", blocks
+    yield "post", state
+    assert spec.is_in_inactivity_leak(state)
+    # nobody attested across the epoch boundary: every active score grew
+    assert all(int(s) > 0 for s in state.inactivity_scores)
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_inactivity_scores_full_participation_leaking(spec, state):
+    """Full previous-target participation during a leak: scores shrink
+    (participation decrement applies; no recovery while leaking)."""
+    set_state_in_leak(spec, state)
+    for i in range(len(state.validators)):
+        state.inactivity_scores[i] = 10
+        state.previous_epoch_participation[i] = spec.add_flag(
+            spec.ParticipationFlags(0), spec.TIMELY_TARGET_FLAG_INDEX)
+        state.current_epoch_participation[i] = spec.add_flag(
+            spec.ParticipationFlags(0), spec.TIMELY_TARGET_FLAG_INDEX)
+    yield "pre", state
+    blocks = []
+    for _ in range(spec.SLOTS_PER_EPOCH):
+        block = build_empty_block_for_next_slot(spec, state)
+        blocks.append(state_transition_and_sign_block(spec, state, block))
+    yield "blocks", blocks
+    yield "post", state
+    # the epoch boundary consumed previous participation: 10 -> 9
+    assert all(int(s) == 9 for s in state.inactivity_scores)
