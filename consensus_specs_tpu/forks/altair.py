@@ -555,6 +555,19 @@ class AltairSpec(SyncDutiesMixin, LightClientMixin, Phase0Spec):
         post.next_sync_committee = self.get_next_sync_committee(post)
         return post
 
+    def initialize_beacon_state_from_eth1(self, eth1_block_hash,
+                                          eth1_timestamp, deposits):
+        """Altair testing variant (``specs/altair/beacon-chain.md``
+        Testing section): genesis at the altair fork version, sync
+        committees pre-filled (current == next at genesis)."""
+        state = super().initialize_beacon_state_from_eth1(
+            eth1_block_hash, eth1_timestamp, deposits)
+        state.fork.previous_version = self.config.ALTAIR_FORK_VERSION
+        state.fork.current_version = self.config.ALTAIR_FORK_VERSION
+        state.current_sync_committee = self.get_next_sync_committee(state)
+        state.next_sync_committee = self.get_next_sync_committee(state)
+        return state
+
     # -- mock genesis hook ---------------------------------------------------
 
     def post_mock_genesis(self, state):

@@ -346,6 +346,23 @@ class BellatrixSpec(OptimisticSyncMixin, AltairSpec):
         )
         return post
 
+    def initialize_beacon_state_from_eth1(self, eth1_block_hash,
+                                          eth1_timestamp, deposits,
+                                          execution_payload_header=None):
+        """Bellatrix testing variant (``specs/bellatrix/beacon-chain.md``
+        Testing section): genesis at the bellatrix fork version; an
+        empty (default) payload header boots a pre-merge chain, a
+        non-empty one starts post-transition."""
+        state = super().initialize_beacon_state_from_eth1(
+            eth1_block_hash, eth1_timestamp, deposits)
+        version = getattr(self.config,
+                          f"{self.fork.upper()}_FORK_VERSION")
+        state.fork.previous_version = version
+        state.fork.current_version = version
+        if execution_payload_header is not None:
+            state.latest_execution_payload_header = execution_payload_header
+        return state
+
     # -- mock genesis hook ---------------------------------------------------
 
     def post_mock_genesis(self, state):

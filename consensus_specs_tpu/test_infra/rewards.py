@@ -85,7 +85,7 @@ def run_get_inclusion_delay_deltas(spec, state):
 
 def _altair_inactivity_quotient(spec):
     """Fork-graduated quotient (altair beacon-chain.md Modified
-    get_inactivity_penalty_deltas; bellatrix raises the quotient)."""
+    get_inactivity_penalty_deltas; bellatrix shrinks the quotient, raising the penalty)."""
     if hasattr(spec, "INACTIVITY_PENALTY_QUOTIENT_BELLATRIX") \
             and spec.fork not in ("phase0", "altair"):
         return spec.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX

@@ -1,0 +1,27 @@
+#!/bin/bash
+# Retry the accelerator bench measurement until one lands.
+#
+# The axon tunnel comes and goes (VERDICT round-4 #1: "try the TPU
+# measurement early and repeatedly in the round").  Each attempt runs
+# bench.py's device role, which records a TPU-platform entry into
+# tools/bench_measurements.json on success so bench.py can serve it even
+# after the tunnel drops again.
+cd "$(dirname "$0")/../.."
+LOG=/tmp/tpu_retry.log
+for attempt in $(seq 1 40); do
+    echo "=== attempt $attempt $(date -u +%H:%M:%S) ===" >> "$LOG"
+    CS_TPU_BENCH_ROLE=device \
+    CS_TPU_REQUIRE_ACCELERATOR=1 \
+    CS_TPU_BLS_FUSE=0 \
+    CS_TPU_BLS_BATCH=16 \
+    CS_TPU_BENCH_INNER_DEADLINE=$(python3 -c 'import time; print(time.time()+2100)') \
+    timeout 2400 python bench.py >> "$LOG" 2>&1
+    rc=$?
+    echo "rc=$rc" >> "$LOG"
+    if [ $rc -eq 0 ] && grep -q '"platform": *"\(axon\|tpu\)' "$LOG"; then
+        echo "TPU MEASUREMENT LANDED" >> "$LOG"
+        exit 0
+    fi
+    sleep 900
+done
+echo "gave up after 40 attempts" >> "$LOG"

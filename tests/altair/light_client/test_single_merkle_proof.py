@@ -1,0 +1,63 @@
+"""Single-merkle-proof vectors for light-client gindices.
+
+Reference model:
+``test/altair/light_client/test_single_merkle_proof.py`` (proofs for
+current/next sync committee and finalized root out of a BeaconState)
+against ``specs/altair/light-client/sync-protocol.md`` constants +
+``ssz/merkle-proofs.md``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases_from,
+)
+from consensus_specs_tpu.utils.ssz import (
+    hash_tree_root, compute_merkle_proof,
+)
+
+with_altair_and_later = with_all_phases_from("altair")
+
+
+def _run_state_proof_test(spec, state, gindex, leaf_root):
+    from consensus_specs_tpu.forks.light_client import floorlog2
+    proof = compute_merkle_proof(state, gindex)
+    yield "object", state
+    yield "proof", {
+        "leaf": "0x" + bytes(leaf_root).hex(),
+        "leaf_index": int(gindex),
+        "branch": ["0x" + bytes(b).hex() for b in proof],
+    }
+    assert len(proof) == floorlog2(gindex)
+    assert spec.is_valid_merkle_branch(
+        leaf=leaf_root, branch=proof, depth=floorlog2(gindex),
+        index=spec.get_subtree_index(gindex), root=hash_tree_root(state))
+    # a flipped sibling must break verification
+    bad = list(proof)
+    bad[0] = spec.Bytes32(bytes(32))
+    if bad[0] == proof[0]:
+        bad[0] = spec.Bytes32(b"\x01" * 32)
+    assert not spec.is_valid_merkle_branch(
+        leaf=leaf_root, branch=bad, depth=floorlog2(gindex),
+        index=spec.get_subtree_index(gindex), root=hash_tree_root(state))
+
+
+@with_altair_and_later
+@spec_state_test
+def test_current_sync_committee_merkle_proof(spec, state):
+    yield from _run_state_proof_test(
+        spec, state, spec.CURRENT_SYNC_COMMITTEE_GINDEX,
+        hash_tree_root(state.current_sync_committee))
+
+
+@with_altair_and_later
+@spec_state_test
+def test_next_sync_committee_merkle_proof(spec, state):
+    yield from _run_state_proof_test(
+        spec, state, spec.NEXT_SYNC_COMMITTEE_GINDEX,
+        hash_tree_root(state.next_sync_committee))
+
+
+@with_altair_and_later
+@spec_state_test
+def test_finality_root_merkle_proof(spec, state):
+    yield from _run_state_proof_test(
+        spec, state, spec.FINALIZED_ROOT_GINDEX,
+        hash_tree_root(state.finalized_checkpoint.root))

@@ -1,0 +1,96 @@
+"""``is_better_update`` total-order coverage.
+
+Reference model:
+``test/altair/light_client/test_update_ranking.py`` (construct updates
+differing in one ranking criterion each, assert the full sort order)
+against ``specs/altair/light-client/sync-protocol.md``
+``is_better_update``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, with_config_overrides, never_bls,
+)
+
+altair_active = with_config_overrides({"ALTAIR_FORK_EPOCH": 0})
+
+
+def _aggregate(spec, num_participants):
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    return spec.SyncAggregate(
+        sync_committee_bits=[i < num_participants for i in range(size)],
+        sync_committee_signature=spec.BLSSignature(b"\x11" * 96),
+    )
+
+
+def _update(spec, state, participants, with_committee=False,
+            with_finality=False, attested_slot=1, signature_slot=2):
+    """A synthetic update; branches are nonzero markers (ranking only
+    inspects emptiness/periods, not proof validity)."""
+    update = spec.LightClientUpdate(
+        sync_aggregate=_aggregate(spec, participants),
+        signature_slot=signature_slot,
+    )
+    update.attested_header.beacon.slot = attested_slot
+    if with_committee:
+        update.next_sync_committee_branch = type(
+            update.next_sync_committee_branch)(
+                [b"\x22" * 32
+                 for _ in range(len(update.next_sync_committee_branch))])
+    if with_finality:
+        update.finality_branch = type(update.finality_branch)(
+            [b"\x33" * 32 for _ in range(len(update.finality_branch))])
+        update.finalized_header.beacon.slot = max(0, attested_slot - 8)
+    return update
+
+
+@with_phases(["altair"])
+@altair_active
+@spec_state_test
+@never_bls
+def test_update_ranking(spec, state):
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    supermajority = size * 2 // 3 + 1
+    minority = size // 3
+    # best -> worst, one ranking rule apart each step
+    ranked = [
+        # supermajority + relevant committee + finality
+        _update(spec, state, size, with_committee=True, with_finality=True),
+        # same but fewer (still supermajority) participants
+        _update(spec, state, supermajority, with_committee=True,
+                with_finality=True),
+        # supermajority + committee, no finality
+        _update(spec, state, supermajority, with_committee=True),
+        # supermajority only
+        _update(spec, state, supermajority),
+        # sub-supermajority: more participants beat fewer
+        _update(spec, state, minority, with_committee=True,
+                with_finality=True),
+        _update(spec, state, minority - 1, with_committee=True,
+                with_finality=True),
+    ]
+    for i, high in enumerate(ranked):
+        for low in ranked[i + 1:]:
+            assert spec.is_better_update(high, low)
+            assert not spec.is_better_update(low, high)
+    yield
+
+
+@with_phases(["altair"])
+@altair_active
+@spec_state_test
+@never_bls
+def test_update_ranking_tiebreakers(spec, state):
+    """Equal on all class rules: earlier attested slot, then earlier
+    signature slot, wins."""
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    older = _update(spec, state, size, attested_slot=1, signature_slot=3)
+    newer = _update(spec, state, size, attested_slot=2, signature_slot=3)
+    assert spec.is_better_update(older, newer)
+    assert not spec.is_better_update(newer, older)
+
+    early_sig = _update(spec, state, size, attested_slot=2,
+                        signature_slot=3)
+    late_sig = _update(spec, state, size, attested_slot=2,
+                       signature_slot=4)
+    assert spec.is_better_update(early_sig, late_sig)
+    assert not spec.is_better_update(late_sig, early_sig)
+    yield

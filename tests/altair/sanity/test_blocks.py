@@ -25,7 +25,8 @@ ALTAIR_ONLY = with_phases(["altair"])
 
 
 def _run_sync_committee_sanity_test(spec, state, fraction_full=1.0,
-                                    rng=Random(454545)):
+                                    rng=None):
+    rng = rng or Random(454545)
     committee_indices = compute_committee_indices(state)
     size = len(committee_indices)
     selected = set(rng.sample(range(size), int(size * fraction_full)))

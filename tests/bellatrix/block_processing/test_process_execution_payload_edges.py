@@ -1,0 +1,269 @@
+"""``process_execution_payload`` first/regular-payload matrix.
+
+Reference model:
+``test/bellatrix/block_processing/test_process_execution_payload.py``
+(26 cases: every validated field wrong on both the merge-transition
+payload and a regular payload; non-validated fields randomized) against
+``specs/bellatrix/beacon-chain.md`` ``process_execution_payload``.
+"""
+from random import Random
+
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases,
+)
+from consensus_specs_tpu.test_infra.block import next_slots
+from consensus_specs_tpu.test_infra.execution_payload import (
+    build_empty_execution_payload, compute_el_block_hash,
+    build_state_with_incomplete_transition,
+    build_state_with_complete_transition,
+)
+
+from tests.bellatrix.block_processing.test_process_execution_payload import (
+    run_execution_payload_processing,
+)
+
+EXECUTION_FORKS = ["bellatrix", "capella", "deneb"]
+BELLATRIX_ONLY = with_phases(["bellatrix"])
+
+
+# -- gap slots ---------------------------------------------------------------
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_success_first_payload_with_gap_slot(spec, state):
+    state = build_state_with_incomplete_transition(spec, state)
+    next_slots(spec, state, 2)
+    payload = build_empty_execution_payload(spec, state)
+    payload.parent_hash = b"\x55" * 32
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_success_regular_payload_with_gap_slot(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    next_slots(spec, state, 2)
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+# -- engine rejection --------------------------------------------------------
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_invalid_bad_execution_first_payload(spec, state):
+    state = build_state_with_incomplete_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.parent_hash = b"\x55" * 32
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(
+        spec, state, payload, valid=False, execution_valid=False)
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_invalid_bad_execution_regular_payload(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_execution_payload_processing(
+        spec, state, payload, valid=False, execution_valid=False)
+
+
+# -- parent-hash handling on the transition payload --------------------------
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_bad_parent_hash_first_payload(spec, state):
+    """Pre-merge, parent_hash is unconstrained: any value is VALID."""
+    state = build_state_with_incomplete_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.parent_hash = spec.Hash32(b"\x55" * 32)
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+# -- bad everything ----------------------------------------------------------
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_invalid_bad_everything_first_payload(spec, state):
+    state = build_state_with_incomplete_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.prev_randao = spec.Bytes32(b"\x01" * 32)
+    payload.timestamp = 0 if int(payload.timestamp) else 1
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload,
+                                                valid=False)
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_invalid_bad_everything_regular_payload(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.parent_hash = spec.Hash32(b"\x55" * 32)
+    payload.prev_randao = spec.Bytes32(b"\x01" * 32)
+    payload.timestamp = 0 if int(payload.timestamp) else 1
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload,
+                                                valid=False)
+
+
+# -- timestamps on both payload kinds ----------------------------------------
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_invalid_future_timestamp_first_payload(spec, state):
+    state = build_state_with_incomplete_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.timestamp = payload.timestamp + 1
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload,
+                                                valid=False)
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_invalid_past_timestamp_first_payload(spec, state):
+    state = build_state_with_incomplete_transition(spec, state)
+    state.genesis_time = 100
+    payload = build_empty_execution_payload(spec, state)
+    payload.timestamp = 0
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload,
+                                                valid=False)
+
+
+# -- non-validated fields round-trip -----------------------------------------
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_non_empty_extra_data_first_payload(spec, state):
+    state = build_state_with_incomplete_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.parent_hash = b"\x55" * 32
+    payload.extra_data = b"\x45" * 12
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload)
+    assert bytes(state.latest_execution_payload_header.extra_data) == \
+        b"\x45" * 12
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_non_empty_extra_data_regular_payload(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.extra_data = b"\x45" * 12
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_non_empty_transactions_first_payload(spec, state):
+    state = build_state_with_incomplete_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.parent_hash = b"\x55" * 32
+    payload.transactions = [spec.Transaction(b"\x99" * 128)
+                            for _ in range(2)]
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload)
+    from consensus_specs_tpu.utils.ssz import hash_tree_root
+    assert state.latest_execution_payload_header.transactions_root == \
+        hash_tree_root(payload.transactions)
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_non_empty_transactions_regular_payload(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.transactions = [spec.Transaction(b"\x99" * 128)
+                            for _ in range(2)]
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_zero_length_transaction_first_payload(spec, state):
+    state = build_state_with_incomplete_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.parent_hash = b"\x55" * 32
+    payload.transactions = [spec.Transaction(b"")]
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_zero_length_transaction_regular_payload(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.transactions = [spec.Transaction(b"")]
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+# -- randomized non-validated execution fields -------------------------------
+
+def _randomize_non_validated_fields(spec, payload, rng):
+    """Fields the consensus layer does NOT check: any value must ride
+    through when the engine accepts, and must not mask an engine reject."""
+    payload.fee_recipient = spec.ExecutionAddress(rng.randbytes(20))
+    payload.state_root = spec.Bytes32(rng.randbytes(32))
+    payload.receipts_root = spec.Bytes32(rng.randbytes(32))
+    payload.logs_bloom = rng.randbytes(int(spec.BYTES_PER_LOGS_BLOOM))
+    payload.block_number = rng.randrange(1 << 40)
+    payload.gas_limit = rng.randrange(1 << 40)
+    payload.gas_used = rng.randrange(1 << 40)
+    payload.extra_data = rng.randbytes(rng.randrange(
+        int(spec.MAX_EXTRA_DATA_BYTES)))
+    payload.base_fee_per_gas = rng.randrange(1 << 64)
+    payload.block_hash = compute_el_block_hash(spec, payload)
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_randomized_non_validated_execution_fields_first_payload__execution_valid(
+        spec, state):
+    state = build_state_with_incomplete_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.parent_hash = b"\x55" * 32
+    _randomize_non_validated_fields(spec, payload, Random(1111))
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_randomized_non_validated_execution_fields_regular_payload__execution_valid(
+        spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    _randomize_non_validated_fields(spec, payload, Random(2222))
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_invalid_randomized_non_validated_execution_fields_first_payload__execution_invalid(
+        spec, state):
+    state = build_state_with_incomplete_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.parent_hash = b"\x55" * 32
+    _randomize_non_validated_fields(spec, payload, Random(3333))
+    yield from run_execution_payload_processing(
+        spec, state, payload, valid=False, execution_valid=False)
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_invalid_randomized_non_validated_execution_fields_regular_payload__execution_invalid(
+        spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    _randomize_non_validated_fields(spec, payload, Random(4444))
+    yield from run_execution_payload_processing(
+        spec, state, payload, valid=False, execution_valid=False)

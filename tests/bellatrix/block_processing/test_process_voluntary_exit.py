@@ -1,0 +1,112 @@
+"""Voluntary-exit signature domains across the bellatrix fork boundary.
+
+Reference model:
+``test/bellatrix/block_processing/test_process_voluntary_exit.py``
+(6 cases: exits signed with current/previous/genesis fork versions for
+epochs before/after the fork epoch) against phase0
+``process_voluntary_exit`` + ``get_domain`` fork-version selection.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, always_bls,
+)
+from consensus_specs_tpu.test_infra.voluntary_exits import (
+    sign_voluntary_exit, run_voluntary_exit_processing,
+)
+from consensus_specs_tpu.test_infra.keys import privkeys
+
+BELLATRIX_ONLY = with_phases(["bellatrix"])
+
+
+def _prepare_exit_state(spec, state, exit_epoch_offset=0):
+    """Fast-forward past the shard-committee period and pin the state's
+    fork to a bellatrix-boundary shape: previous=altair, current=bellatrix,
+    fork epoch strictly inside the walked range."""
+    state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+    current_epoch = spec.get_current_epoch(state)
+    state.fork.previous_version = spec.config.ALTAIR_FORK_VERSION
+    state.fork.current_version = spec.config.BELLATRIX_FORK_VERSION
+    state.fork.epoch = current_epoch - 2
+    return current_epoch
+
+
+def _signed_exit(spec, state, epoch, index, fork_version):
+    exit_message = spec.VoluntaryExit(epoch=epoch, validator_index=index)
+    return sign_voluntary_exit(spec, state, exit_message, privkeys[index],
+                               fork_version=fork_version)
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+@always_bls
+def test_invalid_voluntary_exit_with_current_fork_version_is_before_fork_epoch(
+        spec, state):
+    """Exit epoch BEFORE the fork, signed with the CURRENT version: the
+    domain must use the previous version, so this signature fails."""
+    current_epoch = _prepare_exit_state(spec, state)
+    signed = _signed_exit(spec, state, state.fork.epoch - 1, 0,
+                          state.fork.current_version)
+    yield from run_voluntary_exit_processing(spec, state, signed,
+                                             valid=False)
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+@always_bls
+def test_voluntary_exit_with_current_fork_version_not_is_before_fork_epoch(
+        spec, state):
+    current_epoch = _prepare_exit_state(spec, state)
+    assert current_epoch >= state.fork.epoch
+    signed = _signed_exit(spec, state, current_epoch, 0,
+                          state.fork.current_version)
+    yield from run_voluntary_exit_processing(spec, state, signed)
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+@always_bls
+def test_voluntary_exit_with_previous_fork_version_is_before_fork_epoch(
+        spec, state):
+    """Exit epoch before the fork, previous-version domain: valid."""
+    _prepare_exit_state(spec, state)
+    signed = _signed_exit(spec, state, state.fork.epoch - 1, 0,
+                          state.fork.previous_version)
+    yield from run_voluntary_exit_processing(spec, state, signed)
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+@always_bls
+def test_invalid_voluntary_exit_with_previous_fork_version_not_is_before_fork_epoch(
+        spec, state):
+    current_epoch = _prepare_exit_state(spec, state)
+    signed = _signed_exit(spec, state, current_epoch, 0,
+                          state.fork.previous_version)
+    yield from run_voluntary_exit_processing(spec, state, signed,
+                                             valid=False)
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+@always_bls
+def test_invalid_voluntary_exit_with_genesis_fork_version_is_before_fork_epoch(
+        spec, state):
+    """The genesis version is two forks back: never the right domain."""
+    _prepare_exit_state(spec, state)
+    assert spec.config.GENESIS_FORK_VERSION not in (
+        state.fork.previous_version, state.fork.current_version)
+    signed = _signed_exit(spec, state, state.fork.epoch - 1, 0,
+                          spec.config.GENESIS_FORK_VERSION)
+    yield from run_voluntary_exit_processing(spec, state, signed,
+                                             valid=False)
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+@always_bls
+def test_invalid_voluntary_exit_with_genesis_fork_version_not_is_before_fork_epoch(
+        spec, state):
+    current_epoch = _prepare_exit_state(spec, state)
+    signed = _signed_exit(spec, state, current_epoch, 0,
+                          spec.config.GENESIS_FORK_VERSION)
+    yield from run_voluntary_exit_processing(spec, state, signed,
+                                             valid=False)

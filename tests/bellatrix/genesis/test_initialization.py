@@ -1,0 +1,58 @@
+"""Bellatrix genesis initialization with/without a payload header.
+
+Reference model: ``test/bellatrix/genesis/test_initialization.py``
+against ``specs/bellatrix/beacon-chain.md`` Testing-section
+``initialize_beacon_state_from_eth1`` (the ``execution_payload_header``
+parameter decides whether the chain starts pre- or post-merge).
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_test, with_phases,
+)
+from consensus_specs_tpu.test_infra.deposits import (
+    prepare_full_genesis_deposits,
+)
+
+BELLATRIX_ONLY = with_phases(["bellatrix"])
+
+
+def _genesis_deposits(spec):
+    count = int(spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT)
+    deposits, root, _ = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE, count, signed=True)
+    return deposits, root
+
+
+@BELLATRIX_ONLY
+@spec_test
+def test_initialize_pre_transition_no_param(spec):
+    deposits, _ = _genesis_deposits(spec)
+    state = spec.initialize_beacon_state_from_eth1(
+        b"\x12" * 32, 1234567890, deposits)
+    assert state.fork.current_version == spec.config.BELLATRIX_FORK_VERSION
+    # default header: the merge has not happened
+    assert not spec.is_merge_transition_complete(state)
+    yield "state", state
+
+
+@BELLATRIX_ONLY
+@spec_test
+def test_initialize_pre_transition_empty_payload(spec):
+    deposits, _ = _genesis_deposits(spec)
+    state = spec.initialize_beacon_state_from_eth1(
+        b"\x12" * 32, 1234567890, deposits,
+        execution_payload_header=spec.ExecutionPayloadHeader())
+    assert not spec.is_merge_transition_complete(state)
+    yield "state", state
+
+
+@BELLATRIX_ONLY
+@spec_test
+def test_initialize_post_transition(spec):
+    deposits, _ = _genesis_deposits(spec)
+    genesis_header = spec.default_payload_header()
+    state = spec.initialize_beacon_state_from_eth1(
+        b"\x12" * 32, 1234567890, deposits,
+        execution_payload_header=genesis_header)
+    assert spec.is_merge_transition_complete(state)
+    assert state.latest_execution_payload_header == genesis_header
+    yield "state", state

@@ -1,0 +1,68 @@
+"""Bellatrix whole-block sanity transitions.
+
+Reference model: ``test/bellatrix/sanity/test_blocks.py`` (empty
+no-transaction block, randomized payload, execution-disabled block)
+against ``specs/bellatrix/beacon-chain.md`` ``process_block``.
+"""
+from random import Random
+
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, with_all_phases_from,
+)
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block,
+)
+from consensus_specs_tpu.test_infra.execution_payload import (
+    build_state_with_incomplete_transition, compute_el_block_hash,
+)
+
+BELLATRIX_ONLY = with_phases(["bellatrix"])
+with_bellatrix_and_later = with_all_phases_from("bellatrix")
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_empty_block_transition_no_tx(spec, state):
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    assert len(block.body.execution_payload.transactions) == 0
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert state.latest_execution_payload_header.block_hash == \
+        block.body.execution_payload.block_hash
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_block_transition_randomized_payload(spec, state):
+    rng = Random(7070)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    payload = block.body.execution_payload
+    payload.fee_recipient = spec.ExecutionAddress(rng.randbytes(20))
+    payload.gas_limit = rng.randrange(1 << 40)
+    payload.gas_used = rng.randrange(1 << 40)
+    payload.transactions = [
+        spec.Transaction(rng.randbytes(rng.randrange(1, 256)))
+        for _ in range(rng.randrange(1, 5))]
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    block.body.execution_payload = payload
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_is_execution_enabled_false(spec, state):
+    """Pre-merge block with the default payload: execution stays off."""
+    state = build_state_with_incomplete_transition(spec, state)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.execution_payload = spec.ExecutionPayload()
+    assert not spec.is_execution_enabled(state, block.body)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert not spec.is_merge_transition_complete(state)

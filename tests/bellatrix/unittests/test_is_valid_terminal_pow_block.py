@@ -1,0 +1,50 @@
+"""``is_valid_terminal_pow_block`` difficulty-boundary unit tests.
+
+Reference model:
+``test/bellatrix/unittests/test_is_valid_terminal_pow_block.py``
+against ``specs/bellatrix/fork-choice.md`` (block at/above TTD whose
+parent is below TTD).
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases,
+)
+
+BELLATRIX_ONLY = with_phases(["bellatrix"])
+
+
+def _pow_pair(spec, parent_difficulty, block_difficulty):
+    parent = spec.PowBlock(block_hash=b"\x01" * 32,
+                           parent_hash=b"\x00" * 32,
+                           total_difficulty=parent_difficulty)
+    block = spec.PowBlock(block_hash=b"\x02" * 32,
+                          parent_hash=parent.block_hash,
+                          total_difficulty=block_difficulty)
+    return block, parent
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_is_valid_terminal_pow_block_success_valid(spec, state):
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    block, parent = _pow_pair(spec, ttd - 1, ttd)
+    assert spec.is_valid_terminal_pow_block(block, parent)
+    yield  # unit test: no vector parts
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_is_valid_terminal_pow_block_fail_before_terminal(spec, state):
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    block, parent = _pow_pair(spec, ttd - 2, ttd - 1)
+    assert not spec.is_valid_terminal_pow_block(block, parent)
+    yield
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_is_valid_terminal_pow_block_fail_just_after_terminal(spec, state):
+    """Parent already at TTD: the terminal block was one earlier."""
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    block, parent = _pow_pair(spec, ttd, ttd + 1)
+    assert not spec.is_valid_terminal_pow_block(block, parent)
+    yield

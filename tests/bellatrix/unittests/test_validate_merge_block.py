@@ -1,0 +1,161 @@
+"""``validate_merge_block`` unit tests.
+
+Reference model:
+``test/bellatrix/unittests/test_validate_merge_block.py`` (8 cases:
+PoW-chain lookups, terminal-difficulty checks, TERMINAL_BLOCK_HASH
+override + activation epoch) against
+``specs/bellatrix/fork-choice.md`` ``validate_merge_block``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, with_config_overrides,
+    expect_assertion_error,
+)
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.test_infra.execution_payload import (
+    build_state_with_incomplete_transition, compute_el_block_hash,
+)
+
+BELLATRIX_ONLY = with_phases(["bellatrix"])
+
+TB_HASH = b"\xab" * 32
+TB_HASH_HEX = "0x" + TB_HASH.hex()
+
+
+def _merge_block(spec, state, parent_hash):
+    state = build_state_with_incomplete_transition(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    payload = block.body.execution_payload
+    payload.parent_hash = parent_hash
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    block.body.execution_payload = payload
+    return block
+
+
+def _with_pow_chain(spec, blocks):
+    """Patch the class-level get_pow_block stub with a table lookup;
+    caller must run inside the returned try/finally via _run."""
+    table = {bytes(b.block_hash): b for b in blocks}
+    spec.get_pow_block = lambda h: table.get(bytes(h))
+
+
+def _restore(spec):
+    if "get_pow_block" in spec.__dict__:
+        del spec.get_pow_block
+
+
+def _terminal_chain(spec, tip_hash):
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    parent = spec.PowBlock(block_hash=b"\x01" * 32,
+                           parent_hash=b"\x00" * 32,
+                           total_difficulty=ttd - 1)
+    tip = spec.PowBlock(block_hash=tip_hash,
+                        parent_hash=parent.block_hash,
+                        total_difficulty=ttd)
+    return tip, parent
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_validate_merge_block_success(spec, state):
+    block = _merge_block(spec, state, b"\xaa" * 32)
+    tip, parent = _terminal_chain(spec, b"\xaa" * 32)
+    _with_pow_chain(spec, [tip, parent])
+    try:
+        spec.validate_merge_block(block)
+    finally:
+        _restore(spec)
+    yield
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_validate_merge_block_fail_block_lookup(spec, state):
+    """The payload's PoW parent is unknown to the node."""
+    block = _merge_block(spec, state, b"\xaa" * 32)
+    _with_pow_chain(spec, [])
+    try:
+        expect_assertion_error(lambda: spec.validate_merge_block(block))
+    finally:
+        _restore(spec)
+    yield
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_validate_merge_block_fail_parent_block_lookup(spec, state):
+    """The PoW parent exists but ITS parent is unknown."""
+    block = _merge_block(spec, state, b"\xaa" * 32)
+    tip, _ = _terminal_chain(spec, b"\xaa" * 32)
+    _with_pow_chain(spec, [tip])  # grandparent missing
+    try:
+        expect_assertion_error(lambda: spec.validate_merge_block(block))
+    finally:
+        _restore(spec)
+    yield
+
+
+@BELLATRIX_ONLY
+@spec_state_test
+def test_validate_merge_block_fail_after_terminal(spec, state):
+    """Parent is already past TTD: the merge block anchored too late."""
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    block = _merge_block(spec, state, b"\xaa" * 32)
+    parent = spec.PowBlock(block_hash=b"\x01" * 32,
+                           parent_hash=b"\x00" * 32,
+                           total_difficulty=ttd)
+    tip = spec.PowBlock(block_hash=b"\xaa" * 32,
+                        parent_hash=parent.block_hash,
+                        total_difficulty=ttd + 1)
+    _with_pow_chain(spec, [tip, parent])
+    try:
+        expect_assertion_error(lambda: spec.validate_merge_block(block))
+    finally:
+        _restore(spec)
+    yield
+
+
+@BELLATRIX_ONLY
+@with_config_overrides({"TERMINAL_BLOCK_HASH": TB_HASH_HEX,
+                        "TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH": 0})
+@spec_state_test
+def test_validate_merge_block_tbh_override_success(spec, state):
+    """With a terminal-hash override, difficulty is ignored entirely."""
+    assert bytes(spec.config.TERMINAL_BLOCK_HASH) == TB_HASH
+    block = _merge_block(spec, state, TB_HASH)
+    # no PoW chain registered at all: the override path never looks
+    spec.validate_merge_block(block)
+    yield
+
+
+@BELLATRIX_ONLY
+@with_config_overrides({"TERMINAL_BLOCK_HASH": TB_HASH_HEX,
+                        "TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH": 0})
+@spec_state_test
+def test_validate_merge_block_fail_parent_hash_is_not_tbh(spec, state):
+    block = _merge_block(spec, state, b"\xcd" * 32)
+    expect_assertion_error(lambda: spec.validate_merge_block(block))
+    yield
+
+
+@BELLATRIX_ONLY
+@with_config_overrides({"TERMINAL_BLOCK_HASH": TB_HASH_HEX,
+                        "TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH": 2**30})
+@spec_state_test
+def test_validate_merge_block_terminal_block_hash_fail_activation_not_reached(
+        spec, state):
+    block = _merge_block(spec, state, TB_HASH)
+    expect_assertion_error(lambda: spec.validate_merge_block(block))
+    yield
+
+
+@BELLATRIX_ONLY
+@with_config_overrides({"TERMINAL_BLOCK_HASH": TB_HASH_HEX,
+                        "TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH": 2**30})
+@spec_state_test
+def test_validate_merge_block_fail_activation_not_reached_parent_hash_is_not_tbh(
+        spec, state):
+    block = _merge_block(spec, state, b"\xcd" * 32)
+    expect_assertion_error(lambda: spec.validate_merge_block(block))
+    yield

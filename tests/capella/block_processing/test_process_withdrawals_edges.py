@@ -1,0 +1,548 @@
+"""``process_withdrawals`` boundary and adversarial-payload coverage.
+
+Reference model: ``test/capella/block_processing/test_process_withdrawals.py``
+(53 cases) against ``specs/capella/beacon-chain.md``
+``get_expected_withdrawals`` / ``process_withdrawals``: eligibility
+predicates, sweep bounds, and every way a payload's withdrawal list can
+disagree with the state's expectation.
+"""
+from random import Random
+
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases,
+)
+from consensus_specs_tpu.test_infra.block import next_epoch
+from consensus_specs_tpu.test_infra.execution_payload import (
+    build_empty_execution_payload)
+
+from tests.capella.block_processing.test_process_withdrawals import (
+    set_eth1_credentials, prepare_expected_withdrawals,
+    run_withdrawals_processing,
+)
+
+WITHDRAWAL_FORKS = ["capella", "deneb"]
+CAPELLA_ONLY = with_phases(["capella"])
+
+
+def _make_fully_withdrawable(spec, state, index, balance=None):
+    set_eth1_credentials(spec, state, index)
+    state.validators[index].withdrawable_epoch = spec.get_current_epoch(state)
+    if balance is not None:
+        state.balances[index] = balance
+
+
+def _make_partially_withdrawable(spec, state, index, excess=10**9):
+    set_eth1_credentials(spec, state, index)
+    state.validators[index].effective_balance = spec.MAX_EFFECTIVE_BALANCE
+    state.balances[index] = spec.MAX_EFFECTIVE_BALANCE + excess
+
+
+# -- successful sweeps -------------------------------------------------------
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_success_all_fully_withdrawable_in_one_sweep(spec, state):
+    """Every validator in one sweep window is fully withdrawable."""
+    count = min(int(spec.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP),
+                len(state.validators))
+    for index in range(count):
+        _make_fully_withdrawable(spec, state, index)
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == spec.MAX_WITHDRAWALS_PER_PAYLOAD
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_success_all_fully_withdrawable(spec, state):
+    for index in range(len(state.validators)):
+        _make_fully_withdrawable(spec, state, index)
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == spec.MAX_WITHDRAWALS_PER_PAYLOAD
+    yield from run_withdrawals_processing(spec, state, payload)
+    # exactly the first MAX_WITHDRAWALS_PER_PAYLOAD validators were paid
+    for w in payload.withdrawals:
+        assert state.balances[w.validator_index] == 0
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_success_all_partially_withdrawable_in_one_sweep(spec, state):
+    count = min(int(spec.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP),
+                len(state.validators))
+    for index in range(count):
+        _make_partially_withdrawable(spec, state, index)
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == spec.MAX_WITHDRAWALS_PER_PAYLOAD
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_success_all_partially_withdrawable(spec, state):
+    for index in range(len(state.validators)):
+        _make_partially_withdrawable(spec, state, index)
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_withdrawals_processing(spec, state, payload)
+    for w in payload.withdrawals:
+        assert state.balances[w.validator_index] == \
+            spec.MAX_EFFECTIVE_BALANCE
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_success_two_partial_withdrawable(spec, state):
+    _make_partially_withdrawable(spec, state, 0)
+    _make_partially_withdrawable(spec, state, 1)
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == 2
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_success_max_partial_withdrawable(spec, state):
+    for index in range(int(spec.MAX_WITHDRAWALS_PER_PAYLOAD)):
+        _make_partially_withdrawable(spec, state, index)
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == spec.MAX_WITHDRAWALS_PER_PAYLOAD
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_success_max_plus_one_withdrawable(spec, state):
+    for index in range(int(spec.MAX_WITHDRAWALS_PER_PAYLOAD) + 1):
+        _make_partially_withdrawable(spec, state, index)
+    payload = build_empty_execution_payload(spec, state)
+    # capped at the payload bound; the +1th waits for the next block
+    assert len(payload.withdrawals) == spec.MAX_WITHDRAWALS_PER_PAYLOAD
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+# -- eligibility-predicate edges --------------------------------------------
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_success_no_max_effective_balance(spec, state):
+    """Excess balance but effective balance below MAX: not partial."""
+    set_eth1_credentials(spec, state, 0)
+    state.validators[0].effective_balance = \
+        spec.MAX_EFFECTIVE_BALANCE - spec.EFFECTIVE_BALANCE_INCREMENT
+    state.balances[0] = spec.MAX_EFFECTIVE_BALANCE + 10**9
+    assert spec.get_expected_withdrawals(state) == []
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_success_no_excess_balance(spec, state):
+    """Max effective balance but no excess: not partial."""
+    set_eth1_credentials(spec, state, 0)
+    state.validators[0].effective_balance = spec.MAX_EFFECTIVE_BALANCE
+    state.balances[0] = spec.MAX_EFFECTIVE_BALANCE
+    assert spec.get_expected_withdrawals(state) == []
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_success_excess_balance_but_no_max_effective_balance(spec, state):
+    set_eth1_credentials(spec, state, 0)
+    state.validators[0].effective_balance = \
+        spec.MAX_EFFECTIVE_BALANCE - spec.EFFECTIVE_BALANCE_INCREMENT
+    state.balances[0] = spec.MAX_EFFECTIVE_BALANCE + 1
+    assert spec.get_expected_withdrawals(state) == []
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_success_one_partial_withdrawable_not_yet_active(spec, state):
+    """Activation status is irrelevant to partial withdrawability."""
+    _make_partially_withdrawable(spec, state, 0)
+    state.validators[0].activation_epoch = \
+        spec.get_current_epoch(state) + 4
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == 1
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_success_one_partial_withdrawable_in_exit_queue(spec, state):
+    _make_partially_withdrawable(spec, state, 0)
+    spec.initiate_validator_exit(state, spec.ValidatorIndex(0))
+    assert state.validators[0].exit_epoch > spec.get_current_epoch(state)
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == 1
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_success_one_partial_withdrawable_exited(spec, state):
+    _make_partially_withdrawable(spec, state, 0)
+    state.validators[0].exit_epoch = spec.get_current_epoch(state)
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == 1
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_success_one_partial_withdrawable_active_and_slashed(spec, state):
+    _make_partially_withdrawable(spec, state, 0)
+    state.validators[0].slashed = True
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == 1
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_success_one_partial_withdrawable_exited_and_slashed(spec, state):
+    _make_partially_withdrawable(spec, state, 0)
+    state.validators[0].slashed = True
+    state.validators[0].exit_epoch = spec.get_current_epoch(state)
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == 1
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_withdrawable_epoch_but_0_balance(spec, state):
+    """withdrawable_epoch reached but balance zero: nothing to pay."""
+    _make_fully_withdrawable(spec, state, 0, balance=0)
+    state.validators[0].effective_balance = 0
+    assert spec.get_expected_withdrawals(state) == []
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_withdrawable_epoch_but_0_effective_balance_0_balance(spec, state):
+    _make_fully_withdrawable(spec, state, 0, balance=0)
+    state.validators[0].effective_balance = 0
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == 0
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_withdrawable_epoch_but_0_effective_balance_nonzero_balance(
+        spec, state):
+    """Zero EFFECTIVE balance with real balance still fully withdraws."""
+    _make_fully_withdrawable(spec, state, 0, balance=10**9)
+    state.validators[0].effective_balance = 0
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == 1
+    yield from run_withdrawals_processing(spec, state, payload)
+    assert state.balances[0] == 0
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_no_withdrawals_but_some_next_epoch(spec, state):
+    """withdrawable_epoch = next epoch: nothing due yet."""
+    current = spec.get_current_epoch(state)
+    set_eth1_credentials(spec, state, 0)
+    state.validators[0].withdrawable_epoch = current + 1
+    assert spec.get_expected_withdrawals(state) == []
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_all_withdrawal(spec, state):
+    """Whole registry fully withdrawable: repeated blocks drain it."""
+    for index in range(len(state.validators)):
+        _make_fully_withdrawable(spec, state, index)
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_withdrawals_processing(spec, state, payload)
+    paid = sum(1 for b in state.balances if int(b) == 0)
+    assert paid == spec.MAX_WITHDRAWALS_PER_PAYLOAD
+
+
+# -- invalid payload manipulations ------------------------------------------
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_invalid_non_withdrawable_non_empty_withdrawals(spec, state):
+    """No one is withdrawable, but the payload claims a withdrawal."""
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == 0
+    payload.withdrawals.append(spec.Withdrawal(
+        index=0, validator_index=0,
+        address=b"\x30" * 20, amount=10**9))
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_invalid_one_expected_full_withdrawal_and_none_in_withdrawals(
+        spec, state):
+    prepare_expected_withdrawals(spec, state, num_full=1)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals = type(payload.withdrawals)()
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_invalid_one_expected_partial_withdrawal_and_none_in_withdrawals(
+        spec, state):
+    prepare_expected_withdrawals(spec, state, num_partial=1)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals = type(payload.withdrawals)()
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_invalid_one_expected_full_withdrawal_and_duplicate_in_withdrawals(
+        spec, state):
+    prepare_expected_withdrawals(spec, state, num_full=1)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals.append(payload.withdrawals[0])
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_invalid_two_expected_partial_withdrawal_and_duplicate_in_withdrawals(
+        spec, state):
+    prepare_expected_withdrawals(spec, state, num_partial=2)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals[1] = payload.withdrawals[0]
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_invalid_max_per_slot_full_withdrawals_and_one_less_in_withdrawals(
+        spec, state):
+    prepare_expected_withdrawals(
+        spec, state, num_full=int(spec.MAX_WITHDRAWALS_PER_PAYLOAD))
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals = payload.withdrawals[:-1]
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_invalid_max_per_slot_partial_withdrawals_and_one_less_in_withdrawals(
+        spec, state):
+    prepare_expected_withdrawals(
+        spec, state, num_partial=int(spec.MAX_WITHDRAWALS_PER_PAYLOAD))
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals = payload.withdrawals[:-1]
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_invalid_a_lot_fully_withdrawable_too_few_in_withdrawals(spec, state):
+    prepare_expected_withdrawals(
+        spec, state, num_full=int(spec.MAX_WITHDRAWALS_PER_PAYLOAD) * 2)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals = payload.withdrawals[:-2]
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_invalid_a_lot_partially_withdrawable_too_few_in_withdrawals(
+        spec, state):
+    prepare_expected_withdrawals(
+        spec, state, num_partial=int(spec.MAX_WITHDRAWALS_PER_PAYLOAD) * 2)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals = payload.withdrawals[:-2]
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_invalid_a_lot_mixed_withdrawable_in_queue_too_few_in_withdrawals(
+        spec, state):
+    n = int(spec.MAX_WITHDRAWALS_PER_PAYLOAD)
+    prepare_expected_withdrawals(spec, state, num_full=n, num_partial=n)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals = payload.withdrawals[:-1]
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_invalid_incorrect_withdrawal_index(spec, state):
+    prepare_expected_withdrawals(spec, state, num_full=1)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals[0].index += 1
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_invalid_incorrect_address_full(spec, state):
+    prepare_expected_withdrawals(spec, state, num_full=1)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals[0].address = b"\xff" * 20
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_invalid_incorrect_address_partial(spec, state):
+    prepare_expected_withdrawals(spec, state, num_partial=1)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals[0].address = b"\xff" * 20
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_invalid_incorrect_amount_full(spec, state):
+    prepare_expected_withdrawals(spec, state, num_full=1)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals[0].amount += 1
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_phases(WITHDRAWAL_FORKS)
+@spec_state_test
+def test_invalid_incorrect_amount_partial(spec, state):
+    prepare_expected_withdrawals(spec, state, num_partial=1)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals[0].amount += 1
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_invalid_one_of_many_incorrectly_full(spec, state):
+    prepare_expected_withdrawals(
+        spec, state, num_full=int(spec.MAX_WITHDRAWALS_PER_PAYLOAD))
+    payload = build_empty_execution_payload(spec, state)
+    mid = len(payload.withdrawals) // 2
+    payload.withdrawals[mid].amount += 1
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_invalid_one_of_many_incorrectly_partial(spec, state):
+    prepare_expected_withdrawals(
+        spec, state, num_partial=int(spec.MAX_WITHDRAWALS_PER_PAYLOAD))
+    payload = build_empty_execution_payload(spec, state)
+    mid = len(payload.withdrawals) // 2
+    payload.withdrawals[mid].validator_index += 1
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_invalid_many_incorrectly_full(spec, state):
+    prepare_expected_withdrawals(
+        spec, state, num_full=int(spec.MAX_WITHDRAWALS_PER_PAYLOAD))
+    payload = build_empty_execution_payload(spec, state)
+    for i, w in enumerate(payload.withdrawals):
+        w.index += i + 1
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_invalid_many_incorrectly_partial(spec, state):
+    prepare_expected_withdrawals(
+        spec, state, num_partial=int(spec.MAX_WITHDRAWALS_PER_PAYLOAD))
+    payload = build_empty_execution_payload(spec, state)
+    for i, w in enumerate(payload.withdrawals):
+        w.address = bytes([i + 1]) * 20
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+# -- randomized mixes --------------------------------------------------------
+
+def _run_random_withdrawals(spec, state, rng, full_fraction,
+                            partial_fraction):
+    for index in range(len(state.validators)):
+        roll = rng.random()
+        if roll < full_fraction:
+            _make_fully_withdrawable(
+                spec, state, index,
+                balance=rng.randrange(1, 2 * int(spec.MAX_EFFECTIVE_BALANCE)))
+        elif roll < full_fraction + partial_fraction:
+            _make_partially_withdrawable(
+                spec, state, index, excess=rng.randrange(1, 10**10))
+    # start the sweep cursor somewhere random to cover wrap-around
+    state.next_withdrawal_validator_index = spec.ValidatorIndex(
+        rng.randrange(len(state.validators)))
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_withdrawals_processing(spec, state, payload)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_random_full_withdrawals_0(spec, state):
+    yield from _run_random_withdrawals(spec, state, Random(440), 0.3, 0.0)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_random_full_withdrawals_1(spec, state):
+    yield from _run_random_withdrawals(spec, state, Random(441), 0.6, 0.0)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_random_full_withdrawals_2(spec, state):
+    yield from _run_random_withdrawals(spec, state, Random(442), 0.9, 0.0)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_random_full_withdrawals_3(spec, state):
+    yield from _run_random_withdrawals(spec, state, Random(443), 1.0, 0.0)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_random_partial_withdrawals_1(spec, state):
+    yield from _run_random_withdrawals(spec, state, Random(451), 0.0, 0.3)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_random_partial_withdrawals_2(spec, state):
+    yield from _run_random_withdrawals(spec, state, Random(452), 0.0, 0.6)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_random_partial_withdrawals_3(spec, state):
+    yield from _run_random_withdrawals(spec, state, Random(453), 0.0, 0.9)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_random_partial_withdrawals_4(spec, state):
+    yield from _run_random_withdrawals(spec, state, Random(454), 0.0, 1.0)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_random_partial_withdrawals_5(spec, state):
+    yield from _run_random_withdrawals(spec, state, Random(455), 0.0, 0.5)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_random_0(spec, state):
+    yield from _run_random_withdrawals(spec, state, Random(456), 0.25, 0.25)

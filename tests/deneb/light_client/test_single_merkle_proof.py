@@ -1,0 +1,84 @@
+"""Deneb light-client merkle proofs + blob-gas header rules.
+
+Reference model: ``test/deneb/light_client/test_single_merkle_proof.py``
+against ``specs/deneb/light-client/sync-protocol.md`` (execution header
+gains blob_gas_used/excess_blob_gas; pre-deneb headers must zero them).
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, with_config_overrides,
+)
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block,
+)
+from consensus_specs_tpu.utils.ssz import (
+    hash_tree_root, compute_merkle_proof,
+)
+
+DENEB_ONLY = with_phases(["deneb"])
+deneb_lc_active = with_config_overrides({
+    "ALTAIR_FORK_EPOCH": 0, "BELLATRIX_FORK_EPOCH": 0,
+    "CAPELLA_FORK_EPOCH": 0, "DENEB_FORK_EPOCH": 0,
+})
+
+
+@DENEB_ONLY
+@spec_state_test
+def test_execution_merkle_proof(spec, state):
+    from consensus_specs_tpu.forks.light_client import floorlog2
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    body = signed_block.message.body
+    gindex = spec.EXECUTION_PAYLOAD_GINDEX
+    proof = compute_merkle_proof(body, gindex)
+    leaf = hash_tree_root(body.execution_payload)
+    yield "object", body
+    yield "proof", {
+        "leaf": "0x" + bytes(leaf).hex(),
+        "leaf_index": int(gindex),
+        "branch": ["0x" + bytes(b).hex() for b in proof],
+    }
+    assert spec.is_valid_merkle_branch(
+        leaf=leaf, branch=proof, depth=floorlog2(gindex),
+        index=spec.get_subtree_index(gindex), root=hash_tree_root(body))
+
+
+@DENEB_ONLY
+@spec_state_test
+def test_next_sync_committee_merkle_proof_deneb_state(spec, state):
+    from consensus_specs_tpu.forks.light_client import floorlog2
+    gindex = spec.NEXT_SYNC_COMMITTEE_GINDEX
+    proof = compute_merkle_proof(state, gindex)
+    assert spec.is_valid_merkle_branch(
+        leaf=hash_tree_root(state.next_sync_committee), branch=proof,
+        depth=floorlog2(gindex), index=spec.get_subtree_index(gindex),
+        root=hash_tree_root(state))
+    yield
+
+
+@DENEB_ONLY
+@deneb_lc_active
+@spec_state_test
+def test_header_with_blob_gas_fields(spec, state):
+    """Deneb headers carry blob-gas fields through the LC header."""
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    header = spec.block_to_light_client_header(signed_block)
+    assert spec.is_valid_light_client_header(header)
+    assert header.execution.blob_gas_used == \
+        signed_block.message.body.execution_payload.blob_gas_used
+
+
+@DENEB_ONLY
+@with_config_overrides({
+    "ALTAIR_FORK_EPOCH": 0, "BELLATRIX_FORK_EPOCH": 0,
+    "CAPELLA_FORK_EPOCH": 0, "DENEB_FORK_EPOCH": 4})
+@spec_state_test
+def test_pre_deneb_header_must_zero_blob_gas(spec, state):
+    """Headers dated before DENEB_FORK_EPOCH must zero the blob-gas
+    fields (sync-protocol.md Modified is_valid_light_client_header)."""
+    header = spec.LightClientHeader()
+    header.beacon.slot = 0  # epoch 0 < DENEB_FORK_EPOCH=4, >= capella
+    # capella-era rules apply: execution branch must prove the leaf; an
+    # empty header with blob gas set is invalid before proof checking
+    header.execution.blob_gas_used = 1
+    assert not spec.is_valid_light_client_header(header)
