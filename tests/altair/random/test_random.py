@@ -1,0 +1,135 @@
+"""Altair-specific seeded randomized scenarios.
+
+Reference model: ``test/altair/random/test_random.py`` (16 seeded
+scenarios mixing leak/no-leak states, random blocks with sync
+aggregates) compiled from ``test/utils/randomized_block_tests.py``.
+"""
+from random import Random
+
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases,
+)
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block,
+    next_epoch,
+)
+from consensus_specs_tpu.test_infra.random_scenarios import (
+    run_random_scenario, randomize_state,
+)
+from consensus_specs_tpu.test_infra.rewards import set_state_in_leak
+from consensus_specs_tpu.test_infra.sync_committee import (
+    compute_aggregate_sync_committee_signature, compute_committee_indices,
+)
+
+ALTAIR_ONLY = with_phases(["altair"])
+
+
+def _random_sync_aggregate_block(spec, state, rng):
+    """A block carrying a random-participation sync aggregate."""
+    committee_indices = compute_committee_indices(state)
+    size = len(committee_indices)
+    selected = set(rng.sample(range(size), rng.randrange(size + 1)))
+    bits = [i in selected for i in range(size)]
+    participants = [committee_indices[i] for i in range(size) if bits[i]]
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, participants),
+    )
+    return state_transition_and_sign_block(spec, state, block)
+
+
+def _run_sync_scenario(spec, state, seed, epochs=1, leak=False):
+    rng = Random(seed)
+    if leak:
+        set_state_in_leak(spec, state)
+    else:
+        next_epoch(spec, state)
+        next_epoch(spec, state)
+    randomize_state(spec, state, rng, exit_fraction=0.02,
+                    slash_fraction=0.02)
+    yield "pre", state
+    blocks = []
+    for _ in range(epochs * 4):
+        blocks.append(_random_sync_aggregate_block(spec, state, rng))
+    yield "blocks", blocks
+    yield "post", state
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_scenario_0(spec, state):
+    yield "pre", state
+    blocks = run_random_scenario(spec, state, seed=5510)
+    yield "blocks", blocks
+    yield "post", state
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_scenario_1(spec, state):
+    yield "pre", state
+    blocks = run_random_scenario(spec, state, seed=5511)
+    yield "blocks", blocks
+    yield "post", state
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_sync_aggregates_0(spec, state):
+    yield from _run_sync_scenario(spec, state, seed=6600)
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_sync_aggregates_1(spec, state):
+    yield from _run_sync_scenario(spec, state, seed=6601)
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_sync_aggregates_leak(spec, state):
+    yield from _run_sync_scenario(spec, state, seed=6602, leak=True)
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_sync_aggregates_two_epochs(spec, state):
+    yield from _run_sync_scenario(spec, state, seed=6603, epochs=2)
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_with_exits_and_slashings(spec, state):
+    rng = Random(6604)
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    randomize_state(spec, state, rng, exit_fraction=0.15,
+                    slash_fraction=0.15)
+    yield "pre", state
+    blocks = [_random_sync_aggregate_block(spec, state, rng)
+              for _ in range(4)]
+    yield "blocks", blocks
+    yield "post", state
+
+
+@ALTAIR_ONLY
+@spec_state_test
+def test_random_leak_recovery(spec, state):
+    """Enter a leak, then give full participation: epoch processing must
+    walk scores back down without underflow."""
+    rng = Random(6605)
+    set_state_in_leak(spec, state)
+    yield "pre", state
+    flag = spec.add_flag(spec.ParticipationFlags(0),
+                         spec.TIMELY_TARGET_FLAG_INDEX)
+    for i in range(len(state.validators)):
+        state.previous_epoch_participation[i] = flag
+        state.current_epoch_participation[i] = flag
+    blocks = []
+    for _ in range(2 * spec.SLOTS_PER_EPOCH):
+        blocks.append(_random_sync_aggregate_block(spec, state, rng))
+    yield "blocks", blocks
+    yield "post", state
+    assert all(int(s) >= 0 for s in state.inactivity_scores)

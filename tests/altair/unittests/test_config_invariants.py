@@ -1,0 +1,50 @@
+"""Altair constant/config invariants.
+
+Reference model: ``test/altair/unittests/test_config_invariants.py``
+against ``specs/altair/beacon-chain.md`` constants.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases_from,
+)
+
+with_altair_and_later = with_all_phases_from("altair")
+
+
+@with_altair_and_later
+@spec_state_test
+def test_weight_denominator(spec, state):
+    assert (
+        spec.TIMELY_HEAD_WEIGHT
+        + spec.TIMELY_SOURCE_WEIGHT
+        + spec.TIMELY_TARGET_WEIGHT
+        + spec.SYNC_REWARD_WEIGHT
+        + spec.PROPOSER_WEIGHT
+    ) == spec.WEIGHT_DENOMINATOR
+    yield
+
+
+@with_altair_and_later
+@spec_state_test
+def test_inactivity_score(spec, state):
+    assert spec.config.INACTIVITY_SCORE_BIAS <= \
+        spec.config.INACTIVITY_SCORE_RECOVERY_RATE
+    yield
+
+
+@with_altair_and_later
+@spec_state_test
+def test_flag_indices_distinct_and_weighted(spec, state):
+    flags = [spec.TIMELY_SOURCE_FLAG_INDEX, spec.TIMELY_TARGET_FLAG_INDEX,
+             spec.TIMELY_HEAD_FLAG_INDEX]
+    assert sorted(flags) == [0, 1, 2]
+    assert len(spec.PARTICIPATION_FLAG_WEIGHTS) == len(flags)
+    yield
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committee_period_is_epochs(spec, state):
+    assert int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD) >= 1
+    assert int(spec.SYNC_COMMITTEE_SIZE) % \
+        int(spec.SYNC_COMMITTEE_SUBNET_COUNT) == 0
+    yield
