@@ -1,0 +1,122 @@
+"""ABI-level harness over the deposit-contract model.
+
+Plays the role of the reference's ``web3_tester``: drives deposits
+through the COMMITTED ABI artifact (argument validation, value checks,
+event log emission) instead of poking the python model directly, so the
+ABI JSON is load-bearing in tests rather than decorative.
+"""
+import json
+import os
+
+from solidity_deposit_contract.contract_model import DepositContractModel
+
+_ABI_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "deposit_contract.json")
+
+GWEI = 10**9
+MIN_DEPOSIT_WEI = 10**9 * GWEI  # 1 ether, contract's minimum
+
+
+def load_abi():
+    with open(_ABI_PATH) as f:
+        return json.load(f)["abi"]
+
+
+class AbiError(Exception):
+    """Argument/value rejected at the ABI or require() layer."""
+
+
+class DepositContractTester:
+    """In-process 'deployment': the ABI front-end over the model."""
+
+    def __init__(self):
+        self._model = DepositContractModel()
+        self._abi = {e["name"]: e for e in load_abi()
+                     if e["type"] == "function"}
+        self.logs = []  # DepositEvent dicts, in emission order
+
+    # -- ABI argument validation ------------------------------------
+
+    @staticmethod
+    def _check_bytes(name, value, exact=None):
+        if not isinstance(value, (bytes, bytearray)):
+            raise AbiError(f"{name}: bytes required")
+        if exact is not None and len(value) != exact:
+            raise AbiError(f"{name}: length {len(value)} != {exact}")
+
+    # -- calls -------------------------------------------------------
+
+    def deposit(self, pubkey, withdrawal_credentials, signature,
+                deposit_data_root, value_wei):
+        """`deposit(bytes,bytes,bytes,bytes32)` payable."""
+        assert "deposit" in self._abi
+        # dynamic-bytes args: the CONTRACT enforces the lengths
+        self._check_bytes("pubkey", pubkey)
+        self._check_bytes("withdrawal_credentials", withdrawal_credentials)
+        self._check_bytes("signature", signature)
+        self._check_bytes("deposit_data_root", deposit_data_root, exact=32)
+        if len(pubkey) != 48:
+            raise AbiError("DepositContract: invalid pubkey length")
+        if len(withdrawal_credentials) != 32:
+            raise AbiError(
+                "DepositContract: invalid withdrawal_credentials length")
+        if len(signature) != 96:
+            raise AbiError("DepositContract: invalid signature length")
+        if value_wei < MIN_DEPOSIT_WEI:
+            raise AbiError("DepositContract: deposit value too low")
+        if value_wei % GWEI != 0:
+            raise AbiError(
+                "DepositContract: deposit value not multiple of gwei")
+        amount_gwei = value_wei // GWEI
+        if amount_gwei > 2**64 - 1:
+            raise AbiError("DepositContract: deposit value too high")
+        computed = self._model.deposit_data_root(
+            bytes(pubkey), bytes(withdrawal_credentials), amount_gwei,
+            bytes(signature))
+        if computed != bytes(deposit_data_root):
+            raise AbiError(
+                "DepositContract: reconstructed DepositData does not match "
+                "supplied deposit_data_root")
+        index = self._model.deposit_count
+        self._model.deposit(bytes(pubkey), bytes(withdrawal_credentials),
+                            amount_gwei, bytes(signature))
+        self.logs.append({
+            "event": "DepositEvent",
+            "pubkey": bytes(pubkey),
+            "withdrawal_credentials": bytes(withdrawal_credentials),
+            "amount": amount_gwei.to_bytes(8, "little"),
+            "signature": bytes(signature),
+            "index": index.to_bytes(8, "little"),
+        })
+
+    def get_deposit_root(self) -> bytes:
+        return self._model.get_deposit_root()
+
+    def get_deposit_count(self) -> bytes:
+        return self._model.get_deposit_count()
+
+    def supportsInterface(self, interface_id: bytes) -> bool:
+        self._check_bytes("interfaceId", interface_id, exact=4)
+        # ERC165 itself + IDepositContract's computed id
+        erc165 = bytes.fromhex("01ffc9a7")
+        ideposit = _interface_id()
+        return interface_id in (erc165, ideposit)
+
+
+def _selector(sig: str) -> bytes:
+    """4-byte function selector = keccak256(signature)[:4]."""
+    from consensus_specs_tpu.utils.keccak import keccak256
+    return keccak256(sig.encode())[:4]
+
+
+def _interface_id() -> bytes:
+    """ERC165 interface id = XOR of the interface's selectors."""
+    sels = [
+        _selector("deposit(bytes,bytes,bytes,bytes32)"),
+        _selector("get_deposit_root()"),
+        _selector("get_deposit_count()"),
+    ]
+    out = bytes(4)
+    for s in sels:
+        out = bytes(a ^ b for a, b in zip(out, s))
+    return out

@@ -18,6 +18,10 @@ from consensus_specs_tpu.test_infra.keys import pubkeys, privkeys
 from consensus_specs_tpu.test_infra.deposits import build_deposit_data
 from consensus_specs_tpu.utils.hash_function import hash
 
+CONTRACT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "solidity_deposit_contract")
+
 
 def _spec():
     return build_spec("phase0", "minimal")
@@ -96,3 +100,82 @@ def test_contract_proofs_feed_process_deposit():
         assert len(state.validators) == pre_count + 1
     finally:
         bls.bls_active = True
+
+
+# ---------------------------------------------------------------------------
+# ABI artifact + tester round trip (reference: deposit_contract.json +
+# web3_tester/; Makefile:164-181)
+# ---------------------------------------------------------------------------
+
+def _signed_deposit_args(spec, index, amount_gwei):
+    data = build_deposit_data(
+        spec, pubkeys[index], privkeys[index], amount_gwei,
+        spec.BLS_WITHDRAWAL_PREFIX + hash(pubkeys[index])[1:], signed=True)
+    return data, (bytes(data.pubkey), bytes(data.withdrawal_credentials),
+                  bytes(data.signature), bytes(hash_tree_root(data)))
+
+
+def test_abi_artifact_matches_contract_interface():
+    import json
+    import re
+    abi_path = os.path.join(CONTRACT_DIR, "deposit_contract.json")
+    with open(abi_path) as f:
+        artifact = json.load(f)
+    abi_names = {e["name"] for e in artifact["abi"] if e["type"] == "function"}
+    sol = open(os.path.join(CONTRACT_DIR, "deposit_contract.sol")).read()
+    sol_fns = set(re.findall(r"function (\w+)\(", sol)) - {"to_little_endian_64"}
+    assert abi_names == sol_fns, (abi_names, sol_fns)
+    events = [e for e in artifact["abi"] if e["type"] == "event"]
+    assert [e["name"] for e in events] == ["DepositEvent"]
+    assert [i["name"] for i in events[0]["inputs"]] == [
+        "pubkey", "withdrawal_credentials", "amount", "signature", "index"]
+
+
+def test_abi_tester_round_trip_against_spec_roots():
+    """Deposits driven through the ABI tester produce the same root the
+    beacon chain computes over List[DepositData]."""
+    from solidity_deposit_contract.abi_tester import (
+        DepositContractTester, GWEI)
+    spec = build_spec("phase0", "minimal")
+    tester = DepositContractTester()
+    deposit_data_list = []
+    DepositDataList = List[spec.DepositData, 2**32]
+    for i in range(4):
+        amount_gwei = int(spec.MAX_EFFECTIVE_BALANCE)
+        data, (pubkey, creds, sig, root) = _signed_deposit_args(
+            spec, i, amount_gwei)
+        tester.deposit(pubkey, creds, sig, root,
+                       value_wei=amount_gwei * GWEI)
+        deposit_data_list.append(data)
+        expected = hash_tree_root(DepositDataList(deposit_data_list))
+        assert tester.get_deposit_root() == bytes(expected)
+        assert int.from_bytes(tester.get_deposit_count(), "little") == i + 1
+    # event log mirrors the deposit sequence
+    assert [int.from_bytes(e["index"], "little") for e in tester.logs] == \
+        [0, 1, 2, 3]
+
+
+def test_abi_tester_rejects_bad_inputs():
+    from solidity_deposit_contract.abi_tester import (
+        DepositContractTester, AbiError, GWEI)
+    spec = build_spec("phase0", "minimal")
+    amount_gwei = int(spec.MAX_EFFECTIVE_BALANCE)
+    _, (pubkey, creds, sig, root) = _signed_deposit_args(spec, 0, amount_gwei)
+    tester = DepositContractTester()
+    import pytest
+    with pytest.raises(AbiError):   # short pubkey
+        tester.deposit(pubkey[:-1], creds, sig, root, amount_gwei * GWEI)
+    with pytest.raises(AbiError):   # below 1-ether minimum
+        tester.deposit(pubkey, creds, sig, root, GWEI)
+    with pytest.raises(AbiError):   # non-gwei-multiple value
+        tester.deposit(pubkey, creds, sig, root, amount_gwei * GWEI + 1)
+    with pytest.raises(AbiError):   # wrong data root
+        tester.deposit(pubkey, creds, sig, b"\x00" * 32, amount_gwei * GWEI)
+    assert tester.logs == []
+
+
+def test_supports_interface():
+    from solidity_deposit_contract.abi_tester import DepositContractTester
+    tester = DepositContractTester()
+    assert tester.supportsInterface(bytes.fromhex("01ffc9a7"))  # ERC165
+    assert not tester.supportsInterface(b"\xff\xff\xff\xff")
