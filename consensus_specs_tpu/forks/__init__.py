@@ -61,7 +61,8 @@ def build_spec(fork: str, preset_name: str, config_overrides: Optional[dict] = N
 
 
 def use_compiled_registry():
-    """Swap the phase0..deneb registry entries for the markdown-COMPILED
+    """Swap the registry entries of all nine built forks for the
+    markdown-COMPILED
     ladder (``make pyspec`` output, ``compiler/emit.py``), so the same
     conformance suite that exercises the hand-written classes runs
     against the classes built from ``specs/*/beacon-chain.md`` — pytest
@@ -70,17 +71,20 @@ def use_compiled_registry():
 
     Always recompiles from the markdown first (a couple of seconds of
     pure python) so a green ``--compiled`` run certifies the CURRENT
-    spec text, never a stale or half-written generated tree.  Feature
-    forks (eip6110/eip7002/eip7594/whisk) keep their hand-written
-    classes — they extend the hand-written ladder, and their markdown
-    (``specs/_features/``) is documentation-first.
+    spec text, never a stale or half-written generated tree.  The swap
+    covers the same 9-fork surface the reference builds
+    (``pysetup/spec_builders/__init__.py:12-18``): phase0..deneb plus
+    eip6110/eip7002/whisk/eip7594; the recompile also enforces the
+    provenance guard (``compiler.emit.verify_provenance``), so a green
+    run certifies every spec-logic method came from markdown.
     """
     import importlib
-    fork_registry()  # populate before overriding
-    from consensus_specs_tpu.compiler.emit import main as _compile_all
+    fork_registry()  # populate before overriding (guard needs it too)
+    from consensus_specs_tpu.compiler.emit import (
+        main as _compile_all, _FORK_ORDER)
     _compile_all()
     importlib.invalidate_caches()  # compiled/ may have just been created
-    for fork in ("phase0", "altair", "bellatrix", "capella", "deneb"):
+    for fork in _FORK_ORDER:
         mod = importlib.import_module(f"{__name__}.compiled.{fork}")
         importlib.reload(mod)
         _REGISTRY[fork] = getattr(mod, f"Compiled{fork.capitalize()}Spec")

@@ -70,6 +70,10 @@ class EIP7594Spec(DenebSpec):
             return super().is_data_available(beacon_block_root,
                                              blob_kzg_commitments)
         sampled = retrieve(beacon_block_root)
+        # every committed blob must have been sampled: a short return
+        # means data was withheld, never availability
+        if len(sampled) < len(blob_kzg_commitments):
+            return False
         for commitment, (cell_ids, cells, proofs) in zip(
                 blob_kzg_commitments, sampled):
             if not self.verify_cell_proof_batch(

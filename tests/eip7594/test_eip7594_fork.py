@@ -111,3 +111,22 @@ def test_is_data_available_fallback_and_stub_precedence(spec, state):
         assert calls == [root], "cell stub must take precedence"
     finally:
         del spec.retrieve_cells_and_proofs
+
+
+@with_phases(["eip7594"])
+@spec_state_test
+def test_is_data_available_rejects_withheld_blob(spec, state):
+    """A sampling response covering fewer blobs than the block commits
+    to is data withholding, never availability — the check must not
+    zip-truncate to the sampled prefix."""
+    root = b"\x08" * 32
+    commitments = [b"\xc0" + b"\x00" * 47]  # one committed blob
+
+    def empty_retrieve(block_root):
+        return []  # no cell-sets sampled at all
+
+    spec.retrieve_cells_and_proofs = empty_retrieve
+    try:
+        assert not spec.is_data_available(root, commitments)
+    finally:
+        del spec.retrieve_cells_and_proofs
