@@ -1,0 +1,148 @@
+"""Capella light-client sync-protocol tests: the store machinery over
+headers that carry the execution payload + inclusion branch.
+
+Reference model: ``test/altair/light_client/test_sync.py`` shapes run at
+the capella fork against ``specs/capella/light-client/sync-protocol.md``
+(LightClientHeader gains ``execution``/``execution_branch``;
+``is_valid_light_client_header`` verifies the body-root inclusion).
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, with_config_overrides, always_bls,
+    never_bls,
+)
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block,
+)
+from consensus_specs_tpu.test_infra.sync_committee import (
+    compute_aggregate_sync_committee_signature, compute_committee_indices,
+)
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+capella_lc_active = with_config_overrides({
+    "ALTAIR_FORK_EPOCH": 0, "BELLATRIX_FORK_EPOCH": 0,
+    "CAPELLA_FORK_EPOCH": 0,
+})
+
+
+def _advance_chain(spec, state, n_blocks):
+    out = []
+    for _ in range(n_blocks):
+        block = build_empty_block_for_next_slot(spec, state)
+        signed = state_transition_and_sign_block(spec, state, block)
+        out.append((signed, state.copy()))
+    return out
+
+
+def _signed_sync_aggregate(spec, signing_state, attested_root,
+                           signature_slot, participation=1.0):
+    committee_indices = compute_committee_indices(signing_state)
+    n = int(len(committee_indices) * participation)
+    participants = committee_indices[:n]
+    bits = [i < n for i in range(len(committee_indices))]
+    signature = compute_aggregate_sync_committee_signature(
+        spec, signing_state, signature_slot - 1, participants,
+        block_root=attested_root)
+    return spec.SyncAggregate(sync_committee_bits=bits,
+                              sync_committee_signature=signature)
+
+
+def _bootstrap_store(spec, chain):
+    signed_block, post_state = chain[0]
+    bootstrap = spec.create_light_client_bootstrap(post_state, signed_block)
+    trusted_root = hash_tree_root(signed_block.message)
+    return spec.initialize_light_client_store(trusted_root, bootstrap)
+
+
+@with_phases(["capella"])
+@capella_lc_active
+@spec_state_test
+@never_bls
+def test_bootstrap_header_carries_execution(spec, state):
+    """A capella bootstrap header embeds the execution payload header
+    with a valid body-root inclusion branch."""
+    chain = _advance_chain(spec, state, 1)
+    store = _bootstrap_store(spec, chain)
+    signed_block, post_state = chain[0]
+    header = store.finalized_header
+    assert spec.is_valid_light_client_header(header)
+    assert header.execution.block_hash == \
+        post_state.latest_execution_payload_header.block_hash
+    # tampering any execution field breaks the inclusion branch
+    bad = header.copy()
+    bad.execution.gas_limit += 1
+    assert not spec.is_valid_light_client_header(bad)
+
+
+@with_phases(["capella"])
+@capella_lc_active
+@spec_state_test
+@never_bls
+def test_tampered_execution_branch_rejected(spec, state):
+    chain = _advance_chain(spec, state, 1)
+    signed_block, _ = chain[0]
+    header = spec.block_to_light_client_header(signed_block)
+    assert spec.is_valid_light_client_header(header)
+    bad = header.copy()
+    bad.execution_branch[0] = b"\x27" * 32
+    assert not spec.is_valid_light_client_header(bad)
+
+
+@with_phases(["capella"])
+@capella_lc_active
+@spec_state_test
+@always_bls
+def test_process_light_client_update_capella(spec, state):
+    """The full update pipeline accepts a capella header and advances
+    the optimistic head."""
+    chain = _advance_chain(spec, state, 2)
+    store = _bootstrap_store(spec, chain)
+    attested_block, attested_state = chain[1]
+
+    attested_header = spec.block_to_light_client_header(attested_block)
+    assert spec.is_valid_light_client_header(attested_header)
+    signature_slot = attested_block.message.slot + 1
+    sync_aggregate = _signed_sync_aggregate(
+        spec, attested_state, hash_tree_root(attested_block.message),
+        signature_slot)
+    update = spec.LightClientUpdate(
+        attested_header=attested_header,
+        sync_aggregate=sync_aggregate,
+        signature_slot=signature_slot,
+    )
+    spec.process_light_client_update(
+        store, update, signature_slot,
+        attested_state.genesis_validators_root)
+    assert store.optimistic_header.beacon.slot == attested_block.message.slot
+    assert store.optimistic_header.execution.block_hash == \
+        attested_header.execution.block_hash
+
+
+@with_phases(["capella"])
+@capella_lc_active
+@spec_state_test
+@always_bls
+def test_update_with_invalid_header_rejected(spec, state):
+    """validate_light_client_update must reject an attested header whose
+    execution branch does not include its execution payload."""
+    chain = _advance_chain(spec, state, 2)
+    store = _bootstrap_store(spec, chain)
+    attested_block, attested_state = chain[1]
+
+    attested_header = spec.block_to_light_client_header(attested_block)
+    attested_header.execution.gas_used += 1  # breaks the inclusion proof
+    signature_slot = attested_block.message.slot + 1
+    sync_aggregate = _signed_sync_aggregate(
+        spec, attested_state, hash_tree_root(attested_block.message),
+        signature_slot)
+    update = spec.LightClientUpdate(
+        attested_header=attested_header,
+        sync_aggregate=sync_aggregate,
+        signature_slot=signature_slot,
+    )
+    try:
+        spec.process_light_client_update(
+            store, update, signature_slot,
+            attested_state.genesis_validators_root)
+        raise SystemExit("invalid capella header must be rejected")
+    except AssertionError:
+        pass

@@ -1,0 +1,124 @@
+"""Deneb light-client sync-protocol tests: headers with blob-gas fields
+through the store machinery.
+
+Reference model: ``test/altair/light_client/test_sync.py`` shapes run at
+the deneb fork against ``specs/deneb/light-client/sync-protocol.md``
+(execution header gains ``blob_gas_used``/``excess_blob_gas``; both must
+be zero for pre-deneb headers).
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, with_config_overrides, always_bls,
+    never_bls,
+)
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block,
+)
+from consensus_specs_tpu.test_infra.sync_committee import (
+    compute_aggregate_sync_committee_signature, compute_committee_indices,
+)
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+deneb_lc_active = with_config_overrides({
+    "ALTAIR_FORK_EPOCH": 0, "BELLATRIX_FORK_EPOCH": 0,
+    "CAPELLA_FORK_EPOCH": 0, "DENEB_FORK_EPOCH": 0,
+})
+
+
+def _advance_chain(spec, state, n_blocks):
+    out = []
+    for _ in range(n_blocks):
+        block = build_empty_block_for_next_slot(spec, state)
+        signed = state_transition_and_sign_block(spec, state, block)
+        out.append((signed, state.copy()))
+    return out
+
+
+def _signed_sync_aggregate(spec, signing_state, attested_root,
+                           signature_slot):
+    committee_indices = compute_committee_indices(signing_state)
+    bits = [True] * len(committee_indices)
+    signature = compute_aggregate_sync_committee_signature(
+        spec, signing_state, signature_slot - 1, committee_indices,
+        block_root=attested_root)
+    return spec.SyncAggregate(sync_committee_bits=bits,
+                              sync_committee_signature=signature)
+
+
+def _bootstrap_store(spec, chain):
+    signed_block, post_state = chain[0]
+    bootstrap = spec.create_light_client_bootstrap(post_state, signed_block)
+    trusted_root = hash_tree_root(signed_block.message)
+    return spec.initialize_light_client_store(trusted_root, bootstrap)
+
+
+@with_phases(["deneb"])
+@deneb_lc_active
+@spec_state_test
+@never_bls
+def test_bootstrap_header_carries_blob_gas(spec, state):
+    """A deneb bootstrap header validates with its blob-gas fields and
+    fails once they are tampered (the inclusion branch covers them)."""
+    chain = _advance_chain(spec, state, 1)
+    store = _bootstrap_store(spec, chain)
+    header = store.finalized_header
+    assert spec.is_valid_light_client_header(header)
+    bad = header.copy()
+    bad.execution.blob_gas_used += 1
+    assert not spec.is_valid_light_client_header(bad)
+
+
+@with_phases(["deneb"])
+@deneb_lc_active
+@spec_state_test
+@always_bls
+def test_process_light_client_update_deneb(spec, state):
+    chain = _advance_chain(spec, state, 2)
+    store = _bootstrap_store(spec, chain)
+    attested_block, attested_state = chain[1]
+
+    attested_header = spec.block_to_light_client_header(attested_block)
+    assert spec.is_valid_light_client_header(attested_header)
+    signature_slot = attested_block.message.slot + 1
+    sync_aggregate = _signed_sync_aggregate(
+        spec, attested_state, hash_tree_root(attested_block.message),
+        signature_slot)
+    update = spec.LightClientUpdate(
+        attested_header=attested_header,
+        sync_aggregate=sync_aggregate,
+        signature_slot=signature_slot,
+    )
+    spec.process_light_client_update(
+        store, update, signature_slot,
+        attested_state.genesis_validators_root)
+    assert store.optimistic_header.beacon.slot == attested_block.message.slot
+    assert store.optimistic_header.execution.excess_blob_gas == \
+        attested_header.execution.excess_blob_gas
+
+
+@with_phases(["deneb"])
+@deneb_lc_active
+@spec_state_test
+@always_bls
+def test_update_with_tampered_blob_gas_rejected(spec, state):
+    chain = _advance_chain(spec, state, 2)
+    store = _bootstrap_store(spec, chain)
+    attested_block, attested_state = chain[1]
+
+    attested_header = spec.block_to_light_client_header(attested_block)
+    attested_header.execution.excess_blob_gas += 1  # breaks inclusion
+    signature_slot = attested_block.message.slot + 1
+    sync_aggregate = _signed_sync_aggregate(
+        spec, attested_state, hash_tree_root(attested_block.message),
+        signature_slot)
+    update = spec.LightClientUpdate(
+        attested_header=attested_header,
+        sync_aggregate=sync_aggregate,
+        signature_slot=signature_slot,
+    )
+    try:
+        spec.process_light_client_update(
+            store, update, signature_slot,
+            attested_state.genesis_validators_root)
+        raise SystemExit("tampered deneb header must be rejected")
+    except AssertionError:
+        pass
