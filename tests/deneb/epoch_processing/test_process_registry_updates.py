@@ -1,0 +1,54 @@
+"""Deneb registry updates: EIP-7514 activation-churn cap.
+
+Reference model:
+``test/deneb/epoch_processing/test_process_registry_updates.py`` against
+``specs/deneb/beacon-chain.md`` (``get_validator_activation_churn_limit``
+= min(MAX_PER_EPOCH_ACTIVATION_CHURN_LIMIT, churn)).
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases,
+)
+
+
+
+def _queue_n_eligible(spec, state, n):
+    """Make the first n validators eligible for activation dequeue:
+    eligibility epoch 0 <= the genesis finalized epoch, activation
+    still unset."""
+    indices = []
+    for i in range(n):
+        v = state.validators[i]
+        v.activation_eligibility_epoch = 0
+        v.activation_epoch = spec.FAR_FUTURE_EPOCH
+        indices.append(i)
+    return indices
+
+
+@with_phases(["deneb"])
+@spec_state_test
+def test_activation_churn_is_capped(spec, state):
+    """More eligible validators than the churn: only the (EIP-7514
+    capped) activation-churn's worth dequeue per sweep."""
+    cap = int(spec.MAX_PER_EPOCH_ACTIVATION_CHURN_LIMIT)
+    n = cap + 3
+    indices = _queue_n_eligible(spec, state, n)
+    limit = int(spec.get_validator_activation_churn_limit(state))
+
+    yield "pre", state
+    spec.process_registry_updates(state)
+    yield "post", state
+
+    activated = [i for i in indices
+                 if state.validators[i].activation_epoch
+                 != spec.FAR_FUTURE_EPOCH]
+    assert len(activated) == min(n, limit)
+    assert len(activated) <= cap
+
+
+@with_phases(["deneb"])
+@spec_state_test
+def test_activation_churn_limit_value(spec, state):
+    """The deneb limit is the capella churn clamped by the EIP-7514 cap."""
+    base = spec.get_validator_churn_limit(state)
+    got = spec.get_validator_activation_churn_limit(state)
+    assert got == min(spec.MAX_PER_EPOCH_ACTIVATION_CHURN_LIMIT, base)

@@ -1,0 +1,101 @@
+"""Deneb sanity blocks: blob commitments through the full transition and
+the EIP-7045 extended attestation-inclusion window.
+
+Reference model: ``test/deneb/sanity/test_blocks.py`` (blob-carrying
+blocks) and the EIP-7045 cases in
+``test/deneb/block_processing/test_process_attestation.py`` against
+``specs/deneb/beacon-chain.md``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, expect_assertion_error,
+)
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot, next_slots, next_epoch,
+    state_transition_and_sign_block,
+)
+from consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+
+
+def _blob_block(spec, state, n_commitments):
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.blob_kzg_commitments = [
+        spec.G1_POINT_AT_INFINITY] * n_commitments
+    return block
+
+
+@with_phases(["deneb"])
+@spec_state_test
+def test_zero_blob_block(spec, state):
+    yield "pre", state
+    block = _blob_block(spec, state, 0)
+    signed = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed]
+    yield "post", state
+
+
+@with_phases(["deneb"])
+@spec_state_test
+def test_one_blob_block(spec, state):
+    yield "pre", state
+    block = _blob_block(spec, state, 1)
+    signed = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed]
+    yield "post", state
+    assert state.latest_block_header.body_root == \
+        signed.message.body_root if hasattr(signed.message, "body_root") \
+        else True
+
+
+@with_phases(["deneb"])
+@spec_state_test
+def test_max_blobs_block(spec, state):
+    yield "pre", state
+    block = _blob_block(spec, state, spec.MAX_BLOBS_PER_BLOCK)
+    signed = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed]
+    yield "post", state
+
+
+@with_phases(["deneb"])
+@spec_state_test
+def test_invalid_blob_count_block(spec, state):
+    """MAX_BLOBS_PER_BLOCK + 1 commitments invalidate the whole block."""
+    yield "pre", state
+    block = _blob_block(spec, state, spec.MAX_BLOBS_PER_BLOCK + 1)
+    expect_assertion_error(
+        lambda: state_transition_and_sign_block(spec, state, block))
+    yield "post", None
+
+
+@with_phases(["deneb"])
+@spec_state_test
+def test_attestation_included_after_epoch_window(spec, state):
+    """EIP-7045: a current-or-previous-epoch attestation is includable at
+    ANY later slot — beyond phase0's one-epoch SLOTS_PER_EPOCH bound."""
+    next_epoch(spec, state)  # leave genesis epoch
+    attestation = get_valid_attestation(spec, state, signed=True)
+    # advance past the pre-deneb inclusion bound (slot + SLOTS_PER_EPOCH)
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH + 2)
+    assert state.slot > attestation.data.slot + spec.SLOTS_PER_EPOCH
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attestations = [attestation]
+    signed = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed]
+    yield "post", state
+
+
+@with_phases(["deneb"])
+@spec_state_test
+def test_attestation_from_two_epochs_ago_invalid(spec, state):
+    """The window extends only within current/previous target epochs:
+    an attestation two epochs old still fails the target check."""
+    next_epoch(spec, state)
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, 2 * spec.SLOTS_PER_EPOCH + 2)
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attestations = [attestation]
+    expect_assertion_error(
+        lambda: state_transition_and_sign_block(spec, state, block))
+    yield "post", None
