@@ -225,10 +225,22 @@ CONFIGS = {
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--configs", default="1,2,3,4,5")
+    parser.add_argument("--profile", action="store_true",
+                        help="print the per-stage span breakdown "
+                             "(utils/profiling) after each config")
     ns = parser.parse_args()
+    if ns.profile:
+        from consensus_specs_tpu.utils import profiling
+        profiling.enable()
     for key in ns.configs.split(","):
+        if ns.profile:
+            from consensus_specs_tpu.utils import profiling
+            profiling.reset()
         result = CONFIGS[key.strip()]()
         print(json.dumps(result), flush=True)
+        if ns.profile:
+            print(json.dumps({"config": key.strip(),
+                              "stages": profiling.stats()}), flush=True)
 
 
 if __name__ == "__main__":

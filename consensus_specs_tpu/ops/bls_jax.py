@@ -29,12 +29,21 @@ from consensus_specs_tpu.ops.jax_bls.backend import (
     xp as jnp, kjit, NUMPY_KERNELS)
 
 from consensus_specs_tpu.ops.bls12_381 import ciphersuite as _oracle
+from consensus_specs_tpu.utils import profiling
 from consensus_specs_tpu.utils.profiling import span
 from consensus_specs_tpu.ops.bls12_381.curve import (
     G1Point, G2Point, G1_GENERATOR, g1_from_compressed, g2_from_compressed)
 from consensus_specs_tpu.ops.jax_bls import points as PT
 from consensus_specs_tpu.ops.jax_bls import pairing as PR
 from consensus_specs_tpu.ops.jax_bls import htc as HTC
+
+
+def _profile_sync(tree):
+    """Drain device work at a stage boundary, but ONLY while profiling —
+    unconditional blocking would serialize the async dispatch pipeline
+    the staged TPU path relies on."""
+    if profiling.is_enabled():
+        jax.block_until_ready(tree)
 
 # Cold-path delegation (oracle)
 Sign = _oracle.Sign
@@ -301,8 +310,12 @@ def _program_agg_verify(pk_pts, u0, u1, sig_q, agg_degen, sig_degen):
     if fuse_verify():
         return _program_agg_verify_fused(pk_pts, u0, u1, sig_q, agg_degen,
                                          sig_degen)
-    agg, agg_inf = _program_aggregate(pk_pts)
-    hpt = _program_htc(u0, u1)
+    with span("bls.stage.aggregate"):
+        agg, agg_inf = _program_aggregate(pk_pts)
+        _profile_sync(agg)
+    with span("bls.stage.htc"):
+        hpt = _program_htc(u0, u1)
+        _profile_sync(hpt)
     # assemble (pairs=2, B, ...) inputs for the staged pairing pipeline
     px = jnp.stack([agg[0], jnp.broadcast_to(_NEG_G1[0][0], agg[0].shape)])
     py = jnp.stack([agg[1], jnp.broadcast_to(_NEG_G1[1][0], agg[1].shape)])
@@ -311,8 +324,9 @@ def _program_agg_verify(pk_pts, u0, u1, sig_q, agg_degen, sig_degen):
     qy0 = jnp.stack([hpt[1][0], sig_q[1][0]])
     qy1 = jnp.stack([hpt[1][1], sig_q[1][1]])
     degen = jnp.stack([agg_degen | agg_inf, sig_degen])
-    return np.asarray(PR.staged_pairing_check(
-        px, py, ((qx0, qx1), (qy0, qy1)), degen))
+    with span("bls.stage.pairing"):
+        return np.asarray(PR.staged_pairing_check(
+            px, py, ((qx0, qx1), (qy0, qy1)), degen))
 
 
 # ---------------------------------------------------------------------------
@@ -345,6 +359,13 @@ def _verify_aggregates_batch(items) -> list:
         return [bool(r) for r in results_host]
 
     B = bucket_b()
+    # A lone wide aggregate (altair's 512-key sync committee) would pad
+    # B-1 dead lanes through aggregation+hash-to-curve+pairing — an 8-16x
+    # waste exactly where the work per lane is largest.  Give it a 1-lane
+    # program set instead; the >=128 floor keeps small single verifies on
+    # the shared lane bucket so this adds at most one extra compile set.
+    if len(rows) == 1 and _pow2(len(rows[0][1])) >= 128:
+        B = 1
     for start in range(0, len(rows), B):
         chunk = rows[start:start + B]
         n_pad = max(_N_MIN, _pow2(max(len(r[1]) for r in chunk)))
@@ -358,10 +379,12 @@ def _verify_aggregates_batch(items) -> list:
             sig_pts.append(G2Point.inf())
             msgs.append(b"")
 
-        packed = PT.g1_stack_packed(pk_rows, n_pad)
-        pk_pts = jax.tree_util.tree_map(
-            lambda a: a.reshape((B, n_pad) + a.shape[1:]), packed)
-        u0, u1 = HTC.hash_to_field_host(msgs)
+        with span("bls.stage.host_pack"):
+            packed = PT.g1_stack_packed(pk_rows, n_pad)
+            pk_pts = jax.tree_util.tree_map(
+                lambda a: a.reshape((B, n_pad) + a.shape[1:]), packed)
+        with span("bls.stage.hash_to_field"):
+            u0, u1 = HTC.hash_to_field_host(msgs)
         sig_packed = PT.g2_pack(sig_pts)
         sig_q = (sig_packed[0], sig_packed[1])
         sig_degen = jnp.array([p.infinity for p in sig_pts])

@@ -29,6 +29,10 @@ def enable(on: bool = True) -> None:
     _enabled = on
 
 
+def is_enabled() -> bool:
+    return _enabled
+
+
 def reset() -> None:
     _stats.clear()
 
