@@ -60,10 +60,16 @@ $(addprefix gen_,$(GENERATORS)): gen_%:
 	$(PYTHON) generators/$*/main.py -o $(OUTPUT_DIR)
 
 # native C components (raw-snappy codec for vector IO, SHA-256 merkle
-# layer hasher for host-side merkleization)
+# layer hasher for host-side merkleization, BLS12-381 signature backend
+# — the reference's milagro/arkworks role; constants generated from the
+# python oracle by csrc/gen_bls_consts.py)
 native:
 	gcc -O2 -shared -fPIC -o csrc/libcsnappy.so csrc/snappy.c
 	gcc -O3 -shared -fPIC -o csrc/libcsha256.so csrc/sha256_merkle.c
+	gcc -O2 -shared -fPIC -o csrc/libcbls12381.so csrc/bls12_381.c
+
+bls-consts:
+	$(PYTHON) csrc/gen_bls_consts.py > csrc/bls12_381_consts.h
 
 clean-vectors:
 	rm -rf $(OUTPUT_DIR)/tests

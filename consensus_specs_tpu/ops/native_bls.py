@@ -1,0 +1,218 @@
+"""ctypes binding for the native C BLS12-381 backend.
+
+The CPU-native backend of the module switch (``utils/bls.py``): plays
+the role the Rust milagro/arkworks bindings play for the reference
+(reference backend ladder: ``tests/core/pyspec/eth2spec/utils/bls.py:30-53``).
+Exposes the same 9-function API as the python oracle
+(``ops/bls12_381/ciphersuite.py``); the shared library is built from
+``csrc/bls12_381.c`` (constants generated from the oracle by
+``csrc/gen_bls_consts.py``).
+
+Semantics mirror the oracle exactly: verification functions return
+``False`` on any malformed input; ``Aggregate``/``AggregatePKs`` raise
+``ValueError`` on empty/invalid input; ``Sign``/``SkToPk`` raise on an
+out-of-range secret key.
+
+The library auto-builds on first import when gcc is available (a few
+seconds, cached as ``csrc/libcbls12381.so``); set
+``CS_TPU_NO_NATIVE_BLS=1`` to disable the backend entirely.
+"""
+import ctypes
+import os
+import subprocess
+from typing import Optional, Sequence
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "csrc")
+_SO = os.path.join(_CSRC, "libcbls12381.so")
+_SRC = os.path.join(_CSRC, "bls12_381.c")
+
+
+def _build() -> bool:
+    try:
+        res = subprocess.run(
+            ["gcc", "-O2", "-shared", "-fPIC", "-o", _SO + ".tmp", _SRC],
+            capture_output=True, timeout=120, cwd=_CSRC)
+        if res.returncode != 0:
+            return False
+        os.replace(_SO + ".tmp", _SO)
+        return True
+    except Exception:
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    if os.environ.get("CS_TPU_NO_NATIVE_BLS") == "1":
+        return None
+    stale = (not os.path.exists(_SO)
+             or (os.path.exists(_SRC)
+                 and os.path.getmtime(_SRC) > os.path.getmtime(_SO)))
+    if stale and not _build():
+        if not os.path.exists(_SO):
+            return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    u8p, sz = ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t
+    protos = {
+        "cbls_key_validate": [ctypes.c_char_p],
+        "cbls_verify": [ctypes.c_char_p, ctypes.c_char_p, sz, ctypes.c_char_p],
+        "cbls_fast_aggregate_verify":
+            [ctypes.c_char_p, sz, ctypes.c_char_p, sz, ctypes.c_char_p],
+        "cbls_aggregate_verify":
+            [ctypes.c_char_p, sz, ctypes.c_char_p,
+             ctypes.POINTER(ctypes.c_uint64), ctypes.c_char_p],
+        "cbls_aggregate_sigs": [ctypes.c_char_p, sz, ctypes.c_char_p],
+        "cbls_aggregate_pks": [ctypes.c_char_p, sz, ctypes.c_char_p],
+        "cbls_sk_to_pk": [ctypes.c_char_p, ctypes.c_char_p],
+        "cbls_sign": [ctypes.c_char_p, ctypes.c_char_p, sz, ctypes.c_char_p],
+        "cbls_hash_to_g2":
+            [ctypes.c_char_p, sz, ctypes.c_char_p, sz, ctypes.c_char_p],
+        "cbls_pairing_check": [ctypes.c_char_p, ctypes.c_char_p, sz],
+        "cbls_g1_mult": [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p],
+        "cbls_g1_msm": [ctypes.c_char_p, ctypes.c_char_p, sz, ctypes.c_char_p],
+        "cbls_selftest": [],
+    }
+    try:
+        for name, argtypes in protos.items():
+            fn = getattr(lib, name)
+            fn.argtypes = argtypes
+            fn.restype = ctypes.c_int
+        if lib.cbls_selftest() != 1:
+            return None
+    except AttributeError:
+        return None
+    del u8p
+    return lib
+
+
+_lib = _load()
+
+
+def available() -> bool:
+    return _lib is not None
+
+
+def _req() -> ctypes.CDLL:
+    if _lib is None:
+        raise RuntimeError("native BLS library unavailable "
+                           "(build csrc/libcbls12381.so or unset "
+                           "CS_TPU_NO_NATIVE_BLS)")
+    return _lib
+
+
+# ---------------------------------------------------------------------------
+# The 9-function backend API (same surface as ops/bls12_381/ciphersuite.py)
+# ---------------------------------------------------------------------------
+
+def SkToPk(sk: int) -> bytes:
+    if not 0 < sk < (1 << 256):
+        raise ValueError("secret key out of range")
+    out = ctypes.create_string_buffer(48)
+    if _req().cbls_sk_to_pk(sk.to_bytes(32, "big"), out) != 1:
+        raise ValueError("secret key out of range")
+    return out.raw
+
+
+def Sign(sk: int, msg: bytes) -> bytes:
+    if not 0 < sk < (1 << 256):
+        raise ValueError("secret key out of range")
+    out = ctypes.create_string_buffer(96)
+    if _req().cbls_sign(sk.to_bytes(32, "big"), bytes(msg), len(msg),
+                        out) != 1:
+        raise ValueError("secret key out of range")
+    return out.raw
+
+
+def KeyValidate(pk: bytes) -> bool:
+    pk = bytes(pk)
+    if len(pk) != 48:
+        return False
+    return _req().cbls_key_validate(pk) == 1
+
+
+def Verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
+    pk, msg, sig = bytes(pk), bytes(msg), bytes(sig)
+    if len(pk) != 48 or len(sig) != 96:
+        return False
+    return _req().cbls_verify(pk, msg, len(msg), sig) == 1
+
+
+def FastAggregateVerify(pks: Sequence[bytes], msg: bytes, sig: bytes) -> bool:
+    pks = [bytes(p) for p in pks]
+    msg, sig = bytes(msg), bytes(sig)
+    if not pks or any(len(p) != 48 for p in pks) or len(sig) != 96:
+        return False
+    return _req().cbls_fast_aggregate_verify(
+        b"".join(pks), len(pks), msg, len(msg), sig) == 1
+
+
+def AggregateVerify(pks: Sequence[bytes], msgs: Sequence[bytes],
+                    sig: bytes) -> bool:
+    pks = [bytes(p) for p in pks]
+    msgs = [bytes(m) for m in msgs]
+    sig = bytes(sig)
+    if (not pks or len(pks) != len(msgs)
+            or any(len(p) != 48 for p in pks) or len(sig) != 96):
+        return False
+    lens = (ctypes.c_uint64 * len(msgs))(*[len(m) for m in msgs])
+    return _req().cbls_aggregate_verify(
+        b"".join(pks), len(pks), b"".join(msgs), lens, sig) == 1
+
+
+def Aggregate(signatures: Sequence[bytes]) -> bytes:
+    sigs = [bytes(s) for s in signatures]
+    if not sigs:
+        raise ValueError("cannot aggregate empty signature list")
+    if any(len(s) != 96 for s in sigs):
+        raise ValueError("malformed signature length")
+    out = ctypes.create_string_buffer(96)
+    if _req().cbls_aggregate_sigs(b"".join(sigs), len(sigs), out) != 1:
+        raise ValueError("invalid signature in aggregation")
+    return out.raw
+
+
+def AggregatePKs(pubkeys: Sequence[bytes]) -> bytes:
+    pks = [bytes(p) for p in pubkeys]
+    if not pks:
+        raise ValueError("cannot aggregate empty pubkey list")
+    if any(len(p) != 48 for p in pks):
+        raise ValueError("malformed pubkey length")
+    out = ctypes.create_string_buffer(48)
+    if _req().cbls_aggregate_pks(b"".join(pks), len(pks), out) != 1:
+        raise ValueError("invalid pubkey in aggregation")
+    return out.raw
+
+
+# --------------------------------------------------------------------------
+# Extras used by tests / the KZG path
+# --------------------------------------------------------------------------
+
+def hash_to_g2_compressed(msg: bytes, dst: bytes) -> bytes:
+    out = ctypes.create_string_buffer(96)
+    if _req().cbls_hash_to_g2(bytes(msg), len(msg), bytes(dst), len(dst),
+                              out) != 1:
+        raise ValueError("hash_to_g2 failed")
+    return out.raw
+
+
+def pairing_check_compressed(g1s: Sequence[bytes], g2s: Sequence[bytes]) -> bool:
+    g1s, g2s = [bytes(p) for p in g1s], [bytes(q) for q in g2s]
+    if (len(g1s) != len(g2s) or len(g1s) > 64
+            or any(len(p) != 48 for p in g1s)
+            or any(len(q) != 96 for q in g2s)):
+        raise ValueError("bad pairing-check input")
+    return _req().cbls_pairing_check(b"".join(g1s), b"".join(g2s),
+                                     len(g1s)) == 1
+
+
+def g1_msm_compressed(points: Sequence[bytes], scalars: Sequence[int]) -> bytes:
+    pts = [bytes(p) for p in points]
+    if len(pts) != len(scalars) or any(len(p) != 48 for p in pts):
+        raise ValueError("bad MSM input")
+    out = ctypes.create_string_buffer(48)
+    sc = b"".join(int(s).to_bytes(32, "big") for s in scalars)
+    if _req().cbls_g1_msm(b"".join(pts), sc, len(pts), out) != 1:
+        raise ValueError("invalid MSM input")
+    return out.raw

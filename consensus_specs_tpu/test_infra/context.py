@@ -165,6 +165,8 @@ def _consume(result):
 def _set_bls_backend():
     if DEFAULT_BLS_TYPE == "jax":
         bls.use_jax()
+    elif DEFAULT_BLS_TYPE == "native":
+        bls.use_native()
     elif DEFAULT_BLS_TYPE == "fastest":
         bls.use_fastest()
     else:

@@ -58,11 +58,40 @@ def use_jax():
     _backend_name = "jax"
 
 
+def use_native():
+    """CPU-native C backend (csrc/bls12_381.c) — the role the reference's
+    Rust milagro/arkworks bindings play (bls.py:61-72); ~20-25x the
+    python oracle on one core."""
+    global _backend, _backend_name
+    from consensus_specs_tpu.ops import native_bls
+    if not native_bls.available():
+        raise RuntimeError("native BLS library unavailable")
+    if _backend_name != "native":
+        clear_verify_memo()
+    _backend = native_bls
+    _backend_name = "native"
+
+
 def use_fastest():
+    """Backend ladder (reference ``fastest_bls``, bls.py:35-53): the JAX
+    kernels when an accelerator is attached, else the native C library,
+    else the python oracle.  On a bare CPU the jax path pays minutes of
+    XLA compile for sub-oracle throughput, so it is only 'fastest' when
+    a real device is present."""
     try:
-        use_jax()
+        from consensus_specs_tpu.utils.jax_env import accelerator_cached
+        if accelerator_cached():
+            use_jax()
+            return
     except Exception:
-        use_py()
+        pass
+    try:
+        use_native()
+    except Exception:
+        try:
+            use_jax()
+        except Exception:
+            use_py()
 
 
 def backend_name() -> str:

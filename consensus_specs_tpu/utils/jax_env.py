@@ -189,6 +189,28 @@ def ensure_working_backend(timeout: int = 90) -> str:
 _PROBE_RESULT = None
 
 
+def accelerator_cached() -> bool:
+    """True iff an accelerator backend is already KNOWN to be live in
+    this process — from a prior probe or an initialized jax backend.
+    Never probes or initializes anything itself (a dead tunnel hangs
+    ``jax.devices()``, and this is called from hot backend-selection
+    paths like ``bls.use_fastest``)."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        return False
+    if _PROBE_RESULT == "default":
+        return True
+    import sys
+    if "jax" in sys.modules:
+        try:
+            import jax
+            from jax._src import xla_bridge
+            if getattr(xla_bridge, "_backends", None):
+                return jax.default_backend() != "cpu"
+        except Exception:
+            return False
+    return False
+
+
 def setup_compile_cache() -> str:
     """Point JAX at the keyed persistent cache; idempotent.
 

@@ -1,0 +1,1684 @@
+/* BLS12-381 signature backend, from-scratch C implementation.
+ *
+ * The CPU-native crypto backend of the framework: plays the role the
+ * Rust milagro/arkworks bindings play for the reference
+ * (reference: tests/core/pyspec/eth2spec/utils/bls.py:30-53 backend
+ * ladder; SURVEY.md section 2.3).  The JAX kernel stack targets the
+ * TPU; this library makes the CPU fallback faster than the pure-python
+ * oracle by orders of magnitude.
+ *
+ * Design:
+ *  - Fp: 6x64-bit limbs, Montgomery form, CIOS multiplication via
+ *    unsigned __int128.  Montgomery constants (R, R^2, -p^-1 mod 2^64)
+ *    are DERIVED at init, not hardcoded.
+ *  - Tower Fq2 = Fq[u]/(u^2+1), Fq6 = Fq2[v]/(v^3 - xi), xi = 1+u,
+ *    Fq12 = Fq6[w]/(w^2 - v) - the same tower as the python oracle
+ *    (consensus_specs_tpu/ops/bls12_381/fields.py).
+ *  - G1/G2 in Jacobian coordinates (a=0 formulas).
+ *  - Optimal ate Miller loop with G2 untwist (x/w^2, y/w^3); line
+ *    denominators and overall Fq2 factors are dropped (killed by the
+ *    final exponentiation since c^(p^6-1) = 1 for c in Fq2*).
+ *  - Final exponentiation: cheap easy part, then plain square-and-
+ *    multiply by the hardcoded (p^4 - p^2 + 1)/r (correctness over
+ *    micro-optimised x-chains).
+ *  - hash-to-curve: RFC 9380 BLS12381G2_XMD:SHA-256_SSWU_RO_ with the
+ *    E.3 3-isogeny and Budroni-Pintore psi cofactor clearing,
+ *    mirroring the oracle (ops/bls12_381/hash_to_curve.py).
+ *  - Subgroup checks by the z-ladder identity [r]P = [z^2]([z^2]P - P) + P
+ *    (r = z^4 - z^2 + 1), no endomorphism shortcuts.
+ *
+ * Every curve constant is generated from the python oracle by
+ * csrc/gen_bls_consts.py (single source of truth).  API returns:
+ * 1 = true/ok, 0 = false/invalid-input (mirrors the oracle's
+ * exception-as-False semantics), negative = usage error.
+ */
+#include <stddef.h>
+#include <stdint.h>
+#include <string.h>
+
+#include "bls12_381_consts.h"
+
+typedef unsigned __int128 u128;
+
+/* ================================================================= */
+/* SHA-256 (compact, for expand_message_xmd)                          */
+/* ================================================================= */
+
+typedef struct { uint32_t h[8]; uint64_t len; uint8_t buf[64]; size_t fill; } sha_t;
+
+static const uint32_t SHA_K[64] = {
+    0x428a2f98,0x71374491,0xb5c0fbcf,0xe9b5dba5,0x3956c25b,0x59f111f1,
+    0x923f82a4,0xab1c5ed5,0xd807aa98,0x12835b01,0x243185be,0x550c7dc3,
+    0x72be5d74,0x80deb1fe,0x9bdc06a7,0xc19bf174,0xe49b69c1,0xefbe4786,
+    0x0fc19dc6,0x240ca1cc,0x2de92c6f,0x4a7484aa,0x5cb0a9dc,0x76f988da,
+    0x983e5152,0xa831c66d,0xb00327c8,0xbf597fc7,0xc6e00bf3,0xd5a79147,
+    0x06ca6351,0x14292967,0x27b70a85,0x2e1b2138,0x4d2c6dfc,0x53380d13,
+    0x650a7354,0x766a0abb,0x81c2c92e,0x92722c85,0xa2bfe8a1,0xa81a664b,
+    0xc24b8b70,0xc76c51a3,0xd192e819,0xd6990624,0xf40e3585,0x106aa070,
+    0x19a4c116,0x1e376c08,0x2748774c,0x34b0bcb5,0x391c0cb3,0x4ed8aa4a,
+    0x5b9cca4f,0x682e6ff3,0x748f82ee,0x78a5636f,0x84c87814,0x8cc70208,
+    0x90befffa,0xa4506ceb,0xbef9a3f7,0xc67178f2};
+
+#define ROR(x,n) (((x) >> (n)) | ((x) << (32 - (n))))
+
+static void sha_block(sha_t *s, const uint8_t *p) {
+    uint32_t w[64], a, b, c, d, e, f, g, h;
+    for (int i = 0; i < 16; i++)
+        w[i] = ((uint32_t)p[4*i] << 24) | ((uint32_t)p[4*i+1] << 16) |
+               ((uint32_t)p[4*i+2] << 8) | p[4*i+3];
+    for (int i = 16; i < 64; i++) {
+        uint32_t s0 = ROR(w[i-15],7) ^ ROR(w[i-15],18) ^ (w[i-15] >> 3);
+        uint32_t s1 = ROR(w[i-2],17) ^ ROR(w[i-2],19) ^ (w[i-2] >> 10);
+        w[i] = w[i-16] + s0 + w[i-7] + s1;
+    }
+    a=s->h[0]; b=s->h[1]; c=s->h[2]; d=s->h[3];
+    e=s->h[4]; f=s->h[5]; g=s->h[6]; h=s->h[7];
+    for (int i = 0; i < 64; i++) {
+        uint32_t S1 = ROR(e,6) ^ ROR(e,11) ^ ROR(e,25);
+        uint32_t ch = (e & f) ^ (~e & g);
+        uint32_t t1 = h + S1 + ch + SHA_K[i] + w[i];
+        uint32_t S0 = ROR(a,2) ^ ROR(a,13) ^ ROR(a,22);
+        uint32_t mj = (a & b) ^ (a & c) ^ (b & c);
+        uint32_t t2 = S0 + mj;
+        h=g; g=f; f=e; e=d+t1; d=c; c=b; b=a; a=t1+t2;
+    }
+    s->h[0]+=a; s->h[1]+=b; s->h[2]+=c; s->h[3]+=d;
+    s->h[4]+=e; s->h[5]+=f; s->h[6]+=g; s->h[7]+=h;
+}
+
+static void sha_init(sha_t *s) {
+    static const uint32_t iv[8] = {0x6a09e667,0xbb67ae85,0x3c6ef372,
+        0xa54ff53a,0x510e527f,0x9b05688c,0x1f83d9ab,0x5be0cd19};
+    memcpy(s->h, iv, sizeof iv); s->len = 0; s->fill = 0;
+}
+
+static void sha_update(sha_t *s, const uint8_t *p, size_t n) {
+    s->len += n;
+    while (n) {
+        size_t take = 64 - s->fill; if (take > n) take = n;
+        memcpy(s->buf + s->fill, p, take);
+        s->fill += take; p += take; n -= take;
+        if (s->fill == 64) { sha_block(s, s->buf); s->fill = 0; }
+    }
+}
+
+static void sha_final(sha_t *s, uint8_t out[32]) {
+    uint64_t bits = s->len * 8;
+    uint8_t pad = 0x80;
+    sha_update(s, &pad, 1);
+    uint8_t z = 0;
+    while (s->fill != 56) sha_update(s, &z, 1);
+    uint8_t lb[8];
+    for (int i = 0; i < 8; i++) lb[i] = (uint8_t)(bits >> (56 - 8*i));
+    sha_update(s, lb, 8);
+    for (int i = 0; i < 8; i++) {
+        out[4*i]   = (uint8_t)(s->h[i] >> 24);
+        out[4*i+1] = (uint8_t)(s->h[i] >> 16);
+        out[4*i+2] = (uint8_t)(s->h[i] >> 8);
+        out[4*i+3] = (uint8_t)(s->h[i]);
+    }
+}
+
+/* ================================================================= */
+/* u64[6] bignum helpers (raw, little-endian limbs)                   */
+/* ================================================================= */
+
+static int bn_cmp(const uint64_t *a, const uint64_t *b, int n) {
+    for (int i = n - 1; i >= 0; i--) {
+        if (a[i] != b[i]) return a[i] > b[i] ? 1 : -1;
+    }
+    return 0;
+}
+
+static uint64_t bn_add(uint64_t *r, const uint64_t *a, const uint64_t *b, int n) {
+    u128 c = 0;
+    for (int i = 0; i < n; i++) {
+        c += (u128)a[i] + b[i];
+        r[i] = (uint64_t)c; c >>= 64;
+    }
+    return (uint64_t)c;
+}
+
+static uint64_t bn_sub(uint64_t *r, const uint64_t *a, const uint64_t *b, int n) {
+    u128 br = 0;
+    for (int i = 0; i < n; i++) {
+        u128 t = (u128)a[i] - b[i] - br;
+        r[i] = (uint64_t)t;
+        br = (t >> 64) ? 1 : 0;
+    }
+    return (uint64_t)br;
+}
+
+static void bn_shr1(uint64_t *r, const uint64_t *a, int n) {
+    for (int i = 0; i < n; i++)
+        r[i] = (a[i] >> 1) | (i + 1 < n ? a[i+1] << 63 : 0);
+}
+
+/* divide by a small odd d (3 here), most-significant first */
+static void bn_div_small(uint64_t *r, const uint64_t *a, uint64_t d, int n) {
+    u128 rem = 0;
+    for (int i = n - 1; i >= 0; i--) {
+        u128 cur = (rem << 64) | a[i];
+        r[i] = (uint64_t)(cur / d);
+        rem = cur % d;
+    }
+}
+
+static int bn_is_zero(const uint64_t *a, int n) {
+    for (int i = 0; i < n; i++) if (a[i]) return 0;
+    return 1;
+}
+
+static void be_to_limbs(uint64_t *r, const uint8_t *be, size_t blen, int n) {
+    memset(r, 0, (size_t)n * 8);
+    for (size_t i = 0; i < blen; i++) {
+        size_t k = blen - 1 - i;           /* byte significance */
+        if (k / 8 < (size_t)n) r[k / 8] |= (uint64_t)be[i] << (8 * (k % 8));
+    }
+}
+
+static void limbs_to_be(uint8_t *be, const uint64_t *a, int n) {
+    for (int i = 0; i < n; i++)
+        for (int j = 0; j < 8; j++)
+            be[(n - 1 - i) * 8 + (7 - j)] = (uint8_t)(a[i] >> (8 * j));
+}
+
+/* ================================================================= */
+/* Fp: Montgomery arithmetic                                          */
+/* ================================================================= */
+
+typedef struct { uint64_t l[6]; } fp_t;
+
+static uint64_t FP_N0;          /* -p^-1 mod 2^64 */
+static fp_t FP_ONE;             /* R mod p        */
+static fp_t FP_R2;              /* R^2 mod p      */
+static uint64_t E_PM2[6];       /* p-2            */
+static uint64_t E_PP1_4[6];     /* (p+1)/4        */
+static uint64_t E_PM1_2[6];     /* (p-1)/2        */
+static uint64_t E_PM1_3[6];     /* (p-1)/3        */
+static uint64_t E_PM1_6[6];     /* (p-1)/6        */
+
+static void fp_reduce_once(fp_t *r) {
+    if (bn_cmp(r->l, FP_P, 6) >= 0) bn_sub(r->l, r->l, FP_P, 6);
+}
+
+static void fp_add(fp_t *r, const fp_t *a, const fp_t *b) {
+    bn_add(r->l, a->l, b->l, 6);   /* p < 2^383 so no carry out */
+    fp_reduce_once(r);
+}
+
+static void fp_sub(fp_t *r, const fp_t *a, const fp_t *b) {
+    if (bn_sub(r->l, a->l, b->l, 6)) bn_add(r->l, r->l, FP_P, 6);
+}
+
+static void fp_neg(fp_t *r, const fp_t *a) {
+    if (bn_is_zero(a->l, 6)) { memset(r, 0, sizeof *r); return; }
+    bn_sub(r->l, FP_P, a->l, 6);
+}
+
+static void fp_dbl(fp_t *r, const fp_t *a) { fp_add(r, a, a); }
+
+static int fp_is_zero(const fp_t *a) { return bn_is_zero(a->l, 6); }
+
+static int fp_eq(const fp_t *a, const fp_t *b) { return bn_cmp(a->l, b->l, 6) == 0; }
+
+/* CIOS Montgomery multiplication, 6 limbs */
+static void fp_mul(fp_t *r, const fp_t *a, const fp_t *b) {
+    uint64_t t[8];
+    memset(t, 0, sizeof t);
+    for (int i = 0; i < 6; i++) {
+        u128 c = 0;
+        for (int j = 0; j < 6; j++) {
+            c = (u128)a->l[j] * b->l[i] + t[j] + (uint64_t)c;
+            t[j] = (uint64_t)c;
+            c >>= 64;
+        }
+        c = (u128)t[6] + (uint64_t)c;
+        t[6] = (uint64_t)c;
+        t[7] = (uint64_t)(c >> 64);
+
+        uint64_t m = t[0] * FP_N0;
+        c = (u128)m * FP_P[0] + t[0];
+        c >>= 64;
+        for (int j = 1; j < 6; j++) {
+            c = (u128)m * FP_P[j] + t[j] + (uint64_t)c;
+            t[j-1] = (uint64_t)c;
+            c >>= 64;
+        }
+        c = (u128)t[6] + (uint64_t)c;
+        t[5] = (uint64_t)c;
+        t[6] = t[7] + (uint64_t)(c >> 64);
+        t[7] = 0;
+    }
+    memcpy(r->l, t, 48);
+    /* t[6] can be at most 1; fold it by subtracting p (t < 2p always) */
+    if (t[6] || bn_cmp(r->l, FP_P, 6) >= 0) bn_sub(r->l, r->l, FP_P, 6);
+}
+
+static void fp_sqr(fp_t *r, const fp_t *a) { fp_mul(r, a, a); }
+
+static void fp_to_mont(fp_t *r, const fp_t *raw) { fp_mul(r, raw, &FP_R2); }
+
+static void fp_from_mont(fp_t *r, const fp_t *m) {
+    fp_t one_raw;
+    memset(&one_raw, 0, sizeof one_raw);
+    one_raw.l[0] = 1;
+    fp_mul(r, m, &one_raw);
+}
+
+static void fp_set_u64(fp_t *r, uint64_t v) {
+    fp_t raw; memset(&raw, 0, sizeof raw); raw.l[0] = v;
+    fp_to_mont(r, &raw);
+}
+
+static void fp_from_limbs(fp_t *r, const uint64_t raw[6]) {
+    fp_t t; memcpy(t.l, raw, 48); fp_to_mont(r, &t);
+}
+
+/* MSB-first square-and-multiply over a u64[6] exponent */
+static void fp_pow_limbs(fp_t *r, const fp_t *a, const uint64_t e[6]) {
+    fp_t acc = FP_ONE;
+    int top = 5;
+    while (top >= 0 && e[top] == 0) top--;
+    if (top < 0) { *r = FP_ONE; return; }
+    int started = 0;
+    for (int i = top; i >= 0; i--) {
+        for (int bit = 63; bit >= 0; bit--) {
+            if (started) fp_sqr(&acc, &acc);
+            if ((e[i] >> bit) & 1) {
+                if (started) fp_mul(&acc, &acc, a);
+                else { acc = *a; started = 1; }
+            }
+        }
+    }
+    *r = acc;
+}
+
+static void fp_inv(fp_t *r, const fp_t *a) { fp_pow_limbs(r, a, E_PM2); }
+
+/* sqrt via a^((p+1)/4); returns 1 on success */
+static int fp_sqrt(fp_t *r, const fp_t *a) {
+    fp_t c, c2;
+    fp_pow_limbs(&c, a, E_PP1_4);
+    fp_sqr(&c2, &c);
+    if (!fp_eq(&c2, a)) return 0;
+    *r = c;
+    return 1;
+}
+
+/* parity / lexicographic helpers need the raw residue */
+static int fp_raw_parity(const fp_t *a) {
+    fp_t raw; fp_from_mont(&raw, a);
+    return (int)(raw.l[0] & 1);
+}
+
+static int fp_raw_gt_half(const fp_t *a) {       /* a > (p-1)/2 ? */
+    fp_t raw; fp_from_mont(&raw, a);
+    return bn_cmp(raw.l, E_PM1_2, 6) > 0;
+}
+
+/* ================================================================= */
+/* Fq2 = Fq[u]/(u^2+1)                                                */
+/* ================================================================= */
+
+typedef struct { fp_t a, b; } fp2_t;   /* a + b*u */
+
+static fp2_t FP2_ONE, FP2_ZERO, FP2_XI;     /* xi = 1 + u */
+
+static void fp2_add(fp2_t *r, const fp2_t *x, const fp2_t *y) {
+    fp_add(&r->a, &x->a, &y->a); fp_add(&r->b, &x->b, &y->b);
+}
+
+static void fp2_sub(fp2_t *r, const fp2_t *x, const fp2_t *y) {
+    fp_sub(&r->a, &x->a, &y->a); fp_sub(&r->b, &x->b, &y->b);
+}
+
+static void fp2_neg(fp2_t *r, const fp2_t *x) {
+    fp_neg(&r->a, &x->a); fp_neg(&r->b, &x->b);
+}
+
+static void fp2_dbl(fp2_t *r, const fp2_t *x) { fp2_add(r, x, x); }
+
+static int fp2_is_zero(const fp2_t *x) {
+    return fp_is_zero(&x->a) && fp_is_zero(&x->b);
+}
+
+static int fp2_eq(const fp2_t *x, const fp2_t *y) {
+    return fp_eq(&x->a, &y->a) && fp_eq(&x->b, &y->b);
+}
+
+static void fp2_conj(fp2_t *r, const fp2_t *x) {
+    r->a = x->a; fp_neg(&r->b, &x->b);
+}
+
+/* (a+bu)(c+du) = (ac-bd) + ((a+b)(c+d)-ac-bd)u */
+static void fp2_mul(fp2_t *r, const fp2_t *x, const fp2_t *y) {
+    fp_t ac, bd, s1, s2, m;
+    fp_mul(&ac, &x->a, &y->a);
+    fp_mul(&bd, &x->b, &y->b);
+    fp_add(&s1, &x->a, &x->b);
+    fp_add(&s2, &y->a, &y->b);
+    fp_mul(&m, &s1, &s2);
+    fp_sub(&r->b, &m, &ac); fp_sub(&r->b, &r->b, &bd);
+    fp_sub(&r->a, &ac, &bd);
+}
+
+/* (a+bu)^2 = (a+b)(a-b) + 2ab*u */
+static void fp2_sqr(fp2_t *r, const fp2_t *x) {
+    fp_t s, d, ab;
+    fp_add(&s, &x->a, &x->b);
+    fp_sub(&d, &x->a, &x->b);
+    fp_mul(&ab, &x->a, &x->b);
+    fp_mul(&r->a, &s, &d);
+    fp_dbl(&r->b, &ab);
+}
+
+static void fp2_mul_fp(fp2_t *r, const fp2_t *x, const fp_t *k) {
+    fp_mul(&r->a, &x->a, k); fp_mul(&r->b, &x->b, k);
+}
+
+/* multiply by xi = 1+u: (a-b) + (a+b)u */
+static void fp2_mul_xi(fp2_t *r, const fp2_t *x) {
+    fp_t s, d;
+    fp_add(&s, &x->a, &x->b);
+    fp_sub(&d, &x->a, &x->b);
+    r->a = d; r->b = s;
+}
+
+static void fp2_inv(fp2_t *r, const fp2_t *x) {
+    fp_t n, t, ninv;
+    fp_sqr(&n, &x->a); fp_sqr(&t, &x->b); fp_add(&n, &n, &t);
+    fp_inv(&ninv, &n);
+    fp_mul(&r->a, &x->a, &ninv);
+    fp_mul(&t, &x->b, &ninv); fp_neg(&r->b, &t);
+}
+
+static void fp2_pow_limbs(fp2_t *r, const fp2_t *x, const uint64_t e[6]) {
+    fp2_t acc = FP2_ONE;
+    int top = 5;
+    while (top >= 0 && e[top] == 0) top--;
+    if (top < 0) { *r = FP2_ONE; return; }
+    int started = 0;
+    for (int i = top; i >= 0; i--) {
+        for (int bit = 63; bit >= 0; bit--) {
+            if (started) fp2_sqr(&acc, &acc);
+            if ((e[i] >> bit) & 1) {
+                if (started) fp2_mul(&acc, &acc, x);
+                else { acc = *x; started = 1; }
+            }
+        }
+    }
+    *r = acc;
+}
+
+/* Euler criterion via the norm: a+bu square iff a^2+b^2 square in Fq */
+static int fp2_is_square(const fp2_t *x) {
+    fp_t n, t, e;
+    fp_sqr(&n, &x->a); fp_sqr(&t, &x->b); fp_add(&n, &n, &t);
+    if (fp_is_zero(&n)) return 1;
+    fp_pow_limbs(&e, &n, E_PM1_2);
+    return fp_eq(&e, &FP_ONE);
+}
+
+static fp_t FP_INV2;   /* (p+1)/2 as field element = 1/2 */
+
+/* complex-method sqrt, mirrors the oracle Fq2.sqrt; 1 on success */
+static int fp2_sqrt(fp2_t *r, const fp2_t *x) {
+    if (fp2_is_zero(x)) { *r = FP2_ZERO; return 1; }
+    if (fp_is_zero(&x->b)) {
+        fp_t s;
+        if (fp_sqrt(&s, &x->a)) { r->a = s; memset(&r->b, 0, sizeof r->b); return 1; }
+        fp_t na; fp_neg(&na, &x->a);
+        if (!fp_sqrt(&s, &na)) return 0;
+        memset(&r->a, 0, sizeof r->a); r->b = s;
+        return 1;
+    }
+    fp_t norm, t, alpha, delta, xx, y, x2inv;
+    fp_sqr(&norm, &x->a); fp_sqr(&t, &x->b); fp_add(&norm, &norm, &t);
+    if (!fp_sqrt(&alpha, &norm)) return 0;
+    fp_add(&delta, &x->a, &alpha); fp_mul(&delta, &delta, &FP_INV2);
+    if (!fp_sqrt(&xx, &delta)) {
+        fp_sub(&delta, &x->a, &alpha); fp_mul(&delta, &delta, &FP_INV2);
+        if (!fp_sqrt(&xx, &delta)) return 0;
+    }
+    fp_dbl(&t, &xx); fp_inv(&x2inv, &t);
+    fp_mul(&y, &x->b, &x2inv);
+    r->a = xx; r->b = y;
+    fp2_t chk; fp2_sqr(&chk, r);
+    return fp2_eq(&chk, x);
+}
+
+/* ================================================================= */
+/* Fq6 = Fq2[v]/(v^3 - xi),  Fq12 = Fq6[w]/(w^2 - v)                  */
+/* ================================================================= */
+
+typedef struct { fp2_t c0, c1, c2; } fp6_t;
+typedef struct { fp6_t c0, c1; } fp12_t;
+
+static fp6_t FP6_ZERO, FP6_ONE;
+static fp12_t FP12_ONE;
+
+static void fp6_add(fp6_t *r, const fp6_t *x, const fp6_t *y) {
+    fp2_add(&r->c0, &x->c0, &y->c0);
+    fp2_add(&r->c1, &x->c1, &y->c1);
+    fp2_add(&r->c2, &x->c2, &y->c2);
+}
+
+static void fp6_sub(fp6_t *r, const fp6_t *x, const fp6_t *y) {
+    fp2_sub(&r->c0, &x->c0, &y->c0);
+    fp2_sub(&r->c1, &x->c1, &y->c1);
+    fp2_sub(&r->c2, &x->c2, &y->c2);
+}
+
+static void fp6_neg(fp6_t *r, const fp6_t *x) {
+    fp2_neg(&r->c0, &x->c0);
+    fp2_neg(&r->c1, &x->c1);
+    fp2_neg(&r->c2, &x->c2);
+}
+
+static int fp6_is_zero(const fp6_t *x) {
+    return fp2_is_zero(&x->c0) && fp2_is_zero(&x->c1) && fp2_is_zero(&x->c2);
+}
+
+static int fp6_eq(const fp6_t *x, const fp6_t *y) {
+    return fp2_eq(&x->c0, &y->c0) && fp2_eq(&x->c1, &y->c1) && fp2_eq(&x->c2, &y->c2);
+}
+
+/* mirrors the oracle Fq6.__mul__ */
+static void fp6_mul(fp6_t *r, const fp6_t *x, const fp6_t *y) {
+    fp2_t t0, t1, t2, s, u, w;
+    fp2_mul(&t0, &x->c0, &y->c0);
+    fp2_mul(&t1, &x->c1, &y->c1);
+    fp2_mul(&t2, &x->c2, &y->c2);
+
+    fp6_t out;
+    /* c0 = t0 + ((a1+a2)(b1+b2) - t1 - t2) * xi */
+    fp2_add(&s, &x->c1, &x->c2);
+    fp2_add(&u, &y->c1, &y->c2);
+    fp2_mul(&w, &s, &u);
+    fp2_sub(&w, &w, &t1); fp2_sub(&w, &w, &t2);
+    fp2_mul_xi(&w, &w);
+    fp2_add(&out.c0, &t0, &w);
+    /* c1 = (a0+a1)(b0+b1) - t0 - t1 + t2*xi */
+    fp2_add(&s, &x->c0, &x->c1);
+    fp2_add(&u, &y->c0, &y->c1);
+    fp2_mul(&w, &s, &u);
+    fp2_sub(&w, &w, &t0); fp2_sub(&w, &w, &t1);
+    fp2_t t2xi; fp2_mul_xi(&t2xi, &t2);
+    fp2_add(&out.c1, &w, &t2xi);
+    /* c2 = (a0+a2)(b0+b2) - t0 - t2 + t1 */
+    fp2_add(&s, &x->c0, &x->c2);
+    fp2_add(&u, &y->c0, &y->c2);
+    fp2_mul(&w, &s, &u);
+    fp2_sub(&w, &w, &t0); fp2_sub(&w, &w, &t2);
+    fp2_add(&out.c2, &w, &t1);
+    *r = out;
+}
+
+static void fp6_sqr(fp6_t *r, const fp6_t *x) { fp6_mul(r, x, x); }
+
+/* multiply by v: (c0,c1,c2) -> (xi*c2, c0, c1) */
+static void fp6_mul_v(fp6_t *r, const fp6_t *x) {
+    fp2_t t; fp2_mul_xi(&t, &x->c2);
+    fp2_t c0 = x->c0, c1 = x->c1;
+    r->c0 = t; r->c1 = c0; r->c2 = c1;
+}
+
+/* mirrors the oracle Fq6.inv */
+static void fp6_inv(fp6_t *r, const fp6_t *x) {
+    fp2_t t0, t1, t2, w, f, finv;
+    /* t0 = c0^2 - c1*c2*xi */
+    fp2_sqr(&t0, &x->c0);
+    fp2_mul(&w, &x->c1, &x->c2); fp2_mul_xi(&w, &w);
+    fp2_sub(&t0, &t0, &w);
+    /* t1 = c2^2*xi - c0*c1 */
+    fp2_sqr(&t1, &x->c2); fp2_mul_xi(&t1, &t1);
+    fp2_mul(&w, &x->c0, &x->c1);
+    fp2_sub(&t1, &t1, &w);
+    /* t2 = c1^2 - c0*c2 */
+    fp2_sqr(&t2, &x->c1);
+    fp2_mul(&w, &x->c0, &x->c2);
+    fp2_sub(&t2, &t2, &w);
+    /* f = c0*t0 + c2*t1*xi + c1*t2*xi */
+    fp2_mul(&f, &x->c0, &t0);
+    fp2_mul(&w, &x->c2, &t1); fp2_mul_xi(&w, &w); fp2_add(&f, &f, &w);
+    fp2_mul(&w, &x->c1, &t2); fp2_mul_xi(&w, &w); fp2_add(&f, &f, &w);
+    fp2_inv(&finv, &f);
+    fp2_mul(&r->c0, &t0, &finv);
+    fp2_mul(&r->c1, &t1, &finv);
+    fp2_mul(&r->c2, &t2, &finv);
+}
+
+static fp2_t FROB_V1, FROB_V2, FROB_W;   /* xi^((p-1)/3), its square, xi^((p-1)/6) */
+
+static void fp6_frob(fp6_t *r, const fp6_t *x) {
+    fp2_t t;
+    fp2_conj(&r->c0, &x->c0);
+    fp2_conj(&t, &x->c1); fp2_mul(&r->c1, &t, &FROB_V1);
+    fp2_conj(&t, &x->c2); fp2_mul(&r->c2, &t, &FROB_V2);
+}
+
+static void fp12_add(fp12_t *r, const fp12_t *x, const fp12_t *y) {
+    fp6_add(&r->c0, &x->c0, &y->c0);
+    fp6_add(&r->c1, &x->c1, &y->c1);
+}
+
+static int fp12_eq(const fp12_t *x, const fp12_t *y) {
+    return fp6_eq(&x->c0, &y->c0) && fp6_eq(&x->c1, &y->c1);
+}
+
+static void fp12_mul(fp12_t *r, const fp12_t *x, const fp12_t *y) {
+    fp6_t t0, t1, s, u, w, t1v;
+    fp6_mul(&t0, &x->c0, &y->c0);
+    fp6_mul(&t1, &x->c1, &y->c1);
+    fp6_add(&s, &x->c0, &x->c1);
+    fp6_add(&u, &y->c0, &y->c1);
+    fp6_mul(&w, &s, &u);
+    fp6_sub(&w, &w, &t0); fp6_sub(&w, &w, &t1);
+    fp6_mul_v(&t1v, &t1);
+    fp6_add(&r->c0, &t0, &t1v);
+    r->c1 = w;
+}
+
+static void fp12_sqr(fp12_t *r, const fp12_t *x) { fp12_mul(r, x, x); }
+
+static void fp12_conj(fp12_t *r, const fp12_t *x) {
+    r->c0 = x->c0; fp6_neg(&r->c1, &x->c1);
+}
+
+static void fp12_inv(fp12_t *r, const fp12_t *x) {
+    fp6_t t0, t1, t, tinv, n;
+    fp6_sqr(&t0, &x->c0);
+    fp6_sqr(&t1, &x->c1); fp6_mul_v(&t1, &t1);
+    fp6_sub(&t, &t0, &t1);
+    fp6_inv(&tinv, &t);
+    fp6_mul(&r->c0, &x->c0, &tinv);
+    fp6_mul(&n, &x->c1, &tinv); fp6_neg(&r->c1, &n);
+}
+
+static void fp12_frob(fp12_t *r, const fp12_t *x) {
+    fp6_t c0, c1;
+    fp6_frob(&c0, &x->c0);
+    fp6_frob(&c1, &x->c1);
+    fp2_mul(&c1.c0, &c1.c0, &FROB_W);
+    fp2_mul(&c1.c1, &c1.c1, &FROB_W);
+    fp2_mul(&c1.c2, &c1.c2, &FROB_W);
+    r->c0 = c0; r->c1 = c1;
+}
+
+/* MSB-first pow over a big-endian byte exponent */
+static void fp12_pow_be(fp12_t *r, const fp12_t *x, const uint8_t *e, size_t elen) {
+    fp12_t acc = FP12_ONE;
+    int started = 0;
+    for (size_t i = 0; i < elen; i++) {
+        for (int bit = 7; bit >= 0; bit--) {
+            if (started) fp12_sqr(&acc, &acc);
+            if ((e[i] >> bit) & 1) {
+                if (started) fp12_mul(&acc, &acc, x);
+                else { acc = *x; started = 1; }
+            }
+        }
+    }
+    *r = acc;
+}
+
+/* ================================================================= */
+/* G1: E1(Fq): y^2 = x^3 + 4, Jacobian coordinates (Z=0 <=> infinity) */
+/* ================================================================= */
+
+typedef struct { fp_t x, y, z; } g1_t;
+typedef struct { fp_t x, y; int inf; } g1_aff_t;
+
+static fp_t FP_B1;          /* 4 */
+static g1_aff_t G1_GEN;
+
+static void g1_set_inf(g1_t *r) { memset(r, 0, sizeof *r); }
+static int g1_is_inf(const g1_t *p) { return fp_is_zero(&p->z); }
+
+static void g1_from_aff(g1_t *r, const g1_aff_t *a) {
+    if (a->inf) { g1_set_inf(r); return; }
+    r->x = a->x; r->y = a->y; r->z = FP_ONE;
+}
+
+static void g1_to_aff(g1_aff_t *r, const g1_t *p) {
+    if (g1_is_inf(p)) { memset(r, 0, sizeof *r); r->inf = 1; return; }
+    fp_t zi, zi2, zi3;
+    fp_inv(&zi, &p->z);
+    fp_sqr(&zi2, &zi); fp_mul(&zi3, &zi2, &zi);
+    fp_mul(&r->x, &p->x, &zi2);
+    fp_mul(&r->y, &p->y, &zi3);
+    r->inf = 0;
+}
+
+/* dbl-2009-l (a=0) */
+static void g1_dbl(g1_t *r, const g1_t *p) {
+    if (g1_is_inf(p) || fp_is_zero(&p->y)) { g1_set_inf(r); return; }
+    fp_t A, B, C, D, E, F, t, X3, Y3, Z3;
+    fp_sqr(&A, &p->x);
+    fp_sqr(&B, &p->y);
+    fp_sqr(&C, &B);
+    fp_add(&t, &p->x, &B); fp_sqr(&t, &t);
+    fp_sub(&t, &t, &A); fp_sub(&t, &t, &C);
+    fp_dbl(&D, &t);
+    fp_dbl(&E, &A); fp_add(&E, &E, &A);
+    fp_sqr(&F, &E);
+    fp_sub(&X3, &F, &D); fp_sub(&X3, &X3, &D);
+    fp_sub(&t, &D, &X3); fp_mul(&Y3, &E, &t);
+    fp_dbl(&t, &C); fp_dbl(&t, &t); fp_dbl(&t, &t);
+    fp_sub(&Y3, &Y3, &t);
+    fp_mul(&Z3, &p->y, &p->z); fp_dbl(&Z3, &Z3);
+    r->x = X3; r->y = Y3; r->z = Z3;
+}
+
+static void g1_add(g1_t *r, const g1_t *p, const g1_t *q) {
+    if (g1_is_inf(p)) { *r = *q; return; }
+    if (g1_is_inf(q)) { *r = *p; return; }
+    fp_t Z1Z1, Z2Z2, U1, U2, S1, S2, H, rr, t;
+    fp_sqr(&Z1Z1, &p->z);
+    fp_sqr(&Z2Z2, &q->z);
+    fp_mul(&U1, &p->x, &Z2Z2);
+    fp_mul(&U2, &q->x, &Z1Z1);
+    fp_mul(&S1, &p->y, &q->z); fp_mul(&S1, &S1, &Z2Z2);
+    fp_mul(&S2, &q->y, &p->z); fp_mul(&S2, &S2, &Z1Z1);
+    fp_sub(&H, &U2, &U1);
+    fp_sub(&rr, &S2, &S1);
+    if (fp_is_zero(&H)) {
+        if (fp_is_zero(&rr)) { g1_dbl(r, p); return; }
+        g1_set_inf(r); return;
+    }
+    fp_t H2, H3, V, X3, Y3, Z3;
+    fp_sqr(&H2, &H); fp_mul(&H3, &H2, &H);
+    fp_mul(&V, &U1, &H2);
+    fp_sqr(&X3, &rr); fp_sub(&X3, &X3, &H3);
+    fp_dbl(&t, &V); fp_sub(&X3, &X3, &t);
+    fp_sub(&t, &V, &X3); fp_mul(&Y3, &rr, &t);
+    fp_mul(&t, &S1, &H3); fp_sub(&Y3, &Y3, &t);
+    fp_mul(&Z3, &p->z, &q->z); fp_mul(&Z3, &Z3, &H);
+    r->x = X3; r->y = Y3; r->z = Z3;
+}
+
+static void g1_neg(g1_t *r, const g1_t *p) {
+    r->x = p->x; fp_neg(&r->y, &p->y); r->z = p->z;
+}
+
+/* MSB-first double-and-add over a big-endian byte scalar */
+static void g1_mul_be(g1_t *r, const g1_t *p, const uint8_t *k, size_t klen) {
+    g1_t acc; g1_set_inf(&acc);
+    for (size_t i = 0; i < klen; i++)
+        for (int bit = 7; bit >= 0; bit--) {
+            g1_dbl(&acc, &acc);
+            if ((k[i] >> bit) & 1) g1_add(&acc, &acc, p);
+        }
+    *r = acc;
+}
+
+static void g1_mul_z0(g1_t *r, const g1_t *p) {
+    uint8_t k[8];
+    for (int i = 0; i < 8; i++) k[i] = (uint8_t)(BLS_Z0 >> (56 - 8*i));
+    g1_mul_be(r, p, k, 8);
+}
+
+/* [r]P = [z^2]([z^2]P - P) + P must vanish (r = z^4 - z^2 + 1) */
+static int g1_in_subgroup(const g1_t *p) {
+    if (g1_is_inf(p)) return 1;
+    g1_t a, b, c, np, s;
+    g1_mul_z0(&a, p); g1_mul_z0(&a, &a);
+    g1_neg(&np, p);
+    g1_add(&b, &a, &np);
+    g1_mul_z0(&c, &b); g1_mul_z0(&c, &c);
+    g1_add(&s, &c, p);
+    return g1_is_inf(&s);
+}
+
+static int g1_on_curve_aff(const g1_aff_t *p) {
+    if (p->inf) return 1;
+    fp_t y2, x3;
+    fp_sqr(&y2, &p->y);
+    fp_sqr(&x3, &p->x); fp_mul(&x3, &x3, &p->x);
+    fp_add(&x3, &x3, &FP_B1);
+    return fp_eq(&y2, &x3);
+}
+
+/* ZCash compressed encoding: 48 bytes, flags in top 3 bits */
+static void g1_compress(uint8_t out[48], const g1_aff_t *p) {
+    if (p->inf) { memset(out, 0, 48); out[0] = 0xC0; return; }
+    fp_t raw; fp_from_mont(&raw, &p->x);
+    limbs_to_be(out, raw.l, 6);
+    out[0] |= 0x80;
+    if (fp_raw_gt_half(&p->y)) out[0] |= 0x20;
+}
+
+/* 1 ok; 0 malformed (mirrors oracle g1_from_compressed exceptions) */
+static int g1_decompress(g1_aff_t *p, const uint8_t in[48]) {
+    int c_flag = (in[0] >> 7) & 1, i_flag = (in[0] >> 6) & 1, s_flag = (in[0] >> 5) & 1;
+    if (!c_flag) return 0;
+    uint8_t xb[48]; memcpy(xb, in, 48); xb[0] &= 0x1F;
+    uint64_t xl[6]; be_to_limbs(xl, xb, 48, 6);
+    if (i_flag) {
+        if (!bn_is_zero(xl, 6) || s_flag) return 0;
+        memset(p, 0, sizeof *p); p->inf = 1; return 1;
+    }
+    if (bn_cmp(xl, FP_P, 6) >= 0) return 0;
+    fp_t x; fp_from_limbs(&x, xl);
+    fp_t y2, y;
+    fp_sqr(&y2, &x); fp_mul(&y2, &y2, &x); fp_add(&y2, &y2, &FP_B1);
+    if (!fp_sqrt(&y, &y2)) return 0;
+    if (fp_raw_gt_half(&y) != (s_flag != 0)) fp_neg(&y, &y);
+    p->x = x; p->y = y; p->inf = 0;
+    return 1;
+}
+
+/* ================================================================= */
+/* G2: E2(Fq2): y^2 = x^3 + 4(1+u)                                    */
+/* ================================================================= */
+
+typedef struct { fp2_t x, y, z; } g2_t;
+typedef struct { fp2_t x, y; int inf; } g2_aff_t;
+
+static fp2_t FP2_B2;        /* 4 + 4u */
+static g2_aff_t G2_GEN;
+
+static void g2_set_inf(g2_t *r) { memset(r, 0, sizeof *r); }
+static int g2_is_inf(const g2_t *p) { return fp2_is_zero(&p->z); }
+
+static void g2_from_aff(g2_t *r, const g2_aff_t *a) {
+    if (a->inf) { g2_set_inf(r); return; }
+    r->x = a->x; r->y = a->y;
+    r->z.a = FP_ONE; memset(&r->z.b, 0, sizeof r->z.b);
+}
+
+static void g2_to_aff(g2_aff_t *r, const g2_t *p) {
+    if (g2_is_inf(p)) { memset(r, 0, sizeof *r); r->inf = 1; return; }
+    fp2_t zi, zi2, zi3;
+    fp2_inv(&zi, &p->z);
+    fp2_sqr(&zi2, &zi); fp2_mul(&zi3, &zi2, &zi);
+    fp2_mul(&r->x, &p->x, &zi2);
+    fp2_mul(&r->y, &p->y, &zi3);
+    r->inf = 0;
+}
+
+static void g2_dbl(g2_t *r, const g2_t *p) {
+    if (g2_is_inf(p) || fp2_is_zero(&p->y)) { g2_set_inf(r); return; }
+    fp2_t A, B, C, D, E, F, t, X3, Y3, Z3;
+    fp2_sqr(&A, &p->x);
+    fp2_sqr(&B, &p->y);
+    fp2_sqr(&C, &B);
+    fp2_add(&t, &p->x, &B); fp2_sqr(&t, &t);
+    fp2_sub(&t, &t, &A); fp2_sub(&t, &t, &C);
+    fp2_dbl(&D, &t);
+    fp2_dbl(&E, &A); fp2_add(&E, &E, &A);
+    fp2_sqr(&F, &E);
+    fp2_sub(&X3, &F, &D); fp2_sub(&X3, &X3, &D);
+    fp2_sub(&t, &D, &X3); fp2_mul(&Y3, &E, &t);
+    fp2_dbl(&t, &C); fp2_dbl(&t, &t); fp2_dbl(&t, &t);
+    fp2_sub(&Y3, &Y3, &t);
+    fp2_mul(&Z3, &p->y, &p->z); fp2_dbl(&Z3, &Z3);
+    r->x = X3; r->y = Y3; r->z = Z3;
+}
+
+static void g2_add(g2_t *r, const g2_t *p, const g2_t *q) {
+    if (g2_is_inf(p)) { *r = *q; return; }
+    if (g2_is_inf(q)) { *r = *p; return; }
+    fp2_t Z1Z1, Z2Z2, U1, U2, S1, S2, H, rr, t;
+    fp2_sqr(&Z1Z1, &p->z);
+    fp2_sqr(&Z2Z2, &q->z);
+    fp2_mul(&U1, &p->x, &Z2Z2);
+    fp2_mul(&U2, &q->x, &Z1Z1);
+    fp2_mul(&S1, &p->y, &q->z); fp2_mul(&S1, &S1, &Z2Z2);
+    fp2_mul(&S2, &q->y, &p->z); fp2_mul(&S2, &S2, &Z1Z1);
+    fp2_sub(&H, &U2, &U1);
+    fp2_sub(&rr, &S2, &S1);
+    if (fp2_is_zero(&H)) {
+        if (fp2_is_zero(&rr)) { g2_dbl(r, p); return; }
+        g2_set_inf(r); return;
+    }
+    fp2_t H2, H3, V, X3, Y3, Z3;
+    fp2_sqr(&H2, &H); fp2_mul(&H3, &H2, &H);
+    fp2_mul(&V, &U1, &H2);
+    fp2_sqr(&X3, &rr); fp2_sub(&X3, &X3, &H3);
+    fp2_dbl(&t, &V); fp2_sub(&X3, &X3, &t);
+    fp2_sub(&t, &V, &X3); fp2_mul(&Y3, &rr, &t);
+    fp2_mul(&t, &S1, &H3); fp2_sub(&Y3, &Y3, &t);
+    fp2_mul(&Z3, &p->z, &q->z); fp2_mul(&Z3, &Z3, &H);
+    r->x = X3; r->y = Y3; r->z = Z3;
+}
+
+static void g2_neg(g2_t *r, const g2_t *p) {
+    r->x = p->x; fp2_neg(&r->y, &p->y); r->z = p->z;
+}
+
+static void g2_mul_be(g2_t *r, const g2_t *p, const uint8_t *k, size_t klen) {
+    g2_t acc; g2_set_inf(&acc);
+    for (size_t i = 0; i < klen; i++)
+        for (int bit = 7; bit >= 0; bit--) {
+            g2_dbl(&acc, &acc);
+            if ((k[i] >> bit) & 1) g2_add(&acc, &acc, p);
+        }
+    *r = acc;
+}
+
+static void g2_mul_z0(g2_t *r, const g2_t *p) {
+    uint8_t k[8];
+    for (int i = 0; i < 8; i++) k[i] = (uint8_t)(BLS_Z0 >> (56 - 8*i));
+    g2_mul_be(r, p, k, 8);
+}
+
+static int g2_in_subgroup(const g2_t *p) {
+    if (g2_is_inf(p)) return 1;
+    g2_t a, b, c, np, s;
+    g2_mul_z0(&a, p); g2_mul_z0(&a, &a);
+    g2_neg(&np, p);
+    g2_add(&b, &a, &np);
+    g2_mul_z0(&c, &b); g2_mul_z0(&c, &c);
+    g2_add(&s, &c, p);
+    return g2_is_inf(&s);
+}
+
+static int g2_on_curve_aff(const g2_aff_t *p) {
+    if (p->inf) return 1;
+    fp2_t y2, x3;
+    fp2_sqr(&y2, &p->y);
+    fp2_sqr(&x3, &p->x); fp2_mul(&x3, &x3, &p->x);
+    fp2_add(&x3, &x3, &FP2_B2);
+    return fp2_eq(&y2, &x3);
+}
+
+/* sign of y: (im > (p-1)/2) if im != 0 else (re > (p-1)/2) */
+static int fp2_y_sign(const fp2_t *y) {
+    if (!fp_is_zero(&y->b)) return fp_raw_gt_half(&y->b);
+    return fp_raw_gt_half(&y->a);
+}
+
+/* 96 bytes: imaginary part first, then real (oracle G2Point.to_compressed) */
+static void g2_compress(uint8_t out[96], const g2_aff_t *p) {
+    if (p->inf) { memset(out, 0, 96); out[0] = 0xC0; return; }
+    fp_t raw;
+    fp_from_mont(&raw, &p->x.b); limbs_to_be(out, raw.l, 6);
+    fp_from_mont(&raw, &p->x.a); limbs_to_be(out + 48, raw.l, 6);
+    out[0] |= 0x80;
+    if (fp2_y_sign(&p->y)) out[0] |= 0x20;
+}
+
+static int g2_decompress(g2_aff_t *p, const uint8_t in[96]) {
+    int c_flag = (in[0] >> 7) & 1, i_flag = (in[0] >> 6) & 1, s_flag = (in[0] >> 5) & 1;
+    if (!c_flag) return 0;
+    uint8_t imb[48]; memcpy(imb, in, 48); imb[0] &= 0x1F;
+    uint64_t iml[6], rel[6];
+    be_to_limbs(iml, imb, 48, 6);
+    be_to_limbs(rel, in + 48, 48, 6);
+    if (i_flag) {
+        if (!bn_is_zero(iml, 6) || !bn_is_zero(rel, 6) || s_flag) return 0;
+        memset(p, 0, sizeof *p); p->inf = 1; return 1;
+    }
+    if (bn_cmp(iml, FP_P, 6) >= 0 || bn_cmp(rel, FP_P, 6) >= 0) return 0;
+    fp2_t x, y2, y;
+    fp_from_limbs(&x.a, rel); fp_from_limbs(&x.b, iml);
+    fp2_sqr(&y2, &x); fp2_mul(&y2, &y2, &x); fp2_add(&y2, &y2, &FP2_B2);
+    if (!fp2_sqrt(&y, &y2)) return 0;
+    if (fp2_y_sign(&y) != (s_flag != 0)) fp2_neg(&y, &y);
+    p->x = x; p->y = y; p->inf = 0;
+    return 1;
+}
+
+/* ================================================================= */
+/* Library init: derive Montgomery + tower constants                  */
+/* ================================================================= */
+
+static fp2_t PSI_CX, PSI_CY;         /* psi endomorphism coefficients  */
+static fp2_t SSWU_A2, SSWU_B2, SSWU_Z2;
+static fp2_t ISO_KXN[4], ISO_KXD[3], ISO_KYN[4], ISO_KYD[4];
+static int CBLS_READY = 0;
+
+static void fp2_from_limbs2(fp2_t *r, const uint64_t raw[2][6]) {
+    fp_from_limbs(&r->a, raw[0]);
+    fp_from_limbs(&r->b, raw[1]);
+}
+
+static void cbls_init(void) {
+    if (CBLS_READY) return;
+
+    /* -p^-1 mod 2^64 by Newton iteration */
+    uint64_t inv = 1;
+    for (int i = 0; i < 6; i++) inv *= 2 - FP_P[0] * inv;
+    FP_N0 = (uint64_t)(0 - inv);
+
+    /* R = 2^384 mod p, R2 = 2^768 mod p by repeated modular doubling */
+    fp_t acc; memset(&acc, 0, sizeof acc); acc.l[0] = 1;
+    for (int i = 0; i < 384; i++) { bn_add(acc.l, acc.l, acc.l, 6); fp_reduce_once(&acc); }
+    FP_ONE = acc;
+    for (int i = 0; i < 384; i++) { bn_add(acc.l, acc.l, acc.l, 6); fp_reduce_once(&acc); }
+    FP_R2 = acc;
+
+    /* exponent tables from p */
+    uint64_t pm1[6], t[6];
+    uint64_t two[6] = {2, 0, 0, 0, 0, 0};
+    uint64_t one1[6] = {1, 0, 0, 0, 0, 0};
+    bn_sub(E_PM2, FP_P, two, 6);
+    bn_sub(pm1, FP_P, one1, 6);
+    bn_shr1(E_PM1_2, pm1, 6);
+    bn_shr1(t, pm1, 6); bn_shr1(t, t, 6);          /* (p-1)/4 = (p-3)/4 ... */
+    /* (p+1)/4 = (p >> 2) + 1 since p = 3 mod 4 */
+    bn_shr1(E_PP1_4, FP_P, 6); bn_shr1(E_PP1_4, E_PP1_4, 6);
+    bn_add(E_PP1_4, E_PP1_4, one1, 6);
+    bn_div_small(E_PM1_3, pm1, 3, 6);
+    bn_shr1(E_PM1_6, E_PM1_3, 6);                  /* (p-1)/3 is even */
+
+    memset(&FP2_ZERO, 0, sizeof FP2_ZERO);
+    FP2_ONE.a = FP_ONE; memset(&FP2_ONE.b, 0, sizeof FP2_ONE.b);
+    FP2_XI.a = FP_ONE; FP2_XI.b = FP_ONE;
+    memset(&FP6_ZERO, 0, sizeof FP6_ZERO);
+    FP6_ONE.c0 = FP2_ONE; FP6_ONE.c1 = FP2_ZERO; FP6_ONE.c2 = FP2_ZERO;
+    FP12_ONE.c0 = FP6_ONE; FP12_ONE.c1 = FP6_ZERO;
+
+    fp_set_u64(&FP_B1, 4);
+    FP2_B2.a = FP_B1; FP2_B2.b = FP_B1;
+
+    /* 1/2 = (p+1)/2 as a field element */
+    uint64_t pp1_2[6];
+    bn_shr1(pp1_2, FP_P, 6); bn_add(pp1_2, pp1_2, one1, 6);
+    fp_from_limbs(&FP_INV2, pp1_2);
+
+    /* frobenius coefficients: xi^((p-1)/3), its square, xi^((p-1)/6) */
+    fp2_pow_limbs(&FROB_V1, &FP2_XI, E_PM1_3);
+    fp2_mul(&FROB_V2, &FROB_V1, &FROB_V1);
+    fp2_pow_limbs(&FROB_W, &FP2_XI, E_PM1_6);
+
+    /* psi coefficients: inv(xi^((p-1)/3)), inv(xi^((p-1)/2))
+       (oracle hash_to_curve.py:172-173) */
+    fp2_t xi_pm1_2;
+    fp2_inv(&PSI_CX, &FROB_V1);
+    fp2_pow_limbs(&xi_pm1_2, &FP2_XI, E_PM1_2);
+    fp2_inv(&PSI_CY, &xi_pm1_2);
+
+    /* generators */
+    fp_from_limbs(&G1_GEN.x, G1_GEN_X);
+    fp_from_limbs(&G1_GEN.y, G1_GEN_Y);
+    G1_GEN.inf = 0;
+    fp2_from_limbs2(&G2_GEN.x, G2_GEN_X);
+    fp2_from_limbs2(&G2_GEN.y, G2_GEN_Y);
+    G2_GEN.inf = 0;
+
+    /* SSWU + isogeny tables */
+    fp2_from_limbs2(&SSWU_A2, SSWU_A);
+    fp2_from_limbs2(&SSWU_B2, SSWU_B);
+    fp2_from_limbs2(&SSWU_Z2, SSWU_Z);
+    for (int i = 0; i < 4; i++) fp2_from_limbs2(&ISO_KXN[i], ISO_XNUM[i]);
+    for (int i = 0; i < 3; i++) fp2_from_limbs2(&ISO_KXD[i], ISO_XDEN[i]);
+    for (int i = 0; i < 4; i++) fp2_from_limbs2(&ISO_KYN[i], ISO_YNUM[i]);
+    for (int i = 0; i < 4; i++) fp2_from_limbs2(&ISO_KYD[i], ISO_YDEN[i]);
+
+    CBLS_READY = 1;
+}
+
+/* ================================================================= */
+/* psi endomorphism + cofactor clearing (oracle hash_to_curve.py)     */
+/* ================================================================= */
+
+/* psi on Jacobian coords: conjugate each coordinate, scale X,Y */
+static void g2_psi(g2_t *r, const g2_t *p) {
+    fp2_t t;
+    fp2_conj(&t, &p->x); fp2_mul(&r->x, &t, &PSI_CX);
+    fp2_conj(&t, &p->y); fp2_mul(&r->y, &t, &PSI_CY);
+    fp2_conj(&r->z, &p->z);
+}
+
+/* [x^2-x-1]P + [x-1]psi(P) + psi^2([2]P), x the negative BLS param:
+   = [z0^2+z0-1]P - [z0+1]psi(P) + psi^2([2]P) */
+static void g2_clear_cofactor(g2_t *r, const g2_t *p) {
+    uint8_t k16[16], k8[8];
+    for (int i = 0; i < 8; i++) {
+        k16[i]     = (uint8_t)(COFAC_T1[1] >> (56 - 8*i));
+        k16[8 + i] = (uint8_t)(COFAC_T1[0] >> (56 - 8*i));
+        k8[i]      = (uint8_t)(COFAC_T2 >> (56 - 8*i));
+    }
+    g2_t t1, u, pu, t2, d, t3, s;
+    g2_mul_be(&t1, p, k16, 16);
+    g2_mul_be(&u, p, k8, 8);
+    g2_psi(&pu, &u); g2_neg(&t2, &pu);
+    g2_dbl(&d, p);
+    g2_psi(&t3, &d); g2_psi(&t3, &t3);
+    g2_add(&s, &t1, &t2);
+    g2_add(r, &s, &t3);
+}
+
+/* ================================================================= */
+/* hash-to-curve (RFC 9380, BLS12381G2_XMD:SHA-256_SSWU_RO_)          */
+/* ================================================================= */
+
+static void expand_message_xmd(uint8_t *out, size_t len_in_bytes,
+                               const uint8_t *msg, size_t msg_len,
+                               const uint8_t *dst, size_t dst_len) {
+    uint8_t dst_buf[256];
+    if (dst_len > 255) {
+        sha_t h; sha_init(&h);
+        sha_update(&h, (const uint8_t *)"H2C-OVERSIZE-DST-", 17);
+        sha_update(&h, dst, dst_len);
+        sha_final(&h, dst_buf);
+        dst = dst_buf; dst_len = 32;
+    }
+    uint8_t dst_prime[257];
+    memcpy(dst_prime, dst, dst_len);
+    dst_prime[dst_len] = (uint8_t)dst_len;
+    size_t dlen = dst_len + 1;
+
+    size_t ell = (len_in_bytes + 31) / 32;
+    uint8_t z_pad[64] = {0};
+    uint8_t lib[3] = {(uint8_t)(len_in_bytes >> 8), (uint8_t)len_in_bytes, 0};
+
+    uint8_t b0[32], bi[32];
+    sha_t h;
+    sha_init(&h);
+    sha_update(&h, z_pad, 64);
+    sha_update(&h, msg, msg_len);
+    sha_update(&h, lib, 3);
+    sha_update(&h, dst_prime, dlen);
+    sha_final(&h, b0);
+
+    uint8_t ctr = 1;
+    sha_init(&h);
+    sha_update(&h, b0, 32);
+    sha_update(&h, &ctr, 1);
+    sha_update(&h, dst_prime, dlen);
+    sha_final(&h, bi);
+
+    size_t off = 0;
+    for (size_t i = 1; i <= ell && off < len_in_bytes; i++) {
+        size_t take = len_in_bytes - off < 32 ? len_in_bytes - off : 32;
+        memcpy(out + off, bi, take);
+        off += take;
+        if (i < ell) {
+            uint8_t x[32];
+            for (int j = 0; j < 32; j++) x[j] = b0[j] ^ bi[j];
+            ctr = (uint8_t)(i + 1);
+            sha_init(&h);
+            sha_update(&h, x, 32);
+            sha_update(&h, &ctr, 1);
+            sha_update(&h, dst_prime, dlen);
+            sha_final(&h, bi);
+        }
+    }
+}
+
+/* reduce a 64-byte big-endian integer mod p (Horner over bytes) */
+static void fp_from_be64_mod(fp_t *r, const uint8_t be[64]) {
+    fp_t acc; memset(&acc, 0, sizeof acc);
+    for (int i = 0; i < 64; i++) {
+        for (int s = 0; s < 8; s++) {            /* acc *= 256 mod p */
+            bn_add(acc.l, acc.l, acc.l, 6);
+            fp_reduce_once(&acc);
+        }
+        fp_t byte; memset(&byte, 0, sizeof byte); byte.l[0] = be[i];
+        bn_add(acc.l, acc.l, byte.l, 6);
+        fp_reduce_once(&acc);
+    }
+    fp_to_mont(r, &acc);
+}
+
+static void hash_to_field_fq2(fp2_t *out, int count,
+                              const uint8_t *msg, size_t msg_len,
+                              const uint8_t *dst, size_t dst_len) {
+    uint8_t buf[4 * 64];       /* count <= 2 */
+    expand_message_xmd(buf, (size_t)count * 128, msg, msg_len, dst, dst_len);
+    for (int i = 0; i < count; i++) {
+        fp_from_be64_mod(&out[i].a, buf + 128 * i);
+        fp_from_be64_mod(&out[i].b, buf + 128 * i + 64);
+    }
+}
+
+/* RFC 9380 sgn0 for m=2 (mirrors oracle _sgn0) */
+static int fp2_sgn0(const fp2_t *x) {
+    if (!fp_is_zero(&x->a)) return fp_raw_parity(&x->a);
+    return fp_raw_parity(&x->b);
+}
+
+/* simplified SWU onto E' (oracle map_to_curve_sswu) */
+static void map_to_curve_sswu(fp2_t *xo, fp2_t *yo, const fp2_t *u) {
+    fp2_t zu2, tv, x1, gx1, t, t2;
+    fp2_sqr(&zu2, u); fp2_mul(&zu2, &zu2, &SSWU_Z2);
+    fp2_sqr(&tv, &zu2); fp2_add(&tv, &tv, &zu2);
+    if (fp2_is_zero(&tv)) {
+        /* x1 = B * inv(Z*A) */
+        fp2_mul(&t, &SSWU_Z2, &SSWU_A2);
+        fp2_inv(&t, &t);
+        fp2_mul(&x1, &SSWU_B2, &t);
+    } else {
+        /* x1 = (-B) * inv(A) * (1 + inv(tv)) */
+        fp2_inv(&t, &tv);
+        fp2_add(&t, &t, &FP2_ONE);
+        fp2_inv(&t2, &SSWU_A2);
+        fp2_mul(&t, &t, &t2);
+        fp2_neg(&t2, &SSWU_B2);
+        fp2_mul(&x1, &t2, &t);
+    }
+    /* gx1 = x1^3 + A*x1 + B */
+    fp2_sqr(&gx1, &x1); fp2_mul(&gx1, &gx1, &x1);
+    fp2_mul(&t, &SSWU_A2, &x1); fp2_add(&gx1, &gx1, &t);
+    fp2_add(&gx1, &gx1, &SSWU_B2);
+    fp2_t x, y;
+    if (fp2_is_square(&gx1)) {
+        x = x1;
+        fp2_sqrt(&y, &gx1);
+    } else {
+        fp2_t x2, gx2;
+        fp2_mul(&x2, &zu2, &x1);
+        fp2_sqr(&gx2, &x2); fp2_mul(&gx2, &gx2, &x2);
+        fp2_mul(&t, &SSWU_A2, &x2); fp2_add(&gx2, &gx2, &t);
+        fp2_add(&gx2, &gx2, &SSWU_B2);
+        x = x2;
+        fp2_sqrt(&y, &gx2);      /* must be square (oracle asserts) */
+    }
+    if (fp2_sgn0(u) != fp2_sgn0(&y)) fp2_neg(&y, &y);
+    *xo = x; *yo = y;
+}
+
+static void iso_poly_eval(fp2_t *r, const fp2_t *k, int n, const fp2_t *x) {
+    fp2_t acc = FP2_ZERO;
+    for (int i = n - 1; i >= 0; i--) {
+        fp2_mul(&acc, &acc, x);
+        fp2_add(&acc, &acc, &k[i]);
+    }
+    *r = acc;
+}
+
+/* E.3 3-isogeny E' -> E2 (oracle iso_map_g2); alias-safe in/out */
+static void iso_map_g2(fp2_t *xo, fp2_t *yo, const fp2_t *x, const fp2_t *y) {
+    fp2_t xn, xd, yn, yd, t, rx, ry;
+    iso_poly_eval(&xn, ISO_KXN, 4, x);
+    iso_poly_eval(&xd, ISO_KXD, 3, x);
+    iso_poly_eval(&yn, ISO_KYN, 4, x);
+    iso_poly_eval(&yd, ISO_KYD, 4, x);
+    fp2_inv(&t, &xd); fp2_mul(&rx, &xn, &t);
+    fp2_inv(&t, &yd); fp2_mul(&ry, &yn, &t);
+    fp2_mul(&ry, &ry, y);
+    *xo = rx; *yo = ry;
+}
+
+/* full hash_to_g2; result in Jacobian */
+static void hash_to_g2_jac(g2_t *r, const uint8_t *msg, size_t msg_len,
+                           const uint8_t *dst, size_t dst_len) {
+    fp2_t u[2], x0, y0, x1, y1;
+    hash_to_field_fq2(u, 2, msg, msg_len, dst, dst_len);
+    map_to_curve_sswu(&x0, &y0, &u[0]);
+    iso_map_g2(&x0, &y0, &x0, &y0);
+    map_to_curve_sswu(&x1, &y1, &u[1]);
+    iso_map_g2(&x1, &y1, &x1, &y1);
+    g2_aff_t a0 = {x0, y0, 0}, a1 = {x1, y1, 0};
+    g2_t p0, p1, s;
+    g2_from_aff(&p0, &a0);
+    g2_from_aff(&p1, &a1);
+    g2_add(&s, &p0, &p1);
+    g2_clear_cofactor(r, &s);
+}
+
+/* ================================================================= */
+/* Optimal ate pairing                                                */
+/* ================================================================= */
+
+/* Line through the untwisted R (and Q) evaluated at P, as a sparse
+ * fp12: c0.c0 + c1.c1*(v w) + c1.c2*(v^2 w).  Derivation (module
+ * comment): l * xi = lam*px*(v^2 w) + (y - lam*x)(v w) - py*xi with
+ * lam the twist-slope; Jacobian denominators are free Fq2 factors. */
+static void fp12_from_line(fp12_t *l, const fp2_t *c00,
+                           const fp2_t *c11, const fp2_t *c12) {
+    l->c0.c0 = *c00; l->c0.c1 = FP2_ZERO; l->c0.c2 = FP2_ZERO;
+    l->c1.c0 = FP2_ZERO; l->c1.c1 = *c11; l->c1.c2 = *c12;
+}
+
+/* f *= line(tangent at R, P); R <- 2R */
+static void miller_dbl_step(fp12_t *f, g2_t *R, const fp_t *px, const fp_t *py) {
+    fp2_t X = R->x, Y = R->y, Z = R->z;
+    fp2_t Y2, Z2, Z3, X2, X3c, t, c00, c11, c12;
+    fp2_sqr(&Y2, &Y);
+    fp2_sqr(&Z2, &Z); fp2_mul(&Z3, &Z2, &Z);
+    fp2_sqr(&X2, &X); fp2_mul(&X3c, &X2, &X);
+
+    /* c00 = -2*Y*Z^3*py * xi */
+    fp2_mul(&t, &Y, &Z3); fp2_dbl(&t, &t);
+    fp2_mul_fp(&t, &t, py);
+    fp2_mul_xi(&t, &t);
+    fp2_neg(&c00, &t);
+    /* c11 = 2*Y^2 - 3*X^3 */
+    fp2_dbl(&c11, &Y2);
+    fp2_dbl(&t, &X3c); fp2_add(&t, &t, &X3c);
+    fp2_sub(&c11, &c11, &t);
+    /* c12 = 3*X^2*Z^2*px */
+    fp2_dbl(&t, &X2); fp2_add(&t, &t, &X2);
+    fp2_mul(&t, &t, &Z2);
+    fp2_mul_fp(&c12, &t, px);
+
+    fp12_t line;
+    fp12_from_line(&line, &c00, &c11, &c12);
+    fp12_sqr(f, f);
+    fp12_mul(f, f, &line);
+    g2_dbl(R, R);
+}
+
+/* f *= line(chord R--Q, P); R <- R + Q (Q affine) */
+static void miller_add_step(fp12_t *f, g2_t *R, const g2_aff_t *Q,
+                            const fp_t *px, const fp_t *py) {
+    fp2_t Z2, Z3, theta, delta, t, zd, c00, c11, c12;
+    fp2_sqr(&Z2, &R->z); fp2_mul(&Z3, &Z2, &R->z);
+    /* theta = Y - qy*Z^3 ; delta = X - qx*Z^2 */
+    fp2_mul(&t, &Q->y, &Z3); fp2_sub(&theta, &R->y, &t);
+    fp2_mul(&t, &Q->x, &Z2); fp2_sub(&delta, &R->x, &t);
+    fp2_mul(&zd, &R->z, &delta);
+
+    /* c00 = -py * Z*delta * xi */
+    fp2_mul_fp(&t, &zd, py);
+    fp2_mul_xi(&t, &t);
+    fp2_neg(&c00, &t);
+    /* c11 = qy*Z*delta - theta*qx */
+    fp2_mul(&c11, &Q->y, &zd);
+    fp2_mul(&t, &theta, &Q->x);
+    fp2_sub(&c11, &c11, &t);
+    /* c12 = theta * px */
+    fp2_mul_fp(&c12, &theta, px);
+
+    fp12_t line;
+    fp12_from_line(&line, &c00, &c11, &c12);
+    fp12_mul(f, f, &line);
+
+    /* mixed add R += Q using H = -delta-ish recomputation (standard) */
+    fp2_t U2, S2, H, rr, H2, H3, V, X3, Y3, Z3n;
+    fp2_mul(&U2, &Q->x, &Z2);
+    fp2_mul(&S2, &Q->y, &Z3);
+    fp2_sub(&H, &U2, &R->x);
+    fp2_sub(&rr, &S2, &R->y);
+    fp2_sqr(&H2, &H); fp2_mul(&H3, &H2, &H);
+    fp2_mul(&V, &R->x, &H2);
+    fp2_sqr(&X3, &rr); fp2_sub(&X3, &X3, &H3);
+    fp2_dbl(&t, &V); fp2_sub(&X3, &X3, &t);
+    fp2_sub(&t, &V, &X3); fp2_mul(&Y3, &rr, &t);
+    fp2_mul(&t, &R->y, &H3); fp2_sub(&Y3, &Y3, &t);
+    fp2_mul(&Z3n, &R->z, &H);
+    R->x = X3; R->y = Y3; R->z = Z3n;
+}
+
+/* f_{|x|,Q}(P), conjugated for the negative BLS parameter */
+static void miller_loop(fp12_t *f, const g1_aff_t *P, const g2_aff_t *Q) {
+    if (P->inf || Q->inf) { *f = FP12_ONE; return; }
+    g2_t R; g2_from_aff(&R, Q);
+    *f = FP12_ONE;
+    /* bits of z0 = 0xd201000000010000, MSB first, skipping the top bit */
+    for (int i = 62; i >= 0; i--) {
+        miller_dbl_step(f, &R, &P->x, &P->y);
+        if ((BLS_Z0 >> i) & 1)
+            miller_add_step(f, &R, Q, &P->x, &P->y);
+    }
+    fp12_conj(f, f);
+}
+
+/* f^((p^12-1)/r) */
+static void final_exponentiation(fp12_t *r, const fp12_t *f) {
+    fp12_t a, b, m;
+    /* easy: f^(p^6-1) then ^(p^2+1) */
+    fp12_conj(&a, f);
+    fp12_inv(&b, f);
+    fp12_mul(&m, &a, &b);
+    fp12_frob(&a, &m); fp12_frob(&a, &a);
+    fp12_mul(&m, &a, &m);
+    /* hard: plain pow by (p^4 - p^2 + 1)/r */
+    fp12_pow_be(r, &m, FEXP_HARD, sizeof FEXP_HARD);
+}
+
+/* product-of-pairings check: prod e(P_i, Q_i) == 1 */
+static int pairing_check(const g1_aff_t *ps, const g2_aff_t *qs, size_t n) {
+    fp12_t f = FP12_ONE, m;
+    for (size_t i = 0; i < n; i++) {
+        if (ps[i].inf || qs[i].inf) continue;
+        miller_loop(&m, &ps[i], &qs[i]);
+        fp12_mul(&f, &f, &m);
+    }
+    fp12_t e;
+    final_exponentiation(&e, &f);
+    return fp12_eq(&e, &FP12_ONE);
+}
+
+/* ================================================================= */
+/* Public API (1 = true/ok, 0 = false/invalid, negative = usage)      */
+/* ================================================================= */
+
+#define API __attribute__((visibility("default")))
+
+/* decode + KeyValidate in one pass (oracle _decode_pubkey):
+   decompression ok AND not infinity AND in subgroup */
+static int decode_pubkey(g1_aff_t *p, const uint8_t pk[48]) {
+    if (!g1_decompress(p, pk)) return 0;
+    if (p->inf) return 0;
+    g1_t j; g1_from_aff(&j, p);
+    return g1_in_subgroup(&j);
+}
+
+/* decode signature: decompression ok AND in subgroup (infinity allowed) */
+static int decode_sig(g2_aff_t *s, const uint8_t sig[96]) {
+    if (!g2_decompress(s, sig)) return 0;
+    g2_t j; g2_from_aff(&j, s);
+    return g2_in_subgroup(&j);
+}
+
+API int cbls_key_validate(const uint8_t pk[48]) {
+    cbls_init();
+    g1_aff_t p;
+    return decode_pubkey(&p, pk);
+}
+
+API int cbls_verify(const uint8_t pk[48], const uint8_t *msg, size_t msg_len,
+                    const uint8_t sig[96]) {
+    cbls_init();
+    g1_aff_t p;
+    g2_aff_t s;
+    if (!decode_pubkey(&p, pk)) return 0;
+    if (!decode_sig(&s, sig)) return 0;
+    g2_t hm_j; g2_aff_t hm;
+    hash_to_g2_jac(&hm_j, msg, msg_len, DST_G2, DST_G2_LEN);
+    g2_to_aff(&hm, &hm_j);
+    g1_aff_t neg_g1 = G1_GEN; fp_neg(&neg_g1.y, &G1_GEN.y);
+    g1_aff_t ps[2] = {p, neg_g1};
+    g2_aff_t qs[2] = {hm, s};
+    return pairing_check(ps, qs, 2);
+}
+
+API int cbls_fast_aggregate_verify(const uint8_t *pks, size_t n,
+                                   const uint8_t *msg, size_t msg_len,
+                                   const uint8_t sig[96]) {
+    cbls_init();
+    if (n == 0) return 0;
+    g1_t acc; g1_set_inf(&acc);
+    for (size_t i = 0; i < n; i++) {
+        g1_aff_t p;
+        if (!decode_pubkey(&p, pks + 48 * i)) return 0;
+        g1_t pj; g1_from_aff(&pj, &p);
+        g1_add(&acc, &acc, &pj);
+    }
+    g2_aff_t s;
+    if (!decode_sig(&s, sig)) return 0;
+    g2_t hm_j; g2_aff_t hm;
+    hash_to_g2_jac(&hm_j, msg, msg_len, DST_G2, DST_G2_LEN);
+    g2_to_aff(&hm, &hm_j);
+    g1_aff_t agg; g1_to_aff(&agg, &acc);
+    g1_aff_t neg_g1 = G1_GEN; fp_neg(&neg_g1.y, &G1_GEN.y);
+    g1_aff_t ps[2] = {agg, neg_g1};
+    g2_aff_t qs[2] = {hm, s};
+    return pairing_check(ps, qs, 2);
+}
+
+/* msgs concatenated; msg_lens[i] gives each length */
+API int cbls_aggregate_verify(const uint8_t *pks, size_t n,
+                              const uint8_t *msgs, const uint64_t *msg_lens,
+                              const uint8_t sig[96]) {
+    cbls_init();
+    if (n == 0) return 0;
+    g2_aff_t s;
+    if (!decode_sig(&s, sig)) return 0;
+    fp12_t f = FP12_ONE, m;
+    size_t off = 0;
+    for (size_t i = 0; i < n; i++) {
+        g1_aff_t p;
+        if (!decode_pubkey(&p, pks + 48 * i)) return 0;
+        g2_t hm_j; g2_aff_t hm;
+        hash_to_g2_jac(&hm_j, msgs + off, (size_t)msg_lens[i],
+                       DST_G2, DST_G2_LEN);
+        g2_to_aff(&hm, &hm_j);
+        off += (size_t)msg_lens[i];
+        miller_loop(&m, &p, &hm);
+        fp12_mul(&f, &f, &m);
+    }
+    if (!s.inf) {
+        g1_aff_t neg_g1 = G1_GEN; fp_neg(&neg_g1.y, &G1_GEN.y);
+        miller_loop(&m, &neg_g1, &s);
+        fp12_mul(&f, &f, &m);
+    }
+    fp12_t e;
+    final_exponentiation(&e, &f);
+    return fp12_eq(&e, &FP12_ONE);
+}
+
+/* point sums: no subgroup checks (oracle Aggregate/g2_from_compressed) */
+API int cbls_aggregate_sigs(const uint8_t *sigs, size_t n, uint8_t out[96]) {
+    cbls_init();
+    if (n == 0) return 0;
+    g2_t acc; g2_set_inf(&acc);
+    for (size_t i = 0; i < n; i++) {
+        g2_aff_t s;
+        if (!g2_decompress(&s, sigs + 96 * i)) return 0;
+        if (s.inf) continue;
+        g2_t sj; g2_from_aff(&sj, &s);
+        g2_add(&acc, &acc, &sj);
+    }
+    g2_aff_t a; g2_to_aff(&a, &acc);
+    g2_compress(out, &a);
+    return 1;
+}
+
+/* pubkey sum WITH per-key validation (oracle AggregatePKs) */
+API int cbls_aggregate_pks(const uint8_t *pks, size_t n, uint8_t out[48]) {
+    cbls_init();
+    if (n == 0) return 0;
+    g1_t acc; g1_set_inf(&acc);
+    for (size_t i = 0; i < n; i++) {
+        g1_aff_t p;
+        if (!decode_pubkey(&p, pks + 48 * i)) return 0;
+        g1_t pj; g1_from_aff(&pj, &p);
+        g1_add(&acc, &acc, &pj);
+    }
+    g1_aff_t a; g1_to_aff(&a, &acc);
+    g1_compress(out, &a);
+    return 1;
+}
+
+/* scalar must satisfy 0 < sk < r (32 bytes big-endian) */
+static int check_sk(const uint8_t sk[32]) {
+    uint64_t k[4];
+    be_to_limbs(k, sk, 32, 4);
+    if (bn_is_zero(k, 4)) return 0;
+    return bn_cmp(k, BLS_R, 4) < 0;
+}
+
+API int cbls_sk_to_pk(const uint8_t sk[32], uint8_t out[48]) {
+    cbls_init();
+    if (!check_sk(sk)) return 0;
+    g1_t g, p; g1_from_aff(&g, &G1_GEN);
+    g1_mul_be(&p, &g, sk, 32);
+    g1_aff_t a; g1_to_aff(&a, &p);
+    g1_compress(out, &a);
+    return 1;
+}
+
+API int cbls_sign(const uint8_t sk[32], const uint8_t *msg, size_t msg_len,
+                  uint8_t out[96]) {
+    cbls_init();
+    if (!check_sk(sk)) return 0;
+    g2_t hm, s;
+    hash_to_g2_jac(&hm, msg, msg_len, DST_G2, DST_G2_LEN);
+    g2_mul_be(&s, &hm, sk, 32);
+    g2_aff_t a; g2_to_aff(&a, &s);
+    g2_compress(out, &a);
+    return 1;
+}
+
+/* exposed for differential testing against the oracle + IETF vectors */
+API int cbls_hash_to_g2(const uint8_t *msg, size_t msg_len,
+                        const uint8_t *dst, size_t dst_len, uint8_t out[96]) {
+    cbls_init();
+    g2_t h; g2_aff_t a;
+    hash_to_g2_jac(&h, msg, msg_len, dst, dst_len);
+    g2_to_aff(&a, &h);
+    g2_compress(out, &a);
+    return 1;
+}
+
+/* raw pairing-product check over compressed points (KZG path) */
+API int cbls_pairing_check(const uint8_t *g1s, const uint8_t *g2s, size_t n) {
+    cbls_init();
+    if (n > 64) return 0;
+    g1_aff_t ps[64];
+    g2_aff_t qs[64];
+    for (size_t i = 0; i < n; i++) {
+        if (!g1_decompress(&ps[i], g1s + 48 * i)) return 0;
+        if (!g2_decompress(&qs[i], g2s + 96 * i)) return 0;
+    }
+    return pairing_check(ps, qs, n);
+}
+
+/* G1 scalar mult on a compressed point (KZG lincomb building block) */
+API int cbls_g1_mult(const uint8_t in[48], const uint8_t scalar[32],
+                     uint8_t out[48]) {
+    cbls_init();
+    g1_aff_t p;
+    if (!g1_decompress(&p, in)) return 0;
+    g1_t j, r; g1_from_aff(&j, &p);
+    g1_mul_be(&r, &j, scalar, 32);
+    g1_aff_t a; g1_to_aff(&a, &r);
+    g1_compress(out, &a);
+    return 1;
+}
+
+/* multi-scalar multiplication over compressed G1 points (g1_lincomb):
+   simple per-point double-and-add accumulation, still native speed */
+API int cbls_g1_msm(const uint8_t *points, const uint8_t *scalars, size_t n,
+                    uint8_t out[48]) {
+    cbls_init();
+    g1_t acc; g1_set_inf(&acc);
+    for (size_t i = 0; i < n; i++) {
+        g1_aff_t p;
+        if (!g1_decompress(&p, points + 48 * i)) return 0;
+        g1_t j, r; g1_from_aff(&j, &p);
+        g1_mul_be(&r, &j, scalars + 32 * i, 32);
+        g1_add(&acc, &acc, &r);
+    }
+    g1_aff_t a; g1_to_aff(&a, &acc);
+    g1_compress(out, &a);
+    return 1;
+}
+
+/* internal consistency checks; 1 = all pass, else a failing stage id */
+API int cbls_selftest(void) {
+    cbls_init();
+    /* generators on curve, in subgroup */
+    if (!g1_on_curve_aff(&G1_GEN)) return -1;
+    if (!g2_on_curve_aff(&G2_GEN)) return -2;
+    g1_t g1; g1_from_aff(&g1, &G1_GEN);
+    g2_t g2; g2_from_aff(&g2, &G2_GEN);
+    if (!g1_in_subgroup(&g1)) return -3;
+    if (!g2_in_subgroup(&g2)) return -4;
+    /* compression round-trips */
+    uint8_t b48[48], b96[96];
+    g1_aff_t p1;
+    g2_aff_t p2;
+    g1_compress(b48, &G1_GEN);
+    if (!g1_decompress(&p1, b48)) return -5;
+    if (!fp_eq(&p1.x, &G1_GEN.x) || !fp_eq(&p1.y, &G1_GEN.y)) return -5;
+    g2_compress(b96, &G2_GEN);
+    if (!g2_decompress(&p2, b96)) return -6;
+    if (!fp2_eq(&p2.x, &G2_GEN.x) || !fp2_eq(&p2.y, &G2_GEN.y)) return -6;
+    /* pairing bilinearity: e([2]G1, G2) == e(G1, [2]G2), both != 1,
+       and e([2]G1, G2) * e(-G1, [2]G2) == 1 */
+    g1_t g1x2; g1_dbl(&g1x2, &g1);
+    g2_t g2x2; g2_dbl(&g2x2, &g2);
+    g1_aff_t a2, na;
+    g2_aff_t b2a;
+    g1_to_aff(&a2, &g1x2);
+    g2_to_aff(&b2a, &g2x2);
+    na = G1_GEN; fp_neg(&na.y, &G1_GEN.y);
+    fp12_t m1, e1;
+    miller_loop(&m1, &a2, &G2_GEN);
+    final_exponentiation(&e1, &m1);
+    if (fp12_eq(&e1, &FP12_ONE)) return -7;     /* must be nondegenerate */
+    g1_aff_t ps[2] = {a2, na};
+    g2_aff_t qs[2] = {G2_GEN, b2a};
+    if (!pairing_check(ps, qs, 2)) return -8;
+    /* hash-to-curve output in subgroup */
+    g2_t h;
+    hash_to_g2_jac(&h, (const uint8_t *)"selftest", 8, DST_G2, DST_G2_LEN);
+    if (!g2_in_subgroup(&h)) return -9;
+    if (g2_is_inf(&h)) return -9;
+    /* sign/verify round trip */
+    uint8_t sk[32] = {0}; sk[31] = 7;
+    uint8_t pk[48], sig[96];
+    if (!cbls_sk_to_pk(sk, pk)) return -10;
+    if (!cbls_sign(sk, (const uint8_t *)"msg", 3, sig)) return -10;
+    if (!cbls_verify(pk, (const uint8_t *)"msg", 3, sig)) return -11;
+    if (cbls_verify(pk, (const uint8_t *)"msh", 3, sig)) return -12;
+    return 1;
+}
+
+/* fine-grained hash-to-curve probe for bring-up/debug */
+API int cbls_debug_h2c(void) {
+    cbls_init();
+    fp2_t u[2];
+    hash_to_field_fq2(u, 2, (const uint8_t *)"selftest", 8, DST_G2, DST_G2_LEN);
+    fp2_t x0, y0;
+    map_to_curve_sswu(&x0, &y0, &u[0]);
+    /* on E'? y^2 == x^3 + A x + B */
+    fp2_t lhs, rhs, t;
+    fp2_sqr(&lhs, &y0);
+    fp2_sqr(&rhs, &x0); fp2_mul(&rhs, &rhs, &x0);
+    fp2_mul(&t, &SSWU_A2, &x0); fp2_add(&rhs, &rhs, &t);
+    fp2_add(&rhs, &rhs, &SSWU_B2);
+    if (!fp2_eq(&lhs, &rhs)) return -21;
+    /* iso image on E2? */
+    fp2_t X, Y;
+    iso_map_g2(&X, &Y, &x0, &y0);
+    g2_aff_t q = {X, Y, 0};
+    if (!g2_on_curve_aff(&q)) return -22;
+    /* psi acts as [p] = [-z0 mod r] on G2: psi(G) == -[z0]G */
+    g2_t g, pg, zg, nzg;
+    g2_from_aff(&g, &G2_GEN);
+    g2_psi(&pg, &g);
+    g2_mul_z0(&zg, &g);
+    g2_neg(&nzg, &zg);
+    g2_aff_t a1, a2;
+    g2_to_aff(&a1, &pg);
+    g2_to_aff(&a2, &nzg);
+    if (!fp2_eq(&a1.x, &a2.x) || !fp2_eq(&a1.y, &a2.y)) return -23;
+    /* cofactor clearing lands in subgroup from an arbitrary E2 point */
+    g2_t qj, c;
+    g2_from_aff(&qj, &q);
+    g2_clear_cofactor(&c, &qj);
+    if (!g2_in_subgroup(&c)) return -24;
+    return 1;
+}
+
+/* dump the two field elements (raw, big-endian 4x48 bytes) for debug */
+API int cbls_debug_h2f(const uint8_t *msg, size_t msg_len, uint8_t out[192]) {
+    cbls_init();
+    fp2_t u[2];
+    hash_to_field_fq2(u, 2, msg, msg_len, DST_G2, DST_G2_LEN);
+    fp_t raw;
+    fp_from_mont(&raw, &u[0].a); limbs_to_be(out, raw.l, 6);
+    fp_from_mont(&raw, &u[0].b); limbs_to_be(out + 48, raw.l, 6);
+    fp_from_mont(&raw, &u[1].a); limbs_to_be(out + 96, raw.l, 6);
+    fp_from_mont(&raw, &u[1].b); limbs_to_be(out + 144, raw.l, 6);
+    return 1;
+}
+
+/* dump iso-mapped affine point for u[idx] (raw BE: x.a x.b y.a y.b) */
+API int cbls_debug_sswu(const uint8_t *msg, size_t msg_len, int idx,
+                        uint8_t out[192]) {
+    cbls_init();
+    fp2_t u[2], x, y;
+    hash_to_field_fq2(u, 2, msg, msg_len, DST_G2, DST_G2_LEN);
+    map_to_curve_sswu(&x, &y, &u[idx]);
+    iso_map_g2(&x, &y, &x, &y);
+    fp_t raw;
+    fp_from_mont(&raw, &x.a); limbs_to_be(out, raw.l, 6);
+    fp_from_mont(&raw, &x.b); limbs_to_be(out + 48, raw.l, 6);
+    fp_from_mont(&raw, &y.a); limbs_to_be(out + 96, raw.l, 6);
+    fp_from_mont(&raw, &y.b); limbs_to_be(out + 144, raw.l, 6);
+    return 1;
+}
+
+/* dump PRE-iso sswu affine point for u[idx] */
+API int cbls_debug_sswu_raw(const uint8_t *msg, size_t msg_len, int idx,
+                            uint8_t out[192]) {
+    cbls_init();
+    fp2_t u[2], x, y;
+    hash_to_field_fq2(u, 2, msg, msg_len, DST_G2, DST_G2_LEN);
+    map_to_curve_sswu(&x, &y, &u[idx]);
+    fp_t raw;
+    fp_from_mont(&raw, &x.a); limbs_to_be(out, raw.l, 6);
+    fp_from_mont(&raw, &x.b); limbs_to_be(out + 48, raw.l, 6);
+    fp_from_mont(&raw, &y.a); limbs_to_be(out + 96, raw.l, 6);
+    fp_from_mont(&raw, &y.b); limbs_to_be(out + 144, raw.l, 6);
+    return 1;
+}

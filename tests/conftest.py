@@ -43,8 +43,9 @@ def pytest_addoption(parser):
     parser.addoption("--disable-bls", action="store_true", default=False,
                      help="(default) skip BLS checks where tests allow it")
     parser.addoption("--bls-type", action="store", default="py",
-                     choices=["py", "jax", "fastest"],
-                     help="BLS backend")
+                     choices=["py", "jax", "native", "fastest"],
+                     help="BLS backend (native = the C library, the "
+                          "reference's milagro/arkworks role)")
     parser.addoption("--compiled", action="store_true", default=False,
                      help="run the conformance suite against the markdown-"
                           "compiled spec ladder (make pyspec output) instead "

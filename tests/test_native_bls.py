@@ -1,0 +1,211 @@
+"""Differential tests: native C BLS backend vs the python oracle.
+
+The C library (csrc/bls12_381.c, loaded via ops/native_bls.py) plays the
+reference's milagro/arkworks role (reference backend ladder
+``tests/core/pyspec/eth2spec/utils/bls.py:30-53``).  Every API function
+is checked against the oracle on honest inputs, malformed encodings, and
+the subgroup/infinity edge cases the reference's ``bls`` vector suite
+exercises; hash-to-G2 is pinned to the RFC 9380 IETF vectors.
+"""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu.ops import native_bls
+from consensus_specs_tpu.ops.bls12_381 import ciphersuite as py
+from consensus_specs_tpu.ops.bls12_381.curve import (
+    G1Point, G2Point, g1_from_compressed, G1_GENERATOR)
+from consensus_specs_tpu.ops.bls12_381.fields import P, R_ORDER, Fq
+
+pytestmark = pytest.mark.skipif(
+    not native_bls.available(), reason="native BLS library not built")
+
+MSG = b"native backend differential message"
+SKS = [1, 2, 3, 7, 1000, R_ORDER - 1]
+
+
+@pytest.fixture(scope="module")
+def fixture():
+    pks = [py.SkToPk(sk) for sk in SKS]
+    sigs = [py.Sign(sk, MSG) for sk in SKS]
+    return pks, sigs, py.Aggregate(sigs)
+
+
+def test_selftest():
+    assert native_bls._lib.cbls_selftest() == 1
+
+
+def test_sk_to_pk_matches_oracle():
+    for sk in SKS:
+        assert native_bls.SkToPk(sk) == py.SkToPk(sk)
+    for bad in (0, R_ORDER, R_ORDER + 5):
+        with pytest.raises(ValueError):
+            native_bls.SkToPk(bad)
+
+
+def test_sign_matches_oracle():
+    for sk in (1, 42, R_ORDER - 1):
+        for msg in (b"", b"x", MSG, b"\x00" * 100):
+            assert native_bls.Sign(sk, msg) == py.Sign(sk, msg)
+    with pytest.raises(ValueError):
+        native_bls.Sign(0, MSG)
+
+
+def test_verify_roundtrip(fixture):
+    pks, sigs, _ = fixture
+    assert native_bls.Verify(pks[0], MSG, sigs[0])
+    assert not native_bls.Verify(pks[0], MSG + b"!", sigs[0])
+    assert not native_bls.Verify(pks[1], MSG, sigs[0])
+    assert not native_bls.Verify(pks[0], MSG, sigs[1])
+
+
+def test_fast_aggregate_verify(fixture):
+    pks, sigs, agg = fixture
+    assert native_bls.FastAggregateVerify(pks, MSG, agg)
+    assert not native_bls.FastAggregateVerify(pks[:-1], MSG, agg)
+    assert not native_bls.FastAggregateVerify(pks, b"other", agg)
+    assert not native_bls.FastAggregateVerify([], MSG, agg)
+    assert native_bls.FastAggregateVerify(pks, MSG, agg) == \
+        py.FastAggregateVerify(pks, MSG, agg)
+
+
+def test_aggregate_verify_distinct_messages():
+    msgs = [bytes([i]) * 32 for i in range(4)]
+    pks = [py.SkToPk(i + 1) for i in range(4)]
+    sig = py.Aggregate([py.Sign(i + 1, msgs[i]) for i in range(4)])
+    assert native_bls.AggregateVerify(pks, msgs, sig)
+    assert not native_bls.AggregateVerify(pks, list(reversed(msgs)), sig)
+    assert not native_bls.AggregateVerify(pks, msgs[:3], sig)
+    assert not native_bls.AggregateVerify([], [], sig)
+
+
+def test_aggregate_matches_oracle(fixture):
+    pks, sigs, agg = fixture
+    assert native_bls.Aggregate(sigs) == agg
+    assert native_bls.Aggregate(sigs[:1]) == py.Aggregate(sigs[:1])
+    with pytest.raises(ValueError):
+        native_bls.Aggregate([])
+
+
+def test_aggregate_pks_matches_oracle(fixture):
+    pks, _, _ = fixture
+    assert native_bls.AggregatePKs(pks) == py.AggregatePKs(pks)
+    with pytest.raises(ValueError):
+        native_bls.AggregatePKs([])
+    with pytest.raises(ValueError):
+        native_bls.AggregatePKs([b"\x00" * 48])
+
+
+def test_key_validate_edge_cases(fixture):
+    pks, _, _ = fixture
+    for pk in pks:
+        assert native_bls.KeyValidate(pk) == py.KeyValidate(pk) is True
+    # infinity pubkey: compressed-infinity flags, must be invalid
+    inf_pk = bytes([0xC0]) + b"\x00" * 47
+    assert native_bls.KeyValidate(inf_pk) == py.KeyValidate(inf_pk) is False
+    # uncompressed flag bit unset
+    bad_flag = bytes([pks[0][0] & 0x7F]) + pks[0][1:]
+    assert native_bls.KeyValidate(bad_flag) == py.KeyValidate(bad_flag) is False
+    # x >= p (non-canonical)
+    big_x = bytes([0x9F]) + b"\xff" * 47
+    assert native_bls.KeyValidate(big_x) == py.KeyValidate(big_x) is False
+    # x not on curve: flip a byte until decompression fails in the oracle
+    for b in range(256):
+        cand = pks[0][:20] + bytes([b]) + pks[0][21:]
+        try:
+            g1_from_compressed(cand)
+        except Exception:
+            assert native_bls.KeyValidate(cand) is False
+            break
+    # wrong length
+    assert native_bls.KeyValidate(b"\x01" * 47) is False
+
+
+def test_non_subgroup_pubkey_rejected():
+    # Build an E1 point OUTSIDE the r-subgroup: random x until on-curve,
+    # then check it's not in G1 (overwhelmingly likely: cofactor > 1).
+    for xi in range(1, 2000):
+        x = Fq(xi)
+        y2 = x * x * x + Fq(4)
+        y = y2.sqrt()
+        if y is None:
+            continue
+        pt = G1Point(x, y)
+        if not pt.in_subgroup():
+            enc = pt.to_compressed()
+            assert py.KeyValidate(enc) is False
+            assert native_bls.KeyValidate(enc) is False
+            return
+    pytest.fail("no non-subgroup point found in range")
+
+
+def test_infinity_signature_semantics(fixture):
+    pks, _, _ = fixture
+    inf_sig = bytes([0xC0]) + b"\x00" * 95
+    # infinity signature IS in the subgroup: decodes fine, verification
+    # reduces to e(agg, H(m)) == 1 which is false for real keys
+    assert native_bls.FastAggregateVerify(pks, MSG, inf_sig) == \
+        py.FastAggregateVerify(pks, MSG, inf_sig) is False
+    # malformed infinity encoding (sign bit set) must be rejected
+    bad_inf = bytes([0xE0]) + b"\x00" * 95
+    assert native_bls.FastAggregateVerify(pks, MSG, bad_inf) == \
+        py.FastAggregateVerify(pks, MSG, bad_inf) is False
+
+
+def test_hash_to_g2_ietf_vectors():
+    # RFC 9380 G.10.2 suite vectors, same set the oracle test pins
+    from tests.test_hash_to_curve import G2_VECTORS, G2_DST
+    from consensus_specs_tpu.ops.bls12_381.fields import Fq2
+    for msg, (x_re, x_im, y_re, y_im) in G2_VECTORS.items():
+        out = native_bls.hash_to_g2_compressed(msg, G2_DST)
+        expect = G2Point(Fq2(x_re, x_im), Fq2(y_re, y_im)).to_compressed()
+        assert out == expect, msg
+
+
+def test_hash_to_g2_matches_oracle_on_random_messages():
+    from consensus_specs_tpu.ops.bls12_381.hash_to_curve import hash_to_g2
+    for i in range(4):
+        msg = bytes([i]) * (i * 7 + 1)
+        assert native_bls.hash_to_g2_compressed(
+            msg, b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+        ) == hash_to_g2(msg).to_compressed()
+
+
+def test_pairing_check_compressed():
+    # e([2]G1, G2) * e(-G1, [2]G2) == 1
+    g1 = G1_GENERATOR
+    from consensus_specs_tpu.ops.bls12_381.curve import G2_GENERATOR
+    ps = [g1.double().to_compressed(), (-g1).to_compressed()]
+    qs = [G2_GENERATOR.to_compressed(), G2_GENERATOR.double().to_compressed()]
+    assert native_bls.pairing_check_compressed(ps, qs)
+    assert not native_bls.pairing_check_compressed(ps, list(reversed(qs)))
+
+
+def test_g1_msm_matches_oracle():
+    pts = [G1_GENERATOR.mult(k) for k in (1, 5, 11)]
+    scalars = [3, 2, 9]
+    expect = G1Point.inf()
+    for p, s in zip(pts, scalars):
+        expect = expect + p.mult(s)
+    got = native_bls.g1_msm_compressed(
+        [p.to_compressed() for p in pts], scalars)
+    assert got == expect.to_compressed()
+
+
+def test_backend_switch_integration(fixture):
+    """use_native() slots into the module switch; memo cleared on swap."""
+    from consensus_specs_tpu.utils import bls
+    pks, sigs, agg = fixture
+    prev = bls.backend_name()
+    try:
+        bls.use_native()
+        assert bls.backend_name() == "native"
+        assert bls.FastAggregateVerify(pks, MSG, agg)
+        assert bls.Verify(pks[0], MSG, sigs[0])
+        assert not bls.Verify(pks[0], b"no", sigs[0])
+        assert bls.AggregatePKs(pks) == py.AggregatePKs(pks)
+    finally:
+        bls.use_py() if prev == "py" else None
