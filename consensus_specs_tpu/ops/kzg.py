@@ -21,7 +21,8 @@ from typing import Sequence, Tuple
 from consensus_specs_tpu.utils.hash_function import hash
 from consensus_specs_tpu.ops.bls12_381.fields import R_ORDER
 from consensus_specs_tpu.ops.bls12_381.curve import (
-    G1Point, G1_GENERATOR, G2_GENERATOR, g1_from_compressed, g2_from_compressed)
+    G1Point, G2Point, G1_GENERATOR, G2_GENERATOR, g1_from_compressed,
+    g2_from_compressed)
 from consensus_specs_tpu.ops.bls12_381.pairing import multi_pairing_check
 
 # Constants (polynomial-commitments.md:70-100)
@@ -193,6 +194,10 @@ def g1_lincomb(points: Sequence[bytes], scalars: Sequence[int],
             from consensus_specs_tpu.ops.jax_bls import msm as _msm
             return _msm.g1_msm(pts, scalars,
                                cache_key=cache_key).to_compressed()
+    from consensus_specs_tpu.ops import native_bls
+    if native_bls.available():
+        return native_bls.g1_msm_affine(
+            [(0, 0) if p.infinity else (p.x.n, p.y.n) for p in pts], scalars)
     return _pippenger_msm(pts, scalars).to_compressed()
 
 
@@ -334,13 +339,59 @@ def _g1_of(b48: bytes) -> G1Point:
     return _to_g1(bytes(b48))
 
 
+def _native():
+    from consensus_specs_tpu.ops import native_bls
+    return native_bls if native_bls.available() else None
+
+
+def _pairing_check(pairs) -> bool:
+    """multi_pairing_check, through the native C pairing when present
+    (the arkworks multi_pairing role; oracle fallback otherwise)."""
+    nb = _native()
+    if nb is not None:
+        return nb.pairing_check_compressed(
+            [p.to_compressed() for p, _ in pairs],
+            [q.to_compressed() for _, q in pairs])
+    return multi_pairing_check(pairs)
+
+
+def _g1_combine(point_scalar_pairs) -> G1Point:
+    """sum([k]P) over a few points — native when present."""
+    nb = _native()
+    if nb is not None:
+        out = nb.g1_msm_affine(
+            [(0, 0) if p.infinity else (p.x.n, p.y.n)
+             for p, _ in point_scalar_pairs],
+            [int(k) for _, k in point_scalar_pairs])
+        return _g1_of(out)
+    acc = G1Point.inf()
+    for p, k in point_scalar_pairs:
+        acc = acc + p.mult(int(k))
+    return acc
+
+
+def _g2_combine(point_scalar_pairs) -> G2Point:
+    """sum([k]Q) over a few G2 points — native when present."""
+    nb = _native()
+    if nb is not None:
+        out = nb.g2_msm_compressed(
+            [q.to_compressed() for q, _ in point_scalar_pairs],
+            [int(k) for _, k in point_scalar_pairs])
+        return g2_from_compressed(out)
+    acc = G2Point.inf()
+    for q, k in point_scalar_pairs:
+        acc = acc + q.mult(int(k))
+    return acc
+
+
 def verify_kzg_proof_impl(commitment: bytes, z: int, y: int, proof: bytes,
                           setup: TrustedSetup) -> bool:
     """md:379 — e(P - y, -G2) * e(proof, [tau - z]G2) == 1."""
-    X_minus_z = setup.g2_tau + G2_GENERATOR.mult((BLS_MODULUS - z) % BLS_MODULUS)
-    P_minus_y = _g1_of(commitment) + G1_GENERATOR.mult(
-        (BLS_MODULUS - y) % BLS_MODULUS)
-    return multi_pairing_check([
+    X_minus_z = _g2_combine([(setup.g2_tau, 1),
+                             (G2_GENERATOR, (BLS_MODULUS - z) % BLS_MODULUS)])
+    P_minus_y = _g1_combine([(_g1_of(commitment), 1),
+                             (G1_GENERATOR, (BLS_MODULUS - y) % BLS_MODULUS)])
+    return _pairing_check([
         (P_minus_y, -G2_GENERATOR),
         (_g1_of(proof), X_minus_z),
     ])
@@ -366,13 +417,13 @@ def verify_kzg_proof_batch(commitments, zs, ys, proofs,
         proofs, [int(z) * r_power % BLS_MODULUS
                  for z, r_power in zip(zs, r_powers)])
     C_minus_ys = [
-        (_g1_of(commitment)
-         + G1_GENERATOR.mult((BLS_MODULUS - int(y)) % BLS_MODULUS))
+        _g1_combine([(_g1_of(commitment), 1),
+                     (G1_GENERATOR, (BLS_MODULUS - int(y)) % BLS_MODULUS)])
         .to_compressed()
         for commitment, y in zip(commitments, ys)]
     C_minus_y_lincomb = g1_lincomb(C_minus_ys, r_powers)
 
-    return multi_pairing_check([
+    return _pairing_check([
         (_g1_of(proof_lincomb), -setup.g2_tau),
         (_g1_of(C_minus_y_lincomb) + _g1_of(proof_z_lincomb), G2_GENERATOR),
     ])

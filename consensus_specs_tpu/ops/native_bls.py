@@ -22,6 +22,8 @@ import os
 import subprocess
 from typing import Optional, Sequence
 
+from consensus_specs_tpu.ops.bls12_381.fields import R_ORDER
+
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))), "csrc")
 _SO = os.path.join(_CSRC, "libcbls12381.so")
@@ -44,12 +46,16 @@ def _build() -> bool:
 def _load() -> Optional[ctypes.CDLL]:
     if os.environ.get("CS_TPU_NO_NATIVE_BLS") == "1":
         return None
+    deps = [p for p in (_SRC, os.path.join(_CSRC, "bls12_381_consts.h"))
+            if os.path.exists(p)]
     stale = (not os.path.exists(_SO)
-             or (os.path.exists(_SRC)
-                 and os.path.getmtime(_SRC) > os.path.getmtime(_SO)))
+             or any(os.path.getmtime(p) > os.path.getmtime(_SO)
+                    for p in deps))
     if stale and not _build():
-        if not os.path.exists(_SO):
-            return None
+        # never serve crypto from a library older than its source — a
+        # stale .so passing differential tests would mask the very code
+        # it claims to exercise
+        return None
     try:
         lib = ctypes.CDLL(_SO)
     except OSError:
@@ -72,6 +78,9 @@ def _load() -> Optional[ctypes.CDLL]:
         "cbls_pairing_check": [ctypes.c_char_p, ctypes.c_char_p, sz],
         "cbls_g1_mult": [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p],
         "cbls_g1_msm": [ctypes.c_char_p, ctypes.c_char_p, sz, ctypes.c_char_p],
+        "cbls_g1_msm_pippenger":
+            [ctypes.c_char_p, ctypes.c_char_p, sz, ctypes.c_char_p],
+        "cbls_g2_msm": [ctypes.c_char_p, ctypes.c_char_p, sz, ctypes.c_char_p],
         "cbls_selftest": [],
     }
     try:
@@ -205,6 +214,36 @@ def pairing_check_compressed(g1s: Sequence[bytes], g2s: Sequence[bytes]) -> bool
         raise ValueError("bad pairing-check input")
     return _req().cbls_pairing_check(b"".join(g1s), b"".join(g2s),
                                      len(g1s)) == 1
+
+
+def g1_msm_affine(points_xy: Sequence[tuple], scalars: Sequence[int]) -> bytes:
+    """Pippenger MSM over affine (x, y) int coordinate pairs (infinity =
+    (0, 0)); returns the compressed sum.  The arkworks-role hot path for
+    ``g1_lincomb`` — raw coordinates skip the per-point decompression
+    sqrt."""
+    if len(points_xy) != len(scalars):
+        raise ValueError("length mismatch")
+    buf = b"".join(int(x).to_bytes(48, "big") + int(y).to_bytes(48, "big")
+                   for x, y in points_xy)
+    # canonical scalar reduction (negative scalars included) — the C
+    # side multiplies by the 256-bit value literally
+    sc = b"".join((int(s) % R_ORDER).to_bytes(32, "big") for s in scalars)
+    out = ctypes.create_string_buffer(48)
+    if _req().cbls_g1_msm_pippenger(buf, sc, len(scalars), out) != 1:
+        raise ValueError("invalid MSM input")
+    return out.raw
+
+
+def g2_msm_compressed(points: Sequence[bytes], scalars: Sequence[int]) -> bytes:
+    pts = [bytes(p) for p in points]
+    if len(pts) != len(scalars) or len(pts) > 64 \
+            or any(len(p) != 96 for p in pts):
+        raise ValueError("bad G2 MSM input")
+    sc = b"".join((int(s) % R_ORDER).to_bytes(32, "big") for s in scalars)
+    out = ctypes.create_string_buffer(96)
+    if _req().cbls_g2_msm(b"".join(pts), sc, len(pts), out) != 1:
+        raise ValueError("invalid G2 MSM input")
+    return out.raw
 
 
 def g1_msm_compressed(points: Sequence[bytes], scalars: Sequence[int]) -> bytes:

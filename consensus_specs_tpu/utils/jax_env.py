@@ -169,11 +169,17 @@ def ensure_working_backend(timeout: int = 90) -> str:
     try:
         proc = subprocess.run(
             [_sys.executable, "-c",
-             "import jax; jax.devices()"],
+             "import jax; jax.devices(); print(jax.default_backend())"],
             timeout=timeout, capture_output=True)
         if proc.returncode == 0:
-            _PROBE_RESULT = "default"
-            return "default"
+            # rc=0 with a cpu default backend means jax works but no
+            # accelerator is attached (CPU-only install): report "cpu"
+            # so accelerator_cached()/use_fastest() pick the native
+            # CPU backend instead of minutes of XLA:CPU compiles
+            platform = proc.stdout.decode().strip().splitlines()[-1] \
+                if proc.stdout.strip() else ""
+            _PROBE_RESULT = "cpu" if platform == "cpu" else "default"
+            return _PROBE_RESULT
     except subprocess.TimeoutExpired:
         pass
     except Exception:

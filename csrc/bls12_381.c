@@ -34,6 +34,7 @@
  */
 #include <stddef.h>
 #include <stdint.h>
+#include <stdlib.h>
 #include <string.h>
 
 #include "bls12_381_consts.h"
@@ -1680,5 +1681,92 @@ API int cbls_debug_sswu_raw(const uint8_t *msg, size_t msg_len, int idx,
     fp_from_mont(&raw, &x.b); limbs_to_be(out + 48, raw.l, 6);
     fp_from_mont(&raw, &y.a); limbs_to_be(out + 96, raw.l, 6);
     fp_from_mont(&raw, &y.b); limbs_to_be(out + 144, raw.l, 6);
+    return 1;
+}
+
+/* Pippenger MSM over raw affine G1 points (x||y, 96 bytes each, raw
+ * big-endian field residues — no decompression sqrt per point).  The
+ * arkworks-role hot path for g1_lincomb over the 4096-point trusted
+ * setup (specs/deneb/polynomial-commitments.md g1_lincomb).
+ * infinity encoded as x==y==0.  Window = 8 bits, 32 windows, MSB first. */
+API int cbls_g1_msm_pippenger(const uint8_t *points_xy, const uint8_t *scalars,
+                              size_t n, uint8_t out[48]) {
+    cbls_init();
+    enum { W = 8, NBUCKET = (1 << W) - 1 };
+    g1_t *buckets;                 /* heap: ctypes drops the GIL, so no
+                                      shared static scratch */
+    g1_aff_t *aff = NULL;
+    g1_t acc; g1_set_inf(&acc);
+    /* parse + validate points on curve */
+    {
+        buckets = (g1_t *)malloc(NBUCKET * sizeof(g1_t));
+        if (buckets == NULL) return 0;
+        aff = (g1_aff_t *)malloc(n * sizeof(g1_aff_t));
+        if (aff == NULL && n > 0) { free(buckets); return 0; }
+        for (size_t i = 0; i < n; i++) {
+            uint64_t xl[6], yl[6];
+            be_to_limbs(xl, points_xy + 96 * i, 48, 6);
+            be_to_limbs(yl, points_xy + 96 * i + 48, 48, 6);
+            if (bn_is_zero(xl, 6) && bn_is_zero(yl, 6)) {
+                memset(&aff[i], 0, sizeof aff[i]); aff[i].inf = 1;
+                continue;
+            }
+            if (bn_cmp(xl, FP_P, 6) >= 0 || bn_cmp(yl, FP_P, 6) >= 0) {
+                free(aff); free(buckets); return 0;
+            }
+            fp_from_limbs(&aff[i].x, xl);
+            fp_from_limbs(&aff[i].y, yl);
+            aff[i].inf = 0;
+            if (!g1_on_curve_aff(&aff[i])) {
+                free(aff); free(buckets); return 0;
+            }
+        }
+        /* scalars are big-endian: byte 0 is the MOST significant
+           window, processed first (doublings shift earlier windows up) */
+        for (int w = 0; w < 32; w++) {
+            if (!g1_is_inf(&acc))
+                for (int d = 0; d < W; d++) g1_dbl(&acc, &acc);
+            for (int b = 0; b < NBUCKET; b++) g1_set_inf(&buckets[b]);
+            for (size_t i = 0; i < n; i++) {
+                if (aff[i].inf) continue;
+                int digit = scalars[32 * i + w];
+                if (digit == 0) continue;
+                g1_t pj; g1_from_aff(&pj, &aff[i]);
+                g1_add(&buckets[digit - 1], &buckets[digit - 1], &pj);
+            }
+            g1_t running, window_sum;
+            g1_set_inf(&running); g1_set_inf(&window_sum);
+            for (int d = NBUCKET - 1; d >= 0; d--) {
+                g1_add(&running, &running, &buckets[d]);
+                g1_add(&window_sum, &window_sum, &running);
+            }
+            g1_add(&acc, &acc, &window_sum);
+        }
+        free(aff);
+        free(buckets);
+    }
+    g1_aff_t a; g1_to_aff(&a, &acc);
+    g1_compress(out, &a);
+    return 1;
+}
+
+/* small G2 MSM over compressed points (double-and-add per point) — the
+ * [tau - z]G2 combination in verify_kzg_proof_impl */
+API int cbls_g2_msm(const uint8_t *points, const uint8_t *scalars, size_t n,
+                    uint8_t out[96]) {
+    cbls_init();
+    if (n > 64) return 0;
+    g2_t acc; g2_set_inf(&acc);
+    for (size_t i = 0; i < n; i++) {
+        g2_aff_t p;
+        if (!g2_decompress(&p, points + 96 * i)) return 0;
+        if (p.inf) continue;
+        g2_t j, r;
+        g2_from_aff(&j, &p);
+        g2_mul_be(&r, &j, scalars + 32 * i, 32);
+        g2_add(&acc, &acc, &r);
+    }
+    g2_aff_t a; g2_to_aff(&a, &acc);
+    g2_compress(out, &a);
     return 1;
 }
