@@ -121,3 +121,87 @@ def build_state_with_execution_payload_header(spec, state, header):
     pre_state = state.copy()
     pre_state.latest_execution_payload_header = header
     return pre_state
+
+
+# -- blob-transaction fabrication (deneb payload tests) ---------------------
+#
+# The reference fabricates a mock SSZ "SignedBlobTransaction" carrying
+# the blob versioned hashes and prefixes it with the EIP-4844 tx type
+# (reference test/helpers/sharding.py get_sample_opaque_tx).  This
+# framework's mock wire format (NOT the real EIP-4844 encoding, same as
+# the reference's mock is not): 0x03 || uint64-LE count || count x 32-byte
+# versioned hashes.  Only the test execution engine parses it.
+
+BLOB_TX_TYPE = 0x03
+
+
+def tx_with_versioned_hashes(versioned_hashes):
+    return (bytes([BLOB_TX_TYPE])
+            + len(versioned_hashes).to_bytes(8, "little")
+            + b"".join(bytes(h) for h in versioned_hashes))
+
+
+def parse_blob_tx_versioned_hashes(tx: bytes):
+    """Inverse of ``tx_with_versioned_hashes``; raises on malformed tx."""
+    tx = bytes(tx)
+    if len(tx) < 9 or tx[0] != BLOB_TX_TYPE:
+        raise ValueError("not a blob transaction")
+    count = int.from_bytes(tx[1:9], "little")
+    body = tx[9:]
+    if len(body) != 32 * count:
+        raise ValueError("blob tx length mismatch")
+    return [body[i * 32:(i + 1) * 32] for i in range(count)]
+
+
+def get_sample_opaque_tx(spec, blob_count=1):
+    """(opaque_tx, blobs, blob_kzg_commitments, proofs) for payload tests.
+
+    Deterministic: commitment bytes are fabricated (infinity-point
+    commitments with distinct trailing bytes) — versioned-hash
+    validation is a pure byte-hashing path, no KZG math needed (the kzg
+    test suites cover the real commitment math)."""
+    blobs, commitments, proofs = [], [], []
+    for i in range(blob_count):
+        commitment = spec.KZGCommitment(
+            bytes([0xC0]) + b"\x00" * 46 + bytes([i]))
+        blobs.append(spec.Blob(b"\x00" * (32 * spec.FIELD_ELEMENTS_PER_BLOB)))
+        commitments.append(commitment)
+        proofs.append(spec.KZGProof(bytes([0xC0]) + b"\x00" * 47))
+    hashes = [spec.kzg_commitment_to_versioned_hash(c) for c in commitments]
+    return tx_with_versioned_hashes(hashes), blobs, commitments, proofs
+
+
+class BlobVersionedHashesExecutionEngine:
+    """Test engine implementing ``is_valid_versioned_hashes`` for real:
+    parses blob transactions in the payload and compares their hashes
+    with the NewPayloadRequest's (the check the NoopExecutionEngine
+    stubs to True; role of the reference's test-only engine in
+    ``test/deneb/block_processing/test_process_execution_payload.py``)."""
+
+    def __init__(self, spec):
+        self.spec = spec
+
+    def notify_new_payload(self, *args, **kwargs) -> bool:
+        return True
+
+    def is_valid_block_hash(self, new_payload_request) -> bool:
+        payload = new_payload_request.execution_payload
+        return payload.block_hash == compute_el_block_hash(
+            self.spec, payload)
+
+    def is_valid_versioned_hashes(self, new_payload_request) -> bool:
+        try:
+            expected = []
+            for tx in new_payload_request.execution_payload.transactions:
+                tx = bytes(tx)
+                if tx[:1] == bytes([BLOB_TX_TYPE]):
+                    expected.extend(parse_blob_tx_versioned_hashes(tx))
+            return [bytes(h) for h in
+                    new_payload_request.versioned_hashes] == expected
+        except Exception:
+            return False
+
+    def verify_and_notify_new_payload(self, new_payload_request) -> bool:
+        return (self.is_valid_block_hash(new_payload_request)
+                and self.is_valid_versioned_hashes(new_payload_request)
+                and self.notify_new_payload(new_payload_request))

@@ -144,9 +144,12 @@ def prepare_state_with_attestations(spec, state, participation_fn=None):
                     if participation_fn is None:
                         return comm
                     return participation_fn(comm)
+                # signed=True keeps generated vectors verifiable under
+                # real BLS (generators force bls_active; under pytest's
+                # default bls-off the signing is a cheap stub)
                 attestation = get_valid_attestation(
                     spec, state, state.slot, index=index,
-                    filter_participant_set=participants, signed=False)
+                    filter_participant_set=participants, signed=True)
                 if any(attestation.aggregation_bits):
                     slot_atts.append(attestation)
             pending.append((state.slot, slot_atts))

@@ -1,0 +1,176 @@
+"""Deneb ``process_execution_payload``: versioned-hash validation against
+blob transactions, via a test engine that implements the check the
+NoopExecutionEngine stubs out.
+
+Reference model:
+``test/deneb/block_processing/test_process_execution_payload.py``
+against ``specs/deneb/beacon-chain.md`` process_execution_payload
+(commitment cap + versioned hashes into the NewPayloadRequest).
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, expect_assertion_error,
+)
+from consensus_specs_tpu.test_infra.execution_payload import (
+    build_empty_execution_payload, compute_el_block_hash,
+    get_sample_opaque_tx, tx_with_versioned_hashes,
+    BlobVersionedHashesExecutionEngine, BLOB_TX_TYPE,
+)
+from consensus_specs_tpu.test_infra.block import next_slot
+
+DENEB_ONLY = with_phases(["deneb"])
+
+
+def _run_payload_test(spec, state, mutate=None, valid=True, engine=None):
+    """Build body(payload + commitments), optionally mutate, run the
+    processor with the versioned-hash-validating engine."""
+    next_slot(spec, state)
+    opaque_tx, _, commitments, _ = get_sample_opaque_tx(spec, blob_count=2)
+    payload = build_empty_execution_payload(spec, state)
+    payload.transactions = [opaque_tx]
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    body = spec.BeaconBlockBody(
+        execution_payload=payload,
+        blob_kzg_commitments=commitments,
+    )
+    if mutate is not None:
+        mutate(spec, body)
+        # a real proposer would re-commit the mutated payload unless the
+        # mutation IS a block-hash corruption
+        if mutate.__name__ != "bad_block_hash":
+            body.execution_payload.block_hash = compute_el_block_hash(
+                spec, body.execution_payload)
+    engine = engine or BlobVersionedHashesExecutionEngine(spec)
+    yield "pre", state
+    yield "execution", {"execution_valid": valid}
+    yield "body", body
+    if valid:
+        spec.process_execution_payload(state, body, engine)
+        yield "post", state
+    else:
+        expect_assertion_error(
+            lambda: spec.process_execution_payload(state, body, engine))
+        yield "post", None
+
+
+@DENEB_ONLY
+@spec_state_test
+def test_valid_blob_tx_payload(spec, state):
+    yield from _run_payload_test(spec, state)
+
+
+@DENEB_ONLY
+@spec_state_test
+def test_invalid_incorrect_blob_tx_type(spec, state):
+    def mutate(spec, body):
+        tx = bytearray(bytes(body.execution_payload.transactions[0]))
+        tx[0] = 0x04                    # not BLOB_TX_TYPE: hashes unparsed
+        body.execution_payload.transactions[0] = tx
+    yield from _run_payload_test(spec, state, mutate, valid=False)
+
+
+@DENEB_ONLY
+@spec_state_test
+def test_invalid_transaction_length_1_extra_byte(spec, state):
+    def mutate(spec, body):
+        tx = bytes(body.execution_payload.transactions[0]) + b"\x00"
+        body.execution_payload.transactions[0] = tx
+    yield from _run_payload_test(spec, state, mutate, valid=False)
+
+
+@DENEB_ONLY
+@spec_state_test
+def test_invalid_transaction_length_1_byte_short(spec, state):
+    def mutate(spec, body):
+        tx = bytes(body.execution_payload.transactions[0])[:-1]
+        body.execution_payload.transactions[0] = tx
+    yield from _run_payload_test(spec, state, mutate, valid=False)
+
+
+@DENEB_ONLY
+@spec_state_test
+def test_invalid_transaction_empty(spec, state):
+    def mutate(spec, body):
+        body.execution_payload.transactions[0] = bytes([BLOB_TX_TYPE])
+    yield from _run_payload_test(spec, state, mutate, valid=False)
+
+
+@DENEB_ONLY
+@spec_state_test
+def test_invalid_transaction_32_extra_bytes(spec, state):
+    def mutate(spec, body):
+        tx = bytes(body.execution_payload.transactions[0]) + b"\x11" * 32
+        body.execution_payload.transactions[0] = tx
+    yield from _run_payload_test(spec, state, mutate, valid=False)
+
+
+@DENEB_ONLY
+@spec_state_test
+def test_invalid_no_transactions_with_commitments(spec, state):
+    def mutate(spec, body):
+        body.execution_payload.transactions = []
+    yield from _run_payload_test(spec, state, mutate, valid=False)
+
+
+@DENEB_ONLY
+@spec_state_test
+def test_invalid_incorrect_commitment(spec, state):
+    def mutate(spec, body):
+        c = bytearray(bytes(body.blob_kzg_commitments[0]))
+        c[-1] ^= 0xFF
+        body.blob_kzg_commitments[0] = c
+    yield from _run_payload_test(spec, state, mutate, valid=False)
+
+
+@DENEB_ONLY
+@spec_state_test
+def test_invalid_incorrect_commitments_order(spec, state):
+    def mutate(spec, body):
+        a, b = body.blob_kzg_commitments[0], body.blob_kzg_commitments[1]
+        body.blob_kzg_commitments[0] = b
+        body.blob_kzg_commitments[1] = a
+    yield from _run_payload_test(spec, state, mutate, valid=False)
+
+
+@DENEB_ONLY
+@spec_state_test
+def test_invalid_block_hash(spec, state):
+    def bad_block_hash(spec, body):
+        body.execution_payload.block_hash = spec.Hash32(b"\x12" * 32)
+    yield from _run_payload_test(spec, state, bad_block_hash, valid=False)
+
+
+@DENEB_ONLY
+@spec_state_test
+def test_zeroed_commitment(spec, state):
+    """An all-zero commitment is hash-consistent if the tx carries its
+    versioned hash — the payload processor accepts it (validity of the
+    commitment itself is the kzg library's concern)."""
+    def mutate(spec, body):
+        zero = spec.KZGCommitment(b"\x00" * 48)
+        body.blob_kzg_commitments = [zero]
+        body.execution_payload.transactions = [tx_with_versioned_hashes(
+            [spec.kzg_commitment_to_versioned_hash(zero)])]
+    yield from _run_payload_test(spec, state, mutate, valid=True)
+
+
+@DENEB_ONLY
+@spec_state_test
+def test_invalid_correct_input_execution_invalid(spec, state):
+    class RejectingEngine(BlobVersionedHashesExecutionEngine):
+        def notify_new_payload(self, *a, **k) -> bool:
+            return False
+    yield from _run_payload_test(
+        spec, state, valid=False, engine=RejectingEngine(spec))
+
+
+@DENEB_ONLY
+@spec_state_test
+def test_multiple_blob_txs(spec, state):
+    """Versioned hashes concatenate across several blob transactions in
+    payload order."""
+    def mutate(spec, body):
+        h = [spec.kzg_commitment_to_versioned_hash(c)
+             for c in body.blob_kzg_commitments]
+        body.execution_payload.transactions = [
+            tx_with_versioned_hashes(h[:1]), tx_with_versioned_hashes(h[1:])]
+    yield from _run_payload_test(spec, state, mutate, valid=True)

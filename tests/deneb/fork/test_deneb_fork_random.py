@@ -1,0 +1,98 @@
+"""Randomized-pre-state upgrade_to_deneb tests.
+
+Reference model: ``test/deneb/fork/test_deneb_fork_random.py`` — seeded
+random capella states (random participation, balances, leak, large
+validator churn) pushed through the fork upgrade, checking the
+roots-preserving invariants of ``run_fork_test``.
+"""
+from random import Random
+
+from consensus_specs_tpu.forks import build_spec
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases, never_bls,
+)
+from consensus_specs_tpu.test_infra.block import next_epoch, next_slots
+from consensus_specs_tpu.test_infra.random_scenarios import randomize_state
+from consensus_specs_tpu.test_infra.rewards import set_state_in_leak
+
+from tests.deneb.fork.test_deneb_fork import run_fork_test
+
+CAPELLA_PRE = with_phases(["capella"])
+
+
+def _randomized(spec, state, seed, leak=False, exit_fraction=0.05,
+                slash_fraction=0.05):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    rng = Random(seed)
+    randomize_state(spec, state, rng, exit_fraction=exit_fraction,
+                    slash_fraction=slash_fraction)
+    if leak:
+        set_state_in_leak(spec, state)
+    return state
+
+
+@CAPELLA_PRE
+@spec_state_test
+@never_bls
+def test_deneb_fork_random_0(spec, state):
+    post_spec = build_spec("deneb", spec.preset_name)
+    yield from run_fork_test(post_spec, _randomized(spec, state, 5010))
+
+
+@CAPELLA_PRE
+@spec_state_test
+@never_bls
+def test_deneb_fork_random_1(spec, state):
+    post_spec = build_spec("deneb", spec.preset_name)
+    yield from run_fork_test(post_spec, _randomized(spec, state, 5011))
+
+
+@CAPELLA_PRE
+@spec_state_test
+@never_bls
+def test_deneb_fork_random_2(spec, state):
+    post_spec = build_spec("deneb", spec.preset_name)
+    yield from run_fork_test(post_spec, _randomized(spec, state, 5012))
+
+
+@CAPELLA_PRE
+@spec_state_test
+@never_bls
+def test_deneb_fork_random_leak(spec, state):
+    post_spec = build_spec("deneb", spec.preset_name)
+    yield from run_fork_test(
+        post_spec, _randomized(spec, state, 5013, leak=True))
+
+
+@CAPELLA_PRE
+@spec_state_test
+@never_bls
+def test_deneb_fork_random_heavy_exits(spec, state):
+    post_spec = build_spec("deneb", spec.preset_name)
+    yield from run_fork_test(
+        post_spec,
+        _randomized(spec, state, 5014, exit_fraction=0.3,
+                    slash_fraction=0.0))
+
+
+@CAPELLA_PRE
+@spec_state_test
+@never_bls
+def test_deneb_fork_random_heavy_slashes(spec, state):
+    post_spec = build_spec("deneb", spec.preset_name)
+    yield from run_fork_test(
+        post_spec,
+        _randomized(spec, state, 5015, exit_fraction=0.0,
+                    slash_fraction=0.3))
+
+
+@CAPELLA_PRE
+@spec_state_test
+@never_bls
+def test_deneb_fork_random_mid_epoch(spec, state):
+    """Upgrade landing mid-epoch (not on a boundary slot)."""
+    post_spec = build_spec("deneb", spec.preset_name)
+    state = _randomized(spec, state, 5016)
+    next_slots(spec, state, 3)
+    yield from run_fork_test(post_spec, state)

@@ -1,0 +1,145 @@
+"""Deneb seeded randomized scenarios: random blocks carrying random blob
+commitments (with matching versioned hashes through the payload) on top
+of the phase0 random-op mix.
+
+Reference model: ``test/deneb/random/test_random.py`` (16 seeded
+scenarios from the randomized_block_tests DSL).
+"""
+from random import Random
+
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_phases,
+)
+from consensus_specs_tpu.test_infra.block import (
+    next_epoch, next_slots, state_transition_and_sign_block,
+)
+from consensus_specs_tpu.test_infra.random_scenarios import (
+    randomize_state, random_block,
+)
+from consensus_specs_tpu.test_infra.rewards import set_state_in_leak
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+DENEB_ONLY = with_phases(["deneb"])
+
+
+def _skip_slashed_proposers(spec, state):
+    """Randomized registries can hand proposer duty to a slashed
+    validator, whose block the spec rejects; advance past those slots."""
+    probe = state.copy()
+    spec.process_slots(probe, probe.slot + 1)
+    skipped = 0
+    while probe.validators[spec.get_beacon_proposer_index(probe)].slashed:
+        spec.process_slots(probe, probe.slot + 1)
+        skipped += 1
+    if skipped:
+        next_slots(spec, state, skipped)
+
+
+def _random_blob_block(spec, state, rng):
+    """A random-op block additionally carrying 0..MAX_BLOBS_PER_BLOCK
+    commitments (infinity points: valid commitments whose data the
+    NoopExecutionEngine treats as available)."""
+    _skip_slashed_proposers(spec, state)
+    block = random_block(spec, state, rng)
+    n_blobs = rng.randint(0, spec.MAX_BLOBS_PER_BLOCK)
+    block.body.blob_kzg_commitments = [spec.G1_POINT_AT_INFINITY] * n_blobs
+    return block
+
+
+def _run_scenario(spec, state, seed, epochs=1, leak=False,
+                  blocks_per_epoch=4):
+    rng = Random(seed)
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    if leak:
+        set_state_in_leak(spec, state)
+    randomize_state(spec, state, rng, exit_fraction=0.05,
+                    slash_fraction=0.05)
+    yield "pre", state
+    signed_blocks = []
+    for _ in range(epochs):
+        for _ in range(blocks_per_epoch):
+            if rng.random() < 0.3:
+                next_slots(spec, state, rng.randint(1, 2))
+            block = _random_blob_block(spec, state, rng)
+            signed_blocks.append(
+                state_transition_and_sign_block(spec, state, block))
+        next_epoch(spec, state)
+    assert hash_tree_root(state) is not None
+    yield "blocks", signed_blocks
+    yield "post", state
+
+
+@DENEB_ONLY
+@spec_state_test
+def test_random_blob_blocks_0(spec, state):
+    yield from _run_scenario(spec, state, seed=440)
+
+
+@DENEB_ONLY
+@spec_state_test
+def test_random_blob_blocks_1(spec, state):
+    yield from _run_scenario(spec, state, seed=441)
+
+
+@DENEB_ONLY
+@spec_state_test
+def test_random_blob_blocks_2(spec, state):
+    yield from _run_scenario(spec, state, seed=442)
+
+
+@DENEB_ONLY
+@spec_state_test
+def test_random_blob_blocks_multi_epoch(spec, state):
+    yield from _run_scenario(spec, state, seed=443, epochs=2)
+
+
+@DENEB_ONLY
+@spec_state_test
+def test_random_blob_blocks_leak_0(spec, state):
+    yield from _run_scenario(spec, state, seed=444, leak=True)
+
+
+@DENEB_ONLY
+@spec_state_test
+def test_random_blob_blocks_leak_1(spec, state):
+    yield from _run_scenario(spec, state, seed=445, leak=True)
+
+
+@DENEB_ONLY
+@spec_state_test
+def test_random_blob_blocks_sparse(spec, state):
+    """Longer slot gaps between blocks (epoch-boundary crossings)."""
+    rng = Random(446)
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    randomize_state(spec, state, rng, exit_fraction=0.02,
+                    slash_fraction=0.02)
+    yield "pre", state
+    signed_blocks = []
+    for _ in range(4):
+        next_slots(spec, state, rng.randint(3, 9))
+        block = _random_blob_block(spec, state, rng)
+        signed_blocks.append(
+            state_transition_and_sign_block(spec, state, block))
+    yield "blocks", signed_blocks
+    yield "post", state
+
+
+@DENEB_ONLY
+@spec_state_test
+def test_random_blob_blocks_max_blobs_every_block(spec, state):
+    """Every block saturated at MAX_BLOBS_PER_BLOCK commitments."""
+    rng = Random(447)
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    yield "pre", state
+    signed_blocks = []
+    for _ in range(4):
+        block = random_block(spec, state, rng)
+        block.body.blob_kzg_commitments = \
+            [spec.G1_POINT_AT_INFINITY] * spec.MAX_BLOBS_PER_BLOCK
+        signed_blocks.append(
+            state_transition_and_sign_block(spec, state, block))
+    yield "blocks", signed_blocks
+    yield "post", state

@@ -1,0 +1,185 @@
+"""get_head weight accounting: attestation votes, latest-message
+freshness, equivocation discard, proposer-boost weight.
+
+Reference models: ``test/phase0/fork_choice/test_get_head.py``
+(``discard_equivocations``, vote-shifted heads) against
+``specs/phase0/fork-choice.md`` get_weight/on_attester_slashing.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases, never_bls,
+)
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block,
+    next_slots,
+)
+from consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+from consensus_specs_tpu.test_infra.slashings import (
+    get_valid_attester_slashing, get_indexed_attestation_participants,
+)
+from consensus_specs_tpu.test_infra.fork_choice import (
+    get_genesis_forkchoice_store_and_block, on_tick_and_append_step,
+    tick_and_add_block, add_attestation, add_attester_slashing,
+)
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+
+def _two_forks(spec, state, store, test_steps):
+    """Two competing single-block forks on top of genesis; returns
+    (state_a, root_a, state_b, root_b) with both blocks in the store."""
+    base = state.copy()
+    state_a = base.copy()
+    block_a = build_empty_block_for_next_slot(spec, state_a)
+    signed_a = state_transition_and_sign_block(spec, state_a, block_a)
+    state_b = base.copy()
+    block_b = build_empty_block_for_next_slot(spec, state_b)
+    block_b.body.graffiti = b"\x42" * 32
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+    tick_and_add_block(spec, store, signed_a, test_steps)
+    tick_and_add_block(spec, store, signed_b, test_steps)
+    return (state_a, hash_tree_root(block_a),
+            state_b, hash_tree_root(block_b))
+
+
+def _tick_next_slot(spec, store, test_steps):
+    slot = spec.get_current_slot(store) + 1
+    on_tick_and_append_step(
+        spec, store,
+        store.genesis_time + int(slot) * int(spec.config.SECONDS_PER_SLOT),
+        test_steps)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_attestation_flips_head(spec, state):
+    """Votes for the tie-break loser flip the head to it."""
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    state_a, root_a, state_b, root_b = _two_forks(spec, state, store,
+                                                  test_steps)
+    tie_winner = bytes(spec.get_head(store))
+    loser_state, loser_root = \
+        (state_a, root_a) if tie_winner == bytes(root_b) else (state_b, root_b)
+    att = get_valid_attestation(spec, loser_state, signed=True)
+    # attestation slot must be reached + 1 before on_attestation accepts
+    next_slots(spec, loser_state, 2)
+    _tick_next_slot(spec, store, test_steps)
+    _tick_next_slot(spec, store, test_steps)
+    add_attestation(spec, store, att, test_steps)
+    assert bytes(spec.get_head(store)) == bytes(loser_root)
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_equivocating_votes_discarded(spec, state):
+    """After on_attester_slashing, the equivocators' latest messages no
+    longer count toward get_weight and the head reverts."""
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    state_a, root_a, state_b, root_b = _two_forks(spec, state, store,
+                                                  test_steps)
+    # with no votes and no boost the tie-break is the lexicographic max;
+    # vote for the SMALLER root so both flips are observable
+    _tick_next_slot(spec, store, test_steps)   # boost wears off
+    tie_winner = max([bytes(root_a), bytes(root_b)])
+    assert bytes(spec.get_head(store)) == tie_winner
+    loser_state, loser_root = \
+        (state_a, root_a) if tie_winner == bytes(root_b) else (state_b, root_b)
+    att = get_valid_attestation(spec, loser_state, signed=True)
+    _tick_next_slot(spec, store, test_steps)
+    add_attestation(spec, store, att, test_steps)
+    assert bytes(spec.get_head(store)) == bytes(loser_root)
+
+    # slash exactly the attesting committee: their votes are discarded
+    slashing = get_valid_attester_slashing(
+        spec, loser_state, slot=att.data.slot, signed_1=True, signed_2=True)
+    participants = get_indexed_attestation_participants(
+        spec, slashing.attestation_1)
+    add_attester_slashing(spec, store, slashing, test_steps)
+    assert all(int(i) in store.equivocating_indices for i in participants)
+    assert bytes(spec.get_head(store)) == tie_winner
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_equivocators_ignored_for_future_votes(spec, state):
+    """A new attestation from an equivocating validator never re-enters
+    latest_messages."""
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    state_a, root_a, state_b, root_b = _two_forks(spec, state, store,
+                                                  test_steps)
+    loser_state, loser_root = state_b, root_b
+    att = get_valid_attestation(spec, loser_state, signed=True)
+    slashing = get_valid_attester_slashing(
+        spec, loser_state, slot=att.data.slot, signed_1=True, signed_2=True)
+    participants = set(map(int, get_indexed_attestation_participants(
+        spec, slashing.attestation_1)))
+    _tick_next_slot(spec, store, test_steps)
+    _tick_next_slot(spec, store, test_steps)
+    add_attester_slashing(spec, store, slashing, test_steps)
+    add_attestation(spec, store, att, test_steps)
+    assert not (participants & set(store.latest_messages.keys()))
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_proposer_boost_weight_without_votes(spec, state):
+    """A timely block's weight includes the committee-fraction boost
+    even with zero attestations."""
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    on_tick_and_append_step(
+        spec, store,
+        store.genesis_time
+        + int(signed.message.slot) * int(spec.config.SECONDS_PER_SLOT),
+        test_steps)
+    tick_and_add_block(spec, store, signed, test_steps)
+    root = hash_tree_root(block)
+    assert bytes(store.proposer_boost_root) == root
+    assert spec.get_weight(store, root) > 0
+    # after the boost wears off (next slot), weight drops back to zero
+    _tick_next_slot(spec, store, test_steps)
+    assert spec.get_weight(store, root) == 0
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_invalid_attestation_unknown_block(spec, state):
+    """on_attestation rejects votes for blocks the store has not seen."""
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    att = get_valid_attestation(spec, state, signed=True)
+    att.data.beacon_block_root = b"\x99" * 32
+    _tick_next_slot(spec, store, test_steps)
+    _tick_next_slot(spec, store, test_steps)
+    add_attestation(spec, store, att, test_steps, valid=False)
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_invalid_attestation_future_slot(spec, state):
+    """Votes whose slot the store has not reached are rejected (queued
+    by real clients, asserted here)."""
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    tick_and_add_block(spec, store, signed, test_steps)
+    att = get_valid_attestation(spec, state, signed=True)
+    # store time still at the attestation's slot: slot + 1 not reached
+    assert spec.get_current_slot(store) == att.data.slot
+    add_attestation(spec, store, att, test_steps, valid=False)
+    yield "steps", test_steps

@@ -1,0 +1,221 @@
+"""on_block handler invariants: finalized-ancestry guards, proposer
+boost timeliness, boost reset, pulled-up justification.
+
+Reference model: ``test/phase0/fork_choice/test_on_block.py`` against
+``specs/phase0/fork-choice.md`` on_block/on_tick.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases, never_bls,
+)
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block,
+    next_slots, next_epoch,
+)
+from consensus_specs_tpu.test_infra.fork_choice import (
+    get_genesis_forkchoice_store_and_block, on_tick_and_append_step,
+    tick_and_add_block, add_block, apply_next_epoch_with_attestations,
+)
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+
+def _block_time(spec, store, slot):
+    return store.genesis_time + int(slot) * int(spec.config.SECONDS_PER_SLOT)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_on_block_basic_chain_checkpoints(spec, state):
+    """Two attested epochs: store's justified checkpoint advances."""
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    on_tick_and_append_step(
+        spec, store, _block_time(spec, store, state.slot), test_steps)
+    for _ in range(3):
+        state, store, _ = apply_next_epoch_with_attestations(
+            spec, state, store, True, False, test_steps)
+    assert store.justified_checkpoint.epoch > 0
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_invalid_on_block_before_finalized_slot(spec, state):
+    """A block at/before the finalized epoch's start slot is rejected."""
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    on_tick_and_append_step(
+        spec, store, _block_time(spec, store, state.slot), test_steps)
+    # a competing branch buildable from genesis later
+    early_state = state.copy()
+    state, store, _ = apply_next_epoch_with_attestations(
+        spec, state, store, True, False, test_steps)
+    for _ in range(3):
+        state, store, _ = apply_next_epoch_with_attestations(
+            spec, state, store, True, True, test_steps)
+    assert store.finalized_checkpoint.epoch > 0
+    # block on the abandoned early branch: slot <= finalized start slot
+    block = build_empty_block_for_next_slot(spec, early_state)
+    signed = state_transition_and_sign_block(spec, early_state, block)
+    assert signed.message.slot <= spec.compute_start_slot_at_epoch(
+        store.finalized_checkpoint.epoch)
+    add_block(spec, store, signed, test_steps, valid=False)
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_invalid_on_block_not_finalized_descendant(spec, state):
+    """A block past the finalized slot whose ancestry bypasses the
+    finalized checkpoint is rejected."""
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    on_tick_and_append_step(
+        spec, store, _block_time(spec, store, state.slot), test_steps)
+    early_state = state.copy()
+    state, store, _ = apply_next_epoch_with_attestations(
+        spec, state, store, True, False, test_steps)
+    for _ in range(3):
+        state, store, _ = apply_next_epoch_with_attestations(
+            spec, state, store, True, True, test_steps)
+    assert store.finalized_checkpoint.epoch > 0
+    # grow the early branch beyond the finalized slot, then submit its tip
+    finalized_slot = spec.compute_start_slot_at_epoch(
+        store.finalized_checkpoint.epoch)
+    next_slots(spec, early_state, int(finalized_slot - early_state.slot) + 2)
+    block = build_empty_block_for_next_slot(spec, early_state)
+    signed = state_transition_and_sign_block(spec, early_state, block)
+    assert signed.message.slot > finalized_slot
+    # its parent chain is NOT in the store (pruned branch): on_block asserts
+    add_block(spec, store, signed, test_steps, valid=False)
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_on_block_finalized_skip_slots(spec, state):
+    """A valid descendant after skipped slots is accepted and can win."""
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    on_tick_and_append_step(
+        spec, store, _block_time(spec, store, state.slot), test_steps)
+    state, store, _ = apply_next_epoch_with_attestations(
+        spec, state, store, True, False, test_steps)
+    state, store, _ = apply_next_epoch_with_attestations(
+        spec, state, store, True, True, test_steps)
+    next_slots(spec, state, 3)          # skip slots
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    tick_and_add_block(spec, store, signed, test_steps)
+    assert bytes(spec.get_head(store)) == hash_tree_root(block)
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_proposer_boost_timely_block(spec, state):
+    """A block arriving before the attesting interval earns the boost."""
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    # tick exactly to the block's slot start: within the first interval
+    on_tick_and_append_step(
+        spec, store, _block_time(spec, store, signed.message.slot),
+        test_steps)
+    add_block(spec, store, signed, test_steps)
+    assert bytes(store.proposer_boost_root) == hash_tree_root(block)
+    assert store.block_timeliness[hash_tree_root(block)]
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_proposer_boost_late_block_not_boosted(spec, state):
+    """Arrival after the attesting-interval cutoff: no boost."""
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    late = (_block_time(spec, store, signed.message.slot)
+            + int(spec.config.SECONDS_PER_SLOT) // spec.INTERVALS_PER_SLOT + 1)
+    on_tick_and_append_step(spec, store, late, test_steps)
+    add_block(spec, store, signed, test_steps)
+    assert bytes(store.proposer_boost_root) == b"\x00" * 32
+    assert not store.block_timeliness[hash_tree_root(block)]
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_proposer_boost_cleared_next_slot(spec, state):
+    """on_tick into the next slot wipes proposer_boost_root."""
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    on_tick_and_append_step(
+        spec, store, _block_time(spec, store, signed.message.slot),
+        test_steps)
+    add_block(spec, store, signed, test_steps)
+    assert bytes(store.proposer_boost_root) != b"\x00" * 32
+    on_tick_and_append_step(
+        spec, store, _block_time(spec, store, signed.message.slot + 1),
+        test_steps)
+    assert bytes(store.proposer_boost_root) == b"\x00" * 32
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_proposer_boost_not_stolen_by_second_block(spec, state):
+    """Boost goes to the FIRST timely block of the slot only."""
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    base = state.copy()
+    block_a = build_empty_block_for_next_slot(spec, state)
+    signed_a = state_transition_and_sign_block(spec, state, block_a)
+    # competing block for the SAME slot (different graffiti)
+    state_b = base.copy()
+    block_b = build_empty_block_for_next_slot(spec, state_b)
+    block_b.body.graffiti = b"\x42" * 32
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+    on_tick_and_append_step(
+        spec, store, _block_time(spec, store, signed_a.message.slot),
+        test_steps)
+    add_block(spec, store, signed_a, test_steps)
+    add_block(spec, store, signed_b, test_steps)
+    assert bytes(store.proposer_boost_root) == hash_tree_root(block_a)
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_pulled_up_justification_applied_at_epoch_boundary(spec, state):
+    """Unrealized justification becomes realized when the epoch ticks
+    over (on_tick_per_slot at the boundary)."""
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    on_tick_and_append_step(
+        spec, store, _block_time(spec, store, state.slot), test_steps)
+    state, store, _ = apply_next_epoch_with_attestations(
+        spec, state, store, True, False, test_steps)
+    state, store, _ = apply_next_epoch_with_attestations(
+        spec, state, store, True, False, test_steps)
+    unrealized = store.unrealized_justified_checkpoint
+    assert unrealized.epoch >= store.justified_checkpoint.epoch
+    # tick to the next epoch boundary: unrealized promotes
+    next_boundary_slot = spec.compute_start_slot_at_epoch(
+        spec.compute_epoch_at_slot(spec.get_current_slot(store)) + 1)
+    on_tick_and_append_step(
+        spec, store, _block_time(spec, store, next_boundary_slot), test_steps)
+    assert store.justified_checkpoint.epoch == unrealized.epoch
+    yield "steps", test_steps

@@ -207,3 +207,121 @@ def test_rewards_leak_with_slashed(spec, state):
     rw.prepare_state_with_attestations(spec, state)
     yield "pre", state
     yield from rw.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_with_not_yet_activated_validators(spec, state):
+    """Pending validators are excluded from attestation deltas."""
+    rng = Random(1101)
+    for index in rng.sample(range(len(state.validators)), 4):
+        v = state.validators[index]
+        v.activation_eligibility_epoch = spec.get_current_epoch(state) + 3
+        v.activation_epoch = spec.FAR_FUTURE_EPOCH
+    rw.prepare_state_with_attestations(spec, state)
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_with_zero_balances(spec, state):
+    """Zero-balance (but active) validators: penalties floor at zero."""
+    rng = Random(1102)
+    for index in rng.sample(range(len(state.validators)), 4):
+        state.balances[index] = 0
+    rw.prepare_state_with_attestations(
+        spec, state, participation_fn=rw.randomize_participation(rng, 0.5))
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_leak_misc_balances(spec, state):
+    """Inactivity leak over a registry with scattered effective balances."""
+    rng = Random(1103)
+    for index in range(len(state.validators)):
+        state.validators[index].effective_balance = spec.Gwei(
+            rng.randrange(0, int(spec.MAX_EFFECTIVE_BALANCE) + 1,
+                          int(spec.EFFECTIVE_BALANCE_INCREMENT)))
+    rw.set_state_in_leak(spec, state)
+    rw.prepare_state_with_attestations(
+        spec, state, participation_fn=rw.randomize_participation(rng, 0.6))
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_leak_some_exited(spec, state):
+    rng = Random(1104)
+    current_epoch = spec.get_current_epoch(state)
+    for index in rng.sample(range(len(state.validators)), 4):
+        state.validators[index].exit_epoch = current_epoch
+        state.validators[index].withdrawable_epoch = current_epoch + 1
+    rw.set_state_in_leak(spec, state)
+    rw.prepare_state_with_attestations(spec, state)
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_random_seed_3(spec, state):
+    rng = Random(3033)
+    rw.prepare_state_with_attestations(
+        spec, state, participation_fn=rw.randomize_participation(rng, 0.3))
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_random_seed_4(spec, state):
+    rng = Random(4044)
+    rw.prepare_state_with_attestations(
+        spec, state, participation_fn=rw.randomize_participation(rng, 0.9))
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_leak_random_seed_5(spec, state):
+    rng = Random(5055)
+    rw.set_state_in_leak(spec, state)
+    rw.prepare_state_with_attestations(
+        spec, state, participation_fn=rw.randomize_participation(rng, 0.4))
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_full_but_low_effective_balance(spec, state):
+    """Every validator at the minimum nonzero effective balance."""
+    for index in range(len(state.validators)):
+        state.validators[index].effective_balance = \
+            spec.EFFECTIVE_BALANCE_INCREMENT
+    rw.prepare_state_with_attestations(spec, state)
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_mixed_slashed_and_exited(spec, state):
+    rng = Random(1107)
+    current_epoch = spec.get_current_epoch(state)
+    indices = rng.sample(range(len(state.validators)), 8)
+    for index in indices[:4]:
+        state.validators[index].slashed = True
+        state.validators[index].withdrawable_epoch = current_epoch + \
+            spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    for index in indices[4:]:
+        state.validators[index].exit_epoch = current_epoch
+    rw.prepare_state_with_attestations(
+        spec, state, participation_fn=rw.randomize_participation(rng, 0.7))
+    yield "pre", state
+    yield from rw.run_deltas(spec, state)

@@ -200,6 +200,8 @@ def test_backend_switch_integration(fixture):
     from consensus_specs_tpu.utils import bls
     pks, sigs, agg = fixture
     prev = bls.backend_name()
+    restore = {"py": bls.use_py, "jax": bls.use_jax,
+               "native": bls.use_native, "fastest": bls.use_fastest}
     try:
         bls.use_native()
         assert bls.backend_name() == "native"
@@ -208,4 +210,4 @@ def test_backend_switch_integration(fixture):
         assert not bls.Verify(pks[0], b"no", sigs[0])
         assert bls.AggregatePKs(pks) == py.AggregatePKs(pks)
     finally:
-        bls.use_py() if prev == "py" else None
+        restore.get(prev, bls.use_py)()
