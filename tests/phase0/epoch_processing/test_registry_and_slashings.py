@@ -183,3 +183,117 @@ def test_slashings_no_penalty_outside_window(spec, state):
     spec.process_slashings(state)
     yield "post", state
     assert state.balances[0] == pre_balance
+
+
+def _eject_validator(spec, state, index):
+    state.validators[index].effective_balance = spec.config.EJECTION_BALANCE
+    state.balances[index] = spec.config.EJECTION_BALANCE
+
+
+@with_all_phases
+@spec_state_test
+def test_ejection(spec, state):
+    """One validator at EJECTION_BALANCE is exited by registry updates."""
+    index = 0
+    assert spec.is_active_validator(
+        state.validators[index], spec.get_current_epoch(state))
+    _eject_validator(spec, state, index)
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    assert state.validators[index].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_to_activated_if_finalized(spec, state):
+    """Eligibility at/below the finalized epoch dequeues; above it stays."""
+    state.finalized_checkpoint.epoch = 2
+    _queue_validator(spec, state, 0, epoch=2)       # dequeues
+    _queue_validator(spec, state, 1, epoch=3)       # stays queued
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    assert state.validators[0].activation_epoch != spec.FAR_FUTURE_EPOCH
+    assert state.validators[1].activation_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_and_ejection_one_each(spec, state):
+    """Activation churn and ejections process independently in one pass."""
+    state.finalized_checkpoint.epoch = 2
+    _queue_validator(spec, state, 0, epoch=2)
+    _eject_validator(spec, state, 1)
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    assert state.validators[0].activation_epoch != spec.FAR_FUTURE_EPOCH
+    assert state.validators[1].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_exceeds_churn_limit(spec, state):
+    """churn+1 eligible validators: exactly churn activate, the tail
+    (highest index) stays queued."""
+    churn = int(spec.get_validator_churn_limit(state))
+    state.finalized_checkpoint.epoch = 2
+    for index in range(churn + 1):
+        _queue_validator(spec, state, index, epoch=2)
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    activated = [i for i in range(churn + 1)
+                 if state.validators[i].activation_epoch
+                 != spec.FAR_FUTURE_EPOCH]
+    assert len(activated) == churn
+    assert churn not in activated
+    assert state.validators[churn].activation_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_ejections_past_churn_all_exit(spec, state):
+    """Ejections are NOT churn-limited at initiation: every ejected
+    validator gets an exit epoch, the queue spreads via exit churn."""
+    churn = int(spec.get_validator_churn_limit(state))
+    count = churn + 2
+    for index in range(count):
+        _eject_validator(spec, state, index)
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+    exited = [i for i in range(count)
+              if state.validators[i].exit_epoch != spec.FAR_FUTURE_EPOCH]
+    assert len(exited) == count
+    # exit epochs cluster then spill by churn
+    epochs = sorted(int(state.validators[i].exit_epoch) for i in exited)
+    assert epochs[-1] >= epochs[0]
+    assert len([e for e in epochs if e == epochs[0]]) <= churn
+
+
+@with_all_phases
+@spec_state_test
+def test_effective_balance_hysteresis(spec, state):
+    """process_effective_balance_updates: the effective balance moves
+    only when the balance leaves the hysteresis band."""
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    q = inc // int(spec.HYSTERESIS_QUOTIENT)
+    down = q * int(spec.HYSTERESIS_DOWNWARD_MULTIPLIER)
+    up = q * int(spec.HYSTERESIS_UPWARD_MULTIPLIER)
+    max_eff = int(spec.MAX_EFFECTIVE_BALANCE)
+    # (pre_effective, balance) pairs probing both band edges
+    cases = [
+        (max_eff, max_eff),                 # at cap, no move
+        (max_eff, max_eff - down),          # inside band: hold
+        (max_eff, max_eff - down - 1),      # below band: drop
+        (max_eff - inc, max_eff - inc + up),      # inside band: hold
+        (max_eff - inc, max_eff - inc + up + 1),  # above band: rise
+    ]
+    for i, (pre_eff, bal) in enumerate(cases):
+        state.validators[i].effective_balance = pre_eff
+        state.balances[i] = bal
+    yield from run_epoch_processing_with(
+        spec, state, "process_effective_balance_updates")
+    for i, (pre_eff, bal) in enumerate(cases):
+        if bal + down < pre_eff or pre_eff + up < bal:
+            expected = min(bal - bal % inc, max_eff)
+        else:
+            expected = pre_eff
+        assert int(state.validators[i].effective_balance) == expected, i

@@ -223,3 +223,122 @@ def test_shuffle_proof_rejects_non_permutation():
     bad = bytearray(proof)
     bad[60] ^= 0x01
     assert not whisk_proofs.IsValidWhiskShuffleProof(pre, post_t, bytes(bad))
+
+
+@with_phases(["whisk"])
+@spec_state_test
+def test_whisk_invalid_identity_tracker_registration(spec, state):
+    """First proposal must not re-register the identity form r_G == G."""
+    block = build_whisk_block(spec, state, register=True)
+    block.body.whisk_tracker = spec.WhiskTracker(
+        r_G=spec.BLS_G1_GENERATOR,
+        k_r_G=bytes(block.body.whisk_tracker.k_r_G))
+    expect_assertion_error(lambda: _transition(spec, state.copy(), block))
+
+
+@with_phases(["whisk"])
+@spec_state_test
+def test_whisk_invalid_non_unique_k_other(spec, state):
+    """Registering another validator's k commitment is rejected."""
+    block = build_whisk_block(spec, state, register=True)
+    other = (block.proposer_index + 1) % len(state.validators)
+    other_k = spec.get_initial_whisk_k(other, 0)
+    r = 12345
+    tracker = spec.WhiskTracker(
+        r_G=spec.BLSG1ScalarMultiply(r, spec.BLS_G1_GENERATOR),
+        k_r_G=spec.BLSG1ScalarMultiply(
+            (other_k * r) % spec.BLS_MODULUS, spec.BLS_G1_GENERATOR))
+    block.body.whisk_tracker = tracker
+    block.body.whisk_k_commitment = spec.get_k_commitment(other_k)
+    block.body.whisk_registration_proof = \
+        whisk_proofs.GenerateWhiskTrackerProof(tracker, other_k)
+    expect_assertion_error(lambda: _transition(spec, state.copy(), block))
+
+
+@with_phases(["whisk"])
+@spec_state_test
+def test_whisk_second_proposal_empty_registration(spec, state):
+    """A proposer with a non-initial tracker must leave the registration
+    fields zeroed (second-proposal branch)."""
+    # learn the slot's proposer on a throwaway copy, then mutate the
+    # real state BEFORE building (the block binds the parent state root)
+    probe = build_whisk_block(spec, state.copy(), register=False)
+    k = spec.get_initial_whisk_k(probe.proposer_index, 0)
+    r = 999
+    state.whisk_trackers[probe.proposer_index] = spec.WhiskTracker(
+        r_G=spec.BLSG1ScalarMultiply(r, spec.BLS_G1_GENERATOR),
+        k_r_G=spec.BLSG1ScalarMultiply(
+            (k * r) % spec.BLS_MODULUS, spec.BLS_G1_GENERATOR))
+    block = build_whisk_block(spec, state, register=False)
+    assert block.proposer_index == probe.proposer_index
+    yield "pre", state
+    _transition(spec, state, block)
+    yield "post", state
+
+
+@with_phases(["whisk"])
+@spec_state_test
+def test_whisk_invalid_second_proposal_with_registration(spec, state):
+    """Re-registration by an already-registered proposer is rejected."""
+    probe = build_whisk_block(spec, state.copy(), register=True)
+    k = spec.get_initial_whisk_k(probe.proposer_index, 0)
+    r = 999
+    state.whisk_trackers[probe.proposer_index] = spec.WhiskTracker(
+        r_G=spec.BLSG1ScalarMultiply(r, spec.BLS_G1_GENERATOR),
+        k_r_G=spec.BLSG1ScalarMultiply(
+            (k * r) % spec.BLS_MODULUS, spec.BLS_G1_GENERATOR))
+    block = build_whisk_block(spec, state, register=True)
+    expect_assertion_error(lambda: _transition(spec, state.copy(), block))
+
+
+@with_phases(["whisk"])
+@spec_state_test
+def test_whisk_invalid_zeroed_shuffle_outside_cooldown(spec, state):
+    """During the active shuffle window, zeroed post-trackers (the
+    cooldown form) are rejected."""
+    shuffle_epoch = spec.get_current_epoch(state) \
+        % spec.config.WHISK_EPOCHS_PER_SHUFFLING_PHASE
+    assert shuffle_epoch + spec.config.WHISK_PROPOSER_SELECTION_GAP + 1 \
+        < spec.config.WHISK_EPOCHS_PER_SHUFFLING_PHASE
+    block = build_whisk_block(spec, state, register=True)
+    block.body.whisk_post_shuffle_trackers = type(
+        block.body.whisk_post_shuffle_trackers)()
+    block.body.whisk_shuffle_proof = spec.WhiskShuffleProof()
+    expect_assertion_error(lambda: _transition(spec, state.copy(), block))
+
+
+def _advance_to_cooldown(spec, state):
+    """Advance so shuffle_epoch falls in the cooldown window."""
+    phase = spec.config.WHISK_EPOCHS_PER_SHUFFLING_PHASE
+    gap = spec.config.WHISK_PROPOSER_SELECTION_GAP
+    while (spec.get_current_epoch(state) % phase) + gap + 1 < phase:
+        spec.process_slots(state, state.slot + spec.SLOTS_PER_EPOCH)
+
+
+@with_phases(["whisk"])
+@spec_state_test
+def test_whisk_cooldown_zeroed_shuffle_ok(spec, state):
+    """In the cooldown window a zeroed shuffle is the only valid form."""
+    _advance_to_cooldown(spec, state)
+    block = build_whisk_block(spec, state, register=True)
+    assert bytes(block.body.whisk_shuffle_proof) == \
+        bytes(spec.WhiskShuffleProof())
+    yield "pre", state
+    _transition(spec, state, block)
+    yield "post", state
+
+
+@with_phases(["whisk"])
+@spec_state_test
+def test_whisk_invalid_cooldown_non_zero_shuffle(spec, state):
+    """Shuffling during the cooldown window is rejected."""
+    _advance_to_cooldown(spec, state)
+    block = build_whisk_block(spec, state, register=True)
+    indices = spec.get_shuffle_indices(block.body.randao_reveal)
+    pre = [state.whisk_candidate_trackers[i] for i in indices]
+    post, proof = whisk_proofs.GenerateWhiskShuffleProof(
+        pre, list(range(len(pre))), 7)
+    block.body.whisk_post_shuffle_trackers = [
+        spec.WhiskTracker(r_G=r, k_r_G=krg) for r, krg in post]
+    block.body.whisk_shuffle_proof = proof
+    expect_assertion_error(lambda: _transition(spec, state.copy(), block))
