@@ -308,9 +308,11 @@ def main():
             "CS_TPU_REQUIRE_ACCELERATOR": "1",
             "CS_TPU_BLS_FUSE": os.environ.get("CS_TPU_BLS_FUSE", "0"),
             # default 32: best cold-compile-to-throughput tradeoff
-            # (119.9/s at 492 s compile); the measured headline is
-            # batch 48 (133.5/s, 648 s compile) — throughput flattens
-            # across 32-48 and batch 64 hit a pathological XLA compile
+            # (119.9/s at 492 s compile).  The measured headline is
+            # batch 56 (211.3/s) riding the 64-lane bucket program the
+            # batch-48 run compiled (648 s cold); batch 64 itself hit a
+            # pathological XLA compile once — prefer 56 for max
+            # throughput when the cache is warm
             "CS_TPU_BLS_BATCH": os.environ.get("CS_TPU_BLS_BATCH", "32")}))
     for i, (name, overrides) in enumerate(attempts):
         left = len(attempts) - i

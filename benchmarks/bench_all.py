@@ -127,8 +127,13 @@ def bench_process_block(n_validators=2048, max_atts=None):
         spec.MAX_EFFECTIVE_BALANCE)
     if max_atts is None:
         max_atts = spec.MAX_ATTESTATIONS
-    bls.use_py()
+    # fixture signing: any backend produces identical (deterministic)
+    # signatures; the native library builds the 128-attestation block in
+    # seconds where the oracle needs minutes
+    from consensus_specs_tpu.ops import native_bls
+    bls.use_native() if native_bls.available() else bls.use_py()
     signed_block, _ = _build_block_with_attestations(spec, state, max_atts)
+    bls.use_py()
 
     def run(backend):
         backend()

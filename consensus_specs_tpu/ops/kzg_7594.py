@@ -39,8 +39,14 @@ def bytes_to_cell(cell_bytes) -> list:
 
 
 def g2_lincomb(points: Sequence[bytes], scalars: Sequence[int]) -> bytes:
-    """md:104 — small G2 MSM (vanishing-polynomial commitment)."""
+    """md:104 — small G2 MSM (vanishing-polynomial commitment); native
+    C MSM when present, python oracle fallback."""
     assert len(points) == len(scalars)
+    from consensus_specs_tpu.ops import native_bls
+    if native_bls.available() and len(points) <= 64:
+        return native_bls.g2_msm_compressed(
+            [bytes(p) for p in points],
+            [int(a) % BLS_MODULUS for a in scalars])
     result = G2Point.inf()
     for x, a in zip(points, scalars):
         result = result + g2_from_compressed(bytes(x)).mult(
@@ -200,7 +206,6 @@ def compute_kzg_proof_multi_impl(polynomial_coeff, zs,
 def verify_kzg_proof_multi_impl(commitment, zs, ys, proof, setup) -> bool:
     """md:323 — e(proof, [Z(tau)]G2) == e(C - [I(tau)]G1, G2)."""
     from consensus_specs_tpu.ops.bls12_381.curve import G2_GENERATOR
-    from consensus_specs_tpu.ops.bls12_381.pairing import multi_pairing_check
 
     assert len(zs) == len(ys)
     zero_poly = g2_lincomb(setup.KZG_SETUP_G2_MONOMIAL[:len(zs) + 1],
@@ -208,7 +213,8 @@ def verify_kzg_proof_multi_impl(commitment, zs, ys, proof, setup) -> bool:
     interpolated_poly = K.g1_lincomb(
         setup.KZG_SETUP_G1_MONOMIAL[:len(zs)],
         interpolate_polynomialcoeff(zs, ys))
-    return multi_pairing_check([
+    # K._pairing_check routes through the native C pairing when present
+    return K._pairing_check([
         (K._g1_of(proof), g2_from_compressed(zero_poly)),
         (K._g1_of(commitment) + (-K._g1_of(interpolated_poly)),
          -G2_GENERATOR),

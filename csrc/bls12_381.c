@@ -477,10 +477,6 @@ static void fp6_neg(fp6_t *r, const fp6_t *x) {
     fp2_neg(&r->c2, &x->c2);
 }
 
-static int fp6_is_zero(const fp6_t *x) {
-    return fp2_is_zero(&x->c0) && fp2_is_zero(&x->c1) && fp2_is_zero(&x->c2);
-}
-
 static int fp6_eq(const fp6_t *x, const fp6_t *y) {
     return fp2_eq(&x->c0, &y->c0) && fp2_eq(&x->c1, &y->c1) && fp2_eq(&x->c2, &y->c2);
 }
@@ -559,11 +555,6 @@ static void fp6_frob(fp6_t *r, const fp6_t *x) {
     fp2_conj(&t, &x->c2); fp2_mul(&r->c2, &t, &FROB_V2);
 }
 
-static void fp12_add(fp12_t *r, const fp12_t *x, const fp12_t *y) {
-    fp6_add(&r->c0, &x->c0, &y->c0);
-    fp6_add(&r->c1, &x->c1, &y->c1);
-}
-
 static int fp12_eq(const fp12_t *x, const fp12_t *y) {
     return fp6_eq(&x->c0, &y->c0) && fp6_eq(&x->c1, &y->c1);
 }
@@ -605,22 +596,6 @@ static void fp12_frob(fp12_t *r, const fp12_t *x) {
     fp2_mul(&c1.c1, &c1.c1, &FROB_W);
     fp2_mul(&c1.c2, &c1.c2, &FROB_W);
     r->c0 = c0; r->c1 = c1;
-}
-
-/* MSB-first pow over a big-endian byte exponent */
-static void fp12_pow_be(fp12_t *r, const fp12_t *x, const uint8_t *e, size_t elen) {
-    fp12_t acc = FP12_ONE;
-    int started = 0;
-    for (size_t i = 0; i < elen; i++) {
-        for (int bit = 7; bit >= 0; bit--) {
-            if (started) fp12_sqr(&acc, &acc);
-            if ((e[i] >> bit) & 1) {
-                if (started) fp12_mul(&acc, &acc, x);
-                else { acc = *x; started = 1; }
-            }
-        }
-    }
-    *r = acc;
 }
 
 /* ================================================================= */

@@ -96,3 +96,48 @@ def test_invalid_signature_wrong_key(spec, state):
                                       privkeys[index + 1])
     yield from run_voluntary_exit_processing(spec, state, signed_exit,
                                              valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_incorrect_validator_index(spec, state):
+    """validator_index out of registry range."""
+    _age_state(spec, state)
+    exit_msg = spec.VoluntaryExit(
+        epoch=spec.get_current_epoch(state),
+        validator_index=len(state.validators))
+    signed_exit = sign_voluntary_exit(spec, state, exit_msg, privkeys[0])
+    yield from run_voluntary_exit_processing(spec, state, signed_exit,
+                                             valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_default_exit_epoch_subsequent_exit(spec, state):
+    """A later exit lands in the same default exit epoch until churn
+    fills; the exit queue epoch never moves backwards."""
+    _age_state(spec, state)
+    signed_exits = prepare_signed_exits(spec, state, [0, 1])
+    yield "pre", state
+    spec.process_voluntary_exit(state, signed_exits[0])
+    first_epoch = state.validators[0].exit_epoch
+    spec.process_voluntary_exit(state, signed_exits[1])
+    yield "post", state
+    assert state.validators[1].exit_epoch >= first_epoch
+
+
+@with_all_phases
+@spec_state_test
+def test_exit_queue_spreads_past_churn(spec, state):
+    """churn+1 exits in one epoch: the last one lands one epoch later."""
+    _age_state(spec, state)
+    churn = int(spec.get_validator_churn_limit(state))
+    indices = list(range(churn + 1))
+    signed_exits = prepare_signed_exits(spec, state, indices)
+    yield "pre", state
+    for signed_exit in signed_exits:
+        spec.process_voluntary_exit(state, signed_exit)
+    yield "post", state
+    epochs = [int(state.validators[i].exit_epoch) for i in indices]
+    assert max(epochs) == min(epochs) + 1
+    assert epochs.count(min(epochs)) == churn

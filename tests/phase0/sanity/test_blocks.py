@@ -6,7 +6,7 @@ from consensus_specs_tpu.test_infra.context import (
     spec_state_test, with_all_phases, always_bls, expect_assertion_error,
 )
 from consensus_specs_tpu.test_infra.block import (
-    build_empty_block_for_next_slot, build_empty_block, state_transition_and_sign_block, sign_block, next_epoch)
+    build_empty_block_for_next_slot, build_empty_block, state_transition_and_sign_block, sign_block, next_epoch, next_slots)
 from consensus_specs_tpu.test_infra.attestations import get_valid_attestation
 from consensus_specs_tpu.test_infra.slashings import get_valid_proposer_slashing
 
@@ -547,3 +547,173 @@ def sign_block_after_failed_transition(spec, state, block):
     expect_assertion_error(
         lambda: spec.state_transition(state, signed_block))
     return signed_block
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_similar_proposer_slashings_same_block(spec, state):
+    """Two slashings of the SAME proposer built from different header
+    pairs: the second is a no-op re-slash, the block is invalid."""
+    from consensus_specs_tpu.test_infra.slashings import (
+        get_valid_proposer_slashing)
+    s1 = get_valid_proposer_slashing(spec, state)
+    s2 = get_valid_proposer_slashing(spec, state)
+    s2.signed_header_2.message.body_root = b"\x42" * 32
+    from consensus_specs_tpu.test_infra.slashings import sign_block_header
+    from consensus_specs_tpu.test_infra.keys import privkeys
+    s2.signed_header_2 = sign_block_header(
+        spec, state, s2.signed_header_2.message,
+        privkeys[s2.signed_header_1.message.proposer_index])
+    assert s1.signed_header_1.message.proposer_index == \
+        s2.signed_header_1.message.proposer_index
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings.append(s1)
+    block.body.proposer_slashings.append(s2)
+    expect_assertion_error(
+        lambda: state_transition_and_sign_block(spec, state, block))
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_multiple_attester_slashings_no_overlap(spec, state):
+    """Two attester slashings over disjoint committees both apply."""
+    from consensus_specs_tpu.test_infra.slashings import (
+        get_valid_attester_slashing, get_indexed_attestation_participants)
+    next_slots(spec, state, 2)
+    s1 = get_valid_attester_slashing(spec, state, slot=state.slot - 2,
+                                     signed_1=True, signed_2=True)
+    s2 = get_valid_attester_slashing(spec, state, slot=state.slot - 1,
+                                     signed_1=True, signed_2=True)
+    p1 = set(get_indexed_attestation_participants(spec, s1.attestation_1))
+    p2 = set(get_indexed_attestation_participants(spec, s2.attestation_1))
+    if p1 & p2:
+        return  # committee layout overlap on this preset: vacuous
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attester_slashings.append(s1)
+    block.body.attester_slashings.append(s2)
+    signed = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed]
+    yield "post", state
+    for i in p1 | p2:
+        assert state.validators[i].slashed
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_only_increase_deposit_count(spec, state):
+    """The STATE expects a deposit (eth1_data.deposit_count advanced)
+    but the block provides none: process_operations rejects."""
+    state.eth1_data.deposit_count += 1
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    expect_assertion_error(
+        lambda: state_transition_and_sign_block(spec, state, block))
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_duplicate_deposit_same_block(spec, state):
+    """The same deposit twice: the second replays an index and fails the
+    merkle branch at the advanced deposit index."""
+    from consensus_specs_tpu.test_infra.deposits import (
+        prepare_state_and_deposit)
+    index = len(state.validators)
+    deposit = prepare_state_and_deposit(
+        spec, state, index, spec.MAX_EFFECTIVE_BALANCE, signed=True)
+    state.eth1_data.deposit_count += 1   # state expects TWO deposits now
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.deposits.append(deposit)
+    block.body.deposits.append(deposit)
+    expect_assertion_error(
+        lambda: state_transition_and_sign_block(spec, state, block))
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_after_inactive_index(spec, state):
+    """An exited validator leaves the proposer rotation; chain continues
+    with a proposer whose index is above the inactive one."""
+    inactive = 2
+    state.validators[inactive].exit_epoch = spec.get_current_epoch(state)
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH)  # rotation catches up
+    yield "pre", state
+    blocks = []
+    for _ in range(spec.SLOTS_PER_EPOCH):
+        block = build_empty_block_for_next_slot(spec, state)
+        assert block.proposer_index != inactive
+        blocks.append(state_transition_and_sign_block(spec, state, block))
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_balance_driven_status_transitions(spec, state):
+    """Dropping a validator to EJECTION_BALANCE exits it through the
+    epoch transition inside a block-driven chain."""
+    index = 3
+    state.validators[index].effective_balance = spec.config.EJECTION_BALANCE
+    state.balances[index] = spec.config.EJECTION_BALANCE
+    assert state.validators[index].exit_epoch == spec.FAR_FUTURE_EPOCH
+    yield "pre", state
+    blocks = []
+    for _ in range(spec.SLOTS_PER_EPOCH):
+        block = build_empty_block_for_next_slot(spec, state)
+        blocks.append(state_transition_and_sign_block(spec, state, block))
+    yield "blocks", blocks
+    yield "post", state
+    assert state.validators[index].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_data_votes_consensus(spec, state):
+    """A strict majority of identical votes within one voting period
+    adopts the new eth1_data (minimal preset: period = 32 slots)."""
+    period = int(spec.EPOCHS_PER_ETH1_VOTING_PERIOD) \
+        * int(spec.SLOTS_PER_EPOCH)
+    if period > 4 * int(spec.SLOTS_PER_EPOCH):
+        return  # mainnet-scale period (2048 slots): minimal-only scenario
+    pre_eth1 = state.eth1_data.copy()
+    new_eth1 = spec.Eth1Data(
+        deposit_root=b"\x11" * 32,
+        deposit_count=state.eth1_data.deposit_count,
+        block_hash=b"\x22" * 32)
+    assert new_eth1 != pre_eth1
+    yield "pre", state
+    blocks = []
+    votes_needed = period // 2 + 1
+    for _ in range(votes_needed):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.eth1_data = new_eth1
+        blocks.append(state_transition_and_sign_block(spec, state, block))
+    yield "blocks", blocks
+    yield "post", state
+    assert state.eth1_data == new_eth1
+
+@with_all_phases
+@spec_state_test
+def test_eth1_data_votes_no_consensus(spec, state):
+    """Split votes never adopt a new eth1_data."""
+    pre_eth1 = state.eth1_data.copy()
+    vote_a = spec.Eth1Data(deposit_root=b"\x11" * 32,
+                           deposit_count=state.eth1_data.deposit_count,
+                           block_hash=b"\x22" * 32)
+    vote_b = spec.Eth1Data(deposit_root=b"\x33" * 32,
+                           deposit_count=state.eth1_data.deposit_count,
+                           block_hash=b"\x44" * 32)
+    yield "pre", state
+    blocks = []
+    for i in range(int(spec.SLOTS_PER_EPOCH)):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.eth1_data = vote_a if i % 2 == 0 else vote_b
+        blocks.append(state_transition_and_sign_block(spec, state, block))
+    yield "blocks", blocks
+    yield "post", state
+    assert state.eth1_data == pre_eth1
