@@ -145,8 +145,12 @@ def _run_child(role: str, env_overrides: dict, timeout: float):
     if env.get("JAX_PLATFORMS") == "cpu" or role == "oracle":
         # CPU-only/no-jax children must not pay (or hang in) accelerator
         # plugin registration at interpreter start (sitecustomize runs
-        # before the script body; with a flaky tunnel it stalls minutes)
+        # before the script body; with a flaky tunnel it stalls minutes),
+        # and must never store remote-compiled XLA:CPU artifacts into
+        # the hermetic cache (machine-feature poisoning, round 5)
         env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
     env["CS_TPU_BENCH_INNER_DEADLINE"] = str(time.time() + timeout)
     try:
         proc = subprocess.run(

@@ -145,7 +145,6 @@ def bench_process_block(n_validators=2048, max_atts=None):
 
     py_dt = run(bls.use_py)
     results = {}
-    from consensus_specs_tpu.ops import native_bls
     if native_bls.available():
         run(bls.use_native)  # warm decode caches
         results["native"] = min(run(bls.use_native), run(bls.use_native))

@@ -48,7 +48,11 @@ def _cpu_fingerprint() -> str:
     return hashlib.sha256(flags.encode()).hexdigest()[:12]
 
 
-def keyed_cache_dir() -> str:
+def keyed_cache_dir(hermetic=None) -> str:
+    """``hermetic``: None = infer from the environment (axon plugin
+    present or not); True = force the hermetic-CPU directory (used by
+    the dryrun marker, which parent and child must agree on regardless
+    of which env computes it)."""
     parts = []
     try:
         import jaxlib.version
@@ -61,6 +65,17 @@ def keyed_cache_dir() -> str:
     except Exception:
         parts.append("libtpu-none")
     parts.append("cpu-" + _cpu_fingerprint())
+    # Segregate plugin sessions from hermetic-CPU children: with the
+    # axon plugin registered, even XLA:CPU modules may be compiled by
+    # the REMOTE compile service on a machine whose LLVM feature set
+    # differs from this host's — storing those artifacts in the
+    # hermetic dir poisons it (every load rejects with a
+    # machine-feature mismatch and recompiles; measured round 5, the
+    # reason the dryrun's warm cache never took). "h2" restarts the
+    # hermetic dir clean of previously mixed artifacts.
+    if hermetic is None:
+        hermetic = not os.environ.get("PALLAS_AXON_POOL_IPS")
+    parts.append("h2" if hermetic else "axon")
     return os.path.join(_CACHE_ROOT, "-".join(parts))
 
 
@@ -142,7 +157,13 @@ def cpu_subprocess_env(base=None) -> dict:
     """
     env = dict(os.environ if base is None else base)
     env.pop("PALLAS_AXON_POOL_IPS", None)
+    # never remote-compile in a hermetic child: remote XLA:CPU artifacts
+    # carry the service machine's feature set, not this host's
+    env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
     env["JAX_PLATFORMS"] = "cpu"
+    # the child's cache key must be computed IN the child (the axon
+    # discriminator depends on the env this function just edited)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
     return env
 
 
