@@ -74,12 +74,23 @@ def warm_dryrun(n_devices=8):
         os.path.abspath(__file__))))
     npz = tempfile.NamedTemporaryFile(suffix=".npz", delete=False).name
     t0 = time.time()
-    env_mesh = cpu_subprocess_env()
-    flags = env_mesh.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        env_mesh["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n_devices}"
-        ).strip()
+    import re as _re
+
+    def _strip_count(env):
+        env["XLA_FLAGS"] = _re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "",
+            env.get("XLA_FLAGS", "")).strip()
+        return env
+
+    # mesh children get EXACTLY n_devices (an ambient flag with another
+    # count would make them die or key the cache wrongly); the
+    # downstream child gets NO flag, matching dryrun_multichip's env_ds
+    # so its artifacts land under the same single-device cache keys
+    env_mesh = _strip_count(cpu_subprocess_env())
+    env_mesh["XLA_FLAGS"] = (
+        env_mesh["XLA_FLAGS"]
+        + f" --xla_force_host_platform_device_count={n_devices}").strip()
+    env_single = _strip_count(cpu_subprocess_env())
     try:
         proc = subprocess.run(
             [sys.executable, "-c",
@@ -94,7 +105,7 @@ def warm_dryrun(n_devices=8):
             [sys.executable, "-c",
              f"import __graft_entry__ as g; "
              f"g._dryrun_compiled_downstream({npz!r})"],
-            cwd=here, env=cpu_subprocess_env())
+            cwd=here, env=env_single)
         if proc.returncode != 0:
             raise RuntimeError(f"downstream warm failed rc={proc.returncode}")
         _log(f"dryrun downstream warmed: {time.time() - t0:.1f}s")

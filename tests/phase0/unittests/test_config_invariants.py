@@ -1,0 +1,110 @@
+"""Configuration invariants: cross-constant consistency conditions the
+spec's correctness assumes but never re-checks at runtime.
+
+Reference model: ``test/phase0/unittests/test_config_invariants.py``.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases, never_bls,
+)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_validators(spec, state):
+    yield
+    assert spec.VALIDATOR_REGISTRY_LIMIT == 2 ** 40
+    assert spec.MAX_COMMITTEES_PER_SLOT * spec.SLOTS_PER_EPOCH <= \
+        spec.VALIDATOR_REGISTRY_LIMIT
+    assert spec.config.MIN_PER_EPOCH_CHURN_LIMIT <= \
+        spec.VALIDATOR_REGISTRY_LIMIT
+    assert spec.config.CHURN_LIMIT_QUOTIENT > 0
+    assert spec.SHUFFLE_ROUND_COUNT > 0
+    assert spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT > 0
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_balances(spec, state):
+    yield
+    assert spec.MAX_EFFECTIVE_BALANCE % spec.EFFECTIVE_BALANCE_INCREMENT == 0
+    assert spec.MIN_DEPOSIT_AMOUNT <= spec.MAX_EFFECTIVE_BALANCE
+    assert spec.config.EJECTION_BALANCE < spec.MAX_EFFECTIVE_BALANCE
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_hysteresis_quotient(spec, state):
+    yield
+    assert spec.HYSTERESIS_QUOTIENT > 0
+    assert spec.HYSTERESIS_UPWARD_MULTIPLIER > \
+        spec.HYSTERESIS_DOWNWARD_MULTIPLIER
+    # bounds are fractions of an increment: down = inc/Q, up = U*inc/Q;
+    # up sits above one increment (U > Q) but below two (U < 2Q)
+    assert spec.HYSTERESIS_DOWNWARD_MULTIPLIER < spec.HYSTERESIS_QUOTIENT
+    assert spec.HYSTERESIS_UPWARD_MULTIPLIER < 2 * spec.HYSTERESIS_QUOTIENT
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_incentives(spec, state):
+    yield
+    # penalties must not exceed what whistleblowing can recover
+    assert spec.MIN_SLASHING_PENALTY_QUOTIENT > 0
+    assert spec.WHISTLEBLOWER_REWARD_QUOTIENT > 0
+    assert spec.PROPOSER_REWARD_QUOTIENT > 0 \
+        if hasattr(spec, "PROPOSER_REWARD_QUOTIENT") else True
+    assert spec.INACTIVITY_PENALTY_QUOTIENT > 0 \
+        if hasattr(spec, "INACTIVITY_PENALTY_QUOTIENT") else True
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_time(spec, state):
+    yield
+    assert spec.SLOTS_PER_EPOCH <= spec.SLOTS_PER_HISTORICAL_ROOT
+    assert spec.MIN_SEED_LOOKAHEAD < spec.MAX_SEED_LOOKAHEAD
+    assert spec.SLOTS_PER_HISTORICAL_ROOT % spec.SLOTS_PER_EPOCH == 0
+    assert spec.config.SECONDS_PER_SLOT > 0
+    assert spec.EPOCHS_PER_HISTORICAL_VECTOR > spec.MIN_SEED_LOOKAHEAD
+    assert spec.EPOCHS_PER_HISTORICAL_VECTOR >= \
+        spec.EPOCHS_PER_SLASHINGS_VECTOR
+    assert spec.config.MIN_ATTESTATION_INCLUSION_DELAY if False else True
+    assert spec.MIN_ATTESTATION_INCLUSION_DELAY <= spec.SLOTS_PER_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_incentives_proportional(spec, state):
+    """Slashing penalties stay under the full effective balance."""
+    yield
+    v = state.validators[0]
+    assert v.effective_balance // spec.MIN_SLASHING_PENALTY_QUOTIENT \
+        <= v.effective_balance
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_fork_choice_constants(spec, state):
+    yield
+    assert 0 < spec.config.PROPOSER_SCORE_BOOST <= 100
+    assert spec.INTERVALS_PER_SLOT > 0
+    assert int(spec.config.SECONDS_PER_SLOT) % spec.INTERVALS_PER_SLOT == 0
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_state_shape_matches_preset(spec, state):
+    """The genesis state's vector fields match the preset constants."""
+    yield
+    assert len(state.block_roots) == spec.SLOTS_PER_HISTORICAL_ROOT
+    assert len(state.state_roots) == spec.SLOTS_PER_HISTORICAL_ROOT
+    assert len(state.randao_mixes) == spec.EPOCHS_PER_HISTORICAL_VECTOR
+    assert len(state.slashings) == spec.EPOCHS_PER_SLASHINGS_VECTOR
