@@ -24,6 +24,22 @@ import numpy as np
 import jax
 
 
+def _gather_and_combine(part, axis_name: str, n_shards: int):
+    """all_gather per-shard partial G1 sums along ``axis_name`` and
+    combine them in a fixed order on every device (complete point
+    addition is not a ``psum``-able monoid over raw limb vectors, so
+    the collective must carry partial sums).  ``part`` leaves must have
+    the shard axis at position 0 after the gather."""
+    from consensus_specs_tpu.ops.jax_bls import points as PT
+    gathered = jax.tree_util.tree_map(
+        lambda a: jax.lax.all_gather(a, axis_name), part)
+    total = jax.tree_util.tree_map(lambda a: a[0], gathered)
+    for i in range(1, n_shards):
+        total = PT.g1_add(
+            total, jax.tree_util.tree_map(lambda a, i=i: a[i], gathered))
+    return total
+
+
 def build_mesh(devices, data: int, agg: int):
     """(data x agg) Mesh over the given devices."""
     from jax.sharding import Mesh
@@ -47,17 +63,10 @@ def make_sharded_agg(mesh):
     agg_size = mesh.shape["agg"]
 
     def local_agg(pk_pts):
-        # per-shard partial aggregation over the local pubkey slice
+        # per-shard partial aggregation over the local pubkey slice,
+        # then the shared gather + ordered combine
         part = PT.g1_tree_sum_batched(pk_pts)
-        # gather partials across 'agg' and combine on every device
-        gathered = jax.tree_util.tree_map(
-            lambda a: jax.lax.all_gather(a, "agg"), part)
-        total = jax.tree_util.tree_map(lambda a: a[0], gathered)
-        for i in range(1, agg_size):
-            total = PT.g1_add(
-                total,
-                jax.tree_util.tree_map(lambda a, i=i: a[i], gathered))
-        return total
+        return _gather_and_combine(part, "agg", agg_size)
 
     pk_spec = P("data", "agg")
     return jax.jit(shard_map(
@@ -84,3 +93,94 @@ def make_sharded_agg_verify(mesh):
             sharded_agg(pk_pts), u0, u1, sig_q, agg_degen, sig_degen)
 
     return step
+
+
+def make_sharded_msm(mesh_devices):
+    """Compile a POINTS-sharded multi-scalar multiplication.
+
+    The ``g1_lincomb`` hot path at pod scale (SURVEY §2.4: "shard MSM
+    over devices with shard_map, reduce over ICI"): the point/scalar
+    axis is split across a 1D ``points`` mesh, each device runs the
+    digit-parallel windowed MSM core over its slice, and the per-shard
+    partial sums ``all_gather`` and combine on-device — the same
+    collective pattern as the aggregation tree (point addition is not a
+    ``psum``-able monoid over raw limb vectors).
+
+    Returns ``msm(window_pts, digit_bits) -> packed G1 total`` where
+    the inputs are the window expansion / bit planes produced by
+    ``ops.jax_bls.msm`` (``_flatten_windows``/``_digits_msb_bits``),
+    both shaped ``(N_WINDOWS * n_points, ...)`` and sharded along that
+    leading axis.  n_points must divide evenly by the mesh size.
+    """
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from consensus_specs_tpu.ops.jax_bls import points as PT
+    from consensus_specs_tpu.ops.jax_bls import msm as M
+
+    mesh_devices = tuple(mesh_devices)
+    mesh = Mesh(np.array(mesh_devices), ("points",))
+    n_shards = mesh.shape["points"]
+
+    def local_msm(window_pts, digit_bits):
+        part = M._msm_core(window_pts, digit_bits)     # local partial
+        part = jax.tree_util.tree_map(lambda a: a[None], part)
+        total = _gather_and_combine(part, "points", n_shards)
+        return jax.tree_util.tree_map(lambda a: a[0], total)
+
+    spec = P("points")
+    return jax.jit(shard_map(
+        local_msm, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: spec, (0, 0, 0)),
+                  spec),
+        out_specs=P(), check_rep=False))
+
+
+_SHARDED_MSM_CACHE = {}
+
+
+def _sharded_msm_for(devices: tuple):
+    """Memoized compiled program per device tuple: rebuilding the
+    ``shard_map`` closure on every call would defeat jit's identity-
+    keyed cache (~90 s compile per call on a 1-core host)."""
+    prog = _SHARDED_MSM_CACHE.get(devices)
+    if prog is None:
+        prog = make_sharded_msm(devices)
+        _SHARDED_MSM_CACHE[devices] = prog
+    return prog
+
+
+def sharded_g1_msm(points, scalars, devices):
+    """Host API: MSM over oracle ``G1Point``s sharded across ``devices``.
+
+    Pads the point list to a multiple of the device count with infinity
+    points (zero scalars), so any size works.
+    """
+    from consensus_specs_tpu.ops.jax_bls import points as PT
+    from consensus_specs_tpu.ops.jax_bls import msm as M
+    from consensus_specs_tpu.ops.bls12_381.curve import G1Point
+
+    assert len(points) == len(scalars)
+    if not points:
+        return G1Point.inf()
+    devices = tuple(devices)
+    n_dev = len(devices)
+    pts = list(points)
+    sc = [int(s) for s in scalars]
+    pad = (-len(pts)) % n_dev
+    pts += [G1Point.inf()] * pad
+    sc += [0] * pad
+    # window-major flattening interleaves windows of ALL points; shard
+    # by point instead: expand per shard
+    per = len(pts) // n_dev
+    msm = _sharded_msm_for(devices)
+    wins, bits = [], []
+    for s in range(n_dev):
+        sl = pts[s * per:(s + 1) * per]
+        packed = PT.g1_pack(sl)
+        wins.append(M._flatten_windows(M._expand_windows(packed)))
+        bits.append(M._digits_msb_bits(sc[s * per:(s + 1) * per]))
+    window_pts = jax.tree_util.tree_map(
+        lambda *a: np.concatenate(a, axis=0), *wins)
+    digit_bits = np.concatenate(bits, axis=0)
+    out = msm(window_pts, digit_bits)
+    return PT.g1_unpack(out)

@@ -139,3 +139,174 @@ def test_top_up_leaves_effective_balance_until_epoch(spec, state):
     spec.process_deposit_receipt(
         state, _receipt(spec, 0, spec.EFFECTIVE_BALANCE_INCREMENT, index=2))
     assert state.validators[0].effective_balance == pre_effective
+
+
+@with_phases(["eip6110"])
+@spec_state_test
+@always_bls
+def test_new_deposit_under_max(spec, state):
+    new_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE - spec.EFFECTIVE_BALANCE_INCREMENT
+    receipt = _receipt(spec, new_index, amount, index=0)
+    yield "pre", state
+    spec.process_deposit_receipt(state, receipt)
+    yield "post", state
+    assert state.balances[new_index] == amount
+    assert state.validators[new_index].effective_balance == amount
+
+
+@with_phases(["eip6110"])
+@spec_state_test
+@always_bls
+def test_new_deposit_over_max(spec, state):
+    """Balance above the cap credits fully; effective balance caps."""
+    new_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE + spec.EFFECTIVE_BALANCE_INCREMENT
+    receipt = _receipt(spec, new_index, amount, index=0)
+    yield "pre", state
+    spec.process_deposit_receipt(state, receipt)
+    yield "post", state
+    assert state.balances[new_index] == amount
+    assert state.validators[new_index].effective_balance == \
+        spec.MAX_EFFECTIVE_BALANCE
+
+
+@with_phases(["eip6110"])
+@spec_state_test
+@always_bls
+def test_new_deposit_eth1_withdrawal_credentials(spec, state):
+    """0x01 credentials are accepted as-is (no proof-of-possession tie)."""
+    new_index = len(state.validators)
+    pubkey = pubkeys[new_index]
+    creds = spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + b"\x42" * 20
+    data = build_deposit_data(spec, pubkey, privkeys[new_index],
+                              spec.MAX_EFFECTIVE_BALANCE, creds, signed=True)
+    receipt = spec.DepositReceipt(
+        pubkey=data.pubkey, withdrawal_credentials=creds,
+        amount=data.amount, signature=data.signature, index=0)
+    yield "pre", state
+    spec.process_deposit_receipt(state, receipt)
+    yield "post", state
+    assert bytes(state.validators[new_index].withdrawal_credentials) == creds
+
+
+@with_phases(["eip6110"])
+@spec_state_test
+@always_bls
+def test_invalid_sig_top_up_still_credits(spec, state):
+    """Top-ups skip signature verification: a bad signature on an
+    EXISTING validator's receipt still credits the balance."""
+    pre_balance = state.balances[0]
+    amount = spec.MIN_DEPOSIT_AMOUNT
+    receipt = _receipt(spec, 0, amount, index=1, signed=False)
+    yield "pre", state
+    spec.process_deposit_receipt(state, receipt)
+    yield "post", state
+    assert state.balances[0] == pre_balance + amount
+
+
+@with_phases(["eip6110"])
+@spec_state_test
+@always_bls
+def test_incorrect_withdrawal_credentials_top_up(spec, state):
+    """Top-up with mismatched credentials still credits (credentials are
+    only fixed at validator creation)."""
+    pre_creds = bytes(state.validators[0].withdrawal_credentials)
+    pubkey = pubkeys[0]
+    wrong = spec.BLS_WITHDRAWAL_PREFIX + hash(b"other")[1:]
+    data = build_deposit_data(spec, pubkey, privkeys[0],
+                              spec.MIN_DEPOSIT_AMOUNT, wrong, signed=True)
+    receipt = spec.DepositReceipt(
+        pubkey=data.pubkey, withdrawal_credentials=wrong,
+        amount=data.amount, signature=data.signature, index=2)
+    pre_balance = state.balances[0]
+    yield "pre", state
+    spec.process_deposit_receipt(state, receipt)
+    yield "post", state
+    assert state.balances[0] == pre_balance + spec.MIN_DEPOSIT_AMOUNT
+    assert bytes(state.validators[0].withdrawal_credentials) == pre_creds
+
+
+@with_phases(["eip6110"])
+@spec_state_test
+@always_bls
+def test_invalid_subgroup_pubkey_receipt_skipped(spec, state):
+    """A pubkey failing KeyValidate never creates a validator."""
+    from consensus_specs_tpu.ops.bls12_381.curve import G1Point
+    from consensus_specs_tpu.ops.bls12_381.fields import Fq
+    # an on-curve, non-subgroup point (cofactor component)
+    for xi in range(1, 2000):
+        x = Fq(xi)
+        y = (x * x * x + Fq(4)).sqrt()
+        if y is not None and not G1Point(x, y).in_subgroup():
+            bad_pubkey = G1Point(x, y).to_compressed()
+            break
+    else:
+        raise AssertionError("no non-subgroup point found")
+    pre_count = len(state.validators)
+    receipt = spec.DepositReceipt(
+        pubkey=bad_pubkey,
+        withdrawal_credentials=spec.BLS_WITHDRAWAL_PREFIX + b"\x00" * 31,
+        amount=spec.MAX_EFFECTIVE_BALANCE,
+        signature=b"\x11" * 96,
+        index=0)
+    yield "pre", state
+    spec.process_deposit_receipt(state, receipt)
+    yield "post", state
+    assert len(state.validators) == pre_count
+
+
+@with_phases(["eip6110"])
+@spec_state_test
+@always_bls
+def test_wrong_fork_version_sig_skipped(spec, state):
+    """Deposit signatures bind the GENESIS fork domain
+    (compute_domain with no fork version); a deposit message properly
+    signed under the CURRENT fork\'s domain must fail verification and
+    the receipt is skipped for new keys."""
+    from consensus_specs_tpu.utils import bls as _bls
+    new_index = len(state.validators)
+    pubkey = pubkeys[new_index]
+    creds = spec.BLS_WITHDRAWAL_PREFIX + hash(pubkey)[1:]
+    data = build_deposit_data(spec, pubkey, privkeys[new_index],
+                              spec.MAX_EFFECTIVE_BALANCE, creds,
+                              signed=False)
+    deposit_message = spec.DepositMessage(
+        pubkey=data.pubkey, withdrawal_credentials=creds,
+        amount=data.amount)
+    # sign under the CURRENT fork version instead of the genesis domain
+    wrong_domain = spec.compute_domain(
+        spec.DOMAIN_DEPOSIT, state.fork.current_version,
+        state.genesis_validators_root)
+    from consensus_specs_tpu.utils.ssz import hash_tree_root
+    signing_root = spec.compute_signing_root_with_domain(
+        deposit_message, wrong_domain) \
+        if hasattr(spec, "compute_signing_root_with_domain") else \
+        hash_tree_root(spec.SigningData(
+            object_root=hash_tree_root(deposit_message),
+            domain=wrong_domain))
+    data.signature = _bls.Sign(privkeys[new_index], signing_root)
+    receipt = spec.DepositReceipt(
+        pubkey=data.pubkey, withdrawal_credentials=creds,
+        amount=data.amount, signature=data.signature, index=0)
+    pre_count = len(state.validators)
+    yield "pre", state
+    spec.process_deposit_receipt(state, receipt)
+    yield "post", state
+    assert len(state.validators) == pre_count
+
+
+@with_phases(["eip6110"])
+@spec_state_test
+@always_bls
+def test_top_up_withdrawn_validator(spec, state):
+    """A receipt for an exited+withdrawable validator still credits."""
+    current_epoch = spec.get_current_epoch(state)
+    state.validators[0].exit_epoch = current_epoch
+    state.validators[0].withdrawable_epoch = current_epoch
+    pre_balance = state.balances[0]
+    receipt = _receipt(spec, 0, spec.MIN_DEPOSIT_AMOUNT, index=5)
+    yield "pre", state
+    spec.process_deposit_receipt(state, receipt)
+    yield "post", state
+    assert state.balances[0] == pre_balance + spec.MIN_DEPOSIT_AMOUNT

@@ -105,3 +105,29 @@ def test_sharded_sum_collective_layout():
 def test_dryrun_multichip_full():
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
+
+
+@pytest.mark.skipif(not HEAVY, reason="MSM shard_map compile on a 1-core "
+                    "host (CS_TPU_HEAVY=1)")
+def test_sharded_msm_matches_host():
+    """Points-sharded MSM over the 8-device mesh equals the host
+    Pippenger result (SURVEY 2.4: shard MSM over devices, reduce over
+    the mesh collective)."""
+    _require_devices(4)
+    from consensus_specs_tpu.parallel.sharded_verify import sharded_g1_msm
+    from consensus_specs_tpu.ops.bls12_381.curve import G1_GENERATOR, G1Point
+
+    pts = [G1_GENERATOR.mult(k) for k in (1, 3, 7, 11, 13, 17, 19, 23)]
+    scalars = [5, 9, 2, 31, 1, 8, 27, 4]
+    expect = G1Point.inf()
+    for p, s in zip(pts, scalars):
+        expect = expect + p.mult(s)
+    got = sharded_g1_msm(pts, scalars, jax.devices()[:4])
+    assert got == expect
+
+    # ragged size: padding with infinity points must not change the sum
+    got2 = sharded_g1_msm(pts[:5], scalars[:5], jax.devices()[:4])
+    expect2 = G1Point.inf()
+    for p, s in zip(pts[:5], scalars[:5]):
+        expect2 = expect2 + p.mult(s)
+    assert got2 == expect2
