@@ -123,9 +123,9 @@ def make_sharded_msm(mesh_devices):
 
     def local_msm(window_pts, digit_bits):
         part = M._msm_core(window_pts, digit_bits)     # local partial
-        part = jax.tree_util.tree_map(lambda a: a[None], part)
-        total = _gather_and_combine(part, "points", n_shards)
-        return jax.tree_util.tree_map(lambda a: a[0], total)
+        # all_gather inserts the shard axis at 0 and g1_add is
+        # elementwise over limb leaves, so rank-1 parts pass straight in
+        return _gather_and_combine(part, "points", n_shards)
 
     spec = P("points")
     return jax.jit(shard_map(
@@ -149,11 +149,17 @@ def _sharded_msm_for(devices: tuple):
     return prog
 
 
-def sharded_g1_msm(points, scalars, devices):
+_SHARDED_WINDOW_CACHE = {}
+
+
+def sharded_g1_msm(points, scalars, devices, cache_key=None):
     """Host API: MSM over oracle ``G1Point``s sharded across ``devices``.
 
     Pads the point list to a multiple of the device count with infinity
-    points (zero scalars), so any size works.
+    points (zero scalars), so any size works.  ``cache_key``: hashable
+    id for a FIXED basis (the KZG trusted setup) so the 248-doubling
+    per-shard window expansions run once per process, mirroring
+    ``ops.jax_bls.msm.g1_msm``'s setup cache.
     """
     from consensus_specs_tpu.ops.jax_bls import points as PT
     from consensus_specs_tpu.ops.jax_bls import msm as M
@@ -173,14 +179,21 @@ def sharded_g1_msm(points, scalars, devices):
     # by point instead: expand per shard
     per = len(pts) // n_dev
     msm = _sharded_msm_for(devices)
-    wins, bits = [], []
-    for s in range(n_dev):
-        sl = pts[s * per:(s + 1) * per]
-        packed = PT.g1_pack(sl)
-        wins.append(M._flatten_windows(M._expand_windows(packed)))
-        bits.append(M._digits_msb_bits(sc[s * per:(s + 1) * per]))
-    window_pts = jax.tree_util.tree_map(
-        lambda *a: np.concatenate(a, axis=0), *wins)
-    digit_bits = np.concatenate(bits, axis=0)
+    full_key = (cache_key, devices, len(pts)) if cache_key is not None \
+        else None
+    window_pts = _SHARDED_WINDOW_CACHE.get(full_key) \
+        if full_key is not None else None
+    if window_pts is None:
+        wins = []
+        for s in range(n_dev):
+            packed = PT.g1_pack(pts[s * per:(s + 1) * per])
+            wins.append(M._flatten_windows(M._expand_windows(packed)))
+        window_pts = jax.tree_util.tree_map(
+            lambda *a: np.concatenate(a, axis=0), *wins)
+        if full_key is not None:
+            _SHARDED_WINDOW_CACHE[full_key] = window_pts
+    digit_bits = np.concatenate(
+        [M._digits_msb_bits(sc[s * per:(s + 1) * per])
+         for s in range(n_dev)], axis=0)
     out = msm(window_pts, digit_bits)
     return PT.g1_unpack(out)

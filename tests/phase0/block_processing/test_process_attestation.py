@@ -405,3 +405,47 @@ def test_invalid_incorrect_head_and_target_after_max_inclusion_slot(
     yield from _run_delay_matrix_case(
         spec, state, spec.SLOTS_PER_EPOCH + 1, wrong_head=True,
         wrong_target=True, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_too_many_aggregation_bits(spec, state):
+    """A bitlist longer than the committee is rejected by the bit/
+    committee length check."""
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    bits = list(attestation.aggregation_bits) + [True]
+    committee = spec.get_beacon_committee(
+        state, attestation.data.slot, attestation.data.index)
+    attestation.aggregation_bits = Bitlist[
+        spec.MAX_VALIDATORS_PER_COMMITTEE](bits)
+    assert len(attestation.aggregation_bits) != len(committee)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_wrong_committee_index_for_slot(spec, state):
+    """data.index >= the slot's committee count is rejected."""
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    committees = spec.get_committee_count_per_slot(
+        state, spec.compute_epoch_at_slot(attestation.data.slot))
+    attestation.data.index = committees
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_previous_epoch_attestation(spec, state):
+    """An attestation from the previous epoch is includable within its
+    window and lands in the previous-epoch accounting."""
+    next_epoch(spec, state)
+    attestation = get_valid_attestation(
+        spec, state, slot=state.slot - 1, signed=True)
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH - 1)
+    assert spec.compute_epoch_at_slot(attestation.data.slot) == \
+        spec.get_previous_epoch(state)
+    yield from run_attestation_processing(spec, state, attestation)

@@ -5,7 +5,7 @@ and the executable blocks of ``specs/phase0/validator.md``,
 ``specs/phase0/p2p-interface.md:1021``, ``specs/phase0/weak-subjectivity.md``.
 """
 from consensus_specs_tpu.test_infra.context import (
-    spec_state_test, with_all_phases, with_phases, always_bls,
+    spec_state_test, with_all_phases, with_phases, always_bls, never_bls,
 )
 from consensus_specs_tpu.test_infra.keys import privkeys, pubkeys
 from consensus_specs_tpu.test_infra.attestations import get_valid_attestation
@@ -215,3 +215,134 @@ def test_sync_committee_duties(spec, state):
                              spec.compute_epoch_at_slot(contribution.slot))
     signing_root = spec.compute_signing_root(cap, domain)
     assert bls.Verify(pubkeys[validator_index], signing_root, sig)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_committee_assignment_none_outside_lookahead(spec, state):
+    """Assignments exist for current/next epoch only; further epochs
+    raise (the spec's lookahead bound)."""
+    epoch = spec.get_current_epoch(state)
+    assert spec.get_committee_assignment(state, epoch, 0) is not None
+    try:
+        spec.get_committee_assignment(state, epoch + 2, 0)
+        raised = False
+    except AssertionError:
+        raised = True
+    assert raised
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_committee_assignment_next_epoch(spec, state):
+    """Next-epoch assignments are computable (duty lookahead)."""
+    epoch = spec.get_current_epoch(state) + 1
+    found = 0
+    for index in range(len(state.validators)):
+        a = spec.get_committee_assignment(state, epoch, index)
+        if a is not None:
+            committee, committee_index, slot = a
+            assert index in committee
+            assert spec.compute_epoch_at_slot(slot) == epoch
+            found += 1
+    assert found == len(state.validators)  # all active at genesis
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_compute_time_at_slot_linear(spec, state):
+    t0 = spec.compute_time_at_slot(state, 0)
+    assert t0 == state.genesis_time
+    assert spec.compute_time_at_slot(state, 5) == \
+        state.genesis_time + 5 * spec.config.SECONDS_PER_SLOT
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_eth1_candidate_block_window(spec, state):
+    """is_candidate_block bounds: inside [period_start - 2*follow*T,
+    period_start - follow*T]."""
+    follow = int(spec.config.ETH1_FOLLOW_DISTANCE)
+    sec = int(spec.config.SECONDS_PER_ETH1_BLOCK)
+    period_start = spec.voting_period_start_time(state)
+
+    class Blk:
+        def __init__(self, ts):
+            self.timestamp = ts
+
+    lo = period_start - 2 * follow * sec
+    hi = period_start - follow * sec
+    assert spec.is_candidate_block(Blk(lo), period_start)
+    assert spec.is_candidate_block(Blk(hi), period_start)
+    assert not spec.is_candidate_block(Blk(hi + sec), period_start)
+    assert not spec.is_candidate_block(Blk(lo - sec), period_start)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_aggregator_modulus_floor(spec, state):
+    """is_aggregator survives committees smaller than
+    TARGET_AGGREGATORS_PER_COMMITTEE (the max(1, ...) modulus floor:
+    every member becomes an aggregator instead of div-by-zero)."""
+    committee = spec.get_beacon_committee(state, state.slot, 0)
+    sig = spec.get_slot_signature(state, state.slot,
+                                  privkeys[int(committee[0])])
+    result = spec.is_aggregator(state, state.slot, 0, sig)
+    assert isinstance(result, bool)
+    if len(committee) <= spec.TARGET_AGGREGATORS_PER_COMMITTEE:
+        # modulus floors at 1: everyone aggregates
+        assert result is True
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_subscribed_subnets_stable_within_seed_window(spec, state):
+    """Subscriptions are a pure function of the node's rotation window:
+    the window index is (epoch + node_id % period) // period, so two
+    epochs in the SAME window give identical subnets and the window
+    boundary rotates them (p2p-interface.md compute_subscribed_subnet)."""
+    node_id = 0x1234567890ABCDEF
+    period = int(spec.config.EPOCHS_PER_SUBNET_SUBSCRIPTION)
+    offset = node_id % period
+    # pick two epochs inside one window, and one past its boundary
+    window_start = period - offset      # first epoch of window 1
+    a = list(spec.compute_subscribed_subnets(node_id, window_start))
+    b = list(spec.compute_subscribed_subnets(node_id,
+                                             window_start + period - 1))
+    c = list(spec.compute_subscribed_subnets(node_id,
+                                             window_start + period))
+    assert a == b                       # same window: stable
+    assert all(0 <= s < spec.config.ATTESTATION_SUBNET_COUNT
+               for s in a + c)
+    # determinism
+    assert a == list(spec.compute_subscribed_subnets(node_id,
+                                                     window_start))
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_subscribed_subnets_depend_on_node_prefix(spec, state):
+    """The subnet choice keys on the node id's HIGH bits (the DHT
+    prefix), so nodes with different prefixes spread across subnets."""
+    # 256-bit node ids differing in their top bits
+    ids = [(i << 248) | 0xABC for i in (1, 37, 99, 201)]
+    sets = {tuple(spec.compute_subscribed_subnets(nid, 0)) for nid in ids}
+    assert len(sets) > 1
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_proposer_uniqueness_per_slot(spec, state):
+    """Exactly one validator believes it proposes each slot."""
+    proposers = [index for index in range(len(state.validators))
+                 if spec.is_proposer(state, index)]
+    assert len(proposers) == 1
+    assert proposers[0] == spec.get_beacon_proposer_index(state)

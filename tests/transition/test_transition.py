@@ -136,3 +136,72 @@ def test_transition_attestation_from_pre_fork_included_after(
 
     assert int(state.slot) == fork_epoch * spec.SLOTS_PER_EPOCH + 1
     yield from _finish(post_spec, fork_epoch, blocks, state)
+
+
+# the leak scenario needs headroom: set_state_in_leak advances
+# MIN_EPOCHS_TO_INACTIVITY_PENALTY + 2 epochs before the fork may hit
+_LEAK_METAS = [ForkMeta(pre, post, fork_epoch=8)
+               for pre, post in AFTER_FORK_PAIRS]
+
+
+@with_fork_metas(_LEAK_METAS)
+def test_transition_with_leaking_pre_state(state, fork_epoch, spec,
+                                           post_spec):
+    """A chain in inactivity leak crosses the fork and keeps processing
+    (the leak accounting moves from pending-attestation deltas to
+    participation flags at altair-shaped boundaries)."""
+    from consensus_specs_tpu.test_infra.rewards import set_state_in_leak
+    set_state_in_leak(spec, state)
+    assert spec.get_current_epoch(state) < fork_epoch
+    yield "pre", state
+    blocks = state_transition_across_slots(
+        spec, state, fork_epoch * spec.SLOTS_PER_EPOCH - 1)
+    state, fork_block = do_fork(state, spec, post_spec, fork_epoch)
+    if fork_block is not None:
+        blocks.append(fork_block)
+    transition_to_next_epoch_and_append_blocks(post_spec, state, blocks)
+    yield from _finish(post_spec, fork_epoch, blocks, state)
+
+
+@with_fork_metas(_METAS)
+def test_transition_with_exits_in_flight(state, fork_epoch, spec,
+                                         post_spec):
+    """Validators whose exits initiate PRE-fork complete their exit
+    under the POST-fork spec with the same epochs."""
+    current_epoch = spec.get_current_epoch(state)
+    exit_epoch = fork_epoch + 2
+    for index in (0, 1):
+        state.validators[index].exit_epoch = exit_epoch
+        state.validators[index].withdrawable_epoch = exit_epoch + \
+            spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    assert current_epoch < fork_epoch
+    yield "pre", state
+    blocks = state_transition_across_slots(
+        spec, state, fork_epoch * spec.SLOTS_PER_EPOCH - 1)
+    state, fork_block = do_fork(state, spec, post_spec, fork_epoch)
+    if fork_block is not None:
+        blocks.append(fork_block)
+    transition_to_next_epoch_and_append_blocks(post_spec, state, blocks)
+    yield from _finish(post_spec, fork_epoch, blocks, state)
+    for index in (0, 1):
+        assert state.validators[index].exit_epoch == exit_epoch
+
+
+@with_fork_metas(_METAS)
+def test_transition_with_slashed_validators(state, fork_epoch, spec,
+                                            post_spec):
+    """Slashed flags and slashings-vector balances survive the upgrade
+    byte-for-byte."""
+    for index in (2, 3):
+        state.validators[index].slashed = True
+    state.slashings[0] = spec.Gwei(7 * 10 ** 9)
+    pre_slashings = [int(s) for s in state.slashings]
+    yield "pre", state
+    blocks = state_transition_across_slots(
+        spec, state, fork_epoch * spec.SLOTS_PER_EPOCH - 1)
+    state, fork_block = do_fork(state, spec, post_spec, fork_epoch)
+    if fork_block is not None:
+        blocks.append(fork_block)
+    yield from _finish(post_spec, fork_epoch, blocks, state)
+    assert state.validators[2].slashed and state.validators[3].slashed
+    assert [int(s) for s in state.slashings] == pre_slashings
