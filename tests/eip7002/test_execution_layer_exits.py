@@ -140,3 +140,64 @@ def test_exit_sets_withdrawable_epoch(spec, state):
     v = state.validators[index]
     assert v.withdrawable_epoch == \
         v.exit_epoch + spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+
+
+@with_phases(["eip7002"])
+@spec_state_test
+def test_btec_then_el_exit_same_block(spec, state):
+    """A BLSToExecutionChange rotating to 0x01 credentials earlier in
+    the block enables an EL exit later in the SAME block (operations
+    process in body order: btec before payload exits)."""
+    from consensus_specs_tpu.test_infra.keys import pubkeys, privkeys
+    from consensus_specs_tpu.utils.hash_function import hash as H
+    from consensus_specs_tpu.utils import bls
+    index = 0
+    _age_validator(spec, state, index)
+    # start on BLS credentials derived from a known withdrawal key
+    wd_pubkey = pubkeys[index + 100]
+    state.validators[index].withdrawal_credentials = \
+        spec.BLS_WITHDRAWAL_PREFIX + H(wd_pubkey)[1:]
+    address = b"\x42" * 20
+    change = spec.BLSToExecutionChange(
+        validator_index=index,
+        from_bls_pubkey=wd_pubkey,
+        to_execution_address=address)
+    domain = spec.compute_domain(
+        spec.DOMAIN_BLS_TO_EXECUTION_CHANGE,
+        genesis_validators_root=state.genesis_validators_root)
+    from consensus_specs_tpu.utils.ssz import hash_tree_root
+    signing_root = hash_tree_root(spec.SigningData(
+        object_root=hash_tree_root(change), domain=domain))
+    signed_change = spec.SignedBLSToExecutionChange(
+        message=change,
+        signature=bls.Sign(privkeys[index + 100], signing_root))
+    yield "pre", state
+    spec.process_bls_to_execution_change(state, signed_change)
+    exit_op = spec.ExecutionLayerExit(
+        source_address=address,
+        validator_pubkey=state.validators[index].pubkey)
+    spec.process_execution_layer_exit(state, exit_op)
+    yield "post", state
+    assert state.validators[index].exit_epoch < spec.FAR_FUTURE_EPOCH
+
+
+@with_phases(["eip7002"])
+@spec_state_test
+def test_cl_exit_then_el_exit_noop(spec, state):
+    """A voluntary (CL) exit processed first makes the EL exit for the
+    same validator a no-op (already initiated)."""
+    index = 0
+    address = _set_eth1_credentials(spec, state, index)
+    _age_validator(spec, state, index)
+    exit_epoch = spec.compute_activation_exit_epoch(
+        spec.get_current_epoch(state))
+    spec.initiate_validator_exit(state, index)
+    first_epoch = state.validators[index].exit_epoch
+    exit_op = spec.ExecutionLayerExit(
+        source_address=address,
+        validator_pubkey=state.validators[index].pubkey)
+    yield "pre", state
+    spec.process_execution_layer_exit(state, exit_op)
+    yield "post", state
+    assert state.validators[index].exit_epoch == first_epoch
+    assert first_epoch >= exit_epoch
