@@ -183,21 +183,24 @@ def test_btec_then_el_exit_same_block(spec, state):
 
 @with_phases(["eip7002"])
 @spec_state_test
-def test_cl_exit_then_el_exit_noop(spec, state):
-    """A voluntary (CL) exit processed first makes the EL exit for the
-    same validator a no-op (already initiated)."""
+def test_cl_exit_op_then_el_exit_noop(spec, state):
+    """A voluntary exit OPERATION (the CL path, process_voluntary_exit)
+    processed first makes the EL exit for the same validator a no-op —
+    distinct from test_exit_already_initiated_noop, which initiates the
+    exit directly: this exercises the real cross-channel interplay."""
+    from consensus_specs_tpu.test_infra.voluntary_exits import (
+        prepare_signed_exits)
     index = 0
     address = _set_eth1_credentials(spec, state, index)
     _age_validator(spec, state, index)
-    exit_epoch = spec.compute_activation_exit_epoch(
-        spec.get_current_epoch(state))
-    spec.initiate_validator_exit(state, index)
+    signed_exit = prepare_signed_exits(spec, state, [index])[0]
+    yield "pre", state
+    spec.process_voluntary_exit(state, signed_exit)
     first_epoch = state.validators[index].exit_epoch
+    assert first_epoch < spec.FAR_FUTURE_EPOCH
     exit_op = spec.ExecutionLayerExit(
         source_address=address,
         validator_pubkey=state.validators[index].pubkey)
-    yield "pre", state
     spec.process_execution_layer_exit(state, exit_op)
     yield "post", state
     assert state.validators[index].exit_epoch == first_epoch
-    assert first_epoch >= exit_epoch

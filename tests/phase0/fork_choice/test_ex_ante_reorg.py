@@ -1,0 +1,204 @@
+"""Ex-ante reorg defense and the get_proposer_head single-slot re-org
+rule.
+
+Reference models: ``test/phase0/fork_choice/test_ex_ante.py`` (proposer
+boost beating withheld-block attacks) and ``test_get_proposer_head.py``
+against ``specs/phase0/fork-choice.md`` get_proposer_head /
+proposer-boost scoring.
+"""
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases, never_bls,
+)
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block,
+    next_slots,
+)
+from consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+from consensus_specs_tpu.test_infra.fork_choice import (
+    get_genesis_forkchoice_store_and_block, on_tick_and_append_step,
+    tick_and_add_block, add_block, add_attestation,
+)
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+
+def _slot_time(spec, store, slot, interval=0):
+    per_interval = int(spec.config.SECONDS_PER_SLOT) // spec.INTERVALS_PER_SLOT
+    return store.genesis_time + int(slot) * int(spec.config.SECONDS_PER_SLOT) \
+        + interval * per_interval
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_ex_ante_withheld_block_loses_to_boosted_proposal(spec, state):
+    """An adversary withholds its slot-n block and reveals it at slot
+    n+1 alongside the honest proposal: the honest block's proposer
+    boost outweighs the withheld block's head start."""
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    base = state.copy()
+
+    # common parent at slot 1
+    state_a = base.copy()
+    block_a = build_empty_block_for_next_slot(spec, state_a)
+    signed_a = state_transition_and_sign_block(spec, state_a, block_a)
+    tick_and_add_block(spec, store, signed_a, test_steps)
+
+    # adversary builds (and withholds) a slot-2 child of A
+    state_b = state_a.copy()
+    block_b = build_empty_block_for_next_slot(spec, state_b)
+    block_b.body.graffiti = b"\xbb" * 32
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+
+    # honest proposer builds the slot-3 child of A (not of B: B unseen)
+    state_c = state_a.copy()
+    next_slots(spec, state_c, 1)
+    block_c = build_empty_block_for_next_slot(spec, state_c)
+    signed_c = state_transition_and_sign_block(spec, state_c, block_c)
+
+    # slot 3 begins: the withheld B arrives late (no boost), C on time
+    on_tick_and_append_step(
+        spec, store, _slot_time(spec, store, block_c.slot), test_steps)
+    add_block(spec, store, signed_b, test_steps)
+    assert bytes(store.proposer_boost_root) == b"\x00" * 32  # B not timely
+    add_block(spec, store, signed_c, test_steps)
+    root_c = hash_tree_root(block_c)
+    assert bytes(store.proposer_boost_root) == root_c
+
+    root_b = hash_tree_root(block_b)
+    assert int(spec.get_weight(store, root_c)) > \
+        int(spec.get_weight(store, root_b))
+    assert bytes(spec.get_head(store)) == root_c
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_ex_ante_sandwich_without_attestations(spec, state):
+    """Withheld block + one late attestation for it: the boosted honest
+    proposal still wins when the adversarial vote fraction is below the
+    boost (40% committee weight)."""
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    state_a = state.copy()
+    block_a = build_empty_block_for_next_slot(spec, state_a)
+    signed_a = state_transition_and_sign_block(spec, state_a, block_a)
+    tick_and_add_block(spec, store, signed_a, test_steps)
+
+    state_b = state_a.copy()
+    block_b = build_empty_block_for_next_slot(spec, state_b)
+    block_b.body.graffiti = b"\xbb" * 32
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+
+    # a SINGLE adversarial attester votes for B at its slot
+    att_b = get_valid_attestation(
+        spec, state_b, slot=block_b.slot,
+        filter_participant_set=lambda c: {min(c)}, signed=True)
+
+    state_c = state_a.copy()
+    next_slots(spec, state_c, 1)
+    block_c = build_empty_block_for_next_slot(spec, state_c)
+    signed_c = state_transition_and_sign_block(spec, state_c, block_c)
+
+    on_tick_and_append_step(
+        spec, store, _slot_time(spec, store, block_c.slot), test_steps)
+    add_block(spec, store, signed_b, test_steps)
+    add_block(spec, store, signed_c, test_steps)
+    add_attestation(spec, store, att_b, test_steps)
+
+    root_b, root_c = hash_tree_root(block_b), hash_tree_root(block_c)
+    boost = int(spec.get_proposer_score(store))
+    one_vote = int(spec.get_weight(store, root_b))
+    # precondition of the scenario: the boost outweighs one lone vote
+    assert boost > one_vote
+    assert bytes(spec.get_head(store)) == root_c
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_proposer_head_prefers_parent_of_late_weak_head(spec, state):
+    """get_proposer_head returns the PARENT when the head arrived late,
+    is weak (no votes), and the parent is strong — the single-slot
+    re-org rule."""
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+
+    # parent block with TWO slots of attestation weight behind it
+    # (is_parent_strong needs > REORG_PARENT_WEIGHT_THRESHOLD = 160%
+    # of one slot's committee weight)
+    state_p = state.copy()
+    block_p = build_empty_block_for_next_slot(spec, state_p)
+    signed_p = state_transition_and_sign_block(spec, state_p, block_p)
+    tick_and_add_block(spec, store, signed_p, test_steps)
+    atts = []
+    epoch = spec.compute_epoch_at_slot(block_p.slot)
+    committees = spec.get_committee_count_per_slot(state_p, epoch)
+    for index in range(committees):
+        atts.append(get_valid_attestation(
+            spec, state_p, slot=block_p.slot, index=index, signed=True))
+
+    # the head is block_p's DIRECT child (single-slot rule) arriving
+    # LATE in its slot (interval 2: not timely)
+    state_h = state_p.copy()
+    block_h = build_empty_block_for_next_slot(spec, state_h)
+    # the head slot's own attesters never saw the late block: they vote
+    # for block_p as head — the second slot of parent weight
+    state_empty = state_p.copy()
+    next_slots(spec, state_empty, 1)
+    assert state_empty.slot == block_h.slot
+    for index in range(committees):
+        atts.append(get_valid_attestation(
+            spec, state_empty, slot=state_empty.slot, index=index,
+            signed=True))
+    signed_h = state_transition_and_sign_block(spec, state_h, block_h)
+    on_tick_and_append_step(
+        spec, store, _slot_time(spec, store, block_h.slot, interval=2),
+        test_steps)
+    add_block(spec, store, signed_h, test_steps)
+    root_h = hash_tree_root(block_h)
+    assert not store.block_timeliness[root_h]
+
+    # next slot, proposing on time; the attestations (including the
+    # head slot's own, which require slot+1) land now
+    on_tick_and_append_step(
+        spec, store, _slot_time(spec, store, block_h.slot + 1), test_steps)
+    for att in atts:
+        add_attestation(spec, store, att, test_steps)
+    assert bytes(spec.get_head(store)) == root_h   # head by chain length
+    proposal_head = bytes(spec.get_proposer_head(
+        store, root_h, block_h.slot + 1))
+    assert proposal_head == hash_tree_root(block_p)
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+def test_proposer_head_keeps_timely_head(spec, state):
+    """A TIMELY head is never re-orged by get_proposer_head even when
+    voteless."""
+    test_steps = []
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    state_p = state.copy()
+    block_p = build_empty_block_for_next_slot(spec, state_p)
+    signed_p = state_transition_and_sign_block(spec, state_p, block_p)
+    tick_and_add_block(spec, store, signed_p, test_steps)
+
+    state_h = state_p.copy()
+    block_h = build_empty_block_for_next_slot(spec, state_h)
+    signed_h = state_transition_and_sign_block(spec, state_h, block_h)
+    on_tick_and_append_step(
+        spec, store, _slot_time(spec, store, block_h.slot), test_steps)
+    add_block(spec, store, signed_h, test_steps)
+    root_h = hash_tree_root(block_h)
+    assert store.block_timeliness[root_h]
+
+    on_tick_and_append_step(
+        spec, store, _slot_time(spec, store, block_h.slot + 1), test_steps)
+    proposal_head = bytes(spec.get_proposer_head(
+        store, root_h, block_h.slot + 1))
+    assert proposal_head == root_h
+    yield "steps", test_steps
