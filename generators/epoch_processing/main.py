@@ -13,5 +13,4 @@ ALL_MODS = {fork: mods
             for fork in ("phase0", "altair", "bellatrix", "capella", "deneb")}
 
 if __name__ == "__main__":
-    run_state_test_generators("epoch_processing", ALL_MODS,
-                              presets=("minimal",))
+    run_state_test_generators("epoch_processing", ALL_MODS)

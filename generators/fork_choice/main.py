@@ -35,4 +35,4 @@ ALL_MODS = {
 }
 
 if __name__ == "__main__":
-    run_state_test_generators("fork_choice", ALL_MODS, presets=("minimal",))
+    run_state_test_generators("fork_choice", ALL_MODS)

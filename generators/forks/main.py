@@ -19,5 +19,5 @@ EXEC_FORKS = {"altair": "phase0", "bellatrix": "altair",
               "capella": "bellatrix", "deneb": "capella"}
 
 if __name__ == "__main__":
-    run_state_test_generators("forks", ALL_MODS, presets=("minimal",),
+    run_state_test_generators("forks", ALL_MODS,
                               exec_forks=EXEC_FORKS)

@@ -10,4 +10,4 @@ from consensus_specs_tpu.gen import run_state_test_generators
 ALL_MODS = {"phase0": {"initialization": "tests.phase0.genesis.test_genesis"}}
 
 if __name__ == "__main__":
-    run_state_test_generators("genesis", ALL_MODS, presets=("minimal",))
+    run_state_test_generators("genesis", ALL_MODS)

@@ -13,4 +13,4 @@ ALL_MODS = {
 }
 
 if __name__ == "__main__":
-    run_state_test_generators("light_client", ALL_MODS, presets=("minimal",))
+    run_state_test_generators("light_client", ALL_MODS)

@@ -15,4 +15,4 @@ ALL_MODS = {
 }
 
 if __name__ == "__main__":
-    run_state_test_generators("merkle_proof", ALL_MODS, presets=("minimal",))
+    run_state_test_generators("merkle_proof", ALL_MODS)

@@ -43,4 +43,4 @@ ALL_MODS = {
 }
 
 if __name__ == "__main__":
-    run_state_test_generators("operations", ALL_MODS, presets=("minimal",))
+    run_state_test_generators("operations", ALL_MODS)

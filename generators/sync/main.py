@@ -11,4 +11,4 @@ mods = {"optimistic": "tests.bellatrix.sync.test_optimistic"}
 ALL_MODS = {fork: mods for fork in ("bellatrix", "capella", "deneb")}
 
 if __name__ == "__main__":
-    run_state_test_generators("sync", ALL_MODS, presets=("minimal",))
+    run_state_test_generators("sync", ALL_MODS)

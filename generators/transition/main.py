@@ -16,4 +16,4 @@ ALL_MODS = {fork: mods
             for fork in ("altair", "bellatrix", "capella", "deneb")}
 
 if __name__ == "__main__":
-    run_state_test_generators("transition", ALL_MODS, presets=("minimal",))
+    run_state_test_generators("transition", ALL_MODS)

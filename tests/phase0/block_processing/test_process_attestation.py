@@ -78,9 +78,11 @@ def test_invalid_wrong_index_for_slot(spec, state):
         state.validators.pop()
         state.balances.pop()
     index = spec.MAX_COMMITTEES_PER_SLOT - 1
-    attestation = get_valid_attestation(spec, state)
+    # sign the honest attestation FIRST: the index corruption is what
+    # process_attestation rejects (before any signature check), and
+    # signing helpers cannot resolve a committee for the bogus index
+    attestation = get_valid_attestation(spec, state, signed=True)
     attestation.data.index = index
-    sign_attestation(spec, state, attestation)
     next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
     yield from run_attestation_processing(spec, state, attestation, valid=False)
 
