@@ -14,10 +14,10 @@ GENERATORS = operations sanity finality rewards random forks epoch_processing \
 test:
 	$(PYTHON) -m pytest tests/ -q
 
-# CI tier: every signature verified, minimal preset
-# (reference `make citest`, Makefile:129-137)
-citest:
-	$(PYTHON) -m pytest tests/ -q --enable-bls
+# CI tier: every signature verified through the native C backend
+# (reference `make citest` with --bls-type=fastest, Makefile:129-137)
+citest: native
+	$(PYTHON) -m pytest tests/ -q --enable-bls --bls-type native
 
 # static checks: syntax gate + stdlib AST lint (unused imports, bare
 # except, mutable defaults) — role of the reference `make lint`
@@ -26,12 +26,19 @@ lint:
 	$(PYTHON) -m compileall -q consensus_specs_tpu tests generators benchmarks
 	$(PYTHON) -m consensus_specs_tpu.tools.lint .
 
-# crypto kernels incl. the heavy differential tier
+# crypto kernels incl. the heavy differential tier — one pytest
+# process per file: the big XLA programs (pairing, sharded verify,
+# batched SHA) each claim gigabytes during compile, and accumulating
+# them in one interpreter can exhaust the 1-core host mid-run
+CRYPTO_SUITES = tests/test_bls.py tests/test_native_bls.py \
+	tests/test_numpy_kernels.py tests/test_hash_to_curve.py \
+	tests/test_sha256_kernel.py tests/test_curdleproofs.py \
+	tests/test_jax_bls.py tests/test_multichip.py tests/deneb/kzg
+
 test-crypto:
-	CS_TPU_HEAVY=1 $(PYTHON) -m pytest tests/test_bls.py tests/test_jax_bls.py \
-		tests/test_hash_to_curve.py tests/test_sha256_kernel.py \
-		tests/test_multichip.py tests/test_curdleproofs.py \
-		tests/deneb/kzg -q
+	@set -e; for s in $(CRYPTO_SUITES); do \
+		echo "=== $$s"; CS_TPU_HEAVY=1 $(PYTHON) -m pytest $$s -q; \
+	done
 
 bench:
 	$(PYTHON) bench.py

@@ -52,22 +52,45 @@ def _rotr(x, n):
 
 
 def _compress(state, block_words):
-    """One SHA-256 compression: state (..., 8) u32, block (..., 16) u32."""
-    w = [block_words[..., i] for i in range(16)]
-    for i in range(16, 64):
-        s0 = _rotr(w[i - 15], 7) ^ _rotr(w[i - 15], 18) ^ (w[i - 15] >> 3)
-        s1 = _rotr(w[i - 2], 17) ^ _rotr(w[i - 2], 19) ^ (w[i - 2] >> 10)
-        w.append(w[i - 16] + s0 + w[i - 7] + s1)
+    """One SHA-256 compression: state (..., 8) u32, block (..., 16) u32.
 
-    a, b, c, d, e, f, g, h = [state[..., i] for i in range(8)]
-    for i in range(64):
+    Round loops run under ``lax.scan`` (not unrolled): XLA traces ONE
+    round body instead of 112, keeping the compiled module small —
+    unrolling blew XLA:CPU's LLVM pipeline past 50 minutes of compile
+    at the batched shapes the merkle layer uses, and the scan form is
+    the compiler-friendly shape on TPU as well.
+
+    W-extension scan carries the sliding 16-word window along the last
+    axis; the round scan carries the 8 working variables.
+    """
+    w16 = jnp.stack([block_words[..., i] for i in range(16)], axis=0)
+
+    def w_step(window, _):
+        # window: (16, ...) — oldest word first
+        s0 = _rotr(window[1], 7) ^ _rotr(window[1], 18) ^ (window[1] >> 3)
+        s1 = _rotr(window[14], 17) ^ _rotr(window[14], 19) \
+            ^ (window[14] >> 10)
+        nxt = window[0] + s0 + window[9] + s1
+        return jnp.concatenate([window[1:], nxt[None]], axis=0), nxt
+
+    window, w_ext = jax.lax.scan(w_step, w16, None, length=48)
+    w_all = jnp.concatenate([w16, w_ext], axis=0)          # (64, ...)
+
+    def round_step(vars8, inputs):
+        k_i, w_i = inputs
+        a, b, c, d, e, f, g, h = vars8
         s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
         ch = (e & f) ^ (~e & g)
-        t1 = h + s1 + ch + jnp.uint32(_K[i]) + w[i]
+        t1 = h + s1 + ch + k_i + w_i
         s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
         maj = (a & b) ^ (a & c) ^ (b & c)
         t2 = s0 + maj
-        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
+        return (t1 + t2, a, b, c, d + t1, e, f, g), None
+
+    init = tuple(state[..., i] for i in range(8))
+    ks = jnp.asarray(_K).reshape((64,) + (1,) * (w_all.ndim - 1))
+    (a, b, c, d, e, f, g, h), _ = jax.lax.scan(
+        round_step, init, (ks, w_all))
     return state + jnp.stack([a, b, c, d, e, f, g, h], axis=-1)
 
 

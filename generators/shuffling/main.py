@@ -21,7 +21,7 @@ def shuffling_case(spec, seed, count):
             for part in parts:
                 ctx.VECTOR_COLLECTOR(part)
         return parts
-    return TestCase(fork_name="phase0", preset_name="minimal",
+    return TestCase(fork_name="phase0", preset_name=spec.preset_name,
                     runner_name="shuffling", handler_name="core",
                     suite_name="shuffle",
                     case_name=f"shuffle_0x{seed[:4].hex()}_{count}",
@@ -29,11 +29,12 @@ def shuffling_case(spec, seed, count):
 
 
 def make_cases():
-    spec = build_spec("phase0", "minimal")
-    for seed_byte in (0, 0x55, 0xAA):
-        seed = bytes([seed_byte]) * 32
-        for count in (0, 1, 2, 3, 5, 33, 100):
-            yield shuffling_case(spec, seed, count)
+    for preset in ("minimal", "mainnet"):
+        spec = build_spec("phase0", preset)
+        for seed_byte in (0, 0x55, 0xAA):
+            seed = bytes([seed_byte]) * 32
+            for count in (0, 1, 2, 3, 5, 33, 100):
+                yield shuffling_case(spec, seed, count)
 
 
 if __name__ == "__main__":

@@ -67,18 +67,21 @@ def ssz_static_case(fork, preset, type_name, typ, mode, seed, count):
 
 
 def make_cases():
-    for fork in FORKS:
-        spec = build_spec(fork, "minimal")
-        for type_name, typ in sorted(_spec_container_types(spec).items()):
-            for mode in (RandomizationMode.mode_random,
-                         RandomizationMode.mode_zero,
-                         RandomizationMode.mode_max):
-                count = 3 if mode.is_changing() else 1
-                for i in range(count):
-                    yield ssz_static_case(
-                        fork, "minimal", type_name, typ, mode,
-                        seed=_stable_seed(fork, type_name, mode.value, i),
-                        count=i)
+    for preset in ("minimal", "mainnet"):
+        for fork in FORKS:
+            spec = build_spec(fork, preset)
+            for type_name, typ in sorted(
+                    _spec_container_types(spec).items()):
+                for mode in (RandomizationMode.mode_random,
+                             RandomizationMode.mode_zero,
+                             RandomizationMode.mode_max):
+                    count = 3 if mode.is_changing() else 1
+                    for i in range(count):
+                        yield ssz_static_case(
+                            fork, preset, type_name, typ, mode,
+                            seed=_stable_seed(fork, type_name,
+                                              mode.value, i),
+                            count=i)
 
 
 if __name__ == "__main__":

@@ -8,7 +8,7 @@ balances, no progress off-boundary) against
 """
 from consensus_specs_tpu.test_infra.context import (
     spec_test, with_phases, with_all_phases_from, with_custom_state,
-    single_phase, spec_state_test, misc_balances, default_balances,
+    single_phase, spec_state_test, misc_balances,
     default_activation_threshold,
 )
 from consensus_specs_tpu.test_infra.epoch_processing import (

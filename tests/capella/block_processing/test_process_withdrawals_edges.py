@@ -11,7 +11,6 @@ from random import Random
 from consensus_specs_tpu.test_infra.context import (
     spec_state_test, with_phases,
 )
-from consensus_specs_tpu.test_infra.block import next_epoch
 from consensus_specs_tpu.test_infra.execution_payload import (
     build_empty_execution_payload)
 

@@ -10,8 +10,8 @@ from consensus_specs_tpu.test_infra.context import (
     expect_assertion_error,
 )
 from consensus_specs_tpu.test_infra.block import (
-    build_empty_block_for_next_slot, next_epoch, next_slot,
-    state_transition_and_sign_block, sign_block, transition_unsigned_block,
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block, transition_unsigned_block,
 )
 from consensus_specs_tpu.test_infra.execution_payload import (
     build_empty_execution_payload, compute_el_block_hash,

@@ -9,7 +9,7 @@ from consensus_specs_tpu.test_infra.context import (
 )
 from consensus_specs_tpu.test_infra.block import (
     build_empty_block_for_next_slot, state_transition_and_sign_block,
-    next_slots, next_epoch,
+    next_slots,
 )
 from consensus_specs_tpu.test_infra.fork_choice import (
     get_genesis_forkchoice_store_and_block, on_tick_and_append_step,

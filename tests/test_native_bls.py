@@ -18,7 +18,7 @@ from consensus_specs_tpu.ops import native_bls
 from consensus_specs_tpu.ops.bls12_381 import ciphersuite as py
 from consensus_specs_tpu.ops.bls12_381.curve import (
     G1Point, G2Point, g1_from_compressed, G1_GENERATOR)
-from consensus_specs_tpu.ops.bls12_381.fields import P, R_ORDER, Fq
+from consensus_specs_tpu.ops.bls12_381.fields import R_ORDER, Fq
 
 pytestmark = pytest.mark.skipif(
     not native_bls.available(), reason="native BLS library not built")
