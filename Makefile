@@ -14,10 +14,14 @@ GENERATORS = operations sanity finality rewards random forks epoch_processing \
 test:
 	$(PYTHON) -m pytest tests/ -q
 
-# CI tier: every signature verified through the native C backend
+# CI tier: every signature verified through the fastest available
+# backend — native C when gcc can build it, else jax, else the py
+# oracle.  The native build is best-effort (`-`) so hosts without gcc
+# degrade to a slower backend instead of erroring out of the whole tier
 # (reference `make citest` with --bls-type=fastest, Makefile:129-137)
-citest: native
-	$(PYTHON) -m pytest tests/ -q --enable-bls --bls-type native
+citest:
+	-$(MAKE) native
+	$(PYTHON) -m pytest tests/ -q --enable-bls --bls-type fastest
 
 # static checks: syntax gate + stdlib AST lint (unused imports, bare
 # except, mutable defaults) — role of the reference `make lint`
@@ -45,6 +49,11 @@ bench:
 
 bench-all:
 	$(PYTHON) benchmarks/bench_all.py
+
+# epoch-engine smoke: loop-vs-vectorized rewards at the small registry
+# shape (full matrix: --epoch-shapes 16384,262144,1048576)
+bench-epoch:
+	$(PYTHON) benchmarks/bench_all.py --configs 5 --epoch-shapes 16384
 
 dryrun:
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -238,6 +238,111 @@ def bench_epoch_replay(n_validators=4096, slots=8):
             "value": round(dt, 3), "unit": "s/epoch", "vs_baseline": 1.0}
 
 
+# validator counts for the config #5 loop-vs-vectorized engine
+# comparison (overridden by --epoch-shapes)
+_EPOCH_SHAPES = [16384]
+
+
+def _synthetic_registry_state(spec, n_validators, seed=5):
+    """A mainnet-shaped altair state at epoch 3 with ``n_validators``
+    active validators: fabricated pubkeys (the epoch path never reads
+    them), ~2% slashed, a few low-balance validators, ~75% full
+    participation.  Built directly (no deposits, no real keys) so the
+    1M-validator shape is constructible in seconds, not hours."""
+    import random as _random
+    rng = _random.Random(seed)
+    max_eb = int(spec.MAX_EFFECTIVE_BALANCE)
+    increment = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    far = int(spec.FAR_FUTURE_EPOCH)
+    epoch = 3
+    Validator = spec.Validator
+    filler = b"\xaa" * 42
+    validators, balances, participation, scores = [], [], [], []
+    for i in range(n_validators):
+        slashed = rng.random() < 0.02
+        eff = max_eb if rng.random() > 0.05 else max_eb - increment
+        validators.append(Validator(
+            pubkey=i.to_bytes(6, "little") + filler,
+            effective_balance=eff,
+            slashed=slashed,
+            exit_epoch=far,
+            withdrawable_epoch=epoch + rng.randrange(1, 16) if slashed
+            else far,
+        ))
+        balances.append(eff + rng.randrange(0, increment))
+        participation.append(7 if rng.random() < 0.75 else rng.randrange(8))
+        scores.append(0 if rng.random() < 0.9 else rng.randrange(1, 20))
+    state = spec.BeaconState(
+        slot=epoch * int(spec.SLOTS_PER_EPOCH),
+        validators=validators, balances=balances,
+        previous_epoch_participation=participation,
+        current_epoch_participation=participation,
+        inactivity_scores=scores,
+    )
+    state.finalized_checkpoint.epoch = 1    # recent finality: no leak
+    # warm the registry subtree memo: production merkleizes the state
+    # every slot (process_slot state-root caching), so by any epoch
+    # boundary the validators root is already cached — a freshly built
+    # synthetic registry must not charge that first-ever merkleization
+    # to either engine
+    from consensus_specs_tpu.utils.ssz import hash_tree_root
+    hash_tree_root(state.validators)
+    return state
+
+
+def _bench_epoch_engine_at(n_validators):
+    """One shape of the loop-vs-vectorized comparison: altair
+    ``process_rewards_and_penalties`` (the participation-flag path that
+    carries bellatrix..eip7594 by inheritance) through the per-validator
+    spec loop vs the columnar engine.  ``vec_cold_s`` includes the
+    once-per-epoch snapshot extraction; ``vec_warm_s`` is the
+    steady-state cost with the snapshot amortized across the five epoch
+    stages (and unchanged registries)."""
+    from consensus_specs_tpu.forks import build_spec
+    from consensus_specs_tpu.ops import epoch_kernels as ek
+    from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+    spec = build_spec("altair", "mainnet")
+    state = _synthetic_registry_state(spec, n_validators)
+    s_loop = state.copy()
+
+    ek.use_loops()
+    t0 = time.time()
+    spec.process_rewards_and_penalties(s_loop)
+    loop_s = time.time() - t0
+
+    ek.use_vectorized()
+    t0 = time.time()
+    spec.process_rewards_and_penalties(state)
+    vec_cold_s = time.time() - t0
+    # differential check rides every bench run: same pre-state, both
+    # engines, identical post-balances root
+    assert hash_tree_root(state.balances) == hash_tree_root(s_loop.balances)
+
+    warm = []
+    for _ in range(3):
+        t0 = time.time()
+        spec.process_rewards_and_penalties(state)
+        warm.append(time.time() - t0)
+    vec_warm_s = min(warm)
+    ek.use_auto()
+    return {"validators": n_validators, "loop_s": round(loop_s, 3),
+            "vec_cold_s": round(vec_cold_s, 3),
+            "vec_warm_s": round(vec_warm_s, 4),
+            "speedup_cold": round(loop_s / vec_cold_s, 1),
+            "speedup_warm": round(loop_s / vec_warm_s, 1)}
+
+
+def bench_epoch_transition():
+    """Config #5: the BASELINE epoch-replay metric (now running through
+    the vectorized engine by default) plus the explicit loop-vs-
+    vectorized ``process_rewards_and_penalties`` comparison at the
+    --epoch-shapes registry sizes."""
+    out = bench_epoch_replay()
+    out["engine"] = [_bench_epoch_engine_at(n) for n in _EPOCH_SHAPES]
+    return out
+
+
 def bench_blob_batch(n_blobs=6):
     """Config #4: deneb ``verify_blob_kzg_proof_batch`` over 6 blobs
     (mainnet setup) vs serial per-blob verification.  The batch path is
@@ -277,17 +382,23 @@ CONFIGS = {
     "2": bench_process_block,
     "3": bench_sync_aggregate,
     "4": bench_blob_batch,
-    "5": bench_epoch_replay,
+    "5": bench_epoch_transition,
 }
 
 
 def main():
+    global _EPOCH_SHAPES
     parser = argparse.ArgumentParser()
     parser.add_argument("--configs", default="1,2,3,4,5")
+    parser.add_argument("--epoch-shapes", default="16384",
+                        help="comma-separated validator counts for the "
+                             "config #5 loop-vs-vectorized epoch-engine "
+                             "comparison (e.g. 16384,262144,1048576)")
     parser.add_argument("--profile", action="store_true",
                         help="print the per-stage span breakdown "
                              "(utils/profiling) after each config")
     ns = parser.parse_args()
+    _EPOCH_SHAPES = [int(s) for s in ns.epoch_shapes.split(",")]
     if ns.profile:
         from consensus_specs_tpu.utils import profiling
         profiling.enable()

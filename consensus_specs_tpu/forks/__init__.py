@@ -84,8 +84,13 @@ def use_compiled_registry():
         main as _compile_all, _FORK_ORDER)
     _compile_all()
     importlib.invalidate_caches()  # compiled/ may have just been created
+    from consensus_specs_tpu.ops.epoch_kernels import install_vectorized_epoch
     for fork in _FORK_ORDER:
         mod = importlib.import_module(f"{__name__}.compiled.{fork}")
         importlib.reload(mod)
-        _REGISTRY[fork] = getattr(mod, f"Compiled{fork.capitalize()}Spec")
+        cls = getattr(mod, f"Compiled{fork.capitalize()}Spec")
+        # compiled method bodies are emitted verbatim from the markdown,
+        # so the vectorized-epoch dispatch wraps them from outside
+        install_vectorized_epoch(cls)
+        _REGISTRY[fork] = cls
     _spec_cache.clear()

@@ -22,6 +22,7 @@ from consensus_specs_tpu.utils.ssz import (
     Bitlist, Bitvector, Vector, List, Container,
 )  # noqa: F401 (compiled-spec namespace)
 from consensus_specs_tpu.utils import bls
+from consensus_specs_tpu.ops import epoch_kernels
 from . import register_fork
 from .fork_choice import ForkChoiceMixin
 from .validator_guide import ValidatorGuideMixin
@@ -102,7 +103,7 @@ class Phase0Spec(ValidatorGuideMixin, ForkChoiceMixin):
         # without limit across a long generator run.
         self._caches: Dict[str, "_LRUDict"] = {
             "committee": _LRUDict(512), "proposer": _LRUDict(512),
-            "active_indices": _LRUDict(128),
+            "active_indices": _LRUDict(128), "total_balance": _LRUDict(128),
         }
 
     # -- config ------------------------------------------------------------
@@ -542,8 +543,19 @@ class Phase0Spec(ValidatorGuideMixin, ForkChoiceMixin):
                         sum(state.validators[index].effective_balance for index in indices)))
 
     def get_total_active_balance(self, state) -> Gwei:
-        return self.get_total_balance(
-            state, set(self.get_active_validator_indices(state, self.get_current_epoch(state))))
+        # root-keyed like the committee caches (reference analog:
+        # pysetup's lru-cached get_total_active_balance): per-validator
+        # reward loops call this once per index, and the O(validators)
+        # sum would otherwise make every epoch function quadratic
+        key = (hash_tree_root(state.validators), self.get_current_epoch(state))
+        cached = self._caches["total_balance"].get(key)
+        if cached is None:
+            cached = self.get_total_balance(
+                state,
+                set(self.get_active_validator_indices(
+                    state, self.get_current_epoch(state))))
+            self._caches["total_balance"][key] = cached
+        return cached
 
     def get_domain(self, state, domain_type, epoch=None) -> Domain:
         epoch = self.get_current_epoch(state) if epoch is None else epoch
@@ -909,6 +921,8 @@ class Phase0Spec(ValidatorGuideMixin, ForkChoiceMixin):
         return rewards, penalties
 
     def process_rewards_and_penalties(self, state) -> None:
+        if epoch_kernels.try_process_rewards_and_penalties(self, state):
+            return
         # No rewards are applied at the end of `GENESIS_EPOCH` because rewards
         # are for work done in the previous epoch
         if self.get_current_epoch(state) == GENESIS_EPOCH:
@@ -922,6 +936,8 @@ class Phase0Spec(ValidatorGuideMixin, ForkChoiceMixin):
 
     def process_registry_updates(self, state) -> None:
         """beacon-chain.md:1592"""
+        if epoch_kernels.try_process_registry_updates(self, state):
+            return
         # Process activation eligibility and ejections
         for index, validator in enumerate(state.validators):
             if self.is_eligible_for_activation_queue(validator):
@@ -944,6 +960,8 @@ class Phase0Spec(ValidatorGuideMixin, ForkChoiceMixin):
 
     def process_slashings(self, state) -> None:
         """beacon-chain.md:1619"""
+        if epoch_kernels.try_process_slashings(self, state):
+            return
         epoch = self.get_current_epoch(state)
         total_balance = self.get_total_active_balance(state)
         adjusted_total_slashing_balance = min(
@@ -963,6 +981,8 @@ class Phase0Spec(ValidatorGuideMixin, ForkChoiceMixin):
             state.eth1_data_votes = type(state.eth1_data_votes)()
 
     def process_effective_balance_updates(self, state) -> None:
+        if epoch_kernels.try_process_effective_balance_updates(self, state):
+            return
         for index, validator in enumerate(state.validators):
             balance = state.balances[index]
             HYSTERESIS_INCREMENT = uint64(

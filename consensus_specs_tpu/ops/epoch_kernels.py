@@ -1,0 +1,795 @@
+"""Vectorized epoch-processing engine: array-native rewards, penalties
+and balance updates.
+
+The per-validator epoch loops (``process_rewards_and_penalties``,
+``process_inactivity_updates``, ``process_effective_balance_updates``,
+``process_registry_updates`` eligibility scans, ``process_slashings``)
+are O(validators) python iterations over SSZ typed views — the last
+python-loop-bound hot path at registry scale (BENCHMARKS.md config #5:
+the 1M-validator epoch transition is all epoch-loop time).  This module
+re-expresses them as columnar array kernels over a struct-of-arrays
+snapshot of the validator set, extracted once per epoch from the SSZ
+state and re-keyed incrementally as the epoch functions mutate it.
+
+Layering mirrors the BLS backend switch (``utils/bls.py``):
+
+  use_vectorized() / use_loops() / use_auto()   runtime switch; auto
+      (the default) is ON unless ``CS_TPU_VECTORIZED_EPOCH=0``
+  try_process_*(spec, state) -> bool            entry points the fork
+      ladder calls first; True means the vectorized engine committed the
+      transition, False means "run the spec loop" (switch off, genesis
+      no-op, or a guard tripped)
+  install_vectorized_epoch(cls)                 wraps a markdown-compiled
+      spec class's epoch methods with the same dispatch (the compiled
+      ladder cannot carry hand-written calls in its method bodies)
+
+Exactness contract: every kernel reproduces the spec loops bit-for-bit
+— same uint64 truncations, same clamp-at-zero balance decreases, same
+ordering — so post-state ``hash_tree_root`` is identical (enforced by
+``tests/test_epoch_vectorized.py``).  All intermediate products are
+range-checked against 2**64 with python-int bounds before any array op
+runs; a state that could overflow a uint64 lane falls back to the spec
+loop instead of wrapping.
+
+The kernels themselves (``*_kernel``) are pure functions of arrays and
+python scalars written against an ``xp`` array namespace: ``numpy`` on
+the host (the production CPU path) and ``jax.numpy`` under ``jax.jit``
+for device dispatch (uint64 lanes need ``jax_enable_x64``).
+"""
+import math
+import os
+
+import numpy as np
+
+from consensus_specs_tpu.utils.lru import LRUDict
+from consensus_specs_tpu.utils.ssz import (
+    hash_tree_root, sequence_items, replace_basic_items)
+
+_U64_MAX = (1 << 64) - 1
+
+# ---------------------------------------------------------------------------
+# Runtime switch (mirrors utils/bls.py's use_py/use_jax/use_fastest)
+# ---------------------------------------------------------------------------
+
+_mode = "auto"
+
+
+def use_vectorized() -> None:
+    """Force the array engine on (guards can still fall back)."""
+    global _mode
+    _mode = "on"
+
+
+def use_loops() -> None:
+    """Force the per-validator spec loops (the differential oracle)."""
+    global _mode
+    _mode = "off"
+
+
+def use_auto() -> None:
+    """Default policy: on unless ``CS_TPU_VECTORIZED_EPOCH=0``."""
+    global _mode
+    _mode = "auto"
+
+
+def backend_name() -> str:
+    return "loops" if not enabled() else "vectorized"
+
+
+def enabled() -> bool:
+    if _mode == "on":
+        return True
+    if _mode == "off":
+        return False
+    return os.environ.get("CS_TPU_VECTORIZED_EPOCH") != "0"
+
+
+# vectorized-commit / guard-fallback counters; the differential suite
+# asserts on these so a silent fallback cannot turn its comparisons
+# into loop-vs-loop tautologies
+_stats = {"vectorized": 0, "fallback": 0}
+
+
+def stats() -> dict:
+    return dict(_stats)
+
+
+class _Fallback(Exception):
+    """A guard refused the array path (possible uint64 overflow or an
+    unsupported shape); the caller runs the spec loop instead."""
+
+
+def _guard(*products) -> None:
+    """Fail over to the spec loop if any python-int bound reaches 2**64."""
+    for p in products:
+        if p > _U64_MAX:
+            raise _Fallback()
+
+
+# ---------------------------------------------------------------------------
+# Struct-of-arrays snapshot of the validator registry
+# ---------------------------------------------------------------------------
+
+_VALIDATOR_DTYPE = np.dtype([
+    ("eff", "<u8"),    # effective_balance
+    ("aee", "<u8"),    # activation_eligibility_epoch
+    ("act", "<u8"),    # activation_epoch
+    ("ext", "<u8"),    # exit_epoch
+    ("wd", "<u8"),     # withdrawable_epoch
+    ("sl", "?"),       # slashed
+])
+
+# validators hash_tree_root -> structured column array.  Root-keyed like
+# the spec's committee caches: exact (the root commits to every field)
+# and warm across the five epoch functions of one transition.
+_COLS_CACHE = LRUDict(8)
+
+
+def validator_columns(state):
+    """Extract (or fetch cached) the registry snapshot as one structured
+    uint64 array — a single python pass over the typed views instead of
+    one pass per consumer field."""
+    key = bytes(hash_tree_root(state.validators))
+    cols = _COLS_CACHE.get(key)
+    if cols is None:
+        items = sequence_items(state.validators)
+        cols = np.fromiter(
+            ((v.effective_balance, v.activation_eligibility_epoch,
+              v.activation_epoch, v.exit_epoch, v.withdrawable_epoch,
+              bool(v.slashed)) for v in items),
+            dtype=_VALIDATOR_DTYPE, count=len(items))
+        _COLS_CACHE[key] = cols
+    return cols
+
+
+def _recache_columns(state, cols) -> None:
+    """Key updated columns under the post-mutation root, so the next
+    epoch function reuses them instead of re-extracting.  ``cols`` must
+    be a PRIVATE copy, never the array ``validator_columns`` returned:
+    cached entries are immutable (a state copy — or another fork's state
+    with an identical registry — maps to the old key and must keep
+    seeing the pre-mutation snapshot)."""
+    _COLS_CACHE[bytes(hash_tree_root(state.validators))] = cols
+
+
+def u64_column(seq) -> np.ndarray:
+    items = sequence_items(seq)
+    return np.fromiter(items, dtype=np.uint64, count=len(items))
+
+
+# ---------------------------------------------------------------------------
+# Write-back
+# ---------------------------------------------------------------------------
+
+def _write_u64_list(seq, elem_type, old, new) -> None:
+    """Commit a uint64 column back into its SSZ list, matching the spec
+    loop's per-index writes bit-for-bit but without its per-index python
+    cost.  Few changes -> targeted ``__setitem__`` (keeps the incremental
+    chunk tree); registry-wide changes -> wholesale item swap, building
+    the element objects through a value-dedup table (epoch deltas are
+    highly repetitive: equal-stake validators earn equal rewards)."""
+    changed = np.nonzero(old != new)[0]
+    if changed.size == 0:
+        return
+    if changed.size <= max(64, len(old) // 64):
+        for i in changed.tolist():
+            seq[i] = elem_type(int(new[i]))
+        return
+    vals, inv = np.unique(new, return_inverse=True)
+    if vals.size * 4 <= new.size:
+        pool = [elem_type(int(v)) for v in vals.tolist()]
+        items = [pool[i] for i in inv.tolist()]
+    else:
+        # int.__new__ skips BasicValue's range re-validation; the values
+        # come out of a uint64 array, so the range holds by construction
+        items = [int.__new__(elem_type, v) for v in new.tolist()]
+    replace_basic_items(seq, items)
+
+
+# ---------------------------------------------------------------------------
+# Pure array kernels (xp = numpy on host, jax.numpy under jit)
+# ---------------------------------------------------------------------------
+
+def apply_deltas_kernel(xp, balances, rewards, penalties):
+    """increase_balance then clamped decrease_balance, per validator."""
+    up = balances + rewards
+    return xp.where(penalties > up, xp.uint64(0), up - penalties)
+
+
+def flag_deltas_kernel(xp, base_reward, eligible, participating, *,
+                       weight, weight_denominator, participating_increments,
+                       active_increments, in_leak, is_head_flag):
+    """altair ``get_flag_index_deltas`` for one participation flag."""
+    zero = xp.uint64(0)
+    reward = (base_reward * xp.uint64(weight)
+              * xp.uint64(participating_increments)) \
+        // xp.uint64(active_increments * weight_denominator)
+    rewards = xp.where(eligible & participating & (not in_leak), reward, zero)
+    penalty = (base_reward * xp.uint64(weight)) // xp.uint64(weight_denominator)
+    penalize = eligible & ~participating & (not is_head_flag)
+    penalties = xp.where(penalize, penalty, zero)
+    return rewards, penalties
+
+
+def inactivity_penalty_kernel(xp, eff, scores, eligible, target_participating,
+                              *, denominator):
+    """altair+ ``get_inactivity_penalty_deltas`` (score-scaled)."""
+    penalty = (eff * scores) // xp.uint64(denominator)
+    return xp.where(eligible & ~target_participating, penalty, xp.uint64(0))
+
+
+def inactivity_updates_kernel(xp, scores, eligible, participating, *,
+                              bias, recovery_rate, in_leak):
+    """altair ``process_inactivity_updates`` score transition."""
+    one = xp.uint64(1)
+    bumped = xp.where(participating, scores - xp.minimum(one, scores),
+                      scores + xp.uint64(bias))
+    if not in_leak:
+        rec = xp.uint64(recovery_rate)
+        bumped = bumped - xp.minimum(rec, bumped)
+    return xp.where(eligible, bumped, scores)
+
+
+def phase0_component_kernel(xp, base_reward, eligible, attesting, *,
+                            in_leak, attesting_increments, total_increments):
+    """phase0 ``get_attestation_component_deltas`` (source/target/head)."""
+    zero = xp.uint64(0)
+    if in_leak:
+        # full base reward; canceled later by the inactivity deltas
+        reward = base_reward
+    else:
+        reward = (base_reward * xp.uint64(attesting_increments)) \
+            // xp.uint64(total_increments)
+    rewards = xp.where(eligible & attesting, reward, zero)
+    penalties = xp.where(eligible & ~attesting, base_reward, zero)
+    return rewards, penalties
+
+
+def phase0_inactivity_kernel(xp, base_reward, eff, eligible,
+                             target_attesting, *, base_rewards_per_epoch,
+                             proposer_reward_quotient, finality_delay,
+                             inactivity_penalty_quotient):
+    """phase0 ``get_inactivity_penalty_deltas`` (leak epochs only)."""
+    zero = xp.uint64(0)
+    proposer_reward = base_reward // xp.uint64(proposer_reward_quotient)
+    base_pen = xp.uint64(base_rewards_per_epoch) * base_reward - proposer_reward
+    extra = (eff * xp.uint64(finality_delay)) \
+        // xp.uint64(inactivity_penalty_quotient)
+    pen = base_pen + xp.where(target_attesting, zero, extra)
+    return xp.where(eligible, pen, zero)
+
+
+def effective_balance_kernel(xp, balances, eff, *, increment,
+                             downward_threshold, upward_threshold,
+                             max_effective_balance):
+    """``process_effective_balance_updates`` hysteresis."""
+    crossed = ((balances + xp.uint64(downward_threshold) < eff)
+               | (eff + xp.uint64(upward_threshold) < balances))
+    capped = xp.minimum(balances - balances % xp.uint64(increment),
+                        xp.uint64(max_effective_balance))
+    return xp.where(crossed, capped, eff)
+
+
+def slashing_penalty_kernel(xp, eff, target, *, increment,
+                            adjusted_total_slashing_balance, total_balance):
+    """``process_slashings`` penalty column (spec's truncation order:
+    divide by total_balance BEFORE multiplying back by increment)."""
+    numer = (eff // xp.uint64(increment)) \
+        * xp.uint64(adjusted_total_slashing_balance)
+    penalty = (numer // xp.uint64(total_balance)) * xp.uint64(increment)
+    return xp.where(target, penalty, xp.uint64(0))
+
+
+# ---------------------------------------------------------------------------
+# Scalar plumbing shared by the orchestrators
+# ---------------------------------------------------------------------------
+
+def _fork_lineage(spec) -> frozenset:
+    """Fork names along the spec class's inheritance chain — works for
+    the hand-written and the markdown-compiled ladder alike (both stamp
+    ``fork`` on every class)."""
+    return frozenset(
+        c.__dict__["fork"] for c in type(spec).__mro__
+        if isinstance(c.__dict__.get("fork"), str))
+
+
+def _masked_sum(eff, mask) -> int:
+    """Exact python-int sum of a masked uint64 column."""
+    sub = eff[mask]
+    if sub.size == 0:
+        return 0
+    mx = int(sub.max())
+    if mx and sub.size > _U64_MAX // mx:
+        return sum(int(x) for x in sub.tolist())
+    return int(sub.sum(dtype=np.uint64))
+
+
+def _epoch_masks(spec, cols, previous_epoch):
+    """active-at-previous-epoch and reward-eligibility masks
+    (``get_eligible_validator_indices``)."""
+    prev = np.uint64(previous_epoch)
+    active_prev = (cols["act"] <= prev) & (prev < cols["ext"])
+    eligible = active_prev | (cols["sl"] & (previous_epoch + 1 < cols["wd"]))
+    return active_prev, eligible
+
+
+def _total_active_balance(spec, cols, current_epoch) -> int:
+    """``get_total_active_balance`` from columns (same increment clamp)."""
+    cur = np.uint64(current_epoch)
+    active = (cols["act"] <= cur) & (cur < cols["ext"])
+    return max(int(spec.EFFECTIVE_BALANCE_INCREMENT),
+               _masked_sum(cols["eff"], active))
+
+
+def _mask_from_indices(n, indices) -> np.ndarray:
+    mask = np.zeros(n, dtype=bool)
+    if indices:
+        mask[np.fromiter(indices, dtype=np.int64, count=len(indices))] = True
+    return mask
+
+
+def _commit_balances(spec, state, old, new) -> None:
+    _write_u64_list(state.balances, spec.Gwei, old, new)
+
+
+# ---------------------------------------------------------------------------
+# process_rewards_and_penalties
+# ---------------------------------------------------------------------------
+
+def try_process_rewards_and_penalties(spec, state) -> bool:
+    if not enabled():
+        return False
+    if spec.get_current_epoch(state) == spec.GENESIS_EPOCH:
+        return False    # the spec body is already a no-op early return
+    try:
+        if "altair" in _fork_lineage(spec):
+            _altair_rewards_and_penalties(spec, state)
+        else:
+            _phase0_rewards_and_penalties(spec, state)
+    except _Fallback:
+        _stats["fallback"] += 1
+        return False
+    _stats["vectorized"] += 1
+    return True
+
+
+def _base_reward_phase0(spec, cols, total_balance):
+    """phase0 ``get_base_reward`` column + its python-int max bound."""
+    sqrt_total = spec.integer_squareroot(total_balance)
+    brf = int(spec.BASE_REWARD_FACTOR)
+    brpe = int(spec.BASE_REWARDS_PER_EPOCH)
+    max_eff = int(cols["eff"].max(initial=0))
+    _guard(max_eff * brf)
+    base_reward = (cols["eff"] * np.uint64(brf)) \
+        // np.uint64(int(sqrt_total)) // np.uint64(brpe)
+    return base_reward, max_eff * brf // int(sqrt_total) // brpe
+
+
+def _phase0_rewards_and_penalties(spec, state) -> None:
+    """``get_attestation_deltas`` + the balance-update loop, columnar.
+    The O(attestations) committee work stays in python (it is already
+    cached and small); every O(validators) pass runs as an array op."""
+    xp = np
+    prev_epoch = spec.get_previous_epoch(state)
+    # spec helpers up front: their assertion behavior (exception as
+    # invalidity) must fire exactly as in the loop path, before any write
+    src_atts = spec.get_matching_source_attestations(state, prev_epoch)
+    tgt_atts = spec.get_matching_target_attestations(state, prev_epoch)
+    head_atts = spec.get_matching_head_attestations(state, prev_epoch)
+    src_set = spec.get_unslashed_attesting_indices(state, src_atts)
+    tgt_set = spec.get_unslashed_attesting_indices(state, tgt_atts)
+    head_set = spec.get_unslashed_attesting_indices(state, head_atts)
+
+    cols = validator_columns(state)
+    n = len(cols)
+    if n == 0:
+        return
+    eff = cols["eff"]
+    _, eligible = _epoch_masks(spec, cols, int(prev_epoch))
+    total_balance = _total_active_balance(spec, cols,
+                                          int(spec.get_current_epoch(state)))
+    _guard(total_balance)
+    increment = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    total_increments = total_balance // increment
+    in_leak = bool(spec.is_in_inactivity_leak(state))
+    base_reward, br_max = _base_reward_phase0(spec, cols, total_balance)
+
+    reward_parts, penalty_parts = [], []
+    for att_set in (src_set, tgt_set, head_set):
+        att_mask = _mask_from_indices(n, att_set)
+        att_balance = max(increment, _masked_sum(eff, att_mask))
+        att_increments = att_balance // increment
+        _guard(br_max * att_increments)
+        r, p = phase0_component_kernel(
+            xp, base_reward, eligible, att_mask, in_leak=in_leak,
+            attesting_increments=att_increments,
+            total_increments=total_increments)
+        reward_parts.append(r)
+        penalty_parts.append(p)
+
+    # inclusion-delay rewards: one ordered pass over the source
+    # attestations finds each attester's earliest-included attestation
+    # (the spec's min() keeps the first minimum, hence the strict <)
+    prq = int(spec.PROPOSER_REWARD_QUOTIENT)
+    src_mask = _mask_from_indices(n, src_set)
+    best_delay = np.full(n, _U64_MAX, dtype=np.uint64)
+    best_proposer = np.zeros(n, dtype=np.int64)
+    for att in src_atts:
+        idxs = spec.get_attesting_indices(state, att.data,
+                                          att.aggregation_bits)
+        if not idxs:
+            continue
+        ii = np.fromiter(idxs, dtype=np.int64, count=len(idxs))
+        upd = np.uint64(int(att.inclusion_delay)) < best_delay[ii]
+        sel = ii[upd]
+        best_delay[sel] = np.uint64(int(att.inclusion_delay))
+        best_proposer[sel] = int(att.proposer_index)
+    proposer_reward = base_reward // np.uint64(prq)
+    incl_rewards = np.zeros(n, dtype=np.uint64)
+    src_idx = np.nonzero(src_mask)[0]
+    if src_idx.size:
+        max_attester = base_reward[src_idx] - proposer_reward[src_idx]
+        incl_rewards[src_idx] = max_attester // best_delay[src_idx]
+        # every attester's proposer cut could land on ONE proposer index
+        _guard(br_max + src_idx.size * (br_max // prq))
+        np.add.at(incl_rewards, best_proposer[src_idx],
+                  proposer_reward[src_idx])
+    reward_parts.append(incl_rewards)
+
+    # inactivity penalties (leak epochs)
+    if in_leak:
+        finality_delay = int(spec.get_finality_delay(state))
+        tgt_mask = _mask_from_indices(n, tgt_set)
+        max_eff = int(eff.max(initial=0))
+        # base_pen + extra is one uint64 lane sum: bound the two together
+        _guard(int(spec.BASE_REWARDS_PER_EPOCH) * br_max
+               + max_eff * finality_delay)
+        penalty_parts.append(phase0_inactivity_kernel(
+            xp, base_reward, eff, eligible, tgt_mask,
+            base_rewards_per_epoch=int(spec.BASE_REWARDS_PER_EPOCH),
+            proposer_reward_quotient=prq, finality_delay=finality_delay,
+            inactivity_penalty_quotient=int(spec.INACTIVITY_PENALTY_QUOTIENT)))
+
+    _guard(sum(int(r.max(initial=0)) for r in reward_parts),
+           sum(int(p.max(initial=0)) for p in penalty_parts))
+    rewards = reward_parts[0]
+    for r in reward_parts[1:]:
+        rewards = rewards + r
+    penalties = penalty_parts[0]
+    for p in penalty_parts[1:]:
+        penalties = penalties + p
+
+    balances = u64_column(state.balances)
+    _guard(int(balances.max(initial=0)) + int(rewards.max(initial=0)))
+    new_balances = apply_deltas_kernel(xp, balances, rewards, penalties)
+    _commit_balances(spec, state, balances, new_balances)
+
+
+def _altair_participation(spec, state, cols, flag_index, previous_epoch,
+                          active_prev):
+    """``get_unslashed_participating_indices`` as a mask (prev epoch)."""
+    flags = np.fromiter(
+        sequence_items(state.previous_epoch_participation),
+        dtype=np.uint8, count=len(cols))
+    has_flag = (flags >> np.uint8(flag_index)) & np.uint8(1) == np.uint8(1)
+    return active_prev & has_flag & ~cols["sl"]
+
+
+def _altair_rewards_and_penalties(spec, state) -> None:
+    """altair+ flag deltas + inactivity deltas, applied pairwise in spec
+    order (each pair's decrease clamps at zero before the next applies)."""
+    xp = np
+    cols = validator_columns(state)
+    n = len(cols)
+    if n == 0:
+        return
+    eff = cols["eff"]
+    prev_epoch = int(spec.get_previous_epoch(state))
+    active_prev, eligible = _epoch_masks(spec, cols, prev_epoch)
+    total_balance = _total_active_balance(spec, cols,
+                                          int(spec.get_current_epoch(state)))
+    _guard(total_balance)
+    increment = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    active_increments = total_balance // increment
+    in_leak = bool(spec.is_in_inactivity_leak(state))
+    weight_denominator = int(spec.WEIGHT_DENOMINATOR)
+    brpi = increment * int(spec.BASE_REWARD_FACTOR) \
+        // math.isqrt(total_balance)
+    max_eff = int(eff.max(initial=0))
+    _guard((max_eff // increment) * brpi)
+    base_reward = (eff // np.uint64(increment)) * np.uint64(brpi)
+    br_max = (max_eff // increment) * brpi
+
+    head_flag = int(spec.TIMELY_HEAD_FLAG_INDEX)
+    target_flag = int(spec.TIMELY_TARGET_FLAG_INDEX)
+    delta_pairs = []
+    target_participating = None
+    for flag_index, weight in enumerate(spec.PARTICIPATION_FLAG_WEIGHTS):
+        participating = _altair_participation(
+            spec, state, cols, flag_index, prev_epoch, active_prev)
+        if flag_index == target_flag:
+            target_participating = participating
+        up_balance = max(increment, _masked_sum(eff, participating))
+        up_increments = up_balance // increment
+        _guard(br_max * int(weight) * up_increments)
+        delta_pairs.append(flag_deltas_kernel(
+            xp, base_reward, eligible, participating,
+            weight=int(weight), weight_denominator=weight_denominator,
+            participating_increments=up_increments,
+            active_increments=active_increments, in_leak=in_leak,
+            is_head_flag=flag_index == head_flag))
+
+    quotient = (int(spec.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX)
+                if "bellatrix" in _fork_lineage(spec)
+                else int(spec.INACTIVITY_PENALTY_QUOTIENT_ALTAIR))
+    scores = u64_column(state.inactivity_scores)
+    _guard(max_eff * int(scores.max(initial=0)))
+    inactivity_penalties = inactivity_penalty_kernel(
+        xp, eff, scores, eligible, target_participating,
+        denominator=int(spec.config.INACTIVITY_SCORE_BIAS) * quotient)
+    delta_pairs.append((np.zeros(n, dtype=np.uint64), inactivity_penalties))
+
+    balances = u64_column(state.balances)
+    old = balances
+    max_bal = int(balances.max(initial=0))
+    for rewards, penalties in delta_pairs:
+        _guard(max_bal + int(rewards.max(initial=0)))
+        balances = apply_deltas_kernel(xp, balances, rewards, penalties)
+        max_bal = int(balances.max(initial=0))
+    _commit_balances(spec, state, old, balances)
+
+
+# ---------------------------------------------------------------------------
+# process_inactivity_updates (altair+)
+# ---------------------------------------------------------------------------
+
+def try_process_inactivity_updates(spec, state) -> bool:
+    if not enabled():
+        return False
+    if spec.get_current_epoch(state) == spec.GENESIS_EPOCH:
+        return False    # spec body no-ops
+    if "altair" not in _fork_lineage(spec):
+        return False
+    try:
+        cols = validator_columns(state)
+        if len(cols) == 0:
+            return False
+        prev_epoch = int(spec.get_previous_epoch(state))
+        active_prev, eligible = _epoch_masks(spec, cols, prev_epoch)
+        participating = _altair_participation(
+            spec, state, cols, int(spec.TIMELY_TARGET_FLAG_INDEX),
+            prev_epoch, active_prev)
+        scores = u64_column(state.inactivity_scores)
+        bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+        _guard(int(scores.max(initial=0)) + bias)
+        new_scores = inactivity_updates_kernel(
+            np, scores, eligible, participating, bias=bias,
+            recovery_rate=int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE),
+            in_leak=bool(spec.is_in_inactivity_leak(state)))
+        _write_u64_list(state.inactivity_scores, spec.uint64,
+                        scores, new_scores)
+    except _Fallback:
+        _stats["fallback"] += 1
+        return False
+    _stats["vectorized"] += 1
+    return True
+
+
+# ---------------------------------------------------------------------------
+# process_registry_updates
+# ---------------------------------------------------------------------------
+
+def try_process_registry_updates(spec, state) -> bool:
+    if not enabled():
+        return False
+    try:
+        _registry_updates(spec, state)
+    except _Fallback:
+        _stats["fallback"] += 1
+        return False
+    _stats["vectorized"] += 1
+    return True
+
+
+def _registry_updates(spec, state) -> None:
+    """Eligibility scans and the activation-queue sort as array ops; the
+    per-ejection exit-queue recurrence (a running max + churn counter) is
+    simulated incrementally instead of re-scanning the registry per exit."""
+    # private copy: the cached snapshot under the pre-state root stays
+    # pristine while this function mutates epoch fields through the views
+    cols = validator_columns(state).copy()
+    n = len(cols)
+    if n == 0:
+        return
+    validators = sequence_items(state.validators)
+    current_epoch = int(spec.get_current_epoch(state))
+    far_future = int(spec.FAR_FUTURE_EPOCH)
+    max_eb = int(spec.MAX_EFFECTIVE_BALANCE)
+
+    aee = cols["aee"]
+    ext = cols["ext"]
+    wd = cols["wd"]
+
+    # activation-queue eligibility stamps (is_eligible_for_activation_queue)
+    queue_mask = (aee == np.uint64(far_future)) & (cols["eff"] == np.uint64(max_eb))
+    stamp = current_epoch + 1
+    for i in np.nonzero(queue_mask)[0].tolist():
+        validators[i].activation_eligibility_epoch = stamp
+    aee[queue_mask] = np.uint64(stamp)
+
+    # ejections: initiate_validator_exit per index, in index order.  The
+    # churn limit is constant across the loop (assigned exit epochs are
+    # all in the future, so current-epoch activity never changes).
+    cur = np.uint64(current_epoch)
+    active_cur = (cols["act"] <= cur) & (cur < cols["ext"])
+    churn = max(int(spec.config.MIN_PER_EPOCH_CHURN_LIMIT),
+                int(active_cur.sum()) // int(spec.config.CHURN_LIMIT_QUOTIENT))
+    eject = np.nonzero(active_cur
+                       & (cols["eff"] <= np.uint64(
+                           int(spec.config.EJECTION_BALANCE))))[0]
+    if eject.size:
+        exited = ext[ext != np.uint64(far_future)]
+        queue_epoch = current_epoch + 1 + int(spec.MAX_SEED_LOOKAHEAD)
+        if exited.size:
+            queue_epoch = max(queue_epoch, int(exited.max()))
+        queue_churn = int((ext == np.uint64(queue_epoch)).sum())
+        delay = int(spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)
+        _guard(queue_epoch + eject.size + delay)
+        for i in eject.tolist():
+            if int(ext[i]) != far_future:
+                continue
+            if queue_churn >= churn:
+                queue_epoch += 1
+                queue_churn = 0
+            queue_churn += 1
+            ext[i] = np.uint64(queue_epoch)
+            wd[i] = np.uint64(queue_epoch + delay)
+            validators[i].exit_epoch = queue_epoch
+            validators[i].withdrawable_epoch = queue_epoch + delay
+
+    # activations: sort eligibles by (activation_eligibility_epoch, index),
+    # dequeue up to the (fork-dependent) activation churn limit
+    finalized = int(state.finalized_checkpoint.epoch)
+    eligible = (aee <= np.uint64(finalized)) \
+        & (cols["act"] == np.uint64(far_future))
+    idx = np.nonzero(eligible)[0]
+    if idx.size:
+        order = np.lexsort((idx, aee[idx]))
+        activation_churn = churn
+        if "deneb" in _fork_lineage(spec):
+            activation_churn = min(
+                int(spec.MAX_PER_EPOCH_ACTIVATION_CHURN_LIMIT), churn)
+        activation_epoch = current_epoch + 1 + int(spec.MAX_SEED_LOOKAHEAD)
+        for i in idx[order][:activation_churn].tolist():
+            validators[i].activation_epoch = activation_epoch
+            cols["act"][i] = np.uint64(activation_epoch)
+
+    _recache_columns(state, cols)
+
+
+# ---------------------------------------------------------------------------
+# process_slashings
+# ---------------------------------------------------------------------------
+
+def try_process_slashings(spec, state) -> bool:
+    if not enabled():
+        return False
+    try:
+        lineage = _fork_lineage(spec)
+        if "bellatrix" in lineage:
+            multiplier = spec.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX
+        elif "altair" in lineage:
+            multiplier = spec.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR
+        else:
+            multiplier = spec.PROPORTIONAL_SLASHING_MULTIPLIER
+        _slashings(spec, state, int(multiplier))
+    except _Fallback:
+        _stats["fallback"] += 1
+        return False
+    _stats["vectorized"] += 1
+    return True
+
+
+def _slashings(spec, state, multiplier) -> None:
+    cols = validator_columns(state)
+    if len(cols) == 0:
+        return
+    epoch = int(spec.get_current_epoch(state))
+    total_balance = _total_active_balance(spec, cols, epoch)
+    _guard(total_balance)
+    slashed_sum = sum(int(s) for s in sequence_items(state.slashings))
+    adjusted = min(slashed_sum * multiplier, total_balance)
+    increment = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    target_epoch = epoch + int(spec.EPOCHS_PER_SLASHINGS_VECTOR) // 2
+    _guard(target_epoch)
+    target = cols["sl"] & (cols["wd"] == np.uint64(target_epoch))
+    if not target.any():
+        return
+    _guard((int(cols["eff"].max(initial=0)) // increment) * adjusted)
+    penalties = slashing_penalty_kernel(
+        np, cols["eff"], target, increment=increment,
+        adjusted_total_slashing_balance=adjusted, total_balance=total_balance)
+    balances = u64_column(state.balances)
+    new_balances = np.where(penalties > balances, np.uint64(0),
+                            balances - penalties)
+    _commit_balances(spec, state, balances, new_balances)
+
+
+# ---------------------------------------------------------------------------
+# process_effective_balance_updates
+# ---------------------------------------------------------------------------
+
+def try_process_effective_balance_updates(spec, state) -> bool:
+    if not enabled():
+        return False
+    try:
+        _effective_balance_updates(spec, state)
+    except _Fallback:
+        _stats["fallback"] += 1
+        return False
+    _stats["vectorized"] += 1
+    return True
+
+
+def _effective_balance_updates(spec, state) -> None:
+    cols = validator_columns(state)
+    if len(cols) == 0:
+        return
+    increment = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    hysteresis_increment = increment // int(spec.HYSTERESIS_QUOTIENT)
+    down = hysteresis_increment * int(spec.HYSTERESIS_DOWNWARD_MULTIPLIER)
+    up = hysteresis_increment * int(spec.HYSTERESIS_UPWARD_MULTIPLIER)
+    balances = u64_column(state.balances)
+    eff = cols["eff"]
+    _guard(int(balances.max(initial=0)) + down, int(eff.max(initial=0)) + up)
+    new_eff = effective_balance_kernel(
+        np, balances, eff, increment=increment, downward_threshold=down,
+        upward_threshold=up,
+        max_effective_balance=int(spec.MAX_EFFECTIVE_BALANCE))
+    changed = np.nonzero(eff != new_eff)[0]
+    if changed.size == 0:
+        return
+    validators = sequence_items(state.validators)
+    for i in changed.tolist():
+        validators[i].effective_balance = int(new_eff[i])
+    new_cols = cols.copy()   # cached pre-state snapshot stays pristine
+    new_cols["eff"] = new_eff
+    _recache_columns(state, new_cols)
+
+
+# ---------------------------------------------------------------------------
+# Compiled-ladder routing
+# ---------------------------------------------------------------------------
+
+_TRY_BY_NAME = {
+    "process_rewards_and_penalties": try_process_rewards_and_penalties,
+    "process_inactivity_updates": try_process_inactivity_updates,
+    "process_registry_updates": try_process_registry_updates,
+    "process_slashings": try_process_slashings,
+    "process_effective_balance_updates": try_process_effective_balance_updates,
+}
+
+
+def install_vectorized_epoch(cls) -> None:
+    """Wrap a spec class's own epoch methods with the vectorized
+    dispatch.  Used for the markdown-compiled ladder, whose method bodies
+    are emitted verbatim from the spec text and therefore cannot carry
+    the hand-written ladder's inline ``try_process_*`` calls.  Only
+    methods defined on ``cls`` itself are wrapped (inherited ones are
+    already wrapped on the base class), and wrapping is idempotent."""
+    import functools
+    for name, try_fn in _TRY_BY_NAME.items():
+        fn = cls.__dict__.get(name)
+        if fn is None or getattr(fn, "_vectorized_epoch_wrapper", False):
+            continue
+
+        def _make(orig, tfn):
+            @functools.wraps(orig)
+            def wrapper(self, state):
+                if tfn(self, state):
+                    return None
+                return orig(self, state)
+            wrapper._vectorized_epoch_wrapper = True
+            return wrapper
+
+        setattr(cls, name, _make(fn, try_fn))

@@ -20,6 +20,7 @@ seconds, cached as ``csrc/libcbls12381.so``); set
 import ctypes
 import os
 import subprocess
+import tempfile
 from typing import Optional, Sequence
 
 from consensus_specs_tpu.ops.bls12_381.fields import R_ORDER
@@ -31,16 +32,40 @@ _SRC = os.path.join(_CSRC, "bls12_381.c")
 
 
 def _build() -> bool:
+    # compile to a per-process temp name: concurrent builders (parallel
+    # pytest/make) each write their own file, and os.replace atomically
+    # publishes a COMPLETE library — never interleaved gcc output
+    tmp = None
     try:
+        fd, tmp = tempfile.mkstemp(prefix="libcbls12381.", suffix=".so.tmp",
+                                   dir=_CSRC)
+        os.close(fd)
         res = subprocess.run(
-            ["gcc", "-O2", "-shared", "-fPIC", "-o", _SO + ".tmp", _SRC],
+            ["gcc", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC],
             capture_output=True, timeout=120, cwd=_CSRC)
         if res.returncode != 0:
             return False
-        os.replace(_SO + ".tmp", _SO)
+        os.replace(tmp, _SO)
+        tmp = None
         return True
     except Exception:
         return False
+    finally:
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def _discard_corrupt() -> None:
+    """Drop a library that failed to load or self-test: leaving it on
+    disk would disable the backend on every future import (the staleness
+    check sees a fresh .so and never rebuilds)."""
+    try:
+        os.unlink(_SO)
+    except OSError:
+        pass
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -59,6 +84,7 @@ def _load() -> Optional[ctypes.CDLL]:
     try:
         lib = ctypes.CDLL(_SO)
     except OSError:
+        _discard_corrupt()
         return None
     u8p, sz = ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t
     protos = {
@@ -89,8 +115,10 @@ def _load() -> Optional[ctypes.CDLL]:
             fn.argtypes = argtypes
             fn.restype = ctypes.c_int
         if lib.cbls_selftest() != 1:
+            _discard_corrupt()
             return None
     except AttributeError:
+        _discard_corrupt()
         return None
     del u8p
     return lib

@@ -168,10 +168,14 @@ def sharded_g1_msm(points, scalars, devices, cache_key=None):
     assert len(points) == len(scalars)
     if not points:
         return G1Point.inf()
+    from consensus_specs_tpu.ops.bls12_381.fields import R_ORDER
     devices = tuple(devices)
     n_dev = len(devices)
     pts = list(points)
-    sc = [int(s) for s in scalars]
+    # canonical reduction before digit extraction (matches g1_lincomb):
+    # _digits_msb_bits reads 256 two's-complement bits, so a negative or
+    # >= 2**256 scalar would otherwise yield a silently wrong MSM
+    sc = [int(s) % R_ORDER for s in scalars]
     pad = (-len(pts)) % n_dev
     pts += [G1Point.inf()] * pad
     sc += [0] * pad

@@ -68,7 +68,8 @@ def get_valid_attestation(spec, state, slot=None, index=None,
         aggregation_bits=Bitlist[spec.MAX_VALIDATORS_PER_COMMITTEE]([0] * committee_size),
         data=attestation_data,
     )
-    # fill the attestation with (optionally filtered) participants, and optionally sign it
+    # set the committee's participation bits (subject to the caller's
+    # filter), then sign unless the test wants an unsigned aggregate
     fill_aggregate_attestation(
         spec, state, attestation, signed=signed,
         filter_participant_set=filter_participant_set)

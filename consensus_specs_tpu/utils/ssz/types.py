@@ -1085,3 +1085,45 @@ class Union(UnionBase, metaclass=_ParamMeta):
                      (UnionBase,), {"options": tuple(params)})
             _union_cache[key] = t
         return t
+
+
+# ---------------------------------------------------------------------------
+# columnar access (ops/epoch_kernels.py: struct-of-arrays epoch engine)
+# ---------------------------------------------------------------------------
+
+def sequence_items(seq):
+    """The backing element list of a List/Vector — a zero-copy view for
+    columnar extraction (``np.fromiter`` over a registry-sized sequence
+    instead of len(seq) ``__getitem__`` calls).  Read-only contract:
+    callers must never mutate the returned list or its slots; all writes
+    go through the sequence API (or :func:`replace_basic_items`) so dirty
+    tracking stays exact."""
+    if not isinstance(seq, _SequenceBase):
+        raise TypeError(f"sequence_items: want List/Vector, got {type(seq)}")
+    return seq._items
+
+
+def replace_basic_items(seq, items) -> None:
+    """Bulk-swap every element of a basic-element List/Vector.
+
+    ``items`` must be a list of already-coerced ``elem_type`` instances
+    (the epoch engine builds them straight from validated uint64 numpy
+    columns); per-element ``coerce``+dirty-marking — the O(n) python cost
+    a registry-wide ``seq[i] = v`` loop pays — is skipped wholesale.  The
+    cached chunk tree is dropped, so the next root is a fresh chunk-level
+    merkleization: the same hashing bill the incremental path pays when
+    every chunk is dirty, without the python-level bookkeeping.
+    """
+    et = type(seq).elem_type
+    if not issubclass(et, BasicValue):
+        raise TypeError("replace_basic_items: basic element types only")
+    limit = getattr(type(seq), "limit", 0)
+    length = getattr(type(seq), "length", 0)
+    if length and len(items) != length:
+        raise ValueError(f"{type(seq).__name__}: need {length} elements")
+    if limit and len(items) > limit:
+        raise ValueError(f"{type(seq).__name__}: {len(items)} exceeds limit")
+    if items and not (isinstance(items[0], et) and isinstance(items[-1], et)):
+        raise TypeError(f"replace_basic_items: want {et.__name__} elements")
+    object.__setattr__(seq, "_items", list(items))
+    seq._drop_tree()

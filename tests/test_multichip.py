@@ -117,11 +117,16 @@ def test_sharded_msm_matches_host():
     from consensus_specs_tpu.parallel.sharded_verify import sharded_g1_msm
     from consensus_specs_tpu.ops.bls12_381.curve import G1_GENERATOR, G1Point
 
+    from consensus_specs_tpu.ops.bls12_381.fields import R_ORDER
+
     pts = [G1_GENERATOR.mult(k) for k in (1, 3, 7, 11, 13, 17, 19, 23)]
-    scalars = [5, 9, 2, 31, 1, 8, 27, 4]
+    # non-canonical scalars ride along: a negative and a >= 2**256 value
+    # must be reduced mod the group order before digit extraction
+    # (regression: unreduced two's-complement bits gave a wrong MSM)
+    scalars = [5, -9, 2**256 + 2, 31, R_ORDER + 1, 8, 27, 4]
     expect = G1Point.inf()
     for p, s in zip(pts, scalars):
-        expect = expect + p.mult(s)
+        expect = expect + p.mult(s % R_ORDER)
     got = sharded_g1_msm(pts, scalars, jax.devices()[:4])
     assert got == expect
 
