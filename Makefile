@@ -7,6 +7,7 @@ GENERATORS = operations sanity finality rewards random forks epoch_processing \
              fork_choice merkle_proof ssz_generic sync transition
 
 .PHONY: test citest test-crypto bench bench-all dryrun warm native lint \
+        speclint-baseline \
         generate_tests $(addprefix gen_,$(GENERATORS)) clean-vectors pyspec
 
 # fast local suite: signature checks off except @always_bls
@@ -23,12 +24,23 @@ citest:
 	-$(MAKE) native
 	$(PYTHON) -m pytest tests/ -q --enable-bls --bls-type fastest
 
-# static checks: syntax gate + stdlib AST lint (unused imports, bare
-# except, mutable defaults) — role of the reference `make lint`
-# (Makefile:153-158, flake8+mypy; neither ships in this image)
+# static checks: syntax gate + the speclint multi-pass analyzer
+# (style, uint64-hazard, jax-tracing, ladder-drift, spec-markdown) in
+# one process — role of the reference `make lint` (Makefile:153-158,
+# flake8+mypy; neither ships in this image).  Exits 0 modulo the
+# checked-in ratchet file speclint_baseline.json.  The compiled ladder
+# is generated (gitignored): build it if absent so fresh clones lint
+# out of the box, but never overwrite an existing tree (a drifted or
+# hand-edited one must stay visible to the L3xx pass).
 lint:
 	$(PYTHON) -m compileall -q consensus_specs_tpu tests generators benchmarks
-	$(PYTHON) -m consensus_specs_tpu.tools.lint .
+	@test -d consensus_specs_tpu/forks/compiled || $(MAKE) pyspec
+	$(PYTHON) -m consensus_specs_tpu.tools.speclint .
+
+# intentionally re-record the speclint debt (after paying some down, or
+# with a written justification for new findings in the PR)
+speclint-baseline:
+	$(PYTHON) -m consensus_specs_tpu.tools.speclint . --write-baseline
 
 # crypto kernels incl. the heavy differential tier — one pytest
 # process per file: the big XLA programs (pairing, sharded verify,

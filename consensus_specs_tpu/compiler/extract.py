@@ -25,6 +25,11 @@ class SpecDocument:
     # level (Store/LatestMessage dataclasses, module helper functions)
     # instead of inside the spec class body
     module_blocks: List[str] = field(default_factory=list)
+    # 1-based markdown line of each block's first content line (parallel
+    # to code_blocks/module_blocks) — diagnostics anchor for speclint's
+    # spec-markdown pass
+    code_block_lines: List[int] = field(default_factory=list)
+    module_block_lines: List[int] = field(default_factory=list)
 
     def functions(self) -> Dict[str, str]:
         """name -> source for every top-level def in the code blocks."""
@@ -48,12 +53,15 @@ def parse_markdown_spec(text: str) -> SpecDocument:
     in_block = False
     module_scope = False
     block_lines: List[str] = []
+    block_start = fence_line = 0
     while i < len(lines):
         line = lines[i]
         if in_block:
             if _FENCE_END_RE.match(line):
                 dest = doc.module_blocks if module_scope else doc.code_blocks
                 dest.append("\n".join(block_lines))
+                (doc.module_block_lines if module_scope
+                 else doc.code_block_lines).append(block_start)
                 block_lines = []
                 in_block = False
                 module_scope = False
@@ -61,6 +69,8 @@ def parse_markdown_spec(text: str) -> SpecDocument:
                 block_lines.append(line)
         elif _FENCE_RE.match(line):
             in_block = True
+            fence_line = i + 1
+            block_start = i + 2
         else:
             meta = _META_RE.match(line.strip())
             if meta:
@@ -83,7 +93,10 @@ def parse_markdown_spec(text: str) -> SpecDocument:
                         doc.constants[name] = value
         i += 1
     if in_block:
-        raise ValueError("unterminated python fence")
+        err = ValueError(
+            f"unterminated python fence (opened at line {fence_line})")
+        err.fence_line = fence_line     # structured anchor for speclint
+        raise err
     return doc
 
 

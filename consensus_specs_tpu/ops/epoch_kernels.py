@@ -196,6 +196,7 @@ def apply_deltas_kernel(xp, balances, rewards, penalties):
     return xp.where(penalties > up, xp.uint64(0), up - penalties)
 
 
+# speclint: guarded-by-caller (try_process_* bounds every product < 2**64)
 def flag_deltas_kernel(xp, base_reward, eligible, participating, *,
                        weight, weight_denominator, participating_increments,
                        active_increments, in_leak, is_head_flag):
@@ -211,6 +212,7 @@ def flag_deltas_kernel(xp, base_reward, eligible, participating, *,
     return rewards, penalties
 
 
+# speclint: guarded-by-caller (try_process_* bounds eff * scores < 2**64)
 def inactivity_penalty_kernel(xp, eff, scores, eligible, target_participating,
                               *, denominator):
     """altair+ ``get_inactivity_penalty_deltas`` (score-scaled)."""
@@ -230,6 +232,7 @@ def inactivity_updates_kernel(xp, scores, eligible, participating, *,
     return xp.where(eligible, bumped, scores)
 
 
+# speclint: guarded-by-caller (br_max * att_increments bounded < 2**64)
 def phase0_component_kernel(xp, base_reward, eligible, attesting, *,
                             in_leak, attesting_increments, total_increments):
     """phase0 ``get_attestation_component_deltas`` (source/target/head)."""
@@ -245,6 +248,7 @@ def phase0_component_kernel(xp, base_reward, eligible, attesting, *,
     return rewards, penalties
 
 
+# speclint: guarded-by-caller (base_pen + extra bounded together < 2**64)
 def phase0_inactivity_kernel(xp, base_reward, eff, eligible,
                              target_attesting, *, base_rewards_per_epoch,
                              proposer_reward_quotient, finality_delay,
@@ -252,7 +256,9 @@ def phase0_inactivity_kernel(xp, base_reward, eff, eligible,
     """phase0 ``get_inactivity_penalty_deltas`` (leak epochs only)."""
     zero = xp.uint64(0)
     proposer_reward = base_reward // xp.uint64(proposer_reward_quotient)
-    base_pen = xp.uint64(base_rewards_per_epoch) * base_reward - proposer_reward
+    # proposer_reward <= base_reward <= brpe * base_reward: cannot wrap
+    base_pen = (xp.uint64(base_rewards_per_epoch) * base_reward  # noqa: U101
+                - proposer_reward)
     extra = (eff * xp.uint64(finality_delay)) \
         // xp.uint64(inactivity_penalty_quotient)
     pen = base_pen + xp.where(target_attesting, zero, extra)
@@ -270,6 +276,7 @@ def effective_balance_kernel(xp, balances, eff, *, increment,
     return xp.where(crossed, capped, eff)
 
 
+# speclint: guarded-by-caller ((eff // increment) * adjusted bounded < 2**64)
 def slashing_penalty_kernel(xp, eff, target, *, increment,
                             adjusted_total_slashing_balance, total_balance):
     """``process_slashings`` penalty column (spec's truncation order:
@@ -428,7 +435,9 @@ def _phase0_rewards_and_penalties(spec, state) -> None:
     incl_rewards = np.zeros(n, dtype=np.uint64)
     src_idx = np.nonzero(src_mask)[0]
     if src_idx.size:
-        max_attester = base_reward[src_idx] - proposer_reward[src_idx]
+        # proposer_reward = base_reward // PRQ <= base_reward: cannot wrap
+        max_attester = (base_reward[src_idx]  # noqa: U101
+                        - proposer_reward[src_idx])
         incl_rewards[src_idx] = max_attester // best_delay[src_idx]
         # every attester's proposer cut could land on ONE proposer index
         _guard(br_max + src_idx.size * (br_max // prq))
@@ -622,8 +631,11 @@ def _registry_updates(spec, state) -> None:
     # all in the future, so current-epoch activity never changes).
     cur = np.uint64(current_epoch)
     active_cur = (cols["act"] <= cur) & (cur < cols["ext"])
+    # explicit accumulator: a bool .sum() uses the platform default int,
+    # which is 32-bit on some hosts — silently wrong above 2**31 lanes
     churn = max(int(spec.config.MIN_PER_EPOCH_CHURN_LIMIT),
-                int(active_cur.sum()) // int(spec.config.CHURN_LIMIT_QUOTIENT))
+                int(active_cur.sum(dtype=np.int64))
+                // int(spec.config.CHURN_LIMIT_QUOTIENT))
     eject = np.nonzero(active_cur
                        & (cols["eff"] <= np.uint64(
                            int(spec.config.EJECTION_BALANCE))))[0]
@@ -632,7 +644,7 @@ def _registry_updates(spec, state) -> None:
         queue_epoch = current_epoch + 1 + int(spec.MAX_SEED_LOOKAHEAD)
         if exited.size:
             queue_epoch = max(queue_epoch, int(exited.max()))
-        queue_churn = int((ext == np.uint64(queue_epoch)).sum())
+        queue_churn = int((ext == np.uint64(queue_epoch)).sum(dtype=np.int64))
         delay = int(spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)
         _guard(queue_epoch + eject.size + delay)
         for i in eject.tolist():

@@ -90,7 +90,7 @@ def _kogge_stone(g, p, n):
     out[i] = g[i] | (p[i] & g[i-1]) | (p[i] & p[i-1] & g[i-2]) | ... -
     the carry (or borrow) out of position i.  Unrolled log2 depth."""
     d = 1
-    while d < n:
+    while d < n:  # noqa: J203 (static log2-depth unroll: n is a python int)
         g = g | (p & _shift_limbs(g, d))
         p = p & _shift_limbs(p, d)
         d *= 2

@@ -29,7 +29,7 @@ N_WINDOWS = 32  # ceil(255 / 8)
 
 
 def _double_k_times(p, k):
-    for _ in range(k):
+    for _ in range(k):  # noqa: J203 (static unroll: k is a trace-time int)
         p = PT.g1_add(p, p)
     return p
 

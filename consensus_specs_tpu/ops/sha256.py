@@ -137,7 +137,7 @@ def sha256_blocks(blocks, num_blocks: int):
     ``expand_message_xmd`` (hash-to-curve kernel).
     """
     state = jnp.broadcast_to(jnp.asarray(_H0), blocks.shape[:-2] + (8,))
-    for i in range(num_blocks):
+    for i in range(num_blocks):  # noqa: J203 (static unroll per block count)
         state = _compress(state, blocks[..., i, :])
     return state
 

@@ -34,7 +34,7 @@ def _gather_and_combine(part, axis_name: str, n_shards: int):
     gathered = jax.tree_util.tree_map(
         lambda a: jax.lax.all_gather(a, axis_name), part)
     total = jax.tree_util.tree_map(lambda a: a[0], gathered)
-    for i in range(1, n_shards):
+    for i in range(1, n_shards):  # noqa: J203 (static unroll: mesh size)
         total = PT.g1_add(
             total, jax.tree_util.tree_map(lambda a, i=i: a[i], gathered))
     return total

@@ -1,27 +1,20 @@
-"""Stdlib lint tier (role of the reference's ``make lint``,
-reference Makefile:153-158: flake8 + mypy over pyspec and generators).
+"""Migration shim: the stdlib lint tier grew into the multi-pass
+``tools/speclint`` subsystem (uint64-hazard, jax-tracing, ladder-drift,
+spec-markdown + this module's original style checks — see
+``docs/static-analysis.md``).
 
-The build image ships no external linters, so this implements the
-high-signal subset with ``ast`` alone:
-
-* syntax gate (``compile``) over every tracked python file,
-* unused module-level imports (honouring ``# noqa`` and re-export
-  ``__init__`` conventions),
-* accidental tab indentation and trailing whitespace,
-* ``except:`` bare handlers,
-* mutable default arguments (list/dict/set literals).
-
-Exit 1 on any finding; print file:line: messages flake8-style.
+``python -m consensus_specs_tpu.tools.lint`` keeps working as an alias
+for the full speclint driver so the Makefile and local muscle memory
+don't break, and ``lint_file``/``iter_py_files`` keep their historical
+signatures for any importers.
 """
-import ast
 import os
 import sys
 
-SKIP_DIRS = {".git", ".jax_cache", "__pycache__", "build",
-             "consensus-spec-tests"}
-# compiled modules are generated (make pyspec); star-import surfaces make
-# unused-import analysis meaningless there
-GENERATED_MARK = "AUTO-COMPILED from specs/"
+from consensus_specs_tpu.tools.speclint.driver import main  # noqa: F401
+from consensus_specs_tpu.tools.speclint.driver import SKIP_DIRS
+from consensus_specs_tpu.tools.speclint.passes.style import (  # noqa: F401
+    lint_file)
 
 
 def iter_py_files(root):
@@ -30,99 +23,6 @@ def iter_py_files(root):
         for fn in filenames:
             if fn.endswith(".py"):
                 yield os.path.join(dirpath, fn)
-
-
-class ImportCollector(ast.NodeVisitor):
-    def __init__(self):
-        self.imports = {}   # name -> (lineno, stated)
-        self.used = set()
-
-    def visit_Import(self, node):
-        for alias in node.names:
-            name = alias.asname or alias.name.split(".")[0]
-            self.imports[name] = (node.lineno, node.end_lineno, alias.name)
-
-    def visit_ImportFrom(self, node):
-        for alias in node.names:
-            if alias.name == "*":
-                continue
-            name = alias.asname or alias.name
-            self.imports[name] = (node.lineno, node.end_lineno, alias.name)
-
-    def visit_Name(self, node):
-        self.used.add(node.id)
-
-    def visit_Attribute(self, node):
-        self.generic_visit(node)
-
-
-def lint_file(path):
-    findings = []
-    with open(path, "rb") as f:
-        raw = f.read()
-    text = raw.decode("utf-8", errors="replace")
-    try:
-        tree = ast.parse(text, filename=path)
-    except SyntaxError as e:
-        return [(path, e.lineno or 0, f"E999 syntax error: {e.msg}")]
-
-    lines = text.split("\n")
-    noqa = {i + 1 for i, ln in enumerate(lines) if "# noqa" in ln}
-    for i, ln in enumerate(lines, 1):
-        if ln.rstrip("\n") != ln.rstrip():
-            findings.append((path, i, "W291 trailing whitespace"))
-        if ln.startswith("\t"):
-            findings.append((path, i, "W191 tab indentation"))
-
-    is_reexport = os.path.basename(path) == "__init__.py"
-    is_generated = GENERATED_MARK in text[:400]
-    if not (is_reexport or is_generated):
-        col = ImportCollector()
-        col.visit(tree)
-        # names can also be referenced from docstring doctests or __all__
-        exported = set()
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Assign):
-                for t in node.targets:
-                    if isinstance(t, ast.Name) and t.id == "__all__":
-                        try:
-                            exported |= set(ast.literal_eval(node.value))
-                        except Exception:
-                            pass
-        for name, (lineno, end_lineno, stated) in sorted(col.imports.items()):
-            if name in col.used or name in exported \
-                    or noqa & set(range(lineno, end_lineno + 1)):
-                continue
-            findings.append(
-                (path, lineno, f"F401 '{stated}' imported but unused"))
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and node.type is None \
-                and node.lineno not in noqa:
-            findings.append((path, node.lineno, "E722 bare except"))
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for default in node.args.defaults + node.args.kw_defaults:
-                if isinstance(default, (ast.List, ast.Dict, ast.Set)) \
-                        and default.lineno not in noqa:
-                    findings.append(
-                        (path, default.lineno,
-                         "B006 mutable default argument"))
-    return findings
-
-
-def main(argv=None):
-    root = (argv or sys.argv[1:] or ["."])[0]
-    total = 0
-    for path in sorted(iter_py_files(root)):
-        for fpath, lineno, msg in lint_file(path):
-            rel = os.path.relpath(fpath, root)
-            print(f"{rel}:{lineno}: {msg}")
-            total += 1
-    if total:
-        print(f"{total} finding(s)")
-        return 1
-    print("lint: clean")
-    return 0
 
 
 if __name__ == "__main__":

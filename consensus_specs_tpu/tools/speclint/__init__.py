@@ -1,0 +1,6 @@
+"""speclint: domain-aware multi-pass static analysis for this repo
+(role of the reference's ``make lint`` flake8+mypy tier, Makefile
+:153-158, specialized to the three bug classes this codebase actually
+produces — see ``docs/static-analysis.md``)."""
+from .driver import Context, main, run_passes  # noqa: F401
+from .findings import Finding  # noqa: F401

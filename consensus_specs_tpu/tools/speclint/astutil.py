@@ -1,0 +1,21 @@
+"""Small helpers shared by the speclint passes."""
+import ast
+
+# compiled modules are generated (make pyspec); one sentinel shared by
+# the style pass (skip unused-import analysis under star-import
+# surfaces) and the ladder pass (L303 provenance check) so the two
+# cannot drift apart if the emitter's header changes
+AUTO_COMPILED_MARK = "AUTO-COMPILED from specs/"
+
+
+def is_generated(text: str) -> bool:
+    return AUTO_COMPILED_MARK in text[:400]
+
+
+def terminal_name(node):
+    """`np.uint64` -> 'uint64', `uint64` -> 'uint64', else None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None

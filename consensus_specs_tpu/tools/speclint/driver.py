@@ -1,0 +1,220 @@
+"""speclint driver: file discovery, pass orchestration, ``# noqa``
+filtering, the baseline ratchet, and output formatting.
+
+Usage (one process, all passes)::
+
+    python -m consensus_specs_tpu.tools.speclint [root]
+        [--passes style,uint64,tracing,ladder,specmd]
+        [--format text|github] [--baseline PATH]
+        [--write-baseline] [--no-baseline]
+
+Baseline ratchet: ``speclint_baseline.json`` (checked in at the repo
+root) records per ``path::CODE`` finding counts.  A run fails only when
+a count *grows* — pre-existing debt is visible but non-blocking, and
+new debt cannot land.  Shrink the debt, then ``make speclint-baseline``
+to ratchet the file down (a stale baseline is reported as a note).
+"""
+import argparse
+import ast
+import json
+import os
+from collections import Counter
+
+from .findings import suppressed
+from .passes import ALL_PASSES
+
+SKIP_DIRS = {".git", ".jax_cache", "__pycache__", "build", ".pytest_cache",
+             "consensus-spec-tests", "node_modules", ".claude"}
+BASELINE_NAME = "speclint_baseline.json"
+
+
+class Context:
+    """Shared per-run state handed to every pass: the scan root, the
+    discovered python files, and a parse cache (each file is read and
+    AST-parsed at most once across all passes)."""
+
+    def __init__(self, root):
+        self.root = os.path.abspath(root)
+        self._sources = {}
+        self._trees = {}
+        self.py_files = self._discover()
+
+    def _discover(self):
+        out = []
+        for dirpath, dirnames, filenames in os.walk(self.root):
+            dirnames[:] = sorted(d for d in dirnames if d not in SKIP_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    rel = os.path.relpath(os.path.join(dirpath, fn),
+                                          self.root).replace(os.sep, "/")
+                    out.append(rel)
+        return out
+
+    def source(self, rel: str) -> str:
+        text = self._sources.get(rel)
+        if text is None:
+            with open(os.path.join(self.root, rel), "rb") as f:
+                text = f.read().decode("utf-8", errors="replace")
+            self._sources[rel] = text
+        return text
+
+    def _parse(self, rel):
+        if rel not in self._trees:
+            try:
+                self._trees[rel] = ast.parse(self.source(rel), filename=rel)
+            except SyntaxError as e:
+                self._trees[rel] = e
+        return self._trees[rel]
+
+    def tree(self, rel):
+        """AST for ``rel``, or None on a syntax error (the style pass
+        owns E999 via ``syntax_error``)."""
+        t = self._parse(rel)
+        return None if isinstance(t, SyntaxError) else t
+
+    def syntax_error(self, rel):
+        t = self._parse(rel)
+        return t if isinstance(t, SyntaxError) else None
+
+
+def run_passes(ctx, pass_names=None):
+    """All findings from the selected passes, noqa-filtered and sorted."""
+    findings = []
+    for mod in ALL_PASSES:
+        if pass_names is not None and mod.NAME not in pass_names:
+            continue
+        findings.extend(mod.run(ctx))
+    kept = []
+    line_cache = {}     # one split per file across all its findings
+    for f in findings:
+        lines = line_cache.get(f.path)
+        if lines is None:
+            if f.path.endswith(".py"):
+                lines = ctx.source(f.path).split("\n")
+            else:
+                path = os.path.join(ctx.root, f.path)
+                lines = []
+                if os.path.isfile(path):
+                    with open(path, "rb") as fh:
+                        lines = fh.read().decode("utf-8", errors="replace") \
+                            .split("\n")
+            line_cache[f.path] = lines
+        if not suppressed(f, lines):
+            kept.append(f)
+    return sorted(set(kept), key=lambda f: (f.path, f.line, f.code))
+
+
+def load_baseline(path):
+    if not os.path.isfile(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    return dict(data.get("counts", {}))
+
+
+def write_baseline(path, findings, keep_prefixes=()):
+    """Record ``findings`` as the new baseline.  ``keep_prefixes``:
+    code prefixes of passes that did NOT run this invocation — their
+    existing entries are carried over, so ``--passes X
+    --write-baseline`` cannot silently delete another pass's debt."""
+    counts = Counter(f.baseline_key for f in findings)
+    if keep_prefixes:
+        for key, n in load_baseline(path).items():
+            code = key.rsplit("::", 1)[-1]
+            if code.startswith(tuple(keep_prefixes)):
+                counts[key] = n
+    with open(path, "w") as f:
+        json.dump({"comment": "speclint ratchet: per path::CODE finding "
+                              "counts; regenerate with "
+                              "`make speclint-baseline`",
+                   "counts": dict(sorted(counts.items()))}, f, indent=1)
+        f.write("\n")
+
+
+def apply_baseline(findings, baseline, code_prefixes=None):
+    """Split findings into (new, baselined) under the ratchet, plus the
+    stale keys whose debt shrank below the recorded count.
+    ``code_prefixes``: the running passes' code prefixes — baseline
+    keys owned by passes that did NOT run are excluded from the stale
+    report (their findings are legitimately absent)."""
+    by_key = {}
+    for f in findings:
+        by_key.setdefault(f.baseline_key, []).append(f)
+    new, baselined = [], []
+    for key, group in by_key.items():
+        allowed = baseline.get(key, 0)
+        if len(group) > allowed:
+            # the ratchet fails the whole key: line-level identity is
+            # unstable under edits, so we cannot tell WHICH finding is
+            # the new one — show them all
+            new.extend(group)
+        else:
+            baselined.extend(group)
+    stale = sorted(
+        k for k, n in baseline.items()
+        if n > len(by_key.get(k, ()))
+        and (code_prefixes is None
+             or k.rsplit("::", 1)[-1].startswith(tuple(code_prefixes))))
+    return new, baselined, stale
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="speclint", description="domain-aware static analysis: "
+        "uint64-hazard, jax-tracing, ladder-drift, spec-markdown, style")
+    parser.add_argument("root", nargs="?", default=".")
+    parser.add_argument("--passes", default=None,
+                        help="comma-separated subset of: "
+                        + ",".join(m.NAME for m in ALL_PASSES))
+    parser.add_argument("--format", choices=("text", "github"),
+                        default="text")
+    parser.add_argument("--baseline", default=None,
+                        help=f"ratchet file (default <root>/{BASELINE_NAME})")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="record the current findings as the baseline")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline: every finding fails")
+    args = parser.parse_args(argv)
+
+    ctx = Context(args.root)
+    if not os.path.isdir(os.path.join(ctx.root, "consensus_specs_tpu")):
+        # the domain passes anchor on repo-root-relative prefixes; a
+        # subtree root must not read as a silent clean
+        print("note: root has no consensus_specs_tpu/ package — the "
+              "uint64/ladder/specmd passes have nothing to scan here; "
+              "run from the repo root for full coverage")
+    pass_names = None if args.passes is None \
+        else {p.strip() for p in args.passes.split(",") if p.strip()}
+    if pass_names is not None:
+        known = {m.NAME for m in ALL_PASSES}
+        unknown = pass_names - known
+        if unknown:
+            parser.error(f"unknown pass(es): {', '.join(sorted(unknown))}")
+    findings = run_passes(ctx, pass_names)
+
+    baseline_path = args.baseline or os.path.join(ctx.root, BASELINE_NAME)
+    if args.write_baseline:
+        keep = () if pass_names is None else tuple(
+            p for m in ALL_PASSES if m.NAME not in pass_names
+            for p in m.CODE_PREFIXES)
+        write_baseline(baseline_path, findings, keep_prefixes=keep)
+        print(f"speclint: baseline written to {baseline_path} "
+              f"({len(findings)} finding(s))")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(baseline_path)
+    prefixes = None if pass_names is None else tuple(
+        p for m in ALL_PASSES if m.NAME in pass_names
+        for p in m.CODE_PREFIXES)
+    new, baselined, stale = apply_baseline(findings, baseline, prefixes)
+    for f in new:
+        print(f.render_github() if args.format == "github" else f.render())
+    for key in stale:
+        print(f"note: baseline is stale for {key} "
+              f"(debt shrank; run `make speclint-baseline`)")
+    if new:
+        print(f"speclint: {len(new)} new finding(s) "
+              f"({len(baselined)} baselined)")
+        return 1
+    print(f"speclint: clean ({len(baselined)} baselined finding(s))")
+    return 0

@@ -1,0 +1,65 @@
+"""Finding model shared by every speclint pass.
+
+A finding is one ``file:line: CODE message`` record, flake8-style.
+Codes are namespaced per pass:
+
+* ``U1xx`` uint64-hazard  * ``J2xx`` jax-tracing  * ``L3xx`` ladder-drift
+* ``M4xx`` spec-markdown  * style pass keeps the flake8/bugbear codes it
+  inherited from ``tools/lint.py`` (E999, W291, W191, F401, E722, B006).
+
+Suppression: a trailing ``# noqa`` comment on the flagged source line
+silences every code; ``# noqa: U101,J203`` silences only the listed
+codes (comma- or space-separated, case-insensitive).
+"""
+import re
+from dataclasses import dataclass
+
+_NOQA_RE = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z0-9, ]+))?",
+                      re.IGNORECASE)
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str       # repo-relative, forward slashes
+    line: int       # 1-based; 0 for whole-file findings
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+    def render_github(self) -> str:
+        """One GitHub Actions workflow-command annotation."""
+        msg = self.message.replace("%", "%25").replace("\r", "%0D") \
+            .replace("\n", "%0A")
+        return (f"::error file={self.path},line={max(self.line, 1)},"
+                f"title=speclint {self.code}::{msg}")
+
+    @property
+    def baseline_key(self) -> str:
+        """Line-number-free identity used by the baseline ratchet, so
+        unrelated edits shifting a finding down a file don't read as a
+        new finding."""
+        return f"{self.path}::{self.code}"
+
+
+def noqa_codes(source_line: str):
+    """``None`` if the line has no noqa; empty set for a bare ``# noqa``
+    (suppress everything); otherwise the set of listed codes."""
+    m = _NOQA_RE.search(source_line)
+    if m is None:
+        return None
+    codes = m.group("codes")
+    if codes is None:
+        return set()
+    return {c.strip().upper() for c in re.split(r"[ ,]+", codes) if c.strip()}
+
+
+def suppressed(finding: Finding, source_lines) -> bool:
+    """True if the finding's source line carries a matching noqa."""
+    if not (1 <= finding.line <= len(source_lines)):
+        return False
+    codes = noqa_codes(source_lines[finding.line - 1])
+    if codes is None:
+        return False
+    return not codes or finding.code.upper() in codes

@@ -1,0 +1,187 @@
+"""ladder-drift pass (L3xx): the hand-written fork ladder
+(``forks/<fork>.py``) and the markdown-compiled ladder
+(``forks/compiled/<fork>.py``) must stay byte-identical in behavior
+(north-star invariant, enforced dynamically by the golden tests).  This
+pass catches the cheap-to-catch drift statically:
+
+* L301 — a public spec symbol present in one ladder and missing from
+  the other (function removed/renamed on one side only).
+* L302 — normalized signature drift: same method, different parameter
+  names/order (annotations and defaults are ignored; ``self`` is
+  dropped).
+* L303 — a compiled module without the ``AUTO-COMPILED from specs/``
+  provenance header: it can no longer prove it came from the markdown.
+* L304 — a hand-edit marker inside a compiled module (``HAND-EDIT`` /
+  ``MANUALLY EDITED``): edits belong in the markdown + ``make pyspec``.
+
+Method surfaces are resolved across the AST inheritance chain (fork
+classes inherit the previous fork; both ladders share the
+``ForkChoiceMixin``/``ValidatorGuideMixin`` modules), so only genuine
+drift is reported.  Class-body assignments (``floorlog2 =
+staticmethod(...)``) count for symbol presence but carry no signature.
+"""
+import ast
+
+from ..astutil import AUTO_COMPILED_MARK as PROVENANCE_MARK
+from ..astutil import is_generated
+from ..findings import Finding
+
+NAME = "ladder"
+CODE_PREFIXES = ("L",)
+
+FORKS_REL = "consensus_specs_tpu/forks"
+COMPILED_REL = "consensus_specs_tpu/forks/compiled"
+HAND_EDIT_MARKERS = ("HAND-EDIT", "HAND EDIT", "MANUALLY EDITED",
+                     "DO-NOT-REGENERATE")
+COMPILED_PREFIX = "Compiled"
+
+
+def _callable_value(node) -> bool:
+    if isinstance(node, ast.Lambda):
+        return True
+    return isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+        and node.func.id in ("staticmethod", "classmethod", "property")
+
+
+def _norm_args(a: ast.arguments):
+    names = [arg.arg for arg in a.posonlyargs + a.args]
+    if names and names[0] in ("self", "cls"):
+        names = names[1:]
+    if a.vararg:
+        names.append("*" + a.vararg.arg)
+    names.extend(arg.arg for arg in a.kwonlyargs)
+    return tuple(names)
+
+
+class _Class:
+    def __init__(self, rel, node):
+        self.rel = rel
+        self.name = node.name
+        self.bases = [b.attr if isinstance(b, ast.Attribute) else b.id
+                      for b in node.bases
+                      if isinstance(b, (ast.Attribute, ast.Name))]
+        self.sigs = {}      # method -> (normalized args, lineno)
+        self.symbols = {}   # public CALLABLE class-body binding -> lineno
+        for m in node.body:
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if not m.name.startswith("_"):
+                    self.sigs[m.name] = (_norm_args(m.args), m.lineno)
+                    self.symbols[m.name] = m.lineno
+            elif isinstance(m, ast.Assign) and _callable_value(m.value):
+                # floorlog2 = staticmethod(floorlog2)-style re-binds
+                # count for symbol presence; plain constants are owned
+                # by the preset/config machinery and are out of scope
+                for t in m.targets:
+                    if isinstance(t, ast.Name) and not t.id.startswith("_"):
+                        self.symbols[t.id] = m.lineno
+
+
+def _collect_module(rel, text, tree, table, texts):
+    texts[rel] = text
+    if tree is None:
+        return      # the style pass owns E999
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            table[node.name] = _Class(rel, node)
+
+
+def _surface(table, cname, _seen=None):
+    """Resolved public surface: name -> (sig-or-None, rel, lineno)."""
+    if _seen is None:
+        _seen = set()
+    if cname not in table or cname in _seen:
+        return {}
+    _seen.add(cname)
+    cls = table[cname]
+    out = {}
+    for base in cls.bases:
+        out.update(_surface(table, base, _seen))
+    for name, lineno in cls.symbols.items():
+        sig = cls.sigs.get(name)
+        out[name] = (sig[0] if sig else None, cls.rel, lineno)
+    return out
+
+
+def check_tree(root: str):
+    """Run the drift comparison against one repo tree (tests point this
+    at synthetic trees with planted drift)."""
+    from ..driver import Context
+    return run(Context(root))
+
+
+def _compare(table, texts):
+    findings = []
+    for rel, text in sorted(texts.items()):
+        if not rel.startswith(COMPILED_REL) or rel.endswith("__init__.py"):
+            continue
+        if not is_generated(text):
+            findings.append(Finding(
+                rel, 1, "L303",
+                f"compiled module lacks the '{PROVENANCE_MARK}' "
+                "provenance header"))
+        for i, line in enumerate(text.split("\n"), 1):
+            upper = line.upper()
+            if any(mark in upper for mark in HAND_EDIT_MARKERS):
+                findings.append(Finding(
+                    rel, i, "L304",
+                    "hand-edit marker in a compiled module; edit the "
+                    "markdown and `make pyspec` instead"))
+
+    for cname in sorted(table):
+        if not cname.startswith(COMPILED_PREFIX):
+            continue
+        comp = table[cname]
+        if not comp.rel.startswith(COMPILED_REL):
+            continue
+        stem = cname[len(COMPILED_PREFIX):]
+        # case-insensitive: CompiledEip6110Spec pairs with EIP6110Spec
+        hand_name = next((n for n in table if n.lower() == stem.lower()
+                          and not n.startswith(COMPILED_PREFIX)), None)
+        if hand_name is None:
+            findings.append(Finding(
+                comp.rel, 1, "L301",
+                f"no hand-written counterpart class '{stem}' for "
+                f"'{cname}'"))
+            continue
+        hand_surface = _surface(table, hand_name)
+        comp_surface = _surface(table, cname)
+        for sym, (_, rel, lineno) in sorted(hand_surface.items()):
+            if sym not in comp_surface:
+                findings.append(Finding(
+                    rel, lineno, "L301",
+                    f"'{sym}' in hand-written '{hand_name}' has no "
+                    f"counterpart in compiled '{cname}'"))
+        for sym, (sig, rel, lineno) in sorted(comp_surface.items()):
+            if sym not in hand_surface:
+                findings.append(Finding(
+                    rel, lineno, "L301",
+                    f"'{sym}' in compiled '{cname}' has no counterpart "
+                    f"in hand-written '{hand_name}'"))
+                continue
+            hand_sig = hand_surface[sym][0]
+            if sig is not None and hand_sig is not None and sig != hand_sig:
+                findings.append(Finding(
+                    rel, lineno, "L302",
+                    f"signature drift on '{sym}': compiled"
+                    f"({', '.join(sig)}) vs hand-written"
+                    f"({', '.join(hand_sig)})"))
+    return findings
+
+
+def run(ctx):
+    table, texts = {}, {}
+    for rel in ctx.py_files:
+        if rel.startswith(FORKS_REL + "/"):
+            _collect_module(rel, ctx.source(rel), ctx.tree(rel),
+                            table, texts)
+    has_hand = any(not rel.startswith(COMPILED_REL + "/") for rel in texts)
+    has_compiled = any(rel.startswith(COMPILED_REL + "/") for rel in texts)
+    if has_hand and not has_compiled:
+        # the compiled ladder is generated (gitignored): a fresh
+        # checkout has none, and silently reporting "no drift" there
+        # would make the whole pass a green no-op in CI
+        return [Finding(
+            COMPILED_REL, 0, "L300",
+            "compiled ladder missing — run `make pyspec` first; the "
+            "ladder-drift pass cannot certify the ladders without it")]
+    return _compare(table, texts)

@@ -1,0 +1,103 @@
+"""spec-markdown pass (M4xx): the markdown under ``specs/**`` is the
+source of truth the compiler (``compiler/extract.py`` + ``make
+pyspec``) turns into runtime modules — a malformed or non-deterministic
+spec block should fail *lint*, not the pyspec build three steps later.
+
+Every ``specs/**/*.md`` is run through the real
+``parse_markdown_spec`` and a banned-construct check is applied to the
+extracted python blocks:
+
+* M400 — unterminated python fence (the extractor cannot even split
+  the document).
+* M401 — ``import`` inside a spec block: the compiled module's import
+  surface is owned by the emitter scaffold, not the spec text.
+* M402 — float literal: consensus math is integer-only; a float in a
+  spec block is a determinism bug by definition.
+* M403 — nondeterministic/stateful stdlib call (``time``, ``random``,
+  ``datetime``, ``os``, ``secrets``, ``uuid``, ``open``/``input``/
+  ``eval``/``exec``): spec functions must be pure state transitions.
+* M404 — spec block does not parse as python.
+
+Findings anchor to the markdown file/line (block start + offset), so
+``--format github`` annotates the spec document itself.
+"""
+import ast
+import os
+
+from ..findings import Finding
+
+NAME = "specmd"
+CODE_PREFIXES = ("M",)
+
+SPECS_REL = "specs"
+
+_BANNED_MODULES = {"time", "random", "datetime", "os", "secrets", "uuid",
+                   "sys", "subprocess"}
+_BANNED_BUILTINS = {"open", "input", "eval", "exec", "globals", "locals",
+                    "vars"}
+
+
+def check_markdown(rel: str, text: str):
+    from consensus_specs_tpu.compiler.extract import parse_markdown_spec
+    try:
+        doc = parse_markdown_spec(text)
+    except ValueError as e:
+        # the extractor stamps the opening fence's line on the error
+        return [Finding(rel, getattr(e, "fence_line", 1), "M400", str(e))]
+    findings = []
+    blocks = list(zip(doc.code_blocks, doc.code_block_lines)) \
+        + list(zip(doc.module_blocks, doc.module_block_lines))
+    for block, start in blocks:
+        findings.extend(_check_block(rel, block, start))
+    return findings
+
+
+def _check_block(rel, block, start):
+    try:
+        tree = ast.parse(block)
+    except SyntaxError as e:
+        return [Finding(rel, start + (e.lineno or 1) - 1, "M404",
+                        f"spec block does not parse as python: {e.msg}")]
+    findings = []
+    for node in ast.walk(tree):
+        line = start + getattr(node, "lineno", 1) - 1
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            findings.append(Finding(
+                rel, line, "M401",
+                "import inside a spec block; the emitter scaffold owns "
+                "the module's import surface"))
+        elif isinstance(node, ast.Constant) and isinstance(node.value, float):
+            findings.append(Finding(
+                rel, line, "M402",
+                f"float literal {node.value!r} in a spec block; "
+                "consensus math is integer-only"))
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute) \
+                    and isinstance(func.value, ast.Name) \
+                    and func.value.id in _BANNED_MODULES:
+                findings.append(Finding(
+                    rel, line, "M403",
+                    f"nondeterministic stdlib call "
+                    f"'{func.value.id}.{func.attr}' in a spec block"))
+            elif isinstance(func, ast.Name) and func.id in _BANNED_BUILTINS:
+                findings.append(Finding(
+                    rel, line, "M403",
+                    f"stateful builtin '{func.id}()' in a spec block"))
+    return findings
+
+
+def run(ctx):
+    findings = []
+    specs_dir = os.path.join(ctx.root, SPECS_REL)
+    for dirpath, dirnames, filenames in os.walk(specs_dir):
+        dirnames.sort()
+        for fn in sorted(filenames):
+            if not fn.endswith(".md"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, ctx.root).replace(os.sep, "/")
+            with open(path, "rb") as f:
+                text = f.read().decode("utf-8", errors="replace")
+            findings.extend(check_markdown(rel, text))
+    return findings

@@ -1,0 +1,206 @@
+"""jax-tracing pass (J2xx): recompile/purity hazards inside functions
+reached by ``jit``/``vmap``/``pjit``/``shard_map`` (and the ``lax``
+control-flow combinators, whose callables trace the same way).
+
+A module-local call graph is built from the AST: functions decorated
+with a tracer, passed as a callable to a tracer call, or defined inside
+a traced function are roots; calls to module-local names propagate the
+traced property transitively.  Inside traced code:
+
+* J201 — concretization of a traced value: ``int()``/``float()``/
+  ``bool()`` on a non-literal, ``.item()``, ``asarray``.  Under trace
+  these force an abstract value to a python scalar (TracerError at
+  best, silent recompile key at worst).
+* J202 — impurity: calls into ``time``/``random``/``np.random`` and
+  ``global`` mutation; the result is baked into the compiled program
+  at trace time.
+* J203 — python ``for``/``while`` loops: unrolled at trace time and a
+  recompile per shape.  Loops over literal constants (``range(8)``, a
+  tuple literal) are static unrolls by construction and exempt; mark
+  intentional data-independent unrolls with ``# noqa: J203``.
+"""
+import ast
+import re
+
+from ..astutil import terminal_name as _terminal_name
+from ..findings import Finding
+
+NAME = "tracing"
+CODE_PREFIXES = ("J",)
+
+_TRACER_NAMES = {"jit", "vmap", "pjit", "shard_map", "pmap", "grad",
+                 "value_and_grad", "checkpoint", "scan", "fori_loop",
+                 "while_loop", "cond", "switch", "custom_jvp", "custom_vjp"}
+_IMPURE_ROOTS = {"time", "random"}
+
+
+def _is_literal(node) -> bool:
+    try:
+        ast.literal_eval(node)
+        return True
+    except (ValueError, TypeError, SyntaxError, MemoryError):
+        return False
+
+
+class _ModuleGraph:
+    """name -> FunctionDef map plus the traced-root set for one module."""
+
+    def __init__(self, tree):
+        self.funcs = {}          # name -> node (innermost wins is fine)
+        self.parents = {}        # nested def -> enclosing def
+        self.roots = set()       # node ids traced directly
+        self._collect(tree, None)
+        self._find_roots(tree)
+
+    def _collect(self, node, enclosing):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.funcs[child.name] = child
+                if enclosing is not None:
+                    self.parents[child] = enclosing
+                self._collect(child, child)
+            else:
+                self._collect(child, enclosing)
+
+    def _find_roots(self, tree):
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    if any(_terminal_name(n) in _TRACER_NAMES
+                           for n in ast.walk(deco)):
+                        self.roots.add(node)
+            elif isinstance(node, ast.Call) \
+                    and _terminal_name(node.func) in _TRACER_NAMES:
+                for arg in list(node.args) + \
+                        [kw.value for kw in node.keywords]:
+                    if isinstance(arg, ast.Name) and arg.id in self.funcs:
+                        self.roots.add(self.funcs[arg.id])
+
+    def traced_functions(self):
+        """Transitive closure over local calls + lexical nesting."""
+        traced = set(self.roots)
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(traced):
+                # local calls made from a traced function trace too
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call) \
+                            and isinstance(node.func, ast.Name) \
+                            and node.func.id in self.funcs:
+                        callee = self.funcs[node.func.id]
+                        if callee not in traced:
+                            traced.add(callee)
+                            changed = True
+            for child, parent in self.parents.items():
+                if parent in traced and child not in traced:
+                    traced.add(child)
+                    changed = True
+        return traced
+
+
+def _check_traced_body(path, fn, findings):
+    # walk the function, pruning nested defs (each traced def is
+    # visited on its own so findings are not duplicated)
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(node, ast.Call):
+            name = _terminal_name(node.func)
+            if isinstance(node.func, ast.Name) \
+                    and name in ("int", "float", "bool") \
+                    and node.args and not all(map(_is_literal, node.args)):
+                findings.append(Finding(
+                    path, node.lineno, "J201",
+                    f"{name}() on a possibly-traced value concretizes "
+                    "under jit"))
+            elif isinstance(node.func, ast.Attribute) \
+                    and name in ("item", "asarray", "tolist") \
+                    and not _bakes_constant(name, node):
+                findings.append(Finding(
+                    path, node.lineno, "J201",
+                    f".{name}() on a possibly-traced value concretizes "
+                    "under jit"))
+            if isinstance(node.func, ast.Attribute):
+                base = node.func.value
+                root = base.id if isinstance(base, ast.Name) else \
+                    _terminal_name(base)
+                if root in _IMPURE_ROOTS:
+                    findings.append(Finding(
+                        path, node.lineno, "J202",
+                        f"call into '{root}' is baked in at trace time "
+                        "(impure under jit)"))
+        elif isinstance(node, ast.Global):
+            findings.append(Finding(
+                path, node.lineno, "J202",
+                "global mutation inside traced code is a silent "
+                "side effect"))
+        elif isinstance(node, ast.While):
+            findings.append(Finding(
+                path, node.lineno, "J203",
+                "python while loop unrolls/retraces under jit; use "
+                "lax.while_loop or annotate # noqa: J203"))
+        elif isinstance(node, ast.For) and not _static_iter(node.iter):
+            findings.append(Finding(
+                path, node.lineno, "J203",
+                "python for loop over a non-literal iterable retraces "
+                "per shape under jit; use lax.scan/fori_loop or "
+                "annotate # noqa: J203"))
+        stack.extend(ast.iter_child_nodes(node))
+
+
+_CONST_NAME_RE = re.compile(r"^_?[A-Z][A-Z0-9_]*$")
+
+
+def _bakes_constant(name, call) -> bool:
+    """``jnp.asarray(_MILLER_BITS)`` — converting a CONSTANT_STYLE
+    module name to a device array is the standard constant-baking
+    idiom, not a concretization hazard."""
+    if name != "asarray" or len(call.args) != 1:
+        return False
+    arg = call.args[0]
+    return isinstance(arg, ast.Name) and bool(_CONST_NAME_RE.match(arg.id))
+
+
+def _static_iter(node) -> bool:
+    """Literal-bounded iterables are static unrolls, not recompile
+    hazards: ``range(<literal>)``, literal tuples/lists, and
+    ``enumerate``/``zip``/``reversed`` over those."""
+    if _is_literal(node):
+        return True
+    if isinstance(node, ast.Call):
+        name = _terminal_name(node.func)
+        if name == "range":
+            return all(map(_is_literal, node.args))
+        if name in ("enumerate", "zip", "reversed"):
+            return all(_static_iter(a) for a in node.args)
+    return False
+
+
+def check_source(path: str, text: str):
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError:
+        return []   # the style pass owns E999
+    return _check(path, tree)
+
+
+def _check(path, tree):
+    graph = _ModuleGraph(tree)
+    if not graph.roots:
+        return []
+    findings = []
+    for fn in sorted(graph.traced_functions(), key=lambda f: f.lineno):
+        _check_traced_body(path, fn, findings)
+    return findings
+
+
+def run(ctx):
+    findings = []
+    for rel in ctx.py_files:
+        tree = ctx.tree(rel)
+        if tree is not None:
+            findings.extend(_check(rel, tree))
+    return findings

@@ -1,0 +1,303 @@
+"""uint64-hazard pass (U1xx): overflow/underflow hazards in the
+numpy/jnp columnar code (``ops/epoch_kernels.py``, ``parallel/``,
+``ops/jax_bls/``) — the bug class PR 1's *runtime* guard-fallback
+exists for, caught at lint time instead.
+
+Unsigned lanes wrap silently: ``a - b`` underflows to huge values,
+``a * b`` truncates mod 2**64, and a dtype-less ``.sum()`` accumulates
+in the platform default integer (int32 on some hosts) rather than the
+lane dtype.  The pass runs a per-function forward taint walk: values
+born from ``uint64``/``u64_column``/``validator_columns``/
+``dtype=np.uint64`` seeds (and, for the ``xp``-namespace kernels of
+``epoch_kernels.py``, every array parameter) are marked unsigned, and
+arithmetic on them is checked:
+
+* U101 — subtraction on unsigned values with no clamp idiom.  Exempt
+  idioms (provably non-wrapping): ``a - xp.minimum(b, a)``,
+  ``a - a % b``, and a subtraction inside a ``where(...)`` whose
+  condition is a comparison (the clamp-at-zero pattern).
+* U102 — multiplication on unsigned values with no widening cast and
+  no preceding ``_guard(...)`` bound-check in the same function.
+  Functions whose magnitude bounds are checked by their callers carry
+  ``# speclint: guarded-by-caller`` on the ``def`` line.
+* U103 — ``.sum()`` / ``np.sum`` / ``xp.sum`` without an explicit
+  ``dtype=``.  Deliberately taint-INDEPENDENT: the worst offenders are
+  bool-mask reductions (``active_cur.sum()``), whose masks come from
+  comparisons the taint walk rightly treats as escaping the unsigned
+  domain — yet their dtype-less sums accumulate in the platform
+  default int (32-bit on some hosts).  In these integer-only kernels
+  every reduction wants an explicit accumulator.
+"""
+import ast
+import re
+
+from ..astutil import terminal_name as _terminal_name
+from ..findings import Finding
+
+NAME = "uint64"
+CODE_PREFIXES = ("U",)
+
+SCOPED_PREFIXES = (
+    "consensus_specs_tpu/ops/epoch_kernels.py",
+    "consensus_specs_tpu/parallel/",
+    "consensus_specs_tpu/ops/jax_bls/",
+)
+
+_SEED_CALLS = {"uint64", "u64_column", "validator_columns"}
+_ARRAY_CTORS = {"fromiter", "zeros", "ones", "full", "empty", "arange",
+                "asarray", "array"}
+_PROPAGATING_METHODS = {"copy", "reshape", "max", "min", "clip", "cumsum",
+                        "astype", "view"}
+_COMBINE_CALLS = {"where", "minimum", "maximum", "mod", "add", "subtract",
+                  "multiply"}
+_CALLER_GUARD_PRAGMA = "speclint: guarded-by-caller"
+
+
+def _mentions_uint64(node):
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and n.value in ("<u8", "uint64"):
+            return True
+        if _terminal_name(n) == "uint64":
+            return True
+    return False
+
+
+_CTX_RE = re.compile(r",?\s*ctx=(?:Load|Store|Del)\(\)")
+
+
+def _dump_no_ctx(node) -> str:
+    """Structural dump ignoring Load/Store context, so the target of
+    `b -= minimum(p, b)` matches the `b` inside the clamp call."""
+    return _CTX_RE.sub("", ast.dump(node))
+
+
+def _dtype_kwarg(call):
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            return kw.value
+    return None
+
+
+class _FunctionChecker:
+    """Forward taint walk over one function (or the module top level)."""
+
+    def __init__(self, path, lines, func=None):
+        self.path = path
+        self.lines = lines
+        self.func = func
+        self.tainted = set()
+        self.findings = []
+        self.guard_seen_line = None     # first `_guard(...)` stmt line
+        self.caller_guarded = func is not None and self._has_pragma(func)
+        if func is not None and func.args.args \
+                and func.args.args[0].arg == "xp":
+            # epoch_kernels kernel convention: pure array kernels take
+            # the array namespace first; every array param is a u64 lane
+            for arg in func.args.args[1:]:
+                self.tainted.add(arg.arg)
+
+    def _has_pragma(self, func):
+        # pragma accepted on the line above the def, the def line(s),
+        # or anywhere up to the first body statement
+        start = max(func.lineno - 2, 0)
+        stop = min(func.body[0].lineno - 1, len(self.lines))
+        return any(_CALLER_GUARD_PRAGMA in ln
+                   for ln in self.lines[start:stop] if ln)
+
+    # -- taint -------------------------------------------------------------
+
+    def is_tainted(self, node) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Subscript):
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.BinOp):
+            return self.is_tainted(node.left) or self.is_tainted(node.right)
+        if isinstance(node, ast.IfExp):
+            return self.is_tainted(node.body) or self.is_tainted(node.orelse)
+        if isinstance(node, ast.Call):
+            name = _terminal_name(node.func)
+            if name in _SEED_CALLS:
+                return True
+            if name in _ARRAY_CTORS:
+                dt = _dtype_kwarg(node)
+                return dt is not None and _mentions_uint64(dt)
+            if name in _COMBINE_CALLS:
+                return any(self.is_tainted(a) for a in node.args)
+            if name == "int":
+                return False    # explicit escape to python-int math
+            if isinstance(node.func, ast.Attribute) \
+                    and name in _PROPAGATING_METHODS \
+                    and self.is_tainted(node.func.value):
+                if name == "astype":
+                    return any(_mentions_uint64(a) for a in node.args) \
+                        or _mentions_uint64(node)
+                return True
+        return False
+
+    # -- checks ------------------------------------------------------------
+
+    def _safe_sub(self, node: ast.BinOp, where_conds) -> bool:
+        left, right = node.left, node.right
+        # a - minimum(b, a): subtracting a value clamped to the minuend
+        if isinstance(right, ast.Call) \
+                and _terminal_name(right.func) in ("minimum", "fmin"):
+            ldump = _dump_no_ctx(left)
+            if any(_dump_no_ctx(a) == ldump for a in right.args):
+                return True
+        # a - a % b: a remainder never exceeds its dividend
+        if isinstance(right, ast.BinOp) and isinstance(right.op, ast.Mod) \
+                and _dump_no_ctx(right.left) == _dump_no_ctx(left):
+            return True
+        # inside a where(...) whose condition compares magnitudes:
+        # the clamp-at-zero pattern evaluates both branches but the
+        # wrapped lane is discarded by the select
+        if any(node in scope for scope in where_conds):
+            return True
+        return False
+
+    def check(self, body):
+        # collect the branch subtrees of every compare-guarded where()
+        where_branches = []
+        for stmt in body:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Call) \
+                        and _terminal_name(n.func) == "where" \
+                        and len(n.args) == 3 \
+                        and isinstance(n.args[0], ast.Compare):
+                    where_branches.append(
+                        set(ast.walk(n.args[1])) | set(ast.walk(n.args[2])))
+        self._walk_block(body, where_branches)
+        return self.findings
+
+    def _walk_block(self, stmts, where_branches):
+        """Source-order walk that descends into compound-statement
+        bodies, so assignments inside if/for/while/try blocks update
+        the taint set and a nested ``_guard(...)`` discharges U102.
+        Branches are over-approximated: every block is walked as if
+        taken, in order."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue    # nested defs are their own taint scope
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._check_simple(stmt.iter, where_branches)
+                if self.is_tainted(stmt.iter):
+                    for n in ast.walk(stmt.target):
+                        if isinstance(n, ast.Name):
+                            self.tainted.add(n.id)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                self._check_simple(stmt.test, where_branches)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._check_simple(item.context_expr, where_branches)
+            elif not isinstance(stmt, ast.Try):
+                self._check_stmt(stmt, where_branches)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if isinstance(sub, list) and sub \
+                        and isinstance(sub[0], ast.stmt):
+                    self._walk_block(sub, where_branches)
+            for handler in getattr(stmt, "handlers", ()):
+                self._walk_block(handler.body, where_branches)
+
+    def _check_stmt(self, stmt, where_branches):
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call) \
+                and _terminal_name(stmt.value.func) == "_guard" \
+                and self.guard_seen_line is None:
+            self.guard_seen_line = stmt.lineno
+        self._check_simple(stmt, where_branches)
+        if isinstance(stmt, ast.AugAssign):
+            # `b -= p` / `b *= p` hold their op directly (no BinOp
+            # child): check the equivalent `b = b - p` spelling so the
+            # in-place form of the hazard — and its clamp idioms like
+            # `b -= minimum(p, b)` — behave identically
+            self._check_binop(ast.copy_location(
+                ast.BinOp(left=stmt.target, op=stmt.op, right=stmt.value),
+                stmt), where_branches)
+        # assignments propagate taint AFTER the RHS is checked
+        if isinstance(stmt, ast.Assign):
+            val_tainted = self.is_tainted(stmt.value)
+            for t in stmt.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        if val_tainted:
+                            self.tainted.add(n.id)
+                        else:
+                            self.tainted.discard(n.id)
+        elif isinstance(stmt, ast.AugAssign) \
+                and isinstance(stmt.target, ast.Name) \
+                and self.is_tainted(stmt.value):
+            self.tainted.add(stmt.target.id)
+
+    def _check_simple(self, root, where_branches):
+        """Expression-level checks, pruning nested defs (their own
+        scope; compound sub-blocks are walked by ``_walk_block``)."""
+        stack = [root]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not root:
+                continue
+            if isinstance(node, ast.BinOp):
+                self._check_binop(node, where_branches)
+            elif isinstance(node, ast.Call):
+                self._check_call(node)
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _check_binop(self, node, where_branches):
+        if not (self.is_tainted(node.left) or self.is_tainted(node.right)):
+            return
+        if isinstance(node.op, ast.Sub) \
+                and not self._safe_sub(node, where_branches):
+            self.findings.append(Finding(
+                self.path, node.lineno, "U101",
+                "subtraction on unsigned array may wrap; clamp with a "
+                "where()/minimum() idiom or # noqa with a bound argument"))
+        elif isinstance(node.op, ast.Mult) and not self.caller_guarded \
+                and (self.guard_seen_line is None
+                     or node.lineno <= self.guard_seen_line):
+            self.findings.append(Finding(
+                self.path, node.lineno, "U102",
+                "unsigned multiplication without a widening cast or a "
+                "preceding _guard() bound-check"))
+
+    def _check_call(self, node):
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "sum" \
+                and _dtype_kwarg(node) is None:
+            self.findings.append(Finding(
+                self.path, node.lineno, "U103",
+                "reduction without an explicit dtype= accumulates in the "
+                "platform default integer"))
+
+
+def check_source(path: str, text: str):
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError:
+        return []   # the style pass owns E999
+    return _check(path, text, tree)
+
+
+def _check(path, text, tree):
+    lines = text.split("\n")
+    findings = []
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in funcs:
+        checker = _FunctionChecker(path, lines, fn)
+        findings.extend(checker.check(fn.body))
+    # module top level (constants built from columns etc.)
+    top = [s for s in tree.body
+           if not isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef))]
+    findings.extend(_FunctionChecker(path, lines).check(top))
+    return findings
+
+
+def run(ctx):
+    findings = []
+    for rel in ctx.py_files:
+        if rel.startswith(SCOPED_PREFIXES) and ctx.tree(rel) is not None:
+            findings.extend(_check(rel, ctx.source(rel), ctx.tree(rel)))
+    return findings

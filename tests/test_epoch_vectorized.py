@@ -434,3 +434,72 @@ def test_epoch_ordering_covers_vectorized_fns():
             else ALTAIR_VECTORIZED_FNS
         for fn in expected:
             assert fn in calls, (fork, fn)
+
+
+# ---------------------------------------------------------------------------
+# speclint uint64-hazard regressions: the real findings the U1xx pass
+# surfaced in ops/epoch_kernels.py, each pinned against the spec-loop
+# oracle at the shape that makes the fixed/annotated line load-bearing
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fork", ["phase0", "deneb"])
+def test_registry_mass_ejection_sum_dtype_regression(fork):
+    """Pins the explicit-dtype reductions in ``_registry_updates``
+    (active-set churn limit and exit-queue churn counter, both formerly
+    dtype-less bool ``.sum()``s): eject half the registry so the churn
+    recurrence advances ``queue_epoch`` repeatedly — every advance
+    consumes both counts — and require bit-identical post-state."""
+    spec = _spec(fork)
+    state = _genesis(spec)
+    ek.use_loops()
+    next_epoch(spec, state)
+    for i in range(0, len(state.validators), 2):
+        state.validators[i].effective_balance = spec.config.EJECTION_BALANCE
+    s_loop, s_vec = state.copy(), state.copy()
+    ek.use_loops()
+    spec.process_registry_updates(s_loop)
+    ek.use_vectorized()
+    before = ek.stats()
+    spec.process_registry_updates(s_vec)
+    after = ek.stats()
+    assert after["vectorized"] == before["vectorized"] + 1
+    assert after["fallback"] == before["fallback"]
+    assert hash_tree_root(s_loop) == hash_tree_root(s_vec)
+    # the queue really did saturate: ejections spread over >= 2 epochs,
+    # so the per-epoch churn counter (the second fixed sum) was consumed
+    exits = {int(v.exit_epoch) for v in s_vec.validators
+             if v.exit_epoch != spec.FAR_FUTURE_EPOCH}
+    assert len(exits) >= 2
+
+
+def test_phase0_minimal_balance_reward_bounds_regression():
+    """Pins the ``max_attester = base_reward - proposer_reward``
+    unsigned subtraction (# noqa: U101): at one-increment effective
+    balances ``base_reward`` is at its minimum and the proposer cut
+    rounds to its extreme relative value — the lane must not wrap."""
+    spec, state = _phase0_state("phase0", seed=31)
+    for i in range(len(state.validators)):
+        state.validators[i].effective_balance = \
+            spec.EFFECTIVE_BALANCE_INCREMENT
+    _assert_function_equivalence(spec, state,
+                                 ["process_rewards_and_penalties"])
+
+
+def test_phase0_leak_minimal_balance_base_pen_regression():
+    """Pins the ``base_pen = BASE_REWARDS_PER_EPOCH * base_reward -
+    proposer_reward`` unsigned subtraction (# noqa: U101), which only
+    runs in an inactivity leak, at minimum-balance extremes."""
+    spec = _spec("phase0")
+    state = _genesis(spec)
+    ek.use_loops()
+    next_epoch(spec, state)
+    _, _, state = next_epoch_with_attestations(spec, state, True, False)
+    for _ in range(6):     # let finality lapse into a leak
+        next_epoch(spec, state)
+    _, _, state = next_epoch_with_attestations(spec, state, True, False)
+    for i in range(len(state.validators)):
+        state.validators[i].effective_balance = \
+            spec.EFFECTIVE_BALANCE_INCREMENT
+    assert spec.is_in_inactivity_leak(state)
+    _assert_function_equivalence(spec, state,
+                                 ["process_rewards_and_penalties"])

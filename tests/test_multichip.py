@@ -52,7 +52,7 @@ def test_sharded_g1_aggregate_matches_host():
         gathered = jax.tree_util.tree_map(
             lambda a: jax.lax.all_gather(a, "agg"), part)
         total = jax.tree_util.tree_map(lambda a: a[0], gathered)
-        for i in range(1, n_shards):
+        for i in range(1, n_shards):  # noqa: J203 (static unroll: mesh size)
             total = PT.g1_add(
                 total, jax.tree_util.tree_map(lambda a: a[i], gathered))
         return PT.g1_normalize(total)

@@ -112,6 +112,49 @@ def test_compiled_shuffle_matches():
             comp.compute_shuffled_index(i, 20, seed)
 
 
+def test_extract_module_scope_blocks():
+    """``<!-- scope: module -->`` routes the NEXT block to module level
+    (Store dataclasses, helpers) — and only that block."""
+    md = (
+        "# Demo\n\n"
+        "<!-- scope: module -->\n"
+        "```python\n"
+        "MODULE_HELPER = 1\n"
+        "```\n\n"
+        "```python\n"
+        "def method(self): pass\n"
+        "```\n")
+    doc = parse_markdown_spec(md)
+    assert doc.module_blocks == ["MODULE_HELPER = 1"]
+    assert doc.code_blocks == ["def method(self): pass"]
+    # line anchors: first content line of each fence (speclint relies
+    # on these to annotate the markdown itself)
+    assert doc.module_block_lines == [5]
+    assert doc.code_block_lines == [9]
+
+
+def test_extract_constant_tables_two_vs_three_columns():
+    """2-column tables with parseable values are constants; 3+-column
+    documentation tables and header/separator rows are not."""
+    md = (
+        "# Demo\n\n"
+        "| Name | Value |\n"
+        "| - | - |\n"
+        "| `MAX_THINGS` | `2**10` |\n"
+        "| `BAD_SYNTAX` | `)( nope` |\n\n"
+        "| Name | Value | Unit |\n"
+        "| `PRESET_VAR` | `64` | slots |\n")
+    doc = parse_markdown_spec(md)
+    assert doc.constants == {"MAX_THINGS": "2**10"}
+
+
+def test_extract_unterminated_fence_raises_with_line():
+    import pytest
+    md = "# Demo\n\n```python\nx = 1\n"
+    with pytest.raises(ValueError, match="line 3"):
+        parse_markdown_spec(md)
+
+
 def test_provenance_manifest_covers_all_spec_logic():
     """Every fork's hand-written spec-logic methods must be
     markdown-sourced (the judge-audited no-silent-fallback invariant)."""

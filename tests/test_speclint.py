@@ -1,0 +1,500 @@
+"""speclint fixture suite.
+
+Each domain pass must (a) flag its planted-bug fixture and (b) stay
+quiet on the safe idiom right next to it; the driver must run clean on
+the real tree modulo the checked-in baseline, and the ratchet must fail
+when debt grows.  The synthetic ladder-drift test copies the REAL fork
+ladder and removes one function from a compiled module — the exact
+regression the pass exists for.
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu.tools.speclint import driver
+from consensus_specs_tpu.tools.speclint.findings import (
+    Finding, noqa_codes, suppressed)
+from consensus_specs_tpu.tools.speclint.passes import (
+    ladder, specmd, style, tracing, uint64)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCOPED = "consensus_specs_tpu/ops/epoch_kernels.py"   # in uint64 pass scope
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _ensure_compiled_ladder():
+    """forks/compiled/ is generated (gitignored): on a fresh checkout
+    build it once so the real-tree ladder tests compare real surfaces
+    (CI's lint job runs `make pyspec` for the same reason)."""
+    if not os.path.isdir(os.path.join(REPO, "consensus_specs_tpu",
+                                      "forks", "compiled")):
+        subprocess.run([sys.executable, "-m", "consensus_specs_tpu.compiler"],
+                       check=True, cwd=REPO, capture_output=True)
+
+
+def _codes(findings):
+    return [f.code for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# uint64-hazard pass
+# ---------------------------------------------------------------------------
+
+def test_uint64_flags_unsigned_subtraction():
+    src = (
+        "import numpy as np\n"
+        "def f(seq):\n"
+        "    balances = u64_column(seq)\n"
+        "    penalties = u64_column(seq)\n"
+        "    return balances - penalties\n")
+    assert "U101" in _codes(uint64.check_source(SCOPED, src))
+
+
+def test_uint64_accepts_clamp_idioms():
+    src = (
+        "import numpy as np\n"
+        "def kernel(xp, balances, rewards, penalties):\n"
+        "    up = balances + rewards\n"
+        "    safe1 = xp.where(penalties > up, xp.uint64(0), up - penalties)\n"
+        "    safe2 = up - xp.minimum(penalties, up)\n"
+        "    safe3 = up - up % xp.uint64(32)\n"
+        "    return safe1, safe2, safe3\n")
+    assert [c for c in _codes(uint64.check_source(SCOPED, src))
+            if c == "U101"] == []
+
+
+def test_uint64_flags_unguarded_multiplication():
+    src = (
+        "def f(seq, factor):\n"
+        "    eff = u64_column(seq)\n"
+        "    return eff * factor\n")
+    assert "U102" in _codes(uint64.check_source(SCOPED, src))
+
+
+def test_uint64_mult_discharged_by_guard_or_pragma():
+    guarded = (
+        "def f(seq, factor):\n"
+        "    eff = u64_column(seq)\n"
+        "    _guard(int(eff.max(initial=0)) * factor)\n"
+        "    return eff * factor\n")
+    assert "U102" not in _codes(uint64.check_source(SCOPED, guarded))
+    pragma = (
+        "# speclint: guarded-by-caller (bounds checked in try_process_*)\n"
+        "def kernel(xp, eff, factor):\n"
+        "    return eff * factor\n")
+    assert "U102" not in _codes(uint64.check_source(SCOPED, pragma))
+
+
+def test_uint64_flags_dtypeless_reduction():
+    src = (
+        "def f(seq):\n"
+        "    mask = u64_column(seq)\n"
+        "    n_bad = int(mask.sum())\n"
+        "    n_ok = int(mask.sum(dtype='int64'))\n"
+        "    return n_bad, n_ok\n")
+    assert _codes(uint64.check_source(SCOPED, src)).count("U103") == 1
+
+
+def test_uint64_flags_augmented_assignment():
+    """`b -= p` / `b *= p` are the in-place spelling of the hazard and
+    must behave exactly like `b = b - p`, clamp idioms included."""
+    src = (
+        "def f(seq):\n"
+        "    b = u64_column(seq)\n"
+        "    p = u64_column(seq)\n"
+        "    b -= p\n"
+        "    b *= p\n")
+    codes = _codes(uint64.check_source(SCOPED, src))
+    assert "U101" in codes and "U102" in codes
+    clamped = (
+        "def f(xp, seq):\n"
+        "    b = u64_column(seq)\n"
+        "    p = u64_column(seq)\n"
+        "    b -= xp.minimum(p, b)\n")
+    assert "U101" not in _codes(uint64.check_source(SCOPED, clamped))
+
+
+def test_uint64_taint_flows_through_nested_blocks():
+    """Assignments inside if/for bodies must update the taint set, and
+    a _guard() inside a branch must discharge a later multiply."""
+    src = (
+        "def f(seq, flag):\n"
+        "    if flag:\n"
+        "        b = u64_column(seq)\n"
+        "        return b - b\n"
+        "    return None\n")
+    assert "U101" in _codes(uint64.check_source(SCOPED, src))
+    guarded = (
+        "def f(seq, flag, factor):\n"
+        "    eff = u64_column(seq)\n"
+        "    if flag:\n"
+        "        _guard(int(eff.max(initial=0)) * factor)\n"
+        "        return eff * factor\n"
+        "    return eff\n")
+    assert "U102" not in _codes(uint64.check_source(SCOPED, guarded))
+
+
+def test_uint64_out_of_scope_files_ignored(tmp_path):
+    bad = "def f(seq):\n    return u64_column(seq) - u64_column(seq)\n"
+    root = tmp_path / "repo"
+    target = root / SCOPED
+    target.parent.mkdir(parents=True)
+    target.write_text(bad)
+    other = root / "consensus_specs_tpu" / "utils" / "misc.py"
+    other.parent.mkdir(parents=True)
+    other.write_text(bad)
+    findings = uint64.run(driver.Context(str(root)))
+    assert {f.path for f in findings} == {SCOPED}
+
+
+# ---------------------------------------------------------------------------
+# jax-tracing pass
+# ---------------------------------------------------------------------------
+
+def test_tracing_flags_concretization_in_jitted_fn():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return int(x) + x.item()\n")
+    assert _codes(tracing.check_source("m.py", src)).count("J201") == 2
+
+
+def test_tracing_untraced_function_not_flagged():
+    src = (
+        "def host_only(x):\n"
+        "    return int(x) + time.time()\n")
+    assert tracing.check_source("m.py", src) == []
+
+
+def test_tracing_flags_impurity_and_loops_transitively():
+    src = (
+        "import jax, time\n"
+        "def helper(x):\n"
+        "    t = time.time()\n"
+        "    while x > 0:\n"
+        "        x = x - 1\n"
+        "    return x + t\n"
+        "def outer(x):\n"
+        "    return helper(x)\n"
+        "prog = jax.jit(outer)\n")
+    codes = _codes(tracing.check_source("m.py", src))
+    assert "J202" in codes and "J203" in codes
+
+
+def test_tracing_static_unrolls_exempt():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    for i in range(8):\n"
+        "        x = x + i\n"
+        "    for w in (1, 2, 3):\n"
+        "        x = x * w\n"
+        "    return x\n")
+    assert tracing.check_source("m.py", src) == []
+
+
+def test_tracing_constant_baking_asarray_exempt():
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    k = jnp.asarray(_K_TABLE)\n"
+        "    return jnp.asarray(x) + k\n")
+    assert _codes(tracing.check_source("m.py", src)).count("J201") == 1
+
+
+# ---------------------------------------------------------------------------
+# ladder-drift pass
+# ---------------------------------------------------------------------------
+
+def _mini_ladder(tmp_path, compiled_body, hand_body=None):
+    root = tmp_path / "repo"
+    forks = root / "consensus_specs_tpu" / "forks"
+    compiled = forks / "compiled"
+    compiled.mkdir(parents=True)
+    (forks / "foo.py").write_text(hand_body or (
+        "class FooSpec:\n"
+        "    fork = 'foo'\n"
+        "    def process_thing(self, state, index):\n"
+        "        return state\n"
+        "    def get_value(self, state):\n"
+        "        return 1\n"))
+    (compiled / "foo.py").write_text(compiled_body)
+    return str(root)
+
+
+_COMPILED_OK = (
+    '"""AUTO-COMPILED from specs/foo.md — do not edit."""\n'
+    "class CompiledFooSpec:\n"
+    "    fork = 'foo'\n"
+    "    def process_thing(self, state, index):\n"
+    "        return state\n"
+    "    def get_value(self, state):\n"
+    "        return 1\n")
+
+
+def test_ladder_clean_on_matching_pair(tmp_path):
+    assert ladder.check_tree(_mini_ladder(tmp_path, _COMPILED_OK)) == []
+
+
+def test_ladder_flags_missing_compiled_tree(tmp_path):
+    """A hand ladder with no compiled counterpart tree (fresh checkout
+    before `make pyspec`) must be an explicit finding, not a silent
+    green no-op."""
+    root = tmp_path / "repo"
+    forks = root / "consensus_specs_tpu" / "forks"
+    forks.mkdir(parents=True)
+    (forks / "foo.py").write_text("class FooSpec:\n    fork = 'foo'\n")
+    findings = ladder.check_tree(str(root))
+    assert _codes(findings) == ["L300"]
+    assert "make pyspec" in findings[0].message
+
+
+def test_ladder_detects_missing_function(tmp_path):
+    dropped = _COMPILED_OK.replace(
+        "    def get_value(self, state):\n        return 1\n", "")
+    findings = ladder.check_tree(_mini_ladder(tmp_path, dropped))
+    assert ["L301"] == _codes(findings)
+    assert "get_value" in findings[0].message
+
+
+def test_ladder_detects_signature_drift(tmp_path):
+    drifted = _COMPILED_OK.replace("def process_thing(self, state, index)",
+                                   "def process_thing(self, state, idx)")
+    findings = ladder.check_tree(_mini_ladder(tmp_path, drifted))
+    assert ["L302"] == _codes(findings)
+
+
+def test_ladder_detects_missing_header_and_hand_edit(tmp_path):
+    hacked = _COMPILED_OK.replace(
+        '"""AUTO-COMPILED from specs/foo.md — do not edit."""',
+        "# HAND-EDIT: patched in place\n")
+    findings = ladder.check_tree(_mini_ladder(tmp_path, hacked))
+    assert sorted(_codes(findings)) == ["L303", "L304"]
+
+
+def test_ladder_synthetic_drift_on_real_tree(tmp_path):
+    """Acceptance fixture: remove one public function from a COPY of a
+    real compiled module; the pass must catch the drift."""
+    root = tmp_path / "repo"
+    dst = root / "consensus_specs_tpu" / "forks"
+    shutil.copytree(os.path.join(REPO, "consensus_specs_tpu", "forks"), dst,
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    assert ladder.check_tree(str(root)) == []   # pristine copy is clean
+
+    mod = dst / "compiled" / "altair.py"
+    text = mod.read_text().split("\n")
+    # drop the body of one public spec method (keep the file parseable)
+    start = next(i for i, ln in enumerate(text)
+                 if ln.strip().startswith("def get_flag_index_deltas"))
+    indent = len(text[start]) - len(text[start].lstrip())
+    end = start + 1
+    while end < len(text) and (not text[end].strip()
+                               or len(text[end]) - len(text[end].lstrip())
+                               > indent):
+        end += 1
+    mod.write_text("\n".join(text[:start] + text[end:]))
+    findings = ladder.check_tree(str(root))
+    assert any(f.code == "L301" and "get_flag_index_deltas" in f.message
+               for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# spec-markdown pass
+# ---------------------------------------------------------------------------
+
+def test_specmd_flags_banned_constructs():
+    md = (
+        "# Demo spec\n"
+        "\n"
+        "```python\n"
+        "import os\n"
+        "def get_rate() -> uint64:\n"
+        "    return uint64(0.5 * random.random())\n"
+        "```\n")
+    codes = _codes(specmd.check_markdown("specs/demo.md", md))
+    assert codes.count("M401") == 1    # import
+    assert codes.count("M402") == 1    # float literal
+    assert codes.count("M403") == 1    # random.random()
+
+
+def test_specmd_line_anchoring():
+    md = "line1\n\n```python\nx = GOOD\nimport os\n```\n"
+    (finding,) = specmd.check_markdown("specs/demo.md", md)
+    assert (finding.code, finding.line) == ("M401", 5)
+
+
+def test_specmd_unterminated_fence():
+    md = "# Demo\n\n```python\nx = 1\n"
+    (finding,) = specmd.check_markdown("specs/demo.md", md)
+    assert (finding.code, finding.line) == ("M400", 3)
+
+
+def test_specmd_unparsable_block():
+    md = "```python\n    dangling indent\n```\n"
+    (finding,) = specmd.check_markdown("specs/demo.md", md)
+    assert finding.code == "M404"
+
+
+def test_specmd_clean_block_passes():
+    md = (
+        "```python\n"
+        "def get_current_epoch(state: BeaconState) -> Epoch:\n"
+        "    return compute_epoch_at_slot(state.slot)\n"
+        "```\n")
+    assert specmd.check_markdown("specs/demo.md", md) == []
+
+
+# ---------------------------------------------------------------------------
+# style pass / lint.py shim
+# ---------------------------------------------------------------------------
+
+def test_style_pass_keeps_legacy_checks():
+    src = (
+        "import os\n"
+        "def f(x=[]):\n"
+        "    try:\n"
+        "        pass\n"
+        "    except:\n"
+        "        pass\n")
+    codes = _codes(style.check_source("m.py", src))
+    assert {"F401", "B006", "E722"} <= set(codes)
+
+
+def test_lint_shim_still_works(tmp_path):
+    from consensus_specs_tpu.tools import lint
+    good = tmp_path / "ok.py"
+    good.write_text("x = 1\n")
+    assert lint.lint_file(str(good)) == []
+    assert lint.main([str(tmp_path), "--no-baseline"]) == 0
+    assert list(lint.iter_py_files(str(tmp_path))) == [str(good)]
+
+
+def test_lint_shim_keeps_noqa_suppression(tmp_path):
+    """The historical lint_file honored # noqa on E722/B006 lines."""
+    from consensus_specs_tpu.tools import lint
+    target = tmp_path / "m.py"
+    target.write_text(
+        "try:\n"
+        "    pass\n"
+        "except:  # noqa\n"
+        "    pass\n")
+    assert lint.lint_file(str(target)) == []
+
+
+# ---------------------------------------------------------------------------
+# driver: noqa, baseline ratchet, real tree
+# ---------------------------------------------------------------------------
+
+def test_noqa_parsing_and_suppression():
+    assert noqa_codes("x = 1") is None
+    assert noqa_codes("x = 1  # noqa") == set()
+    assert noqa_codes("x = 1  # noqa: U101, J203") == {"U101", "J203"}
+    f = Finding("m.py", 1, "U101", "boom")
+    assert suppressed(f, ["bad - code  # noqa: U101"])
+    assert not suppressed(f, ["bad - code  # noqa: J203"])
+    assert suppressed(f, ["bad - code  # noqa"])
+
+
+def test_driver_noqa_filters_findings(tmp_path):
+    root = tmp_path / "repo"
+    target = root / SCOPED
+    target.parent.mkdir(parents=True)
+    target.write_text(
+        "def f(seq):\n"
+        "    balances = u64_column(seq)\n"
+        "    return balances - balances  # noqa: U101\n")
+    assert driver.run_passes(driver.Context(str(root)), {"uint64"}) == []
+
+
+def test_baseline_ratchet(tmp_path):
+    root = tmp_path / "repo"
+    target = root / SCOPED
+    target.parent.mkdir(parents=True)
+    bad = ("def f(seq):\n"
+           "    b = u64_column(seq)\n"
+           "    return b - b\n")
+    target.write_text(bad)
+    baseline = str(root / "speclint_baseline.json")
+
+    # no baseline: the finding fails the run
+    assert driver.main([str(root), "--passes", "uint64"]) == 1
+    # record it, and the same tree is green
+    assert driver.main([str(root), "--passes", "uint64",
+                        "--write-baseline"]) == 0
+    assert driver.main([str(root), "--passes", "uint64"]) == 0
+    # debt grows -> ratchet fails
+    target.write_text(bad + "def g(seq):\n"
+                            "    b = u64_column(seq)\n"
+                            "    return b - b\n")
+    assert driver.main([str(root), "--passes", "uint64"]) == 1
+    # debt paid down -> green (stale baseline is only a note)
+    target.write_text("def f(seq):\n    return u64_column(seq)\n")
+    assert driver.main([str(root), "--passes", "uint64"]) == 0
+    with open(baseline) as f:
+        assert sum(json.load(f)["counts"].values()) == 1
+
+
+def test_write_baseline_with_pass_subset_preserves_other_debt(tmp_path):
+    """`--passes X --write-baseline` must not delete other passes'
+    recorded debt from the ratchet file."""
+    root = tmp_path / "repo"
+    target = root / SCOPED
+    target.parent.mkdir(parents=True)
+    target.write_text("def f(seq):\n"
+                      "    b = u64_column(seq)\n"
+                      "    return b - b\n")
+    md = root / "specs" / "demo.md"
+    md.parent.mkdir(parents=True)
+    md.write_text("```python\nimport os\n```\n")
+    assert driver.main([str(root), "--write-baseline"]) == 0
+    assert driver.main([str(root)]) == 0
+    # re-record only the uint64 pass: the M401 debt must survive
+    assert driver.main([str(root), "--passes", "uint64",
+                        "--write-baseline"]) == 0
+    assert driver.main([str(root)]) == 0
+    with open(root / "speclint_baseline.json") as f:
+        counts = json.load(f)["counts"]
+    assert any(k.endswith("::M401") for k in counts)
+    assert any(k.endswith("::U101") for k in counts)
+
+
+def test_subtree_root_warns_instead_of_silent_clean(capsys):
+    """Pointing speclint at a subtree (where the repo-anchored passes
+    match nothing) must say so, not just report clean."""
+    assert driver.main([os.path.join(REPO, "consensus_specs_tpu"),
+                        "--no-baseline", "--passes", "uint64"]) == 0
+    assert "run from the repo root" in capsys.readouterr().out
+
+
+def test_pass_subset_does_not_report_other_debt_as_stale(capsys):
+    """`--passes uint64` must not print stale-baseline notes for the
+    spec-markdown debt that legitimately did not run."""
+    assert driver.main([REPO, "--passes", "uint64"]) == 0
+    assert "stale" not in capsys.readouterr().out
+
+
+def test_real_tree_clean_modulo_baseline():
+    """`make lint`'s contract: all passes, one process, exit 0 on the
+    repo with the checked-in baseline."""
+    assert driver.main([REPO]) == 0
+
+
+def test_real_tree_baseline_has_no_code_findings():
+    """The checked-in debt is all in the reference spec markdown; the
+    python tree itself must lint clean."""
+    with open(os.path.join(REPO, "speclint_baseline.json")) as f:
+        counts = json.load(f)["counts"]
+    assert counts, "baseline unexpectedly empty"
+    for key in counts:
+        assert key.startswith("specs/"), f"code debt crept in: {key}"
