@@ -6,8 +6,8 @@ GENERATORS = operations sanity finality rewards random forks epoch_processing \
              genesis ssz_static bls shuffling light_client kzg_4844 \
              fork_choice merkle_proof ssz_generic sync transition
 
-.PHONY: test citest test-crypto bench bench-all dryrun warm native lint \
-        speclint-baseline \
+.PHONY: test citest test-crypto bench bench-all bench-merkle-smoke dryrun \
+        warm native lint speclint-baseline \
         generate_tests $(addprefix gen_,$(GENERATORS)) clean-vectors pyspec
 
 # fast local suite: signature checks off except @always_bls
@@ -22,6 +22,7 @@ test:
 # (reference `make citest` with --bls-type=fastest, Makefile:129-137)
 citest:
 	-$(MAKE) native
+	$(PYTHON) benchmarks/bench_merkle_smoke.py
 	$(PYTHON) -m pytest tests/ -q --enable-bls --bls-type fastest
 
 # static checks: syntax gate + the speclint multi-pass analyzer
@@ -66,6 +67,14 @@ bench-all:
 # shape (full matrix: --epoch-shapes 16384,262144,1048576)
 bench-epoch:
 	$(PYTHON) benchmarks/bench_all.py --configs 5 --epoch-shapes 16384
+
+# merkle-engine dispatch smoke: registry-wide commits must re-hash
+# through the batched paths (asserted via the utils/ssz/merkle counters;
+# nonzero exit on a per-pair hashlib regression).  Native build is
+# best-effort: without it the smoke installs the JAX batched hasher.
+bench-merkle-smoke:
+	-$(MAKE) native
+	$(PYTHON) benchmarks/bench_merkle_smoke.py
 
 dryrun:
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

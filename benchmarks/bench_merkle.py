@@ -7,6 +7,12 @@ caching in ``utils/ssz`` (remerkleable's role; reference
 ``setup.py:549``).  Pubkeys are synthetic — signature checks are off in
 this config; the workload is hashing, not crypto.
 
+Also measures the registry-wide balance-commit root (every validator's
+balance changes, then the state re-roots) — the merkleization bill of an
+epoch transition, which the slot-replay window alone does not capture —
+and reports the merkle engine's dispatch counters for it (batched vs
+per-pair hashlib; see ``utils/ssz/merkle.stats``).
+
 Prints one JSON line per registry size.
 """
 import json
@@ -18,6 +24,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from consensus_specs_tpu.forks import build_spec
 from consensus_specs_tpu.utils import bls
+from consensus_specs_tpu.utils.ssz import merkle
+from consensus_specs_tpu.utils.ssz.forest import hash_forest
 
 
 def build_state(spec, n):
@@ -65,6 +73,17 @@ def main():
         t0 = time.time()
         spec.process_slots(state, state.slot + 1)         # crosses boundary
         epoch_s = time.time() - t0
+        # registry-wide balance commit: every balance changes through the
+        # public API, then the state re-roots (the epoch transition's
+        # merkleization bill, outside the slot-replay window above)
+        merkle.reset_stats()
+        t0 = time.time()
+        for i in range(n):
+            state.balances[i] = int(state.balances[i]) - 1
+        with hash_forest():
+            state.hash_tree_root()
+        commit_root_s = time.time() - t0
+        stats = merkle.stats()
         print(json.dumps({
             "metric": f"32-slot replay, {n} validators",
             "value": round(slots_s + epoch_s, 3), "unit": "s",
@@ -72,6 +91,10 @@ def main():
             "first_full_root_s": round(first_root_s, 2),
             "per_slot_ms": round(slots_s / n_slots * 1000, 1),
             "epoch_transition_s": round(epoch_s, 2),
+            "balance_commit_root_s": round(commit_root_s, 3),
+            "pair_batch_pairs": stats["pair_batch_pairs"],
+            "pair_scalar": stats["pair_scalar"],
+            "layer_calls": stats["layer_calls"],
         }), flush=True)
 
 

@@ -1,0 +1,109 @@
+"""Merkle-engine dispatch smoke (``make bench-merkle-smoke``, CI-wired).
+
+Drives a tiny registry through the two registry-wide commit paths and
+asserts — via the dispatch counters in ``utils/ssz/merkle`` — that the
+batched engine actually engaged:
+
+1. the epoch engine's chunk-packed column commit
+   (``ops/epoch_kernels._write_u64_list`` -> ``replace_basic_items``
+   with a packed buffer) must re-hash entirely through batched layer
+   dispatches: ZERO per-pair hashlib calls;
+2. a wide ``__setitem__`` commit must route every dirty level at or
+   above the pair threshold through a batched dispatch — only
+   below-threshold tail levels may hash per pair.
+
+Roots are verified against the no-cache ``decode_bytes(serialize())``
+oracle, so a dispatch bug cannot pass as a performance quirk.
+
+Exits nonzero on any violation.  When neither the native C hasher nor a
+kernel is installed (no gcc), the JAX batched hasher is installed first —
+the smoke then also covers the kernel plug path.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from consensus_specs_tpu.utils.ssz import merkle
+
+
+def main():
+    backend = "native" if merkle._native is not None else "kernel"
+    if not merkle.have_fast_backend():
+        from consensus_specs_tpu.ops.sha256 import install_merkle_hasher
+        install_merkle_hasher()
+    from consensus_specs_tpu.forks import build_spec
+    from consensus_specs_tpu.ops import epoch_kernels
+    from consensus_specs_tpu.utils import bls
+    from consensus_specs_tpu.utils.ssz.forest import hash_forest
+
+    bls.bls_active = False
+    n = 2048
+    spec = build_spec("phase0", "minimal")
+    state = spec.BeaconState()
+    v = spec.Validator(
+        effective_balance=spec.MAX_EFFECTIVE_BALANCE,
+        exit_epoch=spec.FAR_FUTURE_EPOCH,
+        withdrawable_epoch=spec.FAR_FUTURE_EPOCH)
+    for i in range(n):
+        v.pubkey = i.to_bytes(8, "little") * 6
+        state.validators.append(v)
+        state.balances.append(spec.MAX_EFFECTIVE_BALANCE)
+    state.hash_tree_root()
+    # the largest level allowed to hash per pair: below the pair floor
+    # always; on a kernel-only backend also below the kernel batch
+    # threshold (can_batch_pairs — a gather the kernel won't take would
+    # just feed hashlib anyway)
+    layer_min, pair_min = merkle.batch_thresholds()
+    scalar_limit = pair_min if merkle._native is not None \
+        else max(pair_min, layer_min)
+
+    def oracle():
+        return type(state).decode_bytes(state.serialize()).hash_tree_root()
+
+    # 1. chunk-packed column commit (the vectorized epoch engine's path)
+    old = epoch_kernels.u64_column(state.balances)
+    new = old - np.uint64(1)
+    merkle.reset_stats()
+    t0 = time.time()
+    epoch_kernels._write_u64_list(state.balances, spec.Gwei, old, new)
+    with hash_forest():
+        root = state.hash_tree_root()
+    packed_s = time.time() - t0
+    packed_stats = merkle.stats()
+    assert root == oracle(), "packed commit root mismatch"
+    assert packed_stats["pair_scalar"] == 0, \
+        f"packed commit used per-pair hashlib: {packed_stats}"
+    assert packed_stats["layer_calls"] + packed_stats["pair_batch_calls"] > 0, \
+        f"packed commit never dispatched batched: {packed_stats}"
+
+    # 2. wide __setitem__ commit (the incremental dirty-pair engine)
+    merkle.reset_stats()
+    t0 = time.time()
+    for i in range(n):
+        state.balances[i] = int(state.balances[i]) - 1
+    with hash_forest():
+        root = state.hash_tree_root()
+    setitem_s = time.time() - t0
+    pair_stats = merkle.stats()
+    assert root == oracle(), "setitem commit root mismatch"
+    assert pair_stats["pair_batch_pairs"] > 0, \
+        f"wide update never batched: {pair_stats}"
+    assert pair_stats["pair_scalar_max"] < scalar_limit, \
+        f"an above-threshold level hashed per pair: {pair_stats}"
+
+    print(json.dumps({
+        "metric": f"merkle smoke, {n} validators", "backend": backend,
+        "packed_commit_s": round(packed_s, 4),
+        "packed_stats": packed_stats,
+        "setitem_commit_s": round(setitem_s, 4),
+        "setitem_stats": pair_stats,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -22,6 +22,7 @@ from consensus_specs_tpu.utils.ssz import (
     Bitlist, Bitvector, Vector, List, Container,
 )  # noqa: F401 (compiled-spec namespace)
 from consensus_specs_tpu.utils import bls
+from consensus_specs_tpu.utils.ssz.forest import hash_forest
 from consensus_specs_tpu.ops import epoch_kernels
 from . import register_fork
 from .fork_choice import ForkChoiceMixin
@@ -692,7 +693,8 @@ class Phase0Spec(ValidatorGuideMixin, ForkChoiceMixin):
         batch.assert_valid()
         # Verify state root
         if validate_result:
-            assert block.state_root == hash_tree_root(state)
+            with hash_forest():
+                assert block.state_root == hash_tree_root(state)
 
     def verify_block_signature(self, state, signed_block) -> bool:
         proposer = state.validators[signed_block.message.proposer_index]
@@ -710,8 +712,11 @@ class Phase0Spec(ValidatorGuideMixin, ForkChoiceMixin):
             state.slot = Slot(state.slot + 1)
 
     def process_slot(self, state) -> None:
-        # Cache state root
-        previous_state_root = hash_tree_root(state)
+        # Cache state root.  The forest scope batches the dirty re-hash
+        # level-aligned across every mutated tree of the state (balances,
+        # roots vectors, registry, ...) — see utils/ssz/forest.py.
+        with hash_forest():
+            previous_state_root = hash_tree_root(state)
         state.state_roots[state.slot % self.SLOTS_PER_HISTORICAL_ROOT] = previous_state_root
         # Cache latest block header state root
         if state.latest_block_header.state_root == Bytes32():

@@ -44,6 +44,7 @@ import numpy as np
 from consensus_specs_tpu.utils.lru import LRUDict
 from consensus_specs_tpu.utils.ssz import (
     hash_tree_root, sequence_items, replace_basic_items)
+from consensus_specs_tpu.utils.ssz import forest
 
 _U64_MAX = (1 << 64) - 1
 
@@ -125,19 +126,38 @@ _VALIDATOR_DTYPE = np.dtype([
 _COLS_CACHE = LRUDict(8)
 
 
+# forest column-stash field names -> _VALIDATOR_DTYPE keys
+_SHARED_FIELDS = (
+    ("effective_balance", "eff"), ("activation_eligibility_epoch", "aee"),
+    ("activation_epoch", "act"), ("exit_epoch", "ext"),
+    ("withdrawable_epoch", "wd"), ("slashed", "sl"))
+
+
 def validator_columns(state):
     """Extract (or fetch cached) the registry snapshot as one structured
-    uint64 array — a single python pass over the typed views instead of
-    one pass per consumer field."""
+    uint64 array.  First choice: the uint64 columns the hash-forest
+    columnar root build already extracted (``forest.peek_columns``,
+    generation-validated — the registry merkleization and the epoch
+    engine share one python pass over the typed views).  Fallback: a
+    single ``np.fromiter`` pass."""
     key = bytes(hash_tree_root(state.validators))
     cols = _COLS_CACHE.get(key)
     if cols is None:
         items = sequence_items(state.validators)
-        cols = np.fromiter(
-            ((v.effective_balance, v.activation_eligibility_epoch,
-              v.activation_epoch, v.exit_epoch, v.withdrawable_epoch,
-              bool(v.slashed)) for v in items),
-            dtype=_VALIDATOR_DTYPE, count=len(items))
+        shared = forest.peek_columns(state.validators)
+        if shared is not None and all(f in shared for f, _ in _SHARED_FIELDS):
+            cols = np.empty(len(items), dtype=_VALIDATOR_DTYPE)
+            for fname, col in _SHARED_FIELDS:
+                if col == "sl":
+                    cols[col] = shared[fname] != 0
+                else:
+                    cols[col] = shared[fname]
+        else:
+            cols = np.fromiter(
+                ((v.effective_balance, v.activation_eligibility_epoch,
+                  v.activation_epoch, v.exit_epoch, v.withdrawable_epoch,
+                  bool(v.slashed)) for v in items),
+                dtype=_VALIDATOR_DTYPE, count=len(items))
         _COLS_CACHE[key] = cols
     return cols
 
@@ -167,7 +187,11 @@ def _write_u64_list(seq, elem_type, old, new) -> None:
     cost.  Few changes -> targeted ``__setitem__`` (keeps the incremental
     chunk tree); registry-wide changes -> wholesale item swap, building
     the element objects through a value-dedup table (epoch deltas are
-    highly repetitive: equal-stake validators earn equal rewards)."""
+    highly repetitive: equal-stake validators earn equal rewards) and
+    committing chunk-level: the 32-byte leaf chunks are packed straight
+    from the column (``new.astype('<u8').tobytes()``) and bulk-fed to
+    the tree, so the commit materializes zero per-chunk python work and
+    re-hashes through the batched layer path."""
     changed = np.nonzero(old != new)[0]
     if changed.size == 0:
         return
@@ -183,7 +207,7 @@ def _write_u64_list(seq, elem_type, old, new) -> None:
         # int.__new__ skips BasicValue's range re-validation; the values
         # come out of a uint64 array, so the range holds by construction
         items = [int.__new__(elem_type, v) for v in new.tolist()]
-    replace_basic_items(seq, items)
+    replace_basic_items(seq, items, packed=new.astype("<u8").tobytes())
 
 
 # ---------------------------------------------------------------------------

@@ -120,12 +120,21 @@ def hash64_batch(data: bytes, n: int) -> bytes:
     This is the ``set_batched_hasher`` plug for the merkle engine
     (:mod:`consensus_specs_tpu.utils.ssz.merkle`).
     """
-    words = np.frombuffer(data, dtype=">u4").reshape(n, 16).astype(np.uint32)
+    return hash64_batch_np(
+        np.frombuffer(data, dtype=np.uint8).reshape(n, 64)).tobytes()
+
+
+def hash64_batch_np(rows: np.ndarray) -> np.ndarray:
+    """Array-path variant for the incremental engine's gathered dirty-pair
+    buffers (``set_batched_hasher_np``): ``(n, 64)`` uint8 message rows in,
+    ``(n, 32)`` uint8 digests out — no bytes round-trip on either side."""
+    n = rows.shape[0]
+    words = rows.view(">u4").astype(np.uint32)
     n_pad = _next_pow2(n)
     if n_pad != n:
         words = np.concatenate([words, np.zeros((n_pad - n, 16), np.uint32)])
     out = np.asarray(_hash64_words(jnp.asarray(words)))[:n]
-    return out.astype(">u4").tobytes()
+    return out.astype(">u4").view(np.uint8).reshape(n, 32)
 
 
 @functools.partial(jax.jit, static_argnames=("num_blocks",))
@@ -143,9 +152,11 @@ def sha256_blocks(blocks, num_blocks: int):
 
 
 def install_merkle_hasher() -> None:
-    """Route SSZ layer hashing through the batched kernel."""
+    """Route SSZ layer hashing through the batched kernel (both the
+    bytes-layer and the gathered-pair array entry points)."""
     from consensus_specs_tpu.utils.ssz import merkle
     merkle.set_batched_hasher(hash64_batch)
+    merkle.set_batched_hasher_np(hash64_batch_np)
 
 
 def sha256_bytes(msg: bytes) -> bytes:

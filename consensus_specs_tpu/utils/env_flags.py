@@ -4,3 +4,29 @@ import os
 
 # Heavy crypto tier gate (jit-compile-bound tests; ``make test-crypto``)
 HEAVY = os.environ.get("CS_TPU_HEAVY") == "1"
+
+
+def _int_env(name):
+    """Optional integer env knob: None when unset or non-numeric."""
+    raw = os.environ.get(name, "")
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+# Merkleization batching floor.  When set, overrides BOTH batching
+# thresholds in ``utils/ssz/merkle.py``: the kernel-layer threshold
+# (``_BATCH_THRESHOLD``, default 256 — 64-byte inputs above which a full
+# layer is dispatched to the batched JAX hasher instead of native C /
+# hashlib) and the dirty-pair batching floor (``_PAIR_BATCH_MIN``,
+# default 32 — dirty sibling pairs per tree level above which the
+# incremental engine gathers the level into one batched dispatch instead
+# of a per-pair hashlib loop).  ``CS_TPU_MERKLE_BATCH_MIN=1`` forces the
+# batched paths everywhere; a huge value forces the scalar paths.
+MERKLE_BATCH_MIN = _int_env("CS_TPU_MERKLE_BATCH_MIN")
+
+# Hash-forest batch scope kill switch: ``CS_TPU_HASH_FOREST=0`` turns
+# ``utils/ssz/forest.py`` scopes into no-ops (every tree flushes alone)
+# and disables the columnar bulk container-root path.
+HASH_FOREST = os.environ.get("CS_TPU_HASH_FOREST") != "0"

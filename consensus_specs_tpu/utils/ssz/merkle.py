@@ -11,11 +11,25 @@ natural batch boundary for the vectorized SHA-256 kernel
 (``consensus_specs_tpu.ops.sha256``) — a 1M-leaf tree becomes ~20 kernel
 calls instead of ~2M scalar hashes. A hashlib loop is the small-batch
 fallback.
+
+The incremental engine (:class:`IncrementalTree`) applies the same idea to
+*dirty* re-hashing: a mutation batch marks chunk paths dirty, and each tree
+level's dirty sibling pairs are gathered into one contiguous buffer and
+hashed in a single dispatch (native C indexed pair-gather, the JAX kernel,
+or — below :data:`_PAIR_BATCH_MIN` pairs — a per-pair hashlib loop).  A
+registry-wide balance update therefore re-hashes as ~40 batched calls, not
+~500k scalar ones.  ``utils/ssz/forest.py`` extends the batching across
+sibling trees of one state.
 """
 import ctypes
 import os
+from bisect import bisect_right
 from hashlib import sha256
 from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..env_flags import MERKLE_BATCH_MIN
 
 ZERO_CHUNK = b"\x00" * 32
 
@@ -37,7 +51,24 @@ def _load_native_hasher():
         return None
 
 
+def _probe_native_pairs(lib):
+    """The indexed pair-gather entry point (csrc sha256_merkle_pairs) —
+    absent in pre-rebuild .so files, in which case the numpy gather +
+    layer hash path is used instead."""
+    if lib is None:
+        return None
+    try:
+        fn = lib.sha256_merkle_pairs
+    except AttributeError:
+        return None
+    fn.argtypes = [ctypes.c_void_p, ctypes.c_size_t, ctypes.c_void_p,
+                   ctypes.c_size_t, ctypes.c_char_p, ctypes.c_void_p]
+    fn.restype = None
+    return fn
+
+
 _native = _load_native_hasher()
+_native_pairs = _probe_native_pairs(_native)
 
 # zero_hashes[i] = root of an all-zero subtree of depth i
 zero_hashes: List[bytes] = [ZERO_CHUNK]
@@ -46,10 +77,70 @@ for _ in range(64):
     zero_hashes.append(h)
 
 # Threshold (number of 64-byte parent inputs) above which layer hashing is
-# dispatched to the batched kernel instead of a hashlib loop.
-_BATCH_THRESHOLD = 256
+# dispatched to the batched kernel instead of a hashlib loop, and (pairs)
+# the dirty-pair count per level above which the incremental engine
+# gathers the level into one batched dispatch.  Both are overridden by
+# CS_TPU_MERKLE_BATCH_MIN (see utils/env_flags.py).
+_BATCH_THRESHOLD = 256 if MERKLE_BATCH_MIN is None else MERKLE_BATCH_MIN
+_PAIR_BATCH_MIN = 32 if MERKLE_BATCH_MIN is None else MERKLE_BATCH_MIN
 
 _batched_hasher = None
+_batched_hasher_np = None
+
+# Dispatch accounting, asserted by the bench-merkle smoke (a registry-wide
+# commit must hash through the batched paths, never a per-pair loop):
+#   pair_batch_calls / pair_batch_pairs — batched dispatches of gathered
+#       dirty sibling pairs (incremental engine + forest flushes +
+#       columnar container-root reductions), and the pairs they covered
+#   pair_scalar  — dirty pairs hashed one at a time through hashlib
+#   pair_scalar_max — largest batch that went through the scalar loop
+#       (must stay below the pair threshold: bigger ones must batch)
+#   layer_calls  — full-layer dispatches through the native C / JAX path
+#   layer_scalar — layer nodes that fell through to the hashlib loop
+_stats = {"pair_batch_calls": 0, "pair_batch_pairs": 0, "pair_scalar": 0,
+          "pair_scalar_max": 0, "layer_calls": 0, "layer_scalar": 0}
+
+
+def stats() -> dict:
+    return dict(_stats)
+
+
+def reset_stats() -> None:
+    for k in _stats:
+        _stats[k] = 0
+
+
+def set_batch_thresholds(layer: Optional[int] = None,
+                         pairs: Optional[int] = None) -> None:
+    """Override the batching thresholds at runtime (tests force both the
+    batched and the scalar code paths through this)."""
+    global _BATCH_THRESHOLD, _PAIR_BATCH_MIN
+    if layer is not None:
+        _BATCH_THRESHOLD = layer
+    if pairs is not None:
+        _PAIR_BATCH_MIN = pairs
+
+
+def batch_thresholds() -> tuple:
+    return (_BATCH_THRESHOLD, _PAIR_BATCH_MIN)
+
+
+def have_fast_backend() -> bool:
+    """True when layer hashing has a non-hashlib implementation to batch
+    into (native C or an installed kernel)."""
+    return (_native is not None or _batched_hasher is not None
+            or _batched_hasher_np is not None)
+
+
+def can_batch_pairs(n: int) -> bool:
+    """True when a batched backend will actually take ``n`` gathered
+    pairs: native C accepts any width; a kernel-only backend engages at
+    ``_BATCH_THRESHOLD`` — below it the gather would just feed a hashlib
+    loop, slower than hashing the pairs in place."""
+    if _native is not None:
+        return True
+    return ((_batched_hasher is not None or _batched_hasher_np is not None)
+            and n >= _BATCH_THRESHOLD)
 
 
 def set_batched_hasher(fn) -> None:
@@ -62,19 +153,57 @@ def set_batched_hasher(fn) -> None:
     _batched_hasher = fn
 
 
+def set_batched_hasher_np(fn) -> None:
+    """Install the array-path variant: fn(rows: (n, 64) uint8 ndarray) ->
+    (n, 32) uint8 digests.  Lets :func:`hash_rows` feed gathered pair
+    buffers to the kernel without a bytes round-trip."""
+    global _batched_hasher_np
+    _batched_hasher_np = fn
+
+
 def hash_layer(data: bytes) -> bytes:
     """Hash a full tree layer: data is n*64 bytes -> n*32 bytes."""
     n = len(data) // 64
     if _batched_hasher is not None and n >= _BATCH_THRESHOLD:
+        _stats["layer_calls"] += 1
         return _batched_hasher(data, n)
     if _native is not None and n > 1:
+        _stats["layer_calls"] += 1
         out = ctypes.create_string_buffer(n * 32)
         _native.sha256_merkle_layer(data, out, n)
         return out.raw
+    _stats["layer_scalar"] += n
     out = bytearray(n * 32)
     for i in range(n):
         out[i * 32:(i + 1) * 32] = sha256(data[i * 64:(i + 1) * 64]).digest()
     return bytes(out)
+
+
+def hash_rows(rows: np.ndarray) -> np.ndarray:
+    """Hash an ``(m, 64)`` uint8 array of parent inputs into ``(m, 32)``
+    digests in one batched dispatch.  The entry point for gathered
+    dirty-pair buffers (incremental engine, forest flushes, columnar
+    container-root reductions)."""
+    m = rows.shape[0]
+    if _batched_hasher_np is not None and m >= _BATCH_THRESHOLD:
+        _stats["pair_batch_calls"] += 1
+        _stats["pair_batch_pairs"] += m
+        _stats["layer_calls"] += 1
+        return _batched_hasher_np(np.ascontiguousarray(rows))
+    # derive the pair counters from the dispatch hash_layer ACTUALLY
+    # took (its layer_scalar delta), so a routing change there can never
+    # silently desynchronize the CI-asserted pair accounting
+    before_scalar = _stats["layer_scalar"]
+    digests = hash_layer(rows.tobytes())
+    scalar_nodes = _stats["layer_scalar"] - before_scalar
+    if scalar_nodes:
+        _stats["pair_scalar"] += scalar_nodes
+        if scalar_nodes > _stats["pair_scalar_max"]:
+            _stats["pair_scalar_max"] = scalar_nodes
+    else:
+        _stats["pair_batch_calls"] += 1
+        _stats["pair_batch_pairs"] += m
+    return np.frombuffer(digests, dtype=np.uint8).reshape(m, 32)
 
 
 def next_power_of_two(v: int) -> int:
@@ -85,6 +214,15 @@ def next_power_of_two(v: int) -> int:
 
 def ceil_log2(v: int) -> int:
     return (v - 1).bit_length() if v > 1 else 0
+
+
+def _padded_layer(layer, level: int) -> bytes:
+    """A layer as bytes, zero-subtree-padded to an even chunk count — the
+    odd-width rule shared by :func:`merkleize_chunks` and
+    :class:`IncrementalTree` bulk builds."""
+    if (len(layer) // 32) % 2 == 1:
+        return bytes(layer) + zero_hashes[level]
+    return layer if type(layer) is bytes else bytes(layer)
 
 
 def merkleize_chunks(chunks: Sequence[bytes], limit: Optional[int] = None) -> bytes:
@@ -109,11 +247,7 @@ def merkleize_chunks(chunks: Sequence[bytes], limit: Optional[int] = None) -> by
 
     layer = b"".join(chunks)
     for level in range(depth):
-        n = len(layer) // 32
-        if n % 2 == 1:
-            layer += zero_hashes[level]
-            n += 1
-        layer = hash_layer(layer)
+        layer = hash_layer(_padded_layer(layer, level))
     return layer
 
 
@@ -127,23 +261,30 @@ class IncrementalTree:
     whole tree.  Levels store only the occupied prefix; everything to the
     right is a precomputed ``zero_hashes`` entry.  Bulk construction goes
     through :func:`hash_layer` (native/batched SHA-256); incremental
-    updates use hashlib (a handful of pairs).
+    updates gather each level's dirty sibling pairs into one batched
+    dispatch above ``_PAIR_BATCH_MIN`` pairs, and fall back to a hashlib
+    loop for a handful of pairs.
     """
 
     __slots__ = ("depth", "levels")
 
-    def __init__(self, chunks: Sequence[bytes], limit: int):
+    def __init__(self, chunks, limit: int):
+        """``chunks``: a sequence of 32-byte chunks, or a pre-packed
+        bytes-like leaf buffer (whole chunks, the zero-copy bulk path)."""
         self.depth = ceil_log2(next_power_of_two(limit))
         self._build(chunks)
 
-    def _build(self, chunks: Sequence[bytes]) -> None:
-        levels = [bytearray(b"".join(chunks))]
+    def _build(self, chunks) -> None:
+        if isinstance(chunks, (bytes, bytearray, memoryview)):
+            data = chunks
+            if len(data) % 32 != 0:   # right-pad a packed buffer to chunks
+                data = bytes(data) + b"\x00" * (32 - len(data) % 32)
+        else:
+            data = b"".join(chunks)
+        levels = [bytearray(data)]
         for level in range(self.depth):
-            layer = levels[-1]
-            n = len(layer) // 32
-            if n % 2 == 1:
-                layer = layer + zero_hashes[level]
-            levels.append(bytearray(hash_layer(bytes(layer))))
+            levels.append(bytearray(hash_layer(_padded_layer(
+                levels[-1], level))))
         self.levels = levels
 
     @property
@@ -155,40 +296,143 @@ class IncrementalTree:
             return zero_hashes[self.depth]
         return bytes(self.levels[self.depth][:32])
 
-    def update(self, updates: dict) -> None:
-        """Apply ``{chunk_index: chunk_bytes}``; indices may extend the
-        occupied prefix by any amount (gaps zero-fill)."""
+    # -- leaf-layer bulk replacement ------------------------------------
+
+    def set_leaves(self, data) -> None:
+        """Replace the whole leaf layer with a pre-packed byte buffer
+        (right-padded to whole chunks here) and rebuild the upper levels
+        via batched layer hashing — the chunk-level commit path for
+        registry-wide column writes: zero per-chunk Python work."""
+        if (len(data) + 31) // 32 > (1 << self.depth):
+            raise ValueError("chunk count beyond tree limit")
+        self._build(data)
+
+    # -- incremental dirty-pair engine ----------------------------------
+
+    def apply_leaves(self, updates: dict) -> list:
+        """Write ``{chunk_index: chunk_bytes}`` into the leaf layer
+        (indices may extend the occupied prefix by any amount; gaps
+        zero-fill) and return the sorted dirty parent indices for
+        :meth:`rehash_up` — split out so a forest scope can align the
+        level re-hash across many trees."""
         if not updates:
-            return
-        from hashlib import sha256 as _sha
+            return []
         level0 = self.levels[0]
         hi = max(updates)
         if hi >= (1 << self.depth):
             raise ValueError("chunk index beyond tree limit")
         if (hi + 1) * 32 > len(level0):
             level0.extend(ZERO_CHUNK * (hi + 1 - len(level0) // 32))
-        dirty = set()
         for i, chunk in updates.items():
             level0[i * 32:(i + 1) * 32] = chunk
-            dirty.add(i >> 1)
+        return sorted({i >> 1 for i in updates})
+
+    def level_parents(self, level: int, parents: list) -> list:
+        """The prefix of (sorted) ``parents`` whose children are at least
+        partly occupied at ``level`` — parents of fully-virtual children
+        keep their zero-hash value — with the parent layer grown to cover
+        them."""
+        occ = len(self.levels[level]) // 32
+        if occ == 0:
+            return []
+        ps = parents[:bisect_right(parents, (occ - 1) // 2)]
+        if ps:
+            parent = self.levels[level + 1]
+            if (ps[-1] + 1) * 32 > len(parent):
+                parent.extend(zero_hashes[level + 1]
+                              * (ps[-1] + 1 - len(parent) // 32))
+        return ps
+
+    def gather_pairs(self, level: int, ps: list) -> np.ndarray:
+        """Gather the sibling pairs under parents ``ps`` into one
+        contiguous ``(n, 64)`` buffer (virtual right siblings read the
+        level's zero-subtree hash)."""
+        cur = self.levels[level]
+        occ = len(cur) // 32
+        arr = np.frombuffer(cur, dtype=np.uint8).reshape(-1, 32)
+        idx = np.asarray(ps, dtype=np.int64)
+        buf = np.empty((len(ps), 64), dtype=np.uint8)
+        buf[:, :32] = arr[2 * idx]
+        ri = 2 * idx + 1
+        real = ri < occ
+        buf[real, 32:] = arr[ri[real]]
+        if not real.all():
+            buf[~real, 32:] = np.frombuffer(zero_hashes[level],
+                                            dtype=np.uint8)
+        return buf
+
+    def scatter_level(self, level: int, ps: list, digests) -> list:
+        """Write ``digests`` (``(n, 32)`` uint8 or n*32 bytes) into the
+        parent layer at ``ps`` and return the sorted grandparent set."""
+        parent = self.levels[level + 1]
+        out = np.frombuffer(parent, dtype=np.uint8).reshape(-1, 32)
+        if not isinstance(digests, np.ndarray):
+            digests = np.frombuffer(digests, dtype=np.uint8).reshape(-1, 32)
+        out[np.asarray(ps, dtype=np.int64)] = digests
+        nxt, last = [], -1
+        for p in ps:
+            g = p >> 1
+            if g != last:
+                nxt.append(g)
+                last = g
+        return nxt
+
+    def _native_pair_hash(self, level: int, ps: list) -> np.ndarray:
+        """Hash the pairs under ``ps`` through the C indexed pair-gather
+        entry point — no Python-side copy of the level buffer."""
+        cur = self.levels[level]
+        n = len(ps)
+        view = np.frombuffer(cur, dtype=np.uint8)
+        idx = np.asarray(ps, dtype=np.uint64)
+        out = ctypes.create_string_buffer(n * 32)
+        _native_pairs(view.ctypes.data, len(cur) // 32, idx.ctypes.data, n,
+                      zero_hashes[level], ctypes.addressof(out))
+        return np.frombuffer(out.raw, dtype=np.uint8).reshape(n, 32)
+
+    def _rehash_level(self, level: int, ps: list) -> list:
+        """Re-hash the parent nodes ``ps`` at one level: batched dispatch
+        above the pair threshold, per-pair hashlib below it."""
+        n = len(ps)
+        if n >= _PAIR_BATCH_MIN and can_batch_pairs(n):
+            if _native_pairs is not None and not (
+                    _batched_hasher is not None and n >= _BATCH_THRESHOLD):
+                _stats["pair_batch_calls"] += 1
+                _stats["pair_batch_pairs"] += n
+                digests = self._native_pair_hash(level, ps)
+            else:
+                digests = hash_rows(self.gather_pairs(level, ps))
+            return self.scatter_level(level, ps, digests)
+        _stats["pair_scalar"] += n
+        if n > _stats["pair_scalar_max"]:
+            _stats["pair_scalar_max"] = n
+        cur, parent = self.levels[level], self.levels[level + 1]
+        occ = len(cur) // 32
+        nxt, last = [], -1
+        for p in ps:
+            li, ri = 2 * p, 2 * p + 1
+            left = bytes(cur[li * 32:(li + 1) * 32])
+            right = bytes(cur[ri * 32:(ri + 1) * 32]) \
+                if ri < occ else zero_hashes[level]
+            parent[p * 32:(p + 1) * 32] = sha256(left + right).digest()
+            g = p >> 1
+            if g != last:
+                nxt.append(g)
+                last = g
+        return nxt
+
+    def rehash_up(self, parents: list) -> None:
+        """Propagate dirty parent indices to the root, one level-batched
+        re-hash per level."""
         for level in range(self.depth):
-            cur, parent = self.levels[level], self.levels[level + 1]
-            next_dirty = set()
-            occ = len(cur) // 32
-            for p in sorted(dirty):
-                li, ri = 2 * p, 2 * p + 1
-                if li * 32 >= len(cur):
-                    break  # parent of fully-virtual children stays zero-hash
-                left = bytes(cur[li * 32:(li + 1) * 32])
-                right = bytes(cur[ri * 32:(ri + 1) * 32]) \
-                    if ri < occ else zero_hashes[level]
-                node = _sha(left + right).digest()
-                if (p + 1) * 32 > len(parent):
-                    parent.extend(zero_hashes[level + 1]
-                                  * (p + 1 - len(parent) // 32))
-                parent[p * 32:(p + 1) * 32] = node
-                next_dirty.add(p >> 1)
-            dirty = next_dirty
+            parents = self.level_parents(level, parents)
+            if not parents:
+                return
+            parents = self._rehash_level(level, parents)
+
+    def update(self, updates: dict) -> None:
+        """Apply ``{chunk_index: chunk_bytes}``; indices may extend the
+        occupied prefix by any amount (gaps zero-fill)."""
+        self.rehash_up(self.apply_leaves(updates))
 
     def truncate(self, count: int) -> None:
         """Shrink the occupied prefix to ``count`` chunks (pop support):
@@ -200,20 +444,12 @@ class IncrementalTree:
         # re-hash the path of the last surviving chunk and every dropped
         # parent edge: rebuilding the right edge level by level
         for level in range(self.depth):
-            cur, parent = self.levels[level], self.levels[level + 1]
+            cur = self.levels[level]
             n_parent = (len(cur) // 32 + 1) // 2
-            self.levels[level + 1] = parent[:n_parent * 32]
-            parent = self.levels[level + 1]
+            self.levels[level + 1] = self.levels[level + 1][:n_parent * 32]
             if n_parent == 0:
                 continue
-            p = n_parent - 1
-            li, ri = 2 * p, 2 * p + 1
-            occ = len(cur) // 32
-            left = bytes(cur[li * 32:(li + 1) * 32])
-            right = bytes(cur[ri * 32:(ri + 1) * 32]) \
-                if ri < occ else zero_hashes[level]
-            from hashlib import sha256 as _sha
-            parent[p * 32:(p + 1) * 32] = _sha(left + right).digest()
+            self._rehash_level(level, [n_parent - 1])
 
     def copy(self) -> "IncrementalTree":
         new = object.__new__(IncrementalTree)

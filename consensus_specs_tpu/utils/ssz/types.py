@@ -480,7 +480,8 @@ def _pack_basic(values, elem_type) -> bytes:
 
 
 class _SequenceBase(SSZValue):
-    __slots__ = ("_items", "_root_memo", "_tree", "_dirty", "_owner")
+    __slots__ = ("_items", "_root_memo", "_tree", "_dirty", "_owner", "_gen",
+                 "_hash_memo")
     elem_type: type = None
 
     def _coerce_items(self, values):
@@ -495,32 +496,49 @@ class _SequenceBase(SSZValue):
         if tree is not None:
             self._dirty.add(key)
         self._root_memo = None
+        # mutation generation: validates forest-stashed column snapshots
+        self._gen = getattr(self, "_gen", 0) + 1
         _notify_owner(self)
 
     def _drop_tree(self) -> None:
-        """Structural change the incremental path doesn't model (shrink):
-        fall back to a full rebuild on next root."""
+        """Structural change the incremental path doesn't model (full
+        replacement, empty shrink): fall back to a rebuild on next root."""
         object.__setattr__(self, "_tree", None)
         self._root_memo = None
+        self._gen = getattr(self, "_gen", 0) + 1
         _notify_owner(self)
 
-    def _chunks_for_items(self, indices=None):
-        """Leaf chunks for ``indices`` (None = all) as {chunk_idx: bytes}."""
+    def _chunks_for_items(self, indices):
+        """Leaf chunks for the element ``indices`` as {chunk_idx: bytes}.
+        Wide composite sets go columnar (one batched reduction for all
+        dirty element roots); full builds use :meth:`_leaf_data`."""
         et = type(self).elem_type
         if issubclass(et, BasicValue):
-            size = et.byte_length
-            per = 32 // size
-            if indices is None:
-                return dict(enumerate(
-                    pack_bytes_into_chunks(_pack_basic(self._items, et))))
+            per = 32 // et.byte_length
             out = {}
             for ci in {i // per for i in indices}:
                 seg = self._items[ci * per:(ci + 1) * per]
                 out[ci] = _pack_basic(seg, et).ljust(32, b"\x00")
             return out
-        if indices is None:
-            return dict(enumerate(x.hash_tree_root() for x in self._items))
+        if len(indices) >= forest._COLUMNAR_MIN:
+            idx = sorted(indices)
+            data = forest.bulk_element_root_bytes(
+                [self._items[i] for i in idx], et)
+            if data is not None:
+                return {i: data[k * 32:(k + 1) * 32]
+                        for k, i in enumerate(idx)}
         return {i: self._items[i].hash_tree_root() for i in indices}
+
+    def _leaf_data(self):
+        """The full leaf layer as one packed byte buffer (the zero-copy
+        bulk-build path: no per-chunk dict or list is materialized)."""
+        et = type(self).elem_type
+        if issubclass(et, BasicValue):
+            return _pack_basic(self._items, et)   # tree pads to chunks
+        data = forest.bulk_element_root_bytes(self._items, et, self)
+        if data is not None:
+            return data
+        return b"".join(x.hash_tree_root() for x in self._items)
 
     def _limit_chunks(self) -> int:
         et = type(self).elem_type
@@ -538,25 +556,37 @@ class _SequenceBase(SSZValue):
         object.__setattr__(new, "_dirty", set(getattr(self, "_dirty", ())))
         new._root_memo = getattr(self, "_root_memo", None)
 
+    def _apply_dirty_leaves(self):
+        """Flush pending dirty chunks into the backing tree's leaf layer
+        and return ``(tree, sorted_dirty_parents)`` for the deferred
+        level re-hash — the forest scope's per-tree entry point, so the
+        upward hashing can be gathered across sibling trees.  None when
+        nothing is pending."""
+        tree = getattr(self, "_tree", None)
+        if tree is None or not self._dirty:
+            return None
+        et = type(self).elem_type
+        per = 32 // et.byte_length if issubclass(et, BasicValue) else 1
+        n_chunks = (len(self._items) + per - 1) // per
+        if tree.count > n_chunks:
+            tree.truncate(n_chunks)
+        live = {i for i in self._dirty if i < len(self._items)}
+        self._dirty.clear()
+        parents = tree.apply_leaves(self._chunks_for_items(live))
+        return (tree, parents) if parents else None
+
     def _tree_root(self) -> bytes:
         """Chunk-tree root (before any length mix-in), incrementally
-        maintained: only dirty chunk paths re-hash."""
+        maintained: only dirty chunk paths re-hash, level-batched."""
         tree = getattr(self, "_tree", None)
         if tree is None:
-            tree = IncrementalTree(
-                list(self._chunks_for_items(None).values()),
-                self._limit_chunks())
+            tree = IncrementalTree(self._leaf_data(), self._limit_chunks())
             object.__setattr__(self, "_tree", tree)
             object.__setattr__(self, "_dirty", set())
         elif self._dirty:
-            et = type(self).elem_type
-            per = 32 // et.byte_length if issubclass(et, BasicValue) else 1
-            n_chunks = (len(self._items) + per - 1) // per
-            if tree.count > n_chunks:
-                tree.truncate(n_chunks)
-            live = {i for i in self._dirty if i < len(self._items)}
-            self._dirty.clear()
-            tree.update(self._chunks_for_items(live))
+            job = self._apply_dirty_leaves()
+            if job is not None:
+                job[0].rehash_up(job[1])
         return tree.root()
 
     def __len__(self):
@@ -595,7 +625,20 @@ class _SequenceBase(SSZValue):
         return NotImplemented
 
     def __hash__(self):
-        return hash(tuple(bytes(x.serialize()) for x in self._items))
+        # Must stay consistent with __eq__, which compares only
+        # (elem_type, items) — NOT the sequence class's limit/length — so
+        # a List[u64, 8] equals a List[u64, 16] with the same values and
+        # they must hash alike; the tree root (which commits to the
+        # limit) is therefore NOT a valid hash key.  The content hash is
+        # memoized against the mutation generation, so repeated hashing
+        # is O(1); the old form serialized every element on each call.
+        memo = getattr(self, "_hash_memo", None)
+        gen = getattr(self, "_gen", 0)
+        if memo is not None and memo[0] == gen:
+            return memo[1]
+        h = hash(tuple(self._items))
+        self._hash_memo = (gen, h)
+        return h
 
     def index(self, v):
         return self._items.index(v)
@@ -765,9 +808,13 @@ class ListBase(_SequenceBase):
 
     def pop(self):
         v = self._items.pop()
-        # shrink isn't modeled incrementally (the vacated chunk and its
-        # path must revert); rebuild on next root
-        self._drop_tree()
+        if self._items and getattr(self, "_tree", None) is not None:
+            # shrink-by-one is modeled incrementally: marking the new
+            # right-edge element dirty makes the next flush truncate the
+            # tree and rewrite the (possibly partial) edge chunk
+            self._mark_child_dirty(len(self._items) - 1)
+        else:
+            self._drop_tree()
         return v
 
     def serialize(self) -> bytes:
@@ -950,6 +997,10 @@ class Container(SSZValue, metaclass=_ContainerMeta):
         cached = object.__getattribute__(self, "_root_cache")
         if cached is not None:
             return cached
+        if forest.scope_active():
+            # batch scope: flush every dirty subtree of this forest
+            # level-aligned before the recursive walk reads their roots
+            forest.flush_container(self)
         chunks = [getattr(self, f).hash_tree_root() for f in type(self)._fields]
         root = merkleize_chunks(chunks)
         object.__setattr__(self, "_root_cache", root)
@@ -1103,16 +1154,20 @@ def sequence_items(seq):
     return seq._items
 
 
-def replace_basic_items(seq, items) -> None:
+def replace_basic_items(seq, items, packed=None) -> None:
     """Bulk-swap every element of a basic-element List/Vector.
 
     ``items`` must be a list of already-coerced ``elem_type`` instances
     (the epoch engine builds them straight from validated uint64 numpy
     columns); per-element ``coerce``+dirty-marking — the O(n) python cost
-    a registry-wide ``seq[i] = v`` loop pays — is skipped wholesale.  The
-    cached chunk tree is dropped, so the next root is a fresh chunk-level
-    merkleization: the same hashing bill the incremental path pays when
-    every chunk is dirty, without the python-level bookkeeping.
+    a registry-wide ``seq[i] = v`` loop pays — is skipped wholesale.
+
+    ``packed``, when given, must be the items' concatenated little-endian
+    serialization (e.g. ``column.astype('<u8').tobytes()``): the cached
+    chunk tree is then rebuilt chunk-level straight from the buffer
+    through batched layer hashing — a registry-wide commit materializes
+    zero per-chunk python work.  Without it the tree is dropped and the
+    next root pays a fresh (still batched, but python-packed) rebuild.
     """
     et = type(seq).elem_type
     if not issubclass(et, BasicValue):
@@ -1125,5 +1180,27 @@ def replace_basic_items(seq, items) -> None:
         raise ValueError(f"{type(seq).__name__}: {len(items)} exceeds limit")
     if items and not (isinstance(items[0], et) and isinstance(items[-1], et)):
         raise TypeError(f"replace_basic_items: want {et.__name__} elements")
+    if packed is not None and len(packed) != len(items) * et.byte_length:
+        # validate BEFORE the swap: a rejected commit must leave the
+        # sequence (items, tree, memo) fully untouched
+        raise ValueError("replace_basic_items: packed length mismatch")
     object.__setattr__(seq, "_items", list(items))
-    seq._drop_tree()
+    if packed is None:
+        seq._drop_tree()
+        return
+    tree = getattr(seq, "_tree", None)
+    if tree is None:
+        object.__setattr__(seq, "_tree",
+                           IncrementalTree(packed, seq._limit_chunks()))
+    else:
+        tree.set_leaves(packed)
+    object.__setattr__(seq, "_dirty", set())
+    seq._root_memo = None
+    seq._gen = getattr(seq, "_gen", 0) + 1
+    _notify_owner(seq)
+
+
+# Bottom import: forest.py needs the class definitions above (it walks
+# Container/_SequenceBase instances); by this point the module namespace
+# is complete, so the circular reference resolves either import order.
+from . import forest  # noqa: E402

@@ -169,10 +169,18 @@ static void compress_shani(uint32_t state[8], const uint8_t *block) {
     _mm_storeu_si128((__m128i *)&state[4], STATE1);
 }
 
+#include <cpuid.h>
+
+/* CPUID leaf 7 EBX bit 29 = SHA extensions.  Probed directly instead of
+ * __builtin_cpu_supports("sha"): gcc < 11 rejects the "sha" feature
+ * string, which used to fail the whole `make native` build. */
 static int has_shani(void) {
     static int cached = -1;
-    if (cached < 0)
-        cached = __builtin_cpu_supports("sha") ? 1 : 0;
+    if (cached < 0) {
+        unsigned int a = 0, b = 0, c = 0, d = 0;
+        cached = (__get_cpuid_count(7, 0, &a, &b, &c, &d) && (b >> 29) & 1)
+            ? 1 : 0;
+    }
     return cached;
 }
 #else
@@ -207,6 +215,50 @@ void sha256_merkle_layer(const uint8_t *in, uint8_t *out, size_t n) {
             compress(st, PAD_BLOCK);
         }
         uint8_t *o = out + 32 * i;
+        for (int j = 0; j < 8; j++) {
+            o[4 * j] = (uint8_t)(st[j] >> 24);
+            o[4 * j + 1] = (uint8_t)(st[j] >> 16);
+            o[4 * j + 2] = (uint8_t)(st[j] >> 8);
+            o[4 * j + 3] = (uint8_t)st[j];
+        }
+    }
+}
+
+/* Indexed pair-gather hasher for the incremental dirty-subtree engine
+ * (consensus_specs_tpu/utils/ssz/merkle.py IncrementalTree): for each
+ * parent index p in `parents`, hash the 64-byte sibling pair at chunk
+ * indices (2p, 2p+1) of `level` into out[32*k].  `occ` is the occupied
+ * chunk count of the level; a right sibling at or beyond it is virtual
+ * and reads from `zero` (the level's zero-subtree hash).  The gather
+ * happens here, so a sparse dirty set costs no Python-side copy of the
+ * level buffer. */
+void sha256_merkle_pairs(const uint8_t *level, size_t occ,
+                         const uint64_t *parents, size_t n,
+                         const uint8_t *zero, uint8_t *out) {
+    int ni = has_shani();
+    uint8_t pair[64];
+    for (size_t k = 0; k < n; k++) {
+        size_t li = 2 * parents[k], ri = li + 1;
+        const uint8_t *block;
+        if (ri < occ) {
+            block = level + 32 * li;
+        } else {
+            memcpy(pair, level + 32 * li, 32);
+            memcpy(pair + 32, zero, 32);
+            block = pair;
+        }
+        uint32_t st[8] = {
+            0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+            0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
+        };
+        if (ni) {
+            compress_shani(st, block);
+            compress_shani(st, PAD_BLOCK);
+        } else {
+            compress(st, block);
+            compress(st, PAD_BLOCK);
+        }
+        uint8_t *o = out + 32 * k;
         for (int j = 0; j < 8; j++) {
             o[4 * j] = (uint8_t)(st[j] >> 24);
             o[4 * j + 1] = (uint8_t)(st[j] >> 16);

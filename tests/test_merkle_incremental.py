@@ -1,0 +1,381 @@
+"""Level-batched incremental merkleization: differential suite.
+
+The dirty-subtree engine (``utils/ssz/merkle.IncrementalTree``), the
+hash-forest batch scope (``utils/ssz/forest``) and the columnar
+container-root path must produce roots byte-identical to a from-scratch
+``merkleize_chunks`` rebuild (and, for typed values, to the
+``decode_bytes(serialize())`` oracle — a fresh value with no caches) after
+ARBITRARY interleavings of update/truncate/copy/append/pop — with the
+batched dispatch forced both ON and OFF.  A divergence is a consensus bug.
+
+The batched path is forced without any native/JAX dependency by
+installing a hashlib-backed batched hasher, so this suite exercises the
+gather/scatter machinery on every host.
+"""
+import os
+import random
+import subprocess
+import sys
+from hashlib import sha256
+
+import pytest
+
+from consensus_specs_tpu.utils.ssz import merkle
+from consensus_specs_tpu.utils.ssz.merkle import (
+    IncrementalTree, merkleize_chunks, zero_hashes)
+from consensus_specs_tpu.utils.ssz import (
+    Bitlist, Bytes32, Bytes48, Container, List, Vector,
+    boolean, uint64, replace_basic_items)
+from consensus_specs_tpu.utils.ssz import forest
+from consensus_specs_tpu.utils.ssz.forest import hash_forest
+
+
+def _py_batched(data: bytes, n: int) -> bytes:
+    """A dependency-free 'batched' hasher: lets the suite force the
+    gather/scatter dispatch machinery even when neither the native lib
+    nor the JAX kernel is available."""
+    return b"".join(sha256(data[i * 64:(i + 1) * 64]).digest()
+                    for i in range(n))
+
+
+@pytest.fixture(params=["batched", "scalar"])
+def dispatch_mode(request):
+    """Run the test body under both dispatch regimes: every pair/layer
+    batched (threshold 1, synthetic batched hasher installed), and the
+    pure per-pair hashlib path (threshold never reached)."""
+    prev = merkle._batched_hasher
+    prev_np = merkle._batched_hasher_np
+    prev_thresholds = merkle.batch_thresholds()
+    if request.param == "batched":
+        merkle.set_batched_hasher(_py_batched)
+        merkle.set_batched_hasher_np(None)
+        merkle.set_batch_thresholds(layer=1, pairs=1)
+    else:
+        merkle.set_batched_hasher(None)
+        merkle.set_batched_hasher_np(None)
+        merkle.set_batch_thresholds(layer=10**9, pairs=10**9)
+    yield request.param
+    merkle.set_batched_hasher(prev)
+    merkle.set_batched_hasher_np(prev_np)
+    merkle.set_batch_thresholds(*prev_thresholds)
+
+
+class Inner(Container):
+    pubkey: Bytes48
+    wc: Bytes32
+    eff: uint64
+    slashed: boolean
+
+
+class Holder(Container):
+    nums: List[uint64, 1 << 30]
+    inners: List[Inner, 1 << 30]
+    fixed: Vector[Bytes32, 32]
+    bits: Bitlist[512]
+    tag: uint64
+
+
+def _fresh_root(v):
+    return type(v).decode_bytes(v.serialize()).hash_tree_root()
+
+
+# ---------------------------------------------------------------------------
+# IncrementalTree vs merkleize_chunks
+# ---------------------------------------------------------------------------
+
+def test_incremental_tree_randomized_differential(dispatch_mode):
+    rng = random.Random(20260803)
+    for limit in (64, 4096):
+        chunks = [rng.randbytes(32) for _ in range(rng.randrange(0, 40))]
+        t = IncrementalTree(chunks, limit)
+        for step in range(120):
+            op = rng.randrange(10)
+            if op < 6:     # update: sparse or wide, may extend with gaps
+                width = rng.choice([1, 2, 7, 40, 150])
+                hi = min(limit - 1, len(chunks) + rng.randrange(0, 30))
+                ups = {rng.randrange(hi + 1): rng.randbytes(32)
+                       for _ in range(width)}
+                for i, c in ups.items():
+                    while len(chunks) <= i:
+                        chunks.append(b"\x00" * 32)
+                    chunks[i] = c
+                t.update(ups)
+            elif op < 8 and chunks:    # truncate
+                keep = rng.randrange(0, len(chunks))
+                chunks = chunks[:keep]
+                t.truncate(keep)
+            elif op == 8:              # copy: divergence must not leak
+                t2 = t.copy()
+                t2.update({0: rng.randbytes(32)})
+                t = t.copy()
+            else:                      # bulk leaf replacement
+                chunks = [rng.randbytes(32)
+                          for _ in range(rng.randrange(0, min(90, limit)))]
+                t.set_leaves(b"".join(chunks))
+            assert t.root() == merkleize_chunks(chunks, limit=limit), \
+                (dispatch_mode, limit, step, op)
+
+
+def test_empty_and_zero_edges(dispatch_mode):
+    t = IncrementalTree([], 4096)
+    assert t.root() == zero_hashes[12]
+    t.update({0: b"\x01" * 32})
+    assert t.root() == merkleize_chunks([b"\x01" * 32], limit=4096)
+    t.truncate(0)
+    assert t.root() == zero_hashes[12]
+
+
+# ---------------------------------------------------------------------------
+# Typed SSZ values: interleaved mutations vs the no-cache oracle
+# ---------------------------------------------------------------------------
+
+def test_ssz_randomized_differential(dispatch_mode):
+    rng = random.Random(77)
+    v = Holder(
+        nums=list(range(300)),
+        inners=[Inner(eff=i, pubkey=bytes([i % 251]) * 48)
+                for i in range(280)],
+        bits=[True, False] * 40,
+    )
+    assert v.hash_tree_root() == _fresh_root(v)
+
+    def mutate():
+        op = rng.randrange(12)
+        if op == 0:
+            v.nums[rng.randrange(len(v.nums))] = rng.randrange(2 ** 64)
+        elif op == 1:
+            v.nums.append(rng.randrange(2 ** 64))
+        elif op == 2 and len(v.nums) > 1:
+            v.nums.pop()
+        elif op == 3:
+            v.inners[rng.randrange(len(v.inners))].eff = rng.randrange(2 ** 64)
+        elif op == 4:
+            v.inners[rng.randrange(len(v.inners))] = Inner(
+                eff=rng.randrange(2 ** 64), wc=rng.randbytes(32))
+        elif op == 5:
+            v.inners.append(Inner(eff=rng.randrange(2 ** 64)))
+        elif op == 6 and len(v.inners) > 1:
+            v.inners.pop()
+        elif op == 7:
+            v.fixed[rng.randrange(32)] = rng.randbytes(32)
+        elif op == 8:
+            v.bits[rng.randrange(len(v.bits))] = rng.randrange(2)
+        elif op == 9:
+            # wide mutation burst: enough dirty chunks to cross batching
+            # thresholds inside one flush
+            for i in range(0, len(v.nums), 2):
+                v.nums[i] = rng.randrange(2 ** 64)
+        elif op == 10:
+            for i in range(0, len(v.inners), 3):
+                v.inners[i].slashed = rng.randrange(2)
+        else:
+            v.tag = rng.randrange(2 ** 64)
+
+    for step in range(140):
+        mutate()
+        if step % 4 == 0:
+            use_forest = step % 8 == 0
+            if use_forest:
+                with hash_forest():
+                    got = v.hash_tree_root()
+            else:
+                got = v.hash_tree_root()
+            assert got == _fresh_root(v), (dispatch_mode, step, use_forest)
+    assert v.hash_tree_root() == _fresh_root(v)
+
+
+def test_copies_stay_independent_under_batching(dispatch_mode):
+    v = Holder(nums=list(range(100)),
+               inners=[Inner(eff=i) for i in range(60)])
+    r0 = v.hash_tree_root()
+    c = v.copy()
+    for i in range(0, 100, 2):
+        c.nums[i] = 7
+    c.inners[3].eff = 123456
+    with hash_forest():
+        rc = c.hash_tree_root()
+    assert v.hash_tree_root() == r0
+    assert rc == _fresh_root(c) != r0
+
+
+def test_packed_commit_rejection_leaves_sequence_untouched():
+    a = Holder(nums=[1, 2, 3, 4])
+    r0 = a.hash_tree_root()
+    with pytest.raises(ValueError):
+        replace_basic_items(a.nums, [uint64(9), uint64(8)], packed=b"\x07")
+    assert list(a.nums) == [1, 2, 3, 4]      # no partial swap
+    assert a.hash_tree_root() == r0 == _fresh_root(a)
+
+
+def test_packed_bulk_commit_matches_setitem(dispatch_mode):
+    np = pytest.importorskip("numpy")
+    a = Holder(nums=list(range(512)))
+    b = Holder(nums=list(range(512)))
+    a.hash_tree_root(), b.hash_tree_root()   # warm both trees
+    col = np.arange(512, dtype=np.uint64) * np.uint64(3)
+    items = [uint64(int(x)) for x in col.tolist()]
+    replace_basic_items(a.nums, items, packed=col.astype("<u8").tobytes())
+    for i in range(512):
+        b.nums[i] = int(col[i])
+    assert a.hash_tree_root() == b.hash_tree_root() == _fresh_root(a)
+
+
+# ---------------------------------------------------------------------------
+# Columnar bulk container roots
+# ---------------------------------------------------------------------------
+
+def test_bulk_element_roots_match_per_object(dispatch_mode):
+    rng = random.Random(5)
+    items = [Inner(pubkey=rng.randbytes(48), wc=rng.randbytes(32),
+                   eff=rng.randrange(2 ** 64), slashed=rng.randrange(2))
+             for _ in range(400)]
+    data = forest.bulk_element_root_bytes(items, Inner)
+    if data is None:    # CS_TPU_HASH_FOREST=0 run: nothing to compare
+        pytest.skip("columnar path disabled")
+    for k, x in enumerate(items):
+        assert data[k * 32:(k + 1) * 32] == _fresh_root(x), k
+
+
+def test_bulk_byte_vector_roots(dispatch_mode):
+    rng = random.Random(6)
+    for typ, size in ((Bytes32, 32), (Bytes48, 48)):
+        items = [typ(rng.randbytes(size)) for _ in range(300)]
+        data = forest.bulk_element_root_bytes(items, typ)
+        if data is None:
+            pytest.skip("columnar path disabled")
+        for k, x in enumerate(items):
+            assert data[k * 32:(k + 1) * 32] == x.hash_tree_root(), (size, k)
+
+
+def test_columnar_fallback_field_kinds():
+    """A container with a field the column planner cannot vectorize
+    (a nested list -> per-object 'root' kind) still bulk-roots exactly."""
+    class Odd(Container):
+        xs: List[uint64, 64]
+        tag: uint64
+
+    items = [Odd(xs=list(range(i % 5)), tag=i) for i in range(300)]
+    data = forest.bulk_element_root_bytes(items, Odd)
+    if data is None:
+        pytest.skip("columnar path disabled")
+    for k, x in enumerate(items):
+        assert data[k * 32:(k + 1) * 32] == _fresh_root(x), k
+
+
+# ---------------------------------------------------------------------------
+# All 12 forks: post-update state roots vs full re-merkleization
+# ---------------------------------------------------------------------------
+
+ALL_FORKS = ["phase0", "sharding", "custody_game", "altair", "bellatrix",
+             "capella", "deneb", "eip6110", "eip7002", "eip7594", "whisk",
+             "eip6914"]
+
+_SPEC_CACHE = {}
+
+
+def _spec(fork):
+    if fork not in _SPEC_CACHE:
+        from consensus_specs_tpu.forks import build_spec
+        _SPEC_CACHE[fork] = build_spec(fork, "minimal")
+    return _SPEC_CACHE[fork]
+
+
+@pytest.mark.parametrize("fork", ALL_FORKS)
+def test_fork_state_roots_differential(fork, dispatch_mode):
+    from consensus_specs_tpu.test_infra.genesis import create_genesis_state
+    spec = _spec(fork)
+    rng = random.Random(hash(fork) & 0xFFFF)
+    state = create_genesis_state(
+        spec, [spec.MAX_EFFECTIVE_BALANCE] * 32, spec.MAX_EFFECTIVE_BALANCE)
+    with hash_forest():
+        assert state.hash_tree_root() == _fresh_root(state)
+    # mutate across sibling trees: balances column, registry fields,
+    # roots vectors, slot — then re-root incrementally vs the oracle
+    for i in range(0, 32, 2):
+        state.balances[i] = int(state.balances[i]) - rng.randrange(10 ** 6)
+    for i in range(0, 32, 5):
+        state.validators[i].effective_balance = \
+            int(spec.MAX_EFFECTIVE_BALANCE) - 10 ** 9
+        state.validators[i].slashed = True
+    state.block_roots[3] = rng.randbytes(32)
+    state.state_roots[7] = rng.randbytes(32)
+    state.slot = 17
+    with hash_forest():
+        got = state.hash_tree_root()
+    assert got == _fresh_root(state), (fork, dispatch_mode)
+    # and again without the forest scope (plain incremental path)
+    state.balances[1] = 7
+    assert state.hash_tree_root() == _fresh_root(state), (fork, dispatch_mode)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch accounting: wide commits must never hashlib per pair
+# ---------------------------------------------------------------------------
+
+def test_wide_update_batches_with_zero_scalar_pairs():
+    prev = merkle._batched_hasher
+    prev_np = merkle._batched_hasher_np
+    prev_thresholds = merkle.batch_thresholds()
+    merkle.set_batched_hasher(_py_batched)
+    merkle.set_batched_hasher_np(None)
+    merkle.set_batch_thresholds(layer=1, pairs=1)
+    try:
+        v = Holder(nums=list(range(4096)))
+        v.hash_tree_root()
+        for i in range(4096):
+            v.nums[i] = i * 2 + 1
+        merkle.reset_stats()
+        v.hash_tree_root()
+        stats = merkle.stats()
+        assert stats["pair_scalar"] == 0, stats
+        assert stats["pair_batch_pairs"] > 0, stats
+    finally:
+        merkle.set_batched_hasher(prev)
+        merkle.set_batched_hasher_np(prev_np)
+        merkle.set_batch_thresholds(*prev_thresholds)
+
+
+# ---------------------------------------------------------------------------
+# Env-tunable thresholds (CS_TPU_MERKLE_BATCH_MIN)
+# ---------------------------------------------------------------------------
+
+def test_batch_min_env_overrides_both_thresholds():
+    code = ("from consensus_specs_tpu.utils.ssz import merkle; "
+            "print(merkle.batch_thresholds())")
+    env = dict(os.environ, CS_TPU_MERKLE_BATCH_MIN="7", JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, check=True)
+    assert out.stdout.strip() == "(7, 7)"
+    env.pop("CS_TPU_MERKLE_BATCH_MIN")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, check=True)
+    assert out.stdout.strip() == "(256, 32)"
+
+
+# ---------------------------------------------------------------------------
+# _SequenceBase.__hash__: eq-consistent content hash, O(1) amortized
+# ---------------------------------------------------------------------------
+
+def test_sequence_hash_matches_eq_and_memoizes():
+    a = List[uint64, 1024](1, 2, 3)
+    b = List[uint64, 1024](1, 2, 3)
+    c = List[uint64, 1024](1, 2, 4)
+    assert a == b and hash(a) == hash(b)        # equal values collide
+    d = {a: "x"}
+    assert d[b] == "x" and c not in d           # dict/set usage works
+    assert len({a, b, c}) == 2
+    # __eq__ ignores the sequence class's limit/length; the hash must too
+    wide = List[uint64, 4096](1, 2, 3)
+    vec = Vector[uint64, 3]([1, 2, 3])
+    assert a == wide == vec
+    assert hash(a) == hash(wide) == hash(vec)
+    # memoized against the mutation generation: repeated hashing reuses,
+    # mutation recomputes
+    h0 = hash(a)
+    assert a._hash_memo[1] == h0
+    gen = getattr(a, "_gen", 0)
+    hash(a)
+    assert getattr(a, "_gen", 0) == gen         # no recompute churn
+    a[0] = 9
+    assert hash(a) != h0 or a._items != [1, 2, 3]
+    assert hash(a) == hash(List[uint64, 1024](9, 2, 3))
