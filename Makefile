@@ -6,7 +6,8 @@ GENERATORS = operations sanity finality rewards random forks epoch_processing \
              genesis ssz_static bls shuffling light_client kzg_4844 \
              fork_choice merkle_proof ssz_generic sync transition
 
-.PHONY: test citest test-crypto bench bench-all bench-merkle-smoke dryrun \
+.PHONY: test citest test-crypto bench bench-all bench-merkle-smoke \
+        bench-forkchoice-smoke dryrun \
         warm native lint speclint-baseline \
         generate_tests $(addprefix gen_,$(GENERATORS)) clean-vectors pyspec
 
@@ -23,6 +24,7 @@ test:
 citest:
 	-$(MAKE) native
 	$(PYTHON) benchmarks/bench_merkle_smoke.py
+	$(PYTHON) benchmarks/bench_fork_choice.py --smoke
 	$(PYTHON) -m pytest tests/ -q --enable-bls --bls-type fastest
 
 # static checks: syntax gate + the speclint multi-pass analyzer
@@ -75,6 +77,13 @@ bench-epoch:
 bench-merkle-smoke:
 	-$(MAKE) native
 	$(PYTHON) benchmarks/bench_merkle_smoke.py
+
+# fork-choice dispatch smoke: head recomputes must run through the
+# proto-array engine (ZERO spec-loop fallbacks) and match the spec loop
+# byte-for-byte on every churn round (asserted via the
+# forkchoice/proto_array counters; nonzero exit on regression)
+bench-forkchoice-smoke:
+	$(PYTHON) benchmarks/bench_fork_choice.py --smoke
 
 dryrun:
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

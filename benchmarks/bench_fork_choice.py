@@ -1,0 +1,182 @@
+"""Fork-choice engine benchmark (``make bench-forkchoice-smoke`` runs
+the counter-asserted smoke shape in CI).
+
+Shape: N validators x M blocks (a deep multi-branch tree) x an
+attestation-churn stream.  Each round moves a slice of the validators'
+latest messages to new tips and recomputes the head twice — once
+through the incremental proto-array engine
+(``forkchoice/proto_array.py``), once through the spec loop — asserting
+byte-identical heads.  The spec loop pays O(blocks x validators) per
+recompute; the engine pays one columnar delta pass + one O(#nodes)
+sweep.
+
+Blocks are registered synthetically (no state transitions): this
+isolates fork-choice cost, the thing being measured.  The differential
+property is still enforced on every verified round, and in ``--smoke``
+mode the engine-hit counters must show the proto path really answered
+(ZERO fallbacks) or the process exits nonzero.
+"""
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_store(spec, n_validators):
+    from consensus_specs_tpu.utils.ssz import hash_tree_root
+    state = spec.BeaconState()
+    v = spec.Validator(
+        effective_balance=spec.MAX_EFFECTIVE_BALANCE,
+        exit_epoch=spec.FAR_FUTURE_EPOCH,
+        withdrawable_epoch=spec.FAR_FUTURE_EPOCH)
+    for i in range(n_validators):
+        v.pubkey = i.to_bytes(8, "little") * 6
+        state.validators.append(v)
+        state.balances.append(spec.MAX_EFFECTIVE_BALANCE)
+    anchor_block = spec.BeaconBlock(state_root=hash_tree_root(state))
+    store = spec.get_forkchoice_store(state, anchor_block)
+    return store, bytes(hash_tree_root(anchor_block))
+
+
+def register_block(spec, store, block, root):
+    """The on_block bookkeeping without the state transition: the block
+    joins blocks/timeliness/unrealized-justifications, the children
+    index, and the proto array."""
+    store.blocks[root] = block
+    store.block_states[root] = store.block_states[
+        bytes(store.justified_checkpoint.root)]
+    store.block_timeliness[root] = True
+    store.unrealized_justifications[root] = \
+        store.justified_checkpoint.copy()
+    store._fc_children.setdefault(bytes(block.parent_root), []).append(root)
+    store._fc_children_n = len(store.blocks)
+    eng = getattr(store, "_fc_proto", None)
+    if eng is not None:
+        eng.note_block(spec, store, root)
+
+
+def build_tree(spec, store, anchor_root, n_blocks, branches, rng):
+    """``branches`` chains forking off the anchor, round-robin extended
+    to ``n_blocks`` total — a deep tree with a branching point at the
+    base (the worst case for the spec loop's per-level get_weight)."""
+    from consensus_specs_tpu.utils.ssz import hash_tree_root
+    tips = [(anchor_root, 0)] * branches
+    blocks = []
+    for i in range(n_blocks):
+        b = i % branches
+        parent_root, parent_slot = tips[b]
+        block = spec.BeaconBlock(
+            slot=parent_slot + 1,
+            proposer_index=rng.randrange(16),
+            parent_root=parent_root,
+            state_root=i.to_bytes(32, "little"))
+        root = bytes(hash_tree_root(block))
+        register_block(spec, store, block, root)
+        tips[b] = (root, parent_slot + 1)
+        blocks.append(root)
+    store.time = (store.genesis_time
+                  + int(spec.config.SECONDS_PER_SLOT)
+                  * (max(s for _, s in tips) + int(spec.SLOTS_PER_EPOCH)))
+    return blocks, [r for r, _ in tips]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--validators", type=int, default=131072)
+    ap.add_argument("--blocks", type=int, default=128)
+    ap.add_argument("--branches", type=int, default=2)
+    ap.add_argument("--rounds", type=int, default=10,
+                    help="attestation-churn head recomputes (proto)")
+    ap.add_argument("--spec-rounds", type=int, default=2,
+                    help="rounds also measured+verified via the spec loop")
+    ap.add_argument("--churn", type=int, default=None,
+                    help="validators re-voting per round (default N/64)")
+    ap.add_argument("--assert-speedup", type=float, default=None,
+                    help="fail unless proto is at least this many times "
+                         "faster per head recompute")
+    ap.add_argument("--smoke", action="store_true",
+                    help="small CI shape + engine-hit counter asserts")
+    args = ap.parse_args()
+    if args.smoke:
+        args.validators, args.blocks, args.branches = 4096, 48, 3
+        args.rounds, args.spec_rounds = 6, 6
+    churn = args.churn or max(1, args.validators // 64)
+
+    from consensus_specs_tpu.forks import build_spec
+    from consensus_specs_tpu.forkchoice import proto_array
+    from consensus_specs_tpu.utils import bls
+    bls.bls_active = False
+    spec = build_spec("phase0", "minimal")
+    rng = random.Random(1337)
+
+    t0 = time.time()
+    store, anchor_root = build_store(spec, args.validators)
+    assert store._fc_proto is not None, \
+        "proto engine not attached (CS_TPU_PROTO_ARRAY=0?)"
+    blocks, tips = build_tree(spec, store, anchor_root, args.blocks,
+                              args.branches, rng)
+    # every validator votes some block in the deeper half of the tree
+    vote_pool = blocks[len(blocks) // 2:]
+    for i in range(args.validators):
+        store.latest_messages[i] = spec.LatestMessage(
+            epoch=1, root=rng.choice(vote_pool))
+    store._fc_proto.note_votes(range(args.validators))
+    setup_s = time.time() - t0
+
+    proto_array.reset_stats()
+    proto_s = spec_s = 0.0
+    spec_measured = 0
+    for r in range(args.rounds):
+        movers = rng.sample(range(args.validators), churn)
+        for i in movers:
+            store.latest_messages[i] = spec.LatestMessage(
+                epoch=2 + r, root=rng.choice(vote_pool))
+        store._fc_proto.note_votes(movers)
+        proto_array.use_proto()
+        t0 = time.time()
+        head_proto = bytes(spec.get_head(store))
+        proto_s += time.time() - t0
+        if r < args.spec_rounds:
+            proto_array.use_spec()
+            t0 = time.time()
+            head_spec = bytes(spec.get_head(store))
+            spec_s += time.time() - t0
+            spec_measured += 1
+            assert head_proto == head_spec, \
+                f"round {r}: engines disagree on the head"
+        proto_array.use_auto()
+
+    stats = proto_array.stats()
+    proto_per_head = proto_s / args.rounds
+    spec_per_head = spec_s / max(1, spec_measured)
+    speedup = spec_per_head / proto_per_head if proto_per_head else 0.0
+    result = {
+        "metric": "fork-choice head recompute",
+        "validators": args.validators, "blocks": args.blocks,
+        "branches": args.branches, "churn_per_round": churn,
+        "setup_s": round(setup_s, 3),
+        "proto_rounds": args.rounds,
+        "proto_per_head_s": round(proto_per_head, 6),
+        "spec_rounds": spec_measured,
+        "spec_per_head_s": round(spec_per_head, 4),
+        "speedup": round(speedup, 1),
+        "stats": stats,
+    }
+    print(json.dumps(result), flush=True)
+
+    # differential + dispatch guarantees (the smoke's reason to exist)
+    assert stats["proto_heads"] == args.rounds, stats
+    assert stats["fallbacks"] == 0, f"engine fell back: {stats}"
+    assert stats["vote_deltas"] > 0, f"no vote deltas applied: {stats}"
+    assert stats["balance_passes"] >= 1, stats
+    if args.assert_speedup is not None:
+        assert speedup >= args.assert_speedup, \
+            f"speedup {speedup:.1f}x below required {args.assert_speedup}x"
+
+
+if __name__ == "__main__":
+    main()

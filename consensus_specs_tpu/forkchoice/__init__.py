@@ -1,0 +1,13 @@
+"""Incremental fork-choice engines.
+
+``proto_array`` holds the flat-array, delta-propagating LMD-GHOST
+realization (protolambda's proto-array design) plus the install hook
+that wraps a spec class's fork-choice surface with the dispatch; the
+spec-shaped reference implementation stays in ``forks/fork_choice.py``.
+"""
+from . import proto_array  # noqa: F401
+
+from .proto_array import (  # noqa: F401
+    ProtoArrayEngine, install_forkchoice_accel,
+    enabled, use_proto, use_spec, use_auto, stats, reset_stats,
+)

@@ -85,12 +85,16 @@ def use_compiled_registry():
     _compile_all()
     importlib.invalidate_caches()  # compiled/ may have just been created
     from consensus_specs_tpu.ops.epoch_kernels import install_vectorized_epoch
+    from consensus_specs_tpu.forkchoice.proto_array import (
+        install_forkchoice_accel)
     for fork in _FORK_ORDER:
         mod = importlib.import_module(f"{__name__}.compiled.{fork}")
         importlib.reload(mod)
         cls = getattr(mod, f"Compiled{fork.capitalize()}Spec")
         # compiled method bodies are emitted verbatim from the markdown,
-        # so the vectorized-epoch dispatch wraps them from outside
+        # so the vectorized-epoch and proto-array fork-choice dispatches
+        # wrap them from outside
         install_vectorized_epoch(cls)
+        install_forkchoice_accel(cls)
         _REGISTRY[fork] = cls
     _spec_cache.clear()

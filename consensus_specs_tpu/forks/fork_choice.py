@@ -18,10 +18,23 @@ Design differences from the reference (same observable behavior):
 - ``Store.checkpoint_states`` is keyed by ``(epoch, root)`` tuples because
   our SSZ containers are mutable (the reference relies on remerkleable
   view hashing).
+
+The class bodies below stay spec-shaped; the performance layer is
+installed from the outside (``install_forkchoice_accel`` at the bottom
+of this module, mirroring ``ops/epoch_kernels.install_vectorized_epoch``
+for the markdown-compiled ladder): ``get_head`` / ``get_weight`` /
+``get_filtered_block_tree`` dispatch to the incremental proto-array
+engine (``forkchoice/proto_array.py``, ``CS_TPU_PROTO_ARRAY=0`` to
+disable), stores carry an incrementally-maintained parent->children
+index (``_children_index`` rebuilds are O(1) instead of O(blocks) per
+``filter_block_tree`` call), and ``get_ancestor`` memoizes its walks in
+a per-store (root, slot)-keyed cache so the spec fallback stops paying
+O(depth) per vote.
 """
 from dataclasses import dataclass, field
 from typing import Dict, Set, Tuple
 
+from consensus_specs_tpu.forkchoice.proto_array import install_forkchoice_accel
 from consensus_specs_tpu.utils.ssz import hash_tree_root
 
 INTERVALS_PER_SLOT = 3
@@ -454,3 +467,9 @@ class ForkChoiceMixin:
         for index in (set(map(int, att1.attesting_indices))
                       & set(map(int, att2.attesting_indices))):
             store.equivocating_indices.add(index)
+
+
+# proto-array dispatch + store bookkeeping, wrapped from the outside so
+# the method bodies above stay spec-shaped (the compiled ladder gets the
+# same treatment in ``forks.use_compiled_registry``)
+install_forkchoice_accel(ForkChoiceMixin)

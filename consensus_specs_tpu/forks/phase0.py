@@ -485,10 +485,14 @@ class Phase0Spec(ValidatorGuideMixin, ForkChoiceMixin):
         key = (hash_tree_root(state.validators), epoch)
         cached = self._caches["active_indices"].get(key)
         if cached is None:
-            cached = [ValidatorIndex(i) for i, v in enumerate(state.validators)
-                      if self.is_active_validator(v, epoch)]
+            cached = tuple(ValidatorIndex(i)
+                           for i, v in enumerate(state.validators)
+                           if self.is_active_validator(v, epoch))
             self._caches["active_indices"][key] = cached
-        return list(cached)
+        # an immutable tuple, returned without the old per-call O(n)
+        # defensive list() copy: callers can index/iterate but cannot
+        # poison the cache by mutating the returned sequence
+        return cached
 
     def get_validator_churn_limit(self, state) -> uint64:
         active = self.get_active_validator_indices(state, self.get_current_epoch(state))
@@ -517,14 +521,15 @@ class Phase0Spec(ValidatorGuideMixin, ForkChoiceMixin):
         if cached is None:
             epoch = self.compute_epoch_at_slot(slot)
             committees_per_slot = self.get_committee_count_per_slot(state, epoch)
-            cached = self.compute_committee(
+            cached = tuple(self.compute_committee(
                 indices=self.get_active_validator_indices(state, epoch),
                 seed=self.get_seed(state, epoch, DOMAIN_BEACON_ATTESTER),
                 index=(slot % self.SLOTS_PER_EPOCH) * committees_per_slot + index,
                 count=committees_per_slot * self.SLOTS_PER_EPOCH,
-            )
+            ))
             self._caches["committee"][key] = cached
-        return list(cached)
+        # immutable, uncopied: see get_active_validator_indices
+        return cached
 
     def get_beacon_proposer_index(self, state) -> ValidatorIndex:
         key = (hash_tree_root(state.validators), hash_tree_root(state.randao_mixes),

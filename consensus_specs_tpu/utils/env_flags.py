@@ -30,3 +30,13 @@ MERKLE_BATCH_MIN = _int_env("CS_TPU_MERKLE_BATCH_MIN")
 # ``utils/ssz/forest.py`` scopes into no-ops (every tree flushes alone)
 # and disables the columnar bulk container-root path.
 HASH_FOREST = os.environ.get("CS_TPU_HASH_FOREST") != "0"
+
+# Proto-array fork-choice kill switch: ``CS_TPU_PROTO_ARRAY=0`` runs the
+# spec-loop ``get_head`` / ``get_weight`` / ``get_filtered_block_tree``
+# (``forks/fork_choice.py``) instead of the incremental columnar engine
+# in ``forkchoice/proto_array.py``, and stores are created without an
+# engine attached.  This snapshot is the default
+# ``forkchoice.proto_array.enabled()`` answers with; setting the
+# variable after import also works (like ``CS_TPU_VECTORIZED_EPOCH``,
+# the switch re-reads the environment at call time when it is present).
+PROTO_ARRAY = os.environ.get("CS_TPU_PROTO_ARRAY") != "0"

@@ -1,0 +1,398 @@
+"""Randomized differential suite: the proto-array engine
+(``forkchoice/proto_array.py``) against the spec-loop fork choice.
+
+Every scenario drives ONE store through an event stream and, after
+every event, answers ``get_head`` / ``get_weight`` /
+``get_filtered_block_tree`` twice — once with the engine forced on
+(``use_proto``), once with the spec loops forced (``use_spec``) — and
+requires byte-identical results.  The engine-hit counters are asserted
+so a silent fallback cannot turn the comparison into a
+loop-vs-loop tautology.  Scenarios cover random block trees with
+competing forks, attestation streams, proposer boost, equivocation
+discard, late-justification pull-ups, and finalization pruning.
+"""
+import random
+
+from consensus_specs_tpu.forkchoice import proto_array
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases, with_phases, never_bls, pytest_only,
+)
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot, state_transition_and_sign_block,
+    next_slots,
+)
+from consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+from consensus_specs_tpu.test_infra.slashings import (
+    get_valid_attester_slashing, get_indexed_attestation_participants,
+)
+from consensus_specs_tpu.test_infra.fork_choice import (
+    get_genesis_forkchoice_store_and_block, on_tick_and_append_step,
+    tick_and_add_block, add_attestation, add_attester_slashing,
+    apply_next_epoch_with_attestations,
+)
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+
+def _store_with_engine(spec, state):
+    """A genesis store with the engine force-attached, so the
+    differential comparison is meaningful even when the suite runs
+    under ``CS_TPU_PROTO_ARRAY=0`` (the satellite's engine-off leg):
+    attach happens at store creation; after that the write hooks keep
+    the engine in sync in either mode."""
+    proto_array.use_proto()
+    try:
+        store, genesis_block = get_genesis_forkchoice_store_and_block(
+            spec, state)
+    finally:
+        proto_array.use_auto()
+    assert store._fc_proto is not None
+    return store, genesis_block
+
+
+def _assert_engines_agree(spec, store, check_weights=True):
+    """Both engines answer the full read surface identically; the proto
+    side must really have been the engine (counter-asserted)."""
+    eng = getattr(store, "_fc_proto", None)
+    assert eng is not None, "engine not attached (CS_TPU_PROTO_ARRAY=0?)"
+    assert not eng._broken
+    pre = proto_array.stats()
+    proto_array.use_proto()
+    try:
+        head_proto = bytes(spec.get_head(store))
+        tree_proto = spec.get_filtered_block_tree(store)
+        weights_proto = {
+            r: int(spec.get_weight(store, r)) for r in store.blocks
+        } if check_weights else None
+    finally:
+        proto_array.use_spec()
+    post = proto_array.stats()
+    assert post["proto_heads"] == pre["proto_heads"] + 1
+    assert post["proto_trees"] == pre["proto_trees"] + 1
+    try:
+        head_spec = bytes(spec.get_head(store))
+        tree_spec = spec.get_filtered_block_tree(store)
+        weights_spec = {
+            r: int(spec.get_weight(store, r)) for r in store.blocks
+        } if check_weights else None
+    finally:
+        proto_array.use_auto()
+    assert head_proto == head_spec
+    assert set(tree_proto) == set(tree_spec)
+    for r in tree_proto:
+        assert tree_proto[r] is tree_spec[r]
+    if check_weights:
+        assert weights_proto == weights_spec
+    return head_proto
+
+
+def _tick_next_slot(spec, store, test_steps):
+    slot = spec.get_current_slot(store) + 1
+    on_tick_and_append_step(
+        spec, store,
+        store.genesis_time + int(slot) * int(spec.config.SECONDS_PER_SLOT),
+        test_steps)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+@pytest_only
+def test_proto_differential_random_tree(spec, state):
+    """Random branching trees + attestation streams: byte-identical
+    head/weights/filtered-tree after every event."""
+    for seed in (11, 29):
+        rng = random.Random(seed)
+        test_steps = []
+        store, genesis_block = _store_with_engine(spec, state.copy())
+        branches = [(state.copy(), hash_tree_root(genesis_block))]
+        for _ in range(14):
+            action = rng.random()
+            if action < 0.55 or len(store.blocks) < 3:
+                # extend a random branch (sometimes forking it first)
+                i = rng.randrange(len(branches))
+                branch_state, _ = branches[i]
+                if rng.random() < 0.4 and len(branches) < 4:
+                    branch_state = branch_state.copy()   # new fork
+                else:
+                    branches.pop(i)
+                block = build_empty_block_for_next_slot(spec, branch_state)
+                block.body.graffiti = bytes([rng.randrange(256)]) * 32
+                signed = state_transition_and_sign_block(
+                    spec, branch_state, block)
+                tick_and_add_block(spec, store, signed, test_steps)
+                branches.append((branch_state, hash_tree_root(block)))
+            elif action < 0.85:
+                # attest a random branch tip with a random committee
+                branch_state, _ = rng.choice(branches)
+                att_state = branch_state.copy()
+                att = get_valid_attestation(
+                    spec, att_state, slot=att_state.slot,
+                    index=0, signed=True)
+                next_slots(spec, att_state, 2)
+                while spec.get_current_slot(store) <= att.data.slot:
+                    _tick_next_slot(spec, store, test_steps)
+                add_attestation(spec, store, att, test_steps)
+            else:
+                _tick_next_slot(spec, store, test_steps)
+            _assert_engines_agree(spec, store)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+@pytest_only
+def test_proto_differential_boost_and_equivocation(spec, state):
+    """Proposer boost on/off and equivocation discard keep both engines
+    byte-identical."""
+    test_steps = []
+    store, genesis_block = _store_with_engine(spec, state)
+    base = state.copy()
+    state_a = base.copy()
+    block_a = build_empty_block_for_next_slot(spec, state_a)
+    signed_a = state_transition_and_sign_block(spec, state_a, block_a)
+    state_b = base.copy()
+    block_b = build_empty_block_for_next_slot(spec, state_b)
+    block_b.body.graffiti = b"\x42" * 32
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+    tick_and_add_block(spec, store, signed_a, test_steps)
+    # the first timely block carries the proposer boost
+    assert bytes(store.proposer_boost_root) == bytes(hash_tree_root(block_a))
+    _assert_engines_agree(spec, store)
+    tick_and_add_block(spec, store, signed_b, test_steps)
+    _assert_engines_agree(spec, store)
+
+    _tick_next_slot(spec, store, test_steps)   # boost wears off
+    _assert_engines_agree(spec, store)
+
+    # votes flip the head to the tie-break loser, then the voters are
+    # slashed and the head reverts — engines agree at every step
+    tie_winner = _assert_engines_agree(spec, store)
+    loser_state, loser_root = \
+        (state_a, hash_tree_root(block_a)) \
+        if tie_winner == bytes(hash_tree_root(block_b)) \
+        else (state_b, hash_tree_root(block_b))
+    att = get_valid_attestation(spec, loser_state, signed=True)
+    _tick_next_slot(spec, store, test_steps)
+    add_attestation(spec, store, att, test_steps)
+    assert _assert_engines_agree(spec, store) == bytes(loser_root)
+    slashing = get_valid_attester_slashing(
+        spec, loser_state, slot=att.data.slot, signed_1=True, signed_2=True)
+    participants = get_indexed_attestation_participants(
+        spec, slashing.attestation_1)
+    add_attester_slashing(spec, store, slashing, test_steps)
+    assert all(int(i) in store.equivocating_indices for i in participants)
+    assert _assert_engines_agree(spec, store) == tie_winner
+
+
+@with_phases(["phase0", "altair", "deneb"])
+@spec_state_test
+@never_bls
+@pytest_only
+def test_proto_differential_justification_and_pruning(spec, state):
+    """Epochs of attested blocks: justified/finalized checkpoints
+    advance (late-justification pull-ups included) and finalization
+    prunes the proto array; engines stay byte-identical throughout."""
+    test_steps = []
+    store, _ = _store_with_engine(spec, state)
+    eng = store._fc_proto
+    for epoch in range(4):
+        # no previous-epoch attestations to fill in the first epoch
+        state, store, _ = apply_next_epoch_with_attestations(
+            spec, state, store, True, epoch > 0, test_steps)
+        _assert_engines_agree(spec, store, check_weights=(epoch % 2 == 0))
+    assert store.finalized_checkpoint.epoch > spec.GENESIS_EPOCH
+    # the finalized update pruned everything outside the finalized
+    # subtree; the spec store keeps every block
+    proto_array.use_proto()
+    try:
+        spec.get_head(store)
+    finally:
+        proto_array.use_auto()
+    assert proto_array.stats()["prunes"] > 0
+    assert len(eng._roots) < len(store.blocks)
+    assert eng._parent[0] == -1
+    assert eng._roots[0] == bytes(store.finalized_checkpoint.root)
+    _assert_engines_agree(spec, store)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+@never_bls
+@pytest_only
+def test_proto_disabled_restores_pure_spec_path(spec, state):
+    """With the switch off at store-creation time no engine is attached
+    and every read runs the spec loop."""
+    proto_array.use_spec()
+    try:
+        test_steps = []
+        store, genesis_block = get_genesis_forkchoice_store_and_block(
+            spec, state)
+        assert getattr(store, "_fc_proto", None) is None
+        block = build_empty_block_for_next_slot(spec, state)
+        signed = state_transition_and_sign_block(spec, state, block)
+        tick_and_add_block(spec, store, signed, test_steps)
+        pre = proto_array.stats()
+        assert bytes(spec.get_head(store)) == bytes(hash_tree_root(block))
+        post = proto_array.stats()
+        assert post["proto_heads"] == pre["proto_heads"]
+        assert post["spec_heads"] == pre["spec_heads"] + 1
+    finally:
+        proto_array.use_auto()
+
+
+@with_phases(["phase0"])
+@spec_state_test
+@never_bls
+@pytest_only
+def test_heldover_delta_survives_node_growth(spec, state):
+    """Regression: a pending delta array left behind by a refresh that
+    fell back after queuing deltas is smaller than a node array that
+    grew afterwards; the next propagation must grow it instead of
+    crashing (IndexError)."""
+    test_steps = []
+    store, genesis_block = _store_with_engine(spec, state)
+    eng = store._fc_proto
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    tick_and_add_block(spec, store, signed, test_steps)
+    att = get_valid_attestation(spec, state, slot=block.slot, signed=True)
+    _tick_next_slot(spec, store, test_steps)
+    add_attestation(spec, store, att, test_steps)
+    proto_array.use_proto()
+    try:
+        spec.get_head(store)   # drain real deltas
+    finally:
+        proto_array.use_auto()
+    # prime a held-over delta at the CURRENT node count (what a
+    # fallback between the delta passes and propagation leaves behind)
+    eng._get_delta()
+    assert eng._delta is not None
+    held_size = eng._delta.size
+    # grow the array through RAW handlers (no test-infra store checks,
+    # whose per-event get_head would drain the delta early) with LATE
+    # blocks, so the boost stays cleared and the next refresh reaches
+    # propagation with the stale, smaller delta still pending
+    spec.on_tick(store, store.time
+                 + 2 * int(spec.config.SECONDS_PER_SLOT))
+    for _ in range(2):
+        block = build_empty_block_for_next_slot(spec, state)
+        signed = state_transition_and_sign_block(spec, state, block)
+        spec.on_block(store, signed)
+    assert bytes(store.proposer_boost_root) == b"\x00" * 32
+    assert eng._delta is not None and eng._delta.size == held_size
+    assert eng._n > held_size
+    assert _assert_engines_agree(spec, store) == bytes(hash_tree_root(block))
+
+
+@with_phases(["phase0"])
+@spec_state_test
+@never_bls
+@pytest_only
+def test_direct_block_insertion_falls_back(spec, state):
+    """A consumer inserting into ``store.blocks`` directly (bypassing
+    the wrapped on_block) must never be answered from stale caches: the
+    children index rebuilds from scratch and the engine refuses the
+    array, falling back to the spec loop."""
+    test_steps = []
+    store, genesis_block = _store_with_engine(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    tick_and_add_block(spec, store, signed, test_steps)
+    # second block: registered by hand, store bookkeeping bypassed
+    rogue = build_empty_block_for_next_slot(spec, state)
+    rogue_signed = state_transition_and_sign_block(spec, state, rogue)
+    rogue_root = bytes(hash_tree_root(rogue))
+    store.blocks[rogue_root] = rogue_signed.message.copy()
+    store.block_states[rogue_root] = state.copy()
+    store.unrealized_justifications[rogue_root] = \
+        store.justified_checkpoint.copy()
+    assert rogue_root not in store._fc_children.get(
+        bytes(rogue.parent_root), [])
+    # the children index detects staleness and rebuilds from scratch
+    rebuilt = spec._children_index(store)
+    assert rebuilt is not store._fc_children
+    assert rogue_root in rebuilt[bytes(rogue.parent_root)]
+    # the engine detects the unseen block and falls back to the spec
+    # loop, which sees the rogue block as the new head
+    pre = proto_array.stats()
+    proto_array.use_proto()
+    try:
+        head = bytes(spec.get_head(store))
+    finally:
+        proto_array.use_auto()
+    post = proto_array.stats()
+    # the spec get_head itself re-enters wrapped reads (filtered tree,
+    # per-child weights), each refusing the stale array in turn
+    assert post["fallbacks"] > pre["fallbacks"]
+    assert post["proto_heads"] == pre["proto_heads"]
+    assert post["spec_heads"] == pre["spec_heads"] + 1
+    assert head == rogue_root
+
+
+@with_phases(["phase0"])
+@spec_state_test
+@never_bls
+@pytest_only
+def test_children_index_consistent_out_of_order(spec, state):
+    """The incrementally-maintained parent->children index matches a
+    from-scratch rebuild under out-of-order (forked, interleaved)
+    insertion."""
+    test_steps = []
+    store, genesis_block = get_genesis_forkchoice_store_and_block(
+        spec, state)
+    base = state.copy()
+    # three competing forks, extended in interleaved order so children
+    # lists accrete out of chain order
+    forks = []
+    for tag in (b"\x01", b"\x02", b"\x03"):
+        fork_state = base.copy()
+        block = build_empty_block_for_next_slot(spec, fork_state)
+        block.body.graffiti = tag * 32
+        forks.append((fork_state,
+                      state_transition_and_sign_block(spec, fork_state,
+                                                      block)))
+    # add fork tips 2, 0, 1, then extend 0 and 2
+    for i in (2, 0, 1):
+        tick_and_add_block(spec, store, forks[i][1], test_steps)
+    for i in (0, 2):
+        fork_state = forks[i][0]
+        block = build_empty_block_for_next_slot(spec, fork_state)
+        signed = state_transition_and_sign_block(spec, fork_state, block)
+        tick_and_add_block(spec, store, signed, test_steps)
+
+    maintained = spec._children_index(store)
+    assert maintained is store._fc_children
+    # the pre-accel spec body, reachable through the wrapper's
+    # __wrapped__, rebuilds the index from every block in the store
+    rebuilt = type(spec)._children_index.__wrapped__(spec, store)
+    assert {k: sorted(v) for k, v in maintained.items()} \
+        == {k: sorted(map(bytes, v)) for k, v in rebuilt.items()}
+
+
+@with_phases(["phase0"])
+@spec_state_test
+@never_bls
+@pytest_only
+def test_ancestor_cache_matches_uncached_walk(spec, state):
+    """The memoized get_ancestor equals the uncached spec walk for every
+    (block, slot) pair in a forked store."""
+    test_steps = []
+    store, genesis_block = get_genesis_forkchoice_store_and_block(
+        spec, state)
+    base = state.copy()
+    for tag in (b"\x00", b"\x11"):
+        fork_state = base.copy()
+        for _ in range(3):
+            block = build_empty_block_for_next_slot(spec, fork_state)
+            block.body.graffiti = tag * 32
+            signed = state_transition_and_sign_block(spec, fork_state, block)
+            tick_and_add_block(spec, store, signed, test_steps)
+    uncached = type(spec).get_ancestor.__wrapped__
+    slots = sorted({int(b.slot) for b in store.blocks.values()})
+    for root in store.blocks:
+        for slot in slots:
+            assert bytes(spec.get_ancestor(store, root, slot)) \
+                == bytes(uncached(spec, store, root, slot))
+    # cache hits answer without re-walking: poison-proof because keys
+    # are (root, slot) of an immutable chain structure
+    assert store._fc_ancestors

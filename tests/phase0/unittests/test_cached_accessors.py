@@ -1,0 +1,49 @@
+"""Cached-accessor immutability: ``get_active_validator_indices`` and
+``get_beacon_committee`` return their cached tuples directly (no O(n)
+defensive ``list()`` copy per call), so a caller can no longer poison
+the cache by mutating the returned sequence."""
+import pytest
+
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases, never_bls, pytest_only,
+)
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+@pytest_only
+def test_active_indices_cache_immutable(spec, state):
+    epoch = spec.get_current_epoch(state)
+    first = spec.get_active_validator_indices(state, epoch)
+    assert isinstance(first, tuple)
+    assert len(first) == len(state.validators)
+    # mutation through the return value is impossible...
+    with pytest.raises((TypeError, AttributeError)):
+        first[0] = 99
+    # ...and a caller-side copy can be mangled freely without touching
+    # the cache: the next call still sees the full set
+    mangled = list(first)
+    mangled.clear()
+    again = spec.get_active_validator_indices(state, epoch)
+    assert again == first and len(again) == len(state.validators)
+    # no defensive copy: repeated calls hand back the SAME cached object
+    assert again is first
+
+
+@with_all_phases
+@spec_state_test
+@never_bls
+@pytest_only
+def test_beacon_committee_cache_immutable(spec, state):
+    committee = spec.get_beacon_committee(state, state.slot, 0)
+    assert isinstance(committee, tuple)
+    assert len(committee) > 0
+    with pytest.raises((TypeError, AttributeError)):
+        committee.append(0)
+    mangled = list(committee)
+    mangled.reverse()
+    mangled.pop()
+    again = spec.get_beacon_committee(state, state.slot, 0)
+    assert again == committee
+    assert again is committee
