@@ -561,13 +561,15 @@ class ProtoArrayEngine:
         """Subtree weight of ``root`` (boost included), or None."""
         if self._broken:
             return None
-        idx = self._index.get(bytes(root))
-        if idx is None:
-            return None
         try:
             self._refresh(spec, store)
         except _Fallback:
             _stats["fallbacks"] += 1
+            return None
+        # look up only after _refresh: a prune inside it compacts the
+        # arrays and remaps every index
+        idx = self._index.get(bytes(root))
+        if idx is None:
             return None
         return self._weight[idx]
 

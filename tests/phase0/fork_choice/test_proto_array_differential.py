@@ -219,6 +219,61 @@ def test_proto_differential_justification_and_pruning(spec, state):
 @spec_state_test
 @never_bls
 @pytest_only
+def test_weight_is_first_engine_read_after_finalization(spec, state):
+    """Regression: ``get_weight`` as the FIRST engine read after a
+    finalization advance (no preceding ``get_head``).  The prune inside
+    ``_refresh`` compacts the arrays and remaps every index, so a root
+    lookup taken before the refresh read another node's subtree weight
+    (or raised IndexError).  Covers both a surviving root (engine
+    answer at the remapped index) and a pruned root (engine declines,
+    spec-loop fallback)."""
+    test_steps = []
+    store, genesis_block = _store_with_engine(spec, state)
+    eng = store._fc_proto
+    genesis_root = bytes(hash_tree_root(genesis_block))
+    # advance finalization with every read forced onto the spec loop:
+    # the write hooks keep the engine fed, but no get_head drains the
+    # pending prune
+    proto_array.use_spec()
+    try:
+        last = None
+        for epoch in range(4):
+            state, store, last = apply_next_epoch_with_attestations(
+                spec, state, store, True, epoch > 0, test_steps)
+    finally:
+        proto_array.use_auto()
+    assert store.finalized_checkpoint.epoch > spec.GENESIS_EPOCH
+    assert bytes(store.finalized_checkpoint.root) != genesis_root
+    surviving_root = bytes(hash_tree_root(last.message))
+    # the prune really is still pending
+    assert eng._fin_seen != proto_array._ckpt_key(store.finalized_checkpoint)
+    pre = proto_array.stats()
+    proto_array.use_proto()
+    try:
+        w_surviving = int(spec.get_weight(store, surviving_root))
+        w_pruned = int(spec.get_weight(store, genesis_root))
+    finally:
+        proto_array.use_spec()
+    try:
+        assert w_surviving == int(spec.get_weight(store, surviving_root))
+        assert w_pruned == int(spec.get_weight(store, genesis_root))
+    finally:
+        proto_array.use_auto()
+    post = proto_array.stats()
+    # the very first read triggered the prune and was still answered by
+    # the engine; the pruned root fell back to the spec loop
+    assert post["prunes"] == pre["prunes"] + 1
+    assert post["proto_weights"] == pre["proto_weights"] + 1
+    assert post["spec_weights"] == pre["spec_weights"] + 3
+    assert genesis_root not in eng._index
+    assert surviving_root in eng._index
+    _assert_engines_agree(spec, store)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+@never_bls
+@pytest_only
 def test_proto_disabled_restores_pure_spec_path(spec, state):
     """With the switch off at store-creation time no engine is attached
     and every read runs the spec loop."""
