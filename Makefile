@@ -7,7 +7,7 @@ GENERATORS = operations sanity finality rewards random forks epoch_processing \
              fork_choice merkle_proof ssz_generic sync transition
 
 .PHONY: test citest test-crypto bench bench-all bench-merkle-smoke \
-        bench-forkchoice-smoke dryrun \
+        bench-forkchoice-smoke bench-obs-smoke obs-report dryrun \
         warm native lint speclint-baseline \
         generate_tests $(addprefix gen_,$(GENERATORS)) clean-vectors pyspec
 
@@ -88,6 +88,19 @@ bench-merkle-smoke:
 # forkchoice/proto_array counters; nonzero exit on regression)
 bench-forkchoice-smoke:
 	$(PYTHON) benchmarks/bench_fork_choice.py --smoke
+
+# telemetry disabled-path overhead: with CS_TPU_PROFILE/CS_TPU_TRACE
+# unset, the span + counter instrumentation across the engine stack
+# must cost <2% of the 32-slot replay (exact op census x measured
+# per-op cost; nonzero exit above the bound)
+bench-obs-smoke:
+	$(PYTHON) benchmarks/bench_obs_overhead.py
+
+# human telemetry view: 32-slot replay with full tracing, span tree +
+# metric catalog (see docs/observability.md; --format json|prom for the
+# machine exporters)
+obs-report:
+	$(PYTHON) -m consensus_specs_tpu.tools.obs_report
 
 dryrun:
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

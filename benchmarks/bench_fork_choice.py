@@ -128,6 +128,11 @@ def main():
     setup_s = time.time() - t0
 
     proto_array.reset_stats()
+    # same boundary for the cache counters the result embeds — without
+    # this the emitted hit/miss ratios would be dominated by the
+    # build_store/build_tree setup traffic, not the measured rounds
+    from consensus_specs_tpu.obs import registry as obs_registry
+    obs_registry.reset("cache.")
     proto_s = spec_s = 0.0
     spec_measured = 0
     for r in range(args.rounds):
@@ -151,6 +156,11 @@ def main():
         proto_array.use_auto()
 
     stats = proto_array.stats()
+    # telemetry snapshot: schema-valid with non-empty fork-choice path
+    # counters (the labeled engine/spec attribution the smoke certifies)
+    from consensus_specs_tpu.obs import export
+    snap = export.snapshot()
+    export.assert_schema(snap, require_nonempty=("forkchoice.",))
     proto_per_head = proto_s / args.rounds
     spec_per_head = spec_s / max(1, spec_measured)
     speedup = spec_per_head / proto_per_head if proto_per_head else 0.0
@@ -165,6 +175,8 @@ def main():
         "spec_per_head_s": round(spec_per_head, 4),
         "speedup": round(speedup, 1),
         "stats": stats,
+        "obs": {"metrics": {k: v for k, v in snap["metrics"].items()
+                            if k.startswith(("forkchoice.", "cache."))}},
     }
     print(json.dumps(result), flush=True)
 

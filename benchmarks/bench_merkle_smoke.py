@@ -96,12 +96,21 @@ def main():
     assert pair_stats["pair_scalar_max"] < scalar_limit, \
         f"an above-threshold level hashed per pair: {pair_stats}"
 
+    # telemetry snapshot: must be schema-valid with non-empty merkle
+    # dispatch counters (the backend-labeled series are the engine's
+    # regression tripwire — see docs/observability.md)
+    from consensus_specs_tpu.obs import export
+    snap = export.snapshot()
+    export.assert_schema(snap, require_nonempty=("merkle.",))
+
     print(json.dumps({
         "metric": f"merkle smoke, {n} validators", "backend": backend,
         "packed_commit_s": round(packed_s, 4),
         "packed_stats": packed_stats,
         "setitem_commit_s": round(setitem_s, 4),
         "setitem_stats": pair_stats,
+        "obs": {"metrics": {k: v for k, v in snap["metrics"].items()
+                            if k.startswith(("merkle.", "forest."))}},
     }), flush=True)
 
 

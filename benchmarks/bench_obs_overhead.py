@@ -1,0 +1,191 @@
+"""Telemetry disabled-path overhead micro-bench (``make bench-obs-smoke``).
+
+Proves the satellite claim: with both gates unset, the instrumentation
+added across the engine stack costs <2% of the 32-slot replay
+wall-clock.  Two measurements back this:
+
+1. **Per-op costs** — tight-loop ns/op of a disabled ``span`` enter/exit
+   and of a bound counter ``add`` (the only two operations hot paths
+   pay when telemetry is off).
+2. **Op census** — one instrumented replay counts how many span
+   entries and counter bumps a 32-slot replay actually performs (the
+   census run patches the series classes; the timed runs are untouched).
+
+overhead% = (spans x span_cost + bumps x add_cost) / replay_time.  This
+deterministic decomposition is the asserted bound (<2%); a direct A/B
+of the same replay with spans force-disabled vs enabled is printed for
+reference but not asserted (wall-clock A/B of a ~1s python workload is
+noise at the 2% scale).
+
+Exits nonzero when the computed overhead reaches 2%.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SLOTS = 32
+# 256 validators: the hashing/transition work in the denominator scales
+# with registry size while the span/bump census stays near-constant, so
+# the asserted ratio keeps headroom on faster hosts (and better matches
+# the production shapes the <2% claim is about)
+VALIDATORS = 256
+REPS = 3
+
+
+def _best_of(fn, reps=3) -> float:
+    """Per-op costs are measured best-of-N: scheduler noise only ever
+    inflates a tight-loop measurement, so the minimum is the estimator
+    of the true cost (and keeps the asserted bound flake-free)."""
+    return min(fn() for _ in range(reps))
+
+
+def _per_op_span_ns(n=200_000) -> float:
+    from consensus_specs_tpu.obs.tracing import span
+
+    def one():
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with span("bench.noop"):
+                pass
+        return (time.perf_counter() - t0) / n * 1e9
+
+    return _best_of(one)
+
+
+def _per_op_add_ns(n=1_000_000) -> float:
+    from consensus_specs_tpu.obs import registry
+    series = registry.counter("bench.add").labels()
+    add = series.add
+
+    def one():
+        t0 = time.perf_counter()
+        for _ in range(n):
+            add()
+        return (time.perf_counter() - t0) / n * 1e9
+
+    return _best_of(one)
+
+
+def _fresh_replay_args():
+    from consensus_specs_tpu.forks import build_spec
+    from consensus_specs_tpu.tools.obs_report import build_state
+    spec = build_spec("phase0", "minimal")
+    return spec, build_state(spec, VALIDATORS)
+
+
+def _timed_replay() -> float:
+    from consensus_specs_tpu.tools.obs_report import replay
+    spec, state = _fresh_replay_args()
+    t0 = time.perf_counter()
+    replay(spec, state, SLOTS)
+    return time.perf_counter() - t0
+
+
+def _census() -> tuple:
+    """(span entries, counter/gauge bump events) one replay performs —
+    exact: every live series object is temporarily reclassed so writes
+    to its value slot count, which intercepts both ``.add()`` calls and
+    the inline ``series.n += 1`` bumps the hottest sites use."""
+    from consensus_specs_tpu import obs
+    from consensus_specs_tpu.obs import registry, tracing
+    from consensus_specs_tpu.tools.obs_report import replay
+
+    bumps = [0]
+
+    def counting_slot(base_cls, slot_name):
+        slot = getattr(base_cls, slot_name)
+
+        def _set(self, v):
+            bumps[0] += 1
+            slot.__set__(self, v)
+
+        return property(slot.__get__, _set)
+
+    class CountingCounter(registry._CounterSeries):
+        __slots__ = ()
+        n = counting_slot(registry._CounterSeries, "n")
+
+    class CountingGauge(registry._GaugeSeries):
+        __slots__ = ()
+        v = counting_slot(registry._GaugeSeries, "v")
+
+    swaps = {registry._CounterSeries: CountingCounter,
+             registry._GaugeSeries: CountingGauge}
+    spec, state = _fresh_replay_args()    # setup excluded from census
+
+    def _reclass(to_counting: bool) -> None:
+        for m in registry.metrics().values():
+            for _, s in m.series_items():
+                if to_counting:
+                    target = swaps.get(type(s))
+                else:
+                    target = {v: k for k, v in swaps.items()}.get(type(s))
+                if target is not None:
+                    s.__class__ = target
+
+    obs.reset_all()
+    obs.enable(True, counters=False)
+    _reclass(True)
+    try:
+        bumps[0] = 0
+        replay(spec, state, SLOTS)
+    finally:
+        _reclass(False)
+        obs.enable(False)
+    spans = sum(s["count"] for s in tracing.stats().values())
+    tracing.reset()
+    return spans, bumps[0]
+
+
+def main() -> int:
+    from consensus_specs_tpu import obs
+    from consensus_specs_tpu.utils import bls
+    bls.bls_active = False
+    # this bench measures the DISABLED path: force both gates off no
+    # matter what CS_TPU_PROFILE/CS_TPU_TRACE the caller's shell exports
+    # (otherwise the per-op loops would time the enabled tree-insert
+    # path and fail the bound spuriously)
+    obs.enable(False, counters=False)
+
+    span_ns = _per_op_span_ns()
+    add_ns = _per_op_add_ns()
+    spans, bumps = _census()
+
+    # timed replays, telemetry fully off (the shipping default)
+    disabled_s = min(_timed_replay() for _ in range(REPS))
+
+    # reference A/B: same replay with spans recording
+    obs.enable(True, counters=False)
+    try:
+        enabled_s = min(_timed_replay() for _ in range(REPS))
+    finally:
+        obs.enable(False)
+        obs.reset_all()
+
+    overhead_s = (spans * span_ns + bumps * add_ns) / 1e9
+    overhead_pct = overhead_s / disabled_s * 100.0
+
+    print(json.dumps({
+        "metric": f"obs disabled-path overhead, {SLOTS}-slot replay, "
+                  f"{VALIDATORS} validators",
+        "span_disabled_ns": round(span_ns, 1),
+        "counter_add_ns": round(add_ns, 1),
+        "spans_per_replay": spans,
+        "counter_bumps_per_replay": bumps,
+        "replay_disabled_s": round(disabled_s, 4),
+        "replay_profiled_s": round(enabled_s, 4),
+        "computed_overhead_s": round(overhead_s, 6),
+        "computed_overhead_pct": round(overhead_pct, 3),
+    }), flush=True)
+
+    assert overhead_pct < 2.0, (
+        f"disabled-path telemetry overhead {overhead_pct:.2f}% >= 2% "
+        f"of the {SLOTS}-slot replay")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

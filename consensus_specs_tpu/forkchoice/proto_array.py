@@ -56,6 +56,8 @@ import os
 
 import numpy as np
 
+from consensus_specs_tpu.obs import registry as obs_registry
+from consensus_specs_tpu.obs.tracing import span
 from consensus_specs_tpu.ops.epoch_kernels import validator_columns
 from consensus_specs_tpu.utils import env_flags
 from consensus_specs_tpu.utils.ssz import hash_tree_root
@@ -108,24 +110,46 @@ def backend_name() -> str:
 
 # engine-hit / spec-loop counters; the differential suite and the
 # bench smoke assert on these so a silent fallback cannot turn the
-# comparisons into loop-vs-loop tautologies
-_stats = {
-    "proto_heads": 0, "spec_heads": 0,
-    "proto_weights": 0, "spec_weights": 0,
-    "proto_trees": 0, "spec_trees": 0,
-    "refreshes": 0, "vote_deltas": 0, "balance_passes": 0,
-    "boost_deltas": 0, "prunes": 0, "pruned_nodes": 0,
-    "fallbacks": 0,
-}
+# comparisons into loop-vs-loop tautologies.  Registered in the obs
+# metrics registry with the read surface labeled by answer path
+# (``forkchoice.head{path=engine|spec}`` ...), series pre-bound at
+# module scope (speclint O5xx hot-path rule).
+_C_HEAD_ENGINE = obs_registry.counter("forkchoice.head").labels(path="engine")
+_C_HEAD_SPEC = obs_registry.counter("forkchoice.head").labels(path="spec")
+_C_WEIGHT_ENGINE = obs_registry.counter(
+    "forkchoice.weight").labels(path="engine")
+_C_WEIGHT_SPEC = obs_registry.counter("forkchoice.weight").labels(path="spec")
+_C_TREE_ENGINE = obs_registry.counter(
+    "forkchoice.filtered_tree").labels(path="engine")
+_C_TREE_SPEC = obs_registry.counter(
+    "forkchoice.filtered_tree").labels(path="spec")
+_C_REFRESHES = obs_registry.counter("forkchoice.refreshes").labels()
+_C_VOTE_DELTAS = obs_registry.counter("forkchoice.vote_deltas").labels()
+_C_BALANCE_PASSES = obs_registry.counter("forkchoice.balance_passes").labels()
+_C_BOOST_DELTAS = obs_registry.counter("forkchoice.boost_deltas").labels()
+_C_PRUNES = obs_registry.counter("forkchoice.prunes").labels()
+_C_PRUNED_NODES = obs_registry.counter("forkchoice.pruned_nodes").labels()
+_C_FALLBACKS = obs_registry.counter("forkchoice.fallbacks").labels()
+_C_ANC_HIT = obs_registry.counter("cache.hit").labels(cache="fc_ancestors")
+_C_ANC_MISS = obs_registry.counter("cache.miss").labels(cache="fc_ancestors")
 
 
 def stats() -> dict:
-    return dict(_stats)
+    """Back-compat alias view of the ``forkchoice.*`` registry metrics
+    (the differential suite and bench smoke assert on these keys)."""
+    return {"proto_heads": _C_HEAD_ENGINE.n, "spec_heads": _C_HEAD_SPEC.n,
+            "proto_weights": _C_WEIGHT_ENGINE.n,
+            "spec_weights": _C_WEIGHT_SPEC.n,
+            "proto_trees": _C_TREE_ENGINE.n, "spec_trees": _C_TREE_SPEC.n,
+            "refreshes": _C_REFRESHES.n, "vote_deltas": _C_VOTE_DELTAS.n,
+            "balance_passes": _C_BALANCE_PASSES.n,
+            "boost_deltas": _C_BOOST_DELTAS.n, "prunes": _C_PRUNES.n,
+            "pruned_nodes": _C_PRUNED_NODES.n,
+            "fallbacks": _C_FALLBACKS.n}
 
 
 def reset_stats() -> None:
-    for k in _stats:
-        _stats[k] = 0
+    obs_registry.reset("forkchoice.")
 
 
 class _Fallback(Exception):
@@ -330,8 +354,8 @@ class ProtoArrayEngine:
             padded[:k] = self._delta[:k]
             self._delta = padded[kept]
         self._anc_cache = None
-        _stats["prunes"] += 1
-        _stats["pruned_nodes"] += n - m
+        _C_PRUNES.add()
+        _C_PRUNED_NODES.add(n - m)
 
     def _balance_column(self, spec, state) -> np.ndarray:
         """Per-validator vote weight from the justified state: effective
@@ -354,7 +378,7 @@ class ProtoArrayEngine:
         balance-delta pass (justified checkpoint changed), one loop over
         the changed votes, one boost adjustment, one backward
         up-propagation."""
-        _stats["refreshes"] += 1
+        _C_REFRESHES.add()
         # a consumer that inserted into store.blocks directly (bypassing
         # the wrapped on_block) would leave the array blind to those
         # blocks; spec stores never delete, so unique-roots-ever-seen
@@ -395,7 +419,7 @@ class ProtoArrayEngine:
                 self._vote_weight[idx] = bal_eff[idx]
             self._bal_eff = bal_eff
             self._bal_key = jk
-            _stats["balance_passes"] += 1
+            _C_BALANCE_PASSES.add()
 
         if self._dirty:
             bal_eff = self._bal_eff
@@ -418,7 +442,7 @@ class ProtoArrayEngine:
                     delta[node] += new_w
                 self._vote_node[i] = node
                 self._vote_weight[i] = new_w
-                _stats["vote_deltas"] += 1
+                _C_VOTE_DELTAS.add()
             self._dirty.clear()
 
         # proposer boost: a virtual vote worth get_proposer_score,
@@ -438,7 +462,7 @@ class ProtoArrayEngine:
             if desired is not None:
                 delta[desired[0]] += desired[1]
             self._boost = desired
-            _stats["boost_deltas"] += 1
+            _C_BOOST_DELTAS.add()
 
         if self._delta is not None:
             # through _get_delta(): a held-over delta array (a prior
@@ -548,11 +572,11 @@ class ProtoArrayEngine:
         try:
             self._refresh(spec, store)
         except _Fallback:
-            _stats["fallbacks"] += 1
+            _C_FALLBACKS.add()
             return None
         j = self._index.get(bytes(store.justified_checkpoint.root))
         if j is None:
-            _stats["fallbacks"] += 1
+            _C_FALLBACKS.add()
             return None
         _, _, best_desc = self._sweep(spec, store)
         return self._roots[best_desc[j]]
@@ -564,7 +588,7 @@ class ProtoArrayEngine:
         try:
             self._refresh(spec, store)
         except _Fallback:
-            _stats["fallbacks"] += 1
+            _C_FALLBACKS.add()
             return None
         # look up only after _refresh: a prune inside it compacts the
         # arrays and remaps every index
@@ -580,11 +604,11 @@ class ProtoArrayEngine:
         try:
             self._refresh(spec, store)
         except _Fallback:
-            _stats["fallbacks"] += 1
+            _C_FALLBACKS.add()
             return None
         j = self._index.get(bytes(store.justified_checkpoint.root))
         if j is None:
-            _stats["fallbacks"] += 1
+            _C_FALLBACKS.add()
             return None
         viable, _, _ = self._sweep(spec, store)
         n = self._n
@@ -711,7 +735,9 @@ def install_forkchoice_accel(cls) -> None:
             slot_i = int(slot)
             hit = cache.get((root, slot_i))
             if hit is not None:
+                _C_ANC_HIT.add()
                 return self.Root(hit)
+            _C_ANC_MISS.add()
             # the spec's iterative walk, memoizing every visited link so
             # repeated per-vote walks are O(1) amortized
             path = []
@@ -745,14 +771,15 @@ def install_forkchoice_accel(cls) -> None:
 
     def make_get_head(orig):
         def get_head(self, store):
-            eng = _engine(store)
-            if eng is not None:
-                head = eng.head(self, store)
-                if head is not None:
-                    _stats["proto_heads"] += 1
-                    return self.Root(head)
-            _stats["spec_heads"] += 1
-            return orig(self, store)
+            with span("forkchoice.get_head"):
+                eng = _engine(store)
+                if eng is not None:
+                    head = eng.head(self, store)
+                    if head is not None:
+                        _C_HEAD_ENGINE.add()
+                        return self.Root(head)
+                _C_HEAD_SPEC.add()
+                return orig(self, store)
         return get_head
 
     def make_get_weight(orig):
@@ -761,9 +788,9 @@ def install_forkchoice_accel(cls) -> None:
             if eng is not None:
                 w = eng.weight(self, store, root)
                 if w is not None:
-                    _stats["proto_weights"] += 1
+                    _C_WEIGHT_ENGINE.add()
                     return self.Gwei(w)
-            _stats["spec_weights"] += 1
+            _C_WEIGHT_SPEC.add()
             return orig(self, store, root)
         return get_weight
 
@@ -773,9 +800,9 @@ def install_forkchoice_accel(cls) -> None:
             if eng is not None:
                 tree = eng.filtered_block_tree(self, store)
                 if tree is not None:
-                    _stats["proto_trees"] += 1
+                    _C_TREE_ENGINE.add()
                     return tree
-            _stats["spec_trees"] += 1
+            _C_TREE_SPEC.add()
             return orig(self, store)
         return get_filtered_block_tree
 

@@ -13,6 +13,12 @@ _REGISTRY = {}
 
 def register_fork(name):
     def deco(cls):
+        # span-instrument the transition surface from outside so the
+        # method bodies stay spec-shaped (same pattern as the epoch /
+        # fork-choice engine installs; zero-overhead unless
+        # CS_TPU_PROFILE/CS_TPU_TRACE)
+        from consensus_specs_tpu.obs import install_tracing
+        install_tracing(cls)
         _REGISTRY[name] = cls
         cls.fork = name
         return cls
@@ -84,6 +90,7 @@ def use_compiled_registry():
         main as _compile_all, _FORK_ORDER)
     _compile_all()
     importlib.invalidate_caches()  # compiled/ may have just been created
+    from consensus_specs_tpu.obs import install_tracing
     from consensus_specs_tpu.ops.epoch_kernels import install_vectorized_epoch
     from consensus_specs_tpu.forkchoice.proto_array import (
         install_forkchoice_accel)
@@ -93,8 +100,9 @@ def use_compiled_registry():
         cls = getattr(mod, f"Compiled{fork.capitalize()}Spec")
         # compiled method bodies are emitted verbatim from the markdown,
         # so the vectorized-epoch and proto-array fork-choice dispatches
-        # wrap them from outside
+        # (and the tracing spans) wrap them from outside
         install_vectorized_epoch(cls)
         install_forkchoice_accel(cls)
+        install_tracing(cls)
         _REGISTRY[fork] = cls
     _spec_cache.clear()

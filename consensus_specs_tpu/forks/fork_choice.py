@@ -35,6 +35,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Set, Tuple
 
 from consensus_specs_tpu.forkchoice.proto_array import install_forkchoice_accel
+from consensus_specs_tpu.obs import install_tracing
 from consensus_specs_tpu.utils.ssz import hash_tree_root
 
 INTERVALS_PER_SLOT = 3
@@ -473,3 +474,7 @@ class ForkChoiceMixin:
 # the method bodies above stay spec-shaped (the compiled ladder gets the
 # same treatment in ``forks.use_compiled_registry``)
 install_forkchoice_accel(ForkChoiceMixin)
+# span-instrument the handler surface on top of the accel dispatch
+# (the fork classes only define the transition methods; on_block /
+# on_attestation / on_tick live here on the mixin)
+install_tracing(ForkChoiceMixin)

@@ -103,8 +103,10 @@ class Phase0Spec(ValidatorGuideMixin, ForkChoiceMixin):
         # (pysetup/spec_builders/phase0.py:59-105); unbounded dicts would grow
         # without limit across a long generator run.
         self._caches: Dict[str, "_LRUDict"] = {
-            "committee": _LRUDict(512), "proposer": _LRUDict(512),
-            "active_indices": _LRUDict(128), "total_balance": _LRUDict(128),
+            "committee": _LRUDict(512, name="committee"),
+            "proposer": _LRUDict(512, name="proposer"),
+            "active_indices": _LRUDict(128, name="active_indices"),
+            "total_balance": _LRUDict(128, name="total_balance"),
         }
 
     # -- config ------------------------------------------------------------

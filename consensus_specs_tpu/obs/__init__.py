@@ -1,0 +1,38 @@
+"""Unified telemetry subsystem: metrics registry, tracing spans, and
+exporters for the whole engine stack.
+
+Three layers, importable without jax or the fork registry:
+
+* ``obs.registry`` — typed, labeled metrics (``counter`` / ``gauge`` /
+  ``histogram``).  Always on; hot paths pre-bind series at module scope
+  and pay one int add per event.
+* ``obs.tracing``  — hierarchical wall-clock spans with self-vs-
+  cumulative time and (under ``CS_TPU_TRACE=1``) attached counter
+  deltas.  Zero-overhead when disabled.
+* ``obs.export``   — JSON snapshot, Prometheus text format, human
+  ``report()`` table, and the snapshot schema check the bench smokes
+  assert on.
+
+CLI: ``python -m consensus_specs_tpu.tools.obs_report`` replays a
+configurable slot window with full telemetry and prints any exporter's
+view.  Docs: ``docs/observability.md``.
+"""
+from .registry import (                              # noqa: F401
+    counter, gauge, histogram, metrics)
+from .tracing import span, span_tree, stats          # noqa: F401
+from .export import (                                # noqa: F401
+    snapshot, report, to_json, to_prometheus, assert_schema,
+    schema_problems)
+from .instrument import install_tracing              # noqa: F401
+from . import registry, tracing, export              # noqa: F401
+
+
+def enable(on: bool = True, counters=None) -> None:
+    """Runtime gate for span recording (see ``tracing.enable``)."""
+    tracing.enable(on, counters)
+
+
+def reset_all() -> None:
+    """Zero every metric series and drop all recorded spans."""
+    registry.reset()
+    tracing.reset()

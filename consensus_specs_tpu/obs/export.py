@@ -1,0 +1,206 @@
+"""Telemetry exporters: JSON snapshot, Prometheus text format, and the
+human ``report()`` table.
+
+All three read the same two sources — the metrics registry
+(``obs/registry.py``) and the span tree (``obs/tracing.py``) — and are
+pure functions of a snapshot, so the bench smokes can embed
+:func:`snapshot` output in their emitted measurement lines and CI can
+:func:`schema_check` it without re-running anything.
+"""
+import json
+
+from . import registry, tracing
+
+# Prometheus metric name prefix (component namespace per the Prometheus
+# naming conventions).
+PROM_PREFIX = "cs_tpu_"
+
+
+def snapshot() -> dict:
+    """The full telemetry snapshot: metrics + span tree + gate states.
+    Plain data, deep-copied, JSON-serializable."""
+    return {
+        "metrics": registry.snapshot(),
+        "spans": tracing.span_tree(),
+        "flags": {
+            "profile": tracing.is_enabled(),
+            "trace_counters": tracing.trace_counters_enabled(),
+        },
+    }
+
+
+def to_json(indent=None) -> str:
+    return json.dumps(snapshot(), indent=indent, sort_keys=True)
+
+
+def _prom_name(name: str) -> str:
+    return PROM_PREFIX + name.replace(".", "_").replace("-", "_")
+
+
+def _prom_labels(suffix: str) -> str:
+    """Registry label suffix ``{k=v,...}`` -> Prometheus ``{k="v",...}``."""
+    if not suffix:
+        return ""
+    body = suffix[1:-1]
+    parts = []
+    for kv in body.split(","):
+        k, _, v = kv.partition("=")
+        v = v.replace("\\", "\\\\").replace('"', '\\"')
+        parts.append(f'{k}="{v}"')
+    return "{" + ",".join(parts) + "}"
+
+
+def to_prometheus() -> str:
+    """Prometheus text exposition format (version 0.0.4) of the metrics
+    registry.  Spans are exported as three synthetic per-name counters
+    (``_span_count`` / ``_span_seconds`` / ``_span_self_seconds``)."""
+    lines = []
+    for name, m in sorted(registry.metrics().items()):
+        pname = _prom_name(name)
+        lines.append(f"# TYPE {pname} {m.kind}")
+        for suffix, value in m.series_values().items():
+            labels = _prom_labels(suffix)
+            if m.kind == "histogram":
+                # snapshot buckets are per-interval counts; Prometheus
+                # requires CUMULATIVE le buckets with +Inf == _count
+                cum = 0
+                for le, c in value["buckets"].items():
+                    cum += c
+                    lb = labels[1:-1] + "," if labels else ""
+                    lines.append(
+                        f'{pname}_bucket{{{lb}le="{le}"}} {cum}')
+                lines.append(f"{pname}_sum{labels} {value['sum']}")
+                lines.append(f"{pname}_count{labels} {value['count']}")
+            else:
+                lines.append(f"{pname}{labels} {value}")
+    flat = tracing.stats()
+    if flat:
+        lines.append(f"# TYPE {PROM_PREFIX}span_seconds counter")
+        for name, s in sorted(flat.items()):
+            labels = f'{{span="{name}"}}'
+            lines.append(f"{PROM_PREFIX}span_count{labels} {s['count']}")
+            lines.append(f"{PROM_PREFIX}span_seconds{labels} {s['total_s']}")
+            lines.append(
+                f"{PROM_PREFIX}span_self_seconds{labels} {s['self_s']}")
+    return "\n".join(lines) + "\n"
+
+
+def _fmt_count(v) -> str:
+    return f"{v:,}" if isinstance(v, int) else str(v)
+
+
+def report() -> str:
+    """Human-readable table: span tree (indented, cumulative + self
+    time) followed by the non-zero metric series."""
+    out = []
+    tree = tracing.span_tree()
+    if tree:
+        out.append(f"{'span':<44}  {'count':>7}  {'total':>9}  "
+                   f"{'self':>9}  {'max':>9}")
+
+        def _walk(children, depth):
+            rows = sorted(children.items(),
+                          key=lambda kv: -kv[1]["total_s"])
+            for name, node in rows:
+                label = "  " * depth + name
+                out.append(f"{label:<44}  {node['count']:>7}  "
+                           f"{node['total_s']:>8.3f}s  "
+                           f"{node['self_s']:>8.3f}s  "
+                           f"{node['max_s']:>8.4f}s")
+                _walk(node["children"], depth + 1)
+
+        _walk(tree, 0)
+        out.append("")
+    elif tracing.is_enabled():
+        out.append("spans: none recorded")
+        out.append("")
+    else:
+        out.append("spans: disabled (CS_TPU_PROFILE=1 to enable)")
+        out.append("")
+    rows = []
+    for name, m in sorted(registry.snapshot().items()):
+        for suffix, value in m["series"].items():
+            if m["type"] == "histogram":
+                if value["count"]:
+                    rows.append((name + suffix,
+                                 f"count={value['count']} "
+                                 f"sum={value['sum']:.4f} "
+                                 f"max={value['max']:.4f}"))
+            elif value:
+                rows.append((name + suffix, _fmt_count(value)))
+    if rows:
+        width = max(len(n) for n, _ in rows)
+        out.append(f"{'metric'.ljust(width)}  value")
+        for name, value in rows:
+            out.append(f"{name.ljust(width)}  {value}")
+    else:
+        out.append("metrics: all zero")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Snapshot schema validation (bench smokes / CI assert on this)
+# ---------------------------------------------------------------------------
+
+def schema_problems(snap) -> list:
+    """Structural problems of a :func:`snapshot`-shaped dict, empty when
+    valid.  Deliberately dependency-free (no jsonschema in the image)."""
+    probs = []
+    if not isinstance(snap, dict):
+        return ["snapshot is not a dict"]
+    metrics = snap.get("metrics")
+    if not isinstance(metrics, dict):
+        probs.append("missing/invalid 'metrics'")
+        metrics = {}
+    for name, m in metrics.items():
+        if not isinstance(m, dict) or "type" not in m or "series" not in m:
+            probs.append(f"metric {name!r}: missing type/series")
+            continue
+        if m["type"] not in ("counter", "gauge", "histogram"):
+            probs.append(f"metric {name!r}: unknown type {m['type']!r}")
+        if not isinstance(m["series"], dict):
+            probs.append(f"metric {name!r}: series is not a dict")
+            continue
+        for suffix, value in m["series"].items():
+            if suffix and not (suffix.startswith("{")
+                               and suffix.endswith("}")):
+                probs.append(f"metric {name!r}: bad label suffix "
+                             f"{suffix!r}")
+            if m["type"] == "histogram":
+                if not isinstance(value, dict) or "count" not in value:
+                    probs.append(f"metric {name!r}{suffix}: bad "
+                                 "histogram value")
+            elif not isinstance(value, (int, float)):
+                probs.append(f"metric {name!r}{suffix}: non-numeric value")
+    spans = snap.get("spans")
+    if not isinstance(spans, dict):
+        probs.append("missing/invalid 'spans'")
+    else:
+        def _walk(children, path):
+            for name, node in children.items():
+                for field in ("count", "total_s", "self_s", "children"):
+                    if field not in node:
+                        probs.append(f"span {path + name!r}: missing "
+                                     f"{field!r}")
+                        return
+                _walk(node["children"], path + name + ">")
+
+        _walk(spans, "")
+    return probs
+
+
+def assert_schema(snap, require_nonempty=()) -> None:
+    """Raise AssertionError on schema problems; ``require_nonempty``
+    lists metric-name prefixes that must have at least one non-zero
+    counter series (the bench smokes' "the engine really ran" check)."""
+    probs = schema_problems(snap)
+    assert not probs, f"telemetry snapshot schema problems: {probs}"
+    for prefix in require_nonempty:
+        hit = False
+        for name, m in snap["metrics"].items():
+            if name.startswith(prefix) and m["type"] == "counter" \
+                    and any(v for v in m["series"].values()):
+                hit = True
+                break
+        assert hit, (f"no non-zero counter under prefix {prefix!r} "
+                     f"in telemetry snapshot")

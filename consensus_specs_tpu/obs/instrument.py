@@ -1,0 +1,57 @@
+"""From-outside span instrumentation of the spec classes.
+
+The fork ladders keep their method bodies spec-shaped (hand-written
+classes mirror the markdown; compiled classes ARE the markdown), so
+tracing wraps them from outside — the same installation pattern as
+``ops/epoch_kernels.install_vectorized_epoch`` and
+``forkchoice/proto_array.install_forkchoice_accel``:
+``forks.register_fork`` applies :func:`install_tracing` to every
+hand-written fork class at definition time, and
+``forks.use_compiled_registry`` applies it to each compiled class.
+
+Only methods defined on the class itself are wrapped (an inherited
+method is already wrapped on the base; a fork's override gets its own
+wrapper), and wrapping is idempotent.  The wrapper's disabled path is
+one module-global read on top of the original call — per-slot / per-
+block granularity, so it never sits inside a per-validator loop.
+"""
+import functools
+
+from . import tracing
+
+# The traced spec surface: block/epoch-granularity transition stages.
+# Order is irrelevant; nesting comes from runtime call structure.
+TRACED_METHODS = (
+    "state_transition",
+    "process_slots",
+    "process_slot",
+    "process_epoch",
+    "process_block",
+    "process_operations",
+    "on_block",
+    "on_attestation",
+    "on_tick",
+)
+
+
+def install_tracing(cls) -> None:
+    """Wrap ``cls``'s own transition-stage methods in tracing spans."""
+    for name in TRACED_METHODS:
+        fn = cls.__dict__.get(name)
+        if fn is None or getattr(fn, "_obs_span_wrapper", False):
+            continue
+        setattr(cls, name, _make_wrapper(name, fn))
+
+
+def _make_wrapper(name, fn):
+    _span = tracing.span
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        if not tracing._enabled:
+            return fn(self, *args, **kwargs)
+        with _span(name):
+            return fn(self, *args, **kwargs)
+
+    wrapper._obs_span_wrapper = True
+    return wrapper

@@ -1,0 +1,267 @@
+"""Metrics registry: typed, labeled counters / gauges / histograms.
+
+The engine stack's dispatch accounting used to live in three private
+module dicts (``utils/ssz/merkle._stats``, ``forkchoice/proto_array
+._stats``, ``ops/epoch_kernels._stats``); this registry unifies them
+into named, labeled series so exporters (``obs/export.py``), the span
+tracer (``obs/tracing.py``) and the bench smokes read one surface::
+
+    from consensus_specs_tpu.obs import registry
+
+    _HEADS_ENGINE = registry.counter("forkchoice.head").labels(path="engine")
+    ...
+    _HEADS_ENGINE.add()          # hot path: a single int add
+
+Hot-path contract (enforced by the speclint O5xx pass): series are
+resolved ONCE at module import (``counter(name).labels(**kv)``) and the
+per-event cost is one bound-attribute integer add, which the GIL makes
+atomic enough for accounting (the value can never tear; a lost update
+under free-threaded racing costs a count, not a crash).  ``counter()``
+/ ``labels()`` involve dict lookups and a lock and must never sit on a
+per-pair / per-validator path.
+
+Counters are always on: the differential suites assert on them to prove
+which engine actually answered, so they cannot hide behind an env flag.
+``CS_TPU_PROFILE`` / ``CS_TPU_TRACE`` gate the *span* machinery only
+(``obs/tracing.py``).
+
+Snapshots (:func:`snapshot`) are plain nested dicts, deep-copied —
+mutating one never writes back into the registry.  :func:`reset` zeroes
+series **in place** so module-held bound series keep working.
+"""
+import threading
+
+_lock = threading.Lock()
+_metrics = {}           # name -> Counter | Gauge | Histogram
+
+
+class _CounterSeries:
+    """One labeled counter time series.  ``add`` is the hot-path entry:
+    a single GIL-relying int add, no locks, no lookups."""
+
+    __slots__ = ("n",)
+
+    def __init__(self):
+        self.n = 0
+
+    def add(self, n=1):
+        self.n += n
+
+    def _reset(self):
+        self.n = 0
+
+    def _value(self):
+        return self.n
+
+
+class _GaugeSeries:
+    """One labeled gauge series: last-set value plus a running-max
+    helper (``set_max``) for high-watermark style gauges."""
+
+    __slots__ = ("v",)
+
+    def __init__(self):
+        self.v = 0
+
+    def set(self, v):
+        self.v = v
+
+    def set_max(self, v):
+        if v > self.v:
+            self.v = v
+
+    def _reset(self):
+        self.v = 0
+
+    def _value(self):
+        return self.v
+
+
+# Default histogram buckets: sub-ms to minutes, a wall-clock-seconds
+# shape (the main histogram customers are span-adjacent timings).
+DEFAULT_BUCKETS = (0.001, 0.005, 0.025, 0.1, 0.5, 2.5, 10.0, 60.0)
+
+
+class _HistogramSeries:
+    __slots__ = ("buckets", "counts", "count", "sum", "min", "max")
+
+    def __init__(self, buckets):
+        self.buckets = buckets
+        self._reset()
+
+    def observe(self, v):
+        self.count += 1
+        self.sum += v
+        if self.min is None or v < self.min:
+            self.min = v
+        if self.max is None or v > self.max:
+            self.max = v
+        for i, le in enumerate(self.buckets):
+            if v <= le:
+                self.counts[i] += 1
+                break
+        else:
+            self.counts[-1] += 1     # +Inf overflow bucket
+
+    def _reset(self):
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+
+    def _value(self):
+        # bucket keys as strings ("0.1" ... "+Inf"): keeps the snapshot
+        # JSON-sortable and maps 1:1 onto Prometheus ``le`` label values
+        keys = [str(b) for b in self.buckets] + ["+Inf"]
+        return {"count": self.count, "sum": self.sum,
+                "min": self.min, "max": self.max,
+                "buckets": dict(zip(keys, self.counts))}
+
+
+def _label_key(kv: dict) -> tuple:
+    """Canonical, hashable identity of a label set."""
+    return tuple(sorted((str(k), str(v)) for k, v in kv.items()))
+
+
+def render_labels(key: tuple) -> str:
+    """``{k=v,...}`` suffix used in snapshots / test assertions; empty
+    string for the unlabeled series."""
+    if not key:
+        return ""
+    return "{" + ",".join(f"{k}={v}" for k, v in key) + "}"
+
+
+class _Metric:
+    """Shared series-table plumbing; subclasses pick the series type."""
+
+    kind = None
+    _series_cls = None
+
+    def __init__(self, name: str):
+        self.name = name
+        self._series = {}    # label key tuple -> series
+
+    def _make_series(self):
+        return self._series_cls()
+
+    def labels(self, **kv):
+        """The bound series for one label set — resolve at module scope,
+        then bump the returned handle on the hot path."""
+        key = _label_key(kv)
+        s = self._series.get(key)
+        if s is None:
+            with _lock:
+                s = self._series.setdefault(key, self._make_series())
+        return s
+
+    def value(self, **kv):
+        key = _label_key(kv)
+        s = self._series.get(key)
+        return s._value() if s is not None else 0
+
+    def reset(self):
+        for s in self._series.values():
+            s._reset()
+
+    def series_values(self) -> dict:
+        """{rendered-label-suffix: value} snapshot of every series."""
+        return {render_labels(k): s._value()
+                for k, s in sorted(self._series.items())}
+
+    def series_items(self):
+        return list(self._series.items())
+
+
+class Counter(_Metric):
+    kind = "counter"
+    _series_cls = _CounterSeries
+
+    def inc(self, n=1, **kv):
+        """Convenience slow path (label resolution per call) — tests and
+        cold paths only; hot paths pre-bind via :meth:`labels`."""
+        self.labels(**kv).add(n)
+
+    def total(self) -> int:
+        return sum(s.n for s in self._series.values())
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+    _series_cls = _GaugeSeries
+
+    def set(self, v, **kv):
+        self.labels(**kv).set(v)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, buckets=DEFAULT_BUCKETS):
+        super().__init__(name)
+        self.buckets = tuple(buckets)
+
+    def _make_series(self):
+        return _HistogramSeries(self.buckets)
+
+    def observe(self, v, **kv):
+        self.labels(**kv).observe(v)
+
+
+def _get_or_create(name, cls, **kw):
+    m = _metrics.get(name)
+    if m is None:
+        with _lock:
+            m = _metrics.get(name)
+            if m is None:
+                m = cls(name, **kw)
+                _metrics[name] = m
+    if not isinstance(m, cls):
+        raise TypeError(f"metric {name!r} already registered as {m.kind}")
+    return m
+
+
+def counter(name: str) -> Counter:
+    return _get_or_create(name, Counter)
+
+
+def gauge(name: str) -> Gauge:
+    return _get_or_create(name, Gauge)
+
+
+def histogram(name: str, buckets=None) -> Histogram:
+    if buckets is not None:
+        return _get_or_create(name, Histogram, buckets=buckets)
+    return _get_or_create(name, Histogram)
+
+
+def metrics() -> dict:
+    """Live name -> metric mapping (read-only by convention)."""
+    return dict(_metrics)
+
+
+def snapshot() -> dict:
+    """Deep plain-data snapshot: {name: {"type": kind, "series":
+    {label-suffix: value}}}.  Isolated — mutate freely."""
+    return {name: {"type": m.kind, "series": m.series_values()}
+            for name, m in sorted(_metrics.items())}
+
+
+def counter_values() -> dict:
+    """Flat {name + label-suffix: int} over counters only — the cheap
+    view the span tracer diffs on span entry/exit."""
+    out = {}
+    for name, m in _metrics.items():
+        if m.kind != "counter":
+            continue
+        for key, s in m.series_items():
+            out[name + render_labels(key)] = s.n
+    return out
+
+
+def reset(prefix: str = "") -> None:
+    """Zero every series (in place — bound handles stay live) whose
+    metric name starts with ``prefix``; everything when empty."""
+    for name, m in _metrics.items():
+        if name.startswith(prefix):
+            m.reset()

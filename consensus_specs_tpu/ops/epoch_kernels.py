@@ -41,6 +41,8 @@ import os
 
 import numpy as np
 
+from consensus_specs_tpu.obs import registry as obs_registry
+
 from consensus_specs_tpu.utils.lru import LRUDict
 from consensus_specs_tpu.utils.ssz import (
     hash_tree_root, sequence_items, replace_basic_items)
@@ -87,12 +89,27 @@ def enabled() -> bool:
 
 # vectorized-commit / guard-fallback counters; the differential suite
 # asserts on these so a silent fallback cannot turn its comparisons
-# into loop-vs-loop tautologies
-_stats = {"vectorized": 0, "fallback": 0}
+# into loop-vs-loop tautologies.  Registered in the obs metrics registry
+# as ``epoch.transition{path=vectorized|loop}`` plus a dedicated
+# guard-trip counter (series pre-bound, speclint O5xx hot-path rule).
+# ``path=loop`` counts every transition the spec loop ended up running
+# (engine off, genesis no-op, or a guard trip); ``epoch.fallbacks``
+# counts only the guard trips among them.
+_C_EPOCH_VECTORIZED = obs_registry.counter(
+    "epoch.transition").labels(path="vectorized")
+_C_EPOCH_LOOP = obs_registry.counter("epoch.transition").labels(path="loop")
+_C_EPOCH_FALLBACKS = obs_registry.counter("epoch.fallbacks").labels()
 
 
 def stats() -> dict:
-    return dict(_stats)
+    """Back-compat alias view of the ``epoch.*`` registry metrics (the
+    differential suite asserts on these keys)."""
+    return {"vectorized": _C_EPOCH_VECTORIZED.n,
+            "fallback": _C_EPOCH_FALLBACKS.n}
+
+
+def reset_stats() -> None:
+    obs_registry.reset("epoch.")
 
 
 class _Fallback(Exception):
@@ -123,7 +140,7 @@ _VALIDATOR_DTYPE = np.dtype([
 # validators hash_tree_root -> structured column array.  Root-keyed like
 # the spec's committee caches: exact (the root commits to every field)
 # and warm across the five epoch functions of one transition.
-_COLS_CACHE = LRUDict(8)
+_COLS_CACHE = LRUDict(8, name="epoch_cols")
 
 
 # forest column-stash field names -> _VALIDATOR_DTYPE keys
@@ -369,8 +386,10 @@ def _commit_balances(spec, state, old, new) -> None:
 
 def try_process_rewards_and_penalties(spec, state) -> bool:
     if not enabled():
+        _C_EPOCH_LOOP.add()
         return False
     if spec.get_current_epoch(state) == spec.GENESIS_EPOCH:
+        _C_EPOCH_LOOP.add()
         return False    # the spec body is already a no-op early return
     try:
         if "altair" in _fork_lineage(spec):
@@ -378,9 +397,10 @@ def try_process_rewards_and_penalties(spec, state) -> bool:
         else:
             _phase0_rewards_and_penalties(spec, state)
     except _Fallback:
-        _stats["fallback"] += 1
+        _C_EPOCH_FALLBACKS.add()
+        _C_EPOCH_LOOP.add()
         return False
-    _stats["vectorized"] += 1
+    _C_EPOCH_VECTORIZED.add()
     return True
 
 
@@ -578,14 +598,18 @@ def _altair_rewards_and_penalties(spec, state) -> None:
 
 def try_process_inactivity_updates(spec, state) -> bool:
     if not enabled():
+        _C_EPOCH_LOOP.add()
         return False
     if spec.get_current_epoch(state) == spec.GENESIS_EPOCH:
+        _C_EPOCH_LOOP.add()
         return False    # spec body no-ops
     if "altair" not in _fork_lineage(spec):
+        _C_EPOCH_LOOP.add()
         return False
     try:
         cols = validator_columns(state)
         if len(cols) == 0:
+            _C_EPOCH_LOOP.add()
             return False
         prev_epoch = int(spec.get_previous_epoch(state))
         active_prev, eligible = _epoch_masks(spec, cols, prev_epoch)
@@ -602,9 +626,10 @@ def try_process_inactivity_updates(spec, state) -> bool:
         _write_u64_list(state.inactivity_scores, spec.uint64,
                         scores, new_scores)
     except _Fallback:
-        _stats["fallback"] += 1
+        _C_EPOCH_FALLBACKS.add()
+        _C_EPOCH_LOOP.add()
         return False
-    _stats["vectorized"] += 1
+    _C_EPOCH_VECTORIZED.add()
     return True
 
 
@@ -614,13 +639,15 @@ def try_process_inactivity_updates(spec, state) -> bool:
 
 def try_process_registry_updates(spec, state) -> bool:
     if not enabled():
+        _C_EPOCH_LOOP.add()
         return False
     try:
         _registry_updates(spec, state)
     except _Fallback:
-        _stats["fallback"] += 1
+        _C_EPOCH_FALLBACKS.add()
+        _C_EPOCH_LOOP.add()
         return False
-    _stats["vectorized"] += 1
+    _C_EPOCH_VECTORIZED.add()
     return True
 
 
@@ -709,6 +736,7 @@ def _registry_updates(spec, state) -> None:
 
 def try_process_slashings(spec, state) -> bool:
     if not enabled():
+        _C_EPOCH_LOOP.add()
         return False
     try:
         lineage = _fork_lineage(spec)
@@ -720,9 +748,10 @@ def try_process_slashings(spec, state) -> bool:
             multiplier = spec.PROPORTIONAL_SLASHING_MULTIPLIER
         _slashings(spec, state, int(multiplier))
     except _Fallback:
-        _stats["fallback"] += 1
+        _C_EPOCH_FALLBACKS.add()
+        _C_EPOCH_LOOP.add()
         return False
-    _stats["vectorized"] += 1
+    _C_EPOCH_VECTORIZED.add()
     return True
 
 
@@ -757,13 +786,15 @@ def _slashings(spec, state, multiplier) -> None:
 
 def try_process_effective_balance_updates(spec, state) -> bool:
     if not enabled():
+        _C_EPOCH_LOOP.add()
         return False
     try:
         _effective_balance_updates(spec, state)
     except _Fallback:
-        _stats["fallback"] += 1
+        _C_EPOCH_FALLBACKS.add()
+        _C_EPOCH_LOOP.add()
         return False
-    _stats["vectorized"] += 1
+    _C_EPOCH_VECTORIZED.add()
     return True
 
 

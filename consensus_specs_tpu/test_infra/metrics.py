@@ -1,0 +1,53 @@
+"""Shared metrics snapshot/diff helper for counter-asserted tests.
+
+The differential suites prove "the engine really answered" by asserting
+on telemetry counters.  Before this helper each suite hand-rolled
+``pre = stats(); ...; post = stats()`` pairs; now they wrap the probed
+region::
+
+    from consensus_specs_tpu.test_infra.metrics import counting
+
+    with counting() as delta:
+        head = spec.get_head(store)
+    assert delta["forkchoice.head{path=engine}"] == 1
+    assert delta["forkchoice.fallbacks"] == 0
+
+``delta`` maps ``name{label=value,...}`` (label suffix omitted for
+unlabeled series) to the counter increase across the block; keys absent
+from the delta read as 0, so asserting "nothing fell back" needs no
+key-existence dance.  Gauges and histograms are not diffed — counters
+are the monotonic ones.
+
+The pytest fixture ``metrics_diff`` (registered in ``tests/conftest.py``)
+exposes the same context manager as a fixture argument for tests that
+prefer injection over imports.
+"""
+from consensus_specs_tpu.obs import registry
+
+
+class MetricsDelta(dict):
+    """Counter deltas for a ``counting()`` block; missing keys are 0."""
+
+    def __missing__(self, key):
+        return 0
+
+    def nonzero(self) -> dict:
+        return {k: v for k, v in self.items() if v}
+
+
+class counting:
+    """Context manager snapshotting every counter series on entry and
+    exposing the per-series increase after (and during) the block."""
+
+    def __enter__(self) -> MetricsDelta:
+        self._before = registry.counter_values()
+        self._delta = MetricsDelta()
+        return self._delta
+
+    def __exit__(self, exc_type, exc, tb):
+        after = registry.counter_values()
+        before = self._before
+        self._delta.clear()
+        for key, value in after.items():
+            self._delta[key] = value - before.get(key, 0)
+        return False

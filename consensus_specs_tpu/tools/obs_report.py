@@ -1,0 +1,98 @@
+"""Telemetry report CLI: replay a slot window with full instrumentation
+and print any exporter's view.
+
+    python -m consensus_specs_tpu.tools.obs_report \
+        [--slots 32] [--validators 64] [--fork phase0] \
+        [--preset minimal] [--format table|json|prom] [--no-trace]
+
+Builds a mock-genesis state (``test_infra.genesis``), applies one empty
+block per slot through the full ``state_transition`` (signatures off,
+state roots verified), and prints the resulting span tree + metrics
+snapshot.  This is the acceptance surface for the telemetry subsystem:
+with profiling on, a 32-slot replay must produce a span tree rooted at
+``state_transition`` and a snapshot with backend-labeled merkle pair
+counts, fork-choice path counters, and epoch path counters.
+
+``replay()`` is importable — ``benchmarks/bench_obs_overhead.py`` uses
+it as the workload for the disabled-overhead micro-bench.
+"""
+import argparse
+import sys
+
+
+def build_state(spec, n_validators: int):
+    from consensus_specs_tpu.test_infra.genesis import create_genesis_state
+    balances = [spec.MAX_EFFECTIVE_BALANCE] * n_validators
+    return create_genesis_state(spec, balances, spec.MAX_EFFECTIVE_BALANCE)
+
+
+def replay(spec, state, slots: int) -> None:
+    """Apply one empty block per slot through the full
+    ``state_transition`` (the span-instrumented path) AND feed each
+    block to a fork-choice store (``on_tick`` / ``on_block`` /
+    ``get_head``), mutating ``state`` in place.  BLS must already be
+    off.  This drives every instrumented engine: merkle/forest batching,
+    the vectorized epoch kernels, and the proto-array fork choice."""
+    from consensus_specs_tpu.test_infra.block import (
+        build_empty_block_for_next_slot)
+    from consensus_specs_tpu.utils.ssz import hash_tree_root
+    anchor = spec.BeaconBlock(slot=state.slot,
+                              state_root=hash_tree_root(state))
+    store = spec.get_forkchoice_store(state.copy(), anchor)
+    for _ in range(slots):
+        block = build_empty_block_for_next_slot(spec, state)
+        post = state.copy()
+        spec.process_slots(post, block.slot)
+        spec.process_block(post, block)
+        block.state_root = hash_tree_root(post)
+        signed = spec.SignedBeaconBlock(message=block)
+        # validate_result on: exercises the state-root verification
+        # (hash_forest flush) inside the state_transition span; the
+        # signature check is a no-op with bls inactive
+        spec.state_transition(state, signed, validate_result=True)
+        spec.on_tick(store, store.genesis_time
+                     + int(block.slot) * int(spec.config.SECONDS_PER_SLOT))
+        spec.on_block(store, signed)
+        spec.get_head(store)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="replay a slot window with full telemetry")
+    parser.add_argument("--slots", type=int, default=32)
+    parser.add_argument("--validators", type=int, default=64)
+    parser.add_argument("--fork", default="phase0")
+    parser.add_argument("--preset", default="minimal")
+    parser.add_argument("--format", default="table",
+                        choices=["table", "json", "prom"])
+    parser.add_argument("--no-trace", action="store_true",
+                        help="spans without per-span counter deltas")
+    args = parser.parse_args(argv)
+
+    from consensus_specs_tpu import obs
+    from consensus_specs_tpu.forks import build_spec
+    from consensus_specs_tpu.utils import bls
+
+    bls.bls_active = False
+    spec = build_spec(args.fork, args.preset)
+    state = build_state(spec, args.validators)
+    obs.reset_all()
+    obs.enable(True, counters=not args.no_trace)
+    try:
+        replay(spec, state, args.slots)
+    finally:
+        obs.enable(False)
+
+    if args.format == "json":
+        print(obs.to_json(indent=2))
+    elif args.format == "prom":
+        sys.stdout.write(obs.to_prometheus())
+    else:
+        print(f"== {args.slots}-slot {args.fork}/{args.preset} replay, "
+              f"{args.validators} validators ==")
+        print(obs.report())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

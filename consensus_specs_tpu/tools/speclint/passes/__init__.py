@@ -1,4 +1,4 @@
 """speclint passes.  Each module exposes ``NAME`` and ``run(ctx)``."""
-from . import uint64, tracing, ladder, specmd, style  # noqa: F401
+from . import uint64, tracing, ladder, obs, specmd, style  # noqa: F401
 
-ALL_PASSES = (style, uint64, tracing, ladder, specmd)
+ALL_PASSES = (style, uint64, tracing, ladder, specmd, obs)

@@ -1,0 +1,128 @@
+"""observability pass (O5xx): hot-path instrumentation must use the
+zero-overhead guard patterns of ``consensus_specs_tpu/obs``.
+
+Scope: the hot-path packages — ``consensus_specs_tpu/ops/``,
+``consensus_specs_tpu/utils/ssz/``, ``consensus_specs_tpu/forkchoice/``
+— where a per-event instrumentation slip multiplies by the validator /
+chunk / node count.
+
+* O501 — bare wall-clock call (``time.perf_counter()`` / ``time.time()``
+  / ``time.monotonic()``) inside a function in a hot-path file.  Ad-hoc
+  timing pays its cost even with telemetry off; use
+  ``obs.tracing.span`` (class-based, one module-global read when
+  disabled) and let CS_TPU_PROFILE gate it.
+* O502 — per-call metric resolution inside a function in a hot-path
+  file: ``counter(...)`` / ``gauge(...)`` / ``histogram(...)`` or a
+  ``.labels(...)`` bind reached on every event.  Name resolution is a
+  dict lookup behind a lock; bind the series ONCE at module scope
+  (``_C_X = registry.counter("...").labels(...)``) and bump the bound
+  handle (``_C_X.add()``) on the hot path.
+
+Module-scope statements are exempt (that is where pre-binding lives),
+as is ``obs/`` itself and anything under tests/ or benchmarks/ (not in
+scope anyway).  Intentional cold-path uses inside scoped files carry
+``# noqa: O501`` / ``# noqa: O502``.
+"""
+import ast
+
+from ..findings import Finding
+
+NAME = "obs"
+CODE_PREFIXES = ("O",)
+
+# repo-relative path prefixes under instrumentation discipline
+HOT_PREFIXES = (
+    "consensus_specs_tpu/ops/",
+    "consensus_specs_tpu/utils/ssz/",
+    "consensus_specs_tpu/forkchoice/",
+)
+
+_CLOCK_FNS = {"perf_counter", "perf_counter_ns", "monotonic",
+              "monotonic_ns", "time", "time_ns", "process_time"}
+_RESOLVE_FNS = {"counter", "gauge", "histogram"}
+
+
+def _in_scope(path: str) -> bool:
+    return any(path.startswith(p) for p in HOT_PREFIXES)
+
+
+def _is_clock_call(node) -> bool:
+    """``time.perf_counter()``-style: an attribute call rooted at a name
+    ``time`` (the module), or a bare name imported from it."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr in _CLOCK_FNS:
+        root = fn.value
+        return isinstance(root, ast.Name) and root.id == "time"
+    if isinstance(fn, ast.Name) and fn.id in ("perf_counter",
+                                              "perf_counter_ns",
+                                              "monotonic", "process_time"):
+        return True
+    return False
+
+
+def _is_metric_resolution(node) -> bool:
+    """``counter("x")`` / ``registry.gauge("y")`` / ``....labels(...)``."""
+    fn = node.func
+    if isinstance(fn, ast.Name) and fn.id in _RESOLVE_FNS:
+        return True
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in _RESOLVE_FNS:
+            return True
+        if fn.attr == "labels":
+            return True
+    return False
+
+
+def check_source(path: str, text: str):
+    """All O5xx findings for one file (``path`` repo-relative)."""
+    if not _in_scope(path):
+        return []
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError:
+        return []    # the style pass owns E999
+    findings = []
+
+    # every Call node that sits INSIDE a function body; module scope
+    # (including class-level assignments) is the pre-bind zone.  A
+    # single recursive walk with an in-function flag visits each node
+    # exactly once (nested defs stay flagged).
+    def _visit(node, in_fn):
+        for child in ast.iter_child_nodes(node):
+            child_in_fn = in_fn or isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef))
+            if child_in_fn and isinstance(child, ast.Call):
+                if _is_clock_call(child):
+                    findings.append(Finding(
+                        path, child.lineno, "O501",
+                        "bare wall-clock call on a hot path — wrap the "
+                        "region in obs.tracing.span(...) (zero-overhead "
+                        "when disabled) instead of ad-hoc timing"))
+                elif _is_metric_resolution(child):
+                    findings.append(Finding(
+                        path, child.lineno, "O502",
+                        "per-call metric resolution on a hot path — "
+                        "bind the series once at module scope "
+                        "(registry.counter(name).labels(...)) and bump "
+                        "the bound handle"))
+            _visit(child, child_in_fn)
+
+    _visit(tree, False)
+    # a chained ``counter(...).labels(...)`` is two Call nodes on one
+    # line — one finding is enough
+    seen, out = set(), []
+    for f in findings:
+        key = (f.line, f.code)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+def run(ctx):
+    findings = []
+    for rel in ctx.py_files:
+        if not _in_scope(rel):
+            continue
+        findings.extend(check_source(rel, ctx.source(rel)))
+    return findings

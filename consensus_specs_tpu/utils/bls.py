@@ -185,7 +185,7 @@ def only_with_bls(alt_return=None):
 # the same inputs) always exercises the newly selected backend, and
 # benchmarks can call ``clear_verify_memo`` between reps so they time
 # pairings, not dict hits.
-_verify_memo = LRUDict(1 << 16)
+_verify_memo = LRUDict(1 << 16, name="bls_verify")
 
 
 def clear_verify_memo() -> None:

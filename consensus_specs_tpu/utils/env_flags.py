@@ -31,6 +31,17 @@ MERKLE_BATCH_MIN = _int_env("CS_TPU_MERKLE_BATCH_MIN")
 # and disables the columnar bulk container-root path.
 HASH_FOREST = os.environ.get("CS_TPU_HASH_FOREST") != "0"
 
+# Telemetry span gates (``consensus_specs_tpu/obs``).  PROFILE turns on
+# hierarchical tracing spans (wall-clock span tree + flat aggregates,
+# ``obs.tracing`` / the ``utils/profiling`` aliases); TRACE additionally
+# attaches per-span counter deltas (a registry-wide counter diff on
+# every span entry/exit — more detail, more overhead) and implies
+# PROFILE.  Both default OFF: the disabled span path is a single
+# module-global read.  Metric *counters* are not gated — the
+# differential suites assert on them to prove which engine answered.
+PROFILE = os.environ.get("CS_TPU_PROFILE") == "1"
+TRACE = os.environ.get("CS_TPU_TRACE") == "1"
+
 # Proto-array fork-choice kill switch: ``CS_TPU_PROTO_ARRAY=0`` runs the
 # spec-loop ``get_head`` / ``get_weight`` / ``get_filtered_block_tree``
 # (``forks/fork_choice.py``) instead of the incremental columnar engine

@@ -1,20 +1,41 @@
 """Minimal bounded LRU mapping (role of the reference's ``lru-dict`` C
 extension, ``setup.py:550``). Shared by the spec runtimes' committee/
-proposer caches (``forks/phase0.py``) and the BLS verification memo
-(``utils/bls.py``)."""
+proposer caches (``forks/phase0.py``), the BLS verification memo
+(``utils/bls.py``) and the epoch engine's column cache
+(``ops/epoch_kernels.py``).
+
+A cache constructed with a ``name`` reports hit/miss counts to the
+telemetry registry as ``cache.hit{cache=<name>}`` /
+``cache.miss{cache=<name>}`` (series bound once at construction — the
+per-get cost is one int add).  Unnamed caches count nothing.
+"""
 from collections import OrderedDict
+
+from ..obs import registry as _obs_registry
+
+_CACHE_HIT = _obs_registry.counter("cache.hit")
+_CACHE_MISS = _obs_registry.counter("cache.miss")
 
 
 class LRUDict(OrderedDict):
 
-    def __init__(self, maxsize: int):
+    def __init__(self, maxsize: int, name: str = None):
         super().__init__()
         self._maxsize = maxsize
+        if name is not None:
+            self._hit = _CACHE_HIT.labels(cache=name)
+            self._miss = _CACHE_MISS.labels(cache=name)
+        else:
+            self._hit = self._miss = None
 
     def get(self, key, default=None):
         if key in self:
+            if self._hit is not None:
+                self._hit.n += 1
             self.move_to_end(key)
             return self[key]
+        if self._miss is not None:
+            self._miss.n += 1
         return default
 
     def __setitem__(self, key, value):

@@ -1,9 +1,9 @@
-"""Lightweight kernel/process timing registry.
+"""Span timing — thin aliases over the unified telemetry subsystem.
 
-The reference ships only per-case wall-clock printing above a threshold
-(``gen_base/settings.py`` TIME_THRESHOLD_TO_PRINT, used
-``gen_runner.py:357-360``).  This module gives the framework the same
-capability plus named-span aggregation around the hot kernels:
+Historically this module owned a flat named-span timer; the machinery
+now lives in ``consensus_specs_tpu/obs`` (hierarchical span tree,
+metrics registry, exporters — see ``docs/observability.md``).  The
+surface here is kept because kernels and benches import it::
 
     from consensus_specs_tpu.utils.profiling import span, report
 
@@ -14,62 +14,33 @@ capability plus named-span aggregation around the hot kernels:
 Spans nest; disabled (zero-overhead guard) unless ``CS_TPU_PROFILE=1``
 or :func:`enable` is called.  ``jax.block_until_ready`` is the caller's
 responsibility — a span measures wall-clock of whatever it wraps.
+
+Nesting fix vs the old flat timer: ``stats()`` rows now carry both
+``total_s`` (cumulative — a nested span's time also counts inside its
+parent) and ``self_s`` (child-span time excluded), so summing a column
+of ``self_s`` no longer double-counts parents.
 """
-import contextlib
-import os
-import time
-from collections import defaultdict
+from ..obs import tracing
 
-_enabled = os.environ.get("CS_TPU_PROFILE") == "1"
-_stats = defaultdict(lambda: [0, 0.0, 0.0])   # name -> [count, total, max]
-
-
-def enable(on: bool = True) -> None:
-    global _enabled
-    _enabled = on
-
-
-def is_enabled() -> bool:
-    return _enabled
-
-
-def reset() -> None:
-    _stats.clear()
-
-
-@contextlib.contextmanager
-def span(name: str):
-    if not _enabled:
-        yield
-        return
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        dt = time.perf_counter() - t0
-        s = _stats[name]
-        s[0] += 1
-        s[1] += dt
-        s[2] = max(s[2], dt)
-
-
-def stats() -> dict:
-    """{name: {count, total_s, mean_s, max_s}} snapshot."""
-    return {name: {"count": c, "total_s": round(t, 6),
-                   "mean_s": round(t / c, 6) if c else 0.0,
-                   "max_s": round(mx, 6)}
-            for name, (c, t, mx) in _stats.items()}
+# the span context manager itself (class-based, zero-overhead disabled)
+span = tracing.span
+enable = tracing.enable
+is_enabled = tracing.is_enabled
+reset = tracing.reset
+stats = tracing.stats
 
 
 def report() -> str:
-    """Human-readable table, longest total first."""
+    """Human-readable flat table, longest total first (the span TREE
+    view lives in ``obs.report()``)."""
     rows = sorted(stats().items(), key=lambda kv: -kv[1]["total_s"])
     if not rows:
         return "profiling: no spans recorded (enable with CS_TPU_PROFILE=1)"
     width = max(len(n) for n, _ in rows)
-    out = [f"{'span'.ljust(width)}  count     total      mean       max"]
+    out = [f"{'span'.ljust(width)}  count     total      self"
+           f"      mean       max"]
     for name, s in rows:
         out.append(f"{name.ljust(width)}  {s['count']:5d}  "
-                   f"{s['total_s']:8.3f}s  {s['mean_s']:8.4f}s  "
-                   f"{s['max_s']:8.4f}s")
+                   f"{s['total_s']:8.3f}s  {s['self_s']:8.3f}s  "
+                   f"{s['mean_s']:8.4f}s  {s['max_s']:8.4f}s")
     return "\n".join(out)

@@ -25,6 +25,8 @@ from contextlib import contextmanager
 
 import numpy as np
 
+from ...obs import registry as obs_registry
+from ...obs.tracing import span
 from ..env_flags import HASH_FOREST
 from . import merkle
 from .types import BasicValue, ByteVectorBase, Container, _SequenceBase
@@ -36,6 +38,19 @@ _COLUMNAR_MIN = 256
 
 _scope_depth = 0
 _in_flush = False
+
+# Flush accounting (pre-bound series, speclint O5xx hot-path rule):
+#   forest.flushes — hash_forest-scope flushes that found dirty trees
+#   forest.flush_trees — dirty trees covered by those flushes
+#   forest.cross_tree_dispatches — levels where pairs from >1 tree were
+#       gathered into ONE batched hash call (the whole point of the
+#       forest scope; zero here means the scope never amortized)
+#   forest.bulk_roots — container sequences whose element roots were
+#       computed via the columnar (N, fields, 32) cube reduction
+_C_FLUSHES = obs_registry.counter("forest.flushes").labels()
+_C_FLUSH_TREES = obs_registry.counter("forest.flush_trees").labels()
+_C_CROSS_TREE = obs_registry.counter("forest.cross_tree_dispatches").labels()
+_C_BULK_ROOTS = obs_registry.counter("forest.bulk_roots").labels()
 
 
 def scope_active() -> bool:
@@ -66,10 +81,13 @@ def flush_container(obj) -> None:
         return
     _in_flush = True
     try:
-        jobs = []
-        _collect_jobs(obj, jobs)
-        if jobs:
-            _flush_jobs(jobs)
+        with span("hash_forest.flush"):
+            jobs = []
+            _collect_jobs(obj, jobs)
+            if jobs:
+                _C_FLUSHES.add()
+                _C_FLUSH_TREES.add(len(jobs))
+                _flush_jobs(jobs)
     finally:
         _in_flush = False
 
@@ -111,6 +129,7 @@ def _flush_jobs(jobs) -> None:
         if len(live) > 1 and total >= merkle._PAIR_BATCH_MIN \
                 and merkle.can_batch_pairs(total):
             # genuine cross-tree level: one gathered dispatch for all
+            _C_CROSS_TREE.add()
             bufs = [t.gather_pairs(level, ps) for t, ps in live]
             digests = merkle.hash_rows(np.concatenate(bufs))
             off = 0
@@ -185,6 +204,7 @@ def bulk_element_root_bytes(items, et, owner=None) -> bytes:
         buf[:, :size] = raw.reshape(n, size)
         return merkle.hash_rows(buf).tobytes()
     if issubclass(et, Container):
+        _C_BULK_ROOTS.add()
         return _container_root_bytes(items, et, owner)
     return None
 

@@ -29,6 +29,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ...obs import registry as obs_registry
+from ...obs.tracing import span
 from ..env_flags import MERKLE_BATCH_MIN
 
 ZERO_CHUNK = b"\x00" * 32
@@ -88,26 +90,52 @@ _batched_hasher = None
 _batched_hasher_np = None
 
 # Dispatch accounting, asserted by the bench-merkle smoke (a registry-wide
-# commit must hash through the batched paths, never a per-pair loop):
-#   pair_batch_calls / pair_batch_pairs — batched dispatches of gathered
-#       dirty sibling pairs (incremental engine + forest flushes +
-#       columnar container-root reductions), and the pairs they covered
-#   pair_scalar  — dirty pairs hashed one at a time through hashlib
-#   pair_scalar_max — largest batch that went through the scalar loop
-#       (must stay below the pair threshold: bigger ones must batch)
-#   layer_calls  — full-layer dispatches through the native C / JAX path
-#   layer_scalar — layer nodes that fell through to the hashlib loop
-_stats = {"pair_batch_calls": 0, "pair_batch_pairs": 0, "pair_scalar": 0,
-          "pair_scalar_max": 0, "layer_calls": 0, "layer_scalar": 0}
+# commit must hash through the batched paths, never a per-pair loop).
+# Series are pre-bound at module scope (the speclint O5xx hot-path rule);
+# per-event cost is one int add.
+#   merkle.pairs_hashed{backend=native|jax|hashlib} — 64-byte parent
+#       inputs hashed, attributed to the backend that really took them
+#       (the hashlib series re-engaging at scale is the 4x-regression
+#       signature the counters exist to catch)
+#   merkle.dispatches{backend=...} — batched calls per backend
+#   merkle.pair_batch_calls / pair_batch_pairs — batched dispatches of
+#       gathered dirty sibling pairs (incremental engine + forest
+#       flushes + columnar container-root reductions), and the pairs
+#       they covered
+#   merkle.pair_scalar  — dirty pairs hashed one at a time via hashlib
+#   merkle.pair_scalar_max (gauge) — largest batch that went through the
+#       scalar loop (must stay below the pair threshold: bigger ones
+#       must batch)
+#   merkle.layer_calls  — full-layer dispatches, native C / JAX path
+#   merkle.layer_scalar — layer nodes that fell to the hashlib loop
+_PAIRS_HASHED = obs_registry.counter("merkle.pairs_hashed")
+_PAIRS_NATIVE = _PAIRS_HASHED.labels(backend="native")
+_PAIRS_JAX = _PAIRS_HASHED.labels(backend="jax")
+_PAIRS_HASHLIB = _PAIRS_HASHED.labels(backend="hashlib")
+_DISPATCHES = obs_registry.counter("merkle.dispatches")
+_DISPATCH_NATIVE = _DISPATCHES.labels(backend="native")
+_DISPATCH_JAX = _DISPATCHES.labels(backend="jax")
+_C_PAIR_BATCH_CALLS = obs_registry.counter("merkle.pair_batch_calls").labels()
+_C_PAIR_BATCH_PAIRS = obs_registry.counter("merkle.pair_batch_pairs").labels()
+_C_PAIR_SCALAR = obs_registry.counter("merkle.pair_scalar").labels()
+_G_PAIR_SCALAR_MAX = obs_registry.gauge("merkle.pair_scalar_max").labels()
+_C_LAYER_CALLS = obs_registry.counter("merkle.layer_calls").labels()
+_C_LAYER_SCALAR = obs_registry.counter("merkle.layer_scalar").labels()
 
 
 def stats() -> dict:
-    return dict(_stats)
+    """Back-compat alias view of the ``merkle.*`` registry metrics (the
+    differential suites and the bench smoke assert on these keys)."""
+    return {"pair_batch_calls": _C_PAIR_BATCH_CALLS.n,
+            "pair_batch_pairs": _C_PAIR_BATCH_PAIRS.n,
+            "pair_scalar": _C_PAIR_SCALAR.n,
+            "pair_scalar_max": _G_PAIR_SCALAR_MAX.v,
+            "layer_calls": _C_LAYER_CALLS.n,
+            "layer_scalar": _C_LAYER_SCALAR.n}
 
 
 def reset_stats() -> None:
-    for k in _stats:
-        _stats[k] = 0
+    obs_registry.reset("merkle.")
 
 
 def set_batch_thresholds(layer: Optional[int] = None,
@@ -165,14 +193,21 @@ def hash_layer(data: bytes) -> bytes:
     """Hash a full tree layer: data is n*64 bytes -> n*32 bytes."""
     n = len(data) // 64
     if _batched_hasher is not None and n >= _BATCH_THRESHOLD:
-        _stats["layer_calls"] += 1
-        return _batched_hasher(data, n)
+        _C_LAYER_CALLS.n += 1
+        _DISPATCH_JAX.n += 1
+        _PAIRS_JAX.n += n
+        with span("sha256.dispatch"):
+            return _batched_hasher(data, n)
     if _native is not None and n > 1:
-        _stats["layer_calls"] += 1
-        out = ctypes.create_string_buffer(n * 32)
-        _native.sha256_merkle_layer(data, out, n)
-        return out.raw
-    _stats["layer_scalar"] += n
+        _C_LAYER_CALLS.n += 1
+        _DISPATCH_NATIVE.n += 1
+        _PAIRS_NATIVE.n += n
+        with span("sha256.dispatch"):
+            out = ctypes.create_string_buffer(n * 32)
+            _native.sha256_merkle_layer(data, out, n)
+            return out.raw
+    _C_LAYER_SCALAR.n += n
+    _PAIRS_HASHLIB.n += n
     out = bytearray(n * 32)
     for i in range(n):
         out[i * 32:(i + 1) * 32] = sha256(data[i * 64:(i + 1) * 64]).digest()
@@ -186,23 +221,26 @@ def hash_rows(rows: np.ndarray) -> np.ndarray:
     container-root reductions)."""
     m = rows.shape[0]
     if _batched_hasher_np is not None and m >= _BATCH_THRESHOLD:
-        _stats["pair_batch_calls"] += 1
-        _stats["pair_batch_pairs"] += m
-        _stats["layer_calls"] += 1
-        return _batched_hasher_np(np.ascontiguousarray(rows))
+        _C_PAIR_BATCH_CALLS.n += 1
+        _C_PAIR_BATCH_PAIRS.n += m
+        _C_LAYER_CALLS.n += 1
+        _DISPATCH_JAX.n += 1
+        _PAIRS_JAX.n += m
+        with span("sha256.dispatch"):
+            return _batched_hasher_np(np.ascontiguousarray(rows))
     # derive the pair counters from the dispatch hash_layer ACTUALLY
     # took (its layer_scalar delta), so a routing change there can never
     # silently desynchronize the CI-asserted pair accounting
-    before_scalar = _stats["layer_scalar"]
+    before_scalar = _C_LAYER_SCALAR.n
     digests = hash_layer(rows.tobytes())
-    scalar_nodes = _stats["layer_scalar"] - before_scalar
+    scalar_nodes = _C_LAYER_SCALAR.n - before_scalar
     if scalar_nodes:
-        _stats["pair_scalar"] += scalar_nodes
-        if scalar_nodes > _stats["pair_scalar_max"]:
-            _stats["pair_scalar_max"] = scalar_nodes
+        _C_PAIR_SCALAR.n += scalar_nodes
+        if scalar_nodes > _G_PAIR_SCALAR_MAX.v:
+            _G_PAIR_SCALAR_MAX.v = scalar_nodes
     else:
-        _stats["pair_batch_calls"] += 1
-        _stats["pair_batch_pairs"] += m
+        _C_PAIR_BATCH_CALLS.n += 1
+        _C_PAIR_BATCH_PAIRS.n += m
     return np.frombuffer(digests, dtype=np.uint8).reshape(m, 32)
 
 
@@ -382,12 +420,15 @@ class IncrementalTree:
         entry point — no Python-side copy of the level buffer."""
         cur = self.levels[level]
         n = len(ps)
-        view = np.frombuffer(cur, dtype=np.uint8)
-        idx = np.asarray(ps, dtype=np.uint64)
-        out = ctypes.create_string_buffer(n * 32)
-        _native_pairs(view.ctypes.data, len(cur) // 32, idx.ctypes.data, n,
-                      zero_hashes[level], ctypes.addressof(out))
-        return np.frombuffer(out.raw, dtype=np.uint8).reshape(n, 32)
+        _DISPATCH_NATIVE.n += 1
+        _PAIRS_NATIVE.n += n
+        with span("sha256.dispatch"):
+            view = np.frombuffer(cur, dtype=np.uint8)
+            idx = np.asarray(ps, dtype=np.uint64)
+            out = ctypes.create_string_buffer(n * 32)
+            _native_pairs(view.ctypes.data, len(cur) // 32, idx.ctypes.data,
+                          n, zero_hashes[level], ctypes.addressof(out))
+            return np.frombuffer(out.raw, dtype=np.uint8).reshape(n, 32)
 
     def _rehash_level(self, level: int, ps: list) -> list:
         """Re-hash the parent nodes ``ps`` at one level: batched dispatch
@@ -396,15 +437,16 @@ class IncrementalTree:
         if n >= _PAIR_BATCH_MIN and can_batch_pairs(n):
             if _native_pairs is not None and not (
                     _batched_hasher is not None and n >= _BATCH_THRESHOLD):
-                _stats["pair_batch_calls"] += 1
-                _stats["pair_batch_pairs"] += n
+                _C_PAIR_BATCH_CALLS.n += 1
+                _C_PAIR_BATCH_PAIRS.n += n
                 digests = self._native_pair_hash(level, ps)
             else:
                 digests = hash_rows(self.gather_pairs(level, ps))
             return self.scatter_level(level, ps, digests)
-        _stats["pair_scalar"] += n
-        if n > _stats["pair_scalar_max"]:
-            _stats["pair_scalar_max"] = n
+        _C_PAIR_SCALAR.n += n
+        if n > _G_PAIR_SCALAR_MAX.v:
+            _G_PAIR_SCALAR_MAX.v = n
+        _PAIRS_HASHLIB.n += n
         cur, parent = self.levels[level], self.levels[level + 1]
         occ = len(cur) // 32
         nxt, last = [], -1

@@ -21,6 +21,7 @@ merkleization re-hashes only mutated subtree paths.
 import weakref
 from typing import Dict, Optional, Sequence, Tuple
 
+from ...obs import registry as _obs_registry
 from .merkle import (
     IncrementalTree,
     merkleize_chunks,
@@ -30,6 +31,14 @@ from .merkle import (
 )
 
 OFFSET_BYTE_LENGTH = 4
+
+# Composite-root memo accounting (``cache.hit{cache=root}`` — every
+# hash_tree_root call on a Container / sequence either reads the memo or
+# recomputes).  Pre-bound series, one int add per call (speclint O5xx):
+# hash_tree_root is the hottest read in the codebase, so nothing heavier
+# may sit here.
+_C_ROOT_HIT = _obs_registry.counter("cache.hit").labels(cache="root")
+_C_ROOT_MISS = _obs_registry.counter("cache.miss").labels(cache="root")
 
 # Root caching uses parent-pointer dirty propagation: every mutable
 # composite knows the single location that owns it (value semantics:
@@ -612,7 +621,9 @@ class _SequenceBase(SSZValue):
         no global clock involved."""
         memo = getattr(self, "_root_memo", None)
         if memo is not None:
+            _C_ROOT_HIT.n += 1
             return memo
+        _C_ROOT_MISS.n += 1
         root = finish(self._tree_root())
         self._root_memo = root
         return root
@@ -996,7 +1007,9 @@ class Container(SSZValue, metaclass=_ContainerMeta):
         # ownership chain and clears this cache precisely.
         cached = object.__getattribute__(self, "_root_cache")
         if cached is not None:
+            _C_ROOT_HIT.n += 1
             return cached
+        _C_ROOT_MISS.n += 1
         if forest.scope_active():
             # batch scope: flush every dirty subtree of this forest
             # level-aligned before the recursive walk reads their roots

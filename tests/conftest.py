@@ -52,6 +52,24 @@ def pytest_addoption(parser):
                           "of the hand-written classes")
 
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def metrics_diff():
+    """Counter snapshot/diff fixture (``test_infra/metrics.py``): yields
+    the ``counting`` context manager class; keys absent from a measured
+    delta read as 0::
+
+        def test_engine_answered(metrics_diff):
+            with metrics_diff() as delta:
+                spec.get_head(store)
+            assert delta["forkchoice.head{path=engine}"] == 1
+    """
+    from consensus_specs_tpu.test_infra.metrics import counting
+    return counting
+
+
 def pytest_configure(config):
     from consensus_specs_tpu.test_infra import context as ctx
     ctx.DEFAULT_TEST_PRESET = config.getoption("--preset")

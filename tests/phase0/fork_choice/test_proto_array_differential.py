@@ -14,6 +14,7 @@ discard, late-justification pull-ups, and finalization pruning.
 import random
 
 from consensus_specs_tpu.forkchoice import proto_array
+from consensus_specs_tpu.test_infra.metrics import counting
 from consensus_specs_tpu.test_infra.context import (
     spec_state_test, with_all_phases, with_phases, never_bls, pytest_only,
 )
@@ -55,19 +56,18 @@ def _assert_engines_agree(spec, store, check_weights=True):
     eng = getattr(store, "_fc_proto", None)
     assert eng is not None, "engine not attached (CS_TPU_PROTO_ARRAY=0?)"
     assert not eng._broken
-    pre = proto_array.stats()
     proto_array.use_proto()
     try:
-        head_proto = bytes(spec.get_head(store))
-        tree_proto = spec.get_filtered_block_tree(store)
-        weights_proto = {
-            r: int(spec.get_weight(store, r)) for r in store.blocks
-        } if check_weights else None
+        with counting() as delta:
+            head_proto = bytes(spec.get_head(store))
+            tree_proto = spec.get_filtered_block_tree(store)
+            weights_proto = {
+                r: int(spec.get_weight(store, r)) for r in store.blocks
+            } if check_weights else None
     finally:
         proto_array.use_spec()
-    post = proto_array.stats()
-    assert post["proto_heads"] == pre["proto_heads"] + 1
-    assert post["proto_trees"] == pre["proto_trees"] + 1
+    assert delta["forkchoice.head{path=engine}"] == 1
+    assert delta["forkchoice.filtered_tree{path=engine}"] == 1
     try:
         head_spec = bytes(spec.get_head(store))
         tree_spec = spec.get_filtered_block_tree(store)
@@ -247,24 +247,23 @@ def test_weight_is_first_engine_read_after_finalization(spec, state):
     surviving_root = bytes(hash_tree_root(last.message))
     # the prune really is still pending
     assert eng._fin_seen != proto_array._ckpt_key(store.finalized_checkpoint)
-    pre = proto_array.stats()
-    proto_array.use_proto()
-    try:
-        w_surviving = int(spec.get_weight(store, surviving_root))
-        w_pruned = int(spec.get_weight(store, genesis_root))
-    finally:
-        proto_array.use_spec()
-    try:
-        assert w_surviving == int(spec.get_weight(store, surviving_root))
-        assert w_pruned == int(spec.get_weight(store, genesis_root))
-    finally:
-        proto_array.use_auto()
-    post = proto_array.stats()
+    with counting() as delta:
+        proto_array.use_proto()
+        try:
+            w_surviving = int(spec.get_weight(store, surviving_root))
+            w_pruned = int(spec.get_weight(store, genesis_root))
+        finally:
+            proto_array.use_spec()
+        try:
+            assert w_surviving == int(spec.get_weight(store, surviving_root))
+            assert w_pruned == int(spec.get_weight(store, genesis_root))
+        finally:
+            proto_array.use_auto()
     # the very first read triggered the prune and was still answered by
     # the engine; the pruned root fell back to the spec loop
-    assert post["prunes"] == pre["prunes"] + 1
-    assert post["proto_weights"] == pre["proto_weights"] + 1
-    assert post["spec_weights"] == pre["spec_weights"] + 3
+    assert delta["forkchoice.prunes"] == 1
+    assert delta["forkchoice.weight{path=engine}"] == 1
+    assert delta["forkchoice.weight{path=spec}"] == 3
     assert genesis_root not in eng._index
     assert surviving_root in eng._index
     _assert_engines_agree(spec, store)
@@ -286,11 +285,10 @@ def test_proto_disabled_restores_pure_spec_path(spec, state):
         block = build_empty_block_for_next_slot(spec, state)
         signed = state_transition_and_sign_block(spec, state, block)
         tick_and_add_block(spec, store, signed, test_steps)
-        pre = proto_array.stats()
-        assert bytes(spec.get_head(store)) == bytes(hash_tree_root(block))
-        post = proto_array.stats()
-        assert post["proto_heads"] == pre["proto_heads"]
-        assert post["spec_heads"] == pre["spec_heads"] + 1
+        with counting() as delta:
+            assert bytes(spec.get_head(store)) == bytes(hash_tree_root(block))
+        assert delta["forkchoice.head{path=engine}"] == 0
+        assert delta["forkchoice.head{path=spec}"] == 1
     finally:
         proto_array.use_auto()
 
@@ -369,18 +367,17 @@ def test_direct_block_insertion_falls_back(spec, state):
     assert rogue_root in rebuilt[bytes(rogue.parent_root)]
     # the engine detects the unseen block and falls back to the spec
     # loop, which sees the rogue block as the new head
-    pre = proto_array.stats()
-    proto_array.use_proto()
-    try:
-        head = bytes(spec.get_head(store))
-    finally:
-        proto_array.use_auto()
-    post = proto_array.stats()
+    with counting() as delta:
+        proto_array.use_proto()
+        try:
+            head = bytes(spec.get_head(store))
+        finally:
+            proto_array.use_auto()
     # the spec get_head itself re-enters wrapped reads (filtered tree,
     # per-child weights), each refusing the stale array in turn
-    assert post["fallbacks"] > pre["fallbacks"]
-    assert post["proto_heads"] == pre["proto_heads"]
-    assert post["spec_heads"] == pre["spec_heads"] + 1
+    assert delta["forkchoice.fallbacks"] > 0
+    assert delta["forkchoice.head{path=engine}"] == 0
+    assert delta["forkchoice.head{path=spec}"] == 1
     assert head == rogue_root
 
 

@@ -29,6 +29,7 @@ from consensus_specs_tpu.test_infra.block import next_epoch
 from consensus_specs_tpu.test_infra.epoch_processing import (
     get_process_calls, run_epoch_processing_to)
 from consensus_specs_tpu.test_infra.genesis import create_genesis_state
+from consensus_specs_tpu.test_infra.metrics import counting
 from consensus_specs_tpu.utils import bls
 from consensus_specs_tpu.utils.ssz import (
     List, hash_tree_root, uint64)
@@ -178,13 +179,12 @@ def _assert_function_equivalence(spec, state, fns):
         run_epoch_processing_to(spec, s_loop, fn)
         getattr(spec, fn)(s_loop)
         ek.use_vectorized()
-        before = ek.stats()
-        run_epoch_processing_to(spec, s_vec, fn)
-        getattr(spec, fn)(s_vec)
-        after = ek.stats()
-        assert after["vectorized"] > before["vectorized"], \
+        with counting() as delta:
+            run_epoch_processing_to(spec, s_vec, fn)
+            getattr(spec, fn)(s_vec)
+        assert delta["epoch.transition{path=vectorized}"] > 0, \
             f"{spec.fork}.{fn}: vectorized engine never committed"
-        assert after["fallback"] == before["fallback"], \
+        assert delta["epoch.fallbacks"] == 0, \
             f"{spec.fork}.{fn}: unexpected guard fallback"
         assert hash_tree_root(s_loop) == hash_tree_root(s_vec), \
             f"{spec.fork}.{fn}: post-state roots diverge"
@@ -251,10 +251,9 @@ def test_guard_fallback_matches_loop():
     ek.use_loops()
     spec.process_rewards_and_penalties(s_loop)
     ek.use_vectorized()
-    before = ek.stats()
-    spec.process_rewards_and_penalties(s_vec)
-    after = ek.stats()
-    assert after["fallback"] == before["fallback"] + 1
+    with counting() as delta:
+        spec.process_rewards_and_penalties(s_vec)
+    assert delta["epoch.fallbacks"] == 1
     assert hash_tree_root(s_loop) == hash_tree_root(s_vec)
 
 
@@ -416,10 +415,9 @@ def test_compiled_ladder_vectorized_differential():
     ek.use_loops()
     spec.process_epoch(s_loop)
     ek.use_vectorized()
-    before = ek.stats()
-    spec.process_epoch(s_vec)
-    after = ek.stats()
-    assert after["vectorized"] > before["vectorized"], \
+    with counting() as delta:
+        spec.process_epoch(s_vec)
+    assert delta["epoch.transition{path=vectorized}"] > 0, \
         "compiled ladder never dispatched to the vectorized engine"
     assert hash_tree_root(s_loop) == hash_tree_root(s_vec), \
         "compiled-ladder post-state roots diverge"
@@ -459,11 +457,10 @@ def test_registry_mass_ejection_sum_dtype_regression(fork):
     ek.use_loops()
     spec.process_registry_updates(s_loop)
     ek.use_vectorized()
-    before = ek.stats()
-    spec.process_registry_updates(s_vec)
-    after = ek.stats()
-    assert after["vectorized"] == before["vectorized"] + 1
-    assert after["fallback"] == before["fallback"]
+    with counting() as delta:
+        spec.process_registry_updates(s_vec)
+    assert delta["epoch.transition{path=vectorized}"] == 1
+    assert delta["epoch.fallbacks"] == 0
     assert hash_tree_root(s_loop) == hash_tree_root(s_vec)
     # the queue really did saturate: ejections spread over >= 2 epochs,
     # so the per-epoch churn counter (the second fixed sum) was consumed

@@ -1,0 +1,336 @@
+"""Unified telemetry subsystem tests (``consensus_specs_tpu/obs``):
+registry semantics, span-tree shape on a real replay, exporter golden
+checks, and the counter-diff fixture attributing engine-on vs
+engine-off paths to different labels."""
+import json
+
+import pytest
+
+from consensus_specs_tpu import obs
+from consensus_specs_tpu.obs import export, registry, tracing
+from consensus_specs_tpu.test_infra.metrics import counting
+from consensus_specs_tpu.utils import env_flags
+
+
+@pytest.fixture(autouse=True)
+def _quiet_spans():
+    """Spans off around every test here (individual tests enable as
+    needed); teardown restores the env-derived gate state so a
+    CS_TPU_PROFILE=1 pytest process keeps tracing the suites collected
+    after this module."""
+    tracing.enable(False)
+    tracing.reset()
+    yield
+    tracing.enable(env_flags.PROFILE or env_flags.TRACE,
+                   counters=env_flags.TRACE)
+    tracing.reset()
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_labels_and_identity():
+    c = registry.counter("t.obs.requests")
+    assert registry.counter("t.obs.requests") is c
+    a = c.labels(path="engine")
+    b = c.labels(path="spec")
+    assert c.labels(path="engine") is a        # bound series are stable
+    a.add()
+    a.add(2)
+    b.add(5)
+    assert c.value(path="engine") == 3
+    assert c.value(path="spec") == 5
+    assert c.total() == 8
+    # label order does not split series
+    c2 = registry.counter("t.obs.multi")
+    c2.inc(a="1", b="2")
+    assert c2.labels(b="2", a="1").n == 1
+
+
+def test_metric_kind_conflict_raises():
+    registry.counter("t.obs.kind")
+    with pytest.raises(TypeError):
+        registry.gauge("t.obs.kind")
+
+
+def test_reset_keeps_bound_series_live():
+    c = registry.counter("t.obs.reset")
+    s = c.labels(backend="x")
+    s.add(7)
+    registry.reset("t.obs.")
+    assert s.n == 0
+    s.add()                                    # the old handle still counts
+    assert c.value(backend="x") == 1
+
+
+def test_prefix_reset_scopes():
+    a = registry.counter("t.scope.a").labels()
+    b = registry.counter("t.other.b").labels()
+    a.add(3)
+    b.add(4)
+    registry.reset("t.scope.")
+    assert a.n == 0 and b.n == 4
+
+
+def test_snapshot_isolation():
+    c = registry.counter("t.obs.iso")
+    c.labels(k="v").add(2)
+    snap = registry.snapshot()
+    snap["t.obs.iso"]["series"]["{k=v}"] = 999
+    snap["t.obs.iso"]["type"] = "gauge"
+    fresh = registry.snapshot()
+    assert fresh["t.obs.iso"]["series"]["{k=v}"] == 2
+    assert fresh["t.obs.iso"]["type"] == "counter"
+
+
+def test_gauge_set_and_max():
+    g = registry.gauge("t.obs.gauge")
+    g.set(5, lane="a")
+    g.labels(lane="a").set_max(3)
+    assert g.value(lane="a") == 5
+    g.labels(lane="a").set_max(9)
+    assert g.value(lane="a") == 9
+
+
+def test_histogram_buckets():
+    h = registry.histogram("t.obs.hist", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 0.5, 5.0):
+        h.observe(v)
+    val = h.labels()._value()
+    assert val["count"] == 4
+    assert val["min"] == 0.05 and val["max"] == 5.0
+    assert val["buckets"] == {"0.1": 1, "1.0": 2, "+Inf": 1}
+
+
+def test_counting_delta_missing_keys_read_zero():
+    c = registry.counter("t.obs.delta").labels()
+    with counting() as delta:
+        c.add(3)
+    assert delta["t.obs.delta"] == 3
+    assert delta["t.obs.never_bumped"] == 0
+    assert delta.nonzero().get("t.obs.delta") == 3
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+def test_disabled_spans_record_nothing():
+    with tracing.span("t.span.off"):
+        pass
+    assert tracing.stats() == {}
+    assert tracing.span_tree() == {}
+
+
+def test_nested_spans_self_vs_cumulative():
+    tracing.enable(True, counters=False)
+    with tracing.span("outer"):
+        for _ in range(3):
+            with tracing.span("inner"):
+                pass
+    st = tracing.stats()
+    assert st["outer"]["count"] == 1
+    assert st["inner"]["count"] == 3
+    # cumulative >= self; the parent's self excludes child time, so the
+    # self column sums to <= wall-clock (the nesting double-count fix)
+    assert st["outer"]["total_s"] >= st["outer"]["self_s"]
+    assert abs(st["outer"]["self_s"]
+               + st["inner"]["total_s"] - st["outer"]["total_s"]) < 1e-3
+    tree = tracing.span_tree()
+    assert tree["outer"]["children"]["inner"]["count"] == 3
+
+
+def test_span_counter_deltas_attach():
+    c = registry.counter("t.span.work").labels(kind="unit")
+    tracing.enable(True, counters=True)
+    with tracing.span("t.span.cd"):
+        c.add(4)
+    node = tracing.span_tree()["t.span.cd"]
+    assert node["counters"]["t.span.work{kind=unit}"] == 4
+
+
+def test_span_exception_still_recorded():
+    tracing.enable(True, counters=False)
+    with pytest.raises(ValueError):
+        with tracing.span("t.span.err"):
+            raise ValueError("boom")
+    assert tracing.stats()["t.span.err"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# real replay: span-tree shape + label attribution
+# ---------------------------------------------------------------------------
+
+def _replayed_snapshot(slots=8, validators=32):
+    from consensus_specs_tpu.forks import build_spec
+    from consensus_specs_tpu.tools.obs_report import build_state, replay
+    from consensus_specs_tpu.utils import bls
+    spec = build_spec("phase0", "minimal")
+    state = build_state(spec, validators)
+    was_active = bls.bls_active
+    bls.bls_active = False
+    obs.reset_all()
+    obs.enable(True, counters=True)
+    try:
+        replay(spec, state, slots)
+    finally:
+        obs.enable(False)
+        bls.bls_active = was_active
+    return export.snapshot()
+
+
+def test_state_transition_span_tree_shape():
+    snap = _replayed_snapshot()
+    tree = snap["spans"]
+    st = tree["state_transition"]
+    assert st["count"] == 8
+    slots_node = st["children"]["process_slots"]
+    assert slots_node["count"] == 8
+    assert "process_slot" in slots_node["children"]
+    assert "process_epoch" in slots_node["children"]
+    assert "process_block" in st["children"]
+    # the batched merkleization shows up inside the transition
+    assert "hash_forest.flush" in st["children"] \
+        or "hash_forest.flush" in slots_node["children"]["process_slot"][
+            "children"]
+    # fork-choice handlers traced too (replay feeds a store)
+    assert tree["on_block"]["count"] == 8
+    # per-span counter deltas attached under CS_TPU_TRACE semantics
+    assert any(st["counters"].values())
+
+
+def test_replay_snapshot_has_labeled_engine_counters():
+    snap = _replayed_snapshot()
+    metrics = snap["metrics"]
+    pairs = metrics["merkle.pairs_hashed"]["series"]
+    assert sum(pairs.values()) > 0
+    assert set(pairs) <= {"{backend=native}", "{backend=jax}",
+                          "{backend=hashlib}"}
+    heads = metrics["forkchoice.head"]["series"]
+    assert sum(heads.values()) == 8
+    epochs = metrics["epoch.transition"]["series"]
+    assert sum(epochs.values()) > 0
+    assert metrics["cache.hit"]["series"]["{cache=root}"] > 0
+    assert not export.schema_problems(snap)
+
+
+def test_engine_on_vs_off_attribute_to_different_labels():
+    """The counter-diff fixture regression: the same epoch transition
+    books under path=vectorized with the engine on and path=loop with
+    the engine off."""
+    from consensus_specs_tpu.forks import build_spec
+    from consensus_specs_tpu.ops import epoch_kernels as ek
+    from consensus_specs_tpu.test_infra.block import next_epoch
+    from consensus_specs_tpu.test_infra.genesis import create_genesis_state
+    from consensus_specs_tpu.utils import bls
+    spec = build_spec("phase0", "minimal")
+    was_active = bls.bls_active
+    bls.bls_active = False
+    try:
+        state = create_genesis_state(
+            spec, [spec.MAX_EFFECTIVE_BALANCE] * 32,
+            spec.MAX_EFFECTIVE_BALANCE)
+        next_epoch(spec, state)
+        s_on, s_off = state.copy(), state.copy()
+        ek.use_vectorized()
+        try:
+            with counting() as delta_on:
+                spec.process_epoch(s_on)
+        finally:
+            ek.use_loops()
+        try:
+            with counting() as delta_off:
+                spec.process_epoch(s_off)
+        finally:
+            ek.use_auto()
+    finally:
+        bls.bls_active = was_active
+    assert delta_on["epoch.transition{path=vectorized}"] > 0
+    assert delta_on["epoch.transition{path=loop}"] == 0
+    assert delta_off["epoch.transition{path=vectorized}"] == 0
+    assert delta_off["epoch.transition{path=loop}"] > 0
+
+
+def test_metrics_diff_fixture(metrics_diff):
+    c = registry.counter("t.obs.fixture").labels()
+    with metrics_diff() as delta:
+        c.add(2)
+    assert delta["t.obs.fixture"] == 2
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+def test_prometheus_export_format():
+    registry.counter("t.prom.hits").labels(backend="native").add(3)
+    registry.gauge("t.prom.depth").set(7)
+    registry.histogram("t.prom.lat", buckets=(1.0,)).observe(0.5)
+    text = export.to_prometheus()
+    assert "# TYPE cs_tpu_t_prom_hits counter" in text
+    assert 'cs_tpu_t_prom_hits{backend="native"} 3' in text
+    assert "# TYPE cs_tpu_t_prom_depth gauge" in text
+    assert "cs_tpu_t_prom_depth 7" in text
+    assert 'cs_tpu_t_prom_lat_bucket{le="1.0"} 1' in text
+    # buckets are cumulative in the exposition: +Inf must equal _count
+    assert 'cs_tpu_t_prom_lat_bucket{le="+Inf"} 1' in text
+    assert "cs_tpu_t_prom_lat_count 1" in text
+
+
+def test_json_snapshot_round_trips():
+    registry.counter("t.json.c").labels(x="y").add(1)
+    parsed = json.loads(export.to_json())
+    assert parsed["metrics"]["t.json.c"]["series"]["{x=y}"] == 1
+    assert "spans" in parsed and "flags" in parsed
+
+
+def test_schema_check_accepts_real_and_rejects_corrupt():
+    snap = export.snapshot()
+    assert export.schema_problems(snap) == []
+    bad = json.loads(json.dumps(snap))
+    bad["metrics"]["broken"] = {"type": "wat", "series": {"oops": "nan"}}
+    probs = export.schema_problems(bad)
+    assert any("unknown type" in p for p in probs)
+    assert any("non-numeric" in p for p in probs)
+    assert export.schema_problems({"metrics": 3}) != []
+    with pytest.raises(AssertionError):
+        export.assert_schema(snap, require_nonempty=("no.such.metric",))
+
+
+def test_report_renders_tree_and_metrics():
+    registry.counter("t.report.c").labels().add(2)
+    tracing.enable(True, counters=False)
+    with tracing.span("t.report.outer"):
+        with tracing.span("t.report.inner"):
+            pass
+    text = export.report()
+    assert "t.report.outer" in text
+    assert "  t.report.inner" in text       # indented under its parent
+    assert "t.report.c" in text
+
+
+# ---------------------------------------------------------------------------
+# env gates / profiling alias surface
+# ---------------------------------------------------------------------------
+
+def test_env_flags_registered():
+    assert hasattr(env_flags, "PROFILE")
+    assert hasattr(env_flags, "TRACE")
+
+
+def test_profiling_module_is_thin_alias():
+    from consensus_specs_tpu.utils import profiling
+    assert profiling.span is tracing.span
+    assert profiling.stats is tracing.stats
+    profiling.enable(True)
+    try:
+        with profiling.span("t.alias"):
+            pass
+        st = profiling.stats()["t.alias"]
+        assert {"count", "total_s", "self_s", "mean_s", "max_s"} \
+            <= set(st)
+        assert "t.alias" in profiling.report()
+    finally:
+        profiling.enable(False)
+        profiling.reset()

@@ -21,7 +21,7 @@ from consensus_specs_tpu.tools.speclint import driver
 from consensus_specs_tpu.tools.speclint.findings import (
     Finding, noqa_codes, suppressed)
 from consensus_specs_tpu.tools.speclint.passes import (
-    ladder, specmd, style, tracing, uint64)
+    ladder, obs as obs_pass, specmd, style, tracing, uint64)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -353,6 +353,65 @@ def test_specmd_clean_block_passes():
         "    return compute_epoch_at_slot(state.slot)\n"
         "```\n")
     assert specmd.check_markdown("specs/demo.md", md) == []
+
+
+# ---------------------------------------------------------------------------
+# observability pass
+# ---------------------------------------------------------------------------
+
+def test_obs_flags_bare_clock_on_hot_path():
+    src = (
+        "import time\n"
+        "def hot(xs):\n"
+        "    t0 = time.perf_counter()\n"
+        "    work(xs)\n"
+        "    return time.time() - t0\n")
+    codes = _codes(obs_pass.check_source(SCOPED, src))
+    assert codes == ["O501", "O501"]
+
+
+def test_obs_flags_per_call_metric_resolution():
+    src = (
+        "from consensus_specs_tpu.obs import registry\n"
+        "def hot(xs):\n"
+        "    registry.counter('m.x').inc()\n"
+        "    s = registry.counter('m.y').labels(backend='jax')\n"
+        "    s.add(len(xs))\n")
+    codes = _codes(obs_pass.check_source(SCOPED, src))
+    # the chained counter().labels() line reports once
+    assert codes == ["O502", "O502"]
+
+
+def test_obs_accepts_guarded_idioms():
+    """Module-scope pre-binding, bound-series bumps, and spans are the
+    sanctioned patterns — zero findings."""
+    src = (
+        "from consensus_specs_tpu.obs import registry\n"
+        "from consensus_specs_tpu.obs.tracing import span\n"
+        "_C = registry.counter('m.pairs').labels(backend='native')\n"
+        "def hot(xs):\n"
+        "    _C.add(len(xs))\n"
+        "    with span('m.dispatch'):\n"
+        "        return work(xs)\n")
+    assert _codes(obs_pass.check_source(SCOPED, src)) == []
+
+
+def test_obs_out_of_scope_files_ignored():
+    src = "import time\ndef f():\n    return time.perf_counter()\n"
+    assert obs_pass.check_source("benchmarks/bench_all.py", src) == []
+    assert obs_pass.check_source(
+        "consensus_specs_tpu/obs/tracing.py", src) == []
+
+
+def test_obs_noqa_suppression():
+    src = (
+        "import time\n"
+        "def cold_build():\n"
+        "    t0 = time.perf_counter()  # noqa: O501\n"
+        "    return t0\n")
+    findings = obs_pass.check_source(SCOPED, src)
+    lines = src.splitlines()
+    assert [f for f in findings if not suppressed(f, lines)] == []
 
 
 # ---------------------------------------------------------------------------
