@@ -7,7 +7,8 @@ GENERATORS = operations sanity finality rewards random forks epoch_processing \
              fork_choice merkle_proof ssz_generic sync transition
 
 .PHONY: test citest test-crypto bench bench-all bench-merkle-smoke \
-        bench-forkchoice-smoke bench-obs-smoke obs-report dryrun \
+        bench-forkchoice-smoke bench-obs-smoke bench-block-smoke \
+        obs-report dryrun \
         warm native lint speclint-baseline \
         generate_tests $(addprefix gen_,$(GENERATORS)) clean-vectors pyspec
 
@@ -29,6 +30,7 @@ citest:
 	          "degrading to the jax/python backends" >&2; fi
 	$(PYTHON) benchmarks/bench_merkle_smoke.py
 	$(PYTHON) benchmarks/bench_fork_choice.py --smoke
+	$(PYTHON) benchmarks/bench_block_verify.py --smoke
 	$(PYTHON) -m pytest tests/ -q --enable-bls --bls-type fastest
 
 # static checks: syntax gate + the speclint multi-pass analyzer
@@ -53,7 +55,8 @@ speclint-baseline:
 # process per file: the big XLA programs (pairing, sharded verify,
 # batched SHA) each claim gigabytes during compile, and accumulating
 # them in one interpreter can exhaust the 1-core host mid-run
-CRYPTO_SUITES = tests/test_bls.py tests/test_native_bls.py \
+CRYPTO_SUITES = tests/test_bls.py tests/test_bls_rlc.py \
+	tests/test_native_bls.py \
 	tests/test_numpy_kernels.py tests/test_hash_to_curve.py \
 	tests/test_sha256_kernel.py tests/test_curdleproofs.py \
 	tests/test_jax_bls.py tests/test_multichip.py tests/deneb/kzg
@@ -88,6 +91,15 @@ bench-merkle-smoke:
 # forkchoice/proto_array counters; nonzero exit on regression)
 bench-forkchoice-smoke:
 	$(PYTHON) benchmarks/bench_fork_choice.py --smoke
+
+# whole-block signature-verification smoke: the deferred flush must
+# take the RLC path with EXACTLY one pairing for the block (asserted
+# via the bls.flush/bls.pairings counters; nonzero exit on regression),
+# agree with the lane path + python oracle on a tampered-item matrix,
+# and report lane-vs-RLC and oracle-vs-RLC ratios
+bench-block-smoke:
+	-$(MAKE) native
+	$(PYTHON) benchmarks/bench_block_verify.py --smoke
 
 # telemetry disabled-path overhead: with CS_TPU_PROFILE/CS_TPU_TRACE
 # unset, the span + counter instrumentation across the engine stack

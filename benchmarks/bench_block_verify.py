@@ -1,0 +1,249 @@
+"""Whole-block signature-verification benchmark (``make bench-block-smoke``
+runs the counter-asserted smoke shape in CI).
+
+The headline crypto number (ROADMAP item 3): a mainnet-shaped block —
+up to 128 attestation aggregates over committee-sized pubkey sets, plus
+the proposer signature and randao reveal — flushed through the deferred
+batch context three ways:
+
+* **rlc**   — the random-linear-combination fold (``CS_TPU_BLS_RLC=1``,
+  default): 2 MSMs + ONE product pairing for the whole block
+  (``ops/bls_rlc.py``);
+* **lanes** — the per-lane batch path (``CS_TPU_BLS_RLC=0``): one full
+  pairing check per queued item;
+* **python oracle** — the reference-role pure-python backend, one
+  ``FastAggregateVerify`` at a time (timed on a subset and extrapolated:
+  a full 128-attestation oracle block takes minutes).
+
+Aggregate signatures are built with one ``Sign`` per attestation
+(``H(m)^sum(sk_i)`` equals the aggregate of the members' signatures), so
+the bench spends its time verifying, not signing.
+
+``--smoke`` also counter-asserts the engine contract: the RLC flush must
+report ``bls.flush{path=rlc}`` with EXACTLY one ``bls.pairings`` tick
+per block, byte-agree with the lane path and the oracle on a
+valid-and-invalid item matrix, and emit a schema-valid obs snapshot.
+
+``--slots N`` appends a sustained full ``state_transition`` loop (BLS
+on) on a minimal-preset genesis — slots/sec with per-stage span
+attribution (host_pack / hash_to_field / msm / pairing) when
+``CS_TPU_PROFILE=1``.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu.ops.bls12_381.fields import R_ORDER
+
+
+def _build_block_items(n_aggregates, committee, n_singles=2):
+    """n_aggregates FastAggregateVerify triples (distinct messages,
+    ``committee`` pubkeys each, one Sign per aggregate via the privkey
+    sum) + n_singles single-pubkey items (proposer / randao stand-ins)."""
+    from consensus_specs_tpu.test_infra.keys import privkeys, pubkey
+    from consensus_specs_tpu.utils import bls
+    items = []
+    for a in range(n_aggregates):
+        members = [privkeys[(a * committee + j) % len(privkeys)]
+                   for j in range(committee)]
+        msg = b"block-att-" + a.to_bytes(4, "little") + b"\x00" * 18
+        sig = bls.Sign(sum(members) % R_ORDER, msg)
+        items.append(([pubkey(sk) for sk in members], msg, sig))
+    for s in range(n_singles):
+        sk = privkeys[-(s + 1)]
+        msg = b"block-hdr-" + s.to_bytes(4, "little") + b"\x00" * 18
+        items.append(([pubkey(sk)], msg, bls.Sign(sk, msg)))
+    return items
+
+
+def _flush(items):
+    from consensus_specs_tpu.utils import bls
+    bls.clear_verify_memo()
+    batch = bls.DeferredBatch()
+    for pks, msg, sig in items:
+        batch.add(pks, msg, sig)
+    return batch.flush()
+
+
+def _time_flush(items, reps):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        ok = _flush(items)
+        best = min(best, time.perf_counter() - t0)
+        assert ok, "bench items must verify"
+    return best
+
+
+def _time_oracle(items, limit):
+    """Per-item pure-python verification, extrapolated per item CLASS:
+    committee-size aggregates and single-pubkey items have different
+    oracle costs (the decode/aggregation prefix), so each class is
+    timed on its own subset and scaled by its own count."""
+    from consensus_specs_tpu.ops.bls12_381 import ciphersuite
+
+    def timed(sub):
+        t0 = time.perf_counter()
+        for pks, msg, sig in sub:
+            assert ciphersuite.FastAggregateVerify(pks, msg, sig)
+        return (time.perf_counter() - t0) / len(sub) if sub else 0.0
+
+    aggs = [it for it in items if len(it[0]) > 1]
+    singles = [it for it in items if len(it[0]) == 1]
+    per_agg = timed(aggs[:limit])
+    per_single = timed(singles[:max(1, limit // 2)])
+    total = per_agg * len(aggs) + per_single * len(singles)
+    return total, min(limit, len(aggs)) + min(max(1, limit // 2),
+                                              len(singles))
+
+
+def _pick_backend(name):
+    from consensus_specs_tpu.utils import bls
+    if name == "fastest":
+        bls.use_fastest()
+    elif name == "native":
+        bls.use_native()
+    elif name == "jax":
+        bls.use_jax()
+    else:
+        bls.use_py()
+    return bls.backend_name()
+
+
+def _counter_asserted_smoke(items, metrics):
+    """The CI contract: RLC path really answers, with ONE pairing."""
+    from consensus_specs_tpu.utils import bls
+    pairings = metrics["bls.pairings"]
+    flush = metrics["bls.flush"]
+    assert bls.rlc_enabled(), \
+        "smoke must run with CS_TPU_BLS_RLC unset/1 (the default)"
+    p0, r0 = pairings.total(), flush.value(path="rlc")
+    assert _flush(items), "valid block failed to verify"
+    assert flush.value(path="rlc") - r0 == 1, "flush did not take the RLC path"
+    assert pairings.total() - p0 == 1, \
+        f"RLC flush used {pairings.total() - p0} pairings, expected 1"
+    # invalid matrix: one tampered aggregate -> fallback bisect must
+    # blame exactly that item, identically to the oracle's verdicts
+    bad = list(items)
+    pks0, msg0, _ = bad[0]
+    bad[0] = (pks0, msg0, bad[1][2])
+    from consensus_specs_tpu.utils.bls import DeferredBatch
+    from consensus_specs_tpu.utils import bls as _bls
+    _bls.clear_verify_memo()
+    batch = DeferredBatch()
+    for pks, msg, sig in bad:
+        batch.add(pks, msg, sig)
+    assert not batch.flush(), "tampered block must fail"
+    assert batch.last_results[0] is False \
+        and all(batch.last_results[1:]), \
+        f"bisect blamed the wrong items: {batch.last_results}"
+
+
+def _sustained_slots(n_slots):
+    """Full state_transition loop (BLS on) on a minimal-preset genesis:
+    the serving-throughput shape, slots/sec."""
+    from consensus_specs_tpu.forks import build_spec
+    from consensus_specs_tpu.test_infra.context import (
+        _get_genesis_state, default_balances, default_activation_threshold)
+    from consensus_specs_tpu.test_infra.attestations import (
+        get_valid_attestation)
+    from consensus_specs_tpu.test_infra.block import (
+        build_empty_block_for_next_slot, state_transition_and_sign_block)
+    from consensus_specs_tpu.utils import bls
+
+    bls.bls_active = True
+    spec = build_spec("phase0", "minimal")
+    state = _get_genesis_state(spec, default_balances,
+                               default_activation_threshold).copy()
+    t0 = time.perf_counter()
+    for _ in range(n_slots):
+        attestation = get_valid_attestation(spec, state, signed=True) \
+            if state.slot > 0 else None
+        block = build_empty_block_for_next_slot(spec, state)
+        if attestation is not None and int(state.slot) + 1 >= int(
+                attestation.data.slot
+                + spec.MIN_ATTESTATION_INCLUSION_DELAY):
+            block.body.attestations.append(attestation)
+        state_transition_and_sign_block(spec, state, block)
+    dt = time.perf_counter() - t0
+    return n_slots / dt, dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--attestations", type=int, default=128)
+    ap.add_argument("--committee", type=int, default=64)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--oracle-items", type=int, default=4,
+                    help="items actually timed on the python oracle "
+                         "(extrapolated to the full block)")
+    ap.add_argument("--backend", default="fastest",
+                    choices=["fastest", "native", "jax", "py"])
+    ap.add_argument("--slots", type=int, default=0,
+                    help="append a sustained state_transition loop of "
+                         "this many slots (BLS on)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="small counter-asserted CI shape")
+    args = ap.parse_args()
+
+    if args.smoke:
+        args.attestations, args.committee = 8, 8
+        args.reps = 2
+
+    from consensus_specs_tpu.obs import export, registry
+    from consensus_specs_tpu.utils import bls
+    metrics = {name: registry.counter(name)
+               for name in ("bls.pairings", "bls.flush")}
+
+    backend = _pick_backend(args.backend)
+    bls.bls_active = True
+    items = _build_block_items(args.attestations, args.committee)
+
+    if args.smoke:
+        _counter_asserted_smoke(items, metrics)
+
+    prior_rlc = os.environ.get("CS_TPU_BLS_RLC")
+    try:
+        os.environ["CS_TPU_BLS_RLC"] = "1"
+        rlc_s = _time_flush(items, args.reps)
+        os.environ["CS_TPU_BLS_RLC"] = "0"
+        lanes_s = _time_flush(items, args.reps)
+    finally:
+        if prior_rlc is None:
+            del os.environ["CS_TPU_BLS_RLC"]
+        else:
+            os.environ["CS_TPU_BLS_RLC"] = prior_rlc
+    oracle_s, oracle_timed = _time_oracle(items, args.oracle_items)
+
+    out = {
+        "metric": f"block verify, {args.attestations} aggregates x "
+                  f"{args.committee} keys (+2 singles)",
+        "backend": backend,
+        "rlc_flush_s": round(rlc_s, 4),
+        "lanes_flush_s": round(lanes_s, 4),
+        "python_oracle_s": round(oracle_s, 3),
+        "oracle_items_timed": oracle_timed,
+        "lane_vs_rlc": round(lanes_s / rlc_s, 2),
+        "oracle_vs_rlc": round(oracle_s / rlc_s, 1),
+    }
+    if args.slots:
+        slots_per_s, wall = _sustained_slots(args.slots)
+        out["sustained_slots"] = args.slots
+        out["slots_per_sec"] = round(slots_per_s, 2)
+        out["sustained_wall_s"] = round(wall, 2)
+
+    # telemetry snapshot: schema-valid with the bls flush/pairing
+    # counters populated (the "one pairing per block" tripwire)
+    snap = export.snapshot()
+    export.assert_schema(snap, require_nonempty=("bls.",))
+    out["obs"] = {"metrics": {k: v for k, v in snap["metrics"].items()
+                              if k.startswith("bls.")}}
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -36,6 +36,7 @@ from typing import Dict, Set, Tuple
 
 from consensus_specs_tpu.forkchoice.proto_array import install_forkchoice_accel
 from consensus_specs_tpu.obs import install_tracing
+from consensus_specs_tpu.utils import bls
 from consensus_specs_tpu.utils.ssz import hash_tree_root
 
 INTERVALS_PER_SLOT = 3
@@ -380,13 +381,21 @@ class ForkChoiceMixin:
             store, block.parent_root, store.finalized_checkpoint.epoch)
         assert bytes(store.finalized_checkpoint.root) == bytes(finalized_block)
 
-        # deneb+: blob data-availability check (deneb/fork-choice.md:70);
-        # no-op pre-deneb
-        self._on_block_data_availability_check(block)
+        # One batched-verification scope spans the data-availability
+        # check AND the state transition: the blob-KZG batch pairing
+        # (deneb+) defers into the same flush as the block's signature
+        # checks, so the whole on_block verifies with ONE pairing on the
+        # RLC path (utils/bls.py; state_transition's nested scope joins
+        # this batch and flushes it before any store mutation below).
+        with bls.batched_verification() as batch:
+            # deneb+: blob data-availability check
+            # (deneb/fork-choice.md:70); no-op pre-deneb
+            self._on_block_data_availability_check(block)
 
-        state = pre_state
-        block_root = hash_tree_root(block)
-        self.state_transition(state, signed_block, True)
+            state = pre_state
+            block_root = hash_tree_root(block)
+            self.state_transition(state, signed_block, True)
+        batch.assert_valid()
         # bellatrix+: merge-transition validation hook
         # (specs/bellatrix/fork-choice.md:266); no-op pre-merge
         self._on_block_merge_check(store.block_states[bytes(block.parent_root)],

@@ -114,6 +114,47 @@ class G2Point(_Point):
         return bytes(data)
 
 
+def msm(points, scalars, window: int = 4):
+    """Windowed-bucket (Pippenger) multi-scalar multiplication over either
+    group: ``sum([k_i] P_i)`` for a list of ``G1Point``s or ``G2Point``s.
+
+    Group-agnostic — only uses the shared affine ``+``/``double``/``inf``
+    surface, so the RLC batch verifier can run its G2 signature
+    combination ``sum(r_i * sig_i)`` through the same code path as small
+    G1 folds.  The window defaults to 4 bits: RLC coefficients are
+    128-bit, where 32 windows x 15 buckets beats the 8-bit setup cost.
+    """
+    assert len(points) == len(scalars)
+    live = [(p, int(s)) for p, s in zip(points, scalars)
+            if not p.infinity and int(s) % R_ORDER != 0]
+    if not live:
+        return (type(points[0]).inf() if points else G1Point.inf())
+    cls = type(live[0][0])
+    scal = [s % R_ORDER for _, s in live]
+    n_bits = max(s.bit_length() for s in scal)
+    n_windows = (n_bits + window - 1) // window
+    mask = (1 << window) - 1
+    result = cls.inf()
+    for w in range(n_windows - 1, -1, -1):
+        if not result.infinity:
+            for _ in range(window):
+                result = result.double()
+        buckets = [None] * (mask + 1)
+        for (pt, _), s in zip(live, scal):
+            digit = (s >> (w * window)) & mask
+            if digit:
+                buckets[digit] = pt if buckets[digit] is None \
+                    else buckets[digit] + pt
+        running = cls.inf()
+        window_sum = cls.inf()
+        for digit in range(mask, 0, -1):
+            if buckets[digit] is not None:
+                running = running + buckets[digit]
+            window_sum = window_sum + running
+        result = result + window_sum
+    return result
+
+
 def _check_flags(data: bytes):
     c_flag = (data[0] >> 7) & 1
     i_flag = (data[0] >> 6) & 1

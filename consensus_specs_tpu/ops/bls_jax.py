@@ -472,8 +472,139 @@ def aggregate_verify_batch(items) -> list:
 
 
 # ---------------------------------------------------------------------------
-# Scalar (reference-shaped) API
+# RLC combined check - the one-pairing-per-block path.
+#
+# ``utils/bls.DeferredBatch.flush`` folds a whole block's queued
+# FastAggregateVerify checks (plus any deferred raw pairing checks, e.g.
+# the Deneb blob-KZG batch) into
+#
+#   prod_i e(r_i * agg_pk_i, H(m_i)) * e(-G1, sum_i r_i * sig_i) == 1
+#
+# so the device work is: one batched pubkey aggregation, one batched
+# 128-bit G1 scaling, one G2 MSM over the signatures, hash-to-curve, and
+# a SINGLE product pairing check (one final exponentiation) - versus one
+# full 2-pair pairing check per lane on the per-lane path.
 # ---------------------------------------------------------------------------
+
+@kjit
+def _j_g1_scale(pts, bits):
+    """(B,) packed projective G1 x (B, n_bits) MSB-first bit planes ->
+    (B,) scaled points (no reduction - per-lane [r_i]P_i)."""
+    return PT.g1_scalar_mul(pts, bits)
+
+
+@kjit
+def _j_g2_scale_sum(sig_pts, bits):
+    """(B,) packed projective G2 x (B, n_bits) bits -> sum_i [r_i]Q_i,
+    the RLC signature MSM: per-lane double-and-add, log-depth tree sum."""
+    return PT.g2_tree_sum(PT.g2_scalar_mul(sig_pts, bits))
+
+
+def _bits_msb(scalars, n_bits: int) -> np.ndarray:
+    """(B,) ints -> (B, n_bits) uint32 MSB-first bit planes.
+
+    Vectorized via unpackbits over big-endian byte rows: this sits in
+    the per-block host_pack stage, where a per-bit python loop
+    (B x n_bits iterations) would be a fixed serial tax per flush."""
+    n_bytes = (n_bits + 7) // 8
+    rows = np.frombuffer(
+        b"".join(int(s).to_bytes(n_bytes, "big") for s in scalars),
+        dtype=np.uint8).reshape(len(scalars), n_bytes)
+    bits = np.unpackbits(rows, axis=1)[:, -n_bits:]
+    return bits.astype(np.uint32)
+
+
+RLC_SCALAR_BITS = 128
+
+
+def rlc_combined_check(pk_rows, msgs, sig_pts, scalars, extra_pairs=(),
+                       mesh_devices=None) -> bool:
+    """One product pairing for a whole flushed batch.
+
+    ``pk_rows``: per item, the list of packed affine pubkey rows (already
+    KeyValidate-checked by the caller); ``msgs``: per-item message bytes;
+    ``sig_pts``: per-item oracle G2Points (subgroup-checked; infinity
+    allowed); ``scalars``: the per-item 128-bit RLC coefficients;
+    ``extra_pairs``: pre-scaled oracle ``(G1Point, G2Point)`` pairs
+    appended to the product (deferred raw pairing checks, e.g. the
+    blob-KZG batch).  ``mesh_devices``: optional 1D device tuple - the
+    signature MSM shards its point axis across it through
+    ``parallel.sharded_verify.make_sharded_g2_msm``.
+    """
+    n = len(pk_rows)
+    assert n == len(msgs) == len(sig_pts) and len(scalars) >= n
+    px_parts, py_parts = [], []
+    qx0_parts, qx1_parts, qy0_parts, qy1_parts = [], [], [], []
+    degen_parts = []
+    if n:
+        bucket = PR.lane_bucket(n)
+        npk_pad = max(_N_MIN, _pow2(max(len(r) for r in pk_rows)))
+        rows = list(pk_rows) + [[]] * (bucket - n)
+        pad_scalars = list(scalars[:n]) + [0] * (bucket - n)
+
+        with span("bls.stage.host_pack"):
+            packed = PT.g1_stack_packed(rows, npk_pad)
+            pk_pts = jax.tree_util.tree_map(
+                lambda a: a.reshape((bucket, npk_pad) + a.shape[1:]), packed)
+            sig_packed = PT.g2_pack(list(sig_pts)
+                                    + [G2Point.inf()] * (bucket - n))
+            bits = jnp.asarray(_bits_msb(pad_scalars, RLC_SCALAR_BITS))
+
+        with span("bls.stage.msm"):
+            # pubkey side: per-item aggregate, then the 128-bit scale
+            agg = _j_tree_sum(pk_pts)
+            aggp, agg_inf = _j_g1_normalize_flag(_j_g1_scale(agg, bits))
+            # signature side: the G2 MSM (points-sharded when a mesh is
+            # registered and the padded batch divides across it)
+            g2_msm = None
+            if mesh_devices and bucket % len(tuple(mesh_devices)) == 0:
+                from consensus_specs_tpu.parallel import sharded_verify
+                g2_msm = sharded_verify.sharded_g2_msm_for(
+                    tuple(mesh_devices))
+            if g2_msm is not None:
+                s_total = g2_msm(sig_packed, bits)
+            else:
+                s_total = _j_g2_scale_sum(sig_packed, bits)
+            s_total = jax.tree_util.tree_map(lambda a: a[None], s_total)
+            s_aff = _program_g2_normalize(s_total)
+            s_inf = jnp.asarray(PT.g2_is_identity(s_aff))
+            _profile_sync(aggp)
+
+        with span("bls.stage.hash_to_field"):
+            u0, u1 = HTC.hash_to_field_host(
+                list(msgs) + [b""] * (bucket - n))
+        with span("bls.stage.htc"):
+            hpt = _program_htc(u0, u1)
+            _profile_sync(hpt)
+
+        # flat pairs axis: n item pairs + the folded signature pair
+        px_parts += [aggp[0][:n], jnp.asarray(_NEG_G1[0])]
+        py_parts += [aggp[1][:n], jnp.asarray(_NEG_G1[1])]
+        qx0_parts += [hpt[0][0][:n], s_aff[0][0]]
+        qx1_parts += [hpt[0][1][:n], s_aff[0][1]]
+        qy0_parts += [hpt[1][0][:n], s_aff[1][0]]
+        qy1_parts += [hpt[1][1][:n], s_aff[1][1]]
+        degen_parts += [np.asarray(agg_inf)[:n], np.asarray(s_inf)]
+    if extra_pairs:
+        eg1 = PT.g1_pack([p for p, _ in extra_pairs])
+        eg2 = PT.g2_pack([q for _, q in extra_pairs])
+        px_parts.append(eg1[0]); py_parts.append(eg1[1])
+        qx0_parts.append(eg2[0][0]); qx1_parts.append(eg2[0][1])
+        qy0_parts.append(eg2[1][0]); qy1_parts.append(eg2[1][1])
+        degen_parts.append(np.array(
+            [p.infinity or q.infinity for p, q in extra_pairs]))
+    cat = jnp.concatenate
+    px = cat([jnp.asarray(a) for a in px_parts])
+    py = cat([jnp.asarray(a) for a in py_parts])
+    q = ((cat([jnp.asarray(a) for a in qx0_parts]),
+          cat([jnp.asarray(a) for a in qx1_parts])),
+         (cat([jnp.asarray(a) for a in qy0_parts]),
+          cat([jnp.asarray(a) for a in qy1_parts])))
+    degen = jnp.asarray(np.concatenate(degen_parts))
+
+    with span("bls.stage.pairing"):
+        return bool(np.asarray(
+            PR.staged_product_pairing_check(px, py, q, degen)))
 
 # Public staged-program surface (the sharded step in
 # consensus_specs_tpu.parallel and the dryrun's numpy cross-check both

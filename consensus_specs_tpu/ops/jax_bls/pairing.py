@@ -449,6 +449,50 @@ def pad_axis(a, axis: int, n: int, fill=0):
     return jnp.concatenate([a, pad], axis=axis)
 
 
+def staged_product_pairing_check(px, py, q, degenerate):
+    """ONE product pairing over a single flat pairs axis: True iff
+    ``prod_i e(P_i, Q_i) == 1``.
+
+    Inputs carry one leading ``(n_pairs,)`` axis (no batch axis).  This
+    is the RLC batch-verification finisher: a whole block's checks fold
+    into one pair list, so unlike :func:`staged_pairing_check` there is
+    exactly ONE final exponentiation regardless of how many pairs (the
+    lane path pays one per batch element).
+
+    The pairs axis pads to a power-of-two bucket (floor ``LANE_BUCKET``)
+    with degenerate pairs so the Miller stages compile once per bucket;
+    the per-pair Miller outputs then fold in a log-depth f12 product
+    tree (each level one bounded program) down to a single lane for the
+    final exp.  Skipped in numpy-kernel mode (eager).
+    """
+    from .backend import NUMPY_KERNELS
+    tm = jax.tree_util.tree_map
+    n = jax.tree_util.tree_leaves(px)[0].shape[0]
+    # the fold tree needs a power of two even in eager numpy mode (where
+    # lane_bucket is the identity and there is no compile to amortize)
+    pow2 = 1 if n <= 1 else 1 << (n - 1).bit_length()
+    bucket = pow2 if NUMPY_KERNELS else max(lane_bucket(n), pow2)
+    if bucket != n:
+        pad = lambda a: pad_axis(a, 0, bucket - n)
+        px, py, q = tm(pad, px), tm(pad, py), tm(pad, q)
+        degenerate = pad_axis(degenerate, 0, bucket - n, fill=True)
+
+    carry = _j_miller_init(q)
+    for runs, with_add in _MILLER_SCHEDULE:
+        carry = _j_miller_dbl_run(carry, px, py, runs)
+        if with_add:
+            carry = _j_miller_add(carry, q, px, py)
+    f = _j_miller_finish(carry, degenerate)
+
+    m = bucket
+    while m > 1:
+        m //= 2
+        lo = tm(lambda a: a[:m], f)
+        hi = tm(lambda a: a[m:2 * m], f)
+        f = _j_f12_mul(lo, hi)
+    return staged_final_exp_is_one(f)[0]
+
+
 def staged_pairing_check(px, py, q, degenerate):
     """pairing_check as a pipeline of bounded compiled programs.
 

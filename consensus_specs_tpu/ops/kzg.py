@@ -423,10 +423,19 @@ def verify_kzg_proof_batch(commitments, zs, ys, proofs,
         for commitment, y in zip(commitments, ys)]
     C_minus_y_lincomb = g1_lincomb(C_minus_ys, r_powers)
 
-    return _pairing_check([
+    pairs = [
         (_g1_of(proof_lincomb), -setup.g2_tau),
         (_g1_of(C_minus_y_lincomb) + _g1_of(proof_z_lincomb), G2_GENERATOR),
-    ])
+    ]
+    # Inside an assert-style batched_verification scope (deneb on_block:
+    # data availability + state transition share one flush) the final
+    # pairing folds into the block's single RLC pairing check instead of
+    # paying its own final exponentiation (utils/bls.py batch contract:
+    # any check deferred under a scope is assert-style).
+    from consensus_specs_tpu.utils import bls as _bls
+    if _bls.defer_pairing_check(pairs, label="kzg_batch"):
+        return True
+    return _pairing_check(pairs)
 
 
 def compute_kzg_proof(blob: bytes, z_bytes: bytes,

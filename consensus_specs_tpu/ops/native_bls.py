@@ -102,6 +102,7 @@ def _load() -> Optional[ctypes.CDLL]:
         "cbls_hash_to_g2":
             [ctypes.c_char_p, sz, ctypes.c_char_p, sz, ctypes.c_char_p],
         "cbls_pairing_check": [ctypes.c_char_p, ctypes.c_char_p, sz],
+        "cbls_g2_validate": [ctypes.c_char_p],
         "cbls_g1_mult": [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p],
         "cbls_g1_msm": [ctypes.c_char_p, ctypes.c_char_p, sz, ctypes.c_char_p],
         "cbls_g1_msm_pippenger":
@@ -235,13 +236,26 @@ def hash_to_g2_compressed(msg: bytes, dst: bytes) -> bytes:
 
 
 def pairing_check_compressed(g1s: Sequence[bytes], g2s: Sequence[bytes]) -> bool:
+    """Product pairing check over compressed pairs.  The C side streams
+    the Miller accumulations, so a whole RLC-folded block (hundreds of
+    pairs) is one call with ONE final exponentiation."""
     g1s, g2s = [bytes(p) for p in g1s], [bytes(q) for q in g2s]
-    if (len(g1s) != len(g2s) or len(g1s) > 64
+    if (len(g1s) != len(g2s) or len(g1s) > (1 << 16)
             or any(len(p) != 48 for p in g1s)
             or any(len(q) != 96 for q in g2s)):
         raise ValueError("bad pairing-check input")
     return _req().cbls_pairing_check(b"".join(g1s), b"".join(g2s),
                                      len(g1s)) == 1
+
+
+def g2_validate(sig: bytes) -> bool:
+    """decode_sig semantics: decompression ok AND in the r-order
+    subgroup (infinity allowed) — the gate signatures must pass before
+    entering the (unchecked) ``g2_msm_compressed`` RLC fold."""
+    sig = bytes(sig)
+    if len(sig) != 96:
+        return False
+    return _req().cbls_g2_validate(sig) == 1
 
 
 def g1_msm_affine(points_xy: Sequence[tuple], scalars: Sequence[int]) -> bytes:
@@ -264,7 +278,7 @@ def g1_msm_affine(points_xy: Sequence[tuple], scalars: Sequence[int]) -> bytes:
 
 def g2_msm_compressed(points: Sequence[bytes], scalars: Sequence[int]) -> bytes:
     pts = [bytes(p) for p in points]
-    if len(pts) != len(scalars) or len(pts) > 64 \
+    if len(pts) != len(scalars) or len(pts) > (1 << 16) \
             or any(len(p) != 96 for p in pts):
         raise ValueError("bad G2 MSM input")
     sc = b"".join((int(s) % R_ORDER).to_bytes(32, "big") for s in scalars)

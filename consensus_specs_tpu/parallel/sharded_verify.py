@@ -24,18 +24,22 @@ import numpy as np
 import jax
 
 
-def _gather_and_combine(part, axis_name: str, n_shards: int):
-    """all_gather per-shard partial G1 sums along ``axis_name`` and
+def _gather_and_combine(part, axis_name: str, n_shards: int, add=None):
+    """all_gather per-shard partial point sums along ``axis_name`` and
     combine them in a fixed order on every device (complete point
     addition is not a ``psum``-able monoid over raw limb vectors, so
     the collective must carry partial sums).  ``part`` leaves must have
-    the shard axis at position 0 after the gather."""
+    the shard axis at position 0 after the gather.  ``add`` selects the
+    group law (default G1 complete addition; pass ``PT.g2_add`` for the
+    G2 collectives)."""
     from consensus_specs_tpu.ops.jax_bls import points as PT
+    if add is None:
+        add = PT.g1_add
     gathered = jax.tree_util.tree_map(
         lambda a: jax.lax.all_gather(a, axis_name), part)
     total = jax.tree_util.tree_map(lambda a: a[0], gathered)
     for i in range(1, n_shards):  # noqa: J203 (static unroll: mesh size)
-        total = PT.g1_add(
+        total = add(
             total, jax.tree_util.tree_map(lambda a, i=i: a[i], gathered))
     return total
 
@@ -133,6 +137,57 @@ def make_sharded_msm(mesh_devices):
         in_specs=(jax.tree_util.tree_map(lambda _: spec, (0, 0, 0)),
                   spec),
         out_specs=P(), check_rep=False))
+
+
+def make_sharded_g2_msm(mesh_devices):
+    """Compile a POINTS-sharded G2 multi-scalar multiplication.
+
+    The RLC batch verifier's signature fold ``sum_i [r_i] sig_i``
+    (``ops/bls_jax.rlc_combined_check``) at pod scale: the signature
+    axis splits across a 1D ``points`` mesh, each device runs the
+    per-lane double-and-add + local tree sum over its slice, and the
+    per-shard partial G2 sums ``all_gather`` and combine on-device —
+    the same collective pattern as the G1 aggregation tree.
+
+    Returns ``msm(sig_pts, bits) -> packed G2 total`` where ``sig_pts``
+    is a packed projective G2 pytree of shape ``(B, ...)`` and ``bits``
+    the ``(B, n_bits)`` MSB-first scalar bit planes
+    (``ops.bls_jax._bits_msb``), both sharded along the leading axis.
+    B must divide evenly by the mesh size.
+    """
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from consensus_specs_tpu.ops.jax_bls import points as PT
+
+    mesh_devices = tuple(mesh_devices)
+    mesh = Mesh(np.array(mesh_devices), ("points",))
+    n_shards = mesh.shape["points"]
+
+    def local_msm(sig_pts, bits):
+        part = PT.g2_tree_sum(PT.g2_scalar_mul(sig_pts, bits))
+        return _gather_and_combine(part, "points", n_shards, add=PT.g2_add)
+
+    spec = P("points")
+    g2_tree_spec = jax.tree_util.tree_map(
+        lambda _: spec, ((0, 0), (0, 0), (0, 0)))
+    return jax.jit(shard_map(
+        local_msm, mesh=mesh, in_specs=(g2_tree_spec, spec),
+        out_specs=P(), check_rep=False))
+
+
+_SHARDED_G2_MSM_CACHE = {}
+
+
+def sharded_g2_msm_for(devices: tuple):
+    """Memoized compiled G2-MSM program per device tuple (same rationale
+    as :func:`_sharded_msm_for`: rebuilding the ``shard_map`` closure
+    would defeat jit's identity-keyed cache)."""
+    devices = tuple(devices)
+    prog = _SHARDED_G2_MSM_CACHE.get(devices)
+    if prog is None:
+        prog = make_sharded_g2_msm(devices)
+        _SHARDED_G2_MSM_CACHE[devices] = prog
+    return prog
 
 
 _SHARDED_MSM_CACHE = {}

@@ -36,6 +36,32 @@ def warm_bench(batch=None):
     _log(f"bench pipeline (batch {b}, 64 keys): {time.time() - t0:.1f}s")
 
 
+def warm_rlc():
+    """Compile the RLC flush pipeline (``ops/bls_rlc`` jax path): the
+    per-item aggregate + 128-bit scale, the signature G2 MSM, and the
+    flat-pairs product pairing with its log-depth f12 fold — the
+    programs ``DeferredBatch.flush`` dispatches under the jax backend.
+
+    Shapes compile per item-count bucket (lane_bucket of n items; the
+    flat pairs axis buckets at the next power of two above n+1), so by
+    default this warms the smallest bucket only; set
+    ``CS_TPU_WARM_RLC_ITEMS`` to the expected block size (e.g. 130 for
+    a full 128-attestation block) to also pre-pay that bucket's
+    compiles — multi-minute on XLA:CPU, worth it before throughput runs.
+    """
+    from consensus_specs_tpu.ops import bls_rlc
+    from consensus_specs_tpu.tools import bench_fixtures
+
+    pks, msg, agg = bench_fixtures.load()
+    n_items = max(1, int(os.environ.get("CS_TPU_WARM_RLC_ITEMS", "1")))
+    items = [(pks, msg, agg)] * n_items
+    t0 = time.time()
+    verdict = bls_rlc.combined_check(items, [], "jax")
+    assert verdict is True
+    _log(f"rlc combined check ({n_items} item(s), 64 keys): "
+         f"{time.time() - t0:.1f}s")
+
+
 def warm_entry():
     """Compile the single-chip graft-entry program (the flagship pairing
     check the driver compile-checks)."""
@@ -133,7 +159,8 @@ def main():
                         help="cpu: pin XLA:CPU (the dryrun cache and the "
                              "bench fallback path); auto: probe the "
                              "accelerator and use it if it answers")
-    parser.add_argument("--stage", choices=("all", "bench", "dryrun", "entry"),
+    parser.add_argument("--stage",
+                        choices=("all", "bench", "dryrun", "entry", "rlc"),
                         default="all")
     ns = parser.parse_args()
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
@@ -149,6 +176,8 @@ def main():
         _log(f"platform: {ensure_working_backend()}")
     if ns.stage in ("all", "bench"):
         warm_bench()
+    if ns.stage in ("all", "rlc"):
+        warm_rlc()
     if ns.stage in ("all", "entry"):
         warm_entry()
     # the dryrun re-execs via subprocess paths of __graft_entry__; warm it

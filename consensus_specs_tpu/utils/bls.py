@@ -13,9 +13,12 @@ plus the test kill-switch ``bls_active`` with STUB constants
 (``bls.py:49-57,93-104``): when inactive, Sign returns a stub and verifies
 trivially pass — used by the harness's @never_bls/@always_bls decorators.
 """
+import os
 from contextlib import contextmanager
 from typing import Sequence
 
+from consensus_specs_tpu.obs import registry as _obs_registry
+from consensus_specs_tpu.utils import env_flags as _env_flags
 from consensus_specs_tpu.utils.lru import LRUDict
 from consensus_specs_tpu.ops.bls12_381 import ciphersuite as _py_backend
 from consensus_specs_tpu.ops.bls12_381.fields import R_ORDER as CURVE_ORDER  # noqa: F401
@@ -115,32 +118,131 @@ def backend_name() -> str:
 # Only *assert-style* verifications may be deferred.  Conditional ones
 # (deposit proofs of possession, where the boolean steers state) must use
 # the eager paths below.
+#
+# Flush strategy (``CS_TPU_BLS_RLC``, default on): the whole queue folds
+# into a random-linear-combination product — 2 MSMs + ONE pairing check
+# for the block (``ops/bls_rlc.py``; math and soundness documented
+# there).  On a combined failure (or a structurally invalid item) the
+# flush re-runs the per-lane path to bisect and report exactly which
+# item failed, so assert semantics are unchanged.  ``bls.flush{path=
+# rlc|lanes|fallback}`` counts which strategy answered; ``bls.pairings``
+# counts pairing-check evaluations so "one pairing per block" is
+# counter-assertable.
 # ---------------------------------------------------------------------------
 
+_FLUSH_RLC = _obs_registry.counter("bls.flush").labels(path="rlc")
+_FLUSH_LANES = _obs_registry.counter("bls.flush").labels(path="lanes")
+_FLUSH_FALLBACK = _obs_registry.counter("bls.flush").labels(path="fallback")
+_PAIRINGS = _obs_registry.counter("bls.pairings").labels()
+
+
+def rlc_enabled() -> bool:
+    """RLC flush switch: live env re-read when the variable is present
+    (CI legs flip it after import), else the import-time snapshot."""
+    if "CS_TPU_BLS_RLC" in os.environ:
+        return os.environ["CS_TPU_BLS_RLC"] != "0"
+    return _env_flags.BLS_RLC
+
+
 class DeferredBatch:
-    """Signature-verification triples collected under one block."""
+    """Signature-verification triples (and deferred raw pairing-product
+    checks, e.g. the blob-KZG batch) collected under one block."""
 
     def __init__(self):
-        self.items = []
+        self.items = []            # (pubkeys, message, signature)
+        self.item_keys = []        # per item: memo keys to record at flush
+        self.pairing_checks = []   # (pairs, label): raw product checks
+        self._seen = {}            # triple -> index (in-batch dedup)
+        self.last_results = None
+        self.last_pairing_results = None
 
-    def add(self, pubkeys, message, signature):
-        self.items.append(([bytes(pk) for pk in pubkeys],
-                           bytes(message), bytes(signature)))
+    def add(self, pubkeys, message, signature, memo_key=None):
+        item = ([bytes(pk) for pk in pubkeys],
+                bytes(message), bytes(signature))
+        if memo_key is None:
+            memo_key = ("fav", tuple(item[0]), item[1], item[2])
+        dedup = (tuple(item[0]), item[1], item[2])
+        idx = self._seen.get(dedup)
+        if idx is not None:
+            # identical triple already queued this block: one device lane
+            # serves both call sites, both memo keys get the result
+            if memo_key not in self.item_keys[idx]:
+                self.item_keys[idx].append(memo_key)
+            return
+        self._seen[dedup] = len(self.items)
+        self.items.append(item)
+        self.item_keys.append([memo_key])
 
-    def flush(self) -> bool:
-        items, self.items = self.items, []
+    def add_pairing_check(self, pairs, label=""):
+        """Defer a raw product-pairing check ``prod e(P_i, Q_i) == 1``
+        (oracle point pairs).  Folds into the RLC flush with its own
+        random coefficient; evaluated individually on the bisect path."""
+        self.pairing_checks.append(
+            ([(p, q) for p, q in pairs], str(label)))
+
+    @staticmethod
+    def _lane_results(items) -> list:
         if not items:
-            return True
+            return []
         if _backend_name == "jax":
             from consensus_specs_tpu.ops import bls_jax
-            results = bls_jax.verify_aggregates_batch(items)
+            return bls_jax.verify_aggregates_batch(items)
+        return [_backend.FastAggregateVerify(pks, msg, sig)
+                for pks, msg, sig in items]
+
+    @staticmethod
+    def _eval_pairing_check(pairs) -> bool:
+        from consensus_specs_tpu.ops.kzg import _pairing_check
+        return _pairing_check(pairs)
+
+    def flush(self) -> bool:
+        items, keys = self.items, self.item_keys
+        checks = self.pairing_checks
+        self.items, self.item_keys, self.pairing_checks = [], [], []
+        self._seen = {}
+        if not items and not checks:
+            return True
+        if rlc_enabled():
+            from consensus_specs_tpu.ops import bls_rlc
+            verdict = bls_rlc.combined_check(items, checks, _backend_name)
+            if verdict is not None:
+                _PAIRINGS.add()          # the one combined product pairing
+            if verdict is True:
+                _FLUSH_RLC.add()
+                for ks in keys:
+                    for k in ks:
+                        _memo_put(k, True)
+                self.last_results = [True] * len(items)
+                self.last_pairing_results = [True] * len(checks)
+                return True
+            # combined failure (False) or structurally invalid item
+            # (None): bisect through the per-lane path for exact
+            # per-item reporting
+            _FLUSH_FALLBACK.add()
         else:
-            results = [_backend.FastAggregateVerify(pks, msg, sig)
-                       for pks, msg, sig in items]
-        return all(results)
+            _FLUSH_LANES.add()
+        results = self._lane_results(items)
+        _PAIRINGS.add(len(items))
+        pairing_results = [self._eval_pairing_check(pairs)
+                           for pairs, _ in checks]
+        _PAIRINGS.add(len(checks))
+        for ks, ok in zip(keys, results):
+            for k in ks:
+                _memo_put(k, bool(ok))
+        self.last_results = [bool(r) for r in results]
+        self.last_pairing_results = pairing_results
+        return all(results) and all(pairing_results)
 
     def assert_valid(self):
-        assert self.flush(), "batched signature verification failed"
+        if not self.flush():
+            failed = [i for i, r in enumerate(self.last_results or [])
+                      if not r]
+            failed_checks = [i for i, r in
+                             enumerate(self.last_pairing_results or [])
+                             if not r]
+            raise AssertionError(
+                "batched signature verification failed "
+                f"(items {failed}, deferred checks {failed_checks})")
 
 
 _batch_stack = []
@@ -162,6 +264,23 @@ def batched_verification():
         yield batch
     finally:
         _batch_stack.pop()
+
+
+def defer_pairing_check(pairs, label="") -> bool:
+    """Queue a raw product-pairing check ``prod e(P_i, Q_i) == 1`` (oracle
+    point pairs) into the active batch context, to fold into the block's
+    single RLC pairing.  Returns False when no batch context is active or
+    the RLC path is off — the caller must then evaluate eagerly.
+
+    Deferred checks are assert-style by contract (the batched-
+    verification scope rule above): the optimistic True is only sound
+    when the caller asserts the result and block-level failure discards
+    the state.
+    """
+    if not _batch_stack or not rlc_enabled():
+        return False
+    _batch_stack[-1].add_pairing_check(pairs, label)
+    return True
 
 
 def only_with_bls(alt_return=None):
@@ -203,10 +322,17 @@ def _memo_put(key, value: bool) -> bool:
 
 @only_with_bls(alt_return=True)
 def Verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
-    if _batch_stack:
-        _batch_stack[-1].add([pk], msg, sig)
-        return True
     key = ("v", bytes(pk), bytes(msg), bytes(sig))
+    if _batch_stack:
+        # memo before enqueue: a repeated signature (replayed block)
+        # skips the device lane entirely; a memoized failure surfaces
+        # immediately (assert-style callers raise just as they would at
+        # flush).  Results memo back in at flush.
+        hit = _memo_get(key)
+        if hit is None:
+            _batch_stack[-1].add([pk], msg, sig, memo_key=key)
+            return True
+        return hit
     hit = _memo_get(key)
     if hit is not None:
         return hit
@@ -238,10 +364,14 @@ def AggregateVerify(pks: Sequence[bytes], msgs: Sequence[bytes], sig: bytes) -> 
 
 @only_with_bls(alt_return=True)
 def FastAggregateVerify(pks: Sequence[bytes], msg: bytes, sig: bytes) -> bool:
-    if _batch_stack:
-        _batch_stack[-1].add(pks, msg, sig)
-        return True
     key = ("fav", tuple(bytes(p) for p in pks), bytes(msg), bytes(sig))
+    if _batch_stack:
+        # memo before enqueue (see Verify): repeats skip device work
+        hit = _memo_get(key)
+        if hit is None:
+            _batch_stack[-1].add(pks, msg, sig, memo_key=key)
+            return True
+        return hit
     hit = _memo_get(key)
     if hit is not None:
         return hit

@@ -42,6 +42,16 @@ HASH_FOREST = os.environ.get("CS_TPU_HASH_FOREST") != "0"
 PROFILE = os.environ.get("CS_TPU_PROFILE") == "1"
 TRACE = os.environ.get("CS_TPU_TRACE") == "1"
 
+# Random-linear-combination batch-verification switch:
+# ``CS_TPU_BLS_RLC=0`` makes ``utils/bls.DeferredBatch.flush`` run the
+# per-lane path (one pairing check per queued item) instead of folding
+# the whole batch into 2 MSMs + ONE product pairing check.  Like
+# ``CS_TPU_PROTO_ARRAY``, this snapshot is the import-time default and
+# the switch re-reads the environment at call time when the variable is
+# present (``utils/bls.rlc_enabled``), so a test/CI leg can flip it
+# after import.
+BLS_RLC = os.environ.get("CS_TPU_BLS_RLC") != "0"
+
 # Proto-array fork-choice kill switch: ``CS_TPU_PROTO_ARRAY=0`` runs the
 # spec-loop ``get_head`` / ``get_weight`` / ``get_filtered_block_tree``
 # (``forks/fork_choice.py``) instead of the incremental columnar engine

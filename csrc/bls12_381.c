@@ -1560,15 +1560,36 @@ API int cbls_hash_to_g2(const uint8_t *msg, size_t msg_len,
 
 /* raw pairing-product check over compressed points (KZG path) */
 API int cbls_pairing_check(const uint8_t *g1s, const uint8_t *g2s, size_t n) {
+    /* streaming accumulation (no per-pair array): the RLC batch
+       verifier folds a whole block into ONE product pairing, so n can
+       be a full block's worth of pairs (attestations + sync aggregate
+       + proposer + randao + blob-KZG), well past the old 64-pair cap */
     cbls_init();
-    if (n > 64) return 0;
-    g1_aff_t ps[64];
-    g2_aff_t qs[64];
+    if (n > (1u << 16)) return 0;
+    fp12_t f = FP12_ONE, m;
     for (size_t i = 0; i < n; i++) {
-        if (!g1_decompress(&ps[i], g1s + 48 * i)) return 0;
-        if (!g2_decompress(&qs[i], g2s + 96 * i)) return 0;
+        g1_aff_t p;
+        g2_aff_t q;
+        if (!g1_decompress(&p, g1s + 48 * i)) return 0;
+        if (!g2_decompress(&q, g2s + 96 * i)) return 0;
+        if (p.inf || q.inf) continue;
+        miller_loop(&m, &p, &q);
+        fp12_mul(&f, &f, &m);
     }
-    return pairing_check(ps, qs, n);
+    fp12_t e;
+    final_exponentiation(&e, &f);
+    return fp12_eq(&e, &FP12_ONE);
+}
+
+/* G2 subgroup gate for the RLC signature MSM: decompression ok AND in
+   the r-order subgroup (infinity allowed) - decode_sig semantics,
+   exposed so the python side can validate signatures BEFORE folding
+   them into cbls_g2_msm (which, like the oracle Aggregate, does not
+   subgroup-check) */
+API int cbls_g2_validate(const uint8_t sig[96]) {
+    cbls_init();
+    g2_aff_t s;
+    return decode_sig(&s, sig);
 }
 
 /* G1 scalar mult on a compressed point (KZG lincomb building block) */
@@ -1818,7 +1839,7 @@ API int cbls_g1_msm_pippenger(const uint8_t *points_xy, const uint8_t *scalars,
 API int cbls_g2_msm(const uint8_t *points, const uint8_t *scalars, size_t n,
                     uint8_t out[96]) {
     cbls_init();
-    if (n > 64) return 0;
+    if (n > (1u << 16)) return 0;   /* streaming: a block's signatures */
     g2_t acc; g2_set_inf(&acc);
     for (size_t i = 0; i < n; i++) {
         g2_aff_t p;

@@ -71,6 +71,10 @@ def metrics_diff():
 
 
 def pytest_configure(config):
+    # `slow`: excluded from the tier-1 `-m 'not slow'` budget run; still
+    # covered by `make citest` / CI (no marker filter there)
+    config.addinivalue_line(
+        "markers", "slow: long-running test excluded from the fast tier")
     from consensus_specs_tpu.test_infra import context as ctx
     ctx.DEFAULT_TEST_PRESET = config.getoption("--preset")
     ctx.DEFAULT_BLS_ACTIVE = (config.getoption("--enable-bls")

@@ -136,3 +136,29 @@ def test_sharded_msm_matches_host():
     for p, s in zip(pts[:5], scalars[:5]):
         expect2 = expect2 + p.mult(s)
     assert got2 == expect2
+
+
+@pytest.mark.skipif(not HEAVY, reason="G2 MSM shard_map compile on a "
+                    "1-core host (CS_TPU_HEAVY=1)")
+def test_sharded_g2_msm_matches_host():
+    """Points-sharded G2 MSM (the RLC signature fold
+    ``sum_i [r_i] sig_i``) over the virtual mesh equals the oracle
+    Pippenger result."""
+    _require_devices(4)
+    from consensus_specs_tpu.parallel.sharded_verify import sharded_g2_msm_for
+    from consensus_specs_tpu.ops import bls_jax
+    from consensus_specs_tpu.ops.jax_bls import points as PT
+    from consensus_specs_tpu.ops.bls12_381.curve import (
+        g2_from_compressed, msm as oracle_msm)
+    from consensus_specs_tpu.utils import bls
+
+    bls.use_py()
+    sigs = [g2_from_compressed(bls.Sign(i, bytes([i]) * 32))
+            for i in range(1, 9)]
+    rng = np.random.RandomState(42)
+    rs = [int.from_bytes(rng.bytes(16), "little") | 1 for _ in sigs]
+    prog = sharded_g2_msm_for(tuple(jax.devices()[:4]))
+    out = prog(PT.g2_pack(sigs),
+               jnp.asarray(bls_jax._bits_msb(rs, bls_jax.RLC_SCALAR_BITS)))
+    got = PT.g2_unpack(jax.tree_util.tree_map(lambda a: a[None], out))
+    assert got == oracle_msm(sigs, rs)
