@@ -8,7 +8,7 @@ GENERATORS = operations sanity finality rewards random forks epoch_processing \
 
 .PHONY: test citest test-crypto bench bench-all bench-merkle-smoke \
         bench-forkchoice-smoke bench-obs-smoke bench-block-smoke \
-        obs-report dryrun \
+        bench-state-smoke obs-report dryrun \
         warm native lint speclint-baseline \
         generate_tests $(addprefix gen_,$(GENERATORS)) clean-vectors pyspec
 
@@ -31,6 +31,7 @@ citest:
 	$(PYTHON) benchmarks/bench_merkle_smoke.py
 	$(PYTHON) benchmarks/bench_fork_choice.py --smoke
 	$(PYTHON) benchmarks/bench_block_verify.py --smoke
+	$(PYTHON) benchmarks/bench_state_arrays.py --smoke
 	$(PYTHON) -m pytest tests/ -q --enable-bls --bls-type fastest
 
 # static checks: syntax gate + the speclint multi-pass analyzer
@@ -100,6 +101,14 @@ bench-forkchoice-smoke:
 bench-block-smoke:
 	-$(MAKE) native
 	$(PYTHON) benchmarks/bench_block_verify.py --smoke
+
+# state-arrays store smoke: the copy-on-write column store must show
+# at most one registry extraction per epoch transition, exactly one
+# balance-family commit per transition, and N forked replays sharing
+# one base snapshot with byte-identical roots (counter-asserted via the
+# state_arrays.* metrics; nonzero exit on regression)
+bench-state-smoke:
+	$(PYTHON) benchmarks/bench_state_arrays.py --smoke
 
 # telemetry disabled-path overhead: with CS_TPU_PROFILE/CS_TPU_TRACE
 # unset, the span + counter instrumentation across the engine stack

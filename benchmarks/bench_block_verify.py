@@ -143,6 +143,24 @@ def _counter_asserted_smoke(items, metrics):
         f"bisect blamed the wrong items: {batch.last_results}"
 
 
+def _att_prep_smoke():
+    """The vmapped message-prep contract (``ops/att_prep.py``): every
+    block attestation verified during a real state_transition slice
+    must be served from the per-block prepared signing-root table —
+    zero misses, one hit per prepared attestation — while the full
+    BLS-on transition (which would assert on any wrong signing root)
+    stays green."""
+    from consensus_specs_tpu.test_infra.metrics import counting
+    with counting() as delta:
+        _sustained_slots(4)
+    assert delta["att_prep.blocks"] > 0, "no blocks prepared"
+    assert delta["att_prep.prepared"] > 0, "no attestations prepared"
+    assert delta["att_prep.misses"] == 0, \
+        f"prepared attestations missed the table: {dict(delta)}"
+    assert delta["att_prep.hits"] == delta["att_prep.prepared"], \
+        f"hit/prepared census mismatch: {dict(delta)}"
+
+
 def _sustained_slots(n_slots):
     """Full state_transition loop (BLS on) on a minimal-preset genesis:
     the serving-throughput shape, slots/sec."""
@@ -205,6 +223,7 @@ def main():
 
     if args.smoke:
         _counter_asserted_smoke(items, metrics)
+        _att_prep_smoke()
 
     prior_rlc = os.environ.get("CS_TPU_BLS_RLC")
     try:

@@ -22,8 +22,8 @@ engine (``ops/epoch_kernels``):
 * one validator vote array: applied vote target (node index) and applied
   vote weight, int64 lanes;
 * vote weights come columnar from the justified checkpoint state via
-  ``ops/epoch_kernels.validator_columns`` (the same struct-of-arrays
-  registry snapshot the epoch engine and hash forest share), so a
+  ``state/arrays.py`` (the canonical copy-on-write struct-of-arrays
+  store the epoch engine and hash forest share), so a
   justified-checkpoint change is ONE vectorized balance-delta pass, not
   a million python iterations;
 * proposer boost is a virtual vote applied/removed through the same
@@ -58,7 +58,7 @@ import numpy as np
 
 from consensus_specs_tpu.obs import registry as obs_registry
 from consensus_specs_tpu.obs.tracing import span
-from consensus_specs_tpu.ops.epoch_kernels import validator_columns
+from consensus_specs_tpu.state import arrays as state_arrays
 from consensus_specs_tpu.utils import env_flags
 from consensus_specs_tpu.utils.ssz import hash_tree_root
 
@@ -360,8 +360,12 @@ class ProtoArrayEngine:
     def _balance_column(self, spec, state) -> np.ndarray:
         """Per-validator vote weight from the justified state: effective
         balance where active and not slashed, else 0 — exactly the set
-        the spec's ``get_weight`` loop iterates."""
-        cols = validator_columns(state)
+        the spec's ``get_weight`` loop iterates.  Columns come from the
+        justified state's attached ``StateArrays`` store, shared with
+        the epoch engine and the hash forest; checkpoint states derived
+        by state copies inherit their parent's columns copy-on-write,
+        so a justified-checkpoint change typically re-walks nothing."""
+        cols = state_arrays.registry_of(state)
         epoch = int(spec.get_current_epoch(state))
         eff = cols["eff"]
         if eff.size and int(eff.max()) > _WEIGHT_GUARD:

@@ -18,6 +18,8 @@ def register_fork(name):
         # fork-choice engine installs; zero-overhead unless
         # CS_TPU_PROFILE/CS_TPU_TRACE)
         from consensus_specs_tpu.obs import install_tracing
+        from consensus_specs_tpu.ops.att_prep import install_att_prep
+        install_att_prep(cls)
         install_tracing(cls)
         _REGISTRY[name] = cls
         cls.fork = name
@@ -91,6 +93,7 @@ def use_compiled_registry():
     _compile_all()
     importlib.invalidate_caches()  # compiled/ may have just been created
     from consensus_specs_tpu.obs import install_tracing
+    from consensus_specs_tpu.ops.att_prep import install_att_prep
     from consensus_specs_tpu.ops.epoch_kernels import install_vectorized_epoch
     from consensus_specs_tpu.forkchoice.proto_array import (
         install_forkchoice_accel)
@@ -99,9 +102,11 @@ def use_compiled_registry():
         importlib.reload(mod)
         cls = getattr(mod, f"Compiled{fork.capitalize()}Spec")
         # compiled method bodies are emitted verbatim from the markdown,
-        # so the vectorized-epoch and proto-array fork-choice dispatches
-        # (and the tracing spans) wrap them from outside
+        # so the vectorized-epoch, attestation message-prep and
+        # proto-array fork-choice dispatches (and the tracing spans)
+        # wrap them from outside
         install_vectorized_epoch(cls)
+        install_att_prep(cls)
         install_forkchoice_accel(cls)
         install_tracing(cls)
         _REGISTRY[fork] = cls

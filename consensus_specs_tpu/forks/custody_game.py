@@ -41,6 +41,12 @@ class CustodyGameSpec(ShardingSpec):
     fork = "custody_game"
     previous_fork = "sharding"
 
+    # custody's epoch ordering interleaves spec-loop balance writes
+    # (reveal/challenge deadline slashings) between the engine
+    # sub-transitions — deferred column commits would expose stale
+    # balances to them, so this fork commits per sub-transition
+    _defer_epoch_commits = False
+
     # Constants (beacon-chain.md "Misc")
     CUSTODY_PRIME = 2**256 - 189
     CUSTODY_SECRETS = 3

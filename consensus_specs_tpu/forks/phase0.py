@@ -24,6 +24,7 @@ from consensus_specs_tpu.utils.ssz import (
 from consensus_specs_tpu.utils import bls
 from consensus_specs_tpu.utils.ssz.forest import hash_forest
 from consensus_specs_tpu.ops import epoch_kernels
+from consensus_specs_tpu.state import arrays as state_arrays
 from . import register_fork
 from .fork_choice import ForkChoiceMixin
 from .validator_guide import ValidatorGuideMixin
@@ -709,13 +710,25 @@ class Phase0Spec(ValidatorGuideMixin, ForkChoiceMixin):
             signed_block.message, self.get_domain(state, DOMAIN_BEACON_PROPOSER))
         return bls.Verify(proposer.pubkey, signing_root, signed_block.signature)
 
+    # Epoch transitions run inside a StateArrays commit scope: the
+    # engine's balance-family column writes flush back to SSZ chunks
+    # ONCE at scope exit instead of once per sub-transition.  Forks
+    # whose epoch ordering interleaves non-engine balance writes between
+    # the engine sub-transitions (custody_game's reveal/challenge
+    # deadlines) opt out by overriding this to False.
+    _defer_epoch_commits = True
+
     def process_slots(self, state, slot) -> None:
         assert state.slot < slot
         while state.slot < slot:
             self.process_slot(state)
             # Process epoch on the start slot of the next epoch
             if (state.slot + 1) % self.SLOTS_PER_EPOCH == 0:
-                self.process_epoch(state)
+                if self._defer_epoch_commits:
+                    with state_arrays.commit_scope(state):
+                        self.process_epoch(state)
+                else:
+                    self.process_epoch(state)
             state.slot = Slot(state.slot + 1)
 
     def process_slot(self, state) -> None:

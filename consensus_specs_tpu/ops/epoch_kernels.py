@@ -7,9 +7,13 @@ The per-validator epoch loops (``process_rewards_and_penalties``,
 are O(validators) python iterations over SSZ typed views — the last
 python-loop-bound hot path at registry scale (BENCHMARKS.md config #5:
 the 1M-validator epoch transition is all epoch-loop time).  This module
-re-expresses them as columnar array kernels over a struct-of-arrays
-snapshot of the validator set, extracted once per epoch from the SSZ
-state and re-keyed incrementally as the epoch functions mutate it.
+re-expresses them as columnar array kernels over the canonical
+struct-of-arrays state store (``state/arrays.py``): columns are
+extracted once per state lineage, revalidated structurally against the
+SSZ mutation generations, mutated copy-on-write by the kernels, and —
+inside the ``state_arrays.commit_scope`` the fork ladder opens around
+``process_epoch`` — committed back to SSZ chunks once per epoch
+transition instead of once per sub-transition.
 
 Layering mirrors the BLS backend switch (``utils/bls.py``):
 
@@ -43,10 +47,13 @@ import numpy as np
 
 from consensus_specs_tpu.obs import registry as obs_registry
 
-from consensus_specs_tpu.utils.lru import LRUDict
-from consensus_specs_tpu.utils.ssz import (
-    hash_tree_root, sequence_items, replace_basic_items)
-from consensus_specs_tpu.utils.ssz import forest
+from consensus_specs_tpu.state import arrays as state_arrays
+# shared commit/extraction primitives live in the state layer now;
+# re-exported here because the merkle bench smoke and older call sites
+# import them under these names
+from consensus_specs_tpu.state.arrays import (   # noqa: F401
+    u64_column, _write_u64_list)
+from consensus_specs_tpu.utils.ssz import sequence_items
 
 _U64_MAX = (1 << 64) - 1
 
@@ -125,106 +132,19 @@ def _guard(*products) -> None:
 
 
 # ---------------------------------------------------------------------------
-# Struct-of-arrays snapshot of the validator registry
+# Struct-of-arrays state access (state/arrays.py)
 # ---------------------------------------------------------------------------
-
-_VALIDATOR_DTYPE = np.dtype([
-    ("eff", "<u8"),    # effective_balance
-    ("aee", "<u8"),    # activation_eligibility_epoch
-    ("act", "<u8"),    # activation_epoch
-    ("ext", "<u8"),    # exit_epoch
-    ("wd", "<u8"),     # withdrawable_epoch
-    ("sl", "?"),       # slashed
-])
-
-# validators hash_tree_root -> structured column array.  Root-keyed like
-# the spec's committee caches: exact (the root commits to every field)
-# and warm across the five epoch functions of one transition.
-_COLS_CACHE = LRUDict(8, name="epoch_cols")
-
-
-# forest column-stash field names -> _VALIDATOR_DTYPE keys
-_SHARED_FIELDS = (
-    ("effective_balance", "eff"), ("activation_eligibility_epoch", "aee"),
-    ("activation_epoch", "act"), ("exit_epoch", "ext"),
-    ("withdrawable_epoch", "wd"), ("slashed", "sl"))
-
-
-def validator_columns(state):
-    """Extract (or fetch cached) the registry snapshot as one structured
-    uint64 array.  First choice: the uint64 columns the hash-forest
-    columnar root build already extracted (``forest.peek_columns``,
-    generation-validated — the registry merkleization and the epoch
-    engine share one python pass over the typed views).  Fallback: a
-    single ``np.fromiter`` pass."""
-    key = bytes(hash_tree_root(state.validators))
-    cols = _COLS_CACHE.get(key)
-    if cols is None:
-        items = sequence_items(state.validators)
-        shared = forest.peek_columns(state.validators)
-        if shared is not None and all(f in shared for f, _ in _SHARED_FIELDS):
-            cols = np.empty(len(items), dtype=_VALIDATOR_DTYPE)
-            for fname, col in _SHARED_FIELDS:
-                if col == "sl":
-                    cols[col] = shared[fname] != 0
-                else:
-                    cols[col] = shared[fname]
-        else:
-            cols = np.fromiter(
-                ((v.effective_balance, v.activation_eligibility_epoch,
-                  v.activation_epoch, v.exit_epoch, v.withdrawable_epoch,
-                  bool(v.slashed)) for v in items),
-                dtype=_VALIDATOR_DTYPE, count=len(items))
-        _COLS_CACHE[key] = cols
-    return cols
-
-
-def _recache_columns(state, cols) -> None:
-    """Key updated columns under the post-mutation root, so the next
-    epoch function reuses them instead of re-extracting.  ``cols`` must
-    be a PRIVATE copy, never the array ``validator_columns`` returned:
-    cached entries are immutable (a state copy — or another fork's state
-    with an identical registry — maps to the old key and must keep
-    seeing the pre-mutation snapshot)."""
-    _COLS_CACHE[bytes(hash_tree_root(state.validators))] = cols
-
-
-def u64_column(seq) -> np.ndarray:
-    items = sequence_items(seq)
-    return np.fromiter(items, dtype=np.uint64, count=len(items))
-
-
-# ---------------------------------------------------------------------------
-# Write-back
-# ---------------------------------------------------------------------------
-
-def _write_u64_list(seq, elem_type, old, new) -> None:
-    """Commit a uint64 column back into its SSZ list, matching the spec
-    loop's per-index writes bit-for-bit but without its per-index python
-    cost.  Few changes -> targeted ``__setitem__`` (keeps the incremental
-    chunk tree); registry-wide changes -> wholesale item swap, building
-    the element objects through a value-dedup table (epoch deltas are
-    highly repetitive: equal-stake validators earn equal rewards) and
-    committing chunk-level: the 32-byte leaf chunks are packed straight
-    from the column (``new.astype('<u8').tobytes()``) and bulk-fed to
-    the tree, so the commit materializes zero per-chunk python work and
-    re-hashes through the batched layer path."""
-    changed = np.nonzero(old != new)[0]
-    if changed.size == 0:
-        return
-    if changed.size <= max(64, len(old) // 64):
-        for i in changed.tolist():
-            seq[i] = elem_type(int(new[i]))
-        return
-    vals, inv = np.unique(new, return_inverse=True)
-    if vals.size * 4 <= new.size:
-        pool = [elem_type(int(v)) for v in vals.tolist()]
-        items = [pool[i] for i in inv.tolist()]
-    else:
-        # int.__new__ skips BasicValue's range re-validation; the values
-        # come out of a uint64 array, so the range holds by construction
-        items = [int.__new__(elem_type, v) for v in new.tolist()]
-    replace_basic_items(seq, items, packed=new.astype("<u8").tobytes())
+#
+# The registry snapshot, balance and participation columns all come from
+# the state's attached copy-on-write ``StateArrays`` store: extracted
+# once per state lineage, revalidated against the SSZ sequences'
+# mutation generations (a write through the sequence API bumps the
+# generation, so a stale column is structurally impossible), and — when
+# the fork ladder's ``commit_scope`` is open around ``process_epoch`` —
+# committed back to SSZ chunks once per transition.  The root-keyed
+# ``_COLS_CACHE`` LRU this module used to keep is gone; every guard
+# fallback flushes pending column writes first so the spec loop always
+# reads fresh SSZ.
 
 
 # ---------------------------------------------------------------------------
@@ -376,16 +296,13 @@ def _mask_from_indices(n, indices) -> np.ndarray:
     return mask
 
 
-def _commit_balances(spec, state, old, new) -> None:
-    _write_u64_list(state.balances, spec.Gwei, old, new)
-
-
 # ---------------------------------------------------------------------------
 # process_rewards_and_penalties
 # ---------------------------------------------------------------------------
 
 def try_process_rewards_and_penalties(spec, state) -> bool:
     if not enabled():
+        state_arrays.flush(state)
         _C_EPOCH_LOOP.add()
         return False
     if spec.get_current_epoch(state) == spec.GENESIS_EPOCH:
@@ -397,6 +314,7 @@ def try_process_rewards_and_penalties(spec, state) -> bool:
         else:
             _phase0_rewards_and_penalties(spec, state)
     except _Fallback:
+        state_arrays.flush(state)
         _C_EPOCH_FALLBACKS.add()
         _C_EPOCH_LOOP.add()
         return False
@@ -431,7 +349,8 @@ def _phase0_rewards_and_penalties(spec, state) -> None:
     tgt_set = spec.get_unslashed_attesting_indices(state, tgt_atts)
     head_set = spec.get_unslashed_attesting_indices(state, head_atts)
 
-    cols = validator_columns(state)
+    sa = state_arrays.of(state)
+    cols = sa.registry()
     n = len(cols)
     if n == 0:
         return
@@ -512,18 +431,15 @@ def _phase0_rewards_and_penalties(spec, state) -> None:
     for p in penalty_parts[1:]:
         penalties = penalties + p
 
-    balances = u64_column(state.balances)
+    balances = sa.balances()
     _guard(int(balances.max(initial=0)) + int(rewards.max(initial=0)))
     new_balances = apply_deltas_kernel(xp, balances, rewards, penalties)
-    _commit_balances(spec, state, balances, new_balances)
+    sa.set_balances(new_balances)
 
 
-def _altair_participation(spec, state, cols, flag_index, previous_epoch,
-                          active_prev):
+def _altair_participation(spec, sa, cols, flag_index, active_prev):
     """``get_unslashed_participating_indices`` as a mask (prev epoch)."""
-    flags = np.fromiter(
-        sequence_items(state.previous_epoch_participation),
-        dtype=np.uint8, count=len(cols))
+    flags = sa.participation("previous")
     has_flag = (flags >> np.uint8(flag_index)) & np.uint8(1) == np.uint8(1)
     return active_prev & has_flag & ~cols["sl"]
 
@@ -532,7 +448,8 @@ def _altair_rewards_and_penalties(spec, state) -> None:
     """altair+ flag deltas + inactivity deltas, applied pairwise in spec
     order (each pair's decrease clamps at zero before the next applies)."""
     xp = np
-    cols = validator_columns(state)
+    sa = state_arrays.of(state)
+    cols = sa.registry()
     n = len(cols)
     if n == 0:
         return
@@ -559,7 +476,7 @@ def _altair_rewards_and_penalties(spec, state) -> None:
     target_participating = None
     for flag_index, weight in enumerate(spec.PARTICIPATION_FLAG_WEIGHTS):
         participating = _altair_participation(
-            spec, state, cols, flag_index, prev_epoch, active_prev)
+            spec, sa, cols, flag_index, active_prev)
         if flag_index == target_flag:
             target_participating = participating
         up_balance = max(increment, _masked_sum(eff, participating))
@@ -575,21 +492,23 @@ def _altair_rewards_and_penalties(spec, state) -> None:
     quotient = (int(spec.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX)
                 if "bellatrix" in _fork_lineage(spec)
                 else int(spec.INACTIVITY_PENALTY_QUOTIENT_ALTAIR))
-    scores = u64_column(state.inactivity_scores)
+    # the store's view: includes the scores process_inactivity_updates
+    # may have written earlier in this (possibly still uncommitted)
+    # epoch transition — exactly what the spec loop would read from SSZ
+    scores = sa.inactivity_scores()
     _guard(max_eff * int(scores.max(initial=0)))
     inactivity_penalties = inactivity_penalty_kernel(
         xp, eff, scores, eligible, target_participating,
         denominator=int(spec.config.INACTIVITY_SCORE_BIAS) * quotient)
     delta_pairs.append((np.zeros(n, dtype=np.uint64), inactivity_penalties))
 
-    balances = u64_column(state.balances)
-    old = balances
+    balances = sa.balances()
     max_bal = int(balances.max(initial=0))
     for rewards, penalties in delta_pairs:
         _guard(max_bal + int(rewards.max(initial=0)))
         balances = apply_deltas_kernel(xp, balances, rewards, penalties)
         max_bal = int(balances.max(initial=0))
-    _commit_balances(spec, state, old, balances)
+    sa.set_balances(balances)
 
 
 # ---------------------------------------------------------------------------
@@ -598,6 +517,7 @@ def _altair_rewards_and_penalties(spec, state) -> None:
 
 def try_process_inactivity_updates(spec, state) -> bool:
     if not enabled():
+        state_arrays.flush(state)
         _C_EPOCH_LOOP.add()
         return False
     if spec.get_current_epoch(state) == spec.GENESIS_EPOCH:
@@ -607,25 +527,25 @@ def try_process_inactivity_updates(spec, state) -> bool:
         _C_EPOCH_LOOP.add()
         return False
     try:
-        cols = validator_columns(state)
+        sa = state_arrays.of(state)
+        cols = sa.registry()
         if len(cols) == 0:
             _C_EPOCH_LOOP.add()
             return False
         prev_epoch = int(spec.get_previous_epoch(state))
         active_prev, eligible = _epoch_masks(spec, cols, prev_epoch)
         participating = _altair_participation(
-            spec, state, cols, int(spec.TIMELY_TARGET_FLAG_INDEX),
-            prev_epoch, active_prev)
-        scores = u64_column(state.inactivity_scores)
+            spec, sa, cols, int(spec.TIMELY_TARGET_FLAG_INDEX), active_prev)
+        scores = sa.inactivity_scores()
         bias = int(spec.config.INACTIVITY_SCORE_BIAS)
         _guard(int(scores.max(initial=0)) + bias)
         new_scores = inactivity_updates_kernel(
             np, scores, eligible, participating, bias=bias,
             recovery_rate=int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE),
             in_leak=bool(spec.is_in_inactivity_leak(state)))
-        _write_u64_list(state.inactivity_scores, spec.uint64,
-                        scores, new_scores)
+        sa.set_inactivity_scores(new_scores)
     except _Fallback:
+        state_arrays.flush(state)
         _C_EPOCH_FALLBACKS.add()
         _C_EPOCH_LOOP.add()
         return False
@@ -639,11 +559,13 @@ def try_process_inactivity_updates(spec, state) -> bool:
 
 def try_process_registry_updates(spec, state) -> bool:
     if not enabled():
+        state_arrays.flush(state)
         _C_EPOCH_LOOP.add()
         return False
     try:
         _registry_updates(spec, state)
     except _Fallback:
+        state_arrays.flush(state)
         _C_EPOCH_FALLBACKS.add()
         _C_EPOCH_LOOP.add()
         return False
@@ -654,10 +576,15 @@ def try_process_registry_updates(spec, state) -> bool:
 def _registry_updates(spec, state) -> None:
     """Eligibility scans and the activation-queue sort as array ops; the
     per-ejection exit-queue recurrence (a running max + churn counter) is
-    simulated incrementally instead of re-scanning the registry per exit."""
-    # private copy: the cached snapshot under the pre-state root stays
-    # pristine while this function mutates epoch fields through the views
-    cols = validator_columns(state).copy()
+    simulated incrementally instead of re-scanning the registry per exit.
+
+    Registry mutations run copy-on-write: the shared store columns are
+    only copied (``registry_writable``) when this epoch actually stamps,
+    ejects or activates someone — the common quiet epoch touches
+    nothing.  SSZ per-index writes and column writes stay paired, then
+    ``mark_registry_committed`` re-stamps the store."""
+    sa = state_arrays.of(state)
+    cols = sa.registry()
     n = len(cols)
     if n == 0:
         return
@@ -666,16 +593,27 @@ def _registry_updates(spec, state) -> None:
     far_future = int(spec.FAR_FUTURE_EPOCH)
     max_eb = int(spec.MAX_EFFECTIVE_BALANCE)
 
+    wcols = None
+
+    def writable():
+        nonlocal wcols, cols
+        if wcols is None:
+            wcols = sa.registry_writable()
+            cols = wcols
+        return wcols
+
     aee = cols["aee"]
-    ext = cols["ext"]
-    wd = cols["wd"]
 
     # activation-queue eligibility stamps (is_eligible_for_activation_queue)
     queue_mask = (aee == np.uint64(far_future)) & (cols["eff"] == np.uint64(max_eb))
     stamp = current_epoch + 1
-    for i in np.nonzero(queue_mask)[0].tolist():
-        validators[i].activation_eligibility_epoch = stamp
-    aee[queue_mask] = np.uint64(stamp)
+    if queue_mask.any():
+        # copy-on-write BEFORE the paired SSZ writes: the generation
+        # bump would otherwise read as a stale cell and re-extract
+        aee = writable()["aee"]
+        for i in np.nonzero(queue_mask)[0].tolist():
+            validators[i].activation_eligibility_epoch = stamp
+        aee[queue_mask] = np.uint64(stamp)
 
     # ejections: initiate_validator_exit per index, in index order.  The
     # churn limit is constant across the loop (assigned exit epochs are
@@ -691,6 +629,8 @@ def _registry_updates(spec, state) -> None:
                        & (cols["eff"] <= np.uint64(
                            int(spec.config.EJECTION_BALANCE))))[0]
     if eject.size:
+        ext = writable()["ext"]
+        wd = wcols["wd"]
         exited = ext[ext != np.uint64(far_future)]
         queue_epoch = current_epoch + 1 + int(spec.MAX_SEED_LOOKAHEAD)
         if exited.size:
@@ -722,12 +662,16 @@ def _registry_updates(spec, state) -> None:
         if "deneb" in _fork_lineage(spec):
             activation_churn = min(
                 int(spec.MAX_PER_EPOCH_ACTIVATION_CHURN_LIMIT), churn)
-        activation_epoch = current_epoch + 1 + int(spec.MAX_SEED_LOOKAHEAD)
-        for i in idx[order][:activation_churn].tolist():
-            validators[i].activation_epoch = activation_epoch
-            cols["act"][i] = np.uint64(activation_epoch)
+        take = idx[order][:activation_churn].tolist()
+        if take:
+            activation_epoch = current_epoch + 1 + int(spec.MAX_SEED_LOOKAHEAD)
+            act = writable()["act"]
+            for i in take:
+                validators[i].activation_epoch = activation_epoch
+                act[i] = np.uint64(activation_epoch)
 
-    _recache_columns(state, cols)
+    if wcols is not None:
+        sa.mark_registry_committed()
 
 
 # ---------------------------------------------------------------------------
@@ -736,6 +680,7 @@ def _registry_updates(spec, state) -> None:
 
 def try_process_slashings(spec, state) -> bool:
     if not enabled():
+        state_arrays.flush(state)
         _C_EPOCH_LOOP.add()
         return False
     try:
@@ -748,6 +693,7 @@ def try_process_slashings(spec, state) -> bool:
             multiplier = spec.PROPORTIONAL_SLASHING_MULTIPLIER
         _slashings(spec, state, int(multiplier))
     except _Fallback:
+        state_arrays.flush(state)
         _C_EPOCH_FALLBACKS.add()
         _C_EPOCH_LOOP.add()
         return False
@@ -756,7 +702,8 @@ def try_process_slashings(spec, state) -> bool:
 
 
 def _slashings(spec, state, multiplier) -> None:
-    cols = validator_columns(state)
+    sa = state_arrays.of(state)
+    cols = sa.registry()
     if len(cols) == 0:
         return
     epoch = int(spec.get_current_epoch(state))
@@ -774,10 +721,10 @@ def _slashings(spec, state, multiplier) -> None:
     penalties = slashing_penalty_kernel(
         np, cols["eff"], target, increment=increment,
         adjusted_total_slashing_balance=adjusted, total_balance=total_balance)
-    balances = u64_column(state.balances)
+    balances = sa.balances()
     new_balances = np.where(penalties > balances, np.uint64(0),
                             balances - penalties)
-    _commit_balances(spec, state, balances, new_balances)
+    sa.set_balances(new_balances)
 
 
 # ---------------------------------------------------------------------------
@@ -786,11 +733,13 @@ def _slashings(spec, state, multiplier) -> None:
 
 def try_process_effective_balance_updates(spec, state) -> bool:
     if not enabled():
+        state_arrays.flush(state)
         _C_EPOCH_LOOP.add()
         return False
     try:
         _effective_balance_updates(spec, state)
     except _Fallback:
+        state_arrays.flush(state)
         _C_EPOCH_FALLBACKS.add()
         _C_EPOCH_LOOP.add()
         return False
@@ -799,14 +748,17 @@ def try_process_effective_balance_updates(spec, state) -> bool:
 
 
 def _effective_balance_updates(spec, state) -> None:
-    cols = validator_columns(state)
+    sa = state_arrays.of(state)
+    cols = sa.registry()
     if len(cols) == 0:
         return
     increment = int(spec.EFFECTIVE_BALANCE_INCREMENT)
     hysteresis_increment = increment // int(spec.HYSTERESIS_QUOTIENT)
     down = hysteresis_increment * int(spec.HYSTERESIS_DOWNWARD_MULTIPLIER)
     up = hysteresis_increment * int(spec.HYSTERESIS_UPWARD_MULTIPLIER)
-    balances = u64_column(state.balances)
+    # the store's balances: includes this transition's still-deferred
+    # reward/slashing writes, exactly what the spec loop would read
+    balances = sa.balances()
     eff = cols["eff"]
     _guard(int(balances.max(initial=0)) + down, int(eff.max(initial=0)) + up)
     new_eff = effective_balance_kernel(
@@ -816,12 +768,12 @@ def _effective_balance_updates(spec, state) -> None:
     changed = np.nonzero(eff != new_eff)[0]
     if changed.size == 0:
         return
+    # copy-on-write BEFORE the paired SSZ writes (generation bump)
+    sa.registry_writable()["eff"] = new_eff
     validators = sequence_items(state.validators)
     for i in changed.tolist():
         validators[i].effective_balance = int(new_eff[i])
-    new_cols = cols.copy()   # cached pre-state snapshot stays pristine
-    new_cols["eff"] = new_eff
-    _recache_columns(state, new_cols)
+    sa.mark_registry_committed()
 
 
 # ---------------------------------------------------------------------------
@@ -843,7 +795,14 @@ def install_vectorized_epoch(cls) -> None:
     are emitted verbatim from the spec text and therefore cannot carry
     the hand-written ladder's inline ``try_process_*`` calls.  Only
     methods defined on ``cls`` itself are wrapped (inherited ones are
-    already wrapped on the base class), and wrapping is idempotent."""
+    already wrapped on the base class), and wrapping is idempotent.
+
+    ``process_epoch`` itself is additionally wrapped in the state-store
+    commit scope (``state_arrays.commit_scope``) so the deferrable
+    column writes of the whole transition flush to SSZ chunks once, at
+    scope exit — unless the class opts out via
+    ``_defer_epoch_commits = False`` (forks whose epoch ordering
+    interleaves non-engine balance writes, e.g. custody_game)."""
     import functools
     for name, try_fn in _TRY_BY_NAME.items():
         fn = cls.__dict__.get(name)
@@ -860,3 +819,13 @@ def install_vectorized_epoch(cls) -> None:
             return wrapper
 
         setattr(cls, name, _make(fn, try_fn))
+
+    fn = cls.__dict__.get("process_epoch")
+    if fn is not None and not getattr(fn, "_vectorized_epoch_wrapper", False) \
+            and getattr(cls, "_defer_epoch_commits", True):
+        @functools.wraps(fn)
+        def epoch_wrapper(self, state, _orig=fn):
+            with state_arrays.commit_scope(state):
+                return _orig(self, state)
+        epoch_wrapper._vectorized_epoch_wrapper = True
+        setattr(cls, "process_epoch", epoch_wrapper)

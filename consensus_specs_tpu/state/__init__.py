@@ -1,0 +1,9 @@
+"""Canonical columnar state layer.
+
+``state.arrays`` is the one sanctioned place where SSZ beacon-state
+sequences are extracted into (and committed back from) numpy columns.
+Engine code in ``ops/``, ``forkchoice/`` and ``utils/ssz/`` reads
+through :func:`arrays.of` / :func:`arrays.registry_of` instead of
+walking the registry itself (enforced by the speclint S6xx pass).
+"""
+from . import arrays  # noqa: F401

@@ -1,4 +1,5 @@
 """speclint passes.  Each module exposes ``NAME`` and ``run(ctx)``."""
-from . import uint64, tracing, ladder, obs, specmd, style  # noqa: F401
+from . import (  # noqa: F401
+    uint64, tracing, ladder, obs, specmd, state_layer, style)
 
-ALL_PASSES = (style, uint64, tracing, ladder, specmd, obs)
+ALL_PASSES = (style, uint64, tracing, ladder, specmd, obs, state_layer)

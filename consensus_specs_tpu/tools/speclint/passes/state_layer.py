@@ -1,0 +1,121 @@
+"""state-layer pass (S6xx): columnar extraction belongs to
+``consensus_specs_tpu/state/`` — the copy-on-write ``StateArrays``
+store is the one place SSZ sequences turn into numpy columns and
+columns commit back to SSZ chunks.
+
+Before the store existed, three engines extracted the same registry
+columns independently, each with its own cache keys and staleness
+heuristics — the stale-column bug class the store kills structurally
+(per-column mutation generations).  This pass keeps private extraction
+from creeping back into engine code:
+
+* S601 — raw column extraction (``np.fromiter`` / ``xp.fromiter``
+  over a ``sequence_items(...)`` walk — nested directly or through a
+  name bound to one, the historical two-line shape) in a scoped
+  engine package.  Read columns through ``state.arrays.of(state)`` /
+  ``registry_of(state)`` (or ``state.arrays.u64_column`` for the rare
+  sanctioned one-off) so extraction is counted, cached, and
+  generation-validated in one place.
+* S602 — ``forkchoice/`` importing the raw sequence-access primitives
+  (``sequence_items`` / ``replace_basic_items``).  Fork choice is a
+  pure column consumer; it must read via the store.
+
+Scope: ``consensus_specs_tpu/ops/``, ``consensus_specs_tpu/
+forkchoice/``, ``consensus_specs_tpu/utils/ssz/`` (the state package
+itself is the sanctioned home and is not scanned).  Intentional
+exceptions carry ``# noqa: S601`` / ``# noqa: S602``.
+"""
+import ast
+
+from ..findings import Finding
+
+NAME = "state_layer"
+CODE_PREFIXES = ("S6",)
+
+HOT_PREFIXES = (
+    "consensus_specs_tpu/ops/",
+    "consensus_specs_tpu/forkchoice/",
+    "consensus_specs_tpu/utils/ssz/",
+)
+
+_RAW_IMPORTS = {"sequence_items", "replace_basic_items"}
+
+
+def _in_scope(path: str) -> bool:
+    return any(path.startswith(p) for p in HOT_PREFIXES)
+
+
+def _call_name(node):
+    fn = node.func
+    return fn.attr if isinstance(fn, ast.Attribute) else \
+        fn.id if isinstance(fn, ast.Name) else None
+
+
+def _item_walk_names(tree):
+    """Names bound to a ``sequence_items(...)`` walk anywhere in the
+    module — the historical two-line extraction shape
+    (``items = sequence_items(seq)`` then ``np.fromiter(items, ...)``)
+    must fire S601 just like the nested one-liner.  Module-wide (not
+    per-scope) on purpose: a shadowing reuse of such a name for
+    something else is itself worth a look, and ``# noqa: S601`` covers
+    the sanctioned cases."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                and _call_name(node.value) == "sequence_items":
+            names.update(t.id for t in node.targets
+                         if isinstance(t, ast.Name))
+    return names
+
+
+def _is_fromiter_over_sequence_items(node, item_names) -> bool:
+    if _call_name(node) != "fromiter" or not node.args:
+        return False
+    for inner in ast.walk(node.args[0]):
+        if isinstance(inner, ast.Call) \
+                and _call_name(inner) == "sequence_items":
+            return True
+        if isinstance(inner, ast.Name) and inner.id in item_names:
+            return True
+    return False
+
+
+def check_source(path: str, text: str):
+    """All S6xx findings for one file (``path`` repo-relative)."""
+    if not _in_scope(path):
+        return []
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError:
+        return []    # the style pass owns E999
+    findings = []
+    in_forkchoice = path.startswith("consensus_specs_tpu/forkchoice/")
+    item_names = _item_walk_names(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and _is_fromiter_over_sequence_items(node, item_names):
+            findings.append(Finding(
+                path, node.lineno, "S601",
+                "raw column extraction (fromiter over sequence_items) "
+                "outside the state layer — read through "
+                "state.arrays.of(state) so extraction is cached, "
+                "counted and generation-validated in one place"))
+        elif in_forkchoice and isinstance(node, ast.ImportFrom):
+            names = {a.name for a in node.names} & _RAW_IMPORTS
+            for n in sorted(names):
+                findings.append(Finding(
+                    path, node.lineno, "S602",
+                    f"forkchoice/ imports the raw sequence primitive "
+                    f"{n!r} — fork choice consumes columns via the "
+                    f"StateArrays store (state/arrays.py), never the "
+                    f"typed views directly"))
+    return findings
+
+
+def run(ctx):
+    findings = []
+    for rel in ctx.py_files:
+        if not _in_scope(rel):
+            continue
+        findings.extend(check_source(rel, ctx.source(rel)))
+    return findings

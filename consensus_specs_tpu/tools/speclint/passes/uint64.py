@@ -7,7 +7,7 @@ Unsigned lanes wrap silently: ``a - b`` underflows to huge values,
 ``a * b`` truncates mod 2**64, and a dtype-less ``.sum()`` accumulates
 in the platform default integer (int32 on some hosts) rather than the
 lane dtype.  The pass runs a per-function forward taint walk: values
-born from ``uint64``/``u64_column``/``validator_columns``/
+born from ``uint64``/``u64_column``/the StateArrays accessors/
 ``dtype=np.uint64`` seeds (and, for the ``xp``-namespace kernels of
 ``epoch_kernels.py``, every array parameter) are marked unsigned, and
 arithmetic on them is checked:
@@ -43,7 +43,12 @@ SCOPED_PREFIXES = (
     "consensus_specs_tpu/ops/jax_bls/",
 )
 
-_SEED_CALLS = {"uint64", "u64_column", "validator_columns"}
+# seeds include the StateArrays accessors (state/arrays.py): columns
+# handed out by the store are uint64 lanes like the old direct
+# extraction helpers were
+_SEED_CALLS = {"uint64", "u64_column",
+               "registry", "registry_of", "registry_writable",
+               "balances", "inactivity_scores", "participation"}
 _ARRAY_CTORS = {"fromiter", "zeros", "ones", "full", "empty", "arange",
                 "asarray", "array"}
 _PROPAGATING_METHODS = {"copy", "reshape", "max", "min", "clip", "cumsum",

@@ -52,6 +52,16 @@ TRACE = os.environ.get("CS_TPU_TRACE") == "1"
 # after import.
 BLS_RLC = os.environ.get("CS_TPU_BLS_RLC") != "0"
 
+# Copy-on-write columnar state store kill switch:
+# ``CS_TPU_STATE_ARRAYS=0`` detaches the per-state ``StateArrays``
+# column store (``state/arrays.py``): every engine access re-extracts
+# its columns and commits immediately instead of sharing one extraction
+# per state lineage with deferred per-epoch commits.  Like
+# ``CS_TPU_PROTO_ARRAY``, this snapshot is the import-time default and
+# ``state.arrays.enabled()`` re-reads the environment at call time when
+# the variable is present, so a test/CI leg can flip it after import.
+STATE_ARRAYS = os.environ.get("CS_TPU_STATE_ARRAYS") != "0"
+
 # Proto-array fork-choice kill switch: ``CS_TPU_PROTO_ARRAY=0`` runs the
 # spec-loop ``get_head`` / ``get_weight`` / ``get_filtered_block_tree``
 # (``forks/fork_choice.py``) instead of the incremental columnar engine

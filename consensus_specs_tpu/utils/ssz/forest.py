@@ -13,10 +13,12 @@ The scope also carries the columnar container-root fast path
 (:func:`bulk_element_root_bytes`): all N element roots of a
 ``List[Validator, ...]``-style sequence are computed from vectorized
 field serialization plus batched layer hashes over an ``(N, fields, 32)``
-chunk cube, instead of N per-object merkleizations.  The uint64 field
-columns extracted along the way are kept (root-generation-validated) for
-the vectorized epoch engine (``ops/epoch_kernels.py``), which otherwise
-re-extracts them with an O(N) python pass.
+chunk cube, instead of N per-object merkleizations.  Column sharing
+with the state layer runs both ways: a build first asks the registered
+column provider (``state/arrays.py``) for the committed uint64 columns
+of a live ``StateArrays`` store — skipping the per-field python walk —
+and when no store exists yet, the columns extracted along the way are
+stashed (generation-validated) for the store to adopt on first access.
 
 ``CS_TPU_HASH_FOREST=0`` disables both (see ``utils/env_flags.py``).
 """
@@ -214,13 +216,25 @@ def _container_root_bytes(items, et, owner) -> bytes:
     plan = _columnar_plan(et)
     width = merkle.next_power_of_two(max(len(plan), 1))
     cols = np.zeros((n, width, 32), dtype=np.uint8)
-    stash = {} if owner is not None else None
+    # full-extraction builds first ask the registered column provider
+    # (state/arrays.py): a live StateArrays store already holds the
+    # committed uint64 columns, so the per-field python walk is skipped
+    provided = _column_provider(owner) \
+        if owner is not None and _column_provider is not None else None
+    if provided is not None \
+            and any(c.shape[0] != n for c in provided.values()):
+        provided = None     # shape desync: never trust a short column
+    stash = {} if owner is not None and provided is None else None
     for j, (fname, kind, size) in enumerate(plan):
         if kind == "uint":
-            vals = np.fromiter((int(getattr(x, fname)) for x in items),
-                               dtype=np.uint64, count=n)
-            # value < 2**(8*size), so bytes past `size` are zero anyway
-            cols[:, j, :8] = vals.astype("<u8", copy=False) \
+            vals = provided.get(fname) if provided is not None else None
+            if vals is None:
+                vals = np.fromiter((int(getattr(x, fname)) for x in items),
+                                   dtype=np.uint64, count=n)
+            # value < 2**(8*size), so bytes past `size` are zero anyway.
+            # ascontiguousarray: provider columns can be strided
+            # structured-array field views, which .view(uint8) rejects
+            cols[:, j, :8] = np.ascontiguousarray(vals, dtype="<u8") \
                 .view(np.uint8).reshape(n, 8)
             if stash is not None:
                 stash[fname] = vals
@@ -246,8 +260,19 @@ def _container_root_bytes(items, et, owner) -> bytes:
 
 
 # ---------------------------------------------------------------------------
-# Column sharing with the epoch engine
+# Column sharing with the state layer (state/arrays.py)
 # ---------------------------------------------------------------------------
+
+# Registered by ``state/arrays.py`` at import (keeps this module free of
+# an upward dependency): maps an owning sequence to its live, committed
+# ``{ssz field name: uint64 column}`` view, or None.
+_column_provider = None
+
+
+def set_column_provider(fn) -> None:
+    global _column_provider
+    _column_provider = fn
+
 
 # (weakref to owning sequence, owner mutation generation, {field: u64 col})
 _shared_columns = None

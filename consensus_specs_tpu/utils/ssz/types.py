@@ -1020,6 +1020,16 @@ class Container(SSZValue, metaclass=_ContainerMeta):
         return root
 
     def copy(self):
+        # A state carrying an attached StateArrays column store
+        # (state/arrays.py) hands its columns to the copy
+        # copy-on-write: pending column writes flush BEFORE the field
+        # snapshot (so the copied SSZ content matches), and the forked
+        # store rides along afterwards.  Duck-typed on the attribute so
+        # this module needs no upward import; a plain container pays
+        # one dict lookup.
+        store = self.__dict__.get("_state_arrays")
+        if store is not None:
+            store.commit()
         new = object.__new__(type(self))
         for f in type(self)._fields:
             fv = getattr(self, f).copy()
@@ -1028,6 +1038,8 @@ class Container(SSZValue, metaclass=_ContainerMeta):
         # field copies have identical roots, so the memoized root carries over
         object.__setattr__(new, "_root_cache",
                            object.__getattribute__(self, "_root_cache"))
+        if store is not None:
+            store.fork(new)
         return new
 
     def __eq__(self, other):

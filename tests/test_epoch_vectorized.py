@@ -23,6 +23,7 @@ import pytest
 
 from consensus_specs_tpu.forks import build_spec
 from consensus_specs_tpu.ops import epoch_kernels as ek
+from consensus_specs_tpu.state import arrays as state_arrays
 from consensus_specs_tpu.test_infra.attestations import (
     next_epoch_with_attestations)
 from consensus_specs_tpu.test_infra.block import next_epoch
@@ -54,6 +55,7 @@ def _engine_mode_reset():
     yield
     bls.bls_active = prev_bls
     ek.use_auto()
+    state_arrays.use_auto()
 
 
 def _spec(fork):
@@ -255,6 +257,36 @@ def test_guard_fallback_matches_loop():
         spec.process_rewards_and_penalties(s_vec)
     assert delta["epoch.fallbacks"] == 1
     assert hash_tree_root(s_loop) == hash_tree_root(s_vec)
+
+
+@pytest.mark.parametrize("store_on", [True, False])
+def test_registry_poisoning_mid_epoch(store_on):
+    """Cache-poisoning regression (the PR-4-review bug shape): mutate
+    the registry through the SSZ sequence API BETWEEN kernel reads of
+    one epoch, with warm columns.  The next kernel read must see fresh
+    columns — the StateArrays store revalidates against the sequence
+    mutation generation (store on) or re-extracts per call (store off);
+    a stale snapshot would keep validator 5's old effective balance and
+    commit a divergent post-state."""
+    (state_arrays.use_arrays if store_on else state_arrays.use_fallback)()
+    spec, state = _altair_state("altair", seed=43)
+    s_loop, s_vec = state.copy(), state.copy()
+
+    ek.use_vectorized()
+    with counting() as delta:
+        assert ek.try_process_rewards_and_penalties(spec, s_vec)
+    assert delta["cache.miss{cache=state_arrays}"] > 0   # columns warmed
+    # poison: a raw SSZ write the engine never saw
+    s_vec.validators[5].effective_balance = spec.EFFECTIVE_BALANCE_INCREMENT
+    assert ek.try_process_effective_balance_updates(spec, s_vec)
+
+    ek.use_loops()
+    spec.process_rewards_and_penalties(s_loop)
+    s_loop.validators[5].effective_balance = spec.EFFECTIVE_BALANCE_INCREMENT
+    spec.process_effective_balance_updates(s_loop)
+
+    assert hash_tree_root(s_loop) == hash_tree_root(s_vec), \
+        f"store_on={store_on}: stale registry columns after SSZ mutation"
 
 
 def test_env_flag_disables_auto(monkeypatch):

@@ -212,6 +212,16 @@ def test_replay_snapshot_has_labeled_engine_counters():
     epochs = metrics["epoch.transition"]["series"]
     assert sum(epochs.values()) > 0
     assert metrics["cache.hit"]["series"]["{cache=root}"] > 0
+    # the state-arrays store answered the replay's column reads: its
+    # cache series populate and every extraction is column-attributed
+    sa_series = metrics["state_arrays.extracts"]["series"]
+    assert set(sa_series) <= {"{column=registry}", "{column=balances}",
+                              "{column=inactivity_scores}",
+                              "{column=participation}"}
+    assert metrics["cache.hit"]["series"].get("{cache=state_arrays}", 0) > 0
+    # (an 8-slot replay only crosses the genesis-epoch transition, which
+    # writes nothing — the commit census lives in bench_state_arrays)
+    assert "state_arrays.commits" in metrics
     assert not export.schema_problems(snap)
 
 
@@ -317,6 +327,30 @@ def test_report_renders_tree_and_metrics():
 def test_env_flags_registered():
     assert hasattr(env_flags, "PROFILE")
     assert hasattr(env_flags, "TRACE")
+    assert hasattr(env_flags, "STATE_ARRAYS")
+
+
+def test_state_arrays_commit_span_recorded():
+    """The deferred column flush books a ``state_arrays.commit`` span
+    (profiling on) and a ``state_arrays.commits`` counter tick."""
+    import numpy as np
+    from consensus_specs_tpu.forks import build_spec
+    from consensus_specs_tpu.state import arrays
+    from consensus_specs_tpu.test_infra.genesis import create_genesis_state
+    spec = build_spec("phase0", "minimal")
+    state = create_genesis_state(
+        spec, [spec.MAX_EFFECTIVE_BALANCE] * 32, spec.MAX_EFFECTIVE_BALANCE)
+    arrays.use_arrays()
+    tracing.enable(True)
+    try:
+        with counting() as delta:
+            sa = arrays.of(state)
+            sa.set_balances(sa.balances() + np.uint64(1))
+        assert delta["state_arrays.commits"] == 1
+        assert tracing.stats()["state_arrays.commit"]["count"] == 1
+    finally:
+        tracing.enable(False)
+        arrays.use_auto()
 
 
 def test_profiling_module_is_thin_alias():

@@ -21,7 +21,7 @@ from consensus_specs_tpu.tools.speclint import driver
 from consensus_specs_tpu.tools.speclint.findings import (
     Finding, noqa_codes, suppressed)
 from consensus_specs_tpu.tools.speclint.passes import (
-    ladder, obs as obs_pass, specmd, style, tracing, uint64)
+    ladder, obs as obs_pass, specmd, state_layer, style, tracing, uint64)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -394,6 +394,73 @@ def test_obs_accepts_guarded_idioms():
         "    with span('m.dispatch'):\n"
         "        return work(xs)\n")
     assert _codes(obs_pass.check_source(SCOPED, src)) == []
+
+
+def test_state_layer_flags_raw_extraction():
+    src = (
+        "import numpy as np\n"
+        "from consensus_specs_tpu.utils.ssz import sequence_items\n"
+        "def cols(state):\n"
+        "    items = sequence_items(state.balances)\n"
+        "    return np.fromiter(sequence_items(state.balances),\n"
+        "                       dtype=np.uint64, count=len(items))\n")
+    assert _codes(state_layer.check_source(SCOPED, src)) == ["S601"]
+
+
+def test_state_layer_flags_two_line_extraction():
+    """The historical shape the pass exists to ban: bind the walk to a
+    name, fromiter over the name (exactly what the pre-store
+    ``validator_columns`` did) — must fire like the nested one-liner."""
+    src = (
+        "import numpy as np\n"
+        "from consensus_specs_tpu.utils.ssz import sequence_items\n"
+        "def cols(state):\n"
+        "    items = sequence_items(state.balances)\n"
+        "    return np.fromiter(items, dtype=np.uint64, count=len(items))\n")
+    findings = state_layer.check_source(SCOPED, src)
+    assert _codes(findings) == ["S601"]
+    assert findings[0].line == 5      # anchored at the fromiter
+
+
+def test_state_layer_accepts_store_access():
+    """Reading through the StateArrays store (and non-extraction
+    fromiter uses) is the sanctioned pattern — zero findings."""
+    src = (
+        "import numpy as np\n"
+        "from consensus_specs_tpu.state import arrays as state_arrays\n"
+        "def cols(state, indices):\n"
+        "    registry = state_arrays.registry_of(state)\n"
+        "    mask = np.fromiter(indices, dtype=np.int64)\n"
+        "    return registry, mask\n")
+    assert state_layer.check_source(SCOPED, src) == []
+
+
+def test_state_layer_flags_forkchoice_raw_imports():
+    src = (
+        "from consensus_specs_tpu.utils.ssz import (\n"
+        "    hash_tree_root, sequence_items, replace_basic_items)\n")
+    codes = _codes(state_layer.check_source(
+        "consensus_specs_tpu/forkchoice/engine.py", src))
+    assert codes == ["S602", "S602"]
+    # the same import outside forkchoice/ is fine (write-back plumbing)
+    assert state_layer.check_source(SCOPED, src) == []
+
+
+def test_state_layer_out_of_scope_and_noqa():
+    src = (
+        "import numpy as np\n"
+        "def f(seq):\n"
+        "    return np.fromiter(sequence_items(seq), dtype=np.uint64)\n")
+    assert state_layer.check_source(
+        "consensus_specs_tpu/state/arrays.py", src) == []
+    assert state_layer.check_source("tests/test_x.py", src) == []
+    suppressed_src = src.replace(
+        "dtype=np.uint64)", "dtype=np.uint64)  # noqa: S601")
+    findings = state_layer.check_source(SCOPED, suppressed_src)
+    lines = suppressed_src.split("\n")
+    assert findings, "S601 must still fire so the noqa has something " \
+                     "to suppress (empty findings would pass vacuously)"
+    assert all(suppressed(f, lines) for f in findings)
 
 
 def test_obs_out_of_scope_files_ignored():

@@ -1,0 +1,556 @@
+"""Unit + differential suite for the copy-on-write columnar state store
+(``consensus_specs_tpu/state/arrays.py``).
+
+Covers the store's four contracts:
+
+* **structural freshness** — columns revalidate against the SSZ
+  sequences' mutation generations; any write through the sequence API
+  (including nested container fields) is seen by the next read, with
+  the store enabled AND disabled;
+* **copy-on-write snapshot/fork** — forked states share column arrays
+  until one side writes, replays forked from one base produce
+  byte-identical roots vs independent copies, and the copy census stays
+  far below columns x replays;
+* **one commit per epoch transition** — inside ``commit_scope`` the
+  balance-family writes hit SSZ once, spec-loop fallbacks flush first,
+  and an exception discards pending writes;
+* **shared columns** — the hash-forest bulk container-root build reads
+  the store's committed registry columns (provider direction) and the
+  store adopts a forest extraction (stash direction).
+"""
+from random import Random
+
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.forks import build_spec
+from consensus_specs_tpu.ops import epoch_kernels as ek
+from consensus_specs_tpu.ops import att_prep
+from consensus_specs_tpu.state import arrays
+from consensus_specs_tpu.test_infra.block import next_epoch
+from consensus_specs_tpu.test_infra.genesis import create_genesis_state
+from consensus_specs_tpu.test_infra.metrics import counting
+from consensus_specs_tpu.utils import bls
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+from consensus_specs_tpu.utils.ssz.forest import hash_forest
+
+N_VALIDATORS = 64
+
+
+@pytest.fixture(autouse=True)
+def _mode_reset():
+    prev_bls = bls.bls_active
+    bls.bls_active = False
+    yield
+    bls.bls_active = prev_bls
+    ek.use_auto()
+    arrays.use_auto()
+
+
+def _spec(fork="altair"):
+    return build_spec(fork, "minimal")
+
+
+def _genesis(spec):
+    return create_genesis_state(
+        spec, [spec.MAX_EFFECTIVE_BALANCE] * N_VALIDATORS,
+        spec.MAX_EFFECTIVE_BALANCE)
+
+
+# ---------------------------------------------------------------------------
+# extraction, caching, structural invalidation
+# ---------------------------------------------------------------------------
+
+def test_registry_extracted_once_then_hits():
+    spec = _spec()
+    state = _genesis(spec)
+    arrays.use_arrays()
+    with counting() as delta:
+        a = arrays.of(state).registry()
+        b = arrays.of(state).registry()
+    assert a is b
+    assert delta["cache.miss{cache=state_arrays}"] == 1
+    assert delta["cache.hit{cache=state_arrays}"] == 1
+    # one extraction event total (python pass OR forest-stash adoption)
+    assert delta["state_arrays.extracts{column=registry}"] \
+        + delta["state_arrays.adoptions"] == 1
+
+
+@pytest.mark.parametrize("engine_on", [True, False])
+def test_ssz_sequence_mutation_invalidates(engine_on):
+    """Columns revalidate against the sequence mutation generation: a
+    write through the SSZ API (nested container field included) is seen
+    by the very next read — no root hashing, no cache keys."""
+    spec = _spec()
+    state = _genesis(spec)
+    (arrays.use_arrays if engine_on else arrays.use_fallback)()
+    cols = arrays.registry_of(state)
+    assert int(cols["eff"][3]) == int(spec.MAX_EFFECTIVE_BALANCE)
+    state.validators[3].effective_balance = 17 * 10**9
+    cols2 = arrays.registry_of(state)
+    assert int(cols2["eff"][3]) == 17 * 10**9
+    state.balances[5] = 123
+    assert int(arrays.of(state).balances()[5]) == 123
+
+
+def test_wholesale_field_replacement_invalidates():
+    spec = _spec()
+    state = _genesis(spec)
+    arrays.use_arrays()
+    sa = arrays.of(state)
+    assert int(sa.balances()[0]) == int(spec.MAX_EFFECTIVE_BALANCE)
+    state.balances = [7] * N_VALIDATORS      # new sequence object
+    assert int(arrays.of(state).balances()[0]) == 7
+
+
+def test_disabled_store_is_detached():
+    spec = _spec()
+    state = _genesis(spec)
+    arrays.use_fallback()
+    s1, s2 = arrays.of(state), arrays.of(state)
+    assert s1 is not s2
+    assert arrays.backend_name() == "fallback"
+    arrays.use_arrays()
+    assert arrays.of(state) is arrays.of(state)
+    assert arrays.backend_name() == "state_arrays"
+
+
+def test_env_flag_disables_auto(monkeypatch):
+    spec = _spec()
+    state = _genesis(spec)
+    monkeypatch.setenv("CS_TPU_STATE_ARRAYS", "0")
+    arrays.use_auto()
+    assert not arrays.enabled()
+    assert arrays.of(state) is not arrays.of(state)
+    # live re-read: flipping the variable after import works too
+    monkeypatch.setenv("CS_TPU_STATE_ARRAYS", "1")
+    assert arrays.enabled()
+    assert arrays.of(state) is arrays.of(state)
+
+
+# ---------------------------------------------------------------------------
+# deferred commits
+# ---------------------------------------------------------------------------
+
+def test_commit_scope_defers_to_one_commit():
+    spec = _spec()
+    state = _genesis(spec)
+    arrays.use_arrays()
+    sa = arrays.of(state)
+    base = sa.balances()
+    with counting() as delta:
+        with arrays.commit_scope(state):
+            sa.set_balances(base + np.uint64(1))
+            # SSZ must still hold the old values mid-scope
+            assert int(state.balances[0]) == int(base[0])
+            sa.set_balances(sa.balances() + np.uint64(1))
+            assert delta["state_arrays.commits"] == 0
+        assert int(state.balances[0]) == int(base[0]) + 2
+    assert delta["state_arrays.commits"] == 1
+
+
+def test_commit_scope_discards_on_exception():
+    spec = _spec()
+    state = _genesis(spec)
+    arrays.use_arrays()
+    sa = arrays.of(state)
+    before = int(state.balances[0])
+    with pytest.raises(ValueError, match="boom"):
+        with arrays.commit_scope(state):
+            sa.set_balances(sa.balances() + np.uint64(9))
+            raise ValueError("boom")
+    assert int(state.balances[0]) == before
+    # pending write discarded: the store agrees with SSZ again
+    assert int(arrays.of(state).balances()[0]) == before
+
+
+def test_deferred_conflict_raises():
+    """A direct SSZ write racing a pending deferred column write is a
+    protocol violation — fail loud, never clobber silently."""
+    spec = _spec()
+    state = _genesis(spec)
+    arrays.use_arrays()
+    sa = arrays.of(state)
+    with pytest.raises(RuntimeError, match="deferred"):
+        with arrays.commit_scope(state):
+            sa.set_balances(sa.balances() + np.uint64(1))
+            state.balances[0] = 42       # bypasses the store
+
+
+def test_deferred_conflict_raises_on_read():
+    """Same protocol violation, but a column READ lands between the
+    direct SSZ write and scope exit: the revalidating read must raise,
+    not quietly re-extract and drop the pending engine write."""
+    spec = _spec()
+    state = _genesis(spec)
+    arrays.use_arrays()
+    sa = arrays.of(state)
+    with pytest.raises(RuntimeError, match="deferred"):
+        with arrays.commit_scope(state):
+            sa.set_balances(sa.balances() + np.uint64(7))
+            state.balances[0] = 42       # bypasses the store
+            sa.balances()                # revalidates -> must fail loud
+
+
+def test_flush_commits_pending_mid_scope():
+    spec = _spec()
+    state = _genesis(spec)
+    arrays.use_arrays()
+    sa = arrays.of(state)
+    with arrays.commit_scope(state):
+        sa.set_balances(sa.balances() + np.uint64(5))
+        arrays.flush(state)
+        # the spec-loop fallback path sees fresh SSZ
+        assert int(state.balances[0]) \
+            == int(spec.MAX_EFFECTIVE_BALANCE) + 5
+
+
+# ---------------------------------------------------------------------------
+# copy-on-write fork
+# ---------------------------------------------------------------------------
+
+def test_fork_shares_columns_until_write():
+    spec = _spec()
+    state = _genesis(spec)
+    arrays.use_arrays()
+    base_cols = arrays.registry_of(state)
+    with counting() as delta:
+        forked = arrays.fork_state(state)
+        fcols = arrays.of(forked).registry()
+    assert fcols is base_cols                      # shared, no copy
+    assert delta["state_arrays.forks"] == 1
+    assert delta["state_arrays.extracts{column=registry}"] == 0
+    assert delta["cache.miss{cache=state_arrays}"] == 0
+    with counting() as delta:
+        w = arrays.of(forked).registry_writable()
+    assert delta["state_arrays.cow_copies"] == 1
+    assert w is not base_cols
+    w["eff"][0] = np.uint64(1)
+    assert int(base_cols["eff"][0]) == int(spec.MAX_EFFECTIVE_BALANCE)
+
+
+def test_concurrent_replays_byte_identical_and_shared():
+    """16 replays forked from one base snapshot: byte-identical roots
+    vs independent full-copy replays, while the copy-on-write census
+    stays far below columns x replays."""
+    spec = _spec("altair")
+    state = _genesis(spec)
+    ek.use_loops()
+    for _ in range(3):
+        next_epoch(spec, state)
+    ek.use_auto()
+    arrays.use_arrays()
+    arrays.registry_of(state)        # warm the base columns
+    arrays.of(state).balances()
+    base_root = bytes(hash_tree_root(state))
+    rng = Random(7)
+    # halving a balance forces a hysteresis crossing, so each replay's
+    # effective-balance update takes the registry copy-on-write path
+    perturbs = [(rng.randrange(N_VALIDATORS),
+                 int(spec.MAX_EFFECTIVE_BALANCE) // 2 + rng.randrange(100))
+                for _ in range(16)]
+
+    def replay(st, i, amount):
+        st.balances[i] = amount
+        next_epoch(spec, st)
+        return bytes(hash_tree_root(st))
+
+    with counting() as delta:
+        forked_roots = [replay(arrays.fork_state(state), i, amt)
+                        for i, amt in perturbs]
+    n_columns = len(arrays._COLUMNS)
+    assert delta["state_arrays.forks"] == 16
+    assert 0 < delta["state_arrays.cow_copies"] < n_columns * 16
+    # and the forks never re-extracted the shared registry
+    assert delta["state_arrays.extracts{column=registry}"] == 0
+
+    # independent leg with the store OFF: a genuine differential
+    # oracle — a store bug corrupting a shared column cannot cancel
+    # out of both sides of the comparison
+    arrays.use_fallback()
+    independent_roots = [replay(state.copy(), i, amt)
+                         for i, amt in perturbs]
+    arrays.use_arrays()
+    assert forked_roots == independent_roots
+    # the base state itself is untouched by any replay
+    assert bytes(hash_tree_root(state)) == base_root
+
+
+def test_plain_copy_carries_columns_and_pending_writes():
+    """Regressions from review: (a) every plain ``state.copy()`` of a
+    store-carrying state shares the columns copy-on-write (fork-choice
+    block/checkpoint states are made with ``.copy()``, not
+    ``fork_state``); (b) a copy taken inside a commit scope flushes the
+    pending column writes BEFORE the field snapshot — a copy that
+    missed them would silently diverge from its own store."""
+    spec = _spec()
+    state = _genesis(spec)
+    arrays.use_arrays()
+    sa = arrays.of(state)
+    cols = sa.registry()
+    with counting() as delta:
+        c = state.copy()
+        assert arrays.of(c).registry() is cols
+    assert delta["state_arrays.forks"] == 1
+    assert delta["state_arrays.extracts{column=registry}"] == 0
+
+    with arrays.commit_scope(state):
+        sa.set_balances(sa.balances() + np.uint64(7))
+        c2 = state.copy()
+    assert int(c2.balances[0]) == int(spec.MAX_EFFECTIVE_BALANCE) + 7
+    assert int(arrays.of(c2).balances()[0]) == int(c2.balances[0])
+    assert bytes(hash_tree_root(c2)) == bytes(hash_tree_root(state))
+
+
+def test_disabled_copy_of_store_carrying_state_shares_nothing():
+    """Regression: a ``state.copy()`` taken AFTER the store is disabled
+    (the differential-oracle shape: warm a store, then use_fallback for
+    the independent leg) must share nothing with the parent — no
+    attached store, no cells, and no forest column-provider binding on
+    the copy's sequences.  Shared columns would let a store bug cancel
+    out of both sides of a forked-vs-independent root comparison."""
+    spec = _spec()
+    state = _genesis(spec)
+    arrays.use_arrays()
+    arrays.registry_of(state)            # warm + bind the parent
+    arrays.use_fallback()
+    c = state.copy()
+    assert c.__dict__.get("_state_arrays") is None
+    assert arrays.peek_registry(c.validators) is None
+    # the parent's own binding is untouched
+    arrays.use_arrays()
+    assert arrays.peek_registry(state.validators) is not None
+
+
+def test_forest_provider_columns_merkleize():
+    """Regression from review: the provider hands the forest strided
+    structured-array field views; the columnar root build must accept
+    them (ascontiguousarray) — a fresh full merkleization with a warm
+    registry cell used to crash."""
+    spec = build_spec("phase0", "minimal")
+    state = create_genesis_state(
+        spec, [spec.MAX_EFFECTIVE_BALANCE] * 512, spec.MAX_EFFECTIVE_BALANCE)
+    arrays.use_arrays()
+    oracle = bytes(
+        type(state.validators).decode_bytes(state.validators.serialize())
+        .hash_tree_root())
+    arrays.registry_of(state)                    # warm + bind provider
+    assert arrays.peek_registry(state.validators) is not None
+    fresh = state.copy().validators              # cold tree, warm provider
+    object.__setattr__(fresh, "_root_memo", None)
+    object.__setattr__(fresh, "_tree", None)
+    assert bytes(hash_tree_root(fresh)) == oracle
+
+
+def test_fork_drops_stale_cells():
+    """Regression: forking a store whose cell went stale (the parent
+    sequence mutated after extraction) must DROP the cell — rebinding
+    it under the child's fresh generation would launder stale data into
+    a valid-looking column and diverge the forked replay."""
+    spec = _spec()
+    state = _genesis(spec)
+    arrays.use_arrays()
+    sa = arrays.of(state)
+    sa.balances()                       # warm the cell...
+    state.balances[7] = 1234            # ...then go stale behind it
+    forked = arrays.fork_state(state)
+    assert int(arrays.of(forked).balances()[7]) == 1234
+    # same for the registry cell
+    arrays.registry_of(state)
+    state.validators[7].effective_balance = 5 * 10**9
+    forked2 = arrays.fork_state(state)
+    assert int(arrays.of(forked2).registry()["eff"][7]) == 5 * 10**9
+
+
+# ---------------------------------------------------------------------------
+# engine integration: one extraction per epoch, fallback flush
+# ---------------------------------------------------------------------------
+
+def test_one_registry_extraction_per_epoch_replay():
+    """A multi-epoch replay extracts registry columns at most once per
+    epoch transition (here: once TOTAL — empty blocks never mutate the
+    registry, so the lineage-attached columns stay valid throughout)."""
+    spec = _spec("altair")
+    state = _genesis(spec)
+    arrays.use_arrays()
+    ek.use_vectorized()
+    next_epoch(spec, state)       # genesis-epoch transition is a no-op
+    with counting() as delta:
+        for _ in range(3):
+            next_epoch(spec, state)
+    assert delta["state_arrays.extracts{column=registry}"] \
+        + delta["state_arrays.adoptions"] <= 3
+    assert delta["epoch.transition{path=vectorized}"] > 0
+    assert delta["epoch.fallbacks"] == 0
+    # balance-family commits: exactly one per epoch transition
+    assert delta["state_arrays.commits"] == 3
+
+
+def test_process_epoch_differential_arrays_on_off():
+    """Full process_slots epoch transitions must commit byte-identical
+    post-states with the store attached and detached, vectorized engine
+    on and off — the 2x2 matrix."""
+    spec = _spec("deneb")
+    state = _genesis(spec)
+    ek.use_loops()
+    for _ in range(2):
+        next_epoch(spec, state)
+    rng = Random(11)
+    for i in range(N_VALIDATORS):
+        state.previous_epoch_participation[i] = \
+            spec.ParticipationFlags(rng.randint(0, 7))
+        state.current_epoch_participation[i] = \
+            spec.ParticipationFlags(rng.randint(0, 7))
+        state.inactivity_scores[i] = rng.randint(0, 40)
+    roots = {}
+    for arrays_mode, ek_mode in (("on", "on"), ("on", "off"),
+                                 ("off", "on"), ("off", "off")):
+        (arrays.use_arrays if arrays_mode == "on"
+         else arrays.use_fallback)()
+        (ek.use_vectorized if ek_mode == "on" else ek.use_loops)()
+        st = state.copy()
+        next_epoch(spec, st)
+        roots[(arrays_mode, ek_mode)] = bytes(hash_tree_root(st))
+    assert len(set(roots.values())) == 1, roots
+
+
+def test_guard_fallback_flushes_pending_writes():
+    """Inside a deferred epoch scope, a guard trip must flush the
+    pending column writes BEFORE the spec loop reads SSZ — the
+    fallback-path state must equal the all-loops state exactly."""
+    spec = _spec("altair")
+    state = _genesis(spec)
+    ek.use_loops()
+    for _ in range(3):
+        next_epoch(spec, state)
+    # trips the rewards guard (eff * score can overflow a uint64 lane)
+    # AFTER process_inactivity_updates already wrote deferred scores
+    state.inactivity_scores[3] = 10**9
+    rng = Random(13)
+    for i in range(N_VALIDATORS):
+        state.previous_epoch_participation[i] = \
+            spec.ParticipationFlags(rng.randint(0, 7))
+    s_loop, s_vec = state.copy(), state.copy()
+    next_epoch(spec, s_loop)
+    ek.use_vectorized()
+    arrays.use_arrays()
+    with counting() as delta:
+        next_epoch(spec, s_vec)
+    assert delta["epoch.fallbacks"] >= 1
+    assert bytes(hash_tree_root(s_loop)) == bytes(hash_tree_root(s_vec))
+
+
+# ---------------------------------------------------------------------------
+# forest column sharing
+# ---------------------------------------------------------------------------
+
+def test_forest_reads_store_columns():
+    """With a live store, the bulk container-root build consumes the
+    committed registry columns through the provider instead of its own
+    python walk — and the root matches the no-cache oracle."""
+    spec = build_spec("phase0", "minimal")
+    state = create_genesis_state(
+        spec, [spec.MAX_EFFECTIVE_BALANCE] * 512, spec.MAX_EFFECTIVE_BALANCE)
+    arrays.use_arrays()
+    cols = arrays.registry_of(state)
+    provided = arrays.peek_registry(state.validators)
+    assert provided is not None
+    assert provided["effective_balance"] is not None
+    assert int(provided["slashed"][0]) == 0
+    with hash_forest():
+        root = hash_tree_root(state)
+    oracle = type(state).decode_bytes(state.serialize()).hash_tree_root()
+    assert bytes(root) == bytes(oracle)
+    # provider goes stale with the sequence generation
+    state.validators[0].slashed = True
+    assert arrays.peek_registry(state.validators) is None
+    assert bool(arrays.registry_of(state)["sl"][0])
+    assert arrays.peek_registry(state.validators) is not None
+    assert int(cols["sl"][0]) == 0       # old snapshot untouched
+
+
+# ---------------------------------------------------------------------------
+# attestation message preparation (ops/att_prep.py)
+# ---------------------------------------------------------------------------
+
+def _fake_attestations(spec, state, n, rng):
+    atts = []
+    for _ in range(n):
+        data = spec.AttestationData(
+            slot=rng.randrange(64), index=rng.randrange(4),
+            beacon_block_root=rng.randbytes(32),
+            source=spec.Checkpoint(epoch=rng.randrange(8),
+                                   root=rng.randbytes(32)),
+            target=spec.Checkpoint(epoch=rng.randrange(8),
+                                   root=rng.randbytes(32)))
+        atts.append(spec.Attestation(data=data))
+    return atts
+
+
+def test_att_prep_roots_match_spec():
+    """The batched checkpoint/data/signing roots must equal the
+    per-object spec computations bit for bit, and the poked memos must
+    survive value-semantics copies."""
+    spec = _spec("altair")
+    state = _genesis(spec)
+    rng = Random(17)
+    atts = _fake_attestations(spec, state, 9, rng)
+    oracles = []
+    for a in atts:
+        fresh = type(a.data).decode_bytes(a.data.serialize())
+        domain = spec.get_domain(state, spec.DOMAIN_BEACON_ATTESTER,
+                                 a.data.target.epoch)
+        oracles.append((bytes(fresh.hash_tree_root()),
+                        bytes(spec.compute_signing_root(fresh, domain))))
+    with counting() as delta:
+        att_prep.prepare_block_attestations(spec, state, atts)
+    assert delta["att_prep.prepared"] == 9
+    for a, (data_root, signing_root) in zip(atts, oracles):
+        assert bytes(hash_tree_root(a.data)) == data_root
+        hit = att_prep.lookup_signing_root(state, a.data)
+        assert hit == signing_root
+        # value-semantics copy (the get_indexed_attestation path)
+        copied = spec.IndexedAttestation(data=a.data)
+        assert bytes(hash_tree_root(copied.data)) == data_root
+    assert att_prep.lookup_signing_root(
+        state, _fake_attestations(spec, state, 1, rng)[0].data) is None
+
+
+def test_att_prep_skips_extended_attestation_data_layouts():
+    """Regression: the legacy sharding lineage appends
+    ``shard_transition_root`` to ``AttestationData``.  The 5-field
+    chunk cube would compute (and memo-poke) wrong container roots for
+    that layout — preparation must decline, leaving every lookup to
+    miss into the spec body with UNPOISONED root memos."""
+    spec = _spec("sharding")
+    state = _genesis(spec)
+    rng = Random(23)
+    atts = _fake_attestations(spec, state, 3, rng)
+    assert "shard_transition_root" in type(atts[0].data)._fields
+    oracles = [bytes(type(a.data).decode_bytes(
+        a.data.serialize()).hash_tree_root()) for a in atts]
+    with counting() as delta:
+        att_prep.prepare_block_attestations(spec, state, atts)
+    assert delta["att_prep.prepared"] == 0
+    for a, data_root in zip(atts, oracles):
+        assert att_prep.lookup_signing_root(state, a.data) is None
+        assert bytes(hash_tree_root(a.data)) == data_root
+
+
+def test_att_prep_wrapper_hits_through_block_processing():
+    """Processing a real block's attestations must route every
+    is_valid_indexed_attestation through the prepared table."""
+    from consensus_specs_tpu.test_infra.attestations import (
+        next_epoch_with_attestations)
+    spec = build_spec("phase0", "minimal")
+    state = create_genesis_state(
+        spec, [spec.MAX_EFFECTIVE_BALANCE] * N_VALIDATORS,
+        spec.MAX_EFFECTIVE_BALANCE)
+    ek.use_loops()
+    next_epoch(spec, state)
+    with counting() as delta:
+        _, _, state = next_epoch_with_attestations(spec, state, True, False)
+    assert delta["att_prep.blocks"] > 0
+    assert delta["att_prep.prepared"] > 0
+    assert delta["att_prep.hits"] == delta["att_prep.prepared"]
+    assert delta["att_prep.misses"] == 0
