@@ -8,7 +8,7 @@ GENERATORS = operations sanity finality rewards random forks epoch_processing \
 
 .PHONY: test citest test-crypto bench bench-all bench-merkle-smoke \
         bench-forkchoice-smoke bench-obs-smoke bench-block-smoke \
-        bench-state-smoke obs-report dryrun \
+        bench-state-smoke sim-smoke sim-heavy obs-report dryrun \
         warm native lint speclint-baseline \
         generate_tests $(addprefix gen_,$(GENERATORS)) clean-vectors pyspec
 
@@ -32,6 +32,7 @@ citest:
 	$(PYTHON) benchmarks/bench_fork_choice.py --smoke
 	$(PYTHON) benchmarks/bench_block_verify.py --smoke
 	$(PYTHON) benchmarks/bench_state_arrays.py --smoke
+	$(MAKE) sim-smoke
 	$(PYTHON) -m pytest tests/ -q --enable-bls --bls-type fastest
 
 # static checks: syntax gate + the speclint multi-pass analyzer
@@ -109,6 +110,29 @@ bench-block-smoke:
 # state_arrays.* metrics; nonzero exit on regression)
 bench-state-smoke:
 	$(PYTHON) benchmarks/bench_state_arrays.py --smoke
+
+# adversarial sweep acceptance (docs/simulator.md): >= 200 seeded
+# hostile scenarios complete engines-on; every injected fault counted
+# on its reason=injected series (zero silent fallbacks), every
+# injected/storm/spec-differential leg byte-identical to its
+# uninjected replay; nonzero exit + minimized repro artifacts under
+# sim_artifacts/ on any violation.  The time budget converts a
+# pathological host into a controlled failure instead of a CI hang.
+sim-smoke:
+	$(PYTHON) -m consensus_specs_tpu.sim.sweep --seeds 200 \
+		--min-scenarios 200 --time-budget 1500
+
+# the CS_TPU_HEAVY nightly shape: a thousand seeds on a denser
+# injection cadence with more real-signature seeds, then the cross-leg
+# with proto-array AND the state-arrays store off (spec-loop fork
+# choice + detached columns) so the remaining engines are swept against
+# the pure-spec composition too
+sim-heavy:
+	$(PYTHON) -m consensus_specs_tpu.sim.sweep --seeds 1000 \
+		--inject-every 4 --max-sites 6 --diff-every 8 --bls-seeds 4
+	CS_TPU_PROTO_ARRAY=0 CS_TPU_STATE_ARRAYS=0 \
+		$(PYTHON) -m consensus_specs_tpu.sim.sweep --seeds 250 \
+		--start 5000 --inject-every 8 --diff-every 10 --bls-seeds 2
 
 # telemetry disabled-path overhead: with CS_TPU_PROFILE/CS_TPU_TRACE
 # unset, the span + counter instrumentation across the engine stack

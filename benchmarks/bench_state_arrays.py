@@ -154,7 +154,8 @@ def main():
     # the census guarantees (the smoke's reason to exist)
     assert replay_delta["epoch.transition{path=vectorized}"] > 0, \
         "vectorized engine never committed during the replay"
-    assert replay_delta["epoch.fallbacks"] == 0, "unexpected guard fallback"
+    assert replay_delta["epoch.fallbacks{reason=guard}"] == 0, \
+        "unexpected guard fallback"
     assert extracts <= epochs, \
         f"registry re-extracted within an epoch: {extracts} > {epochs}"
     assert commits == epochs, \

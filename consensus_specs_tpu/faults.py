@@ -1,0 +1,146 @@
+"""Fault-injection hooks + the counted-fallback helper.
+
+The accelerated engines (epoch kernels, proto-array fork choice, the
+merkle batch dispatch, the BLS RLC flush, the StateArrays chunk-packed
+commit) each keep a spec-shaped fallback path that must produce
+byte-identical results when the fast path refuses a call.  Nothing in
+the ordinary test suites *forces* those paths under failure, so a
+fallback that silently corrupted state — or a handler that swallowed
+the failure without counting it — would pass every suite.  This module
+makes the failure injectable and the fallback accountable:
+
+* :func:`check` is the per-entry-point hook engines call first.  It is
+  a no-op (one module-global read) unless a :class:`FaultSchedule` is
+  armed, in which case the schedule may raise :class:`InjectedFault`
+  at a scheduled call ordinal.  The adversarial simulator
+  (``consensus_specs_tpu/sim``) arms schedules mid-scenario and then
+  asserts the run still finishes byte-identical to an uninjected
+  replay.
+* :func:`count_fallback` is the one sanctioned way for an engine
+  handler to account a fallback: it routes the trip to the engine's
+  reason-labeled counter series (``reason=injected`` for an injected
+  fault, the engine's organic reason otherwise), so injected and
+  organic fallbacks stay distinguishable in ``obs_report`` and a
+  handler that catches without counting is a lint finding (speclint
+  R7xx, ``tools/speclint/passes/fallbacks.py``).
+
+:class:`InjectedFault` deliberately subclasses ``BaseException``: no
+``except Exception`` catch-all anywhere in the stack (generator runners
+included) can swallow an injected fault by accident.  Only the
+dedicated engine handlers — which must route through
+:func:`count_fallback` — may catch it.
+
+Thread model: injection is a test/simulation harness; schedules are
+process-global and runs are single-threaded.  The disarmed hot path is
+safe everywhere.
+"""
+from contextlib import contextmanager
+
+
+class InjectedFault(BaseException):
+    """Raised by an armed :class:`FaultSchedule` at an engine entry
+    point.  ``BaseException`` on purpose — see module docstring."""
+
+    def __init__(self, site: str, n: int):
+        super().__init__(f"injected fault at {site} (call #{n})")
+        self.site = site
+        self.n = n
+
+
+# Engine entry points that call :func:`check`.  The canonical site
+# names double as the schedule vocabulary; the simulator's harness and
+# the docs enumerate this same set.
+SITES = (
+    "epoch.rewards_and_penalties",
+    "epoch.inactivity_updates",
+    "epoch.registry_updates",
+    "epoch.slashings",
+    "epoch.effective_balance_updates",
+    "forkchoice.head",
+    "forkchoice.weight",
+    "forkchoice.filtered_tree",
+    "merkle.dispatch",
+    "state_arrays.commit",
+    "bls.flush",
+)
+
+_active = None      # the armed schedule; None = disarmed (the hot path)
+
+
+class FaultSchedule:
+    """Seeded site -> call-ordinal trigger table.
+
+    ``triggers`` maps a site name to the 1-based call ordinals at which
+    :func:`check` raises.  The schedule records every site hit
+    (``calls``) and every fault it fired (``fired``), so a harness can
+    assert the schedule discharged exactly as planned — an engine
+    change that stops hitting a site turns into a loud scheduling
+    mismatch instead of a vacuously green run.
+    """
+
+    def __init__(self, triggers=None):
+        self.triggers = {site: set(ns)
+                         for site, ns in (triggers or {}).items() if ns}
+        self.calls = {}
+        self.fired = []
+
+    def hit(self, site: str) -> None:
+        n = self.calls.get(site, 0) + 1
+        self.calls[site] = n
+        if n in self.triggers.get(site, ()):
+            self.fired.append((site, n))
+            raise InjectedFault(site, n)
+
+    @property
+    def planned(self) -> int:
+        """Total injections this schedule will fire."""
+        return sum(len(ns) for ns in self.triggers.values())
+
+    def fully_fired(self) -> bool:
+        return len(self.fired) == self.planned
+
+
+def observing() -> FaultSchedule:
+    """A trigger-less schedule: records per-site call counts without
+    ever firing.  The harness runs the baseline leg under one of these
+    to learn which sites a scenario actually exercises (and how often)
+    before drawing injection ordinals."""
+    return FaultSchedule()
+
+
+def check(site: str) -> None:
+    """Engine entry-point hook.  Disarmed cost: one global read."""
+    sched = _active
+    if sched is not None:
+        sched.hit(site)
+
+
+def active():
+    return _active
+
+
+@contextmanager
+def injected(schedule: FaultSchedule):
+    """Arm ``schedule`` for the duration of the block.  Not reentrant —
+    nested arming would make ordinal accounting ambiguous."""
+    global _active
+    if _active is not None:
+        raise RuntimeError("a fault schedule is already armed")
+    _active = schedule
+    try:
+        yield schedule
+    finally:
+        _active = None
+
+
+def count_fallback(series: dict, exc=None, organic: str = "guard") -> None:
+    """Account one engine fallback on its reason-labeled counter.
+
+    ``series`` maps reason -> pre-bound counter series (module-scope
+    resolution, the speclint O5xx hot-path rule); ``exc`` is the caught
+    exception (or None for a non-exception organic fallback such as the
+    BLS bisect); ``organic`` names the reason used when the trip was
+    not injected.  Every engine handler that absorbs a fallback-class
+    exception must route through here (speclint R7xx)."""
+    reason = "injected" if isinstance(exc, InjectedFault) else organic
+    series[reason].add()

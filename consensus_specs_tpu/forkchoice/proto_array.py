@@ -56,6 +56,7 @@ import os
 
 import numpy as np
 
+from consensus_specs_tpu import faults
 from consensus_specs_tpu.obs import registry as obs_registry
 from consensus_specs_tpu.obs.tracing import span
 from consensus_specs_tpu.state import arrays as state_arrays
@@ -129,7 +130,14 @@ _C_BALANCE_PASSES = obs_registry.counter("forkchoice.balance_passes").labels()
 _C_BOOST_DELTAS = obs_registry.counter("forkchoice.boost_deltas").labels()
 _C_PRUNES = obs_registry.counter("forkchoice.prunes").labels()
 _C_PRUNED_NODES = obs_registry.counter("forkchoice.pruned_nodes").labels()
-_C_FALLBACKS = obs_registry.counter("forkchoice.fallbacks").labels()
+# reason-labeled fallback accounting: ``guard`` for organic refusals (a
+# guard tripped or the justified root left the array window),
+# ``injected`` for harness-scheduled faults (consensus_specs_tpu/faults)
+_C_FALLBACKS_ALL = obs_registry.counter("forkchoice.fallbacks")
+_FALLBACKS = {
+    "guard": _C_FALLBACKS_ALL.labels(reason="guard"),
+    "injected": _C_FALLBACKS_ALL.labels(reason="injected"),
+}
 _C_ANC_HIT = obs_registry.counter("cache.hit").labels(cache="fc_ancestors")
 _C_ANC_MISS = obs_registry.counter("cache.miss").labels(cache="fc_ancestors")
 
@@ -145,7 +153,7 @@ def stats() -> dict:
             "balance_passes": _C_BALANCE_PASSES.n,
             "boost_deltas": _C_BOOST_DELTAS.n, "prunes": _C_PRUNES.n,
             "pruned_nodes": _C_PRUNED_NODES.n,
-            "fallbacks": _C_FALLBACKS.n}
+            "fallbacks": _C_FALLBACKS_ALL.total()}
 
 
 def reset_stats() -> None:
@@ -574,13 +582,14 @@ class ProtoArrayEngine:
         if self._broken:
             return None
         try:
+            faults.check("forkchoice.head")
             self._refresh(spec, store)
-        except _Fallback:
-            _C_FALLBACKS.add()
+        except (_Fallback, faults.InjectedFault) as exc:
+            faults.count_fallback(_FALLBACKS, exc)
             return None
         j = self._index.get(bytes(store.justified_checkpoint.root))
         if j is None:
-            _C_FALLBACKS.add()
+            _FALLBACKS["guard"].add()
             return None
         _, _, best_desc = self._sweep(spec, store)
         return self._roots[best_desc[j]]
@@ -590,9 +599,10 @@ class ProtoArrayEngine:
         if self._broken:
             return None
         try:
+            faults.check("forkchoice.weight")
             self._refresh(spec, store)
-        except _Fallback:
-            _C_FALLBACKS.add()
+        except (_Fallback, faults.InjectedFault) as exc:
+            faults.count_fallback(_FALLBACKS, exc)
             return None
         # look up only after _refresh: a prune inside it compacts the
         # arrays and remaps every index
@@ -606,13 +616,14 @@ class ProtoArrayEngine:
         if self._broken:
             return None
         try:
+            faults.check("forkchoice.filtered_tree")
             self._refresh(spec, store)
-        except _Fallback:
-            _C_FALLBACKS.add()
+        except (_Fallback, faults.InjectedFault) as exc:
+            faults.count_fallback(_FALLBACKS, exc)
             return None
         j = self._index.get(bytes(store.justified_checkpoint.root))
         if j is None:
-            _C_FALLBACKS.add()
+            _FALLBACKS["guard"].add()
             return None
         viable, _, _ = self._sweep(spec, store)
         n = self._n

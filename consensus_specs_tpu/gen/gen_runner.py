@@ -15,11 +15,22 @@ import traceback
 
 import yaml
 
+from consensus_specs_tpu.obs import registry as _obs_registry
 from consensus_specs_tpu.utils import snappy
 from consensus_specs_tpu.utils.ssz.types import SSZValue
 from consensus_specs_tpu.debug.encode import encode
 
 TIME_THRESHOLD_TO_PRINT = 1.0  # seconds (reference gen_base/settings.py)
+
+# What a failing *case* is allowed to raise: the spec's
+# exception-as-invalidity surface (AssertionError and the container/
+# math errors it degrades to), case-parameter mistakes, and part-file
+# I/O.  Deliberately NOT `Exception`: a NameError/TypeError in spec or
+# infra code — or an InjectedFault (a BaseException) from
+# ``consensus_specs_tpu/faults`` — is a bug to surface, not a case to
+# skip past.
+_CASE_FAILURES = (AssertionError, IndexError, KeyError, ValueError,
+                  ArithmeticError, OSError)
 
 
 def _write_yaml(path: str, data) -> None:
@@ -146,7 +157,18 @@ def generate_test_vector(test_case, output_dir: str, log) -> str:
         if elapsed > TIME_THRESHOLD_TO_PRINT:
             print(f"  {test_case.dir_path()}: {elapsed:.1f}s")
         return "generated"
-    except Exception:
+    except _CASE_FAILURES as exc:
+        # the expected per-case failure surface: spec invalidity
+        # assertions (exception-as-invalidity), bad case parameters,
+        # and part-file I/O.  Anything else — including an injected
+        # fault from the adversarial harness, which subclasses
+        # BaseException precisely so no catch-all can eat it — must
+        # escape and kill the run loudly.  Every swallowed failure is
+        # accounted on the obs registry so a fault-injection or
+        # flakiness sweep sees generator losses instead of a silently
+        # thinner corpus.
+        _obs_registry.counter("gen.case_errors").labels(
+            error=type(exc).__name__).add()
         log.append({"case": test_case.dir_path(),
                     "error": traceback.format_exc()})
         return "error"
@@ -237,7 +259,13 @@ def run_generator(generator_name: str, providers, args=None) -> dict:
         try:
             from jax._src import xla_bridge as xb
             return not xb.backends_are_initialized()
-        except Exception:
+        except (ImportError, AttributeError) as exc:
+            # jax absent, or the private probe moved between versions:
+            # forking is then safe by definition (no backend could have
+            # initialized), but account the degraded probe so a
+            # version bump that breaks it is visible in obs_report
+            _obs_registry.counter("gen.fork_probe_misses").labels(
+                error=type(exc).__name__).add()
             return True
 
     if ns.workers > 1 and len(cases) > 1 \

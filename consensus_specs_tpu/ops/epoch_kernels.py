@@ -45,6 +45,7 @@ import os
 
 import numpy as np
 
+from consensus_specs_tpu import faults
 from consensus_specs_tpu.obs import registry as obs_registry
 
 from consensus_specs_tpu.state import arrays as state_arrays
@@ -100,19 +101,25 @@ def enabled() -> bool:
 # as ``epoch.transition{path=vectorized|loop}`` plus a dedicated
 # guard-trip counter (series pre-bound, speclint O5xx hot-path rule).
 # ``path=loop`` counts every transition the spec loop ended up running
-# (engine off, genesis no-op, or a guard trip); ``epoch.fallbacks``
-# counts only the guard trips among them.
+# (engine off, genesis no-op, or a guard trip); ``epoch.fallbacks
+# {reason=guard|injected}`` counts only the trips among them — organic
+# guard refusals vs faults injected by the adversarial harness
+# (``consensus_specs_tpu/faults.py``).
 _C_EPOCH_VECTORIZED = obs_registry.counter(
     "epoch.transition").labels(path="vectorized")
 _C_EPOCH_LOOP = obs_registry.counter("epoch.transition").labels(path="loop")
-_C_EPOCH_FALLBACKS = obs_registry.counter("epoch.fallbacks").labels()
+_C_EPOCH_FALLBACKS_ALL = obs_registry.counter("epoch.fallbacks")
+_EPOCH_FALLBACKS = {
+    "guard": _C_EPOCH_FALLBACKS_ALL.labels(reason="guard"),
+    "injected": _C_EPOCH_FALLBACKS_ALL.labels(reason="injected"),
+}
 
 
 def stats() -> dict:
     """Back-compat alias view of the ``epoch.*`` registry metrics (the
     differential suite asserts on these keys)."""
     return {"vectorized": _C_EPOCH_VECTORIZED.n,
-            "fallback": _C_EPOCH_FALLBACKS.n}
+            "fallback": _C_EPOCH_FALLBACKS_ALL.total()}
 
 
 def reset_stats() -> None:
@@ -309,13 +316,14 @@ def try_process_rewards_and_penalties(spec, state) -> bool:
         _C_EPOCH_LOOP.add()
         return False    # the spec body is already a no-op early return
     try:
+        faults.check("epoch.rewards_and_penalties")
         if "altair" in _fork_lineage(spec):
             _altair_rewards_and_penalties(spec, state)
         else:
             _phase0_rewards_and_penalties(spec, state)
-    except _Fallback:
+    except (_Fallback, faults.InjectedFault) as exc:
         state_arrays.flush(state)
-        _C_EPOCH_FALLBACKS.add()
+        faults.count_fallback(_EPOCH_FALLBACKS, exc)
         _C_EPOCH_LOOP.add()
         return False
     _C_EPOCH_VECTORIZED.add()
@@ -527,6 +535,7 @@ def try_process_inactivity_updates(spec, state) -> bool:
         _C_EPOCH_LOOP.add()
         return False
     try:
+        faults.check("epoch.inactivity_updates")
         sa = state_arrays.of(state)
         cols = sa.registry()
         if len(cols) == 0:
@@ -544,9 +553,9 @@ def try_process_inactivity_updates(spec, state) -> bool:
             recovery_rate=int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE),
             in_leak=bool(spec.is_in_inactivity_leak(state)))
         sa.set_inactivity_scores(new_scores)
-    except _Fallback:
+    except (_Fallback, faults.InjectedFault) as exc:
         state_arrays.flush(state)
-        _C_EPOCH_FALLBACKS.add()
+        faults.count_fallback(_EPOCH_FALLBACKS, exc)
         _C_EPOCH_LOOP.add()
         return False
     _C_EPOCH_VECTORIZED.add()
@@ -563,10 +572,11 @@ def try_process_registry_updates(spec, state) -> bool:
         _C_EPOCH_LOOP.add()
         return False
     try:
+        faults.check("epoch.registry_updates")
         _registry_updates(spec, state)
-    except _Fallback:
+    except (_Fallback, faults.InjectedFault) as exc:
         state_arrays.flush(state)
-        _C_EPOCH_FALLBACKS.add()
+        faults.count_fallback(_EPOCH_FALLBACKS, exc)
         _C_EPOCH_LOOP.add()
         return False
     _C_EPOCH_VECTORIZED.add()
@@ -684,6 +694,7 @@ def try_process_slashings(spec, state) -> bool:
         _C_EPOCH_LOOP.add()
         return False
     try:
+        faults.check("epoch.slashings")
         lineage = _fork_lineage(spec)
         if "bellatrix" in lineage:
             multiplier = spec.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX
@@ -692,9 +703,9 @@ def try_process_slashings(spec, state) -> bool:
         else:
             multiplier = spec.PROPORTIONAL_SLASHING_MULTIPLIER
         _slashings(spec, state, int(multiplier))
-    except _Fallback:
+    except (_Fallback, faults.InjectedFault) as exc:
         state_arrays.flush(state)
-        _C_EPOCH_FALLBACKS.add()
+        faults.count_fallback(_EPOCH_FALLBACKS, exc)
         _C_EPOCH_LOOP.add()
         return False
     _C_EPOCH_VECTORIZED.add()
@@ -737,10 +748,11 @@ def try_process_effective_balance_updates(spec, state) -> bool:
         _C_EPOCH_LOOP.add()
         return False
     try:
+        faults.check("epoch.effective_balance_updates")
         _effective_balance_updates(spec, state)
-    except _Fallback:
+    except (_Fallback, faults.InjectedFault) as exc:
         state_arrays.flush(state)
-        _C_EPOCH_FALLBACKS.add()
+        faults.count_fallback(_EPOCH_FALLBACKS, exc)
         _C_EPOCH_LOOP.add()
         return False
     _C_EPOCH_VECTORIZED.add()

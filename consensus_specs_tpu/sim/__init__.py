@@ -1,0 +1,13 @@
+"""Adversarial chain simulator + fault-injection harness.
+
+``sim/scenarios.py`` builds seeded hostile storylines as pure-data step
+scripts, ``sim/driver.py`` replays a script deterministically against a
+real fork-choice ``Store``, ``sim/harness.py`` turns one seed into
+baseline / injected / storm / spec-differential legs and asserts the
+counted-fallback + byte-identical-replay contract, ``sim/repro.py``
+shrinks and dumps failing scripts, and ``sim/sweep.py`` is the CLI the
+``make sim-smoke`` target and the CS_TPU_HEAVY nightly sweep drive.
+
+See ``docs/simulator.md`` for the scenario catalog and the
+fault-injection schedule format.
+"""

@@ -1,0 +1,509 @@
+"""Adversarial chain driver: execute a scenario script against a real
+fork-choice ``Store``.
+
+A *script* is a flat list of JSON-able step dicts (the vocabulary below)
+produced by a seeded scenario builder (``sim/scenarios.py``).  The
+driver replays the steps through the real spec surface — ``on_tick`` /
+``on_block`` / ``on_attestation`` / ``on_attester_slashing`` — building
+every block and attestation live against the store's current contents.
+Execution is **deterministic given (spec, script)**: the driver holds no
+RNG (scenario builders bake all randomness — offline sets, equivocation
+slots, participation fractions — into the script), so the same script
+replays bit-for-bit across engine on/off legs and fault-injection legs,
+which is what lets the harness (``sim/harness.py``) assert byte-identical
+final state.
+
+Adversarial steps are *allowed to be rejected*: a block for an
+unreachable slot or an attestation for an unknown root raises the
+spec's exception-as-invalidity ``AssertionError``, which the driver
+records (``rejected``) and moves on — exactly how a store treats wire
+garbage.  Rejection is deterministic, so the accepted/rejected step
+pattern is itself part of the replay-equality contract.  The step
+shrinker (``sim/repro.py``) leans on this: deleting steps from a script
+always leaves an executable script.
+
+Step vocabulary (all fields JSON-able; ``tip`` is a scenario-chosen
+label or ``"head"`` for the store's current canonical head):
+
+``{"op": "tick"}``
+    Advance one slot (plus ``"interval": 0|1|2`` within the slot —
+    interval 0 is the timely-proposal window that earns proposer
+    boost), then deliver due withheld blocks and queued attestations.
+``{"op": "block", "tip": t, "set": label, "att_slots": k,
+   "frac": f, "delay": d, "graffiti": n, "exits": [i...],
+   "include_evidence": bool}``
+    Build a block on tip ``t`` for the current slot: attestations for
+    the previous ``k`` slots at participation fraction ``f`` (offline
+    validators never attest), optional voluntary exits, optional queued
+    attester-slashing evidence in the body.  ``delay`` withholds the
+    signed block for ``d`` ticks before delivery (the ex-ante reorg
+    primitive); ``graffiti`` differentiates equivocating siblings.
+``{"op": "attest", "tip": t, "frac": f}``
+    Wire attestations to tip ``t``'s block from its slot's committees,
+    queued and delivered after the next tick (the spec rejects
+    same-slot wire attestations).
+``{"op": "double_vote", "tip_a": a, "tip_b": b, "frac": f}``
+    A slashable double vote: the same committee fraction attests both
+    tips at one slot; both attestations are wired and the
+    ``AttesterSlashing`` evidence is queued for a later
+    ``attester_slashing`` or ``include_evidence`` step.
+``{"op": "attester_slashing"}``
+    Deliver one queued piece of evidence straight to
+    ``on_attester_slashing`` (the withheld-evidence counterpart is
+    simply never emitting this step).  Proposer equivocation evidence
+    (two signed blocks by one proposer at one slot — a block header's
+    ``hash_tree_root`` equals its block's, so the block signatures are
+    valid header signatures) is queued automatically whenever the
+    driver builds conflicting siblings, and rides into bodies via
+    ``include_evidence``.
+``{"op": "offline", "indices": [...]}`` / ``{"op": "online",
+   "indices": [...]}``
+    Take concrete validators off/on line (they stop/resume appearing in
+    any participant set) — the inactivity-leak primitive.
+``{"op": "checks"}``
+    Emit a store-check record into the vector event log (head,
+    justified/finalized, boost), mirroring the cross-client
+    ``fork_choice`` format's ``checks`` step.
+"""
+from consensus_specs_tpu.test_infra.attestations import (
+    get_valid_attestation, sign_attestation)
+from consensus_specs_tpu.test_infra.block import (
+    build_empty_block, state_transition_and_sign_block)
+from consensus_specs_tpu.test_infra.context import emit_part
+from consensus_specs_tpu.test_infra.fork_choice import (
+    get_genesis_forkchoice_store_and_block, output_store_checks)
+from consensus_specs_tpu.test_infra.genesis import create_genesis_state
+from consensus_specs_tpu.test_infra.voluntary_exits import (
+    prepare_signed_exits)
+from consensus_specs_tpu.utils import bls
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+# deterministic participation thinning: validator i attests at (slot,
+# fraction) iff _keep(i, slot, frac).  A Knuth-hash mix so different
+# slots drop different validators without any driver-held RNG.
+_MIX = 2654435761
+
+
+def _keep(index: int, slot: int, frac: float) -> bool:
+    return ((int(index) * _MIX + slot * 40503) % 1000) < int(frac * 1000)
+
+
+class SimResult:
+    """Final store digest + replay-equality fields of one execution."""
+
+    __slots__ = ("head", "head_state_root", "justified", "finalized",
+                 "statuses", "accepted", "rejected", "slots", "organic")
+
+    def __init__(self, spec, store, statuses):
+        # organic fallback counts observed by the baseline leg (filled
+        # by harness.run_baseline); NOT part of digest() — the organic
+        # series legitimately differ across engines-off legs
+        self.organic = {}
+        head = bytes(spec.get_head(store))
+        self.head = head
+        self.head_state_root = bytes(hash_tree_root(store.block_states[head]))
+        self.justified = (int(store.justified_checkpoint.epoch),
+                          bytes(store.justified_checkpoint.root))
+        self.finalized = (int(store.finalized_checkpoint.epoch),
+                          bytes(store.finalized_checkpoint.root))
+        self.statuses = tuple(statuses)
+        self.accepted = sum(1 for s in statuses if s == "ok")
+        self.rejected = sum(1 for s in statuses if s == "rejected")
+        self.slots = int(spec.get_current_slot(store))
+
+    def digest(self) -> dict:
+        """The replay-equality surface the harness compares across
+        legs; every field must match byte-for-byte."""
+        return {"head": self.head.hex(),
+                "head_state_root": self.head_state_root.hex(),
+                "justified": [self.justified[0], self.justified[1].hex()],
+                "finalized": [self.finalized[0], self.finalized[1].hex()],
+                "statuses": list(self.statuses)}
+
+
+# spec invalidity surface (reference context.py:299-310): these mean
+# "the store rejected adversarial input", never "the driver broke"
+_REJECTED = (AssertionError, IndexError, KeyError, ValueError)
+
+_GENESIS_CACHE = {}     # (id(spec), n) -> serialized genesis state
+
+
+def genesis_state(spec, n_validators: int):
+    from consensus_specs_tpu.utils.ssz import serialize, deserialize
+    key = (id(spec), n_validators)
+    blob = _GENESIS_CACHE.get(key)
+    if blob is None:
+        state = create_genesis_state(
+            spec, [spec.MAX_EFFECTIVE_BALANCE] * n_validators,
+            spec.MAX_EFFECTIVE_BALANCE)
+        blob = serialize(state)
+        _GENESIS_CACHE[key] = blob
+    return deserialize(spec.BeaconState, blob)
+
+
+class ChainSim:
+    """One scripted store execution (see module docstring)."""
+
+    def __init__(self, spec, n_validators: int, test_steps=None):
+        self.spec = spec
+        self.test_steps = test_steps
+        state = genesis_state(spec, n_validators)
+        self.store, anchor_block = \
+            get_genesis_forkchoice_store_and_block(spec, state)
+        self.anchor_root = bytes(hash_tree_root(anchor_block))
+        self.tips = {"genesis": self.anchor_root}
+        self.offline = set()
+        self.att_queue = []         # (deliverable_at_slot, attestation)
+        self.pending_blocks = []    # (deliver_at_slot, signed, set_label)
+        self.evidence = []          # queued AttesterSlashing objects
+        self.proposer_evidence = []     # queued ProposerSlashing objects
+        self._headers = {}          # (slot, proposer) -> SignedBeaconBlockHeader
+        self.statuses = []
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _slot(self) -> int:
+        return int(self.spec.get_current_slot(self.store))
+
+    def _resolve_tip(self, label) -> bytes:
+        if label == "head" or label is None:
+            return bytes(self.spec.get_head(self.store))
+        return self.tips.get(label, self.anchor_root)
+
+    def _participants(self, committee, slot, frac):
+        return set(i for i in committee
+                   if int(i) not in self.offline
+                   and _keep(int(i), slot, frac))
+
+    def _note(self, status):
+        self.statuses.append(status)
+
+    def _checks(self):
+        if self.test_steps is not None:
+            output_store_checks(self.spec, self.store, self.test_steps)
+
+    # -- delivery -----------------------------------------------------------
+
+    def _deliver_block(self, signed, set_label):
+        spec, store = self.spec, self.store
+        root = bytes(hash_tree_root(signed.message))
+        if self.test_steps is not None:
+            emit_part("block_0x" + root.hex(), signed)
+        try:
+            spec.on_block(store, signed)
+        except _REJECTED:
+            if self.test_steps is not None:
+                self.test_steps.append(
+                    {"block": "block_0x" + root.hex(), "valid": False})
+            self._note("rejected")
+            return
+        # receiving a block implies its attestations + slashings
+        # (test_infra/fork_choice.add_block)
+        for attestation in signed.message.body.attestations:
+            try:
+                spec.on_attestation(store, attestation, is_from_block=True)
+            except _REJECTED:
+                pass
+        for slashing in signed.message.body.attester_slashings:
+            try:
+                spec.on_attester_slashing(store, slashing)
+            except _REJECTED:
+                pass
+        if set_label:
+            self.tips[set_label] = root
+        if self.test_steps is not None:
+            self.test_steps.append({"block": "block_0x" + root.hex()})
+        self._checks()
+        self._note("ok")
+
+    def _deliver_attestation(self, attestation):
+        spec, store = self.spec, self.store
+        if self.test_steps is not None:
+            att_root = hash_tree_root(attestation)
+            emit_part("attestation_0x" + att_root.hex(), attestation)
+        try:
+            spec.on_attestation(store, attestation, is_from_block=False)
+        except _REJECTED:
+            if self.test_steps is not None:
+                self.test_steps.append(
+                    {"attestation": "attestation_0x" + att_root.hex(),
+                     "valid": False})
+            self._note("rejected")
+            return
+        if self.test_steps is not None:
+            self.test_steps.append(
+                {"attestation": "attestation_0x" + att_root.hex()})
+        self._note("ok")
+
+    def _drain_due(self):
+        slot = self._slot()
+        due = [p for p in self.pending_blocks if p[0] <= slot]
+        self.pending_blocks = [p for p in self.pending_blocks if p[0] > slot]
+        for _, signed, set_label in due:
+            self._deliver_block(signed, set_label)
+        deliverable = [a for a in self.att_queue if a[0] <= slot]
+        self.att_queue = [a for a in self.att_queue if a[0] > slot]
+        for _, attestation in deliverable:
+            self._deliver_attestation(attestation)
+
+    def _record_header(self, signed):
+        """Track one signed header per (slot, proposer); a second,
+        different one is proposer equivocation — queue the slashing."""
+        spec = self.spec
+        block = signed.message
+        header = spec.SignedBeaconBlockHeader(
+            message=spec.BeaconBlockHeader(
+                slot=block.slot, proposer_index=block.proposer_index,
+                parent_root=block.parent_root, state_root=block.state_root,
+                body_root=hash_tree_root(block.body)),
+            signature=signed.signature)
+        key = (int(block.slot), int(block.proposer_index))
+        prior = self._headers.get(key)
+        if prior is None:
+            self._headers[key] = header
+        elif bytes(hash_tree_root(prior.message)) \
+                != bytes(hash_tree_root(header.message)):
+            self.proposer_evidence.append(spec.ProposerSlashing(
+                signed_header_1=prior, signed_header_2=header))
+
+    # -- builders -----------------------------------------------------------
+
+    def _state_at(self, parent_root, slot):
+        """The parent's post-state advanced to ``slot`` (a copy)."""
+        state = self.store.block_states[parent_root].copy()
+        if state.slot < slot:
+            self.spec.process_slots(state, slot)
+        return state
+
+    def _block_attestations(self, parent_root, block_slot, att_slots, frac):
+        """Attestations for the chain of ``parent_root`` covering the
+        ``att_slots`` slots before ``block_slot``, thinned to ``frac``
+        minus the offline set — the FFG fuel a block carries."""
+        spec = self.spec
+        out = []
+        state = self._state_at(parent_root, block_slot)
+        lo = max(1, block_slot - att_slots,
+                 block_slot - int(spec.SLOTS_PER_EPOCH) + 1)
+        for s in range(lo, block_slot):
+            committees = spec.get_committee_count_per_slot(
+                state, spec.compute_epoch_at_slot(s))
+            for index in range(committees):
+                try:
+                    att = get_valid_attestation(
+                        spec, state, s, index=index,
+                        filter_participant_set=lambda c: self._participants(
+                            c, s, frac),
+                        signed=False)
+                except _REJECTED:
+                    continue
+                if any(att.aggregation_bits):
+                    if bls.bls_active:
+                        sign_attestation(spec, state, att)
+                    out.append(att)
+        return out
+
+    def _build_block(self, step):
+        spec = self.spec
+        parent_root = self._resolve_tip(step.get("tip"))
+        parent_state = self.store.block_states[parent_root]
+        block_slot = max(self._slot(), int(parent_state.slot) + 1)
+        state = self.store.block_states[parent_root].copy()
+        block = build_empty_block(spec, state, slot=block_slot)
+        graffiti = step.get("graffiti")
+        if graffiti:
+            block.body.graffiti = int(graffiti).to_bytes(32, "little")
+        att_slots = int(step.get("att_slots", 0))
+        frac = float(step.get("frac", 1.0))
+        if att_slots:
+            for att in self._block_attestations(
+                    parent_root, block_slot, att_slots, frac):
+                if len(block.body.attestations) \
+                        < int(spec.MAX_ATTESTATIONS):
+                    block.body.attestations.append(att)
+        exits = step.get("exits") or []
+        if exits:
+            exit_state = self._state_at(parent_root, block_slot)
+            eligible = [
+                i for i in exits
+                if i < len(exit_state.validators)
+                and exit_state.validators[i].exit_epoch
+                == spec.FAR_FUTURE_EPOCH]
+            if eligible:
+                block.body.voluntary_exits = prepare_signed_exits(
+                    spec, exit_state,
+                    eligible[:int(spec.MAX_VOLUNTARY_EXITS)])
+        if step.get("include_evidence"):
+            n = int(spec.MAX_ATTESTER_SLASHINGS)
+            take, self.evidence = self.evidence[:n], self.evidence[n:]
+            for ev in take:
+                block.body.attester_slashings.append(ev)
+            ep = spec.compute_epoch_at_slot(block_slot)
+            vstate = self._state_at(parent_root, block_slot)
+            keep, left = [], []
+            for ev in self.proposer_evidence:
+                idx = int(ev.signed_header_1.message.proposer_index)
+                target = keep if (
+                    len(keep) < int(spec.MAX_PROPOSER_SLASHINGS)
+                    and idx < len(vstate.validators)
+                    and spec.is_slashable_validator(
+                        vstate.validators[idx], ep)) else left
+                target.append(ev)
+            self.proposer_evidence = left
+            for ev in keep:
+                block.body.proposer_slashings.append(ev)
+        return state_transition_and_sign_block(spec, state, block)
+
+    # -- step handlers ------------------------------------------------------
+
+    def _op_tick(self, step):
+        spec, store = self.spec, self.store
+        interval = int(step.get("interval", 0))
+        seconds = int(spec.config.SECONDS_PER_SLOT)
+        time = (store.genesis_time + (self._slot() + 1) * seconds
+                + interval * (seconds // 3))
+        spec.on_tick(store, time)
+        if self.test_steps is not None:
+            self.test_steps.append({"tick": int(time)})
+        self._checks()
+        self._note("ok")
+        self._drain_due()
+
+    def _op_block(self, step):
+        try:
+            signed = self._build_block(step)
+        except _REJECTED:
+            # the scenario asked for an unbuildable block (e.g. a slot
+            # already occupied after shrinking): that IS a rejection
+            self._note("rejected")
+            return
+        self._record_header(signed)
+        delay = int(step.get("delay", 0))
+        if delay > 0:
+            self.pending_blocks.append(
+                (self._slot() + delay, signed, step.get("set")))
+            self._note("withheld")
+            return
+        self._deliver_block(signed, step.get("set"))
+
+    def _attest_tip(self, tip_label, frac):
+        spec = self.spec
+        root = self._resolve_tip(tip_label)
+        block = self.store.blocks.get(root)
+        if block is None:
+            self._note("rejected")
+            return None
+        slot = int(block.slot)
+        state = self.store.block_states[root]
+        out = []
+        try:
+            committees = spec.get_committee_count_per_slot(
+                state, spec.compute_epoch_at_slot(slot))
+            for index in range(committees):
+                att = get_valid_attestation(
+                    spec, state, slot, index=index,
+                    beacon_block_root=root,
+                    filter_participant_set=lambda c: self._participants(
+                        c, slot, frac),
+                    signed=False)
+                if any(att.aggregation_bits):
+                    if bls.bls_active:
+                        sign_attestation(spec, state, att)
+                    out.append(att)
+        except _REJECTED:
+            self._note("rejected")
+            return None
+        return slot, out
+
+    def _op_attest(self, step):
+        built = self._attest_tip(step.get("tip"), float(step.get("frac", 1.0)))
+        if built is None:
+            return
+        slot, atts = built
+        for att in atts:
+            self.att_queue.append((slot + 1, att))
+        self._note("ok")
+
+    def _op_double_vote(self, step):
+        """Same participants attest two conflicting tips: slashable
+        double vote.  Wires both attestations and queues the evidence."""
+        spec = self.spec
+        frac = float(step.get("frac", 0.2))
+        built_a = self._attest_tip(step.get("tip_a"), frac)
+        built_b = self._attest_tip(step.get("tip_b"), frac)
+        if built_a is None or built_b is None:
+            return
+        slot_a, atts_a = built_a
+        slot_b, atts_b = built_b
+        for slot, atts in ((slot_a, atts_a), (slot_b, atts_b)):
+            for att in atts:
+                self.att_queue.append((slot + 1, att))
+        if atts_a and atts_b:
+            att1, att2 = atts_a[0], atts_b[0]
+            state_a = self.store.block_states[
+                bytes(att1.data.beacon_block_root)]
+            indexed_1 = spec.get_indexed_attestation(state_a, att1)
+            state_b = self.store.block_states[
+                bytes(att2.data.beacon_block_root)]
+            indexed_2 = spec.get_indexed_attestation(state_b, att2)
+            if spec.is_slashable_attestation_data(att1.data, att2.data) \
+                    and set(map(int, indexed_1.attesting_indices)) \
+                    & set(map(int, indexed_2.attesting_indices)):
+                self.evidence.append(spec.AttesterSlashing(
+                    attestation_1=indexed_1, attestation_2=indexed_2))
+        self._note("ok")
+
+    def _op_attester_slashing(self, step):
+        if not self.evidence:
+            self._note("rejected")
+            return
+        ev = self.evidence.pop(0)
+        if self.test_steps is not None:
+            ev_root = hash_tree_root(ev)
+            emit_part("attester_slashing_0x" + ev_root.hex(), ev)
+        try:
+            self.spec.on_attester_slashing(self.store, ev)
+        except _REJECTED:
+            self._note("rejected")
+            return
+        if self.test_steps is not None:
+            self.test_steps.append(
+                {"attester_slashing": "attester_slashing_0x" + ev_root.hex()})
+        self._note("ok")
+
+    def _op_offline(self, step):
+        self.offline.update(int(i) for i in step.get("indices", ()))
+        self._note("ok")
+
+    def _op_online(self, step):
+        self.offline.difference_update(
+            int(i) for i in step.get("indices", ()))
+        self._note("ok")
+
+    def _op_checks(self, step):
+        self._checks()
+        self._note("ok")
+
+    _OPS = {"tick": _op_tick, "block": _op_block, "attest": _op_attest,
+            "double_vote": _op_double_vote,
+            "attester_slashing": _op_attester_slashing,
+            "offline": _op_offline, "online": _op_online,
+            "checks": _op_checks}
+
+    def run(self, script) -> SimResult:
+        for step in script:
+            handler = self._OPS.get(step.get("op"))
+            if handler is None:
+                self._note("rejected")      # unknown op: wire garbage
+                continue
+            handler(self, step)
+        return SimResult(self.spec, self.store, self.statuses)
+
+
+def execute(spec, script, n_validators=None, test_steps=None) -> SimResult:
+    """Run ``script`` against a fresh genesis store and return its
+    :class:`SimResult`.  ``n_validators`` defaults to the shape the
+    scenario builders target (8 per slot of an epoch)."""
+    if n_validators is None:
+        n_validators = int(spec.SLOTS_PER_EPOCH) * 8
+    sim = ChainSim(spec, n_validators, test_steps=test_steps)
+    return sim.run(script)

@@ -1,0 +1,284 @@
+"""Fault-injection harness: prove every fallback path under load.
+
+One scenario seed turns into several *legs*, all replaying the identical
+script (``sim/driver.py`` holds no RNG):
+
+baseline
+    Engines on, a trigger-less :func:`faults.observing` schedule armed.
+    Produces the reference digest AND the per-site call census — which
+    engine entry points this scenario actually reaches, and how often.
+injected (one leg per sampled site)
+    A :class:`faults.FaultSchedule` arms one (site, ordinal) trigger
+    drawn from the baseline census.  The leg must (a) complete — the
+    spec-shaped fallback absorbs the fault, (b) discharge the schedule
+    exactly (the fault really fired), (c) increment the engine's
+    ``reason=injected`` fallback counter by exactly the fired count and
+    the ``reason=guard``/organic series by zero extra — a fallback that
+    ran without counting is a *silent* fallback, the failure mode this
+    harness exists to catch — and (d) produce a digest byte-identical
+    to the baseline.
+storm
+    Ordinal-1 triggers at every site the census saw: every engine
+    falls back at first touch, all in one run.  First calls happen
+    regardless of cross-site interference, so the schedule still
+    discharges deterministically.
+spec differential (sampled)
+    The same script with every engine switched off (``CS_TPU_*=0``
+    via their live env re-read) — the pure spec-loop chain must match
+    the engines-on digest byte-for-byte.
+
+Any leg failure dumps a repro artifact (seed + step trace + env
+snapshot, ``sim/repro.py``) with the script pre-minimized by the step
+shrinker before reporting.
+"""
+import os
+
+from consensus_specs_tpu import faults
+from consensus_specs_tpu.sim import driver
+from consensus_specs_tpu.test_infra.metrics import counting
+
+# engine-off env for the spec differential leg: every switch re-reads
+# its variable at call time (utils/env_flags.py documents each)
+ENGINES_OFF = {
+    "CS_TPU_VECTORIZED_EPOCH": "0",
+    "CS_TPU_PROTO_ARRAY": "0",
+    "CS_TPU_STATE_ARRAYS": "0",
+    "CS_TPU_BLS_RLC": "0",
+}
+
+# site -> the reason-labeled counter key its handler must bump.  The
+# schedule-vs-counter cross-check below is what makes a fallback
+# "counted": faults.count_fallback routes every injected trip here.
+SITE_COUNTER = {
+    "epoch.rewards_and_penalties": "epoch.fallbacks{reason=injected}",
+    "epoch.inactivity_updates": "epoch.fallbacks{reason=injected}",
+    "epoch.registry_updates": "epoch.fallbacks{reason=injected}",
+    "epoch.slashings": "epoch.fallbacks{reason=injected}",
+    "epoch.effective_balance_updates":
+        "epoch.fallbacks{reason=injected}",
+    "forkchoice.head": "forkchoice.fallbacks{reason=injected}",
+    "forkchoice.weight": "forkchoice.fallbacks{reason=injected}",
+    "forkchoice.filtered_tree": "forkchoice.fallbacks{reason=injected}",
+    "merkle.dispatch": "merkle.fallbacks{reason=injected}",
+    "state_arrays.commit": "state_arrays.fallbacks{reason=injected}",
+    "bls.flush": "bls.flush{path=fallback,reason=injected}",
+}
+assert set(SITE_COUNTER) == set(faults.SITES)
+
+# organic twins that must NOT move when a fault is injected (an
+# injected trip miscounted as organic would hide in the guard noise)
+ORGANIC_TWIN = {
+    "epoch.fallbacks{reason=injected}": "epoch.fallbacks{reason=guard}",
+    "forkchoice.fallbacks{reason=injected}":
+        "forkchoice.fallbacks{reason=guard}",
+    "bls.flush{path=fallback,reason=injected}":
+        "bls.flush{path=fallback,reason=bisect}",
+}
+
+
+class LegFailure(AssertionError):
+    """One harness leg violated its contract; carries repro context.
+    ``category`` is the machine tag the step shrinker matches on —
+    a reduced script "reproduces" only if it fails the same way:
+    ``no-discharge`` (the schedule never fired), ``silent-fallback``
+    (fired but uncounted), ``organic-leak`` (counted under the organic
+    reason), ``diverged`` (digest mismatch), ``crashed`` (the leg threw
+    outside the exception-as-invalidity net — contained by the sweep,
+    never shrunk)."""
+
+    def __init__(self, kind, scenario, message, schedule=None,
+                 category="diverged"):
+        super().__init__(f"{scenario.describe()} {kind}: {message}")
+        self.kind = kind
+        self.scenario = scenario
+        self.schedule = schedule
+        self.category = category
+
+
+def run_leg(spec, scenario, schedule=None, env=None):
+    """Execute the scenario once.  Arms ``schedule`` (if any), applies
+    ``env`` overrides for the duration, returns the SimResult."""
+    from consensus_specs_tpu.utils import bls
+    # every leg replays cold: the process-global bls_verify memo would
+    # otherwise answer a replay's signature checks before they enqueue,
+    # so the second leg's flushes go empty and the bls.flush site (and
+    # its scheduled faults) silently disappear from the replay
+    bls.clear_verify_memo()
+    saved = {}
+    for k, v in (env or {}).items():
+        saved[k] = os.environ.get(k)
+        os.environ[k] = v
+    try:
+        if schedule is not None:
+            with faults.injected(schedule):
+                return driver.execute(spec, scenario.script,
+                                      scenario.n_validators)
+        return driver.execute(spec, scenario.script, scenario.n_validators)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def run_baseline(spec, scenario):
+    """Engines-on reference leg; returns (result, site census).  The
+    result also records the scenario's OWN organic fallback counts
+    (``result.organic``): a scenario that organically trips a guard
+    trips it identically in every replay of the same script, so the
+    injected legs' organic-leak check is baseline-relative — absolute
+    zero would fail every injected leg of such a scenario."""
+    observer = faults.observing()
+    with counting() as delta:
+        result = run_leg(spec, scenario, schedule=observer)
+    result.organic = {key: delta[key]
+                      for key in set(ORGANIC_TWIN.values())}
+    return result, dict(observer.calls)
+
+
+def draw_injections(rng, census, max_sites=None):
+    """(site, ordinal) triggers from the observed census: every
+    exercised site gets one, at a seed-drawn ordinal."""
+    sites = [s for s in faults.SITES if census.get(s, 0) > 0]
+    if max_sites is not None and len(sites) > max_sites:
+        sites = rng.sample(sites, max_sites)
+    return [(site, rng.randint(1, census[site])) for site in sites]
+
+
+def run_injected(spec, scenario, baseline, site, ordinal):
+    """One single-trigger injected leg; raises LegFailure on any
+    contract violation."""
+    schedule = faults.FaultSchedule({site: [ordinal]})
+    counter_key = SITE_COUNTER[site]
+    twin_key = ORGANIC_TWIN.get(counter_key)
+    # the shared counter-delta helper the differential suites use —
+    # its keys are the registry's own series rendering, so the
+    # silent-fallback cross-check can never drift from the registry
+    with counting() as delta:
+        result = run_leg(spec, scenario, schedule=schedule)
+    kind = f"inject[{site}@{ordinal}]"
+    if not schedule.fully_fired():
+        raise LegFailure(
+            kind, scenario, f"schedule did not discharge: planned "
+            f"{schedule.planned}, fired {len(schedule.fired)} "
+            f"(site called {schedule.calls.get(site, 0)}x)", schedule,
+            category="no-discharge")
+    counted = delta[counter_key]
+    if counted != len(schedule.fired):
+        raise LegFailure(
+            kind, scenario, f"SILENT FALLBACK: {len(schedule.fired)} "
+            f"injected fault(s) fired but {counter_key} moved by "
+            f"{counted}", schedule, category="silent-fallback")
+    if twin_key is not None:
+        organic_base = baseline.organic.get(twin_key, 0)
+        if delta[twin_key] != organic_base:
+            raise LegFailure(
+                kind, scenario, f"injected fault leaked into the organic "
+                f"series {twin_key} ({delta[twin_key]} vs {organic_base} "
+                f"in the uninjected replay)",
+                schedule, category="organic-leak")
+    if result.digest() != baseline.digest():
+        raise LegFailure(
+            kind, scenario, "fallback diverged from the uninjected "
+            "replay: " + _digest_diff(baseline, result), schedule,
+            category="diverged")
+    return result
+
+
+def run_storm(spec, scenario, baseline, census):
+    """Ordinal-1 triggers at every exercised site in one run."""
+    sites = [s for s in faults.SITES if census.get(s, 0) > 0]
+    schedule = faults.FaultSchedule({s: [1] for s in sites})
+    with counting() as delta:
+        result = run_leg(spec, scenario, schedule=schedule)
+    if not schedule.fully_fired():
+        missing = sorted(set(sites)
+                         - {site for site, _ in schedule.fired})
+        raise LegFailure("storm", scenario,
+                         f"first-call triggers never fired at {missing}",
+                         schedule, category="no-discharge")
+    from collections import Counter
+    fired_per_key = Counter(SITE_COUNTER[s] for s, _ in schedule.fired)
+    for key, fired in sorted(fired_per_key.items()):
+        counted = delta[key]
+        if counted != fired:
+            raise LegFailure(
+                "storm", scenario, f"SILENT FALLBACK: {fired} fired at "
+                f"{key} sites but the counter moved by {counted}",
+                schedule, category="silent-fallback")
+    if result.digest() != baseline.digest():
+        raise LegFailure("storm", scenario,
+                         "storm run diverged from the uninjected replay: "
+                         + _digest_diff(baseline, result), schedule,
+                         category="diverged")
+    return result
+
+
+def run_spec_differential(spec, scenario, baseline):
+    """Engines-off replay (CS_TPU_*=0) must match byte-for-byte."""
+    result = run_leg(spec, scenario, env=ENGINES_OFF)
+    if result.digest() != baseline.digest():
+        raise LegFailure("spec-differential", scenario,
+                         "spec-loop chain diverged from engines-on: "
+                         + _digest_diff(baseline, result))
+    return result
+
+
+def _rerun_failing_leg(spec, scenario, failure):
+    """Re-execute the leg that produced ``failure`` against (a possibly
+    reduced copy of) ``scenario``; re-raises LegFailure on repro."""
+    baseline, census = run_baseline(spec, scenario)
+    if failure.kind == "spec-differential":
+        run_spec_differential(spec, scenario, baseline)
+    elif failure.kind == "storm":
+        run_storm(spec, scenario, baseline, census)
+    else:
+        # a single-trigger injected leg: the schedule holds the trigger
+        ((site, ns),) = failure.schedule.triggers.items()
+        (ordinal,) = ns
+        run_injected(spec, scenario, baseline, site, ordinal)
+
+
+def minimize_failure(spec, failure, budget=60, out_dir=None, fork=None,
+                     preset=None):
+    """Shrink the failing scenario's script to a near-minimal script
+    that still fails the same way (same leg, same ``category``), dump
+    the repro artifact, and return its path.  ``budget`` caps shrinker
+    replays — each predicate call re-runs the whole leg.
+    ``fork``/``preset`` are recorded in the artifact so ``repro.replay``
+    rebuilds the same spec.  The caller must hold the BLS mode the
+    failing leg ran under — the shrinker's reproduction predicate is
+    mode-sensitive."""
+    from consensus_specs_tpu.sim import repro
+    from consensus_specs_tpu.sim.scenarios import Scenario
+    scenario = failure.scenario
+
+    def reproduces(script):
+        cand = Scenario(scenario.name, scenario.seed, script,
+                        scenario.n_validators, scenario.config_overrides)
+        try:
+            _rerun_failing_leg(spec, cand, failure)
+        except LegFailure as again:
+            return again.category == failure.category
+        return False
+
+    reduced = repro.shrink_script(scenario.script, reproduces,
+                                  budget=budget)
+    return repro.dump_artifact(scenario, failure.kind, str(failure),
+                               schedule=failure.schedule, script=reduced,
+                               out_dir=out_dir, fork=fork, preset=preset)
+
+
+def _digest_diff(a, b) -> str:
+    da, db = a.digest(), b.digest()
+    parts = []
+    for k in da:
+        if da[k] != db[k]:
+            parts.append(f"{k}: {_short(da[k])} != {_short(db[k])}")
+    return "; ".join(parts) or "(digests equal?)"
+
+
+def _short(v):
+    s = str(v)
+    return s[:64] + "..." if len(s) > 64 else s

@@ -1,0 +1,208 @@
+"""Repro artifacts + the step shrinker.
+
+When a harness leg fails its contract (``sim/harness.LegFailure``), the
+sweep does not just print a seed: it re-runs the failing leg against
+systematically smaller scripts (``shrink_script``, a greedy ddmin) until
+no step can be deleted without losing the failure, then dumps a
+self-contained JSON artifact — scenario identity, the minimized script,
+the fault schedule, the failure text, and an environment snapshot — so
+the failure replays anywhere with::
+
+    python -m consensus_specs_tpu.sim.repro <artifact.json>
+
+Shrinking leans on a driver guarantee (``sim/driver.py``): deleting
+steps from a script always leaves an executable script — adversarial
+steps are allowed to be rejected, so a block whose parent-step was
+deleted simply lands elsewhere or is refused, deterministically.
+"""
+import json
+import os
+import re
+import sys
+from contextlib import contextmanager
+
+from consensus_specs_tpu.sim.scenarios import Scenario
+
+# the env surface that changes replay behavior: engine switches, batch
+# thresholds, backend picks (utils/env_flags.py documents each)
+_ENV_PREFIX = "CS_TPU_"
+
+
+def env_snapshot() -> dict:
+    from consensus_specs_tpu.utils import bls
+    snap = {k: v for k, v in sorted(os.environ.items())
+            if k.startswith(_ENV_PREFIX)}
+    snap["bls_backend"] = bls.backend_name()
+    snap["bls_active"] = bool(bls.bls_active)
+    return snap
+
+
+def shrink_script(script, reproduces, budget=200):
+    """Greedy ddmin: delete chunks of halving size while ``reproduces``
+    (a callable taking a candidate script) stays true.  ``budget`` caps
+    predicate calls — each one is a full chain replay.  Returns the
+    reduced script (the input script itself reproduces by contract, so
+    the result always does too)."""
+    calls = 0
+
+    def check(cand):
+        nonlocal calls
+        if not cand or calls >= budget:
+            return False
+        calls += 1
+        try:
+            return bool(reproduces(cand))
+        except Exception:
+            # a candidate that breaks the leg in some NEW way is not
+            # the failure being minimized
+            return False
+
+    current = list(script)
+    chunk = max(1, len(current) // 2)
+    while True:
+        removed_any = False
+        i = 0
+        while i < len(current):
+            cand = current[:i] + current[i + chunk:]
+            if check(cand):
+                current = cand
+                removed_any = True
+            else:
+                i += chunk
+        if chunk == 1:
+            if not removed_any or calls >= budget:
+                break
+        else:
+            chunk = max(1, chunk // 2)
+    return current
+
+
+def dump_artifact(scenario, kind, message, schedule=None, script=None,
+                  out_dir=None, fork=None, preset=None) -> str:
+    """Write one failure's repro artifact; returns the file path.
+    ``script`` is the (minimized) script to record — defaults to the
+    scenario's full script when shrinking was skipped or failed.
+    ``fork``/``preset`` record the spec the failure ran under so
+    :func:`replay` rebuilds the same one."""
+    out_dir = out_dir or os.environ.get("CS_TPU_SIM_ARTIFACTS",
+                                        "sim_artifacts")
+    os.makedirs(out_dir, exist_ok=True)
+    payload = {
+        "scenario": scenario.name,
+        "seed": scenario.seed,
+        "n_validators": scenario.n_validators,
+        "config_overrides": scenario.config_overrides,
+        "fork": fork,
+        "preset": preset,
+        "failure": {"kind": kind, "message": message},
+        "script": list(script if script is not None else scenario.script),
+        "original_steps": len(scenario.script),
+        "env": env_snapshot(),
+    }
+    if schedule is not None:
+        payload["schedule"] = {
+            "triggers": {site: sorted(ns)
+                         for site, ns in schedule.triggers.items()},
+            "fired": [[site, n] for site, n in schedule.fired],
+        }
+    # the leg kind is part of the name: one seed can fail several legs
+    # in one sweep round (injected sites, storm, spec-diff) and each
+    # failure must keep its own artifact
+    slug = re.sub(r"[^A-Za-z0-9.@-]+", "-", kind).strip("-")
+    path = os.path.join(
+        out_dir, f"repro_{scenario.name}_seed{scenario.seed}_{slug}.json")
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+    return path
+
+
+def load_artifact(path: str):
+    """(Scenario, triggers-or-None, payload) from a dumped artifact."""
+    with open(path) as f:
+        payload = json.load(f)
+    scenario = Scenario(
+        payload["scenario"], payload["seed"], payload["script"],
+        payload["n_validators"], payload.get("config_overrides"))
+    triggers = None
+    sched = payload.get("schedule")
+    if sched:
+        triggers = {site: list(ns)
+                    for site, ns in sched["triggers"].items()}
+    return scenario, triggers, payload
+
+
+@contextmanager
+def _applied_env(snap: dict):
+    """Re-create the artifact's recorded replay context: the `CS_TPU_*`
+    switches and the BLS mode/backend.  Without this, a failure from an
+    engines-off or real-signature leg silently 'does not reproduce' in
+    a default shell — the snapshot IS the failing context."""
+    from consensus_specs_tpu.utils import bls
+    saved = {}
+    for k, v in snap.items():
+        if k.startswith(_ENV_PREFIX):
+            saved[k] = os.environ.get(k)
+            os.environ[k] = str(v)
+    old_active, old_backend = bls.bls_active, bls.backend_name()
+    if "bls_active" in snap:
+        bls.bls_active = bool(snap["bls_active"])
+    backend = snap.get("bls_backend")
+    if backend:
+        getattr(bls, f"use_{backend}", bls.use_py)()
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        bls.bls_active = old_active
+        getattr(bls, f"use_{old_backend}", bls.use_py)()
+
+
+def replay(path: str, fork: str = None, preset: str = None) -> int:
+    """Re-run an artifact's failing leg under the artifact's recorded
+    spec (fork/preset) and environment snapshot; returns a process exit
+    code (0 = the failure no longer reproduces).  Explicit
+    ``fork``/``preset`` arguments override the recorded ones."""
+    from consensus_specs_tpu.forks import build_spec
+    from consensus_specs_tpu.sim import harness
+
+    scenario, triggers, payload = load_artifact(path)
+    fork = fork or payload.get("fork") or "phase0"
+    preset = preset or payload.get("preset") or "minimal"
+    kind = (payload.get("failure") or {}).get("kind", "")
+    spec = build_spec(fork, preset, scenario.config_overrides)
+    print(f"replaying {scenario.describe()} under {fork}/{preset} "
+          f"(triggers={triggers or 'none'})")
+    with _applied_env(payload.get("env") or {}):
+        baseline, census = harness.run_baseline(spec, scenario)
+        print(f"baseline: head={baseline.digest()['head'][:16]}... "
+              f"finalized_epoch={baseline.finalized[0]}")
+        try:
+            if kind == "storm":
+                # every recorded site falls back in ONE run — a failure
+                # born from cross-site interaction only reproduces with
+                # the full storm armed, not trigger-by-trigger
+                harness.run_storm(spec, scenario, baseline, census)
+            elif not triggers:
+                harness.run_spec_differential(spec, scenario, baseline)
+            else:
+                for site, ns in triggers.items():
+                    for n in ns:
+                        harness.run_injected(spec, scenario, baseline,
+                                             site, n)
+        except harness.LegFailure as fail:
+            print(f"REPRODUCED: {fail}")
+            return 1
+    print(f"{kind or 'leg'} clean — failure did not reproduce")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 2:
+        print("usage: python -m consensus_specs_tpu.sim.repro "
+              "<artifact.json> [fork] [preset]", file=sys.stderr)
+        sys.exit(2)
+    sys.exit(replay(sys.argv[1], *sys.argv[2:4]))

@@ -1,0 +1,305 @@
+"""Seeded adversarial scenario catalog.
+
+Each builder composes the driver's step vocabulary (``sim/driver.py``)
+into one hostile storyline; :func:`build` is the seed-indexed entry
+point the sweep uses.  All randomness is drawn from ``random.Random(
+seed)`` **at build time** and baked into the script — the script itself
+is pure data, so every leg of the harness (engines on/off, fault
+injection, shrinker re-runs) replays the identical event stream.
+
+The catalog mirrors the hostile behaviors the reference corpus probes
+one-at-a-time, but composed and sustained over multi-epoch horizons:
+
+``steady``
+    The control group: full participation, finality marching — the
+    chain every other scenario deviates from.
+``equivocation``
+    Proposers equivocate (two signed siblings per slot); attesters
+    double-vote across the siblings.  Evidence is included in later
+    bodies on some seeds and withheld on others.
+``exante_reorg``
+    The classic ex-ante attack: a proposer withholds its block, an
+    honest block lands timely on the old head and earns proposer
+    boost, the withheld block is released late and must lose.
+``balancing``
+    Sustained balancing attempt: two sibling tips kept weight-equal by
+    alternating split attestation streams while blocks extend both —
+    the head flip-flops, stressing incremental weight maintenance.
+``inactivity_leak``
+    30-45% of validators go offline past the leak threshold, the leak
+    bleeds them, they return, and the chain recovers to finality.
+``exit_churn``
+    Voluntary exits queued at the per-epoch churn limit every epoch
+    (plus slashing ejections), stressing registry updates under load.
+    Uses a ``SHARD_COMMITTEE_PERIOD`` override so exits are eligible
+    within a sim-scale warmup.
+``deep_nonfinality``
+    Participation pinned below 2/3 for many epochs while side forks
+    sprout — justification stalls, proto-array grows unpruned — then
+    full participation returns and finalization snaps forward through
+    one big prune.
+"""
+from random import Random
+
+
+class Scenario:
+    """A built scenario: pure-data script + the spec shape it needs."""
+
+    __slots__ = ("name", "seed", "script", "n_validators",
+                 "config_overrides")
+
+    def __init__(self, name, seed, script, n_validators,
+                 config_overrides=None):
+        self.name = name
+        self.seed = seed
+        self.script = script
+        self.n_validators = n_validators
+        self.config_overrides = config_overrides
+
+    def describe(self) -> str:
+        return f"{self.name}[seed={self.seed}, steps={len(self.script)}]"
+
+
+def _advance(script, rng, slots, att_slots=2, frac=1.0, check_every=None,
+             tip="head", set_label=None):
+    """``slots`` rounds of tick + one attested block on ``tip``."""
+    for i in range(slots):
+        script.append({"op": "tick"})
+        step = {"op": "block", "tip": tip, "att_slots": att_slots,
+                "frac": frac}
+        if set_label:
+            step["set"] = set_label
+        script.append(step)
+        if check_every and i % check_every == check_every - 1:
+            script.append({"op": "checks"})
+
+
+def steady(rng: Random, epoch: int, n_validators: int):
+    script = []
+    epochs = rng.randint(3, 5)
+    _advance(script, rng, epochs * epoch, att_slots=2, frac=1.0,
+             check_every=epoch)
+    script.append({"op": "checks"})
+    return script, None
+
+
+def equivocation(rng: Random, epoch: int, n_validators: int):
+    script = []
+    include = rng.random() < 0.6     # vs withholding the evidence
+    _advance(script, rng, epoch, att_slots=2, frac=1.0)
+    epochs = rng.randint(2, 4)
+    for _ in range(epochs * epoch):
+        script.append({"op": "tick"})
+        if rng.random() < 0.3:
+            # proposer equivocation: two siblings on one parent (the
+            # same slot + proposer, different graffiti), votes split
+            script.append({"op": "block", "tip": "head", "set": "fork_base",
+                           "att_slots": 1, "frac": 1.0})
+            script.append({"op": "tick"})
+            g = rng.randrange(1 << 30)
+            script.append({"op": "block", "tip": "fork_base", "set": "sib_a",
+                           "att_slots": 1, "frac": 0.8, "graffiti": g})
+            script.append({"op": "block", "tip": "fork_base", "set": "sib_b",
+                           "att_slots": 1, "frac": 0.8, "graffiti": g + 1})
+            script.append({"op": "double_vote", "tip_a": "sib_a",
+                           "tip_b": "sib_b", "frac": rng.uniform(0.1, 0.3)})
+            if rng.random() < 0.5:
+                script.append({"op": "attester_slashing"})
+        else:
+            script.append({"op": "block", "tip": "head", "att_slots": 2,
+                           "frac": 1.0,
+                           "include_evidence": include and rng.random() < 0.5})
+    script.append({"op": "checks"})
+    return script, None
+
+
+def exante_reorg(rng: Random, epoch: int, n_validators: int):
+    script = []
+    _advance(script, rng, epoch, att_slots=2, frac=1.0)
+    epochs = rng.randint(2, 4)
+    for _ in range(epochs):
+        for _ in range(epoch - 2):
+            script.append({"op": "tick"})
+            script.append({"op": "block", "tip": "head", "att_slots": 2,
+                           "frac": 1.0})
+        # the attack window: attacker withholds, honest lands timely
+        script.append({"op": "tick"})
+        script.append({"op": "block", "tip": "head", "set": "honest_base",
+                       "att_slots": 2, "frac": 1.0})
+        script.append({"op": "block", "tip": "honest_base", "set": "atk",
+                       "delay": rng.randint(1, 2), "att_slots": 1,
+                       "frac": rng.uniform(0.2, 0.5),
+                       "graffiti": rng.randrange(1 << 30)})
+        script.append({"op": "tick"})
+        # honest proposer never saw the withheld block; boost is theirs
+        script.append({"op": "block", "tip": "honest_base", "att_slots": 2,
+                       "frac": 1.0, "graffiti": rng.randrange(1 << 30)})
+        script.append({"op": "attest", "tip": "head", "frac": 0.9})
+        script.append({"op": "checks"})
+    script.append({"op": "checks"})
+    return script, None
+
+
+def balancing(rng: Random, epoch: int, n_validators: int):
+    script = []
+    _advance(script, rng, epoch, att_slots=2, frac=1.0)
+    script.append({"op": "tick"})
+    script.append({"op": "block", "tip": "head", "set": "split",
+                   "att_slots": 1, "frac": 1.0})
+    script.append({"op": "tick"})
+    g = rng.randrange(1 << 30)
+    script.append({"op": "block", "tip": "split", "set": "a",
+                   "att_slots": 1, "frac": 0.5, "graffiti": g})
+    script.append({"op": "block", "tip": "split", "set": "b",
+                   "att_slots": 1, "frac": 0.5, "graffiti": g + 1})
+    rounds = rng.randint(2, 3) * epoch
+    for i in range(rounds):
+        script.append({"op": "attest", "tip": "a" if i % 2 == 0 else "b",
+                       "frac": rng.uniform(0.35, 0.5)})
+        script.append({"op": "tick"})
+        side = "a" if i % 2 == 0 else "b"
+        script.append({"op": "block", "tip": side, "set": side,
+                       "att_slots": 1, "frac": 0.45,
+                       "graffiti": rng.randrange(1 << 30)})
+        if i % epoch == epoch - 1:
+            script.append({"op": "checks"})
+    # resolution: the network converges on whichever tip is head
+    _advance(script, rng, 2 * epoch, att_slots=3, frac=1.0,
+             check_every=epoch)
+    script.append({"op": "checks"})
+    return script, None
+
+
+def inactivity_leak(rng: Random, epoch: int, n_validators: int):
+    script = []
+    _advance(script, rng, epoch, att_slots=2, frac=1.0)
+    # strictly above 1/3 of (equal-balance) stake, or justification
+    # would keep marching and the leak never engage
+    frac_off = rng.uniform(0.36, 0.45)
+    offline = sorted(rng.sample(range(n_validators),
+                                int(n_validators * frac_off)))
+    script.append({"op": "offline", "indices": offline})
+    # ride the leak: participation < 2/3, justification stalls,
+    # MIN_EPOCHS_TO_INACTIVITY_PENALTY (4) epochs in the scores bite
+    leak_epochs = rng.randint(6, 8)
+    _advance(script, rng, leak_epochs * epoch, att_slots=2, frac=1.0,
+             check_every=epoch)
+    script.append({"op": "online", "indices": offline})
+    # recovery: full participation until finality advances again (two
+    # epochs to re-justify, two more to finalize, one of margin)
+    _advance(script, rng, 5 * epoch, att_slots=3, frac=1.0,
+             check_every=epoch)
+    script.append({"op": "checks"})
+    return script, None
+
+
+def exit_churn(rng: Random, epoch: int, n_validators: int):
+    script = []
+    # eligibility within sim horizons: exits require
+    # current_epoch >= activation_epoch + SHARD_COMMITTEE_PERIOD
+    overrides = {"SHARD_COMMITTEE_PERIOD": 2}
+    _advance(script, rng, 2 * epoch, att_slots=2, frac=1.0)
+    epochs = rng.randint(3, 5)
+    nxt = 0
+    for e in range(epochs):
+        for s in range(epoch):
+            script.append({"op": "tick"})
+            step = {"op": "block", "tip": "head", "att_slots": 2,
+                    "frac": 1.0}
+            if s == 0:
+                # churn-limit worth of exits head every epoch's first
+                # block; the spec admits churn-many, queues the rest
+                step["exits"] = list(range(nxt, min(nxt + 4,
+                                                    n_validators // 2)))
+                nxt = min(nxt + 4, n_validators // 2)
+            script.append(step)
+        if rng.random() < 0.4:
+            # slashing ejections stack extra churn on the same epochs:
+            # fork a sibling pair (double votes need genuinely
+            # conflicting data), wire the double vote, deliver the
+            # evidence to the store AND into the next body so
+            # process_attester_slashing really ejects from the registry
+            script.append({"op": "tick"})
+            script.append({"op": "block", "tip": "head",
+                           "set": "churn_base", "att_slots": 1,
+                           "frac": 1.0})
+            script.append({"op": "tick"})
+            g = rng.randrange(1 << 30)
+            script.append({"op": "block", "tip": "churn_base",
+                           "set": "churn_a", "att_slots": 1,
+                           "frac": 0.9, "graffiti": g})
+            script.append({"op": "block", "tip": "churn_base",
+                           "set": "churn_b", "att_slots": 1,
+                           "frac": 0.9, "graffiti": g + 1})
+            script.append({"op": "double_vote", "tip_a": "churn_a",
+                           "tip_b": "churn_b",
+                           "frac": rng.uniform(0.1, 0.2)})
+            script.append({"op": "tick"})
+            script.append({"op": "block", "tip": "head", "att_slots": 1,
+                           "frac": 1.0, "include_evidence": True})
+        script.append({"op": "checks"})
+    _advance(script, rng, epoch, att_slots=2, frac=1.0)
+    script.append({"op": "checks"})
+    return script, overrides
+
+
+def deep_nonfinality(rng: Random, epoch: int, n_validators: int):
+    script = []
+    _advance(script, rng, epoch, att_slots=2, frac=1.0)
+    stall_epochs = rng.randint(5, 8)
+    for e in range(stall_epochs):
+        for s in range(epoch):
+            script.append({"op": "tick"})
+            script.append({"op": "block", "tip": "head", "att_slots": 2,
+                           "frac": 0.55})
+            if rng.random() < 0.15:
+                # a side fork that never wins but never gets pruned
+                # (no finality): the proto-array keeps every node
+                script.append({"op": "block", "tip": "head",
+                               "att_slots": 1, "frac": 0.2,
+                               "graffiti": rng.randrange(1 << 30),
+                               "set": f"side_{e}_{s}"})
+        script.append({"op": "checks"})
+    # recovery: full participation, finalization snaps forward and the
+    # whole stalled backlog is pruned in one pass
+    _advance(script, rng, 4 * epoch, att_slots=3, frac=1.0,
+             check_every=epoch)
+    script.append({"op": "checks"})
+    return script, None
+
+
+# name -> (weight, builder); heavier on the scenarios that exercise
+# more machinery.  Every builder takes (rng, epoch, n_validators).
+_CATALOG = (
+    ("steady", 1, steady),
+    ("equivocation", 2, equivocation),
+    ("exante_reorg", 2, exante_reorg),
+    ("balancing", 2, balancing),
+    ("inactivity_leak", 2, inactivity_leak),
+    ("exit_churn", 1, exit_churn),
+    ("deep_nonfinality", 2, deep_nonfinality),
+)
+NAMES = tuple(name for name, _, _ in _CATALOG)
+_BUILDERS = {name: fn for name, _, fn in _CATALOG}
+
+
+def build(seed: int, epoch: int, n_validators: int,
+          name: str = None) -> Scenario:
+    """The seed-indexed catalog entry: seed picks (weighted) a scenario
+    shape and all its parameters.  ``name`` forces a specific shape
+    (same seed, same script — the forced draw consumes identical
+    entropy)."""
+    rng = Random(seed)
+    pick = rng.randrange(sum(w for _, w, _ in _CATALOG))
+    if name is None:
+        for cand, w, _ in _CATALOG:
+            if pick < w:
+                name = cand
+                break
+            pick -= w
+    builder = _BUILDERS.get(name)
+    if builder is None:
+        raise ValueError(f"unknown scenario {name!r} "
+                         f"(catalog: {', '.join(NAMES)})")
+    script, overrides = builder(rng, epoch, n_validators)
+    return Scenario(name, seed, script, n_validators, overrides)

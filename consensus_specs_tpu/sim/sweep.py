@@ -1,0 +1,237 @@
+"""Seeded adversarial sweep: the CLI behind ``make sim-smoke`` and the
+``CS_TPU_HEAVY=1`` nightly run.
+
+Per seed: build the scenario (``sim/scenarios.build`` — the seed picks
+the shape and every parameter), run the engines-on baseline under an
+observing fault schedule, then sample the expensive legs:
+
+* every ``--inject-every``-th seed runs single-trigger injected legs at
+  up to ``--max-sites`` engine sites (ordinals drawn from the baseline
+  census) plus one all-sites storm leg,
+* every ``--diff-every``-th seed replays with every engine off
+  (``CS_TPU_*=0``) and must match byte-for-byte,
+* the first ``--bls-seeds`` seeds run with real signatures on the
+  fastest available backend so the ``bls.flush`` injection site is
+  exercised (everything else runs with the BLS stub — the spec's
+  ``bls_active`` test switch — which leaves signature bytes out of the
+  digest but keeps every other engine fully loaded).
+
+Any leg contract violation (``sim/harness.LegFailure``) is minimized by
+the step shrinker and dumped as a repro artifact
+(``sim/repro.dump_artifact``); the sweep continues and exits nonzero at
+the end, printing one line per artifact.
+
+Exit contract (the ``make sim-smoke`` acceptance): at least
+``--min-scenarios`` baselines completed, every injected fault counted
+on its ``reason=injected`` series, zero silent fallbacks, zero digest
+divergences.
+"""
+import argparse
+import random
+import sys
+import time
+
+from consensus_specs_tpu import faults
+from consensus_specs_tpu.sim import harness, scenarios
+
+
+def _parse_args(argv):
+    parser = argparse.ArgumentParser(
+        prog="sim-sweep",
+        description="seeded adversarial chain sweep with fault injection")
+    parser.add_argument("--seeds", type=int, default=200,
+                        help="number of scenario seeds (default 200)")
+    parser.add_argument("--start", type=int, default=0,
+                        help="first seed (default 0)")
+    parser.add_argument("--fork", default="phase0")
+    parser.add_argument("--preset", default="minimal")
+    parser.add_argument("--inject-every", type=int, default=8,
+                        help="fault-injection legs every Nth seed")
+    parser.add_argument("--max-sites", type=int, default=4,
+                        help="injected sites sampled per injection seed")
+    parser.add_argument("--diff-every", type=int, default=10,
+                        help="engines-off differential every Nth seed")
+    parser.add_argument("--bls-seeds", type=int, default=2,
+                        help="first K seeds run with real signatures")
+    parser.add_argument("--min-scenarios", type=int, default=None,
+                        help="fail if fewer baselines complete "
+                             "(default: --seeds)")
+    parser.add_argument("--artifact-dir", default=None,
+                        help="repro artifact directory "
+                             "(default $CS_TPU_SIM_ARTIFACTS or "
+                             "sim_artifacts)")
+    parser.add_argument("--shrink-budget", type=int, default=60,
+                        help="max shrinker replays per failure")
+    parser.add_argument("--time-budget", type=float, default=None,
+                        help="soft wall-clock bound in seconds: stop "
+                             "starting new seeds past it (still fails "
+                             "below --min-scenarios)")
+    return parser.parse_args(argv)
+
+
+def _crashed_leg(kind, scenario, exc, schedule=None):
+    """Contain a non-contract crash inside one harness leg as a
+    recorded failure (category ``crashed`` — dumped with its schedule,
+    never shrunk) so a driver/spec bug in one leg cannot abort the
+    sweep or discard the failures already collected.  An
+    ``InjectedFault`` is a BaseException and still escapes: that would
+    be a schedule leak."""
+    return harness.LegFailure(
+        kind, scenario, f"{type(exc).__name__}: {exc}",
+        schedule=schedule, category="crashed")
+
+
+def run_sweep(args) -> int:
+    from consensus_specs_tpu.forks import build_spec
+    from consensus_specs_tpu.utils import bls
+
+    min_scenarios = args.min_scenarios
+    if min_scenarios is None:
+        min_scenarios = args.seeds
+    stats = {"scenarios": 0, "injected_legs": 0, "storm_legs": 0,
+             "diff_legs": 0, "faults_fired": 0, "rejected_steps": 0}
+    per_shape = {}
+    failures = []       # (LegFailure, spec-or-None, with_bls)
+    artifacts = []
+    t0 = time.time()
+
+    base_spec = build_spec(args.fork, args.preset)
+    epoch = int(base_spec.SLOTS_PER_EPOCH)
+    n_validators = epoch * 8
+
+    old_active, old_backend = bls.bls_active, bls.backend_name()
+    try:
+        for seed in range(args.start, args.start + args.seeds):
+            if args.time_budget is not None \
+                    and time.time() - t0 > args.time_budget:
+                print(f"time budget hit after "
+                      f"{stats['scenarios']} scenarios")
+                break
+            with_bls = seed - args.start < args.bls_seeds
+            if with_bls:
+                bls.bls_active = True
+                bls.use_fastest()
+            else:
+                bls.bls_active = False
+            scenario = scenarios.build(seed, epoch, n_validators)
+            spec = base_spec if not scenario.config_overrides else \
+                build_spec(args.fork, args.preset,
+                           scenario.config_overrides)
+            tag = f"seed {seed:4d} {scenario.name:<17s}" \
+                  + ("[bls] " if with_bls else "      ")
+            try:
+                baseline, census = harness.run_baseline(spec, scenario)
+            except Exception as exc:
+                # a driver/spec crash outside the exception-as-
+                # invalidity net
+                fail = _crashed_leg("baseline", scenario, exc)
+                failures.append((fail, None, with_bls))
+                print(f"{tag} BASELINE FAILED: {fail}")
+                continue
+            stats["scenarios"] += 1
+            stats["rejected_steps"] += baseline.rejected
+            per_shape[scenario.name] = per_shape.get(scenario.name, 0) + 1
+            legs = []
+            if (seed - args.start) % args.inject_every == 0:
+                rng = random.Random(seed * 7919 + 1)
+                for site, ordinal in harness.draw_injections(
+                        rng, census, max_sites=args.max_sites):
+                    try:
+                        harness.run_injected(spec, scenario, baseline,
+                                             site, ordinal)
+                        stats["injected_legs"] += 1
+                        stats["faults_fired"] += 1
+                    except harness.LegFailure as fail:
+                        failures.append((fail, spec, with_bls))
+                    except Exception as exc:
+                        failures.append((_crashed_leg(
+                            f"inject[{site}@{ordinal}]", scenario, exc,
+                            faults.FaultSchedule({site: [ordinal]})),
+                            None, with_bls))
+                try:
+                    harness.run_storm(spec, scenario, baseline, census)
+                    stats["storm_legs"] += 1
+                    stats["faults_fired"] += sum(
+                        1 for s in faults.SITES if census.get(s, 0) > 0)
+                except harness.LegFailure as fail:
+                    failures.append((fail, spec, with_bls))
+                except Exception as exc:
+                    exercised = [s for s in faults.SITES
+                                 if census.get(s, 0) > 0]
+                    failures.append((_crashed_leg(
+                        "storm", scenario, exc,
+                        faults.FaultSchedule({s: [1] for s in exercised})),
+                        None, with_bls))
+                legs.append("inject+storm")
+            if (seed - args.start) % args.diff_every == 0:
+                try:
+                    harness.run_spec_differential(spec, scenario,
+                                                  baseline)
+                    stats["diff_legs"] += 1
+                except harness.LegFailure as fail:
+                    failures.append((fail, spec, with_bls))
+                except Exception as exc:
+                    failures.append((_crashed_leg(
+                        "spec-differential", scenario, exc),
+                        None, with_bls))
+                legs.append("spec-diff")
+            print(f"{tag} ok: {len(scenario.script)} steps, "
+                  f"finalized@{baseline.finalized[0]}"
+                  + (f" ({', '.join(legs)})" if legs else ""))
+        # minimize INSIDE the mode scope: each failure's shrink
+        # replays must run under the BLS mode its leg failed in, or a
+        # mode-sensitive failure stops reproducing (and a stub-seed
+        # failure would shrink at real-signature cost)
+        if failures:
+            print(f"\n{len(failures)} LEG FAILURE(S); minimizing:")
+            for fail, spec, fail_bls in failures:
+                bls.bls_active = fail_bls
+                if fail_bls:
+                    bls.use_fastest()
+                if spec is not None:
+                    path = harness.minimize_failure(
+                        spec, fail, budget=args.shrink_budget,
+                        out_dir=args.artifact_dir, fork=args.fork,
+                        preset=args.preset)
+                else:
+                    from consensus_specs_tpu.sim import repro
+                    path = repro.dump_artifact(
+                        fail.scenario, fail.kind, str(fail),
+                        schedule=fail.schedule,
+                        out_dir=args.artifact_dir, fork=args.fork,
+                        preset=args.preset)
+                artifacts.append((fail, path))
+    finally:
+        bls.bls_active = old_active
+        getattr(bls, f"use_{old_backend}", bls.use_py)()
+
+    print(f"\nsweep: {stats['scenarios']} scenarios "
+          f"({', '.join(f'{k}={v}' for k, v in sorted(per_shape.items()))}) "
+          f"in {time.time() - t0:.0f}s")
+    print(f"legs: {stats['injected_legs']} injected + "
+          f"{stats['storm_legs']} storm ({stats['faults_fired']} faults "
+          f"fired, all counted) + {stats['diff_legs']} spec-differential; "
+          f"{stats['rejected_steps']} adversarial steps rejected")
+
+    code = 0
+    if artifacts:
+        for fail, path in artifacts:
+            print(f"  {fail}\n    -> {path}")
+        code = 1
+    if stats["scenarios"] < min_scenarios:
+        print(f"FAIL: only {stats['scenarios']} scenarios completed "
+              f"(need >= {min_scenarios})")
+        code = 1
+    return code
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv)
+    if args.artifact_dir:
+        import os
+        os.environ["CS_TPU_SIM_ARTIFACTS"] = args.artifact_dir
+    return run_sweep(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

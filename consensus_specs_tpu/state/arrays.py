@@ -52,6 +52,7 @@ from contextlib import contextmanager
 
 import numpy as np
 
+from consensus_specs_tpu import faults
 from consensus_specs_tpu.obs import registry as obs_registry
 from consensus_specs_tpu.obs.tracing import span
 from consensus_specs_tpu.utils import env_flags
@@ -121,6 +122,13 @@ _C_ADOPTIONS = obs_registry.counter("state_arrays.adoptions").labels()
 _C_COMMITS = obs_registry.counter("state_arrays.commits").labels()
 _C_COW = obs_registry.counter("state_arrays.cow_copies").labels()
 _C_FORKS = obs_registry.counter("state_arrays.forks").labels()
+# chunk-packed-commit fallbacks: the per-index write loop taken because
+# an injected fault (consensus_specs_tpu/faults.py) failed the batched
+# committer.  No organic series: the committer has no guard of its own.
+_FALLBACKS = {
+    "injected": obs_registry.counter(
+        "state_arrays.fallbacks").labels(reason="injected"),
+}
 
 
 # ---------------------------------------------------------------------------
@@ -164,8 +172,7 @@ def _write_u64_list(seq, elem_type, old, new) -> None:
     if changed.size == 0:
         return
     if changed.size <= max(64, len(old) // 64):
-        for i in changed.tolist():
-            seq[i] = elem_type(int(new[i]))
+        _write_u64_list_loop(seq, elem_type, old, new)
         return
     vals, inv = np.unique(new, return_inverse=True)
     if vals.size * 4 <= new.size:
@@ -176,6 +183,16 @@ def _write_u64_list(seq, elem_type, old, new) -> None:
         # come out of a uint64 array, so the range holds by construction
         items = [int.__new__(elem_type, v) for v in new.tolist()]
     replace_basic_items(seq, items, packed=new.astype("<u8").tobytes())
+
+
+def _write_u64_list_loop(seq, elem_type, old, new) -> None:
+    """The spec-shaped committer: targeted per-index ``__setitem__``
+    writes.  Doubles as :func:`_write_u64_list`'s small-diff branch
+    (one shared loop, so the two paths cannot drift) and as the
+    graceful-degradation leg an injected commit fault forces — the
+    path the adversarial harness proves byte-identical."""
+    for i in np.nonzero(old != new)[0].tolist():
+        seq[i] = elem_type(int(new[i]))
 
 
 def _gen_of(seq) -> int:
@@ -397,8 +414,16 @@ class StateArrays:
                 _C_COMMITS.add()
                 wrote = True
             with span("state_arrays.commit"):
-                _write_u64_list(seq, type(seq).elem_type,
-                                cell.base, cell.data)
+                try:
+                    faults.check("state_arrays.commit")
+                except faults.InjectedFault as exc:
+                    faults.count_fallback(_FALLBACKS, exc,
+                                          organic="injected")
+                    _write_u64_list_loop(seq, type(seq).elem_type,
+                                         cell.base, cell.data)
+                else:
+                    _write_u64_list(seq, type(seq).elem_type,
+                                    cell.base, cell.data)
                 cell.base = cell.data
                 cell.gen = _gen_of(seq)
 

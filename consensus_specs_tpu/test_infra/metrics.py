@@ -10,7 +10,7 @@ region::
     with counting() as delta:
         head = spec.get_head(store)
     assert delta["forkchoice.head{path=engine}"] == 1
-    assert delta["forkchoice.fallbacks"] == 0
+    assert delta["forkchoice.fallbacks{reason=guard}"] == 0
 
 ``delta`` maps ``name{label=value,...}`` (label suffix omitted for
 unlabeled series) to the counter increase across the block; keys absent

@@ -84,6 +84,80 @@ def random_block(spec, state, rng: Random):
     return block
 
 
+def participation_blocks(spec, state, rng: Random, slots: int,
+                         fraction: float):
+    """``slots`` full-chain blocks whose attestations carry a thinned
+    committee (each member kept with probability ``fraction``): the FFG
+    throttle for driving real leak entry/exit through block processing
+    instead of state surgery."""
+    blocks = []
+    for _ in range(slots):
+        block = build_empty_block_for_next_slot(spec, state)
+        slot_to_attest = block.slot - 1
+        committees = spec.get_committee_count_per_slot(
+            state, spec.compute_epoch_at_slot(slot_to_attest))
+        for index in range(committees):
+            att = get_valid_attestation(
+                spec, state, slot_to_attest, index=index,
+                filter_participant_set=lambda c: set(
+                    i for i in c if rng.random() < fraction),
+                signed=True)
+            if any(att.aggregation_bits):
+                block.body.attestations.append(att)
+        blocks.append(state_transition_and_sign_block(spec, state, block))
+    return blocks
+
+
+def run_leak_recovery_scenario(spec, state, seed: int, participation=0.5,
+                               recovery_epochs=4):
+    """Drive the chain into a real inactivity leak and back out to
+    finality, asserting each milestone.
+
+    ``randomize_state`` scatters scores but never stalls finality, so
+    nothing upstream of this helper ever executed the leak arm of epoch
+    processing against organically-built chain state.  Here the leak is
+    *entered* the way a live network enters it — sub-2/3 target weight
+    over ``MIN_EPOCHS_TO_INACTIVITY_PENALTY`` epochs of otherwise-valid
+    blocks — held long enough for the scores to bite (altair+), and
+    then exited through full-participation blocks until finalization
+    advances again.  Returns all signed blocks (vector-format friendly:
+    pre/blocks/post)."""
+    rng = Random(seed)
+    # warmup past genesis (no attestations: finality stays at epoch 0)
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    epoch_slots = int(spec.SLOTS_PER_EPOCH)
+    blocks = []
+
+    # entry: target weight pinned below 2/3 until the finality delay
+    # crosses the leak threshold, plus margin for the scores to grow
+    leak_epochs = int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 2
+    blocks += participation_blocks(spec, state, rng,
+                                   leak_epochs * epoch_slots, participation)
+    assert spec.is_in_inactivity_leak(state), \
+        "chain never entered the inactivity leak"
+    scores_peak = None
+    if hasattr(state, "inactivity_scores"):
+        scores_peak = [int(s) for s in state.inactivity_scores]
+        assert max(scores_peak) > 0, \
+            "leak epochs never grew an inactivity score"
+    finalized_in_leak = int(state.finalized_checkpoint.epoch)
+
+    # recovery: full participation until finalization snaps forward
+    blocks += participation_blocks(spec, state, rng,
+                                   recovery_epochs * epoch_slots, 1.0)
+    assert not spec.is_in_inactivity_leak(state), \
+        "full participation never exited the leak"
+    assert int(state.finalized_checkpoint.epoch) > finalized_in_leak, \
+        "finality never recovered after the leak"
+    if scores_peak is not None:
+        scores_now = [int(s) for s in state.inactivity_scores]
+        assert all(s >= 0 for s in scores_now)
+        assert sum(scores_now) < sum(scores_peak), \
+            "recovery epochs never walked the scores back down"
+    return blocks
+
+
 def run_random_scenario(spec, state, seed: int, epochs=2,
                         blocks_per_epoch=4):
     """Seeded scenario: randomize, then alternate empty slots and random

@@ -1,5 +1,6 @@
 """speclint passes.  Each module exposes ``NAME`` and ``run(ctx)``."""
 from . import (  # noqa: F401
-    uint64, tracing, ladder, obs, specmd, state_layer, style)
+    fallbacks, uint64, tracing, ladder, obs, specmd, state_layer, style)
 
-ALL_PASSES = (style, uint64, tracing, ladder, specmd, obs, state_layer)
+ALL_PASSES = (style, uint64, tracing, ladder, specmd, obs, state_layer,
+              fallbacks)

@@ -1,0 +1,148 @@
+"""counted-fallback pass (R7xx): engine degradation must be accounted.
+
+The graceful-degradation contract (``consensus_specs_tpu/faults``): an
+engine entry point that absorbs a fallback-class exception — its own
+``_Fallback`` guard signal or an injected ``InjectedFault`` — must
+route the trip through :func:`faults.count_fallback`, which books it on
+the engine's reason-labeled fallback counter.  A handler that catches
+without counting produces a *silent* fallback: the run completes on the
+spec loop and every differential suite stays green while the fast path
+is quietly dead.  The adversarial harness (``consensus_specs_tpu/sim``)
+proves the dynamic half of this contract per run; this pass pins the
+static half across the whole engine surface.
+
+Scope: the engine packages — ``ops/``, ``forkchoice/``, ``state/``,
+``utils/ssz/``, ``utils/bls.py`` — plus ``gen/`` and ``sim/`` for R702
+(the harness and generator layers must not eat injected faults either).
+
+* R701 — a function catches a fallback-class exception
+  (``_Fallback`` / ``InjectedFault``) but never calls
+  ``count_fallback``.  The call may sit outside the handler body (the
+  BLS flush defers counting until it knows the organic reason), so the
+  requirement is function-wide.
+* R702 — an ``except BaseException`` / bare ``except`` handler with no
+  ``raise`` in its body.  ``InjectedFault`` subclasses BaseException
+  precisely so ``except Exception`` catch-alls cannot eat it; a
+  BaseException catch-all that does not re-raise defeats that design.
+
+Intentional exceptions carry ``# noqa: R701`` / ``# noqa: R702``.
+Baseline: zero findings — new engine entry points must wire their
+handlers through the helper before landing.
+"""
+import ast
+
+from ..findings import Finding
+
+NAME = "fallbacks"
+CODE_PREFIXES = ("R",)
+
+ENGINE_PREFIXES = (
+    "consensus_specs_tpu/ops/",
+    "consensus_specs_tpu/forkchoice/",
+    "consensus_specs_tpu/state/",
+    "consensus_specs_tpu/utils/ssz/",
+    "consensus_specs_tpu/utils/bls.py",
+)
+# R702 additionally guards the layers a fault must traverse unswallowed
+R702_EXTRA_PREFIXES = (
+    "consensus_specs_tpu/gen/",
+    "consensus_specs_tpu/sim/",
+)
+
+_FALLBACK_NAMES = {"_Fallback", "InjectedFault"}
+
+
+def _scoped(path: str, prefixes) -> bool:
+    return any(path.startswith(p) for p in prefixes)
+
+
+def _names_in(expr):
+    """Terminal identifiers referenced by an except-type expression:
+    ``_Fallback``, ``faults.InjectedFault``, tuples of either."""
+    out = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            out.add(node.attr)
+    return out
+
+
+def _catches_fallback_class(handler) -> bool:
+    return handler.type is not None \
+        and bool(_names_in(handler.type) & _FALLBACK_NAMES)
+
+
+def _catches_base_exception(handler) -> bool:
+    if handler.type is None:
+        return True                      # bare ``except:``
+    return "BaseException" in _names_in(handler.type)
+
+
+def _calls_count_fallback(fn_node) -> bool:
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == "count_fallback":
+                return True
+            if isinstance(f, ast.Attribute) and f.attr == "count_fallback":
+                return True
+    return False
+
+
+def _reraises(handler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+    return False
+
+
+def check_source(path: str, text: str):
+    """All R7xx findings for one file (``path`` repo-relative)."""
+    r701 = _scoped(path, ENGINE_PREFIXES)
+    r702 = r701 or _scoped(path, R702_EXTRA_PREFIXES)
+    if not r702:
+        return []
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError:
+        return []    # the style pass owns E999
+    findings = []
+
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        counts = None    # resolved lazily, once per function
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if r701 and _catches_fallback_class(handler):
+                    if counts is None:
+                        counts = _calls_count_fallback(fn)
+                    if not counts:
+                        findings.append(Finding(
+                            path, handler.lineno, "R701",
+                            f"{fn.name} catches a fallback-class "
+                            "exception without routing through "
+                            "faults.count_fallback — a fallback that "
+                            "runs uncounted is invisible to the "
+                            "no-silent-fallback contract"))
+                if _catches_base_exception(handler) \
+                        and not _reraises(handler):
+                    findings.append(Finding(
+                        path, handler.lineno, "R702",
+                        f"{fn.name} swallows BaseException without "
+                        "re-raising — this eats InjectedFault, which "
+                        "subclasses BaseException precisely so "
+                        "catch-alls cannot absorb an injected fault"))
+    return findings
+
+
+def run(ctx):
+    findings = []
+    for rel in ctx.py_files:
+        if not _scoped(rel, ENGINE_PREFIXES + R702_EXTRA_PREFIXES):
+            continue
+        findings.extend(check_source(rel, ctx.source(rel)))
+    return findings

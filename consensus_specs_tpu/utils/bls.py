@@ -17,6 +17,7 @@ import os
 from contextlib import contextmanager
 from typing import Sequence
 
+from consensus_specs_tpu import faults as _faults
 from consensus_specs_tpu.obs import registry as _obs_registry
 from consensus_specs_tpu.utils import env_flags as _env_flags
 from consensus_specs_tpu.utils.lru import LRUDict
@@ -127,12 +128,19 @@ def backend_name() -> str:
 # item failed, so assert semantics are unchanged.  ``bls.flush{path=
 # rlc|lanes|fallback}`` counts which strategy answered; ``bls.pairings``
 # counts pairing-check evaluations so "one pairing per block" is
-# counter-assertable.
+# counter-assertable.  The fallback series carries a ``reason`` label:
+# ``bisect`` for the organic combined-failure re-run, ``injected`` for
+# harness-scheduled faults (``consensus_specs_tpu/faults.py``).
 # ---------------------------------------------------------------------------
 
 _FLUSH_RLC = _obs_registry.counter("bls.flush").labels(path="rlc")
 _FLUSH_LANES = _obs_registry.counter("bls.flush").labels(path="lanes")
-_FLUSH_FALLBACK = _obs_registry.counter("bls.flush").labels(path="fallback")
+_FLUSH_FALLBACK = {
+    "bisect": _obs_registry.counter(
+        "bls.flush").labels(path="fallback", reason="bisect"),
+    "injected": _obs_registry.counter(
+        "bls.flush").labels(path="fallback", reason="injected"),
+}
 _PAIRINGS = _obs_registry.counter("bls.pairings").labels()
 
 
@@ -203,22 +211,32 @@ class DeferredBatch:
         if not items and not checks:
             return True
         if rlc_enabled():
-            from consensus_specs_tpu.ops import bls_rlc
-            verdict = bls_rlc.combined_check(items, checks, _backend_name)
-            if verdict is not None:
-                _PAIRINGS.add()          # the one combined product pairing
-            if verdict is True:
-                _FLUSH_RLC.add()
-                for ks in keys:
-                    for k in ks:
-                        _memo_put(k, True)
-                self.last_results = [True] * len(items)
-                self.last_pairing_results = [True] * len(checks)
-                return True
-            # combined failure (False) or structurally invalid item
-            # (None): bisect through the per-lane path for exact
-            # per-item reporting
-            _FLUSH_FALLBACK.add()
+            injected = None
+            try:
+                _faults.check("bls.flush")
+            except _faults.InjectedFault as exc:
+                # the RLC combine "failed": degrade to the per-lane
+                # path, exactly like a combined-verdict failure
+                injected = exc
+            if injected is None:
+                from consensus_specs_tpu.ops import bls_rlc
+                verdict = bls_rlc.combined_check(items, checks,
+                                                 _backend_name)
+                if verdict is not None:
+                    _PAIRINGS.add()      # the one combined product pairing
+                if verdict is True:
+                    _FLUSH_RLC.add()
+                    for ks in keys:
+                        for k in ks:
+                            _memo_put(k, True)
+                    self.last_results = [True] * len(items)
+                    self.last_pairing_results = [True] * len(checks)
+                    return True
+            # combined failure (False), structurally invalid item
+            # (None), or an injected fault: bisect through the per-lane
+            # path for exact per-item reporting
+            _faults.count_fallback(_FLUSH_FALLBACK, injected,
+                                   organic="bisect")
         else:
             _FLUSH_LANES.add()
         results = self._lane_results(items)

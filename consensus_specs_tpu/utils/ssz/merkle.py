@@ -29,6 +29,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ... import faults
 from ...obs import registry as obs_registry
 from ...obs.tracing import span
 from ..env_flags import MERKLE_BATCH_MIN
@@ -121,6 +122,14 @@ _C_PAIR_SCALAR = obs_registry.counter("merkle.pair_scalar").labels()
 _G_PAIR_SCALAR_MAX = obs_registry.gauge("merkle.pair_scalar_max").labels()
 _C_LAYER_CALLS = obs_registry.counter("merkle.layer_calls").labels()
 _C_LAYER_SCALAR = obs_registry.counter("merkle.layer_scalar").labels()
+# batched-dispatch fallbacks: the hashlib per-row loop taken because an
+# injected fault (consensus_specs_tpu/faults.py) failed the batched
+# path.  No organic series: threshold-based scalar routing is a policy
+# choice (counted above), not a failure.
+_FALLBACKS = {
+    "injected": obs_registry.counter(
+        "merkle.fallbacks").labels(reason="injected"),
+}
 
 
 def stats() -> dict:
@@ -214,11 +223,31 @@ def hash_layer(data: bytes) -> bytes:
     return bytes(out)
 
 
+def _hash_rows_scalar(rows: np.ndarray) -> np.ndarray:
+    """The spec-shaped fallback for :func:`hash_rows`: a per-row hashlib
+    loop, byte-identical to any batched backend.  Only reached through
+    an injected dispatch fault; counts into the hashlib backend series
+    (it really is hashlib doing the work) but not the scalar-routing
+    counters, which exist to catch threshold regressions."""
+    m = rows.shape[0]
+    buf = rows.tobytes()
+    out = bytearray(m * 32)
+    for i in range(m):
+        out[i * 32:(i + 1) * 32] = sha256(buf[i * 64:(i + 1) * 64]).digest()
+    _PAIRS_HASHLIB.n += m
+    return np.frombuffer(bytes(out), dtype=np.uint8).reshape(m, 32)
+
+
 def hash_rows(rows: np.ndarray) -> np.ndarray:
     """Hash an ``(m, 64)`` uint8 array of parent inputs into ``(m, 32)``
     digests in one batched dispatch.  The entry point for gathered
     dirty-pair buffers (incremental engine, forest flushes, columnar
     container-root reductions)."""
+    try:
+        faults.check("merkle.dispatch")
+    except faults.InjectedFault as exc:
+        faults.count_fallback(_FALLBACKS, exc, organic="injected")
+        return _hash_rows_scalar(rows)
     m = rows.shape[0]
     if _batched_hasher_np is not None and m >= _BATCH_THRESHOLD:
         _C_PAIR_BATCH_CALLS.n += 1

@@ -16,6 +16,10 @@ from consensus_specs_tpu.gen.gen_from_tests import combine_mods
 
 phase0_mods = {
     "get_head": "tests.phase0.fork_choice.test_fork_choice",
+    # curated adversarial-simulator seeds (consensus_specs_tpu/sim):
+    # equivocation, ex-ante/balancing reorgs, inactivity leak, deep
+    # non-finality — emitted in the same event-sourced steps format
+    "sim": "tests.phase0.fork_choice.test_sim_scenarios",
 }
 altair_mods = phase0_mods
 bellatrix_mods = combine_mods({

@@ -6,10 +6,21 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))))
 
 from consensus_specs_tpu.gen import run_state_test_generators
+from consensus_specs_tpu.gen.gen_from_tests import combine_mods
 
-mods = {"random": "tests.phase0.random.test_random"}
-ALL_MODS = {fork: mods
-            for fork in ("phase0", "altair", "bellatrix", "capella", "deneb")}
+phase0_mods = {"random": "tests.phase0.random.test_random"}
+# altair+: the organically-driven inactivity-leak entry/recovery suite
+altair_mods = combine_mods({
+    "leak_recovery": "tests.altair.random.test_leak_recovery",
+}, phase0_mods)
+
+ALL_MODS = {
+    "phase0": phase0_mods,
+    "altair": altair_mods,
+    "bellatrix": altair_mods,
+    "capella": altair_mods,
+    "deneb": altair_mods,
+}
 
 if __name__ == "__main__":
     run_state_test_generators("random", ALL_MODS)

@@ -1,0 +1,82 @@
+"""Inactivity-leak entry and finality recovery, driven organically.
+
+Before this suite, nothing drove a chain into the leak through block
+processing: ``randomize_state`` scatters scores onto a finalizing chain
+and ``set_state_in_leak`` rewrites checkpoints directly, so the leak
+arm of epoch processing (score growth, quotient-scaled penalties,
+recovery decrement) never ran against state the chain itself produced.
+``run_leak_recovery_scenario`` (``test_infra/random_scenarios.py``)
+stalls finality with sub-2/3 blocks until ``is_in_inactivity_leak``,
+holds it while scores grow, then recovers to an advanced finalized
+checkpoint — asserting each milestone — across every altair+ fork,
+with a byte-identity leg against the spec loops (``CS_TPU_*=0``).
+"""
+import os
+
+import pytest
+
+from consensus_specs_tpu.test_infra.context import (
+    spec_state_test, with_all_phases_from, with_phases, pytest_only,
+)
+from consensus_specs_tpu.test_infra.random_scenarios import (
+    run_leak_recovery_scenario,
+)
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+# the canonical engines-off switch map the harness's spec-differential
+# legs use; the switches all live-read their variables (env_flags.py)
+from consensus_specs_tpu.sim.harness import ENGINES_OFF as _ENGINES_OFF
+
+
+@with_all_phases_from("altair")
+@spec_state_test
+def test_leak_entry_and_finality_recovery(spec, state):
+    """The chain leaks and recovers on every altair+ fork; every
+    milestone assert lives in the scenario helper."""
+    yield "pre", state
+    blocks = run_leak_recovery_scenario(spec, state, seed=8800)
+    yield "blocks", blocks
+    yield "post", state
+
+
+@pytest.mark.slow
+@with_all_phases_from("altair")
+@spec_state_test
+def test_leak_recovery_alternate_participation(spec, state):
+    """A deeper stall (40% participation) must still leak and recover.
+    A second full sweep across the fork matrix: outside the tier-1
+    budget, run by the CI adversarial-sim job and the generator."""
+    yield "pre", state
+    blocks = run_leak_recovery_scenario(spec, state, seed=8801,
+                                        participation=0.4)
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_phases(["altair", "deneb"])
+@spec_state_test
+@pytest_only
+def test_leak_recovery_engines_differential(spec, state):
+    """The same leak/recovery replay with every accelerated engine off
+    must produce byte-identical blocks and post-state — the leak arm is
+    exactly where the vectorized inactivity/rewards kernels diverge
+    from the spec loops if they ever will."""
+    s_on = state.copy()
+    blocks_on = run_leak_recovery_scenario(spec, s_on, seed=8802)
+
+    saved = {k: os.environ.get(k) for k in _ENGINES_OFF}
+    os.environ.update(_ENGINES_OFF)
+    try:
+        s_off = state.copy()
+        blocks_off = run_leak_recovery_scenario(spec, s_off, seed=8802)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    assert bytes(hash_tree_root(s_on)) == bytes(hash_tree_root(s_off))
+    assert [bytes(hash_tree_root(b)) for b in blocks_on] \
+        == [bytes(hash_tree_root(b)) for b in blocks_off]
+    yield

@@ -375,7 +375,7 @@ def test_direct_block_insertion_falls_back(spec, state):
             proto_array.use_auto()
     # the spec get_head itself re-enters wrapped reads (filtered tree,
     # per-child weights), each refusing the stale array in turn
-    assert delta["forkchoice.fallbacks"] > 0
+    assert delta["forkchoice.fallbacks{reason=guard}"] > 0
     assert delta["forkchoice.head{path=engine}"] == 0
     assert delta["forkchoice.head{path=spec}"] == 1
     assert head == rogue_root

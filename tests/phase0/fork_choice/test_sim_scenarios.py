@@ -1,0 +1,120 @@
+"""Curated adversarial-simulator seeds as fork-choice vectors.
+
+The feed from the adversarial sweep (``consensus_specs_tpu/sim``) into
+the conformance corpus: each test replays one catalog scenario at a
+pinned seed through the real store, emitting the cross-client
+``fork_choice`` event log (anchor parts + block/attestation parts in
+event order + a ``steps`` yaml with store checks) via the driver's
+``test_steps`` hook — the same dual pytest/generator consumption every
+other suite uses (``generators/fork_choice/main.py`` registers this
+module under the ``sim`` handler).
+
+Seeds are pinned, not arbitrary: each was picked from sweep runs for
+hitting its storyline's interesting outcome (finality through a leak,
+boost defending against the ex-ante release, evidence landing during
+equivocation).  The behavioral asserts below pin that outcome, so a
+seed that drifts into a boring chain fails instead of silently
+emitting a weaker vector.
+"""
+import pytest
+
+from consensus_specs_tpu.sim import driver, scenarios
+from consensus_specs_tpu.test_infra.context import (
+    spec_test, with_all_phases, with_phases, never_bls)
+from consensus_specs_tpu.forks import build_spec
+
+# multi-epoch store replays (~1-4s each x forks): outside the tier-1
+# budget.  The CI adversarial-sim job runs this file explicitly, the
+# per-fork conformance legs run it unfiltered, and the fork_choice
+# generator replays it at vector-emission time regardless of markers.
+pytestmark = pytest.mark.slow
+
+
+def _run_scenario(spec, name, seed, test_steps):
+    epoch = int(spec.SLOTS_PER_EPOCH)
+    scenario = scenarios.build(seed, epoch, epoch * 8, name=name)
+    if scenario.config_overrides:
+        spec = build_spec(spec.fork, spec.preset_name,
+                          scenario.config_overrides)
+    result = driver.execute(spec, scenario.script, scenario.n_validators,
+                            test_steps=test_steps)
+    assert result.accepted > 0
+    return result
+
+
+@with_all_phases
+@spec_test
+@never_bls
+def test_sim_steady_finalizes(spec):
+    """The control storyline: full participation, finality marching."""
+    test_steps = []
+    result = _run_scenario(spec, "steady", 3, test_steps)
+    assert result.finalized[0] >= 1
+    assert result.rejected == 0
+    yield "steps", test_steps
+
+
+@with_phases(["phase0", "altair"])
+@spec_test
+@never_bls
+def test_sim_inactivity_leak_recovers(spec):
+    """40%ish offline through the leak, then recovery to finality —
+    the longest-horizon storyline in the catalog (~26 epochs).
+    phase0 + altair cover both leak mechanisms (pending-attestation vs
+    participation-flag/inactivity-score); the altair+ fork matrix is
+    exercised by the random-scenario leak suite
+    (``tests/altair/test_random_scenarios.py``) and the generator."""
+    test_steps = []
+    result = _run_scenario(spec, "inactivity_leak", 9, test_steps)
+    # the defining outcome: finality stalled during the leak, then
+    # snapped forward after the offline set returned
+    assert result.finalized[0] >= 8
+    yield "steps", test_steps
+
+
+@with_phases(["phase0", "altair"])
+@spec_test
+@never_bls
+def test_sim_exante_reorg_boost_defends(spec):
+    """Withheld-block release races proposer boost; the timely honest
+    chain must keep finalizing regardless."""
+    test_steps = []
+    result = _run_scenario(spec, "exante_reorg", 4, test_steps)
+    assert result.finalized[0] >= 1
+    yield "steps", test_steps
+
+
+@with_phases(["phase0", "altair"])
+@spec_test
+@never_bls
+def test_sim_equivocation_with_evidence(spec):
+    """Equivocating proposers + double votes; slashing evidence rides
+    into bodies on this seed and the chain survives the split."""
+    test_steps = []
+    result = _run_scenario(spec, "equivocation", 1, test_steps)
+    assert result.slots >= 2 * int(spec.SLOTS_PER_EPOCH)
+    yield "steps", test_steps
+
+
+@with_phases(["phase0", "altair"])
+@spec_test
+@never_bls
+def test_sim_balancing_resolves(spec):
+    """Sustained weight-balancing across sibling tips, then the
+    network converges: the head flip-flop must settle and finalize."""
+    test_steps = []
+    result = _run_scenario(spec, "balancing", 0, test_steps)
+    assert result.finalized[0] >= 1
+    yield "steps", test_steps
+
+
+@with_phases(["phase0", "altair"])
+@spec_test
+@never_bls
+def test_sim_deep_nonfinality_prunes(spec):
+    """Multi-epoch justification stall with unpruned side forks, then
+    one finalization snap prunes the whole backlog."""
+    test_steps = []
+    result = _run_scenario(spec, "deep_nonfinality", 2, test_steps)
+    assert result.finalized[0] >= 1
+    yield "steps", test_steps

@@ -107,11 +107,11 @@ def test_combined_failure_falls_back_and_bisects():
     items = _sig_items(3)
     bad = ([bls.SkToPk(9)], MSG_B, bls.Sign(9, MSG_A))   # wrong message
     with _rlc_env("1"):
-        f0 = _FLUSH.value(path="fallback")
+        f0 = _FLUSH.value(path="fallback", reason="bisect")
         ok, batch = _flush_batch(items + [bad])
         assert not ok
         assert batch.last_results == [True, True, True, False]
-        assert _FLUSH.value(path="fallback") - f0 == 1
+        assert _FLUSH.value(path="fallback", reason="bisect") - f0 == 1
 
 
 def test_assert_valid_reports_failing_indices():

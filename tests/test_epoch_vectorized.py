@@ -186,7 +186,7 @@ def _assert_function_equivalence(spec, state, fns):
             getattr(spec, fn)(s_vec)
         assert delta["epoch.transition{path=vectorized}"] > 0, \
             f"{spec.fork}.{fn}: vectorized engine never committed"
-        assert delta["epoch.fallbacks"] == 0, \
+        assert delta["epoch.fallbacks{reason=guard}"] == 0, \
             f"{spec.fork}.{fn}: unexpected guard fallback"
         assert hash_tree_root(s_loop) == hash_tree_root(s_vec), \
             f"{spec.fork}.{fn}: post-state roots diverge"
@@ -255,7 +255,7 @@ def test_guard_fallback_matches_loop():
     ek.use_vectorized()
     with counting() as delta:
         spec.process_rewards_and_penalties(s_vec)
-    assert delta["epoch.fallbacks"] == 1
+    assert delta["epoch.fallbacks{reason=guard}"] == 1
     assert hash_tree_root(s_loop) == hash_tree_root(s_vec)
 
 
@@ -492,7 +492,7 @@ def test_registry_mass_ejection_sum_dtype_regression(fork):
     with counting() as delta:
         spec.process_registry_updates(s_vec)
     assert delta["epoch.transition{path=vectorized}"] == 1
-    assert delta["epoch.fallbacks"] == 0
+    assert delta["epoch.fallbacks{reason=guard}"] == 0
     assert hash_tree_root(s_loop) == hash_tree_root(s_vec)
     # the queue really did saturate: ejections spread over >= 2 epochs,
     # so the per-epoch churn counter (the second fixed sum) was consumed

@@ -368,3 +368,88 @@ def test_profiling_module_is_thin_alias():
     finally:
         profiling.enable(False)
         profiling.reset()
+
+
+# ---------------------------------------------------------------------------
+# reason-labeled fallback accounting (the fault-injection contract)
+# ---------------------------------------------------------------------------
+
+def test_fallback_counters_carry_reason_labels():
+    """Injected vs organic fallbacks must stay distinguishable in
+    ``obs_report``: every engine fallback counter is reason-labeled,
+    the full label set is pre-bound at engine import, and no unlabeled
+    twin series exists for the harness to miscount into."""
+    # importing the engines binds their series at module scope
+    import consensus_specs_tpu.forkchoice.proto_array  # noqa: F401
+    import consensus_specs_tpu.ops.epoch_kernels  # noqa: F401
+    import consensus_specs_tpu.state.arrays  # noqa: F401
+    import consensus_specs_tpu.utils.bls  # noqa: F401
+    import consensus_specs_tpu.utils.ssz.merkle  # noqa: F401
+
+    assert set(registry.counter("forkchoice.fallbacks").series_values()) \
+        == {"{reason=guard}", "{reason=injected}"}
+    assert set(registry.counter("epoch.fallbacks").series_values()) \
+        == {"{reason=guard}", "{reason=injected}"}
+    # engines whose fast path has no organic guard: injected-only
+    assert set(registry.counter("merkle.fallbacks").series_values()) \
+        == {"{reason=injected}"}
+    assert set(registry.counter("state_arrays.fallbacks").series_values()) \
+        == {"{reason=injected}"}
+    flush = set(registry.counter("bls.flush").series_values())
+    assert {"{path=fallback,reason=bisect}",
+            "{path=fallback,reason=injected}"} <= flush
+    assert "{path=fallback}" not in flush
+
+
+def test_injected_fault_books_injected_reason_only():
+    """``faults.count_fallback`` routes an InjectedFault to the
+    ``reason=injected`` series and anything else to the organic one —
+    an injected trip must never hide in the guard noise."""
+    from consensus_specs_tpu import faults
+    series = {
+        "guard": registry.counter("test.fallbacks").labels(reason="guard"),
+        "injected": registry.counter(
+            "test.fallbacks").labels(reason="injected"),
+    }
+    with counting() as delta:
+        faults.count_fallback(series, faults.InjectedFault("test.site", 1))
+        faults.count_fallback(series, RuntimeError("organic trip"))
+        faults.count_fallback(series, None)
+    assert delta["test.fallbacks{reason=injected}"] == 1
+    assert delta["test.fallbacks{reason=guard}"] == 2
+
+
+def test_gen_runner_case_errors_are_obs_accounted():
+    """The generator's narrowed per-case handler books swallowed
+    failures on ``gen.case_errors{error=...}`` instead of vanishing
+    them (a fault-injection run must not disappear into a catch-all —
+    InjectedFault, a BaseException, escapes it entirely)."""
+    from consensus_specs_tpu import faults
+    from consensus_specs_tpu.gen import gen_runner
+
+    class _Case:
+        preset_name = "minimal"
+        fork_name = "phase0"
+
+        def __init__(self, fn):
+            self.case_fn = fn
+            self.exec_fork = "phase0"
+
+        def dir_path(self):
+            return "minimal/phase0/test/test/test/case"
+
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmp:
+        log = []
+        with counting() as delta:
+            result = gen_runner.generate_test_vector(
+                _Case(lambda: (_ for _ in ()).throw(
+                    AssertionError("spec invalidity"))), tmp, log)
+        assert result == "error"
+        assert len(log) == 1
+        assert delta["gen.case_errors{error=AssertionError}"] == 1
+        # an injected fault is NOT swallowed: it kills the case loudly
+        with pytest.raises(faults.InjectedFault):
+            gen_runner.generate_test_vector(
+                _Case(lambda: (_ for _ in ()).throw(
+                    faults.InjectedFault("bls.flush", 1))), tmp, [])

@@ -1,0 +1,548 @@
+"""Suite for the adversarial chain simulator + fault-injection harness
+(``consensus_specs_tpu/sim``, ``consensus_specs_tpu/faults``).
+
+Covers the stack's load-bearing contracts:
+
+* **driver determinism** — the same pure-data script replays to a
+  byte-identical digest, including the accepted/rejected step pattern;
+* **scenario catalog** — every shape builds JSON-able scripts, seeds
+  reproduce, a forced name consumes aligned entropy;
+* **fault schedules** — ordinal triggers fire exactly once, observing
+  schedules never fire, arming is not reentrant, and ``InjectedFault``
+  escapes ``except Exception`` catch-alls by construction;
+* **harness legs** — injected/storm legs finish byte-identical with the
+  ``reason=injected`` counter moving exactly as scheduled, the
+  engines-off differential matches, and each LegFailure category
+  (no-discharge, silent-fallback, organic-leak, diverged) actually
+  trips when its failure mode is simulated;
+* **repro** — the shrinker reduces scripts under a budget, artifacts
+  round-trip through JSON, and ``replay`` re-runs a dumped leg.
+"""
+import json
+
+import pytest
+
+from consensus_specs_tpu import faults
+from consensus_specs_tpu.forks import build_spec
+from consensus_specs_tpu.sim import driver, harness, repro, scenarios
+from consensus_specs_tpu.test_infra.metrics import counting
+from consensus_specs_tpu.utils import bls
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return build_spec("phase0", "minimal")
+
+
+@pytest.fixture(autouse=True)
+def _sim_mode():
+    """Signatures off (scenario digests cover everything but sig bytes;
+    the sweep's --bls-seeds legs and make sim-smoke run them on) and no
+    schedule armed on entry/exit."""
+    prev_bls = bls.bls_active
+    bls.bls_active = False
+    assert faults.active() is None
+    yield
+    assert faults.active() is None
+    bls.bls_active = prev_bls
+
+
+def _epoch(spec):
+    return int(spec.SLOTS_PER_EPOCH)
+
+
+def _short_script(spec, epochs=2):
+    """A small deterministic healthy chain: enough to touch every epoch
+    kernel without catalog-scale runtimes."""
+    script = []
+    for _ in range(epochs * _epoch(spec)):
+        script.append({"op": "tick"})
+        script.append({"op": "block", "tip": "head", "att_slots": 2,
+                       "frac": 1.0})
+    script.append({"op": "checks"})
+    return script
+
+
+def _scenario(spec, script, name="unit", seed=0):
+    return scenarios.Scenario(name, seed, script,
+                              _epoch(spec) * 8, None)
+
+
+# ---------------------------------------------------------------------------
+# faults module
+# ---------------------------------------------------------------------------
+
+def test_schedule_fires_at_exact_ordinals():
+    sched = faults.FaultSchedule({"epoch.slashings": [2, 4]})
+    fired = []
+    for n in range(1, 6):
+        try:
+            sched.hit("epoch.slashings")
+        except faults.InjectedFault as exc:
+            fired.append((exc.site, exc.n))
+    assert fired == [("epoch.slashings", 2), ("epoch.slashings", 4)]
+    assert sched.fully_fired()
+    assert sched.calls == {"epoch.slashings": 5}
+
+
+def test_observing_schedule_counts_without_firing():
+    sched = faults.observing()
+    for _ in range(3):
+        sched.hit("merkle.dispatch")
+    assert sched.calls == {"merkle.dispatch": 3}
+    assert sched.fired == []
+    assert sched.planned == 0 and sched.fully_fired()
+
+
+def test_check_is_noop_when_disarmed():
+    faults.check("forkchoice.head")     # must not raise, no schedule
+
+
+def test_injected_arming_is_not_reentrant():
+    with faults.injected(faults.observing()):
+        with pytest.raises(RuntimeError):
+            with faults.injected(faults.observing()):
+                pass
+    assert faults.active() is None
+
+
+def test_injected_fault_escapes_exception_catchalls():
+    """The design point: ``except Exception`` cannot eat an injected
+    fault, only the dedicated engine handlers may."""
+    assert not issubclass(faults.InjectedFault, Exception)
+    with pytest.raises(faults.InjectedFault):
+        try:
+            raise faults.InjectedFault("bls.flush", 1)
+        except Exception:      # noqa: R702 — proving the escape
+            pytest.fail("catch-all swallowed an InjectedFault")
+
+
+def test_harness_site_map_covers_fault_vocabulary():
+    assert set(harness.SITE_COUNTER) == set(faults.SITES)
+
+
+# ---------------------------------------------------------------------------
+# scenario catalog
+# ---------------------------------------------------------------------------
+
+def test_every_catalog_shape_builds_jsonable_scripts(spec):
+    for name in scenarios.NAMES:
+        s = scenarios.build(7, _epoch(spec), 64, name=name)
+        assert s.name == name and s.script, name
+        # pure data: the artifact format and the shrinker depend on it
+        assert json.loads(json.dumps(s.script)) == s.script, name
+
+
+def test_same_seed_same_script(spec):
+    a = scenarios.build(123, _epoch(spec), 64)
+    b = scenarios.build(123, _epoch(spec), 64)
+    assert a.name == b.name and a.script == b.script
+
+
+def test_forced_name_reproduces_weighted_draw(spec):
+    """When the seed's weighted pick IS the forced name, forcing must
+    not shift the entropy stream: the scripts come out identical."""
+    free = scenarios.build(5, _epoch(spec), 64)
+    forced = scenarios.build(5, _epoch(spec), 64, name=free.name)
+    assert forced.script == free.script
+
+
+def test_unknown_scenario_name_raises(spec):
+    with pytest.raises(ValueError):
+        scenarios.build(0, _epoch(spec), 64, name="nope")
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def test_driver_is_deterministic(spec):
+    script = _short_script(spec)
+    a = driver.execute(spec, script, 64)
+    b = driver.execute(spec, script, 64)
+    assert a.digest() == b.digest()
+    assert a.accepted > 0
+
+
+def test_driver_advances_and_finalizes(spec):
+    """A healthy 4-epoch chain must march finality — the baseline the
+    hostile scenarios deviate from."""
+    result = driver.execute(spec, _short_script(spec, epochs=4), 64)
+    assert result.slots >= 4 * _epoch(spec)
+    assert result.finalized[0] >= 1
+    assert result.rejected == 0
+
+
+def test_driver_rejects_adversarial_garbage_deterministically(spec):
+    """Unknown ops and impossible steps are recorded as rejections, and
+    the rejection pattern is part of the replay-equality surface."""
+    script = [{"op": "tick"},
+              {"op": "warp_drive"},                     # unknown op
+              {"op": "attester_slashing"},              # no evidence
+              {"op": "block", "tip": "head", "att_slots": 1, "frac": 1.0}]
+    a = driver.execute(spec, script, 64)
+    assert a.statuses.count("rejected") == 2
+    assert driver.execute(spec, script, 64).digest() == a.digest()
+
+
+def test_driver_equivocating_siblings_queue_proposer_evidence(spec):
+    """Two different blocks signed by one proposer at one slot must
+    queue ProposerSlashing evidence, deliverable via include_evidence."""
+    epoch = _epoch(spec)
+    script = []
+    for _ in range(epoch):
+        script.append({"op": "tick"})
+        script.append({"op": "block", "tip": "head", "att_slots": 1,
+                       "frac": 1.0, "set": "base"})
+    script.append({"op": "tick"})
+    script.append({"op": "block", "tip": "base", "set": "a",
+                   "att_slots": 1, "frac": 0.6, "graffiti": 1})
+    script.append({"op": "block", "tip": "base", "set": "b",
+                   "att_slots": 1, "frac": 0.6, "graffiti": 2})
+    sim = driver.ChainSim(spec, 64)
+    sim.run(script)
+    assert len(sim.proposer_evidence) == 1
+    ev = sim.proposer_evidence[0]
+    assert ev.signed_header_1.message.slot \
+        == ev.signed_header_2.message.slot
+
+
+def test_driver_double_vote_queues_slashable_evidence(spec):
+    epoch = _epoch(spec)
+    script = []
+    for _ in range(epoch):
+        script.append({"op": "tick"})
+        script.append({"op": "block", "tip": "head", "att_slots": 1,
+                       "frac": 1.0, "set": "base"})
+    script.append({"op": "tick"})
+    script.append({"op": "block", "tip": "base", "set": "a",
+                   "att_slots": 1, "frac": 0.5, "graffiti": 1})
+    script.append({"op": "block", "tip": "base", "set": "b",
+                   "att_slots": 1, "frac": 0.5, "graffiti": 2})
+    script.append({"op": "double_vote", "tip_a": "a", "tip_b": "b",
+                   "frac": 0.5})
+    sim = driver.ChainSim(spec, 64)
+    sim.run(script)
+    assert len(sim.evidence) == 1
+    ind = sim.evidence[0].attestation_1.attesting_indices
+    assert len(ind) > 0
+
+
+def test_driver_offline_validators_never_attest(spec):
+    """The inactivity-leak primitive: offline indices drop out of every
+    participant set, shrinking FFG weight below finality."""
+    epoch = _epoch(spec)
+    offline = list(range(32))           # half of 64: no 2/3 majority
+    script = [{"op": "offline", "indices": offline}]
+    for _ in range(4 * epoch):
+        script.append({"op": "tick"})
+        script.append({"op": "block", "tip": "head", "att_slots": 2,
+                       "frac": 1.0})
+    result = driver.execute(spec, script, 64)
+    assert result.finalized[0] == 0     # justification stalled
+
+
+# ---------------------------------------------------------------------------
+# harness legs
+# ---------------------------------------------------------------------------
+
+def test_baseline_census_sees_engine_sites(spec):
+    scenario = _scenario(spec, _short_script(spec))
+    _, census = harness.run_baseline(spec, scenario)
+    for site in ("epoch.rewards_and_penalties", "epoch.slashings",
+                 "forkchoice.head", "merkle.dispatch",
+                 "state_arrays.commit"):
+        assert census.get(site, 0) > 0, f"census missed {site}"
+
+
+def test_injected_leg_is_byte_identical_and_counted(spec):
+    scenario = _scenario(spec, _short_script(spec))
+    baseline, census = harness.run_baseline(spec, scenario)
+    with counting() as delta:
+        harness.run_injected(spec, scenario, baseline,
+                             "epoch.rewards_and_penalties", 1)
+    assert delta["epoch.fallbacks{reason=injected}"] == 1
+    assert delta["epoch.fallbacks{reason=guard}"] == 0
+
+
+def test_injected_leg_every_site_the_census_sees(spec):
+    """Ordinal-1 injection at each exercised site: the full
+    per-engine-fallback matrix in one test."""
+    scenario = _scenario(spec, _short_script(spec))
+    baseline, census = harness.run_baseline(spec, scenario)
+    exercised = [s for s in faults.SITES if census.get(s, 0) > 0]
+    assert len(exercised) >= 5
+    for site in exercised:
+        harness.run_injected(spec, scenario, baseline, site, 1)
+
+
+def test_storm_leg_all_sites_at_once(spec):
+    scenario = _scenario(spec, _short_script(spec))
+    baseline, census = harness.run_baseline(spec, scenario)
+    harness.run_storm(spec, scenario, baseline, census)
+
+
+def test_spec_differential_leg(spec):
+    scenario = _scenario(spec, _short_script(spec))
+    baseline, _ = harness.run_baseline(spec, scenario)
+    harness.run_spec_differential(spec, scenario, baseline)
+
+
+def test_no_discharge_is_detected(spec):
+    """An ordinal past the scenario's call count never fires: the leg
+    must fail loudly instead of passing vacuously."""
+    scenario = _scenario(spec, _short_script(spec))
+    baseline, census = harness.run_baseline(spec, scenario)
+    beyond = census["epoch.slashings"] + 100
+    with pytest.raises(harness.LegFailure) as exc:
+        harness.run_injected(spec, scenario, baseline,
+                             "epoch.slashings", beyond)
+    assert exc.value.category == "no-discharge"
+
+
+def test_silent_fallback_is_detected(spec, monkeypatch):
+    """Simulate the failure mode the harness exists to catch: a handler
+    that absorbs the fault without counting it."""
+    scenario = _scenario(spec, _short_script(spec))
+    baseline, _ = harness.run_baseline(spec, scenario)
+    monkeypatch.setattr(faults, "count_fallback",
+                        lambda series, exc=None, organic="guard": None)
+    with pytest.raises(harness.LegFailure) as exc:
+        harness.run_injected(spec, scenario, baseline,
+                             "epoch.rewards_and_penalties", 1)
+    assert exc.value.category == "silent-fallback"
+    assert "SILENT FALLBACK" in str(exc.value)
+
+
+def test_organic_leak_is_detected(spec, monkeypatch):
+    """An injected trip miscounted under the organic reason must not
+    hide in the guard noise."""
+    scenario = _scenario(spec, _short_script(spec))
+    baseline, _ = harness.run_baseline(spec, scenario)
+    real = faults.count_fallback
+    monkeypatch.setattr(
+        faults, "count_fallback",
+        lambda series, exc=None, organic="guard": real(series, None,
+                                                       organic=organic))
+    with pytest.raises(harness.LegFailure) as exc:
+        harness.run_injected(spec, scenario, baseline,
+                             "epoch.rewards_and_penalties", 1)
+    assert exc.value.category in ("silent-fallback", "organic-leak")
+
+
+def test_organic_fallbacks_in_baseline_are_tolerated(spec, monkeypatch):
+    """The organic-leak check is baseline-relative: a scenario whose
+    replay organically trips a guard (identically in every leg — the
+    script is pure data) must not fail its injected legs with a false
+    organic-leak."""
+    from consensus_specs_tpu.obs import registry
+    guard = registry.counter("epoch.fallbacks").labels(reason="guard")
+    real_leg = harness.run_leg
+
+    def leg_with_organic_trip(*a, **kw):
+        guard.add()
+        return real_leg(*a, **kw)
+
+    monkeypatch.setattr(harness, "run_leg", leg_with_organic_trip)
+    scenario = _scenario(spec, _short_script(spec))
+    baseline, _ = harness.run_baseline(spec, scenario)
+    assert baseline.organic["epoch.fallbacks{reason=guard}"] == 1
+    # must not raise: the injected leg sees the same one organic trip
+    harness.run_injected(spec, scenario, baseline,
+                         "epoch.rewards_and_penalties", 1)
+    # an EXTRA organic bump beyond the baseline's still trips the check
+    monkeypatch.setattr(
+        harness, "run_leg",
+        lambda *a, **kw: (guard.add(), leg_with_organic_trip(*a, **kw))[1])
+    with pytest.raises(harness.LegFailure) as exc:
+        harness.run_injected(spec, scenario, baseline,
+                             "epoch.rewards_and_penalties", 1)
+    assert exc.value.category == "organic-leak"
+
+
+def test_divergence_is_detected(spec):
+    """A doctored baseline digest must trip the byte-identity check."""
+    scenario = _scenario(spec, _short_script(spec))
+    baseline, _ = harness.run_baseline(spec, scenario)
+    baseline.head = b"\x00" * 32        # corrupt the reference digest
+    with pytest.raises(harness.LegFailure) as exc:
+        harness.run_injected(spec, scenario, baseline,
+                             "epoch.rewards_and_penalties", 1)
+    assert exc.value.category == "diverged"
+
+
+def test_draw_injections_covers_exercised_sites():
+    import random
+    census = {"epoch.slashings": 4, "forkchoice.head": 10,
+              "bls.flush": 0}
+    picks = harness.draw_injections(random.Random(0), census)
+    sites = [s for s, _ in picks]
+    assert sorted(sites) == ["epoch.slashings", "forkchoice.head"]
+    for site, ordinal in picks:
+        assert 1 <= ordinal <= census[site]
+    assert len(harness.draw_injections(random.Random(0), census,
+                                       max_sites=1)) == 1
+
+
+# ---------------------------------------------------------------------------
+# repro: shrinker + artifacts
+# ---------------------------------------------------------------------------
+
+def test_shrinker_reduces_to_minimal_script():
+    script = [{"op": "tick", "i": i} for i in range(40)]
+    script[23] = {"op": "block", "poison": True}
+
+    def reproduces(cand):
+        return any(s.get("poison") for s in cand)
+
+    reduced = repro.shrink_script(script, reproduces)
+    assert reduced == [{"op": "block", "poison": True}]
+
+
+def test_shrinker_respects_budget():
+    script = [{"i": i} for i in range(64)]
+    calls = []
+
+    def reproduces(cand):
+        calls.append(1)
+        return True
+
+    repro.shrink_script(script, reproduces, budget=10)
+    assert len(calls) <= 10
+
+
+def test_shrinker_treats_predicate_crash_as_no_repro():
+    script = [{"i": i} for i in range(8)]
+
+    def reproduces(cand):
+        if len(cand) < 8:
+            raise RuntimeError("different failure")
+        return True
+
+    assert repro.shrink_script(script, reproduces) == script
+
+
+def test_artifact_roundtrip(tmp_path, spec):
+    scenario = _scenario(spec, _short_script(spec), name="steady", seed=42)
+    sched = faults.FaultSchedule({"merkle.dispatch": [3]})
+    try:
+        for _ in range(3):
+            sched.hit("merkle.dispatch")
+    except faults.InjectedFault:
+        pass
+    path = repro.dump_artifact(scenario, "inject[merkle.dispatch@3]",
+                               "unit-test failure", schedule=sched,
+                               out_dir=str(tmp_path))
+    loaded, triggers, payload = repro.load_artifact(path)
+    assert loaded.name == "steady" and loaded.seed == 42
+    assert loaded.script == scenario.script
+    assert triggers == {"merkle.dispatch": [3]}
+    assert payload["schedule"]["fired"] == [["merkle.dispatch", 3]]
+    assert "env" in payload and "bls_backend" in payload["env"]
+
+
+def test_replay_of_clean_artifact_returns_zero(tmp_path, spec,
+                                               monkeypatch):
+    """An artifact whose leg no longer fails replays to exit code 0,
+    under the artifact's recorded spec and environment snapshot (a
+    sentinel CS_TPU var recorded at dump time is applied for the replay
+    and restored after)."""
+    import os
+    monkeypatch.setenv("CS_TPU_SIM_SENTINEL", "1")
+    scenario = _scenario(spec, _short_script(spec), name="steady", seed=1)
+    sched = faults.FaultSchedule({"epoch.slashings": [1]})
+    path = repro.dump_artifact(scenario, "inject[epoch.slashings@1]",
+                               "resolved failure", schedule=sched,
+                               out_dir=str(tmp_path),
+                               fork="phase0", preset="minimal")
+    monkeypatch.delenv("CS_TPU_SIM_SENTINEL")
+    payload = json.loads(open(path).read())
+    assert payload["fork"] == "phase0" and payload["preset"] == "minimal"
+    assert payload["env"]["CS_TPU_SIM_SENTINEL"] == "1"
+    assert repro.replay(path) == 0
+    # the snapshot was applied for the replay only, then restored
+    assert "CS_TPU_SIM_SENTINEL" not in os.environ
+
+
+def test_artifact_names_are_per_leg(tmp_path, spec):
+    """One seed can fail several legs in a sweep round; each failure
+    keeps its own artifact file."""
+    scenario = _scenario(spec, _short_script(spec), name="steady", seed=2)
+    p1 = repro.dump_artifact(scenario, "inject[merkle.dispatch@1]", "a",
+                             out_dir=str(tmp_path))
+    p2 = repro.dump_artifact(scenario, "storm", "b", out_dir=str(tmp_path))
+    p3 = repro.dump_artifact(scenario, "spec-differential", "c",
+                             out_dir=str(tmp_path))
+    assert len({p1, p2, p3}) == 3
+
+
+def test_minimize_failure_dumps_reduced_artifact(spec, monkeypatch,
+                                                 tmp_path):
+    """End-to-end failure workflow: a silent fallback (simulated) is
+    minimized by the shrinker and dumped as a replayable artifact."""
+    monkeypatch.setenv("CS_TPU_SIM_ARTIFACTS", str(tmp_path))
+    scenario = _scenario(spec, _short_script(spec), name="steady", seed=9)
+    baseline, _ = harness.run_baseline(spec, scenario)
+    monkeypatch.setattr(faults, "count_fallback",
+                        lambda series, exc=None, organic="guard": None)
+    with pytest.raises(harness.LegFailure) as exc:
+        harness.run_injected(spec, scenario, baseline,
+                             "epoch.rewards_and_penalties", 1)
+    path = harness.minimize_failure(spec, exc.value, budget=12)
+    payload = json.loads(open(path).read())
+    assert payload["failure"]["kind"] == \
+        "inject[epoch.rewards_and_penalties@1]"
+    # the shrinker ran under its budget and never grew the script
+    assert len(payload["script"]) <= payload["original_steps"]
+
+
+def test_replay_of_storm_artifact_arms_the_full_storm(tmp_path, spec,
+                                                      monkeypatch):
+    """A storm artifact records a multi-site schedule; replay must
+    re-run it as ONE storm leg (cross-site interaction preserved), not
+    as a sequence of single-trigger legs that would each pass."""
+    scenario = _scenario(spec, _short_script(spec), name="steady", seed=3)
+    sched = faults.FaultSchedule({"epoch.slashings": [1],
+                                  "merkle.dispatch": [1]})
+    path = repro.dump_artifact(scenario, "storm", "storm failure",
+                               schedule=sched, out_dir=str(tmp_path),
+                               fork="phase0", preset="minimal")
+
+    def storm_reproduces(spec_, scenario_, baseline_, census_):
+        raise harness.LegFailure("storm", scenario_, "still diverges",
+                                 category="diverged")
+
+    def no_single_triggers(*a, **kw):
+        raise RuntimeError("storm replay must not split into "
+                           "single-trigger legs")
+
+    monkeypatch.setattr(harness, "run_storm", storm_reproduces)
+    monkeypatch.setattr(harness, "run_injected", no_single_triggers)
+    assert repro.replay(path) == 1
+
+
+def test_sweep_contains_leg_crashes(tmp_path, spec, monkeypatch, capsys):
+    """A non-LegFailure crash inside an injected/storm/differential leg
+    is contained as a category=crashed failure (artifact dumped, sweep
+    exits 1) instead of aborting the sweep and discarding the failures
+    already collected."""
+    import argparse
+    from consensus_specs_tpu.sim import sweep
+
+    monkeypatch.setattr(
+        harness, "run_spec_differential",
+        lambda *a, **kw: (_ for _ in ()).throw(
+            TypeError("spec loop exploded")))
+    args = argparse.Namespace(
+        seeds=2, start=0, fork="phase0", preset="minimal",
+        inject_every=1000, max_sites=1, diff_every=1, bls_seeds=0,
+        min_scenarios=2, artifact_dir=str(tmp_path), shrink_budget=2,
+        time_budget=None)
+    code = sweep.run_sweep(args)
+    out = capsys.readouterr().out
+    assert code == 1
+    # both baselines still completed despite every diff leg crashing
+    assert "2 scenarios" in out
+    names = sorted(p.name for p in tmp_path.iterdir())
+    assert len(names) == 2 and all("spec-differential" in n
+                                   for n in names)

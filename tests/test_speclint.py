@@ -21,7 +21,8 @@ from consensus_specs_tpu.tools.speclint import driver
 from consensus_specs_tpu.tools.speclint.findings import (
     Finding, noqa_codes, suppressed)
 from consensus_specs_tpu.tools.speclint.passes import (
-    ladder, obs as obs_pass, specmd, state_layer, style, tracing, uint64)
+    fallbacks, ladder, obs as obs_pass, specmd, state_layer, style,
+    tracing, uint64)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -624,3 +625,107 @@ def test_real_tree_baseline_has_no_code_findings():
     assert counts, "baseline unexpectedly empty"
     for key in counts:
         assert key.startswith("specs/"), f"code debt crept in: {key}"
+
+
+# ---------------------------------------------------------------------------
+# counted-fallback pass (R7xx)
+# ---------------------------------------------------------------------------
+
+def test_fallbacks_flags_uncounted_fallback_catch():
+    """R701: absorbing the guard signal without booking the trip is a
+    silent fallback — the exact failure mode the adversarial harness
+    hunts dynamically."""
+    src = (
+        "def try_fast(spec, state):\n"
+        "    try:\n"
+        "        kernel(state)\n"
+        "    except _Fallback:\n"
+        "        return False\n"
+        "    return True\n")
+    findings = fallbacks.check_source(SCOPED, src)
+    assert _codes(findings) == ["R701"]
+    assert findings[0].line == 4      # anchored at the handler
+
+
+def test_fallbacks_flags_uncounted_injected_fault():
+    src = (
+        "from consensus_specs_tpu import faults\n"
+        "def entry(state):\n"
+        "    try:\n"
+        "        fast(state)\n"
+        "    except (ValueError, faults.InjectedFault):\n"
+        "        slow(state)\n")
+    assert _codes(fallbacks.check_source(SCOPED, src)) == ["R701"]
+
+
+def test_fallbacks_accepts_counted_handler():
+    """Routing through count_fallback discharges R701 — anywhere in the
+    function, since the BLS flush defers counting past the handler."""
+    src = (
+        "from consensus_specs_tpu import faults\n"
+        "def try_fast(spec, state):\n"
+        "    injected = None\n"
+        "    try:\n"
+        "        kernel(state)\n"
+        "    except (_Fallback, faults.InjectedFault) as exc:\n"
+        "        injected = exc\n"
+        "    faults.count_fallback(_SERIES, injected)\n"
+        "    return injected is None\n")
+    assert fallbacks.check_source(SCOPED, src) == []
+
+
+def test_fallbacks_flags_baseexception_swallow():
+    """R702: a BaseException (or bare) catch-all with no raise defeats
+    the InjectedFault-escapes-catch-alls design."""
+    src = (
+        "def run(case):\n"
+        "    try:\n"
+        "        case()\n"
+        "    except BaseException:\n"
+        "        return 'error'\n")
+    assert _codes(fallbacks.check_source(SCOPED, src)) == ["R702"]
+    bare = src.replace("except BaseException:", "except:")
+    assert _codes(fallbacks.check_source(SCOPED, bare)) == ["R702"]
+
+
+def test_fallbacks_accepts_reraising_baseexception():
+    """The gen_runner shape: classify, then re-raise — not a swallow."""
+    src = (
+        "def run(case):\n"
+        "    try:\n"
+        "        case()\n"
+        "    except BaseException as exc:\n"
+        "        if type(exc).__name__ == 'Skipped':\n"
+        "            return 'skipped'\n"
+        "        raise\n")
+    assert fallbacks.check_source(SCOPED, src) == []
+
+
+def test_fallbacks_scope_and_noqa():
+    uncounted = (
+        "def f(state):\n"
+        "    try:\n"
+        "        g(state)\n"
+        "    except _Fallback:\n"
+        "        pass\n")
+    swallow = (
+        "def f(case):\n"
+        "    try:\n"
+        "        case()\n"
+        "    except BaseException:\n"
+        "        pass\n")
+    # gen/ and sim/ are R702-only layers: faults must traverse them
+    # unswallowed, but they have no engine handlers to count
+    gen_path = "consensus_specs_tpu/gen/gen_runner.py"
+    assert fallbacks.check_source(gen_path, uncounted) == []
+    assert _codes(fallbacks.check_source(gen_path, swallow)) == ["R702"]
+    # out of scope entirely
+    assert fallbacks.check_source("tests/test_x.py", swallow) == []
+    assert fallbacks.check_source("benchmarks/bench_all.py", swallow) == []
+    # noqa suppression (driver-side), with non-empty findings to suppress
+    suppressed_src = uncounted.replace(
+        "except _Fallback:", "except _Fallback:  # noqa: R701")
+    findings = fallbacks.check_source(SCOPED, suppressed_src)
+    lines = suppressed_src.split("\n")
+    assert findings, "R701 must fire so the noqa suppresses something"
+    assert all(suppressed(f, lines) for f in findings)

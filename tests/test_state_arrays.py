@@ -381,7 +381,7 @@ def test_one_registry_extraction_per_epoch_replay():
     assert delta["state_arrays.extracts{column=registry}"] \
         + delta["state_arrays.adoptions"] <= 3
     assert delta["epoch.transition{path=vectorized}"] > 0
-    assert delta["epoch.fallbacks"] == 0
+    assert delta["epoch.fallbacks{reason=guard}"] == 0
     # balance-family commits: exactly one per epoch transition
     assert delta["state_arrays.commits"] == 3
 
@@ -436,7 +436,7 @@ def test_guard_fallback_flushes_pending_writes():
     arrays.use_arrays()
     with counting() as delta:
         next_epoch(spec, s_vec)
-    assert delta["epoch.fallbacks"] >= 1
+    assert delta["epoch.fallbacks{reason=guard}"] >= 1
     assert bytes(hash_tree_root(s_loop)) == bytes(hash_tree_root(s_vec))
 
 
