@@ -8,8 +8,8 @@ GENERATORS = operations sanity finality rewards random forks epoch_processing \
 
 .PHONY: test citest test-crypto bench bench-all bench-merkle-smoke \
         bench-forkchoice-smoke bench-obs-smoke bench-block-smoke \
-        bench-state-smoke sim-smoke sim-heavy obs-report dryrun \
-        warm native lint speclint-baseline \
+        bench-state-smoke bench-supervisor-smoke sim-smoke sim-heavy \
+        obs-report dryrun warm native lint speclint-baseline \
         generate_tests $(addprefix gen_,$(GENERATORS)) clean-vectors pyspec
 
 # fast local suite: signature checks off except @always_bls
@@ -32,6 +32,7 @@ citest:
 	$(PYTHON) benchmarks/bench_fork_choice.py --smoke
 	$(PYTHON) benchmarks/bench_block_verify.py --smoke
 	$(PYTHON) benchmarks/bench_state_arrays.py --smoke
+	$(PYTHON) benchmarks/bench_supervisor.py
 	$(MAKE) sim-smoke
 	$(PYTHON) -m pytest tests/ -q --enable-bls --bls-type fastest
 
@@ -120,7 +121,7 @@ bench-state-smoke:
 # pathological host into a controlled failure instead of a CI hang.
 sim-smoke:
 	$(PYTHON) -m consensus_specs_tpu.sim.sweep --seeds 200 \
-		--min-scenarios 200 --time-budget 1500
+		--min-scenarios 200 --time-budget 2400
 
 # the CS_TPU_HEAVY nightly shape: a thousand seeds on a denser
 # injection cadence with more real-signature seeds, then the cross-leg
@@ -140,6 +141,16 @@ sim-heavy:
 # per-op cost; nonzero exit above the bound)
 bench-obs-smoke:
 	$(PYTHON) benchmarks/bench_obs_overhead.py
+
+# engine-supervisor smoke (docs/robustness.md): counter-asserted
+# breaker lifecycle on a real dispatch site (threshold trips ->
+# open -> skip -> half-open probe -> closed; corrupt-mode result +
+# rate-1 sentinel audit -> quarantine + artifact), then the
+# enabled-path overhead bound: supervisor ON must cost <2% of the
+# 32-slot replay (exact call census x measured per-op cost, the
+# bench_obs_overhead discipline; nonzero exit above the bound)
+bench-supervisor-smoke:
+	$(PYTHON) benchmarks/bench_supervisor.py
 
 # human telemetry view: 32-slot replay with full tracing, span tree +
 # metric catalog (see docs/observability.md; --format json|prom for the

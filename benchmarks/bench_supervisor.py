@@ -1,0 +1,250 @@
+"""Supervisor smoke: breaker lifecycle census + enabled-path overhead
+(``make bench-supervisor-smoke``).
+
+Two asserted claims back the supervisor's shipping default (on):
+
+1. **Demote / re-promote census** — driving counted fallbacks through a
+   real engine entry point (``merkle.hash_rows``) must walk the breaker
+   through its full lifecycle with exact counter evidence: threshold
+   trips -> ``closed->open`` (one transition, skips while open, the
+   skip serving byte-identical scalar digests), backoff expiry ->
+   ``open->half_open`` probe, probe success -> ``half_open->closed``.
+   A corrupt-mode schedule under rate-1 audits must then quarantine the
+   site: one failed audit, one quarantine, one artifact.  The telemetry
+   snapshot is schema-checked with ``supervisor.*`` required non-empty.
+
+2. **Enabled overhead** — with the supervisor ON (the default) but no
+   faults, audits, or deadlines armed, the added per-dispatch cost
+   across the engine stack must stay under 2% of the 32-slot replay —
+   the same bound and census-times-per-op-cost discipline as
+   ``bench_obs_overhead.py`` (wall-clock A/B of a ~1s python workload
+   is noise at this scale; the decomposition is exact).
+
+Exits nonzero on any census mismatch or when the computed overhead
+reaches 2%.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SLOTS = 32
+VALIDATORS = 256
+REPS = 3
+
+
+def _best_of(fn, reps=3) -> float:
+    return min(fn() for _ in range(reps))
+
+
+# ---------------------------------------------------------------------------
+# 1. breaker lifecycle census
+# ---------------------------------------------------------------------------
+
+def lifecycle_census() -> dict:
+    import numpy as np
+    from consensus_specs_tpu import faults, supervisor
+    from consensus_specs_tpu.obs import registry
+    from consensus_specs_tpu.test_infra.metrics import counting
+    from consensus_specs_tpu.utils.ssz import merkle
+
+    site = "merkle.dispatch"
+    knobs = {"CS_TPU_BREAKER_THRESHOLD": "2",
+             "CS_TPU_BREAKER_WINDOW_MS": "60000",
+             "CS_TPU_BREAKER_BACKOFF_MS": "5",
+             "CS_TPU_BREAKER_BACKOFF_MAX_MS": "5"}
+    saved = {k: os.environ.get(k) for k in knobs}
+    os.environ.update(knobs)
+    rows = np.arange(16 * 64, dtype=np.uint8).reshape(16, 64)
+    golden = merkle._hash_rows_scalar(rows)
+    try:
+        supervisor.reset()
+        with counting() as delta:
+            # two injected fallbacks = the threshold: breaker opens
+            schedule = faults.FaultSchedule({site: [1, 2]})
+            with faults.injected(schedule):
+                for _ in range(2):
+                    out = merkle.hash_rows(rows)
+                    assert np.array_equal(out, golden)
+            assert schedule.fully_fired(), "injection schedule leaked"
+            assert supervisor.states()[site] == "open", \
+                f"breaker not open after threshold trips: " \
+                f"{supervisor.states()[site]}"
+            # demoted: the next dispatch is skipped onto the scalar
+            # path, byte-identical
+            out = merkle.hash_rows(rows)
+            assert np.array_equal(out, golden)
+            # backoff expiry: the next call is the half-open probe and
+            # its success re-promotes the engine
+            time.sleep(0.05)
+            out = merkle.hash_rows(rows)
+            assert np.array_equal(out, golden)
+            assert supervisor.states()[site] == "closed", \
+                "probe success did not re-close the breaker"
+        demote = {
+            "fallbacks_injected": delta[
+                "merkle.fallbacks{reason=injected}"],
+            "opened": delta[f"supervisor.transitions{{site={site},to=open}}"],
+            "skips": delta[f"supervisor.breaker.skips{{site={site}}}"],
+            "half_open": delta[
+                f"supervisor.transitions{{site={site},to=half_open}}"],
+            "closed": delta[
+                f"supervisor.transitions{{site={site},to=closed}}"],
+        }
+        expected = {"fallbacks_injected": 2, "opened": 1, "skips": 1,
+                    "half_open": 1, "closed": 1}
+        assert demote == expected, f"lifecycle census {demote} != {expected}"
+
+        # quarantine: persistent silent corruption under rate-1 audits
+        os.environ["CS_TPU_AUDIT_RATE"] = "1"
+        supervisor.reset()
+        dumped = []
+        try:
+            with supervisor.quarantine_hook(
+                    lambda s, d: dumped.append((s, d)) or "bench"):
+                with counting() as delta:
+                    schedule = faults.FaultSchedule(corrupt={site: [1]})
+                    with faults.injected(schedule):
+                        out = merkle.hash_rows(rows)
+            assert np.array_equal(out, golden), \
+                "audit did not serve the authoritative scalar digests"
+            assert supervisor.states()[site] == "quarantined"
+            assert delta[f"supervisor.audits{{result=fail,site={site}}}"] \
+                == 1
+            assert delta[f"supervisor.quarantines{{site={site}}}"] == 1
+            assert dumped and dumped[0][0] == site
+        finally:
+            os.environ.pop("CS_TPU_AUDIT_RATE", None)
+
+        from consensus_specs_tpu.obs import export
+        export.assert_schema(export.snapshot(),
+                             require_nonempty=("supervisor.",))
+        quarantine = {
+            "audit_fails": 1, "quarantines": 1,
+            "artifact_hook_fired": bool(dumped),
+        }
+        registry.reset("supervisor")
+        return {"demote_repromote": demote, "quarantine": quarantine}
+    finally:
+        supervisor.reset()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+# ---------------------------------------------------------------------------
+# 2. enabled-path overhead on the 32-slot replay
+# ---------------------------------------------------------------------------
+
+def _per_op_ns(fn, n=200_000) -> float:
+    def one():
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        return (time.perf_counter() - t0) / n * 1e9
+    return _best_of(one)
+
+
+def _fresh_replay_args():
+    from consensus_specs_tpu.forks import build_spec
+    from consensus_specs_tpu.tools.obs_report import build_state
+    spec = build_spec("phase0", "minimal")
+    return spec, build_state(spec, VALIDATORS)
+
+
+def _census() -> dict:
+    """Supervisor API calls one replay performs, counted by patching
+    the module functions (the timed replays run unpatched)."""
+    from consensus_specs_tpu import supervisor
+    from consensus_specs_tpu.tools.obs_report import replay
+
+    counts = {"admit": 0, "note_success": 0, "audit_due": 0,
+              "deadline_scope": 0}
+    originals = {name: getattr(supervisor, name) for name in counts}
+
+    def _wrap(name, orig):
+        def counted(*args, **kwargs):
+            counts[name] += 1
+            return orig(*args, **kwargs)
+        return counted
+
+    spec, state = _fresh_replay_args()
+    supervisor.reset()
+    for name, orig in originals.items():
+        setattr(supervisor, name, _wrap(name, orig))
+    try:
+        replay(spec, state, SLOTS)
+    finally:
+        for name, orig in originals.items():
+            setattr(supervisor, name, orig)
+        supervisor.reset()
+    return counts
+
+
+def _timed_replay() -> float:
+    from consensus_specs_tpu.tools.obs_report import replay
+    spec, state = _fresh_replay_args()
+    t0 = time.perf_counter()
+    replay(spec, state, SLOTS)
+    return time.perf_counter() - t0
+
+
+def overhead() -> dict:
+    from consensus_specs_tpu import supervisor
+    supervisor.reset()
+
+    admit_ns = _per_op_ns(lambda: supervisor.admit("merkle.dispatch"))
+    note_ns = _per_op_ns(lambda: supervisor.note_success("merkle.dispatch"))
+    audit_ns = _per_op_ns(lambda: supervisor.audit_due("merkle.dispatch"))
+
+    def _scope():
+        with supervisor.deadline_scope("merkle.dispatch"):
+            pass
+    scope_ns = _per_op_ns(_scope, n=100_000)
+
+    counts = _census()
+    replay_s = min(_timed_replay() for _ in range(REPS))
+
+    overhead_s = (counts["admit"] * admit_ns
+                  + counts["note_success"] * note_ns
+                  + counts["audit_due"] * audit_ns
+                  + counts["deadline_scope"] * scope_ns) / 1e9
+    return {
+        "admit_ns": round(admit_ns, 1),
+        "note_success_ns": round(note_ns, 1),
+        "audit_due_ns": round(audit_ns, 1),
+        "deadline_scope_ns": round(scope_ns, 1),
+        "calls_per_replay": counts,
+        "replay_s": round(replay_s, 4),
+        "computed_overhead_s": round(overhead_s, 6),
+        "computed_overhead_pct": round(overhead_s / replay_s * 100.0, 3),
+    }
+
+
+def main() -> int:
+    from consensus_specs_tpu.utils import bls
+    bls.bls_active = False
+
+    lifecycle = lifecycle_census()
+    cost = overhead()
+
+    print(json.dumps({
+        "metric": f"supervisor lifecycle census + enabled-path overhead, "
+                  f"{SLOTS}-slot replay, {VALIDATORS} validators",
+        "lifecycle": lifecycle,
+        "overhead": cost,
+    }), flush=True)
+
+    pct = cost["computed_overhead_pct"]
+    assert pct < 2.0, (
+        f"supervisor enabled-path overhead {pct:.2f}% >= 2% of the "
+        f"{SLOTS}-slot replay")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

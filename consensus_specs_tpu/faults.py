@@ -76,13 +76,25 @@ class FaultSchedule:
     assert the schedule discharged exactly as planned — an engine
     change that stops hitting a site turns into a loud scheduling
     mismatch instead of a vacuously green run.
+
+    ``corrupt`` maps a site name to the 1-based call ordinal from which
+    the engine's *result* is silently wrong: starting at that ordinal,
+    :func:`corrupt_armed` answers True for every call, and the engine
+    applies its site-specific deterministic mutation instead of
+    raising.  This models the failure mode PR 8 could not reach — an
+    engine that returns instead of failing — and is what the
+    supervisor's sentinel audits exist to catch.  Corruption events are
+    recorded in ``corrupted`` for discharge assertions.
     """
 
-    def __init__(self, triggers=None):
+    def __init__(self, triggers=None, corrupt=None):
         self.triggers = {site: set(ns)
                          for site, ns in (triggers or {}).items() if ns}
+        self.corrupt = {site: min(ns)
+                        for site, ns in (corrupt or {}).items() if ns}
         self.calls = {}
         self.fired = []
+        self.corrupted = []
 
     def hit(self, site: str) -> None:
         n = self.calls.get(site, 0) + 1
@@ -90,6 +102,19 @@ class FaultSchedule:
         if n in self.triggers.get(site, ()):
             self.fired.append((site, n))
             raise InjectedFault(site, n)
+
+    def corrupting(self, site: str) -> bool:
+        """Whether the site's CURRENT call (the one the immediately
+        preceding :meth:`hit` counted) is scheduled for silent result
+        corruption."""
+        start = self.corrupt.get(site)
+        if start is None:
+            return False
+        n = self.calls.get(site, 0)
+        if n < start:
+            return False
+        self.corrupted.append((site, n))
+        return True
 
     @property
     def planned(self) -> int:
@@ -115,6 +140,19 @@ def check(site: str) -> None:
         sched.hit(site)
 
 
+def corrupt_armed(site: str) -> bool:
+    """Whether the engine must corrupt the result of the call it just
+    computed (silent-corruption injection — the sentinel-audit test
+    vector).  Engines that support the mode call this after their fast
+    path, immediately before returning, and apply a deterministic
+    site-specific mutation when it answers True.  Disarmed cost: one
+    global read."""
+    sched = _active
+    if sched is None or not sched.corrupt:
+        return False
+    return sched.corrupting(site)
+
+
 def active():
     return _active
 
@@ -133,14 +171,34 @@ def injected(schedule: FaultSchedule):
         _active = None
 
 
-def count_fallback(series: dict, exc=None, organic: str = "guard") -> None:
+# set by consensus_specs_tpu.supervisor at its import: the failure hook
+# receives (site, reason) for every counted fallback so trips feed the
+# site's circuit breaker, and ``_deadline_cls`` is the supervisor's
+# DeadlineExceeded type for reason classification.  Hooks (rather than
+# an import) keep this module dependency-free for test collection.
+_failure_hook = None
+_deadline_cls = ()
+
+
+def count_fallback(series: dict, exc=None, organic: str = "guard",
+                   site: str = None) -> None:
     """Account one engine fallback on its reason-labeled counter.
 
     ``series`` maps reason -> pre-bound counter series (module-scope
     resolution, the speclint O5xx hot-path rule); ``exc`` is the caught
     exception (or None for a non-exception organic fallback such as the
     BLS bisect); ``organic`` names the reason used when the trip was
-    not injected.  Every engine handler that absorbs a fallback-class
-    exception must route through here (speclint R7xx)."""
-    reason = "injected" if isinstance(exc, InjectedFault) else organic
+    neither injected nor a deadline guard.  ``site`` is the engine's
+    :data:`SITES` name — when given, the trip additionally feeds the
+    supervisor's circuit breaker for that site.  Every engine handler
+    that absorbs a fallback-class exception must route through here
+    (speclint R7xx)."""
+    if isinstance(exc, InjectedFault):
+        reason = "injected"
+    elif _deadline_cls and isinstance(exc, _deadline_cls):
+        reason = "deadline"
+    else:
+        reason = organic
     series[reason].add()
+    if site is not None and _failure_hook is not None:
+        _failure_hook(site, reason)

@@ -52,11 +52,10 @@ Layering mirrors ``ops/epoch_kernels``:
       calls).
 """
 import functools
-import os
 
 import numpy as np
 
-from consensus_specs_tpu import faults
+from consensus_specs_tpu import faults, supervisor
 from consensus_specs_tpu.obs import registry as obs_registry
 from consensus_specs_tpu.obs.tracing import span
 from consensus_specs_tpu.state import arrays as state_arrays
@@ -99,10 +98,7 @@ def enabled() -> bool:
         return True
     if _mode == "off":
         return False
-    raw = os.environ.get("CS_TPU_PROTO_ARRAY")
-    if raw is None:
-        return env_flags.PROTO_ARRAY
-    return raw != "0"
+    return env_flags.switch("CS_TPU_PROTO_ARRAY")
 
 
 def backend_name() -> str:
@@ -137,6 +133,7 @@ _C_FALLBACKS_ALL = obs_registry.counter("forkchoice.fallbacks")
 _FALLBACKS = {
     "guard": _C_FALLBACKS_ALL.labels(reason="guard"),
     "injected": _C_FALLBACKS_ALL.labels(reason="injected"),
+    "deadline": _C_FALLBACKS_ALL.labels(reason="deadline"),
 }
 _C_ANC_HIT = obs_registry.counter("cache.hit").labels(cache="fc_ancestors")
 _C_ANC_MISS = obs_registry.counter("cache.miss").labels(cache="fc_ancestors")
@@ -579,51 +576,76 @@ class ProtoArrayEngine:
 
     def head(self, spec, store):
         """Root of the canonical head, or None to fall back."""
-        if self._broken:
+        if self._broken or not supervisor.admit("forkchoice.head"):
             return None
         try:
             faults.check("forkchoice.head")
-            self._refresh(spec, store)
-        except (_Fallback, faults.InjectedFault) as exc:
-            faults.count_fallback(_FALLBACKS, exc)
+            with supervisor.deadline_scope("forkchoice.head"):
+                self._refresh(spec, store)
+                # boundary: a pathologically slow refresh (vote deltas,
+                # prune) converts into a counted fallback before the
+                # sweep runs
+                supervisor.deadline_check()
+        except (_Fallback, faults.InjectedFault,
+                supervisor.DeadlineExceeded) as exc:
+            faults.count_fallback(_FALLBACKS, exc, site="forkchoice.head")
             return None
         j = self._index.get(bytes(store.justified_checkpoint.root))
         if j is None:
-            _FALLBACKS["guard"].add()
+            faults.count_fallback(_FALLBACKS, site="forkchoice.head")
             return None
         _, _, best_desc = self._sweep(spec, store)
-        return self._roots[best_desc[j]]
+        head = self._roots[best_desc[j]]
+        if faults.corrupt_armed("forkchoice.head"):
+            # silent-corruption injection (sentinel-audit test vector):
+            # a byte-flipped root — deterministically wrong
+            head = bytes(head[:31]) + bytes([head[31] ^ 1])
+        supervisor.note_success("forkchoice.head")
+        return head
 
     def weight(self, spec, store, root: bytes):
         """Subtree weight of ``root`` (boost included), or None."""
-        if self._broken:
+        if self._broken or not supervisor.admit("forkchoice.weight"):
             return None
         try:
             faults.check("forkchoice.weight")
-            self._refresh(spec, store)
-        except (_Fallback, faults.InjectedFault) as exc:
-            faults.count_fallback(_FALLBACKS, exc)
+            with supervisor.deadline_scope("forkchoice.weight"):
+                self._refresh(spec, store)
+                supervisor.deadline_check()
+        except (_Fallback, faults.InjectedFault,
+                supervisor.DeadlineExceeded) as exc:
+            faults.count_fallback(_FALLBACKS, exc, site="forkchoice.weight")
             return None
         # look up only after _refresh: a prune inside it compacts the
         # arrays and remaps every index
         idx = self._index.get(bytes(root))
         if idx is None:
+            # breaker-neutral on purpose, unlike head/filtered_tree's
+            # justified-root miss: an unknown/pruned QUERY root says
+            # nothing about engine health, and counting it as a failure
+            # would let repeated unknown-root queries demote (or, in
+            # half-open, re-open) a healthy engine
             return None
+        supervisor.note_success("forkchoice.weight")
         return self._weight[idx]
 
     def filtered_block_tree(self, spec, store):
         """The spec's ``get_filtered_block_tree`` dict, or None."""
-        if self._broken:
+        if self._broken or not supervisor.admit("forkchoice.filtered_tree"):
             return None
         try:
             faults.check("forkchoice.filtered_tree")
-            self._refresh(spec, store)
-        except (_Fallback, faults.InjectedFault) as exc:
-            faults.count_fallback(_FALLBACKS, exc)
+            with supervisor.deadline_scope("forkchoice.filtered_tree"):
+                self._refresh(spec, store)
+                supervisor.deadline_check()
+        except (_Fallback, faults.InjectedFault,
+                supervisor.DeadlineExceeded) as exc:
+            faults.count_fallback(_FALLBACKS, exc,
+                                  site="forkchoice.filtered_tree")
             return None
         j = self._index.get(bytes(store.justified_checkpoint.root))
         if j is None:
-            _FALLBACKS["guard"].add()
+            faults.count_fallback(_FALLBACKS, site="forkchoice.filtered_tree")
             return None
         viable, _, _ = self._sweep(spec, store)
         n = self._n
@@ -638,6 +660,7 @@ class ProtoArrayEngine:
                 in_tree[i] = p >= 0 and in_tree[p]
             if in_tree[i] and viable[i]:
                 out[roots[i]] = store.blocks[roots[i]]
+        supervisor.note_success("forkchoice.filtered_tree")
         return out
 
 
@@ -646,8 +669,10 @@ class ProtoArrayEngine:
 # ---------------------------------------------------------------------------
 
 def _engine(store):
-    """The store's engine, for READ dispatch: honors the runtime switch."""
-    if not enabled():
+    """The store's engine, for READ dispatch: honors the runtime switch
+    and the supervisor's audit-probe flag (a sentinel audit's spec-loop
+    replay must not recurse into the engine under audit)."""
+    if not enabled() or supervisor.probing():
         return None
     eng = getattr(store, "_fc_proto", None)
     if eng is not None and eng._broken:
@@ -791,6 +816,18 @@ def install_forkchoice_accel(cls) -> None:
                 if eng is not None:
                     head = eng.head(self, store)
                     if head is not None:
+                        if supervisor.audit_due("forkchoice.head"):
+                            # sentinel audit: the spec loop's answer is
+                            # authoritative; a divergent engine head is
+                            # quarantined, never served
+                            with supervisor.probe():
+                                spec_head = orig(self, store)
+                            supervisor.audit_result(
+                                "forkchoice.head",
+                                bytes(spec_head) == bytes(head),
+                                "engine head diverged from the spec loop")
+                            _C_HEAD_SPEC.add()
+                            return spec_head
                         _C_HEAD_ENGINE.add()
                         return self.Root(head)
                 _C_HEAD_SPEC.add()
@@ -803,6 +840,15 @@ def install_forkchoice_accel(cls) -> None:
             if eng is not None:
                 w = eng.weight(self, store, root)
                 if w is not None:
+                    if supervisor.audit_due("forkchoice.weight"):
+                        with supervisor.probe():
+                            spec_w = orig(self, store, root)
+                        supervisor.audit_result(
+                            "forkchoice.weight", int(spec_w) == int(w),
+                            "engine subtree weight diverged from the "
+                            "spec loop")
+                        _C_WEIGHT_SPEC.add()
+                        return spec_w
                     _C_WEIGHT_ENGINE.add()
                     return self.Gwei(w)
             _C_WEIGHT_SPEC.add()
@@ -815,6 +861,17 @@ def install_forkchoice_accel(cls) -> None:
             if eng is not None:
                 tree = eng.filtered_block_tree(self, store)
                 if tree is not None:
+                    if supervisor.audit_due("forkchoice.filtered_tree"):
+                        with supervisor.probe():
+                            spec_tree = orig(self, store)
+                        supervisor.audit_result(
+                            "forkchoice.filtered_tree",
+                            {bytes(k) for k in tree}
+                            == {bytes(k) for k in spec_tree},
+                            "engine filtered block tree diverged from "
+                            "the spec loop")
+                        _C_TREE_SPEC.add()
+                        return spec_tree
                     _C_TREE_ENGINE.add()
                     return tree
             _C_TREE_SPEC.add()

@@ -47,6 +47,7 @@ from consensus_specs_tpu.ops.bls12_381 import ciphersuite as _oracle
 from consensus_specs_tpu.ops.bls12_381.fields import R_ORDER
 from consensus_specs_tpu.ops.bls12_381.hash_to_curve import hash_to_g2, DST_G2
 from consensus_specs_tpu.ops.bls12_381.pairing import multi_pairing_check
+from consensus_specs_tpu import supervisor
 from consensus_specs_tpu.utils.profiling import span
 
 SCALAR_BITS = 128
@@ -250,5 +251,11 @@ def combined_check(items, extra_checks, backend_name: str):
     """
     with span("bls.rlc.combine"):
         scalars = derive_scalars(items, extra_checks)
+        # cooperative deadline boundary between the (cheap) Fiat-Shamir
+        # scalar stage and the MSM + pairing stage: an armed per-dispatch
+        # budget (supervisor.deadline_scope in DeferredBatch.flush)
+        # converts a pathologically slow flush into a counted
+        # reason=deadline fallback onto the per-lane path
+        supervisor.deadline_check()
         combine = _COMBINERS.get(backend_name, _check_py)
         return combine(items, extra_checks, scalars)

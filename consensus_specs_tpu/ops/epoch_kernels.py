@@ -41,12 +41,12 @@ the host (the production CPU path) and ``jax.numpy`` under ``jax.jit``
 for device dispatch (uint64 lanes need ``jax_enable_x64``).
 """
 import math
-import os
 
 import numpy as np
 
-from consensus_specs_tpu import faults
+from consensus_specs_tpu import faults, supervisor
 from consensus_specs_tpu.obs import registry as obs_registry
+from consensus_specs_tpu.utils import env_flags
 
 from consensus_specs_tpu.state import arrays as state_arrays
 # shared commit/extraction primitives live in the state layer now;
@@ -92,7 +92,7 @@ def enabled() -> bool:
         return True
     if _mode == "off":
         return False
-    return os.environ.get("CS_TPU_VECTORIZED_EPOCH") != "0"
+    return env_flags.switch("CS_TPU_VECTORIZED_EPOCH")
 
 
 # vectorized-commit / guard-fallback counters; the differential suite
@@ -112,6 +112,7 @@ _C_EPOCH_FALLBACKS_ALL = obs_registry.counter("epoch.fallbacks")
 _EPOCH_FALLBACKS = {
     "guard": _C_EPOCH_FALLBACKS_ALL.labels(reason="guard"),
     "injected": _C_EPOCH_FALLBACKS_ALL.labels(reason="injected"),
+    "deadline": _C_EPOCH_FALLBACKS_ALL.labels(reason="deadline"),
 }
 
 
@@ -304,30 +305,110 @@ def _mask_from_indices(n, indices) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# Supervised dispatch plumbing (shared by the five try_process_* sites)
+# ---------------------------------------------------------------------------
+
+def _audited(spec, state, site, method_name, fast_fn) -> bool:
+    """Sentinel-audited epoch call (``supervisor.audit_due``): the spec
+    loop runs on the REAL state — its result is authoritative, so a
+    silently-wrong kernel cannot leak into the chain even on the
+    audited call itself — while the vectorized kernel runs on a
+    throwaway copy, and the two post-states must merkleize
+    byte-identical.  A mismatch quarantines the site.  Returns True:
+    the sub-transition has been applied (by the spec loop) either way,
+    so the caller must not run its body again."""
+    from consensus_specs_tpu.utils.ssz import hash_tree_root
+    probe = state.copy()
+    handled = False
+    try:
+        faults.check(site)
+        with supervisor.deadline_scope(site):
+            handled = fast_fn(spec, probe)
+    except (_Fallback, faults.InjectedFault,
+            supervisor.DeadlineExceeded) as exc:
+        faults.count_fallback(_EPOCH_FALLBACKS, exc, site=site)
+    # the spec loop, via the wrapped/inline-dispatched spec method:
+    # probing() makes every try_process_* decline — which books the
+    # path=loop counter and flushes pending columns itself (_decline),
+    # so this helper must NOT double-book either — and the replay
+    # really is the per-validator loop, never recursing into the kernel
+    with supervisor.probe():
+        getattr(spec, method_name)(state)
+    if handled:
+        state_arrays.flush(probe)
+        ok = bytes(hash_tree_root(probe)) == bytes(hash_tree_root(state))
+        supervisor.audit_result(
+            site, ok, f"vectorized {method_name} post-state root "
+            "diverged from the spec loop")
+    return True
+
+
+def _decline(state) -> bool:
+    """The common spec-loop decline bookkeeping (returns False)."""
+    state_arrays.flush(state)
+    _C_EPOCH_LOOP.add()
+    return False
+
+
+def _supervised(spec, state, site, method_name, fast_fn) -> bool:
+    """The shared supervised-dispatch skeleton behind every
+    try_process_* site (the per-site wrappers keep only their
+    site-specific no-op pre-checks): breaker admission, sentinel-audit
+    sampling, the fault hook + deadline scope around the kernel body,
+    counted fallback on any fallback-class trip, health reporting on
+    success.  ``fast_fn`` returns False when the kernel has nothing to
+    do (the caller's spec body runs instead, no fallback implied)."""
+    if not supervisor.admit(site):
+        return _decline(state)
+    if supervisor.audit_due(site):
+        return _audited(spec, state, site, method_name, fast_fn)
+    try:
+        faults.check(site)
+        with supervisor.deadline_scope(site):
+            if not fast_fn(spec, state):
+                _C_EPOCH_LOOP.add()
+                return False
+    except (_Fallback, faults.InjectedFault,
+            supervisor.DeadlineExceeded) as exc:
+        state_arrays.flush(state)
+        faults.count_fallback(_EPOCH_FALLBACKS, exc, site=site)
+        _C_EPOCH_LOOP.add()
+        return False
+    supervisor.note_success(site)
+    _C_EPOCH_VECTORIZED.add()
+    return True
+
+
+# ---------------------------------------------------------------------------
 # process_rewards_and_penalties
 # ---------------------------------------------------------------------------
 
+def _fast_rewards_and_penalties(spec, state) -> bool:
+    if "altair" in _fork_lineage(spec):
+        _altair_rewards_and_penalties(spec, state)
+    else:
+        _phase0_rewards_and_penalties(spec, state)
+    if faults.corrupt_armed("epoch.rewards_and_penalties"):
+        # silent-corruption injection (sentinel-audit test vector):
+        # one gwei on validator 0, exactly the class of wrongness a
+        # counted fallback can never surface
+        sa = state_arrays.of(state)
+        balances = sa.balances().copy()
+        if balances.size:
+            balances[0] += np.uint64(1)
+            sa.set_balances(balances)
+    return True
+
+
 def try_process_rewards_and_penalties(spec, state) -> bool:
-    if not enabled():
-        state_arrays.flush(state)
-        _C_EPOCH_LOOP.add()
-        return False
+    if not enabled() or supervisor.probing():
+        return _decline(state)
     if spec.get_current_epoch(state) == spec.GENESIS_EPOCH:
         _C_EPOCH_LOOP.add()
         return False    # the spec body is already a no-op early return
-    try:
-        faults.check("epoch.rewards_and_penalties")
-        if "altair" in _fork_lineage(spec):
-            _altair_rewards_and_penalties(spec, state)
-        else:
-            _phase0_rewards_and_penalties(spec, state)
-    except (_Fallback, faults.InjectedFault) as exc:
-        state_arrays.flush(state)
-        faults.count_fallback(_EPOCH_FALLBACKS, exc)
-        _C_EPOCH_LOOP.add()
-        return False
-    _C_EPOCH_VECTORIZED.add()
-    return True
+    return _supervised(spec, state, "epoch.rewards_and_penalties",
+                       "process_rewards_and_penalties",
+                       _fast_rewards_and_penalties)
 
 
 def _base_reward_phase0(spec, cols, total_balance):
@@ -385,6 +466,9 @@ def _phase0_rewards_and_penalties(spec, state) -> None:
         reward_parts.append(r)
         penalty_parts.append(p)
 
+    # cooperative deadline boundary between the component kernels and
+    # the inclusion-delay pass (scope armed by the try_process wrapper)
+    supervisor.deadline_check()
     # inclusion-delay rewards: one ordered pass over the source
     # attestations finds each attester's earliest-included attestation
     # (the spec's min() keeps the first minimum, hence the strict <)
@@ -483,6 +567,9 @@ def _altair_rewards_and_penalties(spec, state) -> None:
     delta_pairs = []
     target_participating = None
     for flag_index, weight in enumerate(spec.PARTICIPATION_FLAG_WEIGHTS):
+        # cooperative deadline boundary: one check per flag component
+        # (deadline_scope armed by try_process_rewards_and_penalties)
+        supervisor.deadline_check()
         participating = _altair_participation(
             spec, sa, cols, flag_index, active_prev)
         if flag_index == target_flag:
@@ -523,64 +610,54 @@ def _altair_rewards_and_penalties(spec, state) -> None:
 # process_inactivity_updates (altair+)
 # ---------------------------------------------------------------------------
 
-def try_process_inactivity_updates(spec, state) -> bool:
-    if not enabled():
-        state_arrays.flush(state)
-        _C_EPOCH_LOOP.add()
+def _fast_inactivity_updates(spec, state) -> bool:
+    sa = state_arrays.of(state)
+    cols = sa.registry()
+    if len(cols) == 0:
         return False
+    prev_epoch = int(spec.get_previous_epoch(state))
+    active_prev, eligible = _epoch_masks(spec, cols, prev_epoch)
+    participating = _altair_participation(
+        spec, sa, cols, int(spec.TIMELY_TARGET_FLAG_INDEX), active_prev)
+    scores = sa.inactivity_scores()
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    _guard(int(scores.max(initial=0)) + bias)
+    new_scores = inactivity_updates_kernel(
+        np, scores, eligible, participating, bias=bias,
+        recovery_rate=int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE),
+        in_leak=bool(spec.is_in_inactivity_leak(state)))
+    sa.set_inactivity_scores(new_scores)
+    return True
+
+
+def try_process_inactivity_updates(spec, state) -> bool:
+    if not enabled() or supervisor.probing():
+        return _decline(state)
     if spec.get_current_epoch(state) == spec.GENESIS_EPOCH:
         _C_EPOCH_LOOP.add()
         return False    # spec body no-ops
     if "altair" not in _fork_lineage(spec):
         _C_EPOCH_LOOP.add()
         return False
-    try:
-        faults.check("epoch.inactivity_updates")
-        sa = state_arrays.of(state)
-        cols = sa.registry()
-        if len(cols) == 0:
-            _C_EPOCH_LOOP.add()
-            return False
-        prev_epoch = int(spec.get_previous_epoch(state))
-        active_prev, eligible = _epoch_masks(spec, cols, prev_epoch)
-        participating = _altair_participation(
-            spec, sa, cols, int(spec.TIMELY_TARGET_FLAG_INDEX), active_prev)
-        scores = sa.inactivity_scores()
-        bias = int(spec.config.INACTIVITY_SCORE_BIAS)
-        _guard(int(scores.max(initial=0)) + bias)
-        new_scores = inactivity_updates_kernel(
-            np, scores, eligible, participating, bias=bias,
-            recovery_rate=int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE),
-            in_leak=bool(spec.is_in_inactivity_leak(state)))
-        sa.set_inactivity_scores(new_scores)
-    except (_Fallback, faults.InjectedFault) as exc:
-        state_arrays.flush(state)
-        faults.count_fallback(_EPOCH_FALLBACKS, exc)
-        _C_EPOCH_LOOP.add()
-        return False
-    _C_EPOCH_VECTORIZED.add()
-    return True
+    return _supervised(spec, state, "epoch.inactivity_updates",
+                       "process_inactivity_updates",
+                       _fast_inactivity_updates)
 
 
 # ---------------------------------------------------------------------------
 # process_registry_updates
 # ---------------------------------------------------------------------------
 
-def try_process_registry_updates(spec, state) -> bool:
-    if not enabled():
-        state_arrays.flush(state)
-        _C_EPOCH_LOOP.add()
-        return False
-    try:
-        faults.check("epoch.registry_updates")
-        _registry_updates(spec, state)
-    except (_Fallback, faults.InjectedFault) as exc:
-        state_arrays.flush(state)
-        faults.count_fallback(_EPOCH_FALLBACKS, exc)
-        _C_EPOCH_LOOP.add()
-        return False
-    _C_EPOCH_VECTORIZED.add()
+def _fast_registry_updates(spec, state) -> bool:
+    _registry_updates(spec, state)
     return True
+
+
+def try_process_registry_updates(spec, state) -> bool:
+    if not enabled() or supervisor.probing():
+        return _decline(state)
+    return _supervised(spec, state, "epoch.registry_updates",
+                       "process_registry_updates", _fast_registry_updates)
 
 
 def _registry_updates(spec, state) -> None:
@@ -614,6 +691,9 @@ def _registry_updates(spec, state) -> None:
 
     aee = cols["aee"]
 
+    # cooperative deadline boundary before the eligibility scans
+    # (deadline_scope armed by try_process_registry_updates)
+    supervisor.deadline_check()
     # activation-queue eligibility stamps (is_eligible_for_activation_queue)
     queue_mask = (aee == np.uint64(far_future)) & (cols["eff"] == np.uint64(max_eb))
     stamp = current_epoch + 1
@@ -688,28 +768,23 @@ def _registry_updates(spec, state) -> None:
 # process_slashings
 # ---------------------------------------------------------------------------
 
-def try_process_slashings(spec, state) -> bool:
-    if not enabled():
-        state_arrays.flush(state)
-        _C_EPOCH_LOOP.add()
-        return False
-    try:
-        faults.check("epoch.slashings")
-        lineage = _fork_lineage(spec)
-        if "bellatrix" in lineage:
-            multiplier = spec.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX
-        elif "altair" in lineage:
-            multiplier = spec.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR
-        else:
-            multiplier = spec.PROPORTIONAL_SLASHING_MULTIPLIER
-        _slashings(spec, state, int(multiplier))
-    except (_Fallback, faults.InjectedFault) as exc:
-        state_arrays.flush(state)
-        faults.count_fallback(_EPOCH_FALLBACKS, exc)
-        _C_EPOCH_LOOP.add()
-        return False
-    _C_EPOCH_VECTORIZED.add()
+def _fast_slashings(spec, state) -> bool:
+    lineage = _fork_lineage(spec)
+    if "bellatrix" in lineage:
+        multiplier = spec.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX
+    elif "altair" in lineage:
+        multiplier = spec.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR
+    else:
+        multiplier = spec.PROPORTIONAL_SLASHING_MULTIPLIER
+    _slashings(spec, state, int(multiplier))
     return True
+
+
+def try_process_slashings(spec, state) -> bool:
+    if not enabled() or supervisor.probing():
+        return _decline(state)
+    return _supervised(spec, state, "epoch.slashings",
+                       "process_slashings", _fast_slashings)
 
 
 def _slashings(spec, state, multiplier) -> None:
@@ -742,21 +817,17 @@ def _slashings(spec, state, multiplier) -> None:
 # process_effective_balance_updates
 # ---------------------------------------------------------------------------
 
-def try_process_effective_balance_updates(spec, state) -> bool:
-    if not enabled():
-        state_arrays.flush(state)
-        _C_EPOCH_LOOP.add()
-        return False
-    try:
-        faults.check("epoch.effective_balance_updates")
-        _effective_balance_updates(spec, state)
-    except (_Fallback, faults.InjectedFault) as exc:
-        state_arrays.flush(state)
-        faults.count_fallback(_EPOCH_FALLBACKS, exc)
-        _C_EPOCH_LOOP.add()
-        return False
-    _C_EPOCH_VECTORIZED.add()
+def _fast_effective_balance_updates(spec, state) -> bool:
+    _effective_balance_updates(spec, state)
     return True
+
+
+def try_process_effective_balance_updates(spec, state) -> bool:
+    if not enabled() or supervisor.probing():
+        return _decline(state)
+    return _supervised(spec, state, "epoch.effective_balance_updates",
+                       "process_effective_balance_updates",
+                       _fast_effective_balance_updates)
 
 
 def _effective_balance_updates(spec, state) -> None:

@@ -33,7 +33,7 @@ shrinker before reporting.
 """
 import os
 
-from consensus_specs_tpu import faults
+from consensus_specs_tpu import faults, supervisor
 from consensus_specs_tpu.sim import driver
 from consensus_specs_tpu.test_infra.metrics import counting
 
@@ -65,6 +65,18 @@ SITE_COUNTER = {
 }
 assert set(SITE_COUNTER) == set(faults.SITES)
 
+# The PR-8 legs (baseline / injected / storm / spec-differential) run
+# with the supervisor LIVE — count_fallback feeds every trip into the
+# breakers, validating the supervisor wiring for free — but
+# breaker-NEUTRAL: the open threshold is pinned unreachably high, so an
+# organic-guard-heavy scenario cannot open a breaker mid-leg.  Without
+# this the legs' exact counter census would depend on wall-clock (the
+# breaker window is real time; whether organic trip N lands inside it
+# is host-speed-dependent, and an opened breaker swallows later
+# faults.check calls — no-discharge / organic-leak flakes).  The
+# breaker lifecycle itself has its own dedicated leg below.
+NEUTRAL_SUPERVISOR_ENV = {"CS_TPU_BREAKER_THRESHOLD": "1000000000"}
+
 # organic twins that must NOT move when a fault is injected (an
 # injected trip miscounted as organic would hide in the guard noise)
 ORGANIC_TWIN = {
@@ -95,20 +107,30 @@ class LegFailure(AssertionError):
         self.category = category
 
 
-def run_leg(spec, scenario, schedule=None, env=None):
+def run_leg(spec, scenario, schedule=None, env=None,
+            reset_supervisor=True):
     """Execute the scenario once.  Arms ``schedule`` (if any), applies
-    ``env`` overrides for the duration, returns the SimResult."""
+    ``env`` overrides for the duration, returns the SimResult.
+
+    Every leg replays cold by default: the supervisor resets AFTER the
+    env overrides apply (so a leg's breaker/audit knobs are read from
+    the leg's environment), and breaker state accumulated by one leg
+    never demotes an engine in the next.  The breaker-lifecycle leg
+    passes ``reset_supervisor=False`` for its healing replay — the
+    whole point there is that the opened breakers carry over."""
     from consensus_specs_tpu.utils import bls
-    # every leg replays cold: the process-global bls_verify memo would
-    # otherwise answer a replay's signature checks before they enqueue,
-    # so the second leg's flushes go empty and the bls.flush site (and
-    # its scheduled faults) silently disappear from the replay
+    # the process-global bls_verify memo would otherwise answer a
+    # replay's signature checks before they enqueue, so the second
+    # leg's flushes go empty and the bls.flush site (and its scheduled
+    # faults) silently disappear from the replay
     bls.clear_verify_memo()
     saved = {}
     for k, v in (env or {}).items():
         saved[k] = os.environ.get(k)
         os.environ[k] = v
     try:
+        if reset_supervisor:
+            supervisor.reset()
         if schedule is not None:
             with faults.injected(schedule):
                 return driver.execute(spec, scenario.script,
@@ -131,7 +153,8 @@ def run_baseline(spec, scenario):
     zero would fail every injected leg of such a scenario."""
     observer = faults.observing()
     with counting() as delta:
-        result = run_leg(spec, scenario, schedule=observer)
+        result = run_leg(spec, scenario, schedule=observer,
+                         env=NEUTRAL_SUPERVISOR_ENV)
     result.organic = {key: delta[key]
                       for key in set(ORGANIC_TWIN.values())}
     return result, dict(observer.calls)
@@ -156,7 +179,8 @@ def run_injected(spec, scenario, baseline, site, ordinal):
     # its keys are the registry's own series rendering, so the
     # silent-fallback cross-check can never drift from the registry
     with counting() as delta:
-        result = run_leg(spec, scenario, schedule=schedule)
+        result = run_leg(spec, scenario, schedule=schedule,
+                         env=NEUTRAL_SUPERVISOR_ENV)
     kind = f"inject[{site}@{ordinal}]"
     if not schedule.fully_fired():
         raise LegFailure(
@@ -186,16 +210,14 @@ def run_injected(spec, scenario, baseline, site, ordinal):
     return result
 
 
-def run_storm(spec, scenario, baseline, census):
-    """Ordinal-1 triggers at every exercised site in one run."""
-    sites = [s for s in faults.SITES if census.get(s, 0) > 0]
-    schedule = faults.FaultSchedule({s: [1] for s in sites})
-    with counting() as delta:
-        result = run_leg(spec, scenario, schedule=schedule)
+def _assert_storm_counted(kind, scenario, schedule, delta, sites):
+    """Shared storm-leg discharge + counter census: every scheduled
+    first-call trigger fired, and every fired fault moved its
+    reason=injected series by exactly the fired count."""
     if not schedule.fully_fired():
         missing = sorted(set(sites)
                          - {site for site, _ in schedule.fired})
-        raise LegFailure("storm", scenario,
+        raise LegFailure(kind, scenario,
                          f"first-call triggers never fired at {missing}",
                          schedule, category="no-discharge")
     from collections import Counter
@@ -204,9 +226,19 @@ def run_storm(spec, scenario, baseline, census):
         counted = delta[key]
         if counted != fired:
             raise LegFailure(
-                "storm", scenario, f"SILENT FALLBACK: {fired} fired at "
+                kind, scenario, f"SILENT FALLBACK: {fired} fired at "
                 f"{key} sites but the counter moved by {counted}",
                 schedule, category="silent-fallback")
+
+
+def run_storm(spec, scenario, baseline, census):
+    """Ordinal-1 triggers at every exercised site in one run."""
+    sites = [s for s in faults.SITES if census.get(s, 0) > 0]
+    schedule = faults.FaultSchedule({s: [1] for s in sites})
+    with counting() as delta:
+        result = run_leg(spec, scenario, schedule=schedule,
+                         env=NEUTRAL_SUPERVISOR_ENV)
+    _assert_storm_counted("storm", scenario, schedule, delta, sites)
     if result.digest() != baseline.digest():
         raise LegFailure("storm", scenario,
                          "storm run diverged from the uninjected replay: "
@@ -215,9 +247,162 @@ def run_storm(spec, scenario, baseline, census):
     return result
 
 
+# breaker-lifecycle leg env: threshold 1 so every injected fault opens
+# its site's breaker immediately; 1ms backoff so the healing replay's
+# half-open probes are due by the time it starts
+BREAKER_STORM_ENV = {
+    "CS_TPU_SUPERVISOR": "1",
+    "CS_TPU_BREAKER_THRESHOLD": "1",
+    "CS_TPU_BREAKER_BACKOFF_MS": "1",
+    "CS_TPU_BREAKER_BACKOFF_MAX_MS": "1",
+}
+
+# sentinel-audit leg env: every engine call audited, so the FIRST
+# corrupted answer is caught and corruption can never reach the digest.
+# Breaker-neutral like the PR-8 legs: an organic guard trip opening the
+# corrupt site's breaker before its first call would skip the engine
+# and the corruption would never arm (quarantine is threshold-free)
+AUDIT_ENV = {
+    "CS_TPU_SUPERVISOR": "1",
+    "CS_TPU_AUDIT_RATE": "1",
+    **NEUTRAL_SUPERVISOR_ENV,
+}
+
+# engines with a silent-corruption injection hook (faults.corrupt_armed),
+# in sweep preference order; every scenario hashes, so merkle.dispatch
+# is almost always exercisable
+CORRUPT_SITES = ("merkle.dispatch", "epoch.rewards_and_penalties",
+                 "forkchoice.head", "state_arrays.commit", "bls.flush")
+
+
+def pick_corrupt_site(census):
+    """First corrupt-capable site the scenario's census exercised."""
+    for site in CORRUPT_SITES:
+        if census.get(site, 0) > 0:
+            return site
+    return None
+
+
+def run_breaker_storm(spec, scenario, baseline, census):
+    """Breaker lifecycle end-to-end: under a threshold-1 supervisor, an
+    ordinal-1 fault storm at every exercised site must open every
+    site's breaker (transition-counter census), the run must complete
+    byte-identical on the skip/spec paths, and a clean healing replay
+    (supervisor NOT reset, backoff expired) must re-close every breaker
+    through successful half-open probes.  Returns None (leg skipped)
+    for scenarios with organic baseline fallbacks: threshold 1 would
+    let an organic trip re-open a healing breaker and flake the
+    end-state assertion."""
+    if any(baseline.organic.values()):
+        return None
+    sites = [s for s in faults.SITES if census.get(s, 0) > 0]
+    schedule = faults.FaultSchedule({s: [1] for s in sites})
+    kind = "breaker-storm"
+    with counting() as delta:
+        result = run_leg(spec, scenario, schedule=schedule,
+                         env=BREAKER_STORM_ENV)
+    _assert_storm_counted(kind, scenario, schedule, delta, sites)
+    for site in sites:
+        if delta[f"supervisor.transitions{{site={site},to=open}}"] < 1:
+            raise LegFailure(
+                kind, scenario, f"breaker at {site} never opened under "
+                "the threshold-1 storm", schedule, category="no-breaker")
+    if result.digest() != baseline.digest():
+        raise LegFailure(kind, scenario,
+                         "storm run diverged from the uninjected replay: "
+                         + _digest_diff(baseline, result), schedule,
+                         category="diverged")
+    # healing replay: same script, no faults, breakers carried over
+    with counting() as heal:
+        result2 = run_leg(spec, scenario, env=BREAKER_STORM_ENV,
+                          reset_supervisor=False)
+    if result2.digest() != baseline.digest():
+        raise LegFailure(kind, scenario,
+                         "healing replay diverged from the uninjected "
+                         "replay: " + _digest_diff(baseline, result2),
+                         schedule, category="diverged")
+    for site in sites:
+        closed = delta[f"supervisor.transitions{{site={site},to=closed}}"] \
+            + heal[f"supervisor.transitions{{site={site},to=closed}}"]
+        if closed < 1:
+            raise LegFailure(
+                kind, scenario, f"breaker at {site} never re-closed via a "
+                "half-open probe after backoff", schedule,
+                category="no-heal")
+    still_open = sorted(s for s, st in supervisor.states().items()
+                        if s in sites and st != "closed")
+    if still_open:
+        raise LegFailure(
+            kind, scenario, f"breakers still demoted after the clean "
+            f"healing replay: {still_open}", schedule, category="no-heal")
+    for twin in set(ORGANIC_TWIN.values()):
+        if delta[twin] or heal[twin]:
+            raise LegFailure(
+                kind, scenario, f"breaker legs leaked into the organic "
+                f"series {twin}", schedule, category="organic-leak")
+    return result2
+
+
+def run_corrupt(spec, scenario, baseline, site, out_dir=None, fork=None,
+                preset=None):
+    """Silent-corruption leg: persistent result corruption armed at
+    ``site`` from its first call, audits at rate 1.  The sentinel must
+    catch the first wrong answer (audit fail counter), quarantine the
+    site (exactly one quarantine, breaker permanently open), dump a
+    replayable artifact through the quarantine hook, and — because the
+    spec answer is authoritative on every audited call — the digest
+    must stay byte-identical to the uninjected replay.  Returns
+    ``(result, artifact_path)``."""
+    schedule = faults.FaultSchedule(corrupt={site: [1]})
+    kind = f"audit[{site}]"
+    dumped = []
+
+    def _dump(q_site, detail):
+        from consensus_specs_tpu.sim import repro
+        path = repro.dump_artifact(
+            scenario, kind,
+            f"sentinel audit quarantined {q_site}: {detail}",
+            schedule=schedule, out_dir=out_dir, fork=fork, preset=preset)
+        dumped.append(path)
+        return path
+
+    with supervisor.quarantine_hook(_dump):
+        with counting() as delta:
+            result = run_leg(spec, scenario, schedule=schedule,
+                             env=AUDIT_ENV)
+    if not schedule.corrupted:
+        raise LegFailure(
+            kind, scenario, "corruption never armed — the site's corrupt "
+            f"hook did not fire (site called "
+            f"{schedule.calls.get(site, 0)}x)", schedule,
+            category="no-discharge")
+    if delta[f"supervisor.audits{{result=fail,site={site}}}"] < 1:
+        raise LegFailure(
+            kind, scenario, f"SILENT CORRUPTION: "
+            f"{len(schedule.corrupted)} corrupted result(s) at {site} "
+            "but no sentinel audit failed", schedule,
+            category="silent-fallback")
+    if delta[f"supervisor.quarantines{{site={site}}}"] != 1:
+        raise LegFailure(
+            kind, scenario, f"expected exactly one quarantine at {site}, "
+            f"counted {delta[f'supervisor.quarantines{{site={site}}}']}",
+            schedule, category="silent-fallback")
+    if not dumped:
+        raise LegFailure(kind, scenario,
+                         "quarantine fired but dumped no artifact",
+                         schedule, category="silent-fallback")
+    if result.digest() != baseline.digest():
+        raise LegFailure(
+            kind, scenario, "corrupted engine result reached the digest "
+            "despite rate-1 audits: " + _digest_diff(baseline, result),
+            schedule, category="diverged")
+    return result, dumped[0]
+
+
 def run_spec_differential(spec, scenario, baseline):
     """Engines-off replay (CS_TPU_*=0) must match byte-for-byte."""
-    result = run_leg(spec, scenario, env=ENGINES_OFF)
+    result = run_leg(spec, scenario,
+                     env={**ENGINES_OFF, **NEUTRAL_SUPERVISOR_ENV})
     if result.digest() != baseline.digest():
         raise LegFailure("spec-differential", scenario,
                          "spec-loop chain diverged from engines-on: "

@@ -105,6 +105,12 @@ def dump_artifact(scenario, kind, message, schedule=None, script=None,
                          for site, ns in schedule.triggers.items()},
             "fired": [[site, n] for site, n in schedule.fired],
         }
+        if schedule.corrupt:
+            # quarantine artifacts: persistent silent-corruption start
+            # ordinals plus every corruption event that actually fired
+            payload["schedule"]["corrupt"] = dict(schedule.corrupt)
+            payload["schedule"]["corrupted"] = [
+                [site, n] for site, n in schedule.corrupted]
     # the leg kind is part of the name: one seed can fail several legs
     # in one sweep round (injected sites, storm, spec-diff) and each
     # failure must keep its own artifact
@@ -176,16 +182,33 @@ def replay(path: str, fork: str = None, preset: str = None) -> int:
     spec = build_spec(fork, preset, scenario.config_overrides)
     print(f"replaying {scenario.describe()} under {fork}/{preset} "
           f"(triggers={triggers or 'none'})")
+    corrupt = (payload.get("schedule") or {}).get("corrupt") or None
     with _applied_env(payload.get("env") or {}):
         baseline, census = harness.run_baseline(spec, scenario)
         print(f"baseline: head={baseline.digest()['head'][:16]}... "
               f"finalized_epoch={baseline.finalized[0]}")
         try:
-            if kind == "storm":
+            if corrupt:
+                # quarantine artifact: re-arm the persistent silent
+                # corruption and require the sentinel audit to catch and
+                # quarantine the site again (run_corrupt succeeding IS
+                # the reproduction; a LegFailure means the corruption
+                # now slips past the audit — worse, also reported)
+                for site in corrupt:
+                    _, path2 = harness.run_corrupt(spec, scenario,
+                                                   baseline, site)
+                    print(f"REPRODUCED: sentinel audit quarantined "
+                          f"{site} again -> {path2}")
+                return 1
+            if kind == "storm" or kind == "breaker-storm":
                 # every recorded site falls back in ONE run — a failure
                 # born from cross-site interaction only reproduces with
                 # the full storm armed, not trigger-by-trigger
-                harness.run_storm(spec, scenario, baseline, census)
+                if kind == "breaker-storm":
+                    harness.run_breaker_storm(spec, scenario, baseline,
+                                              census)
+                else:
+                    harness.run_storm(spec, scenario, baseline, census)
             elif not triggers:
                 harness.run_spec_differential(spec, scenario, baseline)
             else:

@@ -10,6 +10,15 @@ observing fault schedule, then sample the expensive legs:
   census) plus one all-sites storm leg,
 * every ``--diff-every``-th seed replays with every engine off
   (``CS_TPU_*=0``) and must match byte-for-byte,
+* every ``--breaker-every``-th seed runs the supervisor breaker
+  lifecycle leg (``harness.run_breaker_storm``): a threshold-1 fault
+  storm must open every exercised site's breaker, complete
+  byte-identical on the skip paths, and a healing replay must re-close
+  every breaker via half-open probes after backoff,
+* every ``--corrupt-every``-th seed arms persistent silent result
+  corruption at one engine site (``harness.run_corrupt``): the rate-1
+  sentinel audits must quarantine the site, dump a replayable
+  artifact, and keep the digest byte-identical,
 * the first ``--bls-seeds`` seeds run with real signatures on the
   fastest available backend so the ``bls.flush`` injection site is
   exercised (everything else runs with the BLS stub — the spec's
@@ -51,6 +60,16 @@ def _parse_args(argv):
                         help="injected sites sampled per injection seed")
     parser.add_argument("--diff-every", type=int, default=10,
                         help="engines-off differential every Nth seed")
+    parser.add_argument("--breaker-every", type=int, default=16,
+                        help="breaker-lifecycle storm leg every Nth seed "
+                             "(0 disables): threshold-1 supervisor, "
+                             "all-sites storm opens every breaker, "
+                             "healing replay re-closes them")
+    parser.add_argument("--corrupt-every", type=int, default=16,
+                        help="silent-corruption sentinel-audit leg every "
+                             "Nth seed (0 disables): rate-1 audits must "
+                             "quarantine the corrupted site and keep the "
+                             "digest byte-identical")
     parser.add_argument("--bls-seeds", type=int, default=2,
                         help="first K seeds run with real signatures")
     parser.add_argument("--min-scenarios", type=int, default=None,
@@ -89,7 +108,8 @@ def run_sweep(args) -> int:
     if min_scenarios is None:
         min_scenarios = args.seeds
     stats = {"scenarios": 0, "injected_legs": 0, "storm_legs": 0,
-             "diff_legs": 0, "faults_fired": 0, "rejected_steps": 0}
+             "diff_legs": 0, "breaker_legs": 0, "corrupt_legs": 0,
+             "quarantines": 0, "faults_fired": 0, "rejected_steps": 0}
     per_shape = {}
     failures = []       # (LegFailure, spec-or-None, with_bls)
     artifacts = []
@@ -163,6 +183,47 @@ def run_sweep(args) -> int:
                         faults.FaultSchedule({s: [1] for s in exercised})),
                         None, with_bls))
                 legs.append("inject+storm")
+            if args.breaker_every \
+                    and (seed - args.start) % args.breaker_every == 0:
+                exercised = [s for s in faults.SITES
+                             if census.get(s, 0) > 0]
+                try:
+                    ran = harness.run_breaker_storm(spec, scenario,
+                                                    baseline, census)
+                    if ran is not None:
+                        stats["breaker_legs"] += 1
+                        stats["faults_fired"] += len(exercised)
+                        legs.append("breaker")
+                except harness.LegFailure as fail:
+                    failures.append((fail, spec, with_bls))
+                    legs.append("breaker")
+                except Exception as exc:
+                    failures.append((_crashed_leg(
+                        "breaker-storm", scenario, exc,
+                        faults.FaultSchedule({s: [1] for s in exercised})),
+                        None, with_bls))
+                    legs.append("breaker")
+            if args.corrupt_every \
+                    and (seed - args.start) % args.corrupt_every == 0:
+                site = harness.pick_corrupt_site(census)
+                if site is not None:
+                    try:
+                        # run_corrupt's artifact is EVIDENCE of the
+                        # caught quarantine (expected), not a failure
+                        harness.run_corrupt(
+                            spec, scenario, baseline, site,
+                            out_dir=args.artifact_dir, fork=args.fork,
+                            preset=args.preset)
+                        stats["corrupt_legs"] += 1
+                        stats["quarantines"] += 1
+                    except harness.LegFailure as fail:
+                        failures.append((fail, spec, with_bls))
+                    except Exception as exc:
+                        failures.append((_crashed_leg(
+                            f"audit[{site}]", scenario, exc,
+                            faults.FaultSchedule(corrupt={site: [1]})),
+                            None, with_bls))
+                    legs.append(f"corrupt[{site}]")
             if (seed - args.start) % args.diff_every == 0:
                 try:
                     harness.run_spec_differential(spec, scenario,
@@ -210,7 +271,10 @@ def run_sweep(args) -> int:
           f"in {time.time() - t0:.0f}s")
     print(f"legs: {stats['injected_legs']} injected + "
           f"{stats['storm_legs']} storm ({stats['faults_fired']} faults "
-          f"fired, all counted) + {stats['diff_legs']} spec-differential; "
+          f"fired, all counted) + {stats['diff_legs']} spec-differential "
+          f"+ {stats['breaker_legs']} breaker-lifecycle + "
+          f"{stats['corrupt_legs']} sentinel-audit "
+          f"({stats['quarantines']} corruptions caught + quarantined); "
           f"{stats['rejected_steps']} adversarial steps rejected")
 
     code = 0

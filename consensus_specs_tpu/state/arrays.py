@@ -46,13 +46,12 @@ attached store: ``of`` hands out detached single-use stores, every
 access re-extracts, commits are immediate — the slow-but-simple
 fallback the differential suites pin against the engine path.
 """
-import os
 import weakref
 from contextlib import contextmanager
 
 import numpy as np
 
-from consensus_specs_tpu import faults
+from consensus_specs_tpu import faults, supervisor
 from consensus_specs_tpu.obs import registry as obs_registry
 from consensus_specs_tpu.obs.tracing import span
 from consensus_specs_tpu.utils import env_flags
@@ -90,10 +89,7 @@ def enabled() -> bool:
         return True
     if _mode == "off":
         return False
-    raw = os.environ.get("CS_TPU_STATE_ARRAYS")
-    if raw is None:
-        return env_flags.STATE_ARRAYS
-    return raw != "0"
+    return env_flags.switch("CS_TPU_STATE_ARRAYS")
 
 
 def backend_name() -> str:
@@ -128,6 +124,8 @@ _C_FORKS = obs_registry.counter("state_arrays.forks").labels()
 _FALLBACKS = {
     "injected": obs_registry.counter(
         "state_arrays.fallbacks").labels(reason="injected"),
+    "deadline": obs_registry.counter(
+        "state_arrays.fallbacks").labels(reason="deadline"),
 }
 
 
@@ -182,6 +180,12 @@ def _write_u64_list(seq, elem_type, old, new) -> None:
         # int.__new__ skips BasicValue's range re-validation; the values
         # come out of a uint64 array, so the range holds by construction
         items = [int.__new__(elem_type, v) for v in new.tolist()]
+    # cooperative deadline boundary: the object-building stage above is
+    # the python-heavy part and nothing has been written yet — an armed
+    # budget (supervisor.deadline_scope in commit) aborts here into the
+    # counted spec-shaped loop write instead of past the point of
+    # no return
+    supervisor.deadline_check()
     replace_basic_items(seq, items, packed=new.astype("<u8").tobytes())
 
 
@@ -414,16 +418,46 @@ class StateArrays:
                 _C_COMMITS.add()
                 wrote = True
             with span("state_arrays.commit"):
-                try:
-                    faults.check("state_arrays.commit")
-                except faults.InjectedFault as exc:
-                    faults.count_fallback(_FALLBACKS, exc,
-                                          organic="injected")
+                site = "state_arrays.commit"
+                fast = supervisor.admit(site)
+                if fast:
+                    try:
+                        faults.check(site)
+                        with supervisor.deadline_scope(site):
+                            data = cell.data
+                            if faults.corrupt_armed(site):
+                                # silent-corruption injection (sentinel-
+                                # audit test vector): one flipped bit in
+                                # the chunk-packed write; cell.data stays
+                                # true, so the read-back audit can see it
+                                data = data.copy()
+                                if data.size:
+                                    data[0] ^= np.uint64(1)
+                            _write_u64_list(seq, type(seq).elem_type,
+                                            cell.base, data)
+                    except (faults.InjectedFault,
+                            supervisor.DeadlineExceeded) as exc:
+                        faults.count_fallback(_FALLBACKS, exc,
+                                              organic="injected", site=site)
+                        fast = False
+                if not fast:
                     _write_u64_list_loop(seq, type(seq).elem_type,
                                          cell.base, cell.data)
+                elif supervisor.audit_due(site):
+                    # sentinel audit: re-extract the committed column
+                    # and compare against the engine's pending data; on
+                    # a mismatch the site is quarantined and the column
+                    # repaired through the spec-shaped targeted writes
+                    back = u64_column(seq)
+                    ok = bool(np.array_equal(back, cell.data))
+                    supervisor.audit_result(
+                        site, ok, f"chunk-packed commit of {name} read "
+                        "back differently than the pending column")
+                    if not ok:
+                        _write_u64_list_loop(seq, type(seq).elem_type,
+                                             back, cell.data)
                 else:
-                    _write_u64_list(seq, type(seq).elem_type,
-                                    cell.base, cell.data)
+                    supervisor.note_success(site)
                 cell.base = cell.data
                 cell.gen = _gen_of(seq)
 

@@ -1,0 +1,496 @@
+"""Engine supervisor: circuit breakers, deadline guards, and online
+sentinel audits over every accelerated dispatch path.
+
+PR 8's fault harness proved the *stateless* half of the degradation
+contract: any single fault at an engine entry point completes on the
+spec loop and books a counted fallback.  This module adds the
+*stateful* half a serving deployment needs — engines that demote
+themselves when persistently broken, heal themselves when the fault
+clears, and audit themselves online against the spec loop:
+
+circuit breakers
+    Every site in :data:`faults.SITES` carries a breaker.  After
+    ``CS_TPU_BREAKER_THRESHOLD`` counted fallbacks within
+    ``CS_TPU_BREAKER_WINDOW_MS`` the breaker *opens*: :func:`admit`
+    answers False and the engine skips its fast-path attempt entirely
+    (the spec-shaped path serves the call, byte-identical, without
+    re-paying the failure cost).  After an exponential backoff with
+    seeded jitter the next call is admitted as a *half-open* probe: a
+    success re-closes the breaker, a failure re-opens it with doubled
+    backoff.  Transitions (``closed -> open -> half_open -> closed``)
+    are counters; per-site state is a gauge.
+
+deadline guards
+    :func:`deadline_scope` arms a wall-clock budget
+    (``CS_TPU_DEADLINE_MS``) around a compiled/native dispatch;
+    :func:`deadline_check` at cooperative dispatch boundaries raises
+    :class:`DeadlineExceeded` — a fallback-class exception the engine
+    handlers absorb through ``faults.count_fallback`` as a
+    ``reason=deadline`` trip, so a pathologically slow engine degrades
+    to the spec path instead of stalling the replay.  A dispatch that
+    *completes* over budget books a deadline trip (and a breaker
+    failure) post-hoc without discarding its correct result.
+
+sentinel audits
+    Every Kth call per site (``CS_TPU_AUDIT_RATE``, seeded sampling
+    offset) the engine replays the call through the spec loop and
+    compares byte-identical.  On a mismatch the spec answer is
+    authoritative, the site is *quarantined* — its breaker opens with
+    ``reason=audit`` and never re-probes (a silently-wrong engine must
+    not heal itself back in) — and a replayable artifact is dumped
+    (``sim/repro.py`` replays it; the default hook writes a minimal
+    JSON with the site, detail, and env snapshot).
+
+Everything is behind ``CS_TPU_SUPERVISOR`` (default on, live re-read
+through ``utils/env_flags.switch``): with the switch off every function
+here is a pass-through and behavior is exactly pre-PR-9.  Numeric knobs
+are read once per :func:`reset` (the sim harness resets per leg after
+applying env overrides); docs: ``docs/robustness.md``.
+
+Thread model: like ``faults``, breaker state is process-global and the
+engines run single-threaded; the disarmed/closed hot path is one env
+read plus a dict lookup.
+"""
+import json
+import os
+import random
+import time
+from contextlib import contextmanager
+
+from consensus_specs_tpu import faults
+from consensus_specs_tpu.obs import registry as _obs
+from consensus_specs_tpu.utils import env_flags as _env_flags
+
+# test seam: monkeypatch to drive breaker/deadline time deterministically
+_clock = time.monotonic
+
+
+class DeadlineExceeded(Exception):
+    """Raised by :func:`deadline_check` when the armed dispatch budget
+    is spent.  A fallback-class exception: engine handlers catch it
+    alongside their ``_Fallback`` guards and ``InjectedFault`` and
+    route it through ``faults.count_fallback`` (``reason=deadline``)."""
+
+    def __init__(self, site: str, elapsed_s: float, budget_s: float):
+        super().__init__(f"{site}: dispatch exceeded its deadline "
+                         f"({elapsed_s * 1e3:.1f}ms > {budget_s * 1e3:.1f}ms)")
+        self.site = site
+
+
+def enabled() -> bool:
+    """Supervisor master switch (live, ``utils/env_flags.switch``)."""
+    return _env_flags.switch("CS_TPU_SUPERVISOR")
+
+
+# ---------------------------------------------------------------------------
+# Metrics (one series per site, pre-bound at import — speclint O5xx)
+# ---------------------------------------------------------------------------
+
+_C_TRANSITIONS = _obs.counter("supervisor.transitions")
+_G_BREAKER = _obs.gauge("supervisor.breaker")
+_GAUGE_STATE = {"closed": 0, "open": 1, "half_open": 2, "quarantined": 3}
+
+_SKIPS = {site: _obs.counter("supervisor.breaker.skips").labels(site=site)
+          for site in faults.SITES}
+_AUDIT_PASS = {site: _obs.counter("supervisor.audits")
+               .labels(site=site, result="pass") for site in faults.SITES}
+_AUDIT_FAIL = {site: _obs.counter("supervisor.audits")
+               .labels(site=site, result="fail") for site in faults.SITES}
+_QUARANTINES = {site: _obs.counter("supervisor.quarantines")
+                .labels(site=site) for site in faults.SITES}
+_DEADLINE_TRIPS = {site: _obs.counter("supervisor.deadline.trips")
+                   .labels(site=site) for site in faults.SITES}
+_TRANSITIONS = {(site, to): _C_TRANSITIONS.labels(site=site, to=to)
+                for site in faults.SITES
+                for to in ("open", "half_open", "closed")}
+_GAUGES = {site: _G_BREAKER.labels(site=site) for site in faults.SITES}
+
+
+_TABLE_NAMES = {id(_SKIPS): "supervisor.breaker.skips",
+                id(_AUDIT_PASS): "supervisor.audits",
+                id(_AUDIT_FAIL): "supervisor.audits",
+                id(_QUARANTINES): "supervisor.quarantines",
+                id(_DEADLINE_TRIPS): "supervisor.deadline.trips"}
+
+
+def _series(table, site, **kv):
+    """Pre-bound series for a known site; cold labels() resolution for
+    a site outside ``faults.SITES`` (future engines, tests)."""
+    s = table.get(site)
+    if s is not None:
+        return s
+    if table is _TRANSITIONS:
+        return _C_TRANSITIONS.labels(site=site[0], to=site[1])
+    return _obs.counter(_TABLE_NAMES[id(table)]).labels(site=site, **kv)
+
+
+# ---------------------------------------------------------------------------
+# Configuration (read once per reset; the harness resets per leg)
+# ---------------------------------------------------------------------------
+
+class _Config:
+    __slots__ = ("threshold", "window_s", "backoff_s", "backoff_max_s",
+                 "jitter", "audit_rate", "deadline_s", "seed")
+
+    def __init__(self):
+        env = os.environ.get
+        self.threshold = max(1, _int(env("CS_TPU_BREAKER_THRESHOLD"), 5))
+        self.window_s = _float(env("CS_TPU_BREAKER_WINDOW_MS"), 10_000) / 1e3
+        self.backoff_s = _float(env("CS_TPU_BREAKER_BACKOFF_MS"), 200) / 1e3
+        self.backoff_max_s = _float(
+            env("CS_TPU_BREAKER_BACKOFF_MAX_MS"), 60_000) / 1e3
+        self.jitter = 0.25
+        self.audit_rate = _int(env("CS_TPU_AUDIT_RATE"), 0)
+        self.deadline_s = _float(env("CS_TPU_DEADLINE_MS"), 0) / 1e3
+        self.seed = _int(env("CS_TPU_SUPERVISOR_SEED"), 0)
+
+
+def _int(raw, default):
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        return default
+
+
+def _float(raw, default):
+    try:
+        return float(raw)
+    except (TypeError, ValueError):
+        return default
+
+
+_cfg = None
+_rng = None
+
+
+def _config() -> _Config:
+    global _cfg, _rng
+    if _cfg is None:
+        _cfg = _Config()
+        _rng = random.Random(_cfg.seed)
+    return _cfg
+
+
+# ---------------------------------------------------------------------------
+# Circuit breakers
+# ---------------------------------------------------------------------------
+
+class _Breaker:
+    __slots__ = ("site", "state", "fails", "slow", "reopen_at", "opens")
+
+    def __init__(self, site):
+        self.site = site
+        self.state = "closed"
+        self.fails = []         # recent failure timestamps (window-pruned)
+        self.slow = []          # deadline overruns: a dispatch that
+        #                         completed (correctly, so note_success
+        #                         follows) but over budget must still
+        #                         accumulate toward demotion — successes
+        #                         clear ``fails`` but never this list
+        self.reopen_at = 0.0    # next half-open probe time; None = never
+        self.opens = 0          # consecutive opens (backoff exponent)
+
+
+_breakers = {}
+
+
+def _breaker(site) -> _Breaker:
+    br = _breakers.get(site)
+    if br is None:
+        br = _breakers.setdefault(site, _Breaker(site))
+    return br
+
+
+def _set_state(br, state) -> None:
+    br.state = state
+    _GAUGES.get(br.site, _G_BREAKER.labels(site=br.site)) \
+        .set(_GAUGE_STATE[state])
+    to = "open" if state == "quarantined" else state
+    _series(_TRANSITIONS, (br.site, to)).add()
+
+
+def _open(br, cfg) -> None:
+    br.opens += 1
+    backoff = min(cfg.backoff_s * (2 ** (br.opens - 1)), cfg.backoff_max_s)
+    backoff *= 1.0 + cfg.jitter * _rng.random()
+    br.reopen_at = _clock() + backoff
+    br.fails.clear()
+    br.slow.clear()
+    _set_state(br, "open")
+
+
+def admit(site: str) -> bool:
+    """Gate an engine's fast-path attempt.  True (the common case, one
+    env read + a dict lookup) admits the attempt; False means the
+    site's breaker is open — the engine must serve the call on its
+    spec-shaped path without attempting the fast path (and without
+    calling ``faults.check``: a demoted site is out of the schedule
+    vocabulary until it heals)."""
+    if not enabled():
+        return True
+    br = _breakers.get(site)
+    if br is None or br.state == "closed":
+        return True
+    if br.state == "half_open":
+        return True     # a probe is in flight; keep probing
+    if br.state == "open" and _clock() >= br.reopen_at:
+        _set_state(br, "half_open")     # this call is the probe
+        return True
+    _series(_SKIPS, site).add()
+    return False
+
+
+def note_success(site: str) -> None:
+    """Report a fast-path success: closes a half-open probe (resetting
+    the backoff schedule), clears the failure window otherwise."""
+    if not enabled():
+        return
+    br = _breakers.get(site)
+    if br is None or br.state == "closed":
+        if br is not None and br.fails:
+            br.fails.clear()
+        return
+    if br.state == "half_open":
+        br.opens = 0
+        br.fails.clear()
+        br.reopen_at = 0.0
+        _set_state(br, "closed")
+
+
+def note_failure(site: str, reason: str = "guard") -> None:
+    """Report a counted fallback (wired as the ``faults.count_fallback``
+    hook).  A half-open probe failure re-opens with doubled backoff; in
+    the closed state, ``threshold`` failures within the window open the
+    breaker."""
+    if not enabled() or site is None:
+        return
+    cfg = _config()
+    br = _breaker(site)
+    if br.state == "half_open":
+        _open(br, cfg)
+        return
+    if br.state != "closed":
+        return
+    bucket = br.slow if reason == "deadline" else br.fails
+    now = _clock()
+    bucket.append(now)
+    if len(bucket) > cfg.threshold:
+        del bucket[:-cfg.threshold]
+    if len(bucket) >= cfg.threshold and bucket[0] >= now - cfg.window_s:
+        _open(br, cfg)
+
+
+def states() -> dict:
+    """{site: breaker state} for every site touched since reset plus
+    the untouched ones (reported closed)."""
+    out = {site: "closed" for site in faults.SITES}
+    out.update({site: br.state for site, br in _breakers.items()})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Sentinel audits + quarantine
+# ---------------------------------------------------------------------------
+
+_audit_calls = {}
+_audit_offsets = {}
+_probe_depth = 0
+_quarantine_seq = 0
+_last_quarantine = None
+
+
+def audit_due(site: str) -> bool:
+    """True when this engine call is sampled for a sentinel audit (the
+    engine must then produce BOTH answers — spec authoritative — and
+    report through :func:`audit_result`).  Sampling is every
+    ``CS_TPU_AUDIT_RATE``-th call per site at a seeded per-site offset;
+    rate 0 (the default) disables audits."""
+    if not enabled():
+        return False
+    cfg = _config()
+    k = cfg.audit_rate
+    if k <= 0 or _probe_depth:
+        return False
+    br = _breakers.get(site)
+    if br is not None and br.state != "closed":
+        return False    # demoted sites run the spec path anyway
+    n = _audit_calls.get(site, 0) + 1
+    _audit_calls[site] = n
+    off = _audit_offsets.get(site)
+    if off is None:
+        off = _audit_offsets.setdefault(site, _rng.randrange(k))
+    return n % k == off % k
+
+
+def audit_result(site: str, ok: bool, detail: str = "") -> None:
+    """Book one sentinel audit verdict; a failure quarantines the
+    site.  The engine must already have answered with the SPEC result —
+    the audit layer never un-propagates a mismatch after the fact."""
+    if ok:
+        _series(_AUDIT_PASS, site, result="pass").add()
+        note_success(site)
+        return
+    _series(_AUDIT_FAIL, site, result="fail").add()
+    quarantine(site, detail)
+
+
+def quarantine(site: str, detail: str = "") -> None:
+    """Open ``site``'s breaker permanently (``reason=audit``): no
+    backoff re-probe — an engine caught answering *wrong* (not merely
+    failing) stays demoted until an operator resets the supervisor.
+    Dumps a replayable artifact through the quarantine hook."""
+    global _last_quarantine
+    br = _breaker(site)
+    if br.state == "quarantined":
+        return
+    br.reopen_at = None
+    _series(_QUARANTINES, site).add()
+    _set_state(br, "quarantined")
+    _last_quarantine = _quarantine_hook(site, detail)
+
+
+def _default_quarantine_dump(site: str, detail: str):
+    """Minimal standalone quarantine artifact (the sim harness installs
+    a richer hook that records the full scenario script so
+    ``sim/repro.py`` can replay the mismatch)."""
+    global _quarantine_seq
+    out_dir = os.environ.get("CS_TPU_SIM_ARTIFACTS", "sim_artifacts")
+    _quarantine_seq += 1
+    payload = {
+        "kind": "quarantine",
+        "site": site,
+        "detail": detail,
+        "env": {k: v for k, v in sorted(os.environ.items())
+                if k.startswith("CS_TPU_")},
+        "breakers": states(),
+    }
+    path = os.path.join(
+        out_dir, f"quarantine_{site.replace('.', '-')}_{_quarantine_seq}.json")
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=2)
+    except OSError:
+        return None     # read-only host: quarantine still holds
+    return path
+
+
+_quarantine_hook = _default_quarantine_dump
+
+
+@contextmanager
+def quarantine_hook(fn):
+    """Temporarily replace the artifact dump hook (harness use).  The
+    hook receives ``(site, detail)`` and its return value is stored as
+    :func:`last_quarantine`."""
+    global _quarantine_hook
+    prev = _quarantine_hook
+    _quarantine_hook = fn
+    try:
+        yield
+    finally:
+        _quarantine_hook = prev
+
+
+def last_quarantine():
+    """Whatever the quarantine hook returned last (the default hook:
+    the artifact path), or None."""
+    return _last_quarantine
+
+
+@contextmanager
+def probe():
+    """Mark a spec-loop audit replay in progress: engine dispatch
+    declines (``probing()`` is True) so the replay runs the pure spec
+    algorithms instead of recursing into the engine under audit."""
+    global _probe_depth
+    _probe_depth += 1
+    try:
+        yield
+    finally:
+        _probe_depth -= 1
+
+
+def probing() -> bool:
+    return _probe_depth > 0
+
+
+# ---------------------------------------------------------------------------
+# Deadline guards
+# ---------------------------------------------------------------------------
+
+_deadline_stack = []
+
+
+@contextmanager
+def deadline_scope(site: str):
+    """Arm the per-dispatch wall-clock budget around an engine's
+    compiled/native kernel section.  No-op (one env read, no stack
+    push) when the supervisor is off or ``CS_TPU_DEADLINE_MS`` unset.
+    A scope that exits cleanly but over budget books a deadline trip
+    and a breaker failure post-hoc — the (correct) result still stands;
+    only a mid-work :func:`deadline_check` converts the call itself
+    into a fallback."""
+    if not enabled():
+        yield
+        return
+    budget = _config().deadline_s
+    if budget <= 0:
+        yield
+        return
+    start = _clock()
+    entry = (site, start + budget, budget)
+    _deadline_stack.append(entry)
+    try:
+        yield
+    except DeadlineExceeded:
+        _series(_DEADLINE_TRIPS, site).add()
+        raise
+    else:
+        elapsed = _clock() - start
+        if elapsed > budget:
+            _series(_DEADLINE_TRIPS, site).add()
+            note_failure(site, "deadline")
+    finally:
+        _deadline_stack.pop()
+
+
+def deadline_check() -> None:
+    """Cooperative boundary check: raises :class:`DeadlineExceeded`
+    when the innermost armed scope's budget is spent.  Disarmed cost:
+    one list truth test."""
+    if not _deadline_stack:
+        return
+    site, until, budget = _deadline_stack[-1]
+    now = _clock()
+    if now > until:
+        raise DeadlineExceeded(site, now - (until - budget), budget)
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle
+# ---------------------------------------------------------------------------
+
+def reset() -> None:
+    """Forget all breaker/audit/deadline state and re-read the numeric
+    knobs from the environment.  The sim harness calls this at every
+    leg start (after applying the leg's env overrides) so legs replay
+    cold; the test suite resets per test."""
+    global _cfg, _rng, _last_quarantine, _quarantine_seq
+    _breakers.clear()
+    _audit_calls.clear()
+    _audit_offsets.clear()
+    _deadline_stack.clear()
+    _cfg = None
+    _rng = None
+    _last_quarantine = None
+    _quarantine_seq = 0
+    for g in _GAUGES.values():
+        g.set(0)
+
+
+# engines report counted fallbacks through faults.count_fallback; the
+# hooks keep faults dependency-free while routing every counted trip
+# into the breaker state machine and classifying deadline trips
+faults._failure_hook = note_failure
+faults._deadline_cls = DeadlineExceeded

@@ -91,6 +91,17 @@ def main(argv=None) -> int:
         print(f"== {args.slots}-slot {args.fork}/{args.preset} replay, "
               f"{args.validators} validators ==")
         print(obs.report())
+        # supervisor health: per-site breaker states (the machine view
+        # is the supervisor.* metric series above / in the exporters)
+        from consensus_specs_tpu import supervisor
+        if supervisor.enabled():
+            states = supervisor.states()
+            demoted = {s: st for s, st in states.items() if st != "closed"}
+            print(f"\nsupervisor: {len(states)} sites, "
+                  + (f"demoted: {demoted}" if demoted
+                     else "all breakers closed"))
+        else:
+            print("\nsupervisor: disabled (CS_TPU_SUPERVISOR=0)")
     return 0
 
 

@@ -1,6 +1,7 @@
 """speclint passes.  Each module exposes ``NAME`` and ``run(ctx)``."""
 from . import (  # noqa: F401
-    fallbacks, uint64, tracing, ladder, obs, specmd, state_layer, style)
+    fallbacks, supervision, uint64, tracing, ladder, obs, specmd,
+    state_layer, style)
 
 ALL_PASSES = (style, uint64, tracing, ladder, specmd, obs, state_layer,
-              fallbacks)
+              fallbacks, supervision)

@@ -34,7 +34,9 @@ import ast
 from ..findings import Finding
 
 NAME = "fallbacks"
-CODE_PREFIXES = ("R",)
+# R7 specifically: R8xx belongs to the supervision pass — a bare "R"
+# prefix would claim its baseline keys in the --passes bookkeeping
+CODE_PREFIXES = ("R7",)
 
 ENGINE_PREFIXES = (
     "consensus_specs_tpu/ops/",

@@ -1,0 +1,187 @@
+"""supervision pass (R8xx): every engine dispatch must be supervised.
+
+PR 9's supervisor (``consensus_specs_tpu/supervisor.py``) gives each
+``faults.SITES`` entry point a circuit breaker, deadline guard, and
+sentinel-audit hook.  That only holds if every dispatch wrapper
+actually *registers* with the supervisor: an entry point that calls
+``faults.check(site)`` but never gates on ``supervisor.admit(site)``
+is invisible to the breaker — a persistently broken engine at that
+site re-pays the full failure cost on every call forever, exactly the
+regression the supervisor exists to prevent.  The sim harness proves
+the dynamic lifecycle per run; this pass pins the static wiring across
+the engine surface.
+
+* R801 — a function calls ``faults.check(<site>)`` without also
+  calling ``supervisor.admit(<site>)`` for the same site.  Site names
+  are resolved from string literals, including the common
+  ``site = "..."`` local-variable form; a call whose argument cannot
+  be resolved to a literal (e.g. the shared ``_audited`` helper taking
+  the site as a parameter) is out of scope — the literal-carrying
+  caller is the registration point.
+* R802 — a bare retry loop: a ``while`` loop that absorbs exceptions
+  (a handler with no ``raise``) and keeps iterating, with no backoff
+  call (``time.sleep`` / anything named ``*backoff*`` /
+  ``supervisor.admit``) anywhere in the loop.  Unthrottled retry is
+  the hand-rolled sibling of the breaker-less dispatch: under a
+  persistent fault it busy-spins at full failure cost.  Scope:
+  ``ops/``, ``forkchoice/``, ``state/``.
+
+Intentional exceptions carry ``# noqa: R801`` / ``# noqa: R802``.
+Baseline: zero findings — new engine entry points must wire through
+the supervisor before landing.
+"""
+import ast
+
+from ..findings import Finding
+
+NAME = "supervision"
+CODE_PREFIXES = ("R8",)
+
+ENGINE_PREFIXES = (
+    "consensus_specs_tpu/ops/",
+    "consensus_specs_tpu/forkchoice/",
+    "consensus_specs_tpu/state/",
+    "consensus_specs_tpu/utils/ssz/",
+    "consensus_specs_tpu/utils/bls.py",
+)
+R802_PREFIXES = (
+    "consensus_specs_tpu/ops/",
+    "consensus_specs_tpu/forkchoice/",
+    "consensus_specs_tpu/state/",
+)
+
+
+def _scoped(path: str, prefixes) -> bool:
+    return any(path.startswith(p) for p in prefixes)
+
+
+def _call_name(node):
+    """Dotted tail of a call target: ``faults.check`` -> ``check`` with
+    owner ``faults``; bare ``check`` -> owner None."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return None, f.id
+    if isinstance(f, ast.Attribute):
+        owner = f.value.id if isinstance(f.value, ast.Name) else None
+        return owner, f.attr
+    return None, None
+
+
+def _literal_str_bindings(fn_node) -> dict:
+    """{name: literal} for simple ``name = "literal"`` assignments in
+    the function (last assignment wins; a non-literal rebind poisons
+    the name)."""
+    out = {}
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                out[name] = node.value.value
+            else:
+                out[name] = None
+    return out
+
+
+def _resolve_site(arg, bindings):
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if isinstance(arg, ast.Name):
+        return bindings.get(arg.id)
+    return None
+
+
+def _site_calls(fn_node, attr_name, bindings):
+    """Resolved site literals passed to ``*.<attr_name>(site)`` calls
+    (with line numbers) inside the function."""
+    out = []
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        _, name = _call_name(node)
+        if name != attr_name or not node.args:
+            continue
+        site = _resolve_site(node.args[0], bindings)
+        if site is not None:
+            out.append((site, node.lineno))
+    return out
+
+
+def _has_backoff(loop_node) -> bool:
+    for node in ast.walk(loop_node):
+        if isinstance(node, ast.Call):
+            _, name = _call_name(node)
+            if name is None:
+                continue
+            if name == "sleep" or "backoff" in name.lower() \
+                    or name == "admit":
+                return True
+    return False
+
+
+def _swallows(handler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return False
+    return True
+
+
+def check_source(path: str, text: str):
+    """All R8xx findings for one file (``path`` repo-relative)."""
+    r801 = _scoped(path, ENGINE_PREFIXES)
+    r802 = _scoped(path, R802_PREFIXES)
+    if not (r801 or r802):
+        return []
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError:
+        return []    # the style pass owns E999
+    findings = []
+
+    if r801:
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            bindings = _literal_str_bindings(fn)
+            checked = _site_calls(fn, "check", bindings)
+            if not checked:
+                continue
+            admitted = {site for site, _ in
+                        _site_calls(fn, "admit", bindings)}
+            for site, lineno in checked:
+                if site not in admitted:
+                    findings.append(Finding(
+                        path, lineno, "R801",
+                        f"{fn.name} dispatches the engine site "
+                        f"{site!r} (faults.check) without registering "
+                        "with the supervisor (supervisor.admit) — an "
+                        "unsupervised site has no circuit breaker and "
+                        "re-pays every persistent failure forever"))
+
+    if r802:
+        for loop in ast.walk(tree):
+            if not isinstance(loop, ast.While):
+                continue
+            handlers = [h for t in ast.walk(loop)
+                        if isinstance(t, ast.Try) for h in t.handlers]
+            if not handlers or not any(_swallows(h) for h in handlers):
+                continue
+            if _has_backoff(loop):
+                continue
+            findings.append(Finding(
+                path, loop.lineno, "R802",
+                "bare retry loop: a while-loop that absorbs exceptions "
+                "and keeps iterating without any backoff "
+                "(time.sleep / *backoff* / supervisor gate) busy-spins "
+                "at full failure cost under a persistent fault"))
+    return findings
+
+
+def run(ctx):
+    findings = []
+    for rel in ctx.py_files:
+        if not _scoped(rel, ENGINE_PREFIXES + R802_PREFIXES):
+            continue
+        findings.extend(check_source(rel, ctx.source(rel)))
+    return findings

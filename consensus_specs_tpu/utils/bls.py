@@ -13,11 +13,11 @@ plus the test kill-switch ``bls_active`` with STUB constants
 (``bls.py:49-57,93-104``): when inactive, Sign returns a stub and verifies
 trivially pass — used by the harness's @never_bls/@always_bls decorators.
 """
-import os
 from contextlib import contextmanager
 from typing import Sequence
 
 from consensus_specs_tpu import faults as _faults
+from consensus_specs_tpu import supervisor
 from consensus_specs_tpu.obs import registry as _obs_registry
 from consensus_specs_tpu.utils import env_flags as _env_flags
 from consensus_specs_tpu.utils.lru import LRUDict
@@ -140,16 +140,17 @@ _FLUSH_FALLBACK = {
         "bls.flush").labels(path="fallback", reason="bisect"),
     "injected": _obs_registry.counter(
         "bls.flush").labels(path="fallback", reason="injected"),
+    "deadline": _obs_registry.counter(
+        "bls.flush").labels(path="fallback", reason="deadline"),
 }
 _PAIRINGS = _obs_registry.counter("bls.pairings").labels()
 
 
 def rlc_enabled() -> bool:
     """RLC flush switch: live env re-read when the variable is present
-    (CI legs flip it after import), else the import-time snapshot."""
-    if "CS_TPU_BLS_RLC" in os.environ:
-        return os.environ["CS_TPU_BLS_RLC"] != "0"
-    return _env_flags.BLS_RLC
+    (CI legs flip it after import), else the import-time snapshot —
+    the shared ``env_flags.switch`` contract."""
+    return _env_flags.switch("CS_TPU_BLS_RLC")
 
 
 class DeferredBatch:
@@ -210,22 +211,34 @@ class DeferredBatch:
         self._seen = {}
         if not items and not checks:
             return True
-        if rlc_enabled():
-            injected = None
+        site = "bls.flush"
+        verdict = None
+        audited = False
+        if rlc_enabled() and supervisor.admit(site):
+            fallback_exc = None
             try:
-                _faults.check("bls.flush")
-            except _faults.InjectedFault as exc:
+                _faults.check(site)
+                with supervisor.deadline_scope(site):
+                    from consensus_specs_tpu.ops import bls_rlc
+                    verdict = bls_rlc.combined_check(items, checks,
+                                                     _backend_name)
+            except (_faults.InjectedFault,
+                    supervisor.DeadlineExceeded) as exc:
                 # the RLC combine "failed": degrade to the per-lane
                 # path, exactly like a combined-verdict failure
-                injected = exc
-            if injected is None:
-                from consensus_specs_tpu.ops import bls_rlc
-                verdict = bls_rlc.combined_check(items, checks,
-                                                 _backend_name)
+                fallback_exc = exc
+            else:
                 if verdict is not None:
                     _PAIRINGS.add()      # the one combined product pairing
-                if verdict is True:
+                    if _faults.corrupt_armed(site):
+                        # silent-corruption injection (sentinel-audit
+                        # test vector): the combined check lies in
+                        # whichever direction the true verdict isn't
+                        verdict = not verdict
+                    audited = supervisor.audit_due(site)
+                if verdict is True and not audited:
                     _FLUSH_RLC.add()
+                    supervisor.note_success(site)
                     for ks in keys:
                         for k in ks:
                             _memo_put(k, True)
@@ -233,10 +246,16 @@ class DeferredBatch:
                     self.last_pairing_results = [True] * len(checks)
                     return True
             # combined failure (False), structurally invalid item
-            # (None), or an injected fault: bisect through the per-lane
-            # path for exact per-item reporting
-            _faults.count_fallback(_FLUSH_FALLBACK, injected,
-                                   organic="bisect")
+            # (None), or an injected/deadline fault: bisect through the
+            # per-lane path for exact per-item reporting.  Only an
+            # audited verdict=True flush skips the count — there the
+            # lanes run purely as the sentinel's cross-check, not as a
+            # fallback; an audited combined FAILURE is still the
+            # organic bisect and must book (and feed the breaker) like
+            # any other
+            if not audited or verdict is not True:
+                _faults.count_fallback(_FLUSH_FALLBACK, fallback_exc,
+                                       organic="bisect", site=site)
         else:
             _FLUSH_LANES.add()
         results = self._lane_results(items)
@@ -244,6 +263,15 @@ class DeferredBatch:
         pairing_results = [self._eval_pairing_check(pairs)
                            for pairs, _ in checks]
         _PAIRINGS.add(len(checks))
+        if audited:
+            lanes_ok = all(bool(r) for r in results) \
+                and all(pairing_results)
+            ok = (verdict is True) == lanes_ok
+            supervisor.audit_result(
+                site, ok, "RLC combined verdict diverged from the "
+                "per-lane pairing checks")
+            if ok and verdict is True:
+                _FLUSH_RLC.add()
         for ks, ok in zip(keys, results):
             for k in ks:
                 _memo_put(k, bool(ok))

@@ -6,6 +6,62 @@ import os
 HEAVY = os.environ.get("CS_TPU_HEAVY") == "1"
 
 
+# ---------------------------------------------------------------------------
+# Unified engine-switch accessor
+# ---------------------------------------------------------------------------
+# Every accelerated engine hangs off one boolean ``CS_TPU_*`` variable
+# with the same contract: on unless the variable is exactly ``"0"``.
+# Before PR 9 the live-re-read behavior was implemented per engine
+# (``bls.rlc_enabled``, ``proto_array.enabled``, ...) with slightly
+# different fallbacks, and some consumers latched the import-time
+# module constant instead.  :func:`switch` is the one source of truth:
+#
+# * variable present in the environment -> live re-read (a CI leg or
+#   the supervisor can flip an engine after import and every dispatch
+#   sees it on the next call);
+# * variable absent -> the cached import-time default, re-snapshotted
+#   only by an explicit :func:`refresh` (so deleting the variable
+#   mid-process restores the state the process STARTED with instead of
+#   whatever the last override happened to be).
+#
+# The per-call cost is one ``os.environ`` lookup — the same price the
+# engines already paid individually.
+
+ENGINE_SWITCHES = (
+    "CS_TPU_VECTORIZED_EPOCH",
+    "CS_TPU_PROTO_ARRAY",
+    "CS_TPU_STATE_ARRAYS",
+    "CS_TPU_BLS_RLC",
+    "CS_TPU_HASH_FOREST",
+    "CS_TPU_SUPERVISOR",
+)
+
+_SWITCH_DEFAULTS = {}
+
+
+def _snapshot_switches() -> None:
+    for name in ENGINE_SWITCHES:
+        _SWITCH_DEFAULTS[name] = os.environ.get(name) != "0"
+
+
+_snapshot_switches()
+
+
+def switch(name: str) -> bool:
+    """Live boolean engine switch (see the block comment above)."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return _SWITCH_DEFAULTS.get(name, True)
+    return raw != "0"
+
+
+def refresh() -> None:
+    """Explicitly invalidate the cached import-time defaults (rarely
+    needed: only when a harness wants an *unset* variable to mean "the
+    environment as it is now" rather than "as it was at import")."""
+    _snapshot_switches()
+
+
 def _int_env(name):
     """Optional integer env knob: None when unset or non-numeric."""
     raw = os.environ.get(name, "")
@@ -71,3 +127,13 @@ STATE_ARRAYS = os.environ.get("CS_TPU_STATE_ARRAYS") != "0"
 # variable after import also works (like ``CS_TPU_VECTORIZED_EPOCH``,
 # the switch re-reads the environment at call time when it is present).
 PROTO_ARRAY = os.environ.get("CS_TPU_PROTO_ARRAY") != "0"
+
+# Engine supervisor kill switch: ``CS_TPU_SUPERVISOR=0`` turns the
+# health-tracking supervision layer (``consensus_specs_tpu/supervisor``)
+# into a pass-through — no circuit breakers, no deadline guards, no
+# sentinel audits; every dispatch behaves exactly as before PR 9.
+# Live via :func:`switch` like the other engine flags.  The supervisor's
+# numeric knobs (breaker threshold/window/backoff, audit sampling rate,
+# deadline budget) are documented in ``docs/robustness.md`` and read by
+# ``supervisor.reset()``.
+SUPERVISOR = os.environ.get("CS_TPU_SUPERVISOR") != "0"

@@ -29,7 +29,7 @@ import numpy as np
 
 from ...obs import registry as obs_registry
 from ...obs.tracing import span
-from ..env_flags import HASH_FOREST
+from .. import env_flags
 from . import merkle
 from .types import BasicValue, ByteVectorBase, Container, _SequenceBase
 
@@ -56,8 +56,12 @@ _C_BULK_ROOTS = obs_registry.counter("forest.bulk_roots").labels()
 
 
 def scope_active() -> bool:
-    """True when a hash_forest scope is open (and not already flushing)."""
-    return HASH_FOREST and _scope_depth > 0 and not _in_flush
+    """True when a hash_forest scope is open (and not already flushing).
+    The switch reads live through ``env_flags.switch`` (it used to latch
+    the import-time constant, so a CI leg flipping
+    ``CS_TPU_HASH_FOREST`` after import was silently ignored)."""
+    return env_flags.switch("CS_TPU_HASH_FOREST") \
+        and _scope_depth > 0 and not _in_flush
 
 
 @contextmanager
@@ -187,7 +191,7 @@ def bulk_element_root_bytes(items, et, owner=None) -> bytes:
     only) keys the uint64 column stash for :func:`peek_columns`.
     """
     n = len(items)
-    if not HASH_FOREST or n < _COLUMNAR_MIN:
+    if not env_flags.switch("CS_TPU_HASH_FOREST") or n < _COLUMNAR_MIN:
         return None
     if not isinstance(et, type):
         return None

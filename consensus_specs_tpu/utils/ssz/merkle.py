@@ -29,7 +29,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from ... import faults
+from ... import faults, supervisor
 from ...obs import registry as obs_registry
 from ...obs.tracing import span
 from ..env_flags import MERKLE_BATCH_MIN
@@ -129,6 +129,8 @@ _C_LAYER_SCALAR = obs_registry.counter("merkle.layer_scalar").labels()
 _FALLBACKS = {
     "injected": obs_registry.counter(
         "merkle.fallbacks").labels(reason="injected"),
+    "deadline": obs_registry.counter(
+        "merkle.fallbacks").labels(reason="deadline"),
 }
 
 
@@ -242,12 +244,41 @@ def hash_rows(rows: np.ndarray) -> np.ndarray:
     """Hash an ``(m, 64)`` uint8 array of parent inputs into ``(m, 32)``
     digests in one batched dispatch.  The entry point for gathered
     dirty-pair buffers (incremental engine, forest flushes, columnar
-    container-root reductions)."""
+    container-root reductions).
+
+    Supervised (``consensus_specs_tpu/supervisor``): an open breaker
+    skips the batched attempt and serves the scalar spec path directly;
+    a sampled sentinel audit recomputes the batch through the scalar
+    loop and quarantines the site on any byte difference (the scalar
+    digests are then the authoritative answer, so a corrupt batched
+    backend cannot poison a tree past its audit)."""
+    if not supervisor.admit("merkle.dispatch"):
+        return _hash_rows_scalar(rows)
     try:
         faults.check("merkle.dispatch")
-    except faults.InjectedFault as exc:
-        faults.count_fallback(_FALLBACKS, exc, organic="injected")
+        with supervisor.deadline_scope("merkle.dispatch"):
+            out = _hash_rows_batched(rows)
+    except (faults.InjectedFault, supervisor.DeadlineExceeded) as exc:
+        faults.count_fallback(_FALLBACKS, exc, organic="injected",
+                              site="merkle.dispatch")
         return _hash_rows_scalar(rows)
+    if faults.corrupt_armed("merkle.dispatch"):
+        out = out.copy()
+        out[0, 0] ^= 1
+    if supervisor.audit_due("merkle.dispatch"):
+        golden = _hash_rows_scalar(rows)
+        ok = bool(np.array_equal(out, golden))
+        supervisor.audit_result(
+            "merkle.dispatch", ok,
+            f"batched digests != scalar sha256 ({rows.shape[0]} pairs)")
+        return golden
+    supervisor.note_success("merkle.dispatch")
+    return out
+
+
+def _hash_rows_batched(rows: np.ndarray) -> np.ndarray:
+    """The engine body of :func:`hash_rows`: route the gathered pair
+    buffer to the best available batched backend."""
     m = rows.shape[0]
     if _batched_hasher_np is not None and m >= _BATCH_THRESHOLD:
         _C_PAIR_BATCH_CALLS.n += 1

@@ -55,6 +55,19 @@ def pytest_addoption(parser):
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _supervisor_isolation():
+    """Supervisor breaker/audit state must not leak across tests: a
+    differential test that forces repeated guard or injected fallbacks
+    would otherwise open a site's circuit breaker and demote that
+    engine for every later test in the process (the counter-asserted
+    suites would then see spec-path answers).  Reset is a handful of
+    dict clears — negligible per test."""
+    yield
+    from consensus_specs_tpu import supervisor
+    supervisor.reset()
+
+
 @pytest.fixture
 def metrics_diff():
     """Counter snapshot/diff fixture (``test_infra/metrics.py``): yields

@@ -387,17 +387,18 @@ def test_fallback_counters_carry_reason_labels():
     import consensus_specs_tpu.utils.ssz.merkle  # noqa: F401
 
     assert set(registry.counter("forkchoice.fallbacks").series_values()) \
-        == {"{reason=guard}", "{reason=injected}"}
+        == {"{reason=guard}", "{reason=injected}", "{reason=deadline}"}
     assert set(registry.counter("epoch.fallbacks").series_values()) \
-        == {"{reason=guard}", "{reason=injected}"}
-    # engines whose fast path has no organic guard: injected-only
+        == {"{reason=guard}", "{reason=injected}", "{reason=deadline}"}
+    # engines whose fast path has no organic guard: injected + deadline
     assert set(registry.counter("merkle.fallbacks").series_values()) \
-        == {"{reason=injected}"}
+        == {"{reason=injected}", "{reason=deadline}"}
     assert set(registry.counter("state_arrays.fallbacks").series_values()) \
-        == {"{reason=injected}"}
+        == {"{reason=injected}", "{reason=deadline}"}
     flush = set(registry.counter("bls.flush").series_values())
     assert {"{path=fallback,reason=bisect}",
-            "{path=fallback,reason=injected}"} <= flush
+            "{path=fallback,reason=injected}",
+            "{path=fallback,reason=deadline}"} <= flush
     assert "{path=fallback}" not in flush
 
 

@@ -305,8 +305,9 @@ def test_silent_fallback_is_detected(spec, monkeypatch):
     that absorbs the fault without counting it."""
     scenario = _scenario(spec, _short_script(spec))
     baseline, _ = harness.run_baseline(spec, scenario)
-    monkeypatch.setattr(faults, "count_fallback",
-                        lambda series, exc=None, organic="guard": None)
+    monkeypatch.setattr(
+        faults, "count_fallback",
+        lambda series, exc=None, organic="guard", site=None: None)
     with pytest.raises(harness.LegFailure) as exc:
         harness.run_injected(spec, scenario, baseline,
                              "epoch.rewards_and_penalties", 1)
@@ -322,8 +323,8 @@ def test_organic_leak_is_detected(spec, monkeypatch):
     real = faults.count_fallback
     monkeypatch.setattr(
         faults, "count_fallback",
-        lambda series, exc=None, organic="guard": real(series, None,
-                                                       organic=organic))
+        lambda series, exc=None, organic="guard", site=None:
+        real(series, None, organic=organic, site=site))
     with pytest.raises(harness.LegFailure) as exc:
         harness.run_injected(spec, scenario, baseline,
                              "epoch.rewards_and_penalties", 1)
@@ -483,8 +484,9 @@ def test_minimize_failure_dumps_reduced_artifact(spec, monkeypatch,
     monkeypatch.setenv("CS_TPU_SIM_ARTIFACTS", str(tmp_path))
     scenario = _scenario(spec, _short_script(spec), name="steady", seed=9)
     baseline, _ = harness.run_baseline(spec, scenario)
-    monkeypatch.setattr(faults, "count_fallback",
-                        lambda series, exc=None, organic="guard": None)
+    monkeypatch.setattr(
+        faults, "count_fallback",
+        lambda series, exc=None, organic="guard", site=None: None)
     with pytest.raises(harness.LegFailure) as exc:
         harness.run_injected(spec, scenario, baseline,
                              "epoch.rewards_and_penalties", 1)
@@ -536,6 +538,7 @@ def test_sweep_contains_leg_crashes(tmp_path, spec, monkeypatch, capsys):
     args = argparse.Namespace(
         seeds=2, start=0, fork="phase0", preset="minimal",
         inject_every=1000, max_sites=1, diff_every=1, bls_seeds=0,
+        breaker_every=0, corrupt_every=0,
         min_scenarios=2, artifact_dir=str(tmp_path), shrink_budget=2,
         time_budget=None)
     code = sweep.run_sweep(args)
@@ -546,3 +549,100 @@ def test_sweep_contains_leg_crashes(tmp_path, spec, monkeypatch, capsys):
     names = sorted(p.name for p in tmp_path.iterdir())
     assert len(names) == 2 and all("spec-differential" in n
                                    for n in names)
+
+
+# ---------------------------------------------------------------------------
+# supervisor legs: breaker lifecycle + sentinel-audit corruption
+# ---------------------------------------------------------------------------
+
+def test_breaker_storm_leg_lifecycle(spec):
+    """The acceptance storm: threshold-1 faults at every exercised site
+    open every breaker (counter census), the run stays byte-identical,
+    and the healing replay re-closes every breaker via probes."""
+    from consensus_specs_tpu import supervisor
+    scenario = _scenario(spec, _short_script(spec))
+    baseline, census = harness.run_baseline(spec, scenario)
+    assert not any(baseline.organic.values())
+    result = harness.run_breaker_storm(spec, scenario, baseline, census)
+    assert result is not None
+    assert result.digest() == baseline.digest()
+    assert all(st == "closed" for st in supervisor.states().values())
+
+
+def test_breaker_storm_skips_organic_scenarios(spec):
+    scenario = _scenario(spec, _short_script(spec))
+    baseline, census = harness.run_baseline(spec, scenario)
+    baseline.organic = {k: 1 for k in baseline.organic} or {"x": 1}
+    assert harness.run_breaker_storm(spec, scenario, baseline,
+                                     census) is None
+
+
+def test_breaker_storm_detects_missing_breaker(spec, monkeypatch):
+    """A supervisor that never opens (simulated: the count_fallback ->
+    breaker hook lost) is a loud no-breaker failure, not a vacuous
+    green."""
+    scenario = _scenario(spec, _short_script(spec))
+    baseline, census = harness.run_baseline(spec, scenario)
+    monkeypatch.setattr(faults, "_failure_hook",
+                        lambda site, reason="guard": None)
+    with pytest.raises(harness.LegFailure) as exc:
+        harness.run_breaker_storm(spec, scenario, baseline, census)
+    assert exc.value.category == "no-breaker"
+
+
+def test_corrupt_leg_quarantines_and_stays_identical(spec, tmp_path):
+    """The acceptance corruption: a silently-wrong merkle dispatch is
+    caught by the rate-1 sentinel, quarantined, dumped as an artifact,
+    and the digest never sees the corruption."""
+    scenario = _scenario(spec, _short_script(spec))
+    baseline, census = harness.run_baseline(spec, scenario)
+    site = harness.pick_corrupt_site(census)
+    assert site == "merkle.dispatch"
+    result, path = harness.run_corrupt(spec, scenario, baseline, site,
+                                       out_dir=str(tmp_path))
+    assert result.digest() == baseline.digest()
+    payload = json.loads(open(path).read())
+    assert payload["schedule"]["corrupt"] == {site: 1}
+    assert payload["schedule"]["corrupted"]
+    assert payload["failure"]["kind"] == f"audit[{site}]"
+    assert payload["env"]["CS_TPU_AUDIT_RATE"] == "1"
+
+
+def test_corrupt_leg_detects_missed_audit(spec, monkeypatch, tmp_path):
+    """An audit layer that never samples (simulated: audit_due False)
+    lets the corruption ride — the leg must fail silent-fallback, not
+    pass vacuously."""
+    from consensus_specs_tpu import supervisor
+    scenario = _scenario(spec, _short_script(spec))
+    baseline, census = harness.run_baseline(spec, scenario)
+    monkeypatch.setattr(supervisor, "audit_due", lambda site: False)
+    with pytest.raises(harness.LegFailure) as exc:
+        harness.run_corrupt(spec, scenario, baseline, "merkle.dispatch",
+                            out_dir=str(tmp_path))
+    assert exc.value.category in ("silent-fallback", "diverged")
+
+
+def test_corrupt_artifact_replays(spec, tmp_path, monkeypatch):
+    """repro.replay on a quarantine artifact re-arms the corruption and
+    reproduces the catch (exit 1 + the site quarantined again)."""
+    monkeypatch.setenv("CS_TPU_SIM_ARTIFACTS", str(tmp_path))
+    scenario = _scenario(spec, _short_script(spec))
+    baseline, census = harness.run_baseline(spec, scenario)
+    _, path = harness.run_corrupt(spec, scenario, baseline,
+                                  "merkle.dispatch",
+                                  out_dir=str(tmp_path), fork="phase0",
+                                  preset="minimal")
+    assert repro.replay(path) == 1
+
+
+def test_run_leg_resets_supervisor_per_leg(spec):
+    """Leg isolation: breaker state from one leg must not demote an
+    engine in the next (the PR 8 legs replay cold)."""
+    from consensus_specs_tpu import supervisor
+    scenario = _scenario(spec, _short_script(spec))
+    with supervisor.quarantine_hook(lambda s, d: None):
+        supervisor.quarantine("merkle.dispatch", "leftover")
+    with counting() as delta:
+        harness.run_leg(spec, scenario)
+    assert supervisor.states()["merkle.dispatch"] == "closed"
+    assert delta["supervisor.breaker.skips{site=merkle.dispatch}"] == 0

@@ -22,7 +22,7 @@ from consensus_specs_tpu.tools.speclint.findings import (
     Finding, noqa_codes, suppressed)
 from consensus_specs_tpu.tools.speclint.passes import (
     fallbacks, ladder, obs as obs_pass, specmd, state_layer, style,
-    tracing, uint64)
+    supervision, tracing, uint64)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -728,4 +728,110 @@ def test_fallbacks_scope_and_noqa():
     findings = fallbacks.check_source(SCOPED, suppressed_src)
     lines = suppressed_src.split("\n")
     assert findings, "R701 must fire so the noqa suppresses something"
+    assert all(suppressed(f, lines) for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# supervision pass (R8xx)
+# ---------------------------------------------------------------------------
+
+def test_supervision_flags_unsupervised_site():
+    """R801: a dispatch wrapper calling faults.check without the
+    supervisor.admit gate has no circuit breaker."""
+    src = (
+        "from consensus_specs_tpu import faults\n"
+        "def hash_rows(rows):\n"
+        "    try:\n"
+        "        faults.check('merkle.dispatch')\n"
+        "    except faults.InjectedFault as exc:\n"
+        "        faults.count_fallback(_F, exc)\n"
+        "    return rows\n")
+    findings = supervision.check_source(SCOPED, src)
+    assert _codes(findings) == ["R801"]
+    assert findings[0].line == 4      # anchored at the check call
+
+
+def test_supervision_resolves_site_variable():
+    """R801 resolves the common ``site = \"...\"`` local-binding form
+    on both the check and admit sides."""
+    src = (
+        "from consensus_specs_tpu import faults, supervisor\n"
+        "def try_fast(spec, state):\n"
+        "    site = 'epoch.slashings'\n"
+        "    if not supervisor.admit(site):\n"
+        "        return False\n"
+        "    faults.check(site)\n"
+        "    return True\n")
+    assert supervision.check_source(SCOPED, src) == []
+    unadmitted = src.replace("    if not supervisor.admit(site):\n"
+                             "        return False\n", "")
+    assert _codes(supervision.check_source(SCOPED, unadmitted)) == ["R801"]
+
+
+def test_supervision_skips_parameter_sites():
+    """A helper taking the site as a parameter (the epoch ``_audited``
+    shape) is out of scope — its literal-carrying caller registers."""
+    src = (
+        "from consensus_specs_tpu import faults\n"
+        "def _audited(spec, state, site, fast_fn):\n"
+        "    faults.check(site)\n"
+        "    return fast_fn(spec, state)\n")
+    assert supervision.check_source(SCOPED, src) == []
+
+
+def test_supervision_flags_bare_retry_loop():
+    """R802: swallow-and-retry with no backoff busy-spins at full
+    failure cost under a persistent fault."""
+    src = (
+        "def spin(fn):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return fn()\n"
+        "        except ValueError:\n"
+        "            continue\n")
+    findings = supervision.check_source(SCOPED, src)
+    assert _codes(findings) == ["R802"]
+    assert findings[0].line == 2      # anchored at the loop
+
+
+def test_supervision_accepts_backoff_and_reraise_loops():
+    backoff = (
+        "def spin(fn):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return fn()\n"
+        "        except ValueError:\n"
+        "            time.sleep(0.1)\n")
+    assert supervision.check_source(SCOPED, backoff) == []
+    reraise = (
+        "def spin(fn):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return fn()\n"
+        "        except ValueError:\n"
+        "            raise\n")
+    assert supervision.check_source(SCOPED, reraise) == []
+
+
+def test_supervision_scope_and_noqa():
+    retry = (
+        "def spin(fn):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return fn()\n"
+        "        except ValueError:\n"
+        "            pass\n")
+    # R802 scope is the engine packages, not the harness/test layers
+    assert supervision.check_source("tests/test_x.py", retry) == []
+    assert supervision.check_source(
+        "consensus_specs_tpu/sim/driver.py", retry) == []
+    assert _codes(supervision.check_source(
+        "consensus_specs_tpu/state/arrays.py", retry)) == ["R802"]
+    # noqa suppression (driver-side), non-vacuous
+    noqa_src = retry.replace("    while True:",
+                             "    while True:  # noqa: R802")
+    findings = supervision.check_source(
+        "consensus_specs_tpu/state/arrays.py", noqa_src)
+    lines = noqa_src.split("\n")
+    assert findings, "R802 must fire so the noqa suppresses something"
     assert all(suppressed(f, lines) for f in findings)

@@ -1,0 +1,429 @@
+"""Engine-supervisor unit suite (``consensus_specs_tpu/supervisor``):
+breaker state machine under a fake clock, deadline guards, sentinel
+audits + quarantine, the unified ``env_flags.switch`` accessor, and the
+``CS_TPU_SUPERVISOR=0`` pass-through contract."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu import faults, supervisor
+from consensus_specs_tpu.test_infra.metrics import counting
+from consensus_specs_tpu.utils import env_flags
+
+SITE = "merkle.dispatch"
+
+
+@pytest.fixture(autouse=True)
+def _supervisor_on(monkeypatch, tmp_path):
+    """This suite drives the supervisor explicitly: pin the master
+    switch ON regardless of the process env (the CI off-leg runs the
+    whole suite under CS_TPU_SUPERVISOR=0; tests of the off behavior
+    override to \"0\" themselves — the switch reads live), and point
+    quarantine artifact dumps at the test's tmp dir so quarantining
+    tests never dirty the working tree."""
+    monkeypatch.setenv("CS_TPU_SUPERVISOR", "1")
+    monkeypatch.setenv("CS_TPU_SIM_ARTIFACTS", str(tmp_path))
+
+
+@pytest.fixture
+def clock(monkeypatch):
+    """Deterministic supervisor time: yields a one-element list; tests
+    advance it by assignment."""
+    t = [1000.0]
+    monkeypatch.setattr(supervisor, "_clock", lambda: t[0])
+    return t
+
+
+@pytest.fixture
+def knobs(monkeypatch):
+    """Tight, deterministic breaker knobs (threshold 3, 10s window,
+    100ms base backoff, fixed seed) applied and picked up by reset."""
+    monkeypatch.setenv("CS_TPU_BREAKER_THRESHOLD", "3")
+    monkeypatch.setenv("CS_TPU_BREAKER_WINDOW_MS", "10000")
+    monkeypatch.setenv("CS_TPU_BREAKER_BACKOFF_MS", "100")
+    monkeypatch.setenv("CS_TPU_BREAKER_BACKOFF_MAX_MS", "100000")
+    monkeypatch.setenv("CS_TPU_SUPERVISOR_SEED", "7")
+    supervisor.reset()
+    yield
+    supervisor.reset()
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker state machine
+# ---------------------------------------------------------------------------
+
+def test_breaker_opens_at_threshold_within_window(clock, knobs):
+    for _ in range(2):
+        supervisor.note_failure(SITE)
+    assert supervisor.states()[SITE] == "closed"
+    assert supervisor.admit(SITE)
+    supervisor.note_failure(SITE)
+    assert supervisor.states()[SITE] == "open"
+    with counting() as delta:
+        assert not supervisor.admit(SITE)
+    assert delta[f"supervisor.breaker.skips{{site={SITE}}}"] == 1
+
+
+def test_failures_outside_window_do_not_trip(clock, knobs):
+    supervisor.note_failure(SITE)
+    supervisor.note_failure(SITE)
+    clock[0] += 11.0          # past the 10s window
+    supervisor.note_failure(SITE)
+    assert supervisor.states()[SITE] == "closed"
+
+
+def test_success_clears_the_failure_run(clock, knobs):
+    supervisor.note_failure(SITE)
+    supervisor.note_failure(SITE)
+    supervisor.note_success(SITE)
+    supervisor.note_failure(SITE)
+    supervisor.note_failure(SITE)
+    assert supervisor.states()[SITE] == "closed"   # run never reached 3
+
+
+def test_backoff_probe_and_repromotion(clock, knobs):
+    for _ in range(3):
+        supervisor.note_failure(SITE)
+    assert supervisor.states()[SITE] == "open"
+    # before backoff: skipped
+    assert not supervisor.admit(SITE)
+    # after backoff (base 100ms, jitter <= 25%): the next admit is the
+    # half-open probe
+    clock[0] += 0.125 + 1e-6
+    with counting() as delta:
+        assert supervisor.admit(SITE)
+    assert supervisor.states()[SITE] == "half_open"
+    assert delta[f"supervisor.transitions{{site={SITE},to=half_open}}"] == 1
+    supervisor.note_success(SITE)
+    assert supervisor.states()[SITE] == "closed"
+
+
+def test_probe_failure_doubles_backoff(clock, knobs):
+    base_lo, base_hi = 0.1, 0.125
+    for _ in range(3):
+        supervisor.note_failure(SITE)
+    first = supervisor._breakers[SITE].reopen_at - clock[0]
+    assert base_lo <= first <= base_hi
+    clock[0] += first + 1e-6
+    assert supervisor.admit(SITE)                  # the probe
+    supervisor.note_failure(SITE)                  # probe fails
+    assert supervisor.states()[SITE] == "open"
+    second = supervisor._breakers[SITE].reopen_at - clock[0]
+    assert 2 * base_lo <= second <= 2 * base_hi    # doubled (+jitter)
+
+
+def test_backoff_jitter_is_seeded_deterministic(clock, monkeypatch):
+    def trip_once():
+        monkeypatch.setenv("CS_TPU_BREAKER_THRESHOLD", "1")
+        monkeypatch.setenv("CS_TPU_BREAKER_BACKOFF_MS", "100")
+        monkeypatch.setenv("CS_TPU_SUPERVISOR_SEED", "42")
+        supervisor.reset()
+        supervisor.note_failure(SITE)
+        return supervisor._breakers[SITE].reopen_at - clock[0]
+    try:
+        assert trip_once() == trip_once()
+    finally:
+        supervisor.reset()
+
+
+def test_deadline_overruns_accumulate_past_successes(clock, knobs,
+                                                     monkeypatch):
+    """A dispatch that completes correctly but over budget books a
+    ``reason=deadline`` breaker failure that interleaved successes must
+    NOT clear — a persistently slow engine demotes."""
+    for _ in range(2):
+        supervisor.note_failure(SITE, "deadline")
+        supervisor.note_success(SITE)
+    supervisor.note_failure(SITE, "deadline")
+    assert supervisor.states()[SITE] == "open"
+
+
+# ---------------------------------------------------------------------------
+# deadline guards
+# ---------------------------------------------------------------------------
+
+def test_deadline_scope_noop_without_budget(knobs):
+    with supervisor.deadline_scope(SITE):
+        supervisor.deadline_check()      # never raises when disarmed
+    assert supervisor._deadline_stack == []
+
+
+def test_deadline_check_raises_midwork(clock, monkeypatch):
+    monkeypatch.setenv("CS_TPU_DEADLINE_MS", "10")
+    supervisor.reset()
+    try:
+        with counting() as delta:
+            with pytest.raises(supervisor.DeadlineExceeded):
+                with supervisor.deadline_scope(SITE):
+                    clock[0] += 0.02     # 20ms > the 10ms budget
+                    supervisor.deadline_check()
+        assert delta[f"supervisor.deadline.trips{{site={SITE}}}"] == 1
+        assert supervisor._deadline_stack == []
+    finally:
+        supervisor.reset()
+
+
+def test_completed_overrun_books_posthoc_trip(clock, monkeypatch):
+    monkeypatch.setenv("CS_TPU_DEADLINE_MS", "10")
+    supervisor.reset()
+    try:
+        with counting() as delta:
+            with supervisor.deadline_scope(SITE):
+                clock[0] += 0.02         # slow, but completes
+        assert delta[f"supervisor.deadline.trips{{site={SITE}}}"] == 1
+    finally:
+        supervisor.reset()
+
+
+def test_engine_deadline_falls_back_counted(clock, monkeypatch):
+    """Engine-level wiring: a mid-work deadline inside an epoch kernel
+    converts the call into a counted ``reason=deadline`` fallback and
+    the spec loop serves it."""
+    from consensus_specs_tpu.forks import build_spec
+    from consensus_specs_tpu.ops import epoch_kernels
+    from consensus_specs_tpu.tools.obs_report import build_state
+    spec = build_spec("phase0", "minimal")
+    state = build_state(spec, 8)
+    monkeypatch.setenv("CS_TPU_DEADLINE_MS", "5")
+    supervisor.reset()
+    try:
+        orig = epoch_kernels._registry_updates
+
+        def slow(spec, state):
+            clock[0] += 1.0
+            supervisor.deadline_check()
+            orig(spec, state)
+
+        monkeypatch.setattr(epoch_kernels, "_registry_updates", slow)
+        with counting() as delta:
+            handled = epoch_kernels.try_process_registry_updates(spec, state)
+        assert handled is False
+        assert delta["epoch.fallbacks{reason=deadline}"] == 1
+        assert delta["supervisor.deadline.trips"
+                     "{site=epoch.registry_updates}"] == 1
+    finally:
+        supervisor.reset()
+
+
+# ---------------------------------------------------------------------------
+# sentinel audits + quarantine (driven through the real merkle engine)
+# ---------------------------------------------------------------------------
+
+def _rows(n=16):
+    return np.arange(n * 64, dtype=np.uint8).reshape(n, 64)
+
+
+def test_audit_passes_on_clean_engine(monkeypatch):
+    from consensus_specs_tpu.utils.ssz import merkle
+    monkeypatch.setenv("CS_TPU_AUDIT_RATE", "1")
+    supervisor.reset()
+    rows = _rows()
+    with counting() as delta:
+        out = merkle.hash_rows(rows)
+    assert np.array_equal(out, merkle._hash_rows_scalar(rows))
+    assert delta[f"supervisor.audits{{result=pass,site={SITE}}}"] == 1
+    assert supervisor.states()[SITE] == "closed"
+
+
+def test_corruption_caught_within_k_calls(monkeypatch, tmp_path):
+    """The acceptance contract: a persistently corrupt engine result is
+    caught by the sampled sentinel within K calls, the site is
+    quarantined (breaker open, reason=audit), a replayable artifact is
+    dumped, and subsequent calls skip the corrupt engine entirely."""
+    from consensus_specs_tpu.utils.ssz import merkle
+    k = 3
+    monkeypatch.setenv("CS_TPU_AUDIT_RATE", str(k))
+    monkeypatch.setenv("CS_TPU_SIM_ARTIFACTS", str(tmp_path))
+    supervisor.reset()
+    rows = _rows()
+    golden = merkle._hash_rows_scalar(rows)
+    schedule = faults.FaultSchedule(corrupt={SITE: [1]})
+    caught_at = None
+    with counting() as delta:
+        with faults.injected(schedule):
+            for i in range(1, k + 1):
+                merkle.hash_rows(rows)
+                if supervisor.states()[SITE] == "quarantined":
+                    caught_at = i
+                    break
+    assert caught_at is not None and caught_at <= k
+    assert delta[f"supervisor.audits{{result=fail,site={SITE}}}"] == 1
+    assert delta[f"supervisor.quarantines{{site={SITE}}}"] == 1
+    path = supervisor.last_quarantine()
+    assert path is not None and os.path.isfile(path)
+    # quarantined: the engine is never re-probed, every dispatch serves
+    # the spec-shaped scalar path byte-identical
+    with counting() as delta:
+        out = merkle.hash_rows(rows)
+    assert np.array_equal(out, golden)
+    assert delta[f"supervisor.breaker.skips{{site={SITE}}}"] == 1
+    assert delta[f"supervisor.audits{{result=fail,site={SITE}}}"] == 0
+
+
+def test_quarantine_never_reprobes(clock, knobs):
+    supervisor.quarantine(SITE, "test")
+    clock[0] += 1e9
+    assert not supervisor.admit(SITE)
+    assert supervisor.states()[SITE] == "quarantined"
+
+
+def test_audited_call_serves_spec_answer_on_mismatch(monkeypatch):
+    """Even the corrupted call itself answers with the spec result —
+    quarantine means the wrong answer never left the engine."""
+    from consensus_specs_tpu.utils.ssz import merkle
+    monkeypatch.setenv("CS_TPU_AUDIT_RATE", "1")
+    supervisor.reset()
+    rows = _rows()
+    golden = merkle._hash_rows_scalar(rows)
+    with supervisor.quarantine_hook(lambda s, d: None):
+        with faults.injected(faults.FaultSchedule(corrupt={SITE: [1]})):
+            out = merkle.hash_rows(rows)
+    assert np.array_equal(out, golden)
+
+
+def test_epoch_audit_passes_and_spec_serves(monkeypatch):
+    """Epoch-site audit shape: the spec loop runs on the real state,
+    the kernel on a probe copy, post-states merkleize identical."""
+    from consensus_specs_tpu.forks import build_spec
+    from consensus_specs_tpu.ops import epoch_kernels
+    from consensus_specs_tpu.tools.obs_report import build_state
+    from consensus_specs_tpu.utils.ssz import hash_tree_root
+    spec = build_spec("phase0", "minimal")
+    state = build_state(spec, 8)
+    oracle = build_state(spec, 8)
+    monkeypatch.setenv("CS_TPU_AUDIT_RATE", "1")
+    supervisor.reset()
+    with counting() as delta:
+        handled = epoch_kernels.try_process_registry_updates(spec, state)
+    assert handled is True
+    site = "epoch.registry_updates"
+    assert delta[f"supervisor.audits{{result=pass,site={site}}}"] == 1
+    supervisor.reset()
+    monkeypatch.delenv("CS_TPU_AUDIT_RATE")
+    assert epoch_kernels.try_process_registry_updates(spec, oracle)
+    assert bytes(hash_tree_root(state)) == bytes(hash_tree_root(oracle))
+
+
+# ---------------------------------------------------------------------------
+# the unified env_flags.switch accessor (live re-read regression)
+# ---------------------------------------------------------------------------
+
+def test_every_engine_switch_reads_live(monkeypatch):
+    """Flipping each CS_TPU_* engine flag mid-process must be seen by
+    its engine's enabled() accessor on the next call — one source of
+    truth, no import-latched stragglers."""
+    from consensus_specs_tpu.forkchoice import proto_array
+    from consensus_specs_tpu.ops import epoch_kernels
+    from consensus_specs_tpu.state import arrays
+    from consensus_specs_tpu.utils import bls
+    from consensus_specs_tpu.utils.ssz import forest
+    probes = {
+        "CS_TPU_VECTORIZED_EPOCH": epoch_kernels.enabled,
+        "CS_TPU_PROTO_ARRAY": proto_array.enabled,
+        "CS_TPU_STATE_ARRAYS": arrays.enabled,
+        "CS_TPU_BLS_RLC": bls.rlc_enabled,
+        "CS_TPU_SUPERVISOR": supervisor.enabled,
+        "CS_TPU_HASH_FOREST":
+            lambda: env_flags.switch("CS_TPU_HASH_FOREST"),
+    }
+    for var, probe in probes.items():
+        monkeypatch.setenv(var, "1")
+        assert probe() is True, var
+        monkeypatch.setenv(var, "0")
+        assert probe() is False, var
+        monkeypatch.delenv(var)
+        assert probe() is env_flags._SWITCH_DEFAULTS.get(var, True), \
+            f"{var}: unset must fall back to the import-time default"
+    # the forest scope gate itself honors the live read
+    monkeypatch.setenv("CS_TPU_HASH_FOREST", "0")
+    with forest.hash_forest():
+        assert not forest.scope_active()
+    monkeypatch.delenv("CS_TPU_HASH_FOREST")
+    with forest.hash_forest():
+        assert forest.scope_active()
+
+
+def test_switch_refresh_resnapshots_defaults(monkeypatch):
+    saved = dict(env_flags._SWITCH_DEFAULTS)
+    try:
+        monkeypatch.setenv("CS_TPU_PROTO_ARRAY", "0")
+        env_flags.refresh()
+        monkeypatch.delenv("CS_TPU_PROTO_ARRAY")
+        # unset now falls back to the refreshed default (off)
+        assert env_flags.switch("CS_TPU_PROTO_ARRAY") is False
+    finally:
+        env_flags._SWITCH_DEFAULTS.clear()
+        env_flags._SWITCH_DEFAULTS.update(saved)
+    assert env_flags.switch("CS_TPU_PROTO_ARRAY") \
+        is saved["CS_TPU_PROTO_ARRAY"]
+
+
+# ---------------------------------------------------------------------------
+# CS_TPU_SUPERVISOR=0: exact pre-supervisor behavior
+# ---------------------------------------------------------------------------
+
+def test_supervisor_off_is_passthrough(clock, knobs, monkeypatch):
+    supervisor.quarantine(SITE, "pre-existing")
+    monkeypatch.setenv("CS_TPU_SUPERVISOR", "0")
+    # a quarantined site admits, failures/audits book nothing
+    assert supervisor.admit(SITE)
+    with counting() as delta:
+        supervisor.note_failure(SITE)
+        supervisor.note_success(SITE)
+        assert supervisor.audit_due(SITE) is False
+        with supervisor.deadline_scope(SITE):
+            supervisor.deadline_check()
+    assert not delta.nonzero()
+    assert supervisor._deadline_stack == []
+
+
+def test_supervisor_off_engine_paths_unchanged(monkeypatch):
+    """With the switch off and a breaker artificially open, the merkle
+    engine must dispatch its batched path as if the supervisor did not
+    exist (and still serve the fault-injection contract)."""
+    from consensus_specs_tpu.utils.ssz import merkle
+    supervisor.reset()
+    supervisor.quarantine(SITE, "poisoned state that must be ignored")
+    monkeypatch.setenv("CS_TPU_SUPERVISOR", "0")
+    rows = _rows()
+    golden = merkle._hash_rows_scalar(rows)
+    with counting() as delta:
+        out = merkle.hash_rows(rows)
+    assert np.array_equal(out, golden)
+    assert delta[f"supervisor.breaker.skips{{site={SITE}}}"] == 0
+    # injected faults still fall back counted, exactly PR-8 behavior
+    schedule = faults.FaultSchedule({SITE: [1]})
+    with counting() as delta:
+        with faults.injected(schedule):
+            out = merkle.hash_rows(rows)
+    assert np.array_equal(out, golden)
+    assert schedule.fully_fired()
+    assert delta["merkle.fallbacks{reason=injected}"] == 1
+
+
+# ---------------------------------------------------------------------------
+# telemetry surface
+# ---------------------------------------------------------------------------
+
+def test_supervisor_metrics_export(clock, knobs):
+    from consensus_specs_tpu.obs import export
+    for _ in range(3):
+        supervisor.note_failure(SITE)
+    assert not supervisor.admit(SITE)
+    snap = export.snapshot()
+    export.assert_schema(snap, require_nonempty=("supervisor.",))
+    gauge = snap["metrics"]["supervisor.breaker"]["series"]
+    assert gauge[f"{{site={SITE}}}"] == 1          # open
+    prom = export.to_prometheus()
+    assert "cs_tpu_supervisor_transitions" in prom
+    assert f'site="{SITE}"' in prom
+
+
+def test_states_reports_all_sites(knobs):
+    states = supervisor.states()
+    assert set(states) >= set(faults.SITES)
+    assert all(v == "closed" for v in states.values())
