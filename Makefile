@@ -4,11 +4,12 @@ PYTHON ?= python
 OUTPUT_DIR ?= ../consensus-spec-tests
 GENERATORS = operations sanity finality rewards random forks epoch_processing \
              genesis ssz_static bls shuffling light_client kzg_4844 \
-             fork_choice merkle_proof ssz_generic sync transition
+             kzg_7594 fork_choice merkle_proof ssz_generic sync transition
 
 .PHONY: test citest test-crypto bench bench-all bench-merkle-smoke \
         bench-forkchoice-smoke bench-obs-smoke bench-block-smoke \
-        bench-state-smoke bench-supervisor-smoke sim-smoke sim-heavy \
+        bench-state-smoke bench-supervisor-smoke bench-das-smoke \
+        sim-smoke sim-heavy \
         obs-report dryrun warm native lint speclint-baseline \
         generate_tests $(addprefix gen_,$(GENERATORS)) clean-vectors pyspec
 
@@ -33,6 +34,7 @@ citest:
 	$(PYTHON) benchmarks/bench_block_verify.py --smoke
 	$(PYTHON) benchmarks/bench_state_arrays.py --smoke
 	$(PYTHON) benchmarks/bench_supervisor.py
+	$(PYTHON) benchmarks/bench_das.py
 	$(MAKE) sim-smoke
 	$(PYTHON) -m pytest tests/ -q --enable-bls --bls-type fastest
 
@@ -141,6 +143,18 @@ sim-heavy:
 # per-op cost; nonzero exit above the bound)
 bench-obs-smoke:
 	$(PYTHON) benchmarks/bench_obs_overhead.py
+
+# DAS engine smoke (docs/das.md): a multi-blob cell-proof batch must
+# verify in exactly ONE pairing check (ZERO of its own inside an RLC
+# scope — the block's single flush pairing carries it), batched
+# multi-blob erasure recovery must beat the per-blob spec-markdown
+# loop byte-identically, and the CS_TPU_DAS=0 wrapper overhead must
+# stay under the 2% bound (counter-asserted; nonzero exit on any
+# regression).  Native build is best-effort — the engine folds and the
+# spec loop both degrade to the python pairing oracle without it.
+bench-das-smoke:
+	-$(MAKE) native
+	$(PYTHON) benchmarks/bench_das.py
 
 # engine-supervisor smoke (docs/robustness.md): counter-asserted
 # breaker lifecycle on a real dispatch site (threshold trips ->

@@ -40,7 +40,8 @@ _FORK_DOCS = {
     "whisk": ["_features/whisk/beacon-chain.md",
               "_features/whisk/fork.md"],
     "eip7594": ["_features/eip7594/fork.md",
-                "_features/eip7594/polynomial-commitments-sampling.md"],
+                "_features/eip7594/polynomial-commitments-sampling.md",
+                "_features/das/das-core.md"],
 }
 
 # Build order: every fork compiles after its compiled base class exists.
@@ -144,7 +145,7 @@ from consensus_specs_tpu.forks.compiled.capella import CompiledCapellaSpec
         "bases": "CompiledDenebSpec",
         "imports": """\
 from consensus_specs_tpu.forks.eip7594 import *  # noqa: F401,F403
-from consensus_specs_tpu.forks.eip7594 import hash_tree_root
+from consensus_specs_tpu.forks.eip7594 import hash, hash_tree_root
 from consensus_specs_tpu.forks.compiled.deneb import CompiledDenebSpec
 """,
     },

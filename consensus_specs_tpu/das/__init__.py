@@ -1,0 +1,25 @@
+"""Data-availability-sampling engine (EIP-7594 / PeerDAS).
+
+The executable spec chapters
+(``specs/_features/eip7594/polynomial-commitments-sampling.md``,
+``specs/_features/das/das-core.md``) ARE the authoritative sampling
+runtime — one pairing check per cell, one erasure recovery per blob.
+This package is the accelerated twin behind ``CS_TPU_DAS``:
+
+* :mod:`kernels` — the batched crypto: a whole cell-proof batch folded
+  into 2 MSMs + ONE pairing check (deferred into the block's single
+  PR-6 RLC pairing when a batch scope is active), and columnar
+  multi-blob erasure recovery (vanishing polynomial, coset FFTs and
+  Montgomery batch inversion shared across every blob missing the same
+  columns; optional limb-kernel FFTs via ``ops/jax_bls/fr_fft``).
+* :mod:`engine` — the dispatch layer: live ``CS_TPU_DAS`` switch,
+  ``faults.SITES`` entries (``das.verify``, ``das.recover``) with
+  counted spec-loop fallbacks, supervisor circuit breaker / deadline /
+  sentinel-audit integration, and ``install_das_accel`` which wraps the
+  fork classes from outside (the spec bodies stay spec-shaped).
+
+Docs: ``docs/das.md``.
+"""
+from consensus_specs_tpu.das.engine import (  # noqa: F401
+    enabled, install_das_accel, recover_many,
+)

@@ -2,7 +2,8 @@
 
 The accelerated engines (epoch kernels, proto-array fork choice, the
 merkle batch dispatch, the BLS RLC flush, the StateArrays chunk-packed
-commit) each keep a spec-shaped fallback path that must produce
+commit, the DAS batched verify/recover) each keep a spec-shaped
+fallback path that must produce
 byte-identical results when the fast path refuses a call.  Nothing in
 the ordinary test suites *forces* those paths under failure, so a
 fallback that silently corrupted state — or a handler that swallowed
@@ -62,6 +63,8 @@ SITES = (
     "merkle.dispatch",
     "state_arrays.commit",
     "bls.flush",
+    "das.verify",
+    "das.recover",
 )
 
 _active = None      # the armed schedule; None = disarmed (the hot path)

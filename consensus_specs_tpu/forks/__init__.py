@@ -19,7 +19,9 @@ def register_fork(name):
         # CS_TPU_PROFILE/CS_TPU_TRACE)
         from consensus_specs_tpu.obs import install_tracing
         from consensus_specs_tpu.ops.att_prep import install_att_prep
+        from consensus_specs_tpu.das.engine import install_das_accel
         install_att_prep(cls)
+        install_das_accel(cls)
         install_tracing(cls)
         _REGISTRY[name] = cls
         cls.fork = name
@@ -97,17 +99,19 @@ def use_compiled_registry():
     from consensus_specs_tpu.ops.epoch_kernels import install_vectorized_epoch
     from consensus_specs_tpu.forkchoice.proto_array import (
         install_forkchoice_accel)
+    from consensus_specs_tpu.das.engine import install_das_accel
     for fork in _FORK_ORDER:
         mod = importlib.import_module(f"{__name__}.compiled.{fork}")
         importlib.reload(mod)
         cls = getattr(mod, f"Compiled{fork.capitalize()}Spec")
         # compiled method bodies are emitted verbatim from the markdown,
-        # so the vectorized-epoch, attestation message-prep and
-        # proto-array fork-choice dispatches (and the tracing spans)
-        # wrap them from outside
+        # so the vectorized-epoch, attestation message-prep, proto-array
+        # fork-choice and DAS sampling dispatches (and the tracing
+        # spans) wrap them from outside
         install_vectorized_epoch(cls)
         install_att_prep(cls)
         install_forkchoice_accel(cls)
+        install_das_accel(cls)
         install_tracing(cls)
         _REGISTRY[fork] = cls
     _spec_cache.clear()

@@ -1,0 +1,248 @@
+"""Fr (BLS12-381 scalar field) batched radix-2 FFT as limb kernels.
+
+The DAS engine's erasure recovery is FFT-bound: recovering B blobs is
+4 forward/inverse FFTs of 2N field elements each.  This module holds a
+255-bit element as 16 x 16-bit limbs in ``uint32`` lanes (the
+``limbs.py`` representation scaled down from Fq's 24 limbs) and runs
+the whole batch's butterflies stage-by-stage as one vectorized dispatch
+per stage — ``(B, n/2, 16)`` Montgomery multiplies against precomputed
+twiddle tables, then carry-lookahead normalization, exactly the
+formulation ``limbs.py`` documents for the MXU/TPU path.
+
+Backend: ``from .backend import xp`` — the JAX device kernel by
+default, the pure-numpy mirror under ``CS_TPU_NUMPY_KERNELS=1`` (same
+source, eager numpy, no XLA compile — the 1-core-host mode the engine's
+``CS_TPU_DAS_FFT=limb`` knob is measured with).  The per-blob python
+spec loop stays the counted fallback; this kernel is opt-in.
+
+Exactness argument (same as ``limbs.py``): limb products split into
+16-bit halves exact in f32; the 32-term antidiagonal column sums stay
+below ``32 * (2^16 - 1) < 2^21`` — exact in f32 accumulation and far
+from uint32 overflow in the carry chain.
+"""
+import numpy as np
+
+from .backend import xp as jnp, dot_f32, kjit
+
+from consensus_specs_tpu.ops.bls12_381.fields import R_ORDER
+
+NLIMB = 16
+LIMB_BITS = 16
+MASK = jnp.uint32(0xFFFF)
+_NCOL = 2 * NLIMB
+
+R_MONT = (1 << (NLIMB * LIMB_BITS)) % R_ORDER        # 2^256 mod r
+R2_MONT = (R_MONT * R_MONT) % R_ORDER
+NPRIME = (-pow(R_ORDER, -1, 1 << (NLIMB * LIMB_BITS))) \
+    % (1 << (NLIMB * LIMB_BITS))
+
+
+def int_to_limbs(n: int) -> np.ndarray:
+    return np.array([(n >> (LIMB_BITS * i)) & 0xFFFF for i in range(NLIMB)],
+                    dtype=np.uint32)
+
+
+def limbs_to_int(limbs) -> int:
+    arr = np.asarray(limbs).reshape(-1)
+    assert arr.shape == (NLIMB,)
+    return sum(int(arr[i]) << (LIMB_BITS * i) for i in range(NLIMB))
+
+
+R_LIMBS = int_to_limbs(R_ORDER)
+NPRIME_LIMBS = int_to_limbs(NPRIME)
+R2_LIMBS = int_to_limbs(R2_MONT)
+
+
+def _shift_limbs(x, d):
+    pad = jnp.zeros(x.shape[:-1] + (d,), x.dtype)
+    return jnp.concatenate([pad, x[..., :-d]], axis=-1)
+
+
+def _kogge_stone(g, p, n):
+    d = 1
+    while d < n:  # static log2-depth unroll: n is a python int
+        g = g | (p & _shift_limbs(g, d))
+        p = p & _shift_limbs(p, d)
+        d *= 2
+    return g
+
+
+def _carry_chain(cols, n_out):
+    """Propagate 16-bit carries over (..., n) columns -> (..., n_out)."""
+    c = cols[..., :n_out]
+    c = (c & MASK) + _shift_limbs(c >> LIMB_BITS, 1)
+    c = (c & MASK) + _shift_limbs(c >> LIMB_BITS, 1)
+    lo = c & MASK
+    g = c >> LIMB_BITS
+    p = (lo == MASK).astype(jnp.uint32)
+    carry_in = _shift_limbs(_kogge_stone(g, p, n_out), 1)
+    return (lo + carry_in) & MASK
+
+
+def _make_scatter_matrix() -> np.ndarray:
+    S = np.zeros((2, NLIMB, NLIMB, _NCOL), np.float32)
+    for i in range(NLIMB):
+        for j in range(NLIMB):
+            S[0, i, j, i + j] = 1.0
+            S[1, i, j, i + j + 1] = 1.0
+    return S.reshape(2 * NLIMB * NLIMB, _NCOL)
+
+
+_SCATTER = _make_scatter_matrix()
+
+
+def _product_columns(a, b):
+    """(...,16) x (...,16) -> (...,32) antidiagonal column sums (< 2^21)
+    as ONE f32 matmul against the constant scatter matrix (the
+    ``limbs._product_columns`` formulation; rationale documented there)."""
+    prods = a[..., :, None] * b[..., None, :]            # exact in uint32
+    lo = (prods & MASK).astype(jnp.float32)
+    hi = (prods >> LIMB_BITS).astype(jnp.float32)
+    stacked = jnp.concatenate([lo, hi], axis=-2)
+    flat = stacked.reshape(stacked.shape[:-2] + (2 * NLIMB * NLIMB,))
+    cols = dot_f32(flat, jnp.asarray(_SCATTER))
+    return cols.astype(jnp.uint32)
+
+
+def _full_mul(a, b):
+    return _carry_chain(_product_columns(a, b), _NCOL)
+
+
+def _low_mul(a, b):
+    return _carry_chain(_product_columns(a, b), NLIMB)
+
+
+def _sub_limbs(a, b):
+    t = a + (MASK + jnp.uint32(1)) - b
+    g = (jnp.uint32(1) - (t >> LIMB_BITS))
+    p = (t == MASK + jnp.uint32(1)).astype(jnp.uint32)
+    borrow_in = _shift_limbs(_kogge_stone(g, p, a.shape[-1]), 1)
+    out = (t - borrow_in) & MASK
+    top = (t[..., -1] - borrow_in[..., -1]) >> LIMB_BITS
+    borrow = jnp.uint32(1) - top
+    return out, borrow
+
+
+def _cond_sub_r(x):
+    r = jnp.asarray(R_LIMBS)
+    d, borrow = _sub_limbs(x, jnp.broadcast_to(r, x.shape))
+    return jnp.where((borrow != 0)[..., None], x, d)
+
+
+def add_mod(a, b):
+    return _cond_sub_r(_carry_chain(a + b, NLIMB))
+
+
+def sub_mod(a, b):
+    d, borrow = _sub_limbs(a, b)
+    d2 = _carry_chain(d + jnp.asarray(R_LIMBS), NLIMB)
+    return jnp.where((borrow != 0)[..., None], d2, d)
+
+
+def mont_mul(a, b):
+    """Montgomery product a * b * R^{-1} mod r (inputs/outputs reduced)."""
+    t = _full_mul(a, b)
+    m = _low_mul(t[..., :NLIMB], jnp.asarray(NPRIME_LIMBS))
+    u = _full_mul(m, jnp.asarray(R_LIMBS))
+    s = _carry_chain(t + u, _NCOL)
+    return _cond_sub_r(s[..., NLIMB:])
+
+
+def pack_ints_mont(values) -> np.ndarray:
+    """Host: nested int lists -> (..., 16) Montgomery limb array."""
+    arr = np.asarray(
+        [[int_to_limbs(int(v) % R_ORDER) for v in row] for row in values],
+        dtype=np.uint32)
+    r2 = jnp.broadcast_to(jnp.asarray(R2_LIMBS), arr.shape)
+    return mont_mul(jnp.asarray(arr), r2)
+
+
+def unpack_mont(limbs) -> list:
+    """Device (..., 16) Montgomery limbs -> nested python-int lists."""
+    one = np.zeros(NLIMB, np.uint32)
+    one[0] = 1
+    plain = np.asarray(mont_mul(limbs, jnp.broadcast_to(jnp.asarray(one),
+                                                        np.shape(limbs))))
+    out = []
+    for row in plain:
+        out.append([sum(int(row[i][k]) << (LIMB_BITS * k)
+                        for k in range(NLIMB)) for i in range(row.shape[0])])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Batched radix-2 FFT
+# ---------------------------------------------------------------------------
+# Stage tables are host-precomputed per (n, inv): gather indices for the
+# lo/hi butterfly halves and the Montgomery-form twiddles, so the device
+# kernel is pure vectorized arithmetic — one (B, n/2) mont_mul + one
+# add/sub pair per stage, log2(n) stages.
+
+_STAGE_CACHE = {}
+
+
+def _stage_tables(n: int, roots_key, roots):
+    key = (n, roots_key)
+    hit = _STAGE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    assert n & (n - 1) == 0 and len(roots) == n
+    stages = []
+    m = 2
+    while m <= n:
+        stride = n // m
+        half = m // 2
+        lo_idx = np.concatenate(
+            [np.arange(start, start + half) for start in range(0, n, m)])
+        hi_idx = lo_idx + half
+        tw = np.asarray(
+            [int_to_limbs(int(roots[j * stride]) * R_MONT % R_ORDER)
+             for j in range(half)] * (n // m), dtype=np.uint32)
+        order = np.argsort(np.concatenate([lo_idx, hi_idx]))
+        stages.append((lo_idx, hi_idx, tw, order))
+        m *= 2
+    brev = np.array([int(format(i, f"0{n.bit_length() - 1}b")[::-1], 2)
+                     for i in range(n)])
+    _STAGE_CACHE[key] = (stages, brev)
+    return stages, brev
+
+
+@kjit
+def _butterfly(lo, hi, tw):
+    b = mont_mul(hi, tw)
+    return add_mod(lo, b), sub_mod(lo, b)
+
+
+def fft_batch(rows, roots, inv: bool = False, roots_key=None):
+    """Batched FFT: ``rows`` is a list of equal-length int lists (one
+    polynomial per row), ``roots`` the full forward domain.  Returns the
+    transformed rows as python ints — identical to mapping
+    ``ops.kzg_7594.fft_field`` over the rows.
+
+    ``roots_key`` is a hashable identity for the domain (defaults to
+    the domain size + first root) letting the stage tables cache."""
+    if not rows:
+        return []
+    n = len(rows[0])
+    assert all(len(r) == n for r in rows)
+    if roots_key is None:
+        roots_key = (n, int(roots[1]) if n > 1 else 1)
+    if inv:
+        domain = list(roots[0:1]) + list(roots[:0:-1])
+        key = (roots_key, "inv")
+    else:
+        domain = list(roots)
+        key = (roots_key, "fwd")
+    stages, brev = _stage_tables(n, key, domain)
+    vals = pack_ints_mont([[row[j] for j in brev] for row in rows])
+    for lo_idx, hi_idx, tw, order in stages:
+        lo, hi = _butterfly(vals[:, lo_idx], vals[:, hi_idx],
+                            jnp.broadcast_to(jnp.asarray(tw),
+                                             (len(rows),) + tw.shape))
+        # undo the gather layout: lo/hi back to natural positions
+        vals = jnp.concatenate([lo, hi], axis=1)[:, order]
+    out = unpack_mont(vals)
+    if inv:
+        invlen = pow(n, R_ORDER - 2, R_ORDER)
+        out = [[x * invlen % R_ORDER for x in row] for row in out]
+    return out

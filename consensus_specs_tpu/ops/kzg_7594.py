@@ -34,24 +34,36 @@ def cells_per_blob(setup) -> int:
 
 
 def bytes_to_cell(cell_bytes) -> list:
-    """md:92 — 64 x Bytes32 -> field elements (validated)."""
+    """md:92 — one cell's worth of Bytes32 -> field elements
+    (validated).  Accepts the flat-bytes spec encoding (the markdown
+    surface and the corpus format) or the library's legacy list of
+    32-byte chunks."""
+    if isinstance(cell_bytes, (bytes, bytearray)):
+        # exact-length gate, same as the spec body and the engine: a
+        # short flat cell would otherwise shrink the extended-domain
+        # slice assignment in recovery and fail far from the cause
+        assert len(cell_bytes) == 32 * FIELD_ELEMENTS_PER_CELL
+        return [K.bytes_to_bls_field(cell_bytes[32 * i:32 * (i + 1)])
+                for i in range(FIELD_ELEMENTS_PER_CELL)]
     return [K.bytes_to_bls_field(b) for b in cell_bytes]
 
 
 def g2_lincomb(points: Sequence[bytes], scalars: Sequence[int]) -> bytes:
     """md:104 — small G2 MSM (vanishing-polynomial commitment); native
-    C MSM when present, python oracle fallback."""
+    C MSM when present, group-generic Pippenger (``curve.msm``) on the
+    python oracle — the PR-6 bucket method replaces the old per-point
+    double-and-add loop (same results, fewer group additions)."""
     assert len(points) == len(scalars)
     from consensus_specs_tpu.ops import native_bls
     if native_bls.available() and len(points) <= 64:
         return native_bls.g2_msm_compressed(
             [bytes(p) for p in points],
             [int(a) % BLS_MODULUS for a in scalars])
-    result = G2Point.inf()
-    for x, a in zip(points, scalars):
-        result = result + g2_from_compressed(bytes(x)).mult(
-            int(a) % BLS_MODULUS)
-    return result.to_compressed()
+    from consensus_specs_tpu.ops.bls12_381.curve import msm
+    if not points:
+        return G2Point.inf().to_compressed()
+    return msm([g2_from_compressed(bytes(x)) for x in points],
+               [int(a) % BLS_MODULUS for a in scalars]).to_compressed()
 
 
 # ---------------------------------------------------------------------------

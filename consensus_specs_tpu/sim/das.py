@@ -1,0 +1,488 @@
+"""Availability-sampling scenarios: the DAS legs of the adversarial
+sweep.
+
+Scenario scripts are pure data built from a seed (all randomness drawn
+at build time, exactly like ``sim/scenarios.py``), replayed against the
+real eip7594 spec surface — so every leg (engine on, fault-injected,
+engine off, silently corrupted) runs the identical event stream and
+must produce the identical digest.
+
+Step vocabulary (one block's worth of DAS traffic per scenario):
+
+``publish``
+    Compute the extended cells of seeded random blobs (recovery
+    material) and register zero blobs (infinity commitment, all-zero
+    cells, infinity proofs — the one blob family whose multiproofs are
+    free to construct, so sampling verification exercises the real
+    engine/spec pairing paths at sim scale).
+``withhold``
+    Mark a column set unavailable (the adversary).
+``sample``
+    Verify the listed columns of every zero blob through
+    ``verify_cell_proof_batch`` (engine: ONE pairing; spec loop: one
+    per cell) — a sampled column that is withheld marks the block
+    unavailable, with the surviving columns still verified (the
+    engine/pairing census sees every sample step that has at least one
+    available column), and an optionally tampered cell must come back
+    False on every leg.
+``recover``
+    Erasure-recover every random blob from its available columns
+    through the engine's multi-blob path (``das.recover_many``:
+    shared vanishing-polynomial work) — or assert the LOUD refusal
+    when fewer than half the columns survive.
+``custody``
+    Deterministic custody assignment for a node set
+    (``get_custody_columns``), recording assignments + coverage.
+
+Scenario shapes: ``withheld_columns`` (adversarial withholding around
+the sampling detector), ``recovery_boundary`` (exactly 50% present
+succeeds, one fewer refuses loudly), ``custody_rotation`` (churning
+node set re-assigns custody; coverage tracked), ``nonfinality_sampling``
+(sampling retries across rounds while withheld data trickles in).
+
+Legs + contract: see :func:`run_scenario_legs` — the PR-8 counted-
+fallback contract and the PR-9 sentinel-audit quarantine applied to the
+``das.verify`` / ``das.recover`` sites, with artifacts replayable by
+``python -m consensus_specs_tpu.sim.repro``.
+"""
+import hashlib
+from random import Random
+
+from consensus_specs_tpu import faults, supervisor
+from consensus_specs_tpu.sim.scenarios import Scenario
+from consensus_specs_tpu.test_infra.metrics import counting
+
+DAS_PREFIX = "das/"
+DAS_SITES = ("das.verify", "das.recover")
+N_COLUMNS = 128         # minimal preset: 2 * 4096 / 64
+
+
+# ---------------------------------------------------------------------------
+# Scenario builders (all randomness spent HERE, baked into the script)
+# ---------------------------------------------------------------------------
+
+def withheld_columns(rng: Random):
+    """The adversary withholds a column set; sampling must flag the
+    block unavailable whenever a sampled column is missing, recovery
+    must succeed exactly when >= 50% of columns survive."""
+    n_withheld = rng.choice([rng.randint(8, N_COLUMNS // 2),
+                             rng.randint(N_COLUMNS // 2 + 1,
+                                         N_COLUMNS - 8)])
+    withheld = sorted(rng.sample(range(N_COLUMNS), n_withheld))
+    script = [
+        {"op": "publish", "blob_seeds": [rng.randrange(1 << 30)],
+         "zero_blobs": 1},
+        {"op": "withhold", "columns": withheld},
+    ]
+    for _ in range(rng.randint(2, 3)):
+        script.append({"op": "sample",
+                       "columns": sorted(rng.sample(range(N_COLUMNS),
+                                                    rng.randint(4, 8)))})
+    script.append({"op": "recover"})
+    return script
+
+
+def recovery_boundary(rng: Random):
+    """Exactly CELLS_PER_BLOB/2 available -> recovery succeeds; one
+    fewer -> the spec's loud refusal (never garbage)."""
+    present = sorted(rng.sample(range(N_COLUMNS), N_COLUMNS // 2))
+    withheld = sorted(set(range(N_COLUMNS)) - set(present))
+    script = [
+        {"op": "publish", "blob_seeds": [rng.randrange(1 << 30)],
+         "zero_blobs": 1},
+        {"op": "withhold", "columns": withheld},
+        {"op": "sample",
+         "columns": sorted(rng.sample(present, 4))},
+        {"op": "recover"},                      # boundary: succeeds
+        {"op": "withhold", "columns": [present[rng.randrange(
+            len(present))]]},
+        {"op": "recover"},                      # one short: loud refusal
+    ]
+    return script
+
+
+def custody_rotation(rng: Random):
+    """Exit churn over the custody table: nodes leave and join each
+    epoch, assignments must stay deterministic, disjoint-per-node and
+    fully covering in aggregate."""
+    nodes = [rng.randrange(1 << 62) for _ in range(rng.randint(24, 40))]
+    script = [{"op": "publish", "blob_seeds": [], "zero_blobs": 1}]
+    for _ in range(rng.randint(3, 5)):
+        exits = sorted(rng.sample(range(len(nodes)),
+                                  rng.randint(1, max(1, len(nodes) // 6))),
+                       reverse=True)
+        for i in exits:
+            nodes.pop(i)
+        joins = [rng.randrange(1 << 62)
+                 for _ in range(rng.randint(1, 6))]
+        nodes.extend(joins)
+        script.append({"op": "custody", "nodes": list(nodes),
+                       "count": rng.choice([1, 2, 2, 4])})
+    script.append({"op": "sample",
+                   "columns": sorted(rng.sample(range(N_COLUMNS), 4))})
+    return script
+
+
+def nonfinality_sampling(rng: Random):
+    """Sampling under non-finality: the same block re-sampled across
+    rounds while the withheld set shrinks (late data trickles in) —
+    the availability verdict must flip exactly when the samples clear,
+    and recovery engages once >= 50% survive."""
+    withheld = sorted(rng.sample(range(N_COLUMNS),
+                                 rng.randint(N_COLUMNS // 2 + 8,
+                                             N_COLUMNS - 16)))
+    script = [
+        {"op": "publish", "blob_seeds": [rng.randrange(1 << 30)],
+         "zero_blobs": 1},
+        {"op": "withhold", "columns": withheld},
+    ]
+    remaining = list(withheld)
+    rounds = rng.randint(3, 4)
+    for r in range(rounds):
+        script.append({"op": "sample",
+                       "columns": sorted(rng.sample(range(N_COLUMNS),
+                                                    rng.randint(4, 6)))})
+        if remaining:
+            released = [remaining.pop(rng.randrange(len(remaining)))
+                        for _ in range(min(len(remaining),
+                                           rng.randint(20, 40)))]
+            script.append({"op": "release", "columns": sorted(released)})
+    script.append({"op": "recover"})
+    # one adversarial round: a tampered sampled cell must fail closed
+    script.append({"op": "sample", "columns": [0, 1], "tamper": True})
+    return script
+
+
+_CATALOG = (
+    ("withheld_columns", withheld_columns),
+    ("recovery_boundary", recovery_boundary),
+    ("custody_rotation", custody_rotation),
+    ("nonfinality_sampling", nonfinality_sampling),
+)
+NAMES = tuple(DAS_PREFIX + name for name, _ in _CATALOG)
+
+
+def build(seed: int, name: str = None) -> Scenario:
+    """Seed-indexed catalog entry (seed round-robins the shape unless
+    ``name`` — with or without the ``das/`` prefix — forces one)."""
+    rng = Random(seed ^ 0xDA5)
+    if name is None:
+        shape, builder = _CATALOG[seed % len(_CATALOG)]
+    else:
+        shape = name[len(DAS_PREFIX):] if name.startswith(DAS_PREFIX) \
+            else name
+        builder = dict(_CATALOG).get(shape)
+        if builder is None:
+            raise ValueError(f"unknown das scenario {name!r}")
+    return Scenario(DAS_PREFIX + shape, seed, builder(rng), 0, None)
+
+
+# ---------------------------------------------------------------------------
+# Execution (no RNG in here — the script is the whole event stream)
+# ---------------------------------------------------------------------------
+
+class DasResult:
+    """Event-sourced run record; the digest is the byte-identity
+    contract every leg is held to."""
+
+    def __init__(self):
+        self.events = []
+        self.rejected = 0       # loud refusals (expected adversarial)
+        self.organic = {}
+        self.finalized = (0, None)      # sweep-print compatibility
+
+    def log(self, *parts):
+        self.events.append("|".join(str(p) for p in parts))
+
+    def digest(self) -> dict:
+        h = hashlib.sha256()
+        for e in self.events:
+            h.update(e.encode())
+            h.update(b"\x00")
+        return {"events": h.hexdigest(), "count": len(self.events)}
+
+
+def _zero_blob_batch(spec, columns, tamper=False):
+    """A verify batch over the zero blob's columns: infinity commitment,
+    all-zero cells, infinity proofs — a VALID multiproof family that is
+    free to construct (p = 0), so the engine fold and the spec pairing
+    loop both run for real."""
+    cell = bytes(spec.BYTES_PER_CELL)
+    cells = [cell] * len(columns)
+    if tamper and cells:
+        cells = list(cells)
+        cells[0] = (1).to_bytes(32, "big") + cell[32:]
+    inf = bytes(spec.G1_POINT_AT_INFINITY)
+    return ([inf], [0] * len(columns), list(columns), cells,
+            [inf] * len(columns))
+
+
+def execute(spec, script, n_validators=0) -> DasResult:
+    """Replay a das script against the spec surface.  ``n_validators``
+    is accepted (and ignored) for harness-signature compatibility."""
+    result = DasResult()
+    blobs = []          # (seed, cells) random blobs (recovery material)
+    zero_blobs = 0
+    withheld = set()
+    for step in script:
+        op = step["op"]
+        if op == "publish":
+            for bseed in step["blob_seeds"]:
+                rng = Random(bseed)
+                width = int(spec.FIELD_ELEMENTS_PER_BLOB)
+                blob = b"".join(
+                    rng.randrange(int(spec.BLS_MODULUS)).to_bytes(32, "big")
+                    for _ in range(width))
+                cells = spec.compute_cells(blob)
+                blobs.append((bseed, cells))
+            zero_blobs += step.get("zero_blobs", 0)
+            result.log("publish", len(blobs), zero_blobs)
+        elif op == "withhold":
+            withheld |= set(step["columns"])
+            result.log("withhold", sorted(withheld))
+        elif op == "release":
+            withheld -= set(step["columns"])
+            result.log("release", sorted(withheld))
+        elif op == "sample":
+            cols = [c for c in step["columns"] if c not in withheld]
+            short = len(cols) < len(step["columns"])
+            verdict = not short
+            if cols and zero_blobs:
+                ok = spec.verify_cell_proof_batch(
+                    *_zero_blob_batch(spec, cols,
+                                      tamper=step.get("tamper", False)))
+                verdict = verdict and bool(ok)
+            result.log("sample", step["columns"], "available" if verdict
+                       else "unavailable")
+        elif op == "recover":
+            available = [c for c in range(int(spec.NUMBER_OF_COLUMNS))
+                         if c not in withheld]
+            if not blobs:
+                result.log("recover", "no-blobs")
+                continue
+            requests = [
+                (list(available),
+                 [spec.cell_to_bytes(cells[c]) for c in available])
+                for _, cells in blobs]
+            try:
+                from consensus_specs_tpu.das import recover_many
+                outs = recover_many(spec, requests)
+            except AssertionError:
+                # the spec's loud refusal (insufficient columns) — an
+                # expected adversarial outcome, recorded as data
+                result.rejected += 1
+                result.log("recover", "refused", len(available))
+            else:
+                h = hashlib.sha256()
+                for out in outs:
+                    for x in out:
+                        h.update(int(x).to_bytes(32, "big"))
+                result.log("recover", len(available), h.hexdigest())
+        elif op == "custody":
+            union = set()
+            parts = []
+            for node in step["nodes"]:
+                cols = spec.get_custody_columns(node, step["count"])
+                union |= set(map(int, cols))
+                parts.append(f"{node}:{','.join(str(int(c)) for c in cols)}")
+            result.log("custody", step["count"], len(union),
+                       ";".join(parts))
+        else:
+            raise ValueError(f"unknown das op {op!r}")
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Legs (the PR-8/PR-9 contract at the das sites)
+# ---------------------------------------------------------------------------
+
+def run_leg(spec, scenario, schedule=None, env=None,
+            reset_supervisor=True) -> DasResult:
+    """One replay of the scenario: arm ``schedule`` (if any), apply
+    ``env`` overrides for the duration, reset the supervisor cold."""
+    import os
+    saved = {}
+    for k, v in (env or {}).items():
+        saved[k] = os.environ.get(k)
+        os.environ[k] = v
+    try:
+        if reset_supervisor:
+            supervisor.reset()
+        if schedule is not None:
+            with faults.injected(schedule):
+                return execute(spec, scenario.script)
+        return execute(spec, scenario.script)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def run_baseline(spec, scenario):
+    """Engines-on reference leg under an observing schedule; returns
+    (result, das-site census).  Organic fallback counts are recorded
+    baseline-relative like the chain harness does."""
+    from consensus_specs_tpu.sim import harness
+    observer = faults.observing()
+    with counting() as delta:
+        result = run_leg(spec, scenario, schedule=observer,
+                         env=harness.NEUTRAL_SUPERVISOR_ENV)
+    result.organic = {
+        "das.fallbacks{reason=guard}": delta["das.fallbacks{reason=guard}"]}
+    return result, {site: n for site, n in observer.calls.items()
+                    if site in DAS_SITES}
+
+
+def run_injected(spec, scenario, baseline, site, ordinal):
+    """Single-trigger injected leg at a das site: the schedule must
+    discharge, the fallback must be counted (reason=injected, organic
+    twin untouched), and the digest must match the baseline."""
+    from consensus_specs_tpu.sim import harness
+    schedule = faults.FaultSchedule({site: [ordinal]})
+    kind = f"inject[{site}@{ordinal}]"
+    with counting() as delta:
+        result = run_leg(spec, scenario, schedule=schedule,
+                         env=harness.NEUTRAL_SUPERVISOR_ENV)
+    if not schedule.fully_fired():
+        raise harness.LegFailure(
+            kind, scenario, f"schedule did not discharge (site called "
+            f"{schedule.calls.get(site, 0)}x)", schedule,
+            category="no-discharge")
+    counted = delta["das.fallbacks{reason=injected}"]
+    if counted != len(schedule.fired):
+        raise harness.LegFailure(
+            kind, scenario, f"SILENT FALLBACK: {len(schedule.fired)} "
+            f"fired but das.fallbacks{{reason=injected}} moved by "
+            f"{counted}", schedule, category="silent-fallback")
+    organic_base = baseline.organic.get("das.fallbacks{reason=guard}", 0)
+    if delta["das.fallbacks{reason=guard}"] != organic_base:
+        raise harness.LegFailure(
+            kind, scenario, "injected fault leaked into the organic "
+            "guard series", schedule, category="organic-leak")
+    if result.digest() != baseline.digest():
+        raise harness.LegFailure(
+            kind, scenario, "fallback diverged from the uninjected "
+            "replay", schedule, category="diverged")
+    return result
+
+
+def run_engine_off(spec, scenario, baseline):
+    """CS_TPU_DAS=0 replay: the markdown spec loop must match the
+    engine digest byte-for-byte."""
+    from consensus_specs_tpu.sim import harness
+    result = run_leg(spec, scenario,
+                     env={"CS_TPU_DAS": "0",
+                          **harness.NEUTRAL_SUPERVISOR_ENV})
+    if result.digest() != baseline.digest():
+        raise harness.LegFailure(
+            "das-engine-off", scenario,
+            "spec-loop replay diverged from engines-on", None)
+    return result
+
+
+def run_corrupt(spec, scenario, baseline, site, out_dir=None):
+    """Persistent silent corruption at a das site under rate-1 audits:
+    the sentinel must catch the first wrong answer, quarantine the
+    site, dump a replayable artifact, and the digest must stay
+    byte-identical (the spec answer is authoritative on every audited
+    call).  Returns (result, artifact_path)."""
+    from consensus_specs_tpu.sim import harness, repro
+    schedule = faults.FaultSchedule(corrupt={site: [1]})
+    kind = f"audit[{site}]"
+    dumped = []
+
+    def _dump(q_site, detail):
+        path = repro.dump_artifact(
+            scenario, kind,
+            f"sentinel audit quarantined {q_site}: {detail}",
+            schedule=schedule, out_dir=out_dir, fork="eip7594",
+            preset="minimal")
+        dumped.append(path)
+        return path
+
+    with supervisor.quarantine_hook(_dump):
+        with counting() as delta:
+            result = run_leg(spec, scenario, schedule=schedule,
+                             env=harness.AUDIT_ENV)
+    if not schedule.corrupted:
+        raise harness.LegFailure(
+            kind, scenario, "corruption never armed (site called "
+            f"{schedule.calls.get(site, 0)}x)", schedule,
+            category="no-discharge")
+    if delta[f"supervisor.audits{{result=fail,site={site}}}"] < 1:
+        raise harness.LegFailure(
+            kind, scenario, "SILENT CORRUPTION: corrupted result(s) "
+            "but no sentinel audit failed", schedule,
+            category="silent-fallback")
+    if delta[f"supervisor.quarantines{{site={site}}}"] != 1:
+        raise harness.LegFailure(
+            kind, scenario, "expected exactly one quarantine", schedule,
+            category="silent-fallback")
+    if not dumped:
+        raise harness.LegFailure(
+            kind, scenario, "quarantine fired but dumped no artifact",
+            schedule, category="silent-fallback")
+    if result.digest() != baseline.digest():
+        raise harness.LegFailure(
+            kind, scenario, "corrupted engine result reached the digest "
+            "despite rate-1 audits", schedule, category="diverged")
+    return result, dumped[0]
+
+
+def replay_artifact(payload, out_dir=None) -> int:
+    """Replay a das repro artifact (``sim/repro.py`` dispatches here on
+    the ``das/`` scenario-name prefix).  Returns a process exit code:
+    1 = the recorded failure reproduces (for a quarantine artifact:
+    the sentinel audit catches and quarantines again, re-dumping its
+    evidence into ``out_dir``), 0 = clean, 2 = a quarantine replay
+    violated the leg contract itself (e.g. the corruption now slips
+    past the audit — strictly worse than reproducing; the sweep's
+    re-proof requires exactly 1)."""
+    from consensus_specs_tpu.forks import build_spec
+    from consensus_specs_tpu.sim import harness
+    scenario = Scenario(payload["scenario"], payload["seed"],
+                        payload["script"], 0, None)
+    # defense in depth: das scenarios only ever run on a sampling-
+    # capable fork — a stray chain fork in the payload (an artifact
+    # dumped before the sweep recorded das forks correctly) must not
+    # crash the replay with an AttributeError miles from the cause
+    fork = payload.get("fork") or "eip7594"
+    preset = payload.get("preset") or "minimal"
+    if fork not in ("eip7594",):
+        fork, preset = "eip7594", "minimal"
+    spec = build_spec(fork, preset)
+    baseline, census = run_baseline(spec, scenario)
+    print(f"das baseline: {baseline.digest()['events'][:16]}... "
+          f"({baseline.digest()['count']} events)")
+    sched = payload.get("schedule") or {}
+    corrupt = sched.get("corrupt") or None
+    triggers = sched.get("triggers") or None
+    try:
+        if corrupt:
+            # run_corrupt SUCCEEDING is the reproduction; a LegFailure
+            # means the quarantine pipeline itself regressed (silent
+            # corruption, missing artifact, digest divergence) — report
+            # it as a distinct verdict instead of a hollow "reproduced"
+            try:
+                for site in corrupt:
+                    _, path = run_corrupt(spec, scenario, baseline,
+                                          site, out_dir=out_dir)
+                    print(f"REPRODUCED: sentinel audit quarantined "
+                          f"{site} again -> {path}")
+            except harness.LegFailure as fail:
+                print(f"QUARANTINE REPLAY VIOLATED ITS CONTRACT: {fail}")
+                return 2
+            return 1
+        if triggers:
+            for site, ns in triggers.items():
+                for n in ns:
+                    run_injected(spec, scenario, baseline, site, n)
+        else:
+            run_engine_off(spec, scenario, baseline)
+    except harness.LegFailure as fail:
+        print(f"REPRODUCED: {fail}")
+        return 1
+    print("das leg clean — failure did not reproduce")
+    return 0

@@ -62,6 +62,8 @@ SITE_COUNTER = {
     "merkle.dispatch": "merkle.fallbacks{reason=injected}",
     "state_arrays.commit": "state_arrays.fallbacks{reason=injected}",
     "bls.flush": "bls.flush{path=fallback,reason=injected}",
+    "das.verify": "das.fallbacks{reason=injected}",
+    "das.recover": "das.fallbacks{reason=injected}",
 }
 assert set(SITE_COUNTER) == set(faults.SITES)
 
@@ -85,6 +87,7 @@ ORGANIC_TWIN = {
         "forkchoice.fallbacks{reason=guard}",
     "bls.flush{path=fallback,reason=injected}":
         "bls.flush{path=fallback,reason=bisect}",
+    "das.fallbacks{reason=injected}": "das.fallbacks{reason=guard}",
 }
 
 

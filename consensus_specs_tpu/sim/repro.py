@@ -115,8 +115,9 @@ def dump_artifact(scenario, kind, message, schedule=None, script=None,
     # in one sweep round (injected sites, storm, spec-diff) and each
     # failure must keep its own artifact
     slug = re.sub(r"[^A-Za-z0-9.@-]+", "-", kind).strip("-")
+    name = re.sub(r"[^A-Za-z0-9._-]+", "-", scenario.name).strip("-")
     path = os.path.join(
-        out_dir, f"repro_{scenario.name}_seed{scenario.seed}_{slug}.json")
+        out_dir, f"repro_{name}_seed{scenario.seed}_{slug}.json")
     with open(path, "w") as f:
         json.dump(payload, f, indent=2)
     return path
@@ -176,6 +177,14 @@ def replay(path: str, fork: str = None, preset: str = None) -> int:
     from consensus_specs_tpu.sim import harness
 
     scenario, triggers, payload = load_artifact(path)
+    if payload["scenario"].startswith("das/"):
+        # availability-sampling artifact: its own leg machinery (the
+        # chain driver has no das vocabulary).  Re-dumped quarantine
+        # evidence lands next to the artifact being replayed, not in
+        # whatever the default artifact dir happens to be
+        from consensus_specs_tpu.sim import das as _das
+        return _das.replay_artifact(payload,
+                                    out_dir=os.path.dirname(path) or None)
     fork = fork or payload.get("fork") or "phase0"
     preset = preset or payload.get("preset") or "minimal"
     kind = (payload.get("failure") or {}).get("kind", "")

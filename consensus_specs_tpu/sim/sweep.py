@@ -72,6 +72,14 @@ def _parse_args(argv):
                              "digest byte-identical")
     parser.add_argument("--bls-seeds", type=int, default=2,
                         help="first K seeds run with real signatures")
+    parser.add_argument("--das-seeds", type=int, default=8,
+                        help="availability-sampling scenario seeds "
+                             "(sim/das.py; 0 disables): per seed the "
+                             "engines-on baseline, injected legs at the "
+                             "das sites, the CS_TPU_DAS=0 spec leg, and "
+                             "one silent-corruption sentinel-audit leg "
+                             "whose quarantine artifact is re-proven "
+                             "through sim.repro")
     parser.add_argument("--min-scenarios", type=int, default=None,
                         help="fail if fewer baselines complete "
                              "(default: --seeds)")
@@ -100,6 +108,84 @@ def _crashed_leg(kind, scenario, exc, schedule=None):
         schedule=schedule, category="crashed")
 
 
+def run_das_phase(args, stats, failures) -> None:
+    """The DAS legs: per seed a baseline, injected legs at every
+    exercised das site, the CS_TPU_DAS=0 spec leg, and (first seed
+    only) the silent-corruption leg with an end-to-end repro proof of
+    its quarantine artifact.  Failures are recorded (dumped un-shrunk —
+    das scripts are already near-minimal) and the sweep continues."""
+    from consensus_specs_tpu.forks import build_spec
+    from consensus_specs_tpu.sim import das, harness, repro
+
+    spec = build_spec("eip7594", "minimal")
+    proven = False
+    for seed in range(args.das_seeds):
+        scenario = das.build(seed)
+        tag = f"das  {seed:4d} {scenario.name[4:]:<21s}"
+        try:
+            baseline, census = das.run_baseline(spec, scenario)
+        except Exception as exc:
+            fail = _crashed_leg("das-baseline", scenario, exc)
+            failures.append((fail, None, False))
+            print(f"{tag} BASELINE FAILED: {fail}")
+            continue
+        stats["das_scenarios"] += 1
+        stats["das_rejected_steps"] += baseline.rejected
+        legs = []
+        for site, calls in sorted(census.items()):
+            ordinal = 1 + (seed % calls)
+            try:
+                das.run_injected(spec, scenario, baseline, site, ordinal)
+                stats["das_injected_legs"] += 1
+                stats["das_faults_fired"] += 1
+            except harness.LegFailure as fail:
+                failures.append((fail, None, False))
+            except Exception as exc:
+                failures.append((_crashed_leg(
+                    f"inject[{site}@{ordinal}]", scenario, exc,
+                    faults.FaultSchedule({site: [ordinal]})), None, False))
+            legs.append(f"inject[{site.split('.')[1]}]")
+        try:
+            das.run_engine_off(spec, scenario, baseline)
+            stats["das_off_legs"] += 1
+            legs.append("off")
+        except harness.LegFailure as fail:
+            failures.append((fail, None, False))
+        except Exception as exc:
+            failures.append((_crashed_leg("das-engine-off", scenario,
+                                          exc), None, False))
+        recovered = any(
+            e.startswith("recover|") and "refused" not in e
+            and "no-blobs" not in e for e in baseline.events)
+        if not proven and recovered:
+            # a refused-only scenario never reaches the corrupt hook
+            # (the loud refusal fires before the result exists)
+            # one corrupt leg per sweep, its artifact re-proven
+            try:
+                _, artifact = das.run_corrupt(
+                    spec, scenario, baseline, "das.recover",
+                    out_dir=args.artifact_dir)
+                stats["das_corrupt_legs"] += 1
+                legs.append("corrupt+repro")
+                if repro.replay(artifact) != 1:
+                    raise harness.LegFailure(
+                        "das-repro", scenario,
+                        "quarantine artifact did not reproduce through "
+                        "sim.repro", category="no-discharge")
+                stats["das_repro_proofs"] += 1
+                proven = True
+            except harness.LegFailure as fail:
+                failures.append((fail, None, False))
+            except Exception as exc:
+                failures.append((_crashed_leg(
+                    "audit[das.recover]", scenario, exc,
+                    faults.FaultSchedule(corrupt={"das.recover": [1]})),
+                    None, False))
+        print(f"{tag} ok: {len(scenario.script)} steps, "
+              f"{baseline.digest()['count']} events"
+              + (f" ({', '.join(legs)})" if legs else ""))
+
+
 def run_sweep(args) -> int:
     from consensus_specs_tpu.forks import build_spec
     from consensus_specs_tpu.utils import bls
@@ -109,7 +195,11 @@ def run_sweep(args) -> int:
         min_scenarios = args.seeds
     stats = {"scenarios": 0, "injected_legs": 0, "storm_legs": 0,
              "diff_legs": 0, "breaker_legs": 0, "corrupt_legs": 0,
-             "quarantines": 0, "faults_fired": 0, "rejected_steps": 0}
+             "quarantines": 0, "faults_fired": 0, "rejected_steps": 0,
+             "das_scenarios": 0, "das_injected_legs": 0,
+             "das_off_legs": 0, "das_corrupt_legs": 0,
+             "das_repro_proofs": 0, "das_faults_fired": 0,
+             "das_rejected_steps": 0}
     per_shape = {}
     failures = []       # (LegFailure, spec-or-None, with_bls)
     artifacts = []
@@ -239,6 +329,17 @@ def run_sweep(args) -> int:
             print(f"{tag} ok: {len(scenario.script)} steps, "
                   f"finalized@{baseline.finalized[0]}"
                   + (f" ({', '.join(legs)})" if legs else ""))
+        # availability-sampling phase (sim/das.py): seeded das
+        # scenarios replay the counted-fallback + sentinel-audit
+        # contract at the das.verify/das.recover sites; the first
+        # corrupt leg's quarantine artifact is additionally re-proven
+        # through sim.repro (exit 1 = the quarantine reproduces)
+        if getattr(args, "das_seeds", 0):
+            # getattr: harness tests drive run_sweep with hand-built
+            # Namespaces that predate the das phase
+            bls.bls_active = False
+            run_das_phase(args, stats, failures)
+
         # minimize INSIDE the mode scope: each failure's shrink
         # replays must run under the BLS mode its leg failed in, or a
         # mode-sensitive failure stops reproducing (and a stub-seed
@@ -256,11 +357,16 @@ def run_sweep(args) -> int:
                         preset=args.preset)
                 else:
                     from consensus_specs_tpu.sim import repro
+                    # das legs always run eip7594/minimal regardless of
+                    # the sweep's --fork; recording the sweep fork would
+                    # make the artifact rebuild the wrong spec on replay
+                    is_das = fail.scenario.name.startswith("das/")
                     path = repro.dump_artifact(
                         fail.scenario, fail.kind, str(fail),
                         schedule=fail.schedule,
-                        out_dir=args.artifact_dir, fork=args.fork,
-                        preset=args.preset)
+                        out_dir=args.artifact_dir,
+                        fork="eip7594" if is_das else args.fork,
+                        preset="minimal" if is_das else args.preset)
                 artifacts.append((fail, path))
     finally:
         bls.bls_active = old_active
@@ -276,6 +382,18 @@ def run_sweep(args) -> int:
           f"{stats['corrupt_legs']} sentinel-audit "
           f"({stats['quarantines']} corruptions caught + quarantined); "
           f"{stats['rejected_steps']} adversarial steps rejected")
+    if stats["das_scenarios"]:
+        # das legs keep their own counters — folding them into the
+        # chain-phase summary above would double-report quarantines and
+        # make that line internally inconsistent
+        print(f"das:  {stats['das_scenarios']} availability scenarios: "
+              f"{stats['das_injected_legs']} injected "
+              f"({stats['das_faults_fired']} faults fired, all counted) "
+              f"+ {stats['das_off_legs']} engine-off + "
+              f"{stats['das_corrupt_legs']} sentinel-audit legs, "
+              f"{stats['das_repro_proofs']} quarantine artifact(s) "
+              f"re-proven through sim.repro; "
+              f"{stats['das_rejected_steps']} loud refusals recorded")
 
     code = 0
     if artifacts:

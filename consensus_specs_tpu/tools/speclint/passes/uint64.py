@@ -41,14 +41,20 @@ SCOPED_PREFIXES = (
     "consensus_specs_tpu/ops/epoch_kernels.py",
     "consensus_specs_tpu/parallel/",
     "consensus_specs_tpu/ops/jax_bls/",
+    # the DAS engine: column-index/custody tables are uint64-typed like
+    # the state-store accessors, and the fr limb kernels live under
+    # ops/jax_bls/ (already scoped above)
+    "consensus_specs_tpu/das/",
 )
 
-# seeds include the StateArrays accessors (state/arrays.py): columns
-# handed out by the store are uint64 lanes like the old direct
+# seeds include the StateArrays accessors (state/arrays.py) and the DAS
+# engine's custody/column accessors: columns handed out by the store
+# (and custody column ids) are uint64 lanes like the old direct
 # extraction helpers were
 _SEED_CALLS = {"uint64", "u64_column",
                "registry", "registry_of", "registry_writable",
-               "balances", "inactivity_scores", "participation"}
+               "balances", "inactivity_scores", "participation",
+               "get_custody_columns", "custody_columns"}
 _ARRAY_CTORS = {"fromiter", "zeros", "ones", "full", "empty", "arange",
                 "asarray", "array"}
 _PROPAGATING_METHODS = {"copy", "reshape", "max", "min", "clip", "cumsum",

@@ -34,6 +34,7 @@ ENGINE_SWITCHES = (
     "CS_TPU_BLS_RLC",
     "CS_TPU_HASH_FOREST",
     "CS_TPU_SUPERVISOR",
+    "CS_TPU_DAS",
 )
 
 _SWITCH_DEFAULTS = {}
@@ -127,6 +128,18 @@ STATE_ARRAYS = os.environ.get("CS_TPU_STATE_ARRAYS") != "0"
 # variable after import also works (like ``CS_TPU_VECTORIZED_EPOCH``,
 # the switch re-reads the environment at call time when it is present).
 PROTO_ARRAY = os.environ.get("CS_TPU_PROTO_ARRAY") != "0"
+
+# Data-availability-sampling engine kill switch: ``CS_TPU_DAS=0`` runs
+# the spec-loop eip7594 sampling bodies (one pairing per cell,
+# per-blob erasure recovery — the markdown algorithms) instead of the
+# batched DAS engine (``consensus_specs_tpu/das``: whole-batch
+# cell-proof folding into one pairing, columnar multi-blob recovery).
+# Live via :func:`switch` like the other engine flags.
+# ``CS_TPU_DAS_FFT=limb`` additionally routes the engine's scalar-field
+# FFTs through the limb kernels (``ops/jax_bls/fr_fft.py``: JAX device
+# kernel, numpy mirror under CS_TPU_NUMPY_KERNELS=1); unset = host
+# python-int FFT.
+DAS = os.environ.get("CS_TPU_DAS") != "0"
 
 # Engine supervisor kill switch: ``CS_TPU_SUPERVISOR=0`` turns the
 # health-tracking supervision layer (``consensus_specs_tpu/supervisor``)

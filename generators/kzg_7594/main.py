@@ -1,0 +1,176 @@
+"""KZG-7594 (PeerDAS) vector generator.
+
+Emits compute_cells / verify_cell_proof_batch / recover cases against
+the minimal trusted setup in the reference corpus format (the
+``("data", {"input": ..., "output": ...})`` shape the kzg_4844
+generator established).  The roundtrip smoke
+(``tests/eip7594/test_kzg_7594_gen.py``) re-runs emitted cases through
+the verifier/recovery on both the ops library and the spec surface.
+"""
+import os
+import sys
+from functools import lru_cache
+from random import Random
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from consensus_specs_tpu.ops import kzg as K
+from consensus_specs_tpu.ops import kzg_7594 as K7
+from consensus_specs_tpu.gen import TestCase, TestProvider, run_generator
+
+SETUP = K.trusted_setup("minimal")
+WIDTH = SETUP.FIELD_ELEMENTS_PER_BLOB
+N_CELLS = K7.cells_per_blob(SETUP)
+
+
+def _blob(seed):
+    rng = Random(seed)
+    return b"".join(
+        rng.randrange(K.BLS_MODULUS).to_bytes(32, "big")
+        for _ in range(WIDTH))
+
+
+def _cell_hex(cell):
+    return "0x" + b"".join(int(x).to_bytes(32, "big") for x in cell).hex()
+
+
+@lru_cache(maxsize=4)
+def _cells(seed):
+    return K7.compute_cells(_blob(seed), SETUP)
+
+
+@lru_cache(maxsize=4)
+def _proofs(seed, cell_ids):
+    """Multiproofs for a few cells only (one MSM per proof)."""
+    polynomial = K.blob_to_polynomial(_blob(seed), WIDTH)
+    coeff = K7.polynomial_eval_to_coeff(polynomial, SETUP)
+    out = {}
+    for cid in cell_ids:
+        proof, ys = K7.compute_kzg_proof_multi_impl(
+            coeff, K7.coset_for_cell(cid, SETUP), SETUP)
+        assert ys == _cells(seed)[cid]
+        out[cid] = proof
+    return out
+
+
+def _case(handler, name, fn):
+    def case_fn():
+        from consensus_specs_tpu.test_infra import context as ctx
+        parts = fn()
+        if ctx.VECTOR_COLLECTOR is not None:
+            for part in parts:
+                ctx.VECTOR_COLLECTOR(part)
+        return parts
+    return TestCase(fork_name="eip7594", preset_name="general",
+                    runner_name="kzg_7594", handler_name=handler,
+                    suite_name="kzg_7594-minimal", case_name=name,
+                    case_fn=case_fn)
+
+
+def make_cases():
+    def compute_cells_case(seed):
+        def fn():
+            blob = _blob(seed)
+            cells = _cells(seed)
+            return [("data", {
+                "input": {"blob": "0x" + blob.hex()},
+                "output": [_cell_hex(c) for c in cells]})]
+        return fn
+    yield _case("compute_cells", "compute_cells_random_0",
+                compute_cells_case(0))
+    yield _case("compute_cells", "compute_cells_random_1",
+                compute_cells_case(1))
+
+    def invalid_blob_case():
+        def fn():
+            bad = (K.BLS_MODULUS).to_bytes(32, "big") * WIDTH
+            try:
+                K7.compute_cells(bad, SETUP)
+                raise SystemExit("non-canonical blob must be rejected")
+            except AssertionError:
+                pass
+            return [("data", {
+                "input": {"blob": "0x" + bad[:64].hex() + "..."},
+                "output": None})]
+        return fn
+    yield _case("compute_cells", "compute_cells_invalid_field_element",
+                invalid_blob_case())
+
+    def verify_batch_case(seed, cell_ids, tamper, name_output):
+        def fn():
+            commitment = K.blob_to_kzg_commitment(_blob(seed), SETUP)
+            cells = _cells(seed)
+            proofs = _proofs(seed, tuple(cell_ids))
+            cells_bytes = [
+                b"".join(int(x).to_bytes(32, "big") for x in cells[c])
+                for c in cell_ids]
+            if tamper:
+                flip = (int.from_bytes(cells_bytes[0][:32], "big") + 1) \
+                    % K.BLS_MODULUS
+                cells_bytes[0] = flip.to_bytes(32, "big") \
+                    + cells_bytes[0][32:]
+            ok = K7.verify_cell_proof_batch(
+                [commitment], [0] * len(cell_ids), list(cell_ids),
+                cells_bytes, [proofs[c] for c in cell_ids], SETUP)
+            assert ok is name_output
+            return [("data", {
+                "input": {
+                    "row_commitments": ["0x" + commitment.hex()],
+                    "row_indices": [0] * len(cell_ids),
+                    "column_indices": list(cell_ids),
+                    "cells": ["0x" + cb.hex() for cb in cells_bytes],
+                    "proofs": ["0x" + proofs[c].hex()
+                               for c in cell_ids],
+                },
+                "output": name_output})]
+        return fn
+    yield _case("verify_cell_proof_batch", "verify_batch_valid",
+                verify_batch_case(0, [0, 77], False, True))
+    yield _case("verify_cell_proof_batch", "verify_batch_tampered_cell",
+                verify_batch_case(0, [0, 77], True, False))
+
+    def recover_case(seed, drop_seed, name):
+        def fn():
+            cells = _cells(seed)
+            rng = Random(drop_seed)
+            keep = sorted(rng.sample(range(N_CELLS), N_CELLS // 2))
+            cells_bytes = [
+                b"".join(int(x).to_bytes(32, "big") for x in cells[i])
+                for i in keep]
+            recovered = K7.recover_polynomial(keep, cells_bytes, SETUP)
+            assert recovered == [x for c in cells for x in c]
+            return [("data", {
+                "input": {
+                    "cell_ids": keep,
+                    "cells": ["0x" + cb.hex() for cb in cells_bytes],
+                },
+                "output": [_cell_hex(recovered[i * 64:(i + 1) * 64])
+                           for i in range(N_CELLS)]})]
+        return fn
+    yield _case("recover", "recover_half_missing_0", recover_case(0, 5, 0))
+    yield _case("recover", "recover_half_missing_1", recover_case(1, 6, 1))
+
+    def recover_insufficient_case():
+        def fn():
+            cells = _cells(0)
+            keep = list(range(N_CELLS // 2 - 1))
+            cells_bytes = [
+                b"".join(int(x).to_bytes(32, "big") for x in cells[i])
+                for i in keep]
+            try:
+                K7.recover_polynomial(keep, cells_bytes, SETUP)
+                raise SystemExit("insufficient cells must be rejected")
+            except AssertionError:
+                pass
+            return [("data", {
+                "input": {"cell_ids": keep, "cells": "..."},
+                "output": None})]
+        return fn
+    yield _case("recover", "recover_insufficient_cells_rejected",
+                recover_insufficient_case())
+
+
+if __name__ == "__main__":
+    run_generator("kzg_7594", [
+        TestProvider(prepare=lambda: None, make_cases=make_cases)])

@@ -132,3 +132,59 @@ def test_recover_rejects_insufficient_cells():
     with pytest.raises(AssertionError):
         S.recover_polynomial(
             kept, [_cells_to_bytes(cells[i]) for i in kept], SETUP)
+
+
+def test_recover_rejects_duplicate_cell_ids():
+    blob = _random_blob(25)
+    cells = S.compute_cells(blob, SETUP)
+    n_cells = S.cells_per_blob(SETUP)
+    kept = list(range(n_cells // 2))
+    kept[-1] = kept[0]      # duplicate id keeps the count at n/2
+    with pytest.raises(AssertionError):
+        S.recover_polynomial(
+            kept, [_cells_to_bytes(cells[i]) for i in kept], SETUP)
+
+
+def test_bytes_to_cell_flat_length_gate():
+    """The flat-bytes cell encoding is exact-length (one cell), like
+    the spec body and the engine — a short flat cell must be rejected
+    at parse time, not corrupt a recovery slice downstream."""
+    with pytest.raises(AssertionError):
+        S.bytes_to_cell(b"\x00" * 32)
+    full = b"\x00" * (32 * S.FIELD_ELEMENTS_PER_CELL)
+    assert S.bytes_to_cell(full) == [0] * S.FIELD_ELEMENTS_PER_CELL
+    # the legacy chunk-list form is unaffected
+    assert S.bytes_to_cell([b"\x00" * 32]) == [0]
+
+
+def _g2_lincomb_naive(points, scalars):
+    """The pre-PR-11 double-and-add loop, kept as the differential
+    oracle for the group-generic Pippenger swap."""
+    from consensus_specs_tpu.ops.bls12_381.curve import (
+        G2Point, g2_from_compressed)
+    result = G2Point.inf()
+    for x, a in zip(points, scalars):
+        result = result + g2_from_compressed(bytes(x)).mult(
+            int(a) % BLS_MODULUS)
+    return result.to_compressed()
+
+
+def test_g2_lincomb_pippenger_matches_naive_loop():
+    """curve.msm bucket method vs the old per-point double-and-add —
+    byte-identical compressed output, including the edge shapes (empty,
+    zero scalars, repeated points).  Forces the python path: the native
+    backend serves <= 64 points before Pippenger is reached."""
+    import random as _random
+    from unittest import mock
+    from consensus_specs_tpu.ops import native_bls
+    rng = _random.Random(99)
+    pts = SETUP.KZG_SETUP_G2_MONOMIAL[:6] + [SETUP.KZG_SETUP_G2_MONOMIAL[2]]
+    scalars = [rng.randrange(BLS_MODULUS) for _ in range(5)] + [0, 1]
+    with mock.patch.object(native_bls, "available", return_value=False):
+        assert S.g2_lincomb(pts, scalars) == \
+            _g2_lincomb_naive(pts, scalars)
+        assert S.g2_lincomb([], []) == _g2_lincomb_naive(
+            [SETUP.KZG_SETUP_G2_MONOMIAL[0]], [0])
+    if native_bls.available():
+        # and the native path agrees with both
+        assert S.g2_lincomb(pts, scalars) == _g2_lincomb_naive(pts, scalars)

@@ -1,0 +1,159 @@
+"""das-core.md surface: custody columns, DataColumnSidecar
+construction/verification, sampling-driven availability.
+
+Runs against the hand-written ladder and (under ``--compiled``) the
+markdown-compiled one.
+"""
+import random
+
+import pytest
+
+from consensus_specs_tpu.forks import build_spec
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return build_spec("eip7594", "minimal")
+
+
+def test_custody_columns_deterministic_sorted_in_range(spec):
+    cols_a = spec.get_custody_columns(2**200 + 17, 3)
+    cols_b = spec.get_custody_columns(2**200 + 17, 3)
+    assert cols_a == cols_b
+    assert cols_a == sorted(cols_a)
+    assert len(cols_a) == len(set(cols_a))
+    assert all(0 <= int(c) < int(spec.NUMBER_OF_COLUMNS) for c in cols_a)
+    # 3 subnets x columns-per-subnet
+    per_subnet = int(spec.NUMBER_OF_COLUMNS) \
+        // int(spec.DATA_COLUMN_SIDECAR_SUBNET_COUNT)
+    assert len(cols_a) == 3 * per_subnet
+
+
+def test_custody_columns_superset_as_count_grows(spec):
+    """A node raising its custody count keeps every column it had."""
+    node = 987654321
+    small = set(map(int, spec.get_custody_columns(node, 1)))
+    big = set(map(int, spec.get_custody_columns(node, 4)))
+    assert small <= big
+
+
+def test_custody_count_capped(spec):
+    with pytest.raises(AssertionError):
+        spec.get_custody_columns(
+            1, int(spec.DATA_COLUMN_SIDECAR_SUBNET_COUNT) + 1)
+
+
+def test_custody_coverage_across_nodes(spec):
+    """Enough random nodes at CUSTODY_REQUIREMENT cover every column."""
+    rng = random.Random(4)
+    covered = set()
+    for _ in range(100):
+        node = rng.randrange(2**256)
+        covered |= set(map(int, spec.get_custody_columns(node, 2)))
+    assert covered == set(range(int(spec.NUMBER_OF_COLUMNS)))
+
+
+def _sidecar(spec, n_blobs=1, index=0):
+    """A structurally valid sidecar with placeholder cells/proofs (no
+    crypto — structural checks only)."""
+    cell = bytes(spec.BYTES_PER_CELL)
+    return spec.DataColumnSidecar(
+        index=index,
+        column=[spec.Cell(cell)] * n_blobs,
+        kzg_commitments=[spec.KZGCommitment(
+            spec.G1_POINT_AT_INFINITY)] * n_blobs,
+        kzg_proofs=[spec.KZGProof(spec.G1_POINT_AT_INFINITY)] * n_blobs,
+        signed_block_header=spec.SignedBeaconBlockHeader(),
+    )
+
+
+def test_verify_data_column_sidecar_structural(spec):
+    assert spec.verify_data_column_sidecar(_sidecar(spec, 2, 0))
+    assert spec.verify_data_column_sidecar(
+        _sidecar(spec, 1, int(spec.NUMBER_OF_COLUMNS) - 1))
+    # out-of-range column index
+    assert not spec.verify_data_column_sidecar(
+        _sidecar(spec, 1, int(spec.NUMBER_OF_COLUMNS)))
+    # empty column
+    assert not spec.verify_data_column_sidecar(_sidecar(spec, 0, 0))
+    # misaligned commitments
+    bad = _sidecar(spec, 2, 0)
+    bad.kzg_commitments = bad.kzg_commitments[:1]
+    assert not spec.verify_data_column_sidecar(bad)
+
+
+def test_get_data_column_sidecars_layout(spec):
+    """Sidecar construction: column j of sidecar j, one cell per blob,
+    commitments shared, header derived from the signed block."""
+    rng = random.Random(7594_21)
+    width = int(spec.FIELD_ELEMENTS_PER_BLOB)
+    blob = b"".join(rng.randrange(int(spec.BLS_MODULUS)).to_bytes(32, "big")
+                    for _ in range(width))
+    commitment = spec.blob_to_kzg_commitment(blob)
+    cells = spec.compute_cells(blob)
+    # placeholder proofs: layout test, not a crypto test
+    proofs = [spec.G1_POINT_AT_INFINITY] * len(cells)
+
+    block = spec.SignedBeaconBlock()
+    block.message.slot = 3
+    block.message.body.blob_kzg_commitments = [commitment]
+    sidecars = spec.get_data_column_sidecars(block, [(cells, proofs)])
+    assert len(sidecars) == int(spec.NUMBER_OF_COLUMNS)
+    for j in (0, 7, len(sidecars) - 1):
+        sc = sidecars[j]
+        assert int(sc.index) == j
+        assert len(sc.column) == 1
+        assert bytes(sc.column[0]) == spec.cell_to_bytes(cells[j])
+        assert bytes(sc.kzg_commitments[0]) == bytes(commitment)
+        assert spec.verify_data_column_sidecar(sc)
+        assert int(sc.signed_block_header.message.slot) == 3
+    from consensus_specs_tpu.utils.ssz import hash_tree_root
+    assert sidecars[0].signed_block_header.message.body_root == \
+        hash_tree_root(block.message.body)
+
+
+def test_verify_sidecar_kzg_proofs_zero_blob_column(spec):
+    """The whole-column KZG check through verify_cell_proof_batch: the
+    zero blob (infinity commitment, zero cells, infinity proofs) is a
+    valid multiproof family, and a tampered cell fails — engine and
+    spec loop agree (real-proof columns are covered by
+    test_das_engine with the same verify path)."""
+    import os
+    inf = spec.G1_POINT_AT_INFINITY
+    sc = spec.DataColumnSidecar(
+        index=3,
+        column=[spec.Cell(bytes(spec.BYTES_PER_CELL))] * 2,
+        kzg_commitments=[spec.KZGCommitment(inf)] * 2,
+        kzg_proofs=[spec.KZGProof(inf)] * 2,
+        signed_block_header=spec.SignedBeaconBlockHeader(),
+    )
+    assert spec.verify_data_column_sidecar_kzg_proofs(sc)
+    bad = spec.DataColumnSidecar.decode_bytes(sc.serialize())
+    bad.column[0] = spec.Cell(
+        (1).to_bytes(32, "big") + bytes(spec.BYTES_PER_CELL - 32))
+    assert not spec.verify_data_column_sidecar_kzg_proofs(bad)
+    os.environ["CS_TPU_DAS"] = "0"
+    try:
+        assert spec.verify_data_column_sidecar_kzg_proofs(sc)
+        assert not spec.verify_data_column_sidecar_kzg_proofs(bad)
+    finally:
+        del os.environ["CS_TPU_DAS"]
+
+
+def test_is_data_available_sampling_paths(spec, blob_setup=None):
+    """No stub -> deneb full-blob fallback; a stub that returns short
+    means withheld -> unavailable; a stub with verifying cells ->
+    available (exercised with real multiproofs in test_das_engine's
+    fixtures — here the short-return and empty paths)."""
+    root = b"\x07" * 32
+    assert spec.is_data_available(root, [])
+    commitment = spec.G1_POINT_AT_INFINITY
+    try:
+        spec.retrieve_cells_and_proofs = lambda r: []
+        # one committed blob, zero sampled -> withheld
+        assert not spec.is_data_available(root, [commitment])
+        # empty sample set for the one blob: vacuous verify -> available
+        spec.retrieve_cells_and_proofs = lambda r: [([], [], [])]
+        assert spec.is_data_available(root, [commitment])
+    finally:
+        del spec.retrieve_cells_and_proofs

@@ -1,0 +1,420 @@
+"""DAS engine differentials: batched verify/recovery vs the markdown
+spec loop, counted fallbacks, supervision, and the pairing census.
+
+The spec surface under test is the eip7594 fork class — under a
+``--compiled`` session the SAME tests run against the markdown-compiled
+ladder, so "engine vs spec-markdown loop" really is byte-compared
+across both ladders.
+"""
+import os
+import random
+from contextlib import contextmanager
+
+import pytest
+
+from consensus_specs_tpu import faults, supervisor
+from consensus_specs_tpu.forks import build_spec
+from consensus_specs_tpu.test_infra.metrics import counting
+from consensus_specs_tpu.utils import bls
+
+
+@contextmanager
+def _env(**kv):
+    saved = {}
+    for k, v in kv.items():
+        saved[k] = os.environ.get(k)
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+@pytest.fixture(autouse=True)
+def _force_engine_on():
+    """These are engine-vs-spec differentials: each test runs its own
+    on AND off legs, so the module pins the switch on even under the
+    CI-wide CS_TPU_DAS=0 off-leg (the live env re-read makes the pin
+    effective per call)."""
+    with _env(CS_TPU_DAS="1"):
+        yield
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return build_spec("eip7594", "minimal")
+
+
+@pytest.fixture(scope="module")
+def blob_setup(spec):
+    """One blob with commitment, all cells, and multiproofs for a small
+    sample of cells (proof computation is the expensive part)."""
+    rng = random.Random(7594_11)
+    width = int(spec.FIELD_ELEMENTS_PER_BLOB)
+    blob = b"".join(rng.randrange(int(spec.BLS_MODULUS)).to_bytes(32, "big")
+                    for _ in range(width))
+    commitment = spec.blob_to_kzg_commitment(blob)
+    cells = spec.compute_cells(blob)
+    # proofs via the ops library twin (identical outputs, less wall
+    # clock than the spec-shaped O(L^3) interpolation per cell)
+    from consensus_specs_tpu.ops import kzg_7594 as K7
+    setup = spec.kzg_setup
+    coeff = K7.polynomial_eval_to_coeff(
+        __import__("consensus_specs_tpu.ops.kzg", fromlist=["kzg"])
+        .blob_to_polynomial(blob, width), setup)
+    sample_ids = [0, 100]
+    proofs = {}
+    for cid in sample_ids:
+        proof, ys = K7.compute_kzg_proof_multi_impl(
+            coeff, K7.coset_for_cell(cid, setup), setup)
+        assert ys == cells[cid]
+        proofs[cid] = proof
+    cell_bytes = {cid: spec.cell_to_bytes(cells[cid]) for cid in sample_ids}
+    return {
+        "blob": blob, "commitment": commitment, "cells": cells,
+        "sample_ids": sample_ids, "proofs": proofs,
+        "cell_bytes": cell_bytes,
+    }
+
+
+def _batch_args(bs, n=2):
+    ids = bs["sample_ids"][:n]
+    return ([bs["commitment"]], [0] * len(ids), list(ids),
+            [bs["cell_bytes"][c] for c in ids],
+            [bs["proofs"][c] for c in ids])
+
+
+# ---------------------------------------------------------------------------
+# Batched verification
+# ---------------------------------------------------------------------------
+
+def test_engine_batch_is_one_pairing(spec, blob_setup):
+    """The whole batch folds into ONE pairing check; the spec loop pays
+    one per cell (counter-asserted on the shared bls.pairings census;
+    bench_das.py asserts the same census at 3-blob x 3-column shape)."""
+    args = _batch_args(blob_setup, 2)
+    with counting() as delta:
+        assert spec.verify_cell_proof_batch(*args)
+    assert delta["das.verify{path=engine}"] == 1
+    assert delta["das.cells{op=verified}"] == 2
+    assert delta["bls.pairings"] == 1
+    with _env(CS_TPU_DAS="0"):
+        with counting() as delta:
+            assert spec.verify_cell_proof_batch(*args)
+    assert delta["das.verify{path=spec}"] == 1
+    assert delta["bls.pairings"] == 2
+
+
+def test_engine_defers_into_rlc_scope(spec, blob_setup):
+    """Inside an assert-style batch scope the engine's pairs fold into
+    the block's single RLC pairing: zero own pairings, one at flush."""
+    args = _batch_args(blob_setup, 2)
+    bls.clear_verify_memo()
+    with counting() as delta:
+        with bls.batched_verification() as batch:
+            assert spec.verify_cell_proof_batch(*args) is True
+            mid = dict(delta)
+            batch.assert_valid()
+    assert mid.get("bls.pairings", 0) == 0
+    assert delta["bls.pairings"] == 1
+    assert delta["bls.flush{path=rlc}"] == 1
+
+
+def test_tampered_cell_verdict_parity(spec, blob_setup):
+    """A tampered evaluation fails on BOTH paths (engine fold catches
+    exactly what the per-cell spec loop catches)."""
+    coms, rows, cols, cells, proofs = _batch_args(blob_setup, 2)
+    bad = (int.from_bytes(cells[1][:32], "big") + 1) \
+        % int(spec.BLS_MODULUS)
+    cells = list(cells)
+    cells[1] = bad.to_bytes(32, "big") + cells[1][32:]
+    assert spec.verify_cell_proof_batch(coms, rows, cols, cells,
+                                        proofs) is False
+    with _env(CS_TPU_DAS="0"):
+        assert spec.verify_cell_proof_batch(coms, rows, cols, cells,
+                                            proofs) is False
+
+
+def test_wrong_column_and_wrong_proof_parity(spec, blob_setup):
+    coms, rows, cols, cells, proofs = _batch_args(blob_setup, 2)
+    wrong_cols = [cols[1], cols[0]]     # cells swapped across cosets
+    assert spec.verify_cell_proof_batch(
+        coms, rows, wrong_cols, cells, proofs) is False
+    assert spec.verify_cell_proof_batch(
+        coms, rows, cols, cells, list(reversed(proofs))) is False
+    # spec-loop parity on the swapped-coset shape (the wrong-proof
+    # shape short-circuits identically; tamper parity covers it)
+    with _env(CS_TPU_DAS="0"):
+        assert spec.verify_cell_proof_batch(
+            coms, rows, wrong_cols, cells, proofs) is False
+
+
+def test_invalid_encoding_raises_on_both_paths(spec, blob_setup):
+    """Non-canonical field element in a cell: the same AssertionError
+    the spec's bytes_to_cell raises, engine on or off."""
+    coms, rows, cols, cells, proofs = _batch_args(blob_setup, 2)
+    cells = list(cells)
+    cells[0] = int(spec.BLS_MODULUS).to_bytes(32, "big") + cells[0][32:]
+    for env in ({}, {"CS_TPU_DAS": "0"}):
+        with _env(**env):
+            with pytest.raises(AssertionError):
+                spec.verify_cell_proof_batch(coms, rows, cols, cells,
+                                             proofs)
+
+
+def test_empty_batch_true_both_paths(spec):
+    for env in ({}, {"CS_TPU_DAS": "0"}):
+        with _env(**env):
+            assert spec.verify_cell_proof_batch([], [], [], [], []) is True
+
+
+def test_same_commitment_fold_multi_row(spec, blob_setup):
+    """Cells sharing a row commitment fold into one weighted RLC term;
+    a duplicated commitment row keeps the verdict and the one-pairing
+    census."""
+    coms, rows, cols, cells, proofs = _batch_args(blob_setup, 2)
+    # the same commitment listed twice; cells spread across both rows
+    with counting() as delta:
+        assert spec.verify_cell_proof_batch(
+            [coms[0], coms[0]], [0, 1], cols, cells, proofs)
+    assert delta["bls.pairings"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Counted fallbacks + supervision at the new sites
+# ---------------------------------------------------------------------------
+
+def test_injected_fault_counts_and_matches(spec, blob_setup):
+    args = _batch_args(blob_setup, 1)
+    expected = spec.verify_cell_proof_batch(*args)
+    with counting() as delta:
+        with faults.injected(faults.FaultSchedule(
+                {"das.verify": [1]})) as schedule:
+            got = spec.verify_cell_proof_batch(*args)
+    assert schedule.fully_fired()
+    assert got == expected
+    assert delta["das.fallbacks{reason=injected}"] == 1
+    assert delta["das.fallbacks{reason=guard}"] == 0
+    assert delta["das.verify{path=spec}"] == 1
+    assert delta["das.verify{path=engine}"] == 0
+
+
+def test_injected_recover_fault_counts_and_matches(spec, blob_setup):
+    n_cells = spec.cells_per_blob()
+    keep = list(range(0, n_cells, 2))
+    cbs = [spec.cell_to_bytes(blob_setup["cells"][i]) for i in keep]
+    # ground truth is the published cells themselves (the spec-loop
+    # byte-identity is proven by the fuzz test; no extra spec run here)
+    expected = [x for c in blob_setup["cells"] for x in c]
+    with counting() as delta:
+        with faults.injected(faults.FaultSchedule(
+                {"das.recover": [1]})) as schedule:
+            got = spec.recover_polynomial(keep, cbs)
+    assert schedule.fully_fired()
+    assert got == expected
+    assert delta["das.fallbacks{reason=injected}"] == 1
+    assert delta["das.recover{path=spec}"] == 1
+
+
+def test_deadline_trip_degrades_to_spec_loop(spec, blob_setup):
+    """A mid-work deadline trip inside the batched recovery (the
+    cooperative phase boundaries) becomes a counted reason=deadline
+    fallback; the spec loop serves the call byte-identically."""
+    n_cells = spec.cells_per_blob()
+    keep = list(range(n_cells // 2))
+    cbs = [spec.cell_to_bytes(blob_setup["cells"][i]) for i in keep]
+    with _env(CS_TPU_DEADLINE_MS="0.0001"):
+        supervisor.reset()      # re-read the deadline knob
+        with counting() as delta:
+            got = spec.recover_polynomial(keep, cbs)
+    assert got == [x for c in blob_setup["cells"] for x in c]
+    assert delta["das.fallbacks{reason=deadline}"] == 1
+    assert delta["supervisor.deadline.trips{site=das.recover}"] == 1
+    assert delta["das.recover{path=spec}"] == 1
+    supervisor.reset()
+
+
+def test_breaker_opens_and_skips_das_engine(spec, blob_setup):
+    """Threshold-1 supervisor: one injected trip opens das.verify; the
+    next call runs the spec path without an engine attempt (skip
+    counted), and the verdict still matches."""
+    args = _batch_args(blob_setup, 1)
+    with _env(CS_TPU_BREAKER_THRESHOLD="1",
+              CS_TPU_BREAKER_BACKOFF_MS="60000"):
+        supervisor.reset()
+        with faults.injected(faults.FaultSchedule({"das.verify": [1]})):
+            spec.verify_cell_proof_batch(*args)
+        assert supervisor.states()["das.verify"] == "open"
+        with counting() as delta:
+            assert spec.verify_cell_proof_batch(*args)
+        assert delta["supervisor.breaker.skips{site=das.verify}"] == 1
+        assert delta["das.verify{path=spec}"] == 1
+    supervisor.reset()
+
+
+def test_corrupt_verify_caught_by_sentinel_audit(spec, blob_setup, tmp_path):
+    """Silent verdict corruption at das.verify: the rate-1 audit books a
+    fail, quarantines the site, and the SPEC answer is what callers
+    see."""
+    args = _batch_args(blob_setup, 1)
+    with _env(CS_TPU_AUDIT_RATE="1",
+              CS_TPU_SIM_ARTIFACTS=str(tmp_path)):
+        supervisor.reset()
+        with counting() as delta:
+            with faults.injected(faults.FaultSchedule(
+                    corrupt={"das.verify": [1]})) as schedule:
+                got = spec.verify_cell_proof_batch(*args)
+        assert schedule.corrupted
+        assert got is True      # spec answer authoritative
+        assert delta["supervisor.audits{result=fail,site=das.verify}"] == 1
+        assert delta["supervisor.quarantines{site=das.verify}"] == 1
+        assert supervisor.states()["das.verify"] == "quarantined"
+    supervisor.reset()
+
+
+def test_corrupt_recover_caught_by_sentinel_audit(spec, blob_setup,
+                                                  tmp_path):
+    n_cells = spec.cells_per_blob()
+    keep = list(range(n_cells // 2))
+    cbs = [spec.cell_to_bytes(blob_setup["cells"][i]) for i in keep]
+    with _env(CS_TPU_AUDIT_RATE="1",
+              CS_TPU_SIM_ARTIFACTS=str(tmp_path)):
+        supervisor.reset()
+        with counting() as delta:
+            with faults.injected(faults.FaultSchedule(
+                    corrupt={"das.recover": [1]})) as schedule:
+                got = spec.recover_polynomial(keep, cbs)
+        assert schedule.corrupted
+        assert delta["supervisor.audits{result=fail,site=das.recover}"] == 1
+        assert supervisor.states()["das.recover"] == "quarantined"
+        # the served (spec-authoritative) answer is the true data
+        assert got == [x for c in blob_setup["cells"] for x in c]
+    supervisor.reset()
+
+
+# ---------------------------------------------------------------------------
+# Recovery edge cases + fuzz
+# ---------------------------------------------------------------------------
+
+def test_corrupt_recover_with_nothing_missing_still_caught(
+        spec, blob_setup, tmp_path):
+    """A corrupt-armed recovery with ALL cells present must still
+    really corrupt the result (position 0 — there is no missing cell
+    to perturb), or the sentinel-audit legs would flag a false silent
+    corruption (regression)."""
+    n_cells = spec.cells_per_blob()
+    keep = list(range(n_cells))
+    cbs = [spec.cell_to_bytes(blob_setup["cells"][i]) for i in keep]
+    with _env(CS_TPU_AUDIT_RATE="1",
+              CS_TPU_SIM_ARTIFACTS=str(tmp_path)):
+        supervisor.reset()
+        with counting() as delta:
+            with faults.injected(faults.FaultSchedule(
+                    corrupt={"das.recover": [1]})) as schedule:
+                got = spec.recover_polynomial(keep, cbs)
+        assert schedule.corrupted
+        assert delta["supervisor.audits{result=fail,site=das.recover}"] == 1
+        assert got == [x for c in blob_setup["cells"] for x in c]
+    supervisor.reset()
+
+
+def test_recover_exactly_half_boundary(spec, blob_setup):
+    """Exactly CELLS_PER_BLOB/2 present succeeds on both paths,
+    byte-identically."""
+    n_cells = spec.cells_per_blob()
+    keep = sorted(random.Random(1).sample(range(n_cells), n_cells // 2))
+    cbs = [spec.cell_to_bytes(blob_setup["cells"][i]) for i in keep]
+    full = [x for c in blob_setup["cells"] for x in c]
+    got_engine = spec.recover_polynomial(keep, cbs)
+    with _env(CS_TPU_DAS="0"):
+        got_spec = spec.recover_polynomial(keep, cbs)
+    assert got_engine == got_spec == full
+
+
+def test_recover_one_short_fails_loud(spec, blob_setup):
+    """One cell fewer than half: loud AssertionError, not garbage —
+    engine on AND off."""
+    n_cells = spec.cells_per_blob()
+    keep = list(range(n_cells // 2 - 1))
+    cbs = [spec.cell_to_bytes(blob_setup["cells"][i]) for i in keep]
+    for env in ({}, {"CS_TPU_DAS": "0"}):
+        with _env(**env):
+            with pytest.raises(AssertionError):
+                spec.recover_polynomial(keep, cbs)
+
+
+def test_recover_duplicate_cell_ids_rejected(spec, blob_setup):
+    n_cells = spec.cells_per_blob()
+    keep = list(range(n_cells // 2))
+    keep[1] = keep[0]   # duplicate id, count still n/2
+    cbs = [spec.cell_to_bytes(blob_setup["cells"][i]) for i in keep]
+    for env in ({}, {"CS_TPU_DAS": "0"}):
+        with _env(**env):
+            with pytest.raises(AssertionError):
+                spec.recover_polynomial(keep, cbs)
+
+
+def test_recover_randomized_missing_set_fuzz(spec, blob_setup):
+    """Randomized missing sets: engine recovery byte-compared to the
+    spec-markdown loop (the --compiled session runs this same fuzz
+    against the compiled ladder)."""
+    n_cells = spec.cells_per_blob()
+    full = [x for c in blob_setup["cells"] for x in c]
+    rng = random.Random(41)
+    count = rng.randint(n_cells // 2, n_cells - 1)
+    keep = sorted(rng.sample(range(n_cells), count))
+    cbs = [spec.cell_to_bytes(blob_setup["cells"][i]) for i in keep]
+    with counting() as delta:
+        got_engine = spec.recover_polynomial(keep, cbs)
+    assert delta["das.recover{path=engine}"] == 1
+    with _env(CS_TPU_DAS="0"):
+        got_spec = spec.recover_polynomial(keep, cbs)
+    assert got_engine == got_spec == full
+    # more seeds in the heavy tier (sim's engine-off legs fuzz this
+    # same byte-identity every sweep)
+    if os.environ.get("CS_TPU_HEAVY") == "1":
+        for seed in (42, 43, 44):
+            rng = random.Random(seed)
+            keep = sorted(rng.sample(
+                range(n_cells), rng.randint(n_cells // 2, n_cells - 1)))
+            cbs = [spec.cell_to_bytes(blob_setup["cells"][i])
+                   for i in keep]
+            got_engine = spec.recover_polynomial(keep, cbs)
+            with _env(CS_TPU_DAS="0"):
+                assert got_engine == spec.recover_polynomial(keep, cbs) \
+                    == full
+
+
+def test_recover_many_shares_group_work(spec, blob_setup):
+    """Multi-blob batched recovery: blobs missing the same columns
+    recover in ONE engine dispatch, byte-identical to per-blob spec
+    loops."""
+    from consensus_specs_tpu.das import recover_many
+    rng = random.Random(77)
+    width = int(spec.FIELD_ELEMENTS_PER_BLOB)
+    n_cells = spec.cells_per_blob()
+    keep = sorted(rng.sample(range(n_cells), n_cells // 2))
+    blobs = [blob_setup["blob"]]
+    blobs.append(b"".join(
+        rng.randrange(int(spec.BLS_MODULUS)).to_bytes(32, "big")
+        for _ in range(width)))
+    reqs = []
+    fulls = []
+    for blob in blobs:
+        cells = spec.compute_cells(blob)
+        fulls.append([x for c in cells for x in c])
+        reqs.append((keep, [spec.cell_to_bytes(cells[i]) for i in keep]))
+    with counting() as delta:
+        got = recover_many(spec, reqs)
+    assert delta["das.recover{path=engine}"] == 1
+    assert got == fulls
+    if os.environ.get("CS_TPU_HEAVY") == "1":
+        with _env(CS_TPU_DAS="0"):
+            assert recover_many(spec, reqs) == fulls

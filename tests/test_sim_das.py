@@ -1,0 +1,186 @@
+"""Availability-sampling sim legs (sim/das.py): determinism, the
+counted-fallback contract at the das sites, sentinel-audit quarantine
+with a replayable artifact, and the engine-off byte-identity leg."""
+import pytest
+
+from consensus_specs_tpu.forks import build_spec
+from consensus_specs_tpu.sim import das, harness, repro
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return build_spec("eip7594", "minimal")
+
+
+def test_scripts_are_deterministic_pure_data():
+    for seed in range(4):
+        a = das.build(seed)
+        b = das.build(seed)
+        assert a.script == b.script
+        assert a.name.startswith(das.DAS_PREFIX)
+        import json
+        json.dumps(a.script)    # replayable artifacts need JSON scripts
+
+
+def test_catalog_covers_all_shapes():
+    names = {das.build(seed).name for seed in range(8)}
+    assert names == set(das.NAMES)
+
+
+def test_baseline_replays_identical(spec):
+    scenario = das.build(0)
+    a, census_a = das.run_baseline(spec, scenario)
+    b, census_b = das.run_baseline(spec, scenario)
+    assert a.digest() == b.digest()
+    assert census_a == census_b
+    assert set(census_a) <= set(das.DAS_SITES)
+
+
+def test_boundary_scenario_semantics(spec):
+    """recovery_boundary: the exactly-half recover succeeds (hash
+    event), the one-short recover refuses loudly (rejected count)."""
+    scenario = das.build(1, name="recovery_boundary")
+    result, _ = das.run_baseline(spec, scenario)
+    recovers = [e for e in result.events if e.startswith("recover|")]
+    assert len(recovers) == 2
+    assert "refused" not in recovers[0]
+    assert "refused" in recovers[1]
+    assert result.rejected == 1
+
+
+def test_withheld_sampling_flags_unavailable(spec):
+    """A sampled withheld column marks the block unavailable; the
+    tampered adversarial sample fails closed."""
+    scenario = das.build(3, name="nonfinality_sampling")
+    result, _ = das.run_baseline(spec, scenario)
+    samples = [e for e in result.events if e.startswith("sample|")]
+    assert samples, result.events
+    # the final scripted sample is the tampered one: must be unavailable
+    assert samples[-1].endswith("unavailable")
+
+
+def test_injected_legs_satisfy_contract(spec):
+    scenario = das.build(0)
+    baseline, census = das.run_baseline(spec, scenario)
+    assert census
+    for site, calls in sorted(census.items()):
+        das.run_injected(spec, scenario, baseline, site, calls)
+
+
+def test_silent_fallback_detected(spec, monkeypatch):
+    """A das fallback that books nothing must fail the injected leg
+    with the silent-fallback category (the contract the legs exist to
+    enforce)."""
+    from consensus_specs_tpu.das import engine
+
+    class _Mute:
+        def add(self, *a):
+            pass
+
+    scenario = das.build(0)
+    baseline, census = das.run_baseline(spec, scenario)
+    site = sorted(census)[0]
+    monkeypatch.setitem(engine._C_FALLBACKS, "injected", _Mute())
+    with pytest.raises(harness.LegFailure) as err:
+        das.run_injected(spec, scenario, baseline, site, 1)
+    assert err.value.category == "silent-fallback"
+
+
+def test_engine_off_leg_byte_identical(spec):
+    scenario = das.build(0)
+    baseline, _ = das.run_baseline(spec, scenario)
+    das.run_engine_off(spec, scenario, baseline)
+
+
+def test_corrupt_leg_quarantines_and_replays(spec, tmp_path):
+    """End to end: the corrupt leg quarantines das.recover, dumps an
+    artifact, and sim.repro re-arms it and reproduces (exit 1).  A
+    hand-minimal one-recover script keeps the rate-1 audit replays
+    affordable; the sweep runs the full catalog shapes."""
+    from consensus_specs_tpu.sim.scenarios import Scenario
+    scenario = Scenario("das/recovery_boundary", 0, [
+        {"op": "publish", "blob_seeds": [123], "zero_blobs": 0},
+        {"op": "withhold", "columns": list(range(0, das.N_COLUMNS, 2))},
+        {"op": "recover"},
+    ], 0, None)
+    baseline, census = das.run_baseline(spec, scenario)
+    assert census.get("das.recover", 0) >= 1
+    result, artifact = das.run_corrupt(
+        spec, scenario, baseline, "das.recover", out_dir=str(tmp_path))
+    assert result.digest() == baseline.digest()
+    # the replay's re-dumped quarantine evidence must land NEXT TO the
+    # artifact, never in the process-default artifact dir (regression)
+    import os
+    sentinel = tmp_path / "default-dir"
+    saved = os.environ.get("CS_TPU_SIM_ARTIFACTS")
+    os.environ["CS_TPU_SIM_ARTIFACTS"] = str(sentinel)
+    try:
+        assert repro.replay(artifact) == 1
+    finally:
+        if saved is None:
+            os.environ.pop("CS_TPU_SIM_ARTIFACTS", None)
+        else:
+            os.environ["CS_TPU_SIM_ARTIFACTS"] = saved
+    assert not sentinel.exists() or not any(sentinel.iterdir())
+
+
+def test_failure_artifact_records_das_spec(spec, tmp_path):
+    """Leg-failure artifacts from the das phase must replay against
+    the das spec: the sweep records eip7594/minimal (not its --fork),
+    and replay_artifact refuses to rebuild a chain fork even from a
+    stale artifact (regression: a phase0-recorded das artifact crashed
+    replay with an AttributeError)."""
+    from consensus_specs_tpu import faults
+    scenario = das.build(2, name="custody_rotation")
+    schedule = faults.FaultSchedule({"das.verify": [1]})
+    # the shape run_das_phase dumps for a non-corrupt leg failure
+    path = repro.dump_artifact(scenario, "inject[das.verify@1]",
+                               "synthetic", schedule=schedule,
+                               out_dir=str(tmp_path), fork="eip7594",
+                               preset="minimal")
+    assert repro.replay(path) == 0      # healthy leg: no reproduction
+    # stale artifact with a chain fork recorded: still replays
+    stale = repro.dump_artifact(scenario, "das-engine-off", "synthetic",
+                                out_dir=str(tmp_path), fork="phase0",
+                                preset="minimal")
+    assert repro.replay(stale) == 0
+
+
+def test_quarantine_replay_contract_violation_distinct_exit(
+        spec, tmp_path, monkeypatch):
+    """If the quarantine pipeline regresses between dump and replay
+    (run_corrupt raises a LegFailure), the replay reports exit 2 — a
+    distinct verdict, not a hollow 'reproduced' (regression)."""
+    from consensus_specs_tpu import faults
+    from consensus_specs_tpu.sim.scenarios import Scenario
+    scenario = Scenario("das/recovery_boundary", 0, [
+        {"op": "publish", "blob_seeds": [5], "zero_blobs": 0},
+        {"op": "withhold", "columns": list(range(0, das.N_COLUMNS, 2))},
+        {"op": "recover"},
+    ], 0, None)
+    schedule = faults.FaultSchedule(corrupt={"das.recover": [1]})
+    path = repro.dump_artifact(scenario, "audit[das.recover]", "x",
+                               schedule=schedule, out_dir=str(tmp_path),
+                               fork="eip7594", preset="minimal")
+
+    def broken_run_corrupt(*a, **kw):
+        raise harness.LegFailure("audit[das.recover]", scenario,
+                                 "SILENT CORRUPTION (simulated)",
+                                 category="silent-fallback")
+
+    monkeypatch.setattr(das, "run_corrupt", broken_run_corrupt)
+    assert repro.replay(path) == 2
+
+
+@pytest.mark.slow
+def test_corrupt_verify_leg(spec, tmp_path):
+    scenario = das.build(2, name="custody_rotation")
+    baseline, census = das.run_baseline(spec, scenario)
+    assert census.get("das.verify", 0) >= 1
+    result, artifact = das.run_corrupt(
+        spec, scenario, baseline, "das.verify", out_dir=str(tmp_path))
+    assert result.digest() == baseline.digest()
+    import json
+    payload = json.load(open(artifact))
+    assert payload["scenario"].startswith("das/")
+    assert payload["schedule"]["corrupt"] == {"das.verify": 1}

@@ -174,7 +174,11 @@ def test_provenance_manifest_covers_all_spec_logic():
     assert manifest["whisk"]["upgrade_to_whisk"] == \
         "specs/_features/whisk/fork.md"
     assert manifest["eip7594"]["is_data_available"] == \
+        "specs/_features/das/das-core.md"
+    assert manifest["eip7594"]["recover_cells_and_kzg_proofs"] == \
         "specs/_features/eip7594/polynomial-commitments-sampling.md"
+    assert manifest["eip7594"]["get_custody_columns"] == \
+        "specs/_features/das/das-core.md"
 
 
 def test_provenance_guard_fires_on_missing_symbol():
