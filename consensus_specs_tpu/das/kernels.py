@@ -36,8 +36,6 @@ Every function here is verdict/byte-identical to the markdown spec
 loop — asserted by the differential suites and the engine's sentinel
 audits (``das/engine.py``).
 """
-import os
-
 from consensus_specs_tpu import supervisor
 from consensus_specs_tpu.ops import kzg as K
 from consensus_specs_tpu.ops import kzg_7594 as K7
@@ -46,6 +44,7 @@ from consensus_specs_tpu.ops.bls12_381.curve import (
 )
 from consensus_specs_tpu.utils.hash_function import hash as _hash
 from consensus_specs_tpu.utils import bls as _bls
+from consensus_specs_tpu.utils import env_flags as _env_flags
 
 BLS_MODULUS = K.BLS_MODULUS
 CELL = K7.FIELD_ELEMENTS_PER_CELL
@@ -58,7 +57,7 @@ _PAIRINGS = _obs_registry.counter("bls.pairings").labels()
 
 
 # ---------------------------------------------------------------------------
-# Per-setup domain tables (setups are lru-cached singletons; id() keyed)
+# Per-setup domain tables (content-keyed)
 # ---------------------------------------------------------------------------
 
 _TABLES = {}
@@ -94,10 +93,23 @@ class _Tables:
         return pows
 
 
+def _setup_key(setup):
+    """Content key of a setup: a :class:`_Tables` derives exclusively
+    from the blob width and the degree-L G2 monomial, so these two
+    fields ARE the table identity.  The cache was previously keyed on
+    ``id(setup)`` (speclint D1004): an address key aliases if a setup
+    is ever garbage-collected and another allocates at the same
+    address, silently serving the wrong roots/shifts — content keys
+    make that impossible and deduplicate equal-content setups too."""
+    return (int(setup.FIELD_ELEMENTS_PER_BLOB),
+            bytes(setup.KZG_SETUP_G2_MONOMIAL[CELL]))
+
+
 def tables(setup) -> _Tables:
-    t = _TABLES.get(id(setup))
+    key = _setup_key(setup)
+    t = _TABLES.get(key)
     if t is None:
-        t = _TABLES.setdefault(id(setup), _Tables(setup))
+        t = _TABLES.setdefault(key, _Tables(setup))
     return t
 
 
@@ -219,7 +231,7 @@ def _fft_rows(rows, roots_ext, inv, limb):
 
 
 def limb_fft_enabled() -> bool:
-    return os.environ.get("CS_TPU_DAS_FFT") == "limb"
+    return _env_flags.knob("CS_TPU_DAS_FFT") == "limb"
 
 
 def recover_cells_batch(requests, setup):

@@ -67,6 +67,20 @@ SITES = (
     "das.recover",
 )
 
+# Site-family -> the CS_TPU_* switch that turns the family's engine
+# off.  The speclint coverage pass (C11xx) reads this map (by AST, not
+# import) to prove every site has a switch-off CI leg; a SITES entry
+# matching no prefix here fails `make lint` (C1100).  Keys are
+# prefix-matched against site names.
+SITE_SWITCHES = {
+    "epoch.": "CS_TPU_VECTORIZED_EPOCH",
+    "forkchoice.": "CS_TPU_PROTO_ARRAY",
+    "merkle.": "CS_TPU_HASH_FOREST",
+    "state_arrays.": "CS_TPU_STATE_ARRAYS",
+    "bls.": "CS_TPU_BLS_RLC",
+    "das.": "CS_TPU_DAS",
+}
+
 _active = None      # the armed schedule; None = disarmed (the hot path)
 
 

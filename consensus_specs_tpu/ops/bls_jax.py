@@ -20,7 +20,6 @@ Shape discipline: batch and aggregate axes are padded to powers of two so
 the number of compiled program variants stays O(log n); padding lanes are
 degenerate pairs that contribute the identity to the pairing product.
 """
-import os
 from collections import OrderedDict
 
 import numpy as np
@@ -36,6 +35,7 @@ from consensus_specs_tpu.ops.bls12_381.curve import (
 from consensus_specs_tpu.ops.jax_bls import points as PT
 from consensus_specs_tpu.ops.jax_bls import pairing as PR
 from consensus_specs_tpu.ops.jax_bls import htc as HTC
+from consensus_specs_tpu.utils import env_flags
 
 
 def _profile_sync(tree):
@@ -144,8 +144,9 @@ def bucket_b() -> int:
     accelerator plugin can hang there)."""
     global _BUCKET_B
     if _BUCKET_B is None:
-        if "CS_TPU_BLS_BATCH" in os.environ:
-            _BUCKET_B = int(os.environ["CS_TPU_BLS_BATCH"])
+        raw = env_flags.knob("CS_TPU_BLS_BATCH")
+        if raw is not None:
+            _BUCKET_B = int(raw)
         elif NUMPY_KERNELS:
             _BUCKET_B = 8
         else:
@@ -174,8 +175,8 @@ def fuse_verify() -> bool:
             # numpy mode has no fused path: _program_multi_pair_verify's
             # jax.vmap cannot trace numpy-bound kernels
             _FUSE_VERIFY = False
-        elif "CS_TPU_BLS_FUSE" in os.environ:
-            _FUSE_VERIFY = os.environ["CS_TPU_BLS_FUSE"] == "1"
+        elif env_flags.knob("CS_TPU_BLS_FUSE") is not None:
+            _FUSE_VERIFY = env_flags.knob("CS_TPU_BLS_FUSE") == "1"
         else:
             try:
                 _FUSE_VERIFY = jax.default_backend() != "cpu"

@@ -218,6 +218,8 @@ def phase0_component_kernel(xp, base_reward, eligible, attesting, *,
 
 
 # speclint: guarded-by-caller (base_pen + extra bounded together < 2**64)
+# speclint: invariant: base_rewards_per_epoch >= 1
+# speclint: invariant: proposer_reward_quotient >= 1
 def phase0_inactivity_kernel(xp, base_reward, eff, eligible,
                              target_attesting, *, base_rewards_per_epoch,
                              proposer_reward_quotient, finality_delay,
@@ -225,8 +227,9 @@ def phase0_inactivity_kernel(xp, base_reward, eff, eligible,
     """phase0 ``get_inactivity_penalty_deltas`` (leak epochs only)."""
     zero = xp.uint64(0)
     proposer_reward = base_reward // xp.uint64(proposer_reward_quotient)
-    # proposer_reward <= base_reward <= brpe * base_reward: cannot wrap
-    base_pen = (xp.uint64(base_rewards_per_epoch) * base_reward  # noqa: U101
+    # machine-checked safe (speclint U9xx range prover): the declared
+    # invariants give proposer_reward <= base_reward <= brpe*base_reward
+    base_pen = (xp.uint64(base_rewards_per_epoch) * base_reward
                 - proposer_reward)
     extra = (eff * xp.uint64(finality_delay)) \
         // xp.uint64(inactivity_penalty_quotient)
@@ -472,6 +475,7 @@ def _phase0_rewards_and_penalties(spec, state) -> None:
     # inclusion-delay rewards: one ordered pass over the source
     # attestations finds each attester's earliest-included attestation
     # (the spec's min() keeps the first minimum, hence the strict <)
+    # speclint: invariant: prq >= 1
     prq = int(spec.PROPOSER_REWARD_QUOTIENT)
     src_mask = _mask_from_indices(n, src_set)
     best_delay = np.full(n, _U64_MAX, dtype=np.uint64)
@@ -490,8 +494,10 @@ def _phase0_rewards_and_penalties(spec, state) -> None:
     incl_rewards = np.zeros(n, dtype=np.uint64)
     src_idx = np.nonzero(src_mask)[0]
     if src_idx.size:
-        # proposer_reward = base_reward // PRQ <= base_reward: cannot wrap
-        max_attester = (base_reward[src_idx]  # noqa: U101
+        # machine-checked safe (speclint U9xx range prover):
+        # proposer_reward = base_reward // prq <= base_reward with the
+        # declared prq >= 1 invariant, preserved under the shared index
+        max_attester = (base_reward[src_idx]
                         - proposer_reward[src_idx])
         incl_rewards[src_idx] = max_attester // best_delay[src_idx]
         # every attester's proposer cut could land on ONE proposer index

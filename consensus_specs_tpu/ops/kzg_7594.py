@@ -375,7 +375,9 @@ def recover_polynomial(cell_ids, cells_bytes, setup):
     """md:586 — recover all evaluations from >=50% of the cells."""
     assert len(cell_ids) == len(cells_bytes)
     n_cells = cells_per_blob(setup)
-    assert n_cells / 2 <= len(cell_ids) <= n_cells
+    # integer form of the spec's >=50% bound (speclint D1002: no float
+    # on a consensus path); equivalent for every integer n_cells
+    assert n_cells <= 2 * len(cell_ids) and len(cell_ids) <= n_cells
     assert len(cell_ids) == len(set(cell_ids))
 
     roots_of_unity_extended = list(

@@ -24,6 +24,7 @@ import tempfile
 from typing import Optional, Sequence
 
 from consensus_specs_tpu.ops.bls12_381.fields import R_ORDER
+from consensus_specs_tpu.utils import env_flags
 
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))), "csrc")
@@ -69,7 +70,7 @@ def _discard_corrupt() -> None:
 
 
 def _load() -> Optional[ctypes.CDLL]:
-    if os.environ.get("CS_TPU_NO_NATIVE_BLS") == "1":
+    if env_flags.knob("CS_TPU_NO_NATIVE_BLS") == "1":
         return None
     deps = [p for p in (_SRC, os.path.join(_CSRC, "bls12_381_consts.h"))
             if os.path.exists(p)]

@@ -1,0 +1,131 @@
+"""Incremental analysis cache: per-file and whole-tree finding reuse
+keyed on source content hashes.
+
+Every speclint pass is a pure function of file content (plus its own
+code), so findings are safely reusable until the content changes:
+
+* *file-granular* passes (style, uint64, ranges, tracing, obs,
+  state-layer, fallbacks, supervision, spec-markdown) cache findings
+  per ``(file sha256, pass, pass version)`` — editing one file re-runs
+  only that file's passes;
+* *tree-granular* passes (ladder, determinism, coverage) read
+  cross-file state (the ladder pair, the call graph, the CI workflow),
+  so they cache one result per ``(tree fingerprint, pass, version)``
+  where the fingerprint hashes every analysis input — any edit re-runs
+  them, an unchanged tree skips them entirely.
+
+Findings are cached PRE-noqa: the driver re-applies suppression on
+every run (cheap), so a cached finding whose line grew a ``# noqa``
+would still suppress... except the edit changed the file sha and the
+entry was invalidated anyway — the re-application is belt over braces.
+
+The store is one JSON file (``.speclint_cache.json`` at the scan root,
+gitignored); a version/salt mismatch — any pass version bump — drops
+the whole store.  ``--no-incremental`` bypasses it.
+"""
+import hashlib
+import json
+import os
+
+from .findings import Finding
+
+CACHE_NAME = ".speclint_cache.json"
+SCHEMA = 1
+
+
+def _encode(findings):
+    return [[f.path, f.line, f.code, f.message] for f in findings]
+
+
+def _decode(rows):
+    return [Finding(path, line, code, message)
+            for path, line, code, message in rows]
+
+
+class AnalysisCache:
+    """Content-hash-keyed finding store with hit/miss accounting."""
+
+    def __init__(self, path, salt):
+        self.path = path
+        self.salt = salt
+        self.stats = {"file_hits": 0, "file_misses": 0,
+                      "tree_hits": 0, "tree_misses": 0}
+        self._dirty = False
+        self._data = {"schema": SCHEMA, "salt": salt,
+                      "files": {}, "tree": {}}
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            if data.get("schema") == SCHEMA and data.get("salt") == salt:
+                self._data = data
+        except (OSError, ValueError):
+            pass
+
+    # -- file-granular ------------------------------------------------------
+
+    def get_file(self, rel, sha, pass_name):
+        entry = self._data["files"].get(rel)
+        if entry is not None and entry.get("sha") == sha \
+                and pass_name in entry.get("passes", {}):
+            self.stats["file_hits"] += 1
+            return _decode(entry["passes"][pass_name])
+        self.stats["file_misses"] += 1
+        return None
+
+    def put_file(self, rel, sha, pass_name, findings):
+        entry = self._data["files"].get(rel)
+        if entry is None or entry.get("sha") != sha:
+            entry = {"sha": sha, "passes": {}}
+            self._data["files"][rel] = entry
+        entry["passes"][pass_name] = _encode(findings)
+        self._dirty = True
+
+    # -- tree-granular ------------------------------------------------------
+
+    def get_tree(self, pass_name, fingerprint):
+        entry = self._data["tree"].get(pass_name)
+        if entry is not None and entry.get("fingerprint") == fingerprint:
+            self.stats["tree_hits"] += 1
+            return _decode(entry["findings"])
+        self.stats["tree_misses"] += 1
+        return None
+
+    def put_tree(self, pass_name, fingerprint, findings):
+        self._data["tree"][pass_name] = {
+            "fingerprint": fingerprint, "findings": _encode(findings)}
+        self._dirty = True
+
+    # -- persistence --------------------------------------------------------
+
+    def save(self):
+        if not self._dirty:
+            return
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(self._data, f, separators=(",", ":"))
+            os.replace(tmp, self.path)
+        except OSError:
+            pass    # a read-only tree still lints, just never warm
+
+    def summary(self) -> str:
+        s = self.stats
+        return (f"cache: {s['file_hits']}/"
+                f"{s['file_hits'] + s['file_misses']} file entries warm, "
+                f"{s['tree_hits']}/{s['tree_hits'] + s['tree_misses']} "
+                "tree passes warm")
+
+
+def tree_fingerprint(shas, extra=()):
+    """One hash over every (rel, sha) analysis input (sorted) plus any
+    extra tokens (pass version etc.)."""
+    h = hashlib.sha256()
+    for rel, sha in sorted(shas):
+        h.update(rel.encode())
+        h.update(b"\0")
+        h.update(sha.encode())
+        h.update(b"\n")
+    for token in extra:
+        h.update(str(token).encode())
+        h.update(b"\n")
+    return h.hexdigest()

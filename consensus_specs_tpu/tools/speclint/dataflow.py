@@ -1,0 +1,64 @@
+"""Worklist dataflow engine over the project call graph.
+
+The interprocedural passes (coverage C11xx today; any future pass that
+needs "what flows into this function") share one fixed-point solver
+instead of each hand-rolling a convergence loop:
+
+* every function carries a *summary* — a pass-defined, joinable value
+  (sets of facts, typically);
+* a pass supplies ``transfer(fn, get_summary)``: recompute ``fn``'s
+  summary from its own body plus its callees' current summaries;
+* the solver iterates a worklist until no summary changes, re-enqueuing
+  a function's *callers* whenever its summary grows.
+
+Summaries must be monotone under the pass's join (the solver only ever
+replaces a summary when ``transfer`` returns something different, and
+re-visits callers on every change), and the summary domain must be
+finite for termination — the passes here use finite fact sets drawn
+from site literals and parameter names, which trivially satisfies both.
+
+``max_rounds`` is a backstop, not a tuning knob: hitting it means a
+pass's transfer is not monotone, and the solver raises rather than
+silently returning an unconverged (wrong) answer.
+"""
+from collections import deque
+
+
+def solve(functions, callees_of, transfer, max_rounds=10000):
+    """Fixed-point summaries: ``{fn: summary}``.
+
+    ``functions``: iterable of nodes (hashable); ``callees_of(fn)``:
+    edge function (edges outside ``functions`` are ignored);
+    ``transfer(fn, get_summary)``: new summary for ``fn``, where
+    ``get_summary(g)`` reads the current summary of any callee (``None``
+    until first computed).
+    """
+    fns = list(functions)
+    in_set = set(fns)
+    callers = {fn: set() for fn in fns}
+    for fn in fns:
+        for callee in callees_of(fn):
+            if callee in in_set:
+                callers[callee].add(fn)
+    summaries = {}
+    # seed in reverse call order-ish: process everything once, then
+    # iterate on change; correctness does not depend on the order
+    work = deque(fns)
+    queued = set(fns)
+    rounds = 0
+    while work:
+        rounds += 1
+        if rounds > max_rounds * max(len(fns), 1):
+            raise RuntimeError(
+                "speclint dataflow failed to converge — a pass transfer "
+                "function is not monotone")
+        fn = work.popleft()
+        queued.discard(fn)
+        new = transfer(fn, summaries.get)
+        if new != summaries.get(fn):
+            summaries[fn] = new
+            for caller in callers[fn]:
+                if caller not in queued:
+                    queued.add(caller)
+                    work.append(caller)
+    return summaries

@@ -1,62 +1,112 @@
-"""speclint driver: file discovery, pass orchestration, ``# noqa``
-filtering, the baseline ratchet, and output formatting.
+"""speclint driver: file discovery, pass orchestration, the
+incremental cache, ``# noqa`` filtering, the baseline ratchet, output
+formatting, and the autofixer entry point.
 
 Usage (one process, all passes)::
 
     python -m consensus_specs_tpu.tools.speclint [root]
-        [--passes style,uint64,tracing,ladder,specmd]
-        [--format text|github] [--baseline PATH]
-        [--write-baseline] [--no-baseline]
+        [--passes style,uint64,ranges,tracing,ladder,specmd,obs,
+                  state_layer,fallbacks,supervision,determinism,coverage]
+        [--format text|github|sarif] [--baseline PATH]
+        [--write-baseline] [--no-baseline] [--no-incremental]
+        [--fix] [--range-verdicts]
 
 Baseline ratchet: ``speclint_baseline.json`` (checked in at the repo
 root) records per ``path::CODE`` finding counts.  A run fails only when
 a count *grows* — pre-existing debt is visible but non-blocking, and
 new debt cannot land.  Shrink the debt, then ``make speclint-baseline``
-to ratchet the file down (a stale baseline is reported as a note).
+to ratchet the file down (a stale baseline is reported as a note);
+``make speclint-baseline PASSES=uint64,ranges`` re-ratchets only the
+named passes, leaving every other pass's recorded debt untouched.
+
+Incremental cache: findings are reused from ``.speclint_cache.json``
+keyed on source content hashes — file-granular passes per file sha,
+tree-granular passes (ladder, determinism, coverage) on a whole-tree
+fingerprint (see ``cache.py``).  A warm unchanged run re-parses
+nothing.
 """
 import argparse
 import ast
+import hashlib
 import json
 import os
 from collections import Counter
 
+from .cache import CACHE_NAME, AnalysisCache, tree_fingerprint
 from .findings import suppressed
 from .passes import ALL_PASSES
 
 SKIP_DIRS = {".git", ".jax_cache", "__pycache__", "build", ".pytest_cache",
              "consensus-spec-tests", "node_modules", ".claude"}
 BASELINE_NAME = "speclint_baseline.json"
+# non-python analysis inputs folded into the tree fingerprint (the
+# coverage pass reads both)
+EXTRA_INPUTS = (".github/workflows/run-tests.yml", "Makefile")
 
 
 class Context:
     """Shared per-run state handed to every pass: the scan root, the
-    discovered python files, and a parse cache (each file is read and
-    AST-parsed at most once across all passes)."""
+    discovered python/markdown files, a parse cache (each file is read
+    and AST-parsed at most once across all passes), content hashes for
+    the incremental cache, and the memoized project call graph."""
 
     def __init__(self, root):
         self.root = os.path.abspath(root)
+        self._raw = {}
         self._sources = {}
         self._trees = {}
-        self.py_files = self._discover()
+        self._shas = {}
+        self._graph = None
+        self._input_shas = None
+        # shared FunctionRanges store: the uint64 U101-discharge and
+        # the U9xx pass analyze the same functions in one run
+        self.ranges_memo = {}
+        self.py_files, self.md_files = self._discover()
 
     def _discover(self):
-        out = []
+        py, md = [], []
         for dirpath, dirnames, filenames in os.walk(self.root):
             dirnames[:] = sorted(d for d in dirnames if d not in SKIP_DIRS)
             for fn in sorted(filenames):
+                rel = os.path.relpath(os.path.join(dirpath, fn),
+                                      self.root).replace(os.sep, "/")
                 if fn.endswith(".py"):
-                    rel = os.path.relpath(os.path.join(dirpath, fn),
-                                          self.root).replace(os.sep, "/")
-                    out.append(rel)
-        return out
+                    py.append(rel)
+                elif fn.endswith(".md") and rel.startswith("specs/"):
+                    md.append(rel)
+        return py, md
+
+    def raw(self, rel: str) -> bytes:
+        data = self._raw.get(rel)
+        if data is None:
+            with open(os.path.join(self.root, rel), "rb") as f:
+                data = f.read()
+            self._raw[rel] = data
+        return data
 
     def source(self, rel: str) -> str:
         text = self._sources.get(rel)
         if text is None:
-            with open(os.path.join(self.root, rel), "rb") as f:
-                text = f.read().decode("utf-8", errors="replace")
+            text = self.raw(rel).decode("utf-8", errors="replace")
             self._sources[rel] = text
         return text
+
+    def sha(self, rel: str) -> str:
+        got = self._shas.get(rel)
+        if got is None:
+            got = hashlib.sha256(self.raw(rel)).hexdigest()
+            self._shas[rel] = got
+        return got
+
+    def input_shas(self):
+        """Every analysis input as (rel, sha) — the tree fingerprint
+        base."""
+        if self._input_shas is None:
+            rels = list(self.py_files) + list(self.md_files) \
+                + [r for r in EXTRA_INPUTS
+                   if os.path.isfile(os.path.join(self.root, r))]
+            self._input_shas = [(r, self.sha(r)) for r in rels]
+        return self._input_shas
 
     def _parse(self, rel):
         if rel not in self._trees:
@@ -76,28 +126,67 @@ class Context:
         t = self._parse(rel)
         return t if isinstance(t, SyntaxError) else None
 
+    def project_graph(self):
+        """The whole-program call graph, built once per run and shared
+        by every graph-consuming pass."""
+        if self._graph is None:
+            from .graph import ProjectGraph
+            self._graph = ProjectGraph(self)
+        return self._graph
 
-def run_passes(ctx, pass_names=None):
+
+def _pass_salt():
+    return ";".join(f"{m.NAME}={getattr(m, 'VERSION', 1)}"
+                    for m in ALL_PASSES)
+
+
+def _file_candidates(ctx, mod):
+    files = ctx.md_files if getattr(mod, "SCAN", "py") == "md" \
+        else ctx.py_files
+    scope = getattr(mod, "in_scope", None)
+    return files if scope is None else [r for r in files if scope(r)]
+
+
+def _run_one(ctx, mod, cache):
+    """One pass, through the cache when possible."""
+    if cache is None:
+        return mod.run(ctx)
+    if getattr(mod, "GRANULARITY", "tree") == "file" \
+            and hasattr(mod, "check_file"):
+        findings = []
+        for rel in _file_candidates(ctx, mod):
+            sha = ctx.sha(rel)
+            got = cache.get_file(rel, sha, mod.NAME)
+            if got is None:
+                got = mod.check_file(ctx, rel)
+                cache.put_file(rel, sha, mod.NAME, got)
+            findings.extend(got)
+        return findings
+    fingerprint = tree_fingerprint(
+        ctx.input_shas(), extra=(mod.NAME, getattr(mod, "VERSION", 1)))
+    got = cache.get_tree(mod.NAME, fingerprint)
+    if got is None:
+        got = mod.run(ctx)
+        cache.put_tree(mod.NAME, fingerprint, got)
+    return got
+
+
+def run_passes(ctx, pass_names=None, cache=None):
     """All findings from the selected passes, noqa-filtered and sorted."""
     findings = []
     for mod in ALL_PASSES:
         if pass_names is not None and mod.NAME not in pass_names:
             continue
-        findings.extend(mod.run(ctx))
+        findings.extend(_run_one(ctx, mod, cache))
     kept = []
     line_cache = {}     # one split per file across all its findings
     for f in findings:
         lines = line_cache.get(f.path)
         if lines is None:
-            if f.path.endswith(".py"):
+            path = os.path.join(ctx.root, f.path)
+            lines = []
+            if os.path.isfile(path):
                 lines = ctx.source(f.path).split("\n")
-            else:
-                path = os.path.join(ctx.root, f.path)
-                lines = []
-                if os.path.isfile(path):
-                    with open(path, "rb") as fh:
-                        lines = fh.read().decode("utf-8", errors="replace") \
-                            .split("\n")
             line_cache[f.path] = lines
         if not suppressed(f, lines):
             kept.append(f)
@@ -158,15 +247,35 @@ def apply_baseline(findings, baseline, code_prefixes=None):
     return new, baselined, stale
 
 
+def _range_verdicts(ctx):
+    from .passes import rangeproof
+    for rel in ctx.py_files:
+        if rangeproof.in_scope(rel):
+            for line in rangeproof.verdict_report(rel, ctx.source(rel)):
+                print(line)
+    return 0
+
+
+def _fix(ctx):
+    from . import fixer
+    changed = fixer.fix_tree(ctx)
+    for rel, counts in sorted(changed.items()):
+        what = ", ".join(f"{k} x{v}" for k, v in sorted(counts.items()))
+        print(f"fixed {rel}: {what}")
+    print(f"speclint --fix: {len(changed)} file(s) changed")
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="speclint", description="domain-aware static analysis: "
-        "uint64-hazard, jax-tracing, ladder-drift, spec-markdown, style")
+        "uint64-hazard + range proving, jax-tracing, ladder-drift, "
+        "spec-markdown, determinism, engine-coverage, style")
     parser.add_argument("root", nargs="?", default=".")
     parser.add_argument("--passes", default=None,
                         help="comma-separated subset of: "
                         + ",".join(m.NAME for m in ALL_PASSES))
-    parser.add_argument("--format", choices=("text", "github"),
+    parser.add_argument("--format", choices=("text", "github", "sarif"),
                         default="text")
     parser.add_argument("--baseline", default=None,
                         help=f"ratchet file (default <root>/{BASELINE_NAME})")
@@ -174,6 +283,15 @@ def main(argv=None):
                         help="record the current findings as the baseline")
     parser.add_argument("--no-baseline", action="store_true",
                         help="ignore the baseline: every finding fails")
+    parser.add_argument("--no-incremental", action="store_true",
+                        help="bypass the content-hash analysis cache")
+    parser.add_argument("--fix", action="store_true",
+                        help="apply the mechanical autofixes "
+                             "(dtype-less sums, noqa normalization, "
+                             "import hoists) and exit")
+    parser.add_argument("--range-verdicts", action="store_true",
+                        help="print the uint64 range prover's "
+                             "per-subtraction verdicts and exit")
     args = parser.parse_args(argv)
 
     ctx = Context(args.root)
@@ -183,6 +301,10 @@ def main(argv=None):
         print("note: root has no consensus_specs_tpu/ package — the "
               "uint64/ladder/specmd passes have nothing to scan here; "
               "run from the repo root for full coverage")
+    if args.fix:
+        return _fix(ctx)
+    if args.range_verdicts:
+        return _range_verdicts(ctx)
     pass_names = None if args.passes is None \
         else {p.strip() for p in args.passes.split(",") if p.strip()}
     if pass_names is not None:
@@ -190,7 +312,13 @@ def main(argv=None):
         unknown = pass_names - known
         if unknown:
             parser.error(f"unknown pass(es): {', '.join(sorted(unknown))}")
-    findings = run_passes(ctx, pass_names)
+    analysis_cache = None
+    if not args.no_incremental:
+        analysis_cache = AnalysisCache(
+            os.path.join(ctx.root, CACHE_NAME), _pass_salt())
+    findings = run_passes(ctx, pass_names, cache=analysis_cache)
+    if analysis_cache is not None:
+        analysis_cache.save()
 
     baseline_path = args.baseline or os.path.join(ctx.root, BASELINE_NAME)
     if args.write_baseline:
@@ -207,11 +335,17 @@ def main(argv=None):
         p for m in ALL_PASSES if m.NAME in pass_names
         for p in m.CODE_PREFIXES)
     new, baselined, stale = apply_baseline(findings, baseline, prefixes)
+    if args.format == "sarif":
+        from . import sarif
+        print(sarif.render(new, baselined))
+        return 1 if new else 0
     for f in new:
         print(f.render_github() if args.format == "github" else f.render())
     for key in stale:
         print(f"note: baseline is stale for {key} "
               f"(debt shrank; run `make speclint-baseline`)")
+    if analysis_cache is not None:
+        print(f"speclint: {analysis_cache.summary()}")
     if new:
         print(f"speclint: {len(new)} new finding(s) "
               f"({len(baselined)} baselined)")

@@ -1,0 +1,201 @@
+"""``speclint --fix``: mechanical, idempotent autofixes.
+
+Only rules whose repair is a *pure textual function of the finding*
+are fixable — nothing that requires judgment lands here:
+
+* **U103** — a bare ``.sum()`` (no args) in the scoped kernel files
+  grows an explicit accumulator: ``.sum(dtype=np.int64)`` when the
+  file imports ``numpy as np``, else ``.sum(dtype='int64')``.  Calls
+  that already pass any argument are left alone (choosing among
+  existing arguments is judgment, not mechanics).
+* **noqa normalization** — a recognized-but-noncanonical noqa
+  spelling in a REAL comment (tokenize-verified: docstrings and
+  string literals are never touched) is rewritten to the canonical
+  ``# noqa: U101, J203`` form — codes upper-cased, comma+space
+  separated, original order and any trailing justification text kept.
+  The suppression semantics are unchanged (the parser already
+  accepted these); grep-ability and the U903 pragma audit want one
+  spelling.  A noqa whose code list cannot be parsed is left alone.
+* **import hoist** — a function-level ``import x`` whose module is
+  ALREADY imported at module top level is deleted: the hoisted form
+  exists, the local copy is residue (the PR-3 ``hashlib``-hoist
+  precedent).  Imports that are *not* at top level are deliberately
+  NOT moved there — this codebase lazy-imports on purpose (jax must
+  not initialize at import time), so creating a new top-level import
+  is judgment, not mechanics.
+
+``tests/`` is excluded (fixture strings deliberately hold
+non-canonical spellings), as are generated ``AUTO-COMPILED`` modules
+(they are rebuilt by ``make pyspec``; fixing them is churn).
+
+Every fix is idempotent: running ``--fix`` on its own output is a
+no-op, and the fixture suite asserts it.
+"""
+import ast
+import io
+import re
+import tokenize
+
+from .astutil import is_generated
+from .passes.uint64 import SCOPED_PREFIXES as _U64_SCOPE
+
+_NOQA_ANY_RE = re.compile(
+    r"#\s*noqa(?P<sep>\s*:\s*)?", re.IGNORECASE)
+_CODE_TOKEN_RE = re.compile(r"[A-Za-z]{1,8}[0-9]{1,6}$")
+
+
+def _normalize_comment(comment):
+    """Canonical spelling of one comment's noqa, or None to leave it."""
+    m = _NOQA_ANY_RE.search(comment)
+    if m is None:
+        return None
+    rest = comment[m.end():]
+    codes = []
+    if m.group("sep") is not None:
+        while True:
+            m2 = re.match(r"\s*,?\s*([A-Za-z0-9]+)", rest)
+            if m2 is None or not _CODE_TOKEN_RE.match(m2.group(1)):
+                break
+            codes.append(m2.group(1).upper())
+            rest = rest[m2.end():]
+        if not codes:
+            # `# noqa: something-unparsable` — do not guess
+            return None
+    canonical = "# noqa" if not codes else "# noqa: " + ", ".join(codes)
+    new = comment[:m.start()] + canonical + rest
+    return new if new != comment else None
+
+
+def fix_noqa(text):
+    """Normalize noqa spellings in REAL comments (tokenize-located;
+    strings and docstrings are never touched)."""
+    try:
+        tokens = list(tokenize.generate_tokens(
+            io.StringIO(text).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return text, 0
+    lines = text.split("\n")
+    edits = 0
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        new = _normalize_comment(tok.string)
+        if new is None:
+            continue
+        row, col = tok.start[0] - 1, tok.start[1]
+        # a COMMENT token always runs to end of line
+        lines[row] = lines[row][:col] + new
+        edits += 1
+    return "\n".join(lines), edits
+
+
+def fix_u103(rel, text):
+    """``.sum()`` with no arguments -> explicit dtype accumulator, in
+    the uint64-pass scope only."""
+    if not rel.startswith(_U64_SCOPE):
+        return text, 0
+    try:
+        tree = ast.parse(text)
+    except SyntaxError:
+        return text, 0
+    has_np = any(
+        isinstance(n, ast.Import)
+        and any(a.name == "numpy" and a.asname == "np" for a in n.names)
+        for n in ast.walk(tree))
+    dtype = "dtype=np.int64" if has_np else "dtype='int64'"
+    lines = text.split("\n")
+    # collect insertion points (line, col of the closing paren), apply
+    # bottom-up so earlier offsets stay valid
+    points = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "sum" \
+                and not node.args and not node.keywords \
+                and node.end_lineno == node.lineno:
+            points.append((node.lineno, node.end_col_offset - 1))
+    applied = 0
+    for lineno, col in sorted(points, reverse=True):
+        ln = lines[lineno - 1]
+        if ln[col:col + 1] != ")":
+            continue
+        lines[lineno - 1] = ln[:col] + dtype + ln[col:]
+        applied += 1
+    return "\n".join(lines), applied
+
+
+def fix_import_hoist(rel, text):
+    """Delete function-level plain ``import x`` statements whose
+    module is already imported at module top level."""
+    try:
+        tree = ast.parse(text)
+    except SyntaxError:
+        return text, 0
+    top_imports = set()
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            top_imports.update(a.name for a in node.names
+                               if a.asname is None)
+    if not top_imports:
+        return text, 0
+    doomed = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        candidates = [
+            stmt for stmt in fn.body
+            if isinstance(stmt, ast.Import) and len(stmt.names) == 1
+            and stmt.names[0].asname is None
+            and stmt.names[0].name in top_imports
+            and stmt.lineno == stmt.end_lineno]
+        if len(candidates) == len(fn.body):
+            # deleting every statement would leave an unparsable empty
+            # body: keep the last candidate in place
+            candidates = candidates[:-1]
+        doomed.extend((stmt.lineno, stmt.names[0].name)
+                      for stmt in candidates)
+    if not doomed:
+        return text, 0
+    lines = text.split("\n")
+    applied = 0
+    for lineno, module in sorted(doomed, reverse=True):
+        if lines[lineno - 1].strip() == f"import {module}":
+            del lines[lineno - 1]
+            applied += 1
+    return "\n".join(lines), applied
+
+
+def fix_text(rel, text):
+    """All fixers over one file: ``(new_text, {fixer: edits})``."""
+    counts = {}
+    text, counts["u103"] = fix_u103(rel, text)
+    text, counts["import-hoist"] = fix_import_hoist(rel, text)
+    text, counts["noqa"] = fix_noqa(text)
+    return text, counts
+
+
+# tests/ deliberately embeds non-canonical noqa spellings and bare
+# sums inside fixture strings; AUTO-COMPILED modules are regenerated
+# by `make pyspec` (fixing them is churn, and the markdown is the
+# edit site anyway)
+_FIX_EXCLUDE = ("tests/",)
+
+
+def fix_tree(ctx):
+    """Apply every fixer across the tree; returns
+    ``{rel: {fixer: edits}}`` for files that changed (written in
+    place)."""
+    import os
+    changed = {}
+    for rel in ctx.py_files:
+        if rel.startswith(_FIX_EXCLUDE):
+            continue
+        text = ctx.source(rel)
+        if is_generated(text):
+            continue
+        new, counts = fix_text(rel, text)
+        if new != text:
+            with open(os.path.join(ctx.root, rel), "w") as f:
+                f.write(new)
+            changed[rel] = {k: v for k, v in counts.items() if v}
+    return changed

@@ -1,0 +1,464 @@
+"""Whole-program call graph shared by the speclint passes.
+
+PR 2's passes each carried a private slice of this: the tracing pass
+built a module-local root closure, the ladder pass resolved method
+surfaces over the AST inheritance chain, the supervision pass resolved
+``site = "..."`` bindings.  This module is the one shared model — a
+project-wide index of every function, class and import, with resolved
+call edges — so a pass that needs "what can this call reach" (the
+determinism pass), "which literal flows into this parameter" (the
+coverage pass), or "what is this class's method surface" (the ladder
+pass) asks the same graph instead of growing another private walker.
+
+Resolution is deliberately static and over-approximate:
+
+* ``name(...)`` resolves through module-local defs and import aliases
+  (both ``from pkg import mod`` module aliases and
+  ``from pkg.mod import fn`` symbol aliases, at any nesting depth —
+  the engines import lazily inside functions).
+* ``self.m(...)`` / ``cls.m(...)`` resolve over the enclosing class's
+  MRO (depth-first linearization of the AST base-class chain — the
+  fork ladder is single-inheritance plus mixins, where this matches
+  C3 on every class that exists in the tree).
+* ``super().m(...)`` resolves over the MRO *after* the enclosing
+  class, which is how the ``super().process_operations`` fork chains
+  actually dispatch.
+* ``spec.m(...)`` (the engine convention: the spec class object is
+  passed as a parameter named ``spec``) unions over every class
+  defining ``m`` — an over-approximation that errs toward marking
+  code reachable, the safe direction for a checker.
+* ``install_*`` wrappers: a ``cls.m = fn`` / ``setattr(cls, "m", fn)``
+  assignment anywhere registers ``fn`` as an *override* of method
+  ``m``; method-call resolution includes overrides, so code installed
+  from outside (``install_vectorized_epoch``, ``install_das_accel``,
+  ``install_forkchoice_accel``) is reachable from the spec surface
+  exactly as it is at runtime.
+
+Compiled fork modules carry their ``AUTO-COMPILED from specs/...``
+provenance header; :class:`ModuleInfo` parses it so passes can point a
+finding in generated code back at the markdown that owns it.
+"""
+import ast
+import re
+
+from .astutil import AUTO_COMPILED_MARK
+
+_PROVENANCE_RE = re.compile(
+    re.escape(AUTO_COMPILED_MARK).replace(r"specs/", r"(specs/[\w./-]+)"))
+
+
+def norm_args(a: ast.arguments):
+    """Normalized parameter-name tuple (``self``/``cls`` dropped) —
+    the ladder pass's signature identity."""
+    names = [arg.arg for arg in a.posonlyargs + a.args]
+    if names and names[0] in ("self", "cls"):
+        names = names[1:]
+    if a.vararg:
+        names.append("*" + a.vararg.arg)
+    names.extend(arg.arg for arg in a.kwonlyargs)
+    return tuple(names)
+
+
+class FunctionInfo:
+    """One function or method definition."""
+
+    __slots__ = ("rel", "node", "name", "cls_name", "qname", "params")
+
+    def __init__(self, rel, node, cls_name=None):
+        self.rel = rel
+        self.node = node
+        self.name = node.name
+        self.cls_name = cls_name
+        owner = f"{cls_name}." if cls_name else ""
+        self.qname = f"{rel}::{owner}{node.name}"
+        self.params = [a.arg for a in
+                       node.args.posonlyargs + node.args.args]
+
+    def __repr__(self):
+        return f"<fn {self.qname}>"
+
+
+class ClassInfo:
+    """One class definition: AST bases + its own method table."""
+
+    __slots__ = ("rel", "node", "name", "bases", "methods", "symbols")
+
+    def __init__(self, rel, node):
+        self.rel = rel
+        self.node = node
+        self.name = node.name
+        self.bases = [b.attr if isinstance(b, ast.Attribute) else b.id
+                      for b in node.bases
+                      if isinstance(b, (ast.Attribute, ast.Name))]
+        self.methods = {}   # name -> FunctionInfo (own body only)
+        self.symbols = {}   # public callable class-body binding -> lineno
+        for m in node.body:
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[m.name] = FunctionInfo(rel, m, node.name)
+                if not m.name.startswith("_"):
+                    self.symbols[m.name] = m.lineno
+            elif isinstance(m, ast.Assign) and _callable_value(m.value):
+                for t in m.targets:
+                    if isinstance(t, ast.Name) and not t.id.startswith("_"):
+                        self.symbols[t.id] = m.lineno
+
+
+def _callable_value(node) -> bool:
+    if isinstance(node, ast.Lambda):
+        return True
+    return isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+        and node.func.id in ("staticmethod", "classmethod", "property")
+
+
+class ModuleInfo:
+    """Per-module index: functions, classes, import aliases, string
+    constants, and the compiled-module provenance (if any)."""
+
+    __slots__ = ("rel", "tree", "dotted", "funcs", "classes", "aliases",
+                 "str_consts", "provenance")
+
+    def __init__(self, rel, text, tree):
+        self.rel = rel
+        self.tree = tree
+        self.dotted = rel[:-3].replace("/", ".")
+        m = _PROVENANCE_RE.search(text[:400])
+        self.provenance = m.group(1) if m else None
+        self.funcs = {}
+        self.classes = {}
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.funcs[node.name] = FunctionInfo(rel, node)
+            elif isinstance(node, ast.ClassDef):
+                self.classes[node.name] = ClassInfo(rel, node)
+        # module-level string constants: the engines name their sites
+        # (SITE_VERIFY = "das.verify") and pass the constant around
+        self.str_consts = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                self.str_consts[node.targets[0].id] = node.value.value
+        # import aliases at ANY depth (lazy function-level imports)
+        self.aliases = {}   # local name -> ("module", dotted) |
+        #                                  ("symbol", dotted, orig)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    self.aliases[local] = ("module",
+                                           alias.asname and alias.name
+                                           or alias.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                base = self._resolve_from(node)
+                if base is None:
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    self.aliases[local] = ("from", base, alias.name)
+
+    def _resolve_from(self, node):
+        """Absolute dotted base of a ``from X import ...`` (relative
+        imports resolved against this module's package)."""
+        if node.level == 0:
+            return node.module
+        pkg_parts = self.dotted.split(".")[:-1]
+        up = node.level - 1
+        if up:
+            pkg_parts = pkg_parts[:-up] if up <= len(pkg_parts) else []
+        return ".".join(pkg_parts + ([node.module] if node.module else []))
+
+
+class ProjectGraph:
+    """Project-wide function/class index with resolved call edges."""
+
+    def __init__(self, ctx, prefixes=("consensus_specs_tpu/",),
+                 exclude=("consensus_specs_tpu/tools/",)):
+        self.modules = {}        # rel -> ModuleInfo
+        self.by_dotted = {}      # dotted -> ModuleInfo
+        self.classes = {}        # class name -> ClassInfo (first wins)
+        self.overrides = {}      # method name -> set(FunctionInfo)
+        self.functions = []      # every FunctionInfo (incl. nested)
+        self._parents = {}       # nested FunctionInfo -> enclosing
+        self._fn_of_node = {}    # id(ast node) -> FunctionInfo
+        self._callee_cache = {}
+        for rel in ctx.py_files:
+            if not rel.startswith(tuple(prefixes)) \
+                    or rel.startswith(tuple(exclude)):
+                continue
+            tree = ctx.tree(rel)
+            if tree is None:
+                continue
+            mod = ModuleInfo(rel, ctx.source(rel), tree)
+            self.modules[rel] = mod
+            self.by_dotted[mod.dotted] = mod
+        for mod in self.modules.values():
+            self.classes.update(
+                {n: c for n, c in mod.classes.items()
+                 if n not in self.classes})
+        for mod in self.modules.values():
+            self._index_functions(mod)
+        for fn in self.functions:
+            self._collect_overrides(fn)
+
+    # -- indexing -----------------------------------------------------------
+
+    def _index_functions(self, mod):
+        def visit(node, cls_name, enclosing):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    info = self._fn_of_node.get(id(child))
+                    if info is None:
+                        if enclosing is None and cls_name is None:
+                            info = mod.funcs.get(child.name)
+                        elif enclosing is None and cls_name is not None:
+                            cls = mod.classes.get(cls_name)
+                            info = cls and cls.methods.get(child.name)
+                        if info is None or info.node is not child:
+                            info = FunctionInfo(mod.rel, child, cls_name)
+                        self._fn_of_node[id(child)] = info
+                    self.functions.append(info)
+                    if enclosing is not None:
+                        self._parents[info] = enclosing
+                    visit(child, cls_name, info)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, child.name, None)
+                else:
+                    visit(child, cls_name, enclosing)
+        visit(mod.tree, None, None)
+
+    def _collect_overrides(self, fn):
+        """``cls.m = wrapper`` / ``setattr(cls, "m", wrapper)`` inside
+        any function registers ``wrapper`` as an override target of
+        method ``m`` — the install-from-outside wiring."""
+        mod = self.modules[fn.rel]
+        for node in ast.walk(fn.node):
+            name = val = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Attribute):
+                name, val = node.targets[0].attr, node.value
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id == "setattr" \
+                    and len(node.args) == 3 \
+                    and isinstance(node.args[1], ast.Constant) \
+                    and isinstance(node.args[1].value, str):
+                name, val = node.args[1].value, node.args[2]
+            if name is None:
+                continue
+            target = self._value_function(mod, fn, val)
+            if target is not None:
+                self.overrides.setdefault(name, set()).add(target)
+
+    def _value_function(self, mod, fn, val):
+        """The FunctionInfo a simple value expression denotes, if any
+        (a local nested def, a module function, or an imported one)."""
+        if isinstance(val, ast.Name):
+            for cand in self.functions:
+                if cand.rel == fn.rel and cand.name == val.id \
+                        and self._parents.get(cand) is fn:
+                    return cand
+            if val.id in mod.funcs:
+                return mod.funcs[val.id]
+            return self._resolve_alias_symbol(mod, val.id)
+        if isinstance(val, ast.Call):
+            # functools.partial(wrapper, ...) / wraps(...)(wrapper)
+            for sub in ast.walk(val):
+                if isinstance(sub, ast.Name) and sub.id in mod.funcs:
+                    return mod.funcs[sub.id]
+        return None
+
+    def _resolve_alias_symbol(self, mod, local):
+        entry = mod.aliases.get(local)
+        if entry is None or entry[0] != "from":
+            return None
+        _, base, orig = entry
+        target_mod = self.by_dotted.get(f"{base}.{orig}")
+        if target_mod is not None:
+            return None      # module alias, not a symbol
+        src = self.by_dotted.get(base)
+        if src is not None:
+            return src.funcs.get(orig)
+        return None
+
+    # -- MRO + method resolution -------------------------------------------
+
+    def mro(self, class_name):
+        """Depth-first base-chain linearization (dedup, definition
+        order) — matches C3 on the fork ladder's shapes."""
+        out, seen = [], set()
+
+        def visit(name):
+            cls = self.classes.get(name)
+            if cls is None or name in seen:
+                return
+            seen.add(name)
+            out.append(cls)
+            for base in cls.bases:
+                visit(base)
+        visit(class_name)
+        return out
+
+    def resolve_method(self, class_name, method, after=False):
+        """The defining FunctionInfo for ``class_name.method`` over the
+        MRO; ``after=True`` starts past the class itself (``super()``
+        dispatch)."""
+        chain = self.mro(class_name)
+        if after:
+            chain = chain[1:]
+        for cls in chain:
+            if method in cls.methods:
+                return cls.methods[method]
+        return None
+
+    def surface(self, class_name):
+        """Resolved public symbol surface of a class:
+        name -> (normalized-signature-or-None, rel, lineno).  The
+        ladder pass's drift comparison runs over this."""
+        out = {}
+        for cls in reversed(self.mro(class_name)):
+            for name, lineno in cls.symbols.items():
+                m = cls.methods.get(name)
+                sig = norm_args(m.node.args) if m is not None else None
+                out[name] = (sig, cls.rel, lineno)
+        return out
+
+    # -- call edges ---------------------------------------------------------
+
+    def callees(self, fn):
+        """Resolved outgoing edges of ``fn`` (cached)."""
+        cached = self._callee_cache.get(fn)
+        if cached is not None:
+            return cached
+        mod = self.modules[fn.rel]
+        out = set()
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            out.update(self._resolve_call(mod, fn, node))
+        # lexical nesting: a def inside fn runs in its dynamic extent
+        for child, parent in self._parents.items():
+            if parent is fn:
+                out.add(child)
+        self._callee_cache[fn] = out
+        return out
+
+    def resolve_call(self, fn, call):
+        """Resolved targets of ONE call expression inside ``fn``."""
+        return self._resolve_call(self.modules[fn.rel], fn, call)
+
+    def _resolve_call(self, mod, fn, call):
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in mod.funcs:
+                return {mod.funcs[f.id]}
+            sym = self._resolve_alias_symbol(mod, f.id)
+            if sym is not None:
+                return {sym}
+            # local nested def
+            for cand, parent in self._parents.items():
+                if parent is fn and cand.name == f.id:
+                    return {cand}
+            return set()
+        if not isinstance(f, ast.Attribute):
+            return set()
+        base, meth = f.value, f.attr
+        # super().m(...)
+        if isinstance(base, ast.Call) and isinstance(base.func, ast.Name) \
+                and base.func.id == "super" and fn.cls_name:
+            target = self.resolve_method(fn.cls_name, meth, after=True)
+            return {target} if target else set()
+        if isinstance(base, ast.Name):
+            if base.id in ("self", "cls") and fn.cls_name:
+                out = set(self.overrides.get(meth, ()))
+                target = self.resolve_method(fn.cls_name, meth)
+                if target is not None:
+                    out.add(target)
+                return out
+            if base.id == "spec":
+                # engine convention: the spec class rides a parameter
+                # named `spec`; union over every class defining `meth`
+                out = set(self.overrides.get(meth, ()))
+                for cls in self.classes.values():
+                    if meth in cls.methods:
+                        out.add(cls.methods[meth])
+                return out
+            entry = mod.aliases.get(base.id)
+            if entry is not None:
+                target_mod = None
+                if entry[0] == "from":
+                    target_mod = self.by_dotted.get(
+                        f"{entry[1]}.{entry[2]}")
+                elif entry[0] == "module":
+                    target_mod = self.by_dotted.get(entry[1])
+                if target_mod is not None and meth in target_mod.funcs:
+                    return {target_mod.funcs[meth]}
+        return set()
+
+    def callers_index(self, functions=None):
+        """Inverted edge map over ``functions`` (default: all)."""
+        fns = functions if functions is not None else self.functions
+        callers = {fn: set() for fn in fns}
+        for fn in fns:
+            for callee in self.callees(fn):
+                if callee in callers:
+                    callers[callee].add(fn)
+        return callers
+
+    def reachable(self, roots):
+        """Transitive closure over resolved call edges."""
+        seen = set()
+        stack = [r for r in roots if r is not None]
+        while stack:
+            fn = stack.pop()
+            if fn in seen:
+                continue
+            seen.add(fn)
+            stack.extend(self.callees(fn) - seen)
+        return seen
+
+
+class ModuleGraph:
+    """Module-local closure helper (the tracing pass's historical
+    surface, now backed by the shared index): name->def map, lexical
+    parents, and a transitive closure from caller-supplied roots."""
+
+    def __init__(self, tree):
+        self.funcs = {}          # name -> node (innermost wins is fine)
+        self.parents = {}        # nested def -> enclosing def
+        self._collect(tree, None)
+
+    def _collect(self, node, enclosing):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.funcs[child.name] = child
+                if enclosing is not None:
+                    self.parents[child] = enclosing
+                self._collect(child, child)
+            else:
+                self._collect(child, enclosing)
+
+    def closure(self, roots):
+        """Roots plus everything reachable through module-local calls
+        and lexical nesting."""
+        traced = set(roots)
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(traced):
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call) \
+                            and isinstance(node.func, ast.Name) \
+                            and node.func.id in self.funcs:
+                        callee = self.funcs[node.func.id]
+                        if callee not in traced:
+                            traced.add(callee)
+                            changed = True
+            for child, parent in self.parents.items():
+                if parent in traced and child not in traced:
+                    traced.add(child)
+                    changed = True
+        return traced

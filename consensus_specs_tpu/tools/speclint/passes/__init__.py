@@ -1,7 +1,12 @@
-"""speclint passes.  Each module exposes ``NAME`` and ``run(ctx)``."""
+"""speclint passes.  Each module exposes ``NAME``, ``CODE_PREFIXES``
+and ``run(ctx)``; file-granular passes additionally expose
+``GRANULARITY = "file"`` + ``check_file(ctx, rel)`` (and optionally
+``in_scope(rel)`` / ``SCAN = "md"``) so the driver can serve them from
+the incremental cache; tree-granular passes are cached on the
+whole-tree fingerprint."""
 from . import (  # noqa: F401
-    fallbacks, supervision, uint64, tracing, ladder, obs, specmd,
-    state_layer, style)
+    coverage, determinism, fallbacks, rangeproof, supervision, uint64,
+    tracing, ladder, obs, specmd, state_layer, style)
 
-ALL_PASSES = (style, uint64, tracing, ladder, specmd, obs, state_layer,
-              fallbacks, supervision)
+ALL_PASSES = (style, uint64, rangeproof, tracing, ladder, specmd, obs,
+              state_layer, fallbacks, supervision, determinism, coverage)

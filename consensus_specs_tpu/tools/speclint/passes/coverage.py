@@ -1,0 +1,360 @@
+"""engine-coverage pass (C11xx): every ``faults.SITES`` entry must
+carry the FULL harness contract, proven statically across artifacts.
+
+PR 8/9/10 established the per-engine contract by convention: a
+spec-shaped fallback handler, a counted fallback, a supervisor gate, a
+differential harness leg, and a ``CS_TPU_*=0`` CI off-leg.  Until now
+adding an engine with a missing leg relied on reviewer memory.  This
+pass reads ``consensus_specs_tpu/faults.py`` (the ``SITES`` tuple and
+the ``SITE_SWITCHES`` family map), resolves where every site is
+actually dispatched — *interprocedurally*: the epoch wrappers pass
+their site literal through the shared ``_supervised`` helper, so
+literal flow is solved as a worklist dataflow over the project call
+graph (``speclint/dataflow.py``) — and then checks each site against
+the python sources, the test tree, ``.github/workflows/run-tests.yml``
+and the ``Makefile``:
+
+* C1100 — the contract *inputs* are broken: ``SITES`` /
+  ``SITE_SWITCHES`` missing or unparsable, or a site with no switch
+  family.
+* C1101 — no dispatch: nothing calls ``faults.check(site)``.
+* C1102 — no counted fallback: no ``count_fallback(..., site=site)``.
+* C1103 — no supervisor gate: no ``supervisor.admit(site)``.
+* C1104 — no spec-shaped degradation path: no function on the site's
+  dispatch flow catches a fallback-class exception
+  (``InjectedFault`` / ``_Fallback`` / ``DeadlineExceeded``).
+* C1105 — no differential reference: the site literal appears nowhere
+  under ``tests/`` or the sim harness
+  (``consensus_specs_tpu/sim/`` — its per-site legs are the
+  differential suite, exercised by ``tests/test_sim.py``).
+* C1106 — no CI off-leg: the site family's ``CS_TPU_*`` switch is
+  never forced to ``0`` in the workflow or the Makefile.
+* C1107 — the reverse direction: an engine dispatches a site literal
+  that is NOT registered in ``faults.SITES`` (an engine landed without
+  registering with the harness vocabulary).
+
+Baseline: zero findings — ``make lint`` fails the moment an engine
+family lands without its full harness coverage.  Site-missing findings
+anchor at the site's line in the ``SITES`` tuple, so the fix site is
+one click away.
+"""
+import ast
+import re
+
+from ..dataflow import solve
+from ..findings import Finding
+
+NAME = "coverage"
+CODE_PREFIXES = ("C",)
+VERSION = 1
+GRANULARITY = "tree"
+
+FAULTS_REL = "consensus_specs_tpu/faults.py"
+WORKFLOW_REL = ".github/workflows/run-tests.yml"
+MAKEFILE_REL = "Makefile"
+TESTREF_PREFIXES = ("tests/", "consensus_specs_tpu/sim/")
+ENGINE_PREFIXES = (
+    "consensus_specs_tpu/ops/",
+    "consensus_specs_tpu/forkchoice/",
+    "consensus_specs_tpu/state/",
+    "consensus_specs_tpu/das/",
+    "consensus_specs_tpu/utils/",
+)
+
+_FALLBACK_CLASSES = {"InjectedFault", "_Fallback", "DeadlineExceeded"}
+_LEGS = (
+    ("check", "C1101", "is never dispatched: no faults.check({site!r}) "
+     "in the engine sources"),
+    ("count", "C1102", "has no counted fallback: no "
+     "count_fallback(..., site={site!r}) — a trip there would be a "
+     "silent fallback"),
+    ("admit", "C1103", "has no supervisor gate: no "
+     "supervisor.admit({site!r}) — the site has no circuit breaker"),
+    ("handler", "C1104", "has no spec-shaped degradation path: no "
+     "function on its dispatch flow catches a fallback-class "
+     "exception"),
+    ("testref", "C1105", "has no differential reference: the literal "
+     "appears nowhere under tests/ or the sim harness"),
+    ("offleg", "C1106", "has no CI off-leg: {switch}=0 appears in "
+     "neither the workflow nor the Makefile"),
+)
+
+
+def _read(ctx, rel):
+    try:
+        return ctx.source(rel)
+    except OSError:
+        return None
+
+
+def parse_faults(text):
+    """``(sites [(name, lineno)], switches {prefix: env}, errors)``
+    from the faults module source."""
+    sites, switches, errors = [], {}, []
+    try:
+        tree = ast.parse(text)
+    except SyntaxError:
+        return sites, switches, ["faults.py does not parse"]
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1 \
+                or not isinstance(node.targets[0], ast.Name):
+            continue
+        name = node.targets[0].id
+        if name == "SITES":
+            if isinstance(node.value, (ast.Tuple, ast.List)) and all(
+                    isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                    for e in node.value.elts):
+                sites = [(e.value, e.lineno) for e in node.value.elts]
+            else:
+                errors.append("SITES is not a tuple of string literals")
+        elif name == "SITE_SWITCHES":
+            if isinstance(node.value, ast.Dict) and all(
+                    isinstance(k, ast.Constant) and isinstance(v,
+                                                               ast.Constant)
+                    for k, v in zip(node.value.keys, node.value.values)):
+                switches = {k.value: v.value for k, v in
+                            zip(node.value.keys, node.value.values)}
+            else:
+                errors.append(
+                    "SITE_SWITCHES is not a literal str->str dict")
+    if not sites:
+        errors.append("no SITES tuple found")
+    if not switches:
+        errors.append("no SITE_SWITCHES map found")
+    return sites, switches, errors
+
+
+# ---------------------------------------------------------------------------
+# Per-function fact extraction (the dataflow transfer's local half)
+# ---------------------------------------------------------------------------
+
+def _token(arg, bindings, params):
+    """A site argument as ('lit', s) / ('param', name) / None."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return ("lit", arg.value)
+    if isinstance(arg, ast.Name):
+        bound = bindings.get(arg.id)
+        if bound is not None:
+            return ("lit", bound)
+        if arg.id in params:
+            return ("param", arg.id)
+    return None
+
+
+def _bindings(fn_node, str_consts):
+    """Literal string bindings visible in the function: module-level
+    string constants, simple local ``name = "lit"`` assignments, and
+    name-to-name copies of either (``site = SITE_VERIFY``); a
+    non-resolvable rebind poisons the name."""
+    out = dict(str_consts)
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                out[name] = node.value.value
+            elif isinstance(node.value, ast.Name) \
+                    and node.value.id in out:
+                out[name] = out[node.value.id]
+            else:
+                out.pop(name, None)
+    return out
+
+
+def _has_fallback_handler(fn_node) -> bool:
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.ExceptHandler) and node.type is not None:
+            names = {n.id for n in ast.walk(node.type)
+                     if isinstance(n, ast.Name)} \
+                | {n.attr for n in ast.walk(node.type)
+                   if isinstance(n, ast.Attribute)}
+            if names & _FALLBACK_CLASSES:
+                return True
+    return False
+
+
+class _FnFacts:
+    """Precomputed local facts of one function, reused every transfer
+    round: own API applications and outgoing site-argument bindings."""
+
+    __slots__ = ("own", "calls", "handler", "origins")
+
+    def __init__(self, graph, fn):
+        mod = graph.modules[fn.rel]
+        bindings = _bindings(fn.node, mod.str_consts)
+        params = set(fn.params)
+        self.own = set()           # (api, token)
+        self.origins = {}          # (api, lit) -> (rel, lineno)
+        self.handler = _has_fallback_handler(fn.node)
+        self.calls = []            # (callee FunctionInfo, {param: token})
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            tail = f.attr if isinstance(f, ast.Attribute) else \
+                f.id if isinstance(f, ast.Name) else None
+            owner = f.value.id if isinstance(f, ast.Attribute) \
+                and isinstance(f.value, ast.Name) else None
+            # only the real harness APIs: faults.check / supervisor.admit
+            # (underscore-aliased imports included) — an unrelated
+            # .check()/.admit() method must not read as a dispatch
+            if tail == "check" and node.args \
+                    and owner in ("faults", "_faults"):
+                self._apply("check", node, node.args[0], bindings, params)
+            elif tail == "admit" and node.args \
+                    and owner in ("supervisor", "_supervisor"):
+                self._apply("admit", node, node.args[0], bindings, params)
+            elif tail == "count_fallback":
+                site_arg = None
+                for kw in node.keywords:
+                    if kw.arg == "site":
+                        site_arg = kw.value
+                if site_arg is None and len(node.args) >= 4:
+                    site_arg = node.args[3]
+                if site_arg is not None:
+                    self._apply("count", node, site_arg, bindings, params)
+            for callee in graph.resolve_call(fn, node):
+                argmap = {}
+                for i, arg in enumerate(node.args):
+                    if i < len(callee.params):
+                        tok = _token(arg, bindings, params)
+                        if tok is not None:
+                            argmap[callee.params[i]] = tok
+                for kw in node.keywords:
+                    if kw.arg is not None:
+                        tok = _token(kw.value, bindings, params)
+                        if tok is not None:
+                            argmap[kw.arg] = tok
+                # record even with an empty argmap: literal facts in
+                # the callee flow to callers regardless of arguments
+                self.calls.append((callee, argmap))
+
+    def _apply(self, api, call, arg, bindings, params):
+        tok = _token(arg, bindings, params)
+        if tok is None:
+            return
+        self.own.add((api, tok))
+
+
+def solve_site_facts(graph):
+    """Fixed-point ``({site: set(apis)}, origins {(api, site): (rel,
+    lineno)})`` over the engine call graph."""
+    fns = [fn for fn in graph.functions
+           if fn.rel.startswith(ENGINE_PREFIXES)]
+    facts = {}
+    for fn in fns:
+        facts[fn] = _FnFacts(graph, fn)
+    fn_set = set(fns)
+
+    def callees_of(fn):
+        return {callee for callee, _ in facts[fn].calls
+                if callee in fn_set}
+
+    def transfer(fn, get):
+        local = facts[fn]
+        out = set(local.own)
+        for callee, argmap in local.calls:
+            summary = get(callee) if callee in fn_set else None
+            if not summary:
+                continue
+            for api, tok in summary:
+                if tok[0] == "param":
+                    if tok[1] in argmap:
+                        out.add((api, argmap[tok[1]]))
+                else:
+                    # literal facts flow up too: a handler in the
+                    # CALLER of a literal-dispatching helper (try/
+                    # except around `_dispatch()` where _dispatch
+                    # checks the site inline) must still credit the
+                    # site's degradation leg
+                    out.add((api, tok))
+        if local.handler:
+            out |= {("handler", tok) for api, tok in out
+                    if api == "check"}
+        return frozenset(out)
+
+    summaries = solve(fns, callees_of, transfer)
+    sites = {}
+    origins = {}
+    for fn, summary in summaries.items():
+        for api, tok in summary:
+            if tok[0] != "lit":
+                continue
+            sites.setdefault(tok[1], set()).add(api)
+            origins.setdefault((api, tok[1]),
+                               (fn.rel, fn.node.lineno))
+    return sites, origins
+
+
+# ---------------------------------------------------------------------------
+# Cross-artifact legs
+# ---------------------------------------------------------------------------
+
+def _offleg_present(switch, *texts) -> bool:
+    pat = re.compile(rf"{re.escape(switch)}\s*[=:]\s*\"?'?0\b")
+    return any(t is not None and pat.search(t) for t in texts)
+
+
+def _testref_present(ctx, site) -> bool:
+    for rel in ctx.py_files:
+        if rel.startswith(TESTREF_PREFIXES) and site in ctx.source(rel):
+            return True
+    return False
+
+
+def check_tree(root):
+    from ..driver import Context
+    return run(Context(root))
+
+
+def run(ctx):
+    faults_text = _read(ctx, FAULTS_REL)
+    if faults_text is None:
+        return []    # no harness vocabulary in this tree: nothing to prove
+    sites, switches, errors = parse_faults(faults_text)
+    findings = [Finding(FAULTS_REL, 1, "C1100", e) for e in errors]
+    if not sites or not switches:
+        return findings
+
+    site_facts, origins = solve_site_facts(ctx.project_graph())
+    workflow = _read(ctx, WORKFLOW_REL)
+    makefile = _read(ctx, MAKEFILE_REL)
+
+    for site, lineno in sites:
+        switch = next((env for prefix, env in switches.items()
+                       if site.startswith(prefix)), None)
+        if switch is None:
+            findings.append(Finding(
+                FAULTS_REL, lineno, "C1100",
+                f"site {site!r} matches no SITE_SWITCHES family — the "
+                "coverage contract cannot locate its CI off-leg"))
+        apis = site_facts.get(site, set())
+        legs = {
+            "check": "check" in apis,
+            "count": "count" in apis,
+            "admit": "admit" in apis,
+            "handler": "handler" in apis,
+            "testref": _testref_present(ctx, site),
+            "offleg": switch is not None
+            and _offleg_present(switch, workflow, makefile),
+        }
+        for leg, code, template in _LEGS:
+            if leg == "offleg" and switch is None:
+                continue      # already a C1100
+            if not legs[leg]:
+                findings.append(Finding(
+                    FAULTS_REL, lineno, code,
+                    f"engine site {site!r} "
+                    + template.format(site=site, switch=switch)))
+
+    registered = {s for s, _ in sites}
+    for (api, site), (rel, lineno) in sorted(origins.items()):
+        if api == "check" and site not in registered:
+            findings.append(Finding(
+                rel, lineno, "C1107",
+                f"engine dispatches site {site!r} which is not "
+                "registered in faults.SITES — the harness, supervisor "
+                "and coverage contract cannot see it"))
+    return findings

@@ -1,0 +1,312 @@
+"""determinism pass (D10xx): nondeterminism reachable from consensus
+paths.
+
+The north-star invariant is *byte-identical roots* across engines,
+replays and hosts; the RLC batch verifier additionally requires
+*reproducible Fiat-Shamir scalars*.  Both die quietly if anything on a
+consensus path consults ambient process state.  This pass walks the
+whole-program call graph (``speclint/graph.py``) from the consensus
+roots — every public method of the hand fork ladder plus every
+``install_*``-registered engine override — and checks each reachable
+function:
+
+* D1001 — unordered set iteration whose *order escapes*: ``list()`` /
+  ``tuple()`` / ``fromiter()`` / ``enumerate()`` over a provably
+  set-valued expression, or a ``for`` loop over one whose body appends,
+  extends, yields or hashes (order-insensitive reductions — sums,
+  min/max, scatter-adds — are exempt, which is why the spec's
+  ``get_attesting_balance``-style set folds stay clean).  Wrap the set
+  in ``sorted(...)`` like the spec does.
+* D1002 — float arithmetic: a float literal or true division (``/``)
+  on a consensus path.  Consensus math is integer-only; float rounding
+  is host/backend-dependent.
+* D1003 — ambient-state read: ``time.*`` / ``random.*`` /
+  ``np.random.*`` / ``secrets.*`` / ``uuid.*`` calls, or a raw
+  ``os.environ`` / ``os.getenv`` read outside ``utils/env_flags.py``.
+  Engine switches and knobs go through ``env_flags.switch()`` /
+  ``env_flags.knob()`` so every environment dependency is declared in
+  one audited place.
+* D1004 — an ``id()``-keyed structure (``d[id(x)]`` /
+  ``d.get(id(x))`` / ``{id(x): ...}``): ``id()`` is an address — it
+  can alias after garbage collection and never survives a process
+  boundary, so an ``id()``-keyed cache is a stale-aliasing bug waiting
+  for a collection cycle.
+* D1005 — the *builtin* ``hash()`` on a consensus path: str/bytes
+  hashing is salted per process (PYTHONHASHSEED).  Modules that import
+  the spec's sha256 ``hash`` helper shadow the builtin and are exempt.
+
+Findings are reported only for the engine-result packages (``ops/``,
+``forkchoice/``, ``state/``, ``das/``, ``utils/``, the hand ``forks/``)
+— the telemetry, supervision and harness layers may read clocks by
+design, and ``forks/compiled/`` mirrors the hand ladder (whose finding
+is the fix site; a compiled-module finding would double-report and
+flap with ``make pyspec``).  Each finding names the consensus root it
+is reachable from, and findings in provenance-carrying modules point
+back at the owning markdown.  Intentional exceptions carry
+``# noqa: D100x`` with the invariant that makes them deterministic.
+"""
+import ast
+
+from ..findings import Finding
+from ..graph import ProjectGraph
+
+NAME = "determinism"
+CODE_PREFIXES = ("D",)
+VERSION = 1
+GRANULARITY = "tree"
+
+# findings are reported only here: the packages whose functions produce
+# consensus-visible results
+REPORT_PREFIXES = (
+    "consensus_specs_tpu/ops/",
+    "consensus_specs_tpu/forkchoice/",
+    "consensus_specs_tpu/state/",
+    "consensus_specs_tpu/das/",
+    "consensus_specs_tpu/utils/",
+    "consensus_specs_tpu/forks/",
+)
+REPORT_EXCLUDE = (
+    "consensus_specs_tpu/forks/compiled/",   # mirrors the hand ladder
+    "consensus_specs_tpu/utils/env_flags.py",   # the sanctioned reader
+    "consensus_specs_tpu/utils/jax_env.py",     # process setup, pre-spec
+)
+
+_AMBIENT_MODULES = {"time", "random", "secrets", "uuid"}
+_SET_CTORS = {"set", "frozenset"}
+_SET_METHODS = {"union", "intersection", "difference",
+                "symmetric_difference"}
+_ORDER_SINKS = {"list", "tuple", "fromiter", "enumerate", "iter"}
+_ORDER_SENSITIVE_METHODS = {"append", "extend", "add_", "write"}
+
+
+def _in_report_scope(rel: str) -> bool:
+    return rel.startswith(REPORT_PREFIXES) \
+        and not rel.startswith(REPORT_EXCLUDE)
+
+
+def _call_tail(node):
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _call_root(node):
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        return f.value.id
+    return None
+
+
+class _SetTracker:
+    """Module-independent local reasoning: which names/expressions are
+    provably unordered sets inside one function."""
+
+    def __init__(self, fn_node):
+        self.set_names = set()
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and self.is_set_expr(node.value):
+                self.set_names.add(node.targets[0].id)
+
+    def is_set_expr(self, node) -> bool:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Name):
+            return node.id in self.set_names
+        if isinstance(node, ast.Call):
+            tail = _call_tail(node)
+            if tail in _SET_CTORS:
+                return True
+            if tail in _SET_METHODS and isinstance(node.func,
+                                                   ast.Attribute):
+                return self.is_set_expr(node.func.value)
+        if isinstance(node, ast.BinOp) \
+                and isinstance(node.op, (ast.BitOr, ast.BitAnd,
+                                         ast.Sub)):
+            return self.is_set_expr(node.left) \
+                and self.is_set_expr(node.right)
+        return False
+
+
+def _order_sensitive_body(loop) -> bool:
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Call):
+            tail = _call_tail(node)
+            if tail in _ORDER_SENSITIVE_METHODS or tail == "hash":
+                return True
+        elif isinstance(node, (ast.Yield, ast.YieldFrom)):
+            return True
+        elif isinstance(node, ast.AugAssign) \
+                and isinstance(node.op, ast.Add) \
+                and isinstance(node.value, (ast.List, ast.ListComp)):
+            return True
+    return False
+
+
+def _module_shadows_hash(tree) -> bool:
+    """True when the module imports or defines its own ``hash`` (the
+    spec's sha256 helper) — the builtin is shadowed there."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if any((a.asname or a.name) == "hash" for a in node.names):
+                return True
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == "hash":
+            return True
+    return False
+
+
+def _check_function(rel, fn_node, hash_shadowed, root_name, findings):
+    tracker = _SetTracker(fn_node)
+    suffix = f" [reachable from {root_name}]"
+    for node in ast.walk(fn_node):
+        if isinstance(node, (ast.Subscript, ast.Dict, ast.Call)) \
+                and _id_keyed(node):
+            findings.append(Finding(
+                rel, node.lineno, "D1004",
+                "id()-keyed structure: an address can alias after "
+                "garbage collection and never survives a process "
+                f"boundary — key on content{suffix}"))
+        if isinstance(node, ast.Call):
+            tail = _call_tail(node)
+            root = _call_root(node)
+            if tail in _ORDER_SINKS and node.args \
+                    and tracker.is_set_expr(node.args[0]):
+                findings.append(Finding(
+                    rel, node.lineno, "D1001",
+                    f"{tail}() over an unordered set leaks iteration "
+                    "order into a consensus value — wrap the set in "
+                    f"sorted(...){suffix}"))
+            elif root in _AMBIENT_MODULES or _np_random(node):
+                findings.append(Finding(
+                    rel, node.lineno, "D1003",
+                    f"'{root or 'np.random'}.{tail}' consults ambient "
+                    f"process state on a consensus path{suffix}"))
+            elif root == "os" and tail in ("getenv",):
+                findings.append(Finding(
+                    rel, node.lineno, "D1003",
+                    "raw os.getenv on a consensus path — declare the "
+                    f"knob through utils/env_flags{suffix}"))
+            elif tail == "hash" and isinstance(node.func, ast.Name) \
+                    and not hash_shadowed:
+                findings.append(Finding(
+                    rel, node.lineno, "D1005",
+                    "builtin hash() is salted per process "
+                    f"(PYTHONHASHSEED) — not reproducible{suffix}"))
+        elif isinstance(node, ast.Attribute) and node.attr == "environ" \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "os":
+            findings.append(Finding(
+                rel, node.lineno, "D1003",
+                "raw os.environ read on a consensus path — declare "
+                f"the knob through utils/env_flags{suffix}"))
+        elif isinstance(node, ast.For) \
+                and tracker.is_set_expr(node.iter) \
+                and _order_sensitive_body(node):
+            findings.append(Finding(
+                rel, node.lineno, "D1001",
+                "iteration over an unordered set with an "
+                "order-sensitive body — iterate sorted(...) like the "
+                f"spec does{suffix}"))
+        elif isinstance(node, ast.Constant) \
+                and isinstance(node.value, float):
+            findings.append(Finding(
+                rel, node.lineno, "D1002",
+                f"float literal {node.value!r} on a consensus path — "
+                f"consensus math is integer-only{suffix}"))
+        elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Div):
+            findings.append(Finding(
+                rel, node.lineno, "D1002",
+                "true division (/) produces a float on a consensus "
+                f"path — use // integer math{suffix}"))
+
+
+def _np_random(node) -> bool:
+    """``np.random.*(...)`` / ``numpy.random.*(...)``."""
+    f = node.func
+    return isinstance(f, ast.Attribute) \
+        and isinstance(f.value, ast.Attribute) \
+        and f.value.attr == "random" \
+        and isinstance(f.value.value, ast.Name) \
+        and f.value.value.id in ("np", "numpy")
+
+
+def _id_keyed(node) -> bool:
+    keys = []
+    if isinstance(node, ast.Subscript):
+        keys = [node.slice]
+    elif isinstance(node, ast.Dict):
+        keys = [k for k in node.keys if k is not None]
+    elif isinstance(node, ast.Call) and node.args \
+            and _call_tail(node) in ("get", "setdefault", "pop"):
+        keys = [node.args[0]]
+    return any(isinstance(k, ast.Call) and isinstance(k.func, ast.Name)
+               and k.func.id == "id" for k in keys)
+
+
+def consensus_roots(graph: ProjectGraph):
+    """``[(FunctionInfo, display name)]``: every public method of the
+    hand fork ladder plus every installed engine override."""
+    roots = []
+    for cls in graph.classes.values():
+        if not cls.rel.startswith("consensus_specs_tpu/forks/") \
+                or cls.rel.startswith("consensus_specs_tpu/forks/"
+                                      "compiled/"):
+            continue
+        for name, fn in cls.methods.items():
+            if not name.startswith("_"):
+                roots.append((fn, f"{cls.name}.{name}"))
+    for name, fns in sorted(graph.overrides.items()):
+        for fn in fns:
+            roots.append((fn, f"<installed>.{name}"))
+    return roots
+
+
+def run(ctx):
+    graph = ctx.project_graph() if hasattr(ctx, "project_graph") \
+        else ProjectGraph(ctx)
+    roots = consensus_roots(graph)
+    if not roots:
+        return []
+    # reachability, remembering ONE root per function (first wins in
+    # root order — stable because roots are built in a sorted walk)
+    root_of = {}
+    for root_fn, display in roots:
+        if root_fn in root_of:
+            continue
+        stack = [root_fn]
+        while stack:
+            fn = stack.pop()
+            if fn in root_of:
+                continue
+            root_of[fn] = display if fn is not root_fn \
+                else f"{display} (root)"
+            stack.extend(c for c in graph.callees(fn)
+                         if c not in root_of)
+    findings = []
+    shadow_cache = {}
+    for fn, root_name in root_of.items():
+        if not _in_report_scope(fn.rel):
+            continue
+        if fn.rel not in shadow_cache:
+            shadow_cache[fn.rel] = _module_shadows_hash(
+                graph.modules[fn.rel].tree)
+        mod = graph.modules[fn.rel]
+        tag = root_name
+        if mod.provenance:
+            tag += f"; compiled from {mod.provenance}"
+        _check_function(fn.rel, fn.node, shadow_cache[fn.rel], tag,
+                        findings)
+    # one finding per (path, line, code): overlapping reachability from
+    # many roots must not multiply the report
+    out, seen = [], {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.code)):
+        key = (f.path, f.line, f.code)
+        if key not in seen:
+            seen[key] = f
+            out.append(f)
+    return out

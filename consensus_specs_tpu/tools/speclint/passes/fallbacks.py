@@ -34,6 +34,16 @@ import ast
 from ..findings import Finding
 
 NAME = "fallbacks"
+VERSION = 1
+GRANULARITY = "file"
+
+
+def in_scope(rel: str) -> bool:
+    return _scoped(rel, ENGINE_PREFIXES + R702_EXTRA_PREFIXES)
+
+
+def check_file(ctx, rel):
+    return check_source(rel, ctx.source(rel))
 # R7 specifically: R8xx belongs to the supervision pass — a bare "R"
 # prefix would claim its baseline keys in the --passes bookkeeping
 CODE_PREFIXES = ("R7",)

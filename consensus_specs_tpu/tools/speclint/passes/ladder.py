@@ -16,18 +16,23 @@ pass catches the cheap-to-catch drift statically:
 
 Method surfaces are resolved across the AST inheritance chain (fork
 classes inherit the previous fork; both ladders share the
-``ForkChoiceMixin``/``ValidatorGuideMixin`` modules), so only genuine
-drift is reported.  Class-body assignments (``floorlog2 =
-staticmethod(...)``) count for symbol presence but carry no signature.
+``ForkChoiceMixin``/``ValidatorGuideMixin`` modules) by the shared
+graph framework (``speclint/graph.py`` — ``ClassInfo`` + the MRO
+linearization behind ``surface()``), so only genuine drift is
+reported.  Class-body assignments (``floorlog2 = staticmethod(...)``)
+count for symbol presence but carry no signature.
 """
 import ast
 
 from ..astutil import AUTO_COMPILED_MARK as PROVENANCE_MARK
 from ..astutil import is_generated
 from ..findings import Finding
+from ..graph import ClassInfo, norm_args
 
 NAME = "ladder"
 CODE_PREFIXES = ("L",)
+VERSION = 2
+GRANULARITY = "tree"
 
 FORKS_REL = "consensus_specs_tpu/forks"
 COMPILED_REL = "consensus_specs_tpu/forks/compiled"
@@ -36,57 +41,23 @@ HAND_EDIT_MARKERS = ("HAND-EDIT", "HAND EDIT", "MANUALLY EDITED",
 COMPILED_PREFIX = "Compiled"
 
 
-def _callable_value(node) -> bool:
-    if isinstance(node, ast.Lambda):
-        return True
-    return isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
-        and node.func.id in ("staticmethod", "classmethod", "property")
-
-
-def _norm_args(a: ast.arguments):
-    names = [arg.arg for arg in a.posonlyargs + a.args]
-    if names and names[0] in ("self", "cls"):
-        names = names[1:]
-    if a.vararg:
-        names.append("*" + a.vararg.arg)
-    names.extend(arg.arg for arg in a.kwonlyargs)
-    return tuple(names)
-
-
-class _Class:
-    def __init__(self, rel, node):
-        self.rel = rel
-        self.name = node.name
-        self.bases = [b.attr if isinstance(b, ast.Attribute) else b.id
-                      for b in node.bases
-                      if isinstance(b, (ast.Attribute, ast.Name))]
-        self.sigs = {}      # method -> (normalized args, lineno)
-        self.symbols = {}   # public CALLABLE class-body binding -> lineno
-        for m in node.body:
-            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                if not m.name.startswith("_"):
-                    self.sigs[m.name] = (_norm_args(m.args), m.lineno)
-                    self.symbols[m.name] = m.lineno
-            elif isinstance(m, ast.Assign) and _callable_value(m.value):
-                # floorlog2 = staticmethod(floorlog2)-style re-binds
-                # count for symbol presence; plain constants are owned
-                # by the preset/config machinery and are out of scope
-                for t in m.targets:
-                    if isinstance(t, ast.Name) and not t.id.startswith("_"):
-                        self.symbols[t.id] = m.lineno
-
-
 def _collect_module(rel, text, tree, table, texts):
     texts[rel] = text
     if tree is None:
         return      # the style pass owns E999
     for node in tree.body:
         if isinstance(node, ast.ClassDef):
-            table[node.name] = _Class(rel, node)
+            # the shared ClassInfo records bases, own methods and the
+            # public callable class-body bindings (floorlog2 =
+            # staticmethod(...)); plain constants are owned by the
+            # preset/config machinery and are out of scope
+            table[node.name] = ClassInfo(rel, node)
 
 
 def _surface(table, cname, _seen=None):
-    """Resolved public surface: name -> (sig-or-None, rel, lineno)."""
+    """Resolved public surface: name -> (sig-or-None, rel, lineno) —
+    the graph framework's MRO walk, run over this pass's local table
+    (tests point it at synthetic trees)."""
     if _seen is None:
         _seen = set()
     if cname not in table or cname in _seen:
@@ -97,8 +68,9 @@ def _surface(table, cname, _seen=None):
     for base in cls.bases:
         out.update(_surface(table, base, _seen))
     for name, lineno in cls.symbols.items():
-        sig = cls.sigs.get(name)
-        out[name] = (sig[0] if sig else None, cls.rel, lineno)
+        m = cls.methods.get(name)
+        sig = norm_args(m.node.args) if m is not None else None
+        out[name] = (sig, cls.rel, lineno)
     return out
 
 

@@ -29,6 +29,16 @@ from ..findings import Finding
 
 NAME = "obs"
 CODE_PREFIXES = ("O",)
+VERSION = 1
+GRANULARITY = "file"
+
+
+def in_scope(rel: str) -> bool:
+    return _in_scope(rel)
+
+
+def check_file(ctx, rel):
+    return check_source(rel, ctx.source(rel))
 
 # repo-relative path prefixes under instrumentation discipline
 HOT_PREFIXES = (

@@ -1,0 +1,118 @@
+"""range-proof pass (U9xx): proven verdicts over the uint64 kernels.
+
+The U1xx pass *suspects*; this pass *proves*, using the interval +
+relational abstract interpreter in ``speclint/ranges.py`` and the
+checked ``# speclint: invariant:`` annotations.  Three things fall out:
+
+* U901 — a subtraction on unsigned lanes **proven to wrap** under the
+  declared invariants (``right.lo > left.hi``): not a suspicion, a
+  counterexample-free proof of the bug.
+* U902 — a broken invariant annotation: unparsable, constraining more
+  (or less) than one variable, non-constant bounds, or contradictory.
+  Invariants are *inputs to proofs* — one that does not parse is a
+  silent hole in the trust base and must fail loudly.
+* U903 — a ``# noqa: U101`` pragma on a subtraction the prover already
+  proves safe.  The pragma is dead weight: delete it and let the
+  machine-checked fact carry the discharge (this is how the historical
+  "safe subtraction" comments in ``ops/epoch_kernels.py`` were demoted
+  to checked invariants).
+
+The *proven-safe* verdicts themselves are consumed by the U1xx pass
+(a proven-safe subtraction no longer raises U101) and are printable
+with ``speclint --range-verdicts`` for auditing.
+
+Scope: the same columnar-kernel files as the U1xx pass.
+"""
+import ast
+
+from .. import ranges
+from ..findings import Finding, noqa_codes
+from .uint64 import SCOPED_PREFIXES
+
+NAME = "ranges"
+CODE_PREFIXES = ("U9",)
+VERSION = 1
+GRANULARITY = "file"
+
+
+def in_scope(rel: str) -> bool:
+    return rel.startswith(SCOPED_PREFIXES) and rel.endswith(".py")
+
+
+def _functions(tree):
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def analyze_source(path: str, text: str, tree=None, memo=None):
+    """``[(func, FunctionRanges)]`` for every function in the file.
+    ``tree``/``memo`` let the driver share the parse and the analysis
+    with the uint64 pass's U101-discharge consults."""
+    if tree is None:
+        try:
+            tree = ast.parse(text, filename=path)
+        except SyntaxError:
+            return []
+    lines = text.split("\n")
+    return [(fn, ranges.analyze_function_cached(
+                fn, lines, memo, (path, fn.lineno, fn.col_offset)))
+            for fn in _functions(tree)]
+
+
+def check_source(path: str, text: str, tree=None, memo=None):
+    findings = []
+    lines = text.split("\n")
+    seen_inv_errors = set()
+    for fn, fr in analyze_source(path, text, tree, memo):
+        for lineno, msg in fr.invariant_errors:
+            if (lineno, msg) in seen_inv_errors:
+                continue     # nested defs re-scan enclosing lines
+            seen_inv_errors.add((lineno, msg))
+            findings.append(Finding(path, lineno, "U902", msg))
+        for (lineno, _col), (verdict, reason) in \
+                sorted(fr.sub_verdicts.items()):
+            if verdict == "overflow":
+                findings.append(Finding(
+                    path, lineno, "U901",
+                    f"subtraction proven to wrap: {reason}"))
+            elif verdict == "safe" and 1 <= lineno <= len(lines):
+                codes = noqa_codes(lines[lineno - 1])
+                if codes is not None and (not codes or "U101" in codes):
+                    findings.append(Finding(
+                        path, lineno, "U903",
+                        "redundant # noqa: U101 — the range prover "
+                        f"already certifies this subtraction ({reason}); "
+                        "drop the pragma and let the checked invariant "
+                        "carry it"))
+    # one U901/U903 per (line, code): a - b - c on one line collapses
+    out, seen = [], set()
+    for f in findings:
+        if (f.line, f.code, f.message) not in seen:
+            seen.add((f.line, f.code, f.message))
+            out.append(f)
+    return out
+
+
+def verdict_report(path: str, text: str):
+    """Human-readable per-subtraction verdict lines (the
+    ``--range-verdicts`` CLI surface)."""
+    out = []
+    for fn, fr in analyze_source(path, text):
+        for (lineno, _col), (verdict, reason) in \
+                sorted(fr.sub_verdicts.items()):
+            out.append(f"{path}:{lineno}: [{verdict}] "
+                       f"{fn.name}: {reason}")
+    return out
+
+
+def check_file(ctx, rel):
+    return check_source(rel, ctx.source(rel), ctx.tree(rel),
+                        getattr(ctx, "ranges_memo", None))
+
+
+def run(ctx):
+    findings = []
+    for rel in ctx.py_files:
+        if in_scope(rel) and ctx.tree(rel) is not None:
+            findings.extend(check_source(rel, ctx.source(rel)))
+    return findings

@@ -28,6 +28,17 @@ from ..findings import Finding
 
 NAME = "specmd"
 CODE_PREFIXES = ("M",)
+VERSION = 1
+GRANULARITY = "file"
+SCAN = "md"
+
+
+def in_scope(rel: str) -> bool:
+    return rel.startswith(SPECS_REL + "/")
+
+
+def check_file(ctx, rel):
+    return check_markdown(rel, ctx.source(rel))
 
 SPECS_REL = "specs"
 

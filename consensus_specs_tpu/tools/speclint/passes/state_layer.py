@@ -31,6 +31,16 @@ from ..findings import Finding
 
 NAME = "state_layer"
 CODE_PREFIXES = ("S6",)
+VERSION = 1
+GRANULARITY = "file"
+
+
+def in_scope(rel: str) -> bool:
+    return _in_scope(rel)
+
+
+def check_file(ctx, rel):
+    return check_source(rel, ctx.source(rel))
 
 HOT_PREFIXES = (
     "consensus_specs_tpu/ops/",

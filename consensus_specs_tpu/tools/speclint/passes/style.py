@@ -15,6 +15,15 @@ from ..findings import Finding
 
 NAME = "style"
 CODE_PREFIXES = ("E", "W", "F", "B")
+VERSION = 1
+GRANULARITY = "file"
+
+
+def check_file(ctx, rel):
+    err = ctx.syntax_error(rel)
+    if err is not None:
+        return [_syntax_finding(rel, err)]
+    return _check(rel, ctx.source(rel), ctx.tree(rel))
 
 
 class _ImportCollector(ast.NodeVisitor):

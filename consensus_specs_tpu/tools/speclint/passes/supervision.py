@@ -36,6 +36,16 @@ from ..findings import Finding
 
 NAME = "supervision"
 CODE_PREFIXES = ("R8",)
+VERSION = 1
+GRANULARITY = "file"
+
+
+def in_scope(rel: str) -> bool:
+    return _scoped(rel, ENGINE_PREFIXES + R802_PREFIXES)
+
+
+def check_file(ctx, rel):
+    return check_source(rel, ctx.source(rel))
 
 ENGINE_PREFIXES = (
     "consensus_specs_tpu/ops/",

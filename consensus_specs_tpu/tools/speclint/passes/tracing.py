@@ -2,9 +2,10 @@
 reached by ``jit``/``vmap``/``pjit``/``shard_map`` (and the ``lax``
 control-flow combinators, whose callables trace the same way).
 
-A module-local call graph is built from the AST: functions decorated
-with a tracer, passed as a callable to a tracer call, or defined inside
-a traced function are roots; calls to module-local names propagate the
+The module-local call graph comes from the shared graph framework
+(``speclint/graph.py`` — ``ModuleGraph``): functions decorated with a
+tracer, passed as a callable to a tracer call, or defined inside a
+traced function are roots; calls to module-local names propagate the
 traced property transitively.  Inside traced code:
 
 * J201 — concretization of a traced value: ``int()``/``float()``/
@@ -24,9 +25,12 @@ import re
 
 from ..astutil import terminal_name as _terminal_name
 from ..findings import Finding
+from ..graph import ModuleGraph
 
 NAME = "tracing"
 CODE_PREFIXES = ("J",)
+VERSION = 2
+GRANULARITY = "file"
 
 _TRACER_NAMES = {"jit", "vmap", "pjit", "shard_map", "pmap", "grad",
                  "value_and_grad", "checkpoint", "scan", "fori_loop",
@@ -42,61 +46,23 @@ def _is_literal(node) -> bool:
         return False
 
 
-class _ModuleGraph:
-    """name -> FunctionDef map plus the traced-root set for one module."""
-
-    def __init__(self, tree):
-        self.funcs = {}          # name -> node (innermost wins is fine)
-        self.parents = {}        # nested def -> enclosing def
-        self.roots = set()       # node ids traced directly
-        self._collect(tree, None)
-        self._find_roots(tree)
-
-    def _collect(self, node, enclosing):
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                self.funcs[child.name] = child
-                if enclosing is not None:
-                    self.parents[child] = enclosing
-                self._collect(child, child)
-            else:
-                self._collect(child, enclosing)
-
-    def _find_roots(self, tree):
-        for node in ast.walk(tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                for deco in node.decorator_list:
-                    if any(_terminal_name(n) in _TRACER_NAMES
-                           for n in ast.walk(deco)):
-                        self.roots.add(node)
-            elif isinstance(node, ast.Call) \
-                    and _terminal_name(node.func) in _TRACER_NAMES:
-                for arg in list(node.args) + \
-                        [kw.value for kw in node.keywords]:
-                    if isinstance(arg, ast.Name) and arg.id in self.funcs:
-                        self.roots.add(self.funcs[arg.id])
-
-    def traced_functions(self):
-        """Transitive closure over local calls + lexical nesting."""
-        traced = set(self.roots)
-        changed = True
-        while changed:
-            changed = False
-            for fn in list(traced):
-                # local calls made from a traced function trace too
-                for node in ast.walk(fn):
-                    if isinstance(node, ast.Call) \
-                            and isinstance(node.func, ast.Name) \
-                            and node.func.id in self.funcs:
-                        callee = self.funcs[node.func.id]
-                        if callee not in traced:
-                            traced.add(callee)
-                            changed = True
-            for child, parent in self.parents.items():
-                if parent in traced and child not in traced:
-                    traced.add(child)
-                    changed = True
-        return traced
+def _trace_roots(tree, graph):
+    """Functions traced directly: tracer-decorated, or passed as a
+    callable to a tracer call."""
+    roots = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if any(_terminal_name(n) in _TRACER_NAMES
+                       for n in ast.walk(deco)):
+                    roots.add(node)
+        elif isinstance(node, ast.Call) \
+                and _terminal_name(node.func) in _TRACER_NAMES:
+            for arg in list(node.args) + \
+                    [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in graph.funcs:
+                    roots.add(graph.funcs[arg.id])
+    return roots
 
 
 def _check_traced_body(path, fn, findings):
@@ -188,13 +154,19 @@ def check_source(path: str, text: str):
 
 
 def _check(path, tree):
-    graph = _ModuleGraph(tree)
-    if not graph.roots:
+    graph = ModuleGraph(tree)
+    roots = _trace_roots(tree, graph)
+    if not roots:
         return []
     findings = []
-    for fn in sorted(graph.traced_functions(), key=lambda f: f.lineno):
+    for fn in sorted(graph.closure(roots), key=lambda f: f.lineno):
         _check_traced_body(path, fn, findings)
     return findings
+
+
+def check_file(ctx, rel):
+    tree = ctx.tree(rel)
+    return [] if tree is None else _check(rel, tree)
 
 
 def run(ctx):

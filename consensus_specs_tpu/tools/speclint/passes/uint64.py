@@ -15,7 +15,12 @@ arithmetic on them is checked:
 * U101 — subtraction on unsigned values with no clamp idiom.  Exempt
   idioms (provably non-wrapping): ``a - xp.minimum(b, a)``,
   ``a - a % b``, and a subtraction inside a ``where(...)`` whose
-  condition is a comparison (the clamp-at-zero pattern).
+  condition is a comparison (the clamp-at-zero pattern).  Beyond the
+  syntactic idioms, the range prover (``speclint/ranges.py``, the U9xx
+  pass's engine) discharges any subtraction it can PROVE non-wrapping
+  from intervals, relational facts and the checked
+  ``# speclint: invariant:`` annotations — so ``x - x`` and
+  ``a - a // q`` no longer need a noqa.
 * U102 — multiplication on unsigned values with no widening cast and
   no preceding ``_guard(...)`` bound-check in the same function.
   Functions whose magnitude bounds are checked by their callers carry
@@ -31,11 +36,16 @@ arithmetic on them is checked:
 import ast
 import re
 
+from .. import ranges
 from ..astutil import terminal_name as _terminal_name
 from ..findings import Finding
 
 NAME = "uint64"
-CODE_PREFIXES = ("U",)
+# U1 specifically: U9xx belongs to the range-proof pass — a bare "U"
+# prefix would claim its baseline keys in the --passes bookkeeping
+CODE_PREFIXES = ("U1",)
+VERSION = 2
+GRANULARITY = "file"
 
 SCOPED_PREFIXES = (
     "consensus_specs_tpu/ops/epoch_kernels.py",
@@ -92,7 +102,7 @@ def _dtype_kwarg(call):
 class _FunctionChecker:
     """Forward taint walk over one function (or the module top level)."""
 
-    def __init__(self, path, lines, func=None):
+    def __init__(self, path, lines, func=None, ranges_memo=None):
         self.path = path
         self.lines = lines
         self.func = func
@@ -100,6 +110,8 @@ class _FunctionChecker:
         self.findings = []
         self.guard_seen_line = None     # first `_guard(...)` stmt line
         self.caller_guarded = func is not None and self._has_pragma(func)
+        self._ranges = None             # lazy FunctionRanges (prover)
+        self._ranges_memo = ranges_memo
         if func is not None and func.args.args \
                 and func.args.args[0].arg == "xp":
             # epoch_kernels kernel convention: pure array kernels take
@@ -108,9 +120,10 @@ class _FunctionChecker:
                 self.tainted.add(arg.arg)
 
     def _has_pragma(self, func):
-        # pragma accepted on the line above the def, the def line(s),
-        # or anywhere up to the first body statement
-        start = max(func.lineno - 2, 0)
+        # pragma accepted anywhere in the contiguous comment block
+        # above the def (invariant annotations may stack there too),
+        # on the def line(s), or up to the first body statement
+        start = ranges.def_comment_start(self.lines, func)
         stop = min(func.body[0].lineno - 1, len(self.lines))
         return any(_CALLER_GUARD_PRAGMA in ln
                    for ln in self.lines[start:stop] if ln)
@@ -256,15 +269,30 @@ class _FunctionChecker:
                 self._check_call(node)
             stack.extend(ast.iter_child_nodes(node))
 
+    def _proven_safe(self, node) -> bool:
+        """Range-prover discharge: a subtraction PROVEN non-wrapping
+        (intervals, relational chains, checked invariants) is not a
+        hazard — the machine-checked upgrade of the old noqa pragmas."""
+        if self.func is None:
+            return False
+        if self._ranges is None:
+            key = (self.path, self.func.lineno, self.func.col_offset)
+            self._ranges = ranges.analyze_function_cached(
+                self.func, self.lines, self._ranges_memo, key)
+        return self._ranges.verdict(node)[0] == "safe"
+
     def _check_binop(self, node, where_branches):
         if not (self.is_tainted(node.left) or self.is_tainted(node.right)):
             return
         if isinstance(node.op, ast.Sub) \
-                and not self._safe_sub(node, where_branches):
+                and not self._safe_sub(node, where_branches) \
+                and not self._proven_safe(node):
             self.findings.append(Finding(
                 self.path, node.lineno, "U101",
                 "subtraction on unsigned array may wrap; clamp with a "
-                "where()/minimum() idiom or # noqa with a bound argument"))
+                "where()/minimum() idiom, declare a # speclint: "
+                "invariant: the range prover can discharge it with, or "
+                "# noqa with a bound argument"))
         elif isinstance(node.op, ast.Mult) and not self.caller_guarded \
                 and (self.guard_seen_line is None
                      or node.lineno <= self.guard_seen_line):
@@ -290,13 +318,13 @@ def check_source(path: str, text: str):
     return _check(path, text, tree)
 
 
-def _check(path, text, tree):
+def _check(path, text, tree, ranges_memo=None):
     lines = text.split("\n")
     findings = []
     funcs = [n for n in ast.walk(tree)
              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
     for fn in funcs:
-        checker = _FunctionChecker(path, lines, fn)
+        checker = _FunctionChecker(path, lines, fn, ranges_memo)
         findings.extend(checker.check(fn.body))
     # module top level (constants built from columns etc.)
     top = [s for s in tree.body
@@ -306,9 +334,20 @@ def _check(path, text, tree):
     return findings
 
 
+def in_scope(rel: str) -> bool:
+    return rel.startswith(SCOPED_PREFIXES)
+
+
+def check_file(ctx, rel):
+    if ctx.tree(rel) is None:
+        return []
+    return _check(rel, ctx.source(rel), ctx.tree(rel),
+                  getattr(ctx, "ranges_memo", None))
+
+
 def run(ctx):
     findings = []
     for rel in ctx.py_files:
-        if rel.startswith(SCOPED_PREFIXES) and ctx.tree(rel) is not None:
+        if in_scope(rel) and ctx.tree(rel) is not None:
             findings.extend(_check(rel, ctx.source(rel), ctx.tree(rel)))
     return findings

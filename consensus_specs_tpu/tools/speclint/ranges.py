@@ -1,0 +1,526 @@
+"""uint64 range prover: abstract interpretation over integer intervals
+plus relational (``<=``/``>=``) facts, used to turn the U1xx *taint
+heuristic* into proven verdicts (the U9xx pass) and to discharge U101
+findings whose safety is machine-checkable instead of noqa'd.
+
+Domain
+------
+Every expression evaluates to a :class:`Value`:
+
+* an interval ``[lo, hi]`` within the uint64 lane domain
+  ``[0, 2**64 - 1]``;
+* ``ubs`` — a set of *term keys* the value is provably ``<=`` (its own
+  key included), and ``lbs`` — keys it is provably ``>=``.  Keys are
+  versioned: a name's key changes on every assignment, so a relation
+  can never survive the rebinding of either side.
+
+The relational half is what interval analysis alone cannot do: proving
+``base_reward // Q <= BRPE * base_reward`` needs the *chain*
+``a // Q <= a <= BRPE * a`` (division by a divisor ``>= 1`` shrinks,
+multiplication by a factor ``>= 1`` grows), not any absolute bound.
+
+Transfer rules (all element-wise over uint64 lanes):
+
+* ``a // b`` with ``b.lo >= 1``: result ``<= a``.
+* ``a % b``: result ``<= a``.
+* ``a * b`` with ``b.lo >= 1``: result ``>= a`` — but only in a
+  function whose multiplications are guard-discharged (a ``_guard()``
+  bound-check or the ``# speclint: guarded-by-caller`` pragma, i.e.
+  exactly when the U102 rule already accepts them as non-wrapping).
+* ``a + b``: result ``>= a`` and ``>= b`` when the interval sum cannot
+  wrap; otherwise all relations drop.
+* ``minimum(a, b)`` is ``<=`` both; ``maximum`` is ``>=`` both;
+  ``where(c, a, b)`` keeps the relations common to both branches.
+* ``v[idx]``: subscripting both sides of a relation by the *same*
+  index expression (same AST dump, same name versions) preserves it —
+  the ``base_reward[src] - proposer_reward[src]`` shape.
+
+A subtraction ``a - b`` is then
+
+* **safe** when ``b.hi <= a.lo`` (interval proof) or when
+  ``b.ubs ∩ ({a} ∪ a.lbs)`` is non-empty (relational chain through a
+  common midpoint);
+* **overflow** when ``b.lo > a.hi`` — it *always* wraps under the
+  declared invariants;
+* **unknown** otherwise (the U1xx heuristics and noqa still apply).
+
+Invariant annotations
+---------------------
+Domain facts the code cannot express (preset bounds, spec constants)
+are declared as *checked* comments::
+
+    # speclint: invariant: proposer_reward_quotient >= 1
+    # speclint: invariant: 1 <= base_rewards_per_epoch <= 64
+    # speclint: invariant: eff <= MAX_EFFECTIVE_BALANCE
+
+One comparison chain per line, exactly one variable name, bounds built
+from integer literals, ``**``/``*``/``+``/``-``/``//`` and the named
+bounds below.  The U9xx pass rejects unparsable or contradictory
+annotations (U902), so an invariant is a machine-checked input to the
+prover, never a comment that can rot.  Annotations may sit anywhere in
+the function (or on/above its ``def``) and apply whenever the named
+value is *seeded* from outside the analysis (a parameter, or an
+assignment whose right side the prover cannot evaluate).
+
+Straight-line approximation: branches are walked in order as if all
+taken (the U1xx convention).  Verdicts are proofs modulo that
+approximation plus the declared invariants — the same trust base the
+``_guard()`` runtime checks already established for multiplication.
+"""
+import ast
+import re
+
+U64_MAX = 2 ** 64 - 1
+
+# documented spec-wide bounds usable in invariant annotations: balances
+# and epochs are uint64 by SSZ type, effective balance is capped by the
+# spec constant, list lengths by their SSZ caps
+NAMED_BOUNDS = {
+    "UINT64_MAX": U64_MAX,
+    "BALANCE_MAX": U64_MAX,
+    "FAR_FUTURE_EPOCH": U64_MAX,
+    "MAX_EFFECTIVE_BALANCE": 32 * 10 ** 9,
+    "EFFECTIVE_BALANCE_INCREMENT": 10 ** 9,
+    "VALIDATOR_REGISTRY_LIMIT": 2 ** 40,
+    "FIELD_ELEMENTS_PER_BLOB": 4096,
+}
+
+_INVARIANT_RE = re.compile(r"#\s*speclint:\s*invariant:\s*([^#]+?)\s*$")
+_CALLER_GUARD_PRAGMA = "speclint: guarded-by-caller"
+
+_CTX_RE = re.compile(r",?\s*ctx=(?:Load|Store|Del)\(\)")
+
+
+def _dump_no_ctx(node) -> str:
+    return _CTX_RE.sub("", ast.dump(node))
+
+
+class Value:
+    """One abstract value: interval + versioned relation sets."""
+
+    __slots__ = ("lo", "hi", "key", "ubs", "lbs")
+
+    def __init__(self, lo, hi, key, ubs=(), lbs=()):
+        self.lo = max(0, lo)
+        self.hi = min(U64_MAX, hi)
+        self.key = key
+        self.ubs = frozenset(ubs) | {key}
+        self.lbs = frozenset(lbs) | {key}
+
+
+def _const_eval(node):
+    """Integer value of a bound expression (literals, named bounds,
+    ``+ - * // **``), or None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.Name):
+        return NAMED_BOUNDS.get(node.id)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _const_eval(node.operand)
+        return None if v is None else -v
+    if isinstance(node, ast.BinOp):
+        a, b = _const_eval(node.left), _const_eval(node.right)
+        if a is None or b is None:
+            return None
+        if isinstance(node.op, ast.Add):
+            return a + b
+        if isinstance(node.op, ast.Sub):
+            return a - b
+        if isinstance(node.op, ast.Mult):
+            return a * b
+        if isinstance(node.op, ast.FloorDiv):
+            return a // b if b else None
+        if isinstance(node.op, ast.Pow) and b >= 0 and abs(a) <= 2 ** 16 \
+                and b <= 256:
+            return a ** b
+    return None
+
+
+def parse_invariant(expr_text):
+    """``(name, lo, hi)`` for one invariant expression, or an error
+    string.  Exactly one comparison chain with exactly one variable."""
+    try:
+        tree = ast.parse(expr_text.strip(), mode="eval")
+    except SyntaxError:
+        return f"invariant does not parse: {expr_text.strip()!r}"
+    node = tree.body
+    if not isinstance(node, ast.Compare):
+        return f"invariant is not a comparison: {expr_text.strip()!r}"
+    terms = [node.left] + list(node.comparators)
+    names = [t for t in terms
+             if isinstance(t, ast.Name) and t.id not in NAMED_BOUNDS]
+    if len(names) != 1:
+        return ("invariant must constrain exactly one variable: "
+                f"{expr_text.strip()!r}")
+    name = names[0].id
+    lo, hi = 0, U64_MAX
+    # walk the chain left-to-right: term op term op term
+    for left, op, right in zip(terms, node.ops, terms[1:]):
+        lval = None if left is names[0] else _const_eval(left)
+        rval = None if right is names[0] else _const_eval(right)
+        if (left is not names[0] and lval is None) \
+                or (right is not names[0] and rval is None):
+            return f"invariant bound is not constant: {expr_text.strip()!r}"
+        if left is names[0]:       # name OP const
+            if isinstance(op, ast.LtE):
+                hi = min(hi, rval)
+            elif isinstance(op, ast.Lt):
+                hi = min(hi, rval - 1)
+            elif isinstance(op, ast.GtE):
+                lo = max(lo, rval)
+            elif isinstance(op, ast.Gt):
+                lo = max(lo, rval + 1)
+            elif isinstance(op, ast.Eq):
+                lo, hi = max(lo, rval), min(hi, rval)
+            else:
+                return f"unsupported operator in {expr_text.strip()!r}"
+        elif right is names[0]:    # const OP name
+            if isinstance(op, ast.LtE):
+                lo = max(lo, lval)
+            elif isinstance(op, ast.Lt):
+                lo = max(lo, lval + 1)
+            elif isinstance(op, ast.GtE):
+                hi = min(hi, lval)
+            elif isinstance(op, ast.Gt):
+                hi = min(hi, lval - 1)
+            elif isinstance(op, ast.Eq):
+                lo, hi = max(lo, lval), min(hi, lval)
+            else:
+                return f"unsupported operator in {expr_text.strip()!r}"
+        # const OP const legs of a chain carry no information
+    if lo > hi:
+        return (f"invariant bounds are contradictory "
+                f"(lo {lo} > hi {hi}): {expr_text.strip()!r}")
+    return (name, lo, hi)
+
+
+def def_comment_start(lines, func) -> int:
+    """0-based index of the first line of the contiguous comment block
+    sitting directly above the ``def`` — pragmas and invariants may
+    stack there in any order."""
+    i = func.lineno - 2      # line above the def, 0-based
+    while i >= 0 and lines[i].strip().startswith("#"):
+        i -= 1
+    return i + 1
+
+
+def collect_invariants(lines, func):
+    """Invariants declared in the comment block above the ``def`` or
+    anywhere in the body: ``({name: (lo, hi)}, [(lineno, error)])``."""
+    start = def_comment_start(lines, func)
+    end = max((getattr(n, "end_lineno", n.lineno)
+               for n in ast.walk(func) if hasattr(n, "lineno")),
+              default=func.lineno)
+    out, errors = {}, []
+    for i in range(start, min(end, len(lines))):
+        m = _INVARIANT_RE.search(lines[i])
+        if not m:
+            continue
+        parsed = parse_invariant(m.group(1))
+        if isinstance(parsed, str):
+            errors.append((i + 1, parsed))
+            continue
+        name, lo, hi = parsed
+        plo, phi = out.get(name, (0, U64_MAX))
+        lo, hi = max(lo, plo), min(hi, phi)
+        if lo > hi:
+            errors.append((i + 1, f"invariants on {name!r} are jointly "
+                                  f"contradictory"))
+            continue
+        out[name] = (lo, hi)
+    return out, errors
+
+
+_MIN_CALLS = {"minimum", "fmin", "min"}
+_MAX_CALLS = {"maximum", "fmax", "max"}
+_CAST_CALLS = {"uint64", "int", "asarray", "ascontiguousarray"}
+
+
+def _call_tail(node):
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+class FunctionRanges:
+    """Range analysis of one function: per-subtraction verdicts,
+    declared invariants, and annotation errors."""
+
+    def __init__(self, func, lines):
+        self.func = func
+        self.lines = lines
+        self.invariants, self.invariant_errors = \
+            collect_invariants(lines, func)
+        self.sub_verdicts = {}       # (lineno, col) -> (verdict, reason)
+        self._env = {}               # name -> Value
+        self._versions = {}          # name -> int
+        start = def_comment_start(lines, func)
+        stop = min(func.body[0].lineno - 1, len(lines))
+        self._guarded = any(_CALLER_GUARD_PRAGMA in ln
+                            for ln in lines[start:stop])
+        self._guard_lines = [
+            n.lineno for n in ast.walk(func)
+            if isinstance(n, ast.Call) and _call_tail(n) == "_guard"]
+        self._walk_block(func.body)
+
+    # -- environment --------------------------------------------------------
+
+    def _fresh(self, name):
+        v = self._versions.get(name, 0)
+        lo, hi = self.invariants.get(name, (0, U64_MAX))
+        val = Value(lo, hi, ("name", name, v))
+        self._env[name] = val
+        return val
+
+    def _assign(self, name, value):
+        v = self._versions.get(name, 0) + 1
+        self._versions[name] = v
+        # a declared invariant is a fact that always holds for this
+        # name, whatever was assigned: intersect it into the interval
+        # (this is how `prq = int(spec.X)` — opaque to the analysis —
+        # still gets its declared `prq >= 1`)
+        ilo, ihi = self.invariants.get(name, (0, U64_MAX))
+        if value is None:
+            self._env[name] = Value(ilo, ihi, ("name", name, v))
+        else:
+            lo, hi = max(value.lo, ilo), min(value.hi, ihi)
+            if lo > hi:                 # contradictory: trust the code
+                lo, hi = value.lo, value.hi
+            self._env[name] = Value(lo, hi, ("name", name, v),
+                                    value.ubs, value.lbs)
+
+    def _kill(self, target):
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name):
+                self._assign(n.id, None)
+
+    def _idx_key(self, idx):
+        names = tuple(sorted(
+            (n.id, self._versions.get(n.id, 0))
+            for n in ast.walk(idx) if isinstance(n, ast.Name)))
+        return (_dump_no_ctx(idx), names)
+
+    # -- statement walk -----------------------------------------------------
+
+    def _walk_block(self, stmts):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.Assign):
+                val = self._eval(stmt.value)
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        self._assign(t.id, val)
+                    else:
+                        self._kill(t)
+            elif isinstance(stmt, ast.AugAssign):
+                eq = ast.copy_location(
+                    ast.BinOp(left=stmt.target, op=stmt.op,
+                              right=stmt.value), stmt)
+                val = self._eval(eq)
+                if isinstance(stmt.target, ast.Name):
+                    self._assign(stmt.target.id, val)
+                else:
+                    # `pen[idx] += x` mutates pen in place: every name
+                    # under the target loses its abstract value, or a
+                    # later `a - pen` would still see pen's stale
+                    # (e.g. zeros()) interval and prove false safety
+                    self._kill(stmt.target)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._eval(stmt.iter)
+                self._kill(stmt.target)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                self._eval(stmt.test)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._eval(item.context_expr)
+            elif isinstance(stmt, (ast.Expr, ast.Return)) \
+                    and stmt.value is not None:
+                self._eval(stmt.value)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if isinstance(sub, list) and sub \
+                        and isinstance(sub[0], ast.stmt):
+                    self._walk_block(sub)
+            for handler in getattr(stmt, "handlers", ()):
+                self._walk_block(handler.body)
+
+    # -- expression evaluation ---------------------------------------------
+
+    def _eval(self, node):
+        """Abstract value of ``node`` (never None; unknowns get a fresh
+        unconstrained Value so identity relations still hold)."""
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, bool) \
+                    or not isinstance(node.value, int):
+                return Value(0, U64_MAX, ("expr", id(node)))
+            return Value(node.value, node.value, ("const", node.value))
+        if isinstance(node, ast.Name):
+            got = self._env.get(node.id)
+            return got if got is not None else self._fresh(node.id)
+        if isinstance(node, ast.BinOp):
+            return self._eval_binop(node)
+        if isinstance(node, ast.Subscript):
+            base = self._eval(node.value)
+            self._eval_children(node.slice)
+            ik = self._idx_key(node.slice)
+            return Value(base.lo, base.hi, ("sub", base.key, ik),
+                         {("sub", u, ik) for u in base.ubs},
+                         {("sub", u, ik) for u in base.lbs})
+        if isinstance(node, ast.Call):
+            return self._eval_call(node)
+        if isinstance(node, ast.IfExp):
+            self._eval(node.test)
+            a, b = self._eval(node.body), self._eval(node.orelse)
+            return Value(min(a.lo, b.lo), max(a.hi, b.hi),
+                         ("expr", id(node)), a.ubs & b.ubs, a.lbs & b.lbs)
+        self._eval_children(node)
+        return Value(0, U64_MAX, ("expr", id(node)))
+
+    def _eval_children(self, node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            self._eval(child)
+
+    def _mult_exact(self, node) -> bool:
+        return self._guarded or any(ln <= node.lineno
+                                    for ln in self._guard_lines)
+
+    def _eval_binop(self, node):
+        a, b = self._eval(node.left), self._eval(node.right)
+        key = ("expr", id(node))
+        op = node.op
+        if isinstance(op, ast.Sub):
+            self._record_sub(node, a, b)
+            # past a safe proof the result is exact a - b; otherwise it
+            # may have wrapped and carries no relations
+            if self.sub_verdicts[(node.lineno, node.col_offset)][0] \
+                    == "safe":
+                return Value(max(0, a.lo - b.hi), a.hi, key, a.ubs, ())
+            return Value(0, U64_MAX, key)
+        if isinstance(op, ast.Add):
+            if a.hi + b.hi <= U64_MAX:
+                return Value(a.lo + b.lo, a.hi + b.hi, key, (),
+                             a.lbs | b.lbs)
+            return Value(0, U64_MAX, key)
+        if isinstance(op, ast.Mult):
+            if not self._mult_exact(node):
+                return Value(0, U64_MAX, key)
+            lbs = set()
+            if b.lo >= 1:
+                lbs |= a.lbs
+            if a.lo >= 1:
+                lbs |= b.lbs
+            return Value(a.lo * b.lo, a.hi * b.hi, key, (), lbs)
+        if isinstance(op, ast.FloorDiv):
+            if b.lo >= 1:
+                return Value(a.lo // max(b.hi, 1), a.hi // b.lo, key,
+                             a.ubs, ())
+            return Value(0, a.hi, key)
+        if isinstance(op, ast.Mod):
+            hi = a.hi if b.lo < 1 else min(a.hi, b.hi - 1)
+            return Value(0, hi, key, a.ubs, ())
+        if isinstance(op, (ast.RShift,)):
+            return Value(0, a.hi, key, a.ubs, ())
+        if isinstance(op, (ast.BitAnd,)):
+            return Value(0, min(a.hi, b.hi), key, a.ubs | b.ubs, ())
+        return Value(0, U64_MAX, key)
+
+    _INPLACE_MUTATORS = {"at", "fill", "sort", "put", "copyto", "place",
+                         "setfield"}
+
+    def _eval_call(self, node):
+        tail = _call_tail(node)
+        key = ("expr", id(node))
+        args = [self._eval(a) for a in node.args]
+        for kw in node.keywords:
+            self._eval(kw.value)
+        if tail in self._INPLACE_MUTATORS:
+            # np.add.at(pen, idx, x) / pen.fill(x): in-place mutation
+            # with no assignment — invalidate every name involved
+            if isinstance(node.func, ast.Attribute):
+                self._kill(node.func.value)
+            for a in node.args:
+                if isinstance(a, ast.Name):
+                    self._assign(a.id, None)
+        if tail in _CAST_CALLS and len(args) == 1:
+            return args[0]
+        if tail in _MIN_CALLS and len(args) >= 2:
+            ubs = frozenset().union(*(a.ubs for a in args))
+            return Value(min(a.lo for a in args),
+                         min(a.hi for a in args), key, ubs, ())
+        if tail in _MAX_CALLS and len(args) >= 2:
+            lbs = frozenset().union(*(a.lbs for a in args))
+            return Value(max(a.lo for a in args),
+                         max(a.hi for a in args), key, (), lbs)
+        if tail == "where" and len(args) == 3:
+            a, b = args[1], args[2]
+            return Value(min(a.lo, b.lo), max(a.hi, b.hi), key,
+                         a.ubs & b.ubs, a.lbs & b.lbs)
+        if tail in ("zeros", "zeros_like"):
+            return Value(0, 0, key)
+        if tail == "full" and len(args) >= 2:
+            return Value(args[1].lo, args[1].hi, key,
+                         args[1].ubs, args[1].lbs)
+        return Value(0, U64_MAX, key)
+
+    # -- the verdict --------------------------------------------------------
+
+    def _record_sub(self, node, a, b):
+        where = (node.lineno, node.col_offset)
+        if b.hi <= a.lo:
+            self.sub_verdicts[where] = (
+                "safe", f"interval: right <= {b.hi} <= left >= {a.lo}")
+        elif b.ubs & a.lbs:
+            mid = next(iter(b.ubs & a.lbs))
+            self.sub_verdicts[where] = (
+                "safe", f"relational chain through {_key_str(mid)}: "
+                "right <= mid <= left")
+        elif b.lo > a.hi:
+            self.sub_verdicts[where] = (
+                "overflow", f"right >= {b.lo} always exceeds "
+                            f"left <= {a.hi}: the subtraction wraps")
+        else:
+            self.sub_verdicts[where] = ("unknown", "no proof either way")
+
+    def verdict(self, binop):
+        """('safe'|'overflow'|'unknown', reason) for a Sub BinOp seen
+        during the walk ('unknown' if the node was never reached)."""
+        return self.sub_verdicts.get(
+            (binop.lineno, binop.col_offset), ("unknown", "not analyzed"))
+
+
+def _key_str(key):
+    if key[0] == "name":
+        return key[1]
+    if key[0] == "sub":
+        return f"{_key_str(key[1])}[...]"
+    if key[0] == "const":
+        return str(key[1])
+    return "<expr>"
+
+
+def analyze_function(func, lines) -> FunctionRanges:
+    """Range-analyze one function (``lines``: the file's source lines,
+    for pragma/invariant scanning)."""
+    return FunctionRanges(func, lines)
+
+
+def analyze_function_cached(func, lines, memo, key) -> FunctionRanges:
+    """Memoized :func:`analyze_function`.  ``memo`` is a per-Context
+    dict (the uint64 U101-discharge and the U9xx pass analyze the same
+    functions in one run; sharing halves the prover cost) keyed on a
+    caller-supplied stable key — (rel, lineno, col), never ``id()``."""
+    if memo is None:
+        return analyze_function(func, lines)
+    got = memo.get(key)
+    if got is None:
+        got = analyze_function(func, lines)
+        memo[key] = got
+    return got

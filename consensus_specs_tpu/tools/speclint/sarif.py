@@ -1,0 +1,188 @@
+"""SARIF 2.1.0 output (``speclint --format sarif``).
+
+One run, one rule per distinct finding code, one result per finding.
+Results carry ``baselineState`` so a SARIF consumer sees the same
+split the ratchet enforces: ``new`` findings fail the run,
+``unchanged`` ones are the recorded debt.
+
+:func:`validate` checks a log against the SARIF 2.1.0 structural
+requirements this tool exercises (via ``jsonschema`` when available —
+the schema subset below is transcribed from the OASIS sarif-2.1.0
+schema's required properties — with a hand-rolled structural walk as
+the fallback), so the CI upload can be asserted well-formed without a
+network fetch of the full schema.
+"""
+import json
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA_URI = ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+# the structural subset of the OASIS sarif-schema-2.1.0 this tool
+# emits: required properties and types, transcribed from the spec
+SARIF_2_1_0_SCHEMA = {
+    "type": "object",
+    "required": ["version", "runs"],
+    "properties": {
+        "version": {"enum": ["2.1.0"]},
+        "$schema": {"type": "string"},
+        "runs": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "required": ["tool"],
+                "properties": {
+                    "tool": {
+                        "type": "object",
+                        "required": ["driver"],
+                        "properties": {
+                            "driver": {
+                                "type": "object",
+                                "required": ["name"],
+                                "properties": {
+                                    "name": {"type": "string"},
+                                    "rules": {
+                                        "type": "array",
+                                        "items": {
+                                            "type": "object",
+                                            "required": ["id"],
+                                        },
+                                    },
+                                },
+                            },
+                        },
+                    },
+                    "results": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "required": ["message"],
+                            "properties": {
+                                "message": {
+                                    "type": "object",
+                                    "required": ["text"],
+                                },
+                                "level": {"enum": ["none", "note",
+                                                   "warning", "error"]},
+                                "baselineState": {
+                                    "enum": ["new", "unchanged",
+                                             "updated", "absent"]},
+                                "locations": {
+                                    "type": "array",
+                                    "items": {
+                                        "type": "object",
+                                        "properties": {
+                                            "physicalLocation": {
+                                                "type": "object",
+                                                "properties": {
+                                                    "artifactLocation": {
+                                                        "type": "object",
+                                                        "properties": {
+                                                            "uri": {
+                                                                "type":
+                                                                "string"},
+                                                        },
+                                                    },
+                                                    "region": {
+                                                        "type": "object",
+                                                        "properties": {
+                                                            "startLine": {
+                                                                "type":
+                                                                "integer",
+                                                                "minimum":
+                                                                1},
+                                                        },
+                                                    },
+                                                },
+                                            },
+                                        },
+                                    },
+                                },
+                            },
+                        },
+                    },
+                },
+            },
+        },
+    },
+}
+
+
+def _result(finding, baseline_state):
+    return {
+        "ruleId": finding.code,
+        "level": "error",
+        "message": {"text": finding.message},
+        "baselineState": baseline_state,
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": finding.path},
+                "region": {"startLine": max(finding.line, 1)},
+            },
+        }],
+    }
+
+
+def to_sarif(new, baselined, tool_version="2"):
+    """A SARIF 2.1.0 log dict for one speclint run."""
+    codes = sorted({f.code for f in new} | {f.code for f in baselined})
+    results = [_result(f, "new") for f in new] \
+        + [_result(f, "unchanged") for f in baselined]
+    return {
+        "$schema": SARIF_SCHEMA_URI,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "speclint",
+                    "version": str(tool_version),
+                    "informationUri":
+                        "docs/static-analysis.md",
+                    "rules": [{"id": code,
+                               "shortDescription":
+                                   {"text": f"speclint {code}"}}
+                              for code in codes],
+                },
+            },
+            "results": results,
+        }],
+    }
+
+
+def render(new, baselined) -> str:
+    return json.dumps(to_sarif(new, baselined), indent=1)
+
+
+def validate(log) -> list:
+    """Problems (empty = valid) against the 2.1.0 structural subset.
+    Uses ``jsonschema`` when importable; otherwise a hand structural
+    walk of the same requirements."""
+    try:
+        import jsonschema
+    except ImportError:
+        jsonschema = None
+    if jsonschema is not None:
+        validator = jsonschema.Draft7Validator(SARIF_2_1_0_SCHEMA)
+        return [f"{'/'.join(map(str, e.absolute_path))}: {e.message}"
+                for e in validator.iter_errors(log)]
+    problems = []
+    if log.get("version") != SARIF_VERSION:
+        problems.append(f"version must be {SARIF_VERSION!r}")
+    runs = log.get("runs")
+    if not isinstance(runs, list) or not runs:
+        problems.append("runs must be a non-empty array")
+        return problems
+    for i, run in enumerate(runs):
+        driver = run.get("tool", {}).get("driver", {})
+        if not driver.get("name"):
+            problems.append(f"runs[{i}].tool.driver.name required")
+        for j, res in enumerate(run.get("results", [])):
+            if not isinstance(res.get("message", {}).get("text"), str):
+                problems.append(
+                    f"runs[{i}].results[{j}].message.text required")
+            for loc in res.get("locations", []):
+                region = loc.get("physicalLocation", {}).get("region", {})
+                if "startLine" in region and region["startLine"] < 1:
+                    problems.append(
+                        f"runs[{i}].results[{j}] startLine must be >= 1")
+    return problems

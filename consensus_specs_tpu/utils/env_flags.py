@@ -63,6 +63,17 @@ def refresh() -> None:
     _snapshot_switches()
 
 
+def knob(name: str, default=None):
+    """Raw string knob: the sanctioned access point for non-switch
+    ``CS_TPU_*`` environment variables.  Engine code must not read
+    ``os.environ`` directly (speclint D1003): routing every read
+    through this module keeps the full set of environment dependencies
+    declarable and auditable in one place — ambient state a consensus
+    result may depend on is exactly what the determinism pass
+    exists to fence."""
+    return os.environ.get(name, default)
+
+
 def _int_env(name):
     """Optional integer env knob: None when unset or non-numeric."""
     raw = os.environ.get(name, "")

@@ -83,11 +83,49 @@ def metrics_diff():
     return counting
 
 
+def _check_speclint_baseline():
+    """Deflake guard: the checked-in ratchet file must be sorted and
+    duplicate-free, so re-ratchets (`make speclint-baseline`) always
+    produce one-line-per-finding diffs.  An unsorted or duplicated
+    baseline makes every ratchet a whole-file rewrite — churn that
+    hides the real delta — so it fails the session loudly here rather
+    than surviving until a confusing review."""
+    import json
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "speclint_baseline.json")
+    if not os.path.isfile(path):
+        return
+
+    def no_dups(pairs):
+        seen = set()
+        for key, _ in pairs:
+            if key in seen:
+                raise AssertionError(
+                    f"speclint_baseline.json has a duplicate key: {key!r}"
+                    " — deduplicate it (json.load would silently keep "
+                    "one and the ratchet count would flap)")
+            seen.add(key)
+        return dict(pairs)
+
+    with open(path) as f:
+        data = json.load(f, object_pairs_hook=no_dups)
+    keys = list(data.get("counts", {}))
+    assert keys == sorted(keys), (
+        "speclint_baseline.json counts are not sorted — run "
+        "`make speclint-baseline` (the writer sorts) instead of "
+        "editing by hand; unsorted keys turn every re-ratchet into a "
+        "whole-file diff")
+    assert all(isinstance(n, int) and n >= 1
+               for n in data.get("counts", {}).values()), (
+        "speclint_baseline.json counts must be positive integers")
+
+
 def pytest_configure(config):
     # `slow`: excluded from the tier-1 `-m 'not slow'` budget run; still
     # covered by `make citest` / CI (no marker filter there)
     config.addinivalue_line(
         "markers", "slow: long-running test excluded from the fast tier")
+    _check_speclint_baseline()
     from consensus_specs_tpu.test_infra import context as ctx
     ctx.DEFAULT_TEST_PRESET = config.getoption("--preset")
     ctx.DEFAULT_BLS_ACTIVE = (config.getoption("--enable-bls")

@@ -418,3 +418,41 @@ def test_recover_many_shares_group_work(spec, blob_setup):
     if os.environ.get("CS_TPU_HEAVY") == "1":
         with _env(CS_TPU_DAS="0"):
             assert recover_many(spec, reqs) == fulls
+
+
+def test_domain_tables_content_keyed(spec):
+    """Regression (speclint D1004 fix): the per-setup domain-table
+    cache keys on CONTENT (blob width + the degree-L G2 monomial), not
+    on id(setup) — two distinct-but-equal setup objects share one
+    table, and a garbage-collected setup can never alias a fresh one
+    into the wrong roots/shifts."""
+    from consensus_specs_tpu.das import kernels
+
+    class _SetupView:
+        """Same content as the real setup, different object identity."""
+        def __init__(self, base):
+            self.FIELD_ELEMENTS_PER_BLOB = int(base.FIELD_ELEMENTS_PER_BLOB)
+            self.KZG_SETUP_G2_MONOMIAL = list(base.KZG_SETUP_G2_MONOMIAL)
+
+    base = spec.kzg_setup
+    t1 = kernels.tables(base)
+    t2 = kernels.tables(_SetupView(base))
+    assert t1 is t2, "equal-content setups must share one table"
+    # different content gets its own table (no key collision)
+    half = _SetupView(base)
+    half.FIELD_ELEMENTS_PER_BLOB //= 2
+    assert kernels.tables(half) is not t1
+    assert kernels._setup_key(base) == kernels._setup_key(_SetupView(base))
+
+
+def test_limb_fft_knob_reads_through_env_flags(monkeypatch):
+    """Regression (speclint D1003 fix): the CS_TPU_DAS_FFT knob is
+    read through env_flags.knob — flipping it mid-process is seen."""
+    from consensus_specs_tpu.das import kernels
+    from consensus_specs_tpu.utils import env_flags
+    monkeypatch.delenv("CS_TPU_DAS_FFT", raising=False)
+    assert kernels.limb_fft_enabled() is False
+    monkeypatch.setenv("CS_TPU_DAS_FFT", "limb")
+    assert kernels.limb_fft_enabled() is True
+    assert env_flags.knob("CS_TPU_DAS_FFT") == "limb"
+    assert env_flags.knob("CS_TPU_DAS_FFT_MISSING", "d") == "d"

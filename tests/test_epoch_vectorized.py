@@ -296,9 +296,18 @@ def test_env_flag_disables_auto(monkeypatch):
     assert not ek.enabled()
     assert ek.backend_name() == "loops"
     assert not ek.try_process_rewards_and_penalties(spec, state)
-    monkeypatch.delenv("CS_TPU_VECTORIZED_EPOCH")
+    # the live switch must flip back on without a reimport — asserted
+    # with an explicit "1" so the test also holds on the
+    # CS_TPU_VECTORIZED_EPOCH=0 CI off-leg, where the import-time
+    # default (what an unset variable falls back to) is off
+    monkeypatch.setenv("CS_TPU_VECTORIZED_EPOCH", "1")
     assert ek.enabled()
     assert ek.backend_name() == "vectorized"
+    # unset restores the import-time default, whatever it was
+    monkeypatch.delenv("CS_TPU_VECTORIZED_EPOCH")
+    from consensus_specs_tpu.utils import env_flags
+    assert ek.enabled() == \
+        env_flags._SWITCH_DEFAULTS["CS_TPU_VECTORIZED_EPOCH"]
 
 
 def test_registry_churn_pressure():

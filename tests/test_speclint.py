@@ -124,12 +124,15 @@ def test_uint64_flags_augmented_assignment():
 
 def test_uint64_taint_flows_through_nested_blocks():
     """Assignments inside if/for bodies must update the taint set, and
-    a _guard() inside a branch must discharge a later multiply."""
+    a _guard() inside a branch must discharge a later multiply.
+    (Two INDEPENDENT columns: `b - b` itself is now proven safe by the
+    range prover — x - x cannot wrap — and no longer fires.)"""
     src = (
         "def f(seq, flag):\n"
         "    if flag:\n"
         "        b = u64_column(seq)\n"
-        "        return b - b\n"
+        "        p = u64_column(seq)\n"
+        "        return b - p\n"
         "    return None\n")
     assert "U101" in _codes(uint64.check_source(SCOPED, src))
     guarded = (
@@ -540,8 +543,16 @@ def test_driver_noqa_filters_findings(tmp_path):
     target.write_text(
         "def f(seq):\n"
         "    balances = u64_column(seq)\n"
-        "    return balances - balances  # noqa: U101\n")
+        "    penalties = u64_column(seq)\n"
+        "    return balances - penalties  # noqa: U101\n")
+    # non-vacuous: without the noqa the same tree must fail
     assert driver.run_passes(driver.Context(str(root)), {"uint64"}) == []
+    target.write_text(
+        "def f(seq):\n"
+        "    balances = u64_column(seq)\n"
+        "    penalties = u64_column(seq)\n"
+        "    return balances - penalties\n")
+    assert driver.run_passes(driver.Context(str(root)), {"uint64"}) != []
 
 
 def test_baseline_ratchet(tmp_path):
@@ -550,7 +561,8 @@ def test_baseline_ratchet(tmp_path):
     target.parent.mkdir(parents=True)
     bad = ("def f(seq):\n"
            "    b = u64_column(seq)\n"
-           "    return b - b\n")
+           "    p = u64_column(seq)\n"
+           "    return b - p\n")
     target.write_text(bad)
     baseline = str(root / "speclint_baseline.json")
 
@@ -563,7 +575,8 @@ def test_baseline_ratchet(tmp_path):
     # debt grows -> ratchet fails
     target.write_text(bad + "def g(seq):\n"
                             "    b = u64_column(seq)\n"
-                            "    return b - b\n")
+                            "    p = u64_column(seq)\n"
+                            "    return b - p\n")
     assert driver.main([str(root), "--passes", "uint64"]) == 1
     # debt paid down -> green (stale baseline is only a note)
     target.write_text("def f(seq):\n    return u64_column(seq)\n")
@@ -580,7 +593,8 @@ def test_write_baseline_with_pass_subset_preserves_other_debt(tmp_path):
     target.parent.mkdir(parents=True)
     target.write_text("def f(seq):\n"
                       "    b = u64_column(seq)\n"
-                      "    return b - b\n")
+                      "    p = u64_column(seq)\n"
+                      "    return b - p\n")
     md = root / "specs" / "demo.md"
     md.parent.mkdir(parents=True)
     md.write_text("```python\nimport os\n```\n")

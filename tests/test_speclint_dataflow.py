@@ -1,0 +1,933 @@
+"""Speclint v2 fixture corpus: the whole-program dataflow framework
+(call graph + worklist summaries), the U9xx range prover, the D10xx
+determinism pass, the C11xx engine-coverage pass, the SARIF renderer,
+the incremental cache, and the --fix autofixer.
+
+Every pass must (a) flag its planted bug, (b) stay quiet on the safe
+idiom beside it, and (c) hold its acceptance invariant on the REAL
+tree: the coverage pass proves the full contract for every
+``faults.SITES`` entry at baseline zero, the range prover certifies
+the epoch-kernel subtractions with zero false overflow reports, and
+the SARIF output validates against the 2.1.0 schema.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu.tools.speclint import (
+    cache as sl_cache, dataflow, driver, fixer, sarif)
+from consensus_specs_tpu.tools.speclint.graph import ProjectGraph
+from consensus_specs_tpu.tools.speclint.passes import (
+    coverage, determinism, rangeproof, uint64)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCOPED = "consensus_specs_tpu/ops/epoch_kernels.py"
+
+
+def _codes(findings):
+    return [f.code for f in findings]
+
+
+def _write(root, rel, text):
+    path = os.path.join(str(root), rel)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(text)
+
+
+# ---------------------------------------------------------------------------
+# Project call graph: MRO dispatch, super() chains, install_* wrapping,
+# hand-vs-compiled edge parity
+# ---------------------------------------------------------------------------
+
+_HAND_BASE = (
+    "class Phase0Spec:\n"
+    "    fork = 'phase0'\n"
+    "    def process_operations(self, state):\n"
+    "        return self.helper(state)\n"
+    "    def helper(self, state):\n"
+    "        return state\n")
+_HAND_NEXT = (
+    "from consensus_specs_tpu.forks.base import Phase0Spec\n"
+    "class AltairSpec(Phase0Spec):\n"
+    "    def process_operations(self, state):\n"
+    "        self.extra(state)\n"
+    "        return super().process_operations(state)\n"
+    "    def extra(self, state):\n"
+    "        return state\n")
+_ACCEL = (
+    "def _fast_operations(spec, state):\n"
+    "    return kernel(state)\n"
+    "def kernel(state):\n"
+    "    return state\n"
+    "def install_epoch_accel(cls):\n"
+    "    cls.process_operations = _fast_operations\n"
+    "    setattr(cls, 'helper', kernel)\n")
+
+
+def _ladder_tree(tmp_path):
+    root = tmp_path / "repo"
+    _write(root, "consensus_specs_tpu/forks/base.py", _HAND_BASE)
+    _write(root, "consensus_specs_tpu/forks/altair.py", _HAND_NEXT)
+    _write(root, "consensus_specs_tpu/forks/compiled/base.py",
+           '"""AUTO-COMPILED from specs/phase0/beacon-chain.md"""\n'
+           + _HAND_BASE.replace("Phase0Spec", "CompiledPhase0Spec"))
+    _write(root, "consensus_specs_tpu/forks/compiled/altair.py",
+           '"""AUTO-COMPILED from specs/altair/beacon-chain.md"""\n'
+           + _HAND_NEXT
+           .replace("from consensus_specs_tpu.forks.base import Phase0Spec",
+                    "from consensus_specs_tpu.forks.compiled.base import "
+                    "CompiledPhase0Spec")
+           .replace("Phase0Spec", "CompiledPhase0Spec")
+           .replace("AltairSpec", "CompiledAltairSpec"))
+    _write(root, "consensus_specs_tpu/ops/accel.py", _ACCEL)
+    return ProjectGraph(driver.Context(str(root)))
+
+
+def _edge_names(graph, cls, method):
+    fn = graph.classes[cls].methods[method]
+    return {c.name for c in graph.callees(fn)}
+
+
+def test_graph_super_chain_resolves_across_modules(tmp_path):
+    g = _ladder_tree(tmp_path)
+    # AltairSpec.process_operations -> super() -> the phase0 body, plus
+    # the self.extra local dispatch and the installed override
+    edges = {(c.cls_name, c.name) for c in g.callees(
+        g.classes["AltairSpec"].methods["process_operations"])}
+    assert ("Phase0Spec", "process_operations") in edges
+    assert ("AltairSpec", "extra") in edges
+
+
+def test_graph_mro_resolves_inherited_method(tmp_path):
+    g = _ladder_tree(tmp_path)
+    # helper is defined on the base only; MRO resolution from the
+    # subclass must find it
+    fn = g.resolve_method("AltairSpec", "helper")
+    assert fn is not None and fn.cls_name == "Phase0Spec"
+    # super() dispatch starts PAST the class itself
+    fn = g.resolve_method("AltairSpec", "process_operations", after=True)
+    assert fn.cls_name == "Phase0Spec"
+
+
+def test_graph_install_wrappers_register_overrides(tmp_path):
+    g = _ladder_tree(tmp_path)
+    over = {name: {f.name for f in fns}
+            for name, fns in g.overrides.items()}
+    assert over["process_operations"] == {"_fast_operations"}
+    assert over["helper"] == {"kernel"}
+    # a self.helper(...) call site therefore reaches the installed
+    # kernel as well as the MRO body (the process_operations override
+    # itself is an edge of that method's CALLERS, not of its body)
+    edges = _edge_names(g, "Phase0Spec", "process_operations")
+    assert {"helper", "kernel"} <= edges
+    # and the installed wrappers are consensus roots in their own
+    # right, so code only an install_* override reaches is still
+    # analyzed by the determinism pass
+    root_names = {n for _, n in determinism.consensus_roots(g)}
+    assert "<installed>.process_operations" in root_names
+
+
+def test_graph_hand_and_compiled_twins_resolve_identically(tmp_path):
+    """Satellite acceptance: the same dispatch shapes (MRO, super()
+    chain, install wrapping) must produce isomorphic edges for the
+    hand ladder and the compiled ladder."""
+    g = _ladder_tree(tmp_path)
+
+    def shape(cls):
+        out = {}
+        for m in g.classes[cls].methods:
+            out[m] = sorted(
+                (c.cls_name or "", c.name) for c in
+                g.callees(g.classes[cls].methods[m]))
+        return out
+
+    def strip(d):
+        return {m: [(c.replace("Compiled", ""), n) for c, n in v]
+                for m, v in d.items()}
+
+    assert strip(shape("AltairSpec")) == strip(shape("CompiledAltairSpec"))
+    assert strip(shape("Phase0Spec")) == strip(shape("CompiledPhase0Spec"))
+
+
+def test_graph_compiled_provenance_parsed(tmp_path):
+    g = _ladder_tree(tmp_path)
+    mod = g.modules["consensus_specs_tpu/forks/compiled/altair.py"]
+    assert mod.provenance == "specs/altair/beacon-chain.md"
+    assert g.modules["consensus_specs_tpu/forks/altair.py"].provenance \
+        is None
+
+
+def test_graph_lazy_module_alias_edges(tmp_path):
+    root = tmp_path / "repo"
+    _write(root, "consensus_specs_tpu/ops/a.py",
+           "def entry(x):\n"
+           "    from consensus_specs_tpu.ops import b\n"
+           "    return b.work(x)\n")
+    _write(root, "consensus_specs_tpu/ops/b.py",
+           "def work(x):\n    return x\n")
+    g = ProjectGraph(driver.Context(str(root)))
+    fn = g.modules["consensus_specs_tpu/ops/a.py"].funcs["entry"]
+    assert {c.qname for c in g.callees(fn)} \
+        == {"consensus_specs_tpu/ops/b.py::work"}
+
+
+def test_dataflow_worklist_converges():
+    """Literal facts propagate through a three-deep call chain and the
+    solver stops at the fixed point."""
+    edges = {"a": {"b"}, "b": {"c"}, "c": set()}
+    base = {"c": {"seed"}, "b": set(), "a": set()}
+
+    def transfer(fn, get):
+        out = set(base[fn])
+        for callee in edges[fn]:
+            out |= get(callee) or set()
+        return frozenset(out)
+
+    out = dataflow.solve(["a", "b", "c"], lambda f: edges[f], transfer)
+    assert out["a"] == {"seed"}
+
+
+# ---------------------------------------------------------------------------
+# U9xx range prover
+# ---------------------------------------------------------------------------
+
+def _verdicts(src):
+    [(fn, fr)] = rangeproof.analyze_source(SCOPED, src)
+    return {lineno: v for (lineno, _c), (v, _r)
+            in fr.sub_verdicts.items()}
+
+
+def test_ranges_x_minus_x_safe():
+    assert _verdicts("def f(seq):\n"
+                     "    b = u64_column(seq)\n"
+                     "    return b - b\n") == {3: "safe"}
+
+
+def test_ranges_division_chain_safe():
+    """a - a // q with q >= 1: the relational chain, not intervals."""
+    src = ("# speclint: invariant: q >= 1\n"
+           "def f(b, q):\n"
+           "    p = b // q\n"
+           "    return b - p\n")
+    assert _verdicts(src) == {4: "safe"}
+
+
+def test_ranges_multiplication_needs_guard_discharge():
+    """BRPE * b >= b only holds when the multiply itself cannot wrap —
+    the guarded-by-caller pragma (or a _guard call) is the license."""
+    body = ("def f(b, brpe, q):\n"
+            "    p = b // q\n"
+            "    return brpe * b - p\n")
+    inv = ("# speclint: invariant: brpe >= 1\n"
+           "# speclint: invariant: q >= 1\n")
+    assert _verdicts(inv + body)[5] == "unknown"
+    pragma = "# speclint: guarded-by-caller (bounded)\n"
+    assert _verdicts(pragma + inv + body)[6] == "safe"
+
+
+def test_ranges_subscript_preserves_relation():
+    src = ("# speclint: invariant: q >= 1\n"
+           "def f(b, q, idx):\n"
+           "    p = b // q\n"
+           "    return b[idx] - p[idx]\n")
+    assert _verdicts(src) == {4: "safe"}
+    # a DIFFERENT index on each side must NOT inherit the relation
+    src2 = ("# speclint: invariant: q >= 1\n"
+            "def f(b, q, i, j):\n"
+            "    p = b // q\n"
+            "    return b[i] - p[j]\n")
+    assert _verdicts(src2) == {4: "unknown"}
+
+
+def test_ranges_rebinding_kills_relation():
+    src = ("# speclint: invariant: q >= 1\n"
+           "def f(b, q, seq):\n"
+           "    p = b // q\n"
+           "    b = u64_column(seq)\n"
+           "    return b - p\n")
+    assert _verdicts(src) == {5: "unknown"}
+
+
+def test_ranges_interval_proof_and_overflow():
+    safe = ("# speclint: invariant: a >= 1000\n"
+            "# speclint: invariant: b <= 10\n"
+            "def f(a, b):\n"
+            "    return a - b\n")
+    assert _verdicts(safe) == {4: "safe"}
+    bad = ("# speclint: invariant: a <= 10\n"
+           "# speclint: invariant: b >= 1000\n"
+           "def f(a, b):\n"
+           "    return a - b\n")
+    assert _verdicts(bad) == {4: "overflow"}
+    assert "U901" in _codes(rangeproof.check_source(SCOPED, bad))
+
+
+def test_ranges_invariant_applies_to_opaque_assignment():
+    """`prq = int(spec.X)` is opaque; the declared invariant still
+    narrows it — the real epoch-kernel shape."""
+    src = ("def f(spec, b):\n"
+           "    # speclint: invariant: prq >= 1\n"
+           "    prq = int(spec.PROPOSER_REWARD_QUOTIENT)\n"
+           "    p = b // prq\n"
+           "    return b - p\n")
+    assert _verdicts(src) == {5: "safe"}
+
+
+def test_ranges_invariant_errors_are_u902():
+    for inv in ("# speclint: invariant: a >=\n",
+                "# speclint: invariant: a + b\n",
+                "# speclint: invariant: a <= b\n",
+                "# speclint: invariant: 5 <= a <= 3\n"):
+        src = inv + "def f(a, b):\n    return a\n"
+        assert _codes(rangeproof.check_source(SCOPED, src)) == ["U902"], inv
+    ok = ("# speclint: invariant: 1 <= a <= MAX_EFFECTIVE_BALANCE\n"
+          "def f(a, b):\n    return a\n")
+    assert rangeproof.check_source(SCOPED, ok) == []
+
+
+def test_ranges_redundant_noqa_is_u903():
+    src = ("def f(b):\n"
+           "    return b - b  # noqa: U101\n")
+    findings = rangeproof.check_source(SCOPED, src)
+    assert _codes(findings) == ["U903"]
+    # a noqa on a genuinely unprovable subtraction is NOT redundant
+    src2 = ("def f(b, p):\n"
+            "    return b - p  # noqa: U101\n")
+    assert rangeproof.check_source(SCOPED, src2) == []
+
+
+def test_uint64_u101_discharged_by_prover():
+    """The integration the pragmas were demoted for: a taint-flagged
+    subtraction the prover certifies no longer fires U101."""
+    src = ("# speclint: invariant: q >= 1\n"
+           "def f(seq, q):\n"
+           "    b = u64_column(seq)\n"
+           "    p = b // q\n"
+           "    return b - p\n")
+    assert "U101" not in _codes(uint64.check_source(SCOPED, src))
+    unproven = ("def f(seq, q):\n"
+                "    b = u64_column(seq)\n"
+                "    p = b // q\n"
+                "    return b - p\n")   # q >= 1 NOT declared
+    assert "U101" in _codes(uint64.check_source(SCOPED, unproven))
+
+
+def test_real_epoch_kernel_subtractions_proven():
+    """Acceptance: the two historically noqa'd epoch-kernel
+    subtractions carry machine-checked proofs, their pragmas are gone,
+    and the whole scoped tree has zero false overflow reports."""
+    with open(os.path.join(REPO, SCOPED)) as f:
+        text = f.read()
+    assert "noqa: U101" not in text, \
+        "the safe-subtraction pragmas were supposed to be demoted"
+    results = rangeproof.analyze_source(SCOPED, text)
+    proven = {
+        (fn.name, lineno): verdict
+        for fn, fr in results
+        for (lineno, _c), (verdict, _r) in fr.sub_verdicts.items()}
+    assert any(fn == "phase0_inactivity_kernel" and v == "safe"
+               for (fn, _), v in proven.items())
+    assert any(fn == "_phase0_rewards_and_penalties" and v == "safe"
+               for (fn, _), v in proven.items())
+    ctx = driver.Context(REPO)
+    findings = [f for rel in ctx.py_files if rangeproof.in_scope(rel)
+                for f in rangeproof.check_source(rel, ctx.source(rel))]
+    assert findings == [], \
+        f"U9xx must be baseline-zero on the repo: {findings}"
+
+
+# ---------------------------------------------------------------------------
+# D10xx determinism pass
+# ---------------------------------------------------------------------------
+
+def _det_tree(tmp_path, helper_body, helper_name="work"):
+    root = tmp_path / "repo"
+    _write(root, "consensus_specs_tpu/forks/foo.py",
+           "from consensus_specs_tpu.ops import eng\n"
+           "class FooSpec:\n"
+           "    def process_thing(self, state):\n"
+           f"        return eng.{helper_name}(state)\n")
+    _write(root, "consensus_specs_tpu/ops/eng.py", helper_body)
+    return driver.Context(str(root))
+
+
+def test_determinism_flags_set_order_escape(tmp_path):
+    ctx = _det_tree(tmp_path,
+                    "def work(state):\n"
+                    "    s = set(state)\n"
+                    "    return list(s)\n")
+    findings = determinism.run(ctx)
+    assert _codes(findings) == ["D1001"]
+    assert "reachable from FooSpec.process_thing" in findings[0].message
+
+
+def test_determinism_sorted_and_folds_exempt(tmp_path):
+    ctx = _det_tree(tmp_path,
+                    "def work(state):\n"
+                    "    s = set(state)\n"
+                    "    total = 0\n"
+                    "    for x in s:\n"
+                    "        total += x\n"       # order-insensitive fold
+                    "    return sorted(s), total\n")
+    assert determinism.run(ctx) == []
+
+
+def test_determinism_flags_order_sensitive_set_loop(tmp_path):
+    ctx = _det_tree(tmp_path,
+                    "def work(state):\n"
+                    "    out = []\n"
+                    "    for x in set(state):\n"
+                    "        out.append(x)\n"
+                    "    return out\n")
+    assert _codes(determinism.run(ctx)) == ["D1001"]
+
+
+def test_determinism_flags_float_and_division(tmp_path):
+    ctx = _det_tree(tmp_path,
+                    "def work(state):\n"
+                    "    half = state * 0.5\n"
+                    "    return half + state / 2\n")
+    assert _codes(determinism.run(ctx)) == ["D1002", "D1002"]
+
+
+def test_determinism_flags_ambient_reads(tmp_path):
+    ctx = _det_tree(tmp_path,
+                    "import os, time, random\n"
+                    "def work(state):\n"
+                    "    t = time.time()\n"
+                    "    r = random.random()\n"
+                    "    e = os.environ.get('X')\n"
+                    "    return t, r, e, state\n")
+    assert _codes(determinism.run(ctx)) == ["D1003", "D1003", "D1003"]
+
+
+def test_determinism_flags_id_keys_and_builtin_hash(tmp_path):
+    ctx = _det_tree(tmp_path,
+                    "_CACHE = {}\n"
+                    "def work(state):\n"
+                    "    _CACHE[id(state)] = 1\n"
+                    "    return hash('x')\n")
+    assert _codes(determinism.run(ctx)) == ["D1004", "D1005"]
+
+
+def test_determinism_spec_hash_shadow_exempt(tmp_path):
+    ctx = _det_tree(tmp_path,
+                    "from consensus_specs_tpu.utils.hash_function "
+                    "import hash\n"
+                    "def work(state):\n"
+                    "    return hash(state)\n")
+    assert determinism.run(ctx) == []
+
+
+def test_determinism_unreachable_code_not_flagged(tmp_path):
+    """The reachability half: the same hazard in a function nothing on
+    a consensus path calls stays quiet."""
+    ctx = _det_tree(tmp_path,
+                    "def work(state):\n"
+                    "    return state\n"
+                    "def bench_helper(state):\n"
+                    "    import time\n"
+                    "    return time.time()\n")
+    assert determinism.run(ctx) == []
+
+
+def test_determinism_compiled_modules_not_double_reported(tmp_path):
+    root = tmp_path / "repo"
+    _write(root, "consensus_specs_tpu/forks/foo.py",
+           "class FooSpec:\n"
+           "    def process_thing(self, state):\n"
+           "        return state / 2\n")
+    _write(root, "consensus_specs_tpu/forks/compiled/foo.py",
+           '"""AUTO-COMPILED from specs/foo.md"""\n'
+           "class CompiledFooSpec:\n"
+           "    def process_thing(self, state):\n"
+           "        return state / 2\n")
+    findings = determinism.run(driver.Context(str(root)))
+    assert _codes(findings) == ["D1002"]
+    assert findings[0].path == "consensus_specs_tpu/forks/foo.py"
+
+
+def test_determinism_real_tree_clean():
+    """Acceptance half of satellite 1: after the das table-key,
+    env-knob and kzg integer-math fixes, the consensus surface is
+    determinism-clean."""
+    assert determinism.run(driver.Context(REPO)) == []
+
+
+# ---------------------------------------------------------------------------
+# C11xx engine-coverage pass
+# ---------------------------------------------------------------------------
+
+_FIXTURE_FAULTS = (
+    "class InjectedFault(BaseException):\n"
+    "    pass\n"
+    "SITES = (\n"
+    "    'demo.dispatch',\n"
+    ")\n"
+    "SITE_SWITCHES = {\n"
+    "    'demo.': 'CS_TPU_DEMO',\n"
+    "}\n"
+    "def check(site):\n    pass\n"
+    "def count_fallback(series, exc=None, organic='guard', site=None):\n"
+    "    pass\n")
+
+# the epoch shape: the literal flows through a shared helper's
+# parameter, so proving the contract REQUIRES the interprocedural
+# literal-flow solve
+_FIXTURE_ENGINE = (
+    "from consensus_specs_tpu import faults, supervisor\n"
+    "def _supervised(spec, state, site, fast_fn):\n"
+    "    if not supervisor.admit(site):\n"
+    "        return False\n"
+    "    try:\n"
+    "        faults.check(site)\n"
+    "        fast_fn(state)\n"
+    "    except faults.InjectedFault as exc:\n"
+    "        faults.count_fallback(_F, exc, site=site)\n"
+    "        return False\n"
+    "    return True\n"
+    "def try_demo(spec, state):\n"
+    "    return _supervised(spec, state, 'demo.dispatch', kernel)\n"
+    "def kernel(state):\n"
+    "    return state\n")
+_FIXTURE_TEST = (
+    "def test_demo_differential():\n"
+    "    assert 'demo.dispatch'\n")
+_FIXTURE_WORKFLOW = (
+    "jobs:\n"
+    "  off-leg:\n"
+    "    steps:\n"
+    "      - run: CS_TPU_DEMO=0 python -m pytest tests/ -q\n")
+
+
+def _cov_tree(tmp_path, *, faults_text=_FIXTURE_FAULTS,
+              engine=_FIXTURE_ENGINE, test=_FIXTURE_TEST,
+              workflow=_FIXTURE_WORKFLOW):
+    root = tmp_path / "repo"
+    _write(root, "consensus_specs_tpu/faults.py", faults_text)
+    if engine is not None:
+        _write(root, "consensus_specs_tpu/ops/eng.py", engine)
+    if test is not None:
+        _write(root, "tests/test_demo.py", test)
+    if workflow is not None:
+        _write(root, ".github/workflows/run-tests.yml", workflow)
+    return str(root)
+
+
+def test_coverage_full_contract_is_clean(tmp_path):
+    assert coverage.check_tree(_cov_tree(tmp_path)) == []
+
+
+def test_coverage_missing_each_leg_fires(tmp_path):
+    # no dispatch at all: C1101/C1102/C1103/C1104 in one shot
+    codes = _codes(coverage.check_tree(_cov_tree(
+        tmp_path, engine="def unrelated():\n    pass\n")))
+    assert {"C1101", "C1102", "C1103", "C1104"} <= set(codes)
+
+    # counted fallback dropped
+    no_count = _FIXTURE_ENGINE.replace(
+        "        faults.count_fallback(_F, exc, site=site)\n", "")
+    codes = _codes(coverage.check_tree(
+        _cov_tree(tmp_path / "b", engine=no_count)))
+    assert codes == ["C1102"]
+
+    # supervisor gate dropped
+    no_admit = _FIXTURE_ENGINE.replace(
+        "    if not supervisor.admit(site):\n"
+        "        return False\n", "    pass\n")
+    codes = _codes(coverage.check_tree(
+        _cov_tree(tmp_path / "c", engine=no_admit)))
+    assert codes == ["C1103"]
+
+    # fallback handler dropped (count moved out of a handler)
+    no_handler = (
+        "from consensus_specs_tpu import faults, supervisor\n"
+        "def try_demo(spec, state):\n"
+        "    site = 'demo.dispatch'\n"
+        "    supervisor.admit(site)\n"
+        "    faults.check(site)\n"
+        "    faults.count_fallback(_F, None, site=site)\n")
+    codes = _codes(coverage.check_tree(
+        _cov_tree(tmp_path / "d", engine=no_handler)))
+    assert codes == ["C1104"]
+
+    # differential test reference dropped
+    codes = _codes(coverage.check_tree(
+        _cov_tree(tmp_path / "e", test="def test_other():\n    pass\n")))
+    assert codes == ["C1105"]
+
+    # CI off-leg dropped
+    codes = _codes(coverage.check_tree(_cov_tree(
+        tmp_path / "f",
+        workflow=_FIXTURE_WORKFLOW.replace("CS_TPU_DEMO=0", ""))))
+    assert codes == ["C1106"]
+
+
+def test_coverage_site_without_switch_family(tmp_path):
+    faults_text = _FIXTURE_FAULTS.replace(
+        "    'demo.': 'CS_TPU_DEMO',\n", "    'other.': 'CS_TPU_OTHER',\n")
+    codes = _codes(coverage.check_tree(
+        _cov_tree(tmp_path, faults_text=faults_text)))
+    assert "C1100" in codes
+
+
+def test_coverage_unregistered_site_is_c1107(tmp_path):
+    rogue = _FIXTURE_ENGINE + (
+        "def try_rogue(spec, state):\n"
+        "    return _supervised(spec, state, 'rogue.site', kernel)\n")
+    findings = coverage.check_tree(_cov_tree(tmp_path, engine=rogue))
+    assert [f.code for f in findings] == ["C1107"]
+    assert "rogue.site" in findings[0].message
+    assert findings[0].path == "consensus_specs_tpu/ops/eng.py"
+
+
+def test_coverage_findings_anchor_at_sites_tuple(tmp_path):
+    findings = coverage.check_tree(_cov_tree(
+        tmp_path, test="def test_other():\n    pass\n"))
+    (f,) = findings
+    assert f.path == "consensus_specs_tpu/faults.py"
+    assert f.line == 4      # the 'demo.dispatch' tuple entry line
+
+
+def test_coverage_absent_faults_module_is_quiet(tmp_path):
+    root = tmp_path / "repo"
+    _write(root, "consensus_specs_tpu/ops/eng.py", "x = 1\n")
+    assert coverage.check_tree(str(root)) == []
+
+
+def test_coverage_real_tree_baseline_zero():
+    """THE acceptance criterion: every faults.SITES entry proves the
+    full contract — dispatch + counted fallback + supervisor gate +
+    degradation handler + differential reference + CI off-leg — on the
+    real tree, with nothing noqa'd or baselined."""
+    findings = coverage.run(driver.Context(REPO))
+    assert findings == [], "\n".join(f.render() for f in findings)
+    # and non-vacuously: the solver really resolved every site
+    from consensus_specs_tpu import faults
+    graph = driver.Context(REPO).project_graph()
+    site_facts, _ = coverage.solve_site_facts(graph)
+    for site in faults.SITES:
+        assert {"check", "count", "admit", "handler"} \
+            <= site_facts.get(site, set()), site
+
+
+# ---------------------------------------------------------------------------
+# SARIF output
+# ---------------------------------------------------------------------------
+
+def test_sarif_real_run_validates():
+    ctx = driver.Context(REPO)
+    findings = driver.run_passes(ctx)
+    baseline = driver.load_baseline(os.path.join(REPO,
+                                                 driver.BASELINE_NAME))
+    new, baselined, _ = driver.apply_baseline(findings, baseline)
+    log = sarif.to_sarif(new, baselined)
+    assert log["version"] == "2.1.0"
+    assert sarif.validate(log) == []
+    # the recorded debt must surface as unchanged results
+    states = {r["baselineState"] for r in log["runs"][0]["results"]}
+    assert states <= {"new", "unchanged"} and "unchanged" in states
+    rule_ids = {r["id"] for r in log["runs"][0]["tool"]["driver"]["rules"]}
+    assert {r["ruleId"] for r in log["runs"][0]["results"]} <= rule_ids
+
+
+def test_sarif_validator_rejects_malformed():
+    assert sarif.validate({"version": "1.0", "runs": []}) != []
+    assert sarif.validate({"version": "2.1.0"}) != []
+    bad = sarif.to_sarif([], [])
+    bad["runs"][0]["results"] = [{"message": {}}]
+    assert sarif.validate(bad) != []
+
+
+def test_sarif_driver_format(tmp_path, capsys):
+    root = tmp_path / "repo"
+    _write(root, SCOPED,
+           "def f(seq):\n"
+           "    b = u64_column(seq)\n"
+           "    p = u64_column(seq)\n"
+           "    return b - p\n")
+    rc = driver.main([str(root), "--passes", "uint64", "--format",
+                      "sarif", "--no-baseline"])
+    assert rc == 1
+    log = json.loads(capsys.readouterr().out)
+    assert sarif.validate(log) == []
+    (result,) = log["runs"][0]["results"]
+    assert result["ruleId"] == "U101"
+    assert result["baselineState"] == "new"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == SCOPED
+    assert loc["region"]["startLine"] == 4
+
+
+# ---------------------------------------------------------------------------
+# Incremental cache
+# ---------------------------------------------------------------------------
+
+def _cache_stats(root, *args):
+    c = sl_cache.AnalysisCache(os.path.join(root, sl_cache.CACHE_NAME),
+                               "salt")
+    return c
+
+
+def test_cache_warm_run_reuses_everything(tmp_path):
+    root = tmp_path / "repo"
+    _write(root, SCOPED,
+           "def f(seq):\n"
+           "    b = u64_column(seq)\n"
+           "    p = u64_column(seq)\n"
+           "    return b - p\n")
+    assert driver.main([str(root), "--no-baseline"]) == 1
+    ctx = driver.Context(str(root))
+    cache = sl_cache.AnalysisCache(
+        os.path.join(str(root), sl_cache.CACHE_NAME),
+        driver._pass_salt())
+    findings = driver.run_passes(ctx, cache=cache)
+    assert cache.stats["file_misses"] == 0
+    assert cache.stats["tree_misses"] == 0
+    assert [f.code for f in findings] == ["U101"]
+
+
+def test_cache_invalidates_on_edit_and_salt(tmp_path):
+    root = tmp_path / "repo"
+    _write(root, SCOPED, "def f(seq):\n    return u64_column(seq)\n")
+    _write(root, "consensus_specs_tpu/utils/other.py", "x = 1\n")
+    assert driver.main([str(root)]) == 0
+    # edit ONE file: only its entries miss; the other file stays warm
+    _write(root, SCOPED,
+           "def f(seq):\n"
+           "    b = u64_column(seq)\n"
+           "    p = u64_column(seq)\n"
+           "    return b - p\n")
+    ctx = driver.Context(str(root))
+    cache = sl_cache.AnalysisCache(
+        os.path.join(str(root), sl_cache.CACHE_NAME),
+        driver._pass_salt())
+    findings = driver.run_passes(ctx, cache=cache)
+    assert [f.code for f in findings] == ["U101"]
+    assert cache.stats["file_hits"] > 0          # the untouched file
+    assert cache.stats["file_misses"] > 0        # the edited one
+    assert cache.stats["tree_misses"] > 0        # tree fingerprint moved
+    # a salt change (pass version bump) drops the whole store
+    stale = sl_cache.AnalysisCache(
+        os.path.join(str(root), sl_cache.CACHE_NAME), "other-salt")
+    assert stale.get_file(SCOPED, ctx.sha(SCOPED), "uint64") is None
+
+
+def test_cache_findings_roundtrip_suppression(tmp_path):
+    """Cached findings are pre-noqa; the driver re-applies suppression
+    after retrieval, so a cache hit behaves exactly like a fresh run."""
+    root = tmp_path / "repo"
+    _write(root, SCOPED,
+           "def f(seq):\n"
+           "    b = u64_column(seq)\n"
+           "    p = u64_column(seq)\n"
+           "    return b - p  # noqa: U101\n")
+    assert driver.main([str(root), "--no-baseline"]) == 0
+    assert driver.main([str(root), "--no-baseline"]) == 0   # warm
+
+
+# ---------------------------------------------------------------------------
+# --fix autofixer
+# ---------------------------------------------------------------------------
+
+def test_fix_u103_adds_dtype():
+    src = ("import numpy as np\n"
+           "def f(mask):\n"
+           "    n = mask.sum()\n"
+           "    k = mask.sum(dtype=np.int64)\n")
+    fixed, n = fixer.fix_u103(SCOPED, src)
+    assert n == 1
+    assert "mask.sum(dtype=np.int64)\n    k" in fixed
+    # idempotent + out-of-scope untouched
+    assert fixer.fix_u103(SCOPED, fixed) == (fixed, 0)
+    assert fixer.fix_u103("consensus_specs_tpu/sim/x.py", src)[1] == 0
+
+
+def test_fix_noqa_normalizes_real_comments_only():
+    src = ("x = 1  #noqa:u101,j203\n"
+           "y = 2  # NOQA\n"
+           'DOC = """example: #noqa:u101 stays as-is"""\n')
+    fixed, n = fixer.fix_noqa(src)
+    assert "x = 1  # noqa: U101, J203\n" in fixed
+    assert "y = 2  # noqa\n" in fixed
+    assert '#noqa:u101 stays as-is' in fixed      # docstring untouched
+    assert n == 2
+    assert fixer.fix_noqa(fixed) == (fixed, 0)    # idempotent
+
+
+def test_fix_noqa_keeps_justification_text():
+    src = "b = a - c  # noqa: u101 with a bound argument\n"
+    fixed, n = fixer.fix_noqa(src)
+    assert fixed == "b = a - c  # noqa: U101 with a bound argument\n"
+    assert n == 1
+    # an unparsable code list is left alone, not mangled
+    weird = "x = 1  # noqa: D100x\n"
+    assert fixer.fix_noqa(weird) == (weird, 0)
+
+
+def test_fix_import_hoist_removes_redundant_only():
+    src = ("import hashlib\n"
+           "def f(x):\n"
+           "    import hashlib\n"
+           "    return hashlib.sha256(x)\n"
+           "def g(x):\n"
+           "    import secrets\n"        # NOT at top: deliberate lazy
+           "    return secrets.token_bytes(4)\n")
+    fixed, n = fixer.fix_import_hoist("m.py", src)
+    assert n == 1
+    assert fixed.count("import hashlib") == 1
+    assert "    import secrets" in fixed          # lazy import kept
+    assert fixer.fix_import_hoist("m.py", fixed) == (fixed, 0)
+
+
+def test_fix_tree_end_to_end(tmp_path):
+    root = tmp_path / "repo"
+    messy = ("import numpy as np\n"
+             "def f(mask):\n"
+             "    import numpy as np  # kept: aliased, not plain\n"
+             "    return mask.sum()  #noqa:u103\n")
+    _write(root, SCOPED, messy)
+    _write(root, "tests/test_fixture.py", "S = 'x = 1  #noqa:u101'\n")
+    rc = driver.main([str(root), "--fix"])
+    assert rc == 0
+    with open(os.path.join(str(root), SCOPED)) as f:
+        fixed = f.read()
+    assert "mask.sum(dtype=np.int64)  # noqa: U103" in fixed
+    # tests/ fixtures excluded
+    with open(os.path.join(str(root), "tests/test_fixture.py")) as f:
+        assert f.read() == "S = 'x = 1  #noqa:u101'\n"
+    # second --fix is a no-op
+    driver.main([str(root), "--fix"])
+    with open(os.path.join(str(root), SCOPED)) as f:
+        assert f.read() == fixed
+
+
+def test_fix_is_noop_on_real_tree():
+    """The repo itself carries no mechanically-fixable debt (and --fix
+    must never churn it)."""
+    from consensus_specs_tpu.tools.speclint.astutil import is_generated
+    ctx = driver.Context(REPO)
+    for rel in ctx.py_files:
+        if rel.startswith(fixer._FIX_EXCLUDE):
+            continue
+        text = ctx.source(rel)
+        if is_generated(text):
+            continue
+        fixed, _counts = fixer.fix_text(rel, text)
+        assert fixed == text, f"--fix would modify {rel}"
+
+
+# ---------------------------------------------------------------------------
+# driver surface
+# ---------------------------------------------------------------------------
+
+def test_range_verdicts_cli(capsys):
+    assert driver.main([REPO, "--range-verdicts"]) == 0
+    out = capsys.readouterr().out
+    assert "phase0_inactivity_kernel" in out
+    assert "[safe]" in out
+
+
+def test_baseline_guard_matches_conftest_contract():
+    """The checked-in ratchet file satisfies the conftest deflake
+    guard's invariants (sorted, deduped, positive counts)."""
+    path = os.path.join(REPO, "speclint_baseline.json")
+    with open(path) as f:
+        raw = f.read()
+    pairs = json.loads(
+        raw, object_pairs_hook=lambda ps: ps)
+    # top-level: comment + counts
+    counts = dict(pairs)["counts"]
+    keys = [k for k, _ in counts]
+    assert keys == sorted(keys)
+    assert len(keys) == len(set(keys))
+    assert all(isinstance(v, int) and v >= 1 for _, v in counts)
+
+
+# ---------------------------------------------------------------------------
+# review regressions
+# ---------------------------------------------------------------------------
+
+def test_ranges_inplace_mutation_kills_stale_interval():
+    """Review regression: `pen[idx] += big` (and np.add.at) must
+    invalidate pen's abstract value — a later `rewards - pen` was
+    falsely proven safe against pen's stale zeros() interval."""
+    src = ("import numpy as np\n"
+           "def f(seq, idx, big):\n"
+           "    rewards = u64_column(seq)\n"
+           "    pen = np.zeros(4, dtype=np.uint64)\n"
+           "    pen[idx] += big\n"
+           "    return rewards - pen\n")
+    assert _verdicts(src)[6] == "unknown"
+    assert "U101" in _codes(uint64.check_source(SCOPED, src))
+    scatter = ("import numpy as np\n"
+               "def f(seq, idx, big):\n"
+               "    rewards = u64_column(seq)\n"
+               "    pen = np.zeros(4, dtype=np.uint64)\n"
+               "    np.add.at(pen, idx, big)\n"
+               "    return rewards - pen\n")
+    assert _verdicts(scatter)[6] == "unknown"
+    # while an UNtouched zeros() interval still proves safe
+    clean = ("import numpy as np\n"
+             "def f(seq):\n"
+             "    rewards = u64_column(seq)\n"
+             "    pen = np.zeros(4, dtype=np.uint64)\n"
+             "    return rewards - pen\n")
+    assert _verdicts(clean)[5] == "safe"
+
+
+def test_coverage_handler_in_caller_of_literal_dispatch(tmp_path):
+    """Review regression: an engine whose helper dispatches the site
+    literal INLINE (no site parameter) with the fallback handler in
+    the caller must still prove the C1104 leg."""
+    engine = (
+        "from consensus_specs_tpu import faults, supervisor\n"
+        "def _dispatch(state):\n"
+        "    supervisor.admit('demo.dispatch')\n"
+        "    faults.check('demo.dispatch')\n"
+        "    return state\n"
+        "def entry(state):\n"
+        "    try:\n"
+        "        return _dispatch(state)\n"
+        "    except faults.InjectedFault as exc:\n"
+        "        faults.count_fallback(_F, exc, site='demo.dispatch')\n"
+        "        return state\n")
+    assert coverage.check_tree(_cov_tree(tmp_path, engine=engine)) == []
+
+
+def test_fix_import_hoist_never_empties_a_body():
+    """Review regression: deleting a function's only statement (or all
+    of them) must not emit an unparsable empty body."""
+    import ast as _ast
+    sole = ("import os\n"
+            "def probe():\n"
+            "    import os\n")
+    fixed, n = fixer.fix_import_hoist("m.py", sole)
+    _ast.parse(fixed)
+    assert n == 0 and "def probe():" in fixed
+    double = ("import os\n"
+              "import sys\n"
+              "def probe():\n"
+              "    import os\n"
+              "    import sys\n")
+    fixed, n = fixer.fix_import_hoist("m.py", double)
+    _ast.parse(fixed)
+    assert n == 1     # one deleted, one kept so the body stays valid
+
+
+def test_ranges_memo_shared_between_passes(tmp_path):
+    """Review cleanup: one FunctionRanges per function per run — the
+    uint64 discharge and the U9xx pass share the Context memo."""
+    root = tmp_path / "repo"
+    _write(root, SCOPED,
+           "def f(seq):\n"
+           "    b = u64_column(seq)\n"
+           "    return b - b\n")
+    ctx = driver.Context(str(root))
+    ctx.ranges_memo = {}
+    assert uint64.check_file(ctx, SCOPED) == []
+    assert rangeproof.check_file(ctx, SCOPED) == []
+    assert len(ctx.ranges_memo) == 1      # analyzed once, served twice
