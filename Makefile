@@ -9,7 +9,7 @@ GENERATORS = operations sanity finality rewards random forks epoch_processing \
 .PHONY: test citest test-crypto bench bench-all bench-merkle-smoke \
         bench-forkchoice-smoke bench-obs-smoke bench-block-smoke \
         bench-state-smoke bench-supervisor-smoke bench-das-smoke \
-        sim-smoke sim-heavy \
+        bench-mesh-smoke sim-smoke sim-heavy \
         obs-report dryrun warm native lint speclint-baseline \
         generate_tests $(addprefix gen_,$(GENERATORS)) clean-vectors pyspec
 
@@ -35,6 +35,7 @@ citest:
 	$(PYTHON) benchmarks/bench_state_arrays.py --smoke
 	$(PYTHON) benchmarks/bench_supervisor.py
 	$(PYTHON) benchmarks/bench_das.py
+	$(PYTHON) benchmarks/bench_mesh.py
 	$(MAKE) sim-smoke
 	$(PYTHON) -m pytest tests/ -q --enable-bls --bls-type fastest
 
@@ -164,6 +165,18 @@ bench-obs-smoke:
 bench-das-smoke:
 	-$(MAKE) native
 	$(PYTHON) benchmarks/bench_das.py
+
+# mesh-engine smoke (docs/sharding.md): on the 8-way host-device mesh
+# (XLA_FLAGS below), a full epoch transition must run every
+# sub-transition through the SPMD programs with EXACTLY the budgeted
+# psum count per sub-transition (mesh.psums counter-asserted against
+# mesh_epoch.PSUM_BUDGET, psum census proven structurally on the
+# jaxprs), commit byte-identical state roots mesh-on vs mesh-off vs
+# spec loop, and show near-linear (>= 6x at 8 shards) per-shard kernel
+# scaling on 1M-validator columns; nonzero exit on any regression
+bench-mesh-smoke:
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		$(PYTHON) benchmarks/bench_mesh.py
 
 # engine-supervisor smoke (docs/robustness.md): counter-asserted
 # breaker lifecycle on a real dispatch site (threshold trips ->

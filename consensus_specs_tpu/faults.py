@@ -65,6 +65,8 @@ SITES = (
     "bls.flush",
     "das.verify",
     "das.recover",
+    "mesh.epoch",
+    "mesh.merkle",
 )
 
 # Site-family -> the CS_TPU_* switch that turns the family's engine
@@ -79,6 +81,7 @@ SITE_SWITCHES = {
     "state_arrays.": "CS_TPU_STATE_ARRAYS",
     "bls.": "CS_TPU_BLS_RLC",
     "das.": "CS_TPU_DAS",
+    "mesh.": "CS_TPU_MESH",
 }
 
 _active = None      # the armed schedule; None = disarmed (the hot path)

@@ -556,14 +556,12 @@ def rlc_combined_check(pk_rows, msgs, sig_pts, scalars, extra_pairs=(),
             agg = _j_tree_sum(pk_pts)
             aggp, agg_inf = _j_g1_normalize_flag(_j_g1_scale(agg, bits))
             # signature side: the G2 MSM (points-sharded when a mesh is
-            # registered and the padded batch divides across it)
-            g2_msm = None
-            if mesh_devices and bucket % len(tuple(mesh_devices)) == 0:
+            # registered; uneven batches pad with identity lanes, so
+            # ANY bucket size shards across ANY device count)
+            if mesh_devices:
                 from consensus_specs_tpu.parallel import sharded_verify
-                g2_msm = sharded_verify.sharded_g2_msm_for(
-                    tuple(mesh_devices))
-            if g2_msm is not None:
-                s_total = g2_msm(sig_packed, bits)
+                s_total = sharded_verify.sharded_g2_msm_padded(
+                    sig_packed, bits, tuple(mesh_devices))
             else:
                 s_total = _j_g2_scale_sum(sig_packed, bits)
             s_total = jax.tree_util.tree_map(lambda a: a[None], s_total)

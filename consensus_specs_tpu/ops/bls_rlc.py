@@ -118,13 +118,23 @@ _MESH_DEVICES = None
 
 def use_mesh(devices) -> None:
     """Register a 1D device tuple: the jax-path signature MSM shards its
-    point axis across it (``parallel.sharded_verify.make_sharded_g2_msm``).
-    Pass ``None`` to go back to the single-device program."""
+    point axis across it (``parallel.sharded_verify`` — any batch size,
+    uneven shards padded with identity lanes).  Pass ``"auto"`` to
+    derive the mesh shape from ``jax.devices()`` live at every flush
+    (the serving-deployment mode: nothing hardcodes a device count);
+    pass ``None`` to go back to the single-device program."""
     global _MESH_DEVICES
-    _MESH_DEVICES = tuple(devices) if devices else None
+    if devices == "auto":
+        _MESH_DEVICES = "auto"
+    else:
+        _MESH_DEVICES = tuple(devices) if devices else None
 
 
 def mesh_devices():
+    if _MESH_DEVICES == "auto":
+        import jax
+        devs = tuple(jax.devices())
+        return devs if len(devs) > 1 else None
     return _MESH_DEVICES
 
 
@@ -234,7 +244,7 @@ def _check_jax(items, extra_checks, scalars):
         return True
     return bls_jax.rlc_combined_check(
         pk_rows, msgs, sig_pts, scalars[:n], extra_pairs=extra_pairs,
-        mesh_devices=_MESH_DEVICES)
+        mesh_devices=mesh_devices())
 
 
 _COMBINERS = {"py": _check_py, "native": _check_native, "jax": _check_jax}

@@ -387,7 +387,10 @@ def _supervised(spec, state, site, method_name, fast_fn) -> bool:
 # ---------------------------------------------------------------------------
 
 def _fast_rewards_and_penalties(spec, state) -> bool:
-    if "altair" in _fork_lineage(spec):
+    from consensus_specs_tpu.parallel import mesh_epoch
+    if mesh_epoch.try_rewards_and_penalties(spec, state):
+        pass    # SPMD program committed the balance column
+    elif "altair" in _fork_lineage(spec):
         _altair_rewards_and_penalties(spec, state)
     else:
         _phase0_rewards_and_penalties(spec, state)
@@ -617,6 +620,9 @@ def _altair_rewards_and_penalties(spec, state) -> None:
 # ---------------------------------------------------------------------------
 
 def _fast_inactivity_updates(spec, state) -> bool:
+    from consensus_specs_tpu.parallel import mesh_epoch
+    if mesh_epoch.try_inactivity_updates(spec, state):
+        return True
     sa = state_arrays.of(state)
     cols = sa.registry()
     if len(cols) == 0:
@@ -655,6 +661,9 @@ def try_process_inactivity_updates(spec, state) -> bool:
 # ---------------------------------------------------------------------------
 
 def _fast_registry_updates(spec, state) -> bool:
+    from consensus_specs_tpu.parallel import mesh_epoch
+    if mesh_epoch.try_registry_updates(spec, state):
+        return True
     _registry_updates(spec, state)
     return True
 
@@ -681,10 +690,48 @@ def _registry_updates(spec, state) -> None:
     n = len(cols)
     if n == 0:
         return
-    validators = sequence_items(state.validators)
     current_epoch = int(spec.get_current_epoch(state))
     far_future = int(spec.FAR_FUTURE_EPOCH)
     max_eb = int(spec.MAX_EFFECTIVE_BALANCE)
+    aee = cols["aee"]
+
+    # cooperative deadline boundary before the eligibility scans
+    # (deadline_scope armed by try_process_registry_updates)
+    supervisor.deadline_check()
+    # eligibility scans (the half the mesh engine runs shard-local on
+    # the device mesh — parallel/mesh_epoch._p_registry_scan computes
+    # these same four facts and hands them to _registry_apply below)
+    queue_mask = (aee == np.uint64(far_future)) \
+        & (cols["eff"] == np.uint64(max_eb))
+    cur = np.uint64(current_epoch)
+    active_cur = (cols["act"] <= cur) & (cur < cols["ext"])
+    eject_mask = active_cur & (cols["eff"] <= np.uint64(
+        int(spec.config.EJECTION_BALANCE)))
+    # pending activations: stamped-this-epoch entries carry aee ==
+    # current_epoch + 1 > finalized, and unstamped candidates carry
+    # FAR_FUTURE_EPOCH — neither passes the finalized bound, so the
+    # scan commutes with the stamping writes below
+    eligible_mask = (aee <= np.uint64(
+        int(state.finalized_checkpoint.epoch))) \
+        & (cols["act"] == np.uint64(far_future))
+    # explicit accumulator: a bool .sum() uses the platform default int,
+    # which is 32-bit on some hosts — silently wrong above 2**31 lanes
+    active_count = int(active_cur.sum(dtype=np.int64))
+    _registry_apply(spec, state, sa, cols, queue_mask, eject_mask,
+                    eligible_mask, active_count)
+
+
+def _registry_apply(spec, state, sa, cols, queue_mask, eject_mask,
+                    eligible_mask, active_count) -> None:
+    """Churn-ordered resolution of the registry scans: activation-queue
+    stamps, the per-ejection exit-queue recurrence, and the
+    (activation_eligibility_epoch, index)-sorted dequeue — shared by the
+    single-device engine and the mesh engine (whose shard-local scans
+    gather their small candidate index sets here), so cross-shard
+    ordering is byte-identical to the spec loop by construction."""
+    validators = sequence_items(state.validators)
+    current_epoch = int(spec.get_current_epoch(state))
+    far_future = int(spec.FAR_FUTURE_EPOCH)
 
     wcols = None
 
@@ -697,11 +744,7 @@ def _registry_updates(spec, state) -> None:
 
     aee = cols["aee"]
 
-    # cooperative deadline boundary before the eligibility scans
-    # (deadline_scope armed by try_process_registry_updates)
-    supervisor.deadline_check()
     # activation-queue eligibility stamps (is_eligible_for_activation_queue)
-    queue_mask = (aee == np.uint64(far_future)) & (cols["eff"] == np.uint64(max_eb))
     stamp = current_epoch + 1
     if queue_mask.any():
         # copy-on-write BEFORE the paired SSZ writes: the generation
@@ -714,16 +757,9 @@ def _registry_updates(spec, state) -> None:
     # ejections: initiate_validator_exit per index, in index order.  The
     # churn limit is constant across the loop (assigned exit epochs are
     # all in the future, so current-epoch activity never changes).
-    cur = np.uint64(current_epoch)
-    active_cur = (cols["act"] <= cur) & (cur < cols["ext"])
-    # explicit accumulator: a bool .sum() uses the platform default int,
-    # which is 32-bit on some hosts — silently wrong above 2**31 lanes
     churn = max(int(spec.config.MIN_PER_EPOCH_CHURN_LIMIT),
-                int(active_cur.sum(dtype=np.int64))
-                // int(spec.config.CHURN_LIMIT_QUOTIENT))
-    eject = np.nonzero(active_cur
-                       & (cols["eff"] <= np.uint64(
-                           int(spec.config.EJECTION_BALANCE))))[0]
+                active_count // int(spec.config.CHURN_LIMIT_QUOTIENT))
+    eject = np.nonzero(eject_mask)[0]
     if eject.size:
         ext = writable()["ext"]
         wd = wcols["wd"]
@@ -748,10 +784,7 @@ def _registry_updates(spec, state) -> None:
 
     # activations: sort eligibles by (activation_eligibility_epoch, index),
     # dequeue up to the (fork-dependent) activation churn limit
-    finalized = int(state.finalized_checkpoint.epoch)
-    eligible = (aee <= np.uint64(finalized)) \
-        & (cols["act"] == np.uint64(far_future))
-    idx = np.nonzero(eligible)[0]
+    idx = np.nonzero(eligible_mask)[0]
     if idx.size:
         order = np.lexsort((idx, aee[idx]))
         activation_churn = churn
@@ -782,6 +815,9 @@ def _fast_slashings(spec, state) -> bool:
         multiplier = spec.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR
     else:
         multiplier = spec.PROPORTIONAL_SLASHING_MULTIPLIER
+    from consensus_specs_tpu.parallel import mesh_epoch
+    if mesh_epoch.try_slashings(spec, state, int(multiplier)):
+        return True
     _slashings(spec, state, int(multiplier))
     return True
 
@@ -824,6 +860,9 @@ def _slashings(spec, state, multiplier) -> None:
 # ---------------------------------------------------------------------------
 
 def _fast_effective_balance_updates(spec, state) -> bool:
+    from consensus_specs_tpu.parallel import mesh_epoch
+    if mesh_epoch.try_effective_balance_updates(spec, state):
+        return True
     _effective_balance_updates(spec, state)
     return True
 

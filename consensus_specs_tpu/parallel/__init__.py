@@ -1,10 +1,25 @@
-"""Device-mesh sharding for the crypto kernels.
+"""Device-mesh sharding: the crypto kernels' point-axis programs
+(``sharded_verify``) and the mesh-sharded SPMD state engine
+(``mesh_state`` / ``mesh_epoch`` / ``mesh_merkle`` — docs/sharding.md).
 
 The TPU-native replacement for the reference's distributed axis (NCCL/MPI
 have no role there — see SURVEY.md §2.4): aggregate-signature work shards
 over a ``jax.sharding.Mesh`` with XLA collectives riding ICI.
-"""
-from .sharded_verify import build_mesh, make_sharded_agg, \
-    make_sharded_agg_verify
 
-__all__ = ["build_mesh", "make_sharded_agg", "make_sharded_agg_verify"]
+The re-exports resolve lazily (PEP 562): ``sharded_verify`` imports jax
+at module scope, and the state-engine gate (``mesh_state.enabled``)
+sits on every epoch dispatch — a pure-host replay importing this
+package must not pay a jax import to learn the mesh is off.
+"""
+
+_SHARDED_VERIFY_API = ("build_mesh", "make_sharded_agg",
+                       "make_sharded_agg_verify")
+
+__all__ = list(_SHARDED_VERIFY_API)
+
+
+def __getattr__(name):
+    if name in _SHARDED_VERIFY_API:
+        from . import sharded_verify
+        return getattr(sharded_verify, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,896 @@
+"""SPMD epoch transition over the validator mesh.
+
+The PR-1 vectorized epoch engine (``ops/epoch_kernels.py``) re-expressed
+the O(validators) epoch loops as columnar kernels against an ``xp``
+array namespace — numpy on the host.  This module runs the SAME kernels
+as ``shard_map`` SPMD programs over the 1-D ``validators`` mesh
+(``mesh_state.build_mesh``): every device holds one shard of the store
+columns (``mesh_state.sharded_cell``) and executes the per-shard
+flag/reward/penalty kernels shard-locally; the only cross-device
+traffic is ONE ``psum`` per sub-transition that needs a global sum
+(:data:`PSUM_BUDGET` — the bench smoke counter-asserts it).
+
+Byte-identity argument (the differential suites enforce it):
+
+* elementwise uint64 lanes are identical under numpy and jax.numpy
+  with 64-bit lanes enabled (``mesh_state.x64``) — same truncations,
+  same clamps, and the kernels are literally shared with the
+  single-device engine;
+* the ``psum`` reductions are uint64 addition mod 2**64 — associative
+  and commutative, so shard order cannot change the sum — and every
+  reduction is guarded below 2**64 on the host before dispatch
+  (conservative ``n * max`` bounds pre-reduction, the engine's exact
+  bounds post-reduction), falling back to the single-device engine
+  (which re-checks its own exact guards) instead of wrapping;
+* ordering-sensitive registry churn (exit-queue recurrence,
+  activation dequeue) is NOT distributed: the shard-local eligibility
+  scans produce masks, the (small) candidate index sets are gathered to
+  the host, and one shared ordered-resolution body
+  (``epoch_kernels._registry_apply``) applies them in spec order — the
+  same code the single-device engine runs, so cross-shard ordering is
+  byte-identical to the spec loop by construction.
+
+Dispatch layering: ``ops/epoch_kernels``'s ``_fast_*`` bodies offer each
+sub-transition here first.  A decline (engine off, registry below the
+``CS_TPU_MESH_MIN`` floor, a guard trip, an injected fault, a deadline)
+falls back to the single-device columnar path — NOT the spec loop — so
+the degradation ladder is mesh -> columnar -> spec, each leg
+byte-identical.  The ``mesh.epoch`` faults site carries the full
+harness contract: ``supervisor.admit`` gate, ``faults.check`` hook,
+counted reason-labeled fallbacks, sentinel audits (host recomputation
+of the same composition is authoritative — a corrupted device result
+cannot commit past its audit), and the ``CS_TPU_MESH=0`` CI off-leg.
+"""
+import math
+
+import numpy as np
+
+from consensus_specs_tpu import faults, supervisor
+from consensus_specs_tpu.obs import registry as obs_registry
+from consensus_specs_tpu.obs.tracing import span
+from consensus_specs_tpu.parallel import mesh_state
+from consensus_specs_tpu.state import arrays as state_arrays
+
+SITE = "mesh.epoch"
+
+# exact psum count per sub-transition: the collective budget the bench
+# smoke asserts (one reduction program call == one psum, proven
+# structurally by the jaxpr census in tests/test_mesh.py)
+PSUM_BUDGET = {
+    "rewards_and_penalties": 1,
+    "inactivity_updates": 0,
+    "registry_updates": 1,
+    "slashings": 1,
+    "effective_balance_updates": 0,
+}
+
+_C_MESH = obs_registry.counter("mesh.epoch").labels(path="mesh")
+_C_PSUMS = {sub: obs_registry.counter("mesh.psums").labels(site=sub)
+            for sub in PSUM_BUDGET}
+_FALLBACKS = {
+    "guard": obs_registry.counter(
+        "mesh.epoch.fallbacks").labels(reason="guard"),
+    "injected": obs_registry.counter(
+        "mesh.epoch.fallbacks").labels(reason="injected"),
+    "deadline": obs_registry.counter(
+        "mesh.epoch.fallbacks").labels(reason="deadline"),
+}
+
+
+def _ek():
+    """The single-device engine (shared kernels + guard helpers).
+    Imported lazily: ``epoch_kernels`` dispatches INTO this module, so a
+    module-level import would be circular."""
+    from consensus_specs_tpu.ops import epoch_kernels
+    return epoch_kernels
+
+
+# ---------------------------------------------------------------------------
+# Compiled SPMD programs (memoized per mesh + static config)
+# ---------------------------------------------------------------------------
+#
+# Scalars that vary per epoch (total balance, churn increments, brpi,
+# epochs) ride in a replicated uint64 operand vector, NOT as python
+# closure values — closing over them would recompile every epoch.
+# Static arguments (fork constants, in_leak) key the program cache.
+
+_PROGRAMS = {}
+
+
+def _program(kind, mesh, static, builder):
+    key = (kind, mesh, static)
+    prog = _PROGRAMS.get(key)
+    if prog is None:
+        prog = builder()
+        _PROGRAMS[key] = prog
+    return prog
+
+
+def _shard_specs(mesh, n_in, n_out, scalars=True):
+    from jax.sharding import PartitionSpec as P
+    axis = mesh_state.AXIS
+    in_specs = tuple([P(axis)] * n_in + ([P()] if scalars else []))
+    out_specs = tuple([P(axis)] * n_out) if n_out > 1 else P(axis)
+    return in_specs, out_specs
+
+
+def _altair_masks(jnp, act, ext, sl, part, prev, flag_index):
+    """active-at-prev + per-flag unslashed-participating masks,
+    shard-local (``_epoch_masks`` / ``_altair_participation``)."""
+    active_prev = (act <= prev) & (prev < ext)
+    has_flag = (part >> jnp.uint8(flag_index)) & jnp.uint8(1) \
+        == jnp.uint8(1)
+    return active_prev, active_prev & has_flag & ~sl
+
+
+def _p_altair_sums(mesh, n_flags):
+    """Reduction program: [total active balance, per-flag participating
+    balances] — shard-local partials, ONE psum."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+
+        def local(eff, act, ext, sl, part, scal):
+            prev, cur = scal[0], scal[1]
+            zero = jnp.uint64(0)
+            active_cur = (act <= cur) & (cur < ext)
+            parts = [jnp.sum(jnp.where(active_cur, eff, zero),
+                             dtype=jnp.uint64)]
+            for f in range(n_flags):  # noqa: J203 (static: flag count)
+                _, participating = _altair_masks(
+                    jnp, act, ext, sl, part, prev, f)
+                parts.append(jnp.sum(jnp.where(participating, eff, zero),
+                                     dtype=jnp.uint64))
+            return jax.lax.psum(jnp.stack(parts), mesh_state.AXIS)
+
+        in_specs, _ = _shard_specs(mesh, 5, 1)
+        from jax.sharding import PartitionSpec as P
+        return jax.jit(shard_map(local, mesh=mesh, in_specs=in_specs,
+                                 out_specs=P()))
+    return _program("altair_sums", mesh, (n_flags,), build)
+
+
+def _p_masked_sums(mesh):
+    """Generic reduction program: masked sums of one uint64 column under
+    a stacked ``(k, n)`` mask operand — shard-local partials, ONE psum.
+    The phase0 attestation-set sums and the slashings/registry active
+    totals all ride through this shape."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def local(eff, masks):
+            parts = jnp.sum(
+                jnp.where(masks, eff[None, :], jnp.uint64(0)),
+                axis=1, dtype=jnp.uint64)
+            return jax.lax.psum(parts, mesh_state.AXIS)
+
+        axis = mesh_state.AXIS
+        return jax.jit(shard_map(
+            local, mesh=mesh, in_specs=(P(axis), P(None, axis)),
+            out_specs=P()))
+    return _program("masked_sums", mesh, (), build)
+
+
+def _p_altair_deltas(mesh, static):
+    """Elementwise program: base rewards, the three flag delta pairs,
+    the inactivity penalty pair, applied pairwise in spec order —
+    shard-local, ZERO collectives."""
+    (in_leak, weights, weight_denominator, increment, head_flag,
+     target_flag) = static
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        ek = _ek()
+
+        # speclint: guarded-by-caller (_altair_rewards bounds
+        # (max_eff // increment) * brpi and every flag product < 2**64
+        # before dispatching this program)
+        def local(eff, act, ext, sl, wd, part, scores, balances, scal):
+            prev = scal[0]
+            brpi = scal[1]
+            active_increments = scal[2]
+            inact_denom = scal[3]
+            active_prev = (act <= prev) & (prev < ext)
+            eligible = active_prev | (sl & (prev + jnp.uint64(1) < wd))
+            base_reward = (eff // jnp.uint64(increment)) * brpi
+            delta_pairs = []
+            target_participating = None
+            for f, weight in enumerate(weights):  # noqa: J203 (static)
+                _, participating = _altair_masks(
+                    jnp, act, ext, sl, part, prev, f)
+                if f == target_flag:
+                    target_participating = participating
+                delta_pairs.append(ek.flag_deltas_kernel(
+                    jnp, base_reward, eligible, participating,
+                    weight=weight, weight_denominator=weight_denominator,
+                    participating_increments=scal[4 + f],
+                    active_increments=active_increments,
+                    in_leak=in_leak, is_head_flag=f == head_flag))
+            inact = ek.inactivity_penalty_kernel(
+                jnp, eff, scores, eligible, target_participating,
+                denominator=inact_denom)
+            delta_pairs.append((jnp.zeros_like(inact), inact))
+            out = balances
+            for rewards, penalties in delta_pairs:
+                out = ek.apply_deltas_kernel(jnp, out, rewards, penalties)
+            return out
+
+        in_specs, out_specs = _shard_specs(mesh, 8, 1)
+        return jax.jit(shard_map(local, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs))
+    return _program("altair_deltas", mesh, static, build)
+
+
+def _p_phase0_deltas(mesh, static):
+    """Elementwise program: phase0 base rewards, the three attestation
+    component delta pairs, host-prepared inclusion rewards, the leak
+    penalty — summed and applied once, matching the loop engine's
+    accumulate-then-apply order.  Shard-local, ZERO collectives."""
+    in_leak, brf, brpe, prq, ipq = static
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        ek = _ek()
+
+        # speclint: guarded-by-caller (_phase0_rewards bounds
+        # max_eff * brf and every component product < 2**64 before
+        # dispatching this program)
+        def local(eff, act, ext, sl, wd, masks, incl_rewards, balances,
+                  scal):
+            prev = scal[0]
+            sqrt_total = scal[1]
+            total_increments = scal[2]
+            finality_delay = scal[3]
+            active_prev = (act <= prev) & (prev < ext)
+            eligible = active_prev | (sl & (prev + jnp.uint64(1) < wd))
+            base_reward = (eff * jnp.uint64(brf)) // sqrt_total \
+                // jnp.uint64(brpe)
+            rewards = incl_rewards
+            penalties = jnp.zeros_like(incl_rewards)
+            for i in range(3):  # noqa: J203 (static: src/tgt/head)
+                r, p = ek.phase0_component_kernel(
+                    jnp, base_reward, eligible, masks[i],
+                    in_leak=in_leak, attesting_increments=scal[4 + i],
+                    total_increments=total_increments)
+                rewards = rewards + r
+                penalties = penalties + p
+            if in_leak:
+                penalties = penalties + ek.phase0_inactivity_kernel(
+                    jnp, base_reward, eff, eligible, masks[1],
+                    base_rewards_per_epoch=brpe,
+                    proposer_reward_quotient=prq,
+                    finality_delay=finality_delay,
+                    inactivity_penalty_quotient=ipq)
+            return ek.apply_deltas_kernel(jnp, balances, rewards,
+                                          penalties)
+
+        import jax
+        from jax.sharding import PartitionSpec as P
+        axis = mesh_state.AXIS
+        in_specs = (P(axis), P(axis), P(axis), P(axis), P(axis),
+                    P(None, axis), P(axis), P(axis), P())
+        return jax.jit(shard_map(local, mesh=mesh, in_specs=in_specs,
+                                 out_specs=P(axis)))
+    return _program("phase0_deltas", mesh, static, build)
+
+
+def _p_inactivity(mesh, static):
+    """Elementwise program for ``process_inactivity_updates`` —
+    shard-local, ZERO collectives."""
+    bias, recovery_rate, in_leak, target_flag = static
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        ek = _ek()
+
+        def local(act, ext, sl, wd, part, scores, scal):
+            prev = scal[0]
+            active_prev, participating = _altair_masks(
+                jnp, act, ext, sl, part, prev, target_flag)
+            eligible = active_prev | (sl & (prev + jnp.uint64(1) < wd))
+            return ek.inactivity_updates_kernel(
+                jnp, scores, eligible, participating, bias=bias,
+                recovery_rate=recovery_rate, in_leak=in_leak)
+
+        in_specs, out_specs = _shard_specs(mesh, 6, 1)
+        return jax.jit(shard_map(local, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs))
+    return _program("inactivity", mesh, static, build)
+
+
+def _p_slashings(mesh, static):
+    """Elementwise program for ``process_slashings`` penalties + clamped
+    application — shard-local, ZERO collectives (the total-balance
+    reduction runs through :func:`_p_masked_sums`)."""
+    increment, = static
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        ek = _ek()
+
+        def local(eff, sl, wd, balances, scal):
+            adjusted, total_balance, target_epoch = \
+                scal[0], scal[1], scal[2]
+            target = sl & (wd == target_epoch)
+            penalties = ek.slashing_penalty_kernel(
+                jnp, eff, target, increment=increment,
+                adjusted_total_slashing_balance=adjusted,
+                total_balance=total_balance)
+            return jnp.where(penalties > balances, jnp.uint64(0),
+                             balances - penalties)
+
+        in_specs, out_specs = _shard_specs(mesh, 4, 1)
+        return jax.jit(shard_map(local, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs))
+    return _program("slashings", mesh, static, build)
+
+
+def _p_eff_balance(mesh, static):
+    """Elementwise program for the effective-balance hysteresis —
+    shard-local, ZERO collectives."""
+    increment, down, up, max_eb = static
+
+    def build():
+        import jax
+        from jax.experimental.shard_map import shard_map
+        ek = _ek()
+
+        def local(balances, eff):
+            import jax.numpy as jnp
+            return ek.effective_balance_kernel(
+                jnp, balances, eff, increment=increment,
+                downward_threshold=down, upward_threshold=up,
+                max_effective_balance=max_eb)
+
+        in_specs, out_specs = _shard_specs(mesh, 2, 1, scalars=False)
+        return jax.jit(shard_map(local, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs))
+    return _program("eff_balance", mesh, static, build)
+
+
+def _p_registry_scan(mesh, static):
+    """Registry eligibility scans, shard-local: activation-queue stamps,
+    ejection candidates, dequeue eligibles — plus the active-set count
+    for the churn limit (the sub-transition's ONE psum).  The masks come
+    back to the host, which gathers the small candidate index sets and
+    resolves the churn-ordered queues through the shared
+    ``_registry_apply`` body."""
+    far, max_eb, ejection = static
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def local(aee, act, ext, eff, scal):
+            cur, finalized = scal[0], scal[1]
+            queue_mask = (aee == jnp.uint64(far)) \
+                & (eff == jnp.uint64(max_eb))
+            active_cur = (act <= cur) & (cur < ext)
+            eject_mask = active_cur & (eff <= jnp.uint64(ejection))
+            eligible_mask = (aee <= finalized) & (act == jnp.uint64(far))
+            count = jax.lax.psum(
+                jnp.sum(active_cur, dtype=jnp.int64)[None],
+                mesh_state.AXIS)
+            return queue_mask, eject_mask, eligible_mask, count
+
+        axis = mesh_state.AXIS
+        return jax.jit(shard_map(
+            local, mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(axis), P()),
+            out_specs=(P(axis), P(axis), P(axis), P())))
+    return _program("registry_scan", mesh, static, build)
+
+
+# ---------------------------------------------------------------------------
+# Supervised dispatch (site mesh.epoch; falls back to the single-device
+# columnar engine, which re-checks its own exact guards)
+# ---------------------------------------------------------------------------
+
+def _dispatch(spec, state, sub, fast_fn) -> bool:
+    """Run one sub-transition through the mesh.  True: the mesh computed
+    and committed the columns (the caller's single-device body must not
+    run).  False: declined/failed — the caller proceeds single-device."""
+    if supervisor.probing() or not mesh_state.enabled():
+        return False
+    sa = state_arrays.of(state)
+    if not mesh_state.engaged(len(sa.registry())):
+        return False
+    if not supervisor.admit(SITE):
+        return False
+    ek = _ek()
+    try:
+        faults.check(SITE)
+        with supervisor.deadline_scope(SITE):
+            with span("mesh.epoch.dispatch"):
+                with mesh_state.x64():
+                    handled = fast_fn(spec, state, sa)
+    except ek._Fallback:
+        faults.count_fallback(_FALLBACKS, None, organic="guard", site=SITE)
+        return False
+    except (faults.InjectedFault, supervisor.DeadlineExceeded) as exc:
+        faults.count_fallback(_FALLBACKS, exc, site=SITE)
+        return False
+    if not handled:
+        return False
+    supervisor.note_success(SITE)
+    _C_MESH.add()
+    return True
+
+
+def _finish_column(result: np.ndarray, host_recompute) -> np.ndarray:
+    """Corrupt hook + sentinel audit for one device-computed column.
+    ``host_recompute`` replays the SAME composition with numpy kernels
+    and host-exact reductions; on an audit its answer is authoritative,
+    so a silently-wrong device result cannot commit past its audit."""
+    if faults.corrupt_armed(SITE):
+        # silent-corruption injection (sentinel-audit test vector)
+        result = result.copy()
+        if result.size:
+            result[0] ^= result.dtype.type(1)
+    if supervisor.audit_due(SITE):
+        golden = host_recompute()
+        ok = bool(np.array_equal(result, golden))
+        supervisor.audit_result(
+            SITE, ok, "mesh SPMD column diverged from the host "
+            "recomputation of the same kernels")
+        return golden
+    return result
+
+
+def _columns(sa, mesh):
+    reg = mesh_state.sharded_cell(sa, "registry", mesh)
+    return reg
+
+
+def _scal(values) -> np.ndarray:
+    return np.array([int(v) for v in values], dtype=np.uint64)
+
+
+# ---------------------------------------------------------------------------
+# Sub-transition entry points (called by ops/epoch_kernels._fast_*)
+# ---------------------------------------------------------------------------
+
+def try_rewards_and_penalties(spec, state) -> bool:
+    def fast(spec, state, sa):
+        ek = _ek()
+        if "altair" in ek._fork_lineage(spec):
+            return _altair_rewards(spec, state, sa)
+        return _phase0_rewards(spec, state, sa)
+    return _dispatch(spec, state, "rewards_and_penalties", fast)
+
+
+def _altair_rewards(spec, state, sa) -> bool:
+    ek = _ek()
+    cols = sa.registry()
+    n = len(cols)
+    if n == 0:
+        return False
+    mesh = mesh_state.build_mesh()
+    eff = cols["eff"]
+    max_eff = int(eff.max(initial=0))
+    # pre-reduction conservative bound: every psum lane sum is <= n *
+    # max_eff, so < 2**64 here implies the device reduction is exact
+    ek._guard(n * max_eff)
+    prev_epoch = int(spec.get_previous_epoch(state))
+    cur_epoch = int(spec.get_current_epoch(state))
+    increment = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    weights = tuple(int(w) for w in spec.PARTICIPATION_FLAG_WEIGHTS)
+    reg = _columns(sa, mesh)
+    part = mesh_state.sharded_cell(sa, "participation_previous", mesh)
+    sums_prog = _p_altair_sums(mesh, len(weights))
+    _C_PSUMS["rewards_and_penalties"].add()
+    sums = np.asarray(sums_prog(
+        reg["eff"], reg["act"], reg["ext"], reg["sl"], part,
+        mesh_state.replicate(_scal([prev_epoch, cur_epoch]), mesh)))
+    total_balance = max(increment, int(sums[0]))
+    up_balances = [max(increment, int(s)) for s in sums[1:]]
+    # from here the guard set is EXACTLY the single-device engine's
+    ek._guard(total_balance)
+    active_increments = total_balance // increment
+    in_leak = bool(spec.is_in_inactivity_leak(state))
+    weight_denominator = int(spec.WEIGHT_DENOMINATOR)
+    brpi = increment * int(spec.BASE_REWARD_FACTOR) \
+        // math.isqrt(total_balance)
+    ek._guard((max_eff // increment) * brpi)
+    br_max = (max_eff // increment) * brpi
+    up_increments = []
+    for w, ub in zip(weights, up_balances):
+        ui = ub // increment
+        ek._guard(br_max * w * ui)
+        up_increments.append(ui)
+    quotient = (int(spec.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX)
+                if "bellatrix" in ek._fork_lineage(spec)
+                else int(spec.INACTIVITY_PENALTY_QUOTIENT_ALTAIR))
+    inact_denom = int(spec.config.INACTIVITY_SCORE_BIAS) * quotient
+    scores = sa.inactivity_scores()
+    ek._guard(max_eff * int(scores.max(initial=0)))
+    balances = sa.balances()
+    # pairwise application bound: each pair adds at most one flag
+    # reward (or the zero inactivity reward) on top of the running max
+    max_bal = int(balances.max(initial=0))
+    ek._guard(max_bal + (len(weights) + 1) * br_max)
+    static = (in_leak, weights, weight_denominator, increment,
+              int(spec.TIMELY_HEAD_FLAG_INDEX),
+              int(spec.TIMELY_TARGET_FLAG_INDEX))
+    prog = _p_altair_deltas(mesh, static)
+    scal = _scal([prev_epoch, brpi, active_increments, inact_denom]
+                 + up_increments)
+    sc_dev = mesh_state.sharded_cell(sa, "inactivity_scores", mesh)
+    bal_dev = mesh_state.sharded_cell(sa, "balances", mesh)
+    out = mesh_state.unshard(
+        prog(reg["eff"], reg["act"], reg["ext"], reg["sl"], reg["wd"],
+             part, sc_dev, bal_dev, mesh_state.replicate(scal, mesh)), n)
+
+    # speclint: guarded-by-caller (_altair_rewards bounds the same
+    # products before the audit closure can run)
+    def host_recompute():
+        active_prev, eligible = ek._epoch_masks(spec, cols, prev_epoch)
+        base_reward = (eff // np.uint64(increment)) * np.uint64(brpi)
+        acc = balances
+        target_participating = None
+        for f, w in enumerate(weights):
+            participating = ek._altair_participation(
+                spec, sa, cols, f, active_prev)
+            if f == static[5]:
+                target_participating = participating
+            r, p = ek.flag_deltas_kernel(
+                np, base_reward, eligible, participating, weight=w,
+                weight_denominator=weight_denominator,
+                participating_increments=up_increments[f],
+                active_increments=active_increments, in_leak=in_leak,
+                is_head_flag=f == static[4])
+            acc = ek.apply_deltas_kernel(np, acc, r, p)
+        inact = ek.inactivity_penalty_kernel(
+            np, eff, scores, eligible, target_participating,
+            denominator=inact_denom)
+        return ek.apply_deltas_kernel(
+            np, acc, np.zeros(n, dtype=np.uint64), inact)
+
+    sa.set_balances(_finish_column(out, host_recompute))
+    return True
+
+
+def _phase0_rewards(spec, state, sa) -> bool:
+    ek = _ek()
+    cols = sa.registry()
+    n = len(cols)
+    if n == 0:
+        return False
+    mesh = mesh_state.build_mesh()
+    # spec helpers up front: assertion behavior (exception as
+    # invalidity) must fire exactly as in the loop path
+    prev_epoch = spec.get_previous_epoch(state)
+    src_atts = spec.get_matching_source_attestations(state, prev_epoch)
+    tgt_atts = spec.get_matching_target_attestations(state, prev_epoch)
+    head_atts = spec.get_matching_head_attestations(state, prev_epoch)
+    src_set = spec.get_unslashed_attesting_indices(state, src_atts)
+    tgt_set = spec.get_unslashed_attesting_indices(state, tgt_atts)
+    head_set = spec.get_unslashed_attesting_indices(state, head_atts)
+    prev_epoch = int(prev_epoch)
+    cur_epoch = int(spec.get_current_epoch(state))
+    eff = cols["eff"]
+    max_eff = int(eff.max(initial=0))
+    ek._guard(n * max_eff)
+    increment = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    cur = np.uint64(cur_epoch)
+    active_cur = (cols["act"] <= cur) & (cur < cols["ext"])
+    att_masks = np.stack([ek._mask_from_indices(n, s)
+                          for s in (src_set, tgt_set, head_set)])
+    reg = _columns(sa, mesh)
+    sums_prog = _p_masked_sums(mesh)
+    _C_PSUMS["rewards_and_penalties"].add()
+    sums = np.asarray(sums_prog(
+        reg["eff"], _place_masks(
+            np.concatenate([active_cur[None], att_masks]), mesh)))
+    total_balance = max(increment, int(sums[0]))
+    ek._guard(total_balance)
+    total_increments = total_balance // increment
+    in_leak = bool(spec.is_in_inactivity_leak(state))
+    sqrt_total = int(spec.integer_squareroot(total_balance))
+    brf = int(spec.BASE_REWARD_FACTOR)
+    brpe = int(spec.BASE_REWARDS_PER_EPOCH)
+    ek._guard(max_eff * brf)
+    br_max = max_eff * brf // sqrt_total // brpe
+    att_increments = []
+    for s in sums[1:]:
+        ai = max(increment, int(s)) // increment
+        ek._guard(br_max * ai)
+        att_increments.append(ai)
+
+    # inclusion-delay rewards: the ordered O(attestations) host pass of
+    # the single-device engine, verbatim — its output rides into the
+    # SPMD program as one more reward column
+    # speclint: invariant: prq >= 1
+    prq = int(spec.PROPOSER_REWARD_QUOTIENT)
+    src_mask = att_masks[0]
+    best_delay = np.full(n, (1 << 64) - 1, dtype=np.uint64)
+    best_proposer = np.zeros(n, dtype=np.int64)
+    for att in src_atts:
+        idxs = spec.get_attesting_indices(state, att.data,
+                                          att.aggregation_bits)
+        if not idxs:
+            continue
+        ii = np.fromiter(idxs, dtype=np.int64, count=len(idxs))
+        upd = np.uint64(int(att.inclusion_delay)) < best_delay[ii]
+        sel = ii[upd]
+        best_delay[sel] = np.uint64(int(att.inclusion_delay))
+        best_proposer[sel] = int(att.proposer_index)
+    base_reward = (eff * np.uint64(brf)) // np.uint64(sqrt_total) \
+        // np.uint64(brpe)
+    proposer_reward = base_reward // np.uint64(prq)
+    incl_rewards = np.zeros(n, dtype=np.uint64)
+    src_idx = np.nonzero(src_mask)[0]
+    if src_idx.size:
+        # safe under the prq >= 1 invariant: proposer_reward <=
+        # base_reward, preserved under the shared index (the U9xx
+        # prover certifies the same line in the single-device engine)
+        max_attester = base_reward[src_idx] - proposer_reward[src_idx]
+        incl_rewards[src_idx] = max_attester // best_delay[src_idx]
+        ek._guard(br_max + src_idx.size * (br_max // prq))
+        np.add.at(incl_rewards, best_proposer[src_idx],
+                  proposer_reward[src_idx])
+
+    finality_delay = int(spec.get_finality_delay(state)) if in_leak else 0
+    ipq = int(spec.INACTIVITY_PENALTY_QUOTIENT)
+    if in_leak:
+        ek._guard(brpe * br_max + max_eff * finality_delay)
+    # accumulate-then-apply bound, conservative over the exact per-part
+    # maxima the single-device engine reads off its materialized parts
+    balances = sa.balances()
+    ek._guard(3 * br_max + int(incl_rewards.max(initial=0))
+              + int(balances.max(initial=0)),
+              3 * br_max + brpe * br_max + max_eff * finality_delay)
+    static = (in_leak, brf, brpe, prq, ipq)
+    prog = _p_phase0_deltas(mesh, static)
+    scal = _scal([prev_epoch, sqrt_total, total_increments,
+                  finality_delay] + att_increments)
+    bal_dev = mesh_state.sharded_cell(sa, "balances", mesh)
+    out = mesh_state.unshard(
+        prog(reg["eff"], reg["act"], reg["ext"], reg["sl"], reg["wd"],
+             _place_masks(att_masks, mesh),
+             mesh_state.place(incl_rewards, mesh), bal_dev,
+             mesh_state.replicate(scal, mesh)), n)
+
+    def host_recompute():
+        _, eligible = ek._epoch_masks(spec, cols, prev_epoch)
+        rewards = incl_rewards.copy()
+        penalties = np.zeros(n, dtype=np.uint64)
+        for i in range(3):
+            r, p = ek.phase0_component_kernel(
+                np, base_reward, eligible, att_masks[i],
+                in_leak=in_leak, attesting_increments=att_increments[i],
+                total_increments=total_increments)
+            rewards = rewards + r
+            penalties = penalties + p
+        if in_leak:
+            penalties = penalties + ek.phase0_inactivity_kernel(
+                np, base_reward, eff, eligible, att_masks[1],
+                base_rewards_per_epoch=brpe,
+                proposer_reward_quotient=prq,
+                finality_delay=finality_delay,
+                inactivity_penalty_quotient=ipq)
+        return ek.apply_deltas_kernel(np, balances, rewards, penalties)
+
+    sa.set_balances(_finish_column(out, host_recompute))
+    return True
+
+
+def _place_masks(masks: np.ndarray, mesh):
+    """Place a stacked ``(k, n)`` bool mask with the VALIDATOR axis
+    (axis 1) sharded — pad lanes False, so they drop out of every
+    reduction and delta."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    pad = mesh_state.pad_amount(masks.shape[1], mesh.shape[mesh_state.AXIS])
+    if pad:
+        masks = np.concatenate(
+            [masks, np.zeros((masks.shape[0], pad), dtype=bool)], axis=1)
+    return jax.device_put(
+        masks, NamedSharding(mesh, P(None, mesh_state.AXIS)))
+
+
+def try_inactivity_updates(spec, state) -> bool:
+    def fast(spec, state, sa):
+        ek = _ek()
+        cols = sa.registry()
+        n = len(cols)
+        if n == 0:
+            return False
+        mesh = mesh_state.build_mesh()
+        scores = sa.inactivity_scores()
+        bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+        ek._guard(int(scores.max(initial=0)) + bias)
+        prev_epoch = int(spec.get_previous_epoch(state))
+        in_leak = bool(spec.is_in_inactivity_leak(state))
+        static = (bias, int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE),
+                  in_leak, int(spec.TIMELY_TARGET_FLAG_INDEX))
+        reg = _columns(sa, mesh)
+        part = mesh_state.sharded_cell(sa, "participation_previous", mesh)
+        sc_dev = mesh_state.sharded_cell(sa, "inactivity_scores", mesh)
+        prog = _p_inactivity(mesh, static)
+        out = mesh_state.unshard(
+            prog(reg["act"], reg["ext"], reg["sl"], reg["wd"], part,
+                 sc_dev,
+                 mesh_state.replicate(_scal([prev_epoch]), mesh)), n)
+
+        def host_recompute():
+            active_prev, eligible = ek._epoch_masks(spec, cols,
+                                                    prev_epoch)
+            participating = ek._altair_participation(
+                spec, sa, cols, static[3], active_prev)
+            return ek.inactivity_updates_kernel(
+                np, scores, eligible, participating, bias=bias,
+                recovery_rate=static[1], in_leak=in_leak)
+
+        sa.set_inactivity_scores(_finish_column(out, host_recompute))
+        return True
+    return _dispatch(spec, state, "inactivity_updates", fast)
+
+
+def try_slashings(spec, state, multiplier: int) -> bool:
+    def fast(spec, state, sa):
+        ek = _ek()
+        from consensus_specs_tpu.utils.ssz import sequence_items
+        cols = sa.registry()
+        n = len(cols)
+        if n == 0:
+            return False
+        mesh = mesh_state.build_mesh()
+        eff = cols["eff"]
+        max_eff = int(eff.max(initial=0))
+        ek._guard(n * max_eff)
+        epoch = int(spec.get_current_epoch(state))
+        cur = np.uint64(epoch)
+        active_cur = (cols["act"] <= cur) & (cur < cols["ext"])
+        reg = _columns(sa, mesh)
+        _C_PSUMS["slashings"].add()
+        sums = np.asarray(_p_masked_sums(mesh)(
+            reg["eff"], _place_masks(active_cur[None], mesh)))
+        total_balance = max(int(spec.EFFECTIVE_BALANCE_INCREMENT),
+                            int(sums[0]))
+        ek._guard(total_balance)
+        slashed_sum = sum(int(s) for s in sequence_items(state.slashings))
+        adjusted = min(slashed_sum * multiplier, total_balance)
+        increment = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+        target_epoch = epoch + int(spec.EPOCHS_PER_SLASHINGS_VECTOR) // 2
+        ek._guard(target_epoch)
+        ek._guard((max_eff // increment) * adjusted)
+        balances = sa.balances()
+        bal_dev = mesh_state.sharded_cell(sa, "balances", mesh)
+        prog = _p_slashings(mesh, (increment,))
+        scal = _scal([adjusted, total_balance, target_epoch])
+        out = mesh_state.unshard(
+            prog(reg["eff"], reg["sl"], reg["wd"], bal_dev,
+                 mesh_state.replicate(scal, mesh)), n)
+
+        def host_recompute():
+            target = cols["sl"] & (cols["wd"] == np.uint64(target_epoch))
+            penalties = ek.slashing_penalty_kernel(
+                np, eff, target, increment=increment,
+                adjusted_total_slashing_balance=adjusted,
+                total_balance=total_balance)
+            return np.where(penalties > balances, np.uint64(0),
+                            balances - penalties)
+
+        sa.set_balances(_finish_column(out, host_recompute))
+        return True
+    return _dispatch(spec, state, "slashings", fast)
+
+
+def try_effective_balance_updates(spec, state) -> bool:
+    def fast(spec, state, sa):
+        ek = _ek()
+        from consensus_specs_tpu.utils.ssz import sequence_items
+        cols = sa.registry()
+        n = len(cols)
+        if n == 0:
+            return False
+        mesh = mesh_state.build_mesh()
+        increment = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+        hysteresis_increment = increment // int(spec.HYSTERESIS_QUOTIENT)
+        down = hysteresis_increment \
+            * int(spec.HYSTERESIS_DOWNWARD_MULTIPLIER)
+        up = hysteresis_increment * int(spec.HYSTERESIS_UPWARD_MULTIPLIER)
+        balances = sa.balances()
+        eff = cols["eff"]
+        ek._guard(int(balances.max(initial=0)) + down,
+                  int(eff.max(initial=0)) + up)
+        static = (increment, down, up, int(spec.MAX_EFFECTIVE_BALANCE))
+        reg = _columns(sa, mesh)
+        bal_dev = mesh_state.sharded_cell(sa, "balances", mesh)
+        prog = _p_eff_balance(mesh, static)
+        new_eff = mesh_state.unshard(prog(bal_dev, reg["eff"]), n)
+
+        def host_recompute():
+            return ek.effective_balance_kernel(
+                np, balances, eff, increment=increment,
+                downward_threshold=down, upward_threshold=up,
+                max_effective_balance=static[3])
+
+        new_eff = _finish_column(new_eff, host_recompute)
+        changed = np.nonzero(eff != new_eff)[0]
+        if changed.size == 0:
+            return True
+        # copy-on-write BEFORE the paired SSZ writes (generation bump) —
+        # the same write protocol as the single-device engine
+        sa.registry_writable()["eff"] = new_eff
+        validators = sequence_items(state.validators)
+        for i in changed.tolist():
+            validators[i].effective_balance = int(new_eff[i])
+        sa.mark_registry_committed()
+        return True
+    return _dispatch(spec, state, "effective_balance_updates", fast)
+
+
+def try_registry_updates(spec, state) -> bool:
+    def fast(spec, state, sa):
+        ek = _ek()
+        cols = sa.registry()
+        n = len(cols)
+        if n == 0:
+            return False
+        mesh = mesh_state.build_mesh()
+        current_epoch = int(spec.get_current_epoch(state))
+        finalized = int(state.finalized_checkpoint.epoch)
+        static = (int(spec.FAR_FUTURE_EPOCH),
+                  int(spec.MAX_EFFECTIVE_BALANCE),
+                  int(spec.config.EJECTION_BALANCE))
+        reg = _columns(sa, mesh)
+        prog = _p_registry_scan(mesh, static)
+        _C_PSUMS["registry_updates"].add()
+        q_dev, e_dev, el_dev, count = prog(
+            reg["aee"], reg["act"], reg["ext"], reg["eff"],
+            mesh_state.replicate(_scal([current_epoch, finalized]), mesh))
+        queue_mask = mesh_state.unshard(q_dev, n)
+        eject_mask = mesh_state.unshard(e_dev, n)
+        eligible_mask = mesh_state.unshard(el_dev, n)
+        active_count = int(np.asarray(count)[0])
+        if faults.corrupt_armed(SITE):
+            # deterministic silent corruption: stamp validator 0 as an
+            # activation-queue candidate it is not (or clear it if it
+            # is) — exactly the class of wrongness only an audit sees
+            queue_mask = queue_mask.copy()
+            if queue_mask.size:
+                queue_mask[0] = not queue_mask[0]
+        if supervisor.audit_due(SITE):
+            cur = np.uint64(current_epoch)
+            g_queue = (cols["aee"] == np.uint64(static[0])) \
+                & (cols["eff"] == np.uint64(static[1]))
+            g_active = (cols["act"] <= cur) & (cur < cols["ext"])
+            g_eject = g_active & (cols["eff"] <= np.uint64(static[2]))
+            g_eligible = (cols["aee"] <= np.uint64(finalized)) \
+                & (cols["act"] == np.uint64(static[0]))
+            ok = bool(np.array_equal(queue_mask, g_queue)
+                      and np.array_equal(eject_mask, g_eject)
+                      and np.array_equal(eligible_mask, g_eligible)
+                      and active_count
+                      == int(g_active.sum(dtype=np.int64)))
+            supervisor.audit_result(
+                SITE, ok, "mesh registry eligibility scans diverged "
+                "from the host recomputation")
+            if not ok:
+                queue_mask, eject_mask, eligible_mask = \
+                    g_queue, g_eject, g_eligible
+                active_count = int(g_active.sum(dtype=np.int64))
+        # the small gathered index sets resolve churn-ordered on the
+        # host through the SAME body as the single-device engine —
+        # cross-shard ordering byte-identical to the spec loop by
+        # construction
+        ek._registry_apply(spec, state, sa, cols, queue_mask,
+                           eject_mask, eligible_mask, active_count)
+        return True
+    return _dispatch(spec, state, "registry_updates", fast)

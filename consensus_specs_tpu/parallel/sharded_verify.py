@@ -116,13 +116,14 @@ def make_sharded_msm(mesh_devices):
     both shaped ``(N_WINDOWS * n_points, ...)`` and sharded along that
     leading axis.  n_points must divide evenly by the mesh size.
     """
-    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
     from jax.experimental.shard_map import shard_map
     from consensus_specs_tpu.ops.jax_bls import points as PT
     from consensus_specs_tpu.ops.jax_bls import msm as M
+    from consensus_specs_tpu.parallel import mesh_state
 
     mesh_devices = tuple(mesh_devices)
-    mesh = Mesh(np.array(mesh_devices), ("points",))
+    mesh = mesh_state.build_mesh("points", mesh_devices)
     n_shards = mesh.shape["points"]
 
     def local_msm(window_pts, digit_bits):
@@ -155,12 +156,13 @@ def make_sharded_g2_msm(mesh_devices):
     (``ops.bls_jax._bits_msb``), both sharded along the leading axis.
     B must divide evenly by the mesh size.
     """
-    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
     from jax.experimental.shard_map import shard_map
     from consensus_specs_tpu.ops.jax_bls import points as PT
+    from consensus_specs_tpu.parallel import mesh_state
 
     mesh_devices = tuple(mesh_devices)
-    mesh = Mesh(np.array(mesh_devices), ("points",))
+    mesh = mesh_state.build_mesh("points", mesh_devices)
     n_shards = mesh.shape["points"]
 
     def local_msm(sig_pts, bits):
@@ -178,16 +180,46 @@ def make_sharded_g2_msm(mesh_devices):
 _SHARDED_G2_MSM_CACHE = {}
 
 
-def sharded_g2_msm_for(devices: tuple):
+def sharded_g2_msm_for(devices: tuple = None):
     """Memoized compiled G2-MSM program per device tuple (same rationale
     as :func:`_sharded_msm_for`: rebuilding the ``shard_map`` closure
-    would defeat jit's identity-keyed cache)."""
+    would defeat jit's identity-keyed cache).  ``devices`` defaults to
+    the whole host mesh — the shape is derived from ``jax.devices()``,
+    never hardcoded."""
+    if devices is None:
+        devices = jax.devices()
     devices = tuple(devices)
     prog = _SHARDED_G2_MSM_CACHE.get(devices)
     if prog is None:
         prog = make_sharded_g2_msm(devices)
         _SHARDED_G2_MSM_CACHE[devices] = prog
     return prog
+
+
+def sharded_g2_msm_padded(sig_packed, bits, devices: tuple = None):
+    """Host API for the RLC signature fold at ANY batch size: pads the
+    signature axis up to a multiple of the mesh with identity lanes
+    (infinity points, zero scalar bits — the same padding the
+    single-device fold already uses for its lane bucket) and runs the
+    points-sharded program.  Scales the MULTICHIP_r05 8-device dryrun
+    shape to whatever ``jax.devices()`` answers, uneven shards
+    included."""
+    from consensus_specs_tpu.ops.jax_bls import points as PT
+    from consensus_specs_tpu.ops.bls12_381.curve import G2Point
+    if devices is None:
+        devices = jax.devices()
+    devices = tuple(devices)
+    b = jax.tree_util.tree_leaves(sig_packed)[0].shape[0]
+    pad = (-b) % len(devices)
+    if pad:
+        inf = PT.g2_pack([G2Point.inf()] * pad)
+        sig_packed = jax.tree_util.tree_map(
+            lambda a, i: np.concatenate(
+                [np.asarray(a), np.asarray(i)], axis=0), sig_packed, inf)
+        bits = np.asarray(bits)
+        bits = np.concatenate(
+            [bits, np.zeros((pad,) + bits.shape[1:], dtype=bits.dtype)])
+    return sharded_g2_msm_for(devices)(sig_packed, bits)
 
 
 _SHARDED_MSM_CACHE = {}

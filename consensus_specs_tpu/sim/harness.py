@@ -44,6 +44,7 @@ ENGINES_OFF = {
     "CS_TPU_PROTO_ARRAY": "0",
     "CS_TPU_STATE_ARRAYS": "0",
     "CS_TPU_BLS_RLC": "0",
+    "CS_TPU_MESH": "0",
 }
 
 # site -> the reason-labeled counter key its handler must bump.  The
@@ -64,6 +65,8 @@ SITE_COUNTER = {
     "bls.flush": "bls.flush{path=fallback,reason=injected}",
     "das.verify": "das.fallbacks{reason=injected}",
     "das.recover": "das.fallbacks{reason=injected}",
+    "mesh.epoch": "mesh.epoch.fallbacks{reason=injected}",
+    "mesh.merkle": "mesh.merkle.fallbacks{reason=injected}",
 }
 assert set(SITE_COUNTER) == set(faults.SITES)
 
@@ -88,6 +91,8 @@ ORGANIC_TWIN = {
     "bls.flush{path=fallback,reason=injected}":
         "bls.flush{path=fallback,reason=bisect}",
     "das.fallbacks{reason=injected}": "das.fallbacks{reason=guard}",
+    "mesh.epoch.fallbacks{reason=injected}":
+        "mesh.epoch.fallbacks{reason=guard}",
 }
 
 

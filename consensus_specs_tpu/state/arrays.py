@@ -256,15 +256,27 @@ class _Cell:
     mutated in place, so forks may share it freely.  ``data`` is the
     current value: ``data is base`` means clean; anything else is a
     pending engine write awaiting :meth:`StateArrays.commit`.
+
+    ``shard`` is the mesh engine's device placement for this column
+    (``parallel/mesh_state.py``): ``(host_array, placed)`` where
+    ``placed`` is the column padded and ``device_put`` across the
+    validator mesh.  Validity is by identity — the placement serves
+    reads only while ``shard[0] is cell.data`` — so a kernel write (a
+    new ``data`` array) retires it without bookkeeping, and a
+    copy-on-write fork that shares ``data`` shares the placement too:
+    N replays forked from one base pay ONE host->device transfer per
+    column, and committing a scope (``base = data``) never moves data
+    between devices.
     """
 
-    __slots__ = ("data", "base", "seq_ref", "gen", "__weakref__")
+    __slots__ = ("data", "base", "seq_ref", "gen", "shard", "__weakref__")
 
     def __init__(self, data, seq):
         self.data = data
         self.base = data
         self.seq_ref = weakref.ref(seq)
         self.gen = _gen_of(seq)
+        self.shard = None
 
 
 # (name, state field, extractor); participation columns are altair+.
@@ -500,6 +512,11 @@ class StateArrays:
                 continue
             seq = object.__getattribute__(new_state, field)
             ncell = _Cell(cell.data, seq)
+            # the mesh device placement rides along with the shared
+            # column: a forked replay dispatches against the SAME
+            # device arrays until it writes the column (identity check
+            # in parallel/mesh_state.sharded_cell retires it then)
+            ncell.shard = cell.shard
             other._cells[name] = ncell
             if name == "registry":
                 _bind_registry(seq, ncell)

@@ -46,7 +46,7 @@ from ..findings import Finding
 
 NAME = "coverage"
 CODE_PREFIXES = ("C",)
-VERSION = 1
+VERSION = 2
 GRANULARITY = "tree"
 
 FAULTS_REL = "consensus_specs_tpu/faults.py"
@@ -59,6 +59,7 @@ ENGINE_PREFIXES = (
     "consensus_specs_tpu/state/",
     "consensus_specs_tpu/das/",
     "consensus_specs_tpu/utils/",
+    "consensus_specs_tpu/parallel/",
 )
 
 _FALLBACK_CLASSES = {"InjectedFault", "_Fallback", "DeadlineExceeded"}

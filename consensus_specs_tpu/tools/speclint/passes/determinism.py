@@ -15,8 +15,15 @@ function:
   set-valued expression, or a ``for`` loop over one whose body appends,
   extends, yields or hashes (order-insensitive reductions — sums,
   min/max, scatter-adds — are exempt, which is why the spec's
-  ``get_attesting_balance``-style set folds stay clean).  Wrap the set
-  in ``sorted(...)`` like the spec does.
+  ``get_attesting_balance``-style set folds stay clean).  A sink whose
+  value feeds DIRECTLY into an order-insensitive fold
+  (``sum(list(s))``, ``sorted(tuple(s))``) or a mesh collective
+  (``psum`` / ``pmax`` / ``pmin`` / ``all_gather`` — order-insensitive
+  folds performed by the mesh: ``psum`` is modular addition over a
+  fixed axis, ``all_gather`` orders by mesh index, never by arrival)
+  is exempt too: the escaping order is folded away before it can reach
+  a consensus value.  Otherwise wrap the set in ``sorted(...)`` like
+  the spec does.
 * D1002 — float arithmetic: a float literal or true division (``/``)
   on a consensus path.  Consensus math is integer-only; float rounding
   is host/backend-dependent.
@@ -52,7 +59,7 @@ from ..graph import ProjectGraph
 
 NAME = "determinism"
 CODE_PREFIXES = ("D",)
-VERSION = 1
+VERSION = 2
 GRANULARITY = "tree"
 
 # findings are reported only here: the packages whose functions produce
@@ -64,6 +71,7 @@ REPORT_PREFIXES = (
     "consensus_specs_tpu/das/",
     "consensus_specs_tpu/utils/",
     "consensus_specs_tpu/forks/",
+    "consensus_specs_tpu/parallel/",
 )
 REPORT_EXCLUDE = (
     "consensus_specs_tpu/forks/compiled/",   # mirrors the hand ladder
@@ -77,6 +85,12 @@ _SET_METHODS = {"union", "intersection", "difference",
                 "symmetric_difference"}
 _ORDER_SINKS = {"list", "tuple", "fromiter", "enumerate", "iter"}
 _ORDER_SENSITIVE_METHODS = {"append", "extend", "add_", "write"}
+# order-insensitive folds: a sink nested directly under one of these is
+# exempt — host folds (sum/min/max; sorted re-establishes an order) and
+# the mesh collectives (psum = modular addition over the mesh axis,
+# pmax/pmin idempotent-commutative, all_gather ordered by mesh index)
+_EXEMPT_FOLDS = {"sum", "min", "max", "sorted", "frozenset", "set",
+                 "psum", "pmax", "pmin", "all_gather", "psum_scatter"}
 
 
 def _in_report_scope(rel: str) -> bool:
@@ -160,8 +174,23 @@ def _module_shadows_hash(tree) -> bool:
     return False
 
 
+def _under_exempt_fold(node, parents) -> bool:
+    """True when ``node`` sits inside the argument expression of an
+    order-insensitive fold call (``_EXEMPT_FOLDS``) — the walk stops at
+    the first statement boundary, so only DIRECT value flow into the
+    fold exempts."""
+    cur = parents.get(node)
+    while cur is not None and isinstance(cur, ast.expr):
+        if isinstance(cur, ast.Call) and _call_tail(cur) in _EXEMPT_FOLDS:
+            return True
+        cur = parents.get(cur)
+    return False
+
+
 def _check_function(rel, fn_node, hash_shadowed, root_name, findings):
     tracker = _SetTracker(fn_node)
+    parents = {child: parent for parent in ast.walk(fn_node)
+               for child in ast.iter_child_nodes(parent)}
     suffix = f" [reachable from {root_name}]"
     for node in ast.walk(fn_node):
         if isinstance(node, (ast.Subscript, ast.Dict, ast.Call)) \
@@ -175,7 +204,8 @@ def _check_function(rel, fn_node, hash_shadowed, root_name, findings):
             tail = _call_tail(node)
             root = _call_root(node)
             if tail in _ORDER_SINKS and node.args \
-                    and tracker.is_set_expr(node.args[0]):
+                    and tracker.is_set_expr(node.args[0]) \
+                    and not _under_exempt_fold(node, parents):
                 findings.append(Finding(
                     rel, node.lineno, "D1001",
                     f"{tail}() over an unordered set leaks iteration "

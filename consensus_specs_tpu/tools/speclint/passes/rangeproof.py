@@ -31,7 +31,7 @@ from .uint64 import SCOPED_PREFIXES
 
 NAME = "ranges"
 CODE_PREFIXES = ("U9",)
-VERSION = 1
+VERSION = 2
 GRANULARITY = "file"
 
 

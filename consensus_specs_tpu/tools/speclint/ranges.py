@@ -83,6 +83,13 @@ NAMED_BOUNDS = {
     "EFFECTIVE_BALANCE_INCREMENT": 10 ** 9,
     "VALIDATOR_REGISTRY_LIMIT": 2 ** 40,
     "FIELD_ELEMENTS_PER_BLOB": 4096,
+    # mesh-sharded engine bounds (parallel/): a 1-D validator mesh axis
+    # tops out well under 2**13 devices on any deployed topology, and a
+    # per-shard validator span is bounded by the registry limit — these
+    # seed the prover so shard-local uint64 arithmetic (per-shard
+    # lengths, pad amounts, span widths) proves clean without pragmas
+    "MESH_DEVICES": 2 ** 13,
+    "MESH_SHARD_LEN": 2 ** 40,
 }
 
 _INVARIANT_RE = re.compile(r"#\s*speclint:\s*invariant:\s*([^#]+?)\s*$")

@@ -62,6 +62,32 @@ def warm_rlc():
          f"{time.time() - t0:.1f}s")
 
 
+def warm_fft(n: int = None, rows: int = None):
+    """Compile the ``fr_fft`` limb kernel at the DAS shape: the batched
+    (B, 8192, 16) butterflies the ``CS_TPU_DAS_FFT=limb`` erasure-
+    recovery path dispatches (``das/kernels._fft_rows``).  Forward AND
+    inverse domains compile separately (distinct twiddle tables), so
+    both are warmed — multi-minute cold on XLA:CPU, which is exactly
+    why this runs here and not in the first on-device benchmark.
+    ``CS_TPU_WARM_FFT_ROWS`` widens the batch to the expected
+    concurrent-blob count (default 1 row warms the shape bucket)."""
+    from consensus_specs_tpu.ops import kzg as K
+    from consensus_specs_tpu.ops.jax_bls import fr_fft
+    from consensus_specs_tpu.utils import env_flags
+
+    ext = n or 2 * 4096          # FIELD_ELEMENTS_PER_BLOB extension
+    b = rows or max(1, int(env_flags.knob("CS_TPU_WARM_FFT_ROWS", "1")))
+    roots = list(K.compute_roots_of_unity(ext))
+    data = [[(i * 1103515245 + j) % K.BLS_MODULUS for j in range(ext)]
+            for i in range(b)]
+    t0 = time.time()
+    fwd = fr_fft.fft_batch(data, roots)
+    back = fr_fft.fft_batch(fwd, roots, inv=True)
+    assert back == data, "fft roundtrip mismatch"
+    _log(f"fr_fft limb kernel ({b}x{ext}, fwd+inv roundtrip): "
+         f"{time.time() - t0:.1f}s")
+
+
 def warm_entry():
     """Compile the single-chip graft-entry program (the flagship pairing
     check the driver compile-checks)."""
@@ -160,7 +186,8 @@ def main():
                              "bench fallback path); auto: probe the "
                              "accelerator and use it if it answers")
     parser.add_argument("--stage",
-                        choices=("all", "bench", "dryrun", "entry", "rlc"),
+                        choices=("all", "bench", "dryrun", "entry", "rlc",
+                                 "fft"),
                         default="all")
     ns = parser.parse_args()
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
@@ -178,6 +205,8 @@ def main():
         warm_bench()
     if ns.stage in ("all", "rlc"):
         warm_rlc()
+    if ns.stage in ("all", "fft"):
+        warm_fft()
     if ns.stage in ("all", "entry"):
         warm_entry()
     # the dryrun re-execs via subprocess paths of __graft_entry__; warm it

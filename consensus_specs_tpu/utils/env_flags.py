@@ -35,6 +35,7 @@ ENGINE_SWITCHES = (
     "CS_TPU_HASH_FOREST",
     "CS_TPU_SUPERVISOR",
     "CS_TPU_DAS",
+    "CS_TPU_MESH",
 )
 
 _SWITCH_DEFAULTS = {}
@@ -151,6 +152,19 @@ PROTO_ARRAY = os.environ.get("CS_TPU_PROTO_ARRAY") != "0"
 # kernel, numpy mirror under CS_TPU_NUMPY_KERNELS=1); unset = host
 # python-int FFT.
 DAS = os.environ.get("CS_TPU_DAS") != "0"
+
+# Mesh-sharded SPMD state engine kill switch: ``CS_TPU_MESH=0`` keeps
+# the ``StateArrays`` validator-axis columns on one device — epoch
+# sub-transitions and leaf merkleization run the single-device engines
+# (``ops/epoch_kernels``, ``utils/ssz/merkle``) instead of the
+# ``shard_map`` SPMD programs in ``consensus_specs_tpu/parallel/``.
+# Live via :func:`switch`; the engine additionally declines on hosts
+# with fewer than two addressable devices, so the switch only matters
+# on a mesh (or under ``--xla_force_host_platform_device_count``).
+# Engagement floors — registry/leaf sizes below which sharding is pure
+# overhead — are the ``CS_TPU_MESH_MIN`` / ``CS_TPU_MESH_MERKLE_MIN``
+# knobs read through :func:`knob` (``parallel/mesh_state.py``).
+MESH = os.environ.get("CS_TPU_MESH") != "0"
 
 # Engine supervisor kill switch: ``CS_TPU_SUPERVISOR=0`` turns the
 # health-tracking supervision layer (``consensus_specs_tpu/supervisor``)

@@ -379,6 +379,17 @@ class IncrementalTree:
                 data = bytes(data) + b"\x00" * (32 - len(data) % 32)
         else:
             data = b"".join(chunks)
+        # mesh leaf-span path: each device hashes one span subtree, the
+        # host combines the top log2(devices) levels — byte-identical
+        # levels or None (engine off / small tree / counted fallback).
+        # The cheap size pre-check keeps the engine import off the
+        # small-tree hot path entirely.
+        if len(data) >= 16 * 32:
+            from consensus_specs_tpu.parallel import mesh_merkle
+            levels = mesh_merkle.build_levels(data, self.depth)
+            if levels is not None:
+                self.levels = levels
+                return
         levels = [bytearray(data)]
         for level in range(self.depth):
             levels.append(bytearray(hash_layer(_padded_layer(

@@ -1,0 +1,528 @@
+"""Differential + unit suite for the mesh-sharded SPMD state engine
+(``consensus_specs_tpu/parallel/mesh_state.py`` / ``mesh_epoch.py`` /
+``mesh_merkle.py``).
+
+The conftest pins an 8-device virtual CPU mesh before the first jax
+import, so every test here exercises REAL SPMD partitioning —
+``shard_map`` programs, ``NamedSharding`` placements, ``psum``
+collectives — without TPU hardware (the CI ``mesh`` job runs this file
+under the same ``XLA_FLAGS`` leg explicitly, plus the ``CS_TPU_MESH=0``
+off-leg).
+
+Contracts:
+
+* **byte-identity** — epoch transitions and state roots identical
+  across {mesh on, mesh off, spec loop} on the 12-fork differential
+  states, with the engine-commit counters asserted so a silent decline
+  cannot turn the comparison into a tautology;
+* **collective budget** — every reduction program carries exactly ONE
+  psum, every elementwise program ZERO, proven structurally on the
+  jaxprs;
+* **placement lifecycle** — device placements cache on the store cells,
+  ride copy-on-write forks for free, and retire on column writes;
+  16 mesh-forked replays stay byte-identical to independent
+  store-off/mesh-off replays; a ``fork_state`` inside an open
+  ``commit_scope`` strands nothing;
+* **harness contract** — the ``mesh.epoch`` / ``mesh.merkle`` sites
+  take injected faults as counted reason-labeled fallbacks
+  (byte-identical degradation) and rate-1 sentinel audits catch a
+  corrupt-mode result with a quarantine.
+"""
+from random import Random
+
+import numpy as np
+import pytest
+
+from consensus_specs_tpu import faults, supervisor
+from consensus_specs_tpu.forks import build_spec
+from consensus_specs_tpu.ops import epoch_kernels as ek
+from consensus_specs_tpu.parallel import mesh_epoch, mesh_merkle, mesh_state
+from consensus_specs_tpu.state import arrays
+from consensus_specs_tpu.test_infra.block import next_epoch
+from consensus_specs_tpu.test_infra.genesis import create_genesis_state
+from consensus_specs_tpu.test_infra.metrics import counting
+from consensus_specs_tpu.utils import bls
+from consensus_specs_tpu.utils.ssz import (
+    List, hash_tree_root, uint64)
+
+from tests.test_epoch_vectorized import (
+    ALTAIR_FAMILY, PHASE0_FAMILY, _altair_state, _phase0_state)
+
+N_VALIDATORS = 64
+
+
+@pytest.fixture(autouse=True)
+def _mode_reset():
+    prev_bls = bls.bls_active
+    bls.bls_active = False
+    yield
+    bls.bls_active = prev_bls
+    ek.use_auto()
+    arrays.use_auto()
+    mesh_state.use_auto()
+
+
+def _require_mesh():
+    if mesh_state.device_count() < 2:
+        pytest.skip("needs a multi-device host (conftest forces 8 "
+                    "virtual CPU devices)")
+
+
+def _genesis(spec):
+    return create_genesis_state(
+        spec, [spec.MAX_EFFECTIVE_BALANCE] * N_VALIDATORS,
+        spec.MAX_EFFECTIVE_BALANCE)
+
+
+# ---------------------------------------------------------------------------
+# mesh construction / switch plumbing
+# ---------------------------------------------------------------------------
+
+def test_build_mesh_derived_and_memoized():
+    _require_mesh()
+    import jax
+    mesh = mesh_state.build_mesh()
+    assert mesh is mesh_state.build_mesh()          # memoized identity
+    assert mesh.shape[mesh_state.AXIS] == len(jax.devices())
+    pts = mesh_state.build_mesh("points")
+    assert pts.axis_names == ("points",)
+    assert pts is mesh_state.build_mesh("points")
+
+
+def test_pad_amount_uneven_shards():
+    assert mesh_state.pad_amount(16, 8) == 0
+    assert mesh_state.pad_amount(17, 8) == 7
+    assert mesh_state.pad_amount(5, 8) == 3
+    assert mesh_state.pad_amount(0, 8) == 0
+    # a non-power-of-two device count shards too
+    assert mesh_state.pad_amount(16, 6) == 2
+
+
+def test_env_flag_disables_auto(monkeypatch):
+    _require_mesh()
+    monkeypatch.setenv("CS_TPU_MESH", "0")
+    mesh_state.use_auto()
+    assert not mesh_state.enabled()
+    assert mesh_state.backend_name() == "fallback"
+    # live re-read: flipping the variable after import works
+    monkeypatch.setenv("CS_TPU_MESH", "1")
+    assert mesh_state.enabled()
+    assert mesh_state.backend_name() == "mesh"
+    # unset restores the import-time default, whatever it was
+    monkeypatch.delenv("CS_TPU_MESH")
+    from consensus_specs_tpu.utils import env_flags
+    assert mesh_state.enabled() == \
+        env_flags._SWITCH_DEFAULTS["CS_TPU_MESH"]
+
+
+def test_engagement_floor(monkeypatch):
+    _require_mesh()
+    monkeypatch.setenv("CS_TPU_MESH", "1")
+    mesh_state.use_auto()
+    monkeypatch.setenv("CS_TPU_MESH_MIN", "1000")
+    assert not mesh_state.engaged(999)
+    assert mesh_state.engaged(1000)
+    # forcing the engine bypasses the floor (but not the device gate)
+    mesh_state.use_mesh()
+    assert mesh_state.engaged(mesh_state.device_count())
+
+
+# ---------------------------------------------------------------------------
+# collective budget (structural)
+# ---------------------------------------------------------------------------
+
+def test_psum_census_matches_budget():
+    """Every reduction program: exactly ONE psum; every elementwise
+    program: ZERO — the structural half of the bench smoke's counter
+    assertion (``mesh_epoch.PSUM_BUDGET``)."""
+    _require_mesh()
+    import jax
+    mesh = mesh_state.build_mesh()
+    n = 4 * mesh_state.device_count()
+    u64 = np.zeros(n, dtype=np.uint64)
+    u8 = np.zeros(n, dtype=np.uint8)
+    bl = np.zeros(n, dtype=bool)
+    scal = np.zeros(8, dtype=np.uint64)
+
+    def psums(prog, *args):
+        with mesh_state.x64():
+            return str(jax.make_jaxpr(prog)(*args)).count("psum")
+
+    assert psums(mesh_epoch._p_altair_sums(mesh, 3),
+                 u64, u64, u64, bl, u8, scal) == 1
+    assert psums(mesh_epoch._p_masked_sums(mesh),
+                 u64, np.zeros((4, n), dtype=bool)) == 1
+    assert psums(mesh_epoch._p_registry_scan(mesh, (2**64 - 1, 32, 16)),
+                 u64, u64, u64, u64, scal) == 1
+    assert psums(mesh_epoch._p_altair_deltas(
+        mesh, (False, (14, 26, 14), 64, 10**9, 2, 1)),
+        u64, u64, u64, bl, u64, u8, u64, u64, scal) == 0
+    assert psums(mesh_epoch._p_inactivity(mesh, (4, 16, False, 1)),
+                 u64, u64, bl, u64, u8, u64, scal) == 0
+    assert psums(mesh_epoch._p_slashings(mesh, (10**9,)),
+                 u64, bl, u64, u64, scal) == 0
+    assert psums(mesh_epoch._p_eff_balance(
+        mesh, (10**9, 10**8, 10**8, 32 * 10**9)), u64, u64) == 0
+
+
+# ---------------------------------------------------------------------------
+# epoch differential: mesh vs single-device vs spec loop
+# ---------------------------------------------------------------------------
+
+def _epoch_differential(spec, state):
+    s_loop, s_single, s_mesh = state.copy(), state.copy(), state.copy()
+    ek.use_loops()
+    mesh_state.use_fallback()
+    spec.process_epoch(s_loop)
+    ek.use_vectorized()
+    spec.process_epoch(s_single)
+    mesh_state.use_mesh()
+    arrays.use_arrays()
+    with counting() as delta:
+        spec.process_epoch(s_mesh)
+    assert delta["mesh.epoch{path=mesh}"] > 0, \
+        f"{spec.fork}: mesh engine never committed"
+    assert delta["mesh.epoch.fallbacks{reason=guard}"] == 0, \
+        f"{spec.fork}: unexpected mesh guard fallback"
+    r = bytes(hash_tree_root(s_loop))
+    assert bytes(hash_tree_root(s_single)) == r, \
+        f"{spec.fork}: single-device root diverged from the spec loop"
+    assert bytes(hash_tree_root(s_mesh)) == r, \
+        f"{spec.fork}: mesh root diverged"
+    return delta
+
+
+@pytest.mark.parametrize("fork", ALTAIR_FAMILY)
+def test_altair_family_mesh_differential(fork):
+    _require_mesh()
+    spec, state = _altair_state(fork)
+    delta = _epoch_differential(spec, state)
+    # all five sub-transitions through the SPMD programs, on budget
+    assert delta["mesh.epoch{path=mesh}"] == 5
+    for sub, budget in mesh_epoch.PSUM_BUDGET.items():
+        assert delta[f"mesh.psums{{site={sub}}}"] == budget, sub
+
+
+@pytest.mark.parametrize("fork", PHASE0_FAMILY)
+def test_phase0_family_mesh_differential(fork):
+    _require_mesh()
+    spec, state = _phase0_state(fork)
+    delta = _epoch_differential(spec, state)
+    assert delta["mesh.epoch{path=mesh}"] == 4   # no inactivity scores
+
+
+def test_leak_epoch_mesh_differential():
+    _require_mesh()
+    spec, state = _altair_state("altair", leak=True, seed=23)
+    _epoch_differential(spec, state)
+
+
+def test_guard_fallback_counted_and_identical():
+    """A uint64-overflow-risk state declines the mesh (counted
+    reason=guard), falls to the single-device engine — which re-checks
+    its own exact guards — and the result stays byte-identical."""
+    _require_mesh()
+    spec, state = _altair_state("altair", seed=29)
+    state.inactivity_scores[3] = 10**9     # eff * score overflows a lane
+    s_loop, s_mesh = state.copy(), state.copy()
+    ek.use_loops()
+    spec.process_rewards_and_penalties(s_loop)
+    ek.use_vectorized()
+    mesh_state.use_mesh()
+    with counting() as delta:
+        spec.process_rewards_and_penalties(s_mesh)
+    assert delta["mesh.epoch.fallbacks{reason=guard}"] == 1
+    assert hash_tree_root(s_loop) == hash_tree_root(s_mesh)
+
+
+def test_injected_fault_counted_and_identical():
+    """An injected fault at mesh.epoch discharges exactly, books the
+    reason=injected series (organic twin untouched), and the replay
+    stays byte-identical — the PR-8 counted-fallback contract."""
+    _require_mesh()
+    spec, state = _altair_state("altair", seed=31)
+    s_ref, s_inj = state.copy(), state.copy()
+    ek.use_vectorized()
+    mesh_state.use_mesh()
+    arrays.use_arrays()
+    spec.process_epoch(s_ref)
+    sched = faults.FaultSchedule(triggers={"mesh.epoch": {1}})
+    with counting() as delta:
+        with faults.injected(sched):
+            spec.process_epoch(s_inj)
+    assert sched.fully_fired()
+    assert delta["mesh.epoch.fallbacks{reason=injected}"] == 1
+    assert delta["mesh.epoch.fallbacks{reason=guard}"] == 0
+    assert hash_tree_root(s_ref) == hash_tree_root(s_inj)
+
+
+def test_audit_catches_corrupt_epoch_result(monkeypatch, tmp_path):
+    """Corrupt-mode mesh result + rate-1 sentinel audit: the host
+    recomputation is authoritative (the wrong column never commits),
+    the site quarantines, and the post-state is still byte-identical."""
+    _require_mesh()
+    monkeypatch.setenv("CS_TPU_AUDIT_RATE", "1")
+    monkeypatch.setenv("CS_TPU_SIM_ARTIFACTS", str(tmp_path))
+    supervisor.reset()
+    spec, state = _altair_state("altair", seed=37)
+    s_ref, s_cor = state.copy(), state.copy()
+    ek.use_vectorized()
+    mesh_state.use_mesh()
+    arrays.use_arrays()
+    spec.process_epoch(s_ref)
+    supervisor.reset()
+    sched = faults.FaultSchedule(corrupt={"mesh.epoch": [1]})
+    with counting() as delta:
+        with faults.injected(sched):
+            spec.process_epoch(s_cor)
+    assert sched.corrupted, "corrupt mode never armed"
+    assert delta["supervisor.quarantines{site=mesh.epoch}"] == 1
+    assert supervisor.states()["mesh.epoch"] == "quarantined"
+    assert hash_tree_root(s_ref) == hash_tree_root(s_cor)
+
+
+# ---------------------------------------------------------------------------
+# placement lifecycle over copy-on-write forks
+# ---------------------------------------------------------------------------
+
+def test_placement_cached_and_shared_across_forks():
+    _require_mesh()
+    spec = build_spec("altair", "minimal")
+    state = _genesis(spec)
+    arrays.use_arrays()
+    mesh_state.use_mesh()
+    mesh = mesh_state.build_mesh()
+    sa = arrays.of(state)
+    with counting() as delta:
+        a = mesh_state.sharded_cell(sa, "balances", mesh)
+        b = mesh_state.sharded_cell(sa, "balances", mesh)
+    assert a is b
+    assert delta["mesh.placements{column=balances}"] == 1
+    # a copy-on-write fork shares the placement: no new transfer
+    forked = arrays.fork_state(state)
+    with counting() as delta:
+        c = mesh_state.sharded_cell(arrays.of(forked), "balances", mesh)
+    assert c is a
+    assert delta["mesh.placements{column=balances}"] == 0
+    # a column write retires it (identity key) — next read re-places
+    sa.set_balances(sa.balances() + np.uint64(1))
+    with counting() as delta:
+        d = mesh_state.sharded_cell(sa, "balances", mesh)
+    assert d is not a
+    assert delta["mesh.placements{column=balances}"] == 1
+    # ...while the fork still reads the OLD shared placement
+    assert mesh_state.sharded_cell(arrays.of(forked), "balances",
+                                   mesh) is a
+
+
+def test_fork_during_commit_scope_no_stranded_pending():
+    """Regression (satellite): a ``fork_state`` inside an open
+    ``commit_scope`` with device-placed pending columns must commit
+    the pending write into the child (fork commits first), share the
+    post-commit placement, and leave the parent scope functional."""
+    _require_mesh()
+    spec = build_spec("altair", "minimal")
+    state = _genesis(spec)
+    arrays.use_arrays()
+    mesh_state.use_mesh()
+    mesh = mesh_state.build_mesh()
+    sa = arrays.of(state)
+    base = int(spec.MAX_EFFECTIVE_BALANCE)
+    with arrays.commit_scope(state):
+        sa.set_balances(sa.balances() + np.uint64(7))
+        # place the PENDING column on the mesh (an engine read
+        # mid-scope does exactly this)
+        pending_placed = mesh_state.sharded_cell(sa, "balances", mesh)
+        forked = arrays.fork_state(state)
+        # fork committed the pending write first: child SSZ sees it
+        assert int(forked.balances[0]) == base + 7
+        # and the child's cell shares the (still-valid) placement —
+        # nothing re-transferred, nothing stranded on the device
+        with counting() as delta:
+            child_placed = mesh_state.sharded_cell(
+                arrays.of(forked), "balances", mesh)
+        assert child_placed is pending_placed
+        assert delta["mesh.placements{column=balances}"] == 0
+        # parent scope still works after the mid-scope commit
+        sa.set_balances(sa.balances() + np.uint64(5))
+    assert int(state.balances[0]) == base + 12
+    assert int(forked.balances[0]) == base + 7
+    assert bytes(hash_tree_root(forked)) != bytes(hash_tree_root(state))
+
+
+def test_sixteen_mesh_forked_replays_byte_identical():
+    """Satellite: 16 replays forked from one base with the mesh engine
+    ON (sharded columns, shared placements) must merkleize
+    byte-identical to independent store-off mesh-off replays."""
+    _require_mesh()
+    spec, state = _altair_state("altair", seed=41)
+    ek.use_vectorized()
+    arrays.use_arrays()
+    mesh_state.use_mesh()
+    arrays.registry_of(state)
+    arrays.of(state).balances()
+    # warm the BASE placement: forks share it (fork() copies the cell's
+    # shard alongside the data), so replay reads pay zero transfers
+    # until their own copy-on-write registry write
+    mesh_state.sharded_cell(arrays.of(state), "registry",
+                            mesh_state.build_mesh())
+    base_root = bytes(hash_tree_root(state))
+    rng = Random(17)
+    perturbs = [(rng.randrange(N_VALIDATORS),
+                 int(spec.MAX_EFFECTIVE_BALANCE) // 2 + rng.randrange(100))
+                for _ in range(16)]
+
+    def replay(st, i, amount):
+        st.balances[i] = amount
+        next_epoch(spec, st)
+        return bytes(hash_tree_root(st))
+
+    with counting() as delta:
+        forked_roots = [replay(arrays.fork_state(state), i, amt)
+                        for i, amt in perturbs]
+    assert delta["mesh.epoch{path=mesh}"] > 0
+    assert delta["state_arrays.forks"] == 16
+    # shared base placement: each replay re-places the registry at most
+    # once (after its own copy-on-write registry write) instead of the
+    # two transfers an unshared fork pays (initial read + post-write)
+    assert delta["mesh.placements{column=registry}"] <= 16
+
+    mesh_state.use_fallback()
+    arrays.use_fallback()
+    independent_roots = [replay(state.copy(), i, amt)
+                         for i, amt in perturbs]
+    assert forked_roots == independent_roots
+    assert bytes(hash_tree_root(state)) == base_root
+
+
+# ---------------------------------------------------------------------------
+# leaf-span merkleization
+# ---------------------------------------------------------------------------
+
+def test_merkle_levels_byte_identical_fuzz():
+    _require_mesh()
+    mesh_state.use_mesh()
+    rng = np.random.RandomState(3)
+    for count, depth in [(16, 5), (17, 6), (63, 6), (100, 8),
+                         (256, 40), (1000, 12)]:
+        data = rng.bytes(count * 32)
+        got = mesh_merkle.build_levels(data, depth)
+        assert got is not None, (count, depth)
+        golden = mesh_merkle._sequential_levels(data, depth)
+        assert [bytes(a) for a in got] == [bytes(b) for b in golden], \
+            (count, depth)
+
+
+def test_merkle_wired_under_column_commit():
+    """A registry-wide uint64 column commit (``set_leaves`` under the
+    forest flush) routes its full tree rebuild through the leaf-span
+    program — and the committed root matches per-index writes."""
+    _require_mesh()
+    BalanceList = List[uint64, 1 << 40]
+    rng = Random(43)
+    n = 512
+    base = [rng.randrange(0, 2**40) for _ in range(n)]
+    new = [v + 1 for v in base]
+    ref = BalanceList(base)
+    for i, v in enumerate(new):
+        ref[i] = uint64(v)
+    mesh_state.use_mesh()
+    seq = BalanceList(base)
+    hash_tree_root(seq)                  # warm the incremental tree
+    with counting() as delta:
+        ek._write_u64_list(seq, uint64,
+                           np.array(base, dtype=np.uint64),
+                           np.array(new, dtype=np.uint64))
+        root = hash_tree_root(seq)
+    assert delta["mesh.merkle{path=mesh}"] >= 1, \
+        "chunk-packed commit never engaged the leaf-span program"
+    assert bytes(root) == bytes(hash_tree_root(ref))
+
+
+def test_merkle_injected_fault_counted_and_identical():
+    _require_mesh()
+    mesh_state.use_mesh()
+    rng = np.random.RandomState(9)
+    data = rng.bytes(256 * 32)
+    sched = faults.FaultSchedule(triggers={"mesh.merkle": {1}})
+    with counting() as delta:
+        with faults.injected(sched):
+            got = mesh_merkle.build_levels(data, 10)
+    assert got is None                       # declined onto sequential
+    assert sched.fully_fired()
+    assert delta["mesh.merkle.fallbacks{reason=injected}"] == 1
+
+
+def test_merkle_audit_catches_corruption(monkeypatch, tmp_path):
+    _require_mesh()
+    monkeypatch.setenv("CS_TPU_AUDIT_RATE", "1")
+    monkeypatch.setenv("CS_TPU_SIM_ARTIFACTS", str(tmp_path))
+    supervisor.reset()
+    mesh_state.use_mesh()
+    rng = np.random.RandomState(13)
+    data = rng.bytes(256 * 32)
+    golden = mesh_merkle._sequential_levels(data, 10)
+    sched = faults.FaultSchedule(corrupt={"mesh.merkle": [1]})
+    with counting() as delta:
+        with faults.injected(sched):
+            got = mesh_merkle.build_levels(data, 10)
+    assert sched.corrupted
+    # the audit's sequential recompute is authoritative: the caller
+    # still receives byte-identical levels
+    assert [bytes(a) for a in got] == [bytes(b) for b in golden]
+    assert delta["supervisor.quarantines{site=mesh.merkle}"] == 1
+    assert supervisor.states()["mesh.merkle"] == "quarantined"
+    # quarantined: the next build declines straight to sequential
+    assert mesh_merkle.build_levels(data, 10) is None
+
+
+def test_merkle_off_leg_declines():
+    mesh_state.use_fallback()
+    rng = np.random.RandomState(2)
+    assert mesh_merkle.build_levels(rng.bytes(256 * 32), 10) is None
+
+
+# ---------------------------------------------------------------------------
+# G2 MSM mesh scaling (satellite)
+# ---------------------------------------------------------------------------
+
+def test_use_mesh_auto_derives_devices():
+    _require_mesh()
+    import jax
+    from consensus_specs_tpu.ops import bls_rlc
+    try:
+        bls_rlc.use_mesh("auto")
+        assert bls_rlc.mesh_devices() == tuple(jax.devices())
+    finally:
+        bls_rlc.use_mesh(None)
+    assert bls_rlc.mesh_devices() is None
+
+
+@pytest.mark.skipif(
+    not __import__("consensus_specs_tpu.utils.env_flags",
+                   fromlist=["HEAVY"]).HEAVY,
+    reason="G2 MSM shard_map compile on a 1-core host (CS_TPU_HEAVY=1)")
+def test_sharded_g2_msm_uneven_batch_matches_host():
+    """Satellite: the points-sharded G2 MSM at a batch size that does
+    NOT divide the mesh — identity-lane padding — equals the oracle."""
+    _require_mesh()
+    import jax
+    import jax.numpy as jnp
+    from consensus_specs_tpu.parallel.sharded_verify import (
+        sharded_g2_msm_padded)
+    from consensus_specs_tpu.ops import bls_jax
+    from consensus_specs_tpu.ops.jax_bls import points as PT
+    from consensus_specs_tpu.ops.bls12_381.curve import (
+        g2_from_compressed, msm as oracle_msm)
+
+    bls.use_py()
+    sigs = [g2_from_compressed(bls.Sign(i, bytes([i]) * 32))
+            for i in range(1, 7)]          # 6 points over 4 devices
+    rng = np.random.RandomState(42)
+    rs = [int.from_bytes(rng.bytes(16), "little") | 1 for _ in sigs]
+    out = sharded_g2_msm_padded(
+        PT.g2_pack(sigs),
+        jnp.asarray(bls_jax._bits_msb(rs, bls_jax.RLC_SCALAR_BITS)),
+        jax.devices()[:4])
+    got = PT.g2_unpack(jax.tree_util.tree_map(lambda a: a[None], out))
+    assert got == oracle_msm(sigs, rs)

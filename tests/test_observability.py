@@ -382,6 +382,8 @@ def test_fallback_counters_carry_reason_labels():
     # importing the engines binds their series at module scope
     import consensus_specs_tpu.forkchoice.proto_array  # noqa: F401
     import consensus_specs_tpu.ops.epoch_kernels  # noqa: F401
+    import consensus_specs_tpu.parallel.mesh_epoch  # noqa: F401
+    import consensus_specs_tpu.parallel.mesh_merkle  # noqa: F401
     import consensus_specs_tpu.state.arrays  # noqa: F401
     import consensus_specs_tpu.utils.bls  # noqa: F401
     import consensus_specs_tpu.utils.ssz.merkle  # noqa: F401
@@ -394,6 +396,12 @@ def test_fallback_counters_carry_reason_labels():
     assert set(registry.counter("merkle.fallbacks").series_values()) \
         == {"{reason=injected}", "{reason=deadline}"}
     assert set(registry.counter("state_arrays.fallbacks").series_values()) \
+        == {"{reason=injected}", "{reason=deadline}"}
+    # the mesh epoch engine declines organically (guards); the merkle
+    # leaf-span path has no organic guard of its own
+    assert set(registry.counter("mesh.epoch.fallbacks").series_values()) \
+        == {"{reason=guard}", "{reason=injected}", "{reason=deadline}"}
+    assert set(registry.counter("mesh.merkle.fallbacks").series_values()) \
         == {"{reason=injected}", "{reason=deadline}"}
     flush = set(registry.counter("bls.flush").series_values())
     assert {"{path=fallback,reason=bisect}",

@@ -375,6 +375,56 @@ def test_determinism_sorted_and_folds_exempt(tmp_path):
     assert determinism.run(ctx) == []
 
 
+def test_determinism_collective_folds_exempt(tmp_path):
+    """A sink whose value flows DIRECTLY into an order-insensitive fold
+    is exempt: host folds (``sum(list(s))``) and the mesh collectives
+    (``psum``/``all_gather`` — modular addition over a fixed axis /
+    gathered by mesh index, never by arrival order)."""
+    ctx = _det_tree(tmp_path,
+                    "import jax\n"
+                    "import numpy as np\n"
+                    "def work(state):\n"
+                    "    s = set(state)\n"
+                    "    a = sum(list(s))\n"
+                    "    b = jax.lax.psum(np.fromiter(s, np.uint64),\n"
+                    "                     'validators')\n"
+                    "    c = jax.lax.all_gather(np.asarray(list(s)),\n"
+                    "                           'validators')\n"
+                    "    return a, b, c\n")
+    assert determinism.run(ctx) == []
+
+
+def test_determinism_fold_exemption_is_direct_only(tmp_path):
+    """The exemption stops at statement boundaries: materializing the
+    unordered list FIRST and folding later still leaks the order (the
+    intermediate list is a consensus-visible value)."""
+    ctx = _det_tree(tmp_path,
+                    "import jax\n"
+                    "def work(state):\n"
+                    "    s = set(state)\n"
+                    "    items = list(s)\n"
+                    "    return jax.lax.psum(items, 'v')\n")
+    assert _codes(determinism.run(ctx)) == ["D1001"]
+
+
+def test_determinism_reports_in_parallel_package(tmp_path):
+    """The mesh engine (``consensus_specs_tpu/parallel/``) produces
+    consensus-visible results: findings there must report."""
+    root = tmp_path / "repo"
+    _write(root, "consensus_specs_tpu/forks/foo.py",
+           "from consensus_specs_tpu.parallel import eng\n"
+           "class FooSpec:\n"
+           "    def process_thing(self, state):\n"
+           "        return eng.work(state)\n")
+    _write(root, "consensus_specs_tpu/parallel/eng.py",
+           "def work(state):\n"
+           "    return state * 0.5\n")
+    ctx = driver.Context(str(root))
+    findings = determinism.run(ctx)
+    assert _codes(findings) == ["D1002"]
+    assert findings[0].path == "consensus_specs_tpu/parallel/eng.py"
+
+
 def test_determinism_flags_order_sensitive_set_loop(tmp_path):
     ctx = _det_tree(tmp_path,
                     "def work(state):\n"
