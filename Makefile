@@ -9,7 +9,7 @@ GENERATORS = operations sanity finality rewards random forks epoch_processing \
 .PHONY: test citest test-crypto bench bench-all bench-merkle-smoke \
         bench-forkchoice-smoke bench-obs-smoke bench-block-smoke \
         bench-state-smoke bench-supervisor-smoke bench-das-smoke \
-        bench-mesh-smoke sim-smoke sim-heavy \
+        bench-mesh-smoke bench-recovery-smoke sim-smoke sim-heavy \
         obs-report dryrun warm native lint speclint-baseline \
         generate_tests $(addprefix gen_,$(GENERATORS)) clean-vectors pyspec
 
@@ -36,6 +36,7 @@ citest:
 	$(PYTHON) benchmarks/bench_supervisor.py
 	$(PYTHON) benchmarks/bench_das.py
 	$(PYTHON) benchmarks/bench_mesh.py
+	$(PYTHON) benchmarks/bench_recovery.py
 	$(MAKE) sim-smoke
 	$(PYTHON) -m pytest tests/ -q --enable-bls --bls-type fastest
 
@@ -177,6 +178,16 @@ bench-das-smoke:
 bench-mesh-smoke:
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		$(PYTHON) benchmarks/bench_mesh.py
+
+# durable-replay smoke (docs/recovery.md): checkpoint save/restore +
+# journal tail replay round-trip byte-identical (counter-asserted:
+# restore really served from a checkpoint generation), restore +
+# tail-replay cost measured and reported, and the checkpoint-DISABLED
+# wrapper overhead bound: with CS_TPU_CHECKPOINT=0 the durable step
+# driver must cost <2% over the plain replay (the obs/supervisor
+# discipline; nonzero exit above the bound)
+bench-recovery-smoke:
+	$(PYTHON) benchmarks/bench_recovery.py
 
 # engine-supervisor smoke (docs/robustness.md): counter-asserted
 # breaker lifecycle on a real dispatch site (threshold trips ->

@@ -67,6 +67,8 @@ SITES = (
     "das.recover",
     "mesh.epoch",
     "mesh.merkle",
+    "recovery.checkpoint",
+    "recovery.restore",
 )
 
 # Site-family -> the CS_TPU_* switch that turns the family's engine
@@ -82,6 +84,7 @@ SITE_SWITCHES = {
     "bls.": "CS_TPU_BLS_RLC",
     "das.": "CS_TPU_DAS",
     "mesh.": "CS_TPU_MESH",
+    "recovery.": "CS_TPU_CHECKPOINT",
 }
 
 _active = None      # the armed schedule; None = disarmed (the hot path)
@@ -105,16 +108,28 @@ class FaultSchedule:
     engine that returns instead of failing — and is what the
     supervisor's sentinel audits exist to catch.  Corruption events are
     recorded in ``corrupted`` for discharge assertions.
+
+    ``loss`` maps a site name to 1-based call ordinals at which a mesh
+    DEVICE drops out mid-dispatch (:func:`loss_armed`): unlike
+    ``triggers`` the engine does not fall back — its handler invalidates
+    the cached placements, rebuilds the mesh over the surviving
+    devices, books a counted ``reason=device_loss`` fallback and
+    re-dispatches elastically (``parallel/mesh_state.py``).  Each
+    scheduled ordinal fires exactly once (the re-dispatch must not
+    re-lose); fired losses are recorded in ``lost``.
     """
 
-    def __init__(self, triggers=None, corrupt=None):
+    def __init__(self, triggers=None, corrupt=None, loss=None):
         self.triggers = {site: set(ns)
                          for site, ns in (triggers or {}).items() if ns}
         self.corrupt = {site: min(ns)
                         for site, ns in (corrupt or {}).items() if ns}
+        self.loss = {site: set(ns)
+                     for site, ns in (loss or {}).items() if ns}
         self.calls = {}
         self.fired = []
         self.corrupted = []
+        self.lost = []
 
     def hit(self, site: str) -> None:
         n = self.calls.get(site, 0) + 1
@@ -135,6 +150,24 @@ class FaultSchedule:
             return False
         self.corrupted.append((site, n))
         return True
+
+    def losing(self, site: str) -> bool:
+        """Whether the site's CURRENT call is scheduled for a device
+        loss.  The ordinal is CONSUMED on fire: the handler's elastic
+        re-dispatch of the same call must not re-lose a device, or the
+        mesh would drain one device per retry until nothing survives."""
+        ordinals = self.loss.get(site)
+        if not ordinals:
+            return False
+        n = self.calls.get(site, 0)
+        if n not in ordinals:
+            return False
+        ordinals.discard(n)
+        self.lost.append((site, n))
+        return True
+
+    def losses_fired(self) -> bool:
+        return not any(self.loss.values())
 
     @property
     def planned(self) -> int:
@@ -171,6 +204,18 @@ def corrupt_armed(site: str) -> bool:
     if sched is None or not sched.corrupt:
         return False
     return sched.corrupting(site)
+
+
+def loss_armed(site: str) -> bool:
+    """Whether a mesh device drops out of this dispatch (device-loss
+    injection).  The mesh engines check this inside their dispatch
+    scope and raise ``mesh_state.DeviceLoss`` when armed; the handler
+    re-shards over the survivors (``parallel/mesh_state.lose_device``).
+    Disarmed cost: one global read."""
+    sched = _active
+    if sched is None or not sched.loss:
+        return False
+    return sched.losing(site)
 
 
 def active():

@@ -680,6 +680,29 @@ def _engine(store):
     return eng
 
 
+def attach_store_accel(spec, store) -> None:
+    """Attach the engine + store bookkeeping to a store NOT built
+    through the wrapped ``get_forkchoice_store`` — a checkpoint restore
+    (``recovery/checkpoint.py``).  The children index rebuilds from the
+    blocks map (whose insertion order IS the original ``on_block``
+    order, so the engine's parent-before-child node invariant holds),
+    and the engine is seeded with every existing vote and equivocation
+    so the first head read after a restore answers identically to the
+    store that was checkpointed."""
+    children = {}
+    for root, block in store.blocks.items():
+        children.setdefault(bytes(block.parent_root), []) \
+            .append(bytes(root))
+    store._fc_children = children
+    store._fc_children_n = len(store.blocks)
+    store._fc_ancestors = {}
+    if enabled():
+        eng = ProtoArrayEngine(spec, store)
+        eng.note_votes(list(store.latest_messages.keys()))
+        eng.note_equivocations(store)
+        store._fc_proto = eng
+
+
 def install_forkchoice_accel(cls) -> None:
     """Wrap ``cls``'s own fork-choice methods with the proto-array
     dispatch and the store-attached bookkeeping (incremental

@@ -16,6 +16,8 @@ import traceback
 import yaml
 
 from consensus_specs_tpu.obs import registry as _obs_registry
+from consensus_specs_tpu.recovery.atomic import (
+    atomic_replace_bytes, atomic_write_bytes, atomic_write_json)
 from consensus_specs_tpu.utils import snappy
 from consensus_specs_tpu.utils.ssz.types import SSZValue
 from consensus_specs_tpu.debug.encode import encode
@@ -34,8 +36,19 @@ _CASE_FAILURES = (AssertionError, IndexError, KeyError, ValueError,
 
 
 def _write_yaml(path: str, data) -> None:
-    with open(path, "w") as f:
-        yaml.safe_dump(data, f, default_flow_style=None, sort_keys=False)
+    # every emitted vector file lands by atomic rename
+    # (recovery/atomic.py; speclint R901): the corpus is consumed by
+    # OTHER clients — a torn part file would fail their decoders with
+    # no hint the generator died mid-write.  Rename-only (no per-file
+    # fsync): a crashed case directory is distrusted wholesale by the
+    # INCOMPLETE tag below, so per-part durability buys nothing at
+    # thousands of files per corpus run
+    atomic_replace_bytes(path, yaml.safe_dump(
+        data, default_flow_style=None, sort_keys=False).encode("utf-8"))
+
+
+def _write_part_bytes(path: str, data: bytes) -> None:
+    atomic_replace_bytes(path, data)
 
 
 def _encode_meta(value):
@@ -66,21 +79,21 @@ def write_part(case_dir: str, name: str, value, meta: dict) -> None:
     if value is None:
         return  # absent part (e.g. no post state for invalid cases)
     if isinstance(value, RawSSZBytes):
-        with open(os.path.join(case_dir, f"{name}.ssz_snappy"), "wb") as f:
-            f.write(snappy.compress(bytes(value)))
+        _write_part_bytes(os.path.join(case_dir, f"{name}.ssz_snappy"),
+                          snappy.compress(bytes(value)))
     elif isinstance(value, YamlPart):
         payload = value["value"] if set(value) == {"value"} else dict(value)
         _write_yaml(os.path.join(case_dir, f"{name}.yaml"),
                     _encode_meta(payload))
     elif isinstance(value, SSZValue):
-        with open(os.path.join(case_dir, f"{name}.ssz_snappy"), "wb") as f:
-            f.write(snappy.compress(value.serialize()))
+        _write_part_bytes(os.path.join(case_dir, f"{name}.ssz_snappy"),
+                          snappy.compress(value.serialize()))
     elif isinstance(value, (list, tuple)) and value \
             and all(isinstance(v, SSZValue) for v in value):
         for i, v in enumerate(value):
-            with open(os.path.join(case_dir, f"{name}_{i}.ssz_snappy"),
-                      "wb") as f:
-                f.write(snappy.compress(v.serialize()))
+            _write_part_bytes(
+                os.path.join(case_dir, f"{name}_{i}.ssz_snappy"),
+                snappy.compress(v.serialize()))
         meta[f"{name}_count"] = len(value)
     elif isinstance(value, (dict, list, tuple)):
         _write_yaml(os.path.join(case_dir, f"{name}.yaml"),
@@ -102,8 +115,7 @@ def generate_test_vector(test_case, output_dir: str, log) -> str:
     if os.path.exists(case_dir):
         shutil.rmtree(case_dir)
     os.makedirs(case_dir, exist_ok=True)
-    with open(incomplete_tag, "w") as f:
-        f.write("INCOMPLETE")
+    atomic_write_bytes(incomplete_tag, b"INCOMPLETE")
 
     meta = {}
     parts = []
@@ -291,11 +303,15 @@ def run_generator(generator_name: str, providers, args=None) -> dict:
 
     os.makedirs(ns.output_dir, exist_ok=True)
     if error_log:
-        with open(os.path.join(ns.output_dir,
-                               f"testgen_error_log_{generator_name}.txt"),
-                  "a") as f:
-            for entry in error_log:
-                f.write(f"{entry['case']}\n{entry['error']}\n")
+        log_path = os.path.join(
+            ns.output_dir, f"testgen_error_log_{generator_name}.txt")
+        existing_log = ""
+        if os.path.exists(log_path):
+            with open(log_path) as f:
+                existing_log = f.read()
+        atomic_write_bytes(log_path, (existing_log + "".join(
+            f"{entry['case']}\n{entry['error']}\n"
+            for entry in error_log)).encode("utf-8"))
     diag_path = os.path.join(ns.output_dir, "diagnostics_obj.json")
     existing = {}
     if os.path.exists(diag_path):
@@ -303,8 +319,7 @@ def run_generator(generator_name: str, providers, args=None) -> dict:
             existing = json.load(f)
     existing[generator_name] = {k: v for k, v in diagnostics.items()
                                 if k != "test_identifiers"}
-    with open(diag_path, "w") as f:
-        json.dump(existing, f, indent=2)
+    atomic_write_json(diag_path, existing)
 
     print(f"{generator_name}: collected={diagnostics['collected']} "
           f"generated={diagnostics['generated']} "

@@ -74,6 +74,8 @@ _FALLBACKS = {
         "mesh.epoch.fallbacks").labels(reason="injected"),
     "deadline": obs_registry.counter(
         "mesh.epoch.fallbacks").labels(reason="deadline"),
+    "device_loss": obs_registry.counter(
+        "mesh.epoch.fallbacks").labels(reason="device_loss"),
 }
 
 
@@ -173,6 +175,44 @@ def _p_masked_sums(mesh):
             local, mesh=mesh, in_specs=(P(axis), P(None, axis)),
             out_specs=P()))
     return _program("masked_sums", mesh, (), build)
+
+
+# inclusion-delay scan sentinel: an unbeatable (delay, ordinal) key —
+# lanes no source attestation covers keep it, and the host only reads
+# keys at covered lanes
+_INCL_SENTINEL = (1 << 64) - 1
+
+
+def _p_incl_scan(mesh):
+    """Shard-local best-(delay, ordinal) scatter-min for the phase0
+    inclusion-delay pass: the flat participant list rides replicated,
+    each shard scatter-mins the entries that land in its own validator
+    span, and — because every validator lane lives on exactly ONE
+    shard — the per-validator minimum needs ZERO collectives, keeping
+    the rewards_and_penalties psum budget at 1 (asserted structurally
+    in tests/test_mesh.py)."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def local(anchor, idx, keys):
+            n_local = anchor.shape[0]
+            shard = jax.lax.axis_index(mesh_state.AXIS)
+            li = idx - shard.astype(jnp.int64) * n_local
+            ok = (li >= 0) & (li < n_local)
+            li = jnp.where(ok, li, n_local)     # off-shard: dropped
+            keys = jnp.where(ok, keys, jnp.uint64(_INCL_SENTINEL))
+            base = jnp.full((n_local,), jnp.uint64(_INCL_SENTINEL),
+                            dtype=jnp.uint64)
+            return base.at[li].min(keys, mode="drop")
+
+        axis = mesh_state.AXIS
+        return jax.jit(shard_map(
+            local, mesh=mesh, in_specs=(P(axis), P(), P()),
+            out_specs=P(axis)))
+    return _program("incl_scan", mesh, (), build)
 
 
 def _p_altair_deltas(mesh, static):
@@ -412,18 +452,39 @@ def _dispatch(spec, state, sub, fast_fn) -> bool:
     if not supervisor.admit(SITE):
         return False
     ek = _ek()
-    try:
-        faults.check(SITE)
-        with supervisor.deadline_scope(SITE):
-            with span("mesh.epoch.dispatch"):
-                with mesh_state.x64():
-                    handled = fast_fn(spec, state, sa)
-    except ek._Fallback:
-        faults.count_fallback(_FALLBACKS, None, organic="guard", site=SITE)
-        return False
-    except (faults.InjectedFault, supervisor.DeadlineExceeded) as exc:
-        faults.count_fallback(_FALLBACKS, exc, site=SITE)
-        return False
+    checked = False
+    while True:
+        try:
+            if not checked:
+                faults.check(SITE)
+                checked = True
+            with supervisor.deadline_scope(SITE):
+                with span("mesh.epoch.dispatch"):
+                    with mesh_state.x64():
+                        if faults.loss_armed(SITE):
+                            raise mesh_state.DeviceLoss(SITE)
+                        handled = fast_fn(spec, state, sa)
+        except mesh_state.DeviceLoss:
+            # a device dropped out mid-dispatch: retire every cached
+            # placement, re-shard over the survivors, book the counted
+            # fallback and retry elastically — unless the survivor
+            # count falls below the two-device gate / engagement floor,
+            # in which case the single-device engine serves the call
+            mesh_state.lose_device(SITE)
+            faults.count_fallback(_FALLBACKS, None, organic="device_loss",
+                                  site=SITE)
+            if mesh_state.enabled() \
+                    and mesh_state.engaged(len(sa.registry())):
+                continue
+            return False
+        except ek._Fallback:
+            faults.count_fallback(_FALLBACKS, None, organic="guard",
+                                  site=SITE)
+            return False
+        except (faults.InjectedFault, supervisor.DeadlineExceeded) as exc:
+            faults.count_fallback(_FALLBACKS, exc, site=SITE)
+            return False
+        break
     if not handled:
         return False
     supervisor.note_success(SITE)
@@ -611,24 +672,51 @@ def _phase0_rewards(spec, state, sa) -> bool:
         ek._guard(br_max * ai)
         att_increments.append(ai)
 
-    # inclusion-delay rewards: the ordered O(attestations) host pass of
-    # the single-device engine, verbatim — its output rides into the
-    # SPMD program as one more reward column
+    # inclusion-delay rewards: the best-delay/proposer scan runs
+    # SHARD-LOCAL on the mesh.  The flat participant list (one entry
+    # per (attestation, attester)) folds each entry into ONE uint64 key
+    # `delay << 32 | attestation ordinal`, whose lexicographic minimum
+    # reproduces the spec loop's ordered strict-< update byte-for-byte
+    # (the FIRST attestation at the minimal delay wins — ties break on
+    # the ordinal); the proposer-reward apply below stays on the host
+    # in spec order.  Flat operands pad to a power of two so the scan
+    # program compiles O(log flats) shapes, not one per epoch.
     # speclint: invariant: prq >= 1
     prq = int(spec.PROPOSER_REWARD_QUOTIENT)
     src_mask = att_masks[0]
-    best_delay = np.full(n, (1 << 64) - 1, dtype=np.uint64)
-    best_proposer = np.zeros(n, dtype=np.int64)
-    for att in src_atts:
+    flat_idx, flat_key, att_proposers = [], [], []
+    for ordinal, att in enumerate(src_atts):
+        att_proposers.append(int(att.proposer_index))
         idxs = spec.get_attesting_indices(state, att.data,
                                           att.aggregation_bits)
         if not idxs:
             continue
         ii = np.fromiter(idxs, dtype=np.int64, count=len(idxs))
-        upd = np.uint64(int(att.inclusion_delay)) < best_delay[ii]
-        sel = ii[upd]
-        best_delay[sel] = np.uint64(int(att.inclusion_delay))
-        best_proposer[sel] = int(att.proposer_index)
+        flat_idx.append(ii)
+        flat_key.append(np.full(
+            ii.size, np.uint64((int(att.inclusion_delay) << 32)
+                               | ordinal), dtype=np.uint64))
+    best_delay = np.full(n, (1 << 64) - 1, dtype=np.uint64)
+    best_proposer = np.zeros(n, dtype=np.int64)
+    if flat_idx:
+        idx = np.concatenate(flat_idx)
+        keys = np.concatenate(flat_key)
+        pad = (1 << max(1, (idx.size - 1).bit_length())) - idx.size
+        if pad:
+            idx = np.concatenate(
+                [idx, np.full(pad, -1, dtype=np.int64)])
+            keys = np.concatenate(
+                [keys, np.full(pad, _INCL_SENTINEL, dtype=np.uint64)])
+        best_key = mesh_state.unshard(
+            _p_incl_scan(mesh)(reg["eff"],
+                               mesh_state.replicate(idx, mesh),
+                               mesh_state.replicate(keys, mesh)), n)
+        covered = best_key != np.uint64(_INCL_SENTINEL)
+        best_delay[covered] = best_key[covered] >> np.uint64(32)
+        ords = (best_key[covered]
+                & np.uint64(0xFFFFFFFF)).astype(np.int64)
+        best_proposer[covered] = np.array(
+            att_proposers, dtype=np.int64)[ords]
     base_reward = (eff * np.uint64(brf)) // np.uint64(sqrt_total) \
         // np.uint64(brpe)
     proposer_reward = base_reward // np.uint64(prq)
@@ -667,7 +755,27 @@ def _phase0_rewards(spec, state, sa) -> bool:
 
     def host_recompute():
         _, eligible = ek._epoch_masks(spec, cols, prev_epoch)
-        rewards = incl_rewards.copy()
+        # the inclusion-delay scan recomputes through the SPEC-SHAPED
+        # per-attestation loop — the audit must be independent of the
+        # sharded scatter-min it is auditing
+        h_delay = np.full(n, (1 << 64) - 1, dtype=np.uint64)
+        h_proposer = np.zeros(n, dtype=np.int64)
+        for att in src_atts:
+            idxs = spec.get_attesting_indices(state, att.data,
+                                              att.aggregation_bits)
+            if not idxs:
+                continue
+            ii = np.fromiter(idxs, dtype=np.int64, count=len(idxs))
+            upd = np.uint64(int(att.inclusion_delay)) < h_delay[ii]
+            sel = ii[upd]
+            h_delay[sel] = np.uint64(int(att.inclusion_delay))
+            h_proposer[sel] = int(att.proposer_index)
+        rewards = np.zeros(n, dtype=np.uint64)
+        if src_idx.size:
+            max_attester = base_reward[src_idx] - proposer_reward[src_idx]
+            rewards[src_idx] = max_attester // h_delay[src_idx]
+            np.add.at(rewards, h_proposer[src_idx],
+                      proposer_reward[src_idx])
         penalties = np.zeros(n, dtype=np.uint64)
         for i in range(3):
             r, p = ek.phase0_component_kernel(

@@ -45,6 +45,8 @@ _FALLBACKS = {
         "mesh.merkle.fallbacks").labels(reason="injected"),
     "deadline": obs_registry.counter(
         "mesh.merkle.fallbacks").labels(reason="deadline"),
+    "device_loss": obs_registry.counter(
+        "mesh.merkle.fallbacks").labels(reason="device_loss"),
 }
 
 _PROGRAMS = {}
@@ -118,31 +120,49 @@ def build_levels(data, depth: int):
     full_width = merkle.next_power_of_two(count)
     if full_width < 2 * n_dev or depth < merkle.ceil_log2(full_width):
         return None
-    local_depth = merkle.ceil_log2(full_width // n_dev)
     if not supervisor.admit(SITE):
         return None
-    devices = None
-    import jax
-    if n_dev != mesh_state.device_count():
-        devices = tuple(jax.devices()[:n_dev])
-    mesh = mesh_state.build_mesh(devices=devices)
-    try:
-        faults.check(SITE)
-        with supervisor.deadline_scope(SITE):
-            with span("mesh.merkle.dispatch"):
-                padded = bytes(data) \
-                    + b"\x00" * ((full_width - count) * 32)
-                words = np.frombuffer(padded, dtype=">u4") \
-                    .astype(np.uint32).reshape(full_width, 8)
-                with mesh_state.x64():
-                    mesh_state._C_PLACE["leaves"].add()
-                    outs = _program(mesh, local_depth)(words)
-                raw = [np.asarray(o).astype(">u4").tobytes()
-                       for o in outs]
-    except (faults.InjectedFault, supervisor.DeadlineExceeded) as exc:
-        faults.count_fallback(_FALLBACKS, exc, organic="injected",
-                              site=SITE)
-        return None
+    checked = False
+    while True:
+        # span grid re-derives per attempt: a device loss mid-dispatch
+        # shrinks the surviving set and the retry re-shards elastically
+        local_depth = merkle.ceil_log2(full_width // n_dev)
+        devices = None
+        if n_dev != mesh_state.device_count():
+            devices = mesh_state.active_devices()[:n_dev]
+        mesh = mesh_state.build_mesh(devices=devices)
+        try:
+            if not checked:
+                faults.check(SITE)
+                checked = True
+            with supervisor.deadline_scope(SITE):
+                with span("mesh.merkle.dispatch"):
+                    if faults.loss_armed(SITE):
+                        raise mesh_state.DeviceLoss(SITE)
+                    padded = bytes(data) \
+                        + b"\x00" * ((full_width - count) * 32)
+                    words = np.frombuffer(padded, dtype=">u4") \
+                        .astype(np.uint32).reshape(full_width, 8)
+                    with mesh_state.x64():
+                        mesh_state._C_PLACE["leaves"].add()
+                        outs = _program(mesh, local_depth)(words)
+                    raw = [np.asarray(o).astype(">u4").tobytes()
+                           for o in outs]
+        except mesh_state.DeviceLoss:
+            mesh_state.lose_device(SITE)
+            faults.count_fallback(_FALLBACKS, None,
+                                  organic="device_loss", site=SITE)
+            n_dev = _span_shards()
+            if n_dev >= 2 and full_width >= 2 * n_dev \
+                    and mesh_state.enabled() \
+                    and mesh_state.merkle_engaged(count):
+                continue
+            return None     # survivors below the grid: sequential build
+        except (faults.InjectedFault, supervisor.DeadlineExceeded) as exc:
+            faults.count_fallback(_FALLBACKS, exc, organic="injected",
+                                  site=SITE)
+            return None
+        break
     if faults.corrupt_armed(SITE):
         # silent-corruption injection (sentinel-audit test vector): one
         # flipped bit in the top span-root layer — the combined root

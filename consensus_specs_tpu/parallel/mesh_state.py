@@ -44,6 +44,19 @@ device-count gate.
 uint64 columns need 64-bit lanes: every placement and program dispatch
 runs inside ``jax.experimental.enable_x64`` so the rest of the process
 (the u32-limb BLS/SHA kernels) keeps the default dtype rules.
+
+Device-loss recovery (docs/recovery.md): a device dropping out of the
+``validators`` mesh mid-dispatch surfaces as :class:`DeviceLoss` (the
+fault layer injects it via ``faults.loss_armed``; a real XLA device
+failure would be translated by the same handler).  The handler calls
+:func:`lose_device`, which shrinks the active device set and bumps the
+global *placement epoch* — every cached ``_Cell.shard`` placement
+carries the epoch it was placed under, so ALL placements on the old
+mesh retire at once without walking any store — then the dispatch
+rebuilds :func:`build_mesh` over the survivors and re-shards
+elastically.  The two-device gate and the engagement floors keep
+applying: losing down to one device degrades to the single-device
+engines, byte-identical.
 """
 import numpy as np
 
@@ -51,6 +64,16 @@ from consensus_specs_tpu.obs import registry as obs_registry
 from consensus_specs_tpu.utils import env_flags
 
 AXIS = "validators"
+
+
+class DeviceLoss(Exception):
+    """A device dropped out of the mesh mid-dispatch.  A fallback-class
+    exception: the mesh dispatch handlers catch it, re-shard over the
+    survivors and book a counted ``reason=device_loss`` fallback."""
+
+    def __init__(self, site: str):
+        super().__init__(f"{site}: mesh device lost mid-dispatch")
+        self.site = site
 
 # Engagement floors: below these the partition/transfer overhead beats
 # any per-shard win.  Live knobs (read per call through env_flags.knob)
@@ -83,14 +106,22 @@ def use_auto() -> None:
 
 _DEVICE_COUNT = None
 
+# device-loss state: how many devices (from the END of jax.devices(),
+# deterministically) are currently lost, and the placement epoch every
+# cached cell placement is stamped with — bumping it retires every
+# placement on the old mesh at once (no store walking)
+_LOST = 0
+_PLACEMENT_EPOCH = 0
+
 
 def device_count() -> int:
-    """Addressable device count, memoized.  A process that never
-    imported jax answers 0 WITHOUT importing it: the mesh gate sits on
-    every epoch dispatch and every full tree build, and a pure-host
-    replay (spec loops, numpy engines, benches with BLS off) must not
-    pay a jax backend initialization — or risk an accelerator-plugin
-    probe — just to learn there is nothing to shard over."""
+    """SURVIVING addressable device count, memoized.  A process that
+    never imported jax answers 0 WITHOUT importing it: the mesh gate
+    sits on every epoch dispatch and every full tree build, and a
+    pure-host replay (spec loops, numpy engines, benches with BLS off)
+    must not pay a jax backend initialization — or risk an
+    accelerator-plugin probe — just to learn there is nothing to shard
+    over."""
     global _DEVICE_COUNT
     if _DEVICE_COUNT is None:
         import sys
@@ -98,7 +129,50 @@ def device_count() -> int:
             return 0        # not cached: jax may be imported later
         import jax
         _DEVICE_COUNT = len(jax.devices())
-    return _DEVICE_COUNT
+    return max(0, _DEVICE_COUNT - _LOST)
+
+
+def active_devices():
+    """The surviving device tuple the mesh builds over."""
+    import jax
+    devices = tuple(jax.devices())
+    return devices[:len(devices) - _LOST] if _LOST else devices
+
+
+def placement_epoch() -> int:
+    return _PLACEMENT_EPOCH
+
+
+def lose_device(site: str = "mesh") -> int:
+    """Drop one device from the active set (the last, deterministically)
+    and retire EVERY cached placement by bumping the placement epoch.
+    Returns the surviving device count.  Idempotent bookkeeping: the
+    mesh cache keeps old meshes for their key identity, but
+    :func:`build_mesh` with default devices only ever hands out the
+    survivor mesh from here on."""
+    global _LOST, _PLACEMENT_EPOCH
+    total = device_count()
+    if total > 0:
+        _LOST += 1
+    _PLACEMENT_EPOCH += 1
+    series = _C_DEVICE_LOSSES.get(site)
+    if series is None:      # cold resolution only for unknown sites
+        series = obs_registry.counter("mesh.device_losses") \
+            .labels(site=site)
+    series.add()
+    survivors = device_count()
+    _G_SHARDS.set(survivors)
+    return survivors
+
+
+def restore_devices() -> None:
+    """Forget all device losses (test/harness lifecycle); placements
+    made against the degraded mesh retire via the epoch bump."""
+    global _LOST, _PLACEMENT_EPOCH
+    if _LOST:
+        _LOST = 0
+        _PLACEMENT_EPOCH += 1
+    _G_SHARDS.set(device_count())
 
 
 def enabled() -> bool:
@@ -154,6 +228,9 @@ _C_PLACE = {
     for name in ("registry", "balances", "inactivity_scores",
                  "participation", "scalars", "leaves")}
 _G_SHARDS = obs_registry.gauge("mesh.shards").labels()
+_C_DEVICE_LOSSES = {
+    site: obs_registry.counter("mesh.device_losses").labels(site=site)
+    for site in ("mesh.epoch", "mesh.merkle")}
 
 
 # ---------------------------------------------------------------------------
@@ -165,13 +242,13 @@ _MESH_CACHE = {}
 
 
 def build_mesh(axis: str = AXIS, devices=None):
-    """Memoized 1-D ``jax.sharding.Mesh`` over ``devices`` (default: ALL
-    addressable devices — the shape is derived, never hardcoded).
-    Rebuilding a mesh per call would defeat jit's identity-keyed program
-    cache, the same rationale as ``sharded_verify._sharded_msm_for``."""
-    import jax
+    """Memoized 1-D ``jax.sharding.Mesh`` over ``devices`` (default:
+    every SURVIVING addressable device — the shape is derived, never
+    hardcoded, and a device loss shrinks it elastically).  Rebuilding a
+    mesh per call would defeat jit's identity-keyed program cache, the
+    same rationale as ``sharded_verify._sharded_msm_for``."""
     from jax.sharding import Mesh
-    devices = tuple(devices) if devices is not None else tuple(jax.devices())
+    devices = tuple(devices) if devices is not None else active_devices()
     key = (axis, devices)
     mesh = _MESH_CACHE.get(key)
     if mesh is None:
@@ -235,11 +312,14 @@ REGISTRY_U64_FIELDS = ("eff", "aee", "act", "ext", "wd")
 def sharded_cell(sa, name: str, mesh):
     """The device placement of one store column, cached on the cell and
     valid while the cell's current array is the one that was placed
-    (identity check — see module docstring).  Returns the placed device
+    (identity check — see module docstring) AND the placement epoch
+    still matches (a device loss bumps the epoch, retiring every
+    placement on the old mesh at once).  Returns the placed device
     array (or ``{field: array}`` dict for the structured registry)."""
     cell = sa._cell(name)
     sh = cell.shard
-    if sh is not None and sh[0] is cell.data:
+    if sh is not None and sh[0] is cell.data \
+            and sh[2] == _PLACEMENT_EPOCH:
         return sh[1]
     host = cell.data
     with x64():
@@ -254,7 +334,7 @@ def sharded_cell(sa, name: str, mesh):
             # participation_previous / participation_current share one
             # series; the other column names are series keys directly
             _C_PLACE.get(name, _C_PLACE["participation"]).add()
-    cell.shard = (host, placed)
+    cell.shard = (host, placed, _PLACEMENT_EPOCH)
     return placed
 
 

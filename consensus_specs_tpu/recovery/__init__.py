@@ -1,0 +1,27 @@
+"""Durable replay: crash-consistent checkpoint/restore + write-ahead
+journaling for the chain simulator's replays (docs/recovery.md).
+
+Layout:
+
+* ``atomic.py`` — temp + fsync + rename write discipline (shared with
+  ``sim/repro.py`` artifact dumps; enforced by speclint R901);
+* ``journal.py`` — length-prefixed, CRC-guarded write-ahead records;
+* ``checkpoint.py`` — numbered checkpoint generations with per-blob
+  SHA-256 manifests (site ``recovery.checkpoint``);
+* ``replay.py`` — the :class:`DurableReplay` step driver and the
+  recovery ladder (site ``recovery.restore``): latest valid generation
+  + deterministic journal tail replay, degrading generation by
+  generation down to re-execution from genesis.
+
+Everything is behind ``CS_TPU_CHECKPOINT`` (default on, live re-read
+through ``utils/env_flags.switch``): with the switch off a
+:class:`~consensus_specs_tpu.recovery.replay.DurableReplay` neither
+journals nor checkpoints and ``resume`` degrades to deterministic
+re-execution from genesis — byte-identical, just slower.
+"""
+from consensus_specs_tpu.utils import env_flags as _env_flags
+
+
+def enabled() -> bool:
+    """Durability master switch (live, ``utils/env_flags.switch``)."""
+    return _env_flags.switch("CS_TPU_CHECKPOINT")

@@ -1,0 +1,99 @@
+"""Crash-consistent file writes: the one sanctioned way anything in
+this tree persists state it may later need to trust.
+
+A bare ``open(path, "w")`` + ``write`` is a torn-file generator: a
+crash (or SIGKILL — the sim harness sends real ones) between the open
+and the close leaves a half-written file at the FINAL path, and the
+next reader either crashes on it or, worse, trusts it.  Every helper
+here follows the classic temp + fsync + rename discipline instead:
+
+1. write the full payload to a temporary file in the SAME directory
+   (``os.replace`` is only atomic within one filesystem),
+2. flush + ``os.fsync`` the temp file (data durable before the name),
+3. ``os.replace`` onto the final path (atomic on POSIX),
+4. ``os.fsync`` the directory so the rename itself is durable.
+
+Readers therefore see either the old content or the new content, never
+a prefix.  The speclint durability pass (R901,
+``tools/speclint/passes/durability.py``) flags bare final-path writes
+in the persistence scopes so new code cannot regress to the torn
+idiom.
+"""
+import hashlib
+import json
+import os
+import tempfile
+
+
+def fsync_dir(path: str) -> None:
+    """Durable-rename half of the discipline: fsync the directory that
+    just had an entry replaced.  Best-effort on filesystems that refuse
+    directory fds (the rename is still atomic, just not yet durable)."""
+    try:
+        fd = os.open(path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Write ``data`` to ``path`` crash-consistently (module docstring).
+    Raises OSError on any failure; the final path is never left torn —
+    a failed attempt leaves at most an orphaned ``.tmp`` file, which a
+    later successful write of the same path does not depend on."""
+    directory = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=directory,
+                               prefix=os.path.basename(path) + ".",
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    fsync_dir(directory)
+
+
+def atomic_write_json(path: str, payload, indent=2) -> None:
+    """JSON convenience wrapper over :func:`atomic_write_bytes`."""
+    atomic_write_bytes(
+        path, json.dumps(payload, indent=indent).encode("utf-8"))
+
+
+def atomic_replace_bytes(path: str, data: bytes) -> None:
+    """Rename atomicity WITHOUT the fsyncs: readers still never see a
+    torn file, but the write is not durable until the filesystem
+    flushes on its own.  For bulk outputs whose crash-consistency is
+    fenced at a higher level — the vector generator's per-case part
+    files ride under an INCOMPLETE-tag protocol that distrusts the
+    whole case directory after a crash, so paying two fsyncs per part
+    (thousands per corpus run) buys nothing the tag does not."""
+    directory = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=directory,
+                               prefix=os.path.basename(path) + ".",
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def sha256_hex(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()

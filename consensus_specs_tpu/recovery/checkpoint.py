@@ -1,0 +1,458 @@
+"""Crash-consistent checkpoints of a chain replay.
+
+A checkpoint *generation* captures everything a durable replay
+(``recovery/replay.py``) needs to resume byte-identically: the
+fork-choice ``Store`` (blocks, block/checkpoint states, latest
+messages, proposer-boost root, equivocating set, timeliness,
+unrealized justifications — every state anchored through the existing
+SSZ ``serialize``/``deserialize``), the driver sidecar (tips, offline
+set, queued attestations/blocks/evidence, recorded headers, step
+statuses) and a manifest with a per-blob SHA-256 content hash plus a
+monotonic generation counter.
+
+Write protocol (crash-consistent by construction): every blob is
+written through ``recovery/atomic.py`` (temp + fsync + rename), the
+manifest is written LAST — a generation without a manifest does not
+exist, so a crash mid-checkpoint can never produce a half generation
+that recovery would trust.  Read protocol: the manifest must parse and
+every blob must match its recorded SHA-256, or the generation raises
+:class:`CheckpointCorrupt` and the recovery ladder degrades to the
+previous generation with a counted ``recovery.fallbacks{reason=}``.
+
+``StateArrays`` columns are deliberately NOT persisted: they re-derive
+from the restored SSZ states on first engine access (``state/arrays``
+extracts lazily), and mesh device placements / copy-on-write cells
+rebuild the same way — persisting raw columns would add a second
+source of truth that could silently disagree with the SSZ bytes.
+Checkpointing inside an open ``arrays.commit_scope`` is REFUSED
+(:class:`CheckpointRefused`): a state with deferred column writes is
+mid-transition and its SSZ bytes are not yet authoritative.
+
+``recovery.checkpoint`` is a first-class supervised engine site
+(breaker admission, fault hook, deadline scope, counted fallbacks,
+read-back sentinel audits): a failed or demoted checkpoint SKIPS — the
+replay continues, durability degrades one generation, and the trip is
+counted — never crashes the replay.
+"""
+import json
+import os
+import struct
+
+from consensus_specs_tpu import faults, supervisor
+from consensus_specs_tpu.obs import registry as obs_registry
+from consensus_specs_tpu.obs.tracing import span
+from consensus_specs_tpu.recovery.atomic import (
+    atomic_write_bytes, atomic_write_json, sha256_hex)
+from consensus_specs_tpu.utils.ssz import serialize, deserialize
+
+SITE_CHECKPOINT = "recovery.checkpoint"
+SITE_RESTORE = "recovery.restore"
+
+# ---------------------------------------------------------------------------
+# Metrics (pre-bound series, speclint O5xx hot-path rule).  The
+# fallback reason vocabulary doubles as the recovery-ladder rung log:
+# injected/deadline/io skip a checkpoint; manifest/blob/journal_corrupt/
+# torn_record/divergence each degrade a restore one generation.
+# ---------------------------------------------------------------------------
+
+_C_SAVED = obs_registry.counter("recovery.checkpoints").labels(
+    result="saved")
+_C_SKIPPED = obs_registry.counter("recovery.checkpoints").labels(
+    result="skipped")
+_C_REFUSED = obs_registry.counter("recovery.checkpoints").labels(
+    result="refused")
+FALLBACKS = {
+    reason: obs_registry.counter("recovery.fallbacks").labels(reason=reason)
+    for reason in ("injected", "deadline", "io", "manifest", "blob",
+                   "journal_corrupt", "torn_record", "divergence")}
+RESTORES = {
+    path: obs_registry.counter("recovery.restores").labels(path=path)
+    for path in ("checkpoint", "genesis")}
+JOURNAL_RECORDS = {
+    op: obs_registry.counter("recovery.journal.records").labels(op=op)
+    for op in ("appended", "replayed")}
+_G_GENERATION = obs_registry.gauge("recovery.generation").labels()
+
+
+class CheckpointCorrupt(Exception):
+    """A generation failed its integrity checks; ``reason`` names the
+    counted fallback rung (``manifest`` or ``blob``)."""
+
+    def __init__(self, reason: str, detail: str):
+        super().__init__(f"checkpoint {reason} corruption: {detail}")
+        self.reason = reason
+
+
+class CheckpointRefused(RuntimeError):
+    """Checkpoint requested while a state holds deferred column writes
+    (an open ``arrays.commit_scope``): the SSZ bytes are not
+    authoritative mid-scope, so the request is refused loudly."""
+
+
+# ---------------------------------------------------------------------------
+# Record packing (length-prefixed blob members)
+# ---------------------------------------------------------------------------
+
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+
+def _pack(records) -> bytes:
+    out = bytearray()
+    for rec in records:
+        out += _U32.pack(len(rec))
+        out += rec
+    return bytes(out)
+
+
+def _unpack(data: bytes):
+    out = []
+    off = 0
+    n = len(data)
+    while off < n:
+        if off + 4 > n:
+            raise CheckpointCorrupt("blob", "truncated record header")
+        (length,) = _U32.unpack_from(data, off)
+        off += 4
+        if off + length > n:
+            raise CheckpointCorrupt("blob", "truncated record body")
+        out.append(data[off:off + length])
+        off += length
+    return out
+
+
+def _ckpt_json(checkpoint):
+    return [int(checkpoint.epoch), bytes(checkpoint.root).hex()]
+
+
+def _ckpt_obj(spec, pair):
+    return spec.Checkpoint(epoch=int(pair[0]), root=bytes.fromhex(pair[1]))
+
+
+def store_digest(spec, store) -> dict:
+    """The store half of the replay-equality surface (the statuses ride
+    in the sidecar): recorded in the manifest at save time and compared
+    by the restore sentinel audit."""
+    from consensus_specs_tpu.utils.ssz import hash_tree_root
+    head = bytes(spec.get_head(store))
+    return {
+        "head": head.hex(),
+        "head_state_root":
+            bytes(hash_tree_root(store.block_states[head])).hex(),
+        "justified": _ckpt_json(store.justified_checkpoint),
+        "finalized": _ckpt_json(store.finalized_checkpoint),
+    }
+
+
+def scenario_identity(scenario) -> dict:
+    """Content identity of the scenario a checkpoint belongs to,
+    recorded in the manifest and verified by the recovery ladder: a
+    resume against another scenario's checkpoint directory with an
+    EMPTY journal tail would otherwise pass every self-consistency
+    check (the store is internally valid — it is just someone else's)
+    and silently continue the wrong replay."""
+    import hashlib
+    return {
+        "seed": int(scenario.seed),
+        "name": scenario.name,
+        "n_validators": int(scenario.n_validators),
+        "script_sha256": hashlib.sha256(json.dumps(
+            scenario.script, sort_keys=True,
+            separators=(",", ":")).encode("utf-8")).hexdigest(),
+    }
+
+
+def _refuse_open_scopes(store) -> None:
+    for states in (store.block_states, store.checkpoint_states):
+        for state in states.values():
+            sa = getattr(state, "__dict__", {}).get("_state_arrays")
+            if sa is not None and sa._deferred:
+                _C_REFUSED.add()
+                raise CheckpointRefused(
+                    "checkpoint refused: a store state holds deferred "
+                    "column writes (open arrays.commit_scope) — its SSZ "
+                    "bytes are not authoritative mid-transition")
+
+
+class CheckpointStore:
+    """One checkpoint directory: numbered generations + their journals."""
+
+    def __init__(self, root_dir: str, keep: int = 3):
+        self.root_dir = root_dir
+        self.keep = max(2, int(keep))
+        os.makedirs(root_dir, exist_ok=True)
+
+    # -- paths / listing ----------------------------------------------------
+
+    def manifest_path(self, gen: int) -> str:
+        return os.path.join(self.root_dir, f"manifest_{gen}.json")
+
+    def blob_path(self, gen: int, name: str) -> str:
+        return os.path.join(self.root_dir, f"ckpt_{gen}_{name}")
+
+    def journal_path(self, gen: int) -> str:
+        return os.path.join(self.root_dir, f"wal_{gen}.log")
+
+    def generations(self):
+        """Committed generation numbers, ascending.  Only a parseable
+        ``manifest_<g>.json`` NAME counts as committed — content
+        integrity is the loader's job, so a corrupted manifest still
+        occupies its rung and books its counted fallback there."""
+        out = []
+        for name in os.listdir(self.root_dir):
+            if name.startswith("manifest_") and name.endswith(".json"):
+                try:
+                    out.append(int(name[len("manifest_"):-len(".json")]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    # -- save (site recovery.checkpoint) ------------------------------------
+
+    def save(self, spec, sim, step: int, fork: str = None,
+             preset: str = None, scenario=None):
+        """Write the next generation; returns its number, or None when
+        the checkpoint was SKIPPED (breaker open, injected fault,
+        deadline, I/O failure) — a counted degradation, never a crash.
+        Raises :class:`CheckpointRefused` inside an open commit scope
+        (a caller bug, not a fault).  ``scenario`` stamps the manifest
+        with the replay's content identity (:func:`scenario_identity`)
+        so the ladder refuses another scenario's directory."""
+        _refuse_open_scopes(sim.store)
+        site = SITE_CHECKPOINT
+        if not supervisor.admit(site):
+            _C_SKIPPED.add()
+            return None
+        gens = self.generations()
+        gen = (gens[-1] + 1) if gens else 1
+        try:
+            faults.check(site)
+            with span("recovery.checkpoint"):
+                with supervisor.deadline_scope(site):
+                    self._write_generation(spec, sim, step, gen,
+                                           fork=fork, preset=preset,
+                                           scenario=scenario)
+        except (faults.InjectedFault, supervisor.DeadlineExceeded) as exc:
+            faults.count_fallback(FALLBACKS, exc, site=site)
+            self._discard(gen)
+            _C_SKIPPED.add()
+            return None
+        except OSError:
+            faults.count_fallback(FALLBACKS, None, organic="io", site=site)
+            self._discard(gen)
+            _C_SKIPPED.add()
+            return None
+        if supervisor.audit_due(site):
+            ok, detail = self.verify(gen)
+            supervisor.audit_result(
+                site, ok, f"checkpoint generation {gen} read back "
+                f"differently than written: {detail}")
+            if not ok:
+                self._discard(gen)
+                _C_SKIPPED.add()
+                return None
+        else:
+            supervisor.note_success(site)
+        _C_SAVED.add()
+        _G_GENERATION.set(gen)
+        self.prune()
+        return gen
+
+    def _write_blob(self, gen, name, data, blobs, corrupt=False):
+        """One atomic blob write + its manifest hash entry.  ``corrupt``
+        is the silent-corruption injection hook: the RECORDED hash stays
+        true to the intended content while a flipped bit hits the disk —
+        exactly the wrongness the read-back audit / restore hash check
+        must catch."""
+        recorded = sha256_hex(data)
+        if corrupt:
+            data = bytes([data[0] ^ 1]) + data[1:] if data else b"\x01"
+        atomic_write_bytes(self.blob_path(gen, name), data)
+        blobs[name] = {"file": os.path.basename(self.blob_path(gen, name)),
+                       "sha256": recorded, "bytes": len(data)}
+        supervisor.deadline_check()
+
+    def _write_generation(self, spec, sim, step, gen, fork=None,
+                          preset=None, scenario=None) -> None:
+        store = sim.store
+        corrupt = faults.corrupt_armed(SITE_CHECKPOINT)
+        blobs = {}
+        # blob order matters for restore: dict insertion order IS the
+        # on_block order the proto-array engine's parent-before-child
+        # invariant needs, so records are packed in iteration order
+        self._write_blob(gen, "blocks.bin", _pack(
+            bytes(root) + serialize(block)
+            for root, block in store.blocks.items()), blobs,
+            corrupt=corrupt)
+        self._write_blob(gen, "states.bin", _pack(
+            bytes(root) + serialize(state)
+            for root, state in store.block_states.items()), blobs)
+        self._write_blob(gen, "ckpt_states.bin", _pack(
+            _U64.pack(int(epoch)) + bytes(root) + serialize(state)
+            for (epoch, root), state in store.checkpoint_states.items()),
+            blobs)
+        meta = {
+            "time": int(store.time),
+            "genesis_time": int(store.genesis_time),
+            "justified": _ckpt_json(store.justified_checkpoint),
+            "finalized": _ckpt_json(store.finalized_checkpoint),
+            "unrealized_justified":
+                _ckpt_json(store.unrealized_justified_checkpoint),
+            "unrealized_finalized":
+                _ckpt_json(store.unrealized_finalized_checkpoint),
+            "proposer_boost_root":
+                bytes(store.proposer_boost_root).hex(),
+            "equivocating_indices":
+                sorted(int(i) for i in store.equivocating_indices),
+            "block_timeliness": {
+                bytes(r).hex(): bool(t)
+                for r, t in store.block_timeliness.items()},
+            "latest_messages": [
+                [int(i), int(m.epoch), bytes(m.root).hex()]
+                for i, m in store.latest_messages.items()],
+            "unrealized_justifications": [
+                [bytes(r).hex(), _ckpt_json(c)]
+                for r, c in store.unrealized_justifications.items()],
+            "anchor_root": sim.anchor_root.hex(),
+        }
+        self._write_blob(gen, "store_meta.json",
+                         json.dumps(meta, sort_keys=True).encode("utf-8"),
+                         blobs)
+        self._write_blob(gen, "sidecar.json",
+                         json.dumps(sim.snapshot_sidecar(),
+                                    sort_keys=True).encode("utf-8"),
+                         blobs)
+        manifest = {
+            "generation": gen,
+            "step": int(step),
+            "fork": fork or getattr(spec, "fork", None),
+            "preset": preset or getattr(spec, "preset_name", None),
+            "scenario": scenario_identity(scenario)
+            if scenario is not None else None,
+            "digest": store_digest(spec, store),
+            "blobs": blobs,
+        }
+        # the commit point: the manifest lands atomically LAST
+        atomic_write_json(self.manifest_path(gen), manifest)
+
+    def _discard(self, gen: int) -> None:
+        """Drop a half-written or audit-failed generation's files."""
+        for name in os.listdir(self.root_dir):
+            if name == f"manifest_{gen}.json" \
+                    or name.startswith(f"ckpt_{gen}_"):
+                try:
+                    os.unlink(os.path.join(self.root_dir, name))
+                except OSError:
+                    pass
+
+    def prune(self) -> None:
+        """Keep the newest ``keep`` generations (and their journals) —
+        the recovery ladder needs at least one rung below the newest."""
+        gens = self.generations()
+        for gen in gens[:-self.keep]:
+            self._discard(gen)
+            try:
+                os.unlink(self.journal_path(gen))
+            except OSError:
+                pass
+
+    # -- load / verify ------------------------------------------------------
+
+    def read_manifest(self, gen: int) -> dict:
+        try:
+            with open(self.manifest_path(gen)) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as exc:
+            raise CheckpointCorrupt("manifest",
+                                    f"generation {gen}: {exc}") from exc
+        if not isinstance(manifest.get("blobs"), dict) \
+                or "step" not in manifest:
+            raise CheckpointCorrupt(
+                "manifest", f"generation {gen}: missing blobs/step")
+        return manifest
+
+    def _read_blob(self, gen: int, manifest: dict, name: str) -> bytes:
+        entry = manifest["blobs"].get(name)
+        if entry is None:
+            raise CheckpointCorrupt("manifest",
+                                    f"generation {gen}: no {name} entry")
+        try:
+            with open(self.blob_path(gen, name), "rb") as f:
+                data = f.read()
+        except OSError as exc:
+            raise CheckpointCorrupt("blob",
+                                    f"generation {gen}: {exc}") from exc
+        if sha256_hex(data) != entry["sha256"]:
+            raise CheckpointCorrupt(
+                "blob", f"generation {gen}: {name} SHA-256 mismatch "
+                "(bit flip or truncation)")
+        return data
+
+    def verify(self, gen: int):
+        """Read-back integrity check (the checkpoint sentinel audit):
+        ``(ok, detail)`` without materializing any objects."""
+        try:
+            manifest = self.read_manifest(gen)
+            for name in manifest["blobs"]:
+                self._read_blob(gen, manifest, name)
+        except CheckpointCorrupt as exc:
+            return False, str(exc)
+        return True, ""
+
+    def load(self, spec, gen: int):
+        """Rebuild ``(sim, step, manifest)`` from generation ``gen``.
+        Raises :class:`CheckpointCorrupt` on any integrity failure —
+        classification (manifest vs blob) rides on the exception for
+        the ladder's counted fallback."""
+        from consensus_specs_tpu.forkchoice.proto_array import (
+            attach_store_accel)
+        from consensus_specs_tpu.sim.driver import ChainSim
+        manifest = self.read_manifest(gen)
+        meta = json.loads(
+            self._read_blob(gen, manifest, "store_meta.json"))
+        blocks = {}
+        for rec in _unpack(self._read_blob(gen, manifest, "blocks.bin")):
+            blocks[rec[:32]] = deserialize(spec.BeaconBlock, rec[32:])
+        block_states = {}
+        for rec in _unpack(self._read_blob(gen, manifest, "states.bin")):
+            block_states[rec[:32]] = deserialize(spec.BeaconState, rec[32:])
+        checkpoint_states = {}
+        for rec in _unpack(
+                self._read_blob(gen, manifest, "ckpt_states.bin")):
+            (epoch,) = _U64.unpack_from(rec)
+            checkpoint_states[(epoch, rec[8:40])] = deserialize(
+                spec.BeaconState, rec[40:])
+        store = spec.Store(
+            time=int(meta["time"]),
+            genesis_time=int(meta["genesis_time"]),
+            justified_checkpoint=_ckpt_obj(spec, meta["justified"]),
+            finalized_checkpoint=_ckpt_obj(spec, meta["finalized"]),
+            unrealized_justified_checkpoint=_ckpt_obj(
+                spec, meta["unrealized_justified"]),
+            unrealized_finalized_checkpoint=_ckpt_obj(
+                spec, meta["unrealized_finalized"]),
+            proposer_boost_root=bytes.fromhex(
+                meta["proposer_boost_root"]),
+            equivocating_indices=set(meta["equivocating_indices"]),
+            blocks=blocks,
+            block_states=block_states,
+            block_timeliness={bytes.fromhex(r): bool(t)
+                              for r, t in meta["block_timeliness"].items()},
+            checkpoint_states=checkpoint_states,
+            latest_messages={
+                int(i): spec.LatestMessage(epoch=int(e),
+                                           root=bytes.fromhex(r))
+                for i, e, r in meta["latest_messages"]},
+            unrealized_justifications={
+                bytes.fromhex(r): _ckpt_obj(spec, c)
+                for r, c in meta["unrealized_justifications"]},
+        )
+        # the StateArrays columns and device placements re-derive from
+        # the restored SSZ states on first engine access; the
+        # proto-array engine and store bookkeeping re-attach here
+        attach_store_accel(spec, store)
+        sim = ChainSim.restored(
+            spec, store, bytes.fromhex(meta["anchor_root"]))
+        sim.restore_sidecar(json.loads(
+            self._read_blob(gen, manifest, "sidecar.json")))
+        return sim, int(manifest["step"]), manifest

@@ -1,0 +1,296 @@
+"""The durable replay driver + the recovery ladder.
+
+:class:`DurableReplay` executes a scenario script step by step through
+the chain driver (``sim/driver.ChainSim``), journaling every delivered
+wire event and every completed step (``recovery/journal.py``) and
+taking a crash-consistent checkpoint every ``checkpoint_every`` steps
+(``recovery/checkpoint.py``).  Kill it anywhere — SIGKILL included,
+the sim harness sends real ones — and :meth:`DurableReplay.resume`
+provably resumes byte-identical:
+
+recovery ladder (site ``recovery.restore``, every rung counted)
+    1. newest checkpoint generation: manifest parses, every blob
+       matches its SHA-256, the restored store passes the sentinel
+       digest audit when sampled;
+    2. its journal: CRC-valid to the end (a torn final record — the
+       SIGKILL signature — or any mid-file damage degrades the whole
+       generation, ``reason=torn_record`` / ``journal_corrupt``);
+    3. journal tail replay: the completed steps re-execute through the
+       driver and every regenerated wire event must byte-match its
+       journaled record (``reason=divergence`` otherwise) — a
+       nondeterministic resume is detected, never silently served;
+    4. any failure degrades to the previous generation; the final rung
+       is deterministic re-execution from genesis
+       (``recovery.restores{path=genesis}``).
+
+The resumed replay immediately takes a fresh checkpoint generation at
+the resume step, so durability re-arms before any new work.
+"""
+import os
+import signal
+import struct
+
+from consensus_specs_tpu import faults, recovery, supervisor
+from consensus_specs_tpu.obs.tracing import span
+from consensus_specs_tpu.recovery import journal
+from consensus_specs_tpu.recovery.checkpoint import (
+    FALLBACKS, JOURNAL_RECORDS, RESTORES,
+    CheckpointCorrupt, CheckpointStore, scenario_identity, store_digest)
+from consensus_specs_tpu.utils.ssz import serialize
+
+
+class ReplayDivergence(Exception):
+    """A journal tail replay regenerated different wire events than the
+    journal recorded: the resume would not be byte-identical."""
+
+
+_EVENT_KINDS = {"tick": journal.TICK, "block": journal.BLOCK,
+                "attestation": journal.ATTESTATION,
+                "attester_slashing": journal.SLASHING}
+
+
+def encode_event(kind: str, value):
+    """One driver delivery as its ``(journal kind, payload)`` record."""
+    code = _EVENT_KINDS[kind]
+    if code == journal.TICK:
+        return code, struct.pack("<Q", int(value))
+    return code, bytes(serialize(value))
+
+
+def restore_replay(spec, scenario, cs: CheckpointStore):
+    """``(sim, next_step, info)`` through the recovery ladder (module
+    docstring).  ``info`` records the path taken: the generation that
+    served the resume (or ``"genesis"``), the journal steps replayed,
+    and every counted rung reason on the way down."""
+    site = "recovery.restore"       # == checkpoint.SITE_RESTORE; the
+    #                                 literal keeps the C11xx coverage
+    #                                 proof module-local
+    info = {"path": "genesis", "generation": None,
+            "journal_steps": 0, "rungs": []}
+    if recovery.enabled():
+        for gen in sorted(cs.generations(), reverse=True):
+            if not supervisor.admit(site):
+                break
+            try:
+                faults.check(site)
+                with span("recovery.restore"):
+                    with supervisor.deadline_scope(site):
+                        sim, step, manifest = cs.load(spec, gen)
+            except (faults.InjectedFault,
+                    supervisor.DeadlineExceeded) as exc:
+                faults.count_fallback(FALLBACKS, exc, site=site)
+                info["rungs"].append((gen, "injected"))
+                continue
+            except CheckpointCorrupt as exc:
+                faults.count_fallback(FALLBACKS, None, organic=exc.reason,
+                                      site=site)
+                info["rungs"].append((gen, exc.reason))
+                continue
+            ident = manifest.get("scenario")
+            if ident is not None and ident != scenario_identity(scenario):
+                # another scenario's checkpoint directory: the store is
+                # internally valid (every self-consistency check would
+                # pass) but it is someone ELSE's replay — with an empty
+                # journal tail nothing later would catch it, so refuse
+                # the generation here, counted
+                faults.count_fallback(FALLBACKS, None,
+                                      organic="divergence", site=site)
+                info["rungs"].append((gen, "scenario_mismatch"))
+                continue
+            if faults.corrupt_armed(site):
+                # silent-corruption injection (sentinel-audit test
+                # vector): one gwei on the head state — the restored
+                # store still WORKS, its head-state root just lies,
+                # exactly the wrongness only the digest audit surfaces
+                head = bytes(spec.get_head(sim.store))
+                state = sim.store.block_states[head]
+                if len(state.balances):
+                    state.balances[0] += 1
+            if supervisor.audit_due(site):
+                ok = store_digest(spec, sim.store) == manifest["digest"]
+                supervisor.audit_result(
+                    site, ok, f"restored generation {gen} digest "
+                    "diverged from the manifest record")
+                if not ok:
+                    # every rung down is a counted fallback — the
+                    # audit books its supervisor counters, the ladder
+                    # degradation books its own reason
+                    faults.count_fallback(FALLBACKS, None,
+                                          organic="divergence",
+                                          site=site)
+                    info["rungs"].append((gen, "audit"))
+                    continue
+            else:
+                supervisor.note_success(site)
+            records, anomaly = journal.scan(cs.journal_path(gen))
+            if anomaly is not None:
+                reason = "torn_record" if anomaly == "torn" \
+                    else "journal_corrupt"
+                faults.count_fallback(FALLBACKS, None, organic=reason,
+                                      site=site)
+                info["rungs"].append((gen, reason))
+                continue
+            steps = journal.completed_steps(records)
+            try:
+                next_step = _replay_tail(sim, scenario, step, steps)
+            except ReplayDivergence:
+                faults.count_fallback(FALLBACKS, None,
+                                      organic="divergence", site=site)
+                info["rungs"].append((gen, "divergence"))
+                continue
+            RESTORES["checkpoint"].add()
+            info["path"] = "checkpoint"
+            info["generation"] = gen
+            info["journal_steps"] = len(steps)
+            return sim, next_step, info
+    # final rung: byte-identical by determinism, just slower
+    from consensus_specs_tpu.sim.driver import ChainSim
+    RESTORES["genesis"].add()
+    return ChainSim(spec, scenario.n_validators), 0, info
+
+
+def _replay_tail(sim, scenario, start_step: int, steps) -> int:
+    """Re-execute the journal's completed steps through the driver,
+    byte-comparing every regenerated wire event against its journaled
+    record.  Returns the next script step to run."""
+    script = scenario.script
+    regenerated = []
+
+    def hook(kind, value):
+        regenerated.append(encode_event(kind, value))
+
+    sim.event_hook = hook
+    try:
+        expected = start_step
+        for ordinal, step, events in steps:
+            if ordinal != expected or ordinal >= len(script) \
+                    or step != script[ordinal]:
+                raise ReplayDivergence(
+                    f"journaled step {ordinal} does not match the "
+                    f"script (expected step {expected})")
+            regenerated.clear()
+            sim.apply_step(script[ordinal])
+            if regenerated != list(events):
+                raise ReplayDivergence(
+                    f"step {ordinal} regenerated different wire events "
+                    f"than the journal recorded ({len(regenerated)} vs "
+                    f"{len(events)})")
+            JOURNAL_RECORDS["replayed"].add(len(events) + 1)
+            expected = ordinal + 1
+        return expected
+    finally:
+        sim.event_hook = None
+
+
+def _int_knob(raw, default: int) -> int:
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        return default
+
+
+class DurableReplay:
+    """Step-driven scenario execution with journaling + checkpoints.
+
+    With ``CS_TPU_CHECKPOINT=0`` (or the supervisor demoting the
+    checkpoint site) this degrades to a plain replay: no journal, no
+    checkpoints, identical digest — the off-leg the CI job pins."""
+
+    def __init__(self, spec, scenario, ckpt_dir, checkpoint_every=None,
+                 keep=None, fork=None, preset=None):
+        from consensus_specs_tpu.utils import env_flags
+        if checkpoint_every is None:
+            checkpoint_every = _int_knob(
+                env_flags.knob("CS_TPU_CHECKPOINT_EVERY"), 16)
+        if keep is None:
+            keep = _int_knob(env_flags.knob("CS_TPU_CHECKPOINT_KEEP"), 3)
+        self.spec = spec
+        self.scenario = scenario
+        self.cs = CheckpointStore(ckpt_dir, keep=keep)
+        self.checkpoint_every = max(1, int(checkpoint_every))
+        self.fork = fork
+        self.preset = preset
+        self._journal = None
+
+    # -- journaling ---------------------------------------------------------
+
+    def _open_journal(self, gen: int) -> None:
+        if self._journal is not None:
+            self._journal.close()
+        self._journal = journal.Journal(self.cs.journal_path(gen),
+                                        fresh=True)
+
+    def _close_journal(self) -> None:
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
+
+    def _journal_event(self, kind: str, value) -> None:
+        code, payload = encode_event(kind, value)
+        self._journal.append(code, payload)
+        JOURNAL_RECORDS["appended"].add()
+
+    # -- execution ----------------------------------------------------------
+
+    def run(self, kill_at=None, kill_mode="pre", stop_at=None):
+        """Execute the scenario from genesis.  ``kill_at`` SIGKILLs the
+        OWN process at the seeded step (``kill_mode="pre"``: before the
+        step runs; ``"mid"``: after its events journal but before the
+        STEP commit marker — the torn-step signature).  ``stop_at``
+        abandons the run at a step boundary WITHOUT killing the process
+        (the in-process crash simulation the corruption matrix uses);
+        the result is then None."""
+        from consensus_specs_tpu.sim.driver import ChainSim
+        sim = ChainSim(self.spec, self.scenario.n_validators)
+        if recovery.enabled():
+            self._open_journal(0)
+        return self._drive(sim, 0, kill_at=kill_at, kill_mode=kill_mode,
+                           stop_at=stop_at)
+
+    def resume(self):
+        """Recover from disk and finish the script; returns
+        ``(SimResult, info)`` with the ladder record."""
+        sim, next_step, info = restore_replay(self.spec, self.scenario,
+                                              self.cs)
+        if recovery.enabled():
+            # re-arm durability at the resume point: a fresh generation
+            # (may SKIP on a demoted/injected site — counted, replay
+            # simply continues without journaling)
+            gen = self.cs.save(self.spec, sim, next_step,
+                               fork=self.fork, preset=self.preset,
+                               scenario=self.scenario)
+            if gen is not None:
+                self._open_journal(gen)
+        result = self._drive(sim, next_step)
+        return result, info
+
+    def _drive(self, sim, start: int, kill_at=None, kill_mode="pre",
+               stop_at=None):
+        from consensus_specs_tpu.sim.driver import SimResult
+        script = self.scenario.script
+        if self._journal is not None:
+            sim.event_hook = self._journal_event
+        try:
+            for i in range(start, len(script)):
+                if stop_at == i:
+                    return None     # simulated crash at a boundary
+                if kill_at == i and kill_mode == "pre":
+                    os.kill(os.getpid(), signal.SIGKILL)
+                sim.apply_step(script[i])
+                if kill_at == i and kill_mode == "mid":
+                    os.kill(os.getpid(), signal.SIGKILL)
+                if self._journal is not None:
+                    self._journal.commit_step(i, script[i])
+                    JOURNAL_RECORDS["appended"].add()
+                    if (i + 1) % self.checkpoint_every == 0 \
+                            and i + 1 < len(script):
+                        gen = self.cs.save(self.spec, sim, i + 1,
+                                           fork=self.fork,
+                                           preset=self.preset,
+                                           scenario=self.scenario)
+                        if gen is not None:
+                            self._open_journal(gen)
+        finally:
+            sim.event_hook = None
+            self._close_journal()
+        return SimResult(self.spec, sim.store, sim.statuses)

@@ -125,12 +125,29 @@ class SimResult:
 # "the store rejected adversarial input", never "the driver broke"
 _REJECTED = (AssertionError, IndexError, KeyError, ValueError)
 
-_GENESIS_CACHE = {}     # (id(spec), n) -> serialized genesis state
+_GENESIS_CACHE = {}     # (spec identity, n) -> serialized genesis state
+
+
+def _spec_identity(spec):
+    """Stable spec identity for the genesis cache: fork name + preset +
+    a digest of the bound config.  Keying by ``id(spec)`` (the old
+    scheme) was the stale-aliasing class speclint D1004 fences — a
+    GC'd spec module's id can be REUSED by a later, different spec, and
+    the cache would then serve a wrong-fork genesis blob.  Content
+    identity cannot alias: two specs with equal fork/preset/config
+    build byte-identical genesis states by construction."""
+    import hashlib
+    config = getattr(spec, "config", None)
+    items = sorted((k, repr(v)) for k, v in vars(config).items()) \
+        if config is not None else ()
+    digest = hashlib.sha256(repr(items).encode("utf-8")).hexdigest()
+    return (getattr(spec, "fork", type(spec).__name__),
+            getattr(spec, "preset_name", "custom"), digest)
 
 
 def genesis_state(spec, n_validators: int):
     from consensus_specs_tpu.utils.ssz import serialize, deserialize
-    key = (id(spec), n_validators)
+    key = (_spec_identity(spec), n_validators)
     blob = _GENESIS_CACHE.get(key)
     if blob is None:
         state = create_genesis_state(
@@ -151,6 +168,23 @@ class ChainSim:
         self.store, anchor_block = \
             get_genesis_forkchoice_store_and_block(spec, state)
         self.anchor_root = bytes(hash_tree_root(anchor_block))
+        self._init_dynamic()
+
+    @classmethod
+    def restored(cls, spec, store, anchor_root, test_steps=None):
+        """A driver over an existing store (a checkpoint restore,
+        ``recovery/checkpoint.py``): no genesis build, no anchor-store
+        construction — the sidecar state arrives separately through
+        :meth:`restore_sidecar`."""
+        sim = cls.__new__(cls)
+        sim.spec = spec
+        sim.test_steps = test_steps
+        sim.store = store
+        sim.anchor_root = bytes(anchor_root)
+        sim._init_dynamic()
+        return sim
+
+    def _init_dynamic(self):
         self.tips = {"genesis": self.anchor_root}
         self.offline = set()
         self.att_queue = []         # (deliverable_at_slot, attestation)
@@ -159,8 +193,18 @@ class ChainSim:
         self.proposer_evidence = []     # queued ProposerSlashing objects
         self._headers = {}          # (slot, proposer) -> SignedBeaconBlockHeader
         self.statuses = []
+        # write-ahead journaling hook (recovery/replay.py): called with
+        # (kind, value) immediately before every store delivery —
+        # ("tick", time) / ("block", signed) / ("attestation", att) /
+        # ("attester_slashing", evidence).  None (the default) costs
+        # one attribute read per delivery.
+        self.event_hook = None
 
     # -- plumbing -----------------------------------------------------------
+
+    def _emit(self, kind, value):
+        if self.event_hook is not None:
+            self.event_hook(kind, value)
 
     def _slot(self) -> int:
         return int(self.spec.get_current_slot(self.store))
@@ -189,6 +233,7 @@ class ChainSim:
         root = bytes(hash_tree_root(signed.message))
         if self.test_steps is not None:
             emit_part("block_0x" + root.hex(), signed)
+        self._emit("block", signed)
         try:
             spec.on_block(store, signed)
         except _REJECTED:
@@ -221,6 +266,7 @@ class ChainSim:
         if self.test_steps is not None:
             att_root = hash_tree_root(attestation)
             emit_part("attestation_0x" + att_root.hex(), attestation)
+        self._emit("attestation", attestation)
         try:
             spec.on_attestation(store, attestation, is_from_block=False)
         except _REJECTED:
@@ -361,6 +407,7 @@ class ChainSim:
         seconds = int(spec.config.SECONDS_PER_SLOT)
         time = (store.genesis_time + (self._slot() + 1) * seconds
                 + interval * (seconds // 3))
+        self._emit("tick", int(time))
         spec.on_tick(store, time)
         if self.test_steps is not None:
             self.test_steps.append({"tick": int(time)})
@@ -460,6 +507,7 @@ class ChainSim:
         if self.test_steps is not None:
             ev_root = hash_tree_root(ev)
             emit_part("attester_slashing_0x" + ev_root.hex(), ev)
+        self._emit("attester_slashing", ev)
         try:
             self.spec.on_attester_slashing(self.store, ev)
         except _REJECTED:
@@ -489,14 +537,70 @@ class ChainSim:
             "offline": _op_offline, "online": _op_online,
             "checks": _op_checks}
 
+    def apply_step(self, step) -> None:
+        """Execute ONE script step (the durable replay drives steps
+        individually so it can journal/checkpoint between them)."""
+        handler = self._OPS.get(step.get("op"))
+        if handler is None:
+            self._note("rejected")      # unknown op: wire garbage
+            return
+        handler(self, step)
+
     def run(self, script) -> SimResult:
         for step in script:
-            handler = self._OPS.get(step.get("op"))
-            if handler is None:
-                self._note("rejected")      # unknown op: wire garbage
-                continue
-            handler(self, step)
+            self.apply_step(step)
         return SimResult(self.spec, self.store, self.statuses)
+
+    # -- durable-replay sidecar (recovery/checkpoint.py) --------------------
+    #
+    # Everything the driver holds OUTSIDE the store, JSON-able with SSZ
+    # objects hex-framed, so a checkpoint restore rebuilds the exact
+    # mid-script driver: same tips, same queues, same recorded headers,
+    # same per-step status trail (part of the replay-equality digest).
+
+    def snapshot_sidecar(self) -> dict:
+        from consensus_specs_tpu.utils.ssz import serialize
+        return {
+            "tips": {label: root.hex() for label, root in self.tips.items()},
+            "offline": sorted(self.offline),
+            "statuses": list(self.statuses),
+            "att_queue": [[int(slot), serialize(att).hex()]
+                          for slot, att in self.att_queue],
+            "pending_blocks": [[int(slot), serialize(signed).hex(), label]
+                               for slot, signed, label in
+                               self.pending_blocks],
+            "evidence": [serialize(ev).hex() for ev in self.evidence],
+            "proposer_evidence": [serialize(ev).hex()
+                                  for ev in self.proposer_evidence],
+            "headers": [[slot, proposer, serialize(header).hex()]
+                        for (slot, proposer), header in
+                        self._headers.items()],
+        }
+
+    def restore_sidecar(self, payload: dict) -> None:
+        from consensus_specs_tpu.utils.ssz import deserialize
+        spec = self.spec
+        self.tips = {label: bytes.fromhex(root)
+                     for label, root in payload["tips"].items()}
+        self.offline = set(payload["offline"])
+        self.statuses = list(payload["statuses"])
+        self.att_queue = [
+            (slot, deserialize(spec.Attestation, bytes.fromhex(blob)))
+            for slot, blob in payload["att_queue"]]
+        self.pending_blocks = [
+            (slot, deserialize(spec.SignedBeaconBlock,
+                               bytes.fromhex(blob)), label)
+            for slot, blob, label in payload["pending_blocks"]]
+        self.evidence = [
+            deserialize(spec.AttesterSlashing, bytes.fromhex(blob))
+            for blob in payload["evidence"]]
+        self.proposer_evidence = [
+            deserialize(spec.ProposerSlashing, bytes.fromhex(blob))
+            for blob in payload["proposer_evidence"]]
+        self._headers = {
+            (slot, proposer): deserialize(spec.SignedBeaconBlockHeader,
+                                          bytes.fromhex(blob))
+            for slot, proposer, blob in payload["headers"]}
 
 
 def execute(spec, script, n_validators=None, test_steps=None) -> SimResult:

@@ -1,0 +1,90 @@
+"""Durable-replay subprocess entry point (the kill/restart sim leg).
+
+The harness (``sim/recovery.py``) proves the crash-consistency story
+with REAL process deaths: it launches this module as a subprocess that
+replays a seeded scenario under checkpointing + journaling and SIGKILLs
+ITSELF at a seeded step (``--kill-at``; ``--kill-mode mid`` dies after
+the step's events journal but before the step's commit marker — the
+torn-step signature), then launches it again with ``--resume`` and
+requires the completed digest to be byte-identical to an uninterrupted
+replay.  The digest (plus the recovery-ladder info) is written
+atomically to ``--digest-out``; determinism demands the same BLS mode
+as the in-process oracle, hence the explicit ``--bls`` flag::
+
+    python -m consensus_specs_tpu.sim.durable --seed 7 \
+        --ckpt-dir /tmp/ckpt --checkpoint-every 8 --kill-at 21 \
+        --digest-out /tmp/d.json              # first run: dies at 21
+    python -m consensus_specs_tpu.sim.durable --seed 7 \
+        --ckpt-dir /tmp/ckpt --checkpoint-every 8 --resume \
+        --digest-out /tmp/d.json              # resumes, writes digest
+"""
+import argparse
+import sys
+
+
+def _parse_args(argv):
+    parser = argparse.ArgumentParser(prog="sim-durable")
+    parser.add_argument("--seed", type=int, required=True)
+    parser.add_argument("--fork", default="phase0")
+    parser.add_argument("--preset", default="minimal")
+    parser.add_argument("--scenario", default=None,
+                        help="force a scenario shape (default: the "
+                             "seed's weighted catalog draw)")
+    parser.add_argument("--ckpt-dir", required=True)
+    parser.add_argument("--checkpoint-every", type=int, default=8)
+    parser.add_argument("--keep", type=int, default=3)
+    parser.add_argument("--kill-at", type=int, default=None,
+                        help="SIGKILL own process at this step")
+    parser.add_argument("--kill-mode", choices=("pre", "mid"),
+                        default="pre")
+    parser.add_argument("--resume", action="store_true",
+                        help="recover from --ckpt-dir and finish")
+    parser.add_argument("--digest-out", default=None,
+                        help="write the final digest JSON here "
+                             "(atomically)")
+    parser.add_argument("--bls", type=int, default=0,
+                        help="1 = real signatures (must match the "
+                             "oracle's mode for digest equality)")
+    return parser.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv)
+    from consensus_specs_tpu.forks import build_spec
+    from consensus_specs_tpu.recovery.atomic import atomic_write_json
+    from consensus_specs_tpu.recovery.replay import DurableReplay
+    from consensus_specs_tpu.sim import scenarios
+    from consensus_specs_tpu.utils import bls
+
+    bls.bls_active = bool(args.bls)
+    if args.bls:
+        bls.use_fastest()
+    spec = build_spec(args.fork, args.preset)
+    epoch = int(spec.SLOTS_PER_EPOCH)
+    scenario = scenarios.build(args.seed, epoch, epoch * 8,
+                               name=args.scenario)
+    if scenario.config_overrides:
+        spec = build_spec(args.fork, args.preset,
+                          scenario.config_overrides)
+    replay = DurableReplay(spec, scenario, args.ckpt_dir,
+                           checkpoint_every=args.checkpoint_every,
+                           keep=args.keep, fork=args.fork,
+                           preset=args.preset)
+    if args.resume:
+        result, info = replay.resume()
+    else:
+        result = replay.run(kill_at=args.kill_at,
+                            kill_mode=args.kill_mode)
+        info = {"path": "fresh", "generation": None,
+                "journal_steps": 0, "rungs": []}
+    payload = {"digest": result.digest(), "recovery": info}
+    if args.digest_out:
+        atomic_write_json(args.digest_out, payload)
+    else:
+        import json
+        print(json.dumps(payload, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

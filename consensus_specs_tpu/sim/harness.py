@@ -32,6 +32,7 @@ snapshot, ``sim/repro.py``) with the script pre-minimized by the step
 shrinker before reporting.
 """
 import os
+from contextlib import contextmanager
 
 from consensus_specs_tpu import faults, supervisor
 from consensus_specs_tpu.sim import driver
@@ -67,6 +68,8 @@ SITE_COUNTER = {
     "das.recover": "das.fallbacks{reason=injected}",
     "mesh.epoch": "mesh.epoch.fallbacks{reason=injected}",
     "mesh.merkle": "mesh.merkle.fallbacks{reason=injected}",
+    "recovery.checkpoint": "recovery.fallbacks{reason=injected}",
+    "recovery.restore": "recovery.fallbacks{reason=injected}",
 }
 assert set(SITE_COUNTER) == set(faults.SITES)
 
@@ -93,6 +96,8 @@ ORGANIC_TWIN = {
     "das.fallbacks{reason=injected}": "das.fallbacks{reason=guard}",
     "mesh.epoch.fallbacks{reason=injected}":
         "mesh.epoch.fallbacks{reason=guard}",
+    "recovery.fallbacks{reason=injected}":
+        "recovery.fallbacks{reason=io}",
 }
 
 
@@ -115,22 +120,17 @@ class LegFailure(AssertionError):
         self.category = category
 
 
-def run_leg(spec, scenario, schedule=None, env=None,
-            reset_supervisor=True):
-    """Execute the scenario once.  Arms ``schedule`` (if any), applies
-    ``env`` overrides for the duration, returns the SimResult.
-
-    Every leg replays cold by default: the supervisor resets AFTER the
-    env overrides apply (so a leg's breaker/audit knobs are read from
-    the leg's environment), and breaker state accumulated by one leg
-    never demotes an engine in the next.  The breaker-lifecycle leg
-    passes ``reset_supervisor=False`` for its healing replay — the
-    whole point there is that the opened breakers carry over."""
+@contextmanager
+def env_overrides(env, reset_supervisor=True):
+    """The per-leg environment discipline, shared by every harness leg
+    (chain, das, recovery): clear the process-global bls_verify memo —
+    it would otherwise answer a replay's signature checks before they
+    enqueue, so the second leg's flushes go empty and the ``bls.flush``
+    site (and its scheduled faults) silently disappear — apply ``env``
+    overrides, and reset the supervisor AFTER they apply (so a leg's
+    breaker/audit knobs are read from the leg's environment).  Restores
+    the prior environment on exit (absent-before means pop)."""
     from consensus_specs_tpu.utils import bls
-    # the process-global bls_verify memo would otherwise answer a
-    # replay's signature checks before they enqueue, so the second
-    # leg's flushes go empty and the bls.flush site (and its scheduled
-    # faults) silently disappear from the replay
     bls.clear_verify_memo()
     saved = {}
     for k, v in (env or {}).items():
@@ -139,17 +139,31 @@ def run_leg(spec, scenario, schedule=None, env=None,
     try:
         if reset_supervisor:
             supervisor.reset()
-        if schedule is not None:
-            with faults.injected(schedule):
-                return driver.execute(spec, scenario.script,
-                                      scenario.n_validators)
-        return driver.execute(spec, scenario.script, scenario.n_validators)
+        yield
     finally:
         for k, v in saved.items():
             if v is None:
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+
+
+def run_leg(spec, scenario, schedule=None, env=None,
+            reset_supervisor=True):
+    """Execute the scenario once.  Arms ``schedule`` (if any), applies
+    ``env`` overrides for the duration, returns the SimResult.
+
+    Every leg replays cold by default (``env_overrides``); breaker
+    state accumulated by one leg never demotes an engine in the next.
+    The breaker-lifecycle leg passes ``reset_supervisor=False`` for its
+    healing replay — the whole point there is that the opened breakers
+    carry over."""
+    with env_overrides(env, reset_supervisor):
+        if schedule is not None:
+            with faults.injected(schedule):
+                return driver.execute(spec, scenario.script,
+                                      scenario.n_validators)
+        return driver.execute(spec, scenario.script, scenario.n_validators)
 
 
 def run_baseline(spec, scenario):
@@ -464,7 +478,10 @@ def minimize_failure(spec, failure, budget=60, out_dir=None, fork=None,
 
 
 def _digest_diff(a, b) -> str:
-    da, db = a.digest(), b.digest()
+    """Human diff of two replay digests; accepts SimResult-likes or
+    raw digest dicts (the subprocess legs only have the dict)."""
+    da = a if isinstance(a, dict) else a.digest()
+    db = b if isinstance(b, dict) else b.digest()
     parts = []
     for k in da:
         if da[k] != db[k]:

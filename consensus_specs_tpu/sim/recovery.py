@@ -1,0 +1,288 @@
+"""Durable-replay harness legs: kill/restart, corruption injection,
+recovery-site faults, and the checkpoint-off leg (docs/recovery.md).
+
+Per recovery seed the sweep (``sim/sweep.py --recovery-seeds``) runs:
+
+kill/restart (subprocess)
+    ``sim/durable.py`` replays the scenario under checkpointing +
+    journaling and SIGKILLs ITSELF at a seeded step (``mid`` mode: the
+    step's events are journaled, its commit marker is not — the
+    torn-step signature); a second subprocess ``--resume``s from disk
+    and must finish with a digest byte-identical to the uninterrupted
+    in-process replay, having actually resumed from a checkpoint
+    generation.
+corruption matrix (in-process)
+    One partial run leaves >= 2 generations on disk; each case then
+    corrupts a COPY of the checkpoint directory — truncated state
+    blob, bit-flipped block blob, truncated manifest, torn final
+    journal record — and the resume must detect the damage (counted
+    ``recovery.fallbacks{reason=}``), degrade to the previous
+    generation, and still produce the byte-identical digest.  Zero
+    silent wrong resumes.
+recovery-site faults
+    ``faults.FaultSchedule`` triggers at ``recovery.checkpoint`` (the
+    save SKIPS, counted, replay unaffected) and ``recovery.restore``
+    (the newest generation's restore aborts, counted, ladder degrades)
+    — the PR-8/9 counted-fallback contract at the new sites.
+checkpoint-off (CS_TPU_CHECKPOINT=0)
+    The durable wrapper must be a pass-through: no journal, no
+    checkpoints, zero recovery counters, identical digest.
+"""
+import os
+import shutil
+import signal
+import subprocess
+import sys
+
+from consensus_specs_tpu import faults
+from consensus_specs_tpu.recovery.replay import DurableReplay
+from consensus_specs_tpu.sim import harness
+from consensus_specs_tpu.sim.harness import (
+    NEUTRAL_SUPERVISOR_ENV, LegFailure, _digest_diff, env_overrides)
+from consensus_specs_tpu.test_infra.metrics import counting
+
+
+def pick_kill_step(scenario, every: int) -> int:
+    """A seeded kill point deep enough that >= 2 generations exist."""
+    n = len(scenario.script)
+    return max(2 * every + 1, min(n - 2, (2 * n) // 3))
+
+
+def run_kill_restart(spec, scenario, baseline, ckpt_root, fork="phase0",
+                     preset="minimal", every=8, kill_mode="mid"):
+    """The subprocess kill/restart leg (module docstring); returns the
+    resumed run's recovery info, raises :class:`LegFailure` on any
+    contract violation."""
+    import json
+    kind = "kill-restart"
+    ckpt_dir = os.path.join(ckpt_root, f"kill_{scenario.seed}")
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+    kill_at = pick_kill_step(scenario, every)
+    digest_out = os.path.join(ckpt_dir, "digest.json")
+    base_cmd = [sys.executable, "-m", "consensus_specs_tpu.sim.durable",
+                "--seed", str(scenario.seed), "--fork", fork,
+                "--preset", preset, "--scenario", scenario.name,
+                "--ckpt-dir", ckpt_dir,
+                "--checkpoint-every", str(every),
+                "--digest-out", digest_out]
+    env = {**os.environ, **NEUTRAL_SUPERVISOR_ENV}
+    proc = subprocess.run(
+        base_cmd + ["--kill-at", str(kill_at), "--kill-mode", kill_mode],
+        env=env, capture_output=True, text=True, timeout=600)
+    if proc.returncode != -signal.SIGKILL:
+        raise LegFailure(
+            kind, scenario, f"first run was supposed to die by SIGKILL "
+            f"at step {kill_at} but exited {proc.returncode}: "
+            f"{proc.stderr[-500:]}", category="crashed")
+    proc = subprocess.run(base_cmd + ["--resume"], env=env,
+                          capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise LegFailure(
+            kind, scenario, f"resume subprocess failed "
+            f"({proc.returncode}): {proc.stderr[-500:]}",
+            category="crashed")
+    with open(digest_out) as f:
+        payload = json.load(f)
+    if payload["digest"] != baseline.digest():
+        raise LegFailure(
+            kind, scenario, "resumed replay diverged from the "
+            "uninterrupted replay: "
+            + _digest_diff(baseline, payload["digest"]),
+            category="diverged")
+    info = payload["recovery"]
+    if info["path"] != "checkpoint":
+        raise LegFailure(
+            kind, scenario, f"resume did not restore from a checkpoint "
+            f"generation (path={info['path']}, rungs={info['rungs']}) — "
+            "the kill/restart leg proved only re-execution",
+            category="no-discharge")
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+    return info
+
+
+# corruption case -> (file of the NEWEST generation to damage, how)
+def _truncate(path):
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(1, size // 2))
+
+
+def _bitflip(path):
+    with open(path, "r+b") as f:
+        data = bytearray(f.read())
+        data[len(data) // 2] ^= 0x40
+        f.seek(0)
+        f.write(data)
+
+
+def _tear_journal(path):
+    # a half-written frame at the tail: the SIGKILL-mid-append signature
+    with open(path, "ab") as f:
+        f.write(b"\x40\x00\x00\x00\xde\xad")
+
+
+CORRUPTION_CASES = (
+    ("truncated_state_blob", "ckpt_{g}_states.bin", _truncate, "blob"),
+    ("bitflip_block_blob", "ckpt_{g}_blocks.bin", _bitflip, "blob"),
+    ("truncated_manifest", "manifest_{g}.json", _truncate, "manifest"),
+    ("torn_journal_record", "wal_{g}.log", _tear_journal, "torn_record"),
+)
+
+
+def run_corruption_matrix(spec, scenario, baseline, ckpt_root, every=None):
+    """In-process corruption-injection matrix (module docstring).
+    Returns ``{case: fallback reason}``; raises :class:`LegFailure` on
+    any undetected corruption or digest divergence."""
+    base_dir = os.path.join(ckpt_root, f"matrix_{scenario.seed}")
+    shutil.rmtree(base_dir, ignore_errors=True)
+    if every is None:
+        every = max(1, len(scenario.script) // 6)
+    stop_at = pick_kill_step(scenario, every)
+    try:
+        with env_overrides(NEUTRAL_SUPERVISOR_ENV):
+            return _corruption_cases(spec, scenario, baseline, ckpt_root,
+                                     base_dir, every, stop_at)
+    finally:
+        shutil.rmtree(base_dir, ignore_errors=True)
+
+
+def _corruption_cases(spec, scenario, baseline, ckpt_root, base_dir,
+                      every, stop_at) -> dict:
+    out = {}
+    replay = DurableReplay(spec, scenario, base_dir,
+                           checkpoint_every=every)
+    replay.run(stop_at=stop_at)     # simulated crash at a boundary
+    gens = replay.cs.generations()
+    if len(gens) < 2:
+        raise LegFailure(
+            "corruption-matrix", scenario,
+            f"partial run left only {len(gens)} generation(s) — "
+            "the degrade ladder has no rung to fall to",
+            category="no-discharge")
+    newest = gens[-1]
+    for case, target, damage, reason in CORRUPTION_CASES:
+        kind = f"corrupt[{case}]"
+        case_dir = os.path.join(ckpt_root,
+                                f"matrix_{scenario.seed}_{case}")
+        shutil.rmtree(case_dir, ignore_errors=True)
+        shutil.copytree(base_dir, case_dir)
+        damage(os.path.join(case_dir, target.format(g=newest)))
+        case_replay = DurableReplay(spec, scenario, case_dir,
+                                    checkpoint_every=every)
+        with counting() as delta:
+            result, info = case_replay.resume()
+        key = f"recovery.fallbacks{{reason={reason}}}"
+        if delta[key] < 1:
+            raise LegFailure(
+                kind, scenario, f"SILENT WRONG RESUME: the damage "
+                f"was never detected ({key} stayed 0; "
+                f"rungs={info['rungs']})", category="silent-fallback")
+        if info["path"] == "checkpoint" and info["generation"] == newest:
+            raise LegFailure(
+                kind, scenario, f"resume trusted the damaged "
+                f"generation {newest}", category="silent-fallback")
+        if result.digest() != baseline.digest():
+            raise LegFailure(
+                kind, scenario, "degraded resume diverged from the "
+                "uninterrupted replay: "
+                + _digest_diff(baseline, result),
+                category="diverged")
+        out[case] = reason
+        shutil.rmtree(case_dir, ignore_errors=True)
+    return out
+
+
+def run_recovery_injected(spec, scenario, baseline, ckpt_root, site,
+                          every=None):
+    """Injected-fault leg at a recovery site: the fault must be
+    absorbed (checkpoint skipped / restore degraded), counted on
+    ``recovery.fallbacks{reason=injected}``, and the digest must stay
+    byte-identical."""
+    kind = f"inject[{site}@1]"
+    work = os.path.join(ckpt_root, f"inject_{scenario.seed}")
+    shutil.rmtree(work, ignore_errors=True)
+    if every is None:
+        every = max(1, len(scenario.script) // 6)
+    stop_at = pick_kill_step(scenario, every)
+    try:
+        with env_overrides(NEUTRAL_SUPERVISOR_ENV):
+            if site == "recovery.checkpoint":
+                schedule = faults.FaultSchedule({site: [1]})
+                with counting() as delta:
+                    with faults.injected(schedule):
+                        replay = DurableReplay(spec, scenario, work,
+                                               checkpoint_every=every)
+                        result = replay.run()
+            else:
+                replay = DurableReplay(spec, scenario, work,
+                                       checkpoint_every=every)
+                replay.run(stop_at=stop_at)
+                schedule = faults.FaultSchedule({site: [1]})
+                with counting() as delta:
+                    with faults.injected(schedule):
+                        resumed = DurableReplay(spec, scenario, work,
+                                                checkpoint_every=every)
+                        result, info = resumed.resume()
+            if not schedule.fully_fired():
+                raise LegFailure(
+                    kind, scenario, f"schedule did not discharge: "
+                    f"planned {schedule.planned}, fired "
+                    f"{len(schedule.fired)}", schedule,
+                    category="no-discharge")
+            counted = delta["recovery.fallbacks{reason=injected}"]
+            if counted != len(schedule.fired):
+                raise LegFailure(
+                    kind, scenario, f"SILENT FALLBACK: "
+                    f"{len(schedule.fired)} injected fault(s) fired but "
+                    f"recovery.fallbacks{{reason=injected}} moved by "
+                    f"{counted}", schedule, category="silent-fallback")
+            if result.digest() != baseline.digest():
+                raise LegFailure(
+                    kind, scenario, "fallback diverged from the "
+                    "uninjected replay: "
+                    + _digest_diff(baseline, result), schedule,
+                    category="diverged")
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+def run_checkpoint_off(spec, scenario, baseline, ckpt_root):
+    """CS_TPU_CHECKPOINT=0 off-leg: the durable wrapper is a pure
+    pass-through — identical digest, zero recovery metrics, no files."""
+    kind = "checkpoint-off"
+    work = os.path.join(ckpt_root, f"off_{scenario.seed}")
+    shutil.rmtree(work, ignore_errors=True)
+    try:
+        with env_overrides({**NEUTRAL_SUPERVISOR_ENV,
+                            "CS_TPU_CHECKPOINT": "0"}):
+            replay = DurableReplay(spec, scenario, work)
+            with counting() as delta:
+                result = replay.run()
+            if result.digest() != baseline.digest():
+                raise LegFailure(
+                    kind, scenario, "checkpoint-off replay diverged: "
+                    + _digest_diff(baseline, result),
+                    category="diverged")
+            moved = {k: v for k, v in delta.nonzero().items()
+                     if k.startswith("recovery.")}
+            if moved:
+                raise LegFailure(
+                    kind, scenario, f"recovery metrics moved with "
+                    f"CS_TPU_CHECKPOINT=0: {moved}",
+                    category="silent-fallback")
+            leftovers = [n for n in os.listdir(work)
+                         if n.startswith(("manifest_", "ckpt_", "wal_"))] \
+                if os.path.isdir(work) else []
+            if leftovers:
+                raise LegFailure(
+                    kind, scenario, f"checkpoint-off leg wrote "
+                    f"durability files anyway: {leftovers}",
+                    category="silent-fallback")
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+def run_baseline(spec, scenario):
+    """The uninterrupted oracle all recovery legs compare against —
+    the plain harness baseline (engines on, observing schedule)."""
+    return harness.run_baseline(spec, scenario)

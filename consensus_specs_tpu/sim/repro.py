@@ -21,6 +21,7 @@ import re
 import sys
 from contextlib import contextmanager
 
+from consensus_specs_tpu.recovery.atomic import atomic_write_json
 from consensus_specs_tpu.sim.scenarios import Scenario
 
 # the env surface that changes replay behavior: engine switches, batch
@@ -118,15 +119,29 @@ def dump_artifact(scenario, kind, message, schedule=None, script=None,
     name = re.sub(r"[^A-Za-z0-9._-]+", "-", scenario.name).strip("-")
     path = os.path.join(
         out_dir, f"repro_{name}_seed{scenario.seed}_{slug}.json")
-    with open(path, "w") as f:
-        json.dump(payload, f, indent=2)
+    # temp + fsync + rename (recovery/atomic.py): a crash mid-dump must
+    # never leave a truncated artifact at the final path — the artifact
+    # is usually the ONLY record of a failure off an ephemeral runner
+    atomic_write_json(path, payload)
     return path
 
 
 def load_artifact(path: str):
     """(Scenario, triggers-or-None, payload) from a dumped artifact."""
     with open(path) as f:
-        payload = json.load(f)
+        raw = f.read()
+    try:
+        payload = json.loads(raw)
+    except ValueError as exc:
+        # fail LOUD with provenance: artifacts are written atomically
+        # (dump_artifact above), so a torn file here means an outside
+        # writer or transport truncation — name it instead of letting a
+        # bare JSONDecodeError point nowhere
+        raise ValueError(
+            f"repro artifact {path!r} is not valid JSON "
+            f"({exc}; {len(raw)} bytes) — artifacts are written "
+            "atomically, so this file was truncated or corrupted "
+            "outside dump_artifact") from exc
     scenario = Scenario(
         payload["scenario"], payload["seed"], payload["script"],
         payload["n_validators"], payload.get("config_overrides"))

@@ -80,6 +80,16 @@ def _parse_args(argv):
                              "one silent-corruption sentinel-audit leg "
                              "whose quarantine artifact is re-proven "
                              "through sim.repro")
+    parser.add_argument("--recovery-seeds", type=int, default=2,
+                        help="durable-replay recovery seeds "
+                             "(sim/recovery.py; 0 disables): per seed a "
+                             "subprocess replay is SIGKILLed at a "
+                             "seeded step and restored from checkpoint "
+                             "+ journal byte-identically; the first "
+                             "seed additionally runs the corruption-"
+                             "injection matrix, the recovery-site "
+                             "fault legs and the CS_TPU_CHECKPOINT=0 "
+                             "off-leg")
     parser.add_argument("--min-scenarios", type=int, default=None,
                         help="fail if fewer baselines complete "
                              "(default: --seeds)")
@@ -186,6 +196,90 @@ def run_das_phase(args, stats, failures) -> None:
               + (f" ({', '.join(legs)})" if legs else ""))
 
 
+def run_recovery_phase(args, stats, failures) -> None:
+    """The durable-replay legs (``sim/recovery.py``): per seed a REAL
+    SIGKILL kill/restart subprocess round-trip; the first seed also
+    runs the corruption-injection matrix, the recovery-site fault legs
+    and the checkpoint-off leg.  Failures are recorded (dumped
+    un-shrunk — the failing artifact is the checkpoint directory
+    state, not the script) and the sweep continues."""
+    import shutil
+    import tempfile
+
+    from consensus_specs_tpu.forks import build_spec
+    from consensus_specs_tpu.sim import recovery as rec_legs
+    from consensus_specs_tpu.sim import scenarios
+
+    base_spec = build_spec(args.fork, args.preset)
+    epoch = int(base_spec.SLOTS_PER_EPOCH)
+    ckpt_root = tempfile.mkdtemp(prefix="cs_tpu_recovery_")
+    try:
+        for seed in range(args.recovery_seeds):
+            scenario = scenarios.build(seed, epoch, epoch * 8)
+            spec = base_spec if not scenario.config_overrides else \
+                build_spec(args.fork, args.preset,
+                           scenario.config_overrides)
+            tag = f"rcvr {seed:4d} {scenario.name:<17s}      "
+            try:
+                baseline, _ = rec_legs.run_baseline(spec, scenario)
+            except Exception as exc:
+                fail = _crashed_leg("recovery-baseline", scenario, exc)
+                failures.append((fail, None, False))
+                print(f"{tag} BASELINE FAILED: {fail}")
+                continue
+            stats["recovery_scenarios"] += 1
+            legs = []
+            try:
+                rec_legs.run_kill_restart(
+                    spec, scenario, baseline, ckpt_root,
+                    fork=args.fork, preset=args.preset)
+                stats["recovery_kill_legs"] += 1
+                legs.append("kill+restart")
+            except harness.LegFailure as fail:
+                failures.append((fail, None, False))
+            except Exception as exc:
+                failures.append((_crashed_leg("kill-restart", scenario,
+                                              exc), None, False))
+            if seed == 0:
+                try:
+                    cases = rec_legs.run_corruption_matrix(
+                        spec, scenario, baseline, ckpt_root)
+                    stats["recovery_corruption_cases"] += len(cases)
+                    legs.append(f"corrupt-matrix[{len(cases)}]")
+                except harness.LegFailure as fail:
+                    failures.append((fail, None, False))
+                except Exception as exc:
+                    failures.append((_crashed_leg(
+                        "corruption-matrix", scenario, exc), None, False))
+                for site in ("recovery.checkpoint", "recovery.restore"):
+                    try:
+                        rec_legs.run_recovery_injected(
+                            spec, scenario, baseline, ckpt_root, site)
+                        stats["recovery_injected_legs"] += 1
+                        legs.append(f"inject[{site.split('.')[1]}]")
+                    except harness.LegFailure as fail:
+                        failures.append((fail, None, False))
+                    except Exception as exc:
+                        failures.append((_crashed_leg(
+                            f"inject[{site}@1]", scenario, exc,
+                            faults.FaultSchedule({site: [1]})),
+                            None, False))
+                try:
+                    rec_legs.run_checkpoint_off(spec, scenario,
+                                                baseline, ckpt_root)
+                    stats["recovery_off_legs"] += 1
+                    legs.append("off")
+                except harness.LegFailure as fail:
+                    failures.append((fail, None, False))
+                except Exception as exc:
+                    failures.append((_crashed_leg(
+                        "checkpoint-off", scenario, exc), None, False))
+            print(f"{tag} ok: {len(scenario.script)} steps"
+                  + (f" ({', '.join(legs)})" if legs else ""))
+    finally:
+        shutil.rmtree(ckpt_root, ignore_errors=True)
+
+
 def run_sweep(args) -> int:
     from consensus_specs_tpu.forks import build_spec
     from consensus_specs_tpu.utils import bls
@@ -199,7 +293,10 @@ def run_sweep(args) -> int:
              "das_scenarios": 0, "das_injected_legs": 0,
              "das_off_legs": 0, "das_corrupt_legs": 0,
              "das_repro_proofs": 0, "das_faults_fired": 0,
-             "das_rejected_steps": 0}
+             "das_rejected_steps": 0,
+             "recovery_scenarios": 0, "recovery_kill_legs": 0,
+             "recovery_corruption_cases": 0,
+             "recovery_injected_legs": 0, "recovery_off_legs": 0}
     per_shape = {}
     failures = []       # (LegFailure, spec-or-None, with_bls)
     artifacts = []
@@ -339,6 +436,12 @@ def run_sweep(args) -> int:
             # Namespaces that predate the das phase
             bls.bls_active = False
             run_das_phase(args, stats, failures)
+        # durable-replay phase (sim/recovery.py): kill/restart
+        # subprocess round-trips + the corruption-injection matrix +
+        # recovery-site fault legs + the CS_TPU_CHECKPOINT=0 off-leg
+        if getattr(args, "recovery_seeds", 0):
+            bls.bls_active = False
+            run_recovery_phase(args, stats, failures)
 
         # minimize INSIDE the mode scope: each failure's shrink
         # replays must run under the BLS mode its leg failed in, or a
@@ -394,6 +497,15 @@ def run_sweep(args) -> int:
               f"{stats['das_repro_proofs']} quarantine artifact(s) "
               f"re-proven through sim.repro; "
               f"{stats['das_rejected_steps']} loud refusals recorded")
+    if stats["recovery_scenarios"]:
+        print(f"rcvr: {stats['recovery_scenarios']} durable replays: "
+              f"{stats['recovery_kill_legs']} SIGKILL kill/restart "
+              f"round-trips byte-identical + "
+              f"{stats['recovery_corruption_cases']} corruption cases "
+              f"detected-and-degraded + "
+              f"{stats['recovery_injected_legs']} recovery-site "
+              f"injected legs + {stats['recovery_off_legs']} "
+              f"checkpoint-off legs")
 
     code = 0
     if artifacts:

@@ -258,15 +258,17 @@ class _Cell:
     pending engine write awaiting :meth:`StateArrays.commit`.
 
     ``shard`` is the mesh engine's device placement for this column
-    (``parallel/mesh_state.py``): ``(host_array, placed)`` where
+    (``parallel/mesh_state.py``): ``(host_array, placed, epoch)`` where
     ``placed`` is the column padded and ``device_put`` across the
-    validator mesh.  Validity is by identity — the placement serves
-    reads only while ``shard[0] is cell.data`` — so a kernel write (a
-    new ``data`` array) retires it without bookkeeping, and a
-    copy-on-write fork that shares ``data`` shares the placement too:
-    N replays forked from one base pay ONE host->device transfer per
-    column, and committing a scope (``base = data``) never moves data
-    between devices.
+    validator mesh and ``epoch`` is the mesh placement epoch it was
+    made under.  Validity is by identity — the placement serves reads
+    only while ``shard[0] is cell.data`` and the epoch still matches
+    (a device loss bumps the global epoch, retiring every placement on
+    the lost mesh at once) — so a kernel write (a new ``data`` array)
+    retires it without bookkeeping, and a copy-on-write fork that
+    shares ``data`` shares the placement too: N replays forked from one
+    base pay ONE host->device transfer per column, and committing a
+    scope (``base = data``) never moves data between devices.
     """
 
     __slots__ = ("data", "base", "seq_ref", "gen", "shard", "__weakref__")

@@ -51,7 +51,6 @@ Thread model: like ``faults``, breaker state is process-global and the
 engines run single-threaded; the disarmed/closed hot path is one env
 read plus a dict lookup.
 """
-import json
 import os
 import random
 import time
@@ -367,9 +366,11 @@ def _default_quarantine_dump(site: str, detail: str):
     path = os.path.join(
         out_dir, f"quarantine_{site.replace('.', '-')}_{_quarantine_seq}.json")
     try:
+        from consensus_specs_tpu.recovery.atomic import atomic_write_json
         os.makedirs(out_dir, exist_ok=True)
-        with open(path, "w") as f:
-            json.dump(payload, f, indent=2)
+        # temp + fsync + rename: quarantine evidence must never be a
+        # torn file — it is usually read after the process died
+        atomic_write_json(path, payload)
     except OSError:
         return None     # read-only host: quarantine still holds
     return path

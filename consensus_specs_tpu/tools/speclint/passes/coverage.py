@@ -60,6 +60,7 @@ ENGINE_PREFIXES = (
     "consensus_specs_tpu/das/",
     "consensus_specs_tpu/utils/",
     "consensus_specs_tpu/parallel/",
+    "consensus_specs_tpu/recovery/",
 )
 
 _FALLBACK_CLASSES = {"InjectedFault", "_Fallback", "DeadlineExceeded"}

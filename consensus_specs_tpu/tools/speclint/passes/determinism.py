@@ -34,10 +34,18 @@ function:
   ``env_flags.knob()`` so every environment dependency is declared in
   one audited place.
 * D1004 — an ``id()``-keyed structure (``d[id(x)]`` /
-  ``d.get(id(x))`` / ``{id(x): ...}``): ``id()`` is an address — it
-  can alias after garbage collection and never survives a process
-  boundary, so an ``id()``-keyed cache is a stale-aliasing bug waiting
-  for a collection cycle.
+  ``d.get(id(x))`` / ``{id(x): ...}``, a tuple key CONTAINING an
+  ``id()`` call, or a key name locally assigned from one —
+  ``key = (id(x), n); d[key]``): ``id()`` is an address — it can alias
+  after garbage collection and never survives a process boundary, so an
+  ``id()``-keyed cache is a stale-aliasing bug waiting for a collection
+  cycle.  Unlike the other D rules, D1004 additionally reports in
+  ``consensus_specs_tpu/sim/`` regardless of consensus-root
+  reachability: the sim layer's caches (genesis blobs, scenario state)
+  feed replay-equality digests, and the ``sim/driver.py`` genesis cache
+  was exactly this bug — the harness layers may read clocks and RNG by
+  design (D1001-D1003/D1005 stay scoped out) but address-keyed caching
+  is never sound there either.
 * D1005 — the *builtin* ``hash()`` on a consensus path: str/bytes
   hashing is salted per process (PYTHONHASHSEED).  Modules that import
   the spec's sha256 ``hash`` helper shadow the builtin and are exempt.
@@ -78,6 +86,11 @@ REPORT_EXCLUDE = (
     "consensus_specs_tpu/utils/env_flags.py",   # the sanctioned reader
     "consensus_specs_tpu/utils/jax_env.py",     # process setup, pre-spec
 )
+
+# D1004-only extra scope: every function in these packages is scanned
+# for id()-keyed structures regardless of consensus-root reachability
+# (module docstring)
+ID_KEY_EXTRA_PREFIXES = ("consensus_specs_tpu/sim/",)
 
 _AMBIENT_MODULES = {"time", "random", "secrets", "uuid"}
 _SET_CTORS = {"set", "frozenset"}
@@ -187,14 +200,44 @@ def _under_exempt_fold(node, parents) -> bool:
     return False
 
 
+def _id_tainted_names(fn_node):
+    """Local names assigned an expression CONTAINING an ``id()`` call
+    (``key = (id(x), n)``): using one as a lookup key is the same
+    address-keyed bug one assignment removed."""
+    tainted = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            if any(isinstance(c, ast.Call)
+                   and isinstance(c.func, ast.Name) and c.func.id == "id"
+                   for c in ast.walk(node.value)):
+                tainted.add(node.targets[0].id)
+    return tainted
+
+
+def _check_id_keys(rel, fn_node, suffix, findings):
+    """The D1004 half of the function check, shared with the
+    sim-package scan (which skips every other D rule)."""
+    tainted = _id_tainted_names(fn_node)
+    for node in ast.walk(fn_node):
+        if isinstance(node, (ast.Subscript, ast.Dict, ast.Call)) \
+                and _id_keyed(node, tainted):
+            findings.append(Finding(
+                rel, node.lineno, "D1004",
+                "id()-keyed structure: an address can alias after "
+                "garbage collection and never survives a process "
+                f"boundary — key on content{suffix}"))
+
+
 def _check_function(rel, fn_node, hash_shadowed, root_name, findings):
     tracker = _SetTracker(fn_node)
     parents = {child: parent for parent in ast.walk(fn_node)
                for child in ast.iter_child_nodes(parent)}
+    tainted = _id_tainted_names(fn_node)
     suffix = f" [reachable from {root_name}]"
     for node in ast.walk(fn_node):
         if isinstance(node, (ast.Subscript, ast.Dict, ast.Call)) \
-                and _id_keyed(node):
+                and _id_keyed(node, tainted):
             findings.append(Finding(
                 rel, node.lineno, "D1004",
                 "id()-keyed structure: an address can alias after "
@@ -265,7 +308,7 @@ def _np_random(node) -> bool:
         and f.value.value.id in ("np", "numpy")
 
 
-def _id_keyed(node) -> bool:
+def _id_keyed(node, tainted=frozenset()) -> bool:
     keys = []
     if isinstance(node, ast.Subscript):
         keys = [node.slice]
@@ -274,8 +317,16 @@ def _id_keyed(node) -> bool:
     elif isinstance(node, ast.Call) and node.args \
             and _call_tail(node) in ("get", "setdefault", "pop"):
         keys = [node.args[0]]
-    return any(isinstance(k, ast.Call) and isinstance(k.func, ast.Name)
-               and k.func.id == "id" for k in keys)
+
+    def hit(k):
+        if isinstance(k, ast.Call) and isinstance(k.func, ast.Name) \
+                and k.func.id == "id":
+            return True
+        if isinstance(k, ast.Tuple):
+            return any(hit(e) for e in k.elts)
+        return isinstance(k, ast.Name) and k.id in tainted
+
+    return any(hit(k) for k in keys)
 
 
 def consensus_roots(graph: ProjectGraph):
@@ -300,8 +351,6 @@ def run(ctx):
     graph = ctx.project_graph() if hasattr(ctx, "project_graph") \
         else ProjectGraph(ctx)
     roots = consensus_roots(graph)
-    if not roots:
-        return []
     # reachability, remembering ONE root per function (first wins in
     # root order — stable because roots are built in a sorted walk)
     root_of = {}
@@ -331,6 +380,12 @@ def run(ctx):
             tag += f"; compiled from {mod.provenance}"
         _check_function(fn.rel, fn.node, shadow_cache[fn.rel], tag,
                         findings)
+    # D1004-only extra scope: every sim-layer function, reachable or
+    # not — address-keyed caches are never sound in the replay harness
+    for fn in graph.functions:
+        if fn.rel.startswith(ID_KEY_EXTRA_PREFIXES):
+            _check_id_keys(fn.rel, fn.node, " [sim persistence scope]",
+                           findings)
     # one finding per (path, line, code): overlapping reachability from
     # many roots must not multiply the report
     out, seen = [], {}

@@ -1,0 +1,134 @@
+"""durability pass (R9xx): persistence scopes must write
+crash-consistently.
+
+A bare ``open(path, "w")`` + ``json.dump``/``write`` to a FINAL path
+is a torn-file generator: a crash (the recovery harness sends real
+SIGKILLs) between open and close leaves a half-written file the next
+reader either crashes on or silently trusts.  The sanctioned idiom is
+temp + fsync + rename (``recovery/atomic.py``: ``atomic_write_bytes``
+/ ``atomic_write_json``) — readers then see the old content or the new
+content, never a prefix.  ``sim/repro.py`` had exactly this bug: a
+crash mid-``dump_artifact`` left truncated JSON that ``load_artifact``
+crashed on.
+
+* R901 — ``open(..., "w"/"wb"/"a"/"ab"/"x"/"xb")`` in a persistence
+  scope whose enclosing function neither renames a temp file into
+  place (``os.replace`` / ``os.rename``) nor writes through the
+  atomic helpers.  Append-mode journals that fsync their records are
+  exempt via the containing function calling ``fsync`` (the
+  write-ahead journal's own discipline).
+
+Scope (the persistence surfaces whose files are read back and
+trusted): ``consensus_specs_tpu/recovery/``, ``consensus_specs_tpu/
+sim/repro.py``, ``consensus_specs_tpu/gen/``.  Intentional
+exceptions carry ``# noqa: R901`` with the reason the torn window is
+acceptable.  Baseline: zero findings.
+"""
+import ast
+
+from ..findings import Finding
+
+NAME = "durability"
+CODE_PREFIXES = ("R9",)
+VERSION = 2
+GRANULARITY = "file"
+
+SCOPES = (
+    "consensus_specs_tpu/recovery/",
+    "consensus_specs_tpu/sim/repro.py",
+    "consensus_specs_tpu/gen/",
+)
+
+_WRITE_MODES = {"w", "wb", "a", "ab", "x", "xb", "w+", "wb+",
+                "r+b", "r+"}
+# calls whose presence in the enclosing function certify the
+# crash-consistency discipline: delegation to the atomic helpers or a
+# temp-file protocol.  Unambiguous names match by tail alone;
+# "replace"/"rename"/"fsync" must be ``os.*`` calls — a bare tail
+# match would let an ordinary ``str.replace`` filename slug silently
+# exempt a torn write.
+_EXEMPTING_TAILS = {"atomic_write_bytes", "atomic_write_json",
+                    "atomic_replace_bytes", "mkstemp",
+                    "NamedTemporaryFile"}
+_EXEMPTING_OS_TAILS = {"replace", "rename", "fsync"}
+
+
+def in_scope(rel: str) -> bool:
+    return rel.startswith(SCOPES)
+
+
+def check_file(ctx, rel):
+    return check_source(rel, ctx.source(rel))
+
+
+def _call_tail(node):
+    fn = node.func
+    return fn.attr if isinstance(fn, ast.Attribute) else \
+        fn.id if isinstance(fn, ast.Name) else None
+
+
+def _exempting(call) -> bool:
+    tail = _call_tail(call)
+    if tail in _EXEMPTING_TAILS:
+        return True
+    if tail not in _EXEMPTING_OS_TAILS:
+        return False
+    fn = call.func
+    return isinstance(fn, ast.Attribute) \
+        and isinstance(fn.value, ast.Name) and fn.value.id == "os"
+
+
+def _write_mode(call) -> bool:
+    """``open(target, <literal write mode>)``."""
+    if _call_tail(call) != "open" or len(call.args) < 2:
+        return False
+    mode = call.args[1]
+    return isinstance(mode, ast.Constant) and isinstance(mode.value, str) \
+        and mode.value in _WRITE_MODES
+
+
+def _scope_units(tree):
+    """Judgement units: each top-level function or CLASS (methods
+    share their class's discipline — an append-mode journal opened in
+    ``__init__`` is certified by the ``fsync`` in its commit method),
+    plus the remaining module-level statements as one unit."""
+    units, module_rest = [], []
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            units.append(list(ast.walk(node)))
+        else:
+            module_rest.extend(ast.walk(node))
+    if module_rest:
+        units.append(module_rest)
+    return units
+
+
+def check_source(rel, text):
+    try:
+        tree = ast.parse(text)
+    except SyntaxError:
+        return []
+    findings = []
+    for nodes in _scope_units(tree):
+        calls = [n for n in nodes if isinstance(n, ast.Call)]
+        if any(_exempting(c) for c in calls):
+            continue
+        for call in calls:
+            if _write_mode(call):
+                findings.append(Finding(
+                    rel, call.lineno, "R901",
+                    "bare write-mode open() to a final path in a "
+                    "persistence scope — a crash mid-write leaves a "
+                    "torn file; write through recovery/atomic.py "
+                    "(temp + fsync + rename) or fsync an append-only "
+                    "journal"))
+    return findings
+
+
+def run(ctx):
+    findings = []
+    for rel in ctx.py_files:
+        if in_scope(rel):
+            findings.extend(check_file(ctx, rel))
+    return findings

@@ -36,6 +36,7 @@ ENGINE_SWITCHES = (
     "CS_TPU_SUPERVISOR",
     "CS_TPU_DAS",
     "CS_TPU_MESH",
+    "CS_TPU_CHECKPOINT",
 )
 
 _SWITCH_DEFAULTS = {}
@@ -165,6 +166,15 @@ DAS = os.environ.get("CS_TPU_DAS") != "0"
 # overhead — are the ``CS_TPU_MESH_MIN`` / ``CS_TPU_MESH_MERKLE_MIN``
 # knobs read through :func:`knob` (``parallel/mesh_state.py``).
 MESH = os.environ.get("CS_TPU_MESH") != "0"
+
+# Durable-replay kill switch: ``CS_TPU_CHECKPOINT=0`` turns the
+# recovery subsystem (``consensus_specs_tpu/recovery``) off — durable
+# replays neither journal nor checkpoint, and a resume degrades to
+# deterministic re-execution from genesis (byte-identical, slower).
+# Live via :func:`switch`.  Cadence/retention knobs
+# (``CS_TPU_CHECKPOINT_EVERY``, ``CS_TPU_CHECKPOINT_KEEP``) are read
+# through :func:`knob` by the sim recovery legs; docs/recovery.md.
+CHECKPOINT = os.environ.get("CS_TPU_CHECKPOINT") != "0"
 
 # Engine supervisor kill switch: ``CS_TPU_SUPERVISOR=0`` turns the
 # health-tracking supervision layer (``consensus_specs_tpu/supervisor``)

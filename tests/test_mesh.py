@@ -60,6 +60,7 @@ def _mode_reset():
     ek.use_auto()
     arrays.use_auto()
     mesh_state.use_auto()
+    mesh_state.restore_devices()
 
 
 def _require_mesh():
@@ -163,6 +164,12 @@ def test_psum_census_matches_budget():
                  u64, bl, u64, u64, scal) == 0
     assert psums(mesh_epoch._p_eff_balance(
         mesh, (10**9, 10**8, 10**8, 32 * 10**9)), u64, u64) == 0
+    # the inclusion-delay scatter-min scan is shard-local by
+    # construction: every validator lane lives on exactly one shard,
+    # so the rewards budget stays at ONE psum with the scan added
+    assert psums(mesh_epoch._p_incl_scan(mesh), u64,
+                 np.zeros(16, dtype=np.int64),
+                 np.zeros(16, dtype=np.uint64)) == 0
 
 
 # ---------------------------------------------------------------------------
@@ -480,6 +487,109 @@ def test_merkle_off_leg_declines():
     mesh_state.use_fallback()
     rng = np.random.RandomState(2)
     assert mesh_merkle.build_levels(rng.bytes(256 * 32), 10) is None
+
+
+# ---------------------------------------------------------------------------
+# device-loss recovery (docs/recovery.md): elastic re-shard over the
+# survivors, counted reason=device_loss fallbacks, byte-identity to
+# the single-device oracle
+# ---------------------------------------------------------------------------
+
+def test_device_loss_epoch_resharded_and_identical():
+    """A device dropping out mid-epoch-dispatch: the handler retires
+    every cached placement, rebuilds the mesh over the survivors,
+    books the counted fallback and re-dispatches — byte-identical to
+    the no-loss oracle."""
+    _require_mesh()
+    spec, state = _altair_state("altair", seed=37)
+    s_ref, s_loss = state.copy(), state.copy()
+    ek.use_vectorized()
+    mesh_state.use_mesh()
+    arrays.use_arrays()
+    before = mesh_state.device_count()
+    spec.process_epoch(s_ref)
+    sched = faults.FaultSchedule(loss={"mesh.epoch": [1]})
+    with counting() as delta:
+        with faults.injected(sched):
+            spec.process_epoch(s_loss)
+    assert sched.losses_fired()
+    assert sched.lost == [("mesh.epoch", 1)]
+    assert delta["mesh.epoch.fallbacks{reason=device_loss}"] == 1
+    assert delta["mesh.device_losses{site=mesh.epoch}"] == 1
+    assert mesh_state.device_count() == before - 1
+    # the re-dispatch over the survivors still committed all five
+    # sub-transitions through the SPMD programs
+    assert delta["mesh.epoch{path=mesh}"] == 5
+    assert hash_tree_root(s_ref) == hash_tree_root(s_loss)
+
+
+def test_device_loss_retires_cached_placements():
+    """The placement epoch bump retires EVERY cell placement at once:
+    a post-loss read re-places on the survivor mesh."""
+    _require_mesh()
+    spec, state = _altair_state("altair", seed=41)
+    arrays.use_arrays()
+    mesh_state.use_mesh()
+    sa = arrays.of(state)
+    mesh = mesh_state.build_mesh()
+    with counting() as delta:
+        mesh_state.sharded_cell(sa, "balances", mesh)
+        mesh_state.sharded_cell(sa, "balances", mesh)   # cached
+    assert delta["mesh.placements{column=balances}"] == 1
+    mesh_state.lose_device("mesh.epoch")
+    survivor_mesh = mesh_state.build_mesh()
+    assert survivor_mesh is not mesh
+    with counting() as delta:
+        mesh_state.sharded_cell(sa, "balances", survivor_mesh)
+    assert delta["mesh.placements{column=balances}"] == 1
+
+
+def test_device_loss_merkle_resharded_and_identical():
+    _require_mesh()
+    mesh_state.use_mesh()
+    rng = np.random.RandomState(17)
+    data = rng.bytes(256 * 32)
+    golden = mesh_merkle._sequential_levels(data, 10)
+    sched = faults.FaultSchedule(loss={"mesh.merkle": [1]})
+    with counting() as delta:
+        with faults.injected(sched):
+            got = mesh_merkle.build_levels(data, 10)
+    assert sched.losses_fired()
+    assert delta["mesh.merkle.fallbacks{reason=device_loss}"] == 1
+    assert delta["mesh.device_losses{site=mesh.merkle}"] == 1
+    assert got is not None, "re-shard over survivors never re-dispatched"
+    assert delta["mesh.merkle{path=mesh}"] == 1
+    assert [bytes(a) for a in got] == [bytes(b) for b in golden]
+
+
+def test_device_loss_down_to_single_device_falls_back():
+    """Losing down past the two-device gate degrades to the
+    single-device engines — engagement floors respected, result
+    byte-identical."""
+    _require_mesh()
+    spec, state = _altair_state("altair", seed=43)
+    s_ref, s_lost = state.copy(), state.copy()
+    ek.use_vectorized()
+    mesh_state.use_mesh()
+    arrays.use_arrays()
+    spec.process_epoch(s_ref)
+    while mesh_state.device_count() > 1:
+        mesh_state.lose_device("mesh.epoch")
+    assert not mesh_state.enabled()
+    with counting() as delta:
+        spec.process_epoch(s_lost)
+    assert delta["mesh.epoch{path=mesh}"] == 0
+    assert hash_tree_root(s_ref) == hash_tree_root(s_lost)
+
+
+def test_restore_devices_resets_the_mesh():
+    _require_mesh()
+    total = mesh_state.device_count()
+    mesh_state.lose_device("mesh.epoch")
+    assert mesh_state.device_count() == total - 1
+    mesh_state.restore_devices()
+    assert mesh_state.device_count() == total
+    assert len(mesh_state.active_devices()) == total
 
 
 # ---------------------------------------------------------------------------

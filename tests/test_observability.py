@@ -384,6 +384,7 @@ def test_fallback_counters_carry_reason_labels():
     import consensus_specs_tpu.ops.epoch_kernels  # noqa: F401
     import consensus_specs_tpu.parallel.mesh_epoch  # noqa: F401
     import consensus_specs_tpu.parallel.mesh_merkle  # noqa: F401
+    import consensus_specs_tpu.recovery.checkpoint  # noqa: F401
     import consensus_specs_tpu.state.arrays  # noqa: F401
     import consensus_specs_tpu.utils.bls  # noqa: F401
     import consensus_specs_tpu.utils.ssz.merkle  # noqa: F401
@@ -398,11 +399,25 @@ def test_fallback_counters_carry_reason_labels():
     assert set(registry.counter("state_arrays.fallbacks").series_values()) \
         == {"{reason=injected}", "{reason=deadline}"}
     # the mesh epoch engine declines organically (guards); the merkle
-    # leaf-span path has no organic guard of its own
+    # leaf-span path has no organic guard of its own; both re-shard
+    # elastically on a device loss (counted reason=device_loss)
     assert set(registry.counter("mesh.epoch.fallbacks").series_values()) \
-        == {"{reason=guard}", "{reason=injected}", "{reason=deadline}"}
+        == {"{reason=guard}", "{reason=injected}", "{reason=deadline}",
+            "{reason=device_loss}"}
     assert set(registry.counter("mesh.merkle.fallbacks").series_values()) \
-        == {"{reason=injected}", "{reason=deadline}"}
+        == {"{reason=injected}", "{reason=deadline}",
+            "{reason=device_loss}"}
+    # the durability subsystem: injected/deadline skip a checkpoint,
+    # io is the organic rung, the rest name recovery-ladder rungs
+    assert set(registry.counter("recovery.fallbacks").series_values()) \
+        == {"{reason=injected}", "{reason=deadline}", "{reason=io}",
+            "{reason=manifest}", "{reason=blob}",
+            "{reason=journal_corrupt}", "{reason=torn_record}",
+            "{reason=divergence}"}
+    assert set(registry.counter("recovery.checkpoints").series_values()) \
+        == {"{result=saved}", "{result=skipped}", "{result=refused}"}
+    assert set(registry.counter("recovery.restores").series_values()) \
+        == {"{path=checkpoint}", "{path=genesis}"}
     flush = set(registry.counter("bls.flush").series_values())
     assert {"{path=fallback,reason=bisect}",
             "{path=fallback,reason=injected}",

@@ -1,0 +1,630 @@
+"""Durable-replay suite: crash-consistent checkpoint/restore, the
+write-ahead journal, the recovery ladder, the recovery.* engine-site
+contract, and the kill/restart + corruption harness legs
+(``consensus_specs_tpu/recovery/`` + ``sim/recovery.py``;
+docs/recovery.md).
+
+Contracts under test:
+
+* **atomicity** — every persisted file lands via temp + fsync + rename;
+  a failed write never touches the final path;
+* **journal integrity** — records CRC-validate, a torn tail and
+  mid-file damage classify differently, uncommitted step events are
+  discarded;
+* **checkpoint integrity** — the manifest is the commit point, blob
+  hashes gate every load, checkpointing is REFUSED inside an open
+  ``arrays.commit_scope``;
+* **recovery ladder** — every corruption case (truncated checkpoint
+  blob, bit-flipped blob, truncated manifest, torn journal record,
+  per-blob bit flips) is detected, counted on
+  ``recovery.fallbacks{reason=}``, degrades to the previous generation
+  and still produces the byte-identical digest — zero silent wrong
+  resumes;
+* **site contract** — ``recovery.checkpoint`` / ``recovery.restore``
+  take injected faults as counted fallbacks, demote under a
+  threshold-1 breaker, and rate-1 sentinel audits quarantine
+  corrupt-mode results (the PR-9 contract at the new sites);
+* **kill/restart** — a REAL SIGKILL mid-replay, restored from disk by
+  a second process, byte-identical to the uninterrupted replay;
+* **satellites** — the genesis cache keys by stable spec identity (the
+  D1004 stale-aliasing fix) and a truncated repro artifact fails
+  loudly.
+"""
+import json
+import os
+
+import pytest
+
+from consensus_specs_tpu import faults, supervisor
+from consensus_specs_tpu.forks import build_spec
+from consensus_specs_tpu.recovery import atomic, journal
+from consensus_specs_tpu.recovery.checkpoint import (
+    CheckpointRefused, CheckpointStore, store_digest)
+from consensus_specs_tpu.recovery.replay import DurableReplay
+from consensus_specs_tpu.sim import driver, harness, scenarios
+from consensus_specs_tpu.sim import recovery as rec_legs
+from consensus_specs_tpu.state import arrays
+from consensus_specs_tpu.test_infra.metrics import counting
+from consensus_specs_tpu.utils import bls
+
+SEED = 2            # steady scenario: fast, finalizing
+FORK_SEED = 1       # equivocation scenario: sibling forks in the tail
+EVERY = 8
+
+
+@pytest.fixture(autouse=True)
+def _stub_bls(monkeypatch):
+    # signatures off (digest covers everything but sig bytes), and the
+    # subsystem under test FORCED on — the CS_TPU_CHECKPOINT=0 CI leg
+    # re-runs this suite to prove the live switch overrides the job
+    # env, exactly the mesh-suite convention (the off legs proper are
+    # test_checkpoint_off_leg / the sim suite's default paths)
+    monkeypatch.setenv("CS_TPU_CHECKPOINT", "1")
+    prev = bls.bls_active
+    bls.bls_active = False
+    yield
+    bls.bls_active = prev
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    """(spec, scenario, baseline digest) shared by the replay tests."""
+    bls_prev = bls.bls_active
+    bls.bls_active = False
+    spec = build_spec("phase0", "minimal")
+    epoch = int(spec.SLOTS_PER_EPOCH)
+    scenario = scenarios.build(SEED, epoch, epoch * 8)
+    try:
+        with harness.env_overrides(harness.NEUTRAL_SUPERVISOR_ENV):
+            baseline, _ = harness.run_baseline(spec, scenario)
+    finally:
+        bls.bls_active = bls_prev
+    return spec, scenario, baseline
+
+
+def _neutral(monkeypatch):
+    for k, v in harness.NEUTRAL_SUPERVISOR_ENV.items():
+        monkeypatch.setenv(k, v)
+    supervisor.reset()
+
+
+# ---------------------------------------------------------------------------
+# atomic writes
+# ---------------------------------------------------------------------------
+
+def test_atomic_write_roundtrip_and_overwrite(tmp_path):
+    path = str(tmp_path / "blob.bin")
+    atomic.atomic_write_bytes(path, b"first")
+    assert open(path, "rb").read() == b"first"
+    atomic.atomic_write_bytes(path, b"second")
+    assert open(path, "rb").read() == b"second"
+    assert [n for n in os.listdir(tmp_path) if n.endswith(".tmp")] == []
+
+
+def test_atomic_write_failure_never_touches_final_path(tmp_path,
+                                                       monkeypatch):
+    path = str(tmp_path / "blob.bin")
+    atomic.atomic_write_bytes(path, b"old content")
+
+    def boom(src, dst):
+        raise OSError("disk pulled")
+
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(OSError):
+        atomic.atomic_write_bytes(path, b"half-writ")
+    assert open(path, "rb").read() == b"old content"
+    assert [n for n in os.listdir(tmp_path) if n.endswith(".tmp")] == []
+
+
+# ---------------------------------------------------------------------------
+# write-ahead journal
+# ---------------------------------------------------------------------------
+
+def _journal_with(tmp_path, *records):
+    path = str(tmp_path / "wal.log")
+    j = journal.Journal(path)
+    for kind, payload in records:
+        j.append(kind, payload)
+    j.close()
+    return path
+
+
+def test_journal_roundtrip(tmp_path):
+    path = _journal_with(tmp_path, (journal.TICK, b"\x01" * 8),
+                         (journal.BLOCK, b"block bytes"))
+    records, anomaly = journal.scan(path)
+    assert anomaly is None
+    assert records == [(journal.TICK, b"\x01" * 8),
+                       (journal.BLOCK, b"block bytes")]
+
+
+def test_journal_torn_tail_detected(tmp_path):
+    path = _journal_with(tmp_path, (journal.TICK, b"\x02" * 8))
+    with open(path, "ab") as f:
+        f.write(journal.frame(journal.BLOCK, b"x" * 64)[:20])
+    records, anomaly = journal.scan(path)
+    assert anomaly == "torn"
+    assert records == [(journal.TICK, b"\x02" * 8)]
+
+
+def test_journal_midfile_corruption_detected(tmp_path):
+    path = _journal_with(tmp_path, (journal.BLOCK, b"a" * 64),
+                         (journal.BLOCK, b"b" * 64))
+    with open(path, "r+b") as f:
+        f.seek(12)      # inside the first record's payload
+        f.write(b"\xff")
+    records, anomaly = journal.scan(path)
+    assert anomaly == "corrupt"
+    assert records == []
+
+
+def test_completed_steps_discards_uncommitted_tail(tmp_path):
+    path = _journal_with(
+        tmp_path,
+        (journal.TICK, b"\x01" * 8),
+        (journal.STEP, journal.step_payload(0, {"op": "tick"})),
+        (journal.BLOCK, b"uncommitted"))
+    records, anomaly = journal.scan(path)
+    assert anomaly is None
+    steps = journal.completed_steps(records)
+    assert len(steps) == 1
+    ordinal, step, events = steps[0]
+    assert (ordinal, step) == (0, {"op": "tick"})
+    assert events == [(journal.TICK, b"\x01" * 8)]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint store
+# ---------------------------------------------------------------------------
+
+def _partial(spec, scenario, work, stop_at=None, every=EVERY):
+    replay = DurableReplay(spec, scenario, str(work),
+                           checkpoint_every=every)
+    if stop_at is None:
+        stop_at = rec_legs.pick_kill_step(scenario, every)
+    replay.run(stop_at=stop_at)
+    return replay.cs, stop_at
+
+
+def test_checkpoint_save_load_roundtrip(ctx, tmp_path, monkeypatch):
+    spec, scenario, _ = ctx
+    _neutral(monkeypatch)
+    cs, _ = _partial(spec, scenario, tmp_path / "ck")
+    gens = cs.generations()
+    assert len(gens) >= 2
+    sim, step, manifest = cs.load(spec, gens[-1])
+    # the restored store answers the same digest the manifest recorded
+    assert store_digest(spec, sim.store) == manifest["digest"]
+    assert step == manifest["step"]
+    # sidecar state round-trips exactly
+    sim2, _, _ = cs.load(spec, gens[-1])
+    assert sim.snapshot_sidecar() == sim2.snapshot_sidecar()
+
+
+def test_checkpoint_refused_inside_open_commit_scope(ctx, tmp_path,
+                                                     monkeypatch):
+    spec, scenario, _ = ctx
+    _neutral(monkeypatch)
+    arrays.use_arrays()
+    try:
+        sim = driver.ChainSim(spec, scenario.n_validators)
+        sim.run(scenario.script[:6])
+        cs = CheckpointStore(str(tmp_path / "ck"))
+        head = bytes(spec.get_head(sim.store))
+        state = sim.store.block_states[head]
+        with arrays.commit_scope(state):
+            # poke a deferred write so the scope is genuinely open
+            sa = arrays.of(state)
+            sa.set_balances(sa.balances().copy())
+            with pytest.raises(CheckpointRefused):
+                cs.save(spec, sim, 6)
+        # scope closed: the same save goes through
+        assert cs.save(spec, sim, 6) is not None
+    finally:
+        arrays.use_auto()
+
+
+def test_manifest_is_the_commit_point(ctx, tmp_path, monkeypatch):
+    spec, scenario, _ = ctx
+    _neutral(monkeypatch)
+    cs, _ = _partial(spec, scenario, tmp_path / "ck")
+    newest = cs.generations()[-1]
+    os.unlink(cs.manifest_path(newest))
+    # blobs of the un-manifested generation still on disk, yet the
+    # generation does not exist for recovery
+    assert newest not in cs.generations()
+
+
+def test_prune_keeps_newest_generations(ctx, tmp_path, monkeypatch):
+    spec, scenario, _ = ctx
+    _neutral(monkeypatch)
+    cs, _ = _partial(spec, scenario, tmp_path / "ck")
+    gens = cs.generations()
+    assert len(gens) <= cs.keep
+    assert gens == sorted(gens)
+
+
+# ---------------------------------------------------------------------------
+# recovery ladder: crash + resume, corruption matrix
+# ---------------------------------------------------------------------------
+
+def test_resume_after_boundary_crash_byte_identical(ctx, tmp_path,
+                                                    monkeypatch):
+    spec, scenario, baseline = ctx
+    _neutral(monkeypatch)
+    work = str(tmp_path / "ck")
+    _partial(spec, scenario, work)
+    with counting() as delta:
+        result, info = DurableReplay(spec, scenario, work,
+                                     checkpoint_every=EVERY).resume()
+    assert result.digest() == baseline.digest()
+    assert info["path"] == "checkpoint"
+    assert delta["recovery.restores{path=checkpoint}"] == 1
+    # each replayed step re-proves its events + its commit marker
+    assert delta["recovery.journal.records{op=replayed}"] \
+        >= info["journal_steps"]
+
+
+def test_resume_replays_journal_tail(ctx, tmp_path, monkeypatch):
+    """The resume point must sit PAST the checkpoint step: the journal
+    tail really advances the restored store."""
+    spec, scenario, baseline = ctx
+    _neutral(monkeypatch)
+    work = str(tmp_path / "ck")
+    # stop at a step that is NOT a checkpoint boundary so a tail exists
+    stop_at = rec_legs.pick_kill_step(scenario, EVERY)
+    if stop_at % EVERY == 0:
+        stop_at += 1
+    _partial(spec, scenario, work, stop_at=stop_at)
+    result, info = DurableReplay(spec, scenario, work,
+                                 checkpoint_every=EVERY).resume()
+    assert result.digest() == baseline.digest()
+    assert info["path"] == "checkpoint"
+    assert info["journal_steps"] == stop_at % EVERY
+
+
+def test_journal_replay_across_fork_boundary(monkeypatch, tmp_path):
+    """Resume with sibling forks, withheld blocks and queued evidence
+    inside the journaled tail (the equivocation scenario) — the
+    sidecar + journal replay must reconstruct the mid-fork driver."""
+    spec = build_spec("phase0", "minimal")
+    epoch = int(spec.SLOTS_PER_EPOCH)
+    scenario = scenarios.build(FORK_SEED, epoch, epoch * 8)
+    assert scenario.name == "equivocation"
+    _neutral(monkeypatch)
+    baseline = driver.execute(spec, scenario.script,
+                              scenario.n_validators)
+    work = str(tmp_path / "ck")
+    # small cadence: the tail crosses the sibling-fork steps
+    cs, stop_at = _partial(spec, scenario, work, every=4)
+    result, info = DurableReplay(spec, scenario, work,
+                                 checkpoint_every=4).resume()
+    assert result.digest() == baseline.digest()
+    assert info["path"] == "checkpoint"
+
+
+def test_corruption_matrix(ctx, tmp_path):
+    """truncated checkpoint blob / bit-flipped blob / truncated
+    manifest / torn journal record: all detected, counted, degraded,
+    byte-identical (the sweep leg, run directly)."""
+    spec, scenario, baseline = ctx
+    cases = rec_legs.run_corruption_matrix(spec, scenario, baseline,
+                                           str(tmp_path))
+    assert cases == {"truncated_state_blob": "blob",
+                     "bitflip_block_blob": "blob",
+                     "truncated_manifest": "manifest",
+                     "torn_journal_record": "torn_record"}
+
+
+@pytest.mark.parametrize("blob", ["blocks.bin", "states.bin",
+                                  "ckpt_states.bin", "store_meta.json",
+                                  "sidecar.json"])
+def test_bitflip_each_blob_detected(ctx, tmp_path, monkeypatch, blob):
+    """Per-blob corruption matrix: a single flipped bit in ANY
+    manifest-hashed blob fails the generation and degrades."""
+    spec, scenario, baseline = ctx
+    _neutral(monkeypatch)
+    work = str(tmp_path / "ck")
+    cs, _ = _partial(spec, scenario, work)
+    newest = cs.generations()[-1]
+    path = cs.blob_path(newest, blob)
+    data = bytearray(open(path, "rb").read())
+    data[len(data) // 2] ^= 0x01
+    open(path, "wb").write(bytes(data))
+    with counting() as delta:
+        result, info = DurableReplay(spec, scenario, work,
+                                     checkpoint_every=EVERY).resume()
+    assert delta["recovery.fallbacks{reason=blob}"] >= 1
+    assert not (info["path"] == "checkpoint"
+                and info["generation"] == newest)
+    assert result.digest() == baseline.digest()
+
+
+def test_wrong_scenario_checkpoint_dir_refused(ctx, tmp_path,
+                                               monkeypatch):
+    """A resume pointed at ANOTHER scenario's checkpoint directory —
+    with an EMPTY journal tail, so no journaled step could catch it —
+    must refuse every generation (counted) and fall to genesis
+    re-execution of the RIGHT script, byte-identical."""
+    spec, scenario, baseline = ctx
+    _neutral(monkeypatch)
+    epoch = int(spec.SLOTS_PER_EPOCH)
+    other = scenarios.build(FORK_SEED, epoch, epoch * 8)
+    assert other.name != scenario.name
+    work = str(tmp_path / "ck")
+    # stop exactly AT a checkpoint boundary: the newest generation's
+    # journal is empty — the hole _replay_tail cannot cover
+    replay = DurableReplay(spec, other, work, checkpoint_every=EVERY)
+    replay.run(stop_at=2 * EVERY)
+    with counting() as delta:
+        result, info = DurableReplay(spec, scenario, work,
+                                     checkpoint_every=EVERY).resume()
+    assert info["path"] == "genesis"
+    assert any(reason == "scenario_mismatch"
+               for _, reason in info["rungs"])
+    assert delta["recovery.fallbacks{reason=divergence}"] >= 1
+    assert result.digest() == baseline.digest()
+
+
+def test_midfile_journal_corruption_degrades(ctx, tmp_path, monkeypatch):
+    spec, scenario, baseline = ctx
+    _neutral(monkeypatch)
+    work = str(tmp_path / "ck")
+    cs, _ = _partial(spec, scenario, work)
+    newest = cs.generations()[-1]
+    wal = cs.journal_path(newest)
+    data = bytearray(open(wal, "rb").read())
+    if len(data) < 16:
+        pytest.skip("journal tail too short to damage mid-file")
+    data[10] ^= 0xff
+    open(wal, "wb").write(bytes(data))
+    with counting() as delta:
+        result, info = DurableReplay(spec, scenario, work,
+                                     checkpoint_every=EVERY).resume()
+    assert delta["recovery.fallbacks{reason=journal_corrupt}"] \
+        + delta["recovery.fallbacks{reason=torn_record}"] >= 1
+    assert result.digest() == baseline.digest()
+
+
+# ---------------------------------------------------------------------------
+# recovery.* engine-site contract (breaker / injected / audit)
+# ---------------------------------------------------------------------------
+
+def test_injected_fault_at_checkpoint_site(ctx, tmp_path):
+    spec, scenario, baseline = ctx
+    rec_legs.run_recovery_injected(spec, scenario, baseline,
+                                   str(tmp_path), "recovery.checkpoint")
+
+
+def test_injected_fault_at_restore_site(ctx, tmp_path):
+    spec, scenario, baseline = ctx
+    rec_legs.run_recovery_injected(spec, scenario, baseline,
+                                   str(tmp_path), "recovery.restore")
+
+
+def test_breaker_demotes_checkpoint_site(ctx, tmp_path, monkeypatch):
+    """Threshold-1 breaker: one injected checkpoint failure opens the
+    site; later checkpoints SKIP (counted) and the replay finishes
+    byte-identical with degraded durability."""
+    spec, scenario, baseline = ctx
+    for k, v in {"CS_TPU_BREAKER_THRESHOLD": "1",
+                 "CS_TPU_BREAKER_WINDOW_MS": "60000",
+                 "CS_TPU_BREAKER_BACKOFF_MS": "600000",
+                 "CS_TPU_BREAKER_BACKOFF_MAX_MS": "600000"}.items():
+        monkeypatch.setenv(k, v)
+    supervisor.reset()
+    schedule = faults.FaultSchedule({"recovery.checkpoint": [1]})
+    with counting() as delta:
+        with faults.injected(schedule):
+            result = DurableReplay(spec, scenario, str(tmp_path / "ck"),
+                                   checkpoint_every=4).run()
+    assert schedule.fully_fired()
+    assert delta["recovery.fallbacks{reason=injected}"] == 1
+    assert delta["supervisor.transitions{site=recovery.checkpoint,"
+                 "to=open}"] >= 1
+    assert delta["supervisor.breaker.skips{site=recovery.checkpoint}"] \
+        >= 1
+    assert delta["recovery.checkpoints{result=skipped}"] >= 1
+    assert result.digest() == baseline.digest()
+
+
+def test_audit_quarantines_corrupt_checkpoint(ctx, tmp_path,
+                                              monkeypatch):
+    """Corrupt-mode checkpoint writes + rate-1 read-back audits: the
+    first lying generation is caught, discarded and the site
+    quarantined — durability degrades, the replay does not."""
+    spec, scenario, baseline = ctx
+    for k, v in harness.AUDIT_ENV.items():
+        monkeypatch.setenv(k, v)
+    supervisor.reset()
+    schedule = faults.FaultSchedule(
+        corrupt={"recovery.checkpoint": [1]})
+    work = str(tmp_path / "ck")
+    with counting() as delta:
+        with faults.injected(schedule):
+            result = DurableReplay(spec, scenario, work,
+                                   checkpoint_every=4).run()
+    assert schedule.corrupted, "corrupt hook never armed"
+    assert delta["supervisor.audits{result=fail,"
+                 "site=recovery.checkpoint}"] >= 1
+    assert delta["supervisor.quarantines{site=recovery.checkpoint}"] == 1
+    # the lying generation was discarded: whatever remains verifies
+    cs = CheckpointStore(work)
+    for gen in cs.generations():
+        ok, detail = cs.verify(gen)
+        assert ok, detail
+    assert result.digest() == baseline.digest()
+
+
+def test_audit_catches_corrupt_restore(ctx, tmp_path, monkeypatch):
+    """Corrupt-mode restore + rate-1 digest audits: the silently-wrong
+    restored store is caught against the manifest digest, the site
+    quarantined, and the ladder degrades to genesis re-execution —
+    byte-identical."""
+    spec, scenario, baseline = ctx
+    work = str(tmp_path / "ck")
+    with harness.env_overrides(harness.NEUTRAL_SUPERVISOR_ENV):
+        _partial(spec, scenario, work)
+    for k, v in harness.AUDIT_ENV.items():
+        monkeypatch.setenv(k, v)
+    supervisor.reset()
+    schedule = faults.FaultSchedule(corrupt={"recovery.restore": [1]})
+    with counting() as delta:
+        with faults.injected(schedule):
+            result, info = DurableReplay(spec, scenario, work,
+                                         checkpoint_every=EVERY).resume()
+    assert schedule.corrupted, "corrupt hook never armed"
+    assert delta["supervisor.audits{result=fail,"
+                 "site=recovery.restore}"] >= 1
+    assert delta["supervisor.quarantines{site=recovery.restore}"] == 1
+    assert info["path"] == "genesis"
+    assert result.digest() == baseline.digest()
+
+
+# ---------------------------------------------------------------------------
+# restored state + the columnar store (satellite: COW behavior)
+# ---------------------------------------------------------------------------
+
+def test_restore_then_fork_state_shares_columns(ctx, tmp_path,
+                                                monkeypatch):
+    """Restored states re-derive their StateArrays columns lazily, and
+    ``fork_state`` of a restored state SHARES them copy-on-write (the
+    committed cell data is the same array object, not a copy)."""
+    spec, scenario, _ = ctx
+    _neutral(monkeypatch)
+    arrays.use_arrays()
+    try:
+        cs, _ = _partial(spec, scenario, tmp_path / "ck")
+        sim, _, _ = cs.load(spec, cs.generations()[-1])
+        head = bytes(spec.get_head(sim.store))
+        state = sim.store.block_states[head]
+        sa = arrays.of(state)
+        parent_col = sa.registry()
+        parent_bal = sa.balances()      # extracted BEFORE the fork:
+        #                                 only attached cells ride along
+        child = arrays.fork_state(state)
+        child_sa = arrays.of(child)
+        assert child_sa.registry() is parent_col
+        assert child_sa.balances() is parent_bal
+    finally:
+        arrays.use_auto()
+
+
+def test_restore_then_fork_single_replacement_under_mesh(ctx, tmp_path,
+                                                         monkeypatch):
+    """Under the mesh, a restored state's column is PLACED once and the
+    copy-on-write fork rides the same placement: <= 1 registry
+    placement across parent + child reads."""
+    from consensus_specs_tpu.parallel import mesh_state
+    if mesh_state.device_count() < 2:
+        pytest.skip("needs a multi-device host")
+    spec, scenario, _ = ctx
+    _neutral(monkeypatch)
+    arrays.use_arrays()
+    mesh_state.use_mesh()
+    try:
+        cs, _ = _partial(spec, scenario, tmp_path / "ck")
+        sim, _, _ = cs.load(spec, cs.generations()[-1])
+        head = bytes(spec.get_head(sim.store))
+        state = sim.store.block_states[head]
+        sa = arrays.of(state)
+        mesh = mesh_state.build_mesh()
+        with counting() as delta:
+            mesh_state.sharded_cell(sa, "registry", mesh)
+            child = arrays.fork_state(state)
+            mesh_state.sharded_cell(arrays.of(child), "registry", mesh)
+        assert delta["mesh.placements{column=registry}"] == 1
+    finally:
+        mesh_state.use_auto()
+        arrays.use_auto()
+
+
+# ---------------------------------------------------------------------------
+# harness legs: kill/restart (real SIGKILL), checkpoint-off
+# ---------------------------------------------------------------------------
+
+def test_kill_restart_subprocess_round_trip(ctx, tmp_path):
+    """The acceptance leg: a subprocess replay SIGKILLed at a seeded
+    step, restarted from checkpoint + journal, finishes byte-identical
+    to the uninterrupted replay."""
+    spec, scenario, baseline = ctx
+    info = rec_legs.run_kill_restart(spec, scenario, baseline,
+                                     str(tmp_path))
+    assert info["path"] == "checkpoint"
+
+
+def test_checkpoint_off_leg(ctx, tmp_path):
+    spec, scenario, baseline = ctx
+    rec_legs.run_checkpoint_off(spec, scenario, baseline, str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# satellites: genesis-cache identity, truncated artifact
+# ---------------------------------------------------------------------------
+
+def test_genesis_cache_keys_by_spec_identity():
+    """Regression for the id(spec) stale-aliasing bug: an EQUAL but
+    DISTINCT spec instance (the shape a GC'd-and-reused id would fake)
+    must HIT the cache entry, and different configs must not."""
+    from consensus_specs_tpu.config import load_config, load_preset
+    from consensus_specs_tpu.forks import fork_registry
+    spec = build_spec("phase0", "minimal")
+    other = fork_registry()["phase0"](load_preset("minimal"),
+                                      load_config("minimal"),
+                                      preset_name="minimal")
+    assert other is not spec
+    assert driver._spec_identity(other) == driver._spec_identity(spec)
+    driver._GENESIS_CACHE.clear()
+    driver.genesis_state(spec, 8)
+    assert len(driver._GENESIS_CACHE) == 1
+    driver.genesis_state(other, 8)      # equal identity: cache hit
+    assert len(driver._GENESIS_CACHE) == 1
+    altair = build_spec("altair", "minimal")
+    assert driver._spec_identity(altair) != driver._spec_identity(spec)
+    overridden = build_spec("phase0", "minimal",
+                            {"SHARD_COMMITTEE_PERIOD": 2})
+    assert driver._spec_identity(overridden) \
+        != driver._spec_identity(spec)
+
+
+def test_truncated_artifact_fails_loudly(tmp_path):
+    """A torn repro artifact (only possible via an outside writer now
+    that dump_artifact is atomic) must raise a loud, path-naming
+    error, not a bare JSONDecodeError."""
+    from consensus_specs_tpu.sim import repro
+    path = str(tmp_path / "repro_truncated.json")
+    with open(path, "w") as f:
+        f.write('{"scenario": "steady", "seed": 1, "scr')
+    with pytest.raises(ValueError) as err:
+        repro.load_artifact(path)
+    assert "repro_truncated.json" in str(err.value)
+    assert "truncated or corrupted" in str(err.value)
+
+
+def test_dump_artifact_is_atomic(tmp_path, monkeypatch):
+    """dump_artifact writes through recovery/atomic.py: no .tmp
+    leftovers, valid JSON at the final path."""
+    from consensus_specs_tpu.sim import repro
+    scenario = scenarios.Scenario("steady", 1, [{"op": "tick"}], 8)
+    path = repro.dump_artifact(scenario, "unit", "msg",
+                               out_dir=str(tmp_path))
+    payload = json.load(open(path))
+    assert payload["scenario"] == "steady"
+    assert [n for n in os.listdir(tmp_path) if n.endswith(".tmp")] == []
+
+
+# ---------------------------------------------------------------------------
+# sidecar round-trip
+# ---------------------------------------------------------------------------
+
+def test_sidecar_roundtrip(ctx, monkeypatch):
+    spec, scenario, _ = ctx
+    _neutral(monkeypatch)
+    sim = driver.ChainSim(spec, scenario.n_validators)
+    sim.run(scenario.script[:20])
+    snap = sim.snapshot_sidecar()
+    other = driver.ChainSim.restored(spec, sim.store, sim.anchor_root)
+    other.restore_sidecar(json.loads(json.dumps(snap)))
+    assert other.snapshot_sidecar() == snap
+    assert other.tips == sim.tips
+    assert other.statuses == sim.statuses

@@ -646,3 +646,20 @@ def test_run_leg_resets_supervisor_per_leg(spec):
         harness.run_leg(spec, scenario)
     assert supervisor.states()["merkle.dispatch"] == "closed"
     assert delta["supervisor.breaker.skips{site=merkle.dispatch}"] == 0
+
+
+def test_fault_schedule_loss_ordinals_fire_once():
+    """Device-loss ordinals are CONSUMED on fire: the handler's
+    elastic re-dispatch of the same call must not re-lose a device
+    (or the mesh would drain one device per retry)."""
+    sched = faults.FaultSchedule(loss={"mesh.epoch": [2]})
+    with faults.injected(sched):
+        faults.check("mesh.epoch")              # call 1
+        assert not faults.loss_armed("mesh.epoch")
+        faults.check("mesh.epoch")              # call 2: scheduled
+        assert faults.loss_armed("mesh.epoch")
+        assert not faults.loss_armed("mesh.epoch")   # consumed
+    assert sched.losses_fired()
+    assert sched.lost == [("mesh.epoch", 2)]
+    # disarmed: the hook answers False at one-global-read cost
+    assert not faults.loss_armed("mesh.epoch")

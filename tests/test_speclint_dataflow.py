@@ -20,7 +20,7 @@ from consensus_specs_tpu.tools.speclint import (
     cache as sl_cache, dataflow, driver, fixer, sarif)
 from consensus_specs_tpu.tools.speclint.graph import ProjectGraph
 from consensus_specs_tpu.tools.speclint.passes import (
-    coverage, determinism, rangeproof, uint64)
+    coverage, determinism, durability, rangeproof, uint64)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCOPED = "consensus_specs_tpu/ops/epoch_kernels.py"
@@ -505,6 +505,137 @@ def test_determinism_real_tree_clean():
     env-knob and kzg integer-math fixes, the consensus surface is
     determinism-clean."""
     assert determinism.run(driver.Context(REPO)) == []
+
+
+def test_determinism_flags_tuple_id_key(tmp_path):
+    """D1004 catches an id() call hidden inside a tuple key."""
+    ctx = _det_tree(tmp_path,
+                    "CACHE = {}\n"
+                    "def work(state):\n"
+                    "    return CACHE.get((id(state), 4))\n")
+    assert _codes(determinism.run(ctx)) == ["D1004"]
+
+
+def test_determinism_flags_id_tainted_name_key(tmp_path):
+    """D1004 catches the two-line shape the sim genesis cache had:
+    ``key = (id(x), n)`` then ``d.get(key)``."""
+    ctx = _det_tree(tmp_path,
+                    "CACHE = {}\n"
+                    "def work(state):\n"
+                    "    key = (id(state), 4)\n"
+                    "    return CACHE.get(key)\n")
+    findings = determinism.run(ctx)
+    assert _codes(findings) == ["D1004"]
+
+
+def test_determinism_d1004_reports_in_sim_scope(tmp_path):
+    """The sim package is scanned for D1004 regardless of
+    consensus-root reachability — but ONLY for D1004: the harness may
+    read clocks and RNG by design."""
+    root = tmp_path / "repo"
+    _write(root, "consensus_specs_tpu/sim/fixture_driver.py",
+           "import time\n"
+           "CACHE = {}\n"
+           "def genesis(spec, n):\n"
+           "    key = (id(spec), n)\n"
+           "    return CACHE.get(key)\n"
+           "def pacing():\n"
+           "    return time.time()\n")
+    findings = determinism.run(driver.Context(str(root)))
+    assert _codes(findings) == ["D1004"]
+    assert "sim persistence scope" in findings[0].message
+
+
+def test_determinism_sim_driver_genesis_cache_clean():
+    """Regression for the fixed stale-aliasing bug: the real
+    ``sim/driver.py`` genesis cache keys by stable spec identity now —
+    zero D1004 findings anywhere under ``consensus_specs_tpu/sim/``."""
+    findings = determinism.run(driver.Context(REPO))
+    assert [f for f in findings
+            if f.path.startswith("consensus_specs_tpu/sim/")] == []
+
+
+# ---------------------------------------------------------------------------
+# R9xx durability pass
+# ---------------------------------------------------------------------------
+
+def test_durability_flags_bare_final_path_write(tmp_path):
+    root = tmp_path / "repo"
+    _write(root, "consensus_specs_tpu/recovery/foo.py",
+           "import json\n"
+           "def dump(path, payload):\n"
+           "    with open(path, 'w') as f:\n"
+           "        json.dump(payload, f)\n")
+    findings = durability.run(driver.Context(str(root)))
+    assert _codes(findings) == ["R901"]
+    assert "torn file" in findings[0].message
+
+
+def test_durability_temp_rename_exempt(tmp_path):
+    root = tmp_path / "repo"
+    _write(root, "consensus_specs_tpu/recovery/foo.py",
+           "import os\n"
+           "def dump(path, data):\n"
+           "    with open(path + '.tmp', 'wb') as f:\n"
+           "        f.write(data)\n"
+           "        os.fsync(f.fileno())\n"
+           "    os.replace(path + '.tmp', path)\n")
+    assert durability.run(driver.Context(str(root))) == []
+
+
+def test_durability_atomic_helper_exempt(tmp_path):
+    root = tmp_path / "repo"
+    _write(root, "consensus_specs_tpu/sim/repro.py",
+           "from consensus_specs_tpu.recovery.atomic import "
+           "atomic_write_json\n"
+           "def dump(path, payload):\n"
+           "    atomic_write_json(path, payload)\n")
+    assert durability.run(driver.Context(str(root))) == []
+
+
+def test_durability_fsynced_class_journal_exempt(tmp_path):
+    """An append-mode journal certified by the fsync in a SIBLING
+    method of the same class (the write-ahead journal's shape)."""
+    root = tmp_path / "repo"
+    _write(root, "consensus_specs_tpu/recovery/journal2.py",
+           "import os\n"
+           "class J:\n"
+           "    def __init__(self, path):\n"
+           "        self._f = open(path, 'ab')\n"
+           "    def commit(self):\n"
+           "        os.fsync(self._f.fileno())\n")
+    assert durability.run(driver.Context(str(root))) == []
+
+
+def test_durability_str_replace_does_not_exempt(tmp_path):
+    """Only ``os.replace``/``os.rename``/``os.fsync`` certify the
+    discipline — an ordinary str.replace filename slug next to a bare
+    write must still flag."""
+    root = tmp_path / "repo"
+    _write(root, "consensus_specs_tpu/recovery/foo.py",
+           "def dump(site, data):\n"
+           "    path = site.replace('.', '-') + '.json'\n"
+           "    with open(path, 'w') as f:\n"
+           "        f.write(data)\n")
+    assert _codes(durability.run(driver.Context(str(root)))) == ["R901"]
+
+
+def test_durability_out_of_scope_quiet(tmp_path):
+    """The same bare write outside the persistence scopes is not this
+    pass's business."""
+    root = tmp_path / "repo"
+    _write(root, "consensus_specs_tpu/ops/foo.py",
+           "def dump(path, data):\n"
+           "    with open(path, 'w') as f:\n"
+           "        f.write(data)\n")
+    assert durability.run(driver.Context(str(root))) == []
+
+
+def test_durability_real_tree_clean():
+    """Acceptance: after the repro/gen_runner conversions to
+    recovery/atomic.py, the persistence scopes carry zero bare
+    final-path writes."""
+    assert durability.run(driver.Context(REPO)) == []
 
 
 # ---------------------------------------------------------------------------
