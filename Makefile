@@ -9,8 +9,10 @@ GENERATORS = operations sanity finality rewards random forks epoch_processing \
 .PHONY: test citest test-crypto bench bench-all bench-merkle-smoke \
         bench-forkchoice-smoke bench-obs-smoke bench-block-smoke \
         bench-state-smoke bench-supervisor-smoke bench-das-smoke \
-        bench-mesh-smoke bench-recovery-smoke sim-smoke sim-heavy \
-        obs-report dryrun warm native lint speclint-baseline \
+        bench-mesh-smoke bench-recovery-smoke bench-sanitizer-smoke \
+        sim-smoke sim-heavy \
+        obs-report dryrun warm native lint lint-changed \
+        speclint-baseline \
         generate_tests $(addprefix gen_,$(GENERATORS)) clean-vectors pyspec
 
 # fast local suite: signature checks off except @always_bls
@@ -37,6 +39,7 @@ citest:
 	$(PYTHON) benchmarks/bench_das.py
 	$(PYTHON) benchmarks/bench_mesh.py
 	$(PYTHON) benchmarks/bench_recovery.py
+	$(PYTHON) benchmarks/bench_sanitizer.py
 	$(MAKE) sim-smoke
 	$(PYTHON) -m pytest tests/ -q --enable-bls --bls-type fastest
 
@@ -57,6 +60,13 @@ lint:
 	$(PYTHON) -m compileall -q consensus_specs_tpu tests generators benchmarks
 	@test -d consensus_specs_tpu/forks/compiled || $(MAKE) pyspec
 	$(PYTHON) -m consensus_specs_tpu.tools.speclint .
+
+# the pre-commit developer loop (docs/static-analysis.md): lint only
+# the files dirty vs the git index; the tree passes (ladder,
+# determinism, coverage, effects) stay warm through the dependency-
+# granular cache unless a file they actually read changed
+lint-changed:
+	$(PYTHON) -m consensus_specs_tpu.tools.speclint . --changed
 
 # intentionally re-record the speclint debt (after paying some down, or
 # with a written justification for new findings in the PR).
@@ -135,6 +145,9 @@ bench-state-smoke:
 sim-smoke:
 	$(PYTHON) -m consensus_specs_tpu.sim.sweep --seeds 200 \
 		--min-scenarios 200 --time-budget 2400
+	CS_TPU_SANITIZER=1 $(PYTHON) -m consensus_specs_tpu.sim.sweep \
+		--seeds 24 --min-scenarios 24 --start 9000 \
+		--recovery-seeds 1 --time-budget 600
 
 # the CS_TPU_HEAVY nightly shape: a thousand seeds on a denser
 # injection cadence with more real-signature seeds, then the cross-leg
@@ -188,6 +201,14 @@ bench-mesh-smoke:
 # discipline; nonzero exit above the bound)
 bench-recovery-smoke:
 	$(PYTHON) benchmarks/bench_recovery.py
+
+# runtime effect-sanitizer smoke (docs/static-analysis.md): the
+# disabled hooks must cost <2% of the 32-slot replay (census x per-op
+# cost), an ARMED replay must be byte-identical to the disarmed one
+# with zero violations, and the armed leg must book nonzero
+# sanitizer.checks (non-vacuous); nonzero exit on any violated bound
+bench-sanitizer-smoke:
+	$(PYTHON) benchmarks/bench_sanitizer.py
 
 # engine-supervisor smoke (docs/robustness.md): counter-asserted
 # breaker lifecycle on a real dispatch site (threshold trips ->

@@ -355,9 +355,23 @@ def compile_spec(md_path, out_path: str = None, doc_rels=(),
     compile(src, out_path or "<compiled-spec>", "exec")  # syntax gate
     if out_path:
         os.makedirs(os.path.dirname(out_path), exist_ok=True)
-        with open(out_path, "w") as f:
-            f.write(src)
+        _write_module(out_path, src)
     return src
+
+
+def _write_module(out_path: str, src: str) -> None:
+    """Rename-atomic module write.  The compiled ladder is a read-back-
+    and-trusted surface: ``make lint`` only rebuilds it when the
+    DIRECTORY is missing, so a crash mid-``make pyspec`` used to leave
+    a torn ``forks/compiled/<fork>.py`` at the final path that every
+    later run imported — and a module truncated at a statement boundary
+    is still valid python, silently inheriting the PREVIOUS fork's
+    bodies for everything after the tear.  ``atomic_replace_bytes``
+    (not the fsync variant: a derived artifact regenerates, it only
+    must never be torn) makes readers see the old module or the new
+    one, never a prefix."""
+    from consensus_specs_tpu.recovery.atomic import atomic_replace_bytes
+    atomic_replace_bytes(out_path, src.encode("utf-8"))
 
 
 def compile_library(md_path: str, source_rel: str, out_path: str) -> str:
@@ -365,8 +379,7 @@ def compile_library(md_path: str, source_rel: str, out_path: str) -> str:
     src = emit_library_module(doc, source_rel)
     compile(src, out_path, "exec")  # syntax gate
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
-    with open(out_path, "w") as f:
-        f.write(src)
+    _write_module(out_path, src)
     return src
 
 
@@ -377,8 +390,8 @@ def main():
     init = os.path.join(compiled_dir, "__init__.py")
     os.makedirs(compiled_dir, exist_ok=True)
     if not os.path.exists(init):
-        with open(init, "w") as f:
-            f.write('"""Markdown-compiled spec modules (make pyspec)."""\n')
+        _write_module(
+            init, '"""Markdown-compiled spec modules (make pyspec)."""\n')
     lib_md = os.path.join(repo, "specs/deneb/polynomial-commitments.md")
     compile_library(lib_md, "specs/deneb/polynomial-commitments.md",
                     os.path.join(compiled_dir, "polynomial_commitments.py"))
@@ -393,8 +406,10 @@ def main():
                      provenance_out=manifest[fork])
         print(f"compiled {' + '.join(rels)} -> {out_path}")
     import json
-    with open(os.path.join(compiled_dir, "manifest.json"), "w") as f:
-        json.dump(manifest, f, indent=1, sort_keys=True)
+    # the provenance manifest lands atomically LAST — a manifest that
+    # names modules must never describe torn files (E1221 discipline)
+    _write_module(os.path.join(compiled_dir, "manifest.json"),
+                  json.dumps(manifest, indent=1, sort_keys=True) + "\n")
     verify_provenance(manifest)
     print(f"provenance manifest: {sum(map(len, manifest.values()))} "
           f"symbols across {len(manifest)} forks, all spec logic "

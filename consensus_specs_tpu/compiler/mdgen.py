@@ -387,20 +387,26 @@ def main():
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     path = os.path.join(repo, "specs", "phase0", "beacon-chain.md")
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, "w") as f:
-        f.write(generate_markdown(Phase0Spec, "phase0"))
+    _write_doc(path, generate_markdown(Phase0Spec, "phase0"))
     print(f"wrote {path}")
     for cls, fork, prev in ((AltairSpec, "altair", "phase0"),
                             (BellatrixSpec, "bellatrix", "altair"),
                             (CapellaSpec, "capella", "bellatrix"),
                             (DenebSpec, "deneb", "capella")):
         path = os.path.join(repo, "specs", fork, "beacon-chain.md")
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        with open(path, "w") as f:
-            f.write(generate_delta_markdown(cls, fork, prev))
+        _write_doc(path, generate_delta_markdown(cls, fork, prev))
         print(f"wrote {path}")
     write_component_docs(repo)
+
+
+def _write_doc(path: str, text: str) -> None:
+    """Rename-atomic spec-document write: the markdown IS the source of
+    truth the compiler reads back — a crash mid-regeneration must leave
+    the old document, never a torn prefix the next ``make pyspec``
+    silently compiles."""
+    from consensus_specs_tpu.recovery.atomic import atomic_replace_bytes
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    atomic_replace_bytes(path, text.encode("utf-8"))
 
 
 def write_component_docs(repo: str) -> None:
@@ -489,9 +495,7 @@ compiled deneb spec binds as its `_kzg` backend.
     ]
     for rel, text in docs:
         path = os.path.join(repo, "specs", rel)
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        with open(path, "w") as f:
-            f.write(text)
+        _write_doc(path, text)
         print(f"wrote {path}")
 
 

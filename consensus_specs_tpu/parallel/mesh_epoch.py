@@ -55,7 +55,10 @@ SITE = "mesh.epoch"
 
 # exact psum count per sub-transition: the collective budget the bench
 # smoke asserts (one reduction program call == one psum, proven
-# structurally by the jaxpr census in tests/test_mesh.py)
+# structurally by the jaxpr census in tests/test_mesh.py AND statically
+# — before any device exists — by the speclint E1214 census over the
+# dispatch bodies: `speclint . --effect-verdicts` prints the per-body
+# proof lines; docs/static-analysis.md)
 PSUM_BUDGET = {
     "rewards_and_penalties": 1,
     "inactivity_updates": 0,

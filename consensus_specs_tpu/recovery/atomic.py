@@ -24,6 +24,20 @@ import json
 import os
 import tempfile
 
+# lazy sanitizer accessor (shared with journal.py): keeps this
+# module's IMPORT stdlib-only — the first write then pulls in the
+# sanitizer/obs machinery once, armed or not, which is why the hooks
+# sit on per-write boundaries rather than hot loops
+_sanitizer = None
+
+
+def _san():
+    global _sanitizer
+    if _sanitizer is None:
+        from consensus_specs_tpu import sanitizer
+        _sanitizer = sanitizer
+    return _sanitizer
+
 
 def fsync_dir(path: str) -> None:
     """Durable-rename half of the discipline: fsync the directory that
@@ -56,6 +70,7 @@ def atomic_write_bytes(path: str, data: bytes) -> None:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+        _san().rename_event(path, fsynced=True)
     except BaseException:
         try:
             os.unlink(tmp)
@@ -86,7 +101,12 @@ def atomic_replace_bytes(path: str, data: bytes) -> None:
     try:
         with os.fdopen(fd, "wb") as f:
             f.write(data)
-        os.replace(tmp, path)
+        # fsync deliberately skipped (docstring): the INCOMPLETE-tag
+        # protocol fences these outputs, so the E1223 fsync-before-
+        # rename ordering does not apply here (exempt on the runtime
+        # sanitizer leg for the same reason)
+        os.replace(tmp, path)  # noqa: E1223
+        _san().rename_event(path, fsynced=False, exempt=True)
     except BaseException:
         try:
             os.unlink(tmp)

@@ -38,7 +38,7 @@ import json
 import os
 import struct
 
-from consensus_specs_tpu import faults, supervisor
+from consensus_specs_tpu import faults, sanitizer, supervisor
 from consensus_specs_tpu.obs import registry as obs_registry
 from consensus_specs_tpu.obs.tracing import span
 from consensus_specs_tpu.recovery.atomic import (
@@ -163,15 +163,18 @@ def scenario_identity(scenario) -> dict:
 
 
 def _refuse_open_scopes(store) -> None:
+    sanitizer.checkpoint_scope_check()
     for states in (store.block_states, store.checkpoint_states):
         for state in states.values():
             sa = getattr(state, "__dict__", {}).get("_state_arrays")
             if sa is not None and sa._deferred:
                 _C_REFUSED.add()
+                sanitizer.checkpoint_refused()
                 raise CheckpointRefused(
                     "checkpoint refused: a store state holds deferred "
                     "column writes (open arrays.commit_scope) — its SSZ "
-                    "bytes are not authoritative mid-transition")
+                    "bytes are not authoritative mid-transition "
+                    "(speclint E1203 twin)")
 
 
 class CheckpointStore:
@@ -225,6 +228,11 @@ class CheckpointStore:
             return None
         gens = self.generations()
         gen = (gens[-1] + 1) if gens else 1
+        # the generation number is derived from DISK state (no
+        # committed manifest exists for it — e.g. the corruption legs
+        # damage files externally), so any stale sanitizer ledger
+        # entry for it restarts with this write
+        sanitizer.generation_discarded(self.root_dir, gen)
         try:
             faults.check(site)
             with span("recovery.checkpoint"):
@@ -268,6 +276,7 @@ class CheckpointStore:
         if corrupt:
             data = bytes([data[0] ^ 1]) + data[1:] if data else b"\x01"
         atomic_write_bytes(self.blob_path(gen, name), data)
+        sanitizer.blob_written(self.root_dir, gen, name)
         blobs[name] = {"file": os.path.basename(self.blob_path(gen, name)),
                        "sha256": recorded, "bytes": len(data)}
         supervisor.deadline_check()
@@ -332,11 +341,15 @@ class CheckpointStore:
             "digest": store_digest(spec, store),
             "blobs": blobs,
         }
-        # the commit point: the manifest lands atomically LAST
+        # the commit point: the manifest lands atomically LAST — the
+        # sanitizer's shadow ledger re-proves the ordering dynamically
+        # (E1221: every recorded blob must already be durable)
+        sanitizer.manifest_written(self.root_dir, gen, list(blobs))
         atomic_write_json(self.manifest_path(gen), manifest)
 
     def _discard(self, gen: int) -> None:
         """Drop a half-written or audit-failed generation's files."""
+        sanitizer.generation_discarded(self.root_dir, gen)
         for name in os.listdir(self.root_dir):
             if name == f"manifest_{gen}.json" \
                     or name.startswith(f"ckpt_{gen}_"):

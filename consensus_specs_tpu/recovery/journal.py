@@ -43,6 +43,8 @@ import os
 import struct
 import zlib
 
+from consensus_specs_tpu.recovery.atomic import _san
+
 TICK = 1
 BLOCK = 2
 ATTESTATION = 3
@@ -81,6 +83,7 @@ class Journal:
     def append(self, kind: int, payload: bytes) -> None:
         self._f.write(frame(kind, payload))
         self._f.flush()
+        _san().record_appended(self)
 
     def commit_step(self, ordinal: int, step: dict) -> None:
         """The durability boundary: the STEP marker is fsynced, so a
@@ -88,6 +91,7 @@ class Journal:
         self._f.write(frame(STEP, step_payload(ordinal, step)))
         self._f.flush()
         os.fsync(self._f.fileno())
+        _san().step_committed(self, fsynced=True)
 
     def close(self) -> None:
         if not self._f.closed:

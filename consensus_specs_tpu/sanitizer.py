@@ -1,0 +1,277 @@
+"""``CS_TPU_SANITIZER``: the runtime effect sanitizer — the dynamic
+twin of the speclint E12xx static passes (docs/static-analysis.md).
+
+The E12xx family proves the effect contracts *statically*: no direct
+SSZ write under a pending deferred column (E1201), no fork/checkpoint
+inside an open commit scope (E1202/E1203), manifest-written-last
+(E1221), journal-record-before-STEP-marker (E1222), fsync-before-
+rename (E1223).  The static side is deliberately under-approximate
+(linearized control flow, module-local closures), so every contract
+also gets a runtime enforcement arm: with ``CS_TPU_SANITIZER=1`` the
+instrumented layers (``state/arrays.py``, ``recovery/``) feed a shadow
+effect log here, and a violated contract raises
+:class:`EffectViolation` NAMING the E12xx rule — the sim sweep's
+sanitizer leg and the CI sanitizer job then catch dynamically anything
+the linearization cannot see.
+
+Design points:
+
+* **Disabled cost is one mode check per hook**, and the hooks sit on
+  per-epoch / per-checkpoint boundaries, not per-element hot loops —
+  ``benchmarks/bench_sanitizer.py`` asserts <2% of the 32-slot replay.
+* **E1201** upgrades the store's existing fail-loud ``RuntimeError``
+  (a direct SSZ write detected under a pending deferred column) to an
+  :class:`EffectViolation` naming the rule; the scope ledger
+  additionally records which columns are pending so the message can
+  say what would have been clobbered.
+* **E1202** is *counted, not raised*: ``StateArrays.fork`` commits
+  pending writes into the child by design (PR 12's regression pins
+  it), so a fork inside an open scope is a legal early commit — the
+  ``sanitizer.violations{rule=E1202}`` series surfaces the silent
+  contract degradation without breaking the legal path.
+* **E1203** books the rule when ``CheckpointRefused`` fires (the
+  refusal itself predates the sanitizer and stays on).
+* **E1221** keeps a per-generation ledger of blob writes: a manifest
+  recording a blob this process never wrote, or a blob landing after
+  its generation's manifest, raises.
+* **E1222/E1223** arm the journal/rename call sites: the writers
+  declare their ordering facts (``fsynced=``) and a regressed caller
+  raises.
+
+All state is thread-local (the harness legs run scenarios in one
+thread each); ``arm()``/``disarm()`` force the mode for tests, mirroring
+the engine-switch convention.
+"""
+import threading
+
+from consensus_specs_tpu.obs import registry as obs_registry
+from consensus_specs_tpu.utils import env_flags
+
+RULES = ("E1201", "E1202", "E1203", "E1221", "E1222", "E1223")
+
+# pre-bound series (speclint O5xx hot-path rule)
+_C_CHECKS = {r: obs_registry.counter("sanitizer.checks").labels(rule=r)
+             for r in RULES}
+_C_VIOLATIONS = {
+    r: obs_registry.counter("sanitizer.violations").labels(rule=r)
+    for r in RULES}
+
+
+class EffectViolation(RuntimeError):
+    """A runtime effect-contract violation; ``rule`` names the E12xx
+    speclint rule whose static proof is the twin of this check."""
+
+    def __init__(self, rule: str, message: str):
+        super().__init__(f"{rule}: {message} [CS_TPU_SANITIZER]")
+        self.rule = rule
+
+
+# ---------------------------------------------------------------------------
+# Mode (mirrors the engine-switch convention; default OFF — the
+# sanitizer is an opt-in diagnostic arm, not an engine)
+# ---------------------------------------------------------------------------
+
+_mode = "auto"
+
+
+def arm() -> None:
+    global _mode
+    _mode = "on"
+
+
+def disarm() -> None:
+    global _mode
+    _mode = "off"
+
+
+def use_auto() -> None:
+    global _mode
+    _mode = "auto"
+
+
+def enabled() -> bool:
+    if _mode == "on":
+        return True
+    if _mode == "off":
+        return False
+    return env_flags.knob("CS_TPU_SANITIZER") == "1"
+
+
+# ---------------------------------------------------------------------------
+# Shadow effect log (thread-local)
+# ---------------------------------------------------------------------------
+
+_state = threading.local()
+
+
+def _scopes() -> dict:
+    got = getattr(_state, "scopes", None)
+    if got is None:
+        got = _state.scopes = {}
+    return got
+
+
+def _ckpt() -> dict:
+    got = getattr(_state, "ckpt", None)
+    if got is None:
+        got = _state.ckpt = {}
+    return got
+
+
+def reset() -> None:
+    """Drop the shadow log (test/harness lifecycle)."""
+    _state.scopes = {}
+    _state.ckpt = {}
+
+
+def _violation(rule: str, message: str) -> EffectViolation:
+    _C_VIOLATIONS[rule].add()
+    return EffectViolation(rule, message)
+
+
+def effect_error(rule: str, message: str) -> RuntimeError:
+    """The exception for a violated effect contract at an instrumented
+    site: an :class:`EffectViolation` naming the rule when the
+    sanitizer is armed, the layer's plain ``RuntimeError`` otherwise
+    (existing callers keep their exception surface)."""
+    if enabled():
+        return _violation(rule, message)
+    return RuntimeError(message)
+
+
+# -- commit-scope ledger (state/arrays.py) ----------------------------------
+
+def scope_opened(store) -> None:
+    if not enabled():
+        return
+    _C_CHECKS["E1201"].add()
+    _scopes()[id(store)] = set()
+
+
+def deferred_write(store, name: str) -> None:
+    if not enabled():
+        return
+    pending = _scopes().get(id(store))
+    if pending is not None:
+        pending.add(name)
+
+
+def pending_columns(store):
+    """The scope ledger's view of ``store``'s deferred columns (empty
+    when untracked) — used to enrich E1201 messages."""
+    return sorted(_scopes().get(id(store), ()))
+
+
+def scope_closed(store) -> None:
+    # pop UNCONDITIONALLY: a scope opened while armed must not leave a
+    # ledger entry behind when the sanitizer is disarmed before exit —
+    # CPython reuses object ids, so a leaked entry could book a false
+    # E1202 against an unrelated later store (the id()-staleness class
+    # speclint D1004 polices)
+    _scopes().pop(id(store), None)
+
+
+def fork_event(store, pending: bool) -> None:
+    """A store fork/copy observed.  Inside an open scope with pending
+    deferred writes this is E1202 — counted, not raised (module
+    docstring): the fork legally commits-into-child, but the
+    one-commit-per-epoch contract silently degraded."""
+    if not enabled():
+        return
+    _C_CHECKS["E1202"].add()
+    if pending and id(store) in _scopes():
+        _C_VIOLATIONS["E1202"].add()
+
+
+def checkpoint_refused() -> None:
+    """``CheckpointRefused`` fired: book the E1203 twin."""
+    if not enabled():
+        return
+    _C_VIOLATIONS["E1203"].add()
+
+
+def checkpoint_scope_check() -> None:
+    if not enabled():
+        return
+    _C_CHECKS["E1203"].add()
+
+
+# -- checkpoint write-ordering ledger (recovery/checkpoint.py) --------------
+
+def blob_written(owner: str, gen: int, name: str) -> None:
+    """``owner`` scopes the ledger to one checkpoint directory — two
+    replays reusing generation numbers must not share entries."""
+    if not enabled():
+        return
+    _C_CHECKS["E1221"].add()
+    rec = _ckpt().setdefault((owner, gen),
+                             {"blobs": set(), "manifest": False})
+    if rec["manifest"]:
+        raise _violation(
+            "E1221", f"checkpoint blob {name!r} written AFTER "
+            f"generation {gen}'s manifest — the manifest is the commit "
+            "point and must land last")
+    rec["blobs"].add(name)
+
+
+def manifest_written(owner: str, gen: int, blob_names) -> None:
+    if not enabled():
+        return
+    _C_CHECKS["E1221"].add()
+    rec = _ckpt().setdefault((owner, gen),
+                             {"blobs": set(), "manifest": False})
+    missing = set(blob_names) - rec["blobs"]
+    if missing:
+        raise _violation(
+            "E1221", f"generation {gen}'s manifest records blob(s) "
+            f"{sorted(missing)} this process never wrote — a manifest "
+            "must only ever describe blobs already durable")
+    rec["manifest"] = True
+
+
+def generation_discarded(owner: str, gen: int) -> None:
+    if not enabled():
+        return
+    _ckpt().pop((owner, gen), None)
+
+
+# -- journal ordering (recovery/journal.py) ---------------------------------
+
+def record_appended(journal) -> None:
+    if not enabled():
+        return
+    _C_CHECKS["E1222"].add()
+
+
+def step_committed(journal, fsynced: bool) -> None:
+    if not enabled():
+        return
+    _C_CHECKS["E1222"].add()
+    if not fsynced:
+        raise _violation(
+            "E1222", "STEP commit marker written without an fsync — "
+            "the durability boundary is the fsynced marker; a crash "
+            "could lose a committed step")
+
+
+# -- rename ordering (recovery/atomic.py) -----------------------------------
+
+def rename_event(path: str, fsynced: bool, exempt: bool = False) -> None:
+    """A final-path rename.  ``exempt`` marks the sanctioned
+    no-fsync variant (``atomic_replace_bytes``: higher-level fencing)."""
+    if not enabled():
+        return
+    _C_CHECKS["E1223"].add()
+    if not fsynced and not exempt:
+        raise _violation(
+            "E1223", f"final-path rename of {path!r} without a "
+            "preceding fsync — the name can become durable before the "
+            "data")
+
+
+def snapshot() -> dict:
+    """Check/violation counts per rule (test/report convenience)."""
+    checks = obs_registry.counter("sanitizer.checks")
+    violations = obs_registry.counter("sanitizer.violations")
+    return {r: {"checks": checks.value(rule=r),
+                "violations": violations.value(rule=r)} for r in RULES}

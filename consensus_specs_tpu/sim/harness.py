@@ -130,8 +130,13 @@ def env_overrides(env, reset_supervisor=True):
     overrides, and reset the supervisor AFTER they apply (so a leg's
     breaker/audit knobs are read from the leg's environment).  Restores
     the prior environment on exit (absent-before means pop)."""
+    from consensus_specs_tpu import sanitizer
     from consensus_specs_tpu.utils import bls
     bls.clear_verify_memo()
+    # drop the sanitizer's shadow effect log between legs: a leg that
+    # tears down its scenario mid-scope (injected faults, simulated
+    # crashes) must not leave ledger entries the next leg trips over
+    sanitizer.reset()
     saved = {}
     for k, v in (env or {}).items():
         saved[k] = os.environ.get(k)

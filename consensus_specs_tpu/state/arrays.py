@@ -51,7 +51,7 @@ from contextlib import contextmanager
 
 import numpy as np
 
-from consensus_specs_tpu import faults, supervisor
+from consensus_specs_tpu import faults, sanitizer, supervisor
 from consensus_specs_tpu.obs import registry as obs_registry
 from consensus_specs_tpu.obs.tracing import span
 from consensus_specs_tpu.utils import env_flags
@@ -336,10 +336,13 @@ class StateArrays:
             # registry cell is exempt because its write protocol
             # (registry_writable -> matching SSZ writes ->
             # mark_registry_committed) legitimately passes through a
-            # stale-generation window.
-            raise RuntimeError(
+            # stale-generation window.  Under CS_TPU_SANITIZER the
+            # raise names the speclint twin (E1201).
+            raise sanitizer.effect_error(
+                "E1201",
                 f"state_arrays: {name} mutated through the SSZ API "
-                f"while a deferred engine write was pending")
+                f"while a deferred engine write was pending"
+                + _pending_detail(self))
         _C_MISS.add()
         cell = _Cell(_COLUMNS[name][1](seq), seq)
         self._cells[name] = cell
@@ -408,6 +411,8 @@ class StateArrays:
         cell.data = new
         if not self._deferred:
             self.commit()
+        else:
+            sanitizer.deferred_write(self, name)
 
     # -- commit / discard ---------------------------------------------------
 
@@ -424,10 +429,14 @@ class StateArrays:
             if cell.seq_ref() is not seq or cell.gen != _gen_of(seq):
                 # the SSZ list was written directly while an engine
                 # column write was pending — committing would clobber
-                # one of the two.  No wired path does this; fail loud.
-                raise RuntimeError(
+                # one of the two.  No wired path does this; fail loud
+                # (naming the speclint twin E1201 when the sanitizer
+                # is armed).
+                raise sanitizer.effect_error(
+                    "E1201",
                     f"state_arrays: {name} mutated through the SSZ API "
-                    f"while a deferred engine write was pending")
+                    f"while a deferred engine write was pending"
+                    + _pending_detail(self))
             if not wrote:
                 _C_COMMITS.add()
                 wrote = True
@@ -474,6 +483,19 @@ class StateArrays:
                     supervisor.note_success(site)
                 cell.base = cell.data
                 cell.gen = _gen_of(seq)
+
+    def commit_for_copy(self) -> None:
+        """``Container.copy``'s pre-snapshot commit: exactly
+        :meth:`commit`, plus the sanitizer's E1202 shadow check — a
+        copy/fork with pending deferred writes inside an open commit
+        scope is a LEGAL early commit (the child must see the flushed
+        columns), but the one-commit-per-epoch contract silently
+        degraded, so the armed sanitizer counts it."""
+        if sanitizer.enabled():
+            sanitizer.fork_event(self, self._deferred and any(
+                c is not None and c.data is not c.base
+                for c in (self._cells.get(n) for n in _DEFERRABLE)))
+        self.commit()
 
     def discard_pending(self) -> None:
         """Drop uncommitted engine writes (the enclosing transition
@@ -531,6 +553,15 @@ class StateArrays:
 # Module-level surface
 # ---------------------------------------------------------------------------
 
+def _pending_detail(store) -> str:
+    """The armed sanitizer's scope-ledger view of which deferred
+    columns an E1201 violation would clobber — empty when disarmed or
+    untracked."""
+    pending = sanitizer.pending_columns(store)
+    return f" (would clobber deferred: {', '.join(pending)})" \
+        if pending else ""
+
+
 def of(state) -> StateArrays:
     """The state's attached store (created on first use).  With the
     engine disabled every call returns a detached single-use store:
@@ -577,14 +608,17 @@ def commit_scope(state):
         yield
         return
     store._deferred = True
+    sanitizer.scope_opened(store)
     try:
         yield
     except BaseException:
         store._deferred = False
         store.discard_pending()
+        sanitizer.scope_closed(store)
         raise
     store._deferred = False
     store.commit()
+    sanitizer.scope_closed(store)
 
 
 def fork_state(state):

@@ -102,6 +102,19 @@ def main(argv=None) -> int:
                      else "all breakers closed"))
         else:
             print("\nsupervisor: disabled (CS_TPU_SUPERVISOR=0)")
+        # runtime effect sanitizer (docs/static-analysis.md): armed
+        # replays report the contract census; the shipping default is
+        # disarmed and costs one mode check per hook
+        from consensus_specs_tpu import sanitizer
+        if sanitizer.enabled():
+            snap = sanitizer.snapshot()
+            checks = sum(v["checks"] for v in snap.values())
+            bad = {r: v["violations"] for r, v in snap.items()
+                   if v["violations"]}
+            print(f"sanitizer: armed, {checks} contract check(s), "
+                  + (f"VIOLATIONS: {bad}" if bad else "0 violations"))
+        else:
+            print("sanitizer: disarmed (CS_TPU_SANITIZER unset)")
     return 0
 
 

@@ -108,6 +108,25 @@ class Context:
             self._input_shas = [(r, self.sha(r)) for r in rels]
         return self._input_shas
 
+    def input_shas_for(self, mod):
+        """The (rel, sha) input set of ONE tree pass.  A pass that
+        declares ``INPUT_PREFIXES`` (optionally ``INPUT_EXCLUDE`` /
+        ``INPUT_EXTRA``) is fingerprinted over exactly the files it can
+        reach — editing a test or a benchmark no longer invalidates the
+        ladder/determinism/effects results, only the passes that
+        actually read the edited file.  Passes without the declaration
+        keep the conservative whole-tree fingerprint."""
+        prefixes = getattr(mod, "INPUT_PREFIXES", None)
+        if prefixes is None:
+            return self.input_shas()
+        exclude = tuple(getattr(mod, "INPUT_EXCLUDE", ()))
+        rels = [r for r in list(self.py_files) + list(self.md_files)
+                if r.startswith(tuple(prefixes))
+                and not (exclude and r.startswith(exclude))]
+        rels += [r for r in getattr(mod, "INPUT_EXTRA", ())
+                 if os.path.isfile(os.path.join(self.root, r))]
+        return [(r, self.sha(r)) for r in rels]
+
     def _parse(self, rel):
         if rel not in self._trees:
             try:
@@ -144,7 +163,12 @@ def _file_candidates(ctx, mod):
     files = ctx.md_files if getattr(mod, "SCAN", "py") == "md" \
         else ctx.py_files
     scope = getattr(mod, "in_scope", None)
-    return files if scope is None else [r for r in files if scope(r)]
+    if scope is not None:
+        files = [r for r in files if scope(r)]
+    changed = getattr(ctx, "changed_only", None)
+    if changed is not None:
+        files = [r for r in files if r in changed]
+    return files
 
 
 def _run_one(ctx, mod, cache):
@@ -163,7 +187,8 @@ def _run_one(ctx, mod, cache):
             findings.extend(got)
         return findings
     fingerprint = tree_fingerprint(
-        ctx.input_shas(), extra=(mod.NAME, getattr(mod, "VERSION", 1)))
+        ctx.input_shas_for(mod),
+        extra=(mod.NAME, getattr(mod, "VERSION", 1)))
     got = cache.get_tree(mod.NAME, fingerprint)
     if got is None:
         got = mod.run(ctx)
@@ -256,6 +281,46 @@ def _range_verdicts(ctx):
     return 0
 
 
+def _effect_verdicts(ctx):
+    """Print the E12xx positive proofs (commit-scope discipline, psum
+    census, happens-before orderings); nonzero exit on any FAIL line so
+    a CI step can gate on the proofs directly."""
+    from .passes import effects as effects_pass
+    failed = False
+    for line in effects_pass.verdict_report(ctx):
+        print(line)
+        if "[FAIL]" in line:
+            failed = True
+    return 1 if failed else 0
+
+
+def _git_changed(root):
+    """Repo-relative paths dirty vs the git index (staged, unstaged and
+    untracked), or None when git is unavailable."""
+    import subprocess
+    try:
+        # --untracked-files=all: a brand-new directory must list every
+        # file inside it, not one collapsed "?? dir/" entry the path
+        # filter would never match
+        proc = subprocess.run(
+            ["git", "-C", root, "status", "--porcelain",
+             "--untracked-files=all"],
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if proc.returncode != 0:
+        return None
+    changed = set()
+    for line in proc.stdout.splitlines():
+        if len(line) <= 3:
+            continue
+        path = line[3:]
+        if " -> " in path:      # renames report "old -> new"
+            path = path.split(" -> ")[-1]
+        changed.add(path.strip().strip('"'))
+    return changed
+
+
 def _fix(ctx):
     from . import fixer
     changed = fixer.fix_tree(ctx)
@@ -292,6 +357,15 @@ def main(argv=None):
     parser.add_argument("--range-verdicts", action="store_true",
                         help="print the uint64 range prover's "
                              "per-subtraction verdicts and exit")
+    parser.add_argument("--effect-verdicts", action="store_true",
+                        help="print the E12xx effect proofs (commit-"
+                             "scope discipline, psum census, write "
+                             "orderings) and exit")
+    parser.add_argument("--changed", action="store_true",
+                        help="lint only files dirty vs the git index "
+                             "(the pre-commit developer loop); tree "
+                             "passes stay warm through the dependency-"
+                             "granular cache")
     args = parser.parse_args(argv)
 
     ctx = Context(args.root)
@@ -305,6 +379,16 @@ def main(argv=None):
         return _fix(ctx)
     if args.range_verdicts:
         return _range_verdicts(ctx)
+    if args.effect_verdicts:
+        return _effect_verdicts(ctx)
+    if args.changed:
+        changed = _git_changed(ctx.root)
+        if changed is None:
+            print("speclint --changed: git unavailable or not a work "
+                  "tree — linting everything")
+        else:
+            ctx.changed_only = changed
+            print(f"speclint --changed: {len(changed)} dirty path(s)")
     pass_names = None if args.passes is None \
         else {p.strip() for p in args.passes.split(",") if p.strip()}
     if pass_names is not None:
@@ -341,9 +425,12 @@ def main(argv=None):
         return 1 if new else 0
     for f in new:
         print(f.render_github() if args.format == "github" else f.render())
-    for key in stale:
-        print(f"note: baseline is stale for {key} "
-              f"(debt shrank; run `make speclint-baseline`)")
+    if not args.changed:
+        # a --changed run legitimately produces no findings for
+        # unchanged files: their baseline keys are not stale
+        for key in stale:
+            print(f"note: baseline is stale for {key} "
+                  f"(debt shrank; run `make speclint-baseline`)")
     if analysis_cache is not None:
         print(f"speclint: {analysis_cache.summary()}")
     if new:

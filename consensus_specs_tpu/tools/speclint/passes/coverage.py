@@ -48,6 +48,12 @@ NAME = "coverage"
 CODE_PREFIXES = ("C",)
 VERSION = 2
 GRANULARITY = "tree"
+# dependency-granular cache inputs: the contract legs read the
+# package sources, the test tree (C1105 references), the workflow
+# and the Makefile (C1106 off-legs) — nothing else
+INPUT_PREFIXES = ("consensus_specs_tpu/", "tests/")
+INPUT_EXCLUDE = ("consensus_specs_tpu/tools/",)
+INPUT_EXTRA = (".github/workflows/run-tests.yml", "Makefile")
 
 FAULTS_REL = "consensus_specs_tpu/faults.py"
 WORKFLOW_REL = ".github/workflows/run-tests.yml"

@@ -69,6 +69,11 @@ NAME = "determinism"
 CODE_PREFIXES = ("D",)
 VERSION = 2
 GRANULARITY = "tree"
+# dependency-granular cache inputs: reachability runs over the
+# project graph (tools/ excluded) — edits outside the package leave
+# the cached result warm
+INPUT_PREFIXES = ("consensus_specs_tpu/",)
+INPUT_EXCLUDE = ("consensus_specs_tpu/tools/",)
 
 # findings are reported only here: the packages whose functions produce
 # consensus-visible results

@@ -20,9 +20,15 @@ crashed on.
 
 Scope (the persistence surfaces whose files are read back and
 trusted): ``consensus_specs_tpu/recovery/``, ``consensus_specs_tpu/
-sim/repro.py``, ``consensus_specs_tpu/gen/``.  Intentional
-exceptions carry ``# noqa: R901`` with the reason the torn window is
-acceptable.  Baseline: zero findings.
+sim/repro.py``, ``consensus_specs_tpu/gen/``, and — since the E12xx
+effect work surfaced torn writes there — ``consensus_specs_tpu/
+compiler/``: the compiled ladder and the regenerated spec markdown are
+read back and trusted by every later ``make lint`` / ``--compiled``
+run, and ``make pyspec`` is only re-run when the compiled DIRECTORY is
+missing, so a module torn at a statement boundary would be imported
+as-is (still valid python, silently inheriting the previous fork's
+bodies).  Intentional exceptions carry ``# noqa: R901`` with the
+reason the torn window is acceptable.  Baseline: zero findings.
 """
 import ast
 
@@ -30,13 +36,14 @@ from ..findings import Finding
 
 NAME = "durability"
 CODE_PREFIXES = ("R9",)
-VERSION = 2
+VERSION = 3
 GRANULARITY = "file"
 
 SCOPES = (
     "consensus_specs_tpu/recovery/",
     "consensus_specs_tpu/sim/repro.py",
     "consensus_specs_tpu/gen/",
+    "consensus_specs_tpu/compiler/",
 )
 
 _WRITE_MODES = {"w", "wb", "a", "ab", "x", "xb", "w+", "wb+",

@@ -33,6 +33,11 @@ NAME = "ladder"
 CODE_PREFIXES = ("L",)
 VERSION = 2
 GRANULARITY = "tree"
+# dependency-granular cache inputs: the ladder compares hand and
+# compiled class surfaces over the project graph (tools/ excluded) —
+# edits outside the package leave the cached result warm
+INPUT_PREFIXES = ("consensus_specs_tpu/",)
+INPUT_EXCLUDE = ("consensus_specs_tpu/tools/",)
 
 FORKS_REL = "consensus_specs_tpu/forks"
 COMPILED_REL = "consensus_specs_tpu/forks/compiled"

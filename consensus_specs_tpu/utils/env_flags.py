@@ -176,6 +176,18 @@ MESH = os.environ.get("CS_TPU_MESH") != "0"
 # through :func:`knob` by the sim recovery legs; docs/recovery.md.
 CHECKPOINT = os.environ.get("CS_TPU_CHECKPOINT") != "0"
 
+# Runtime effect sanitizer: ``CS_TPU_SANITIZER=1`` arms the dynamic
+# twin of the speclint E12xx effect contracts
+# (``consensus_specs_tpu/sanitizer.py``): the state store and the
+# recovery writers feed a shadow effect log, and a violated contract
+# (direct SSZ write under a pending deferred column, a checkpoint blob
+# after its manifest, an unfsynced STEP marker or final-path rename)
+# raises ``EffectViolation`` naming the E12xx rule.  Default OFF — a
+# diagnostic arm, not an engine; read live through :func:`knob`
+# (``sanitizer.enabled``).  Disabled overhead is bench-asserted <2%
+# (``benchmarks/bench_sanitizer.py``).
+SANITIZER = os.environ.get("CS_TPU_SANITIZER") == "1"
+
 # Engine supervisor kill switch: ``CS_TPU_SUPERVISOR=0`` turns the
 # health-tracking supervision layer (``consensus_specs_tpu/supervisor``)
 # into a pass-through — no circuit breakers, no deadline guards, no

@@ -1029,7 +1029,10 @@ class Container(SSZValue, metaclass=_ContainerMeta):
         # one dict lookup.
         store = self.__dict__.get("_state_arrays")
         if store is not None:
-            store.commit()
+            # commit_for_copy == commit plus the sanitizer's E1202
+            # shadow check (a copy with pending deferred writes inside
+            # an open commit scope is a counted early commit)
+            store.commit_for_copy()
         new = object.__new__(type(self))
         for f in type(self)._fields:
             fv = getattr(self, f).copy()

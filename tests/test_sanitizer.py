@@ -1,0 +1,365 @@
+"""CS_TPU_SANITIZER runtime effect sanitizer (docs/static-analysis.md).
+
+The acceptance bar: every effect contract has a static proof (speclint
+E12xx) AND a runtime enforcement twin — and a seeded violation is
+caught by BOTH.  This suite drives the runtime half end-to-end against
+real states/checkpoints and pins the twin property explicitly.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from consensus_specs_tpu import sanitizer
+from consensus_specs_tpu.forks import build_spec
+from consensus_specs_tpu.state import arrays
+from consensus_specs_tpu.test_infra.genesis import create_genesis_state
+from consensus_specs_tpu.utils import bls
+
+N = 16
+
+
+@pytest.fixture(autouse=True)
+def _lifecycle():
+    prev_bls = bls.bls_active
+    bls.bls_active = False
+    sanitizer.reset()
+    yield
+    bls.bls_active = prev_bls
+    sanitizer.use_auto()
+    arrays.use_auto()
+    sanitizer.reset()
+
+
+def _spec(fork="phase0"):
+    return build_spec(fork, "minimal")
+
+
+def _genesis(spec):
+    return create_genesis_state(
+        spec, [spec.MAX_EFFECTIVE_BALANCE] * N, spec.MAX_EFFECTIVE_BALANCE)
+
+
+def _snap():
+    return sanitizer.snapshot()
+
+
+# ---------------------------------------------------------------------------
+# mode / plumbing
+# ---------------------------------------------------------------------------
+
+def test_disabled_by_default_and_knob_armed(monkeypatch):
+    assert not sanitizer.enabled()
+    monkeypatch.setenv("CS_TPU_SANITIZER", "1")
+    assert sanitizer.enabled()
+    sanitizer.disarm()
+    assert not sanitizer.enabled()
+
+
+def test_effect_error_surface_matches_mode():
+    sanitizer.disarm()
+    err = sanitizer.effect_error("E1201", "boom")
+    assert type(err) is RuntimeError
+    sanitizer.arm()
+    err = sanitizer.effect_error("E1201", "boom")
+    assert isinstance(err, sanitizer.EffectViolation)
+    assert err.rule == "E1201" and "E1201" in str(err)
+    # EffectViolation stays a RuntimeError: existing except clauses in
+    # callers keep working when the sanitizer is armed
+    assert isinstance(err, RuntimeError)
+
+
+# ---------------------------------------------------------------------------
+# E1201: direct SSZ write under a pending deferred column
+# ---------------------------------------------------------------------------
+
+def _seed_e1201(spec, state):
+    sa = arrays.of(state)
+    with arrays.commit_scope(state):
+        bal = sa.balances().copy()
+        bal[0] += np.uint64(1)
+        sa.set_balances(bal)                      # deferred engine write
+        state.balances[1] = int(state.balances[1]) + 2   # direct SSZ write
+
+
+def test_e1201_runtime_violation_names_rule():
+    spec = _spec()
+    state = _genesis(spec)
+    arrays.use_arrays()
+    sanitizer.arm()
+    before = _snap()
+    with pytest.raises(sanitizer.EffectViolation) as exc:
+        _seed_e1201(spec, state)
+    assert exc.value.rule == "E1201"
+    after = _snap()
+    assert after["E1201"]["violations"] \
+        == before["E1201"]["violations"] + 1
+    assert after["E1201"]["checks"] > before["E1201"]["checks"]
+
+
+def test_e1201_disarmed_keeps_plain_runtime_error():
+    spec = _spec()
+    state = _genesis(spec)
+    arrays.use_arrays()
+    sanitizer.disarm()
+    with pytest.raises(RuntimeError) as exc:
+        _seed_e1201(spec, state)
+    assert not isinstance(exc.value, sanitizer.EffectViolation)
+
+
+def test_e1201_twin_caught_statically_and_at_runtime(tmp_path):
+    """THE twin acceptance criterion: ONE seeded contract violation —
+    a direct SSZ balances write while a deferred column write is
+    pending in an open commit scope — is caught by the static pass on
+    a fixture AND by the armed sanitizer at runtime."""
+    # static half: the speclint effects pass flags the same class
+    from consensus_specs_tpu.tools.speclint.passes import (
+        effects as effects_pass)
+    root = tmp_path / "repo"
+    src = (
+        "from consensus_specs_tpu.state import arrays as state_arrays\n"
+        "class DemoSpec:\n"
+        "    def process_slots(self, state):\n"
+        "        with state_arrays.commit_scope(state):\n"
+        "            self.process_epoch(state)\n"
+        "    def process_epoch(self, state):\n"
+        "        state.balances[1] += 2\n")
+    path = root / "consensus_specs_tpu" / "forks" / "demo.py"
+    os.makedirs(path.parent)
+    path.write_text(src)
+    (root / "consensus_specs_tpu" / "state").mkdir()
+    (root / "consensus_specs_tpu" / "state" / "arrays.py").write_text(
+        "def commit_scope(state):\n    pass\n"
+        "def flush(state):\n    pass\n")
+    static = effects_pass.check_tree(str(root))
+    assert [f.code for f in static] == ["E1201"]
+    # runtime half: the sanitizer catches the same violation live
+    spec = _spec()
+    state = _genesis(spec)
+    arrays.use_arrays()
+    sanitizer.arm()
+    with pytest.raises(sanitizer.EffectViolation) as exc:
+        _seed_e1201(spec, state)
+    assert exc.value.rule == "E1201" == static[0].code
+
+
+# ---------------------------------------------------------------------------
+# E1202: fork inside an open scope (counted, not raised)
+# ---------------------------------------------------------------------------
+
+def test_e1202_fork_during_scope_counted_not_raised():
+    spec = _spec()
+    state = _genesis(spec)
+    arrays.use_arrays()
+    sanitizer.arm()
+    sa = arrays.of(state)
+    before = _snap()
+    with arrays.commit_scope(state):
+        bal = sa.balances().copy()
+        bal[0] += np.uint64(3)
+        sa.set_balances(bal)
+        child = arrays.fork_state(state)     # legal early commit
+    after = _snap()
+    assert after["E1202"]["violations"] \
+        == before["E1202"]["violations"] + 1
+    # and the fork really committed-into-child (behavior unchanged)
+    assert int(child.balances[0]) == int(state.balances[0])
+
+
+def test_e1202_clean_fork_outside_scope_books_no_violation():
+    spec = _spec()
+    state = _genesis(spec)
+    arrays.use_arrays()
+    sanitizer.arm()
+    before = _snap()
+    arrays.fork_state(state)
+    after = _snap()
+    assert after["E1202"]["violations"] == before["E1202"]["violations"]
+
+
+# ---------------------------------------------------------------------------
+# E1203: checkpoint refused under an open scope
+# ---------------------------------------------------------------------------
+
+def test_e1203_checkpoint_refusal_booked():
+    from types import SimpleNamespace
+    from consensus_specs_tpu.recovery import checkpoint
+    spec = _spec()
+    state = _genesis(spec)
+    arrays.use_arrays()
+    sanitizer.arm()
+    store = SimpleNamespace(block_states={b"r": state},
+                            checkpoint_states={})
+    before = _snap()
+    sa = arrays.of(state)
+    sa._deferred = True
+    try:
+        with pytest.raises(checkpoint.CheckpointRefused) as exc:
+            checkpoint._refuse_open_scopes(store)
+    finally:
+        sa._deferred = False
+    assert "E1203" in str(exc.value)
+    after = _snap()
+    assert after["E1203"]["violations"] \
+        == before["E1203"]["violations"] + 1
+    assert after["E1203"]["checks"] > before["E1203"]["checks"]
+
+
+# ---------------------------------------------------------------------------
+# E1221: checkpoint blob/manifest ordering ledger
+# ---------------------------------------------------------------------------
+
+def test_e1221_ledger_orders_blobs_before_manifest():
+    sanitizer.arm()
+    sanitizer.blob_written("/d1", 1, "a.bin")
+    sanitizer.blob_written("/d1", 1, "b.bin")
+    sanitizer.manifest_written("/d1", 1, ["a.bin", "b.bin"])
+    with pytest.raises(sanitizer.EffectViolation) as exc:
+        sanitizer.blob_written("/d1", 1, "late.bin")
+    assert exc.value.rule == "E1221"
+
+
+def test_e1221_manifest_recording_unwritten_blob_raises():
+    sanitizer.arm()
+    sanitizer.blob_written("/d2", 1, "a.bin")
+    with pytest.raises(sanitizer.EffectViolation):
+        sanitizer.manifest_written("/d2", 1, ["a.bin", "ghost.bin"])
+
+
+def test_e1221_ledger_scoped_by_directory_and_discard():
+    sanitizer.arm()
+    sanitizer.blob_written("/d3", 1, "a.bin")
+    sanitizer.manifest_written("/d3", 1, ["a.bin"])
+    # a DIFFERENT directory reusing generation numbers is independent
+    sanitizer.blob_written("/d4", 1, "a.bin")
+    sanitizer.manifest_written("/d4", 1, ["a.bin"])
+    # a discarded generation resets its ledger entry
+    sanitizer.generation_discarded("/d3", 1)
+    sanitizer.blob_written("/d3", 1, "a.bin")     # no raise
+
+
+def test_e1221_real_checkpoint_save_is_clean(tmp_path):
+    from consensus_specs_tpu.recovery.checkpoint import CheckpointStore
+    from consensus_specs_tpu.sim.driver import ChainSim
+    spec = _spec()
+    sanitizer.arm()
+    sim = ChainSim(spec, N)
+    cs = CheckpointStore(str(tmp_path / "ckpt"))
+    before = _snap()
+    gen = cs.save(spec, sim, 0, fork="phase0", preset="minimal")
+    assert gen == 1
+    after = _snap()
+    assert after["E1221"]["checks"] > before["E1221"]["checks"]
+    assert after["E1221"]["violations"] == before["E1221"]["violations"]
+
+
+# ---------------------------------------------------------------------------
+# E1222 / E1223: journal + rename ordering facts
+# ---------------------------------------------------------------------------
+
+def test_e1222_unfsynced_step_marker_raises():
+    sanitizer.arm()
+    with pytest.raises(sanitizer.EffectViolation) as exc:
+        sanitizer.step_committed(None, fsynced=False)
+    assert exc.value.rule == "E1222"
+
+
+def test_e1222_real_journal_commit_is_clean(tmp_path):
+    from consensus_specs_tpu.recovery.journal import Journal, BLOCK
+    sanitizer.arm()
+    before = _snap()
+    j = Journal(str(tmp_path / "wal.log"), fresh=True)
+    j.append(BLOCK, b"payload")
+    j.commit_step(0, {"op": "noop"})
+    j.close()
+    after = _snap()
+    assert after["E1222"]["checks"] >= before["E1222"]["checks"] + 2
+    assert after["E1222"]["violations"] == before["E1222"]["violations"]
+
+
+def test_e1223_unfsynced_rename_raises_exempt_passes(tmp_path):
+    from consensus_specs_tpu.recovery.atomic import (
+        atomic_replace_bytes, atomic_write_bytes)
+    sanitizer.arm()
+    with pytest.raises(sanitizer.EffectViolation) as exc:
+        sanitizer.rename_event("/tmp/x", fsynced=False)
+    assert exc.value.rule == "E1223"
+    # the real helpers: full-fsync and the sanctioned exempt variant
+    before = _snap()
+    atomic_write_bytes(str(tmp_path / "a"), b"1")
+    atomic_replace_bytes(str(tmp_path / "b"), b"2")
+    after = _snap()
+    assert after["E1223"]["checks"] == before["E1223"]["checks"] + 2
+    assert after["E1223"]["violations"] == before["E1223"]["violations"]
+
+
+# ---------------------------------------------------------------------------
+# integration: armed epoch transitions are observation-only
+# ---------------------------------------------------------------------------
+
+def test_armed_epoch_transition_byte_identical():
+    from consensus_specs_tpu.test_infra.block import next_epoch
+    from consensus_specs_tpu.utils.ssz import hash_tree_root
+    spec = _spec("altair")
+    arrays.use_arrays()
+    state_a = _genesis(spec)
+    state_b = _genesis(spec)
+    sanitizer.disarm()
+    next_epoch(spec, state_a)
+    sanitizer.arm()
+    before = _snap()
+    next_epoch(spec, state_b)
+    after = _snap()
+    assert bytes(hash_tree_root(state_a)) == bytes(hash_tree_root(state_b))
+    assert after["E1201"]["checks"] > before["E1201"]["checks"]
+    assert sum(v["violations"] for v in after.values()) \
+        == sum(v["violations"] for v in before.values())
+
+
+def test_e1221_generation_reuse_after_external_damage(tmp_path):
+    """Sweep-found regression: the corruption legs delete a
+    generation's manifest on disk, so the next save derives the SAME
+    generation number from disk state — the ledger entry for it is
+    stale and must restart with the new write, not false-positive."""
+    from consensus_specs_tpu.recovery.checkpoint import CheckpointStore
+    from consensus_specs_tpu.sim.driver import ChainSim
+    spec = _spec()
+    sanitizer.arm()
+    sim = ChainSim(spec, N)
+    cs = CheckpointStore(str(tmp_path / "ckpt"))
+    gen = cs.save(spec, sim, 0, fork="phase0", preset="minimal")
+    assert gen == 1
+    os.unlink(cs.manifest_path(gen))      # external damage
+    again = cs.save(spec, sim, 1, fork="phase0", preset="minimal")
+    assert again == gen                   # same number, no EffectViolation
+
+
+def test_scope_ledger_never_leaks_across_disarm():
+    """Review regression: a scope opened while armed must not leave an
+    id()-keyed ledger entry when the sanitizer is disarmed before the
+    scope exits — CPython reuses ids, so a leaked entry could book a
+    false E1202 against an unrelated later store."""
+    spec = _spec()
+    state = _genesis(spec)
+    arrays.use_arrays()
+    sanitizer.arm()
+    sa = arrays.of(state)
+    with arrays.commit_scope(state):
+        assert id(sa) in sanitizer._scopes()
+        sanitizer.disarm()
+    assert id(sa) not in sanitizer._scopes()
+
+
+def test_e1201_message_names_clobbered_columns():
+    """The scope ledger enriches the armed E1201 message with the
+    deferred columns the direct write would clobber."""
+    spec = _spec()
+    state = _genesis(spec)
+    arrays.use_arrays()
+    sanitizer.arm()
+    with pytest.raises(sanitizer.EffectViolation) as exc:
+        _seed_e1201(spec, state)
+    assert "would clobber deferred: balances" in str(exc.value)

@@ -196,3 +196,49 @@ def test_provenance_guard_fires_on_missing_symbol():
     del manifest["eip7002"]["process_execution_layer_exit"]
     with pytest.raises(RuntimeError, match="eip7002"):
         verify_provenance(manifest)
+
+
+def test_module_write_is_rename_atomic(tmp_path, monkeypatch):
+    """E12xx-era regression: the emitter used to bare-write compiled
+    modules to their FINAL path — a crash mid-``make pyspec`` left a
+    torn module that ``make lint``'s ``test -d compiled`` guard never
+    rebuilt, and a module truncated at a statement boundary is still
+    valid python (silently inheriting the previous fork's bodies).
+    The write must be rename-atomic: a failed write leaves the OLD
+    content intact and no stray temp file the next reader trusts."""
+    import pytest
+    from consensus_specs_tpu.compiler.emit import _write_module
+    out = tmp_path / "mod.py"
+    out.write_text("OLD = 1\n")
+    _write_module(str(out), "NEW = 2\n")
+    assert out.read_text() == "NEW = 2\n"         # the happy path lands
+    real_replace = os.replace
+
+    def crash(src, dst):
+        raise OSError("simulated crash at rename")
+
+    monkeypatch.setattr(os, "replace", crash)
+    with pytest.raises(OSError):
+        _write_module(str(out), "TORN = 3\n")
+    monkeypatch.setattr(os, "replace", real_replace)
+    assert out.read_text() == "NEW = 2\n"         # never torn
+    assert [p.name for p in tmp_path.iterdir()
+            if p.name.endswith(".tmp")] == []     # temp cleaned up
+
+
+def test_spec_doc_write_is_rename_atomic(tmp_path, monkeypatch):
+    """Same discipline for the regenerated markdown (the compiler's
+    SOURCE of truth): a crash mid-``mdgen`` must leave the old doc."""
+    import pytest
+    from consensus_specs_tpu.compiler.mdgen import _write_doc
+    out = tmp_path / "specs" / "demo.md"
+    out.parent.mkdir()
+    out.write_text("# old\n")
+
+    def crash(src, dst):
+        raise OSError("simulated crash at rename")
+
+    monkeypatch.setattr(os, "replace", crash)
+    with pytest.raises(OSError):
+        _write_doc(str(out), "# new\n")
+    assert out.read_text() == "# old\n"
