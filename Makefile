@@ -11,7 +11,7 @@ GENERATORS = operations sanity finality rewards random forks epoch_processing \
         bench-state-smoke bench-supervisor-smoke bench-das-smoke \
         bench-mesh-smoke bench-recovery-smoke bench-sanitizer-smoke \
         sim-smoke sim-heavy \
-        obs-report dryrun warm native lint lint-changed \
+        obs-report dryrun warm native lint lint-changed lint-verdicts \
         speclint-baseline \
         generate_tests $(addprefix gen_,$(GENERATORS)) clean-vectors pyspec
 
@@ -67,6 +67,14 @@ lint:
 # granular cache unless a file they actually read changed
 lint-changed:
 	$(PYTHON) -m consensus_specs_tpu.tools.speclint . --changed
+
+# the two CI proof gates on their own (both baseline-zero): the E12xx
+# commit-scope/psum/write-ordering verdicts and the N13xx per-dispatch-
+# path host-work budget (every mesh path proven O(S) host work —
+# docs/static-analysis.md, docs/sharding.md)
+lint-verdicts:
+	$(PYTHON) -m consensus_specs_tpu.tools.speclint . --effect-verdicts
+	$(PYTHON) -m consensus_specs_tpu.tools.speclint . --cost-verdicts
 
 # intentionally re-record the speclint debt (after paying some down, or
 # with a written justification for new findings in the PR).

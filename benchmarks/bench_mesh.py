@@ -70,8 +70,14 @@ def _psum_census(mesh):
         "masked_sums": count(
             mesh_epoch._p_masked_sums(mesh),
             u64(), np.zeros((4, n), dtype=bool)),
+        "active_sums": count(
+            mesh_epoch._p_active_sums(mesh, 0),
+            u64(), u64(), u64(), scal8),
+        "shard_stats": count(
+            mesh_epoch._p_shard_stats(mesh, 3),
+            u64(), u64(), u64()),
         "registry_scan": count(
-            mesh_epoch._p_registry_scan(mesh, (2**64 - 1, 32, 16)),
+            mesh_epoch._p_registry_scan(mesh, (2**64 - 1, 32, 16, 256)),
             u64(), u64(), u64(), u64(), scal8),
         "altair_deltas": count(
             mesh_epoch._p_altair_deltas(
@@ -90,9 +96,10 @@ def _psum_census(mesh):
     }
     assert census["altair_sums"] == 1, census
     assert census["masked_sums"] == 1, census
+    assert census["active_sums"] == 1, census
     assert census["registry_scan"] == 1, census
     for name in ("altair_deltas", "inactivity", "slashings",
-                 "eff_balance"):
+                 "eff_balance", "shard_stats"):
         assert census[name] == 0, \
             f"elementwise program {name} grew a collective: {census}"
     return census
@@ -199,6 +206,7 @@ def main():
     mesh_subs = delta["mesh.epoch{path=mesh}"]
     psums = {sub: delta[f"mesh.psums{{site={sub}}}"]
              for sub in mesh_epoch.PSUM_BUDGET}
+    host_partials = delta["mesh.host_partials"]
 
     # -- 3: per-shard kernel scaling census at 1M --------------------------
     n_full = args.census_validators
@@ -244,6 +252,7 @@ def main():
         "census_validators": n_full,
         "psum_census": census,
         "epoch_psums": psums,
+        "host_partial_elements": host_partials,
         "mesh_subtransitions": mesh_subs,
         "mesh_replay_s": round(mesh_replay_s, 3),
         "shard_kernel_full_s": round(t_full, 4),
@@ -267,6 +276,14 @@ def main():
         f"psum count off budget: {psums} != {mesh_epoch.PSUM_BUDGET}"
     assert delta["mesh.epoch.fallbacks{reason=guard}"] == 0, \
         "unexpected mesh guard fallback"
+    # host-work census: the runtime twin of the speclint N13xx proof —
+    # across the whole mesh epoch the host read only per-shard partial
+    # stacks (10S elements for the altair composition: 3S rewards
+    # maxima + S inactivity + 3S registry candidate counts + S
+    # slashings + 2S effective-balance), never an O(n) column
+    assert 0 < host_partials <= 16 * n_dev, \
+        f"host partial reads off budget: {host_partials} elements " \
+        f"for {n_dev} shards (expected ~10S, hard cap 16S)"
     assert scaling >= args.min_scaling, \
         f"per-shard kernel scaling {scaling:.2f}x < " \
         f"{args.min_scaling}x at {n_dev} shards"

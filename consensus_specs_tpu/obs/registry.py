@@ -32,6 +32,7 @@ series **in place** so module-held bound series keep working.
 import threading
 
 _lock = threading.Lock()
+# speclint: cost: bounded: one entry per metric NAME (static set)
 _metrics = {}           # name -> Counter | Gauge | Histogram
 
 

@@ -700,7 +700,8 @@ def _registry_updates(spec, state) -> None:
     supervisor.deadline_check()
     # eligibility scans (the half the mesh engine runs shard-local on
     # the device mesh — parallel/mesh_epoch._p_registry_scan computes
-    # these same four facts and hands them to _registry_apply below)
+    # these same facts as compact per-shard candidate index buffers and
+    # funnels them into the shared _registry_apply_idx body below)
     queue_mask = (aee == np.uint64(far_future)) \
         & (cols["eff"] == np.uint64(max_eb))
     cur = np.uint64(current_epoch)
@@ -723,12 +724,30 @@ def _registry_updates(spec, state) -> None:
 
 def _registry_apply(spec, state, sa, cols, queue_mask, eject_mask,
                     eligible_mask, active_count) -> None:
+    """Mask-shaped entry into :func:`_registry_apply_idx` for the
+    single-device engine: reduce the full-column eligibility masks to
+    their (ascending) candidate index sets and resolve through the
+    shared churn-ordered body."""
+    _registry_apply_idx(spec, state, sa, cols,
+                        np.nonzero(queue_mask)[0],
+                        np.nonzero(eject_mask)[0],
+                        np.nonzero(eligible_mask)[0],
+                        active_count)
+
+
+def _registry_apply_idx(spec, state, sa, cols, queue_idx, eject_idx,
+                        eligible_idx, active_count) -> None:
     """Churn-ordered resolution of the registry scans: activation-queue
     stamps, the per-ejection exit-queue recurrence, and the
     (activation_eligibility_epoch, index)-sorted dequeue — shared by the
-    single-device engine and the mesh engine (whose shard-local scans
-    gather their small candidate index sets here), so cross-shard
-    ordering is byte-identical to the spec loop by construction."""
+    single-device engine (via the :func:`_registry_apply` mask wrapper)
+    and the mesh engine (whose shard-local scans hand their bounded,
+    ascending candidate index sets straight here), so cross-shard
+    ordering is byte-identical to the spec loop by construction.
+    Candidate sets are bounded (registry churn, not registry size), so
+    this body touches O(candidates) lanes — the two full-column ejection
+    scans below are the documented exception, spec-required exact
+    queue-state reads at the commit boundary."""
     validators = sequence_items(state.validators)
     current_epoch = int(spec.get_current_epoch(state))
     far_future = int(spec.FAR_FUTURE_EPOCH)
@@ -746,31 +765,36 @@ def _registry_apply(spec, state, sa, cols, queue_mask, eject_mask,
 
     # activation-queue eligibility stamps (is_eligible_for_activation_queue)
     stamp = current_epoch + 1
-    if queue_mask.any():
+    if queue_idx.size:
         # copy-on-write BEFORE the paired SSZ writes: the generation
         # bump would otherwise read as a stale cell and re-extract
         aee = writable()["aee"]
-        for i in np.nonzero(queue_mask)[0].tolist():
+        for i in queue_idx.tolist():
             validators[i].activation_eligibility_epoch = stamp
-        aee[queue_mask] = np.uint64(stamp)
+        aee[queue_idx] = np.uint64(stamp)
 
     # ejections: initiate_validator_exit per index, in index order.  The
     # churn limit is constant across the loop (assigned exit epochs are
     # all in the future, so current-epoch activity never changes).
     churn = max(int(spec.config.MIN_PER_EPOCH_CHURN_LIMIT),
                 active_count // int(spec.config.CHURN_LIMIT_QUOTIENT))
-    eject = np.nonzero(eject_mask)[0]
-    if eject.size:
+    if eject_idx.size:
         ext = writable()["ext"]
         wd = wcols["wd"]
-        exited = ext[ext != np.uint64(far_future)]
+        # the exit-queue seed (max assigned exit epoch, and how much of
+        # that epoch's churn is already spent) is a property of the FULL
+        # exit column — a spec-required exact read, O(n) by nature, not
+        # replaceable by a candidate gather (any validator may already
+        # hold the max exit epoch)
+        exited = ext[ext != np.uint64(far_future)]  # noqa: N1301
         queue_epoch = current_epoch + 1 + int(spec.MAX_SEED_LOOKAHEAD)
         if exited.size:
             queue_epoch = max(queue_epoch, int(exited.max()))
-        queue_churn = int((ext == np.uint64(queue_epoch)).sum(dtype=np.int64))
+        qe = np.uint64(queue_epoch)
+        queue_churn = int((ext == qe).sum(dtype=np.int64))  # noqa: N1301
         delay = int(spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)
-        _guard(queue_epoch + eject.size + delay)
-        for i in eject.tolist():
+        _guard(queue_epoch + eject_idx.size + delay)
+        for i in eject_idx.tolist():
             if int(ext[i]) != far_future:
                 continue
             if queue_churn >= churn:
@@ -784,8 +808,10 @@ def _registry_apply(spec, state, sa, cols, queue_mask, eject_mask,
 
     # activations: sort eligibles by (activation_eligibility_epoch, index),
     # dequeue up to the (fork-dependent) activation churn limit
-    idx = np.nonzero(eligible_mask)[0]
+    idx = eligible_idx
     if idx.size:
+        # re-read: the queue stamps above may have copied the column
+        aee = cols["aee"]
         order = np.lexsort((idx, aee[idx]))
         activation_churn = churn
         if "deneb" in _fork_lineage(spec):

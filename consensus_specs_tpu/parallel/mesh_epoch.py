@@ -24,11 +24,22 @@ Byte-identity argument (the differential suites enforce it):
   (which re-checks its own exact guards) instead of wrapping;
 * ordering-sensitive registry churn (exit-queue recurrence,
   activation dequeue) is NOT distributed: the shard-local eligibility
-  scans produce masks, the (small) candidate index sets are gathered to
-  the host, and one shared ordered-resolution body
-  (``epoch_kernels._registry_apply``) applies them in spec order — the
-  same code the single-device engine runs, so cross-shard ordering is
-  byte-identical to the spec loop by construction.
+  scans return COMPACT per-shard candidate index buffers (ascending by
+  construction, O(S*cap) elements), and one shared ordered-resolution
+  body (``epoch_kernels._registry_apply_idx``) applies them in spec
+  order — the same code the single-device engine funnels its masks
+  through, so cross-shard ordering is byte-identical to the spec loop
+  by construction.
+
+Host-work budget (speclint N13xx, ``speclint --cost-verdicts``;
+docs/sharding.md): between dispatch and commit the host reads only
+per-shard *partials* — the exact overflow-guard maxima ride back as
+``(k, S)`` stacks (:func:`_p_shard_stats`), the active/attestation
+balance sums as one psum vector, and the registry candidates as
+bounded index buffers — never a per-epoch O(n) pass over the columns.
+The ``mesh.host_partials`` counter is the runtime twin of that static
+proof (``benchmarks/bench_mesh.py`` counter-asserts the per-epoch
+total).
 
 Dispatch layering: ``ops/epoch_kernels``'s ``_fast_*`` bodies offer each
 sub-transition here first.  A decline (engine off, registry below the
@@ -80,6 +91,14 @@ _FALLBACKS = {
     "device_loss": obs_registry.counter(
         "mesh.epoch.fallbacks").labels(reason="device_loss"),
 }
+# host-side reads of per-shard partial stacks, in ELEMENTS (O(S) per
+# reduction) — the runtime twin of the speclint N13xx host-work proof:
+# between dispatch and commit the host touches partials, never O(n)
+# columns (benchmarks/bench_mesh.py counter-asserts the per-epoch sum)
+_C_PARTIALS = obs_registry.counter("mesh.host_partials").labels()
+# a registry-scan candidate family outgrew the per-shard index cap:
+# the dispatch declines and the columnar engine serves the call
+_C_SCAN_OVERFLOW = obs_registry.counter("mesh.scan_overflow").labels()
 
 
 def _ek():
@@ -99,6 +118,7 @@ def _ek():
 # closure values — closing over them would recompile every epoch.
 # Static arguments (fork constants, in_leak) key the program cache.
 
+# speclint: cost: bounded: keyed per (kind, mesh, static fork config)
 _PROGRAMS = {}
 
 
@@ -159,8 +179,9 @@ def _p_altair_sums(mesh, n_flags):
 def _p_masked_sums(mesh):
     """Generic reduction program: masked sums of one uint64 column under
     a stacked ``(k, n)`` mask operand — shard-local partials, ONE psum.
-    The phase0 attestation-set sums and the slashings/registry active
-    totals all ride through this shape."""
+    The engine's sub-transitions now ride :func:`_p_active_sums` (which
+    computes the active mask on device instead of taking a host-built
+    column); this shape stays for the bench placement leg."""
     def build():
         import jax
         import jax.numpy as jnp
@@ -178,6 +199,75 @@ def _p_masked_sums(mesh):
             local, mesh=mesh, in_specs=(P(axis), P(None, axis)),
             out_specs=P()))
     return _program("masked_sums", mesh, (), build)
+
+
+def _p_active_sums(mesh, k):
+    """Reduction program: [total active balance, per-mask attesting
+    balances] with the active-at-current mask computed ON DEVICE from
+    the ``act``/``ext`` columns — shard-local partials, ONE psum.
+    Replaces the host-side ``active_cur`` elementwise pass the phase0
+    and slashings bodies used to run (speclint N1301)."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def local(eff, act, ext, *rest):
+            scal = rest[-1]
+            cur = scal[0]
+            zero = jnp.uint64(0)
+            active_cur = (act <= cur) & (cur < ext)
+            parts = [jnp.sum(jnp.where(active_cur, eff, zero),
+                             dtype=jnp.uint64)]
+            if k:
+                masks = rest[0]
+                for i in range(k):  # noqa: J203 (static: mask count)
+                    parts.append(jnp.sum(
+                        jnp.where(masks[i], eff, zero),
+                        dtype=jnp.uint64))
+            return jax.lax.psum(jnp.stack(parts), mesh_state.AXIS)
+
+        axis = mesh_state.AXIS
+        in_specs = (P(axis), P(axis), P(axis)) \
+            + ((P(None, axis),) if k else ()) + (P(),)
+        return jax.jit(shard_map(local, mesh=mesh, in_specs=in_specs,
+                                 out_specs=P()))
+    return _program("active_sums", mesh, (k,), build)
+
+
+def _p_shard_stats(mesh, k):
+    """Per-shard maxima for the exact overflow-guard inputs: ``k``
+    uint64 columns in, a ``(k, 1)`` stack of shard-local maxima out —
+    ZERO collectives.  The host reduces the gathered ``(k, S)`` partial
+    stack (:func:`_shard_maxes`) instead of re-scanning n-lane columns;
+    pad lanes are zero, so the maxima match the host's
+    ``max(initial=0)`` exactly."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def local(*cols):
+            return jnp.stack([jnp.max(c) for c in cols])[:, None]
+
+        axis = mesh_state.AXIS
+        return jax.jit(shard_map(
+            local, mesh=mesh, in_specs=tuple([P(axis)] * k),
+            out_specs=P(None, axis)))
+    return _program("shard_stats", mesh, (k,), build)
+
+
+def _shard_maxes(mesh, *cols_dev):
+    """Exact per-column maxima read off per-shard partials: the host
+    reduces a ``(k, S)`` stack — O(S) elements, counted on
+    ``mesh.host_partials`` — never the n-lane columns themselves
+    (speclint N1301; docs/sharding.md host-work budget)."""
+    parts = np.asarray(_p_shard_stats(mesh, len(cols_dev))(*cols_dev))
+    _C_PARTIALS.add(parts.size)
+    maxes = parts.max(axis=1)
+    return [int(v) for v in maxes]
 
 
 # inclusion-delay scan sentinel: an unbeatable (delay, ordinal) key —
@@ -403,14 +493,27 @@ def _p_eff_balance(mesh, static):
     return _program("eff_balance", mesh, static, build)
 
 
+# per-shard candidate index cap for the registry eligibility scans: a
+# shard whose candidate family outgrows this declines the dispatch (the
+# columnar engine serves the call) rather than truncating — real epochs
+# churn a handful of validators per family, so 256 never binds in the
+# differential suites while keeping the host read O(S * cap)
+_SCAN_CAP = 256
+
+
 def _p_registry_scan(mesh, static):
     """Registry eligibility scans, shard-local: activation-queue stamps,
     ejection candidates, dequeue eligibles — plus the active-set count
-    for the churn limit (the sub-transition's ONE psum).  The masks come
-    back to the host, which gathers the small candidate index sets and
-    resolves the churn-ordered queues through the shared
-    ``_registry_apply`` body."""
-    far, max_eb, ejection = static
+    for the churn limit (the sub-transition's ONE psum).  Each family
+    comes back as a COMPACT per-shard index buffer (``cap`` slots per
+    shard, global indices, ascending within a shard) plus the true
+    per-shard candidate counts: the host concatenates count-sliced
+    spans (:func:`_gather_idx`) and resolves the churn-ordered queues
+    through the shared ``epoch_kernels._registry_apply_idx`` body —
+    O(S*cap) elements read, never the n-lane masks.  Pad lanes can
+    never be candidates (``aee``/``act``/``ext`` pad to zero, so every
+    family predicate is False there)."""
+    far, max_eb, ejection, cap = static
 
     def build():
         import jax
@@ -420,22 +523,44 @@ def _p_registry_scan(mesh, static):
 
         def local(aee, act, ext, eff, scal):
             cur, finalized = scal[0], scal[1]
+            n_local = aee.shape[0]
+            shard = jax.lax.axis_index(mesh_state.AXIS)
+            base = shard.astype(jnp.int64) * n_local
             queue_mask = (aee == jnp.uint64(far)) \
                 & (eff == jnp.uint64(max_eb))
             active_cur = (act <= cur) & (cur < ext)
             eject_mask = active_cur & (eff <= jnp.uint64(ejection))
             eligible_mask = (aee <= finalized) & (act == jnp.uint64(far))
+            bufs, counts = [], []
+            families = (queue_mask, eject_mask, eligible_mask)
+            for mask in families:  # noqa: J203 (static: 3 families)
+                li = jnp.nonzero(mask, size=cap, fill_value=n_local)[0]
+                bufs.append(base + li.astype(jnp.int64))
+                counts.append(jnp.sum(mask, dtype=jnp.int64))
             count = jax.lax.psum(
                 jnp.sum(active_cur, dtype=jnp.int64)[None],
                 mesh_state.AXIS)
-            return queue_mask, eject_mask, eligible_mask, count
+            return (bufs[0], bufs[1], bufs[2],
+                    jnp.stack(counts)[:, None], count)
 
         axis = mesh_state.AXIS
         return jax.jit(shard_map(
             local, mesh=mesh,
             in_specs=(P(axis), P(axis), P(axis), P(axis), P()),
-            out_specs=(P(axis), P(axis), P(axis), P())))
+            out_specs=(P(axis), P(axis), P(axis), P(None, axis), P())))
     return _program("registry_scan", mesh, static, build)
+
+
+def _gather_idx(buf, counts, cap):
+    """Concatenate each shard's first ``counts[s]`` candidates out of
+    its ``cap``-slot span of ``buf``.  Per-shard ascending
+    (``jnp.nonzero``) and shard spans ascending, so the result is
+    globally ascending — byte-identical to ``np.nonzero`` over the
+    unsharded mask column."""
+    spans = [buf[s * cap:s * cap + int(c)] for s, c in enumerate(counts)]
+    if not spans:
+        return np.zeros(0, dtype=np.int64)
+    return np.ascontiguousarray(np.concatenate(spans))
 
 
 # ---------------------------------------------------------------------------
@@ -528,6 +653,7 @@ def _scal(values) -> np.ndarray:
 # Sub-transition entry points (called by ops/epoch_kernels._fast_*)
 # ---------------------------------------------------------------------------
 
+# speclint: cost: O(S)
 def try_rewards_and_penalties(spec, state) -> bool:
     def fast(spec, state, sa):
         ek = _ek()
@@ -545,7 +671,14 @@ def _altair_rewards(spec, state, sa) -> bool:
         return False
     mesh = mesh_state.build_mesh()
     eff = cols["eff"]
-    max_eff = int(eff.max(initial=0))
+    reg = _columns(sa, mesh)
+    part = mesh_state.sharded_cell(sa, "participation_previous", mesh)
+    sc_dev = mesh_state.sharded_cell(sa, "inactivity_scores", mesh)
+    bal_dev = mesh_state.sharded_cell(sa, "balances", mesh)
+    # exact guard inputs off per-shard max partials — the host never
+    # re-scans the n-lane columns (speclint N1301; host-work budget)
+    max_eff, max_score, max_bal = _shard_maxes(
+        mesh, reg["eff"], sc_dev, bal_dev)
     # pre-reduction conservative bound: every psum lane sum is <= n *
     # max_eff, so < 2**64 here implies the device reduction is exact
     ek._guard(n * max_eff)
@@ -553,8 +686,6 @@ def _altair_rewards(spec, state, sa) -> bool:
     cur_epoch = int(spec.get_current_epoch(state))
     increment = int(spec.EFFECTIVE_BALANCE_INCREMENT)
     weights = tuple(int(w) for w in spec.PARTICIPATION_FLAG_WEIGHTS)
-    reg = _columns(sa, mesh)
-    part = mesh_state.sharded_cell(sa, "participation_previous", mesh)
     sums_prog = _p_altair_sums(mesh, len(weights))
     _C_PSUMS["rewards_and_penalties"].add()
     sums = np.asarray(sums_prog(
@@ -581,11 +712,10 @@ def _altair_rewards(spec, state, sa) -> bool:
                 else int(spec.INACTIVITY_PENALTY_QUOTIENT_ALTAIR))
     inact_denom = int(spec.config.INACTIVITY_SCORE_BIAS) * quotient
     scores = sa.inactivity_scores()
-    ek._guard(max_eff * int(scores.max(initial=0)))
+    ek._guard(max_eff * max_score)
     balances = sa.balances()
     # pairwise application bound: each pair adds at most one flag
     # reward (or the zero inactivity reward) on top of the running max
-    max_bal = int(balances.max(initial=0))
     ek._guard(max_bal + (len(weights) + 1) * br_max)
     static = (in_leak, weights, weight_denominator, increment,
               int(spec.TIMELY_HEAD_FLAG_INDEX),
@@ -593,8 +723,6 @@ def _altair_rewards(spec, state, sa) -> bool:
     prog = _p_altair_deltas(mesh, static)
     scal = _scal([prev_epoch, brpi, active_increments, inact_denom]
                  + up_increments)
-    sc_dev = mesh_state.sharded_cell(sa, "inactivity_scores", mesh)
-    bal_dev = mesh_state.sharded_cell(sa, "balances", mesh)
     out = mesh_state.unshard(
         prog(reg["eff"], reg["act"], reg["ext"], reg["sl"], reg["wd"],
              part, sc_dev, bal_dev, mesh_state.replicate(scal, mesh)), n)
@@ -647,19 +775,21 @@ def _phase0_rewards(spec, state, sa) -> bool:
     prev_epoch = int(prev_epoch)
     cur_epoch = int(spec.get_current_epoch(state))
     eff = cols["eff"]
-    max_eff = int(eff.max(initial=0))
+    reg = _columns(sa, mesh)
+    bal_dev = mesh_state.sharded_cell(sa, "balances", mesh)
+    # exact guard inputs off per-shard max partials — the host never
+    # re-scans the n-lane columns (speclint N1301; host-work budget)
+    max_eff, max_bal = _shard_maxes(mesh, reg["eff"], bal_dev)
     ek._guard(n * max_eff)
     increment = int(spec.EFFECTIVE_BALANCE_INCREMENT)
-    cur = np.uint64(cur_epoch)
-    active_cur = (cols["act"] <= cur) & (cur < cols["ext"])
     att_masks = np.stack([ek._mask_from_indices(n, s)
                           for s in (src_set, tgt_set, head_set)])
-    reg = _columns(sa, mesh)
-    sums_prog = _p_masked_sums(mesh)
+    sums_prog = _p_active_sums(mesh, 3)
     _C_PSUMS["rewards_and_penalties"].add()
     sums = np.asarray(sums_prog(
-        reg["eff"], _place_masks(
-            np.concatenate([active_cur[None], att_masks]), mesh)))
+        reg["eff"], reg["act"], reg["ext"],
+        _place_masks(att_masks, mesh),
+        mesh_state.replicate(_scal([cur_epoch]), mesh)))
     total_balance = max(increment, int(sums[0]))
     ek._guard(total_balance)
     total_increments = total_balance // increment
@@ -686,7 +816,6 @@ def _phase0_rewards(spec, state, sa) -> bool:
     # program compiles O(log flats) shapes, not one per epoch.
     # speclint: invariant: prq >= 1
     prq = int(spec.PROPOSER_REWARD_QUOTIENT)
-    src_mask = att_masks[0]
     flat_idx, flat_key, att_proposers = [], [], []
     for ordinal, att in enumerate(src_atts):
         att_proposers.append(int(att.proposer_index))
@@ -699,8 +828,7 @@ def _phase0_rewards(spec, state, sa) -> bool:
         flat_key.append(np.full(
             ii.size, np.uint64((int(att.inclusion_delay) << 32)
                                | ordinal), dtype=np.uint64))
-    best_delay = np.full(n, (1 << 64) - 1, dtype=np.uint64)
-    best_proposer = np.zeros(n, dtype=np.int64)
+    best_key = None
     if flat_idx:
         idx = np.concatenate(flat_idx)
         keys = np.concatenate(flat_key)
@@ -714,26 +842,41 @@ def _phase0_rewards(spec, state, sa) -> bool:
             _p_incl_scan(mesh)(reg["eff"],
                                mesh_state.replicate(idx, mesh),
                                mesh_state.replicate(keys, mesh)), n)
-        covered = best_key != np.uint64(_INCL_SENTINEL)
-        best_delay[covered] = best_key[covered] >> np.uint64(32)
-        ords = (best_key[covered]
-                & np.uint64(0xFFFFFFFF)).astype(np.int64)
-        best_proposer[covered] = np.array(
-            att_proposers, dtype=np.int64)[ords]
-    base_reward = (eff * np.uint64(brf)) // np.uint64(sqrt_total) \
-        // np.uint64(brpe)
-    proposer_reward = base_reward // np.uint64(prq)
+    # the source-attester candidate set is BOUNDED (the spec sets are
+    # already materialized) — gather the candidate lanes first and run
+    # the base/proposer-reward arithmetic on O(candidates) elements,
+    # never on full columns (speclint N1302); every source attester is
+    # covered by some source attestation, so reading the scatter-min
+    # keys only at those lanes is byte-identical to the masked update
+    src_idx = np.fromiter(sorted(src_set), dtype=np.int64,
+                          count=len(src_set))
     incl_rewards = np.zeros(n, dtype=np.uint64)
-    src_idx = np.nonzero(src_mask)[0]
+    incl_max = 0
     if src_idx.size:
+        if best_key is None:
+            delay_src = np.full(src_idx.size, (1 << 64) - 1,
+                                dtype=np.uint64)
+            prop_src = np.zeros(src_idx.size, dtype=np.int64)
+        else:
+            key_src = best_key[src_idx]
+            delay_src = key_src >> np.uint64(32)
+            prop_src = np.array(att_proposers, dtype=np.int64)[
+                (key_src & np.uint64(0xFFFFFFFF)).astype(np.int64)]
+        eff_src = eff[src_idx]
+        base_src = (eff_src * np.uint64(brf)) // np.uint64(sqrt_total) \
+            // np.uint64(brpe)
         # safe under the prq >= 1 invariant: proposer_reward <=
         # base_reward, preserved under the shared index (the U9xx
         # prover certifies the same line in the single-device engine)
-        max_attester = base_reward[src_idx] - proposer_reward[src_idx]
-        incl_rewards[src_idx] = max_attester // best_delay[src_idx]
+        proposer_src = base_src // np.uint64(prq)
+        max_attester = base_src - proposer_src
+        incl_rewards[src_idx] = max_attester // delay_src
         ek._guard(br_max + src_idx.size * (br_max // prq))
-        np.add.at(incl_rewards, best_proposer[src_idx],
-                  proposer_reward[src_idx])
+        np.add.at(incl_rewards, prop_src, proposer_src)
+        # incl_rewards is zero off the touched lanes, so the bounded
+        # gather max equals the full-column max the guard needs
+        touched = np.union1d(src_idx, prop_src)
+        incl_max = int(incl_rewards[touched].max(initial=0))
 
     finality_delay = int(spec.get_finality_delay(state)) if in_leak else 0
     ipq = int(spec.INACTIVITY_PENALTY_QUOTIENT)
@@ -742,14 +885,12 @@ def _phase0_rewards(spec, state, sa) -> bool:
     # accumulate-then-apply bound, conservative over the exact per-part
     # maxima the single-device engine reads off its materialized parts
     balances = sa.balances()
-    ek._guard(3 * br_max + int(incl_rewards.max(initial=0))
-              + int(balances.max(initial=0)),
+    ek._guard(3 * br_max + incl_max + max_bal,
               3 * br_max + brpe * br_max + max_eff * finality_delay)
     static = (in_leak, brf, brpe, prq, ipq)
     prog = _p_phase0_deltas(mesh, static)
     scal = _scal([prev_epoch, sqrt_total, total_increments,
                   finality_delay] + att_increments)
-    bal_dev = mesh_state.sharded_cell(sa, "balances", mesh)
     out = mesh_state.unshard(
         prog(reg["eff"], reg["act"], reg["ext"], reg["sl"], reg["wd"],
              _place_masks(att_masks, mesh),
@@ -758,6 +899,12 @@ def _phase0_rewards(spec, state, sa) -> bool:
 
     def host_recompute():
         _, eligible = ek._epoch_masks(spec, cols, prev_epoch)
+        # full-column base/proposer rewards: the audit recomputation is
+        # deliberately independent of the bounded candidate gathers it
+        # is auditing (exempt from the host-work budget by design)
+        ek._guard(max_eff * brf)
+        base_reward = (eff * np.uint64(brf)) // np.uint64(sqrt_total) \
+            // np.uint64(brpe)
         # the inclusion-delay scan recomputes through the SPEC-SHAPED
         # per-attestation loop — the audit must be independent of the
         # sharded scatter-min it is auditing
@@ -775,10 +922,12 @@ def _phase0_rewards(spec, state, sa) -> bool:
             h_proposer[sel] = int(att.proposer_index)
         rewards = np.zeros(n, dtype=np.uint64)
         if src_idx.size:
-            max_attester = base_reward[src_idx] - proposer_reward[src_idx]
+            # speclint: invariant: prq >= 1
+            base_src_h = base_reward[src_idx]
+            proposer_src_h = base_src_h // np.uint64(prq)
+            max_attester = base_src_h - proposer_src_h
             rewards[src_idx] = max_attester // h_delay[src_idx]
-            np.add.at(rewards, h_proposer[src_idx],
-                      proposer_reward[src_idx])
+            np.add.at(rewards, h_proposer[src_idx], proposer_src_h)
         penalties = np.zeros(n, dtype=np.uint64)
         for i in range(3):
             r, p = ek.phase0_component_kernel(
@@ -814,6 +963,7 @@ def _place_masks(masks: np.ndarray, mesh):
         masks, NamedSharding(mesh, P(None, mesh_state.AXIS)))
 
 
+# speclint: cost: O(S)
 def try_inactivity_updates(spec, state) -> bool:
     def fast(spec, state, sa):
         ek = _ek()
@@ -823,15 +973,16 @@ def try_inactivity_updates(spec, state) -> bool:
             return False
         mesh = mesh_state.build_mesh()
         scores = sa.inactivity_scores()
+        reg = _columns(sa, mesh)
+        part = mesh_state.sharded_cell(sa, "participation_previous", mesh)
+        sc_dev = mesh_state.sharded_cell(sa, "inactivity_scores", mesh)
+        max_score, = _shard_maxes(mesh, sc_dev)
         bias = int(spec.config.INACTIVITY_SCORE_BIAS)
-        ek._guard(int(scores.max(initial=0)) + bias)
+        ek._guard(max_score + bias)
         prev_epoch = int(spec.get_previous_epoch(state))
         in_leak = bool(spec.is_in_inactivity_leak(state))
         static = (bias, int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE),
                   in_leak, int(spec.TIMELY_TARGET_FLAG_INDEX))
-        reg = _columns(sa, mesh)
-        part = mesh_state.sharded_cell(sa, "participation_previous", mesh)
-        sc_dev = mesh_state.sharded_cell(sa, "inactivity_scores", mesh)
         prog = _p_inactivity(mesh, static)
         out = mesh_state.unshard(
             prog(reg["act"], reg["ext"], reg["sl"], reg["wd"], part,
@@ -852,6 +1003,7 @@ def try_inactivity_updates(spec, state) -> bool:
     return _dispatch(spec, state, "inactivity_updates", fast)
 
 
+# speclint: cost: O(S)
 def try_slashings(spec, state, multiplier: int) -> bool:
     def fast(spec, state, sa):
         ek = _ek()
@@ -862,15 +1014,16 @@ def try_slashings(spec, state, multiplier: int) -> bool:
             return False
         mesh = mesh_state.build_mesh()
         eff = cols["eff"]
-        max_eff = int(eff.max(initial=0))
+        reg = _columns(sa, mesh)
+        max_eff, = _shard_maxes(mesh, reg["eff"])
         ek._guard(n * max_eff)
         epoch = int(spec.get_current_epoch(state))
-        cur = np.uint64(epoch)
-        active_cur = (cols["act"] <= cur) & (cur < cols["ext"])
-        reg = _columns(sa, mesh)
         _C_PSUMS["slashings"].add()
-        sums = np.asarray(_p_masked_sums(mesh)(
-            reg["eff"], _place_masks(active_cur[None], mesh)))
+        # the active-at-current mask lives ON DEVICE (k=0: no extra
+        # mask rows) — the host reads one psum'd sum, not a column
+        sums = np.asarray(_p_active_sums(mesh, 0)(
+            reg["eff"], reg["act"], reg["ext"],
+            mesh_state.replicate(_scal([epoch]), mesh)))
         total_balance = max(int(spec.EFFECTIVE_BALANCE_INCREMENT),
                             int(sums[0]))
         ek._guard(total_balance)
@@ -902,6 +1055,7 @@ def try_slashings(spec, state, multiplier: int) -> bool:
     return _dispatch(spec, state, "slashings", fast)
 
 
+# speclint: cost: O(S)
 def try_effective_balance_updates(spec, state) -> bool:
     def fast(spec, state, sa):
         ek = _ek()
@@ -918,11 +1072,11 @@ def try_effective_balance_updates(spec, state) -> bool:
         up = hysteresis_increment * int(spec.HYSTERESIS_UPWARD_MULTIPLIER)
         balances = sa.balances()
         eff = cols["eff"]
-        ek._guard(int(balances.max(initial=0)) + down,
-                  int(eff.max(initial=0)) + up)
-        static = (increment, down, up, int(spec.MAX_EFFECTIVE_BALANCE))
         reg = _columns(sa, mesh)
         bal_dev = mesh_state.sharded_cell(sa, "balances", mesh)
+        max_bal, max_eff = _shard_maxes(mesh, bal_dev, reg["eff"])
+        ek._guard(max_bal + down, max_eff + up)
+        static = (increment, down, up, int(spec.MAX_EFFECTIVE_BALANCE))
         prog = _p_eff_balance(mesh, static)
         new_eff = mesh_state.unshard(prog(bal_dev, reg["eff"]), n)
 
@@ -933,7 +1087,9 @@ def try_effective_balance_updates(spec, state) -> bool:
                 max_effective_balance=static[3])
 
         new_eff = _finish_column(new_eff, host_recompute)
-        changed = np.nonzero(eff != new_eff)[0]
+        # the commit diff IS the SSZ write-back boundary: the paired
+        # per-index writes need the changed lanes whichever engine ran
+        changed = np.nonzero(eff != new_eff)[0]  # noqa: N1301
         if changed.size == 0:
             return True
         # copy-on-write BEFORE the paired SSZ writes (generation bump) —
@@ -947,6 +1103,7 @@ def try_effective_balance_updates(spec, state) -> bool:
     return _dispatch(spec, state, "effective_balance_updates", fast)
 
 
+# speclint: cost: O(S)
 def try_registry_updates(spec, state) -> bool:
     def fast(spec, state, sa):
         ek = _ek()
@@ -959,24 +1116,38 @@ def try_registry_updates(spec, state) -> bool:
         finalized = int(state.finalized_checkpoint.epoch)
         static = (int(spec.FAR_FUTURE_EPOCH),
                   int(spec.MAX_EFFECTIVE_BALANCE),
-                  int(spec.config.EJECTION_BALANCE))
+                  int(spec.config.EJECTION_BALANCE), _SCAN_CAP)
         reg = _columns(sa, mesh)
         prog = _p_registry_scan(mesh, static)
         _C_PSUMS["registry_updates"].add()
-        q_dev, e_dev, el_dev, count = prog(
+        q_dev, e_dev, el_dev, fam_dev, count = prog(
             reg["aee"], reg["act"], reg["ext"], reg["eff"],
             mesh_state.replicate(_scal([current_epoch, finalized]), mesh))
-        queue_mask = mesh_state.unshard(q_dev, n)
-        eject_mask = mesh_state.unshard(e_dev, n)
-        eligible_mask = mesh_state.unshard(el_dev, n)
+        fam_counts = np.asarray(fam_dev)
+        _C_PARTIALS.add(fam_counts.size)
+        if int(fam_counts.max(initial=0)) > _SCAN_CAP:
+            # a candidate family outgrew the per-shard index cap — the
+            # compact buffers would truncate, so decline and let the
+            # columnar engine (full-mask scans, its own exact guards)
+            # serve the call: the standard degradation-ladder leg
+            _C_SCAN_OVERFLOW.add()
+            return False
         active_count = int(np.asarray(count)[0])
+        queue_idx = _gather_idx(np.asarray(q_dev), fam_counts[0],
+                                _SCAN_CAP)
+        eject_idx = _gather_idx(np.asarray(e_dev), fam_counts[1],
+                                _SCAN_CAP)
+        eligible_idx = _gather_idx(np.asarray(el_dev), fam_counts[2],
+                                   _SCAN_CAP)
         if faults.corrupt_armed(SITE):
             # deterministic silent corruption: stamp validator 0 as an
-            # activation-queue candidate it is not (or clear it if it
+            # activation-queue candidate it is not (or drop it if it
             # is) — exactly the class of wrongness only an audit sees
-            queue_mask = queue_mask.copy()
-            if queue_mask.size:
-                queue_mask[0] = not queue_mask[0]
+            if queue_idx.size and int(queue_idx[0]) == 0:
+                queue_idx = queue_idx[1:]
+            else:
+                queue_idx = np.concatenate(
+                    [np.zeros(1, dtype=np.int64), queue_idx])
         if supervisor.audit_due(SITE):
             cur = np.uint64(current_epoch)
             g_queue = (cols["aee"] == np.uint64(static[0])) \
@@ -985,23 +1156,25 @@ def try_registry_updates(spec, state) -> bool:
             g_eject = g_active & (cols["eff"] <= np.uint64(static[2]))
             g_eligible = (cols["aee"] <= np.uint64(finalized)) \
                 & (cols["act"] == np.uint64(static[0]))
-            ok = bool(np.array_equal(queue_mask, g_queue)
-                      and np.array_equal(eject_mask, g_eject)
-                      and np.array_equal(eligible_mask, g_eligible)
-                      and active_count
-                      == int(g_active.sum(dtype=np.int64)))
+            ok = bool(
+                np.array_equal(queue_idx, np.nonzero(g_queue)[0])
+                and np.array_equal(eject_idx, np.nonzero(g_eject)[0])
+                and np.array_equal(eligible_idx,
+                                   np.nonzero(g_eligible)[0])
+                and active_count == int(g_active.sum(dtype=np.int64)))
             supervisor.audit_result(
-                SITE, ok, "mesh registry eligibility scans diverged "
+                SITE, ok, "mesh registry candidate gathers diverged "
                 "from the host recomputation")
             if not ok:
-                queue_mask, eject_mask, eligible_mask = \
-                    g_queue, g_eject, g_eligible
+                queue_idx = np.nonzero(g_queue)[0]
+                eject_idx = np.nonzero(g_eject)[0]
+                eligible_idx = np.nonzero(g_eligible)[0]
                 active_count = int(g_active.sum(dtype=np.int64))
-        # the small gathered index sets resolve churn-ordered on the
-        # host through the SAME body as the single-device engine —
-        # cross-shard ordering byte-identical to the spec loop by
+        # the bounded candidate sets resolve churn-ordered on the host
+        # through the SAME body as the single-device engine — cross-
+        # shard ordering byte-identical to the spec loop by
         # construction
-        ek._registry_apply(spec, state, sa, cols, queue_mask,
-                           eject_mask, eligible_mask, active_count)
+        ek._registry_apply_idx(spec, state, sa, cols, queue_idx,
+                               eject_idx, eligible_idx, active_count)
         return True
     return _dispatch(spec, state, "registry_updates", fast)

@@ -238,6 +238,7 @@ _C_DEVICE_LOSSES = {
 # from jax.devices(), memoized per axis/device tuple)
 # ---------------------------------------------------------------------------
 
+# speclint: cost: bounded: keyed per (axis, surviving-device tuple)
 _MESH_CACHE = {}
 
 

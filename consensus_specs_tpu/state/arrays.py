@@ -45,6 +45,14 @@ state object itself:
 attached store: ``of`` hands out detached single-use stores, every
 access re-extracts, commits are immediate — the slow-but-simple
 fallback the differential suites pin against the engine path.
+
+This module is the *host columnar engine* — O(n) numpy passes over
+registry columns are its job, and it is the decline target when the
+mesh engine's bounded candidate buffers overflow (``mesh.scan_overflow``,
+docs/sharding.md).  The speclint N13xx cost pass therefore exempts it
+by design (``cost._EXEMPT_RELS``): the O(S)-host-work budget applies to
+the ``parallel/`` dispatch paths, not to the fallback that exists
+precisely to absorb their declined work.
 """
 import weakref
 from contextlib import contextmanager

@@ -80,6 +80,12 @@ class AnalysisCache:
         entry["passes"][pass_name] = _encode(findings)
         self._dirty = True
 
+    def drop_file(self, rel):
+        """Purge a path that no longer exists (deleted, or the old
+        side of a rename) so its findings cannot outlive the file."""
+        if self._data["files"].pop(rel, None) is not None:
+            self._dirty = True
+
     # -- tree-granular ------------------------------------------------------
 
     def get_tree(self, pass_name, fingerprint):

@@ -1,0 +1,830 @@
+"""Static asymptotic-cost analysis core (the N13xx family engine).
+
+The mesh engine's scaling contract (ROADMAP item 1, docs/sharding.md)
+is that a dispatched sub-transition performs **no per-epoch host pass
+over registry columns**: the SPMD programs own the O(n) work at O(n/S)
+per shard, and the host only touches per-shard *partials* — O(S)
+elements per reduction.  This module proves that contract statically,
+per dispatch path, on the speclint v2 dataflow framework
+(``graph.py`` + ``dataflow.py``): every function gets a symbolic cost
+summary over the registry axis drawn from the five-point lattice ::
+
+    O(1)  <  O(log n)  <  O(S)  <  O(n/S)  <  O(n)
+
+seeded by full-column numpy reductions and elementwise kernels,
+``sequence_items`` loops over registry-axis SSZ fields, and
+per-validator scans — then solved interprocedurally to a fixed point
+with the same virtual-dispatch rules as the E12xx effect pass
+(``spec.m`` unions excluded by design: the attestation helper surface
+is the spec-semantics layer with its own runtime caches, not engine
+host work).  ``shard_map`` program bodies are *pinned* at O(n/S)
+(that is where the column work belongs) and names bound from program
+calls carry O(S) "partial" taint, so a host reduction over per-shard
+partials proves O(S), not O(n).
+
+Cost facts are classified by a light name/shape taint:
+
+* **column** — a full registry-axis array: store accessors
+  (``sa.registry()``, ``sa.balances()`` ...), ``mesh_state.unshard``,
+  ``sequence_items(state.<registry field>)``, the engine's column
+  parameter-name conventions (``cols``/``eff``/``balances``/... —
+  the same convention the E12xx pass uses for live-state params);
+* **partial** — a per-shard output of a ``_p_*``/``_program`` shard
+  program: O(S) elements, reductions over it cost O(S);
+* **bounded** — a candidate index set (``np.nonzero(...)[0]``,
+  ``*_idx`` parameters): gathers through it are not column work.
+
+Rules:
+
+* N1301 — a reportable O(n) host compute (reduction, elementwise op,
+  masked selection, per-validator loop) reachable from a ``parallel/``
+  dispatch entry, outside the audit/corruption-drill branches (those
+  are the *independent recomputation* the byte-identity story needs —
+  exempt by design, like the ``host_recompute`` closures).
+* N1302 — a full-column elementwise derivation whose every direct use
+  is a bounded-index gather: the bounded candidates should be gathered
+  first and the arithmetic done on O(candidates) lanes.
+* N1303 — a module-level dict grown with a non-constant key by a
+  dispatch-reachable function, with no eviction in the module and no
+  ``# speclint: cost: bounded: <reason>`` annotation on the dict.
+* N1304 — a ``# speclint: cost: O(...)`` annotation on a ``def`` that
+  the prover cannot verify (solved host cost above the declared bound,
+  or unparseable bound).
+
+``verdict_report`` prints the per-dispatch-path host-work budget
+(``speclint --cost-verdicts``); ``[FAIL]`` lines gate CI.
+"""
+import ast
+import re
+
+from .dataflow import solve
+from .effects import _dispatch_entries, _owner, _tail, find_shard_programs
+from .findings import Finding, noqa_codes
+
+# -- the cost lattice -------------------------------------------------------
+
+O1, OLOGN, OS, ONS, ON = 0, 1, 2, 3, 4
+RANK_NAMES = {O1: "O(1)", OLOGN: "O(log n)", OS: "O(S)",
+              ONS: "O(n/S)", ON: "O(n)"}
+# normalized annotation spelling -> rank (spaces stripped, upper-cased)
+_BOUND_OF = {"O(1)": O1, "O(LOGN)": OLOGN, "O(S)": OS,
+             "O(N/S)": ONS, "O(N)": ON}
+
+# -- taint classes ----------------------------------------------------------
+
+COL, PARTIAL, IDX, NLIKE, PROG = "col", "partial", "idx", "nlike", "prog"
+
+# registry-axis SSZ fields: sequence_items()/iteration over these is a
+# per-validator pass (state.slashings is EPOCHS_PER_SLASHINGS_VECTOR
+# long — NOT registry-axis, deliberately absent)
+REGISTRY_FIELDS = {"validators", "balances", "inactivity_scores",
+                   "previous_epoch_participation",
+                   "current_epoch_participation"}
+
+# calls whose result is a full registry-axis column (store accessors,
+# the mesh placement/unshard surface)
+_COL_CALL_TAILS = {"registry", "registry_writable", "balances",
+                   "inactivity_scores", "participation",
+                   "registry_of", "u64_column", "unshard",
+                   "sharded_cell", "place", "replicate"}
+
+# column parameter-name convention (the E12xx _LIVE_PARAM_NAMES
+# precedent): a helper taking one of these receives registry-axis data
+_COL_PARAM_NAMES = {"cols", "eff", "balances", "scores", "act", "ext",
+                    "aee", "wd", "sl", "part", "masks", "mask",
+                    "registry", "incl_rewards", "queue_mask",
+                    "eject_mask", "eligible_mask", "participation",
+                    "rewards", "penalties", "new_eff", "new_balances",
+                    "new_scores", "base_reward", "proposer_reward"}
+
+# bounded candidate-index parameter convention
+_IDX_PARAM_SUFFIX = "_idx"
+_IDX_PARAM_NAMES = {"idx", "indices"}
+
+# O(n) host compute seeds
+_REDUCE_TAILS = {"max", "min", "sum", "any", "all", "argmax", "argmin",
+                 "mean", "prod", "nonzero", "cumsum"}
+_NP_SCAN_TAILS = {"nonzero", "lexsort", "sort", "argsort", "unique",
+                  "cumsum", "bincount", "count_nonzero", "where",
+                  "searchsorted"}
+# passthrough wrappers: classify(x.f()) == classify(x)
+_PASSTHROUGH_TAILS = {"astype", "copy", "view", "ravel", "reshape",
+                      "asarray", "array", "ascontiguousarray"}
+_IDX_CALL_TAILS = {"union1d", "intersect1d", "setdiff1d"}
+
+# the parallel engine's lazy-import convention: ``ek = _ek()`` binds
+# the epoch-kernels module at call time (circular-import firewall), so
+# alias resolution cannot see it — resolve ``ek.X`` edges by hand
+_LAZY_ALIAS_MODULES = {"ek": "consensus_specs_tpu/ops/epoch_kernels.py"}
+
+# audit / corruption-drill branches are the byte-identity story's
+# independent recomputation — exempt from the host-work budget
+_EXEMPT_TEST_TAILS = {"audit_due", "corrupt_armed"}
+_AUDIT_FN_NAMES = {"host_recompute"}
+# the store itself is the commit boundary: its column diffing
+# (``_write_u64_list``) is the SSZ write-back contract, measured by the
+# store's own passes, not dispatch-path host work
+_EXEMPT_RELS = ("consensus_specs_tpu/state/arrays.py",)
+
+_ANNOTATION_RE = re.compile(r"#\s*speclint:\s*cost:\s*(?P<body>.+?)\s*$")
+_BOUNDED_RE = re.compile(r"#\s*speclint:\s*cost:\s*bounded\s*:")
+
+
+def _rank_join(a, b):
+    return a if a >= b else b
+
+
+def _is_registry_attr(node):
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "state"
+            and node.attr in REGISTRY_FIELDS)
+
+
+def _own_nodes(fn_node):
+    """Every AST node lexically owned by ``fn_node`` itself — nested
+    ``def``s belong to their own FunctionInfo and are not descended
+    into (their facts and call edges are theirs)."""
+    out = []
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _exempt_ranges(own):
+    """(lineno, end_lineno) spans of ``if`` statements guarded by an
+    audit/corruption-drill predicate."""
+    spans = []
+    for node in own:
+        if not isinstance(node, ast.If):
+            continue
+        for sub in ast.walk(node.test):
+            if isinstance(sub, ast.Call) \
+                    and _tail(sub) in _EXEMPT_TEST_TAILS:
+                spans.append((node.lineno,
+                              getattr(node, "end_lineno", node.lineno)))
+                break
+    return spans
+
+
+def _in_spans(lineno, spans):
+    return any(lo <= lineno <= hi for lo, hi in spans)
+
+
+class FnFacts:
+    """The per-function local cost analysis: classified facts, the
+    N1302 gather-only candidates, and the noqa-suppressed count (for
+    verdict honesty)."""
+
+    __slots__ = ("fn", "facts", "gather_only", "suppressed")
+
+    def __init__(self, fn, facts, gather_only, suppressed):
+        self.fn = fn
+        self.facts = facts              # [(lineno, rank, reportable, desc)]
+        self.gather_only = gather_only  # [(name, lineno)]
+        self.suppressed = suppressed
+
+
+class _FnScan:
+    """One forward scan over a function body: a name-taint environment
+    plus the emitted cost facts."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.env = {}
+        self.raw = {}        # lineno -> (rank, reportable, desc)
+        self._seed_params()
+        self.own = _own_nodes(fn.node)
+        self.exempt = _exempt_ranges(self.own)
+
+    def _seed_params(self):
+        for name in self.fn.params:
+            if name in _COL_PARAM_NAMES:
+                self.env[name] = COL
+            elif name.endswith(_IDX_PARAM_SUFFIX) \
+                    or name in _IDX_PARAM_NAMES:
+                self.env[name] = IDX
+
+    # -- taint classification ----------------------------------------------
+
+    def classify(self, node):
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id)
+        if isinstance(node, ast.Attribute):
+            if _is_registry_attr(node):
+                return COL
+            base = self.classify(node.value)
+            if base in (COL, PARTIAL) and node.attr in ("size", "shape"):
+                return NLIKE
+            return None
+        if isinstance(node, (ast.Tuple, ast.List)):
+            best = None
+            for elt in node.elts:
+                got = self.classify(elt)
+                best = self._join_class(best, got)
+            return best
+        if isinstance(node, ast.Subscript):
+            base = self.classify(node.value)
+            if base == COL:
+                sl = node.slice
+                if isinstance(sl, ast.Constant):
+                    # cols["eff"] stays a column; eff[3] is a lane scalar
+                    return COL if isinstance(sl.value, str) else None
+                if self.classify(sl) == IDX:
+                    return IDX          # bounded gather
+                if self.classify(sl) == COL:
+                    return IDX          # masked selection (fact emitted)
+                if isinstance(sl, ast.Slice):
+                    return COL
+                return None
+            if base in (PARTIAL, IDX, NLIKE):
+                return base
+            return None
+        if isinstance(node, (ast.BinOp, ast.Compare, ast.BoolOp,
+                             ast.UnaryOp, ast.IfExp)):
+            best = None
+            for child in ast.iter_child_nodes(node):
+                best = self._join_class(best, self.classify(child))
+            return best
+        if isinstance(node, ast.Call):
+            return self._classify_call(node)
+        return None
+
+    @staticmethod
+    def _join_class(a, b):
+        order = {None: 0, NLIKE: 1, IDX: 2, PARTIAL: 3, COL: 4, PROG: 5}
+        return a if order.get(a, 0) >= order.get(b, 0) else b
+
+    def _classify_call(self, node):
+        tail = _tail(node)
+        if tail == "sequence_items":
+            if node.args and _is_registry_attr(node.args[0]):
+                return COL
+            return None
+        if tail in _COL_CALL_TAILS:
+            return COL
+        if tail is not None and (tail.startswith("_p_")
+                                 or tail == "_program"):
+            return PROG
+        f = node.func
+        if isinstance(f, ast.Call):
+            inner = _tail(f)
+            if inner is not None and (inner.startswith("_p_")
+                                      or inner == "_program"):
+                return PARTIAL          # _p_x(mesh)(cols...) called direct
+        if isinstance(f, ast.Name) and self.env.get(f.id) == PROG:
+            return PARTIAL              # prog = _p_x(mesh); prog(cols...)
+        if tail in _IDX_CALL_TAILS:
+            return IDX
+        if tail == "nonzero":
+            return IDX
+        if tail in _PASSTHROUGH_TAILS:
+            if isinstance(f, ast.Attribute) and _owner(node) not in (
+                    "np", "numpy", "jnp"):
+                return self.classify(f.value)
+            if node.args:
+                return self.classify(node.args[0])
+            return None
+        if tail == "tolist" and isinstance(f, ast.Attribute):
+            return self.classify(f.value)
+        if tail == "len" and node.args:
+            if self.classify(node.args[0]) == COL:
+                return NLIKE
+        return None
+
+    # -- environment (two forward passes handle late bindings) -------------
+
+    def build_env(self):
+        assigns = sorted(
+            (n for n in self.own
+             if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign))),
+            key=lambda n: (n.lineno, n.col_offset))
+        for _ in range(2):
+            for node in assigns:
+                value = node.value
+                if value is None:
+                    continue
+                cls = self.classify(value)
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for target in targets:
+                    self._bind(target, cls, value)
+
+    def _bind(self, target, cls, value):
+        if isinstance(target, ast.Name):
+            if cls is not None:
+                self.env[target.id] = cls
+            elif isinstance(value, ast.Call):
+                tail = _tail(value)
+                if tail == "len" or tail in _REDUCE_TAILS:
+                    pass                # scalars stay untainted
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, cls, value)
+
+    # -- fact emission ------------------------------------------------------
+
+    def _emit(self, node, rank, reportable, desc):
+        lineno = getattr(node, "lineno", None)
+        if lineno is None:
+            return
+        if _in_spans(lineno, self.exempt):
+            return
+        prev = self.raw.get(lineno)
+        if prev is None or (rank, reportable) > (prev[0], prev[1]):
+            self.raw[lineno] = (rank, reportable, desc)
+
+    def scan(self):
+        self.build_env()
+        for node in self.own:
+            if isinstance(node, ast.Call):
+                self._scan_call(node)
+            elif isinstance(node, (ast.BinOp, ast.Compare, ast.BoolOp)):
+                if isinstance(node, ast.Compare) \
+                        and all(isinstance(op, (ast.Is, ast.IsNot))
+                                for op in node.ops):
+                    # `col is None` is an O(1) pointer identity check,
+                    # never an elementwise broadcast
+                    continue
+                cls = self.classify(node)
+                if cls == COL:
+                    self._emit(node, ON, True,
+                               "full-column elementwise compute")
+                elif cls == PARTIAL:
+                    self._emit(node, OS, True,
+                               "per-shard partial reduction")
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, ast.Load):
+                if self.classify(node.value) == COL \
+                        and self.classify(node.slice) == COL:
+                    self._emit(node, ON, True,
+                               "full-column masked selection")
+            elif isinstance(node, ast.For):
+                self._scan_loop(node.iter, node)
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.DictComp, ast.GeneratorExp)):
+                for gen in node.generators:
+                    self._scan_loop(gen.iter, node)
+        return self.raw
+
+    def _scan_call(self, node):
+        tail = _tail(node)
+        f = node.func
+        if isinstance(f, ast.Attribute) and tail in _REDUCE_TAILS:
+            base = self.classify(f.value)
+            if base == COL:
+                self._emit(node, ON, True,
+                           f"full-column .{tail}() reduction")
+            elif base == PARTIAL:
+                self._emit(node, OS, True,
+                           f"per-shard partial .{tail}() reduction")
+        if tail in _NP_SCAN_TAILS and isinstance(f, ast.Attribute):
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            cls = None
+            for arg in args:
+                cls = self._join_class(cls, self.classify(arg))
+            if cls == COL:
+                self._emit(node, ON, True, f"full-column np.{tail}() scan")
+            elif cls == PARTIAL:
+                self._emit(node, OS, True,
+                           f"per-shard partial np.{tail}() scan")
+
+    def _scan_loop(self, iter_node, site):
+        cls = self.classify(iter_node)
+        if cls == COL:
+            self._emit(site, ON, True, "per-validator loop")
+        elif cls == PARTIAL:
+            self._emit(site, OS, True, "per-shard loop")
+        elif isinstance(iter_node, ast.Call) \
+                and _tail(iter_node) == "enumerate" and iter_node.args:
+            self._scan_loop(iter_node.args[0], site)
+
+    # -- N1302: full-column derivations consumed only via bounded gathers ---
+
+    def gather_only_defs(self, scan_nodes):
+        """Assigned names whose RHS is a full-column elementwise
+        derivation and whose every load is a bounded-index subscript
+        (or an operand of another qualifying derivation — chains like
+        ``base_reward`` -> ``proposer_reward`` qualify together)."""
+        defs = {}
+        for node in self.own:
+            if not isinstance(node, ast.Assign) \
+                    or len(node.targets) != 1 \
+                    or not isinstance(node.targets[0], ast.Name):
+                continue
+            if _in_spans(node.lineno, self.exempt):
+                continue
+            value = node.value
+            if isinstance(value, (ast.BinOp, ast.Compare)) \
+                    and self.classify(value) == COL:
+                defs[node.targets[0].id] = (node.lineno, value)
+        if not defs:
+            return []
+        # parent links over the scan universe (the fn body plus nested
+        # defs that are NOT audit closures — the audit recomputation is
+        # exempt and must not disqualify a candidate)
+        parents = {}
+        for node in scan_nodes:
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+        rhs_nodes = {name: {id(n) for n in ast.walk(value)}
+                     for name, (_, value) in defs.items()}
+        qualified = set(defs)
+        changed = True
+        while changed:
+            changed = False
+            for name in sorted(qualified):
+                for node in scan_nodes:
+                    if not (isinstance(node, ast.Name) and node.id == name
+                            and isinstance(node.ctx, ast.Load)):
+                        continue
+                    parent = parents.get(id(node))
+                    if isinstance(parent, ast.Subscript) \
+                            and parent.value is node \
+                            and self.classify(parent.slice) == IDX:
+                        continue        # bounded gather
+                    if any(id(node) in rhs_nodes[other]
+                           for other in qualified if other != name):
+                        continue        # chained derivation
+                    if id(node) in rhs_nodes[name]:
+                        continue        # its own definition
+                    qualified.discard(name)
+                    changed = True
+                    break
+                if name not in qualified:
+                    continue
+        return sorted((name, defs[name][0]) for name in qualified)
+
+
+def _scan_universe(fn_node):
+    """The N1302 load-scan universe: the body plus nested defs, minus
+    audit closures."""
+    out = []
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in _AUDIT_FN_NAMES:
+            continue
+        out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _line_suppressed(lines, lineno, code):
+    if 1 <= lineno <= len(lines):
+        codes = noqa_codes(lines[lineno - 1])
+        if codes is not None and (not codes or code in codes):
+            return True
+    return False
+
+
+class CostAnalysis:
+    """Whole-program cost summaries, findings and verdicts.  Build once
+    per run (the pass memoizes on the Context)."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.graph = ctx.project_graph()
+        self._local_memo = {}
+        self._edge_memo = {}
+        self._pinned = {}
+        self._pin_programs()
+        self.entries = self._find_entries()
+        self.summaries = self._solve()
+        self._reach = None
+
+    # -- pins ---------------------------------------------------------------
+
+    def _pin_programs(self):
+        """shard_map program bodies (and their module-local closures)
+        carry the column work at O(n/S) per shard — pinned, never
+        expanded, never reported."""
+        for rel in self.graph.modules:
+            if not rel.startswith("consensus_specs_tpu/parallel/"):
+                continue
+            tree = self.ctx.tree(rel)
+            if tree is None:
+                continue
+            for prog in find_shard_programs(rel, tree):
+                for fn_node in prog.closure:
+                    info = self.graph._fn_of_node.get(id(fn_node))
+                    if info is not None:
+                        self._pinned[info] = (ONS, O1)
+        for fn in self.graph.functions:
+            if fn.name in _AUDIT_FN_NAMES or fn.rel in _EXEMPT_RELS:
+                self._pinned.setdefault(fn, (O1, O1))
+
+    # -- local analysis -----------------------------------------------------
+
+    def _local(self, fn):
+        got = self._local_memo.get(fn)
+        if got is not None:
+            return got
+        scan = _FnScan(fn)
+        raw = scan.scan()
+        lines = self.ctx.source(fn.rel).split("\n")
+        facts, suppressed = [], 0
+        for lineno in sorted(raw):
+            rank, reportable, desc = raw[lineno]
+            if reportable and _line_suppressed(lines, lineno, "N1301"):
+                suppressed += 1
+                continue
+            facts.append((lineno, rank, reportable, desc))
+        gather_only = [
+            (name, lineno)
+            for name, lineno in scan.gather_only_defs(
+                _scan_universe(fn.node))
+            if not _line_suppressed(lines, lineno, "N1302")]
+        got = FnFacts(fn, facts, gather_only, suppressed)
+        self._local_memo[fn] = got
+        return got
+
+    # -- call edges ---------------------------------------------------------
+
+    def _edges(self, fn):
+        """Cost-analysis call edges: resolved calls outside exempt
+        branches, ``spec.*`` unions dropped (the spec helper surface is
+        not engine host work), plus function references passed as
+        arguments (the ``_supervised(..., fast_fn)`` convention) and
+        lexical nesting."""
+        cached = self._edge_memo.get(fn)
+        if cached is not None:
+            return cached
+        graph = self.graph
+        mod = graph.modules.get(fn.rel)
+        own = _own_nodes(fn.node)
+        exempt = _exempt_ranges(own)
+        out = set()
+        for node in own:
+            if not isinstance(node, ast.Call):
+                continue
+            if _in_spans(node.lineno, exempt):
+                continue
+            if _owner(node) == "spec":
+                continue
+            lazy_rel = _LAZY_ALIAS_MODULES.get(_owner(node))
+            if lazy_rel is not None:
+                lazy_mod = graph.modules.get(lazy_rel)
+                meth = _tail(node)
+                if lazy_mod is not None and meth in lazy_mod.funcs:
+                    out.add(lazy_mod.funcs[meth])
+            if mod is not None:
+                out.update(graph._resolve_call(mod, fn, node))
+                for arg in list(node.args) \
+                        + [kw.value for kw in node.keywords]:
+                    if isinstance(arg, (ast.Name, ast.Attribute)):
+                        if isinstance(arg, ast.Attribute) \
+                                and isinstance(arg.value, ast.Name) \
+                                and arg.value.id == "spec":
+                            continue
+                        fake = ast.Call(func=arg, args=[], keywords=[])
+                        out.update(graph._resolve_call(mod, fn, fake))
+        for child, parent in graph._parents.items():
+            if parent is fn:
+                out.add(child)
+        out.discard(fn)
+        self._edge_memo[fn] = out
+        return out
+
+    # -- interprocedural solve ---------------------------------------------
+
+    def _solve(self):
+        def transfer(fn, get):
+            pin = self._pinned.get(fn)
+            if pin is not None:
+                return pin
+            loc = self._local(fn)
+            total = host = O1
+            for _, rank, reportable, _ in loc.facts:
+                total = _rank_join(total, rank)
+                if reportable:
+                    host = _rank_join(host, rank)
+            for callee in self._edges(fn):
+                got = get(callee)
+                if got is None:
+                    continue
+                total = _rank_join(total, got[0])
+                host = _rank_join(host, got[1])
+            return (total, host)
+
+        return solve(self.graph.functions, self._edges, transfer)
+
+    # -- reachability -------------------------------------------------------
+
+    def _find_entries(self):
+        entries = []
+        seen = set()
+        for rel in sorted(self.graph.modules):
+            if not rel.startswith("consensus_specs_tpu/parallel/"):
+                continue
+            tree = self.ctx.tree(rel)
+            if tree is None:
+                continue
+            ents, _ = _dispatch_entries(tree)
+            for fn_node, sub, _ in ents:
+                info = self.graph._fn_of_node.get(id(fn_node))
+                if info is None or (rel, sub, info) in seen:
+                    continue
+                seen.add((rel, sub, info))
+                entries.append((rel, sub, info))
+        return entries
+
+    def _closure(self, roots):
+        """BFS over cost edges; pinned functions (programs, audit
+        closures, the store) are reached but never expanded."""
+        seen = set()
+        stack = list(roots)
+        while stack:
+            fn = stack.pop()
+            if fn in seen:
+                continue
+            seen.add(fn)
+            if fn in self._pinned:
+                continue
+            stack.extend(self._edges(fn) - seen)
+        return seen
+
+    def reachable(self):
+        if self._reach is None:
+            self._reach = self._closure(
+                [info for _, _, info in self.entries])
+        return self._reach
+
+    # -- findings -----------------------------------------------------------
+
+    def findings(self):
+        out = []
+        reach = self.reachable()
+        for fn in sorted(reach, key=lambda f: (f.rel, f.node.lineno)):
+            if fn in self._pinned:
+                continue
+            loc = self._local(fn)
+            for lineno, rank, reportable, desc in loc.facts:
+                if reportable and rank == ON:
+                    out.append(Finding(
+                        fn.rel, lineno, "N1301",
+                        f"O(n) host work in mesh dispatch path "
+                        f"({fn.name}): {desc} — reduce per-shard "
+                        f"partials on device and read O(S) elements "
+                        f"on the host"))
+            for name, lineno in loc.gather_only:
+                out.append(Finding(
+                    fn.rel, lineno, "N1302",
+                    f"full-column derivation `{name}` is only consumed "
+                    f"through bounded index gathers — gather the "
+                    f"candidate lanes first and compute on "
+                    f"O(candidates) elements"))
+        out.extend(self._cache_findings(reach))
+        out.extend(self._annotation_findings())
+        return out
+
+    def _cache_findings(self, reach):
+        """N1303: unbounded module-dict growth from dispatch paths."""
+        out = []
+        reach_by_rel = {}
+        for fn in reach:
+            reach_by_rel.setdefault(fn.rel, set()).add(fn)
+        for rel, fns in sorted(reach_by_rel.items()):
+            tree = self.ctx.tree(rel)
+            if tree is None:
+                continue
+            lines = self.ctx.source(rel).split("\n")
+            dicts, evicted = {}, set()
+            for node in tree.body:
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    value = node.value
+                    if isinstance(value, ast.Dict) or (
+                            isinstance(value, ast.Call)
+                            and _tail(value) == "dict"):
+                        dicts[node.targets[0].id] = node.lineno
+            if not dicts:
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Delete):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Subscript) \
+                                and isinstance(tgt.value, ast.Name):
+                            evicted.add(tgt.value.id)
+                elif isinstance(node, ast.Call) \
+                        and _tail(node) in ("pop", "clear", "popitem") \
+                        and isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Name):
+                    evicted.add(node.func.value.id)
+            for name, def_line in sorted(dicts.items()):
+                if name in evicted:
+                    continue
+                if any(_BOUNDED_RE.search(lines[i])
+                       for i in (def_line - 1, def_line - 2)
+                       if 0 <= i < len(lines)):
+                    continue
+                for fn in sorted(fns, key=lambda f: f.node.lineno):
+                    if fn in self._pinned:
+                        continue
+                    store = self._dict_store(fn, name)
+                    if store is None:
+                        continue
+                    if _line_suppressed(lines, store, "N1303"):
+                        continue
+                    out.append(Finding(
+                        rel, store, "N1303",
+                        f"unbounded growth of module cache `{name}` "
+                        f"from a dispatch path (no eviction in the "
+                        f"module) — evict, bound, or annotate the dict "
+                        f"with `# speclint: cost: bounded: <reason>`"))
+        return out
+
+    @staticmethod
+    def _dict_store(fn, name):
+        """First non-constant-key store into module dict ``name``
+        inside ``fn``'s own body, or None."""
+        for node in _own_nodes(fn.node):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript) \
+                            and isinstance(tgt.value, ast.Name) \
+                            and tgt.value.id == name \
+                            and not isinstance(tgt.slice, ast.Constant):
+                        return node.lineno
+            elif isinstance(node, ast.Call) \
+                    and _tail(node) == "setdefault" \
+                    and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == name:
+                if node.args \
+                        and not isinstance(node.args[0], ast.Constant):
+                    return node.lineno
+        return None
+
+    def _annotation_findings(self):
+        """N1304: checked ``# speclint: cost: O(...)`` annotations."""
+        out = []
+        lines_of = {}
+        for fn in self.graph.functions:
+            lines = lines_of.get(fn.rel)
+            if lines is None:
+                lines = self.ctx.source(fn.rel).split("\n")
+                lines_of[fn.rel] = lines
+            ann = None
+            for i in (fn.node.lineno - 1, fn.node.lineno - 2):
+                if 0 <= i < len(lines):
+                    m = _ANNOTATION_RE.search(lines[i])
+                    if m is not None:
+                        ann = m.group("body")
+                        break
+            if ann is None or ann.lstrip().startswith("bounded"):
+                continue
+            declared = _BOUND_OF.get(ann.replace(" ", "").upper())
+            if declared is None:
+                out.append(Finding(
+                    fn.rel, fn.node.lineno, "N1304",
+                    f"unparseable cost annotation {ann!r} — expected "
+                    f"one of O(1), O(log n), O(S), O(n/S), O(n)"))
+                continue
+            host = self.summaries.get(fn, (O1, O1))[1]
+            if host > declared:
+                out.append(Finding(
+                    fn.rel, fn.node.lineno, "N1304",
+                    f"cost annotation claims {RANK_NAMES[declared]} "
+                    f"host work for {fn.name} but the prover derives "
+                    f"{RANK_NAMES[host]}"))
+        return out
+
+    # -- verdicts -----------------------------------------------------------
+
+    def verdicts(self):
+        """One line per dispatch path: the proven host-work budget.
+        ``[FAIL]`` when any reportable O(n) fact is reachable."""
+        lines = []
+        for rel, sub, info in sorted(
+                self.entries, key=lambda e: (e[0], e[1])):
+            worst, site, suppressed = O1, None, 0
+            for fn in self._closure([info]):
+                if fn in self._pinned:
+                    continue
+                loc = self._local(fn)
+                suppressed += loc.suppressed
+                for lineno, rank, reportable, desc in loc.facts:
+                    if reportable and rank > worst:
+                        worst = rank
+                        site = (fn.rel, lineno, desc)
+            mod = rel.rsplit("/", 1)[-1]
+            note = f" ({suppressed} suppressed site(s))" \
+                if suppressed else ""
+            if worst <= OS:
+                lines.append(
+                    f"[PROVEN] {mod}: {sub}: host work "
+                    f"{RANK_NAMES[worst]}{note}")
+            else:
+                lines.append(
+                    f"[FAIL] {mod}: {sub}: host work "
+                    f"{RANK_NAMES[worst]} — {site[2]} at "
+                    f"{site[0]}:{site[1]}{note}")
+        return lines

@@ -294,9 +294,25 @@ def _effect_verdicts(ctx):
     return 1 if failed else 0
 
 
+def _cost_verdicts(ctx):
+    """Print the N13xx host-work budget proofs (one line per dispatch
+    path); nonzero exit on any FAIL line so CI gates on the O(S)
+    invariant directly."""
+    from .passes import cost as cost_pass
+    failed = False
+    for line in cost_pass.verdict_report(ctx):
+        print(line)
+        if "[FAIL]" in line:
+            failed = True
+    return 1 if failed else 0
+
+
 def _git_changed(root):
-    """Repo-relative paths dirty vs the git index (staged, unstaged and
-    untracked), or None when git is unavailable."""
+    """``(changed, stale)`` repo-relative path sets vs the git index —
+    ``changed`` is every dirty path that still exists (staged, unstaged
+    and untracked); ``stale`` is every path that no longer does (the
+    old side of a rename, a deletion) and whose cached findings must be
+    purged.  None when git is unavailable."""
     import subprocess
     try:
         # --untracked-files=all: a brand-new directory must list every
@@ -310,15 +326,20 @@ def _git_changed(root):
         return None
     if proc.returncode != 0:
         return None
-    changed = set()
+    changed, stale = set(), set()
     for line in proc.stdout.splitlines():
         if len(line) <= 3:
             continue
-        path = line[3:]
+        status, path = line[:2], line[3:]
         if " -> " in path:      # renames report "old -> new"
-            path = path.split(" -> ")[-1]
-        changed.add(path.strip().strip('"'))
-    return changed
+            old, path = path.split(" -> ", 1)
+            stale.add(old.strip().strip('"'))
+        path = path.strip().strip('"')
+        if "D" in status:       # deleted (either index side): the path
+            stale.add(path)     # is gone — cached findings are stale
+        else:
+            changed.add(path)
+    return changed, stale - changed
 
 
 def _fix(ctx):
@@ -361,6 +382,10 @@ def main(argv=None):
                         help="print the E12xx effect proofs (commit-"
                              "scope discipline, psum census, write "
                              "orderings) and exit")
+    parser.add_argument("--cost-verdicts", action="store_true",
+                        help="print the N13xx host-work budget proofs "
+                             "(per-dispatch-path asymptotic cost over "
+                             "the registry axis) and exit")
     parser.add_argument("--changed", action="store_true",
                         help="lint only files dirty vs the git index "
                              "(the pre-commit developer loop); tree "
@@ -381,14 +406,20 @@ def main(argv=None):
         return _range_verdicts(ctx)
     if args.effect_verdicts:
         return _effect_verdicts(ctx)
+    if args.cost_verdicts:
+        return _cost_verdicts(ctx)
+    stale_paths = ()
     if args.changed:
-        changed = _git_changed(ctx.root)
-        if changed is None:
+        got = _git_changed(ctx.root)
+        if got is None:
             print("speclint --changed: git unavailable or not a work "
                   "tree — linting everything")
         else:
+            changed, stale_paths = got
             ctx.changed_only = changed
-            print(f"speclint --changed: {len(changed)} dirty path(s)")
+            print(f"speclint --changed: {len(changed)} dirty path(s)"
+                  + (f", {len(stale_paths)} removed"
+                     if stale_paths else ""))
     pass_names = None if args.passes is None \
         else {p.strip() for p in args.passes.split(",") if p.strip()}
     if pass_names is not None:
@@ -400,6 +431,10 @@ def main(argv=None):
     if not args.no_incremental:
         analysis_cache = AnalysisCache(
             os.path.join(ctx.root, CACHE_NAME), _pass_salt())
+        for rel in stale_paths:
+            # a renamed-away or deleted file must not keep serving
+            # cached findings for a path that no longer exists
+            analysis_cache.drop_file(rel)
     findings = run_passes(ctx, pass_names, cache=analysis_cache)
     if analysis_cache is not None:
         analysis_cache.save()
@@ -421,7 +456,10 @@ def main(argv=None):
     new, baselined, stale = apply_baseline(findings, baseline, prefixes)
     if args.format == "sarif":
         from . import sarif
-        print(sarif.render(new, baselined))
+        # a --changed run's missing findings are scope, not fixes —
+        # only a full run may declare baseline entries absent
+        print(sarif.render(new, baselined,
+                           stale if not args.changed else ()))
         return 1 if new else 0
     for f in new:
         print(f.render_github() if args.format == "github" else f.render())

@@ -5,10 +5,10 @@ and ``run(ctx)``; file-granular passes additionally expose
 the incremental cache; tree-granular passes are cached on the
 whole-tree fingerprint."""
 from . import (  # noqa: F401
-    coverage, determinism, durability, effects, fallbacks, rangeproof,
-    supervision, uint64, tracing, ladder, obs, specmd, state_layer,
-    style)
+    cost, coverage, determinism, durability, effects, fallbacks,
+    rangeproof, supervision, uint64, tracing, ladder, obs, specmd,
+    state_layer, style)
 
 ALL_PASSES = (style, uint64, rangeproof, tracing, ladder, specmd, obs,
               state_layer, fallbacks, supervision, durability,
-              determinism, coverage, effects)
+              determinism, coverage, effects, cost)

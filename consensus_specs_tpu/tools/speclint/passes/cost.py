@@ -1,0 +1,74 @@
+"""cost pass (N13xx): static asymptotic-cost & scaling proofs.
+
+ROADMAP item 1 names the 10M-registry wall: the epoch reductions are
+SPMD, but exact overflow guards and eligibility candidate sets used to
+run one numpy pass over the full registry on the host.  This pass
+(engine in ``cost.py``) proves the scaling contract statically: every
+function gets a symbolic cost summary over the registry axis from the
+lattice {O(1), O(log n), O(S), O(n/S), O(n)}, solved interprocedurally
+on the speclint v2 dataflow framework, and every ``parallel/`` dispatch
+path must stay within an O(S) host-work budget — the host reads
+per-shard *partials*, the shard programs own the O(n) at O(n/S) each.
+
+* N1301 — O(n) host work (full-column reduction/elementwise/scan, a
+  per-validator loop) reachable between mesh dispatch and commit.
+  Audit branches, corruption drills and ``host_recompute`` closures
+  are exempt: they are the byte-identity story's independent
+  recomputation.  The store (``state/arrays.py``) is the commit
+  boundary and is measured by its own contracts.
+* N1302 — a full-column elementwise derivation consumed only through
+  bounded index gathers (gather the candidates first).
+* N1303 — unbounded module-cache growth reachable from dispatch paths
+  (no eviction, no ``# speclint: cost: bounded: <reason>``).
+* N1304 — a checked ``# speclint: cost: O(...)`` annotation the prover
+  cannot verify.
+
+Baseline: zero findings.  Positive proofs print one line per dispatch
+path via ``speclint --cost-verdicts`` (CI-gated); the runtime twin is
+the ``mesh.host_partials`` counter census asserted by
+``benchmarks/bench_mesh.py``.
+"""
+from .. import cost
+
+NAME = "cost"
+CODE_PREFIXES = ("N13",)
+VERSION = 1
+GRANULARITY = "tree"
+# dependency-granular cache inputs: the analysis reads the project
+# graph's source universe only (tools/ excluded exactly as the graph
+# excludes it) — edits to tests/, benchmarks/ or docs leave the cached
+# result warm
+INPUT_PREFIXES = ("consensus_specs_tpu/",)
+INPUT_EXCLUDE = ("consensus_specs_tpu/tools/",)
+
+
+def _analysis(ctx):
+    memo = getattr(ctx, "_cost_memo", None)
+    if memo is None:
+        memo = cost.CostAnalysis(ctx)
+        ctx._cost_memo = memo
+    return memo
+
+
+def run(ctx):
+    return _analysis(ctx).findings()
+
+
+def verdict_report(ctx):
+    """The per-dispatch-path host-work budget (--cost-verdicts)."""
+    lines = ["== host-work budget (per dispatch path) =="]
+    lines.extend(_analysis(ctx).verdicts())
+    return lines
+
+
+def check_tree(root):
+    """Fixture-corpus convenience (mirrors effects.check_tree)."""
+    from ..driver import Context
+    return run(Context(root))
+
+
+def analysis_for(root):
+    """Fixture/non-vacuity convenience: the full CostAnalysis for a
+    tree (summaries + facts, not just findings)."""
+    from ..driver import Context
+    return _analysis(Context(root))

@@ -123,11 +123,37 @@ def _result(finding, baseline_state):
     }
 
 
-def to_sarif(new, baselined, tool_version="2"):
-    """A SARIF 2.1.0 log dict for one speclint run."""
-    codes = sorted({f.code for f in new} | {f.code for f in baselined})
+def _absent_result(path, code):
+    """A synthetic result for a baseline entry no longer reported —
+    ``baselineState: "absent"`` lets a SARIF consumer (GitHub code
+    scanning) auto-close the fixed alert.  The baseline records only
+    ``path::CODE`` keys, so the message and line are synthesized."""
+    return {
+        "ruleId": code,
+        "level": "none",
+        "message": {"text": f"previously-baselined {code} finding in "
+                            f"{path} is no longer reported (fixed)"},
+        "baselineState": "absent",
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": path},
+                "region": {"startLine": 1},
+            },
+        }],
+    }
+
+
+def to_sarif(new, baselined, stale=(), tool_version="2"):
+    """A SARIF 2.1.0 log dict for one speclint run.  ``stale``:
+    ``path::CODE`` baseline keys whose findings are gone — emitted
+    with ``baselineState: "absent"``."""
+    absent = [key.rsplit("::", 1) for key in stale
+              if "::" in key]
+    codes = sorted({f.code for f in new} | {f.code for f in baselined}
+                   | {code for _, code in absent})
     results = [_result(f, "new") for f in new] \
-        + [_result(f, "unchanged") for f in baselined]
+        + [_result(f, "unchanged") for f in baselined] \
+        + [_absent_result(path, code) for path, code in absent]
     return {
         "$schema": SARIF_SCHEMA_URI,
         "version": SARIF_VERSION,
@@ -149,8 +175,8 @@ def to_sarif(new, baselined, tool_version="2"):
     }
 
 
-def render(new, baselined) -> str:
-    return json.dumps(to_sarif(new, baselined), indent=1)
+def render(new, baselined, stale=()) -> str:
+    return json.dumps(to_sarif(new, baselined, stale), indent=1)
 
 
 def validate(log) -> list:

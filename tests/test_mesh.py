@@ -153,8 +153,15 @@ def test_psum_census_matches_budget():
                  u64, u64, u64, bl, u8, scal) == 1
     assert psums(mesh_epoch._p_masked_sums(mesh),
                  u64, np.zeros((4, n), dtype=bool)) == 1
-    assert psums(mesh_epoch._p_registry_scan(mesh, (2**64 - 1, 32, 16)),
-                 u64, u64, u64, u64, scal) == 1
+    assert psums(mesh_epoch._p_active_sums(mesh, 3),
+                 u64, u64, u64, np.zeros((3, n), dtype=bool), scal) == 1
+    assert psums(mesh_epoch._p_active_sums(mesh, 0),
+                 u64, u64, u64, scal) == 1
+    assert psums(mesh_epoch._p_registry_scan(
+        mesh, (2**64 - 1, 32, 16, 256)), u64, u64, u64, u64, scal) == 1
+    # the per-shard stat stacks (exact guard maxima) are pure partials:
+    # the host reduces over S elements, the device never communicates
+    assert psums(mesh_epoch._p_shard_stats(mesh, 2), u64, u64) == 0
     assert psums(mesh_epoch._p_altair_deltas(
         mesh, (False, (14, 26, 14), 64, 10**9, 2, 1)),
         u64, u64, u64, bl, u64, u8, u64, u64, scal) == 0
@@ -239,6 +246,32 @@ def test_guard_fallback_counted_and_identical():
     with counting() as delta:
         spec.process_rewards_and_penalties(s_mesh)
     assert delta["mesh.epoch.fallbacks{reason=guard}"] == 1
+    assert hash_tree_root(s_loop) == hash_tree_root(s_mesh)
+
+
+def test_scan_overflow_declines_counted_and_identical(monkeypatch):
+    """A registry-eligibility family outgrowing the bounded per-shard
+    index buffers declines the mesh dispatch (counted
+    mesh.scan_overflow — a degradation-ladder leg, never a truncation)
+    and the columnar engine serves the call byte-identically."""
+    _require_mesh()
+    spec, state = _altair_state("altair", seed=37)
+    far = spec.FAR_FUTURE_EPOCH
+    for i in range(4):                     # guaranteed queue candidates
+        v = state.validators[i]
+        v.activation_eligibility_epoch = far
+        v.activation_epoch = far
+        v.exit_epoch = far
+    s_loop, s_mesh = state.copy(), state.copy()
+    ek.use_loops()
+    spec.process_registry_updates(s_loop)
+    ek.use_vectorized()
+    mesh_state.use_mesh()
+    monkeypatch.setattr(mesh_epoch, "_SCAN_CAP", 1)
+    with counting() as delta:
+        spec.process_registry_updates(s_mesh)
+    assert delta["mesh.scan_overflow"] == 1
+    assert delta["mesh.epoch{path=mesh}"] == 0
     assert hash_tree_root(s_loop) == hash_tree_root(s_mesh)
 
 

@@ -808,9 +808,11 @@ def test_sarif_real_run_validates():
     log = sarif.to_sarif(new, baselined)
     assert log["version"] == "2.1.0"
     assert sarif.validate(log) == []
-    # the recorded debt must surface as unchanged results
+    # the recorded debt must surface as unchanged results (plus any
+    # `absent` markers for baseline keys whose findings are fixed)
     states = {r["baselineState"] for r in log["runs"][0]["results"]}
-    assert states <= {"new", "unchanged"} and "unchanged" in states
+    assert states <= {"new", "unchanged", "absent"} \
+        and "unchanged" in states
     rule_ids = {r["id"] for r in log["runs"][0]["tool"]["driver"]["rules"]}
     assert {r["ruleId"] for r in log["runs"][0]["results"]} <= rule_ids
 
@@ -1528,7 +1530,7 @@ def test_tree_cache_dependency_granularity(tmp_path):
         os.path.join(str(root), sl_cache.CACHE_NAME), driver._pass_salt())
     driver.run_passes(ctx, cache=cache)
     assert cache.stats["tree_misses"] == 1     # coverage only
-    assert cache.stats["tree_hits"] == 3       # ladder/determinism/effects
+    assert cache.stats["tree_hits"] == 4       # ladder/determinism/effects/cost
 
 
 def test_warm_lint_time_budget(tmp_path):
@@ -1645,3 +1647,272 @@ def test_changed_mode_sees_untracked_directories(tmp_path, capsys):
     out = capsys.readouterr().out
     assert rc == 1
     assert "consensus_specs_tpu/parallel/newpkg/kernels.py" in out
+
+
+# ---------------------------------------------------------------------------
+# N13xx cost pass: asymptotic host-work proofs over the registry axis
+# ---------------------------------------------------------------------------
+
+from consensus_specs_tpu.tools.speclint.passes import cost as cost_pass
+
+_CX_ON_BODY = (
+    "        cols = sa.registry()\n"
+    "        eff = cols['eff']\n"
+    "        total = eff.sum()\n"
+    "        return True\n")
+
+_CX_OS_BODY = (
+    "        parts = np.asarray(_p_stats(None)(sa.registry()))\n"
+    "        total = parts.max()\n"
+    "        return True\n")
+
+_CX_ENGINE = (
+    "import numpy as np\n"
+    "def _dispatch(spec, state, sub, fast):\n"
+    "    return fast(spec, state, None)\n"
+    "def _p_stats(mesh):\n"
+    "    def build():\n"
+    "        def local(eff):\n"
+    "            return eff\n"
+    "        return local\n"
+    "    return build()\n"
+    "def try_demo(spec, state):\n"
+    "    def fast(spec, state, sa):\n"
+    "{body}"
+    "    return _dispatch(spec, state, 'demo', fast)\n")
+
+_CX_REL = "consensus_specs_tpu/parallel/demo_engine.py"
+
+
+def _cx_tree(tmp_path, body=_CX_ON_BODY, prefix="", rel=_CX_REL):
+    root = tmp_path / "repo"
+    _write(root, rel, prefix + _CX_ENGINE.format(body=body))
+    return str(root)
+
+
+def test_n1301_column_reduce_in_dispatch_path(tmp_path):
+    findings = cost_pass.check_tree(_cx_tree(tmp_path))
+    assert "N1301" in _codes(findings)
+    (f,) = [f for f in findings if f.code == "N1301"]
+    assert f.path == _CX_REL
+    verdicts = cost_pass.analysis_for(_cx_tree(tmp_path)).verdicts()
+    assert any("[FAIL]" in v and "demo" in v for v in verdicts)
+
+
+def test_n1301_partial_reduce_is_proven(tmp_path):
+    root = _cx_tree(tmp_path, body=_CX_OS_BODY)
+    assert _codes(cost_pass.check_tree(root)) == []
+    verdicts = cost_pass.analysis_for(root).verdicts()
+    assert any("[PROVEN]" in v and "O(S)" in v for v in verdicts)
+
+
+def test_n1301_noqa_suppresses_and_counts(tmp_path):
+    body = _CX_ON_BODY.replace("eff.sum()", "eff.sum()  # noqa: N1301")
+    root = _cx_tree(tmp_path, body=body)
+    assert _codes(cost_pass.check_tree(root)) == []
+    verdicts = cost_pass.analysis_for(root).verdicts()
+    assert any("[PROVEN]" in v and "suppressed" in v for v in verdicts)
+
+
+def test_n1301_interprocedural_through_function_arg(tmp_path):
+    # the _supervised(..., fast_fn) convention: the O(n) body is only
+    # reachable through a function REFERENCE passed as an argument
+    body = "        return _run(spec, state, _worker)\n"
+    prefix = (
+        "def _worker(spec, state, sa):\n"
+        "    eff = u64_column(state)\n"
+        "    return int(eff.sum())\n"
+        "def _run(spec, state, fn):\n"
+        "    return fn(spec, state, None)\n")
+    findings = cost_pass.check_tree(
+        _cx_tree(tmp_path, body=body, prefix=prefix))
+    assert "N1301" in _codes(findings)
+    (f,) = [f for f in findings if f.code == "N1301"]
+    assert "_worker" in f.message
+
+
+def test_n1301_audit_branch_is_exempt(tmp_path):
+    body = (
+        "        if supervisor.audit_due('demo'):\n"
+        "            g = sa.registry()['eff'].sum()\n"
+        "        return True\n")
+    assert _codes(cost_pass.check_tree(_cx_tree(tmp_path, body=body))) \
+        == []
+
+
+def test_n1302_gather_only_column_derivation(tmp_path):
+    body = (
+        "        eff = sa.registry()['eff']\n"
+        "        base = eff * np.uint64(64)\n"
+        "        src_idx = np.nonzero(state.flags)[0]\n"
+        "        out = base[src_idx]\n"
+        "        return True\n")
+    codes = _codes(cost_pass.check_tree(_cx_tree(tmp_path, body=body)))
+    assert "N1302" in codes
+
+
+def test_n1303_unbounded_cache_and_bounded_annotation(tmp_path):
+    body = (
+        "        _CACHE[(id(spec), id(state))] = 1\n"
+        "        return True\n")
+    prefix = "_CACHE = {}\n"
+    codes = _codes(cost_pass.check_tree(
+        _cx_tree(tmp_path, body=body, prefix=prefix)))
+    assert "N1303" in codes
+    bounded = "# speclint: cost: bounded: one probe pair\n" + prefix
+    assert "N1303" not in _codes(cost_pass.check_tree(
+        _cx_tree(tmp_path, body=body, prefix=bounded)))
+
+
+def test_n1303_evicted_cache_is_clean(tmp_path):
+    body = (
+        "        _CACHE.pop(None, None)\n"
+        "        _CACHE[(id(spec), id(state))] = 1\n"
+        "        return True\n")
+    assert "N1303" not in _codes(cost_pass.check_tree(
+        _cx_tree(tmp_path, body=body, prefix="_CACHE = {}\n")))
+
+
+def test_n1304_checked_annotations(tmp_path):
+    # an O(1) claim on an O(n) path fails; an honest O(n) claim and a
+    # matching O(S) claim both verify; a malformed bound is reported
+    over = _cx_tree(tmp_path, prefix="")
+    src = open(os.path.join(over, _CX_REL)).read()
+    with open(os.path.join(over, _CX_REL), "w") as f:
+        f.write(src.replace("def try_demo(spec, state):\n",
+                            "# speclint: cost: O(1)\n"
+                            "def try_demo(spec, state):\n"))
+    findings = cost_pass.check_tree(over)
+    assert any(f.code == "N1304" and "O(n)" in f.message
+               for f in findings)
+    with open(os.path.join(over, _CX_REL), "w") as f:
+        f.write(src.replace("def try_demo(spec, state):\n",
+                            "# speclint: cost: O(n)\n"
+                            "def try_demo(spec, state):\n"))
+    assert "N1304" not in _codes(cost_pass.check_tree(over))
+    with open(os.path.join(over, _CX_REL), "w") as f:
+        f.write(src.replace("def try_demo(spec, state):\n",
+                            "# speclint: cost: O(n^2)\n"
+                            "def try_demo(spec, state):\n"))
+    assert any(f.code == "N1304" and "unparseable" in f.message
+               for f in findings + cost_pass.check_tree(over))
+
+
+def test_cost_real_tree_baseline_zero():
+    """Acceptance: the REAL tree carries zero unsuppressed N13xx debt
+    (the baseline records none), and every dispatch path proves O(S)."""
+    assert cost_pass.check_tree(REPO) == []
+    verdicts = cost_pass.analysis_for(REPO).verdicts()
+    assert len(verdicts) >= 5
+    assert all("[PROVEN]" in v for v in verdicts)
+    assert not any("[FAIL]" in v for v in verdicts)
+
+
+def test_cost_real_tree_proofs_nonvacuous():
+    """The proofs must be doing work on the real tree: the shard
+    programs pin at O(n/S), and at least one dispatch path reduces a
+    per-shard partial stack (an O(S) fact on parallel/)."""
+    from consensus_specs_tpu.tools.speclint import cost as cost_core
+    a = cost_pass.analysis_for(REPO)
+    assert any(total == cost_core.ONS
+               for total, _ in a.summaries.values())
+    os_facts = 0
+    for fn in a.reachable():
+        if fn in a._pinned or not fn.rel.startswith(
+                "consensus_specs_tpu/parallel/"):
+            continue
+        for _, rank, reportable, _ in a._local(fn).facts:
+            if reportable and rank == cost_core.OS:
+                os_facts += 1
+    assert os_facts >= 1
+
+
+def test_cost_verdicts_cli(capsys):
+    assert driver.main([REPO, "--cost-verdicts"]) == 0
+    out = capsys.readouterr().out
+    assert "host-work budget" in out
+    assert "[PROVEN]" in out and "[FAIL]" not in out
+
+
+# ---------------------------------------------------------------------------
+# SARIF baselineState: "absent" (fixed baseline debt)
+# ---------------------------------------------------------------------------
+
+def test_sarif_absent_for_stale_baseline_keys():
+    log = sarif.to_sarif([], [], stale=["consensus_specs_tpu/x.py::U101"])
+    assert sarif.validate(log) == []
+    (result,) = log["runs"][0]["results"]
+    assert result["baselineState"] == "absent"
+    assert result["ruleId"] == "U101"
+    assert result["level"] == "none"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "consensus_specs_tpu/x.py"
+    assert loc["region"]["startLine"] == 1
+    rule_ids = {r["id"] for r in log["runs"][0]["tool"]["driver"]["rules"]}
+    assert "U101" in rule_ids
+
+
+def test_sarif_driver_emits_absent_for_fixed_debt(tmp_path, capsys):
+    """End-to-end: a baseline entry whose finding is gone surfaces as a
+    schema-valid `absent` result in `--format sarif`."""
+    root = tmp_path / "repo"
+    _write(root, SCOPED, "def f(seq):\n    return u64_column(seq)\n")
+    _write(root, driver.BASELINE_NAME, json.dumps(
+        {"counts": {SCOPED + "::U101": 1}}))
+    rc = driver.main([str(root), "--passes", "uint64",
+                      "--format", "sarif"])
+    assert rc == 0
+    log = json.loads(capsys.readouterr().out)
+    assert sarif.validate(log) == []
+    states = [r["baselineState"] for r in log["runs"][0]["results"]]
+    assert states == ["absent"]
+
+
+# ---------------------------------------------------------------------------
+# --changed vs renamed / deleted dirty files
+# ---------------------------------------------------------------------------
+
+def test_changed_mode_purges_renamed_and_deleted(tmp_path, capsys):
+    """Review regression: a dirty rename (R old -> new) or delete (D)
+    must purge the OLD path's cached findings — a stale cache entry
+    would otherwise resurrect findings for a file that no longer
+    exists."""
+    if shutil.which("git") is None:
+        import pytest
+        pytest.skip("git unavailable")
+    root = tmp_path / "repo"
+    buggy = ("def f(seq):\n"
+             "    b = u64_column(seq)\n"
+             "    p = u64_column(seq)\n"
+             "    return b - p\n")
+    old_rel = "consensus_specs_tpu/parallel/old_kernels.py"
+    dead_rel = "consensus_specs_tpu/parallel/dead_kernels.py"
+    new_rel = "consensus_specs_tpu/parallel/new_kernels.py"
+    _write(root, old_rel, buggy)
+    _write(root, dead_rel, buggy)
+    for cmd in (["git", "init", "-q"],
+                ["git", "config", "user.email", "t@t"],
+                ["git", "config", "user.name", "t"],
+                ["git", "add", "-A"],
+                ["git", "commit", "-qm", "seed"]):
+        subprocess.run(cmd, cwd=str(root), check=True)
+    # warm the cache with both files' findings
+    assert driver.main([str(root), "--no-baseline"]) == 1
+    capsys.readouterr()
+    cache_path = os.path.join(str(root), sl_cache.CACHE_NAME)
+    cache = sl_cache.AnalysisCache(cache_path, driver._pass_salt())
+    assert old_rel in cache._data["files"]
+    assert dead_rel in cache._data["files"]
+    # dirty: rename one file (staged, R entry), delete the other
+    subprocess.run(["git", "mv", old_rel, new_rel], cwd=str(root),
+                   check=True)
+    os.remove(os.path.join(str(root), dead_rel))
+    rc = driver.main([str(root), "--changed", "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert new_rel in out
+    assert old_rel not in out and dead_rel not in out
+    cache = sl_cache.AnalysisCache(cache_path, driver._pass_salt())
+    assert old_rel not in cache._data["files"]
+    assert dead_rel not in cache._data["files"]
+    assert new_rel in cache._data["files"]
