@@ -10,6 +10,7 @@ GENERATORS = operations sanity finality rewards random forks epoch_processing \
         bench-forkchoice-smoke bench-obs-smoke bench-block-smoke \
         bench-state-smoke bench-supervisor-smoke bench-das-smoke \
         bench-mesh-smoke bench-recovery-smoke bench-sanitizer-smoke \
+        bench-serving-smoke \
         sim-smoke sim-heavy \
         obs-report dryrun warm native lint lint-changed lint-verdicts \
         speclint-baseline \
@@ -40,6 +41,7 @@ citest:
 	$(PYTHON) benchmarks/bench_mesh.py
 	$(PYTHON) benchmarks/bench_recovery.py
 	$(PYTHON) benchmarks/bench_sanitizer.py
+	$(PYTHON) benchmarks/bench_serving.py --smoke
 	$(MAKE) sim-smoke
 	$(PYTHON) -m pytest tests/ -q --enable-bls --bls-type fastest
 
@@ -199,6 +201,20 @@ bench-das-smoke:
 bench-mesh-smoke:
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		$(PYTHON) benchmarks/bench_mesh.py
+
+# block-serving pipeline smoke (docs/serving.md): the pipelined lane
+# (window batching + overlapped RLC flush + chunk-level clones) must
+# replay the captured adversarial load streams byte-identical to the
+# synchronous per-block lane (deep store digests + per-block verdicts),
+# fold to EXACTLY one pairing per window (bls.pairings ==
+# serving.windows, strictly below the sync lane's per-block count),
+# keep the one-commit-per-epoch census lane-identical under overlap,
+# and sustain strictly more slots/sec; chunk-level clone_state must
+# beat state.copy() root-identically.  Native build is best-effort —
+# the lanes degrade together to a slower signature backend without it.
+bench-serving-smoke:
+	-$(MAKE) native
+	$(PYTHON) benchmarks/bench_serving.py --smoke
 
 # durable-replay smoke (docs/recovery.md): checkpoint save/restore +
 # journal tail replay round-trip byte-identical (counter-asserted:

@@ -69,6 +69,7 @@ SITES = (
     "mesh.merkle",
     "recovery.checkpoint",
     "recovery.restore",
+    "serving.pipeline",
 )
 
 # Site-family -> the CS_TPU_* switch that turns the family's engine
@@ -85,6 +86,7 @@ SITE_SWITCHES = {
     "das.": "CS_TPU_DAS",
     "mesh.": "CS_TPU_MESH",
     "recovery.": "CS_TPU_CHECKPOINT",
+    "serving.": "CS_TPU_SERVING",
 }
 
 _active = None      # the armed schedule; None = disarmed (the hot path)

@@ -71,6 +71,17 @@ def to_prometheus() -> str:
                         f'{pname}_bucket{{{lb}le="{le}"}} {cum}')
                 lines.append(f"{pname}_sum{labels} {value['sum']}")
                 lines.append(f"{pname}_count{labels} {value['count']}")
+                # bucket-interpolated quantile summaries (computed at
+                # export time, not stored): one gauge line per q so
+                # dashboards get p50/p90/p99 without a PromQL
+                # histogram_quantile over the raw buckets
+                for q, pkey in (("0.5", "p50"), ("0.9", "p90"),
+                                ("0.99", "p99")):
+                    est = value.get(pkey)
+                    if est is not None:
+                        lb = labels[1:-1] + "," if labels else ""
+                        lines.append(
+                            f'{pname}_quantile{{{lb}q="{q}"}} {est}')
             else:
                 lines.append(f"{pname}{labels} {value}")
     flat = tracing.stats()
@@ -122,9 +133,13 @@ def report() -> str:
         for suffix, value in m["series"].items():
             if m["type"] == "histogram":
                 if value["count"]:
+                    qs = "".join(
+                        f" {k}={value[k]:.4f}"
+                        for k in ("p50", "p90", "p99")
+                        if isinstance(value.get(k), (int, float)))
                     rows.append((name + suffix,
                                  f"count={value['count']} "
-                                 f"sum={value['sum']:.4f} "
+                                 f"sum={value['sum']:.4f}{qs} "
                                  f"max={value['max']:.4f}"))
             elif value:
                 rows.append((name + suffix, _fmt_count(value)))
@@ -170,6 +185,15 @@ def schema_problems(snap) -> list:
                 if not isinstance(value, dict) or "count" not in value:
                     probs.append(f"metric {name!r}{suffix}: bad "
                                  "histogram value")
+                elif value["count"]:
+                    qs = [value.get(k) for k in ("p50", "p90", "p99")]
+                    if any(not isinstance(q, (int, float)) for q in qs):
+                        probs.append(f"metric {name!r}{suffix}: missing "
+                                     "quantile summaries")
+                    elif not (value["min"] <= qs[0] <= qs[1] <= qs[2]
+                              <= value["max"]):
+                        probs.append(f"metric {name!r}{suffix}: quantile "
+                                     f"ordering violated ({qs})")
             elif not isinstance(value, (int, float)):
                 probs.append(f"metric {name!r}{suffix}: non-numeric value")
     spans = snap.get("spans")

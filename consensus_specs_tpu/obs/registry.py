@@ -111,12 +111,40 @@ class _HistogramSeries:
         self.min = None
         self.max = None
 
+    def quantile(self, q: float):
+        """Bucket-interpolated quantile estimate (the
+        ``histogram_quantile`` rule: linear within the landing bucket),
+        sharpened by the tracked ``min``/``max`` — the first bucket
+        interpolates up from the true minimum, the overflow bucket from
+        its lower bound to the true maximum, and the result is clamped
+        to the observed range.  None when nothing was observed."""
+        if self.count == 0:
+            return None
+        rank = q * self.count
+        cum = 0
+        for i, n in enumerate(self.counts):
+            if n == 0:
+                continue
+            if cum + n >= rank:
+                lo = self.min if i == 0 else self.buckets[i - 1]
+                hi = self.max if i == len(self.buckets) else self.buckets[i]
+                lo = max(lo, self.min)
+                hi = min(hi, self.max)
+                if hi <= lo:
+                    return lo
+                frac = (rank - cum) / n
+                return min(max(lo + (hi - lo) * frac, self.min), self.max)
+            cum += n
+        return self.max
+
     def _value(self):
         # bucket keys as strings ("0.1" ... "+Inf"): keeps the snapshot
         # JSON-sortable and maps 1:1 onto Prometheus ``le`` label values
         keys = [str(b) for b in self.buckets] + ["+Inf"]
         return {"count": self.count, "sum": self.sum,
                 "min": self.min, "max": self.max,
+                "p50": self.quantile(0.5), "p90": self.quantile(0.9),
+                "p99": self.quantile(0.99),
                 "buckets": dict(zip(keys, self.counts))}
 
 

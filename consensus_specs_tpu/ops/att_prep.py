@@ -44,13 +44,19 @@ _C_PREPARED = obs_registry.counter("att_prep.prepared").labels()
 _C_HITS = obs_registry.counter("att_prep.hits").labels()
 _C_MISSES = obs_registry.counter("att_prep.misses").labels()
 
-# one block's worth of {key: signing root bytes};
-# replaced wholesale by the next prepare call (bounded by MAX_ATTESTATIONS)
+# one prepare's worth of {key: signing root bytes} — a single block's
+# attestations, or a whole serving window's (several in-flight blocks +
+# the loose attestation stream); replaced wholesale by the next prepare
+# call (bounded by MAX_ATTESTATIONS x window)
 _table = {}
-# identity of the attestation list the table was built from: fork
+# identities of the attestation lists the table was built from: fork
 # overrides chain process_operations through super(), so the inner
-# (wrapped) call would otherwise re-prepare the same block
-_prepared_src = None
+# (wrapped) call would otherwise re-prepare the same block, and a
+# window prepare covers every block body it batched — the per-block
+# wrapper calls inside that window skip straight to the lookups.  The
+# list holds STRONG references: ``is`` identity is only meaningful
+# while the prepared lists stay alive.
+_prepared_srcs = []
 
 
 # the exact AttestationData layout the chunk cube is built for (the
@@ -74,30 +80,18 @@ def _key(state, data):
             bytes(state.genesis_validators_root))
 
 
-def prepare_block_attestations(spec, state, attestations) -> None:
-    """Batch-compute checkpoint/data/signing roots for every
-    attestation in the block body, poke the container-root memos, and
-    (re)fill the signing-root lookup.  Idempotent per list identity
-    (nested ``super().process_operations`` chains prepare once); a
-    stale skip can only cause lookup misses, never wrong hits — the
-    lookup key re-derives the fork/genesis identity from the querying
-    state."""
-    global _table, _prepared_src
-    if _prepared_src is attestations:
-        return
-    _prepared_src = attestations
-    _table = {}
-    n = len(attestations)
+def _prepare(spec, state, datas):
+    """The five batched hash dispatches over ``datas`` (any number of
+    blocks' worth, concatenated): poke the container-root memos and
+    return the {key: signing root} table — or None when the layout gate
+    trips (the legacy sharding lineage appends shard_transition_root;
+    the 5-field chunk cube below would compute, and memo-poke, wrong
+    container roots for that layout)."""
+    n = len(datas)
     if n == 0:
-        return
-    if tuple(type(attestations[0].data)._fields) != _PHASE0_DATA_FIELDS:
-        # the legacy sharding lineage appends shard_transition_root:
-        # the 5-field chunk cube below would compute (and memo-poke)
-        # wrong container roots for that layout.  Leave the table
-        # empty — every lookup misses into the spec body
-        return
-    _C_BLOCKS.add()
-    datas = [a.data for a in attestations]
+        return {}
+    if tuple(type(datas[0])._fields) != _PHASE0_DATA_FIELDS:
+        return None
 
     # checkpoint roots: rows [0:n] = sources, [n:2n] = targets
     ck = np.zeros((2 * n, 64), dtype=np.uint8)
@@ -155,8 +149,52 @@ def prepare_block_attestations(spec, state, attestations) -> None:
         object.__setattr__(d.source, "_root_cache", ckr[i].tobytes())
         object.__setattr__(d.target, "_root_cache", ckr[n + i].tobytes())
         table[_key(state, d)] = signing[i].tobytes()
+    return table
+
+
+def prepare_block_attestations(spec, state, attestations) -> None:
+    """Batch-compute checkpoint/data/signing roots for every
+    attestation in the block body, poke the container-root memos, and
+    (re)fill the signing-root lookup.  Idempotent per list identity
+    (nested ``super().process_operations`` chains prepare once, and a
+    window prepare covers its block bodies); a stale skip can only
+    cause lookup misses, never wrong hits — the lookup key re-derives
+    the fork/genesis identity from the querying state."""
+    global _table, _prepared_srcs
+    for src in _prepared_srcs:
+        if src is attestations:
+            return
+    _prepared_srcs = [attestations]
+    table = _prepare(spec, state, [a.data for a in attestations])
+    _table = table or {}
+    if table:
+        _C_BLOCKS.add()
+        _C_PREPARED.add(len(attestations))
+
+
+def prepare_window_attestations(spec, state, groups) -> None:
+    """Cross-block batching entry (the serving pipeline): prepare the
+    attestation messages of every in-flight block body — plus any loose
+    attestation stream — in ONE set of batched dispatches instead of
+    one per block.  ``groups`` is a list of attestation lists; block
+    bodies passed here are remembered by identity so the per-block
+    ``process_operations`` wrapper calls inside the window skip their
+    own prepare.  ``state`` only feeds the fork-version/genesis lookup
+    identity and the per-epoch domains, so any state of the same chain
+    serves; across a fork boundary the keys simply miss into the spec
+    body (never a wrong hit)."""
+    global _table, _prepared_srcs
+    groups = [g for g in groups if len(g) > 0]
+    if not groups:
+        return
+    datas = [a.data for g in groups for a in g]
+    table = _prepare(spec, state, datas)
+    if table is None:
+        return
+    _prepared_srcs = list(groups)
     _table = table
-    _C_PREPARED.add(n)
+    _C_BLOCKS.add(len(groups))
+    _C_PREPARED.add(len(datas))
 
 
 def lookup_signing_root(state, data):

@@ -1,0 +1,9 @@
+"""Block-serving pipeline (``CS_TPU_SERVING``): window-batched
+optimistic block delivery with double-buffered flush overlap
+(:mod:`.pipeline`) and chunk-level whole-state snapshots
+(:mod:`.clone`).  See ``docs/serving.md``."""
+
+from consensus_specs_tpu.serving.clone import clone_state
+from consensus_specs_tpu.serving.pipeline import BlockServer
+
+__all__ = ["BlockServer", "clone_state"]

@@ -1,0 +1,423 @@
+"""Block-serving pipeline: window-batched optimistic delivery with the
+RLC/merkle flush overlapped into a worker lane.
+
+The synchronous serving path (``sim/driver.py`` delivery semantics)
+interleaves three very different cost classes per block: the Python
+state transition, the deferred-batch RLC flush (Fiat-Shamir fold → MSM
+→ one pairing), and the post-state merkleization that fork choice and
+the sentinel audits read.  :class:`BlockServer` restructures that into
+a two-deep pipeline over fixed-size event windows:
+
+* **window batching** — ingested events (ticks, blocks, attestations,
+  attester slashings) buffer until ``CS_TPU_SERVING_WINDOW`` blocks are
+  in flight, then the whole window is processed optimistically: every
+  signature verification lands in ONE :class:`_WindowBatch` (so sibling
+  blocks carrying the same attestations — equivocation streams, reorg
+  races — dedup into one RLC term), and every block body's attestation
+  messages are prepared in one cross-block columnar pass
+  (:func:`~consensus_specs_tpu.ops.att_prep.prepare_window_attestations`).
+* **flush overlap** — the window's combined flush runs on a worker
+  thread while the MAIN thread transitions the next window and
+  merkleizes its post-states.  The crypto verdict is resolved one
+  window late (a barrier join before the next submit), which is the
+  double-buffering: device/crypto work for window N-1 overlaps host
+  transition + tree maintenance for window N.  Spec code never runs off
+  the main thread — the worker executes pure verification.
+* **chunk-level snapshots** — each accepted post-state stored into
+  ``store.block_states`` is swapped for a :func:`clone_state` snapshot,
+  so the per-block whole-state copy (and every child's pre-state copy
+  off it) costs what a column fork costs instead of an O(n) walk.
+
+**Deferred-verdict semantics**: within a window, block acceptance is
+optimistic — signature failures surface at the window barrier, not at
+the ingest call.  On any barrier failure (flush verdict False, injected
+fault, deadline, audit mismatch) the store is rolled back from a
+journal snapshot (newest window first), the fork-choice engine is
+rebuilt from the rolled-back store, and the SAME events are replayed
+through the synchronous per-block path — so the post-drain store is
+byte-identical to a synchronous run by construction, and per-block
+errors land exactly where the spec path raises them.  The fallback is
+counted per reason under ``serving.fallbacks`` and feeds the breaker
+(:func:`supervisor.admit`) like every other engine site.
+"""
+import threading
+import time
+
+from consensus_specs_tpu import faults, supervisor
+from consensus_specs_tpu.forkchoice import proto_array
+from consensus_specs_tpu.obs import registry as obs_registry
+from consensus_specs_tpu.obs import tracing
+from consensus_specs_tpu.ops import att_prep
+from consensus_specs_tpu.serving.clone import clone_state
+from consensus_specs_tpu.utils import bls, env_flags
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+_C_WINDOWS = obs_registry.counter("serving.windows").labels()
+_C_BLOCKS_PIPE = obs_registry.counter("serving.blocks").labels(path="pipelined")
+_C_BLOCKS_SYNC = obs_registry.counter("serving.blocks").labels(path="sync")
+_FALLBACKS = {
+    "injected": obs_registry.counter("serving.fallbacks").labels(reason="injected"),
+    "deadline": obs_registry.counter("serving.fallbacks").labels(reason="deadline"),
+    "reverify": obs_registry.counter("serving.fallbacks").labels(reason="reverify"),
+}
+_H_LATENCY = obs_registry.histogram("serving.ingest_latency").labels()
+
+# the sim driver's delivery contract: these reject a block/attestation
+# without poisoning the store (sim/driver.py _REJECTED)
+_REJECTED = (AssertionError, IndexError, KeyError, ValueError)
+
+_DEFAULT_WINDOW = 4
+
+
+def _window_depth() -> int:
+    raw = env_flags.knob("CS_TPU_SERVING_WINDOW", str(_DEFAULT_WINDOW))
+    try:
+        return max(1, int(raw))
+    except (TypeError, ValueError):
+        return _DEFAULT_WINDOW
+
+
+class _WindowBatch(bls.DeferredBatch):
+    """A deferred batch that stays queued across the per-block
+    ``assert_valid()`` calls inside ``on_block``: while ``_deferring``
+    is set, ``flush()`` reports optimistic success without draining, so
+    every block of the window folds into the ONE real flush issued by
+    :meth:`resolve` at the window barrier (one pairing per window, and
+    cross-block dedup of repeated (message, signature) terms)."""
+
+    _deferring = True
+
+    def flush(self):
+        if self._deferring:
+            return True
+        return super().flush()
+
+    def resolve(self):
+        """The window's single real flush (worker lane)."""
+        self._deferring = False
+        return bls.DeferredBatch.flush(self)
+
+
+class _Window:
+    __slots__ = ("events", "journal", "batch", "accepted", "thread",
+                 "outcome")
+
+    def __init__(self, events, journal):
+        self.events = events
+        self.journal = journal
+        self.batch = _WindowBatch()
+        self.accepted = []          # roots accepted by the optimistic pass
+        self.thread = None
+        self.outcome = None         # True | False | BaseException
+
+
+# -- store journal ----------------------------------------------------------
+
+_CHECKPOINT_FIELDS = ("justified_checkpoint", "finalized_checkpoint",
+                      "unrealized_justified_checkpoint",
+                      "unrealized_finalized_checkpoint")
+# add-only maps (or re-delivery overwrites with value-identical entries):
+# rollback = delete the keys the window added
+_GROW_ONLY_MAPS = ("blocks", "block_states", "checkpoint_states",
+                   "unrealized_justifications")
+
+
+def _snapshot(store) -> dict:
+    """Rollback journal for one optimistic window.  ``latest_messages``
+    and ``block_timeliness`` are journaled as full dict copies — their
+    VALUES get overwritten in place (a newer vote replaces an index's
+    LatestMessage; a re-delivered block can re-score timeliness) — while
+    the grow-only maps only need their key sets."""
+    j = {
+        "time": store.time,
+        "proposer_boost_root": store.proposer_boost_root,
+        "equivocating_indices": set(store.equivocating_indices),
+        "latest_messages": dict(store.latest_messages),
+        "block_timeliness": dict(store.block_timeliness),
+    }
+    for name in _CHECKPOINT_FIELDS:
+        j[name] = getattr(store, name).copy()
+    for name in _GROW_ONLY_MAPS:
+        j[name] = set(getattr(store, name))
+    return j
+
+
+def _rollback(store, j) -> None:
+    store.time = j["time"]
+    store.proposer_boost_root = j["proposer_boost_root"]
+    store.equivocating_indices = set(j["equivocating_indices"])
+    store.latest_messages = dict(j["latest_messages"])
+    store.block_timeliness = dict(j["block_timeliness"])
+    for name in _CHECKPOINT_FIELDS:
+        setattr(store, name, j[name].copy())
+    for name in _GROW_ONLY_MAPS:
+        d = getattr(store, name)
+        keep = j[name]
+        for k in [k for k in d if k not in keep]:
+            del d[k]
+
+
+# -- delivery ---------------------------------------------------------------
+
+def _deliver_block_ops(spec, store, signed) -> None:
+    # accepting a block implies delivering its attestations and
+    # attester slashings (the sim driver's contract — both lanes must
+    # mirror it for byte-identical stores)
+    for attestation in signed.message.body.attestations:
+        try:
+            spec.on_attestation(store, attestation, is_from_block=True)
+        except _REJECTED:
+            pass
+    for slashing in signed.message.body.attester_slashings:
+        try:
+            spec.on_attester_slashing(store, slashing)
+        except _REJECTED:
+            pass
+
+
+def _deliver_sync(spec, store, events, results) -> None:
+    """The synchronous reference path: per-event delivery with the
+    spec-default (per-block) signature verification."""
+    for ev in events:
+        kind = ev[0]
+        if kind == "block":
+            signed = ev[1]
+            root = bytes(hash_tree_root(signed.message))
+            try:
+                spec.on_block(store, signed)
+            except _REJECTED as exc:
+                results[root] = (False, exc)
+            else:
+                results[root] = (True, None)
+                _deliver_block_ops(spec, store, signed)
+            if ev[2] is not None:
+                _H_LATENCY.observe(time.perf_counter() - ev[2])
+        elif kind == "tick":
+            spec.on_tick(store, ev[1])
+        elif kind == "attestation":
+            try:
+                spec.on_attestation(store, ev[1], is_from_block=False)
+            except _REJECTED:
+                pass
+        else:
+            try:
+                spec.on_attester_slashing(store, ev[1])
+            except _REJECTED:
+                pass
+
+
+def _tamper(state) -> None:
+    # deterministic silent corruption for the harness corrupt leg: bump
+    # one balance through the SSZ write path so every root memo above
+    # it clears — the sentinel audit must catch a REAL divergence
+    state.balances[0] = state.balances[0] + 1
+
+
+class BlockServer:
+    """Event-ordered block serving over a fork-choice ``store``.
+
+    Feed it the same event stream the synchronous path would see —
+    :meth:`on_tick`, :meth:`ingest` (blocks), :meth:`on_attestation`,
+    :meth:`on_attester_slashing` — in delivery order, then
+    :meth:`drain`.  With ``CS_TPU_SERVING`` on, delivery is pipelined
+    (window batching + overlapped flush + chunk-level snapshots); off,
+    or on breaker/fault/deadline/audit failure, every event goes
+    through the synchronous path — the post-drain store is
+    byte-identical either way, only the error-surfacing point moves
+    (window barrier vs ingest call)."""
+
+    def __init__(self, spec, store, window=None):
+        self.spec = spec
+        self.store = store
+        self.window = int(window) if window else _window_depth()
+        self.results = {}           # block root -> (accepted, error|None)
+        self._events = []
+        self._pending_blocks = 0
+        self._inflight = None
+
+    # -- event intake ------------------------------------------------------
+
+    def on_tick(self, t) -> None:
+        self._events.append(("tick", int(t), None))
+
+    def on_attestation(self, attestation) -> None:
+        self._events.append(("attestation", attestation, None))
+
+    def on_attester_slashing(self, attester_slashing) -> None:
+        self._events.append(("attester_slashing", attester_slashing, None))
+
+    def ingest(self, signed_block) -> None:
+        """Queue a block (stamped for ingest-latency accounting); the
+        window is processed once ``window`` blocks are buffered."""
+        self._events.append(("block", signed_block, time.perf_counter()))
+        self._pending_blocks += 1
+        if self._pending_blocks >= self.window:
+            self._flush_events()
+
+    def drain(self) -> dict:
+        """Process any partial window and resolve the in-flight flush;
+        returns {block_root: (accepted, error|None)} for every block."""
+        if self._events:
+            self._flush_events()
+        self._resolve_inflight()
+        return dict(self.results)
+
+    # -- window machinery --------------------------------------------------
+
+    def _flush_events(self) -> None:
+        events, self._events = self._events, []
+        self._pending_blocks = 0
+        self._process_window(events)
+
+    def _process_window(self, events) -> None:
+        spec, store = self.spec, self.store
+        site = "serving.pipeline"
+        nblocks = sum(1 for ev in events if ev[0] == "block")
+        if not (env_flags.switch("CS_TPU_SERVING")
+                and supervisor.admit(site)):
+            self._resolve_inflight()
+            _deliver_sync(spec, store, events, self.results)
+            _C_BLOCKS_SYNC.add(nblocks)
+            return
+        journal = None
+        try:
+            faults.check(site)
+            journal = _snapshot(store)
+            with tracing.span("serving.window"), \
+                    supervisor.deadline_scope(site):
+                win = self._run_optimistic(events, journal)
+        except (faults.InjectedFault, supervisor.DeadlineExceeded) as exc:
+            if journal is not None:
+                _rollback(store, journal)
+                proto_array.attach_store_accel(spec, store)
+            self._resolve_inflight()
+            faults.count_fallback(_FALLBACKS, exc, organic="reverify",
+                                  site=site)
+            _deliver_sync(spec, store, events, self.results)
+            _C_BLOCKS_SYNC.add(nblocks)
+            return
+        if win.accepted and faults.corrupt_armed(site):
+            _tamper(store.block_states[win.accepted[-1]])
+        if self._resolve_inflight(extra=win):
+            self._submit(win)
+
+    def _run_optimistic(self, events, journal) -> "_Window":
+        spec, store = self.spec, self.store
+        win = _Window(events, journal)
+        results = self.results
+        # cross-block message prep: ONE columnar pass over every
+        # in-flight block body plus the loose attestation stream,
+        # keyed off a committed same-chain state (fork-boundary keys
+        # miss into the spec body, never wrong-hit)
+        groups = [ev[1].message.body.attestations
+                  for ev in events if ev[0] == "block"]
+        loose = [ev[1] for ev in events if ev[0] == "attestation"]
+        if loose:
+            groups.append(loose)
+        anchor = store.block_states.get(
+            bytes(store.justified_checkpoint.root))
+        if anchor is not None and groups:
+            att_prep.prepare_window_attestations(spec, anchor, groups)
+        with bls.scoped_batch(win.batch):
+            for ev in events:
+                supervisor.deadline_check()
+                kind = ev[0]
+                if kind == "block":
+                    signed = ev[1]
+                    root = bytes(hash_tree_root(signed.message))
+                    try:
+                        spec.on_block(store, signed)
+                    except _REJECTED as exc:
+                        results[root] = (False, exc)
+                    else:
+                        # swap the stored post-state for a chunk-level
+                        # snapshot: children's pre-state copies (and
+                        # checkpoint-state copies) become column-fork
+                        # cheap.  The swap touches a key this window
+                        # added, so rollback stays delete-the-added-keys.
+                        store.block_states[root] = clone_state(
+                            store.block_states[root])
+                        results[root] = (True, None)
+                        win.accepted.append(root)
+                        _deliver_block_ops(spec, store, signed)
+                elif kind == "tick":
+                    spec.on_tick(store, ev[1])
+                elif kind == "attestation":
+                    try:
+                        spec.on_attestation(store, ev[1],
+                                            is_from_block=False)
+                    except _REJECTED:
+                        pass
+                else:
+                    try:
+                        spec.on_attester_slashing(store, ev[1])
+                    except _REJECTED:
+                        pass
+        return win
+
+    def _submit(self, win) -> None:
+        """Hand the window's single combined flush to the worker lane;
+        it resolves at the NEXT window's barrier (or drain) while the
+        main thread transitions ahead — the overlap."""
+        def _run():
+            try:
+                win.outcome = win.batch.resolve()
+            except BaseException as exc:     # surfaces at the barrier
+                win.outcome = exc
+        win.thread = threading.Thread(
+            target=_run, name="serving-flush", daemon=True)
+        win.thread.start()
+        self._inflight = win
+        _C_WINDOWS.add()
+
+    def _resolve_inflight(self, extra=None) -> bool:
+        """Barrier: join the in-flight window's flush and commit or
+        unwind.  ``extra`` is the just-transitioned (not yet submitted)
+        window — on failure BOTH are rolled back, newest journal first,
+        and BOTH are replayed synchronously in order."""
+        win, self._inflight = self._inflight, None
+        if win is None:
+            return True
+        spec, store = self.spec, self.store
+        site = "serving.pipeline"
+        with tracing.span("serving.barrier"):
+            win.thread.join()
+        outcome = win.outcome
+        ok = outcome is True
+        if ok and supervisor.audit_due(site):
+            # sentinel: every accepted post-state must merkleize to the
+            # root its block committed to (catches the corrupt leg)
+            audit_ok = all(
+                bytes(hash_tree_root(store.block_states[r]))
+                == bytes(store.blocks[r].state_root)
+                for r in win.accepted)
+            supervisor.audit_result(
+                site, audit_ok,
+                "pipelined post-state diverged from block state_root")
+            ok = audit_ok
+        if ok:
+            supervisor.note_success(site)
+            now = time.perf_counter()
+            nblocks = 0
+            for ev in win.events:
+                if ev[0] == "block":
+                    nblocks += 1
+                    if ev[2] is not None:
+                        _H_LATENCY.observe(now - ev[2])
+            _C_BLOCKS_PIPE.add(nblocks)
+            return True
+        # unwind: newest journal first, rebuild the fork-choice engine
+        # from the rolled-back store, replay in original order
+        if extra is not None:
+            _rollback(store, extra.journal)
+        _rollback(store, win.journal)
+        proto_array.attach_store_accel(spec, store)
+        exc = outcome if isinstance(outcome, BaseException) else None
+        faults.count_fallback(_FALLBACKS, exc, organic="reverify",
+                              site=site)
+        replay = list(win.events)
+        if extra is not None:
+            replay += extra.events
+        _deliver_sync(spec, store, replay, self.results)
+        _C_BLOCKS_SYNC.add(sum(1 for ev in replay if ev[0] == "block"))
+        return False

@@ -70,6 +70,7 @@ SITE_COUNTER = {
     "mesh.merkle": "mesh.merkle.fallbacks{reason=injected}",
     "recovery.checkpoint": "recovery.fallbacks{reason=injected}",
     "recovery.restore": "recovery.fallbacks{reason=injected}",
+    "serving.pipeline": "serving.fallbacks{reason=injected}",
 }
 assert set(SITE_COUNTER) == set(faults.SITES)
 
@@ -98,6 +99,8 @@ ORGANIC_TWIN = {
         "mesh.epoch.fallbacks{reason=guard}",
     "recovery.fallbacks{reason=injected}":
         "recovery.fallbacks{reason=io}",
+    "serving.fallbacks{reason=injected}":
+        "serving.fallbacks{reason=reverify}",
 }
 
 

@@ -1,0 +1,123 @@
+"""Seeded concurrent-block load generator for the serving pipeline.
+
+The adversarial scenario catalog (``sim/scenarios.py``) already builds
+the exact workloads block serving is hard on — equivocating sibling
+blocks, withheld-then-released late blocks, double-vote attestation
+streams — but the scripts are driver-shaped: building the blocks needs
+a ChainSim with signing keys and tip bookkeeping.  This module runs the
+builder ONCE and captures its delivery stream through the driver's
+``event_hook`` seam: the result is a pure ordered list of
+``(kind, value)`` deliveries — ``tick`` / ``block`` / ``attestation`` /
+``attester_slashing`` — that any consumer can replay against a fresh
+anchor store without re-running block production.
+
+One captured :class:`LoadStream` is the shared source for every lane of
+a differential setup: :func:`serve` feeds it to anything with the
+``BlockServer`` event surface (the pipelined lane, or the same class
+with ``CS_TPU_SERVING=0`` as the synchronous control), and
+:func:`store_digest` reduces the resulting store to one comparable
+fingerprint — deep (every block's state root, every latest message),
+so byte-identity claims between lanes mean the whole store, not just
+the head.
+"""
+import hashlib
+
+from consensus_specs_tpu.sim import driver, scenarios
+from consensus_specs_tpu.utils.ssz import hash_tree_root
+
+# catalog entries that generate concurrent/late blocks — the serving
+# load mix (steady is the uncontended control)
+DEFAULT_MIX = ("equivocation", "exante_reorg")
+
+
+class LoadStream:
+    """A captured delivery stream plus the builder's reference result."""
+
+    __slots__ = ("name", "seed", "n_validators", "events", "result")
+
+    def __init__(self, name, seed, n_validators, events, result):
+        self.name = name
+        self.seed = seed
+        self.n_validators = n_validators
+        self.events = events            # ordered (kind, value) deliveries
+        self.result = result            # the builder's SimResult
+
+    @property
+    def n_blocks(self) -> int:
+        return sum(1 for kind, _ in self.events if kind == "block")
+
+    def describe(self) -> str:
+        return (f"{self.name}[seed={self.seed}]: {len(self.events)} events, "
+                f"{self.n_blocks} blocks, {self.n_validators} validators")
+
+
+def generate(spec, seed: int = 0, name: str = "equivocation",
+             n_validators: int = None) -> LoadStream:
+    """Build the scenario, run it once on a builder sim, and capture
+    the delivery stream.  Deterministic per (spec, seed, name)."""
+    epoch = int(spec.SLOTS_PER_EPOCH)
+    if n_validators is None:
+        n_validators = epoch * 8
+    scenario = scenarios.build(seed, epoch, n_validators, name=name)
+    if scenario.config_overrides:
+        raise ValueError(
+            f"scenario {name!r} needs config overrides; the load "
+            "generator replays against an unmodified spec")
+    sim = driver.ChainSim(spec, scenario.n_validators)
+    events = []
+    sim.event_hook = lambda kind, value: events.append((kind, value))
+    result = sim.run(scenario.script)
+    return LoadStream(name, seed, scenario.n_validators, events, result)
+
+
+def anchor_store(spec, stream: LoadStream):
+    """A fresh genesis fork-choice store matching the stream's shape —
+    each replay lane gets its own."""
+    return driver.ChainSim(spec, stream.n_validators).store
+
+
+def serve(server, stream: LoadStream) -> dict:
+    """Replay the stream through a ``BlockServer``-shaped target (the
+    pipelined lane, or the same class under ``CS_TPU_SERVING=0`` as the
+    synchronous control) and drain it.  Returns the per-block results
+    map."""
+    for kind, value in stream.events:
+        if kind == "block":
+            server.ingest(value)
+        elif kind == "tick":
+            server.on_tick(value)
+        elif kind == "attestation":
+            server.on_attestation(value)
+        else:
+            server.on_attester_slashing(value)
+    return server.drain()
+
+
+def store_digest(spec, store) -> str:
+    """Deep store fingerprint: head, every block's post-state root,
+    checkpoints, latest messages, timeliness, equivocations.  Two lanes
+    that report equal digests hold byte-identical consensus state."""
+    h = hashlib.sha256()
+
+    def put(*parts):
+        for p in parts:
+            h.update(str(p).encode("utf-8") if not isinstance(p, bytes)
+                     else p)
+            h.update(b"|")
+
+    put("time", int(store.time), "head", bytes(spec.get_head(store)))
+    for name in ("justified_checkpoint", "finalized_checkpoint",
+                 "unrealized_justified_checkpoint",
+                 "unrealized_finalized_checkpoint"):
+        ckpt = getattr(store, name)
+        put(name, int(ckpt.epoch), bytes(ckpt.root))
+    put("boost", bytes(store.proposer_boost_root))
+    for root in sorted(store.blocks):
+        put(bytes(root), bytes(hash_tree_root(store.block_states[root])))
+    for root in sorted(store.block_timeliness):
+        put(bytes(root), bool(store.block_timeliness[root]))
+    for i in sorted(store.latest_messages):
+        msg = store.latest_messages[i]
+        put(int(i), int(msg.epoch), bytes(msg.root))
+    put("equiv", sorted(int(i) for i in store.equivocating_indices))
+    return h.hexdigest()

@@ -47,12 +47,16 @@ here is a pass-through and behavior is exactly pre-PR-9.  Numeric knobs
 are read once per :func:`reset` (the sim harness resets per leg after
 applying env overrides); docs: ``docs/robustness.md``.
 
-Thread model: like ``faults``, breaker state is process-global and the
-engines run single-threaded; the disarmed/closed hot path is one env
+Thread model: like ``faults``, breaker state is process-global (its
+mutations are GIL-atomic counter adds and dict stores); the deadline
+stack is per-thread because the serving pipeline resolves supervised
+``bls.flush`` dispatches on a worker lane while the main thread keeps
+arming scopes of its own.  The disarmed/closed hot path is one env
 read plus a dict lookup.
 """
 import os
 import random
+import threading
 import time
 from contextlib import contextmanager
 
@@ -420,7 +424,19 @@ def probing() -> bool:
 # Deadline guards
 # ---------------------------------------------------------------------------
 
-_deadline_stack = []
+# Per-thread: the serving pipeline resolves supervised ``bls.flush``
+# dispatches on a worker lane while the main thread keeps arming scopes
+# around state-transition dispatches; a shared stack would interleave
+# push/pop across threads and :func:`deadline_check` would read the
+# other lane's budget.
+_deadline_local = threading.local()
+
+
+def _deadline_stack_for_thread():
+    stack = getattr(_deadline_local, "stack", None)
+    if stack is None:
+        stack = _deadline_local.stack = []
+    return stack
 
 
 @contextmanager
@@ -441,6 +457,7 @@ def deadline_scope(site: str):
         return
     start = _clock()
     entry = (site, start + budget, budget)
+    _deadline_stack = _deadline_stack_for_thread()
     _deadline_stack.append(entry)
     try:
         yield
@@ -459,7 +476,8 @@ def deadline_scope(site: str):
 def deadline_check() -> None:
     """Cooperative boundary check: raises :class:`DeadlineExceeded`
     when the innermost armed scope's budget is spent.  Disarmed cost:
-    one list truth test."""
+    one thread-local attribute read."""
+    _deadline_stack = getattr(_deadline_local, "stack", None)
     if not _deadline_stack:
         return
     site, until, budget = _deadline_stack[-1]
@@ -481,7 +499,7 @@ def reset() -> None:
     _breakers.clear()
     _audit_calls.clear()
     _audit_offsets.clear()
-    _deadline_stack.clear()
+    _deadline_stack_for_thread().clear()
     _cfg = None
     _rng = None
     _last_quarantine = None

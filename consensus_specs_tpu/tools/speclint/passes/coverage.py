@@ -67,6 +67,7 @@ ENGINE_PREFIXES = (
     "consensus_specs_tpu/utils/",
     "consensus_specs_tpu/parallel/",
     "consensus_specs_tpu/recovery/",
+    "consensus_specs_tpu/serving/",
 )
 
 _FALLBACK_CLASSES = {"InjectedFault", "_Fallback", "DeadlineExceeded"}

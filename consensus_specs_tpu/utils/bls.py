@@ -312,6 +312,30 @@ def batched_verification():
         _batch_stack.pop()
 
 
+@contextmanager
+def scoped_batch(batch):
+    """Install ``batch`` as the outermost deferred-verification scope.
+
+    The serving pipeline (``consensus_specs_tpu/serving``) uses this to
+    interpose a window-spanning :class:`DeferredBatch` subclass: every
+    nested :func:`batched_verification` context (one per ``on_block``)
+    then joins the window batch, so signature triples from several
+    in-flight blocks dedup (equivocating siblings share device lanes)
+    and fold into ONE flush at the window barrier.  Refuses to nest
+    inside an active scope — interposition means owning the outermost
+    scope, and silently joining someone else's batch would defer their
+    asserts past the point they resolve them."""
+    if _batch_stack:
+        raise RuntimeError(
+            "bls.scoped_batch: a batch scope is already active")
+    _batch_stack.append(batch)
+    try:
+        yield batch
+    finally:
+        popped = _batch_stack.pop()
+        assert popped is batch
+
+
 def defer_pairing_check(pairs, label="") -> bool:
     """Queue a raw product-pairing check ``prod e(P_i, Q_i) == 1`` (oracle
     point pairs) into the active batch context, to fold into the block's

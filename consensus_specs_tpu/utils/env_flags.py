@@ -37,6 +37,7 @@ ENGINE_SWITCHES = (
     "CS_TPU_DAS",
     "CS_TPU_MESH",
     "CS_TPU_CHECKPOINT",
+    "CS_TPU_SERVING",
 )
 
 _SWITCH_DEFAULTS = {}
@@ -175,6 +176,17 @@ MESH = os.environ.get("CS_TPU_MESH") != "0"
 # (``CS_TPU_CHECKPOINT_EVERY``, ``CS_TPU_CHECKPOINT_KEEP``) are read
 # through :func:`knob` by the sim recovery legs; docs/recovery.md.
 CHECKPOINT = os.environ.get("CS_TPU_CHECKPOINT") != "0"
+
+# Block-serving pipeline kill switch: ``CS_TPU_SERVING=0`` makes the
+# serving layer (``consensus_specs_tpu/serving``) deliver every block
+# through the synchronous per-block ``on_block`` path — no window
+# batching, no overlapped RLC flushes, no chunk-level state clones.
+# Live via :func:`switch` like the other engine flags (the off-leg CI
+# job flips it after import; a latched module constant would miss
+# that — the historical import-latched-flag class this registration
+# exists to prevent).  The window-depth knob (``CS_TPU_SERVING_WINDOW``)
+# is read through :func:`knob` by ``serving/pipeline.py``.
+SERVING = os.environ.get("CS_TPU_SERVING") != "0"
 
 # Runtime effect sanitizer: ``CS_TPU_SANITIZER=1`` arms the dynamic
 # twin of the speclint E12xx effect contracts

@@ -103,6 +103,28 @@ def test_histogram_buckets():
     assert val["buckets"] == {"0.1": 1, "1.0": 2, "+Inf": 1}
 
 
+def test_histogram_quantile_summaries():
+    h = registry.histogram("t.obs.quant", buckets=(0.1, 1.0))
+    empty = h.labels()._value()
+    assert empty["p50"] is None and empty["p90"] is None \
+        and empty["p99"] is None
+    for v in (0.05, 0.2, 0.4, 0.9, 5.0):
+        h.observe(v)
+    val = h.labels()._value()
+    assert val["min"] <= val["p50"] <= val["p90"] <= val["p99"] <= val["max"]
+    # p50 interpolates within its landing bucket; p99 within the
+    # overflow bucket, sharpened toward the tracked max
+    assert 0.1 <= val["p50"] <= 1.0
+    assert 1.0 <= val["p99"] <= 5.0
+
+
+def test_histogram_quantile_single_observation_collapses():
+    h = registry.histogram("t.obs.quant1", buckets=(1.0,))
+    h.observe(0.3)
+    val = h.labels()._value()
+    assert val["p50"] == val["p90"] == val["p99"] == 0.3
+
+
 def test_counting_delta_missing_keys_read_zero():
     c = registry.counter("t.obs.delta").labels()
     with counting() as delta:
@@ -286,6 +308,10 @@ def test_prometheus_export_format():
     # buckets are cumulative in the exposition: +Inf must equal _count
     assert 'cs_tpu_t_prom_lat_bucket{le="+Inf"} 1' in text
     assert "cs_tpu_t_prom_lat_count 1" in text
+    # per-q quantile gauge lines (single observation collapses all
+    # three to the observed value)
+    for q in ("0.5", "0.9", "0.99"):
+        assert f'cs_tpu_t_prom_lat_quantile{{q="{q}"}} 0.5' in text
 
 
 def test_json_snapshot_round_trips():
@@ -306,6 +332,23 @@ def test_schema_check_accepts_real_and_rejects_corrupt():
     assert export.schema_problems({"metrics": 3}) != []
     with pytest.raises(AssertionError):
         export.assert_schema(snap, require_nonempty=("no.such.metric",))
+
+
+def test_schema_flags_quantile_violations():
+    registry.histogram("t.schema.q", buckets=(1.0,)).observe(0.5)
+    bad = json.loads(json.dumps(export.snapshot()))
+    v = bad["metrics"]["t.schema.q"]["series"][""]
+    v["p50"] = None
+    assert any("missing quantile" in p for p in export.schema_problems(bad))
+    v["p50"] = 99.0
+    assert any("quantile ordering" in p
+               for p in export.schema_problems(bad))
+
+
+def test_report_includes_quantile_columns():
+    registry.histogram("t.report.q").observe(0.25)
+    text = export.report()
+    assert "p50=" in text and "p99=" in text
 
 
 def test_report_renders_tree_and_metrics():

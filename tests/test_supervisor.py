@@ -149,7 +149,7 @@ def test_deadline_overruns_accumulate_past_successes(clock, knobs,
 def test_deadline_scope_noop_without_budget(knobs):
     with supervisor.deadline_scope(SITE):
         supervisor.deadline_check()      # never raises when disarmed
-    assert supervisor._deadline_stack == []
+    assert supervisor._deadline_stack_for_thread() == []
 
 
 def test_deadline_check_raises_midwork(clock, monkeypatch):
@@ -162,7 +162,7 @@ def test_deadline_check_raises_midwork(clock, monkeypatch):
                     clock[0] += 0.02     # 20ms > the 10ms budget
                     supervisor.deadline_check()
         assert delta[f"supervisor.deadline.trips{{site={SITE}}}"] == 1
-        assert supervisor._deadline_stack == []
+        assert supervisor._deadline_stack_for_thread() == []
     finally:
         supervisor.reset()
 
@@ -378,7 +378,7 @@ def test_supervisor_off_is_passthrough(clock, knobs, monkeypatch):
         with supervisor.deadline_scope(SITE):
             supervisor.deadline_check()
     assert not delta.nonzero()
-    assert supervisor._deadline_stack == []
+    assert supervisor._deadline_stack_for_thread() == []
 
 
 def test_supervisor_off_engine_paths_unchanged(monkeypatch):
