@@ -10,11 +10,12 @@ GENERATORS = operations sanity finality rewards random forks epoch_processing \
         bench-forkchoice-smoke bench-obs-smoke bench-block-smoke \
         bench-state-smoke bench-supervisor-smoke bench-das-smoke \
         bench-mesh-smoke bench-recovery-smoke bench-sanitizer-smoke \
-        bench-serving-smoke \
+        bench-serving-smoke bench-corpus-smoke \
         sim-smoke sim-heavy \
         obs-report dryrun warm native lint lint-changed lint-verdicts \
         speclint-baseline \
-        generate_tests $(addprefix gen_,$(GENERATORS)) clean-vectors pyspec
+        generate_tests $(addprefix gen_,$(GENERATORS)) clean-vectors pyspec \
+        corpus corpus-check
 
 # fast local suite: signature checks off except @always_bls
 # (reference `make test`, Makefile:118-120)
@@ -42,6 +43,7 @@ citest:
 	$(PYTHON) benchmarks/bench_recovery.py
 	$(PYTHON) benchmarks/bench_sanitizer.py
 	$(PYTHON) benchmarks/bench_serving.py --smoke
+	$(PYTHON) benchmarks/bench_corpus.py --smoke
 	$(MAKE) sim-smoke
 	$(PYTHON) -m pytest tests/ -q --enable-bls --bls-type fastest
 
@@ -269,6 +271,41 @@ generate_tests: $(addprefix gen_,$(GENERATORS))
 
 $(addprefix gen_,$(GENERATORS)): gen_%:
 	$(PYTHON) generators/$*/main.py -o $(OUTPUT_DIR)
+
+# corpus factory (docs/corpus.md): every generator through ONE shared
+# fork-start pool — pre-warmed parent image (spec ladders, genesis
+# states, pubkeys inherited copy-on-write), cost-aware longest-first
+# schedule from the persisted per-case timing profile, per-case RLC
+# signature folds with synchronous replay on any failed fold.
+# Byte-identical to `make generate_tests` (bench_corpus asserts the
+# tree digests); resume semantics unchanged (INCOMPLETE cases redone,
+# complete cases skipped)
+corpus:
+	$(PYTHON) -m consensus_specs_tpu.gen.corpus -o $(OUTPUT_DIR)
+
+# corpus fidelity replay (docs/corpus.md): re-execute the emitted
+# operations/epoch_processing/sanity/finality vectors through the spec
+# twice — engines on, then every CS_TPU_* switch forced off — proving
+# no engine leaked an optimistic result into a vector; nonzero exit on
+# any mismatch in either leg
+corpus-check:
+	$(PYTHON) -m consensus_specs_tpu.gen.replay -o $(OUTPUT_DIR)
+	CS_TPU_VECTORIZED_EPOCH=0 CS_TPU_PROTO_ARRAY=0 \
+	CS_TPU_STATE_ARRAYS=0 CS_TPU_BLS_RLC=0 CS_TPU_HASH_FOREST=0 \
+	CS_TPU_SUPERVISOR=0 CS_TPU_DAS=0 CS_TPU_MESH=0 \
+	CS_TPU_CHECKPOINT=0 CS_TPU_SERVING=0 \
+		$(PYTHON) -m consensus_specs_tpu.gen.replay -o $(OUTPUT_DIR)
+
+# corpus factory smoke (docs/corpus.md): bounded subset generated both
+# ways — serial per-generator processes vs the one-pool factory — with
+# tree digests compared byte-for-byte, plus the counter-asserted
+# censuses: sign memo engages (gen.sign_memo hits > 0), folded cases
+# collapse to at most one RLC pairing each (bls.flush{path=rlc} <=
+# gen.case_batches{path=folded}, total pairings strictly below the
+# unfolded run), and expected-invalid cases fall back through
+# gen.case_replays; nonzero exit on any violation
+bench-corpus-smoke:
+	$(PYTHON) benchmarks/bench_corpus.py --smoke
 
 # native C components (raw-snappy codec for vector IO, SHA-256 merkle
 # layer hasher for host-side merkleization, BLS12-381 signature backend

@@ -5,7 +5,9 @@ gen_from_tests/gen.py) and the 18 entrypoints under ``tests/generators/``.
 """
 from .gen_typing import TestCase, TestProvider
 from .gen_runner import run_generator
-from .gen_from_tests import generate_from_tests, run_state_test_generators
+from .gen_from_tests import (generate_from_tests, run_state_test_generators,
+                             state_test_providers)
 
 __all__ = ["TestCase", "TestProvider", "run_generator",
-           "generate_from_tests", "run_state_test_generators"]
+           "generate_from_tests", "run_state_test_generators",
+           "state_test_providers"]

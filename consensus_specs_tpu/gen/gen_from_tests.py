@@ -33,6 +33,7 @@ def generate_from_tests(runner_name: str, handler_name: str, src,
             case_name=name[len("test_"):],
             case_fn=case_fn,
             exec_fork=exec_fork,
+            batchable=True,
         )
 
 
@@ -44,14 +45,11 @@ def _prepare_bls():
     ctx.DEFAULT_BLS_TYPE = "fastest"
 
 
-def run_state_test_generators(runner_name: str, all_mods,
-                              presets=("minimal", "mainnet"), args=None,
-                              exec_forks=None):
-    """all_mods: {fork: {handler: module path}}; ``exec_forks`` optionally
-    maps a fork to the fork whose spec executes its tests (fork-upgrade
-    suites run under the pre-fork) (reference gen.py:103-136)."""
-    from .gen_runner import run_generator
-
+def state_test_providers(runner_name: str, all_mods,
+                         presets=("minimal", "mainnet"), exec_forks=None):
+    """The provider list behind :func:`run_state_test_generators`,
+    factored out so the corpus orchestrator can collect every
+    generator's cases without going through each one's CLI."""
     def make_cases():
         for preset_name in presets:
             for fork_name, handlers in all_mods.items():
@@ -62,8 +60,19 @@ def run_state_test_generators(runner_name: str, all_mods,
                         preset_name,
                         exec_fork=(exec_forks or {}).get(fork_name))
 
-    provider = TestProvider(prepare=_prepare_bls, make_cases=make_cases)
-    return run_generator(runner_name, [provider], args)
+    return [TestProvider(prepare=_prepare_bls, make_cases=make_cases)]
+
+
+def run_state_test_generators(runner_name: str, all_mods,
+                              presets=("minimal", "mainnet"), args=None,
+                              exec_forks=None):
+    """all_mods: {fork: {handler: module path}}; ``exec_forks`` optionally
+    maps a fork to the fork whose spec executes its tests (fork-upgrade
+    suites run under the pre-fork) (reference gen.py:103-136)."""
+    from .gen_runner import run_generator
+    providers = state_test_providers(runner_name, all_mods,
+                                     presets=presets, exec_forks=exec_forks)
+    return run_generator(runner_name, providers, args)
 
 
 def combine_mods(dict_1, dict_2):

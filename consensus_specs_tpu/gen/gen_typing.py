@@ -15,6 +15,11 @@ class TestCase:
     # fork whose spec executes the test; fork-upgrade tests run under the
     # PRE-fork spec but are filed under the post-fork directory
     exec_fork: str = None
+    # eligible for the runner's per-case deferred-signature fold: only
+    # decorator-built spec tests (generate_from_tests) qualify — custom
+    # providers (kzg, bls, ssz) compute verdict booleans from eager
+    # verification, which an optimistic deferral would falsify
+    batchable: bool = False
 
     def __post_init__(self):
         if self.exec_fork is None:

@@ -288,6 +288,36 @@ def counter_values() -> dict:
     return out
 
 
+def parse_series_key(flat_key: str):
+    """Inverse of ``name + render_labels(key)``: split one
+    :func:`counter_values` key back into ``(name, labels_dict)``."""
+    if flat_key.endswith("}") and "{" in flat_key:
+        name, _, suffix = flat_key.partition("{")
+        labels = {}
+        for pair in suffix[:-1].split(","):
+            k, _, v = pair.partition("=")
+            labels[k] = v
+        return name, labels
+    return flat_key, {}
+
+
+def book_flat_deltas(deltas: dict) -> None:
+    """Re-book counter deltas exported from ANOTHER process's registry.
+
+    A fork-pool worker's counters die with the child; the gen runner
+    ships each case's nonzero deltas (flat :func:`counter_values` keys)
+    back through the pool result and the parent adds them here, so
+    ``obs_report`` sees one coherent ledger regardless of which process
+    ran the case.  Negative deltas are dropped: a counter can only go
+    backwards if the child reset it, which is a child-local act with no
+    parent-side meaning."""
+    for flat_key, n in deltas.items():
+        if n <= 0:
+            continue
+        name, labels = parse_series_key(flat_key)
+        counter(name).labels(**labels).add(n)
+
+
 def reset(prefix: str = "") -> None:
     """Zero every series (in place — bound handles stay live) whose
     metric name starts with ``prefix``; everything when empty."""

@@ -6,6 +6,7 @@ get_valid_attestation:91, sign_attestation, run_attestation_processing:14).
 from consensus_specs_tpu.utils import bls
 from consensus_specs_tpu.utils.ssz import Bitlist
 from .keys import privkeys
+from .signing import sign
 from .block import build_empty_block_for_next_slot
 
 
@@ -113,7 +114,7 @@ def get_attestation_signature(spec, state, attestation_data, privkey):
     domain = spec.get_domain(
         state, spec.DOMAIN_BEACON_ATTESTER, attestation_data.target.epoch)
     signing_root = spec.compute_signing_root(attestation_data, domain)
-    return bls.Sign(privkey, signing_root)
+    return sign(privkey, signing_root)
 
 
 def run_attestation_processing(spec, state, attestation, valid=True):

@@ -6,6 +6,7 @@ transition_unsigned_block:75, state_transition_and_sign_block).
 from consensus_specs_tpu.utils.ssz import hash_tree_root
 from consensus_specs_tpu.utils import bls
 from .keys import privkeys
+from .signing import sign
 
 
 def get_proposer_index_maybe(spec, state, slot, proposer_index=None):
@@ -25,7 +26,7 @@ def apply_randao_reveal(spec, state, block, proposer_index):
     epoch = spec.compute_epoch_at_slot(block.slot)
     domain = spec.get_domain(state, spec.DOMAIN_RANDAO, epoch)
     signing_root = spec.compute_signing_root(spec.uint64(epoch), domain)
-    block.body.randao_reveal = bls.Sign(privkey, signing_root)
+    block.body.randao_reveal = sign(privkey, signing_root)
 
 
 def apply_sig(spec, state, signed_block, proposer_index=None):
@@ -37,7 +38,7 @@ def apply_sig(spec, state, signed_block, proposer_index=None):
     domain = spec.get_domain(state, spec.DOMAIN_BEACON_PROPOSER,
                              spec.compute_epoch_at_slot(block.slot))
     signing_root = spec.compute_signing_root(block, domain)
-    signed_block.signature = bls.Sign(privkey, signing_root)
+    signed_block.signature = sign(privkey, signing_root)
 
 
 def sign_block(spec, state, block, proposer_index=None):

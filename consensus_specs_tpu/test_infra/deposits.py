@@ -9,6 +9,7 @@ from consensus_specs_tpu.utils.ssz import hash_tree_root, uint64
 from consensus_specs_tpu.utils.ssz.merkle import zero_hashes
 from consensus_specs_tpu.utils import bls
 from .keys import privkeys, pubkeys
+from .signing import sign
 
 
 def _merkle_tree(leaves, depth):
@@ -53,7 +54,7 @@ def sign_deposit_data(spec, deposit_data, privkey):
         amount=deposit_data.amount)
     domain = spec.compute_domain(spec.DOMAIN_DEPOSIT)
     signing_root = spec.compute_signing_root(deposit_message, domain)
-    deposit_data.signature = bls.Sign(privkey, signing_root)
+    deposit_data.signature = sign(privkey, signing_root)
 
 
 def build_deposit(spec, deposit_data_list, pubkey, privkey, amount,

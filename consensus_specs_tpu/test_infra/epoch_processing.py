@@ -76,8 +76,16 @@ def run_epoch_processing_to(spec, state, process_name):
 
 
 def run_epoch_processing_with(spec, state, process_name):
-    """Run the epoch sub-transition ``process_name``, yielding pre/post."""
+    """Run the epoch sub-transition ``process_name``, yielding pre/post.
+
+    Also yields the sub-transition name as a ``sub_transition`` meta
+    scalar (lands in ``meta.yaml``): the corpus replayer needs it to
+    re-execute pre -> post, since this repo files every epoch case
+    under one ``epoch_processing`` handler rather than the reference's
+    per-sub-transition handlers.  Hand-rolled cases that drive a
+    sub-transition inline (no meta key) are counted replay-skips."""
     run_epoch_processing_to(spec, state, process_name)
+    yield "sub_transition", process_name
     yield "pre", state
     getattr(spec, process_name)(state)
     yield "post", state

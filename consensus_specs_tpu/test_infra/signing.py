@@ -1,0 +1,49 @@
+"""Memoized test-infra signing.
+
+Sibling cases in a generator suite sign the SAME messages over and
+over: every case built from one cached genesis state re-derives
+identical randao reveals, proposer signatures, and attestation
+signatures (same privkey, same signing root, same deterministic BLS
+output).  `bls.Sign` on the pure-python backend costs ~1ms per call —
+across a multi-fork corpus that is minutes of redundant scalar
+multiplication.  :func:`sign` memoizes on ``(privkey, signing_root)``,
+which is sound because BLS signing is deterministic (RFC 9380 hash-to-
+curve + fixed scalar mult — no nonce).
+
+Hit/miss traffic is census-booked on ``gen.sign_memo{result=...}`` so
+the corpus bench can assert the memo actually engages.  The memo is
+bypassed (not consulted, not populated) while ``bls.bls_active`` is
+off: stub-mode "signatures" are a constant that must not leak into a
+later real-crypto run of the same process, and vice versa.
+
+The cache is plain module state on purpose: the corpus factory
+pre-warms the fork-pool parent, so workers inherit every parent-side
+entry copy-on-write for free, exactly like ``keys._pubkey_cache``.
+"""
+from consensus_specs_tpu.obs import registry as _registry
+from consensus_specs_tpu.utils import bls
+
+_MEMO_HITS = _registry.counter("gen.sign_memo").labels(result="hit")
+_MEMO_MISSES = _registry.counter("gen.sign_memo").labels(result="miss")
+
+_sign_cache = {}
+
+
+def sign(privkey: int, signing_root) -> bytes:
+    """Memoized ``bls.Sign(privkey, signing_root)``."""
+    if not bls.bls_active:
+        return bls.Sign(privkey, signing_root)
+    key = (privkey, bytes(signing_root))
+    sig = _sign_cache.get(key)
+    if sig is not None:
+        _MEMO_HITS.add()
+        return sig
+    _MEMO_MISSES.add()
+    sig = bls.Sign(privkey, signing_root)
+    _sign_cache[key] = sig
+    return sig
+
+
+def clear() -> None:
+    """Drop every memoized signature (tests; backend switches)."""
+    _sign_cache.clear()

@@ -2,8 +2,8 @@
 
 Reference: ``test/helpers/proposer_slashings.py`` + ``attester_slashings.py``.
 """
-from consensus_specs_tpu.utils import bls
 from .keys import privkeys
+from .signing import sign
 from .attestations import get_valid_attestation, sign_attestation
 
 
@@ -11,7 +11,7 @@ def sign_block_header(spec, state, header, privkey):
     domain = spec.get_domain(state, spec.DOMAIN_BEACON_PROPOSER,
                              spec.compute_epoch_at_slot(header.slot))
     signing_root = spec.compute_signing_root(header, domain)
-    signature = bls.Sign(privkey, signing_root)
+    signature = sign(privkey, signing_root)
     return spec.SignedBeaconBlockHeader(message=header, signature=signature)
 
 

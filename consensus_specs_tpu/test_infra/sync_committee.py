@@ -6,6 +6,7 @@ committee_signature and the sync-aggregate test runner).
 from consensus_specs_tpu.utils import bls
 from consensus_specs_tpu.utils.ssz import hash_tree_root
 from .keys import privkeys
+from .signing import sign
 
 
 def compute_sync_committee_signature(spec, state, slot, privkey,
@@ -18,7 +19,7 @@ def compute_sync_committee_signature(spec, state, slot, privkey,
         else:
             block_root = spec.get_block_root_at_slot(state, slot)
     signing_root = spec.compute_signing_root(block_root, domain)
-    return bls.Sign(privkey, signing_root)
+    return sign(privkey, signing_root)
 
 
 def build_latest_block_root(spec, state):

@@ -1,6 +1,6 @@
 """Voluntary-exit builders. Reference: ``test/helpers/voluntary_exits.py``."""
-from consensus_specs_tpu.utils import bls
 from .keys import privkeys
+from .signing import sign
 
 
 def prepare_signed_exits(spec, state, indices, fork_version=None):
@@ -37,7 +37,7 @@ def sign_voluntary_exit(spec, state, voluntary_exit, privkey, fork_version=None)
     signing_root = spec.compute_signing_root(voluntary_exit, domain)
     return spec.SignedVoluntaryExit(
         message=voluntary_exit,
-        signature=bls.Sign(privkey, signing_root),
+        signature=sign(privkey, signing_root),
     )
 
 

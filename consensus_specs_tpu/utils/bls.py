@@ -312,6 +312,13 @@ def batched_verification():
         _batch_stack.pop()
 
 
+def batch_scope_active() -> bool:
+    """True while any deferred-verification scope is open — callers that
+    want to INSTALL an outermost scope (the gen runner's per-case fold)
+    probe this instead of racing :func:`scoped_batch`'s RuntimeError."""
+    return bool(_batch_stack)
+
+
 @contextmanager
 def scoped_batch(batch):
     """Install ``batch`` as the outermost deferred-verification scope.

@@ -114,6 +114,10 @@ def make_cases():
     yield _case("aggregate_verify", "av_valid", av_fn)
 
 
+def providers():
+    """Corpus-factory hook: this generator's provider list."""
+    return [TestProvider(prepare=bls.use_py, make_cases=make_cases)]
+
+
 if __name__ == "__main__":
-    run_generator("bls", [
-        TestProvider(prepare=bls.use_py, make_cases=make_cases)])
+    run_generator("bls", providers())

@@ -12,5 +12,12 @@ mods = {"epoch_processing": "tests.phase0.epoch_processing.test_epoch_processing
 ALL_MODS = {fork: mods
             for fork in ("phase0", "altair", "bellatrix", "capella", "deneb")}
 
+
+def providers():
+    """Corpus-factory hook: this generator's provider list."""
+    from consensus_specs_tpu.gen import state_test_providers
+    return state_test_providers("epoch_processing", ALL_MODS)
+
+
 if __name__ == "__main__":
     run_state_test_generators("epoch_processing", ALL_MODS)

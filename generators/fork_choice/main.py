@@ -38,5 +38,12 @@ ALL_MODS = {
     "deneb": deneb_mods,
 }
 
+
+def providers():
+    """Corpus-factory hook: this generator's provider list."""
+    from consensus_specs_tpu.gen import state_test_providers
+    return state_test_providers("fork_choice", ALL_MODS)
+
+
 if __name__ == "__main__":
     run_state_test_generators("fork_choice", ALL_MODS)

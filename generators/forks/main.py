@@ -18,6 +18,14 @@ ALL_MODS = {
 EXEC_FORKS = {"altair": "phase0", "bellatrix": "altair",
               "capella": "bellatrix", "deneb": "capella"}
 
+
+def providers():
+    """Corpus-factory hook: this generator's provider list."""
+    from consensus_specs_tpu.gen import state_test_providers
+    return state_test_providers("forks", ALL_MODS,
+                                exec_forks=EXEC_FORKS)
+
+
 if __name__ == "__main__":
     run_state_test_generators("forks", ALL_MODS,
                               exec_forks=EXEC_FORKS)

@@ -9,5 +9,12 @@ from consensus_specs_tpu.gen import run_state_test_generators
 
 ALL_MODS = {"phase0": {"initialization": "tests.phase0.genesis.test_genesis"}}
 
+
+def providers():
+    """Corpus-factory hook: this generator's provider list."""
+    from consensus_specs_tpu.gen import state_test_providers
+    return state_test_providers("genesis", ALL_MODS)
+
+
 if __name__ == "__main__":
     run_state_test_generators("genesis", ALL_MODS)

@@ -113,6 +113,10 @@ def make_cases():
                 point_eval_case())
 
 
+def providers():
+    """Corpus-factory hook: this generator's provider list."""
+    return [TestProvider(prepare=lambda: None, make_cases=make_cases)]
+
+
 if __name__ == "__main__":
-    run_generator("kzg", [
-        TestProvider(prepare=lambda: None, make_cases=make_cases)])
+    run_generator("kzg", providers())

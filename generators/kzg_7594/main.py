@@ -171,6 +171,10 @@ def make_cases():
                 recover_insufficient_case())
 
 
+def providers():
+    """Corpus-factory hook: this generator's provider list."""
+    return [TestProvider(prepare=lambda: None, make_cases=make_cases)]
+
+
 if __name__ == "__main__":
-    run_generator("kzg_7594", [
-        TestProvider(prepare=lambda: None, make_cases=make_cases)])
+    run_generator("kzg_7594", providers())

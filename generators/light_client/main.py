@@ -12,5 +12,12 @@ ALL_MODS = {
     "altair": {"sync": "tests.altair.light_client.test_sync_protocol"},
 }
 
+
+def providers():
+    """Corpus-factory hook: this generator's provider list."""
+    from consensus_specs_tpu.gen import state_test_providers
+    return state_test_providers("light_client", ALL_MODS)
+
+
 if __name__ == "__main__":
     run_state_test_generators("light_client", ALL_MODS)

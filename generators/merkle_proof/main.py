@@ -14,5 +14,12 @@ ALL_MODS = {
     },
 }
 
+
+def providers():
+    """Corpus-factory hook: this generator's provider list."""
+    from consensus_specs_tpu.gen import state_test_providers
+    return state_test_providers("merkle_proof", ALL_MODS)
+
+
 if __name__ == "__main__":
     run_state_test_generators("merkle_proof", ALL_MODS)

@@ -22,5 +22,12 @@ ALL_MODS = {
     "deneb": altair_mods,
 }
 
+
+def providers():
+    """Corpus-factory hook: this generator's provider list."""
+    from consensus_specs_tpu.gen import state_test_providers
+    return state_test_providers("random", ALL_MODS)
+
+
 if __name__ == "__main__":
     run_state_test_generators("random", ALL_MODS)

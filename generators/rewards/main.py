@@ -11,5 +11,12 @@ mods = {"basic": "tests.phase0.rewards.test_rewards"}
 ALL_MODS = {fork: mods
             for fork in ("phase0", "altair", "bellatrix", "capella", "deneb")}
 
+
+def providers():
+    """Corpus-factory hook: this generator's provider list."""
+    from consensus_specs_tpu.gen import state_test_providers
+    return state_test_providers("rewards", ALL_MODS)
+
+
 if __name__ == "__main__":
     run_state_test_generators("rewards", ALL_MODS)

@@ -37,6 +37,10 @@ def make_cases():
                 yield shuffling_case(spec, seed, count)
 
 
+def providers():
+    """Corpus-factory hook: this generator's provider list."""
+    return [TestProvider(prepare=lambda: None, make_cases=make_cases)]
+
+
 if __name__ == "__main__":
-    run_generator("shuffling", [
-        TestProvider(prepare=lambda: None, make_cases=make_cases)])
+    run_generator("shuffling", providers())

@@ -196,6 +196,10 @@ def make_cases():
             suite_name=suite, case_name=name, case_fn=fn)
 
 
+def providers():
+    """Corpus-factory hook: this generator's provider list."""
+    return [TestProvider(prepare=lambda: None, make_cases=make_cases)]
+
+
 if __name__ == "__main__":
-    run_generator("ssz_generic", [
-        TestProvider(prepare=lambda: None, make_cases=make_cases)])
+    run_generator("ssz_generic", providers())

@@ -84,6 +84,10 @@ def make_cases():
                             count=i)
 
 
+def providers():
+    """Corpus-factory hook: this generator's provider list."""
+    return [TestProvider(prepare=lambda: None, make_cases=make_cases)]
+
+
 if __name__ == "__main__":
-    run_generator("ssz_static", [
-        TestProvider(prepare=lambda: None, make_cases=make_cases)])
+    run_generator("ssz_static", providers())

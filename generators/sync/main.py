@@ -10,5 +10,12 @@ from consensus_specs_tpu.gen import run_state_test_generators
 mods = {"optimistic": "tests.bellatrix.sync.test_optimistic"}
 ALL_MODS = {fork: mods for fork in ("bellatrix", "capella", "deneb")}
 
+
+def providers():
+    """Corpus-factory hook: this generator's provider list."""
+    from consensus_specs_tpu.gen import state_test_providers
+    return state_test_providers("sync", ALL_MODS)
+
+
 if __name__ == "__main__":
     run_state_test_generators("sync", ALL_MODS)

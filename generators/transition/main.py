@@ -15,5 +15,12 @@ mods = {"core": "tests.transition.test_transition"}
 ALL_MODS = {fork: mods
             for fork in ("altair", "bellatrix", "capella", "deneb")}
 
+
+def providers():
+    """Corpus-factory hook: this generator's provider list."""
+    from consensus_specs_tpu.gen import state_test_providers
+    return state_test_providers("transition", ALL_MODS)
+
+
 if __name__ == "__main__":
     run_state_test_generators("transition", ALL_MODS)

@@ -1,0 +1,433 @@
+"""Corpus factory contract tests.
+
+Covers the gen_runner pool/resume mechanics the orchestrator builds
+on, the corpus factory's byte-identity against the serial
+per-generator path, the cross-case accelerations' censuses
+(sign memo, per-case RLC fold), the worker->parent counter-delta
+plumbing, the locked diagnostics merge, and the fidelity replayer's
+mismatch detection.
+"""
+import json
+import multiprocessing
+import os
+import shutil
+
+import pytest
+
+from consensus_specs_tpu.gen import gen_runner
+from consensus_specs_tpu.gen import corpus as corpus_mod
+from consensus_specs_tpu.gen import replay as replay_mod
+from consensus_specs_tpu.gen.gen_from_tests import state_test_providers
+from consensus_specs_tpu.obs import registry
+from consensus_specs_tpu.test_infra.metrics import counting
+from consensus_specs_tpu.utils import snappy
+
+
+SANITY_MODS = {"phase0": {"blocks": "tests.phase0.sanity.test_blocks",
+                          "slots": "tests.phase0.sanity.test_slots"}}
+
+
+def _sanity_cases(fork_list=("phase0",)):
+    provs = state_test_providers("sanity", SANITY_MODS, presets=("minimal",))
+    cases, _ = gen_runner.collect_cases(provs, ["minimal"], list(fork_list))
+    return cases
+
+
+def _tree_digest(root):
+    """Stable content digest of every file under <root>/tests."""
+    import hashlib
+    h = hashlib.sha256()
+    base = os.path.join(root, "tests")
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames.sort()
+        for name in sorted(filenames):
+            path = os.path.join(dirpath, name)
+            h.update(os.path.relpath(path, base).encode())
+            with open(path, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# resume semantics
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("workers", [1, 2], ids=["serial", "pool"])
+def test_resume_regenerates_exactly_the_incomplete_case(tmp_path, workers):
+    """A crash mid-case leaves the INCOMPLETE tag; the next run
+    regenerates exactly that case and skips every complete one."""
+    out = str(tmp_path)
+    cases = _sanity_cases()[:6]
+    outcomes, _ = gen_runner.run_cases(cases, out, workers=workers)
+    assert {r for _, r, _ in outcomes} == {"generated"}
+
+    victim = cases[2]
+    victim_dir = os.path.join(out, victim.dir_path())
+    # simulate a crash mid-write: tag present, parts half-gone
+    with open(os.path.join(victim_dir, "INCOMPLETE"), "wb") as f:
+        f.write(b"INCOMPLETE")
+    for name in os.listdir(victim_dir):
+        if name != "INCOMPLETE":
+            os.remove(os.path.join(victim_dir, name))
+
+    outcomes, _ = gen_runner.run_cases(cases, out, workers=workers)
+    by_case = {c.dir_path(): r for c, r, _ in outcomes}
+    assert by_case[victim.dir_path()] == "generated"
+    assert sorted(set(by_case.values())) == ["generated", "skipped"]
+    assert sum(1 for r in by_case.values() if r == "generated") == 1
+    assert not os.path.exists(os.path.join(victim_dir, "INCOMPLETE"))
+    assert os.path.exists(os.path.join(victim_dir, "post.ssz_snappy")) or \
+        os.listdir(victim_dir)
+
+
+@pytest.mark.parametrize("workers", [1, 2], ids=["serial", "pool"])
+def test_force_regenerates_complete_cases(tmp_path, workers):
+    out = str(tmp_path)
+    cases = _sanity_cases()[:4]
+    gen_runner.run_cases(cases, out, workers=workers)
+    # without force: all skip
+    outcomes, _ = gen_runner.run_cases(cases, out, workers=workers)
+    assert {r for _, r, _ in outcomes} == {"skipped"}
+    # collect_cases(force=True) removes the complete dirs up front
+    provs = state_test_providers("sanity", SANITY_MODS, presets=("minimal",))
+    forced, _ = gen_runner.collect_cases(
+        provs, ["minimal"], ["phase0"], force=True, output_dir=out)
+    keep = {c.dir_path() for c in cases}
+    forced = [c for c in forced if c.dir_path() in keep]
+    outcomes, _ = gen_runner.run_cases(forced, out, workers=workers)
+    assert {r for _, r, _ in outcomes} == {"generated"}
+
+
+# ---------------------------------------------------------------------------
+# worker-side counters ride back to the parent
+# ---------------------------------------------------------------------------
+
+class _ErrCase:
+    """Minimal TestCase stand-in whose body fails with an assertion."""
+    preset_name = "minimal"
+    fork_name = "phase0"
+    exec_fork = "phase0"
+    batchable = False
+    generator_name = "errgen"
+
+    def __init__(self, name="boom"):
+        self.name = name
+
+    def dir_path(self):
+        return f"tests/minimal/phase0/errgen/err/suite/{self.name}"
+
+    def case_fn(self):
+        raise AssertionError("deliberate case failure")
+
+
+def test_pool_worker_counter_deltas_booked_in_parent(tmp_path):
+    """gen.case_errors bumped inside a fork-pool child must land in the
+    PARENT registry (satellite: lost worker-side obs counters)."""
+    if "fork" not in multiprocessing.get_all_start_methods():
+        pytest.skip("fork start method unavailable")
+    cases = [_ErrCase("a"), _ErrCase("b"), _ErrCase("c")]
+    with counting() as delta:
+        outcomes, error_log = gen_runner.run_cases(
+            cases, str(tmp_path), workers=2)
+    assert {r for _, r, _ in outcomes} == {"error"}
+    assert len(error_log) == 3
+    assert delta["gen.case_errors{error=AssertionError}"] == 3
+
+
+def test_book_flat_deltas_round_trips_series_keys():
+    registry.book_flat_deltas({"x.some_counter{a=1,b=two}": 4,
+                               "x.plain": 2,
+                               "x.negative": -5})
+    vals = registry.counter_values()
+    assert vals["x.some_counter{a=1,b=two}"] == 4
+    assert vals["x.plain"] == 2
+    assert "x.negative" not in vals  # negative deltas dropped
+
+
+# ---------------------------------------------------------------------------
+# diagnostics / error-log merge is lost-update-safe
+# ---------------------------------------------------------------------------
+
+def _report_worker(args):
+    out, name = args
+    gen_runner.write_run_reports(
+        name, out,
+        {"collected": 1, "generated": 1, "skipped": 0, "errors": 0,
+         "test_identifiers": [f"tests/x/{name}"]},
+        [{"case": f"tests/x/{name}", "error": f"err-{name}\n"}],
+        timings={f"tests/x/{name}": 1.0})
+
+
+def test_concurrent_run_reports_lose_no_entries(tmp_path):
+    """16 processes merging diagnostics + error logs concurrently: every
+    generator's entry and every error line survives (satellite: the
+    read-modify-write lost-update race)."""
+    out = str(tmp_path)
+    names = [f"gen{i:02d}" for i in range(16)]
+    ctx = multiprocessing.get_context("fork")
+    with ctx.Pool(8) as pool:
+        pool.map(_report_worker, [(out, n) for n in names])
+    with open(os.path.join(out, "diagnostics_obj.json")) as f:
+        diag = json.load(f)
+    assert sorted(diag) == names
+    for n in names:
+        assert diag[n]["generated"] == 1
+        assert diag[n]["timings"] == {f"tests/x/{n}": 1.0}
+        with open(os.path.join(
+                out, f"testgen_error_log_{n}.txt")) as f:
+            assert f"err-{n}" in f.read()
+
+
+def test_timings_survive_runs_without_fresh_timings(tmp_path):
+    """A resumed run (everything skipped -> no new timings) must not
+    erase the persisted cost profile the scheduler depends on."""
+    out = str(tmp_path)
+    diagnostics = {"collected": 1, "generated": 1, "skipped": 0,
+                   "errors": 0, "test_identifiers": ["tests/x/a"]}
+    gen_runner.write_run_reports("g", out, diagnostics, [],
+                                 timings={"tests/x/a": 2.5})
+    diagnostics = {"collected": 1, "generated": 0, "skipped": 1,
+                   "errors": 0, "test_identifiers": []}
+    gen_runner.write_run_reports("g", out, diagnostics, [], timings={})
+    with open(os.path.join(out, "diagnostics_obj.json")) as f:
+        assert json.load(f)["g"]["timings"] == {"tests/x/a": 2.5}
+
+
+# ---------------------------------------------------------------------------
+# cost-aware scheduler
+# ---------------------------------------------------------------------------
+
+def test_schedule_longest_first_with_unknowns_up_front():
+    class _C:
+        def __init__(self, p):
+            self._p = p
+
+        def dir_path(self):
+            return self._p
+
+    cases = [_C("fast"), _C("slow"), _C("unknown"), _C("mid")]
+    profile = {"fast": 0.1, "slow": 30.0, "mid": 3.0}
+    ordered = corpus_mod.schedule_cases(cases, profile)
+    assert [c.dir_path() for c in ordered] == \
+        ["unknown", "slow", "mid", "fast"]
+
+
+def test_load_cost_profile_unions_all_generators(tmp_path):
+    out = str(tmp_path)
+    gen_runner.write_run_reports(
+        "g1", out, {"collected": 1, "generated": 1, "skipped": 0,
+                    "errors": 0, "test_identifiers": []},
+        [], timings={"tests/a": 1.0})
+    gen_runner.write_run_reports(
+        "g2", out, {"collected": 1, "generated": 1, "skipped": 0,
+                    "errors": 0, "test_identifiers": []},
+        [], timings={"tests/b": 2.0})
+    assert corpus_mod.load_cost_profile(out) == \
+        {"tests/a": 1.0, "tests/b": 2.0}
+
+
+# ---------------------------------------------------------------------------
+# cross-case accelerations: censuses + byte identity
+# ---------------------------------------------------------------------------
+
+def test_sign_memo_hits_and_is_bypassed_in_stub_mode():
+    from consensus_specs_tpu.test_infra import signing
+    from consensus_specs_tpu.utils import bls
+    signing.clear()
+    with counting() as delta:
+        s1 = signing.sign(7, b"\x22" * 32)
+        s2 = signing.sign(7, b"\x22" * 32)
+    assert s1 == s2
+    assert delta["gen.sign_memo{result=miss}"] == 1
+    assert delta["gen.sign_memo{result=hit}"] == 1
+    # stub mode: memo not consulted, not populated
+    old = bls.bls_active
+    bls.bls_active = False
+    try:
+        with counting() as delta:
+            stub = signing.sign(7, b"\x22" * 32)
+        assert stub == bls.STUB_SIGNATURE
+        assert delta["gen.sign_memo{result=hit}"] == 0
+        assert delta["gen.sign_memo{result=miss}"] == 0
+    finally:
+        bls.bls_active = old
+    assert signing.sign(7, b"\x22" * 32) == s1  # real entry intact
+
+
+def test_case_fold_reduces_pairings_and_keeps_bytes(tmp_path):
+    """The per-case RLC fold must (a) collapse each folded case's
+    signature checks into one pairing, (b) replay expected-invalid
+    cases synchronously, and (c) leave the emitted tree byte-identical
+    to the unfolded run."""
+    out_plain = str(tmp_path / "plain")
+    out_fold = str(tmp_path / "fold")
+    cases = _sanity_cases()
+    with counting() as plain_delta:
+        gen_runner.run_cases(cases, out_plain, workers=1, fold=False)
+    with counting() as fold_delta:
+        gen_runner.run_cases(cases, out_fold, workers=1, fold=True)
+    assert _tree_digest(out_plain) == _tree_digest(out_fold)
+    assert fold_delta["gen.case_batches{path=folded}"] > 0
+    # expected-invalid signature cases fall back to the plain path
+    assert fold_delta["gen.case_replays"] >= 1
+    assert 0 < fold_delta["bls.pairings"] < plain_delta["bls.pairings"]
+
+
+class _SystemExitCase:
+    """A case guarding an expected-rejection with SystemExit (the
+    light_client test_invalid_signature_rejected shape): the plain path
+    rejects the bad signature, but a folded scope answers True
+    optimistically and the guard fires."""
+    preset_name = "minimal"
+    fork_name = "phase0"
+    exec_fork = "phase0"
+    batchable = True
+    generator_name = "exitgen"
+    name = "must_reject"
+
+    def dir_path(self):
+        return "tests/minimal/phase0/exitgen/err/suite/must_reject"
+
+    def case_fn(self):
+        from consensus_specs_tpu.utils import bls
+        if bls.Verify(bls.SkToPk(1), b"\x01" * 32,
+                      bls.Sign(2, b"\x02" * 32)):
+            raise SystemExit("invalid signature must fail")
+        yield "description", gen_runner.YamlPart(
+            value="rejected as it must be")
+
+
+def test_fold_replays_systemexit_guard_instead_of_dying(tmp_path):
+    """Under the fold a SystemExit rejection guard is a deferral
+    artifact: the case must replay on the plain path (where the guard
+    stays quiet), not kill the whole corpus process."""
+    from consensus_specs_tpu.utils import bls
+    old = bls.bls_active
+    bls.bls_active = True  # alt_return would accept everything
+    try:
+        with counting() as delta:
+            outcomes, error_log = gen_runner.run_cases(
+                [_SystemExitCase()], str(tmp_path), workers=1, fold=True)
+        assert [r for _, r, _ in outcomes] == ["generated"]
+        assert not error_log
+        assert delta["gen.case_replays"] == 1
+    finally:
+        bls.bls_active = old
+    # outside a fold a SystemExit is a real abort and must escape
+    abort = _SystemExitCase()
+    abort.case_fn = lambda: (_ for _ in ()).throw(
+        SystemExit("real abort"))
+    shutil.rmtree(tmp_path)
+    with pytest.raises(SystemExit):
+        gen_runner.run_cases([abort], str(tmp_path), workers=1,
+                             fold=False)
+
+
+def test_corpus_factory_matches_serial_generators(tmp_path):
+    """End-to-end: run_corpus over two real generators equals the
+    per-generator serial path byte-for-byte, and persists the timing
+    profile a second run schedules from."""
+    out_corpus = str(tmp_path / "corpus")
+    out_serial = str(tmp_path / "serial")
+    gens = ["genesis", "shuffling"]
+    summary = corpus_mod.run_corpus(
+        out_corpus, generator_names=gens, preset_list=["minimal"],
+        fork_list=["phase0"], workers=2)
+    assert summary["errors"] == 0
+    assert summary["generated"] > 0
+    for gen_dir in gens:
+        mod = corpus_mod._load_entrypoint(gen_dir)
+        cases, _ = gen_runner.collect_cases(
+            mod.providers(), ["minimal"], ["phase0"])
+        gen_runner.run_cases(cases, out_serial, workers=1)
+    assert _tree_digest(out_corpus) == _tree_digest(out_serial)
+    # profile persisted under each generator's diagnostics name
+    profile = corpus_mod.load_cost_profile(out_corpus)
+    assert len(profile) == summary["generated"]
+    # resume: everything skips
+    summary2 = corpus_mod.run_corpus(
+        out_corpus, generator_names=gens, preset_list=["minimal"],
+        fork_list=["phase0"], workers=2, prewarm_parent=False)
+    assert summary2["generated"] == 0
+    assert summary2["skipped"] == summary["generated"]
+
+
+def test_prewarm_seeds_parent_caches():
+    from consensus_specs_tpu.test_infra import context as ctx
+    from consensus_specs_tpu.test_infra import keys
+
+    class _C:
+        preset_name = "minimal"
+        exec_fork = "phase0"
+
+    warm = corpus_mod.prewarm([_C()], keys_limit=8)
+    assert warm["specs"] == 1
+    assert any(k[0] == "phase0" and k[1] == "minimal"
+               and k[3] == "default_balances" for k in ctx._state_cache)
+    assert all(keys.privkeys[i] in keys._pubkey_cache for i in range(8))
+
+
+# ---------------------------------------------------------------------------
+# fidelity replayer
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def sanity_corpus(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("replay_corpus"))
+    gen_runner.run_cases(_sanity_cases(), out, workers=1)
+    return out
+
+
+def test_replayer_accepts_faithful_corpus(sanity_corpus):
+    summary = replay_mod.replay_tree(sanity_corpus)
+    assert summary["mismatches"] == []
+    assert summary["replayed"] > 0
+
+
+def test_replayer_detects_tampered_post_state(sanity_corpus, tmp_path):
+    out = str(tmp_path / "tampered")
+    shutil.copytree(sanity_corpus, out)
+    post = None
+    for case_dir, _, _, runner, handler in replay_mod.walk_cases(out):
+        if runner == "sanity" and handler == "slots":
+            candidate = os.path.join(case_dir, "post.ssz_snappy")
+            if os.path.exists(candidate):
+                post = candidate
+                break
+    assert post is not None
+    raw = bytearray(snappy.decompress(open(post, "rb").read()))
+    raw[100] ^= 0xFF
+    with open(post, "wb") as f:
+        f.write(snappy.compress(bytes(raw)))
+    summary = replay_mod.replay_tree(out)
+    assert len(summary["mismatches"]) == 1
+    assert "state root differs" in summary["mismatches"][0]
+
+
+def test_replayer_rejects_incomplete_case(sanity_corpus, tmp_path):
+    out = str(tmp_path / "incomplete")
+    shutil.copytree(sanity_corpus, out)
+    case_dir = next(replay_mod.walk_cases(out))[0]
+    with open(os.path.join(case_dir, "INCOMPLETE"), "wb") as f:
+        f.write(b"INCOMPLETE")
+    summary = replay_mod.replay_tree(out)
+    assert any("INCOMPLETE" in m for m in summary["mismatches"])
+
+
+def test_replayer_flags_wrongly_accepted_invalid_case(sanity_corpus,
+                                                      tmp_path):
+    """A case whose post was deleted claims the input must be rejected;
+    the replayer must flag the (actually valid) input as a mismatch."""
+    out = str(tmp_path / "misflagged")
+    shutil.copytree(sanity_corpus, out)
+    victim = None
+    for case_dir, _, _, runner, handler in replay_mod.walk_cases(out):
+        if runner == "sanity" and handler == "blocks" \
+                and os.path.exists(os.path.join(case_dir,
+                                                "post.ssz_snappy")):
+            victim = case_dir
+            break
+    assert victim is not None
+    os.remove(os.path.join(victim, "post.ssz_snappy"))
+    summary = replay_mod.replay_tree(out)
+    assert any("was accepted" in m for m in summary["mismatches"])

@@ -509,7 +509,7 @@ def test_gen_runner_case_errors_are_obs_accounted():
     with tempfile.TemporaryDirectory() as tmp:
         log = []
         with counting() as delta:
-            result = gen_runner.generate_test_vector(
+            result, _elapsed = gen_runner.generate_test_vector(
                 _Case(lambda: (_ for _ in ()).throw(
                     AssertionError("spec invalidity"))), tmp, log)
         assert result == "error"
