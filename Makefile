@@ -10,7 +10,7 @@ GENERATORS = operations sanity finality rewards random forks epoch_processing \
         bench-forkchoice-smoke bench-obs-smoke bench-block-smoke \
         bench-state-smoke bench-supervisor-smoke bench-das-smoke \
         bench-mesh-smoke bench-recovery-smoke bench-sanitizer-smoke \
-        bench-serving-smoke bench-corpus-smoke \
+        bench-serving-smoke bench-corpus-smoke bench-telemetry-smoke \
         sim-smoke sim-heavy \
         obs-report dryrun warm native lint lint-changed lint-verdicts \
         speclint-baseline \
@@ -176,9 +176,20 @@ sim-heavy:
 # telemetry disabled-path overhead: with CS_TPU_PROFILE/CS_TPU_TRACE
 # unset, the span + counter instrumentation across the engine stack
 # must cost <2% of the 32-slot replay (exact op census x measured
-# per-op cost; nonzero exit above the bound)
+# per-op cost; nonzero exit above the bound).  Also bounds the flight
+# recorder (disarmed record cost x armed-replay census <2%) and
+# asserts a flight+trace-armed serving replay byte-identical to the
+# synchronous oracle.
 bench-obs-smoke:
 	$(PYTHON) benchmarks/bench_obs_overhead.py
+
+# live telemetry plane smoke (docs/observability.md): obs.serve must
+# answer /metrics, /healthz and /snapshot (schema-checked) WHILE a
+# pipelined serving replay runs, without moving a byte of consensus
+# state; a forced quarantine must flip /healthz to 503 and a
+# supervisor reset must restore it
+bench-telemetry-smoke:
+	$(PYTHON) benchmarks/bench_telemetry.py
 
 # DAS engine smoke (docs/das.md): a multi-blob cell-proof batch must
 # verify in exactly ONE pairing check (ZERO of its own inside an RLC

@@ -17,7 +17,18 @@ of the same replay with spans force-disabled vs enabled is printed for
 reference but not asserted (wall-clock A/B of a ~1s python workload is
 noise at the 2% scale).
 
-Exits nonzero when the computed overhead reaches 2%.
+The flight recorder (``obs/flight.py``) gets the same treatment: a
+tight-loop ns/op of a DISARMED ``flight.record()`` call, a census of
+how many records an armed+traced replay emits, and the asserted bound
+flight_records x disarmed_cost / replay_time < 2% (the armed span path
+checks one module global before even calling ``record``, so this is
+the ceiling, not the typical cost).  A final leg proves the armed
+recorder is effect-free where it matters: a pipelined serving replay
+with flight + tracing armed must produce a store digest byte-identical
+to the synchronous ``CS_TPU_SERVING=0`` oracle (``load.sync_digest``).
+
+Exits nonzero when either computed overhead reaches 2% or the armed
+digests diverge.
 """
 import json
 import os
@@ -67,6 +78,74 @@ def _per_op_add_ns(n=1_000_000) -> float:
         return (time.perf_counter() - t0) / n * 1e9
 
     return _best_of(one)
+
+
+def _per_op_flight_ns(n=1_000_000) -> float:
+    """Disarmed ``flight.record()`` call: one module-global check and
+    return.  This is what every always-on evidence site (fault hook,
+    breaker transition, window submit) pays with CS_TPU_FLIGHT=0."""
+    from consensus_specs_tpu.obs import flight
+    assert not flight.is_enabled()
+    record = flight.record
+
+    def one():
+        t0 = time.perf_counter()
+        for _ in range(n):
+            record("bench.noop")
+        return (time.perf_counter() - t0) / n * 1e9
+
+    return _best_of(one)
+
+
+def _flight_census() -> int:
+    """Flight records one armed+traced replay emits (span enter/exit
+    records dominate; the evidence sites add a handful)."""
+    from consensus_specs_tpu import obs
+    from consensus_specs_tpu.obs import flight, registry, tracing
+    from consensus_specs_tpu.tools.obs_report import replay
+    spec, state = _fresh_replay_args()
+    obs.reset_all()
+    flight.enable(True)
+    obs.enable(True, counters=False)
+    try:
+        replay(spec, state, SLOTS)
+        # emitted, not retained: the ring caps what record_count() can
+        # see, the cumulative counter does not wrap
+        return registry.counter("obs.flight.records").total()
+    finally:
+        obs.enable(False)
+        flight.enable(False)
+        tracing.reset()
+        obs.reset_all()
+
+
+def _serving_digest_identity() -> dict:
+    """Armed-recorder effect-freedom: a pipelined serving replay with
+    flight + span tracing on must land the byte-identical store the
+    synchronous oracle lands."""
+    from consensus_specs_tpu import obs
+    from consensus_specs_tpu.forks import build_spec
+    from consensus_specs_tpu.obs import flight
+    from consensus_specs_tpu.serving.pipeline import BlockServer
+    from consensus_specs_tpu.sim import load
+    spec = build_spec("phase0", "minimal")
+    stream = load.generate(spec, seed=3, name="equivocation")
+    oracle = load.sync_digest(spec, stream)
+    obs.reset_all()
+    flight.enable(True)
+    obs.enable(True, counters=False)
+    try:
+        server = BlockServer(spec, load.anchor_store(spec, stream),
+                             window=3)
+        load.serve(server, stream)
+        armed = load.store_digest(spec, server.store)
+        records = flight.record_count()
+    finally:
+        obs.enable(False)
+        flight.enable(False)
+        obs.reset_all()
+    return {"oracle": oracle, "armed": armed, "flight_records": records,
+            "windows": len(server.window_log)}
 
 
 def _fresh_replay_args():
@@ -142,17 +221,23 @@ def _census() -> tuple:
 
 def main() -> int:
     from consensus_specs_tpu import obs
+    from consensus_specs_tpu.obs import flight
     from consensus_specs_tpu.utils import bls
     bls.bls_active = False
     # this bench measures the DISABLED path: force both gates off no
     # matter what CS_TPU_PROFILE/CS_TPU_TRACE the caller's shell exports
     # (otherwise the per-op loops would time the enabled tree-insert
-    # path and fail the bound spuriously)
+    # path and fail the bound spuriously).  The flight recorder is
+    # disarmed too: its per-record counter bump would otherwise inflate
+    # the census, and its per-op cost is measured disarmed by design.
     obs.enable(False, counters=False)
+    flight.enable(False)
 
     span_ns = _per_op_span_ns()
     add_ns = _per_op_add_ns()
+    flight_ns = _per_op_flight_ns()
     spans, bumps = _census()
+    flight_records = _flight_census()
 
     # timed replays, telemetry fully off (the shipping default)
     disabled_s = min(_timed_replay() for _ in range(REPS))
@@ -167,23 +252,42 @@ def main() -> int:
 
     overhead_s = (spans * span_ns + bumps * add_ns) / 1e9
     overhead_pct = overhead_s / disabled_s * 100.0
+    # flight ceiling: every record an armed+traced replay would emit,
+    # priced at the disarmed call cost (the span-site records are in
+    # truth gated behind one module-global read, cheaper still)
+    flight_overhead_pct = (flight_records * flight_ns / 1e9
+                           / disabled_s * 100.0)
+
+    identity = _serving_digest_identity()
 
     print(json.dumps({
         "metric": f"obs disabled-path overhead, {SLOTS}-slot replay, "
                   f"{VALIDATORS} validators",
         "span_disabled_ns": round(span_ns, 1),
         "counter_add_ns": round(add_ns, 1),
+        "flight_disarmed_ns": round(flight_ns, 1),
         "spans_per_replay": spans,
         "counter_bumps_per_replay": bumps,
+        "flight_records_per_replay": flight_records,
         "replay_disabled_s": round(disabled_s, 4),
         "replay_profiled_s": round(enabled_s, 4),
         "computed_overhead_s": round(overhead_s, 6),
         "computed_overhead_pct": round(overhead_pct, 3),
+        "flight_overhead_pct": round(flight_overhead_pct, 3),
+        "serving_digest_identity": identity["oracle"] == identity["armed"],
+        "serving_flight_records": identity["flight_records"],
+        "serving_windows": identity["windows"],
     }), flush=True)
 
     assert overhead_pct < 2.0, (
         f"disabled-path telemetry overhead {overhead_pct:.2f}% >= 2% "
         f"of the {SLOTS}-slot replay")
+    assert flight_overhead_pct < 2.0, (
+        f"disarmed flight-recorder overhead {flight_overhead_pct:.2f}% "
+        f">= 2% of the {SLOTS}-slot replay")
+    assert identity["oracle"] == identity["armed"], (
+        "armed flight+trace serving replay diverged from the "
+        f"synchronous oracle: {identity['armed']} != {identity['oracle']}")
     return 0
 
 

@@ -245,6 +245,9 @@ def injected(schedule: FaultSchedule):
 # an import) keep this module dependency-free for test collection.
 _failure_hook = None
 _deadline_cls = ()
+# flight-recorder tap (set by obs.flight at import): every classified
+# fallback lands in the per-thread ring so crash artifacts carry it
+_flight_hook = None
 
 
 def count_fallback(series: dict, exc=None, organic: str = "guard",
@@ -267,5 +270,7 @@ def count_fallback(series: dict, exc=None, organic: str = "guard",
     else:
         reason = organic
     series[reason].add()
+    if _flight_hook is not None:
+        _flight_hook(site or "", reason)
     if site is not None and _failure_hook is not None:
         _failure_hook(site, reason)

@@ -1,30 +1,40 @@
-"""Unified telemetry subsystem: metrics registry, tracing spans, and
-exporters for the whole engine stack.
+"""Unified telemetry subsystem: metrics registry, tracing spans, flight
+recorder, exporters, and a live HTTP plane for the whole engine stack.
 
-Three layers, importable without jax or the fork registry:
+Five layers, importable without jax or the fork registry:
 
 * ``obs.registry`` — typed, labeled metrics (``counter`` / ``gauge`` /
   ``histogram``).  Always on; hot paths pre-bind series at module scope
   and pay one int add per event.
 * ``obs.tracing``  — hierarchical wall-clock spans with self-vs-
   cumulative time and (under ``CS_TPU_TRACE=1``) attached counter
-  deltas.  Zero-overhead when disabled.
+  deltas.  Zero-overhead when disabled.  Cross-thread causality via
+  ``capture_context()`` / ``adopt_context()`` (the serving pipeline's
+  flush-worker lane parents under its window's span).
+* ``obs.flight``   — bounded per-thread ring buffers of span / fault /
+  breaker events (``CS_TPU_FLIGHT``, default on); ``dump()`` is
+  attached to every evidence artifact and exports to Chrome-trace
+  JSON.
 * ``obs.export``   — JSON snapshot, Prometheus text format, human
   ``report()`` table, and the snapshot schema check the bench smokes
   assert on.
+* ``obs.http``     — ``obs.serve(port)``: ``/metrics`` + ``/healthz``
+  + ``/snapshot`` scraped live during a replay (lazily imported).
 
 CLI: ``python -m consensus_specs_tpu.tools.obs_report`` replays a
-configurable slot window with full telemetry and prints any exporter's
+configurable slot window (or, with ``--serving``, a pipelined
+``sim/load`` stream) with full telemetry and prints any exporter's
 view.  Docs: ``docs/observability.md``.
 """
 from .registry import (                              # noqa: F401
     counter, gauge, histogram, metrics)
-from .tracing import span, span_tree, stats          # noqa: F401
+from .tracing import (                               # noqa: F401
+    span, span_tree, stats, capture_context, adopt_context)
 from .export import (                                # noqa: F401
     snapshot, report, to_json, to_prometheus, assert_schema,
     schema_problems)
 from .instrument import install_tracing              # noqa: F401
-from . import registry, tracing, export              # noqa: F401
+from . import registry, tracing, flight, export      # noqa: F401
 
 
 def enable(on: bool = True, counters=None) -> None:
@@ -33,6 +43,15 @@ def enable(on: bool = True, counters=None) -> None:
 
 
 def reset_all() -> None:
-    """Zero every metric series and drop all recorded spans."""
+    """Zero every metric series, drop all recorded spans, and clear
+    the flight-recorder rings."""
     registry.reset()
     tracing.reset()
+    flight.reset()
+
+
+def serve(port: int = 0, host: str = "127.0.0.1"):
+    """Start the live telemetry HTTP plane (see ``obs.http.serve``);
+    imported lazily so the default path never loads ``http.server``."""
+    from . import http
+    return http.serve(port, host)

@@ -114,6 +114,11 @@ def report() -> str:
                           key=lambda kv: -kv[1]["total_s"])
             for name, node in rows:
                 label = "  " * depth + name
+                # a root subtree born on a worker thread that adopted no
+                # trace context: its time is causally unattributed, so
+                # say so instead of letting it read like a call site
+                if node.get("orphan"):
+                    label += "  [orphan thread]"
                 out.append(f"{label:<44}  {node['count']:>7}  "
                            f"{node['total_s']:>8.3f}s  "
                            f"{node['self_s']:>8.3f}s  "

@@ -1,0 +1,235 @@
+"""Flight recorder: bounded per-thread ring buffers of engine events.
+
+A serving process that dies mid-window leaves the span tree (aggregates,
+no ordering) and the counter ledger (totals, no timeline) — neither says
+*what happened last*.  The flight recorder keeps the last-N events per
+thread in a fixed-slot ring so every evidence path (supervisor
+quarantine artifacts, sim ``LegFailure`` dumps, recovery divergence
+info, ``DurableReplay`` crash resume) can attach an ordered tail of
+span enters/exits, fallback classifications, and breaker transitions::
+
+    from consensus_specs_tpu.obs import flight
+
+    flight.record("fallback", "bls.flush", 0.0)   # cold sites only
+    payload["flight"] = flight.dump(trigger="quarantine")
+
+Record vocabulary (one fixed-slot tuple per event —
+``(seq, t_perf, code, detail, value)``):
+
+* ``span>`` / ``span<`` — span enter / exit (``detail`` = span name,
+  ``value`` = duration on exit).  Emitted by ``obs.tracing`` only when
+  spans are on, so the default (profile-off) replay pays nothing.
+* ``fallback`` — a :func:`faults.count_fallback` classification
+  (``detail`` = ``site:reason``), hooked via ``faults._flight_hook``.
+* ``breaker`` — a supervisor breaker transition (``detail`` =
+  ``site:state``).
+* ``quarantine`` / ``divergence`` / ``note`` — cold-path annotations.
+
+Gating: ``CS_TPU_FLIGHT`` (default **on** — recording is cold-path
+only, see above) arms the recorder; the disarmed cost of
+:func:`record` is one module-global read, bench-gated <2% alongside
+the span machinery in ``benchmarks/bench_obs_overhead.py``.
+``CS_TPU_FLIGHT_SIZE`` bounds each ring (default 1024 slots).
+
+Rings are thread-local for writes (no locks on the record path; slot
+stores are single list-item assignments, atomic under the GIL) and
+merged by thread name at :func:`dump` time.  A dump taken while other
+threads are writing is a best-effort snapshot: records are tagged with
+a global sequence number and sorted, so the merged view is totally
+ordered even across a racing wrap.
+"""
+import itertools
+import json
+import threading
+import time
+
+from ..utils import env_flags
+from . import registry
+
+DEFAULT_SIZE = 1024
+
+# hot-path handle: one bound int add per record (same contract as every
+# engine counter — see obs/registry.py)
+_C_RECORDS = registry.counter("obs.flight.records").labels()
+_C_DUMPS = registry.counter("obs.flight.dumps")   # labeled per trigger
+
+_armed = env_flags.switch("CS_TPU_FLIGHT")
+_lock = threading.Lock()
+_rings = []             # every live ring (any thread), for dump()
+_tls = threading.local()
+_gen = 0                # bumped by reset(): stale thread-local rings die
+_seq = itertools.count()
+
+
+def _ring_size() -> int:
+    raw = env_flags.knob("CS_TPU_FLIGHT_SIZE")
+    try:
+        return max(8, int(raw)) if raw else DEFAULT_SIZE
+    except ValueError:
+        return DEFAULT_SIZE
+
+
+_size = _ring_size()
+
+
+class _Ring:
+    """One thread's fixed-slot record ring."""
+
+    __slots__ = ("thread", "gen", "size", "slots", "idx")
+
+    def __init__(self, thread: str, gen: int, size: int):
+        self.thread = thread
+        self.gen = gen
+        self.size = size
+        self.slots = [None] * size
+        self.idx = 0
+
+
+def _ring() -> _Ring:
+    r = getattr(_tls, "ring", None)
+    if r is None or r.gen != _gen:
+        r = _Ring(threading.current_thread().name, _gen, _size)
+        _tls.ring = r
+        with _lock:
+            _rings.append(r)
+    return r
+
+
+def record(code: str, detail: str = "", value: float = 0.0) -> None:
+    """Append one event to the calling thread's ring.  Disarmed cost:
+    one module-global read.  Armed cost: a counter-next, two attribute
+    reads and a list-slot store — still cold-path-only by convention
+    (speclint O5xx keeps per-pair paths clean of *any* bookkeeping)."""
+    if not _armed:
+        return
+    r = _ring()
+    i = r.idx
+    r.slots[i % r.size] = (next(_seq), time.perf_counter(), code,
+                           detail, value)
+    r.idx = i + 1
+    _C_RECORDS.add()
+
+
+def is_enabled() -> bool:
+    return _armed
+
+
+def enable(on: bool = True) -> None:
+    """Arm/disarm at runtime (the env switch sets the default)."""
+    global _armed
+    _armed = bool(on)
+
+
+def reset(refresh_env: bool = False) -> None:
+    """Drop every recorded event (all threads).  ``refresh_env=True``
+    additionally re-reads ``CS_TPU_FLIGHT`` / ``CS_TPU_FLIGHT_SIZE`` —
+    the sim harness passes it so each leg's env applies cleanly."""
+    global _gen, _armed, _size, _seq
+    with _lock:
+        _rings.clear()
+    _gen += 1
+    _seq = itertools.count()
+    if refresh_env:
+        _armed = env_flags.switch("CS_TPU_FLIGHT")
+        _size = _ring_size()
+
+
+def record_count() -> int:
+    """Total records currently retained across all rings (bounded by
+    threads x ring size; the cumulative count is ``obs.flight.records``)."""
+    with _lock:
+        rings = list(_rings)
+    return sum(min(r.idx, r.size) for r in rings)
+
+
+def dump(trigger: str = "manual") -> dict:
+    """Plain-data snapshot of every ring, merged by thread name and
+    ordered by the global sequence number.  Safe to call from any
+    thread at any time (including inside crash/quarantine paths); the
+    result is JSON-ready and attached verbatim to evidence artifacts."""
+    with _lock:
+        rings = list(_rings)
+    threads = {}
+    dropped = 0
+    for r in rings:
+        idx, size, slots = r.idx, r.size, r.slots
+        dropped += max(0, idx - size)
+        recs = threads.setdefault(r.thread, [])
+        for j in range(max(0, idx - size), idx):
+            rec = slots[j % size]
+            if rec is not None:
+                recs.append([rec[0], round(rec[1], 6), rec[2], rec[3],
+                             round(rec[4], 6)])
+    for recs in threads.values():
+        recs.sort()
+    _C_DUMPS.labels(trigger=trigger).add()
+    return {"kind": "flight", "trigger": trigger, "enabled": _armed,
+            "size": _size, "dropped": dropped, "threads": threads}
+
+
+def format_dump(d: dict) -> str:
+    """Human rendering of a :func:`dump` payload (used by
+    ``sim.repro.replay`` when an artifact carries a flight tail)."""
+    if not d or not d.get("threads"):
+        return "flight recorder: no records"
+    all_t = [rec[1] for recs in d["threads"].values() for rec in recs]
+    t0 = min(all_t) if all_t else 0.0
+    lines = [f"flight recorder (trigger={d.get('trigger', '?')}, "
+             f"{sum(len(r) for r in d['threads'].values())} records, "
+             f"{d.get('dropped', 0)} dropped):"]
+    for thread in sorted(d["threads"]):
+        lines.append(f"  [{thread}]")
+        for seq, t, code, detail, value in d["threads"][thread]:
+            suffix = f"  {value * 1e3:.3f}ms" if value else ""
+            lines.append(f"    {seq:>6}  +{(t - t0) * 1e3:9.3f}ms  "
+                         f"{code:<10} {detail}{suffix}")
+    return "\n".join(lines)
+
+
+def to_chrome_trace(d: dict = None) -> dict:
+    """Chrome-trace / Perfetto JSON view of a dump: ``span<`` records
+    become complete ("X") slices with real thread lanes, everything
+    else an instant event — load the file in ``chrome://tracing`` or
+    ``ui.perfetto.dev`` to see a serving window's double-buffered
+    overlap (main-thread transition vs ``serving-flush`` lane) on a
+    timeline."""
+    if d is None:
+        d = dump(trigger="export")
+    events = []
+    tids = {}
+    for tname in sorted(d.get("threads", {})):
+        tid = tids.setdefault(tname, len(tids) + 1)
+        events.append({"ph": "M", "name": "thread_name", "pid": 1,
+                       "tid": tid, "args": {"name": tname}})
+        for seq, t, code, detail, value in d["threads"][tname]:
+            ts = t * 1e6
+            if code == "span<":
+                events.append({"ph": "X", "name": detail, "cat": "span",
+                               "pid": 1, "tid": tid,
+                               "ts": round(ts - value * 1e6, 3),
+                               "dur": round(value * 1e6, 3)})
+            elif code != "span>":
+                events.append({"ph": "i", "s": "t", "cat": code,
+                               "name": f"{code} {detail}".strip(),
+                               "pid": 1, "tid": tid, "ts": round(ts, 3)})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path: str, d: dict = None) -> int:
+    """Write :func:`to_chrome_trace` JSON to ``path``; returns the
+    event count (``obs_report --trace-out``)."""
+    trace = to_chrome_trace(d)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return len(trace["traceEvents"])
+
+
+def _on_fallback(site: str, reason: str) -> None:
+    record("fallback", f"{site}:{reason}")
+
+
+# Register the faults hook at import (same pattern as the supervisor's
+# _failure_hook: faults.py stays import-dependency-free).
+from .. import faults as _faults                       # noqa: E402
+
+_faults._flight_hook = _on_fallback

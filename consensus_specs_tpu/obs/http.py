@@ -1,0 +1,142 @@
+"""Live telemetry plane: a stdlib HTTP endpoint over the obs surface.
+
+The first real transport in front of the serving event surface
+(ROADMAP item 2): a background ``http.server`` thread exposing the
+registry/span/supervisor state that the exporters already produce, so
+a running replay can be scraped instead of post-processed::
+
+    from consensus_specs_tpu import obs
+
+    srv = obs.serve(port=0)          # 0 = ephemeral; srv.port tells
+    ...                              # ... replay traffic ...
+    srv.close()
+
+Endpoints (all GET; anything else is a counted 404):
+
+* ``/metrics``  — the Prometheus text exposition
+  (``obs.export.to_prometheus``), content type ``text/plain``.
+* ``/healthz``  — supervisor breaker/quarantine states as JSON;
+  **503** while any site is quarantined (a scraper's liveness gate),
+  200 otherwise.
+* ``/snapshot`` — the full schema-checked JSON snapshot
+  (``obs.export.snapshot``); the handler runs ``schema_problems``
+  before answering and turns violations into a 500, so a scraped
+  snapshot is *always* schema-valid.
+
+Every request bumps ``obs.http.requests{endpoint=}``.  Handlers run on
+daemon threads (``ThreadingHTTPServer``) and only *read* the registry
+— the snapshot paths copy C-atomically (see ``obs/registry.py``'s
+thread model), so scraping never perturbs or blocks the replay being
+observed.  This module is imported lazily by :func:`obs.serve`; the
+default path never pays for it.
+"""
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .. import supervisor
+from . import export
+from . import registry
+
+_C_REQ = registry.counter("obs.http.requests")
+_ENDPOINTS = {
+    "/metrics": _C_REQ.labels(endpoint="metrics"),
+    "/healthz": _C_REQ.labels(endpoint="healthz"),
+    "/snapshot": _C_REQ.labels(endpoint="snapshot"),
+}
+_REQ_OTHER = _C_REQ.labels(endpoint="other")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "cs-tpu-obs/1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):     # no stderr chatter under pytest
+        pass
+
+    def _send(self, code: int, ctype: str, body: bytes) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):                 # noqa: N802 (http.server API)
+        path = self.path.split("?", 1)[0]
+        _ENDPOINTS.get(path, _REQ_OTHER).add()
+        try:
+            if path == "/metrics":
+                body = export.to_prometheus().encode()
+                self._send(200, "text/plain; version=0.0.4", body)
+            elif path == "/healthz":
+                self._healthz()
+            elif path == "/snapshot":
+                self._snapshot()
+            else:
+                self._send(404, "text/plain", b"not found\n")
+        except BrokenPipeError:       # scraper hung up mid-reply
+            pass
+        except Exception as exc:      # never kill the serving thread
+            try:
+                self._send(500, "text/plain",
+                           f"telemetry error: {exc}\n".encode())
+            except OSError:
+                pass
+
+    def _healthz(self) -> None:
+        states = supervisor.states()
+        quarantined = sorted(s for s, st in states.items()
+                             if st == "quarantined")
+        body = json.dumps({
+            "ok": not quarantined,
+            "supervisor_enabled": supervisor.enabled(),
+            "quarantined": quarantined,
+            "breakers": states,
+        }, sort_keys=True).encode()
+        self._send(503 if quarantined else 200, "application/json", body)
+
+    def _snapshot(self) -> None:
+        snap = export.snapshot()
+        problems = export.schema_problems(snap)
+        if problems:
+            self._send(500, "application/json",
+                       json.dumps({"schema_problems": problems}).encode())
+            return
+        self._send(200, "application/json",
+                   json.dumps(snap, sort_keys=True).encode())
+
+
+class TelemetryServer:
+    """Handle on a running telemetry endpoint; context-manager aware."""
+
+    def __init__(self, httpd, thread):
+        self._httpd = httpd
+        self._thread = thread
+        self.host, self.port = httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+
+def serve(port: int = 0, host: str = "127.0.0.1") -> TelemetryServer:
+    """Start the telemetry plane on a daemon thread and return its
+    handle.  ``port=0`` binds an ephemeral port (read ``.port``)."""
+    httpd = ThreadingHTTPServer((host, port), _Handler)
+    httpd.daemon_threads = True
+    thread = threading.Thread(target=httpd.serve_forever, args=(0.05,),
+                              name="obs-http", daemon=True)
+    thread.start()
+    return TelemetryServer(httpd, thread)

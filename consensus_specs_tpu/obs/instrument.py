@@ -14,6 +14,14 @@ method is already wrapped on the base; a fork's override gets its own
 wrapper), and wrapping is idempotent.  The wrapper's disabled path is
 one module-global read on top of the original call — per-slot / per-
 block granularity, so it never sits inside a per-validator loop.
+
+These wrappers record on whichever thread calls them: spec code runs
+on the main thread by contract (``serving/pipeline.py`` keeps the
+worker lane to pure verification), so wrapped spans nest under the
+caller's open span there — e.g. ``on_block`` under ``serving.window``.
+Code that DOES move work to a thread must hand over a
+``tracing.capture_context()`` / ``adopt_context()`` pair, or its spans
+root an ``[orphan thread]`` subtree (speclint O504 flags the miss).
 """
 import functools
 

@@ -14,11 +14,33 @@ tracer (``obs/tracing.py``) and the bench smokes read one surface::
 
 Hot-path contract (enforced by the speclint O5xx pass): series are
 resolved ONCE at module import (``counter(name).labels(**kv)``) and the
-per-event cost is one bound-attribute integer add, which the GIL makes
-atomic enough for accounting (the value can never tear; a lost update
-under free-threaded racing costs a count, not a crash).  ``counter()``
+per-event cost is one bound-attribute integer add.  ``counter()``
 / ``labels()`` involve dict lookups and a lock and must never sit on a
 per-pair / per-validator path.
+
+Thread model (the serving pipeline bumps handles from both the main
+thread and the flush-worker lane concurrently):
+
+* **Counter adds are lock-free and lose nothing under the GIL.**
+  ``self.n += n`` compiles to a load/add/store run with no call and no
+  backward jump between the load and the store — exactly the points
+  where CPython's eval-breaker can hand the GIL to another thread — so
+  the read-modify-write cannot be preempted mid-flight and two threads
+  hammering one handle drop zero increments
+  (``tests/test_observability.py::test_counter_hammer_two_threads``
+  pins this empirically).  The value can never tear either way: ints
+  are immutable objects, the slot store is atomic.
+* **Histogram observations take a per-series lock.**  ``observe``
+  mutates five fields and loops over the bucket bounds; the loop's
+  backward jumps ARE preemption points, so without the lock a
+  concurrent pair of observations could interleave (count drift,
+  torn min/max).  Histogram sites are per-window / per-block — never
+  per-pair — so the ~100ns lock is off the O5xx-guarded paths.
+* **Snapshot readers copy before iterating.**  ``counter_values`` /
+  ``snapshot`` / ``reset`` materialize the live dicts via C-level
+  ``list()``/``sorted()`` (atomic under the GIL) before walking them,
+  so a scrape racing a first-time ``labels()`` registration never sees
+  "dictionary changed size during iteration".
 
 Counters are always on: the differential suites assert on them to prove
 which engine actually answered, so they cannot hide behind an env flag.
@@ -84,32 +106,39 @@ DEFAULT_BUCKETS = (0.001, 0.005, 0.025, 0.1, 0.5, 2.5, 10.0, 60.0)
 
 
 class _HistogramSeries:
-    __slots__ = ("buckets", "counts", "count", "sum", "min", "max")
+    __slots__ = ("buckets", "counts", "count", "sum", "min", "max",
+                 "_hlock")
 
     def __init__(self, buckets):
         self.buckets = buckets
+        self._hlock = threading.Lock()
         self._reset()
 
     def observe(self, v):
-        self.count += 1
-        self.sum += v
-        if self.min is None or v < self.min:
-            self.min = v
-        if self.max is None or v > self.max:
-            self.max = v
-        for i, le in enumerate(self.buckets):
-            if v <= le:
-                self.counts[i] += 1
-                break
-        else:
-            self.counts[-1] += 1     # +Inf overflow bucket
+        # multi-field update with preemption points (the bucket loop's
+        # backward jumps) — locked, unlike counter adds; see the thread
+        # model in the module docstring
+        with self._hlock:
+            self.count += 1
+            self.sum += v
+            if self.min is None or v < self.min:
+                self.min = v
+            if self.max is None or v > self.max:
+                self.max = v
+            for i, le in enumerate(self.buckets):
+                if v <= le:
+                    self.counts[i] += 1
+                    break
+            else:
+                self.counts[-1] += 1     # +Inf overflow bucket
 
     def _reset(self):
-        self.counts = [0] * (len(self.buckets) + 1)
-        self.count = 0
-        self.sum = 0.0
-        self.min = None
-        self.max = None
+        with self._hlock:
+            self.counts = [0] * (len(self.buckets) + 1)
+            self.count = 0
+            self.sum = 0.0
+            self.min = None
+            self.max = None
 
     def quantile(self, q: float):
         """Bucket-interpolated quantile estimate (the
@@ -141,11 +170,12 @@ class _HistogramSeries:
         # bucket keys as strings ("0.1" ... "+Inf"): keeps the snapshot
         # JSON-sortable and maps 1:1 onto Prometheus ``le`` label values
         keys = [str(b) for b in self.buckets] + ["+Inf"]
-        return {"count": self.count, "sum": self.sum,
-                "min": self.min, "max": self.max,
-                "p50": self.quantile(0.5), "p90": self.quantile(0.9),
-                "p99": self.quantile(0.99),
-                "buckets": dict(zip(keys, self.counts))}
+        with self._hlock:     # consistent multi-field view vs observe()
+            return {"count": self.count, "sum": self.sum,
+                    "min": self.min, "max": self.max,
+                    "p50": self.quantile(0.5), "p90": self.quantile(0.9),
+                    "p99": self.quantile(0.99),
+                    "buckets": dict(zip(keys, self.counts))}
 
 
 def _label_key(kv: dict) -> tuple:
@@ -190,11 +220,13 @@ class _Metric:
         return s._value() if s is not None else 0
 
     def reset(self):
-        for s in self._series.values():
+        for s in list(self._series.values()):
             s._reset()
 
     def series_values(self) -> dict:
-        """{rendered-label-suffix: value} snapshot of every series."""
+        """{rendered-label-suffix: value} snapshot of every series.
+        ``sorted()`` materializes the dict C-atomically, so a scrape
+        racing a first-time ``labels()`` registration stays safe."""
         return {render_labels(k): s._value()
                 for k, s in sorted(self._series.items())}
 
@@ -212,7 +244,7 @@ class Counter(_Metric):
         self.labels(**kv).add(n)
 
     def total(self) -> int:
-        return sum(s.n for s in self._series.values())
+        return sum(s.n for s in list(self._series.values()))
 
 
 class Gauge(_Metric):
@@ -280,7 +312,7 @@ def counter_values() -> dict:
     """Flat {name + label-suffix: int} over counters only — the cheap
     view the span tracer diffs on span entry/exit."""
     out = {}
-    for name, m in _metrics.items():
+    for name, m in list(_metrics.items()):   # C-atomic copy: scrape-safe
         if m.kind != "counter":
             continue
         for key, s in m.series_items():
@@ -321,6 +353,6 @@ def book_flat_deltas(deltas: dict) -> None:
 def reset(prefix: str = "") -> None:
     """Zero every series (in place — bound handles stay live) whose
     metric name starts with ``prefix``; everything when empty."""
-    for name, m in _metrics.items():
+    for name, m in list(_metrics.items()):
         if name.startswith(prefix):
             m.reset()

@@ -24,13 +24,26 @@ Disabled path (the default, speclint O5xx's sanctioned pattern): one
 module-global read in ``__enter__`` and one attribute test in
 ``__exit__`` — branch-predictable, allocation-free, and measured at
 <2% on the 32-slot replay by ``benchmarks/bench_obs_overhead.py``.
-Span state is thread-local; concurrent threads build disjoint subtrees
-under the shared root.
+
+Span state is thread-local, so by default concurrent threads build
+disjoint subtrees under the shared root.  Cross-thread causality is
+explicit: the submitting thread calls :func:`capture_context` while
+its span of interest is open, hands the returned :class:`TraceContext`
+to the worker, and the worker wraps its work in
+:func:`adopt_context` — its spans then parent under the captured node
+(one causally-linked tree per request) and carry the context's
+``trace_id``.  A root-level subtree opened on a non-main thread that
+*didn't* adopt a context is flagged ``orphan`` in :func:`span_tree`
+so reports can call out unattributed worker-lane time instead of
+silently merging it (speclint O504 statically flags thread submits
+that skip the handoff).
 """
+import itertools
 import threading
 import time
 
 from ..utils import env_flags
+from . import flight
 from . import registry
 
 _enabled = env_flags.PROFILE or env_flags.TRACE
@@ -42,7 +55,7 @@ class _Node:
     the same call path)."""
 
     __slots__ = ("name", "count", "total", "child_total", "max",
-                 "children", "counters")
+                 "children", "counters", "orphan")
 
     def __init__(self, name):
         self.name = name
@@ -52,6 +65,7 @@ class _Node:
         self.max = 0.0
         self.children = {}      # name -> _Node
         self.counters = {}      # metric+labels -> cumulative delta
+        self.orphan = False     # root created on a non-adopted thread
 
 
 _root = _Node("<root>")
@@ -89,12 +103,98 @@ def trace_counters_enabled() -> bool:
 
 
 def reset() -> None:
-    """Drop all recorded spans (flat stats and the tree)."""
+    """Drop all recorded spans (flat stats and the tree) and re-seed
+    the trace-id counter: a fresh tree hands out ids from 1 again, so
+    seeded replays leave byte-deterministic flight tails."""
+    global _trace_ids
     _flat.clear()
     _root.children.clear()
     _root.count = 0
     _root.total = _root.child_total = _root.max = 0.0
     _root.counters.clear()
+    _trace_ids = itertools.count(1)
+
+
+_trace_ids = itertools.count(1)
+
+
+class TraceContext:
+    """An explicit cross-thread handoff of one tree position.
+
+    Captured on the thread whose span should become the parent, adopted
+    (usually once) on the thread doing work on its behalf.  ``trace_id``
+    is a process-unique request identifier the pipeline threads through
+    window ingest, the flush-worker submit and the barrier join.
+    Concurrent adoption from *different* threads is allowed — the
+    serving barrier joins a window whose flush worker is still inside
+    its adoption — but a thread re-adopting a context it already holds
+    is refused (it would double-push the same node on one stack)."""
+
+    __slots__ = ("node", "trace_id", "_threads")
+
+    def __init__(self, node, trace_id):
+        self.node = node
+        self.trace_id = trace_id
+        self._threads = set()   # idents currently inside adopt_context
+
+    def __repr__(self):
+        where = self.node.name if self.node is not None else None
+        return f"TraceContext(trace_id={self.trace_id}, node={where!r})"
+
+
+def capture_context():
+    """Capture the calling thread's current tree position (the
+    innermost open span) for adoption on another thread.  Returns
+    ``None`` when spans are disabled — :func:`adopt_context` treats
+    ``None`` as a no-op, so call sites need no gating of their own."""
+    if not _enabled:
+        return None
+    return TraceContext(_stack()[-1], next(_trace_ids))
+
+
+class adopt_context:
+    """Context manager parenting the calling thread's spans under a
+    captured :class:`TraceContext` — the worker half of the handoff.
+
+    Exception-safe: unwinding pops everything the adopted region
+    pushed, even if a span inside leaked (the stack is restored to its
+    pre-adoption shape).  ``None`` (or a context captured while
+    disabled) adopts nothing and costs one attribute test."""
+
+    __slots__ = ("ctx", "_pushed")
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self._pushed = False
+
+    def __enter__(self):
+        ctx = self.ctx
+        if ctx is None or ctx.node is None or not _enabled:
+            return self
+        ident = threading.get_ident()
+        if ident in ctx._threads:
+            raise RuntimeError(
+                f"trace context {ctx.trace_id} already adopted on this "
+                f"thread (double-adopt)")
+        ctx._threads.add(ident)
+        _stack().append(ctx.node)
+        self._pushed = True
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if not self._pushed:
+            return False
+        self._pushed = False
+        self.ctx._threads.discard(threading.get_ident())
+        stack = _stack()
+        node = self.ctx.node
+        # pop leaked spans (exception unwind) down to, and including,
+        # the adopted node; never pop the thread's own root sentinel
+        while len(stack) > 1 and stack[-1] is not node:
+            stack.pop()
+        if len(stack) > 1 and stack[-1] is node:
+            stack.pop()
+        return False
 
 
 class span:
@@ -119,8 +219,15 @@ class span:
         node = parent.children.get(self.name)
         if node is None:
             node = parent.children[self.name] = _Node(self.name)
+            if (parent is _root and threading.current_thread()
+                    is not threading.main_thread()):
+                # a worker thread rooting its own subtree: no context
+                # was adopted, so this time is causally unattributed
+                node.orphan = True
         stack.append(node)
         self._node = node
+        if flight._armed:
+            flight.record("span>", self.name)
         self._c0 = registry.counter_values() if _trace_counters else None
         self._t0 = time.perf_counter()
         return self
@@ -131,6 +238,8 @@ class span:
             return False
         dt = time.perf_counter() - self._t0
         self._node = None
+        if flight._armed:
+            flight.record("span<", node.name, dt)
         stack = _stack()
         stack.pop()
         stack[-1].child_total += dt
@@ -191,7 +300,7 @@ def span_tree() -> dict:
     {name: {count, total_s, self_s, max_s, counters, children}}."""
 
     def _dump(node):
-        return {
+        out = {
             "count": node.count,
             "total_s": round(node.total, 6),
             "self_s": round(node.total - node.child_total, 6),
@@ -200,5 +309,8 @@ def span_tree() -> dict:
             "children": {n: _dump(c) for n, c in
                          sorted(node.children.items())},
         }
+        if node.orphan:
+            out["orphan"] = True
+        return out
 
     return {n: _dump(c) for n, c in sorted(_root.children.items())}

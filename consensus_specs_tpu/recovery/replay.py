@@ -31,6 +31,7 @@ import signal
 import struct
 
 from consensus_specs_tpu import faults, recovery, supervisor
+from consensus_specs_tpu.obs import flight
 from consensus_specs_tpu.obs.tracing import span
 from consensus_specs_tpu.recovery import journal
 from consensus_specs_tpu.recovery.checkpoint import (
@@ -142,10 +143,18 @@ def restore_replay(spec, scenario, cs: CheckpointStore):
             info["path"] = "checkpoint"
             info["generation"] = gen
             info["journal_steps"] = len(steps)
+            if info["rungs"]:
+                # a degraded resume is divergence evidence: attach the
+                # flight tail (every rung's fallback classification is
+                # in it via the faults hook) to the info record the
+                # durable runner persists
+                info["flight"] = flight.dump(trigger="divergence")
             return sim, next_step, info
     # final rung: byte-identical by determinism, just slower
     from consensus_specs_tpu.sim.driver import ChainSim
     RESTORES["genesis"].add()
+    if info["rungs"]:
+        info["flight"] = flight.dump(trigger="divergence")
     return ChainSim(spec, scenario.n_validators), 0, info
 
 

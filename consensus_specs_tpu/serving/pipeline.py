@@ -39,12 +39,24 @@ byte-identical to a synchronous run by construction, and per-block
 errors land exactly where the spec path raises them.  The fallback is
 counted per reason under ``serving.fallbacks`` and feeds the breaker
 (:func:`supervisor.admit`) like every other engine site.
+
+**Causal tracing**: each window captures a ``tracing.TraceContext``
+(carrying a process-unique trace id) while its ``serving.window`` span
+is open; the flush worker and the (next window's) barrier join adopt
+it, so under ``CS_TPU_PROFILE``/``CS_TPU_TRACE`` the span tree shows
+ONE tree per window — transition, worker-lane ``serving.flush``,
+``serving.barrier``, and ``serving.replay`` when the unwind is taken —
+instead of the flush rooting an orphan subtree on its own thread.
+``BlockServer.window_log`` additionally keeps a per-window latency
+breakdown (queued / optimistic / flush / barrier / replay seconds,
+trace id, outcome) that ``obs_report --serving`` prints.
 """
 import threading
 import time
 
 from consensus_specs_tpu import faults, supervisor
 from consensus_specs_tpu.forkchoice import proto_array
+from consensus_specs_tpu.obs import flight
 from consensus_specs_tpu.obs import registry as obs_registry
 from consensus_specs_tpu.obs import tracing
 from consensus_specs_tpu.ops import att_prep
@@ -100,7 +112,7 @@ class _WindowBatch(bls.DeferredBatch):
 
 class _Window:
     __slots__ = ("events", "journal", "batch", "accepted", "thread",
-                 "outcome")
+                 "outcome", "ctx", "stats")
 
     def __init__(self, events, journal):
         self.events = events
@@ -109,6 +121,13 @@ class _Window:
         self.accepted = []          # roots accepted by the optimistic pass
         self.thread = None
         self.outcome = None         # True | False | BaseException
+        self.ctx = None             # tracing.TraceContext: the window's
+        #                             trace id + span-tree handoff node
+        self.stats = {}             # per-stage wall clock (window_log)
+
+    @property
+    def trace_id(self):
+        return self.ctx.trace_id if self.ctx is not None else None
 
 
 # -- store journal ----------------------------------------------------------
@@ -231,6 +250,7 @@ class BlockServer:
         self.store = store
         self.window = int(window) if window else _window_depth()
         self.results = {}           # block root -> (accepted, error|None)
+        self.window_log = []        # per-window latency breakdown dicts
         self._events = []
         self._pending_blocks = 0
         self._inflight = None
@@ -304,6 +324,15 @@ class BlockServer:
     def _run_optimistic(self, events, journal) -> "_Window":
         spec, store = self.spec, self.store
         win = _Window(events, journal)
+        # captured while the serving.window span is open (we are inside
+        # _process_window's span), so the flush worker's and barrier's
+        # spans parent under THIS window's node — one causal tree per
+        # window, carrying one trace id end to end
+        win.ctx = tracing.capture_context()
+        t0 = time.perf_counter()
+        stamps = [ev[2] for ev in events
+                  if ev[0] == "block" and ev[2] is not None]
+        win.stats["queued_s"] = t0 - min(stamps) if stamps else 0.0
         results = self.results
         # cross-block message prep: ONE columnar pass over every
         # in-flight block body plus the loose attestation stream,
@@ -353,21 +382,30 @@ class BlockServer:
                         spec.on_attester_slashing(store, ev[1])
                     except _REJECTED:
                         pass
+        win.stats["optimistic_s"] = time.perf_counter() - t0
         return win
 
     def _submit(self, win) -> None:
         """Hand the window's single combined flush to the worker lane;
         it resolves at the NEXT window's barrier (or drain) while the
-        main thread transitions ahead — the overlap."""
+        main thread transitions ahead — the overlap.  The worker adopts
+        the window's captured trace context, so its ``serving.flush``
+        span lands INSIDE the window's tree instead of rooting an
+        orphan subtree on its own thread."""
         def _run():
+            t0 = time.perf_counter()
             try:
-                win.outcome = win.batch.resolve()
+                with tracing.adopt_context(win.ctx), \
+                        tracing.span("serving.flush"):
+                    win.outcome = win.batch.resolve()
             except BaseException as exc:     # surfaces at the barrier
                 win.outcome = exc
+            win.stats["flush_s"] = time.perf_counter() - t0
         win.thread = threading.Thread(
             target=_run, name="serving-flush", daemon=True)
         win.thread.start()
         self._inflight = win
+        flight.record("window", f"submit:{win.trace_id or 0}")
         _C_WINDOWS.add()
 
     def _resolve_inflight(self, extra=None) -> bool:
@@ -380,8 +418,15 @@ class BlockServer:
             return True
         spec, store = self.spec, self.store
         site = "serving.pipeline"
-        with tracing.span("serving.barrier"):
+        t_bar = time.perf_counter()
+        # adopt the WINDOW's context (its worker may still hold it on
+        # the other thread — cross-thread concurrent adoption is the
+        # sanctioned overlap): the barrier span joins the same causal
+        # tree as the transition and the flush it is waiting on
+        with tracing.adopt_context(win.ctx), \
+                tracing.span("serving.barrier"):
             win.thread.join()
+        win.stats["barrier_s"] = time.perf_counter() - t_bar
         outcome = win.outcome
         ok = outcome is True
         if ok and supervisor.audit_due(site):
@@ -405,6 +450,7 @@ class BlockServer:
                     if ev[2] is not None:
                         _H_LATENCY.observe(now - ev[2])
             _C_BLOCKS_PIPE.add(nblocks)
+            self._log_window(win, nblocks, "pipelined")
             return True
         # unwind: newest journal first, rebuild the fork-choice engine
         # from the rolled-back store, replay in original order
@@ -418,6 +464,20 @@ class BlockServer:
         replay = list(win.events)
         if extra is not None:
             replay += extra.events
-        _deliver_sync(spec, store, replay, self.results)
-        _C_BLOCKS_SYNC.add(sum(1 for ev in replay if ev[0] == "block"))
+        t_rep = time.perf_counter()
+        # the rollback + synchronous replay is part of the failing
+        # window's causal story — same tree, same trace id
+        with tracing.adopt_context(win.ctx), \
+                tracing.span("serving.replay"):
+            _deliver_sync(spec, store, replay, self.results)
+        win.stats["replay_s"] = time.perf_counter() - t_rep
+        nblocks = sum(1 for ev in replay if ev[0] == "block")
+        _C_BLOCKS_SYNC.add(nblocks)
+        self._log_window(win, nblocks, "replayed")
         return False
+
+    def _log_window(self, win, nblocks, outcome) -> None:
+        entry = {"trace_id": win.trace_id, "blocks": nblocks,
+                 "outcome": outcome}
+        entry.update(win.stats)
+        self.window_log.append(entry)

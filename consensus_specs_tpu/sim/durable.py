@@ -78,6 +78,11 @@ def main(argv=None) -> int:
         info = {"path": "fresh", "generation": None,
                 "journal_steps": 0, "rungs": []}
     payload = {"digest": result.digest(), "recovery": info}
+    if args.resume:
+        # crash-resume evidence: the resumed process's flight tail
+        # (ladder rung fallbacks included) rides with the digest
+        from consensus_specs_tpu.obs import flight
+        payload["flight"] = flight.dump(trigger="resume")
     if args.digest_out:
         atomic_write_json(args.digest_out, payload)
     else:

@@ -134,6 +134,7 @@ def env_overrides(env, reset_supervisor=True):
     breaker/audit knobs are read from the leg's environment).  Restores
     the prior environment on exit (absent-before means pop)."""
     from consensus_specs_tpu import sanitizer
+    from consensus_specs_tpu.obs import flight
     from consensus_specs_tpu.utils import bls
     bls.clear_verify_memo()
     # drop the sanitizer's shadow effect log between legs: a leg that
@@ -145,6 +146,9 @@ def env_overrides(env, reset_supervisor=True):
         saved[k] = os.environ.get(k)
         os.environ[k] = v
     try:
+        # fresh flight rings per leg, armed per the LEG's environment:
+        # a failing leg's artifact then carries only its own tail
+        flight.reset(refresh_env=True)
         if reset_supervisor:
             supervisor.reset()
         yield

@@ -93,6 +93,29 @@ def serve(server, stream: LoadStream) -> dict:
     return server.drain()
 
 
+def sync_digest(spec, stream: LoadStream) -> str:
+    """Oracle digest: replay the stream through the synchronous control
+    lane (``CS_TPU_SERVING=0``) and reduce the store.  Byte-identity
+    legs (benchmarks, the telemetry smoke) compare a pipelined lane's
+    :func:`store_digest` against this.  Deliberately NOT the full
+    ``harness.env_overrides`` leg discipline: that would reset the
+    flight rings, wiping the armed replay's tail a caller is usually
+    about to dump — only the serving switch is flipped here."""
+    import os
+    from consensus_specs_tpu.serving.pipeline import BlockServer
+    saved = os.environ.get("CS_TPU_SERVING")
+    os.environ["CS_TPU_SERVING"] = "0"
+    try:
+        server = BlockServer(spec, anchor_store(spec, stream))
+        serve(server, stream)
+        return store_digest(spec, server.store)
+    finally:
+        if saved is None:
+            os.environ.pop("CS_TPU_SERVING", None)
+        else:
+            os.environ["CS_TPU_SERVING"] = saved
+
+
 def store_digest(spec, store) -> str:
     """Deep store fingerprint: head, every block's post-state root,
     checkpoints, latest messages, timeliness, equivocations.  Two lanes

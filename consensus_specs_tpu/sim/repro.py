@@ -100,6 +100,11 @@ def dump_artifact(scenario, kind, message, schedule=None, script=None,
         "original_steps": len(scenario.script),
         "env": env_snapshot(),
     }
+    # last-N-events flight tail: what every thread was doing when the
+    # leg failed (span enters/exits, fallback classifications, breaker
+    # transitions) — replay() prints it back
+    from consensus_specs_tpu.obs import flight
+    payload["flight"] = flight.dump(trigger="leg_failure")
     if schedule is not None:
         payload["schedule"] = {
             "triggers": {site: sorted(ns)
@@ -206,6 +211,11 @@ def replay(path: str, fork: str = None, preset: str = None) -> int:
     spec = build_spec(fork, preset, scenario.config_overrides)
     print(f"replaying {scenario.describe()} under {fork}/{preset} "
           f"(triggers={triggers or 'none'})")
+    if payload.get("flight", {}).get("threads"):
+        # the recorded tail from the original failure, before the
+        # re-run overwrites the rings with the replay's own events
+        from consensus_specs_tpu.obs import flight as _flight
+        print(_flight.format_dump(payload["flight"]))
     corrupt = (payload.get("schedule") or {}).get("corrupt") or None
     with _applied_env(payload.get("env") or {}):
         baseline, census = harness.run_baseline(spec, scenario)

@@ -61,6 +61,7 @@ import time
 from contextlib import contextmanager
 
 from consensus_specs_tpu import faults
+from consensus_specs_tpu.obs import flight as _flight
 from consensus_specs_tpu.obs import registry as _obs
 from consensus_specs_tpu.utils import env_flags as _env_flags
 
@@ -210,6 +211,7 @@ def _set_state(br, state) -> None:
         .set(_GAUGE_STATE[state])
     to = "open" if state == "quarantined" else state
     _series(_TRANSITIONS, (br.site, to)).add()
+    _flight.record("breaker", f"{br.site}:{state}")
 
 
 def _open(br, cfg) -> None:
@@ -348,6 +350,7 @@ def quarantine(site: str, detail: str = "") -> None:
         return
     br.reopen_at = None
     _series(_QUARANTINES, site).add()
+    _flight.record("quarantine", f"{site}:{detail}"[:160])
     _set_state(br, "quarantined")
     _last_quarantine = _quarantine_hook(site, detail)
 
@@ -366,6 +369,9 @@ def _default_quarantine_dump(site: str, detail: str):
         "env": {k: v for k, v in sorted(os.environ.items())
                 if k.startswith("CS_TPU_")},
         "breakers": states(),
+        # last-N-events tail: what the process was doing when the site
+        # went dark (sim.repro prints it when replaying the artifact)
+        "flight": _flight.dump(trigger="quarantine"),
     }
     path = os.path.join(
         out_dir, f"quarantine_{site.replace('.', '-')}_{_quarantine_seq}.json")

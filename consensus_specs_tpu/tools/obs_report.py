@@ -3,7 +3,8 @@ and print any exporter's view.
 
     python -m consensus_specs_tpu.tools.obs_report \
         [--slots 32] [--validators 64] [--fork phase0] \
-        [--preset minimal] [--format table|json|prom] [--no-trace]
+        [--preset minimal] [--format table|json|prom] [--no-trace] \
+        [--serving] [--trace-out trace.json]
 
 Builds a mock-genesis state (``test_infra.genesis``), applies one empty
 block per slot through the full ``state_transition`` (signatures off,
@@ -12,6 +13,14 @@ snapshot.  This is the acceptance surface for the telemetry subsystem:
 with profiling on, a 32-slot replay must produce a span tree rooted at
 ``state_transition`` and a snapshot with backend-labeled merkle pair
 counts, fork-choice path counters, and epoch path counters.
+
+``--serving`` swaps the workload for a pipelined block-serving replay
+of a ``sim/load`` stream (``--scenario``/``--seed``/``--window``) and
+prints the per-window latency breakdown from ``BlockServer.window_log``
+— queue wait, optimistic transition, worker-lane flush, barrier,
+replay — one causally-linked span tree per window.  ``--trace-out``
+additionally writes the flight recorder's rings as Chrome-trace JSON
+(load it in Perfetto / chrome://tracing).
 
 ``replay()`` is importable — ``benchmarks/bench_obs_overhead.py`` uses
 it as the workload for the disabled-overhead micro-bench.
@@ -56,6 +65,32 @@ def replay(spec, state, slots: int) -> None:
         spec.get_head(store)
 
 
+def serving_replay(spec, seed: int, name: str, window: int):
+    """Replay a captured ``sim/load`` stream through the pipelined
+    ``BlockServer`` and return the server (its ``window_log`` carries
+    the per-window latency breakdown).  BLS must already be off."""
+    from consensus_specs_tpu.serving.pipeline import BlockServer
+    from consensus_specs_tpu.sim import load
+    stream = load.generate(spec, seed=seed, name=name)
+    server = BlockServer(spec, load.anchor_store(spec, stream),
+                         window=window)
+    load.serve(server, stream)
+    return server
+
+
+def _print_window_table(window_log) -> None:
+    cols = ("queued_s", "optimistic_s", "flush_s", "barrier_s",
+            "replay_s")
+    print(f"{'trace':>5} {'blocks':>6} {'outcome':>9} "
+          + " ".join(f"{c[:-2]:>10}" for c in cols))
+    for entry in window_log:
+        cells = " ".join(
+            f"{entry[c] * 1e3:9.2f}m" if c in entry else f"{'-':>10}"
+            for c in cols)
+        print(f"{entry['trace_id'] or '-':>5} {entry['blocks']:>6} "
+              f"{entry['outcome']:>9} {cells}")
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="replay a slot window with full telemetry")
@@ -67,6 +102,19 @@ def main(argv=None) -> int:
                         choices=["table", "json", "prom"])
     parser.add_argument("--no-trace", action="store_true",
                         help="spans without per-span counter deltas")
+    parser.add_argument("--serving", action="store_true",
+                        help="workload = pipelined block-serving replay "
+                             "of a sim/load stream (per-window latency "
+                             "breakdown)")
+    parser.add_argument("--scenario", default="equivocation",
+                        help="sim/load scenario for --serving")
+    parser.add_argument("--seed", type=int, default=3,
+                        help="sim/load seed for --serving")
+    parser.add_argument("--window", type=int, default=3,
+                        help="serving window depth for --serving")
+    parser.add_argument("--trace-out", default=None, metavar="PATH",
+                        help="write the flight rings as Chrome-trace "
+                             "JSON after the replay")
     args = parser.parse_args(argv)
 
     from consensus_specs_tpu import obs
@@ -75,21 +123,39 @@ def main(argv=None) -> int:
 
     bls.bls_active = False
     spec = build_spec(args.fork, args.preset)
-    state = build_state(spec, args.validators)
     obs.reset_all()
     obs.enable(True, counters=not args.no_trace)
+    server = None
     try:
-        replay(spec, state, args.slots)
+        if args.serving:
+            server = serving_replay(spec, args.seed, args.scenario,
+                                    args.window)
+        else:
+            state = build_state(spec, args.validators)
+            replay(spec, state, args.slots)
     finally:
         obs.enable(False)
+    if args.trace_out:
+        from consensus_specs_tpu.obs import flight
+        flight.write_chrome_trace(args.trace_out)
+        print(f"chrome trace -> {args.trace_out} "
+              f"({flight.record_count()} flight records)",
+              file=sys.stderr)
 
     if args.format == "json":
         print(obs.to_json(indent=2))
     elif args.format == "prom":
         sys.stdout.write(obs.to_prometheus())
     else:
-        print(f"== {args.slots}-slot {args.fork}/{args.preset} replay, "
-              f"{args.validators} validators ==")
+        if args.serving:
+            print(f"== serving replay {args.scenario}[seed={args.seed}] "
+                  f"window={args.window} under {args.fork}/{args.preset} "
+                  f"==")
+            _print_window_table(server.window_log)
+            print()
+        else:
+            print(f"== {args.slots}-slot {args.fork}/{args.preset} "
+                  f"replay, {args.validators} validators ==")
         print(obs.report())
         # supervisor health: per-site breaker states (the machine view
         # is the supervisor.* metric series above / in the exporters)

@@ -1,10 +1,13 @@
 """observability pass (O5xx): hot-path instrumentation must use the
-zero-overhead guard patterns of ``consensus_specs_tpu/obs``.
+zero-overhead guard patterns of ``consensus_specs_tpu/obs``, and
+telemetry structure must survive threads and exceptions.
 
-Scope: the hot-path packages — ``consensus_specs_tpu/ops/``,
+Two scopes:
+
+**Hot-path scope** (``consensus_specs_tpu/ops/``,
 ``consensus_specs_tpu/utils/ssz/``, ``consensus_specs_tpu/forkchoice/``
 — where a per-event instrumentation slip multiplies by the validator /
-chunk / node count.
+chunk / node count):
 
 * O501 — bare wall-clock call (``time.perf_counter()`` / ``time.time()``
   / ``time.monotonic()``) inside a function in a hot-path file.  Ad-hoc
@@ -18,10 +21,27 @@ chunk / node count.
   (``_C_X = registry.counter("...").labels(...)``) and bump the bound
   handle (``_C_X.add()``) on the hot path.
 
-Module-scope statements are exempt (that is where pre-binding lives),
-as is ``obs/`` itself and anything under tests/ or benchmarks/ (not in
-scope anyway).  Intentional cold-path uses inside scoped files carry
-``# noqa: O501`` / ``# noqa: O502``.
+**Engine scope** (all of ``consensus_specs_tpu/`` except ``obs/``
+itself and ``tools/``):
+
+* O503 — a ``span(...)`` / ``tracing.span(...)`` call that is not the
+  context expression of a ``with`` item.  A span entered by hand leaks
+  its frame on any exception between enter and exit, corrupting the
+  tree for the rest of the process (the stack heals lazily, but the
+  span's times are garbage).  Functions that do manual management with
+  a ``try/finally`` whose finally calls ``.__exit__`` are exempt.
+* O504 — a ``threading.Thread(...)`` / ``Thread(...)`` construction in
+  a function whose subtree never references ``capture_context`` /
+  ``adopt_context`` (``obs.tracing``).  Spans opened on such a thread
+  root an ``[orphan thread]`` tree instead of joining the request's —
+  the exact cross-thread causality loss the trace-context API exists
+  to prevent.  Deliberately contextless threads carry
+  ``# noqa: O504``.
+
+Module-scope statements are exempt from O501/O502 (that is where
+pre-binding lives), as is ``obs/`` itself and anything under tests/ or
+benchmarks/ (not in scope anyway).  Intentional exceptions carry
+``# noqa: O50x``.
 """
 import ast
 
@@ -29,12 +49,12 @@ from ..findings import Finding
 
 NAME = "obs"
 CODE_PREFIXES = ("O",)
-VERSION = 1
+VERSION = 2
 GRANULARITY = "file"
 
 
 def in_scope(rel: str) -> bool:
-    return _in_scope(rel)
+    return _in_scope(rel) or _in_engine_scope(rel)
 
 
 def check_file(ctx, rel):
@@ -54,6 +74,20 @@ _RESOLVE_FNS = {"counter", "gauge", "histogram"}
 
 def _in_scope(path: str) -> bool:
     return any(path.startswith(p) for p in HOT_PREFIXES)
+
+
+# O503/O504 scope: the whole engine tree except the telemetry package
+# itself (it implements the machinery these rules police) and tools/
+# (CLIs, the linter)
+_ENGINE_EXEMPT = (
+    "consensus_specs_tpu/obs/",
+    "consensus_specs_tpu/tools/",
+)
+
+
+def _in_engine_scope(path: str) -> bool:
+    return (path.startswith("consensus_specs_tpu/")
+            and not any(path.startswith(p) for p in _ENGINE_EXEMPT))
 
 
 def _is_clock_call(node) -> bool:
@@ -83,14 +117,104 @@ def _is_metric_resolution(node) -> bool:
     return False
 
 
+def _is_span_call(node) -> bool:
+    """``span("x")`` / ``tracing.span("x")``-shaped."""
+    fn = node.func
+    if isinstance(fn, ast.Name) and fn.id == "span":
+        return True
+    return isinstance(fn, ast.Attribute) and fn.attr == "span"
+
+
+def _is_thread_call(node) -> bool:
+    """``Thread(...)`` / ``threading.Thread(...)`` construction."""
+    fn = node.func
+    if isinstance(fn, ast.Name) and fn.id == "Thread":
+        return True
+    return isinstance(fn, ast.Attribute) and fn.attr == "Thread"
+
+
+_CTX_NAMES = ("capture_context", "adopt_context")
+
+
+def _references_trace_context(fn_node) -> bool:
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Name) and n.id in _CTX_NAMES:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _CTX_NAMES:
+            return True
+    return False
+
+
+def _has_manual_exit(fn_node) -> bool:
+    """A ``try/finally`` whose finally calls ``.__exit__``: the one
+    sanctioned shape for hand-managed spans."""
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Try) and n.finalbody:
+            for f in n.finalbody:
+                for c in ast.walk(f):
+                    if isinstance(c, ast.Call) \
+                            and isinstance(c.func, ast.Attribute) \
+                            and c.func.attr == "__exit__":
+                        return True
+    return False
+
+
+def _engine_findings(path: str, tree) -> list:
+    """O503/O504 over one engine-scope file."""
+    findings = []
+    # span calls that ARE with-item context expressions are the
+    # sanctioned shape — collect their node identities first
+    with_ctx = set()
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.With, ast.AsyncWith)):
+            for item in n.items:
+                with_ctx.add(id(item.context_expr))
+
+    def _visit(node, fn_stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _visit(child, fn_stack + [child])
+                continue
+            if isinstance(child, ast.Call) and fn_stack:
+                enclosing = fn_stack[-1]
+                if _is_span_call(child) and id(child) not in with_ctx \
+                        and not _has_manual_exit(enclosing):
+                    findings.append(Finding(
+                        path, child.lineno, "O503",
+                        "span() entered outside a with statement — an "
+                        "exception between enter and exit leaks the "
+                        "frame and corrupts the span tree; use 'with "
+                        "span(...):' (or try/finally calling __exit__)"))
+                elif _is_thread_call(child) \
+                        and not _references_trace_context(enclosing):
+                    findings.append(Finding(
+                        path, child.lineno, "O504",
+                        "thread submitted without trace context — spans "
+                        "on this thread will root an [orphan thread] "
+                        "tree; capture_context() at the submit site and "
+                        "adopt_context() in the worker (obs.tracing)"))
+            _visit(child, fn_stack)
+
+    _visit(tree, [])
+    return findings
+
+
 def check_source(path: str, text: str):
     """All O5xx findings for one file (``path`` repo-relative)."""
-    if not _in_scope(path):
+    hot = _in_scope(path)
+    engine = _in_engine_scope(path)
+    if not (hot or engine):
         return []
     try:
         tree = ast.parse(text, filename=path)
     except SyntaxError:
         return []    # the style pass owns E999
+    if engine:
+        engine_findings = _engine_findings(path, tree)
+        if not hot:
+            return engine_findings
+    else:
+        engine_findings = []
     findings = []
 
     # every Call node that sits INSIDE a function body; module scope
@@ -126,13 +250,13 @@ def check_source(path: str, text: str):
         if key not in seen:
             seen.add(key)
             out.append(f)
-    return out
+    return out + engine_findings
 
 
 def run(ctx):
     findings = []
     for rel in ctx.py_files:
-        if not _in_scope(rel):
+        if not in_scope(rel):
             continue
         findings.extend(check_source(rel, ctx.source(rel)))
     return findings

@@ -38,6 +38,11 @@ ENGINE_SWITCHES = (
     "CS_TPU_MESH",
     "CS_TPU_CHECKPOINT",
     "CS_TPU_SERVING",
+    # observability, not an engine (no consensus result depends on it),
+    # but it shares the switch contract: the flight recorder
+    # (``obs/flight.py``) is on unless CS_TPU_FLIGHT=0.  Ring size is a
+    # knob, CS_TPU_FLIGHT_SIZE (default 1024 slots per thread).
+    "CS_TPU_FLIGHT",
 )
 
 _SWITCH_DEFAULTS = {}

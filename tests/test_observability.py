@@ -520,3 +520,347 @@ def test_gen_runner_case_errors_are_obs_accounted():
             gen_runner.generate_test_vector(
                 _Case(lambda: (_ for _ in ()).throw(
                     faults.InjectedFault("bls.flush", 1))), tmp, [])
+
+
+# ---------------------------------------------------------------------------
+# cross-thread trace context (obs.tracing.capture_context/adopt_context)
+# ---------------------------------------------------------------------------
+
+def test_capture_context_disabled_returns_none():
+    assert tracing.capture_context() is None
+    # adopting a None context is a no-op (the disabled fast path), not
+    # an error — callers never branch on the gate themselves
+    with tracing.adopt_context(None):
+        pass
+    assert tracing.span_tree() == {}
+
+
+def test_adopted_worker_spans_join_the_request_tree():
+    import threading
+    tracing.enable(True, counters=False)
+    with tracing.span("req"):
+        ctx = tracing.capture_context()
+        assert ctx is not None and ctx.trace_id >= 1
+
+        def _work():
+            with tracing.adopt_context(ctx):
+                with tracing.span("work"):
+                    pass
+
+        t = threading.Thread(target=_work)
+        t.start()
+        t.join()
+    tree = tracing.span_tree()
+    # ONE causal tree: the worker span is a child of the request span,
+    # not a disjoint root, and nothing is orphan-flagged
+    assert tree["req"]["children"]["work"]["count"] == 1
+    assert "work" not in tree
+    assert "orphan" not in tree["req"]
+    assert "orphan" not in tree["req"]["children"]["work"]
+
+
+def test_adopt_context_exception_unwinds_cleanly():
+    import threading
+    tracing.enable(True, counters=False)
+    caught = []
+    with tracing.span("req"):
+        ctx = tracing.capture_context()
+
+        def _work():
+            try:
+                with tracing.adopt_context(ctx):
+                    with tracing.span("boom"):
+                        raise ValueError("worker failure")
+            except ValueError:
+                caught.append(True)
+
+        t = threading.Thread(target=_work)
+        t.start()
+        t.join()
+    assert caught == [True]
+    tree = tracing.span_tree()
+    assert tree["req"]["children"]["boom"]["count"] == 1
+    assert "boom" not in tree
+
+
+def test_adopt_context_pops_leaked_spans():
+    """A worker that hand-enters a span inside ``adopt_context`` and
+    never exits it must not poison the thread's stack: the adopt exit
+    pops every frame above (and including) the adopted node."""
+    import threading
+    tracing.enable(True, counters=False)
+    with tracing.span("req"):
+        ctx = tracing.capture_context()
+
+        def _work():
+            with tracing.adopt_context(ctx):
+                leaked = tracing.span("leaked")
+                leaked.__enter__()          # deliberately never exited
+            # stack healed: a fresh span roots at the worker's own root
+            # (an orphan, since this thread holds no context now)
+            with tracing.span("after"):
+                pass
+
+        t = threading.Thread(target=_work)
+        t.start()
+        t.join()
+    tree = tracing.span_tree()
+    assert "leaked" in tree["req"]["children"]
+    assert tree["after"]["orphan"] is True
+    assert "after" not in tree["req"]["children"]
+
+
+def test_double_adopt_same_thread_refused():
+    tracing.enable(True, counters=False)
+    with tracing.span("req"):
+        ctx = tracing.capture_context()
+        with tracing.adopt_context(ctx):
+            with pytest.raises(RuntimeError, match="double-adopt"):
+                with tracing.adopt_context(ctx):
+                    pass
+        # the refusal must not have broken the outer adoption: the
+        # stack still carries the request node
+        with tracing.span("again"):
+            pass
+    tree = tracing.span_tree()
+    assert "again" in tree["req"]["children"]
+
+
+def test_nested_adoption_of_inner_span_context():
+    """Capturing deeper inside the tree parents worker spans at that
+    depth, not at the root."""
+    import threading
+    tracing.enable(True, counters=False)
+    with tracing.span("outer"):
+        with tracing.span("inner"):
+            ctx = tracing.capture_context()
+
+            def _work():
+                with tracing.adopt_context(ctx), tracing.span("deep"):
+                    pass
+
+            t = threading.Thread(target=_work)
+            t.start()
+            t.join()
+    tree = tracing.span_tree()
+    inner = tree["outer"]["children"]["inner"]
+    assert inner["children"]["deep"]["count"] == 1
+
+
+def test_orphan_thread_spans_flagged_in_tree_and_report():
+    """Satellite regression: a thread that opens spans WITHOUT adopting
+    a context roots a flagged ``[orphan thread]`` tree — visible, never
+    silently merged with the main tree."""
+    import threading
+    tracing.enable(True, counters=False)
+    with tracing.span("main.work"):
+        pass
+
+    def _work():
+        with tracing.span("stray"):
+            pass
+
+    t = threading.Thread(target=_work)
+    t.start()
+    t.join()
+    tree = tracing.span_tree()
+    assert tree["stray"]["orphan"] is True
+    assert "orphan" not in tree["main.work"]
+    text = export.report()
+    assert "[orphan thread]" in text
+    # the schema tolerates the flag (snapshot stays exporter-valid)
+    assert export.schema_problems(export.snapshot()) == []
+
+
+# ---------------------------------------------------------------------------
+# thread model (the registry.py contract)
+# ---------------------------------------------------------------------------
+
+def test_counter_hammer_two_threads():
+    """The zero-lost-increment contract documented in obs/registry.py:
+    bound-series ``add()`` is a single eval run on this interpreter, so
+    two threads hammering one series under a 1µs switch interval lose
+    nothing."""
+    import sys
+    import threading
+    series = registry.counter("t.hammer").labels()
+    n = 200_000
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-6)
+    try:
+        def _work():
+            add = series.add
+            for _ in range(n):
+                add()
+
+        threads = [threading.Thread(target=_work) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        sys.setswitchinterval(old)
+    assert series.n == 2 * n
+
+
+def test_histogram_concurrent_observe_consistent():
+    """Histogram ``observe`` takes the per-series lock (multi-field
+    update): concurrent observers lose no events and the bucket counts
+    sum to the total."""
+    import sys
+    import threading
+    h = registry.histogram("t.hammer.hist", buckets=(0.5,)).labels()
+    n = 50_000
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-6)
+    try:
+        def _work(v):
+            for _ in range(n):
+                h.observe(v)
+
+        threads = [threading.Thread(target=_work, args=(v,))
+                   for v in (0.1, 0.9)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        sys.setswitchinterval(old)
+    val = h._value()
+    assert val["count"] == 2 * n
+    assert val["buckets"] == {"0.5": n, "+Inf": n}
+    assert val["min"] == 0.1 and val["max"] == 0.9
+
+
+# ---------------------------------------------------------------------------
+# flight recorder (obs.flight)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def _flight():
+    from consensus_specs_tpu.obs import flight
+    flight.reset(refresh_env=True)
+    flight.enable(True)
+    yield flight
+    flight.reset(refresh_env=True)
+
+
+def test_flight_ring_wraparound(_flight, monkeypatch):
+    monkeypatch.setenv("CS_TPU_FLIGHT_SIZE", "8")
+    _flight.reset(refresh_env=True)
+    _flight.enable(True)        # the off-leg (CS_TPU_FLIGHT=0) pins
+    #                             the env default off; force-arm
+    for i in range(20):
+        _flight.record("note", f"n{i}")
+    d = _flight.dump(trigger="manual")
+    recs = d["threads"]["MainThread"]
+    # the ring keeps exactly the LAST size records, in sequence order
+    assert len(recs) == 8
+    assert d["dropped"] == 12
+    assert [r[3] for r in recs] == [f"n{i}" for i in range(12, 20)]
+    seqs = [r[0] for r in recs]
+    assert seqs == sorted(seqs)
+
+
+def test_flight_disabled_records_nothing(_flight):
+    _flight.enable(False)
+    _flight.record("note", "dropped-on-floor")
+    assert _flight.record_count() == 0
+    d = _flight.dump(trigger="manual")
+    assert d["enabled"] is False
+    assert d["threads"] == {}
+
+
+def test_flight_dump_counters_and_format(_flight):
+    with counting() as delta:
+        _flight.record("note", "hello", 1.5)
+        d = _flight.dump(trigger="manual")
+    assert delta["obs.flight.records"] == 1
+    assert delta["obs.flight.dumps{trigger=manual}"] == 1
+    text = _flight.format_dump(d)
+    assert "hello" in text and "MainThread" in text
+
+
+def test_flight_spans_recorded_and_chrome_export(_flight, tmp_path):
+    tracing.enable(True, counters=False)
+    with tracing.span("t.flight.outer"):
+        with tracing.span("t.flight.inner"):
+            pass
+    d = _flight.dump(trigger="manual")
+    codes = [(r[2], r[3]) for r in d["threads"]["MainThread"]]
+    assert ("span>", "t.flight.outer") in codes
+    assert ("span<", "t.flight.inner") in codes
+    # enters before exits, outer brackets inner
+    assert codes.index(("span>", "t.flight.outer")) \
+        < codes.index(("span>", "t.flight.inner")) \
+        < codes.index(("span<", "t.flight.inner")) \
+        < codes.index(("span<", "t.flight.outer"))
+    out = tmp_path / "trace.json"
+    _flight.write_chrome_trace(str(out), d)
+    trace = json.loads(out.read_text())
+    events = trace["traceEvents"]
+    names = {e["name"] for e in events if e["ph"] == "X"}
+    assert {"t.flight.outer", "t.flight.inner"} <= names
+    assert any(e["ph"] == "M" for e in events)
+
+
+def test_flight_cross_thread_dump_merged_by_thread(_flight):
+    import threading
+
+    def _work():
+        _flight.record("note", "from-worker")
+
+    t = threading.Thread(target=_work, name="t-flight-worker")
+    t.start()
+    t.join()
+    _flight.record("note", "from-main")
+    d = _flight.dump(trigger="manual")
+    assert [r[3] for r in d["threads"]["t-flight-worker"]] \
+        == ["from-worker"]
+    assert "from-main" in [r[3] for r in d["threads"]["MainThread"]]
+
+
+# ---------------------------------------------------------------------------
+# live telemetry plane (obs.serve)
+# ---------------------------------------------------------------------------
+
+def _http_get(url):
+    import urllib.error
+    import urllib.request
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read()
+
+
+def test_http_plane_endpoints_and_health_flip():
+    from consensus_specs_tpu import supervisor
+    registry.counter("t.http.seen").labels().add(3)
+    supervisor.reset()
+    try:
+        with obs.serve(0) as srv:
+            code, body = _http_get(srv.url + "/metrics")
+            assert code == 200
+            assert b"cs_tpu_t_http_seen" in body
+            code, body = _http_get(srv.url + "/healthz")
+            assert code == 200 and json.loads(body)["ok"] is True
+            code, body = _http_get(srv.url + "/snapshot")
+            assert code == 200
+            snap = json.loads(body)
+            assert export.schema_problems(snap) == []
+            code, _ = _http_get(srv.url + "/nope")
+            assert code == 404
+            # forced quarantine flips /healthz non-200, naming the site
+            with supervisor.quarantine_hook(lambda s, d: None):
+                supervisor.quarantine("t.http.site", "forced by test")
+            code, body = _http_get(srv.url + "/healthz")
+            health = json.loads(body)
+            assert code == 503 and health["ok"] is False
+            assert "t.http.site" in health["quarantined"]
+            supervisor.reset()
+            code, _ = _http_get(srv.url + "/healthz")
+            assert code == 200
+        assert registry.counter("obs.http.requests").total() >= 6
+    finally:
+        supervisor.reset()

@@ -485,6 +485,99 @@ def test_obs_noqa_suppression():
     assert [f for f in findings if not suppressed(f, lines)] == []
 
 
+ENGINE = "consensus_specs_tpu/serving/pipeline.py"   # engine scope
+
+
+def test_obs_flags_span_outside_with():
+    """O503: a hand-entered span leaks its frame on any exception
+    between enter and exit."""
+    src = (
+        "from consensus_specs_tpu.obs.tracing import span\n"
+        "def f(xs):\n"
+        "    s = span('engine.work')\n"
+        "    s.__enter__()\n"
+        "    work(xs)\n"
+        "    s.__exit__(None, None, None)\n")
+    findings = obs_pass.check_source(ENGINE, src)
+    assert _codes(findings) == ["O503"]
+    assert findings[0].line == 3
+
+
+def test_obs_accepts_with_span_and_manual_finally():
+    """The with-item shape (including multi-item withs) and the
+    try/finally-__exit__ shape are both sanctioned — zero findings."""
+    src = (
+        "from consensus_specs_tpu.obs import tracing\n"
+        "from consensus_specs_tpu.obs.tracing import span\n"
+        "def g(xs, ctx):\n"
+        "    with tracing.adopt_context(ctx), \\\n"
+        "            tracing.span('engine.flush'):\n"
+        "        work(xs)\n"
+        "    with span('engine.other'):\n"
+        "        work(xs)\n"
+        "def h(xs):\n"
+        "    s = span('engine.manual')\n"
+        "    s.__enter__()\n"
+        "    try:\n"
+        "        work(xs)\n"
+        "    finally:\n"
+        "        s.__exit__(None, None, None)\n")
+    assert obs_pass.check_source(ENGINE, src) == []
+
+
+def test_obs_flags_contextless_thread_submit():
+    """O504: spans on a thread submitted without captured trace context
+    root an [orphan thread] tree."""
+    src = (
+        "import threading\n"
+        "def submit(win):\n"
+        "    win.thread = threading.Thread(target=win.run, daemon=True)\n"
+        "    win.thread.start()\n")
+    findings = obs_pass.check_source(ENGINE, src)
+    assert _codes(findings) == ["O504"]
+    assert findings[0].line == 3
+
+
+def test_obs_accepts_context_passing_thread_submit():
+    """Referencing capture_context/adopt_context anywhere in the
+    submitting function's subtree (the worker closure counts) clears
+    O504."""
+    src = (
+        "import threading\n"
+        "from consensus_specs_tpu.obs import tracing\n"
+        "def submit(win):\n"
+        "    win.ctx = tracing.capture_context()\n"
+        "    def _run():\n"
+        "        with tracing.adopt_context(win.ctx):\n"
+        "            win.run()\n"
+        "    win.thread = threading.Thread(target=_run, daemon=True)\n"
+        "    win.thread.start()\n")
+    assert obs_pass.check_source(ENGINE, src) == []
+
+
+def test_obs_engine_scope_boundaries():
+    """O503/O504 cover the engine tree but not obs/ itself, tools/, or
+    hot-path-only extras; O501/O502 stay confined to HOT_PREFIXES."""
+    span_src = (
+        "from consensus_specs_tpu.obs.tracing import span\n"
+        "def f():\n"
+        "    s = span('x')\n"
+        "    s.__enter__()\n")
+    assert _codes(obs_pass.check_source(ENGINE, span_src)) == ["O503"]
+    assert obs_pass.check_source(
+        "consensus_specs_tpu/obs/http.py", span_src) == []
+    assert obs_pass.check_source(
+        "consensus_specs_tpu/tools/obs_report.py", span_src) == []
+    assert obs_pass.check_source("tests/test_x.py", span_src) == []
+    # engine scope outside HOT_PREFIXES gets O503/O504 but not O501
+    clock_src = (
+        "import time\n"
+        "def f():\n"
+        "    return time.perf_counter()\n")
+    assert obs_pass.check_source(ENGINE, clock_src) == []
+    assert _codes(obs_pass.check_source(SCOPED, clock_src)) == ["O501"]
+
+
 # ---------------------------------------------------------------------------
 # style pass / lint.py shim
 # ---------------------------------------------------------------------------
